@@ -1,0 +1,15 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's clusterless testkit approach (reference:
+util/testkit, store/mockstore) — multi-"node" behavior is simulated
+in-process. Env vars must be set before jax initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

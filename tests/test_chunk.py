@@ -1,0 +1,99 @@
+import datetime
+
+import numpy as np
+
+from tidb_tpu.chunk import Chunk, Column, Dictionary
+from tidb_tpu.types import (
+    Decimal,
+    bigint_type,
+    date_type,
+    decimal_type,
+    varchar_type,
+)
+
+
+class TestColumn:
+    def test_bigint_with_nulls(self):
+        col = Column.from_values(bigint_type(), [1, None, 3])
+        assert col.to_pylist() == [1, None, 3]
+        assert col.data.dtype == np.int64
+
+    def test_decimal_encoding(self):
+        ft = decimal_type(15, 2)
+        col = Column.from_values(ft, ["1.50", Decimal.parse("2.25"), 3])
+        assert col.data.tolist() == [150, 225, 300]
+        assert col.to_pylist() == [
+            Decimal.parse("1.50"),
+            Decimal.parse("2.25"),
+            Decimal.parse("3.00"),
+        ]
+
+    def test_date_encoding(self):
+        col = Column.from_values(date_type(), ["1994-01-01", None])
+        assert col.data.dtype == np.int32
+        assert col.to_pylist() == [datetime.date(1994, 1, 1), None]
+
+    def test_string_dictionary(self):
+        d = Dictionary()
+        col = Column.from_values(varchar_type(), ["a", "b", "a", None], d)
+        assert col.data[0] == col.data[2]
+        assert col.to_pylist() == ["a", "b", "a", None]
+        assert len(d) == 2
+
+    def test_dictionary_code_table(self):
+        d = Dictionary(["AIR", "MAIL", "SHIP"])
+        table = d.code_table(lambda s: s in ("AIR", "SHIP"))
+        assert table.tolist() == [True, False, True]
+
+    def test_dictionary_sort_ranks(self):
+        d = Dictionary(["b", "a", "c"])
+        assert d.sort_ranks().tolist() == [1, 0, 2]
+
+    def test_take_and_append(self):
+        a = Column.from_values(bigint_type(), [1, 2, 3])
+        b = Column.from_values(bigint_type(), [4, None])
+        c = a.append(b)
+        assert c.to_pylist() == [1, 2, 3, 4, None]
+        assert c.take(np.array([4, 0])).to_pylist() == [None, 1]
+
+
+class TestChunk:
+    def test_rows(self):
+        ch = Chunk(
+            [
+                Column.from_values(bigint_type(), [1, 2]),
+                Column.from_values(varchar_type(), ["x", "y"]),
+            ]
+        )
+        assert ch.to_pylist() == [(1, "x"), (2, "y")]
+
+    def test_concat(self):
+        a = Chunk([Column.from_values(bigint_type(), [1])])
+        b = Chunk([Column.from_values(bigint_type(), [2, 3])])
+        assert Chunk.concat([a, b]).to_pylist() == [(1,), (2,), (3,)]
+
+
+class TestReviewRegressions:
+    def test_append_foreign_dictionary_reencodes(self):
+        a = Column.from_values(varchar_type(), ["x"])
+        b = Column.from_values(varchar_type(), ["y"])
+        assert a.append(b).to_pylist() == ["x", "y"]
+
+    def test_append_scale_mismatch_rejected(self):
+        import pytest
+        c = Column.from_values(decimal_type(15, 2), ["1.00"])
+        d = Column.from_values(decimal_type(15, 3), ["1.000"])
+        with pytest.raises(AssertionError):
+            c.append(d)
+
+    def test_concat_column_count_mismatch_rejected(self):
+        import pytest
+        a = Chunk([Column.from_values(bigint_type(), [1])])
+        b = Chunk([Column.from_values(bigint_type(), [2]),
+                   Column.from_values(bigint_type(), [3])])
+        with pytest.raises(AssertionError):
+            Chunk.concat([a, b])
+
+    def test_float_decimal_ingest_half_away(self):
+        col = Column.from_values(decimal_type(15, 2), [0.125, -0.125])
+        assert col.data.tolist() == [13, -13]

@@ -1,0 +1,95 @@
+import datetime
+
+import pytest
+
+from tidb_tpu.types import Decimal, decimal_type
+from tidb_tpu.types.value import (
+    decode_date,
+    decode_datetime,
+    encode_date,
+    encode_datetime,
+    parse_date,
+    parse_datetime,
+)
+
+
+class TestDecimal:
+    def test_parse_and_str(self):
+        assert str(Decimal.parse("123.45")) == "123.45"
+        assert str(Decimal.parse("-0.05")) == "-0.05"
+        assert str(Decimal.parse("7")) == "7"
+        assert Decimal.parse("3.140").unscaled == 3140
+        assert Decimal.parse("3.140").scale == 3
+
+    def test_add_sub_mixed_scale(self):
+        a = Decimal.parse("1.5")
+        b = Decimal.parse("2.25")
+        assert str(a + b) == "3.75"
+        assert str(a - b) == "-0.75"
+
+    def test_mul_scale_sums(self):
+        a = Decimal.parse("1.10")  # scale 2
+        b = Decimal.parse("0.06")  # scale 2
+        c = a * b
+        assert c.scale == 4
+        assert str(c) == "0.0660"
+
+    def test_div_mysql_scale(self):
+        # MySQL: scale(dividend) + div_precincrement(4)
+        a = Decimal.parse("10.00")
+        b = Decimal.parse("3")
+        q = a.div(b)
+        assert q.scale == 6
+        assert str(q) == "3.333333"
+
+    def test_div_rounding_half_away(self):
+        q = Decimal.parse("1").div(Decimal.parse("8"))  # 0.125 at scale 4
+        assert str(q) == "0.1250"
+        # dividend scale 5 + increment 4 => result scale 9
+        q2 = Decimal.parse("0.00005").div(Decimal.parse("1"))
+        assert str(q2) == "0.000050000"
+        # rounding half away from zero on the last kept digit
+        q3 = Decimal.parse("0.15").div(Decimal.parse("10"), incr_scale=0)
+        assert str(q3) == "0.02"
+
+    def test_rescale_rounds_half_away_from_zero(self):
+        assert str(Decimal.parse("2.345").rescale(2)) == "2.35"
+        assert str(Decimal.parse("-2.345").rescale(2)) == "-2.35"
+        assert str(Decimal.parse("2.344").rescale(2)) == "2.34"
+
+    def test_compare(self):
+        assert Decimal.parse("1.5") == Decimal.parse("1.50")
+        assert Decimal.parse("1.5") < Decimal.parse("1.51")
+        assert Decimal.parse("-2") < Decimal.parse("0.1")
+
+    def test_precision_cap(self):
+        with pytest.raises(ValueError):
+            decimal_type(19, 2)
+
+
+class TestTemporal:
+    def test_date_roundtrip(self):
+        d = datetime.date(1994, 1, 1)
+        assert decode_date(encode_date(d)) == d
+        assert encode_date(datetime.date(1970, 1, 1)) == 0
+
+    def test_parse_date(self):
+        assert decode_date(parse_date("1998-12-01")) == datetime.date(1998, 12, 1)
+
+    def test_datetime_roundtrip(self):
+        dt = datetime.datetime(2024, 5, 17, 13, 45, 30, 123456)
+        assert decode_datetime(encode_datetime(dt)) == dt
+
+    def test_parse_datetime(self):
+        got = decode_datetime(parse_datetime("2024-05-17 13:45:30"))
+        assert got == datetime.datetime(2024, 5, 17, 13, 45, 30)
+        got2 = decode_datetime(parse_datetime("2024-05-17"))
+        assert got2 == datetime.datetime(2024, 5, 17)
+
+
+class TestReviewRegressions:
+    def test_div_single_rounding(self):
+        # exact quotient 0.4451; half-away to 1 decimal is 0.4 (not the
+        # double-rounded 0.5)
+        q = Decimal.parse("4451").div(Decimal.parse("10000"), incr_scale=1)
+        assert str(q) == "0.4"

@@ -1,0 +1,20 @@
+"""TiTPU — a TPU-native distributed SQL (HTAP) framework.
+
+A from-scratch framework with the capabilities of the surveyed reference
+(TiDB, see SURVEY.md): SQL frontend, cost-based planner, transactional
+storage with MVCC, and a coprocessor tier ("TiTPU") that executes pushed-down
+plan DAGs as JAX/XLA kernels over columnar chunks sharded across a TPU mesh.
+
+Control plane (sessions, planning, transactions, schema) is host-side;
+the data plane is columnar and device-side end-to-end.
+
+int64 is required for exact DECIMAL arithmetic (scaled fixed-point; see
+tidb_tpu/types) and for row handles, so x64 is enabled globally before any
+JAX computation is traced.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

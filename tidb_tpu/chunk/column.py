@@ -1,0 +1,239 @@
+"""Columnar storage: typed flat buffers + validity masks + string dictionaries.
+
+Counterpart of the reference's Apache-Arrow-like chunk column (reference:
+util/chunk/column.go:61 — null bitmap + offsets + flat data buffer), with two
+TPU-first changes:
+
+* Strings are dictionary-encoded as int32 codes against a shared, append-only
+  per-table-column `Dictionary`. Any string predicate or collation-aware
+  ordering is evaluated host-side ONCE over the (small) dictionary and then
+  applied device-side as a gather over codes — the device never touches
+  variable-length bytes.
+* NULLs are a `bool` validity array (True = valid), not a packed bitmap:
+  XLA fuses mask ops for free, and padding masks for static tiles reuse the
+  same representation.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..types.field_type import FieldType, TypeKind
+from ..types.value import (
+    Decimal,
+    decode_date,
+    decode_datetime,
+    encode_date,
+    encode_datetime,
+    parse_date,
+    parse_datetime,
+)
+
+
+class Dictionary:
+    """Append-only string dictionary shared by all regions of a table column.
+
+    Codes are NOT order-preserving (inserts append); ordering and range
+    predicates are handled by computing per-code lookup tables host-side
+    (see copr/kernels). Equality is exact on codes.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Optional[Iterable[str]] = None) -> None:
+        self.values: list[str] = []
+        self._index: dict[str, int] = {}
+        if values:
+            for v in values:
+                self.encode(v)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def encode(self, s: str) -> int:
+        code = self._index.get(s)
+        if code is None:
+            code = len(self.values)
+            self.values.append(s)
+            self._index[s] = code
+        return code
+
+    def lookup(self, s: str) -> int:
+        """Code for s, or -1 if the string is absent (never matches equality)."""
+        return self._index.get(s, -1)
+
+    def decode(self, code: int) -> str:
+        return self.values[code]
+
+    def code_table(self, pred) -> np.ndarray:
+        """bool[len(dict)] lookup table: pred evaluated over every dict value.
+
+        This is how arbitrary string predicates (LIKE, >=, collation compares)
+        become a single device-side gather.
+        """
+        return np.fromiter((pred(v) for v in self.values), dtype=bool,
+                           count=len(self.values))
+
+    def sort_ranks(self) -> np.ndarray:
+        """int32[len(dict)] rank of each code in (binary-collation) sorted
+        order; device maps codes -> ranks to get order-correct comparisons."""
+        order = np.argsort(np.array(self.values, dtype=object), kind="stable")
+        ranks = np.empty(len(self.values), dtype=np.int32)
+        ranks[order] = np.arange(len(self.values), dtype=np.int32)
+        return ranks
+
+
+@dataclass
+class Column:
+    """One typed column: flat numpy buffer + validity + optional dictionary."""
+
+    ftype: FieldType
+    data: np.ndarray
+    valid: Optional[np.ndarray] = None  # None => all valid
+    dictionary: Optional[Dictionary] = None
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    @property
+    def validity(self) -> np.ndarray:
+        if self.valid is None:
+            return np.ones(len(self.data), dtype=bool)
+        return self.valid
+
+    def null_at(self, i: int) -> bool:
+        return self.valid is not None and not self.valid[i]
+
+    # ---- element access (render / host fallback path) ----------------------
+    def value_at(self, i: int) -> Any:
+        """Decode physical storage to a host scalar (None for NULL)."""
+        if self.null_at(i):
+            return None
+        raw = self.data[i]
+        k = self.ftype.kind
+        if self.ftype.is_decimal:
+            return Decimal(int(raw), self.ftype.scale)
+        if k == TypeKind.DATE:
+            return decode_date(int(raw))
+        if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+            return decode_datetime(int(raw))
+        if self.ftype.is_string:
+            assert self.dictionary is not None
+            return self.dictionary.decode(int(raw))
+        if self.ftype.is_float:
+            return float(raw)
+        return int(raw)
+
+    def to_pylist(self) -> list[Any]:
+        return [self.value_at(i) for i in range(len(self))]
+
+    # ---- construction ------------------------------------------------------
+    @staticmethod
+    def empty(ftype: FieldType, dictionary: Optional[Dictionary] = None) -> "Column":
+        return Column(ftype, np.empty(0, dtype=ftype.np_dtype), None, dictionary)
+
+    @staticmethod
+    def from_values(
+        ftype: FieldType,
+        values: Sequence[Any],
+        dictionary: Optional[Dictionary] = None,
+    ) -> "Column":
+        """Encode host scalars into the physical layout.
+
+        Accepts Python ints/floats/strs/Decimals/dates and string literals for
+        temporal types. None encodes as NULL.
+        """
+        n = len(values)
+        data = np.zeros(n, dtype=ftype.np_dtype)
+        valid = np.ones(n, dtype=bool)
+        if ftype.is_string and dictionary is None:
+            dictionary = Dictionary()
+        for i, v in enumerate(values):
+            if v is None:
+                valid[i] = False
+                continue
+            data[i] = _encode_scalar(ftype, v, dictionary)
+        return Column(ftype, data, None if valid.all() else valid, dictionary)
+
+    def take(self, indices: np.ndarray) -> "Column":
+        return Column(
+            self.ftype,
+            self.data[indices],
+            None if self.valid is None else self.valid[indices],
+            self.dictionary,
+        )
+
+    def append(self, other: "Column") -> "Column":
+        assert self.ftype.kind == other.ftype.kind and (
+            not self.ftype.is_decimal or self.ftype.scale == other.ftype.scale
+        ), f"append type mismatch: {self.ftype!r} vs {other.ftype!r}"
+        other_data = other.data
+        dictionary = self.dictionary or other.dictionary
+        if (
+            self.ftype.is_string
+            and self.dictionary is not None
+            and other.dictionary is not None
+            and other.dictionary is not self.dictionary
+        ):
+            # re-encode other's codes into self's dictionary
+            remap = np.fromiter(
+                (self.dictionary.encode(v) for v in other.dictionary.values),
+                dtype=np.int32,
+                count=len(other.dictionary),
+            )
+            other_data = remap[other.data]
+            dictionary = self.dictionary
+        data = np.concatenate([self.data, other_data])
+        if self.valid is None and other.valid is None:
+            valid = None
+        else:
+            valid = np.concatenate([self.validity, other.validity])
+        return Column(self.ftype, data, valid, dictionary)
+
+
+def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -> Any:
+    """Host scalar -> physical representation for one cell."""
+    k = ftype.kind
+    if ftype.is_decimal:
+        if isinstance(v, Decimal):
+            d = v.rescale(ftype.scale)
+        elif isinstance(v, str):
+            d = Decimal.parse(v).rescale(ftype.scale)
+        elif isinstance(v, int):
+            d = Decimal.from_int(v, ftype.scale)
+        elif isinstance(v, float):
+            # half away from zero, consistent with Decimal.rescale
+            scaled = v * ftype.decimal_multiplier
+            d = Decimal(int(math.floor(abs(scaled) + 0.5)) * (-1 if scaled < 0 else 1),
+                        ftype.scale)
+        else:
+            raise TypeError(f"cannot encode {type(v)} as {ftype!r}")
+        if not (-(2**63) < d.unscaled < 2**63):
+            raise OverflowError(f"decimal out of device range: {d}")
+        return d.unscaled
+    if k == TypeKind.DATE:
+        if isinstance(v, str):
+            return parse_date(v)
+        if hasattr(v, "year") and not hasattr(v, "hour"):
+            return encode_date(v)
+        return int(v)
+    if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        if isinstance(v, str):
+            return parse_datetime(v)
+        if hasattr(v, "hour"):
+            return encode_datetime(v)
+        return int(v)
+    if ftype.is_string:
+        assert dictionary is not None
+        return dictionary.encode(str(v))
+    if ftype.is_float:
+        return float(v)
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, float) and v.is_integer():
+        return int(v)
+    return int(v)
