@@ -83,7 +83,7 @@ class TestReviewRegressions:
         import pytest
         c = Column.from_values(decimal_type(15, 2), ["1.00"])
         d = Column.from_values(decimal_type(15, 3), ["1.000"])
-        with pytest.raises(AssertionError):
+        with pytest.raises(TypeError):
             c.append(d)
 
     def test_concat_column_count_mismatch_rejected(self):
@@ -91,9 +91,22 @@ class TestReviewRegressions:
         a = Chunk([Column.from_values(bigint_type(), [1])])
         b = Chunk([Column.from_values(bigint_type(), [2]),
                    Column.from_values(bigint_type(), [3])])
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError):
             Chunk.concat([a, b])
 
     def test_float_decimal_ingest_half_away(self):
         col = Column.from_values(decimal_type(15, 2), [0.125, -0.125])
         assert col.data.tolist() == [13, -13]
+
+    def test_append_all_null_string_column(self):
+        a = Column.from_values(varchar_type(), ["x"])
+        b = Column.from_values(varchar_type(), [None])
+        assert a.append(b).to_pylist() == ["x", None]
+        assert Chunk.concat(
+            [Chunk([a]), Chunk([b])]
+        ).columns[0].to_pylist() == ["x", None]
+
+    def test_float_decimal_uses_shortest_repr(self):
+        # 1.005 is 1.00499... in binary; MySQL rounds the decimal string form
+        col = Column.from_values(decimal_type(15, 2), [1.005])
+        assert col.data.tolist() == [101]

@@ -1,0 +1,175 @@
+import numpy as np
+import pytest
+
+from tidb_tpu.catalog import Catalog, ColumnInfo, TableInfo
+from tidb_tpu.kv import MemDB, TOMBSTONE
+from tidb_tpu.store import Storage, WriteConflictError
+from tidb_tpu.types import bigint_type, decimal_type, varchar_type
+
+
+def make_table(storage: Storage, name="t") -> TableInfo:
+    cat = storage.catalog
+    info = TableInfo(
+        id=cat.alloc_id(),
+        name=name,
+        columns=[
+            ColumnInfo(cat.alloc_id(), "a", bigint_type(), 0),
+            ColumnInfo(cat.alloc_id(), "b", varchar_type(), 1),
+            ColumnInfo(cat.alloc_id(), "c", decimal_type(10, 2), 2),
+        ],
+    )
+    cat.add_table("test", info)
+    storage.register_table(info)
+    return info
+
+
+def insert_rows(storage, info, rows):
+    store = storage.table_store(info.id)
+    txn = storage.begin()
+    for r in rows:
+        h = store.alloc_handle()
+        txn.set_row(info.id, h, store.encode_row(list(r)))
+    return txn.commit()
+
+
+class TestMemDB:
+    def test_staging_cleanup(self):
+        db = MemDB()
+        db.set((1, 1), ("a",))
+        h = db.staging()
+        db.set((1, 2), ("b",))
+        db.set((1, 1), ("a2",))
+        db.cleanup(h)
+        assert db.get((1, 1)) == ("a",)
+        assert db.get((1, 2)) is None
+
+    def test_staging_release_keeps(self):
+        db = MemDB()
+        h = db.staging()
+        db.set((1, 1), ("x",))
+        db.release(h)
+        assert db.get((1, 1)) == ("x",)
+
+    def test_delete_marks_tombstone(self):
+        db = MemDB()
+        db.delete((1, 5))
+        assert db.get((1, 5)) is TOMBSTONE
+
+
+class TestMVCC:
+    def test_insert_then_read(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.50"), (2, "y", None)])
+        txn = storage.begin()
+        snap = txn.snapshot(info.id)
+        assert snap.num_visible_rows == 2
+        col_a = snap.column(0)
+        assert sorted(col_a.to_pylist()) == [1, 2]
+        assert snap.column(2).to_pylist()[1] is None
+        txn.rollback()
+
+    def test_snapshot_isolation(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00")])
+        reader = storage.begin()  # snapshot before writer commits
+        insert_rows(storage, info, [(2, "y", "2.00")])
+        assert reader.snapshot(info.id).num_visible_rows == 1
+        late = storage.begin()
+        assert late.snapshot(info.id).num_visible_rows == 2
+        reader.rollback()
+        late.rollback()
+
+    def test_read_your_writes_and_delete(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00")])
+        store = storage.table_store(info.id)
+        txn = storage.begin()
+        h = store.alloc_handle()
+        txn.set_row(info.id, h, store.encode_row([2, "mine", "9.99"]))
+        snap = txn.snapshot(info.id)
+        assert snap.num_visible_rows == 2
+        # outside observer doesn't see it
+        other = storage.begin()
+        assert other.snapshot(info.id).num_visible_rows == 1
+        txn.commit()
+        other.rollback()
+
+    def test_update_overrides_base_row(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00")])
+        storage.flush()  # row now lives in the base epoch
+        store = storage.table_store(info.id)
+        # find its handle via snapshot
+        t0 = storage.begin()
+        handle = int(t0.snapshot(info.id).handles()[0])
+        t0.rollback()
+        txn = storage.begin()
+        txn.set_row(info.id, handle, store.encode_row([1, "updated", "2.00"]))
+        txn.commit()
+        t1 = storage.begin()
+        snap = t1.snapshot(info.id)
+        assert snap.num_visible_rows == 1
+        assert snap.column(1).to_pylist() == ["updated"]
+        t1.rollback()
+
+    def test_delete_row(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00"), (2, "y", "2.00")])
+        t0 = storage.begin()
+        handles = t0.snapshot(info.id).handles()
+        t0.rollback()
+        txn = storage.begin()
+        txn.delete_row(info.id, int(handles[0]))
+        txn.commit()
+        t1 = storage.begin()
+        assert t1.snapshot(info.id).num_visible_rows == 1
+        t1.rollback()
+
+    def test_write_conflict(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00")])
+        t0 = storage.begin()
+        handle = int(t0.snapshot(info.id).handles()[0])
+        t0.rollback()
+        a = storage.begin()
+        b = storage.begin()
+        store = storage.table_store(info.id)
+        a.set_row(info.id, handle, store.encode_row([1, "a", "1.00"]))
+        b.set_row(info.id, handle, store.encode_row([1, "b", "1.00"]))
+        a.commit()
+        with pytest.raises(WriteConflictError):
+            b.commit()
+
+    def test_compaction_preserves_visibility(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(i, f"s{i % 5}", f"{i}.00") for i in range(100)])
+        storage.flush()
+        epoch1 = storage.table_store(info.id).epoch
+        assert epoch1.num_rows == 100
+        insert_rows(storage, info, [(100, "new", "0.50")])
+        txn = storage.begin()
+        snap = txn.snapshot(info.id)
+        assert snap.num_visible_rows == 101
+        assert snap.epoch.epoch_id == epoch1.epoch_id  # overlay, not refold
+        txn.rollback()
+        storage.flush()
+        assert storage.table_store(info.id).epoch.num_rows == 101
+
+    def test_compaction_respects_active_snapshot(self):
+        storage = Storage()
+        info = make_table(storage)
+        insert_rows(storage, info, [(1, "x", "1.00")])
+        reader = storage.begin()
+        insert_rows(storage, info, [(2, "y", "2.00")])
+        storage.flush()  # must NOT fold row 2 past reader's snapshot
+        assert reader.snapshot(info.id).num_visible_rows == 1
+        reader.rollback()
+        storage.flush()
+        assert storage.table_store(info.id).epoch.num_rows == 2

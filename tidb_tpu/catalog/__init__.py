@@ -1,0 +1,3 @@
+from .schema import ColumnInfo, TableInfo, SchemaInfo, Catalog
+
+__all__ = ["ColumnInfo", "TableInfo", "SchemaInfo", "Catalog"]

@@ -1,0 +1,155 @@
+"""Catalog: schema metadata and name resolution.
+
+Counterpart of the reference's `infoschema.InfoSchema` + `model.TableInfo`
+(reference: infoschema/infoschema.go:39; model types from the external
+parser module). The catalog is an immutable-ish snapshot consumed by the
+planner; DDL produces new versions (schema_version bumps mirror the
+reference's meta schema-version, meta/meta.go:264).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..types.field_type import FieldType
+
+
+@dataclass
+class ColumnInfo:
+    id: int
+    name: str
+    ftype: FieldType
+    offset: int = 0  # position in the table
+    default: Any = None
+    is_primary: bool = False
+    auto_increment: bool = False
+
+    @property
+    def nullable(self) -> bool:
+        return self.ftype.nullable and not self.is_primary
+
+
+@dataclass
+class IndexInfo:
+    id: int
+    name: str
+    col_offsets: list[int]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class TableInfo:
+    id: int
+    name: str
+    columns: list[ColumnInfo]
+    indices: list[IndexInfo] = field(default_factory=list)
+    # offset of an integer PRIMARY KEY column used directly as the row
+    # handle (reference: pk-is-handle tables, table/tables.go); None means
+    # rows get auto-allocated internal handles.
+    pk_handle_offset: Optional[int] = None
+
+    def column_by_name(self, name: str) -> Optional[ColumnInfo]:
+        lname = name.lower()
+        for c in self.columns:
+            if c.name.lower() == lname:
+                return c
+        return None
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+
+@dataclass
+class SchemaInfo:
+    name: str
+    tables: dict[str, TableInfo] = field(default_factory=dict)  # lower-name keyed
+
+
+class Catalog:
+    """All schemas + id allocation + versioning. Single-node, in-memory.
+
+    Name lookups are case-insensitive (MySQL default on most platforms).
+    """
+
+    def __init__(self) -> None:
+        self.schemas: dict[str, SchemaInfo] = {}
+        self.version = 0
+        self._next_id = 1
+        self.create_schema("test")  # convenience default, like test setups
+
+    # ---- id / version ------------------------------------------------------
+    def alloc_id(self) -> int:
+        i = self._next_id
+        self._next_id += 1
+        return i
+
+    def bump_version(self) -> int:
+        self.version += 1
+        return self.version
+
+    # ---- schema ops --------------------------------------------------------
+    def create_schema(self, name: str, if_not_exists: bool = False) -> SchemaInfo:
+        key = name.lower()
+        if key in self.schemas:
+            if if_not_exists:
+                return self.schemas[key]
+            raise KeyError(f"database exists: {name}")
+        info = SchemaInfo(name)
+        self.schemas[key] = info
+        self.bump_version()
+        return info
+
+    def drop_schema(self, name: str, if_exists: bool = False) -> list[TableInfo]:
+        key = name.lower()
+        if key not in self.schemas:
+            if if_exists:
+                return []
+            raise KeyError(f"unknown database: {name}")
+        dropped = list(self.schemas.pop(key).tables.values())
+        self.bump_version()
+        return dropped
+
+    def schema(self, name: str) -> SchemaInfo:
+        key = name.lower()
+        if key not in self.schemas:
+            raise KeyError(f"unknown database: {name}")
+        return self.schemas[key]
+
+    # ---- table ops ---------------------------------------------------------
+    def add_table(self, db: str, tbl: TableInfo, if_not_exists: bool = False) -> bool:
+        schema = self.schema(db)
+        key = tbl.name.lower()
+        if key in schema.tables:
+            if if_not_exists:
+                return False
+            raise KeyError(f"table exists: {db}.{tbl.name}")
+        schema.tables[key] = tbl
+        self.bump_version()
+        return True
+
+    def drop_table(self, db: str, name: str, if_exists: bool = False) -> Optional[TableInfo]:
+        schema = self.schema(db)
+        key = name.lower()
+        if key not in schema.tables:
+            if if_exists:
+                return None
+            raise KeyError(f"unknown table: {db}.{name}")
+        info = schema.tables.pop(key)
+        self.bump_version()
+        return info
+
+    def table(self, db: str, name: str) -> TableInfo:
+        schema = self.schema(db)
+        key = name.lower()
+        if key not in schema.tables:
+            raise KeyError(f"unknown table: {db}.{name}")
+        return schema.tables[key]
+
+    def try_table(self, db: str, name: str) -> Optional[TableInfo]:
+        try:
+            return self.table(db, name)
+        except KeyError:
+            return None

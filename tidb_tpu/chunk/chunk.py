@@ -23,7 +23,8 @@ class Chunk:
     def __post_init__(self) -> None:
         if self.columns:
             n = len(self.columns[0])
-            assert all(len(c) == n for c in self.columns), "ragged chunk"
+            if not all(len(c) == n for c in self.columns):
+                raise ValueError("ragged chunk: column lengths differ")
 
     @property
     def num_rows(self) -> int:
@@ -59,21 +60,26 @@ class Chunk:
         if len(chunks) == 1:
             return chunks[0]
         ncols = chunks[0].num_cols
-        assert all(ch.num_cols == ncols for ch in chunks), "column count mismatch"
+        if not all(ch.num_cols == ncols for ch in chunks):
+            raise ValueError("Chunk.concat: column count mismatch")
         cols = []
         for ci in range(ncols):
             parts = [ch.columns[ci] for ch in chunks]
             first = parts[0]
-            # single-pass concatenation; string parts sharing one dictionary
-            # (the common case: one table column) stay a raw concat
-            same_dict = all(p.dictionary is first.dictionary for p in parts)
-            if not same_dict:
-                col = first
-                for p in parts[1:]:
-                    col = col.append(p)  # re-encodes foreign dictionaries
-                cols.append(col)
-                continue
-            data = np.concatenate([p.data for p in parts])
+            # single pass: remap foreign string dictionaries into the first
+            # part's dictionary, then one concatenate over all parts
+            datas = []
+            for p in parts:
+                if (
+                    first.ftype.is_string
+                    and first.dictionary is not None
+                    and p.dictionary is not None
+                    and p.dictionary is not first.dictionary
+                ):
+                    datas.append(first._remapped_data(p))
+                else:
+                    datas.append(p.data)
+            data = np.concatenate(datas)
             if all(p.valid is None for p in parts):
                 valid = None
             else:

@@ -16,7 +16,6 @@ TPU-first changes:
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional, Sequence
 
@@ -167,10 +166,24 @@ class Column:
             self.dictionary,
         )
 
+    def _remapped_data(self, other: "Column") -> np.ndarray:
+        """other's codes re-encoded into self's dictionary (strings only)."""
+        assert self.dictionary is not None and other.dictionary is not None
+        if len(other.dictionary) == 0:
+            # all-NULL column: placeholder codes, nothing to remap
+            return other.data
+        remap = np.fromiter(
+            (self.dictionary.encode(v) for v in other.dictionary.values),
+            dtype=np.int32,
+            count=len(other.dictionary),
+        )
+        return remap[other.data]
+
     def append(self, other: "Column") -> "Column":
-        assert self.ftype.kind == other.ftype.kind and (
-            not self.ftype.is_decimal or self.ftype.scale == other.ftype.scale
-        ), f"append type mismatch: {self.ftype!r} vs {other.ftype!r}"
+        if self.ftype.kind != other.ftype.kind or (
+            self.ftype.is_decimal and self.ftype.scale != other.ftype.scale
+        ):
+            raise TypeError(f"append type mismatch: {self.ftype!r} vs {other.ftype!r}")
         other_data = other.data
         dictionary = self.dictionary or other.dictionary
         if (
@@ -179,13 +192,7 @@ class Column:
             and other.dictionary is not None
             and other.dictionary is not self.dictionary
         ):
-            # re-encode other's codes into self's dictionary
-            remap = np.fromiter(
-                (self.dictionary.encode(v) for v in other.dictionary.values),
-                dtype=np.int32,
-                count=len(other.dictionary),
-            )
-            other_data = remap[other.data]
+            other_data = self._remapped_data(other)
             dictionary = self.dictionary
         data = np.concatenate([self.data, other_data])
         if self.valid is None and other.valid is None:
@@ -206,10 +213,9 @@ def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -
         elif isinstance(v, int):
             d = Decimal.from_int(v, ftype.scale)
         elif isinstance(v, float):
-            # half away from zero, consistent with Decimal.rescale
-            scaled = v * ftype.decimal_multiplier
-            d = Decimal(int(math.floor(abs(scaled) + 0.5)) * (-1 if scaled < 0 else 1),
-                        ftype.scale)
+            # MySQL converts doubles via their decimal string form (shortest
+            # repr), then rounds half away from zero
+            d = Decimal.parse(repr(v)).rescale(ftype.scale)
         else:
             raise TypeError(f"cannot encode {type(v)} as {ftype!r}")
         if not (-(2**63) < d.unscaled < 2**63):

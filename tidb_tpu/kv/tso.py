@@ -1,0 +1,26 @@
+"""Timestamp oracle: monotonically increasing logical timestamps.
+
+Single-process equivalent of PD's TSO service (reference:
+store/tikv/oracle/oracles/pd.go:77 for the PD-backed oracle,
+oracle/oracles/local.go for the single-node one). start_ts/commit_ts
+ordering is the basis of snapshot-isolation visibility in the MVCC store.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class TimestampOracle:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ts = 0
+
+    def next_ts(self) -> int:
+        with self._lock:
+            self._ts += 1
+            return self._ts
+
+    def current(self) -> int:
+        with self._lock:
+            return self._ts

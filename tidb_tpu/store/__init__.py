@@ -1,0 +1,11 @@
+from .table_store import TableStore, TableSnapshot, ColumnEpoch
+from .storage import Storage, Transaction, WriteConflictError
+
+__all__ = [
+    "TableStore",
+    "TableSnapshot",
+    "ColumnEpoch",
+    "Storage",
+    "Transaction",
+    "WriteConflictError",
+]

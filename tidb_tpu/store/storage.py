@@ -1,0 +1,146 @@
+"""Storage: the single-node transactional store over per-table MVCC stores.
+
+Plays the role of the reference's `kv.Storage` + embedded unistore (reference:
+kv/kv.go:462, store/mockstore/unistore.go) for the dev/test topology, and of
+the txn coordinator (store/tikv/2pc.go) reduced to its single-node core:
+optimistic snapshot-isolation transactions with first-committer-wins
+write-conflict detection at commit. The distributed 2PC/percolator protocol
+slots in behind the same Transaction surface once multi-node exists.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ..catalog.schema import Catalog, TableInfo
+from ..kv.memdb import MemDB, TOMBSTONE
+from ..kv.tso import TimestampOracle
+from .table_store import TableSnapshot, TableStore
+
+
+class WriteConflictError(Exception):
+    """Another txn committed to a key after our start_ts (optimistic SI)."""
+
+
+class Storage:
+    def __init__(self) -> None:
+        self.catalog = Catalog()
+        self.tso = TimestampOracle()
+        self.tables: dict[int, TableStore] = {}
+        self._commit_lock = threading.Lock()
+        # active snapshot ts registry -> GC/compaction safepoint
+        self._active_snapshots: dict[int, int] = {}
+        self._snap_lock = threading.Lock()
+
+    # ---- schema ------------------------------------------------------------
+    def register_table(self, info: TableInfo) -> TableStore:
+        store = TableStore(info)
+        self.tables[info.id] = store
+        return store
+
+    def unregister_table(self, table_id: int) -> None:
+        self.tables.pop(table_id, None)
+
+    def table_store(self, table_id: int) -> TableStore:
+        return self.tables[table_id]
+
+    # ---- snapshot registry (compaction safepoint) ---------------------------
+    def acquire_snapshot_ts(self) -> int:
+        ts = self.tso.next_ts()
+        with self._snap_lock:
+            self._active_snapshots[ts] = self._active_snapshots.get(ts, 0) + 1
+        return ts
+
+    def release_snapshot_ts(self, ts: int) -> None:
+        with self._snap_lock:
+            n = self._active_snapshots.get(ts, 0) - 1
+            if n <= 0:
+                self._active_snapshots.pop(ts, None)
+            else:
+                self._active_snapshots[ts] = n
+
+    def safe_ts(self) -> int:
+        """Newest ts that every active snapshot is at or above."""
+        with self._snap_lock:
+            if self._active_snapshots:
+                return min(self._active_snapshots) - 1
+        return self.tso.current()
+
+    # ---- transactions ------------------------------------------------------
+    def begin(self) -> "Transaction":
+        return Transaction(self, self.acquire_snapshot_ts())
+
+    def commit(self, txn: "Transaction") -> int:
+        """Conflict-check + apply. Single commit lock = the degenerate,
+        correct form of region-grouped parallel 2PC (2pc.go:616)."""
+        mutations = txn.memdb.mutations()
+        if not mutations:
+            return txn.start_ts
+        with self._commit_lock:
+            for (table_id, handle), _ in mutations.items():
+                store = self.tables.get(table_id)
+                if store is None:
+                    continue  # table dropped mid-txn; DDL wins
+                if store.latest_commit_ts(handle) > txn.start_ts:
+                    raise WriteConflictError(
+                        f"write conflict on table {table_id} handle {handle}"
+                    )
+            commit_ts = self.tso.next_ts()
+            for (table_id, handle), row in mutations.items():
+                store = self.tables.get(table_id)
+                if store is not None:
+                    store.apply_commit(commit_ts, handle, row)
+        # opportunistic compaction at the GC-safe ts
+        safe = self.safe_ts()
+        for (table_id, _), _ in mutations.items():
+            store = self.tables.get(table_id)
+            if store is not None:
+                store.maybe_compact(min(safe, commit_ts - 1) if safe else 0)
+        return commit_ts
+
+    def flush(self) -> None:
+        """Fold all committed deltas into base epochs (test/bench helper)."""
+        safe = self.safe_ts()
+        for store in self.tables.values():
+            store.compact(safe)
+
+
+class Transaction:
+    """An optimistic snapshot-isolation transaction."""
+
+    def __init__(self, storage: Storage, start_ts: int) -> None:
+        self.storage = storage
+        self.start_ts = start_ts
+        self.memdb = MemDB()
+        self._finished = False
+
+    # ---- writes ------------------------------------------------------------
+    def set_row(self, table_id: int, handle: int, row: tuple) -> None:
+        self.memdb.set((table_id, handle), row)
+
+    def delete_row(self, table_id: int, handle: int) -> None:
+        self.memdb.set((table_id, handle), TOMBSTONE)
+
+    # ---- reads -------------------------------------------------------------
+    def snapshot(self, table_id: int) -> TableSnapshot:
+        """Snapshot at start_ts unioned with our own uncommitted writes."""
+        store = self.storage.table_store(table_id)
+        overlay = {h: v for h, v in self.memdb.iter_table(table_id)}
+        return store.snapshot(self.start_ts, overlay or None)
+
+    # ---- lifecycle ---------------------------------------------------------
+    def commit(self) -> int:
+        assert not self._finished, "transaction already finished"
+        try:
+            return self.storage.commit(self)
+        finally:
+            self._finish()
+
+    def rollback(self) -> None:
+        if not self._finished:
+            self._finish()
+
+    def _finish(self) -> None:
+        self._finished = True
+        self.storage.release_snapshot_ts(self.start_ts)

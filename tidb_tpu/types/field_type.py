@@ -16,8 +16,9 @@ device layout instead of the row-based `Datum` interpreter:
                             on the host for the long tail)
   DATE                      int32 days since 1970-01-01
   DATETIME/TIMESTAMP        int64 microseconds since epoch
-  CHAR/VARCHAR/TEXT         int32 dictionary code per region chunk
-                            (order-preserving within a region dictionary)
+  CHAR/VARCHAR/TEXT         int32 dictionary code (append-ordered, NOT
+                            order-preserving; ordering/range predicates go
+                            through Dictionary.sort_ranks / code_table)
 
 Static dtypes keep every column XLA-tileable; NULLs live in a separate
 validity bitmap (see tidb_tpu/chunk).
@@ -26,7 +27,7 @@ validity bitmap (see tidb_tpu/chunk).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
