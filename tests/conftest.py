@@ -2,14 +2,15 @@
 
 Mirrors the reference's clusterless testkit approach (reference:
 util/testkit, store/mockstore) — multi-"node" behavior is simulated
-in-process. Env vars must be set before jax initializes its backends.
+in-process on virtual devices.
+
+NOTE: this environment pre-imports jax at interpreter startup (site
+customization registering the TPU plugin), so JAX_PLATFORMS/XLA_FLAGS env
+vars set here would be ignored. jax.config updates still work because no
+backend has been initialized yet at conftest import time.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

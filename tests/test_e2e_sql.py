@@ -1,0 +1,305 @@
+"""End-to-end SQL tests through the full stack (parser -> planner -> TiTPU
+coprocessor -> host executor), testkit style."""
+
+import pytest
+
+from testkit import TestKit
+
+
+@pytest.fixture
+def tk():
+    t = TestKit()
+    t.must_exec(
+        "create table t (id bigint primary key, name varchar(20), "
+        "qty decimal(10,2), d date)"
+    )
+    t.must_exec(
+        "insert into t values "
+        "(1, 'alpha', 10.50, '2024-01-01'), "
+        "(2, 'beta', 3.25, '2024-02-01'), "
+        "(3, 'alpha', 7.00, '2024-03-01'), "
+        "(4, null, null, null), "
+        "(5, 'gamma', 0.75, '2024-01-15')"
+    )
+    return t
+
+
+class TestBasicSelect:
+    def test_select_all(self, tk):
+        rows = tk.must_query("select * from t")
+        assert len(rows) == 5
+
+    def test_select_constant(self, tk):
+        tk.check("select 1 + 2", [(3,)])
+        tk.check("select 'hello'", [("hello",)])
+
+    def test_where_filters(self, tk):
+        tk.check("select id from t where qty > 5", [(1,), (3,)],
+                 ordered=False)
+        tk.check("select id from t where name = 'alpha'", [(1,), (3,)],
+                 ordered=False)
+        tk.check("select id from t where name <> 'alpha' and qty < 1",
+                 [(5,)])
+        tk.check("select id from t where d >= '2024-02-01'", [(2,), (3,)],
+                 ordered=False)
+
+    def test_null_semantics(self, tk):
+        tk.check("select id from t where name is null", [(4,)])
+        tk.check("select id from t where name is not null",
+                 [(1,), (2,), (3,), (5,)], ordered=False)
+        # NULL never matches comparisons
+        tk.check("select id from t where qty <> 3.25", [(1,), (3,), (5,)],
+                 ordered=False)
+
+    def test_in_between_like(self, tk):
+        tk.check("select id from t where id in (2, 4)", [(2,), (4,)],
+                 ordered=False)
+        tk.check("select id from t where qty between 3 and 8",
+                 [(2,), (3,)], ordered=False)
+        tk.check("select id from t where name like 'a%'", [(1,), (3,)],
+                 ordered=False)
+        tk.check("select id from t where name like '%mm%'", [(5,)])
+
+    def test_projection_arith(self, tk):
+        tk.check("select id * 2 + 1 from t where id = 3", [(7,)])
+        tk.check("select qty * 2 from t where id = 1", [("21.00",)])
+        tk.check("select qty * qty from t where id = 2", [("10.5625",)])
+
+    def test_order_by_limit(self, tk):
+        tk.check("select id from t order by qty desc limit 2",
+                 [(1,), (3,)])
+        tk.check("select id from t order by qty limit 2", [(4,), (5,)])
+        # name desc: gamma(5), beta(2), alpha(3,1), NULL(4) last
+        tk.check("select id from t order by name desc, id desc limit 3",
+                 [(5,), (2,), (3,)])
+        tk.check("select id from t order by id limit 2 offset 2",
+                 [(3,), (4,)])
+
+    def test_order_by_alias_and_position(self, tk):
+        tk.check("select id i from t order by i desc limit 1", [(5,)])
+        tk.check("select id, qty from t order by 2 limit 1", [(4, None)])
+
+
+class TestAggregation:
+    def test_scalar_aggs(self, tk):
+        tk.check("select count(*), count(qty), sum(qty), min(qty), max(qty) "
+                 "from t",
+                 [(5, 4, "21.50", "0.75", "10.50")])
+
+    def test_avg_decimal_scale(self, tk):
+        tk.check("select avg(qty) from t", [("5.375000",)])
+
+    def test_group_by(self, tk):
+        tk.check(
+            "select name, count(*), sum(qty) from t group by name "
+            "order by name",
+            [(None, 1, None), ("alpha", 2, "17.50"), ("beta", 1, "3.25"),
+             ("gamma", 1, "0.75")],
+        )
+
+    def test_group_by_having(self, tk):
+        tk.check(
+            "select name, count(*) c from t group by name having c > 1",
+            [("alpha", 2)],
+        )
+
+    def test_empty_table_aggs(self, tk):
+        tk.must_exec("create table e (x bigint, y decimal(8,2))")
+        tk.check("select count(*), sum(y), avg(y) from e", [(0, None, None)])
+
+    def test_count_distinct(self, tk):
+        tk.check("select count(distinct name) from t", [(3,)])
+
+    def test_distinct(self, tk):
+        tk.check("select distinct name from t",
+                 [(None,), ("alpha",), ("beta",), ("gamma",)], ordered=False)
+
+    def test_group_by_int_host_fallback(self, tk):
+        # int group keys take the host fallback path (dense gate)
+        tk.check(
+            "select id % 2, count(*) from t group by id % 2 order by 1",
+            [(0, 2), (1, 3)],
+        )
+
+
+class TestJoins:
+    @pytest.fixture
+    def tk2(self, tk):
+        tk.must_exec("create table o (oid bigint, tid bigint, v bigint)")
+        tk.must_exec(
+            "insert into o values (100, 1, 7), (101, 1, 8), (102, 3, 9), "
+            "(103, 99, 0)"
+        )
+        return tk
+
+    def test_inner_join(self, tk2):
+        tk2.check(
+            "select t.id, o.oid from t join o on t.id = o.tid order by o.oid",
+            [(1, 100), (1, 101), (3, 102)],
+        )
+
+    def test_left_join(self, tk2):
+        tk2.check(
+            "select t.id, o.oid from t left join o on t.id = o.tid "
+            "order by t.id, o.oid",
+            [(1, 100), (1, 101), (2, None), (3, 102), (4, None), (5, None)],
+        )
+
+    def test_comma_join_with_where(self, tk2):
+        tk2.check(
+            "select t.id, o.v from t, o where t.id = o.tid and o.v > 7 "
+            "order by o.v",
+            [(1, 8), (3, 9)],
+        )
+
+    def test_join_agg(self, tk2):
+        tk2.check(
+            "select t.name, sum(o.v) from t join o on t.id = o.tid "
+            "group by t.name order by t.name",
+            [("alpha", 24)],
+        )
+
+
+class TestDML:
+    def test_update(self, tk):
+        tk.must_exec("update t set qty = qty + 1 where id = 2")
+        tk.check("select qty from t where id = 2", [("4.25",)])
+
+    def test_update_all(self, tk):
+        tk.must_exec("update t set name = 'x'")
+        tk.check("select count(distinct name) from t", [(1,)])
+
+    def test_delete(self, tk):
+        tk.must_exec("delete from t where qty < 5")
+        tk.check("select id from t order by id", [(1,), (3,), (4,)])
+
+    def test_insert_select(self, tk):
+        tk.must_exec("create table t2 (id bigint, qty decimal(10,2))")
+        tk.must_exec("insert into t2 select id, qty from t where qty > 5")
+        tk.check("select count(*) from t2", [(2,)])
+
+    def test_replace_into_and_autoinc(self, tk):
+        tk.must_exec(
+            "create table ai (id bigint primary key auto_increment, "
+            "v varchar(5))")
+        tk.must_exec("insert into ai (v) values ('a'), ('b')")
+        rows = tk.must_query("select id from ai order by id")
+        assert rows[0][0] < rows[1][0]
+
+
+class TestTransactions:
+    def test_rollback(self, tk):
+        tk.must_exec("begin")
+        tk.must_exec("insert into t values (10, 'tx', 1.00, null)")
+        tk.check("select count(*) from t", [(6,)])  # read-your-writes
+        tk.must_exec("rollback")
+        tk.check("select count(*) from t", [(5,)])
+
+    def test_commit(self, tk):
+        tk.must_exec("begin")
+        tk.must_exec("insert into t values (10, 'tx', 1.00, null)")
+        tk.must_exec("commit")
+        tk.check("select count(*) from t", [(6,)])
+
+    def test_snapshot_isolation_across_sessions(self, tk):
+        from tidb_tpu.session import Session
+        s2 = Session(tk.session.storage)
+        tk.must_exec("begin")
+        tk.check("select count(*) from t", [(5,)])
+        s2.execute("insert into t values (11, 'other', 2.00, null)")
+        # our txn still sees the old snapshot
+        tk.check("select count(*) from t", [(5,)])
+        tk.must_exec("commit")
+        tk.check("select count(*) from t", [(6,)])
+
+
+class TestDDL:
+    def test_show_tables(self, tk):
+        rows = tk.must_query("show tables")
+        assert ("t",) in rows
+
+    def test_drop_and_recreate(self, tk):
+        tk.must_exec("drop table t")
+        with pytest.raises(Exception):
+            tk.must_query("select * from t")
+        tk.must_exec("create table t (a bigint)")
+        tk.check("select count(*) from t", [(0,)])
+
+    def test_truncate(self, tk):
+        tk.must_exec("truncate table t")
+        tk.check("select count(*) from t", [(0,)])
+
+    def test_explain(self, tk):
+        rows = tk.must_query("explain select sum(qty) from t where id > 1")
+        text = "\n".join(r[0] for r in rows)
+        assert "TableRead[TiTPU]" in text
+        assert "agg" in text
+
+
+class TestExpressions:
+    def test_case_when(self, tk):
+        tk.check(
+            "select id, case when qty > 5 then 'big' when qty is null "
+            "then 'none' else 'small' end from t order by id",
+            [(1, "big"), (2, "small"), (3, "big"), (4, "none"), (5, "small")],
+        )
+
+    def test_cast(self, tk):
+        tk.check("select cast(qty as signed) from t where id = 1", [(11,)])
+        tk.check("select cast(id as decimal(10,2)) from t where id = 3",
+                 [("3.00",)])
+
+    def test_date_functions(self, tk):
+        tk.check("select year(d), month(d), day(d) from t where id = 2",
+                 [(2024, 2, 1)])
+
+    def test_if_ifnull_coalesce(self, tk):
+        tk.check("select ifnull(name, 'missing') from t where id = 4",
+                 [("missing",)])
+        tk.check("select if(qty > 5, id, 0 - id) from t where id = 2",
+                 [(-2,)])
+        tk.check("select coalesce(qty, 0) from t where id = 4", [("0.00",)])
+
+
+class TestReviewRegressions:
+    """Regressions from code review of the end-to-end slice."""
+
+    def test_update_string_literal(self, tk):
+        tk.must_exec("update t set name = 'newval' where id = 1")
+        tk.check("select name from t where id = 1", [("newval",)])
+
+    def test_stale_string_predicate_after_dict_growth(self, tk):
+        tk.check("select id from t where name = 'zed'", [])
+        tk.must_exec("insert into t values (9, 'zed', 1.00, null)")
+        tk.session.storage.flush()
+        tk.check("select id from t where name = 'zed'", [(9,)])
+
+    def test_topn_across_epoch_and_overlay(self, tk):
+        tk.session.storage.flush()  # move fixture rows into the base epoch
+        tk.must_exec("insert into t values (6, 'x', 0.10, null), "
+                     "(7, 'y', 99.00, null)")
+        # base epoch rows and fresh overlay rows must merge correctly
+        tk.check("select id from t order by qty desc limit 2", [(7,), (1,)])
+        tk.check("select id from t order by qty limit 2", [(4,), (6,)])
+
+    def test_float_decimal_sci_notation_ingest(self, tk):
+        tk.must_exec("create table f (x decimal(10,2))")
+        tk.must_exec("insert into f values (1e-05), (2.5e2)")
+        tk.check("select x from f order by x", [("0.00",), ("250.00",)])
+
+    def test_distinct_float_aggs(self, tk):
+        tk.must_exec("create table fl (g bigint, v double)")
+        tk.must_exec("insert into fl values (1, 1.2), (1, 1.5), (1, 1.2)")
+        tk.check("select count(distinct v) from fl", [(2,)])
+
+    def test_int_float_join_keys(self, tk):
+        tk.must_exec("create table a1 (k bigint)")
+        tk.must_exec("create table b1 (k double)")
+        tk.must_exec("insert into a1 values (5), (6)")
+        tk.must_exec("insert into b1 values (5.0), (7.0)")
+        tk.check("select a1.k from a1 join b1 on a1.k = b1.k", [(5,)])
+
+    def test_update_decimal_scale_mismatch(self, tk):
+        # qty*qty has scale 4; column scale is 2 -> must round-rescale
+        tk.must_exec("update t set qty = qty * qty where id = 2")
+        tk.check("select qty from t where id = 2", [("10.56",)])

@@ -1,0 +1,227 @@
+import pytest
+
+from tidb_tpu.sql import ParseError, parse_sql
+from tidb_tpu.sql import ast
+from tidb_tpu.types import Decimal
+from tidb_tpu.types.field_type import TypeKind
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24;
+"""
+
+TPCH_Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus;
+"""
+
+
+def one(sql):
+    stmts = parse_sql(sql)
+    assert len(stmts) == 1
+    return stmts[0]
+
+
+class TestSelect:
+    def test_q6_shape(self):
+        s = one(TPCH_Q6)
+        assert isinstance(s, ast.SelectStmt)
+        assert len(s.fields) == 1
+        f = s.fields[0]
+        assert f.alias == "revenue"
+        assert isinstance(f.expr, ast.FuncCall) and f.expr.name == "SUM"
+        # where is an AND chain with a BETWEEN inside
+        found_between = []
+
+        def walk(e):
+            if isinstance(e, ast.Between):
+                found_between.append(e)
+            for attr in ("left", "right", "operand", "low", "high"):
+                sub = getattr(e, attr, None)
+                if isinstance(sub, ast.Expr):
+                    walk(sub)
+
+        walk(s.where)
+        assert len(found_between) == 1
+        b = found_between[0]
+        assert b.low == ast.Literal(Decimal.parse("0.05"), "decimal")
+
+    def test_q1_shape(self):
+        s = one(TPCH_Q1)
+        assert len(s.fields) == 10
+        assert len(s.group_by) == 2
+        assert len(s.order_by) == 2
+        assert s.fields[-1].expr.is_star
+        # date literal minus interval
+        assert isinstance(s.where, ast.BinaryOp)
+        assert isinstance(s.where.right, ast.BinaryOp)
+        assert isinstance(s.where.right.right, ast.IntervalExpr)
+        assert s.where.right.right.unit == "DAY"
+
+    def test_precedence(self):
+        s = one("select 1 + 2 * 3")
+        e = s.fields[0].expr
+        assert e.op == "+" and e.right.op == "*"
+
+    def test_and_or_precedence(self):
+        s = one("select * from t where a = 1 or b = 2 and c = 3")
+        w = s.where
+        assert w.op == "OR" and w.right.op == "AND"
+
+    def test_in_list_and_not_in(self):
+        s = one("select * from t where a in (1, 2) and b not in ('x')")
+        w = s.where
+        assert isinstance(w.left, ast.InList) and not w.left.negated
+        assert isinstance(w.right, ast.InList) and w.right.negated
+
+    def test_is_null(self):
+        s = one("select * from t where a is null and b is not null")
+        assert isinstance(s.where.left, ast.IsNull)
+        assert s.where.right.negated
+
+    def test_like(self):
+        s = one("select * from t where name like 'a%' and x not like '_b'")
+        assert isinstance(s.where.left, ast.Like)
+        assert s.where.right.negated
+
+    def test_joins(self):
+        s = one(
+            "select * from a join b on a.id = b.id "
+            "left join c as cc on b.k = cc.k"
+        )
+        j = s.from_
+        assert isinstance(j, ast.Join) and j.kind == "LEFT"
+        assert j.right.alias == "cc"
+        assert j.left.kind == "INNER"
+
+    def test_comma_join(self):
+        s = one("select * from a, b where a.x = b.x")
+        assert isinstance(s.from_, ast.Join) and s.from_.kind == "CROSS"
+
+    def test_limit_offset_forms(self):
+        assert one("select * from t limit 5").limit == 5
+        s = one("select * from t limit 10 offset 20")
+        assert (s.limit, s.offset) == (10, 20)
+        s2 = one("select * from t limit 20, 10")
+        assert (s2.limit, s2.offset) == (10, 20)
+
+    def test_group_having_order(self):
+        s = one(
+            "select a, count(*) from t group by a having count(*) > 1 "
+            "order by 2 desc, a"
+        )
+        assert s.having is not None
+        assert s.order_by[0].desc and not s.order_by[1].desc
+
+    def test_case_cast(self):
+        s = one(
+            "select case when a > 0 then 'pos' else 'neg' end, "
+            "cast(a as decimal(10,2)) from t"
+        )
+        assert isinstance(s.fields[0].expr, ast.Case)
+        c = s.fields[1].expr
+        assert isinstance(c, ast.Cast)
+        assert c.target.kind == TypeKind.DECIMAL and c.target.scale == 2
+
+    def test_subqueries(self):
+        s = one("select * from t where a in (select b from u) and "
+                "exists (select 1 from v)")
+        assert isinstance(s.where.left, ast.InSubquery)
+        assert isinstance(s.where.right, ast.SubqueryExpr)
+        assert s.where.right.exists
+
+    def test_derived_table(self):
+        s = one("select x from (select a as x from t) sub")
+        assert isinstance(s.from_, ast.SubqueryTable)
+        assert s.from_.alias == "sub"
+
+    def test_distinct_and_wildcards(self):
+        s = one("select distinct t.*, a from t")
+        assert s.distinct
+        assert s.fields[0].wildcard_table == "t"
+
+    def test_quoted_ident_and_comments(self):
+        s = one("select `select` from t -- trailing\n where /* c */ x = 1")
+        assert s.fields[0].expr.name == "select"
+
+
+class TestDMLDDL:
+    def test_insert_forms(self):
+        s = one("insert into t (a, b) values (1, 'x'), (2, 'y')")
+        assert s.columns == ["a", "b"] and len(s.rows) == 2
+        s2 = one("insert into t values (1)")
+        assert s2.columns is None
+        s3 = one("insert into t select * from u")
+        assert s3.select is not None
+
+    def test_update_delete(self):
+        s = one("update t set a = a + 1, b = 'x' where id = 3")
+        assert len(s.assignments) == 2
+        d = one("delete from t where a < 0")
+        assert d.where is not None
+
+    def test_create_table(self):
+        s = one(
+            "create table if not exists t ("
+            "id bigint primary key auto_increment, "
+            "name varchar(20) not null default 'n', "
+            "price decimal(10, 2), "
+            "created date, "
+            "key idx_name (name), "
+            "unique key uq (price, created))"
+        )
+        assert s.if_not_exists
+        assert len(s.columns) == 4 and len(s.indices) == 2
+        assert s.columns[0].primary_key and s.columns[0].auto_increment
+        assert s.columns[1].not_null
+        assert s.indices[1].unique
+
+    def test_create_drop_database(self):
+        assert one("create database if not exists db1").if_not_exists
+        assert one("drop database db1").name == "db1"
+
+    def test_drop_table_multi(self):
+        s = one("drop table if exists a, b")
+        assert s.if_exists and len(s.tables) == 2
+
+    def test_txn_stmts(self):
+        kinds = [type(s).__name__ for s in parse_sql(
+            "begin; commit; start transaction; rollback;"
+        )]
+        assert kinds == ["BeginStmt", "CommitStmt", "BeginStmt", "RollbackStmt"]
+
+    def test_explain_show_use(self):
+        e = one("explain select * from t")
+        assert isinstance(e.target, ast.SelectStmt)
+        assert one("show tables").kind == "TABLES"
+        assert one("show create table t").target.name == "t"
+        assert one("use mydb").db == "mydb"
+
+    def test_decimal_precision_rejected(self):
+        with pytest.raises(ParseError):
+            one("create table t (a decimal(30, 5))")
+
+    def test_error_position(self):
+        with pytest.raises(ParseError) as ei:
+            one("select from t")
+        assert "near" in str(ei.value)
+
+    def test_multi_statement(self):
+        stmts = parse_sql("select 1; select 2;")
+        assert len(stmts) == 2

@@ -1,0 +1,253 @@
+import pytest
+
+from tidb_tpu.catalog import Catalog, ColumnInfo, TableInfo
+from tidb_tpu.plan import (
+    PhysHashAgg,
+    PhysHashJoin,
+    PhysLimit,
+    PhysProjection,
+    PhysSort,
+    PhysTableRead,
+    PlanBuilder,
+    PlanError,
+    optimize,
+)
+from tidb_tpu.plan.expr import Col, Const
+from tidb_tpu.sql.parser import parse_one
+from tidb_tpu.types import (
+    bigint_type,
+    date_type,
+    decimal_type,
+    varchar_type,
+)
+
+
+@pytest.fixture
+def catalog():
+    cat = Catalog()
+    cols = [
+        ("l_orderkey", bigint_type()),
+        ("l_quantity", decimal_type(15, 2)),
+        ("l_extendedprice", decimal_type(15, 2)),
+        ("l_discount", decimal_type(15, 2)),
+        ("l_tax", decimal_type(15, 2)),
+        ("l_returnflag", varchar_type(1)),
+        ("l_linestatus", varchar_type(1)),
+        ("l_shipdate", date_type()),
+    ]
+    info = TableInfo(
+        id=cat.alloc_id(),
+        name="lineitem",
+        columns=[
+            ColumnInfo(cat.alloc_id(), n, t, i) for i, (n, t) in enumerate(cols)
+        ],
+    )
+    cat.add_table("test", info)
+    orders = TableInfo(
+        id=cat.alloc_id(),
+        name="orders",
+        columns=[
+            ColumnInfo(cat.alloc_id(), "o_orderkey", bigint_type(), 0),
+            ColumnInfo(cat.alloc_id(), "o_orderdate", date_type(), 1),
+        ],
+    )
+    cat.add_table("test", orders)
+    return cat
+
+
+def plan_sql(catalog, sql):
+    stmt = parse_one(sql)
+    logical = PlanBuilder(catalog).build_select(stmt)
+    return optimize(logical)
+
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+Q1 = """
+select l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+class TestPushdown:
+    def test_q6_full_pushdown(self, catalog):
+        p = plan_sql(catalog, Q6)
+        # Projection(final expr) <- HashAgg(final) <- TableRead(sel+agg)
+        assert isinstance(p, PhysProjection)
+        agg = p.children[0]
+        assert isinstance(agg, PhysHashAgg) and agg.mode == "final"
+        tr = agg.children[0]
+        assert isinstance(tr, PhysTableRead)
+        assert tr.dag.selection is not None
+        # between lowers to two conds: >= and <=, plus 3 more
+        assert len(tr.dag.selection.conditions) == 5
+        assert tr.dag.agg is not None and len(tr.dag.agg.aggs) == 1
+        # pruning: only 4 columns of 8 shipped
+        assert sorted(tr.dag.scan.col_offsets) == [1, 2, 3, 7]
+
+    def test_q6_interval_folded(self, catalog):
+        p = plan_sql(catalog, Q6)
+        tr = p.children[0].children[0]
+        conds = tr.dag.selection.conditions
+        # cond 1: l_shipdate < const(folded 1995-01-01)
+        c = conds[1]
+        assert isinstance(c.args[1], Const)
+        from tidb_tpu.types.value import decode_date
+        assert str(decode_date(c.args[1].value)) == "1995-01-01"
+
+    def test_q1_group_agg_pushdown(self, catalog):
+        p = plan_sql(catalog, Q1)
+        # Sort <- Projection <- HashAgg(final) <- TableRead
+        assert isinstance(p, PhysSort)
+        proj = p.children[0]
+        assert isinstance(proj, PhysProjection)
+        agg = proj.children[0]
+        assert isinstance(agg, PhysHashAgg) and agg.mode == "final"
+        tr = agg.children[0]
+        assert isinstance(tr, PhysTableRead)
+        assert len(tr.dag.agg.group_by) == 2
+        assert len(tr.dag.agg.aggs) == 4
+        # partial layout: 2 group cols + 4*(val,cnt) = 10 outputs
+        assert len(tr.schema) == 10
+
+    def test_count_distinct_not_pushed(self, catalog):
+        p = plan_sql(
+            catalog, "select count(distinct l_orderkey) from lineitem"
+        )
+        agg = p.children[0]
+        assert isinstance(agg, PhysHashAgg) and agg.mode == "complete"
+
+    def test_projection_pushdown(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_orderkey + 1, l_quantity from lineitem",
+        )
+        assert isinstance(p, PhysTableRead)
+        assert p.dag.projections is not None
+
+    def test_topn_pushdown(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_orderkey from lineitem order by l_quantity desc limit 10",
+        )
+        # trimming projection over table read with topn
+        tr = p
+        while not isinstance(tr, PhysTableRead):
+            tr = tr.children[0]
+        assert tr.dag.topn is not None and tr.dag.topn.n == 10
+
+    def test_string_order_not_pushed(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_orderkey from lineitem order by l_returnflag limit 5",
+        )
+        assert isinstance(p, PhysLimit)
+        n, found_sort = p, False
+        while True:
+            if isinstance(n, PhysSort):
+                found_sort = True
+            if isinstance(n, PhysTableRead):
+                assert n.dag.topn is None
+                break
+            n = n.children[0]
+        assert found_sort
+
+    def test_join_plan(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_orderkey, o_orderdate from lineitem "
+            "join orders on l_orderkey = o_orderkey "
+            "where l_quantity > 10",
+        )
+        assert isinstance(p, PhysProjection)
+        j = p.children[0]
+        assert isinstance(j, PhysHashJoin)
+        assert j.eq_conditions == [(0, 0)] or len(j.eq_conditions) == 1
+        # filter pushed into the left scan's DAG
+        left = j.children[0]
+        assert isinstance(left, PhysTableRead)
+        assert left.dag.selection is not None
+
+
+class TestBuilderSemantics:
+    def test_group_by_position_and_alias(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_returnflag rf, count(*) from lineitem group by 1",
+        )
+        assert isinstance(p.children[0] if not isinstance(p, PhysHashAgg) else p,
+                          (PhysHashAgg,))
+        p2 = plan_sql(
+            catalog,
+            "select l_returnflag rf, count(*) from lineitem group by rf",
+        )
+        assert p2 is not None
+
+    def test_non_grouped_column_rejected(self, catalog):
+        with pytest.raises(PlanError):
+            plan_sql(
+                catalog,
+                "select l_orderkey, count(*) from lineitem group by l_returnflag",
+            )
+
+    def test_unknown_column(self, catalog):
+        with pytest.raises(PlanError):
+            plan_sql(catalog, "select nope from lineitem")
+
+    def test_ambiguous_column(self, catalog):
+        with pytest.raises((PlanError, KeyError)):
+            plan_sql(
+                catalog,
+                "select l_orderkey from lineitem a join lineitem b "
+                "on a.l_orderkey = b.l_orderkey",
+            )
+
+    def test_having(self, catalog):
+        p = plan_sql(
+            catalog,
+            "select l_returnflag, count(*) c from lineitem "
+            "group by l_returnflag having count(*) > 10",
+        )
+        assert p is not None
+
+    def test_select_no_from(self, catalog):
+        p = plan_sql(catalog, "select 1 + 2")
+        assert isinstance(p, (PhysProjection, PhysTableRead))
+
+    def test_distinct(self, catalog):
+        p = plan_sql(catalog, "select distinct l_returnflag from lineitem")
+        found_agg = False
+        n = p
+        while True:
+            if isinstance(n, PhysHashAgg):
+                found_agg = True
+            if not n.children:
+                break
+            n = n.children[0]
+        assert found_agg
+
+    def test_decimal_type_inference(self, catalog):
+        stmt = parse_one(
+            "select sum(l_extendedprice * (1 - l_discount)) from lineitem"
+        )
+        logical = PlanBuilder(catalog).build_select(stmt)
+        # mul of scale-2 by (1-scale2) = scale 4
+        agg = logical.children[0]
+        from tidb_tpu.plan.logical import LogicalAggregation
+        while not isinstance(agg, LogicalAggregation):
+            agg = agg.children[0]
+        assert agg.aggs[0].ftype.scale == 4

@@ -1,0 +1,45 @@
+"""Testkit: run real SQL through the full stack against in-process storage.
+
+Counterpart of the reference's util/testkit (reference:
+util/testkit/testkit.go:116 NewTestKit, :215 MustExec, :267 MustQuery) —
+the pattern that makes the whole test suite clusterless.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tidb_tpu.session import ResultSet, Session
+from tidb_tpu.types import Decimal
+
+
+class TestKit:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, session: Session | None = None) -> None:
+        self.session = session or Session()
+
+    def must_exec(self, sql: str) -> ResultSet:
+        return self.session.execute(sql)
+
+    def must_query(self, sql: str) -> list[tuple[Any, ...]]:
+        return self.session.execute(sql).rows
+
+    def check(self, sql: str, expected: list[tuple[Any, ...]],
+              ordered: bool = True) -> None:
+        got = [tuple(_norm(v) for v in row) for row in self.must_query(sql)]
+        want = [tuple(_norm(v) for v in row) for row in expected]
+        if not ordered:
+            got = sorted(got, key=repr)
+            want = sorted(want, key=repr)
+        assert got == want, f"\n got: {got}\nwant: {want}\n sql: {sql}"
+
+
+def _norm(v: Any) -> Any:
+    if isinstance(v, Decimal):
+        return str(v)
+    if isinstance(v, float):
+        return round(v, 9)
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    return v

@@ -237,6 +237,8 @@ def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -
         assert dictionary is not None
         return dictionary.encode(str(v))
     if ftype.is_float:
+        if isinstance(v, Decimal):
+            return v.to_float()
         return float(v)
     if isinstance(v, bool):
         return int(v)

@@ -1,0 +1,3 @@
+from .client import CopClient, CopResult
+
+__all__ = ["CopClient", "CopResult"]

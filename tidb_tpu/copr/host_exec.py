@@ -1,0 +1,232 @@
+"""Host (numpy) execution of CopDAGs — the fallback tier.
+
+Counterpart of mocktikv's interpreted coprocessor (reference:
+store/mockstore/mocktikv/cop_handler_dag.go:57) but vectorized with numpy
+rather than row-at-a-time. Used when the device gate rejects a DAG:
+high-cardinality group keys (until the sort-based device kernel lands),
+string ordering compares, multi-key TopN, decimal division in projections.
+
+Produces byte-identical layouts to the device path (partial-agg layout or
+row layout) so the executor above never knows which tier answered.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column, Dictionary
+from ..plan.dag import CopDAG
+from ..plan.expr import Call, Col, Const, PlanExpr
+from ..store.table_store import TableSnapshot
+from ..types.field_type import FieldType, TypeKind
+from .npeval import NumpyEval, VV, _b, _truthy
+
+def execute_host(dag: CopDAG, snap: TableSnapshot, reason: str):
+    from .client import CopResult  # circular-safe
+
+    ev = _HostEval(dag, snap)
+    chunks = ev.run()
+    return CopResult(chunks, is_partial_agg=dag.agg is not None)
+
+
+class _HostEval(NumpyEval):
+    def __init__(self, dag: CopDAG, snap: TableSnapshot) -> None:
+        self.dag = dag
+        self.snap = snap
+        dicts: list[Optional[Dictionary]] = [
+            snap.dictionaries[off] for off in dag.scan.col_offsets
+        ]
+        cols: list[VV] = []
+        for off in dag.scan.col_offsets:
+            col = snap.column(off)
+            cols.append((col.data, col.validity))
+        n = cols[0][0].shape[0] if cols else snap.num_visible_rows
+        super().__init__(cols, dicts, n)
+
+    # ---- entry -------------------------------------------------------------
+    def run(self) -> list[Chunk]:
+        mask = np.ones(self.n, dtype=bool)
+        if self.dag.selection is not None:
+            for c in self.dag.selection.conditions:
+                v, vl = self.eval(c)
+                mask &= _truthy(v) & vl
+        if self.dag.agg is not None:
+            return self._agg(mask)
+        if self.dag.topn is not None:
+            return self._topn(mask)
+        idx = np.nonzero(mask)[0]
+        if self.dag.limit is not None:
+            idx = idx[: self.dag.limit.n]
+        return self._rows(idx)
+
+    # ---- row output --------------------------------------------------------
+    def _rows(self, idx: np.ndarray) -> list[Chunk]:
+        columns = []
+        if self.dag.projections is not None:
+            for pi, e in enumerate(self.dag.projections):
+                v, vl = self.eval(e)
+                ft = self.dag.output_types[pi]
+                dictionary = self._proj_dict(e)
+                columns.append(Column(
+                    ft, np.asarray(v)[idx].astype(ft.np_dtype),
+                    None if vl[idx].all() else vl[idx], dictionary))
+        else:
+            for ci, off in enumerate(self.dag.scan.col_offsets):
+                data, vl = self.cols[ci]
+                ft = self.dag.output_types[ci]
+                columns.append(Column(
+                    ft, data[idx], None if vl[idx].all() else vl[idx],
+                    self.snap.dictionaries[off]))
+        if not columns:
+            return []
+        return [Chunk(columns)]
+
+    def _proj_dict(self, e: PlanExpr) -> Optional[Dictionary]:
+        if isinstance(e, Col) and e.ftype.is_string:
+            return self.dicts[e.idx]
+        return None
+
+    # ---- TopN --------------------------------------------------------------
+    def _topn(self, mask: np.ndarray) -> list[Chunk]:
+        keys = []
+        for e, desc in reversed(self.dag.topn.items):  # lexsort: last primary
+            v, vl = self.eval(e)
+            if e.ftype.is_string:
+                d = self.dicts[e.idx] if isinstance(e, Col) else None
+                if d is not None and len(d):
+                    ranks = d.sort_ranks()
+                    v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
+            v = np.asarray(v)
+            if np.issubdtype(v.dtype, np.floating):
+                key = np.where(vl, v, -np.inf)  # NULLs first (asc)
+            else:
+                key = np.where(vl, v.astype(np.int64),
+                               np.iinfo(np.int64).min + 1)
+            if desc:
+                key = -key
+            keys.append(key)
+        order = np.lexsort(keys) if keys else np.arange(self.n)
+        order = order[mask[order]]
+        idx = order[: self.dag.topn.n]
+        return self._rows(idx)
+
+    # ---- aggregation (partial layout) --------------------------------------
+    def _agg(self, mask: np.ndarray) -> list[Chunk]:
+        agg = self.dag.agg
+        idx = np.nonzero(mask)[0]
+        ngroups_cols = len(agg.group_by)
+        if ngroups_cols == 0:
+            inv = np.zeros(len(idx), dtype=np.int64)
+            n_seg = 1
+            key_vals: list[VV] = []
+        else:
+            key_cols = []
+            key_vals = []
+            for g in agg.group_by:
+                v, vl = self.eval(g)
+                v = np.asarray(v)[idx]
+                vl = np.asarray(vl)[idx]
+                key_vals.append((v, vl))
+                if np.issubdtype(v.dtype, np.floating):
+                    enc = v.view(np.int64)
+                else:
+                    enc = v.astype(np.int64)
+                enc = np.where(vl, enc, np.iinfo(np.int64).min)
+                key_cols.append(enc)
+            stacked = np.stack(key_cols, axis=1) if key_cols else \
+                np.zeros((len(idx), 0), np.int64)
+            _, first_idx, inv = np.unique(
+                stacked, axis=0, return_index=True, return_inverse=True)
+            inv = inv.reshape(-1)
+            n_seg = len(first_idx)
+        if len(idx) == 0:
+            return []
+
+        order = np.argsort(inv, kind="stable")
+        sorted_inv = inv[order]
+        boundaries = np.nonzero(
+            np.r_[True, sorted_inv[1:] != sorted_inv[:-1]])[0]
+
+        def seg_sum(values: np.ndarray) -> np.ndarray:
+            return np.add.reduceat(values[order], boundaries)
+
+        def seg_min(values: np.ndarray) -> np.ndarray:
+            return np.minimum.reduceat(values[order], boundaries)
+
+        def seg_max(values: np.ndarray) -> np.ndarray:
+            return np.maximum.reduceat(values[order], boundaries)
+
+        columns: list[Column] = []
+        for gi, g in enumerate(agg.group_by):
+            v, vl = key_vals[gi]
+            gfirst = v[order][boundaries]
+            gvalid = vl[order][boundaries]
+            dictionary = self._proj_dict(g)
+            columns.append(Column(
+                g.ftype, gfirst.astype(g.ftype.np_dtype),
+                None if gvalid.all() else gvalid, dictionary))
+        rows_per_seg = seg_sum(np.ones(len(idx), np.int64))
+        for ai, d in enumerate(agg.aggs):
+            val_t = self.dag.output_types[ngroups_cols + 2 * ai]
+            if d.arg is None:
+                cnt = rows_per_seg
+                val = cnt
+                columns.append(Column(val_t, val.astype(val_t.np_dtype)))
+                columns.append(Column(
+                    FieldType(TypeKind.BIGINT, nullable=False), cnt))
+                continue
+            av, avl = self.eval(d.arg)
+            av = np.asarray(av)[idx]
+            avl = np.asarray(avl)[idx]
+            cnt = seg_sum(avl.astype(np.int64))
+            if d.func in ("sum", "avg", "count"):
+                if np.issubdtype(av.dtype, np.floating):
+                    vv = np.where(avl, av, 0.0)
+                else:
+                    vv = np.where(avl, av.astype(np.int64), 0)
+                val = seg_sum(vv)
+                if d.func == "count":
+                    val = cnt
+            elif d.func == "min":
+                big = np.inf if np.issubdtype(av.dtype, np.floating) else \
+                    np.iinfo(np.int64).max
+                val = seg_min(np.where(avl, av.astype(
+                    av.dtype if np.issubdtype(av.dtype, np.floating)
+                    else np.int64), big))
+                val = np.where(cnt > 0, val, 0)
+            elif d.func == "max":
+                small = -np.inf if np.issubdtype(av.dtype, np.floating) else \
+                    np.iinfo(np.int64).min
+                val = seg_max(np.where(avl, av.astype(
+                    av.dtype if np.issubdtype(av.dtype, np.floating)
+                    else np.int64), small))
+                val = np.where(cnt > 0, val, 0)
+            else:
+                raise NotImplementedError(d.func)
+            columns.append(Column(val_t, val.astype(val_t.np_dtype),
+                                  None if (cnt > 0).all() else cnt > 0))
+            columns.append(Column(
+                FieldType(TypeKind.BIGINT, nullable=False), cnt))
+        # distinct counting host-side
+        for ai, d in enumerate(agg.aggs):
+            if d.distinct and d.func == "count":
+                av, avl = self.eval(d.arg)
+                av = np.asarray(av)[idx]
+                avl = np.asarray(avl)[idx]
+                distinct_cnt = np.zeros(n_seg, dtype=np.int64)
+                enc = np.where(avl, av.astype(np.int64),
+                               np.iinfo(np.int64).min)
+                pairs = np.stack([inv, enc], axis=1)[avl]
+                if len(pairs):
+                    upairs = np.unique(pairs, axis=0)
+                    segs, c = np.unique(upairs[:, 0], return_counts=True)
+                    distinct_cnt[segs] = c
+                vi = ngroups_cols + 2 * ai
+                columns[vi] = Column(columns[vi].ftype, distinct_cnt)
+                columns[vi + 1] = Column(
+                    FieldType(TypeKind.BIGINT, nullable=False), distinct_cnt)
+        return [Chunk(columns)]
+

@@ -1,0 +1,400 @@
+"""NumpyEval: vectorized host-side expression evaluation.
+
+The numpy twin of copr/eval.py (reference keeps the same duality: row-based
+eval* alongside vectorized vecEval*, expression/builtin_*.go). Shared by the
+host coprocessor fallback (copr/host_exec.py) and the host volcano operators
+(executor/) for selections, projections, join/sort keys, and complete
+aggregation over operator output chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..chunk.column import Dictionary
+from ..plan.expr import Call, Col, Const, PlanExpr
+from ..types.field_type import FieldType, TypeKind
+
+VV = tuple[np.ndarray, np.ndarray]
+
+
+class NumpyEval:
+    """Evaluates resolved expressions over (data, valid) numpy column pairs."""
+
+    def __init__(
+        self,
+        cols: list[VV],
+        dicts: list[Optional[Dictionary]],
+        n: int,
+    ) -> None:
+        self.cols = cols
+        self.dicts = dicts
+        self.n = n
+
+    # ---- string-domain evaluation -------------------------------------------
+    def eval_str(self, e: PlanExpr) -> VV:
+        """Evaluate a string-typed expression to (object array of str, valid).
+
+        Used when the value crosses dictionary domains (CASE branches,
+        IFNULL over different columns, literals) — the caller re-encodes the
+        result into a fresh dictionary."""
+        if isinstance(e, Col):
+            codes, vl = self.cols[e.idx]
+            d = self.dicts[e.idx]
+            if d is None or len(d) == 0:
+                return np.full(self.n, "", dtype=object), \
+                    np.zeros(self.n, bool) if d is None else vl
+            vals = np.array(d.values, dtype=object)
+            return vals[np.clip(codes, 0, len(d) - 1)], vl
+        if isinstance(e, Const):
+            if e.value is None:
+                return (np.full(self.n, "", dtype=object),
+                        np.zeros(self.n, bool))
+            return (np.full(self.n, str(e.value), dtype=object),
+                    np.ones(self.n, bool))
+        assert isinstance(e, Call)
+        op = e.op
+        A = e.args
+        if op == "if":
+            cv, cvl = _b(self.eval(A[0]))
+            tv, tvl = self.eval_str(A[1])
+            fv, fvl = self.eval_str(A[2])
+            cond = cv & cvl
+            return np.where(cond, tv, fv), np.where(cond, tvl, fvl)
+        if op == "ifnull":
+            av, avl = self.eval_str(A[0])
+            bv, bvl = self.eval_str(A[1])
+            return np.where(avl, av, bv), avl | bvl
+        if op == "coalesce":
+            out_v, out_vl = self.eval_str(A[0])
+            for a in A[1:]:
+                av, avl = self.eval_str(a)
+                out_v = np.where(out_vl, out_v, av)
+                out_vl = out_vl | avl
+            return out_v, out_vl
+        if op == "case":
+            has_else = len(A) % 2 == 1
+            pairs = (len(A) - 1) // 2 if has_else else len(A) // 2
+            if has_else:
+                out_v, out_vl = self.eval_str(A[-1])
+                out_v = np.array(out_v, copy=True)
+                out_vl = np.array(out_vl, copy=True)
+            else:
+                out_v = np.full(self.n, "", dtype=object)
+                out_vl = np.zeros(self.n, bool)
+            decided = np.zeros(self.n, bool)
+            for i in range(pairs):
+                cv, cvl = _b(self.eval(A[2 * i]))
+                tv, tvl = self.eval_str(A[2 * i + 1])
+                take = cv & cvl & ~decided
+                out_v = np.where(take, tv, out_v)
+                out_vl = np.where(take, tvl, out_vl)
+                decided |= take
+            return out_v, out_vl
+        raise NotImplementedError(f"string eval: {op}")
+
+    # ---- evaluation ---------------------------------------------------------
+    def eval(self, e: PlanExpr) -> VV:
+        if isinstance(e, Col):
+            return self.cols[e.idx]
+        if isinstance(e, Const):
+            if e.value is None:
+                return (np.zeros(self.n, dtype=e.ftype.np_dtype),
+                        np.zeros(self.n, dtype=bool))
+            v = e.value
+            if e.ftype.is_string:
+                # resolved per comparison; free-standing only for eq against
+                # another string expr handled below
+                return (np.full(self.n, -2, dtype=np.int64),
+                        np.ones(self.n, dtype=bool))
+            return (np.full(self.n, v, dtype=e.ftype.np_dtype),
+                    np.ones(self.n, dtype=bool))
+        assert isinstance(e, Call)
+        return self._call(e)
+
+    def _call(self, e: Call) -> VV:
+        op = e.op
+        A = e.args
+
+        if op == "and":
+            av, avl = _b(self.eval(A[0]))
+            bv, bvl = _b(self.eval(A[1]))
+            known_false = (avl & ~av) | (bvl & ~bv)
+            valid = (avl & bvl) | known_false
+            return av & bv & valid, valid
+        if op == "or":
+            av, avl = _b(self.eval(A[0]))
+            bv, bvl = _b(self.eval(A[1]))
+            value = (av & avl) | (bv & bvl)
+            valid = (avl & bvl) | value
+            return value, valid
+        if op == "not":
+            av, avl = _b(self.eval(A[0]))
+            return (~av) & avl, avl
+        if op == "isnull":
+            _, avl = self.eval(A[0])
+            return ~avl, np.ones_like(avl)
+
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return self._compare(e)
+
+        if op == "in_values":
+            arg = A[0]
+            av, avl = self.eval(arg)
+            if arg.ftype.is_string and isinstance(arg, Col):
+                d = self.dicts[arg.idx]
+                assert d is not None
+                codes = [d.lookup(str(v)) for v in e.extra]
+                hit = np.isin(av, [c for c in codes if c >= 0])
+            else:
+                vals = e.extra
+                hit = np.isin(av, np.array(vals))
+            return hit & avl, avl
+        if op == "like":
+            arg = A[0]
+            av, avl = self.eval(arg)
+            assert isinstance(arg, Col)
+            d = self.dicts[arg.idx]
+            assert d is not None
+            import re
+            from .client import _like_to_regex
+            rx = re.compile(_like_to_regex(str(e.extra)), re.DOTALL)
+            if len(d):
+                table = np.fromiter((rx.fullmatch(s) is not None
+                                     for s in d.values), bool, count=len(d))
+                return table[np.clip(av, 0, len(d) - 1)] & avl, avl
+            return np.zeros(self.n, bool), avl
+
+        if op in ("add", "sub", "mul"):
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            if e.ftype.is_float:
+                av = _f(av, A[0].ftype)
+                bv = _f(bv, A[1].ftype)
+            elif e.ftype.is_decimal and op in ("add", "sub"):
+                av = _rescale(av, A[0].ftype, e.ftype.scale)
+                bv = _rescale(bv, A[1].ftype, e.ftype.scale)
+            fn = {"add": np.add, "sub": np.subtract, "mul": np.multiply}[op]
+            return fn(av, bv), avl & bvl
+        if op == "div":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            if e.ftype.is_float:
+                av = _f(av, A[0].ftype)
+                bv = _f(bv, A[1].ftype)
+                nz = bv != 0
+                return np.where(nz, av / np.where(nz, bv, 1.0), 0.0), \
+                    avl & bvl & nz
+            # exact decimal division via object ints
+            sa = A[0].ftype.scale if A[0].ftype.is_decimal else 0
+            sb = A[1].ftype.scale if A[1].ftype.is_decimal else 0
+            target = e.ftype.scale
+            nz = bv != 0
+            ao = av.astype(object) * (10 ** (target - sa + sb))
+            bo = np.where(nz, bv, 1).astype(object)
+            q = np.abs(ao) // np.abs(bo)
+            r = np.abs(ao) - q * np.abs(bo)
+            q = q + (2 * r >= np.abs(bo))
+            q = np.where((av < 0) != (bv < 0), -q, q)
+            return q.astype(np.int64), avl & bvl & nz
+        if op == "intdiv":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            nz = bv != 0
+            sb = np.where(nz, bv, 1)
+            q = np.abs(av) // np.abs(sb)
+            q = np.where((av < 0) != (bv < 0), -q, q)
+            return q, avl & bvl & nz
+        if op == "mod":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            nz = bv != 0
+            sb = np.where(nz, bv, 1)
+            r = np.abs(av) % np.abs(sb)
+            r = np.where(av < 0, -r, r)
+            return r, avl & bvl & nz
+        if op == "neg":
+            av, avl = self.eval(A[0])
+            return -av, avl
+        if op == "abs":
+            av, avl = self.eval(A[0])
+            return np.abs(av), avl
+
+        if op == "if":
+            cv, cvl = _b(self.eval(A[0]))
+            tv, tvl = self.eval(A[1])
+            fv, fvl = self.eval(A[2])
+            cond = cv & cvl
+            return np.where(cond, tv, fv), np.where(cond, tvl, fvl)
+        if op == "ifnull":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            return np.where(avl, av, bv), avl | bvl
+        if op == "coalesce":
+            out_v, out_vl = self.eval(A[0])
+            for a in A[1:]:
+                av, avl = self.eval(a)
+                out_v = np.where(out_vl, out_v, av)
+                out_vl = out_vl | avl
+            return out_v, out_vl
+        if op == "case":
+            has_else = len(A) % 2 == 1
+            pairs = (len(A) - 1) // 2 if has_else else len(A) // 2
+            if has_else:
+                out_v, out_vl = self.eval(A[-1])
+                out_v = np.array(out_v, copy=True)
+                out_vl = np.array(out_vl, copy=True)
+            else:
+                out_v = np.zeros(self.n, dtype=e.ftype.np_dtype)
+                out_vl = np.zeros(self.n, dtype=bool)
+            decided = np.zeros(self.n, dtype=bool)
+            for i in range(pairs):
+                cv, cvl = _b(self.eval(A[2 * i]))
+                tv, tvl = self.eval(A[2 * i + 1])
+                take = cv & cvl & ~decided
+                out_v = np.where(take, tv, out_v)
+                out_vl = np.where(take, tvl, out_vl)
+                decided |= take
+            return out_v, out_vl
+
+        if op in ("year", "month", "day"):
+            av, avl = self.eval(A[0])
+            days = av
+            if A[0].ftype.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+                days = av // 86_400_000_000
+            y, m, d = _civil(days.astype(np.int64))
+            return {"year": y, "month": m, "day": d}[op], avl
+        if op == "date_add_days":
+            av, avl = self.eval(A[0])
+            return av + int(e.extra), avl
+        if op == "cast":
+            return self._cast(self.eval(A[0]), A[0].ftype, e.ftype)
+
+        raise NotImplementedError(f"host eval: {op}")
+
+    def _compare(self, e: Call) -> VV:
+        op = e.op
+        a, b = e.args
+        av, avl = self.eval(a)
+        bv, bvl = self.eval(b)
+        # string comparisons via dictionaries
+        if a.ftype.is_string or b.ftype.is_string:
+            av2, bv2 = self._string_operands(a, av, b, bv, op)
+        else:
+            av2, bv2 = _align(a.ftype, av, b.ftype, bv)
+        fn = {"eq": np.equal, "ne": np.not_equal, "lt": np.less,
+              "le": np.less_equal, "gt": np.greater, "ge": np.greater_equal}[op]
+        valid = avl & bvl
+        return fn(av2, bv2) & valid, valid
+
+    def _string_operands(self, a, av, b, bv, op):
+        def decode(e, v):
+            if isinstance(e, Col) and e.ftype.is_string:
+                d = self.dicts[e.idx]
+                assert d is not None
+                if op in ("eq", "ne"):
+                    return v  # codes compare fine for equality
+                vals = np.array(d.values + [""], dtype=object)
+                return vals[np.clip(v, 0, len(d))]
+            if isinstance(e, Const) and e.ftype.is_string:
+                if op in ("eq", "ne"):
+                    other = b if e is a else a
+                    if isinstance(other, Col) and other.ftype.is_string:
+                        d = self.dicts[other.idx]
+                        assert d is not None
+                        return np.full(self.n, d.lookup(str(e.value)),
+                                       np.int64)
+                return np.full(self.n, str(e.value), dtype=object)
+            return v
+
+        return decode(a, av), decode(b, bv)
+
+    def _cast(self, vv: VV, src: FieldType, dst: FieldType) -> VV:
+        v, vl = vv
+        if dst.is_float:
+            f = _f(v, src)
+            return f, vl
+        if dst.is_decimal:
+            if src.is_decimal:
+                return _rescale_round(v, src.scale, dst.scale), vl
+            if src.is_integer:
+                return v.astype(np.int64) * 10 ** dst.scale, vl
+            if src.is_float:
+                scaled = v * 10 ** dst.scale
+                q = np.floor(np.abs(scaled) + 0.5)
+                return np.where(scaled < 0, -q, q).astype(np.int64), vl
+        if dst.is_integer:
+            if src.is_decimal:
+                return _rescale_round(v, src.scale, 0), vl
+            if src.is_float:
+                q = np.floor(np.abs(v) + 0.5)
+                return np.where(v < 0, -q, q).astype(np.int64), vl
+            return v.astype(np.int64), vl
+        if dst.is_string and src.is_string:
+            return v, vl
+        raise NotImplementedError(f"host cast {src!r} -> {dst!r}")
+
+
+# ---- helpers ----------------------------------------------------------------
+
+def _truthy(v: np.ndarray) -> np.ndarray:
+    if v.dtype != np.bool_:
+        return v != 0
+    return v
+
+
+def _b(vv: VV) -> VV:
+    v, vl = vv
+    return _truthy(np.asarray(v)), vl
+
+
+def _f(v: np.ndarray, ft: FieldType) -> np.ndarray:
+    out = v.astype(np.float64)
+    if ft.is_decimal:
+        out = out / 10 ** ft.scale
+    return out
+
+
+def _rescale(v: np.ndarray, ft: FieldType, target_scale: int) -> np.ndarray:
+    s = ft.scale if ft.is_decimal else 0
+    if s < target_scale:
+        return v.astype(np.int64) * 10 ** (target_scale - s)
+    return v
+
+
+def _rescale_round(v: np.ndarray, s: int, target: int) -> np.ndarray:
+    if s == target:
+        return v
+    if s < target:
+        return v * 10 ** (target - s)
+    f = 10 ** (s - target)
+    q = (np.abs(v) + f // 2) // f
+    return np.where(v < 0, -q, q)
+
+
+def _align(at: FieldType, av, bt: FieldType, bv):
+    if at.is_float or bt.is_float:
+        return _f(av, at), _f(bv, bt)
+    sa = at.scale if at.is_decimal else 0
+    sb = bt.scale if bt.is_decimal else 0
+    if sa < sb:
+        av = av.astype(np.int64) * 10 ** (sb - sa)
+    elif sb < sa:
+        bv = bv.astype(np.int64) * 10 ** (sa - sb)
+    return av, bv
+
+
+def _civil(z: np.ndarray):
+    z = z + 719_468
+    era = np.where(z >= 0, z, z - 146_096) // 146_097
+    doe = z - era * 146_097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y, m, d

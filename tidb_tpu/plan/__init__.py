@@ -1,0 +1,38 @@
+from .expr import Col, Const, Call, AggDesc, ExprError
+from .schema import ResultField, PlanSchema
+from .dag import CopDAG, DAGScan, DAGSelection, DAGAggregation, DAGTopN, DAGLimit
+from .logical import (
+    LogicalPlan,
+    LogicalScan,
+    LogicalSelection,
+    LogicalProjection,
+    LogicalAggregation,
+    LogicalJoin,
+    LogicalSort,
+    LogicalLimit,
+)
+from .builder import PlanBuilder, PlanError
+from .physical import (
+    PhysicalPlan,
+    PhysTableRead,
+    PhysSelection,
+    PhysProjection,
+    PhysHashAgg,
+    PhysHashJoin,
+    PhysSort,
+    PhysLimit,
+    optimize,
+    explain_plan,
+)
+
+__all__ = [
+    "Col", "Const", "Call", "AggDesc", "ExprError",
+    "ResultField", "PlanSchema",
+    "CopDAG", "DAGScan", "DAGSelection", "DAGAggregation", "DAGTopN", "DAGLimit",
+    "LogicalPlan", "LogicalScan", "LogicalSelection", "LogicalProjection",
+    "LogicalAggregation", "LogicalJoin", "LogicalSort", "LogicalLimit",
+    "PlanBuilder", "PlanError",
+    "PhysicalPlan", "PhysTableRead", "PhysSelection", "PhysProjection",
+    "PhysHashAgg", "PhysHashJoin", "PhysSort", "PhysLimit",
+    "optimize", "explain_plan",
+]

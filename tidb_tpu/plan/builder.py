@@ -1,0 +1,815 @@
+"""AST -> logical plan: name resolution, type inference, agg extraction.
+
+Counterpart of the reference's logical plan builder (reference:
+planner/core/logical_plan_builder.go + planbuilder.go — buildSelect,
+buildAggregation, buildProjection, havingWindowAndOrderbyExprResolver).
+Strict ONLY_FULL_GROUP_BY semantics: a non-aggregated column must appear in
+GROUP BY.
+
+Constant folding runs inline during resolution (reference:
+expression/constant_fold.go) — required for plan-time temporal arithmetic
+like `date '1998-12-01' - interval '90' day`.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Callable, Optional
+
+from ..catalog.schema import Catalog, TableInfo
+from ..sql import ast
+from ..types.field_type import FieldType, TypeKind, boolean_type
+from ..types.value import Decimal, decode_date, encode_date, parse_date, parse_datetime
+from .expr import (
+    AggDesc,
+    Call,
+    Col,
+    Const,
+    ExprError,
+    PlanExpr,
+    agg_result_type,
+    arith_result_type,
+    bool_call,
+    comparable,
+    is_numeric,
+)
+from .logical import (
+    LogicalAggregation,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalScan,
+    LogicalSelection,
+    LogicalSort,
+)
+from .schema import PlanSchema, ResultField
+
+_AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX"}
+
+_ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div",
+              "DIV": "intdiv", "%": "mod"}
+_CMP_OPS = {"=": "eq", "<=>": "eq", "<>": "ne", "<": "lt", "<=": "le",
+            ">": "gt", ">=": "ge"}
+_CMP_SWAP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt",
+             "ge": "le"}
+
+
+class PlanError(Exception):
+    pass
+
+
+def ast_key(node: object) -> str:
+    """Structural identity for AST expressions (group-by matching)."""
+    return repr(node).lower()
+
+
+class PlanBuilder:
+    def __init__(self, catalog: Catalog, current_db: str = "test") -> None:
+        self.catalog = catalog
+        self.current_db = current_db
+
+    # ==================== SELECT ====================
+    def build_select(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        if stmt.from_ is None:
+            plan = self._build_dual(stmt)
+        else:
+            plan = self.build_table_refs(stmt.from_)
+
+        if stmt.where is not None:
+            conds = self._split_conjuncts(self.resolve(stmt.where, plan.schema))
+            plan = LogicalSelection(conds, plan.schema, [plan])
+
+        has_agg = bool(stmt.group_by) or any(
+            f.expr is not None and _contains_agg(f.expr) for f in stmt.fields
+        ) or (stmt.having is not None and _contains_agg(stmt.having))
+
+        if has_agg:
+            plan = self._build_aggregate(stmt, plan)
+        else:
+            if stmt.having is not None:
+                raise PlanError("HAVING without aggregation/group-by")
+            plan = self._build_projection(stmt, plan)
+
+        if stmt.distinct:
+            plan = self._build_distinct(plan)
+
+        if stmt.order_by:
+            plan = self._build_sort(stmt, plan)
+
+        if stmt.limit is not None or stmt.offset:
+            limit = stmt.limit if stmt.limit is not None else 2**62
+            plan = LogicalLimit(limit, stmt.offset, plan.schema, [plan])
+        return plan
+
+    # ---- FROM -------------------------------------------------------------
+    def build_table_refs(self, ref: ast.TableRef) -> LogicalPlan:
+        if isinstance(ref, ast.TableName):
+            return self._build_scan(ref)
+        if isinstance(ref, ast.Join):
+            return self._build_join(ref)
+        if isinstance(ref, ast.SubqueryTable):
+            sub = self.build_select(ref.query)
+            alias = (ref.alias or "").lower()
+            fields = [
+                ResultField(f.name, f.ftype, alias) for f in sub.schema.fields
+            ]
+            sub.schema = PlanSchema(fields)
+            return sub
+        raise PlanError(f"unsupported table reference {type(ref).__name__}")
+
+    def _build_scan(self, tn: ast.TableName) -> LogicalScan:
+        db = tn.db or self.current_db
+        try:
+            info = self.catalog.table(db, tn.name)
+        except KeyError as e:
+            raise PlanError(str(e)) from None
+        alias = (tn.alias or tn.name).lower()
+        fields = [
+            ResultField(c.name.lower(), c.ftype, alias, source_offset=c.offset)
+            for c in info.columns
+        ]
+        return LogicalScan(info, alias, PlanSchema(fields))
+
+    def _build_join(self, j: ast.Join) -> LogicalPlan:
+        left = self.build_table_refs(j.left)
+        right = self.build_table_refs(j.right)
+        merged = PlanSchema(left.schema.fields + right.schema.fields)
+        eq: list[tuple[int, int]] = []
+        others: list[PlanExpr] = []
+        nleft = len(left.schema)
+        if j.using:
+            for name in j.using:
+                li = left.schema.resolve(name)
+                ri = right.schema.resolve(name)
+                if li is None or ri is None:
+                    raise PlanError(f"USING column {name} not found on both sides")
+                eq.append((li, ri))
+        elif j.on is not None:
+            for cond in self._split_conjuncts(self.resolve(j.on, merged)):
+                pair = _as_equi_pair(cond, nleft)
+                if pair is not None:
+                    eq.append(pair)
+                else:
+                    others.append(cond)
+        kind = j.kind if j.kind != "CROSS" else "INNER"
+        if j.kind == "CROSS" and not eq and not others:
+            kind = "CROSS"
+        return LogicalJoin(kind, eq, others, merged, [left, right])
+
+    def _build_dual(self, stmt: ast.SelectStmt) -> LogicalPlan:
+        """SELECT without FROM: a one-row, zero-column pseudo scan."""
+        return LogicalScan(
+            TableInfo(id=-1, name="dual", columns=[]), "dual", PlanSchema([])
+        )
+
+    # ---- projection / aggregation -----------------------------------------
+    def _expand_fields(
+        self, stmt: ast.SelectStmt, child_schema: PlanSchema
+    ) -> list[tuple[ast.Expr, Optional[str]]]:
+        """Expand wildcards into (expr, alias) pairs."""
+        out: list[tuple[ast.Expr, Optional[str]]] = []
+        for f in stmt.fields:
+            if f.expr is not None:
+                out.append((f.expr, f.alias))
+                continue
+            for rf in child_schema.fields:
+                if f.wildcard_table and rf.table_alias != f.wildcard_table.lower():
+                    continue
+                out.append((ast.ColumnRef(rf.name, table=rf.table_alias or None),
+                            None))
+            if not out:
+                raise PlanError("wildcard expanded to no columns")
+        return out
+
+    def _build_projection(
+        self, stmt: ast.SelectStmt, child: LogicalPlan
+    ) -> LogicalProjection:
+        pairs = self._expand_fields(stmt, child.schema)
+        exprs: list[PlanExpr] = []
+        fields: list[ResultField] = []
+        for e, alias in pairs:
+            pe = self.resolve(e, child.schema)
+            exprs.append(pe)
+            fields.append(ResultField(_output_name(e, alias), pe.ftype))
+        return LogicalProjection(exprs, PlanSchema(fields), [child])
+
+    def _build_aggregate(
+        self, stmt: ast.SelectStmt, child: LogicalPlan
+    ) -> LogicalPlan:
+        child_schema = child.schema
+        # 1. resolve group-by expressions (positional ints and aliases allowed)
+        pairs = self._expand_fields(stmt, child_schema)
+        group_ast: list[ast.Expr] = []
+        for g in stmt.group_by:
+            if isinstance(g, ast.Literal) and g.tag == "int":
+                k = int(g.value)
+                if not (1 <= k <= len(pairs)):
+                    raise PlanError(f"GROUP BY position {k} out of range")
+                group_ast.append(pairs[k - 1][0])
+            elif isinstance(g, ast.ColumnRef) and g.table is None and any(
+                alias and alias.lower() == g.name.lower() for _, alias in pairs
+            ):
+                idx = next(i for i, (_, a) in enumerate(pairs)
+                           if a and a.lower() == g.name.lower())
+                group_ast.append(pairs[idx][0])
+            else:
+                group_ast.append(g)
+        group_exprs = [self.resolve(g, child_schema) for g in group_ast]
+        group_keys = [ast_key(g) for g in group_ast]
+
+        # 2. collect aggregate descriptors across select/having/order exprs
+        aggs: list[AggDesc] = []
+        agg_keys: dict[str, int] = {}
+
+        def collect(e: ast.Expr) -> None:
+            for call in _find_aggs(e):
+                key = ast_key(call)
+                if key in agg_keys:
+                    continue
+                func = call.name.lower()
+                if call.is_star:
+                    arg = None
+                elif len(call.args) == 1:
+                    arg = self.resolve(call.args[0], child_schema)
+                else:
+                    raise PlanError(f"{call.name} takes one argument")
+                if func != "count" and arg is None:
+                    raise PlanError(f"{call.name}(*) is not valid")
+                desc = AggDesc(func, arg, agg_result_type(func, arg),
+                               call.distinct, name=key)
+                agg_keys[key] = len(aggs)
+                aggs.append(desc)
+
+        for e, _ in pairs:
+            collect(e)
+        if stmt.having is not None:
+            collect(stmt.having)
+        for item in stmt.order_by:
+            collect(item.expr)
+        if not aggs and not group_exprs:
+            raise PlanError("aggregation without aggregates or group by")
+
+        # 3. agg node schema: [group cols..., agg results...]
+        agg_fields = []
+        for i, (g, ga) in enumerate(zip(group_exprs, group_ast)):
+            name = ga.name.lower() if isinstance(ga, ast.ColumnRef) else f"group#{i}"
+            tbl = (ga.table or "").lower() if isinstance(ga, ast.ColumnRef) else ""
+            agg_fields.append(ResultField(name, g.ftype, tbl))
+        for i, d in enumerate(aggs):
+            agg_fields.append(ResultField(f"agg#{i}", d.ftype))
+        agg_plan = LogicalAggregation(
+            group_exprs, aggs, PlanSchema(agg_fields), [child]
+        )
+
+        # 4. projection over agg output: replace agg calls / group exprs
+        ngroups = len(group_exprs)
+
+        def resolve_over_agg(e: ast.Expr) -> PlanExpr:
+            key = ast_key(e)
+            if key in agg_keys:
+                i = ngroups + agg_keys[key]
+                return Col(i, agg_plan.schema.fields[i].ftype,
+                           repr(aggs[agg_keys[key]]))
+            for gi, gkey in enumerate(group_keys):
+                if key == gkey:
+                    return Col(gi, group_exprs[gi].ftype,
+                               agg_plan.schema.fields[gi].name)
+            if isinstance(e, ast.ColumnRef):
+                idx = agg_plan.schema.resolve(e.name, e.table)
+                if idx is not None and idx < ngroups:
+                    return Col(idx, agg_plan.schema.fields[idx].ftype, e.name)
+                if e.table is None:
+                    # select-field alias (MySQL allows these in HAVING/ORDER)
+                    for fe, alias in pairs:
+                        if alias and alias.lower() == e.name.lower():
+                            return resolve_over_agg(fe)
+                raise PlanError(
+                    f"column {e} must appear in GROUP BY or an aggregate"
+                )
+            return self._resolve_composite(e, resolve_over_agg)
+
+        exprs = []
+        fields = []
+        for e, alias in pairs:
+            pe = resolve_over_agg(e)
+            exprs.append(pe)
+            fields.append(ResultField(_output_name(e, alias), pe.ftype))
+        plan: LogicalPlan = LogicalProjection(exprs, PlanSchema(fields), [agg_plan])
+
+        # 5. HAVING: filter between agg and projection (resolved in agg scope)
+        if stmt.having is not None:
+            cond = resolve_over_agg(stmt.having)
+            # insert selection under the projection
+            sel = LogicalSelection(
+                self._split_conjuncts(cond), agg_plan.schema, [agg_plan]
+            )
+            plan.children[0] = sel
+        # stash for order-by resolution
+        plan._agg_resolver = resolve_over_agg  # type: ignore[attr-defined]
+        return plan
+
+    def _build_distinct(self, child: LogicalPlan) -> LogicalPlan:
+        """DISTINCT = group by every output column (reference lowers it the
+        same way, planner/core/logical_plan_builder.go buildDistinct)."""
+        group = [
+            Col(i, f.ftype, f.name) for i, f in enumerate(child.schema.fields)
+        ]
+        return LogicalAggregation(group, [], child.schema, [child])
+
+    def _build_sort(self, stmt: ast.SelectStmt, plan: LogicalPlan) -> LogicalPlan:
+        out_schema = plan.schema
+        resolver: Optional[Callable] = getattr(plan, "_agg_resolver", None)
+        proj = plan if isinstance(plan, LogicalProjection) else None
+        items: list[tuple[PlanExpr, bool]] = []
+        hidden: list[PlanExpr] = []  # appended projection cols for sort-only refs
+        for item in stmt.order_by:
+            e = item.expr
+            pe: Optional[PlanExpr] = None
+            if isinstance(e, ast.Literal) and e.tag == "int":
+                k = int(e.value)
+                if not (1 <= k <= len(out_schema)):
+                    raise PlanError(f"ORDER BY position {k} out of range")
+                pe = Col(k - 1, out_schema.fields[k - 1].ftype)
+            elif isinstance(e, ast.ColumnRef) and e.table is None:
+                idx = out_schema.resolve(e.name)
+                if idx is not None:
+                    pe = Col(idx, out_schema.fields[idx].ftype, e.name)
+            if pe is None and proj is not None:
+                # match select expressions structurally
+                key = ast_key(e)
+                pairs = self._expand_fields(stmt, proj.children[0].schema) \
+                    if resolver is None else None
+                if pairs is not None:
+                    for i, (fe, _) in enumerate(pairs):
+                        if ast_key(fe) == key:
+                            pe = Col(i, out_schema.fields[i].ftype)
+                            break
+            if pe is None:
+                if resolver is not None:
+                    under = resolver(e)
+                    # add as hidden projection column
+                    assert proj is not None
+                    proj.exprs.append(under)
+                    hid_idx = len(proj.schema.fields)
+                    proj.schema.fields.append(
+                        ResultField(f"__sort#{len(hidden)}", under.ftype)
+                    )
+                    pe = Col(hid_idx, under.ftype)
+                    hidden.append(under)
+                elif proj is not None:
+                    under = self.resolve(e, proj.children[0].schema)
+                    proj.exprs.append(under)
+                    hid_idx = len(proj.schema.fields)
+                    proj.schema.fields.append(
+                        ResultField(f"__sort#{len(hidden)}", under.ftype)
+                    )
+                    pe = Col(hid_idx, under.ftype)
+                    hidden.append(under)
+                else:
+                    pe = self.resolve(e, out_schema)
+            items.append((pe, item.desc))
+        sort = LogicalSort(items, plan.schema, [plan])
+        if hidden:
+            # visible width shrinks back after sort via a trimming projection
+            vis = len(plan.schema.fields) - len(hidden)
+            exprs = [Col(i, plan.schema.fields[i].ftype) for i in range(vis)]
+            trim_schema = PlanSchema(plan.schema.fields[:vis])
+            return LogicalProjection(exprs, trim_schema, [sort])
+        return sort
+
+    # ==================== expression resolution ====================
+    def resolve(self, e: ast.Expr, schema: PlanSchema) -> PlanExpr:
+        def r(node: ast.Expr) -> PlanExpr:
+            if isinstance(node, ast.ColumnRef):
+                idx = schema.resolve(node.name, node.table)
+                if idx is None:
+                    raise PlanError(f"unknown column {node}")
+                return Col(idx, schema.fields[idx].ftype, str(node))
+            return self._resolve_composite(node, r)
+
+        return r(e)
+
+    def _resolve_composite(
+        self, node: ast.Expr, r: Callable[[ast.Expr], PlanExpr]
+    ) -> PlanExpr:
+        """Resolve every non-ColumnRef node, delegating children to r."""
+        if isinstance(node, ast.Literal):
+            return _literal_const(node)
+        if isinstance(node, ast.BinaryOp):
+            return self._resolve_binary(node, r)
+        if isinstance(node, ast.UnaryOp):
+            if node.op == "NOT":
+                arg = _coerce_bool(r(node.operand))
+                return bool_call("not", [arg])
+            arg = r(node.operand)
+            if not is_numeric(arg.ftype):
+                raise PlanError(f"unary - over {arg.ftype!r}")
+            return _fold(Call("neg", [arg], arg.ftype))
+        if isinstance(node, ast.IsNull):
+            arg = r(node.operand)
+            out = bool_call("isnull", [arg])
+            return bool_call("not", [out]) if node.negated else out
+        if isinstance(node, ast.Between):
+            lo = self._resolve_cmp("ge", r(node.operand), r(node.low))
+            hi = self._resolve_cmp("le", r(node.operand), r(node.high))
+            out = bool_call("and", [lo, hi])
+            return bool_call("not", [out]) if node.negated else out
+        if isinstance(node, ast.InList):
+            arg = r(node.operand)
+            items = [r(i) for i in node.items]
+            if not all(isinstance(i, Const) for i in items):
+                # general IN lowers to OR of equalities
+                out: PlanExpr = self._resolve_cmp("eq", arg, items[0])
+                for it in items[1:]:
+                    out = bool_call("or", [out, self._resolve_cmp("eq", arg, it)])
+            else:
+                consts = [self._coerce_const(c, arg.ftype) for c in items]
+                out = bool_call("in_values", [arg],
+                                extra=[c.value for c in consts])
+            return bool_call("not", [out]) if node.negated else out
+        if isinstance(node, ast.Like):
+            arg = r(node.operand)
+            if not arg.ftype.is_string:
+                raise PlanError("LIKE requires a string operand")
+            pat = r(node.pattern)
+            if not isinstance(pat, Const):
+                raise PlanError("LIKE pattern must be a constant")
+            out = bool_call("like", [arg], extra=str(pat.value))
+            return bool_call("not", [out]) if node.negated else out
+        if isinstance(node, ast.FuncCall):
+            if node.name in _AGG_NAMES:
+                raise PlanError(f"aggregate {node.name} not allowed here")
+            return self._resolve_scalar_func(node, r)
+        if isinstance(node, ast.Case):
+            return self._resolve_case(node, r)
+        if isinstance(node, ast.Cast):
+            arg = r(node.operand)
+            return _fold(Call("cast", [arg], node.target))
+        if isinstance(node, ast.IntervalExpr):
+            raise PlanError("INTERVAL only valid in +/- date arithmetic")
+        if isinstance(node, (ast.SubqueryExpr, ast.InSubquery)):
+            raise PlanError("subqueries are not supported yet")
+        raise PlanError(f"unsupported expression {type(node).__name__}")
+
+    def _resolve_binary(
+        self, node: ast.BinaryOp, r: Callable[[ast.Expr], PlanExpr]
+    ) -> PlanExpr:
+        op = node.op
+        if op in ("AND", "OR"):
+            left = _coerce_bool(r(node.left))
+            right = _coerce_bool(r(node.right))
+            return _fold(bool_call(op.lower(), [left, right]))
+        if op in ("XOR",):
+            left = _coerce_bool(r(node.left))
+            right = _coerce_bool(r(node.right))
+            return _fold(bool_call("ne", [left, right]))
+        if op in _CMP_OPS:
+            return self._resolve_cmp(_CMP_OPS[op], r(node.left), r(node.right))
+        if op in _ARITH_OPS:
+            # interval arithmetic on dates
+            if isinstance(node.right, ast.IntervalExpr) and op in ("+", "-"):
+                return self._resolve_date_arith(r(node.left), node.right, op, r)
+            if isinstance(node.left, ast.IntervalExpr) and op == "+":
+                return self._resolve_date_arith(r(node.right), node.left, op, r)
+            a, b = r(node.left), r(node.right)
+            tag = _ARITH_OPS[op]
+            try:
+                ftype = arith_result_type(tag, a.ftype, b.ftype)
+            except ExprError as e:
+                raise PlanError(str(e)) from None
+            return _fold(Call(tag, [a, b], ftype))
+        raise PlanError(f"unsupported operator {op}")
+
+    def _resolve_cmp(self, tag: str, a: PlanExpr, b: PlanExpr) -> PlanExpr:
+        # constant-side coercion: string consts vs temporal/decimal columns
+        if isinstance(b, Const) and not isinstance(a, Const):
+            b = self._coerce_const(b, a.ftype)
+        elif isinstance(a, Const) and not isinstance(b, Const):
+            a = self._coerce_const(a, b.ftype)
+            a, b = b, a
+            tag = _CMP_SWAP[tag]
+        if not comparable(a.ftype, b.ftype):
+            raise PlanError(f"incomparable types {a.ftype!r} vs {b.ftype!r}")
+        return _fold(bool_call(tag, [a, b]))
+
+    def _coerce_const(self, c: Const, target: FieldType) -> Const:
+        """Fold a literal into the physical domain of the other operand."""
+        if c.value is None:
+            return Const(None, target)
+        if target.kind == TypeKind.DATE and c.ftype.is_string:
+            return Const(parse_date(str(c.value)), target)
+        if target.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP) and \
+                c.ftype.is_string:
+            return Const(parse_datetime(str(c.value)), target)
+        if target.is_decimal and c.ftype.is_integer:
+            return Const(int(c.value) * target.decimal_multiplier, target)
+        if target.is_decimal and c.ftype.is_decimal:
+            return c  # scales aligned at kernel compile
+        if target.is_float and (c.ftype.is_integer or c.ftype.is_decimal):
+            v = c.value
+            if c.ftype.is_decimal:
+                v = Decimal(v, c.ftype.scale).to_float()
+            return Const(float(v), target)
+        if target.is_integer and c.ftype.is_decimal:
+            return c  # numeric compare handles mixed scale
+        return c
+
+    def _resolve_date_arith(
+        self,
+        date_expr: PlanExpr,
+        interval: ast.IntervalExpr,
+        op: str,
+        r: Callable[[ast.Expr], PlanExpr],
+    ) -> PlanExpr:
+        if date_expr.ftype.is_string and isinstance(date_expr, Const):
+            date_expr = Const(parse_date(str(date_expr.value)),
+                              FieldType(TypeKind.DATE))
+        if date_expr.ftype.kind != TypeKind.DATE:
+            raise PlanError("interval arithmetic supports DATE operands")
+        amount = r(interval.value)
+        if not isinstance(amount, Const):
+            raise PlanError("INTERVAL amount must be constant")
+        n = int(amount.value) if not amount.ftype.is_string else int(
+            str(amount.value))
+        if op == "-":
+            n = -n
+        unit = interval.unit
+        if unit in ("DAY", "WEEK"):
+            days = n * (7 if unit == "WEEK" else 1)
+            if isinstance(date_expr, Const):
+                return Const(int(date_expr.value) + days, date_expr.ftype)
+            return Call("date_add_days", [date_expr], date_expr.ftype,
+                        extra=days)
+        if unit in ("MONTH", "QUARTER", "YEAR"):
+            months = n * {"MONTH": 1, "QUARTER": 3, "YEAR": 12}[unit]
+            if isinstance(date_expr, Const):
+                d = decode_date(int(date_expr.value))
+                return Const(encode_date(_add_months(d, months)),
+                             date_expr.ftype)
+            raise PlanError("month/year interval over columns not supported yet")
+        raise PlanError(f"unsupported interval unit {unit}")
+
+    def _resolve_scalar_func(
+        self, node: ast.FuncCall, r: Callable[[ast.Expr], PlanExpr]
+    ) -> PlanExpr:
+        name = node.name
+        args = [r(a) for a in node.args]
+
+        def need(n: int) -> None:
+            if len(args) != n:
+                raise PlanError(f"{name} expects {n} argument(s)")
+
+        if name in ("YEAR", "MONTH", "DAY", "DAYOFMONTH"):
+            need(1)
+            if not args[0].ftype.is_temporal:
+                raise PlanError(f"{name} requires a temporal argument")
+            tag = {"YEAR": "year", "MONTH": "month", "DAY": "day",
+                   "DAYOFMONTH": "day"}[name]
+            return _fold(Call(tag, args, FieldType(TypeKind.BIGINT)))
+        if name == "ABS":
+            need(1)
+            return _fold(Call("abs", args, args[0].ftype))
+        if name == "IF":
+            need(3)
+            cond = _coerce_bool(args[0])
+            ft = _unify_types(args[1].ftype, args[2].ftype)
+            return _fold(Call("if", [cond, args[1], args[2]], ft))
+        if name == "IFNULL":
+            need(2)
+            ft = _unify_types(args[0].ftype, args[1].ftype)
+            return _fold(Call("ifnull", args, ft))
+        if name == "COALESCE":
+            if not args:
+                raise PlanError("COALESCE needs arguments")
+            ft = args[0].ftype
+            for a in args[1:]:
+                ft = _unify_types(ft, a.ftype)
+            return _fold(Call("coalesce", args, ft))
+        raise PlanError(f"unsupported function {name}")
+
+    def _resolve_case(
+        self, node: ast.Case, r: Callable[[ast.Expr], PlanExpr]
+    ) -> PlanExpr:
+        # CASE x WHEN v ... lowers to CASE WHEN x = v ...
+        branches: list[PlanExpr] = []
+        result_t: Optional[FieldType] = None
+        for when, then in node.branches:
+            if node.operand is not None:
+                cond = self._resolve_cmp("eq", r(node.operand), r(when))
+            else:
+                cond = _coerce_bool(r(when))
+            tv = r(then)
+            result_t = tv.ftype if result_t is None else _unify_types(
+                result_t, tv.ftype)
+            branches.extend([cond, tv])
+        if node.else_expr is not None:
+            ev = r(node.else_expr)
+            result_t = ev.ftype if result_t is None else _unify_types(
+                result_t, ev.ftype)
+            branches.append(ev)
+        assert result_t is not None
+        return _fold(Call("case", branches, result_t))
+
+    # ---- helpers -----------------------------------------------------------
+    def _split_conjuncts(self, e: PlanExpr) -> list[PlanExpr]:
+        if isinstance(e, Call) and e.op == "and":
+            return self._split_conjuncts(e.args[0]) + \
+                self._split_conjuncts(e.args[1])
+        return [e]
+
+
+# ==================== module helpers ====================
+
+def _output_name(e: ast.Expr, alias: Optional[str]) -> str:
+    if alias:
+        return alias.lower()
+    if isinstance(e, ast.ColumnRef):
+        return e.name.lower()
+    return _short_sql(e)
+
+
+def _short_sql(e: ast.Expr) -> str:
+    if isinstance(e, ast.FuncCall):
+        inner = "*" if e.is_star else ", ".join(_short_sql(a) for a in e.args)
+        return f"{e.name.lower()}({inner})"
+    if isinstance(e, ast.ColumnRef):
+        return e.name.lower()
+    if isinstance(e, ast.Literal):
+        return str(e.value)
+    if isinstance(e, ast.BinaryOp):
+        return f"{_short_sql(e.left)} {e.op.lower()} {_short_sql(e.right)}"
+    return type(e).__name__.lower()
+
+
+def _contains_agg(e: ast.Expr) -> bool:
+    return any(True for _ in _find_aggs(e))
+
+
+def _find_aggs(e: ast.Expr):
+    if isinstance(e, ast.FuncCall) and e.name in _AGG_NAMES:
+        yield e
+        return
+    for attr in ("left", "right", "operand", "low", "high", "pattern",
+                 "value", "else_expr"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, ast.Expr):
+            yield from _find_aggs(sub)
+    for attr in ("args", "items"):
+        subs = getattr(e, attr, None)
+        if isinstance(subs, list):
+            for s in subs:
+                if isinstance(s, ast.Expr):
+                    yield from _find_aggs(s)
+    if isinstance(e, ast.Case):
+        for w, t in e.branches:
+            yield from _find_aggs(w)
+            yield from _find_aggs(t)
+
+
+def _literal_const(node: ast.Literal) -> Const:
+    tag, v = node.tag, node.value
+    if tag == "null" or v is None:
+        return Const(None, FieldType(TypeKind.NULL))
+    if tag == "int":
+        return Const(int(v), FieldType(TypeKind.BIGINT, nullable=False))
+    if tag == "decimal":
+        d: Decimal = v if isinstance(v, Decimal) else Decimal.parse(str(v))
+        return Const(d.unscaled,
+                     FieldType(TypeKind.DECIMAL, flen=18, scale=d.scale,
+                               nullable=False))
+    if tag == "float":
+        return Const(float(v), FieldType(TypeKind.DOUBLE, nullable=False))
+    if tag == "string":
+        return Const(str(v), FieldType(TypeKind.VARCHAR, nullable=False))
+    if tag == "bool":
+        return Const(int(bool(v)), FieldType(TypeKind.BOOLEAN, nullable=False))
+    if tag == "date":
+        return Const(parse_date(str(v)), FieldType(TypeKind.DATE,
+                                                   nullable=False))
+    if tag == "datetime":
+        return Const(parse_datetime(str(v)),
+                     FieldType(TypeKind.DATETIME, nullable=False))
+    raise PlanError(f"unknown literal tag {tag}")
+
+
+def _coerce_bool(e: PlanExpr) -> PlanExpr:
+    if e.ftype.kind == TypeKind.BOOLEAN:
+        return e
+    if is_numeric(e.ftype):
+        zero = Const(0, FieldType(TypeKind.BIGINT, nullable=False))
+        return bool_call("ne", [e, zero])
+    raise PlanError(f"cannot use {e.ftype!r} as a condition")
+
+
+def _unify_types(a: FieldType, b: FieldType) -> FieldType:
+    if a.kind == TypeKind.NULL:
+        return b
+    if b.kind == TypeKind.NULL:
+        return a
+    if a.kind == b.kind:
+        if a.is_decimal:
+            return a if a.scale >= b.scale else b
+        return a
+    if is_numeric(a) and is_numeric(b):
+        from .expr import _NUMERIC_RANK
+        if _NUMERIC_RANK[a.kind] >= _NUMERIC_RANK[b.kind]:
+            hi, lo = a, b
+        else:
+            hi, lo = b, a
+        if hi.is_decimal and lo.is_decimal:
+            return hi if hi.scale >= lo.scale else lo
+        return hi
+    if a.is_string and b.is_string:
+        return a
+    raise PlanError(f"cannot unify types {a!r} and {b!r}")
+
+
+def _as_equi_pair(cond: PlanExpr, nleft: int) -> Optional[tuple[int, int]]:
+    if isinstance(cond, Call) and cond.op == "eq":
+        a, b = cond.args
+        if isinstance(a, Col) and isinstance(b, Col):
+            if a.idx < nleft <= b.idx:
+                return (a.idx, b.idx - nleft)
+            if b.idx < nleft <= a.idx:
+                return (b.idx, a.idx - nleft)
+    return None
+
+
+def _add_months(d: _dt.date, months: int) -> _dt.date:
+    m = d.month - 1 + months
+    y = d.year + m // 12
+    m = m % 12 + 1
+    # clamp day to month end (MySQL DATE_ADD semantics)
+    for day in (d.day, 30, 29, 28):
+        try:
+            return _dt.date(y, m, day)
+        except ValueError:
+            continue
+    raise ValueError("unreachable")
+
+
+# ---- constant folding -------------------------------------------------------
+
+_FOLD_NUMERIC = {"add", "sub", "mul", "neg"}
+
+
+def _fold(e: Call) -> PlanExpr:
+    """Fold constant subtrees. Conservative: only pure numeric/bool ops with
+    all-constant args; decimal ops fold via host Decimal for exactness."""
+    if not all(isinstance(a, Const) for a in e.args):
+        return e
+    args: list[Const] = e.args  # type: ignore[assignment]
+    if any(a.value is None for a in args):
+        if e.op == "isnull":
+            return Const(1, e.ftype)
+        if e.op in _FOLD_NUMERIC or e.op in ("div", "eq", "ne", "lt", "le",
+                                             "gt", "ge"):
+            return Const(None, e.ftype)
+        return e
+    try:
+        if e.op in ("add", "sub", "mul", "div") and all(
+            a.ftype.is_decimal or a.ftype.is_integer for a in args
+        ):
+            def as_dec(c: Const) -> Decimal:
+                if c.ftype.is_decimal:
+                    return Decimal(int(c.value), c.ftype.scale)
+                return Decimal.from_int(int(c.value))
+            a, b = as_dec(args[0]), as_dec(args[1])
+            out = {"add": a + b, "sub": a - b, "mul": a * b}.get(e.op)
+            if e.op == "div":
+                out = a.div(b)
+            assert out is not None
+            if e.ftype.is_decimal:
+                return Const(out.rescale(e.ftype.scale).unscaled, e.ftype)
+            return Const(out.rescale(0).unscaled, e.ftype)
+        if e.op in ("add", "sub", "mul", "div") and any(
+            a.ftype.is_float for a in args
+        ):
+            x, y = float(args[0].value), float(args[1].value)
+            val = {"add": x + y, "sub": x - y, "mul": x * y,
+                   "div": x / y if y != 0 else None}[e.op]
+            return Const(val, e.ftype)
+        if e.op == "neg":
+            return Const(-args[0].value, e.ftype)
+        if e.op == "isnull":
+            return Const(0, e.ftype)
+        if e.op in ("eq", "ne", "lt", "le", "gt", "ge") and all(
+            a.ftype.is_integer or a.ftype.is_decimal or a.ftype.is_float or
+            a.ftype.is_temporal for a in args
+        ):
+            def as_num(c: Const):
+                if c.ftype.is_decimal:
+                    return Decimal(int(c.value), c.ftype.scale)
+                return c.value
+            x, y = as_num(args[0]), as_num(args[1])
+            if isinstance(x, Decimal) and not isinstance(y, Decimal):
+                y = Decimal.from_int(int(y))
+            if isinstance(y, Decimal) and not isinstance(x, Decimal):
+                x = Decimal.from_int(int(x))
+            res = {"eq": x == y, "ne": x != y, "lt": x < y, "le": x <= y,
+                   "gt": x > y, "ge": x >= y}[e.op]
+            return Const(int(res), e.ftype)
+    except (ZeroDivisionError, OverflowError, ExprError):
+        return e
+    return e

@@ -1,0 +1,82 @@
+"""CopDAG: the pushdown plan IR shipped to the TiTPU coprocessor.
+
+Counterpart of the reference's `tipb.DAGRequest` executor list (reference:
+planner/core/plan_to_pb.go:39-326 builds TableScan -> Selection ->
+Aggregation/TopN/Limit chains; the storage side interprets or compiles them,
+store/mockstore/unistore/cophandler/closure_exec.go). Here the DAG is a
+typed Python structure the kernel compiler lowers to one fused JAX program;
+a protobuf wire form comes with the C++/multi-host tier.
+
+Expression trees inside the DAG reference the scan's output columns by
+index (Col.idx is an offset into `DAGScan.col_offsets`' output order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.field_type import FieldType
+from .expr import AggDesc, PlanExpr
+
+
+@dataclass
+class DAGScan:
+    table_id: int
+    # offsets into the stored table's columns, in output order
+    col_offsets: list[int]
+
+
+@dataclass
+class DAGSelection:
+    # conjunctive conditions over the scan output
+    conditions: list[PlanExpr]
+
+
+@dataclass
+class DAGAggregation:
+    group_by: list[PlanExpr]
+    aggs: list[AggDesc]
+
+
+@dataclass
+class DAGTopN:
+    # (expr, desc) sort items over scan output, then keep n
+    items: list[tuple[PlanExpr, bool]]
+    n: int
+
+
+@dataclass
+class DAGLimit:
+    n: int
+
+
+@dataclass
+class CopDAG:
+    """scan -> [selection] -> [agg | topn | limit] -> [projection exprs]."""
+
+    scan: DAGScan
+    selection: Optional[DAGSelection] = None
+    agg: Optional[DAGAggregation] = None
+    topn: Optional[DAGTopN] = None
+    limit: Optional[DAGLimit] = None
+    # post-ops projection evaluated device-side when no agg (scan output ->
+    # projected exprs); with agg, projection happens host-side over agg output
+    projections: Optional[list[PlanExpr]] = None
+    output_types: list[FieldType] = field(default_factory=list)
+
+    def describe(self) -> str:
+        parts = [f"scan(t{self.scan.table_id} cols={self.scan.col_offsets})"]
+        if self.selection:
+            parts.append(f"sel({len(self.selection.conditions)} conds)")
+        if self.agg:
+            parts.append(
+                f"agg(groups={len(self.agg.group_by)}, aggs={self.agg.aggs})"
+            )
+        if self.topn:
+            parts.append(f"topn({self.topn.n})")
+        if self.limit:
+            parts.append(f"limit({self.limit.n})")
+        if self.projections:
+            parts.append(f"proj({len(self.projections)})")
+        return " -> ".join(parts)

@@ -1,0 +1,558 @@
+"""Physical planning: column pruning, pushdown split, host operators.
+
+Counterpart of the reference's physical optimization + task model (reference:
+planner/core/find_best_task.go, task.go:56 copTask/rootTask; pushdown gate
+expression.CanExprsPushDown -> canFuncBePushed, expression/expression.go:921).
+Round-1 strategy is heuristic rather than cost-based: push the largest
+scan->selection->agg/projection prefix whose expressions the device kernel
+library supports; everything above runs in the host volcano engine.
+
+Pruning mirrors columnPruner (reference: planner/core/rule_column_pruning.go):
+scans read only referenced columns — essential when the device column cache
+holds wide TPC-H tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.field_type import FieldType, TypeKind
+from .dag import CopDAG, DAGAggregation, DAGScan, DAGSelection, DAGTopN, DAGLimit
+from .expr import AggDesc, Call, Col, Const, PlanExpr
+from .logical import (
+    LogicalAggregation,
+    LogicalJoin,
+    LogicalLimit,
+    LogicalPlan,
+    LogicalProjection,
+    LogicalScan,
+    LogicalSelection,
+    LogicalSort,
+)
+from .schema import PlanSchema, ResultField
+
+
+# ==================== physical nodes ====================
+
+class PhysicalPlan:
+    schema: PlanSchema
+    children: list["PhysicalPlan"]
+
+
+@dataclass
+class PhysTableRead(PhysicalPlan):
+    """Leaf: ships a CopDAG to the TiTPU coprocessor (distsql.Select analog).
+
+    With a pushed aggregation the output is partial-state columns:
+    [group cols..., (val, cnt) per agg...] — the host PhysHashAgg(final)
+    merges them (reference P2: partial agg in copr, final in TiDB)."""
+
+    dag: CopDAG
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysSelection(PhysicalPlan):
+    conditions: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysProjection(PhysicalPlan):
+    exprs: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysHashAgg(PhysicalPlan):
+    """mode 'final': merge device partials; mode 'complete': host-only agg."""
+
+    mode: str
+    group_by: list[PlanExpr]
+    aggs: list[AggDesc]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysHashJoin(PhysicalPlan):
+    kind: str
+    eq_conditions: list[tuple[int, int]]
+    other_conditions: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysSort(PhysicalPlan):
+    items: list[tuple[PlanExpr, bool]]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysLimit(PhysicalPlan):
+    limit: int
+    offset: int
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+# ==================== pushdown gate ====================
+
+# ops the JAX kernel compiler supports (copr/compiler.py) — keep in sync.
+_DEVICE_OPS = frozenset(
+    """
+    add sub mul div intdiv mod neg abs
+    eq ne lt le gt ge
+    and or not isnull in_values like if ifnull coalesce case
+    year month day date_add_days cast
+    """.split()
+)
+
+_STRING_OK_OPS = frozenset({"eq", "ne", "in_values", "like", "isnull",
+                            "ifnull", "coalesce", "if", "case"})
+
+
+def _type_on_device(ft: FieldType) -> bool:
+    return ft.kind != TypeKind.NULL
+
+
+def expr_pushable(e: PlanExpr) -> bool:
+    """The canFuncBePushed analog for the TiTPU store."""
+    if isinstance(e, (Col, Const)):
+        return _type_on_device(e.ftype)
+    if isinstance(e, Call):
+        if e.op not in _DEVICE_OPS:
+            return False
+        if e.op == "cast":
+            # only numeric<->numeric casts on device
+            if e.ftype.is_string or any(a.ftype.is_string for a in e.args):
+                return False
+        for a in e.args:
+            if a.ftype.is_string and e.op not in _STRING_OK_OPS:
+                return False
+            if not expr_pushable(a):
+                return False
+        return _type_on_device(e.ftype)
+    return False
+
+
+def agg_pushable(group_by: list[PlanExpr], aggs: list[AggDesc]) -> bool:
+    for g in group_by:
+        if not expr_pushable(g):
+            return False
+        if g.ftype.is_float:
+            # float group keys are ill-defined on device hashing; host handles
+            return False
+    for d in aggs:
+        if d.distinct:
+            return False
+        if d.func not in ("sum", "count", "avg", "min", "max"):
+            return False
+        if d.arg is not None:
+            if not expr_pushable(d.arg):
+                return False
+            if d.arg.ftype.is_string:
+                return False  # min/max over dict codes is order-wrong
+    return True
+
+
+# ==================== predicate pushdown ====================
+
+def push_predicates(plan: LogicalPlan) -> LogicalPlan:
+    """Push selection conditions below joins; discover equi-join conditions
+    from WHERE (turns comma/CROSS joins into INNER hash joins). Counterpart
+    of reference planner/core/rule_predicate_push_down.go. Outer joins only
+    accept pushes to their outer side (null-extension safety)."""
+    plan.children = [push_predicates(c) for c in plan.children]
+
+    if isinstance(plan, LogicalSelection):
+        child = plan.children[0]
+        if isinstance(child, LogicalSelection):
+            child.conditions = plan.conditions + child.conditions
+            return child
+        if isinstance(child, LogicalJoin):
+            join = child
+            nleft = len(join.children[0].schema)
+            left_c: list[PlanExpr] = []
+            right_c: list[PlanExpr] = []
+            remain: list[PlanExpr] = []
+            for cond in plan.conditions:
+                cols: set[int] = set()
+                _expr_cols(cond, cols)
+                pair = _as_equi_pair_phys(cond, nleft)
+                if pair is not None and join.kind in ("INNER", "CROSS"):
+                    join.eq_conditions.append(pair)
+                elif cols and max(cols) < nleft and join.kind in (
+                    "INNER", "CROSS", "LEFT"
+                ):
+                    left_c.append(cond)
+                elif cols and min(cols) >= nleft and join.kind in (
+                    "INNER", "CROSS", "RIGHT"
+                ):
+                    right_c.append(_remap_expr(
+                        cond, {i: i - nleft for i in cols}))
+                elif join.kind in ("INNER", "CROSS"):
+                    join.other_conditions.append(cond)
+                else:
+                    remain.append(cond)
+            if join.kind == "CROSS" and (join.eq_conditions or
+                                         join.other_conditions):
+                join.kind = "INNER"
+            if left_c:
+                join.children[0] = push_predicates(LogicalSelection(
+                    left_c, join.children[0].schema, [join.children[0]]))
+            if right_c:
+                join.children[1] = push_predicates(LogicalSelection(
+                    right_c, join.children[1].schema, [join.children[1]]))
+            if remain:
+                plan.conditions = remain
+                plan.children = [join]
+                return plan
+            return join
+    return plan
+
+
+def _as_equi_pair_phys(cond: PlanExpr, nleft: int):
+    if isinstance(cond, Call) and cond.op == "eq":
+        a, b = cond.args
+        if isinstance(a, Col) and isinstance(b, Col):
+            if a.idx < nleft <= b.idx:
+                return (a.idx, b.idx - nleft)
+            if b.idx < nleft <= a.idx:
+                return (b.idx, a.idx - nleft)
+    return None
+
+
+# ==================== column pruning ====================
+
+def _expr_cols(e: PlanExpr, out: set[int]) -> None:
+    if isinstance(e, Col):
+        out.add(e.idx)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _expr_cols(a, out)
+
+
+def _remap_expr(e: PlanExpr, mapping: dict[int, int]) -> PlanExpr:
+    if isinstance(e, Col):
+        return Col(mapping[e.idx], e.ftype, e.name)
+    if isinstance(e, Call):
+        return Call(e.op, [_remap_expr(a, mapping) for a in e.args], e.ftype,
+                    e.extra)
+    return e
+
+
+def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan:
+    """Drop unused columns below each node; rewrites Col indices in place of
+    the old schema positions. `required` is the set of this node's output
+    indices the parent needs (None = all)."""
+    if required is None:
+        required = set(range(len(plan.schema)))
+
+    if isinstance(plan, LogicalScan):
+        keep = sorted(required) or [0] if plan.table.columns else []
+        if plan.table.columns and not keep:
+            keep = [0]
+        fields = [plan.schema.fields[i] for i in keep]
+        plan.used_offsets = [plan.schema.fields[i].source_offset for i in keep]
+        plan.schema = PlanSchema(fields)
+        plan._prune_map = {old: new for new, old in enumerate(keep)}  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalSelection):
+        need = set(required)
+        for c in plan.conditions:
+            _expr_cols(c, need)
+        child = prune(plan.children[0], need)
+        m = child._prune_map  # type: ignore[attr-defined]
+        plan.conditions = [_remap_expr(c, m) for c in plan.conditions]
+        plan.schema = child.schema
+        plan._prune_map = m  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalProjection):
+        keep = sorted(required)
+        exprs = [plan.exprs[i] for i in keep]
+        need: set[int] = set()
+        for e in exprs:
+            _expr_cols(e, need)
+        child = prune(plan.children[0], need)
+        m = child._prune_map  # type: ignore[attr-defined]
+        plan.exprs = [_remap_expr(e, m) for e in exprs]
+        plan.schema = PlanSchema([plan.schema.fields[i] for i in keep])
+        plan._prune_map = {old: new for new, old in enumerate(keep)}  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalAggregation):
+        ngroups = len(plan.group_by)
+        keep_aggs = sorted(
+            {i - ngroups for i in required if i >= ngroups}
+        )
+        plan.aggs = [plan.aggs[i] for i in keep_aggs]
+        need: set[int] = set()
+        for g in plan.group_by:
+            _expr_cols(g, need)
+        for d in plan.aggs:
+            if d.arg is not None:
+                _expr_cols(d.arg, need)
+        child = prune(plan.children[0], need)
+        m = child._prune_map  # type: ignore[attr-defined]
+        plan.group_by = [_remap_expr(g, m) for g in plan.group_by]
+        plan.aggs = [
+            AggDesc(d.func, None if d.arg is None else _remap_expr(d.arg, m),
+                    d.ftype, d.distinct, d.name)
+            for d in plan.aggs
+        ]
+        fields = plan.schema.fields[:ngroups] + [
+            plan.schema.fields[ngroups + i] for i in keep_aggs
+        ]
+        plan.schema = PlanSchema(fields)
+        out_map = {g: g for g in range(ngroups)}
+        for new, old in enumerate(keep_aggs):
+            out_map[ngroups + old] = ngroups + new
+        plan._prune_map = out_map  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalSort):
+        need = set(required)
+        for e, _ in plan.items:
+            _expr_cols(e, need)
+        child = prune(plan.children[0], need)
+        m = child._prune_map  # type: ignore[attr-defined]
+        plan.items = [(_remap_expr(e, m), d) for e, d in plan.items]
+        plan.schema = child.schema
+        plan._prune_map = m  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalLimit):
+        child = prune(plan.children[0], set(required))
+        plan.schema = child.schema
+        plan._prune_map = child._prune_map  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalJoin):
+        nleft = len(plan.children[0].schema)
+        need_l: set[int] = set()
+        need_r: set[int] = set()
+        for i in required:
+            (need_l if i < nleft else need_r).add(i if i < nleft else i - nleft)
+        for li, ri in plan.eq_conditions:
+            need_l.add(li)
+            need_r.add(ri)
+        both: set[int] = set()
+        for c in plan.other_conditions:
+            _expr_cols(c, both)
+        for i in both:
+            (need_l if i < nleft else need_r).add(i if i < nleft else i - nleft)
+        left = prune(plan.children[0], need_l)
+        right = prune(plan.children[1], need_r)
+        ml = left._prune_map  # type: ignore[attr-defined]
+        mr = right._prune_map  # type: ignore[attr-defined]
+        new_nleft = len(left.schema)
+        m = {}
+        for old, new in ml.items():
+            m[old] = new
+        for old, new in mr.items():
+            m[nleft + old] = new_nleft + new
+        plan.eq_conditions = [(ml[a], mr[b]) for a, b in plan.eq_conditions]
+        plan.other_conditions = [
+            _remap_expr(c, m) for c in plan.other_conditions
+        ]
+        plan.schema = PlanSchema(left.schema.fields + right.schema.fields)
+        plan._prune_map = m  # type: ignore[attr-defined]
+        return plan
+
+    raise TypeError(f"prune: unknown node {type(plan).__name__}")
+
+
+# ==================== physical build ====================
+
+def optimize(plan: LogicalPlan) -> PhysicalPlan:
+    plan = push_predicates(plan)
+    plan = prune(plan)
+    return _to_physical(plan)
+
+
+def _fresh_table_read(scan: LogicalScan) -> PhysTableRead:
+    offsets = scan.used_offsets
+    if offsets is None:
+        offsets = [f.source_offset for f in scan.schema.fields]
+    dag = CopDAG(
+        scan=DAGScan(scan.table.id, offsets),
+        output_types=[f.ftype for f in scan.schema.fields],
+    )
+    return PhysTableRead(dag, scan.schema)
+
+
+def _bare_scan(tr: PhysTableRead) -> bool:
+    dag = tr.dag
+    if dag.scan.table_id < 0:
+        return False  # dual pseudo-table: everything stays host-side
+    return dag.agg is None and dag.topn is None and dag.limit is None and \
+        dag.projections is None
+
+
+def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
+    if isinstance(plan, LogicalScan):
+        return _fresh_table_read(plan)
+
+    if isinstance(plan, LogicalSelection):
+        child = _to_physical(plan.children[0])
+        if (
+            isinstance(child, PhysTableRead)
+            and _bare_scan(child)
+            and all(expr_pushable(c) for c in plan.conditions)
+        ):
+            dag = child.dag
+            if dag.selection is None:
+                dag.selection = DAGSelection(list(plan.conditions))
+            else:
+                dag.selection.conditions.extend(plan.conditions)
+            return child
+        return PhysSelection(plan.conditions, plan.schema, [child])
+
+    if isinstance(plan, LogicalAggregation):
+        child = _to_physical(plan.children[0])
+        if (
+            isinstance(child, PhysTableRead)
+            and _bare_scan(child)
+            and agg_pushable(plan.group_by, plan.aggs)
+        ):
+            dag = child.dag
+            dag.agg = DAGAggregation(list(plan.group_by), list(plan.aggs))
+            # partial layout: group cols, then (val, cnt) per agg
+            fields = []
+            for i, g in enumerate(plan.group_by):
+                fields.append(ResultField(f"gk#{i}", g.ftype))
+            for i, d in enumerate(plan.aggs):
+                val_t = _partial_val_type(d)
+                fields.append(ResultField(f"pv#{i}", val_t))
+                fields.append(
+                    ResultField(f"pc#{i}",
+                                FieldType(TypeKind.BIGINT, nullable=False))
+                )
+            child.schema = PlanSchema(fields)
+            dag.output_types = [f.ftype for f in fields]
+            return PhysHashAgg("final", plan.group_by, plan.aggs, plan.schema,
+                               [child])
+        return PhysHashAgg("complete", plan.group_by, plan.aggs, plan.schema,
+                           [child])
+
+    if isinstance(plan, LogicalProjection):
+        child = _to_physical(plan.children[0])
+        if (
+            isinstance(child, PhysTableRead)
+            and _bare_scan(child)
+            and all(expr_pushable(e) for e in plan.exprs)
+            and not any(e.ftype.is_string and not isinstance(e, Col)
+                        for e in plan.exprs)
+        ):
+            child.dag.projections = list(plan.exprs)
+            child.dag.output_types = [e.ftype for e in plan.exprs]
+            child.schema = plan.schema
+            return child
+        return PhysProjection(plan.exprs, plan.schema, [child])
+
+    if isinstance(plan, LogicalSort):
+        child = _to_physical(plan.children[0])
+        return PhysSort(plan.items, plan.schema, [child])
+
+    if isinstance(plan, LogicalLimit):
+        # TopN pushdown (reference: rule_topn_push_down.go). Patterns:
+        #   Limit <- Sort <- pushable chain
+        #   Limit <- Projection(trim) <- Sort <- pushable chain
+        # dag.topn runs after dag.projections, so sort items referencing the
+        # projected output are valid as-is.
+        if plan.offset == 0:
+            sort_node = None
+            trim: Optional[LogicalProjection] = None
+            c0 = plan.children[0]
+            if isinstance(c0, LogicalSort):
+                sort_node = c0
+            elif isinstance(c0, LogicalProjection) and \
+                    isinstance(c0.children[0], LogicalSort) and \
+                    all(isinstance(e, Col) for e in c0.exprs):
+                trim = c0
+                sort_node = c0.children[0]
+            if sort_node is not None and all(
+                expr_pushable(e) and not e.ftype.is_string
+                for e, _ in sort_node.items
+            ):
+                inner = _to_physical(sort_node.children[0])
+                if isinstance(inner, PhysTableRead) and \
+                        inner.dag.scan.table_id >= 0 and \
+                        inner.dag.agg is None and \
+                        inner.dag.topn is None and inner.dag.limit is None:
+                    inner.dag.topn = DAGTopN(sort_node.items, plan.limit)
+                    # per-batch top-k results (base epoch + MVCC overlay)
+                    # still need a host merge sort + exact limit
+                    merged: PhysicalPlan = PhysSort(
+                        sort_node.items, inner.schema, [inner])
+                    merged = PhysLimit(plan.limit, 0, inner.schema, [merged])
+                    if trim is not None:
+                        return PhysProjection(trim.exprs, trim.schema,
+                                              [merged])
+                    return merged
+        child = _to_physical(plan.children[0])
+        # Limit over a pushable chain lowers to dag.limit (per-region limit is
+        # a superset; host PhysLimit still enforces the exact count)
+        if isinstance(child, PhysTableRead) and child.dag.agg is None and \
+                child.dag.topn is None and child.dag.limit is None:
+            child.dag.limit = DAGLimit(plan.limit + plan.offset)
+        return PhysLimit(plan.limit, plan.offset, plan.schema, [child])
+
+    if isinstance(plan, LogicalJoin):
+        left = _to_physical(plan.children[0])
+        right = _to_physical(plan.children[1])
+        return PhysHashJoin(plan.kind, plan.eq_conditions,
+                            plan.other_conditions, plan.schema, [left, right])
+
+    raise TypeError(f"optimize: unknown node {type(plan).__name__}")
+
+
+def _partial_val_type(d: AggDesc) -> FieldType:
+    if d.func == "count":
+        return FieldType(TypeKind.BIGINT, nullable=False)
+    if d.func == "avg":
+        assert d.arg is not None
+        at = d.arg.ftype
+        if at.is_decimal:
+            return FieldType(TypeKind.DECIMAL, flen=18, scale=at.scale)
+        if at.is_float:
+            return FieldType(TypeKind.DOUBLE)
+        return FieldType(TypeKind.BIGINT)
+    return d.ftype
+
+
+# ==================== explain ====================
+
+def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
+    pad = "  " * depth
+    name = type(plan).__name__
+    if isinstance(plan, PhysTableRead):
+        line = f"{pad}TableRead[TiTPU]: {plan.dag.describe()}"
+    elif isinstance(plan, PhysHashAgg):
+        line = (f"{pad}HashAgg({plan.mode}): groups={len(plan.group_by)} "
+                f"aggs={plan.aggs}")
+    elif isinstance(plan, PhysSelection):
+        line = f"{pad}Selection: {plan.conditions}"
+    elif isinstance(plan, PhysProjection):
+        line = f"{pad}Projection: {plan.exprs}"
+    elif isinstance(plan, PhysSort):
+        line = f"{pad}Sort: {[(repr(e), d) for e, d in plan.items]}"
+    elif isinstance(plan, PhysLimit):
+        line = f"{pad}Limit: {plan.limit} offset {plan.offset}"
+    elif isinstance(plan, PhysHashJoin):
+        line = f"{pad}HashJoin({plan.kind}): eq={plan.eq_conditions}"
+    else:
+        line = f"{pad}{name}"
+    out = [line]
+    for c in plan.children:
+        out.extend(explain_plan(c, depth + 1))
+    return out

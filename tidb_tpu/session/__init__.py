@@ -1,0 +1,3 @@
+from .session import Session, ResultSet, SQLError
+
+__all__ = ["Session", "ResultSet", "SQLError"]

@@ -1,0 +1,498 @@
+"""Session: statement lifecycle over the storage + planner + executors.
+
+Counterpart of the reference's session package (reference:
+session/session.go — ExecuteStmt :1328, runStmt :1438, CommitTxn :573) plus
+the DDL executor for the synchronous single-node DDL path (reference's async
+owner-based DDL, ddl/ddl.go:522, arrives with the multi-node tier).
+
+Txn model: autocommit by default; BEGIN opens an explicit optimistic txn;
+statement-level staging gives per-statement rollback inside a txn
+(reference: session/txn.go:52-87 staging).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..catalog.schema import Catalog, ColumnInfo, IndexInfo, TableInfo
+from ..chunk.chunk import Chunk
+from ..copr.client import CopClient
+from ..copr.npeval import NumpyEval, _truthy
+from ..executor.engine import ExecContext, run_physical
+from ..plan.builder import PlanBuilder, PlanError, _literal_const
+from ..plan.physical import explain_plan, optimize
+from ..sql import ast
+from ..sql.parser import ParseError, parse_sql
+from ..store.storage import Storage, Transaction, WriteConflictError
+from ..store.table_store import TableStore
+from ..types.field_type import FieldType, TypeKind
+from ..types.value import Decimal
+
+
+class SQLError(Exception):
+    pass
+
+
+@dataclass
+class ResultSet:
+    column_names: list[str]
+    rows: list[tuple[Any, ...]]
+    affected: int = 0
+
+    def __repr__(self) -> str:
+        return f"ResultSet({self.column_names}, {len(self.rows)} rows)"
+
+
+class Session:
+    def __init__(self, storage: Optional[Storage] = None, db: str = "test",
+                 cop: Optional[CopClient] = None) -> None:
+        self.storage = storage if storage is not None else Storage()
+        self.catalog: Catalog = self.storage.catalog
+        self.current_db = db
+        self.cop = cop if cop is not None else CopClient()
+        self.txn: Optional[Transaction] = None
+        self.in_explicit_txn = False
+        self.vars: dict[str, Any] = {}
+
+    # ==================== public API ====================
+    def execute(self, sql: str) -> ResultSet:
+        """Execute one or more ;-separated statements; returns the last
+        statement's result."""
+        try:
+            stmts = parse_sql(sql)
+        except ParseError as e:
+            raise SQLError(f"parse error: {e}") from None
+        result = ResultSet([], [])
+        for stmt in stmts:
+            result = self._execute_stmt(stmt)
+        return result
+
+    def query(self, sql: str) -> list[tuple[Any, ...]]:
+        return self.execute(sql).rows
+
+    # ==================== statement dispatch ====================
+    def _execute_stmt(self, stmt: ast.Stmt) -> ResultSet:
+        if isinstance(stmt, ast.SelectStmt):
+            return self._run_in_txn(lambda: self._exec_select(stmt))
+        if isinstance(stmt, ast.InsertStmt):
+            return self._run_in_txn(lambda: self._exec_insert(stmt))
+        if isinstance(stmt, ast.UpdateStmt):
+            return self._run_in_txn(lambda: self._exec_update(stmt))
+        if isinstance(stmt, ast.DeleteStmt):
+            return self._run_in_txn(lambda: self._exec_delete(stmt))
+        if isinstance(stmt, ast.CreateTableStmt):
+            return self._exec_create_table(stmt)
+        if isinstance(stmt, ast.DropTableStmt):
+            return self._exec_drop_table(stmt)
+        if isinstance(stmt, ast.CreateDatabaseStmt):
+            self.catalog.create_schema(stmt.name, stmt.if_not_exists)
+            return ResultSet([], [], affected=0)
+        if isinstance(stmt, ast.DropDatabaseStmt):
+            for info in self.catalog.drop_schema(stmt.name, stmt.if_exists):
+                self.storage.unregister_table(info.id)
+            return ResultSet([], [])
+        if isinstance(stmt, ast.TruncateTableStmt):
+            return self._exec_truncate(stmt)
+        if isinstance(stmt, ast.UseStmt):
+            self.catalog.schema(stmt.db)  # raises if unknown
+            self.current_db = stmt.db
+            return ResultSet([], [])
+        if isinstance(stmt, ast.BeginStmt):
+            self._commit_implicit()
+            self.txn = self.storage.begin()
+            self.in_explicit_txn = True
+            return ResultSet([], [])
+        if isinstance(stmt, ast.CommitStmt):
+            self._finish_txn(commit=True)
+            return ResultSet([], [])
+        if isinstance(stmt, ast.RollbackStmt):
+            self._finish_txn(commit=False)
+            return ResultSet([], [])
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, ast.ShowStmt):
+            return self._exec_show(stmt)
+        if isinstance(stmt, ast.SetStmt):
+            for scope, name, expr in stmt.items:
+                c = _literal_const(expr) if isinstance(expr, ast.Literal) \
+                    else None
+                self.vars[name.lower()] = c.value if c is not None else None
+            return ResultSet([], [])
+        if isinstance(stmt, ast.AnalyzeTableStmt):
+            return ResultSet([], [])  # stats pipeline arrives with the CBO
+        raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    # ==================== txn plumbing ====================
+    def _ensure_txn(self) -> Transaction:
+        if self.txn is None:
+            self.txn = self.storage.begin()
+        return self.txn
+
+    def _run_in_txn(self, fn):
+        txn = self._ensure_txn()
+        stage = txn.memdb.staging()
+        try:
+            result = fn()
+        except Exception:
+            txn.memdb.cleanup(stage)
+            if not self.in_explicit_txn:
+                self._finish_txn(commit=False)
+            raise
+        txn.memdb.release(stage)
+        if not self.in_explicit_txn:
+            self._finish_txn(commit=True)
+        return result
+
+    def _commit_implicit(self) -> None:
+        if self.txn is not None and not self.in_explicit_txn:
+            self._finish_txn(commit=True)
+
+    def _finish_txn(self, commit: bool) -> None:
+        if self.txn is None:
+            self.in_explicit_txn = False
+            return
+        txn, self.txn = self.txn, None
+        self.in_explicit_txn = False
+        if commit:
+            try:
+                txn.commit()
+            except WriteConflictError as e:
+                raise SQLError(str(e)) from None
+        else:
+            txn.rollback()
+
+    # ==================== SELECT ====================
+    def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
+        plan = self._plan(stmt)
+        ctx = ExecContext(self._ensure_txn(), self.cop)
+        chunk = run_physical(plan, ctx)
+        names = [f.name for f in plan.schema.fields]
+        if not chunk.columns and not names:
+            # SELECT with no FROM and zero cols can't happen; guard anyway
+            return ResultSet(names, [])
+        if not chunk.columns:
+            return ResultSet(names, [])
+        return ResultSet(names, chunk.to_pylist())
+
+    def _plan(self, stmt: ast.SelectStmt):
+        try:
+            logical = PlanBuilder(self.catalog, self.current_db).build_select(
+                stmt)
+            return optimize(logical)
+        except PlanError as e:
+            raise SQLError(str(e)) from None
+
+    # ==================== DML ====================
+    def _exec_insert(self, stmt: ast.InsertStmt) -> ResultSet:
+        info, store = self._table_for(stmt.table)
+        col_order = self._insert_columns(info, stmt.columns)
+        txn = self._ensure_txn()
+
+        rows: list[list[Any]] = []
+        if stmt.select is not None:
+            sub = self._exec_select(stmt.select)
+            rows = [list(r) for r in sub.rows]
+        else:
+            for value_row in stmt.rows:
+                if len(value_row) != len(col_order):
+                    raise SQLError("column count doesn't match value count")
+                rows.append([self._eval_value(e) for e in value_row])
+
+        count = 0
+        for rv in rows:
+            if len(rv) != len(col_order):
+                raise SQLError("column count doesn't match value count")
+            full = self._complete_row(info, col_order, rv, store)
+            handle = self._row_handle(info, full, store)
+            txn.set_row(info.id, handle, store.encode_row(full))
+            count += 1
+        return ResultSet([], [], affected=count)
+
+    def _exec_update(self, stmt: ast.UpdateStmt) -> ResultSet:
+        info, store = self._table_for(stmt.table)
+        txn = self._ensure_txn()
+        snap = txn.snapshot(info.id)
+        mask, ev = self._where_mask(info, stmt.table, stmt.where, snap)
+        handles = snap.handles()[mask]
+        if len(handles) == 0:
+            return ResultSet([], [], affected=0)
+        # resolve assignments against the scan schema
+        builder = PlanBuilder(self.catalog, self.current_db)
+        scan = builder._build_scan(stmt.table)
+        assigns: dict[int, Any] = {}
+        for a in stmt.assignments:
+            ci = scan.schema.resolve(a.column.name, a.column.table)
+            if ci is None:
+                raise SQLError(f"unknown column {a.column}")
+            assigns[ci] = builder.resolve(a.value, scan.schema)
+        # evaluate each assignment once over the whole snapshot, in the
+        # column's own physical domain
+        new_vals: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for ci, e in assigns.items():
+            col_ft = info.columns[ci].ftype
+            if col_ft.is_string:
+                sv, svl = ev.eval_str(e)
+                d = store.dictionaries[ci]
+                assert d is not None
+                data = np.fromiter(
+                    (d.encode(s) if ok else 0 for s, ok in zip(sv, svl)),
+                    dtype=np.int64, count=len(sv))
+                new_vals[ci] = (data, np.asarray(svl))
+            else:
+                vv = ev.eval(e)
+                v, vl = ev._cast(vv, e.ftype, col_ft) if (
+                    e.ftype.kind != col_ft.kind or
+                    (col_ft.is_decimal and e.ftype.scale != col_ft.scale)
+                ) else vv
+                new_vals[ci] = (np.asarray(v), np.asarray(vl))
+        # hoist full-column materialization out of the per-row loop
+        cols = [snap.column(c) for c in range(info.num_columns)]
+        col_data = [c.data for c in cols]
+        col_valid = [c.validity for c in cols]
+        rows_idx = np.nonzero(mask)[0]
+        count = 0
+        for ri, handle in zip(rows_idx, handles):
+            ri = int(ri)
+            phys = [
+                None if not col_valid[c][ri] else _np_scalar(col_data[c][ri])
+                for c in range(info.num_columns)
+            ]
+            for ci in assigns:
+                v, vl = new_vals[ci]
+                phys[ci] = None if not vl[ri] else _np_scalar(v[ri])
+            txn.set_row(info.id, int(handle), tuple(phys))
+            count += 1
+        return ResultSet([], [], affected=count)
+
+    def _exec_delete(self, stmt: ast.DeleteStmt) -> ResultSet:
+        info, store = self._table_for(stmt.table)
+        txn = self._ensure_txn()
+        snap = txn.snapshot(info.id)
+        mask, _ = self._where_mask(info, stmt.table, stmt.where, snap)
+        handles = snap.handles()[mask]
+        for h in handles:
+            txn.delete_row(info.id, int(h))
+        return ResultSet([], [], affected=len(handles))
+
+    def _where_mask(self, info: TableInfo, table: ast.TableName,
+                    where: Optional[ast.Expr], snap):
+        n = snap.num_visible_rows
+        cols = []
+        dicts = []
+        for off in range(info.num_columns):
+            col = snap.column(off)
+            cols.append((col.data, col.validity))
+            dicts.append(col.dictionary)
+        ev = NumpyEval(cols, dicts, n)
+        if where is None:
+            return np.ones(n, dtype=bool), ev
+        builder = PlanBuilder(self.catalog, self.current_db)
+        scan = builder._build_scan(table)
+        cond = builder.resolve(where, scan.schema)
+        v, vl = ev.eval(cond)
+        return _truthy(np.asarray(v)) & vl, ev
+
+    def _eval_value(self, e: ast.Expr) -> Any:
+        """Evaluate an INSERT VALUES expression (constants + simple arith)."""
+        builder = PlanBuilder(self.catalog, self.current_db)
+        from ..plan.schema import PlanSchema
+        pe = builder.resolve(e, PlanSchema([]))
+        from ..plan.expr import Const
+        if not isinstance(pe, Const):
+            raise SQLError("non-constant INSERT value")
+        if pe.value is None:
+            return None
+        if pe.ftype.is_decimal:
+            return Decimal(pe.value, pe.ftype.scale)
+        if pe.ftype.kind == TypeKind.DATE:
+            from ..types.value import decode_date
+            return decode_date(pe.value)
+        if pe.ftype.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+            from ..types.value import decode_datetime
+            return decode_datetime(pe.value)
+        return pe.value
+
+    def _insert_columns(self, info: TableInfo,
+                        names: Optional[list[str]]) -> list[int]:
+        if names is None:
+            return list(range(info.num_columns))
+        out = []
+        for n in names:
+            c = info.column_by_name(n)
+            if c is None:
+                raise SQLError(f"unknown column {n}")
+            out.append(c.offset)
+        return out
+
+    def _complete_row(self, info: TableInfo, col_order: list[int],
+                      values: list[Any], store: TableStore) -> list[Any]:
+        full: list[Any] = [None] * info.num_columns
+        provided = set()
+        for off, v in zip(col_order, values):
+            full[off] = v
+            provided.add(off)
+        for c in info.columns:
+            if c.offset in provided:
+                continue
+            if c.default is not None:
+                full[c.offset] = c.default
+            elif c.auto_increment:
+                full[c.offset] = store.alloc_handle()
+            elif not c.nullable:
+                raise SQLError(f"column {c.name} cannot be null")
+        for c in info.columns:
+            if full[c.offset] is None and not c.nullable and \
+                    not c.auto_increment:
+                raise SQLError(f"column {c.name} cannot be null")
+        return full
+
+    def _row_handle(self, info: TableInfo, row: list[Any],
+                    store: TableStore) -> int:
+        if info.pk_handle_offset is not None:
+            v = row[info.pk_handle_offset]
+            if v is None:
+                v = store.alloc_handle()
+                row[info.pk_handle_offset] = v
+            handle = int(v)
+            store.note_handle(handle)
+            return handle
+        return store.alloc_handle()
+
+    # ==================== DDL ====================
+    def _exec_create_table(self, stmt: ast.CreateTableStmt) -> ResultSet:
+        db = stmt.table.db or self.current_db
+        columns: list[ColumnInfo] = []
+        pk_offsets: list[int] = []
+        for off, cd in enumerate(stmt.columns):
+            ft = cd.ftype
+            if cd.not_null or cd.primary_key:
+                ft = FieldType(ft.kind, ft.flen, ft.scale, nullable=False)
+            default = None
+            if cd.default is not None:
+                c = _literal_const(cd.default)
+                default = self._decode_default(c, ft)
+            col = ColumnInfo(
+                id=self.catalog.alloc_id(),
+                name=cd.name,
+                ftype=ft,
+                offset=off,
+                default=default,
+                is_primary=cd.primary_key,
+                auto_increment=cd.auto_increment,
+            )
+            columns.append(col)
+            if cd.primary_key:
+                pk_offsets.append(off)
+        indices: list[IndexInfo] = []
+        for idef in stmt.indices:
+            offs = []
+            for name in idef.columns:
+                hit = next((c for c in columns
+                            if c.name.lower() == name.lower()), None)
+                if hit is None:
+                    raise SQLError(f"index column {name} not found")
+                offs.append(hit.offset)
+            if idef.primary:
+                pk_offsets.extend(offs)
+                for o in offs:
+                    columns[o].is_primary = True
+                    ftp = columns[o].ftype
+                    columns[o].ftype = FieldType(ftp.kind, ftp.flen, ftp.scale,
+                                                 nullable=False)
+            indices.append(IndexInfo(self.catalog.alloc_id(),
+                                     idef.name or f"idx_{len(indices)}",
+                                     offs, idef.unique, idef.primary))
+        pk_handle = None
+        if len(pk_offsets) == 1 and columns[pk_offsets[0]].ftype.is_integer:
+            pk_handle = pk_offsets[0]
+        info = TableInfo(
+            id=self.catalog.alloc_id(),
+            name=stmt.table.name,
+            columns=columns,
+            indices=indices,
+            pk_handle_offset=pk_handle,
+        )
+        try:
+            created = self.catalog.add_table(db, info, stmt.if_not_exists)
+        except KeyError as e:
+            raise SQLError(str(e)) from None
+        if created:
+            self.storage.register_table(info)
+        return ResultSet([], [])
+
+    def _decode_default(self, c, ft: FieldType) -> Any:
+        if c.value is None:
+            return None
+        if ft.is_decimal and c.ftype.is_decimal:
+            return Decimal(c.value, c.ftype.scale)
+        if ft.is_string or ft.is_temporal:
+            return c.value
+        return c.value
+
+    def _exec_drop_table(self, stmt: ast.DropTableStmt) -> ResultSet:
+        for tn in stmt.tables:
+            db = tn.db or self.current_db
+            try:
+                info = self.catalog.drop_table(db, tn.name, stmt.if_exists)
+            except KeyError as e:
+                raise SQLError(str(e)) from None
+            if info is not None:
+                self.storage.unregister_table(info.id)
+        return ResultSet([], [])
+
+    def _exec_truncate(self, stmt: ast.TruncateTableStmt) -> ResultSet:
+        info, _ = self._table_for(stmt.table)
+        self.storage.unregister_table(info.id)
+        self.storage.register_table(info)
+        return ResultSet([], [])
+
+    # ==================== EXPLAIN / SHOW ====================
+    def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        if not isinstance(stmt.target, ast.SelectStmt):
+            raise SQLError("EXPLAIN supports SELECT only for now")
+        plan = self._plan(stmt.target)
+        lines = explain_plan(plan)
+        return ResultSet(["plan"], [(line,) for line in lines])
+
+    def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
+        if stmt.kind == "TABLES":
+            schema = self.catalog.schema(self.current_db)
+            names = sorted(t.name for t in schema.tables.values())
+            return ResultSet([f"Tables_in_{self.current_db}"],
+                             [(n,) for n in names])
+        if stmt.kind == "DATABASES":
+            return ResultSet(
+                ["Database"],
+                [(s.name,) for s in sorted(self.catalog.schemas.values(),
+                                           key=lambda s: s.name)])
+        if stmt.kind == "CREATE_TABLE":
+            assert stmt.target is not None
+            info, _ = self._table_for(stmt.target)
+            cols = ",\n  ".join(
+                f"`{c.name}` {c.ftype!r}{'' if c.ftype.nullable else ' NOT NULL'}"
+                for c in info.columns
+            )
+            ddl = f"CREATE TABLE `{info.name}` (\n  {cols}\n)"
+            return ResultSet(["Table", "Create Table"], [(info.name, ddl)])
+        if stmt.kind == "VARIABLES":
+            return ResultSet(["Variable_name", "Value"],
+                             sorted(self.vars.items()))
+        raise SQLError(f"unsupported SHOW {stmt.kind}")
+
+    # ==================== helpers ====================
+    def _table_for(self, tn: ast.TableName) -> tuple[TableInfo, TableStore]:
+        db = tn.db or self.current_db
+        try:
+            info = self.catalog.table(db, tn.name)
+        except KeyError as e:
+            raise SQLError(str(e)) from None
+        return info, self.storage.table_store(info.id)
+
+
+def _np_scalar(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

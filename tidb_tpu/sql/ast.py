@@ -1,0 +1,299 @@
+"""AST node definitions for the SQL subset.
+
+Counterpart of the reference's `ast.StmtNode`/`ast.ExprNode` hierarchy in
+the external parser module. Plain dataclasses; the planner walks these.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..types.field_type import FieldType
+
+
+# ---- expressions ------------------------------------------------------------
+
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: Any  # int | float | Decimal | str | bool | None
+    # literal type tag: 'int' | 'float' | 'decimal' | 'string' | 'null' | 'bool'
+    tag: str = "int"
+
+
+@dataclass
+class ColumnRef(Expr):
+    name: str
+    table: Optional[str] = None  # qualifier as written
+    db: Optional[str] = None
+
+    def __str__(self) -> str:
+        parts = [p for p in (self.db, self.table, self.name) if p]
+        return ".".join(parts)
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str  # '+', '-', '*', '/', 'DIV', '%', '=', '<', 'AND', 'OR', ...
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # '-', 'NOT'
+    operand: Expr
+
+
+@dataclass
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    operand: Expr
+    items: list[Expr]
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class Like(Expr):
+    operand: Expr
+    pattern: Expr
+    negated: bool = False
+
+
+@dataclass
+class FuncCall(Expr):
+    name: str  # upper-cased
+    args: list[Expr]
+    distinct: bool = False  # COUNT(DISTINCT x)
+    is_star: bool = False  # COUNT(*)
+
+
+@dataclass
+class Case(Expr):
+    operand: Optional[Expr]  # CASE x WHEN ... vs CASE WHEN cond ...
+    branches: list[tuple[Expr, Expr]]  # (when, then)
+    else_expr: Optional[Expr] = None
+
+
+@dataclass
+class Cast(Expr):
+    operand: Expr
+    target: FieldType
+
+
+@dataclass
+class IntervalExpr(Expr):
+    value: Expr
+    unit: str  # 'DAY', 'MONTH', 'YEAR', ...
+
+
+@dataclass
+class SubqueryExpr(Expr):
+    query: "SelectStmt"
+    # modifier: None (scalar), 'EXISTS', 'IN' handled via InSubquery
+    exists: bool = False
+    negated: bool = False
+
+
+@dataclass
+class InSubquery(Expr):
+    operand: Expr
+    query: "SelectStmt"
+    negated: bool = False
+
+
+# ---- statements -------------------------------------------------------------
+
+class Stmt:
+    pass
+
+
+@dataclass
+class SelectField:
+    expr: Optional[Expr]  # None => wildcard
+    alias: Optional[str] = None
+    wildcard_table: Optional[str] = None  # t.* qualifier
+
+
+@dataclass
+class TableRef:
+    pass
+
+
+@dataclass
+class TableName(TableRef):
+    name: str
+    db: Optional[str] = None
+    alias: Optional[str] = None
+
+
+@dataclass
+class Join(TableRef):
+    kind: str  # 'INNER' | 'LEFT' | 'RIGHT' | 'CROSS'
+    left: TableRef
+    right: TableRef
+    on: Optional[Expr] = None
+    using: Optional[list[str]] = None
+
+
+@dataclass
+class SubqueryTable(TableRef):
+    query: "SelectStmt"
+    alias: str = ""
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class SelectStmt(Stmt):
+    fields: list[SelectField]
+    from_: Optional[TableRef] = None
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+
+
+@dataclass
+class InsertStmt(Stmt):
+    table: TableName
+    columns: Optional[list[str]]  # None => all, in order
+    rows: list[list[Expr]] = field(default_factory=list)
+    select: Optional[SelectStmt] = None  # INSERT ... SELECT
+    is_replace: bool = False
+
+
+@dataclass
+class Assignment:
+    column: ColumnRef
+    value: Expr
+
+
+@dataclass
+class UpdateStmt(Stmt):
+    table: TableName
+    assignments: list[Assignment]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class DeleteStmt(Stmt):
+    table: TableName
+    where: Optional[Expr] = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    ftype: FieldType
+    not_null: bool = False
+    primary_key: bool = False
+    unique: bool = False
+    auto_increment: bool = False
+    default: Optional[Expr] = None
+
+
+@dataclass
+class IndexDef:
+    name: Optional[str]
+    columns: list[str]
+    unique: bool = False
+    primary: bool = False
+
+
+@dataclass
+class CreateTableStmt(Stmt):
+    table: TableName
+    columns: list[ColumnDef]
+    indices: list[IndexDef] = field(default_factory=list)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt(Stmt):
+    tables: list[TableName]
+    if_exists: bool = False
+
+
+@dataclass
+class CreateDatabaseStmt(Stmt):
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt(Stmt):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTableStmt(Stmt):
+    table: TableName
+
+
+@dataclass
+class UseStmt(Stmt):
+    db: str
+
+
+@dataclass
+class BeginStmt(Stmt):
+    pass
+
+
+@dataclass
+class CommitStmt(Stmt):
+    pass
+
+
+@dataclass
+class RollbackStmt(Stmt):
+    pass
+
+
+@dataclass
+class ExplainStmt(Stmt):
+    target: Stmt
+    analyze: bool = False
+
+
+@dataclass
+class ShowStmt(Stmt):
+    kind: str  # 'TABLES' | 'DATABASES' | 'CREATE_TABLE' | 'VARIABLES'
+    target: Optional[TableName] = None
+
+
+@dataclass
+class SetStmt(Stmt):
+    # assignments of session/global variables: list of (scope, name, expr)
+    items: list[tuple[str, str, Expr]] = field(default_factory=list)
+
+
+@dataclass
+class AnalyzeTableStmt(Stmt):
+    tables: list[TableName] = field(default_factory=list)

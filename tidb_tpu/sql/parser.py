@@ -1,0 +1,803 @@
+"""Recursive-descent / Pratt parser for the MySQL-compatible subset.
+
+Counterpart of the reference's external goyacc parser (reference:
+github.com/pingcap/parser; used via session.ParseSQL, session/session.go:1190).
+Covers the surface needed by TPC-H/SSB/ClickBench-style analytics plus DML,
+DDL, txn control, EXPLAIN/SHOW — widened as the framework grows.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..types.field_type import FieldType, TypeKind
+from ..types.value import Decimal
+from . import ast
+from .lexer import Lexer, Token, TokenKind
+
+# Binary operator precedence (higher binds tighter), MySQL order.
+_PRECEDENCE = {
+    "OR": 1, "||": 1,
+    "XOR": 2,
+    "AND": 3, "&&": 3,
+    # 4 reserved for NOT (prefix, handled separately)
+    "=": 5, "<=>": 5, "<>": 5, "!=": 5, "<": 5, "<=": 5, ">": 5, ">=": 5,
+    "|": 6,
+    "&": 7,
+    "<<": 8, ">>": 8,
+    "+": 9, "-": 9,
+    "*": 10, "/": 10, "DIV": 10, "%": 10, "MOD": 10,
+    "^": 11,
+}
+
+_COMPARISON_LEVEL = 5
+
+_AGG_FUNCS = frozenset({"COUNT", "SUM", "AVG", "MIN", "MAX"})
+
+_TYPE_KEYWORDS = {
+    "TINYINT": TypeKind.TINYINT,
+    "SMALLINT": TypeKind.SMALLINT,
+    "INT": TypeKind.INT,
+    "INTEGER": TypeKind.INT,
+    "BIGINT": TypeKind.BIGINT,
+    "FLOAT": TypeKind.FLOAT,
+    "DOUBLE": TypeKind.DOUBLE,
+    "REAL": TypeKind.DOUBLE,
+    "DECIMAL": TypeKind.DECIMAL,
+    "NUMERIC": TypeKind.DECIMAL,
+    "DATE": TypeKind.DATE,
+    "DATETIME": TypeKind.DATETIME,
+    "TIMESTAMP": TypeKind.TIMESTAMP,
+    "CHAR": TypeKind.CHAR,
+    "VARCHAR": TypeKind.VARCHAR,
+    "TEXT": TypeKind.TEXT,
+    "BOOLEAN": TypeKind.BOOLEAN,
+    "BOOL": TypeKind.BOOLEAN,
+    "YEAR": TypeKind.YEAR,
+}
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, token: Token) -> None:
+        where = f"near {token.text!r}" if token.text else "at end of input"
+        super().__init__(f"{msg} {where} (pos {token.pos})")
+        self.token = token
+
+
+class Parser:
+    def __init__(self, text: str) -> None:
+        self.toks = list(Lexer(text).tokens())
+        self.i = 0
+
+    # ---- token helpers -----------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def peek(self, n: int = 1) -> Token:
+        j = min(self.i + n, len(self.toks) - 1)
+        return self.toks[j]
+
+    def advance(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != TokenKind.EOF:
+            self.i += 1
+        return t
+
+    def accept_kw(self, *names: str) -> Optional[Token]:
+        if self.cur.is_kw(*names):
+            return self.advance()
+        return None
+
+    def accept_op(self, *ops: str) -> Optional[Token]:
+        if self.cur.is_op(*ops):
+            return self.advance()
+        return None
+
+    def expect_kw(self, *names: str) -> Token:
+        t = self.accept_kw(*names)
+        if t is None:
+            raise ParseError(f"expected {'/'.join(names)}", self.cur)
+        return t
+
+    def expect_op(self, op: str) -> Token:
+        t = self.accept_op(op)
+        if t is None:
+            raise ParseError(f"expected {op!r}", self.cur)
+        return t
+
+    def expect_ident(self) -> str:
+        """Identifier; unreserved-ish keywords double as identifiers."""
+        t = self.cur
+        if t.kind == TokenKind.IDENT:
+            self.advance()
+            return t.text
+        if t.kind == TokenKind.KEYWORD and t.text in _IDENT_KEYWORDS:
+            self.advance()
+            return t.text.lower()
+        raise ParseError("expected identifier", t)
+
+    # ---- entry -------------------------------------------------------------
+    def parse(self) -> list[ast.Stmt]:
+        stmts: list[ast.Stmt] = []
+        while True:
+            while self.accept_op(";"):
+                pass
+            if self.cur.kind == TokenKind.EOF:
+                return stmts
+            stmts.append(self.parse_statement())
+            if self.cur.kind != TokenKind.EOF:
+                self.expect_op(";")
+
+    def parse_statement(self) -> ast.Stmt:
+        t = self.cur
+        if t.is_kw("SELECT"):
+            return self.parse_select()
+        if t.is_kw("INSERT", "REPLACE"):
+            return self.parse_insert()
+        if t.is_kw("UPDATE"):
+            return self.parse_update()
+        if t.is_kw("DELETE"):
+            return self.parse_delete()
+        if t.is_kw("CREATE"):
+            return self.parse_create()
+        if t.is_kw("DROP"):
+            return self.parse_drop()
+        if t.is_kw("TRUNCATE"):
+            self.advance()
+            self.accept_kw("TABLE")
+            return ast.TruncateTableStmt(self.parse_table_name())
+        if t.is_kw("USE"):
+            self.advance()
+            return ast.UseStmt(self.expect_ident())
+        if t.is_kw("BEGIN"):
+            self.advance()
+            return ast.BeginStmt()
+        if t.is_kw("START"):
+            self.advance()
+            self.expect_kw("TRANSACTION")
+            return ast.BeginStmt()
+        if t.is_kw("COMMIT"):
+            self.advance()
+            return ast.CommitStmt()
+        if t.is_kw("ROLLBACK"):
+            self.advance()
+            return ast.RollbackStmt()
+        if t.is_kw("EXPLAIN", "DESC", "DESCRIBE"):
+            return self.parse_explain()
+        if t.is_kw("SHOW"):
+            return self.parse_show()
+        if t.is_kw("SET"):
+            return self.parse_set()
+        if t.is_kw("ANALYZE"):
+            self.advance()
+            self.expect_kw("TABLE")
+            tables = [self.parse_table_name()]
+            while self.accept_op(","):
+                tables.append(self.parse_table_name())
+            return ast.AnalyzeTableStmt(tables)
+        raise ParseError("unsupported statement", t)
+
+    # ---- SELECT ------------------------------------------------------------
+    def parse_select(self) -> ast.SelectStmt:
+        self.expect_kw("SELECT")
+        distinct = bool(self.accept_kw("DISTINCT"))
+        self.accept_kw("ALL")
+
+        fields = [self.parse_select_field()]
+        while self.accept_op(","):
+            fields.append(self.parse_select_field())
+
+        stmt = ast.SelectStmt(fields=fields, distinct=distinct)
+        if self.accept_kw("FROM"):
+            stmt.from_ = self.parse_table_refs()
+        if self.accept_kw("WHERE"):
+            stmt.where = self.parse_expr()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            stmt.group_by.append(self.parse_expr())
+            while self.accept_op(","):
+                stmt.group_by.append(self.parse_expr())
+        if self.accept_kw("HAVING"):
+            stmt.having = self.parse_expr()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            stmt.order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                stmt.order_by.append(self.parse_order_item())
+        if self.accept_kw("LIMIT"):
+            first = self.parse_uint("LIMIT")
+            if self.accept_op(","):  # LIMIT offset, count
+                stmt.offset = first
+                stmt.limit = self.parse_uint("LIMIT")
+            else:
+                stmt.limit = first
+                if self.accept_kw("OFFSET"):
+                    stmt.offset = self.parse_uint("OFFSET")
+        return stmt
+
+    def parse_uint(self, what: str) -> int:
+        t = self.cur
+        if t.kind != TokenKind.INT:
+            raise ParseError(f"expected integer after {what}", t)
+        self.advance()
+        return int(t.text)
+
+    def parse_select_field(self) -> ast.SelectField:
+        if self.accept_op("*"):
+            return ast.SelectField(expr=None)
+        # t.* wildcard
+        if (
+            self.cur.kind == TokenKind.IDENT
+            and self.peek().is_op(".")
+            and self.peek(2).is_op("*")
+        ):
+            tbl = self.advance().text
+            self.advance()
+            self.advance()
+            return ast.SelectField(expr=None, wildcard_table=tbl)
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self.expect_ident()
+        elif self.cur.kind == TokenKind.IDENT:
+            alias = self.advance().text
+        elif self.cur.kind == TokenKind.STRING:
+            alias = self.advance().text
+        return ast.SelectField(expr=expr, alias=alias)
+
+    def parse_order_item(self) -> ast.OrderItem:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("DESC"):
+            desc = True
+        else:
+            self.accept_kw("ASC")
+        return ast.OrderItem(e, desc)
+
+    # ---- FROM / joins ------------------------------------------------------
+    def parse_table_refs(self) -> ast.TableRef:
+        left = self.parse_join_chain()
+        while self.accept_op(","):  # comma join = cross join
+            right = self.parse_join_chain()
+            left = ast.Join("CROSS", left, right)
+        return left
+
+    def parse_join_chain(self) -> ast.TableRef:
+        left = self.parse_table_factor()
+        while True:
+            kind = None
+            if self.accept_kw("INNER"):
+                self.expect_kw("JOIN")
+                kind = "INNER"
+            elif self.accept_kw("CROSS"):
+                self.expect_kw("JOIN")
+                kind = "CROSS"
+            elif self.accept_kw("LEFT"):
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "LEFT"
+            elif self.accept_kw("RIGHT"):
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "RIGHT"
+            elif self.accept_kw("JOIN"):
+                kind = "INNER"
+            else:
+                return left
+            right = self.parse_table_factor()
+            on = None
+            using = None
+            if self.accept_kw("ON"):
+                on = self.parse_expr()
+            elif self.accept_kw("USING"):
+                self.expect_op("(")
+                using = [self.expect_ident()]
+                while self.accept_op(","):
+                    using.append(self.expect_ident())
+                self.expect_op(")")
+            left = ast.Join(kind, left, right, on=on, using=using)
+
+    def parse_table_factor(self) -> ast.TableRef:
+        if self.accept_op("("):
+            if self.cur.is_kw("SELECT"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                alias = ""
+                self.accept_kw("AS")
+                if self.cur.kind == TokenKind.IDENT:
+                    alias = self.advance().text
+                return ast.SubqueryTable(sub, alias)
+            refs = self.parse_table_refs()
+            self.expect_op(")")
+            return refs
+        return self.parse_table_name(allow_alias=True)
+
+    def parse_table_name(self, allow_alias: bool = False) -> ast.TableName:
+        name = self.expect_ident()
+        db = None
+        if self.accept_op("."):
+            db, name = name, self.expect_ident()
+        alias = None
+        if allow_alias:
+            if self.accept_kw("AS"):
+                alias = self.expect_ident()
+            elif self.cur.kind == TokenKind.IDENT:
+                alias = self.advance().text
+        return ast.TableName(name=name, db=db, alias=alias)
+
+    # ---- DML ---------------------------------------------------------------
+    def parse_insert(self) -> ast.InsertStmt:
+        is_replace = bool(self.accept_kw("REPLACE"))
+        if not is_replace:
+            self.expect_kw("INSERT")
+        self.accept_kw("INTO")
+        table = self.parse_table_name()
+        columns = None
+        if self.accept_op("("):
+            columns = [self.expect_ident()]
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        if self.cur.is_kw("SELECT"):
+            return ast.InsertStmt(table, columns, select=self.parse_select(),
+                                  is_replace=is_replace)
+        self.expect_kw("VALUES")
+        rows = [self.parse_value_row()]
+        while self.accept_op(","):
+            rows.append(self.parse_value_row())
+        return ast.InsertStmt(table, columns, rows=rows, is_replace=is_replace)
+
+    def parse_value_row(self) -> list[ast.Expr]:
+        self.expect_op("(")
+        if self.accept_op(")"):
+            return []
+        row = [self.parse_expr()]
+        while self.accept_op(","):
+            row.append(self.parse_expr())
+        self.expect_op(")")
+        return row
+
+    def parse_update(self) -> ast.UpdateStmt:
+        self.expect_kw("UPDATE")
+        table = self.parse_table_name(allow_alias=True)
+        self.expect_kw("SET")
+        assigns = [self.parse_assignment()]
+        while self.accept_op(","):
+            assigns.append(self.parse_assignment())
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.UpdateStmt(table, assigns, where)
+
+    def parse_assignment(self) -> ast.Assignment:
+        col = self.parse_column_ref()
+        self.expect_op("=")
+        return ast.Assignment(col, self.parse_expr())
+
+    def parse_delete(self) -> ast.DeleteStmt:
+        self.expect_kw("DELETE")
+        self.expect_kw("FROM")
+        table = self.parse_table_name(allow_alias=True)
+        where = self.parse_expr() if self.accept_kw("WHERE") else None
+        return ast.DeleteStmt(table, where)
+
+    # ---- DDL ---------------------------------------------------------------
+    def parse_create(self) -> ast.Stmt:
+        self.expect_kw("CREATE")
+        if self.accept_kw("DATABASE", "SCHEMA"):
+            ine = self._if_not_exists()
+            return ast.CreateDatabaseStmt(self.expect_ident(), ine)
+        self.expect_kw("TABLE")
+        ine = self._if_not_exists()
+        table = self.parse_table_name()
+        self.expect_op("(")
+        columns: list[ast.ColumnDef] = []
+        indices: list[ast.IndexDef] = []
+        while True:
+            if self.cur.is_kw("PRIMARY"):
+                self.advance()
+                self.expect_kw("KEY")
+                cols = self._paren_ident_list()
+                indices.append(ast.IndexDef("PRIMARY", cols, unique=True, primary=True))
+            elif self.cur.is_kw("UNIQUE"):
+                self.advance()
+                self.accept_kw("KEY", "INDEX")
+                name = self._opt_index_name()
+                indices.append(ast.IndexDef(name, self._paren_ident_list(), unique=True))
+            elif self.cur.is_kw("KEY", "INDEX"):
+                self.advance()
+                name = self._opt_index_name()
+                indices.append(ast.IndexDef(name, self._paren_ident_list()))
+            else:
+                columns.append(self.parse_column_def())
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        # swallow table options (ENGINE=..., CHARSET=..., etc.)
+        while self.cur.kind != TokenKind.EOF and not self.cur.is_op(";"):
+            self.advance()
+        return ast.CreateTableStmt(table, columns, indices, ine)
+
+    def _if_not_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("NOT")
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    def _opt_index_name(self) -> Optional[str]:
+        if self.cur.kind == TokenKind.IDENT and not self.peek().is_op("("):
+            pass
+        if self.cur.kind == TokenKind.IDENT:
+            return self.advance().text
+        return None
+
+    def _paren_ident_list(self) -> list[str]:
+        self.expect_op("(")
+        out = [self.expect_ident()]
+        while self.accept_op(","):
+            out.append(self.expect_ident())
+        self.expect_op(")")
+        return out
+
+    def parse_column_def(self) -> ast.ColumnDef:
+        name = self.expect_ident()
+        ftype = self.parse_field_type()
+        d = ast.ColumnDef(name, ftype)
+        while True:
+            if self.accept_kw("NOT"):
+                self.expect_kw("NULL")
+                d.not_null = True
+            elif self.accept_kw("NULL"):
+                pass
+            elif self.accept_kw("PRIMARY"):
+                self.expect_kw("KEY")
+                d.primary_key = True
+                d.not_null = True
+            elif self.accept_kw("UNIQUE"):
+                self.accept_kw("KEY")
+                d.unique = True
+            elif self.accept_kw("AUTO_INCREMENT"):
+                d.auto_increment = True
+            elif self.accept_kw("DEFAULT"):
+                d.default = self.parse_primary()
+            elif self.cur.kind == TokenKind.IDENT and self.cur.text.upper() in (
+                "CHARACTER", "COLLATE", "COMMENT"
+            ):
+                # swallow charset/collation/comment clauses
+                self.advance()
+                if self.cur.kind in (TokenKind.IDENT, TokenKind.STRING,
+                                     TokenKind.KEYWORD):
+                    self.advance()
+            else:
+                return d
+
+    def parse_field_type(self) -> FieldType:
+        t = self.cur
+        kind = None
+        if t.kind == TokenKind.KEYWORD and t.text in _TYPE_KEYWORDS:
+            kind = _TYPE_KEYWORDS[t.text]
+            self.advance()
+        elif t.kind == TokenKind.IDENT and t.text.upper() in ("SIGNED", "UNSIGNED"):
+            self.advance()
+            self.accept_kw("INT", "INTEGER")
+            kind = TypeKind.BIGINT
+        else:
+            raise ParseError("expected type name", t)
+        flen, scale = -1, 0
+        if self.accept_op("("):
+            flen = self.parse_uint("type length")
+            if self.accept_op(","):
+                scale = self.parse_uint("type scale")
+            self.expect_op(")")
+        if kind == TypeKind.DECIMAL:
+            if flen < 0:
+                flen = 10  # MySQL default DECIMAL(10,0)
+            if flen > 18:
+                raise ParseError(f"DECIMAL({flen}) exceeds supported precision 18",
+                                 t)
+        if self.cur.kind == TokenKind.IDENT and self.cur.text.upper() == "UNSIGNED":
+            self.advance()  # accepted but not tracked yet
+        return FieldType(kind, flen=flen, scale=scale)
+
+    def parse_drop(self) -> ast.Stmt:
+        self.expect_kw("DROP")
+        if self.accept_kw("DATABASE", "SCHEMA"):
+            if_exists = self._if_exists()
+            return ast.DropDatabaseStmt(self.expect_ident(), if_exists)
+        self.expect_kw("TABLE")
+        if_exists = self._if_exists()
+        tables = [self.parse_table_name()]
+        while self.accept_op(","):
+            tables.append(self.parse_table_name())
+        return ast.DropTableStmt(tables, if_exists)
+
+    def _if_exists(self) -> bool:
+        if self.accept_kw("IF"):
+            self.expect_kw("EXISTS")
+            return True
+        return False
+
+    # ---- misc statements ---------------------------------------------------
+    def parse_explain(self) -> ast.Stmt:
+        self.advance()  # EXPLAIN/DESC/DESCRIBE
+        analyze = bool(self.accept_kw("ANALYZE"))
+        return ast.ExplainStmt(self.parse_statement(), analyze)
+
+    def parse_show(self) -> ast.ShowStmt:
+        self.expect_kw("SHOW")
+        if self.accept_kw("TABLES"):
+            return ast.ShowStmt("TABLES")
+        if self.accept_kw("DATABASES"):
+            return ast.ShowStmt("DATABASES")
+        if self.accept_kw("CREATE"):
+            self.expect_kw("TABLE")
+            return ast.ShowStmt("CREATE_TABLE", self.parse_table_name())
+        if self.accept_kw("VARIABLES"):
+            return ast.ShowStmt("VARIABLES")
+        raise ParseError("unsupported SHOW", self.cur)
+
+    def parse_set(self) -> ast.SetStmt:
+        self.expect_kw("SET")
+        items = []
+        while True:
+            scope = "SESSION"
+            if self.accept_kw("GLOBAL"):
+                scope = "GLOBAL"
+            elif self.accept_kw("SESSION"):
+                scope = "SESSION"
+            elif self.accept_op("@"):
+                self.expect_op("@")  # @@var
+                if self.cur.kind == TokenKind.IDENT and self.peek().is_op("."):
+                    scope = self.advance().text.upper()
+                    self.advance()
+            name = self.expect_ident()
+            if not self.accept_op("=") and not self.accept_op(":="):
+                raise ParseError("expected = in SET", self.cur)
+            items.append((scope, name, self.parse_expr()))
+            if not self.accept_op(","):
+                return ast.SetStmt(items)
+
+    # ---- expressions (Pratt) -----------------------------------------------
+    def parse_expr(self) -> ast.Expr:
+        return self.parse_binary(0)
+
+    def parse_binary(self, min_prec: int) -> ast.Expr:
+        left = self.parse_unary()
+        while True:
+            t = self.cur
+            op = None
+            if t.kind == TokenKind.OP and t.text in _PRECEDENCE:
+                op = t.text
+            elif t.kind == TokenKind.KEYWORD and t.text in _PRECEDENCE:
+                op = t.text
+            # NOT IN / NOT LIKE / NOT BETWEEN / IS / IN / BETWEEN / LIKE
+            if t.is_kw("IS", "IN", "BETWEEN", "LIKE", "NOT") and (
+                _COMPARISON_LEVEL > min_prec
+            ):
+                handled, left = self._parse_predicate_suffix(left)
+                if handled:
+                    continue
+            if op is None:
+                return left
+            prec = _PRECEDENCE[op]
+            if prec <= min_prec:
+                return left
+            self.advance()
+            if op in ("||",):
+                op = "OR"
+            if op in ("&&",):
+                op = "AND"
+            if op == "!=":
+                op = "<>"
+            if op == "MOD":
+                op = "%"
+            right = self.parse_binary(prec)
+            left = ast.BinaryOp(op, left, right)
+
+    def _parse_predicate_suffix(self, left: ast.Expr) -> tuple[bool, ast.Expr]:
+        """IS [NOT] NULL, [NOT] IN, [NOT] BETWEEN, [NOT] LIKE."""
+        if self.cur.is_kw("IS"):
+            self.advance()
+            negated = bool(self.accept_kw("NOT"))
+            if self.accept_kw("NULL"):
+                return True, ast.IsNull(left, negated)
+            if self.accept_kw("TRUE"):
+                e: ast.Expr = ast.BinaryOp("=", left, ast.Literal(True, "bool"))
+            elif self.accept_kw("FALSE"):
+                e = ast.BinaryOp("=", left, ast.Literal(False, "bool"))
+            else:
+                raise ParseError("expected NULL/TRUE/FALSE after IS", self.cur)
+            if negated:
+                e = ast.UnaryOp("NOT", e)
+            return True, e
+        negated = False
+        if self.cur.is_kw("NOT") and self.peek().is_kw("IN", "BETWEEN", "LIKE"):
+            self.advance()
+            negated = True
+        if self.accept_kw("IN"):
+            self.expect_op("(")
+            if self.cur.is_kw("SELECT"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return True, ast.InSubquery(left, sub, negated)
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return True, ast.InList(left, items, negated)
+        if self.accept_kw("BETWEEN"):
+            low = self.parse_binary(_COMPARISON_LEVEL)
+            self.expect_kw("AND")
+            high = self.parse_binary(_COMPARISON_LEVEL)
+            return True, ast.Between(left, low, high, negated)
+        if self.accept_kw("LIKE"):
+            pattern = self.parse_binary(_COMPARISON_LEVEL)
+            return True, ast.Like(left, pattern, negated)
+        return False, left
+
+    def parse_unary(self) -> ast.Expr:
+        if self.accept_kw("NOT") or self.accept_op("!"):
+            return ast.UnaryOp("NOT", self.parse_binary(4))
+        if self.accept_op("-"):
+            operand = self.parse_unary()
+            if isinstance(operand, ast.Literal) and operand.tag in (
+                "int", "decimal", "float"
+            ):
+                if operand.tag == "decimal":
+                    return ast.Literal(-operand.value, "decimal")
+                return ast.Literal(-operand.value, operand.tag)
+            return ast.UnaryOp("-", operand)
+        if self.accept_op("+"):
+            return self.parse_unary()
+        if self.accept_kw("INTERVAL"):
+            value = self.parse_primary()
+            unit = self._interval_unit()
+            return ast.IntervalExpr(value, unit)
+        return self.parse_primary()
+
+    def _interval_unit(self) -> str:
+        t = self.cur
+        units = {"DAY", "WEEK", "MONTH", "QUARTER", "YEAR", "HOUR", "MINUTE",
+                 "SECOND", "MICROSECOND"}
+        if t.kind == TokenKind.IDENT and t.text.upper() in units:
+            self.advance()
+            return t.text.upper()
+        if t.kind == TokenKind.KEYWORD and t.text in units:
+            self.advance()
+            return t.text
+        raise ParseError("expected interval unit", t)
+
+    def parse_primary(self) -> ast.Expr:
+        t = self.cur
+        if t.kind == TokenKind.INT:
+            self.advance()
+            return ast.Literal(int(t.text), "int")
+        if t.kind == TokenKind.DECIMAL:
+            self.advance()
+            return ast.Literal(Decimal.parse(t.text), "decimal")
+        if t.kind == TokenKind.FLOAT:
+            self.advance()
+            return ast.Literal(float(t.text), "float")
+        if t.kind == TokenKind.STRING:
+            self.advance()
+            return ast.Literal(t.text, "string")
+        if t.is_kw("NULL"):
+            self.advance()
+            return ast.Literal(None, "null")
+        if t.is_kw("TRUE"):
+            self.advance()
+            return ast.Literal(True, "bool")
+        if t.is_kw("FALSE"):
+            self.advance()
+            return ast.Literal(False, "bool")
+        # DATE 'lit' / TIMESTAMP 'lit' typed literals
+        if t.is_kw("DATE", "TIMESTAMP", "DATETIME") and \
+                self.peek().kind == TokenKind.STRING:
+            self.advance()
+            lit = self.advance()
+            return ast.Literal(lit.text, {"DATE": "date"}.get(t.text, "datetime"))
+        if t.is_kw("CASE"):
+            return self.parse_case()
+        if t.is_kw("CAST", "CONVERT"):
+            return self.parse_cast()
+        if t.is_kw("EXISTS"):
+            self.advance()
+            self.expect_op("(")
+            sub = self.parse_select()
+            self.expect_op(")")
+            return ast.SubqueryExpr(sub, exists=True)
+        if t.is_op("("):
+            self.advance()
+            if self.cur.is_kw("SELECT"):
+                sub = self.parse_select()
+                self.expect_op(")")
+                return ast.SubqueryExpr(sub)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        # aggregate keywords used as functions
+        if t.kind == TokenKind.KEYWORD and t.text in _AGG_FUNCS:
+            self.advance()
+            return self.parse_func_call(t.text)
+        if t.kind == TokenKind.IDENT or (
+            t.kind == TokenKind.KEYWORD and t.text in _IDENT_KEYWORDS
+        ):
+            name = self.advance().text
+            if self.cur.is_op("("):
+                return self.parse_func_call(name.upper())
+            return self._finish_column_ref(name)
+        raise ParseError("expected expression", t)
+
+    def parse_func_call(self, name: str) -> ast.Expr:
+        self.expect_op("(")
+        distinct = bool(self.accept_kw("DISTINCT"))
+        if self.accept_op("*"):
+            self.expect_op(")")
+            return ast.FuncCall(name, [], is_star=True)
+        if self.accept_op(")"):
+            return ast.FuncCall(name, [])
+        args = [self.parse_expr()]
+        while self.accept_op(","):
+            args.append(self.parse_expr())
+        self.expect_op(")")
+        return ast.FuncCall(name, args, distinct=distinct)
+
+    def _finish_column_ref(self, first: str) -> ast.ColumnRef:
+        if self.accept_op("."):
+            second = self.expect_ident()
+            if self.accept_op("."):
+                return ast.ColumnRef(self.expect_ident(), table=second, db=first)
+            return ast.ColumnRef(second, table=first)
+        return ast.ColumnRef(first)
+
+    def parse_column_ref(self) -> ast.ColumnRef:
+        return self._finish_column_ref(self.expect_ident())
+
+    def parse_case(self) -> ast.Case:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.cur.is_kw("WHEN"):
+            operand = self.parse_expr()
+        branches = []
+        while self.accept_kw("WHEN"):
+            when = self.parse_expr()
+            self.expect_kw("THEN")
+            branches.append((when, self.parse_expr()))
+        else_expr = self.parse_expr() if self.accept_kw("ELSE") else None
+        self.expect_kw("END")
+        return ast.Case(operand, branches, else_expr)
+
+    def parse_cast(self) -> ast.Cast:
+        kw = self.advance()  # CAST or CONVERT
+        self.expect_op("(")
+        operand = self.parse_expr()
+        if kw.text == "CAST":
+            self.expect_kw("AS")
+        else:
+            self.expect_op(",")
+        target = self.parse_field_type()
+        self.expect_op(")")
+        return ast.Cast(operand, target)
+
+
+# Keywords that may double as identifiers (table/column names) when not in
+# keyword position — mirrors MySQL's non-reserved keyword list for the subset
+# we actually reserve.
+_IDENT_KEYWORDS = frozenset(
+    """
+    DATE TIME TIMESTAMP DATETIME YEAR STATUS VARIABLES TABLES DATABASES
+    COUNT SUM AVG MIN MAX COLUMN FIRST AFTER BEGIN COMMIT IF
+    """.split()
+)
+
+
+def parse_sql(text: str) -> list[ast.Stmt]:
+    return Parser(text).parse()
+
+
+def parse_one(text: str) -> ast.Stmt:
+    stmts = parse_sql(text)
+    if len(stmts) != 1:
+        raise ParseError("expected exactly one statement",
+                         Token(TokenKind.EOF, "", 0))
+    return stmts[0]

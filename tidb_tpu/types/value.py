@@ -34,6 +34,12 @@ class Decimal:
         neg = text.startswith("-")
         if text and text[0] in "+-":
             text = text[1:]
+        exp = 0
+        for e in ("e", "E"):
+            if e in text:
+                text, exp_s = text.split(e, 1)
+                exp = int(exp_s)
+                break
         if "." in text:
             intpart, frac = text.split(".", 1)
         else:
@@ -42,7 +48,11 @@ class Decimal:
         unscaled = int(intpart + frac) if (intpart + frac) else 0
         if neg:
             unscaled = -unscaled
-        return Decimal(unscaled, len(frac))
+        scale = len(frac) - exp
+        if scale < 0:
+            unscaled *= 10 ** (-scale)
+            scale = 0
+        return Decimal(unscaled, scale)
 
     @staticmethod
     def from_int(v: int, scale: int = 0) -> "Decimal":
