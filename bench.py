@@ -1,0 +1,118 @@
+"""Benchmark: TPC-H Q6 (and Q1) end-to-end rows/sec on the TiTPU engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): the reference publishes no absolute numbers in-repo
+and its Go toolchain isn't present here, so the comparison floor is a
+row-at-a-time interpreted coprocessor baseline measured in-process — the
+execution model of the reference's mocktikv interpreter (reference:
+store/mockstore/mocktikv/cop_handler_dag.go:150, row loop over MVCC pairs)
+— timed on a sample and scaled. vs_baseline = engine rows/s divided by
+interpreter rows/s. The north star (BASELINE.json) asks for >= 10x.
+
+Environment knobs: BENCH_ROWS (default SF1 = 6_001_215), BENCH_REPEAT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def interpreted_q6_baseline(arrays: dict[str, np.ndarray],
+                            sample: int = 200_000) -> float:
+    """Row-at-a-time interpreted Q6 (mocktikv-style) rows/sec."""
+    from tidb_tpu.types.value import parse_date
+
+    n = min(sample, len(arrays["l_shipdate"]))
+    ship = arrays["l_shipdate"][:n].tolist()
+    disc = arrays["l_discount"][:n].tolist()
+    qty = arrays["l_quantity"][:n].tolist()
+    price = arrays["l_extendedprice"][:n].tolist()
+    d1, d2 = parse_date("1994-01-01"), parse_date("1995-01-01")
+    t0 = time.perf_counter()
+    acc = 0
+    for i in range(n):
+        s = ship[i]
+        if s >= d1 and s < d2:
+            d = disc[i]
+            if 5 <= d <= 7 and qty[i] < 2400:
+                acc += price[i] * d
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", 6_001_215))
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        # this image pre-imports jax at interpreter startup, so
+        # JAX_PLATFORMS in the env is ignored; the config path still works
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    from tidb_tpu.bench.tpch import (
+        TPCH_Q1,
+        TPCH_Q6,
+        generate_lineitem_arrays,
+        load_lineitem,
+    )
+    from tidb_tpu.session import Session
+
+    session = Session()
+    t0 = time.perf_counter()
+    load_lineitem(session, n_rows)
+    load_s = time.perf_counter() - t0
+
+    arrays = generate_lineitem_arrays(n_rows)
+    baseline_rps = interpreted_q6_baseline(arrays)
+
+    # correctness gate before timing (digest vs vectorized oracle)
+    from tidb_tpu.types.value import parse_date
+    d1, d2 = parse_date("1994-01-01"), parse_date("1995-01-01")
+    mask = ((arrays["l_shipdate"] >= d1) & (arrays["l_shipdate"] < d2)
+            & (arrays["l_discount"] >= 5) & (arrays["l_discount"] <= 7)
+            & (arrays["l_quantity"] < 2400))
+    oracle = int((arrays["l_extendedprice"][mask].astype(np.int64)
+                  * arrays["l_discount"][mask]).sum())
+    got = session.query(TPCH_Q6)[0][0]  # also warms compile + device cache
+    assert got is not None and got.unscaled == oracle, (
+        f"Q6 digest mismatch: {got} vs {oracle}")
+
+    def best_time(sql: str) -> float:
+        session.query(sql)  # warm
+        best = float("inf")
+        for _ in range(repeat):
+            t = time.perf_counter()
+            session.query(sql)
+            best = min(best, time.perf_counter() - t)
+        return best
+
+    q6_s = best_time(TPCH_Q6)
+    q1_s = best_time(TPCH_Q1)
+    q6_rps = n_rows / q6_s
+    q1_rps = n_rows / q1_s
+
+    print(json.dumps({
+        "metric": "tpch_q6_rows_per_sec",
+        "value": round(q6_rps),
+        "unit": "rows/s",
+        "vs_baseline": round(q6_rps / baseline_rps, 2),
+    }))
+    # context lines on stderr so the JSON line stays clean
+    import sys
+    print(
+        f"# rows={n_rows} load={load_s:.1f}s q6={q6_s*1e3:.1f}ms "
+        f"({q6_rps/1e6:.1f}M rows/s) q1={q1_s*1e3:.1f}ms "
+        f"({q1_rps/1e6:.1f}M rows/s) interp-baseline={baseline_rps/1e3:.0f}K "
+        f"rows/s platform={__import__('jax').default_backend()}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

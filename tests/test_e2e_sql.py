@@ -303,3 +303,27 @@ class TestReviewRegressions:
         # qty*qty has scale 4; column scale is 2 -> must round-rescale
         tk.must_exec("update t set qty = qty * qty where id = 2")
         tk.check("select qty from t where id = 2", [("10.56",)])
+
+    def test_topn_over_computed_sort_key(self, tk):
+        # sort key references the pushed projection's output, not scan cols
+        tk.must_exec("create table sx (a bigint, b bigint)")
+        tk.must_exec("insert into sx values (1, 100), (2, 0), (3, 50)")
+        tk.check("select a + b sm from sx order by sm limit 1", [(2,)])
+        tk.check("select a + b sm from sx order by sm desc limit 2",
+                 [(101,), (53,)])
+
+    def test_string_col_eq_col_cross_dict(self, tk):
+        tk.must_exec("create table u2 (x varchar(5), y varchar(5))")
+        tk.must_exec("insert into u2 values ('a','b'), ('c','c'), ('b','a')")
+        tk.check("select x from u2 where x = y", [("c",)])
+
+    def test_decimal_in_list_scales(self, tk):
+        tk.check("select id from t where qty in (10.5, 3.250, 99)",
+                 [(1,), (2,)], ordered=False)
+        # over-precise value can never match a scale-2 column
+        tk.check("select id from t where qty in (10.505)", [])
+
+    def test_topn_desc_nulls_fill_limit(self, tk):
+        # NULL keys sort last under DESC but still satisfy the LIMIT
+        tk.check("select id from t where id > 1 order by qty desc limit 4",
+                 [(3,), (2,), (5,), (4,)])

@@ -1,0 +1,118 @@
+"""TPC-H Q1/Q6 correctness: engine results vs an independent numpy oracle.
+
+The differential-testing strategy SURVEY.md §7 prescribes: same generated
+data, two independent computations, identical digests required.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.bench.tpch import (
+    TPCH_Q1,
+    TPCH_Q6,
+    generate_lineitem_arrays,
+    load_lineitem,
+)
+from tidb_tpu.session import Session
+from tidb_tpu.types import Decimal
+from tidb_tpu.types.value import parse_date
+
+N_ROWS = 60_000
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    s = Session()
+    load_lineitem(s, N_ROWS)
+    arrays = generate_lineitem_arrays(N_ROWS)
+    return s, arrays
+
+
+class TestQ6:
+    def test_q6_digest(self, loaded):
+        s, a = loaded
+        rows = s.query(TPCH_Q6)
+        assert len(rows) == 1
+        got = rows[0][0]
+
+        d1 = parse_date("1994-01-01")
+        d2 = parse_date("1995-01-01")
+        mask = (
+            (a["l_shipdate"] >= d1)
+            & (a["l_shipdate"] < d2)
+            & (a["l_discount"] >= 5)
+            & (a["l_discount"] <= 7)
+            & (a["l_quantity"] < 2400)
+        )
+        # extendedprice(s2) * discount(s2) -> scale 4
+        oracle = int(np.sum(a["l_extendedprice"][mask].astype(object)
+                            * a["l_discount"][mask].astype(object)))
+        assert isinstance(got, Decimal)
+        assert got.unscaled == oracle and got.scale == 4
+
+    def test_q6_selectivity_sane(self, loaded):
+        s, a = loaded
+        # ~ 1/7 of dates x 3/11 discounts x 23/50 qty ≈ 1.7% selectivity
+        n = s.query(
+            "select count(*) from lineitem where l_shipdate >= "
+            "date '1994-01-01' and l_shipdate < date '1995-01-01' and "
+            "l_discount between 0.05 and 0.07 and l_quantity < 24"
+        )[0][0]
+        assert 0.005 * N_ROWS < n < 0.04 * N_ROWS
+
+
+class TestQ1:
+    def test_q1_digest(self, loaded):
+        s, a = loaded
+        rows = s.query(TPCH_Q1)
+
+        cutoff = parse_date("1998-12-01") - 90
+        mask = a["l_shipdate"] <= cutoff
+        rf = a["l_returnflag"][mask]
+        ls = a["l_linestatus"][mask]
+        qty = a["l_quantity"][mask].astype(object)
+        price = a["l_extendedprice"][mask].astype(object)
+        disc = a["l_discount"][mask].astype(object)
+        tax = a["l_tax"][mask].astype(object)
+
+        flag_names = np.array(["A", "R", "N"])
+        status_names = np.array(["F", "O"])
+        oracle = {}
+        for rfc in range(3):
+            for lsc in range(2):
+                g = (rf == rfc) & (ls == lsc)
+                cnt = int(g.sum())
+                if cnt == 0:
+                    continue
+                sum_qty = int(qty[g].sum())
+                sum_price = int(price[g].sum())
+                # disc_price scale 4: price * (1 - disc) = price*(100-disc)
+                sum_disc_price = int((price[g] * (100 - disc[g])).sum())
+                # charge scale 6: price*(100-disc)*(100+tax)
+                sum_charge = int(
+                    (price[g] * (100 - disc[g]) * (100 + tax[g])).sum())
+                avg_qty = Decimal(sum_qty, 2).div(Decimal.from_int(cnt))
+                avg_price = Decimal(sum_price, 2).div(Decimal.from_int(cnt))
+                avg_disc = Decimal(int(disc[g].sum()), 2).div(
+                    Decimal.from_int(cnt))
+                oracle[(flag_names[rfc], status_names[lsc])] = (
+                    Decimal(sum_qty, 2), Decimal(sum_price, 2),
+                    Decimal(sum_disc_price, 4), Decimal(sum_charge, 6),
+                    avg_qty, avg_price, avg_disc, cnt,
+                )
+
+        assert len(rows) == len(oracle)
+        # engine rows are ordered by returnflag, linestatus (A<N<R binary)
+        got_keys = [(r[0], r[1]) for r in rows]
+        assert got_keys == sorted(oracle.keys())
+        for r in rows:
+            key = (r[0], r[1])
+            want = oracle[key]
+            got = tuple(r[2:])
+            assert got == want, f"group {key}:\n got {got}\nwant {want}"
+
+    def test_q1_plan_is_pushed(self, loaded):
+        s, _ = loaded
+        lines = "\n".join(r[0] for r in s.query("explain " + TPCH_Q1))
+        assert "TableRead[TiTPU]" in lines
+        assert "agg(groups=2" in lines

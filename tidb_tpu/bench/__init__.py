@@ -1,0 +1,3 @@
+from .tpch import load_lineitem, TPCH_Q1, TPCH_Q6, lineitem_ddl
+
+__all__ = ["load_lineitem", "TPCH_Q1", "TPCH_Q6", "lineitem_ddl"]

@@ -75,9 +75,27 @@ class CopClient:
         self._mask_cache: dict[tuple[int, int, str], Any] = {}
         # compiled kernel cache
         self._kernels: dict[Any, Any] = {}
+        # table_id -> last seen epoch_id, for cache eviction
+        self._live_epochs: dict[int, int] = {}
+
+    def _evict_stale(self, table_id: int, epoch_id: int) -> None:
+        """Free device buffers cached for a table's superseded epochs
+        (compaction/bulk_load create a fresh epoch; the old one's padded
+        device copies would otherwise pin HBM for the session lifetime)."""
+        old = self._live_epochs.get(table_id)
+        if old == epoch_id:
+            return
+        self._live_epochs[table_id] = epoch_id
+        if old is None:
+            return
+        for k in [k for k in self._col_cache if k[0] == old]:
+            del self._col_cache[k]
+        for k in [k for k in self._mask_cache if k[0] == old]:
+            del self._mask_cache[k]
 
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
+        self._evict_stale(dag.scan.table_id, snap.epoch.epoch_id)
         prepared, fallback = self._prepare(dag, snap)
         if fallback is not None:
             return host_exec.execute_host(dag, snap, fallback)
@@ -260,6 +278,9 @@ class CopClient:
             return None
         return cards
 
+    def _bucket_size(self, n: int) -> int:
+        return _bucket(n)
+
     # ==================== batch execution ====================
     def _run_batch(
         self,
@@ -282,7 +303,7 @@ class CopClient:
         offsets = dag.scan.col_offsets
         if overlay:
             n = len(snap.overlay_handles)
-            b = _bucket(n)
+            b = self._bucket_size(n)
             host_cols = []
             dev_cols = []
             for off in offsets:
@@ -300,7 +321,7 @@ class CopClient:
 
         epoch = snap.epoch
         n = epoch.num_rows
-        b = _bucket(n)
+        b = self._bucket_size(n)
         dev_cols = []
         host_cols = []
         for off in offsets:
@@ -380,10 +401,14 @@ class CopClient:
         return [Chunk(columns)]
 
     def _build_agg_kernel(self, dag, prepared, cards, segments):
+        return jax.jit(self._agg_kernel_body(dag, prepared, cards, segments))
+
+    def _agg_kernel_body(self, dag, prepared, cards, segments):
+        """Pure (cols, row_mask) -> {partials} function; the distributed
+        client wraps it in shard_map + psum (see parallel/dist.py)."""
         agg = dag.agg
         sel = dag.selection
 
-        @jax.jit
         def kernel(cols, row_mask):
             mask = row_mask
             if sel is not None:
@@ -530,6 +555,10 @@ class CopClient:
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):
         sel = dag.selection
         projections = dag.projections
+        if projections is not None:
+            # sort items were resolved against the projection's output
+            # schema; substitute so the key computes over projected values
+            expr = _subst_proj_cols(expr, projections)
 
         @jax.jit
         def kernel(cols, row_mask):
@@ -537,14 +566,17 @@ class CopClient:
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
             v, vl = eval_expr(expr, cols, prepared)
+            # dropped rows must score strictly below NULL-key rows (DESC
+            # sorts NULLs last but they still belong in the result)
             if jnp.issubdtype(v.dtype, jnp.floating):
-                null_score = jnp.inf if not desc else -jnp.inf
+                null_score = jnp.inf if not desc else -jnp.finfo(
+                    jnp.float64).max
                 drop_score = -jnp.inf
                 score = jnp.where(vl, v if desc else -v, null_score)
             else:
                 v64 = v.astype(jnp.int64)
                 null_score = _INT_MAX if not desc else _INT_MIN
-                drop_score = _INT_MIN
+                drop_score = jnp.iinfo(jnp.int64).min
                 score = jnp.where(vl, v64 if desc else -v64, null_score)
             score = jnp.where(mask, score, drop_score)
             k = min(n, score.shape[0])
@@ -638,6 +670,16 @@ def _expr_reprs(dag: CopDAG) -> str:
     if dag.topn:
         parts.append(repr(dag.topn.items))
     return "|".join(parts)
+
+
+def _subst_proj_cols(e: PlanExpr, projections: list[PlanExpr]) -> PlanExpr:
+    """Rewrite Col refs (projection-output indices) to the projected exprs."""
+    if isinstance(e, Col):
+        return projections[e.idx]
+    if isinstance(e, Call):
+        return Call(e.op, [_subst_proj_cols(a, projections) for a in e.args],
+                    e.ftype, e.extra)
+    return e
 
 
 def _like_to_regex(pattern: str) -> str:

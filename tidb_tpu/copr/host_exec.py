@@ -91,8 +91,13 @@ class _HostEval(NumpyEval):
 
     # ---- TopN --------------------------------------------------------------
     def _topn(self, mask: np.ndarray) -> list[Chunk]:
+        from .client import _subst_proj_cols
+
         keys = []
         for e, desc in reversed(self.dag.topn.items):  # lexsort: last primary
+            if self.dag.projections is not None:
+                # sort items index the projection's output schema
+                e = _subst_proj_cols(e, self.dag.projections)
             v, vl = self.eval(e)
             if e.ftype.is_string:
                 d = self.dicts[e.idx] if isinstance(e, Col) else None
@@ -210,23 +215,5 @@ class _HostEval(NumpyEval):
                                   None if (cnt > 0).all() else cnt > 0))
             columns.append(Column(
                 FieldType(TypeKind.BIGINT, nullable=False), cnt))
-        # distinct counting host-side
-        for ai, d in enumerate(agg.aggs):
-            if d.distinct and d.func == "count":
-                av, avl = self.eval(d.arg)
-                av = np.asarray(av)[idx]
-                avl = np.asarray(avl)[idx]
-                distinct_cnt = np.zeros(n_seg, dtype=np.int64)
-                enc = np.where(avl, av.astype(np.int64),
-                               np.iinfo(np.int64).min)
-                pairs = np.stack([inv, enc], axis=1)[avl]
-                if len(pairs):
-                    upairs = np.unique(pairs, axis=0)
-                    segs, c = np.unique(upairs[:, 0], return_counts=True)
-                    distinct_cnt[segs] = c
-                vi = ngroups_cols + 2 * ai
-                columns[vi] = Column(columns[vi].ftype, distinct_cnt)
-                columns[vi + 1] = Column(
-                    FieldType(TypeKind.BIGINT, nullable=False), distinct_cnt)
         return [Chunk(columns)]
 

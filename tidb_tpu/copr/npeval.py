@@ -290,12 +290,24 @@ class NumpyEval:
         return fn(av2, bv2) & valid, valid
 
     def _string_operands(self, a, av, b, bv, op):
+        # code-space equality is only valid within ONE dictionary; any
+        # cross-dictionary compare must go through the string domain
+        same_dict = (
+            isinstance(a, Col) and isinstance(b, Col)
+            and a.ftype.is_string and b.ftype.is_string
+            and self.dicts[a.idx] is self.dicts[b.idx]
+        )
+        col_vs_const = (
+            (isinstance(a, Col) and isinstance(b, Const))
+            or (isinstance(b, Col) and isinstance(a, Const))
+        )
+
         def decode(e, v):
             if isinstance(e, Col) and e.ftype.is_string:
                 d = self.dicts[e.idx]
                 assert d is not None
-                if op in ("eq", "ne"):
-                    return v  # codes compare fine for equality
+                if op in ("eq", "ne") and (same_dict or col_vs_const):
+                    return v  # codes compare fine within one dictionary
                 vals = np.array(d.values + [""], dtype=object)
                 return vals[np.clip(v, 0, len(d))]
             if isinstance(e, Const) and e.ftype.is_string:

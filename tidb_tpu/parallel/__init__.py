@@ -1,0 +1,3 @@
+from .dist import DistCopClient, make_mesh
+
+__all__ = ["DistCopClient", "make_mesh"]

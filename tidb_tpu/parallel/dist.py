@@ -1,0 +1,85 @@
+"""Distributed coprocessor execution over a TPU mesh.
+
+The multi-chip tier of the design (SURVEY.md §7 step 10): where the
+reference fans coprocessor tasks out to TiKV regions over gRPC and runs MPP
+exchanges between TiFlash nodes (reference: store/tikv/coprocessor.go:248
+buildCopTasks; store/tikv/mpp.go:372 DispatchMPPTasks; exchange operators
+from planner/core/fragment.go), the TPU framework shards the column epoch
+across devices and lets XLA collectives do the exchange:
+
+* scan fan-out (P1)  -> rows axis sharding of the padded column arrays
+* partial aggregation (P2 partial stage) -> per-shard dense segment_sum
+* final merge (P2 final / P9 exchange)   -> psum over the mesh axis (ICI)
+
+The partial layout is identical to the single-chip path, so the host final
+stage is unchanged — it just receives partials that were already reduced
+across devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..copr.client import CopClient
+
+AXIS = "shard"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D data mesh over the given (or all) devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (AXIS,))
+
+
+class DistCopClient(CopClient):
+    """CopClient whose aggregation kernels run sharded over a device mesh.
+
+    Row batches are padded to shape buckets (multiples of 256, so any
+    power-of-two mesh divides them); each device reduces its row shard into
+    the full dense segment space, then a psum over the mesh axis yields the
+    global partials on every device. Inputs are placed with row-sharded
+    NamedShardings so jit consumes them without host round-trips.
+    """
+
+    def __init__(self, mesh: Mesh) -> None:
+        super().__init__()
+        self.mesh = mesh
+        self._n = mesh.devices.size
+
+    def _build_agg_kernel(self, dag, prepared, cards, segments):
+        body = self._agg_kernel_body(dag, prepared, cards, segments)
+
+        def sharded(cols, row_mask):
+            out = body(cols, row_mask)
+            return jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
+
+        mapped = jax.shard_map(
+            sharded,
+            mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS)),
+            out_specs=P(),
+        )
+        return jax.jit(mapped)
+
+    def _bucket_size(self, n: int) -> int:
+        """Round the shape bucket up to a multiple of the mesh size so the
+        rows axis always shards evenly (any device count, not just 2^k)."""
+        b = super()._bucket_size(n)
+        lcm = int(np.lcm(256, self._n))
+        return -(-b // lcm) * lcm
+
+    def _stage_inputs(self, dag, snap, overlay: bool):
+        cols, row_mask, host_cols = super()._stage_inputs(dag, snap, overlay)
+        n = row_mask.shape[0]
+        assert n % self._n == 0, f"bucket {n} vs mesh {self._n}"
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        cols = [
+            (jax.device_put(d, sharding), jax.device_put(v, sharding))
+            for d, v in cols
+        ]
+        row_mask = jax.device_put(row_mask, sharding)
+        return cols, row_mask, host_cols

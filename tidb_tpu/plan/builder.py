@@ -425,6 +425,14 @@ class PlanBuilder:
                     out = bool_call("or", [out, self._resolve_cmp("eq", arg, it)])
             else:
                 consts = [self._coerce_const(c, arg.ftype) for c in items]
+                if arg.ftype.is_decimal:
+                    # values whose scale exceeds the column's can never
+                    # equal a stored value — drop them (exact semantics)
+                    consts = [
+                        c for c in consts
+                        if not (c.ftype.is_decimal
+                                and c.ftype.scale > arg.ftype.scale)
+                    ]  # empty list => never matches (both evaluators)
                 out = bool_call("in_values", [arg],
                                 extra=[c.value for c in consts])
             return bool_call("not", [out]) if node.negated else out
@@ -505,7 +513,15 @@ class PlanBuilder:
         if target.is_decimal and c.ftype.is_integer:
             return Const(int(c.value) * target.decimal_multiplier, target)
         if target.is_decimal and c.ftype.is_decimal:
-            return c  # scales aligned at kernel compile
+            if c.ftype.scale <= target.scale:
+                # exact widening into the column's scale (required for
+                # IN-lists, which compare raw unscaled values)
+                mult = 10 ** (target.scale - c.ftype.scale)
+                return Const(int(c.value) * mult, target)
+            div = 10 ** (c.ftype.scale - target.scale)
+            if int(c.value) % div == 0:
+                return Const(int(c.value) // div, target)  # e.g. 3.250 @ s2
+            return c  # not representable at the column scale
         if target.is_float and (c.ftype.is_integer or c.ftype.is_decimal):
             v = c.value
             if c.ftype.is_decimal:

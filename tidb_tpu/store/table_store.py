@@ -212,6 +212,65 @@ class TableStore:
             overlay_valids=ov_valids,
         )
 
+    # ---- bulk load ----------------------------------------------------------
+    def bulk_load(
+        self,
+        columns: list[np.ndarray],
+        valids: Optional[list[Optional[np.ndarray]]] = None,
+        commit_ts: int = 0,
+    ) -> None:
+        """Append pre-encoded column arrays directly into a new base epoch.
+
+        The loader path of cmd/importer (reference: cmd/importer) — bypasses
+        the transaction layer; intended for benchmarks and dataset loads.
+        Physical encodings must match the table's column types (dictionary
+        codes for strings, scaled ints for decimals, day numbers for dates).
+        """
+        if len(columns) != self.table.num_columns:
+            raise ValueError(
+                f"bulk_load: {len(columns)} columns for "
+                f"{self.table.num_columns}-column table")
+        n = len(columns[0]) if columns else 0
+        for ci, c in enumerate(columns):
+            if len(c) != n:
+                raise ValueError(
+                    f"bulk_load: column {ci} has {len(c)} rows, expected {n}")
+        if valids is not None:
+            for ci, v in enumerate(valids):
+                if v is not None and len(v) != n:
+                    raise ValueError(
+                        f"bulk_load: valids[{ci}] has {len(v)} rows, "
+                        f"expected {n}")
+        with self._lock:
+            epoch = self.epoch
+            handles = np.arange(self._next_handle, self._next_handle + n,
+                                dtype=np.int64)
+            self._next_handle += n
+            new_cols = []
+            new_valids: list[Optional[np.ndarray]] = []
+            for ci in range(self.table.num_columns):
+                dt = self.table.columns[ci].ftype.np_dtype
+                new_cols.append(np.concatenate(
+                    [epoch.columns[ci], columns[ci].astype(dt)]))
+                add_v = valids[ci] if valids is not None else None
+                old_v = epoch.valids[ci]
+                if old_v is None and add_v is None:
+                    new_valids.append(None)
+                else:
+                    ov = old_v if old_v is not None else np.ones(
+                        epoch.num_rows, bool)
+                    av = add_v if add_v is not None else np.ones(n, bool)
+                    new_valids.append(np.concatenate([ov, av]))
+            all_handles = np.concatenate([epoch.handles, handles])
+            self.epoch = ColumnEpoch(
+                epoch_id=next(_epoch_ids),
+                fold_ts=max(epoch.fold_ts, commit_ts),
+                handles=all_handles,
+                columns=new_cols,
+                valids=new_valids,
+                handle_pos={int(h): i for i, h in enumerate(all_handles)},
+            )
+
     # ---- compaction --------------------------------------------------------
     def maybe_compact(self, safe_ts: int) -> None:
         if len(self.deltas) >= self.COMPACT_THRESHOLD:
