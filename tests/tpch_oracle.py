@@ -1,0 +1,140 @@
+"""SQLite differential oracle for the TPC-H suite.
+
+The reference validates plans/results against a golden TPC-H corpus
+(reference: cmd/explaintest/t/tpch.test, r/tpch.result). We go one better:
+load the *same generated rows* into sqlite3 (stdlib) and compare actual
+query results, value by value, after normalization. Decimals become floats
+in sqlite, so numeric cells compare under tolerance; dates normalize to
+ISO strings.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+import sqlite3
+from typing import Any
+
+import numpy as np
+
+
+def load_sqlite(data: dict[str, dict[str, Any]],
+                schemas: dict[str, str]) -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    for table, cols in data.items():
+        decoded = {}
+        for name, v in cols.items():
+            if isinstance(v, tuple):
+                vocab, codes = v
+                arr = np.array(vocab, dtype=object)[codes]
+            else:
+                arr = np.asarray(v)
+            decoded[name] = arr
+        names = list(decoded)
+        ddl_cols = _sqlite_cols(schemas[table], names)
+        conn.execute(f"create table {table} ({', '.join(ddl_cols)})")
+        rows = _to_python_rows(table, names, decoded, schemas[table])
+        ph = ", ".join("?" * len(names))
+        conn.executemany(f"insert into {table} values ({ph})", rows)
+    conn.commit()
+    return conn
+
+
+def _sqlite_cols(ddl: str, names: list[str]) -> list[str]:
+    out = []
+    for n in names:
+        m = re.search(rf"\b{n}\s+(\w+)", ddl)
+        t = m.group(1).lower() if m else "text"
+        if t in ("bigint", "int", "integer"):
+            out.append(f"{n} integer")
+        elif t == "decimal":
+            out.append(f"{n} real")
+        else:
+            out.append(f"{n} text")
+    return out
+
+
+def _to_python_rows(table: str, names: list[str],
+                    decoded: dict[str, np.ndarray], ddl: str):
+    cols = []
+    for n in names:
+        arr = decoded[n]
+        m = re.search(rf"\b{n}\s+(\w+)", ddl)
+        t = m.group(1).lower() if m else "text"
+        if t == "decimal":
+            cols.append([v / 100.0 for v in arr.tolist()])
+        elif t == "date":
+            epoch = _dt.date(1970, 1, 1)  # matches types.value.encode_date
+            cols.append([str(epoch + _dt.timedelta(days=int(v)))
+                         for v in arr.tolist()])
+        else:
+            cols.append(arr.tolist())
+    return list(zip(*cols))
+
+
+def to_sqlite_sql(sql: str) -> str:
+    """Rewrite our MySQL-flavored TPC-H text into sqlite dialect."""
+    s = sql
+    s = re.sub(
+        r"date\s+'([0-9-]+)'\s*([+-])\s*interval\s+'(\d+)'\s+(\w+)",
+        lambda m: f"date('{m.group(1)}', '{m.group(2)}{m.group(3)} "
+                  f"{m.group(4)}')",
+        s, flags=re.IGNORECASE)
+    s = re.sub(r"date\s+'([0-9-]+)'", r"'\1'", s, flags=re.IGNORECASE)
+    s = re.sub(r"extract\s*\(\s*year\s+from\s+([a-z0-9_.]+)\s*\)",
+               r"cast(strftime('%Y', \1) as integer)", s,
+               flags=re.IGNORECASE)
+    s = re.sub(r"substring\s*\(\s*([a-z0-9_.]+)\s+from\s+(\d+)\s+for"
+               r"\s+(\d+)\s*\)",
+               r"substr(\1, \2, \3)", s, flags=re.IGNORECASE)
+    return s
+
+
+def normalize_cell(v: Any) -> Any:
+    if v is None:
+        return None
+    if isinstance(v, _dt.date):
+        return v.isoformat()
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, np.integer)):
+        return float(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    s = str(v)
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+def rows_equal(got: list[tuple], want: list[tuple],
+               ordered: bool, tol: float = 5e-5) -> tuple[bool, str]:
+    g = [tuple(normalize_cell(c) for c in r) for r in got]
+    w = [tuple(normalize_cell(c) for c in r) for r in want]
+    if len(g) != len(w):
+        return False, f"row count {len(g)} != {len(w)}"
+    if not ordered:
+        g = sorted(g, key=_sort_key)
+        w = sorted(w, key=_sort_key)
+    for i, (gr, wr) in enumerate(zip(g, w)):
+        if len(gr) != len(wr):
+            return False, f"row {i} arity {len(gr)} != {len(wr)}"
+        for j, (a, b) in enumerate(zip(gr, wr)):
+            if not _cell_eq(a, b, tol):
+                return False, (f"row {i} col {j}: {a!r} != {b!r}\n"
+                               f" got row: {gr}\nwant row: {wr}")
+    return True, ""
+
+
+def _cell_eq(a: Any, b: Any, tol: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        return abs(a - b) <= max(tol, tol * max(abs(a), abs(b)))
+    return a == b
+
+
+def _sort_key(row: tuple) -> tuple:
+    return tuple((0, v) if isinstance(v, float) else (1, str(v))
+                 for v in row)

@@ -403,9 +403,14 @@ class CopClient:
     def _build_agg_kernel(self, dag, prepared, cards, segments):
         return jax.jit(self._agg_kernel_body(dag, prepared, cards, segments))
 
-    def _agg_kernel_body(self, dag, prepared, cards, segments):
+    def _agg_kernel_body(self, dag, prepared, cards, segments,
+                         keep_sentinels: bool = False):
         """Pure (cols, row_mask) -> {partials} function; the distributed
-        client wraps it in shard_map + psum (see parallel/dist.py)."""
+        client wraps it in shard_map + per-function collectives (psum for
+        sums/counts, pmin/pmax for min/max — see parallel/dist.py).
+        keep_sentinels leaves +-inf/INT_MIN/MAX in empty min/max segments so
+        a cross-device pmin/pmax merge stays correct; the merger zeroes them
+        after reducing."""
         agg = dag.agg
         sel = dag.selection
 
@@ -445,7 +450,8 @@ class CopClient:
                         v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
                         else jnp.int64), sentinel)
                     val = jax.ops.segment_min(vv, seg, segments)
-                    val = jnp.where(cnt > 0, val, 0)
+                    if not keep_sentinels:
+                        val = jnp.where(cnt > 0, val, 0)
                 elif d.func == "max":
                     sentinel = -jnp.inf if jnp.issubdtype(
                         v.dtype, jnp.floating) else _INT_MIN
@@ -453,7 +459,8 @@ class CopClient:
                         v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
                         else jnp.int64), sentinel)
                     val = jax.ops.segment_max(vv, seg, segments)
-                    val = jnp.where(cnt > 0, val, 0)
+                    if not keep_sentinels:
+                        val = jnp.where(cnt > 0, val, 0)
                 else:
                     raise CompileError(f"agg {d.func} not on device")
                 out[f"val{ai}"] = val

@@ -93,6 +93,13 @@ class NumpyEval:
                 out_vl = np.where(take, tvl, out_vl)
                 decided |= take
             return out_v, out_vl
+        if op == "substring":
+            av, avl = self.eval_str(A[0])
+            start, length = e.extra
+            out = np.empty(self.n, dtype=object)
+            for i, s in enumerate(av):
+                out[i] = _substring(s, start, length)
+            return out, avl
         raise NotImplementedError(f"string eval: {op}")
 
     # ---- evaluation ---------------------------------------------------------
@@ -142,25 +149,37 @@ class NumpyEval:
 
         if op == "in_values":
             arg = A[0]
-            av, avl = self.eval(arg)
             if arg.ftype.is_string and isinstance(arg, Col):
+                av, avl = self.eval(arg)
                 d = self.dicts[arg.idx]
                 assert d is not None
                 codes = [d.lookup(str(v)) for v in e.extra]
                 hit = np.isin(av, [c for c in codes if c >= 0])
+            elif arg.ftype.is_string:
+                # computed string (e.g. substring): string-domain membership
+                sv, svl = self.eval_str(arg)
+                hit = np.isin(sv, np.array([str(v) for v in e.extra],
+                                           dtype=object))
+                return hit & svl, svl
             else:
+                av, avl = self.eval(arg)
                 vals = e.extra
                 hit = np.isin(av, np.array(vals))
             return hit & avl, avl
         if op == "like":
+            import re
+
+            from .client import _like_to_regex
             arg = A[0]
+            rx = re.compile(_like_to_regex(str(e.extra)), re.DOTALL)
+            if not isinstance(arg, Col):
+                sv, svl = self.eval_str(arg)
+                hit = np.fromiter((rx.fullmatch(s) is not None for s in sv),
+                                  bool, count=self.n)
+                return hit & svl, svl
             av, avl = self.eval(arg)
-            assert isinstance(arg, Col)
             d = self.dicts[arg.idx]
             assert d is not None
-            import re
-            from .client import _like_to_regex
-            rx = re.compile(_like_to_regex(str(e.extra)), re.DOTALL)
             if len(d):
                 table = np.fromiter((rx.fullmatch(s) is not None
                                      for s in d.values), bool, count=len(d))
@@ -355,6 +374,24 @@ def _truthy(v: np.ndarray) -> np.ndarray:
     if v.dtype != np.bool_:
         return v != 0
     return v
+
+
+def _substring(s: str, start: int, length: Optional[int]) -> str:
+    """MySQL SUBSTRING: 1-based; negative start counts from the end;
+    start=0 yields ''. (reference: expression/builtin_string.go substring)"""
+    if start == 0:
+        return ""
+    if start > 0:
+        i = start - 1
+    else:
+        i = len(s) + start
+        if i < 0:
+            return ""
+    if length is None:
+        return s[i:]
+    if length <= 0:
+        return ""
+    return s[i:i + length]
 
 
 def _b(vv: VV) -> VV:

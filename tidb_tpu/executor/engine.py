@@ -23,7 +23,7 @@ from ..chunk.chunk import Chunk
 from ..chunk.column import Column, Dictionary
 from ..copr.client import CopClient
 from ..copr.npeval import NumpyEval, _truthy
-from ..plan.expr import AggDesc, Col, PlanExpr
+from ..plan.expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from ..plan.physical import (
     PhysHashAgg,
     PhysHashJoin,
@@ -46,6 +46,45 @@ class ExecContext:
     txn: Transaction
     cop: CopClient
 
+    def __post_init__(self) -> None:
+        self._subq_cache: dict[int, Const] = {}
+
+
+def _subst_subq(e: PlanExpr, ctx: ExecContext) -> PlanExpr:
+    """Replace uncorrelated ScalarSubq nodes with materialized Consts.
+
+    The subquery plan runs once per statement (reference evaluates
+    uncorrelated scalar subqueries eagerly at rewrite time,
+    planner/core/expression_rewriter.go)."""
+    if isinstance(e, ScalarSubq):
+        cached = ctx._subq_cache.get(id(e))
+        if cached is not None:
+            return cached
+        chunk = run_physical(e.phys, ctx)
+        if chunk.num_rows == 0 or not chunk.columns:
+            const = Const(None, e.ftype)
+        else:
+            if chunk.num_rows > 1:
+                raise ValueError("scalar subquery returned more than one row")
+            col = chunk.columns[0]
+            if not col.validity[0]:
+                const = Const(None, e.ftype)
+            elif col.dictionary is not None:
+                const = Const(col.dictionary.decode(int(col.data[0])),
+                              e.ftype)
+            else:
+                v = col.data[0]
+                const = Const(float(v) if col.ftype.is_float else int(v),
+                              e.ftype)
+        ctx._subq_cache[id(e)] = const
+        return const
+    if isinstance(e, Call):
+        new_args = [_subst_subq(a, ctx) for a in e.args]
+        if all(n is o for n, o in zip(new_args, e.args)):
+            return e
+        return Call(e.op, new_args, e.ftype, e.extra)
+    return e
+
 
 def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
     if isinstance(plan, PhysTableRead):
@@ -61,7 +100,7 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         ev = _evaluator(child)
         mask = np.ones(child.num_rows, dtype=bool)
         for c in plan.conditions:
-            v, vl = ev.eval(c)
+            v, vl = ev.eval(_subst_subq(c, ctx))
             mask &= _truthy(np.asarray(v)) & vl
         return child.take(np.nonzero(mask)[0])
     if isinstance(plan, PhysProjection):
@@ -71,7 +110,7 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
             ev.n = 1  # dual: constants evaluate to a single row
         cols = []
         for e, f in zip(plan.exprs, plan.schema.fields):
-            from ..plan.expr import Const
+            e = _subst_subq(e, ctx)
             if f.ftype.is_string and not isinstance(e, Col):
                 # computed strings cross dictionary domains: evaluate in the
                 # string domain, re-encode into a fresh dictionary
@@ -99,7 +138,8 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         return _run_agg(plan, ctx)
     if isinstance(plan, PhysSort):
         child = run_physical(plan.children[0], ctx)
-        order = _sort_order(child, plan.items)
+        items = [(_subst_subq(e, ctx), d) for e, d in plan.items]
+        order = _sort_order(child, items)
         return child.take(order)
     if isinstance(plan, PhysLimit):
         child = run_physical(plan.children[0], ctx)
@@ -128,9 +168,14 @@ def _evaluator(chunk: Chunk) -> NumpyEval:
 
 def _run_agg(plan: PhysHashAgg, ctx: ExecContext) -> Chunk:
     child = run_physical(plan.children[0], ctx)
-    ngroups = len(plan.group_by)
     if plan.mode == "final":
         return _merge_partials(plan, child)
+    plan = PhysHashAgg(
+        plan.mode,
+        [_subst_subq(g, ctx) for g in plan.group_by],
+        [AggDesc(d.func, None if d.arg is None else _subst_subq(d.arg, ctx),
+                 d.ftype, d.distinct, d.name) for d in plan.aggs],
+        plan.schema, plan.children)
     return _complete_agg(plan, child)
 
 
@@ -269,9 +314,23 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
     ev = _evaluator(child)
     n = child.num_rows
     key_vv = []
+    key_dicts: list[Optional[Dictionary]] = []
     for g in plan.group_by:
-        v, vl = ev.eval(g)
-        key_vv.append((np.asarray(v), np.asarray(vl)))
+        if g.ftype.is_string and not isinstance(g, Col):
+            # computed string key (e.g. substring): group on fresh codes
+            sv, svl = ev.eval_str(g)
+            d = Dictionary()
+            codes = np.fromiter(
+                (d.encode(s) if ok else 0 for s, ok in zip(sv, svl)),
+                np.int64, count=n)
+            key_vv.append((codes, np.asarray(svl)))
+            key_dicts.append(d)
+        else:
+            v, vl = ev.eval(g)
+            key_vv.append((np.asarray(v), np.asarray(vl)))
+            key_dicts.append(child.columns[g.idx].dictionary
+                             if g.ftype.is_string and isinstance(g, Col)
+                             else None)
     inv, first = _group_ids(key_vv, n)
     n_seg = len(first) if n else 0
     order = np.argsort(inv[:n], kind="stable") if n else np.empty(0, np.int64)
@@ -285,9 +344,7 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
         v, vl = key_vv[gi]
         f = plan.schema.fields[gi]
         gidx = order[bounds] if n else np.empty(0, np.int64)
-        dictionary = None
-        if f.ftype.is_string and isinstance(g, Col):
-            dictionary = child.columns[g.idx].dictionary
+        dictionary = key_dicts[gi]
         data = v[gidx]
         valid = vl[gidx]
         out_cols.append(Column(f.ftype, data.astype(f.ftype.np_dtype),
@@ -427,7 +484,15 @@ def _run_join(plan: PhysHashJoin, ctx: ExecContext) -> Chunk:
     right = run_physical(plan.children[1], ctx)
     nleft = len(left.columns)
 
-    if plan.kind == "CROSS" and not plan.eq_conditions:
+    if plan.kind == "ANTI_NULL":
+        # null-aware NOT IN semantics (reference: planner NAAJ):
+        # any NULL in the subquery side means no outer row qualifies;
+        # outer rows with a NULL key never qualify.
+        ri_idx = plan.eq_conditions[0][1]
+        if right.num_rows and not right.columns[ri_idx].validity.all():
+            return left.take(np.empty(0, np.int64))
+
+    if not plan.eq_conditions:
         li = np.repeat(np.arange(left.num_rows), right.num_rows)
         ri = np.tile(np.arange(right.num_rows), left.num_rows)
     else:
@@ -439,10 +504,21 @@ def _run_join(plan: PhysHashJoin, ctx: ExecContext) -> Chunk:
         ev = _evaluator(joined)
         mask = np.ones(len(li), dtype=bool)
         for c in plan.other_conditions:
-            v, vl = ev.eval(c)
+            v, vl = ev.eval(_subst_subq(c, ctx))
             mask &= _truthy(np.asarray(v)) & vl
         li, ri = li[mask], ri[mask]
 
+    if plan.kind == "SEMI":
+        return left.take(np.unique(li))
+    if plan.kind in ("ANTI", "ANTI_NULL"):
+        keep = np.ones(left.num_rows, dtype=bool)
+        keep[li] = False
+        if plan.kind == "ANTI_NULL" and right.num_rows:
+            # NULL lhs vs a non-empty set is UNKNOWN -> filtered;
+            # NOT IN (empty set) is TRUE even for a NULL lhs
+            li_idx = plan.eq_conditions[0][0]
+            keep &= left.columns[li_idx].validity
+        return left.take(np.nonzero(keep)[0])
     if plan.kind == "LEFT":
         matched = np.zeros(left.num_rows, dtype=bool)
         matched[li] = True

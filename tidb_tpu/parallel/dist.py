@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -51,11 +52,30 @@ class DistCopClient(CopClient):
         self._n = mesh.devices.size
 
     def _build_agg_kernel(self, dag, prepared, cards, segments):
-        body = self._agg_kernel_body(dag, prepared, cards, segments)
+        body = self._agg_kernel_body(dag, prepared, cards, segments,
+                                     keep_sentinels=True)
+        aggs = dag.agg.aggs
 
         def sharded(cols, row_mask):
             out = body(cols, row_mask)
-            return jax.tree.map(lambda x: jax.lax.psum(x, AXIS), out)
+            # per-function merge: sums/counts are additive; min/max need
+            # pmin/pmax over the sentinel-preserving partials, then empty
+            # segments are zeroed exactly like the single-chip kernel
+            merged = {"rows": jax.lax.psum(out["rows"], AXIS)}
+            for ai, d in enumerate(aggs):
+                cnt = jax.lax.psum(out[f"cnt{ai}"], AXIS)
+                val = out[f"val{ai}"]
+                if d.arg is not None and d.func == "min":
+                    val = jax.lax.pmin(val, AXIS)
+                    val = jnp.where(cnt > 0, val, 0)
+                elif d.arg is not None and d.func == "max":
+                    val = jax.lax.pmax(val, AXIS)
+                    val = jnp.where(cnt > 0, val, 0)
+                else:
+                    val = jax.lax.psum(val, AXIS)
+                merged[f"val{ai}"] = val
+                merged[f"cnt{ai}"] = cnt
+            return merged
 
         mapped = jax.shard_map(
             sharded,

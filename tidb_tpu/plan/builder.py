@@ -27,6 +27,7 @@ from .expr import (
     Const,
     ExprError,
     PlanExpr,
+    ScalarSubq,
     agg_result_type,
     arith_result_type,
     bool_call,
@@ -77,8 +78,17 @@ class PlanBuilder:
             plan = self.build_table_refs(stmt.from_)
 
         if stmt.where is not None:
-            conds = self._split_conjuncts(self.resolve(stmt.where, plan.schema))
-            plan = LogicalSelection(conds, plan.schema, [plan])
+            plain, with_subq = [], []
+            for c in _ast_conjuncts(stmt.where):
+                (with_subq if _contains_subquery(c) else plain).append(c)
+            conds: list[PlanExpr] = []
+            for c in plain:
+                conds.extend(self._split_conjuncts(
+                    self.resolve(c, plan.schema)))
+            if conds:
+                plan = LogicalSelection(conds, plan.schema, [plan])
+            for c in with_subq:
+                plan = self._apply_subquery_conjunct(c, plan)
 
         has_agg = bool(stmt.group_by) or any(
             f.expr is not None and _contains_agg(f.expr) for f in stmt.fields
@@ -157,6 +167,231 @@ class PlanBuilder:
             kind = "CROSS"
         return LogicalJoin(kind, eq, others, merged, [left, right])
 
+    # ---- subqueries --------------------------------------------------------
+    #
+    # The reference rewrites subqueries during logical planning
+    # (planner/core/expression_rewriter.go + rule_decorrelate.go). We keep
+    # the same playbook, specialized to the decision-support shapes:
+    #   EXISTS / NOT EXISTS  -> SEMI / ANTI hash join (correlation becomes
+    #                           join keys; non-equality correlation becomes
+    #                           residual join conditions)
+    #   x IN (sub)           -> SEMI join;  x NOT IN (sub) -> null-aware ANTI
+    #   col CMP (corr. agg)  -> group the subquery by its correlation keys,
+    #                           INNER join on them, filter CMP (Q2/Q17/Q20)
+    #   uncorrelated scalar  -> ScalarSubq, materialized once at execution
+
+    def _apply_subquery_conjunct(
+        self, c: ast.Expr, plan: LogicalPlan
+    ) -> LogicalPlan:
+        neg = False
+        node = c
+        while isinstance(node, ast.UnaryOp) and node.op == "NOT":
+            neg = not neg
+            node = node.operand
+        if isinstance(node, ast.SubqueryExpr) and node.exists:
+            return self._build_exists(node.query, plan,
+                                      anti=neg != node.negated)
+        if isinstance(node, ast.InSubquery):
+            return self._build_in_subquery(node, plan, negate=neg)
+        if isinstance(node, ast.BinaryOp) and node.op in (
+                "=", "<>", "!=", "<", "<=", ">", ">="):
+            for lhs, sub, flip in ((node.left, node.right, False),
+                                   (node.right, node.left, True)):
+                if isinstance(sub, ast.SubqueryExpr) and not sub.exists \
+                        and not _contains_subquery(lhs):
+                    op = _flip_cmp(node.op) if flip else node.op
+                    out = self._build_scalar_cmp(lhs, op, sub.query, plan)
+                    if neg:
+                        # NOT (a CMP b): wrap the appended selection
+                        sel = out
+                        assert isinstance(sel, LogicalSelection)
+                        sel.conditions = [
+                            bool_call("not", [_coerce_bool(x)])
+                            for x in sel.conditions]
+                    return out
+        # fallback: resolve in place (uncorrelated subqueries become
+        # ScalarSubq consts; correlated ones raise)
+        conds = self._split_conjuncts(self.resolve(c, plan.schema))
+        return LogicalSelection(conds, plan.schema, [plan])
+
+    def _build_sub_source(
+        self, sub: ast.SelectStmt, outer: PlanSchema
+    ) -> tuple[LogicalPlan, list[tuple[int, int]], list[PlanExpr]]:
+        """Build sub's FROM + WHERE with correlation split out.
+
+        Returns (sub plan, eq pairs (outer_idx, sub_idx), residual
+        conditions over the concatenated outer++sub schema)."""
+        if sub.from_ is None:
+            raise PlanError("correlated subquery needs a FROM clause")
+        splan = self.build_table_refs(sub.from_)
+        local: list[PlanExpr] = []
+        eq_pairs: list[tuple[int, int]] = []
+        residual: list[PlanExpr] = []
+        nouter = len(outer)
+
+        def r_scoped(node: ast.Expr) -> PlanExpr:
+            # SQL scoping: the subquery's own tables shadow outer tables;
+            # indices land in the concatenated outer++sub space
+            if isinstance(node, ast.ColumnRef):
+                idx = splan.schema.resolve(node.name, node.table)
+                if idx is not None:
+                    return Col(nouter + idx, splan.schema.fields[idx].ftype,
+                               str(node))
+                idx = outer.resolve(node.name, node.table)
+                if idx is None:
+                    raise PlanError(f"unknown column {node}")
+                return Col(idx, outer.fields[idx].ftype, str(node))
+            return self._resolve_composite(node, r_scoped)
+
+        if sub.where is not None:
+            for conj in _ast_conjuncts(sub.where):
+                if _contains_subquery(conj):
+                    # nested subquery inside a correlated one: only the
+                    # uncorrelated form is supported (resolved in place)
+                    splan = self._apply_subquery_conjunct(conj, splan)
+                    continue
+                try:
+                    local.extend(self._split_conjuncts(
+                        self.resolve(conj, splan.schema)))
+                    continue
+                except PlanError:
+                    pass
+                e = r_scoped(conj)  # raises if truly unknown
+                pair = _as_equi_pair(e, nouter)
+                if pair is not None:
+                    eq_pairs.append(pair)
+                else:
+                    residual.append(e)
+        if local:
+            splan = LogicalSelection(local, splan.schema, [splan])
+        return splan, eq_pairs, residual
+
+    def _build_exists(
+        self, sub: ast.SelectStmt, plan: LogicalPlan, anti: bool
+    ) -> LogicalPlan:
+        # EXISTS truth depends only on row existence in FROM+WHERE; forms
+        # where that is not true (aggregates always yield a row, LIMIT /
+        # HAVING change the row set) are rejected loudly
+        if sub.group_by or sub.having or sub.limit is not None or any(
+                f.expr is not None and _contains_agg(f.expr)
+                for f in sub.fields):
+            raise PlanError("EXISTS subquery with aggregation/HAVING/LIMIT "
+                            "is not supported")
+        splan, eq_pairs, residual = self._build_sub_source(sub, plan.schema)
+        # remap residuals: outer indices stay, sub indices shift to
+        # len(plan.schema) .. (they were resolved over outer++sub already)
+        kind = "ANTI" if anti else "SEMI"
+        return LogicalJoin(kind, eq_pairs, residual, plan.schema,
+                           [plan, splan])
+
+    def _build_in_subquery(
+        self, node: ast.InSubquery, plan: LogicalPlan, negate: bool
+    ) -> LogicalPlan:
+        lhs = self.resolve(node.operand, plan.schema)
+        if not isinstance(lhs, Col):
+            raise PlanError("IN (subquery) requires a column operand")
+        sub = self.build_select(node.query)
+        if len(sub.schema) != 1:
+            raise PlanError("IN subquery must return exactly one column")
+        anti = negate != node.negated
+        kind = "ANTI_NULL" if anti else "SEMI"
+        return LogicalJoin(kind, [(lhs.idx, 0)], [], plan.schema,
+                           [plan, sub])
+
+    def _build_scalar_cmp(
+        self, lhs_ast: ast.Expr, op: str, sub: ast.SelectStmt,
+        plan: LogicalPlan
+    ) -> LogicalPlan:
+        """col CMP (SELECT agg ... WHERE inner.k = outer.k ...) — the
+        correlated-aggregate pattern (Q2/Q17/Q20)."""
+        try:
+            # uncorrelated scalar subquery: plain selection w/ ScalarSubq
+            cond = self.resolve(
+                ast.BinaryOp(op, lhs_ast, ast.SubqueryExpr(sub)), plan.schema)
+            return LogicalSelection(self._split_conjuncts(cond), plan.schema,
+                                    [plan])
+        except PlanError:
+            pass
+        splan, eq_pairs, residual = self._build_sub_source(sub, plan.schema)
+        if residual:
+            raise PlanError(
+                "correlated scalar subquery supports only equality "
+                "correlation")
+        if not eq_pairs:
+            raise PlanError("correlated scalar subquery: no correlation "
+                            "keys found")
+        if len(sub.fields) != 1 or sub.fields[0].expr is None:
+            raise PlanError("scalar subquery must select exactly one "
+                            "expression")
+        if sub.group_by or sub.having or sub.order_by or sub.limit:
+            raise PlanError("correlated scalar subquery must be a bare "
+                            "aggregate")
+        nouter = len(plan.schema)
+        # group the subquery by its correlation columns (sub-relative idx)
+        group_cols = [Col(s, splan.schema.fields[s].ftype)
+                      for _, s in eq_pairs]
+        field_expr = sub.fields[0].expr
+        aggs: list[AggDesc] = []
+        agg_keys: dict[str, int] = {}
+        for call in _find_aggs(field_expr):
+            key = ast_key(call)
+            if key in agg_keys:
+                continue
+            func = call.name.lower()
+            if func not in ("sum", "min", "max", "avg", "count"):
+                raise PlanError(f"unsupported aggregate {func} in "
+                                "correlated subquery")
+            arg = None if call.is_star else self.resolve(
+                call.args[0], splan.schema)
+            agg_keys[key] = len(aggs)
+            aggs.append(AggDesc(func, arg, agg_result_type(func, arg),
+                                call.distinct, name=key))
+        if not aggs:
+            raise PlanError("correlated scalar subquery must aggregate")
+        ngroup = len(group_cols)
+        agg_fields = [ResultField(f"#corr_k{i}", g.ftype, "#subq")
+                      for i, g in enumerate(group_cols)]
+        agg_fields += [ResultField(f"#corr_a{i}", d.ftype, "#subq")
+                       for i, d in enumerate(aggs)]
+        agg_plan = LogicalAggregation(
+            list(group_cols), aggs, PlanSchema(agg_fields), [splan])
+
+        # scalar-of-aggregate expression over the agg schema (e.g. 0.2*avg)
+        def r_over(e: ast.Expr) -> PlanExpr:
+            key = ast_key(e)
+            if key in agg_keys:
+                i = ngroup + agg_keys[key]
+                return Col(i, agg_plan.schema.fields[i].ftype)
+            if isinstance(e, ast.ColumnRef):
+                raise PlanError(
+                    f"column {e} not allowed in correlated scalar subquery")
+            return self._resolve_composite(e, r_over)
+
+        value = r_over(field_expr)
+        proj_fields = [ResultField(f"#corr_k{i}", g.ftype, "#subq")
+                       for i, g in enumerate(group_cols)]
+        proj_fields.append(ResultField("#corr_v", value.ftype, "#subq"))
+        proj = LogicalProjection(
+            [Col(i, g.ftype) for i, g in enumerate(group_cols)] + [value],
+            PlanSchema(proj_fields), [agg_plan])
+
+        # LEFT join outer plan to the grouped subquery on correlation keys:
+        # an outer row with no group sees NULL (scalar subquery over an
+        # empty set), except COUNT which must see 0 (hence the ifnull)
+        join_schema = PlanSchema(plan.schema.fields + proj_fields)
+        join = LogicalJoin(
+            "LEFT", [(o, i) for i, (o, _) in enumerate(eq_pairs)], [],
+            join_schema, [plan, proj])
+        lhs = self.resolve(lhs_ast, plan.schema)  # outer indices unchanged
+        vcol: PlanExpr = Col(nouter + ngroup, value.ftype, "#corr_v")
+        if isinstance(field_expr, ast.FuncCall) and \
+                field_expr.name.upper() == "COUNT":
+            vcol = Call("ifnull", [vcol, Const(0, vcol.ftype)], vcol.ftype)
+        tag = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
+               ">": "gt", ">=": "ge"}[op]
+        cond = self._resolve_cmp(tag, lhs, vcol)
+        return LogicalSelection([cond], join_schema, [join])
+
     def _build_dual(self, stmt: ast.SelectStmt) -> LogicalPlan:
         """SELECT without FROM: a one-row, zero-column pseudo scan."""
         return LogicalScan(
@@ -176,6 +411,8 @@ class PlanBuilder:
             for rf in child_schema.fields:
                 if f.wildcard_table and rf.table_alias != f.wildcard_table.lower():
                     continue
+                if rf.name.startswith("#"):
+                    continue  # hidden columns from subquery decorrelation
                 out.append((ast.ColumnRef(rf.name, table=rf.table_alias or None),
                             None))
             if not out:
@@ -456,8 +693,16 @@ class PlanBuilder:
             return _fold(Call("cast", [arg], node.target))
         if isinstance(node, ast.IntervalExpr):
             raise PlanError("INTERVAL only valid in +/- date arithmetic")
-        if isinstance(node, (ast.SubqueryExpr, ast.InSubquery)):
-            raise PlanError("subqueries are not supported yet")
+        if isinstance(node, ast.SubqueryExpr):
+            if node.exists:
+                raise PlanError("EXISTS is only valid as a WHERE condition")
+            sub = self.build_select(node.query)  # raises if correlated
+            if len(sub.schema) != 1:
+                raise PlanError("scalar subquery must return one column")
+            return ScalarSubq(sub, sub.schema.fields[0].ftype)
+        if isinstance(node, ast.InSubquery):
+            raise PlanError("IN (subquery) is only valid as a WHERE "
+                            "condition")
         raise PlanError(f"unsupported expression {type(node).__name__}")
 
     def _resolve_binary(
@@ -602,6 +847,20 @@ class PlanBuilder:
             for a in args[1:]:
                 ft = _unify_types(ft, a.ftype)
             return _fold(Call("coalesce", args, ft))
+        if name == "SUBSTRING":
+            if len(args) not in (2, 3):
+                raise PlanError("SUBSTRING expects 2 or 3 arguments")
+            if not args[0].ftype.is_string:
+                raise PlanError("SUBSTRING requires a string argument")
+            for a in args[1:]:
+                if not isinstance(a, Const):
+                    raise PlanError("SUBSTRING position/length must be "
+                                    "constant")
+            start = int(args[1].value)
+            length = int(args[2].value) if len(args) == 3 else None
+            from ..types.field_type import varchar_type
+            return Call("substring", [args[0]], varchar_type(),
+                        extra=(start, length))
         raise PlanError(f"unsupported function {name}")
 
     def _resolve_case(
@@ -739,6 +998,34 @@ def _unify_types(a: FieldType, b: FieldType) -> FieldType:
     if a.is_string and b.is_string:
         return a
     raise PlanError(f"cannot unify types {a!r} and {b!r}")
+
+
+def _ast_conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.BinaryOp) and e.op == "AND":
+        return _ast_conjuncts(e.left) + _ast_conjuncts(e.right)
+    return [e]
+
+
+def _contains_subquery(e: ast.Expr) -> bool:
+    if isinstance(e, (ast.SubqueryExpr, ast.InSubquery)):
+        return True
+    for child in vars(e).values():
+        if isinstance(child, ast.Expr) and _contains_subquery(child):
+            return True
+        if isinstance(child, (list, tuple)):
+            for item in child:
+                if isinstance(item, ast.Expr) and _contains_subquery(item):
+                    return True
+                if isinstance(item, tuple) and any(
+                        isinstance(x, ast.Expr) and _contains_subquery(x)
+                        for x in item):
+                    return True
+    return False
+
+
+def _flip_cmp(op: str) -> str:
+    return {"=": "=", "<>": "<>", "!=": "!=", "<": ">", "<=": ">=",
+            ">": "<", ">=": "<="}[op]
 
 
 def _as_equi_pair(cond: PlanExpr, nleft: int) -> Optional[tuple[int, int]]:

@@ -77,6 +77,21 @@ class Call(PlanExpr):
 
 
 @dataclass
+class ScalarSubq(PlanExpr):
+    """Uncorrelated scalar subquery. Materialized to a Const once per
+    statement before execution (counterpart of the reference's scalar
+    subquery rewrite, planner/core/expression_rewriter.go — which also
+    evaluates uncorrelated subqueries eagerly)."""
+
+    logical: Any  # LogicalPlan (typed loosely to avoid an import cycle)
+    ftype: FieldType
+    phys: Any = None  # PhysicalPlan, filled during optimize()
+
+    def __repr__(self) -> str:
+        return "scalar_subquery()"
+
+
+@dataclass
 class AggDesc:
     """One aggregate: func in {sum,count,avg,min,max}, arg expr (None for
     COUNT(*)), result type. Counterpart of expression/aggregation descriptors

@@ -19,7 +19,7 @@ from typing import Optional
 
 from ..types.field_type import FieldType, TypeKind
 from .dag import CopDAG, DAGAggregation, DAGScan, DAGSelection, DAGTopN, DAGLimit
-from .expr import AggDesc, Call, Col, Const, PlanExpr
+from .expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from .logical import (
     LogicalAggregation,
     LogicalJoin,
@@ -189,7 +189,7 @@ def push_predicates(plan: LogicalPlan) -> LogicalPlan:
                 if pair is not None and join.kind in ("INNER", "CROSS"):
                     join.eq_conditions.append(pair)
                 elif cols and max(cols) < nleft and join.kind in (
-                    "INNER", "CROSS", "LEFT"
+                    "INNER", "CROSS", "LEFT", "SEMI", "ANTI", "ANTI_NULL"
                 ):
                     left_c.append(cond)
                 elif cols and min(cols) >= nleft and join.kind in (
@@ -337,10 +337,12 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
         return plan
 
     if isinstance(plan, LogicalJoin):
+        semi = plan.kind in ("SEMI", "ANTI", "ANTI_NULL")
         nleft = len(plan.children[0].schema)
         need_l: set[int] = set()
         need_r: set[int] = set()
         for i in required:
+            # semi/anti joins output the left schema only
             (need_l if i < nleft else need_r).add(i if i < nleft else i - nleft)
         for li, ri in plan.eq_conditions:
             need_l.add(li)
@@ -364,8 +366,12 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
         plan.other_conditions = [
             _remap_expr(c, m) for c in plan.other_conditions
         ]
-        plan.schema = PlanSchema(left.schema.fields + right.schema.fields)
-        plan._prune_map = m  # type: ignore[attr-defined]
+        if semi:
+            plan.schema = PlanSchema(left.schema.fields)
+            plan._prune_map = ml  # type: ignore[attr-defined]
+        else:
+            plan.schema = PlanSchema(left.schema.fields + right.schema.fields)
+            plan._prune_map = m  # type: ignore[attr-defined]
         return plan
 
     raise TypeError(f"prune: unknown node {type(plan).__name__}")
@@ -376,7 +382,43 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
 def optimize(plan: LogicalPlan) -> PhysicalPlan:
     plan = push_predicates(plan)
     plan = prune(plan)
-    return _to_physical(plan)
+    phys = _to_physical(plan)
+    _optimize_subqueries(phys)
+    return phys
+
+
+def _optimize_subqueries(plan: PhysicalPlan) -> None:
+    """Optimize the logical plan inside every ScalarSubq expression
+    (uncorrelated — runs once per statement, engine materializes it)."""
+    for e in _node_exprs(plan):
+        _optimize_subq_expr(e)
+    for c in plan.children:
+        _optimize_subqueries(c)
+
+
+def _optimize_subq_expr(e: PlanExpr) -> None:
+    if isinstance(e, ScalarSubq):
+        if e.phys is None:
+            e.phys = optimize(e.logical)
+    elif isinstance(e, Call):
+        for a in e.args:
+            _optimize_subq_expr(a)
+
+
+def _node_exprs(plan: PhysicalPlan) -> list[PlanExpr]:
+    out: list[PlanExpr] = []
+    if isinstance(plan, PhysSelection):
+        out += plan.conditions
+    elif isinstance(plan, PhysProjection):
+        out += plan.exprs
+    elif isinstance(plan, PhysHashAgg):
+        out += plan.group_by
+        out += [d.arg for d in plan.aggs if d.arg is not None]
+    elif isinstance(plan, PhysSort):
+        out += [e for e, _ in plan.items]
+    elif isinstance(plan, PhysHashJoin):
+        out += plan.other_conditions
+    return out
 
 
 def _fresh_table_read(scan: LogicalScan) -> PhysTableRead:

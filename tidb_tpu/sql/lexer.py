@@ -65,6 +65,7 @@ KEYWORDS = frozenset(
     DIV MOD
     FIRST AFTER MODIFY CHANGE RENAME TO TRUNCATE
     GLOBAL SESSION VARIABLES STATUS
+    FOR
     """.split()
 )
 

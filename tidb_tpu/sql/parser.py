@@ -730,6 +730,27 @@ class Parser:
 
     def parse_func_call(self, name: str) -> ast.Expr:
         self.expect_op("(")
+        if name == "EXTRACT":
+            # EXTRACT(unit FROM expr) -> YEAR/MONTH/DAY(expr)
+            unit = self._interval_unit()
+            if unit not in ("YEAR", "MONTH", "DAY"):
+                raise ParseError(f"EXTRACT unit {unit} unsupported", self.cur)
+            self.expect_kw("FROM")
+            arg = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall(unit, [arg])
+        if name in ("SUBSTRING", "SUBSTR"):
+            # SUBSTRING(s FROM a [FOR b]) | SUBSTRING(s, a [, b])
+            args = [self.parse_expr()]
+            if self.accept_kw("FROM"):
+                args.append(self.parse_expr())
+                if self.accept_kw("FOR"):
+                    args.append(self.parse_expr())
+            else:
+                while self.accept_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            return ast.FuncCall("SUBSTRING", args)
         distinct = bool(self.accept_kw("DISTINCT"))
         if self.accept_op("*"):
             self.expect_op(")")
