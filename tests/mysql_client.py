@@ -1,0 +1,189 @@
+"""Minimal MySQL text-protocol client for exercising the wire server.
+
+Plays the role the reference's TidbTestSuite clients play (reference:
+server/tidb_test.go uses go-sql-driver) — implemented from the protocol
+spec so the server is tested against an independent encoding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+from typing import Any, Optional
+
+
+class MySQLError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"({code}) {message}")
+        self.code = code
+
+
+class MiniClient:
+    def __init__(self, host: str, port: int, user: str = "root",
+                 password: str = "", db: str = "") -> None:
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.rfile = self.sock.makefile("rb")
+        self.wfile = self.sock.makefile("wb")
+        self.seq = 0
+        self._handshake(user, password, db)
+
+    # ---- framing -----------------------------------------------------------
+    def _read_packet(self) -> bytes:
+        header = self.rfile.read(4)
+        if len(header) < 4:
+            raise ConnectionError("server closed connection")
+        n = int.from_bytes(header[:3], "little")
+        self.seq = (header[3] + 1) % 256
+        data = self.rfile.read(n)
+        if len(data) < n:
+            raise ConnectionError("short packet")
+        return data
+
+    def _write_packet(self, payload: bytes) -> None:
+        self.wfile.write(len(payload).to_bytes(3, "little")
+                         + bytes([self.seq]) + payload)
+        self.wfile.flush()
+        self.seq = (self.seq + 1) % 256
+
+    # ---- handshake ---------------------------------------------------------
+    def _handshake(self, user: str, password: str, db: str) -> None:
+        greet = self._read_packet()
+        assert greet[0] == 0x0A, "expected protocol v10 handshake"
+        pos = greet.index(b"\x00", 1) + 1  # server version
+        pos += 4  # thread id
+        salt = greet[pos:pos + 8]
+        pos += 9  # salt part1 + filler
+        pos += 2 + 1 + 2 + 2  # caps low, charset, status, caps high
+        pos += 1 + 10  # auth len + reserved
+        salt += greet[pos:pos + 12]
+        caps = 0x0F7FF  # PROTOCOL_41 | SECURE_CONNECTION | CONNECT_WITH_DB...
+        auth = _scramble(password, salt) if password else b""
+        payload = struct.pack("<IIB", caps, 2**24 - 1, 255) + b"\x00" * 23
+        payload += user.encode() + b"\x00"
+        payload += bytes([len(auth)]) + auth
+        payload += (db.encode() + b"\x00") if db else b"\x00"
+        self._write_packet(payload)
+        resp = self._read_packet()
+        if resp[0] == 0xFF:
+            raise MySQLError(*_parse_err(resp))
+
+    # ---- queries -----------------------------------------------------------
+    def query(self, sql: str) -> list[tuple[Optional[str], ...]]:
+        """COM_QUERY; returns rows of decoded text values (None = NULL)."""
+        self.seq = 0
+        self._write_packet(b"\x03" + sql.encode("utf-8"))
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise MySQLError(*_parse_err(first))
+        if first[0] == 0x00:
+            return []  # OK packet: no resultset
+        ncols, _ = _lenenc(first, 0)
+        self.columns = []
+        for _ in range(ncols):
+            cd = self._read_packet()
+            self.columns.append(_column_name(cd))
+        eof = self._read_packet()
+        assert eof[0] == 0xFE
+        rows = []
+        while True:
+            data = self._read_packet()
+            if data[0] == 0xFE and len(data) < 9:
+                break
+            if data[0] == 0xFF:
+                raise MySQLError(*_parse_err(data))
+            rows.append(_parse_text_row(data, ncols))
+        return rows
+
+    def execute(self, sql: str) -> int:
+        """COM_QUERY for statements; returns affected rows."""
+        self.seq = 0
+        self._write_packet(b"\x03" + sql.encode("utf-8"))
+        first = self._read_packet()
+        if first[0] == 0xFF:
+            raise MySQLError(*_parse_err(first))
+        if first[0] == 0x00:
+            affected, _ = _lenenc(first, 1)
+            return affected
+        # resultset: drain it
+        ncols, _ = _lenenc(first, 0)
+        for _ in range(ncols):
+            self._read_packet()
+        while True:
+            data = self._read_packet()
+            if data[0] == 0xFE and len(data) < 9:
+                break
+        while True:
+            data = self._read_packet()
+            if data[0] == 0xFE and len(data) < 9:
+                break
+        return 0
+
+    def ping(self) -> bool:
+        self.seq = 0
+        self._write_packet(b"\x0e")
+        return self._read_packet()[0] == 0x00
+
+    def init_db(self, db: str) -> None:
+        self.seq = 0
+        self._write_packet(b"\x02" + db.encode())
+        resp = self._read_packet()
+        if resp[0] == 0xFF:
+            raise MySQLError(*_parse_err(resp))
+
+    def close(self) -> None:
+        try:
+            self.seq = 0
+            self._write_packet(b"\x01")  # COM_QUIT
+        except OSError:
+            pass
+        self.sock.close()
+
+
+def _scramble(password: str, salt: bytes) -> bytes:
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    p3 = hashlib.sha1(salt + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, p3))
+
+
+def _lenenc(buf: bytes, pos: int) -> tuple[int, int]:
+    first = buf[pos]
+    if first < 251:
+        return first, pos + 1
+    if first == 0xFC:
+        return int.from_bytes(buf[pos + 1:pos + 3], "little"), pos + 3
+    if first == 0xFD:
+        return int.from_bytes(buf[pos + 1:pos + 4], "little"), pos + 4
+    return int.from_bytes(buf[pos + 1:pos + 9], "little"), pos + 9
+
+
+def _parse_err(data: bytes) -> tuple[int, str]:
+    code = int.from_bytes(data[1:3], "little")
+    msg = data[3:].decode("utf-8", "replace")
+    if msg.startswith("#"):
+        msg = msg[6:]
+    return code, msg
+
+
+def _column_name(cd: bytes) -> str:
+    pos = 0
+    for _ in range(4):  # catalog, schema, table, org_table
+        n, pos = _lenenc(cd, pos)
+        pos += n
+    n, pos = _lenenc(cd, pos)
+    return cd[pos:pos + n].decode()
+
+
+def _parse_text_row(data: bytes, ncols: int) -> tuple[Optional[str], ...]:
+    out: list[Optional[str]] = []
+    pos = 0
+    for _ in range(ncols):
+        if data[pos] == 0xFB:
+            out.append(None)
+            pos += 1
+        else:
+            n, pos = _lenenc(data, pos)
+            out.append(data[pos:pos + n].decode("utf-8"))
+            pos += n
+    return tuple(out)

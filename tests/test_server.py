@@ -1,0 +1,141 @@
+"""MySQL wire server tests: handshake, auth, queries, concurrency, kill.
+
+Counterpart of the reference's server tests (reference: server/conn_test.go,
+server/tidb_test.go) driven by the independent MiniClient implementation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from mysql_client import MiniClient, MySQLError
+from tidb_tpu.server import Server
+
+
+@pytest.fixture()
+def server():
+    srv = Server(port=0, users={"root": "", "alice": "secret"},
+                 allow_unknown_users=False)
+    srv.start()
+    yield srv
+    srv.close(drain_timeout=0.2)
+
+
+def _connect(srv, **kw):
+    return MiniClient("127.0.0.1", srv.port, **kw)
+
+
+def test_handshake_and_simple_query(server):
+    c = _connect(server)
+    assert c.ping()
+    assert c.query("select 1 + 1") == [("2",)]
+    c.close()
+
+
+def test_ddl_dml_roundtrip(server):
+    c = _connect(server)
+    c.execute("create table wt (a bigint, b varchar(20), c decimal(10,2))")
+    assert c.execute(
+        "insert into wt values (1,'x',1.50),(2,'y',2.25),(3,null,null)") == 3
+    rows = c.query("select a, b, c from wt order by a")
+    assert rows == [("1", "x", "1.50"), ("2", "y", "2.25"),
+                    ("3", None, None)]
+    assert c.query("select sum(c) from wt") == [("3.75",)]
+    assert c.execute("delete from wt where a = 1") == 1
+    assert c.query("select count(*) from wt") == [("2",)]
+    c.execute("drop table wt")
+    c.close()
+
+
+def test_password_auth(server):
+    c = _connect(server, user="alice", password="secret")
+    assert c.ping()
+    c.close()
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(server, user="alice", password="wrong")
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(server, user="mallory", password="x")
+
+
+def test_error_propagation(server):
+    c = _connect(server)
+    with pytest.raises(MySQLError):
+        c.query("select * from no_such_table")
+    # connection still usable afterwards
+    assert c.query("select 42") == [("42",)]
+    c.close()
+
+
+def test_init_db_and_unknown_db(server):
+    c = _connect(server)
+    c.execute("create database mydb")
+    c.init_db("mydb")
+    c.execute("create table t (x bigint)")
+    c.execute("insert into t values (7)")
+    assert c.query("select x from t") == [("7",)]
+    with pytest.raises(MySQLError):
+        c.init_db("nope")
+    c.close()
+
+
+def test_explicit_transaction(server):
+    c = _connect(server)
+    c.execute("create table txt (a bigint)")
+    c.execute("begin")
+    c.execute("insert into txt values (1)")
+    c.execute("rollback")
+    assert c.query("select count(*) from txt") == [("0",)]
+    c.execute("begin")
+    c.execute("insert into txt values (2)")
+    c.execute("commit")
+    assert c.query("select a from txt") == [("2",)]
+    c.close()
+
+
+def test_concurrent_connections_share_storage(server):
+    c1 = _connect(server)
+    c1.execute("create table ct (a bigint)")
+
+    errs: list[Exception] = []
+
+    def worker(base: int) -> None:
+        try:
+            c = _connect(server)
+            for i in range(10):
+                c.execute(f"insert into ct values ({base + i})")
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k * 100,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert c1.query("select count(*) from ct") == [("40",)]
+    c1.close()
+
+
+def test_kill_connection(server):
+    c = _connect(server)
+    assert c.ping()
+    assert server.connection_count() == 1
+    conn_id = list(server._conns)[0]
+    assert server.kill_connection(conn_id)
+    with pytest.raises((ConnectionError, OSError, MySQLError)):
+        for _ in range(5):
+            c.query("select 1")
+    assert not server.kill_connection(99999)
+
+
+def test_null_and_types_rendering(server):
+    c = _connect(server)
+    c.execute("create table ty (d date, f double, dec decimal(8,3))")
+    c.execute("insert into ty values ('2024-02-29', 1.5, 12.345)")
+    rows = c.query("select d, f, dec from ty")
+    assert rows == [("2024-02-29", "1.5", "12.345")]
+    c.close()

@@ -1,0 +1,6 @@
+"""MySQL wire protocol server (reference: server/ package)."""
+
+from .conn import ClientConn
+from .server import Server
+
+__all__ = ["ClientConn", "Server"]

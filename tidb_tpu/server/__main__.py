@@ -1,0 +1,53 @@
+"""Process entry point: `python -m tidb_tpu.server [flags]`.
+
+Counterpart of the reference's tidb-server binary (reference:
+tidb-server/main.go:160 — flag parsing :76-151, store+domain creation :263,
+signal handling + graceful shutdown :652,703).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..store.storage import Storage
+from .server import Server
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tidb-tpu-server",
+        description="TPU-native MySQL-compatible SQL server")
+    p.add_argument("-host", default="0.0.0.0", help="listen address")
+    p.add_argument("-P", "--port", type=int, default=4000,
+                   help="MySQL protocol port")
+    p.add_argument("--default-db", default="test")
+    p.add_argument("--max-connections", type=int, default=512)
+    args = p.parse_args(argv)
+
+    storage = Storage()
+    srv = Server(storage, host=args.host, port=args.port,
+                 default_db=args.default_db,
+                 max_connections=args.max_connections)
+    srv.start()
+    print(f"tidb-tpu-server listening on {args.host}:{srv.port}",
+          flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):  # noqa: ARG001
+        print("shutting down...", flush=True)
+        done.set()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    done.wait()
+    srv.close()
+    storage.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,215 @@
+"""Per-connection handler: handshake, auth, command dispatch loop.
+
+Counterpart of the reference's clientConn (reference: server/conn.go —
+handshake :235, readOptionalSSLRequestAndHandshakeResponse :665, command
+loop Run :725, dispatch :929, handleQuery :1409, writeResultset :1718).
+mysql_native_password auth: scramble = SHA1(pwd) XOR SHA1(salt +
+SHA1(SHA1(pwd))); with an empty server-side password any client response
+is accepted (the bootstrap root account, like the reference's default).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import struct
+import threading
+from typing import TYPE_CHECKING, Optional
+
+from ..session.session import ResultSet, Session, SQLError
+from . import packet as P
+
+if TYPE_CHECKING:
+    from .server import Server
+
+SERVER_VERSION = "5.7.25-TiDB-TPU-v0.1"
+
+_CAPS = (P.CLIENT_LONG_PASSWORD | P.CLIENT_LONG_FLAG
+         | P.CLIENT_CONNECT_WITH_DB | P.CLIENT_PROTOCOL_41
+         | P.CLIENT_TRANSACTIONS | P.CLIENT_SECURE_CONNECTION
+         | P.CLIENT_MULTI_STATEMENTS | P.CLIENT_MULTI_RESULTS
+         | P.CLIENT_PLUGIN_AUTH)
+
+
+class ClientConn:
+    def __init__(self, server: "Server", sock, conn_id: int) -> None:
+        self.server = server
+        self.sock = sock
+        self.conn_id = conn_id
+        self.session = Session(server.storage, db=server.default_db)
+        self.io = P.PacketIO(sock.makefile("rb"), sock.makefile("wb"))
+        self.salt = secrets.token_bytes(20)
+        self.capabilities = 0
+        self.user = ""
+        self.alive = True
+        self.killed = threading.Event()
+
+    # ---- handshake ---------------------------------------------------------
+    def write_initial_handshake(self) -> None:
+        payload = (
+            b"\x0a" + SERVER_VERSION.encode() + b"\x00"
+            + struct.pack("<I", self.conn_id)
+            + self.salt[:8] + b"\x00"
+            + struct.pack("<H", _CAPS & 0xFFFF)
+            + bytes([P._CHARSET_UTF8MB4 & 0xFF])
+            + struct.pack("<H", P.SERVER_STATUS_AUTOCOMMIT)
+            + struct.pack("<H", (_CAPS >> 16) & 0xFFFF)
+            + bytes([21])  # auth plugin data length
+            + b"\x00" * 10
+            + self.salt[8:20] + b"\x00"
+            + b"mysql_native_password\x00"
+        )
+        self.io.write_packet(payload)
+        self.io.flush()
+
+    def read_handshake_response(self) -> None:
+        data = self.io.read_packet()
+        caps = struct.unpack_from("<I", data, 0)[0]
+        self.capabilities = caps
+        pos = 4 + 4 + 1 + 23  # caps, max packet, charset, filler
+        end = data.index(b"\x00", pos)
+        self.user = data[pos:end].decode()
+        pos = end + 1
+        if caps & P.CLIENT_SECURE_CONNECTION:
+            alen = data[pos]
+            auth = data[pos + 1:pos + 1 + alen]
+            pos += 1 + alen
+        else:
+            end = data.index(b"\x00", pos)
+            auth = data[pos:end]
+            pos = end + 1
+        db = None
+        if caps & P.CLIENT_CONNECT_WITH_DB and pos < len(data):
+            end = data.index(b"\x00", pos)
+            db = data[pos:end].decode()
+            pos = end + 1
+        if not self._check_auth(self.user, auth):
+            self.io.write_packet(P.err_packet(
+                1045, f"Access denied for user '{self.user}'", "28000"))
+            self.io.flush()
+            raise ConnectionError("auth failed")
+        if db:
+            try:
+                self.session.catalog.schema(db)
+                self.session.current_db = db
+            except KeyError:
+                pass
+        self.io.write_packet(P.ok_packet())
+        self.io.flush()
+
+    def _check_auth(self, user: str, auth: bytes) -> bool:
+        pwd = self.server.users.get(user)
+        if pwd is None:
+            return self.server.allow_unknown_users
+        if pwd == "":
+            return True
+        want = _native_scramble(pwd, self.salt)
+        return secrets.compare_digest(want, auth)
+
+    # ---- command loop ------------------------------------------------------
+    def run(self) -> None:
+        try:
+            self.write_initial_handshake()
+            self.read_handshake_response()
+            while self.alive and not self.killed.is_set():
+                self.io.reset_sequence()
+                try:
+                    data = self.io.read_packet()
+                except ConnectionError:
+                    break
+                if not data:
+                    break
+                if not self.dispatch(data[0], data[1:]):
+                    break
+                self.io.flush()
+        except ConnectionError:
+            pass
+        finally:
+            self.close()
+
+    def dispatch(self, cmd: int, payload: bytes) -> bool:
+        if cmd == P.COM_QUIT:
+            return False
+        if cmd == P.COM_PING:
+            self.io.write_packet(P.ok_packet(status=self._status()))
+            return True
+        if cmd == P.COM_INIT_DB:
+            return self._com_init_db(payload)
+        if cmd == P.COM_QUERY:
+            return self._com_query(payload.decode("utf-8"))
+        if cmd == P.COM_FIELD_LIST:
+            # deprecated command: empty column list terminator
+            self.io.write_packet(P.eof_packet(status=self._status()))
+            return True
+        self.io.write_packet(P.err_packet(
+            1047, f"Unknown command {cmd:#x}", "08S01"))
+        return True
+
+    def _com_init_db(self, payload: bytes) -> bool:
+        db = payload.decode("utf-8")
+        try:
+            self.session.catalog.schema(db)
+        except KeyError:
+            self.io.write_packet(P.err_packet(
+                1049, f"Unknown database '{db}'", "42000"))
+            return True
+        self.session.current_db = db
+        self.io.write_packet(P.ok_packet(status=self._status()))
+        return True
+
+    def _com_query(self, sql: str) -> bool:
+        try:
+            rs = self.session.execute(sql)
+        except Exception as e:  # noqa: BLE001 - wire boundary catches all
+            self.io.write_packet(P.err_packet(1105, str(e)))
+            return True
+        self._write_resultset(rs)
+        return True
+
+    def _write_resultset(self, rs: ResultSet) -> None:
+        if not rs.column_names:
+            self.io.write_packet(P.ok_packet(
+                affected=rs.affected, status=self._status()))
+            return
+        self.io.write_packet(P.lenenc_int(len(rs.column_names)))
+        types = rs.column_types or [None] * len(rs.column_names)
+        for name, ft in zip(rs.column_names, types):
+            self.io.write_packet(P.column_def(name, ft))
+        self.io.write_packet(P.eof_packet(status=self._status()))
+        for row in rs.rows:
+            self.io.write_packet(P.text_row(row))
+        self.io.write_packet(P.eof_packet(status=self._status()))
+
+    def _status(self) -> int:
+        s = P.SERVER_STATUS_AUTOCOMMIT
+        if self.session.in_explicit_txn:
+            s |= P.SERVER_STATUS_IN_TRANS
+        return s
+
+    def kill(self) -> None:
+        """Kill this connection (reference: server/server.go:548 Kill)."""
+        self.killed.set()
+        try:
+            self.sock.shutdown(2)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.session.rollback_if_active()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.server.deregister(self.conn_id)
+
+
+def _native_scramble(password: str, salt: bytes) -> bytes:
+    """mysql_native_password: SHA1(pwd) XOR SHA1(salt + SHA1(SHA1(pwd)))."""
+    p1 = hashlib.sha1(password.encode()).digest()
+    p2 = hashlib.sha1(p1).digest()
+    p3 = hashlib.sha1(salt + p2).digest()
+    return bytes(a ^ b for a, b in zip(p1, p3))

@@ -1,0 +1,119 @@
+"""MySQL wire server: accept loop, connection registry, graceful shutdown.
+
+Counterpart of the reference's server package (reference: server/server.go —
+NewServer, Run accept loop :308, onConn :411, Kill :548, graceful drain
+:605,621; token-limiter concurrency cap :141). One OS thread per
+connection — the heavy compute runs inside JAX/XLA which releases the GIL,
+and the host operator layer is numpy (also GIL-releasing), so threads are
+the right host-side concurrency model here.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from ..store.storage import Storage
+from .conn import ClientConn
+
+
+class Server:
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        host: str = "127.0.0.1",
+        port: int = 4000,
+        default_db: str = "test",
+        users: Optional[dict[str, str]] = None,
+        allow_unknown_users: bool = True,
+        max_connections: int = 512,
+    ) -> None:
+        self.storage = storage if storage is not None else Storage()
+        self.host = host
+        self.port = port
+        self.default_db = default_db
+        self.users = users if users is not None else {"root": ""}
+        self.allow_unknown_users = allow_unknown_users
+        self.max_connections = max_connections
+
+        self._listener: Optional[socket.socket] = None
+        self._conns: dict[int, ClientConn] = {}
+        self._lock = threading.Lock()
+        self._next_conn_id = 1
+        self._shutdown = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Bind + start accepting in a background thread; returns once the
+        listener is live (port readable via .port, 0 picks a free one)."""
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.host, self.port))
+        ls.listen(128)
+        self.port = ls.getsockname()[1]
+        self._listener = ls
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mysql-accept", daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break  # listener closed
+            with self._lock:
+                if len(self._conns) >= self.max_connections:
+                    sock.close()
+                    continue
+                conn_id = self._next_conn_id
+                self._next_conn_id += 1
+                conn = ClientConn(self, sock, conn_id)
+                self._conns[conn_id] = conn
+            t = threading.Thread(target=conn.run,
+                                 name=f"conn-{conn_id}", daemon=True)
+            t.start()
+
+    def deregister(self, conn_id: int) -> None:
+        with self._lock:
+            self._conns.pop(conn_id, None)
+
+    def kill_connection(self, conn_id: int) -> bool:
+        """KILL <id> semantics (reference: server/server.go:548)."""
+        with self._lock:
+            conn = self._conns.get(conn_id)
+        if conn is None:
+            return False
+        conn.kill()
+        return True
+
+    def connection_count(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def close(self, drain_timeout: float = 5.0) -> None:
+        """Graceful shutdown: stop accepting, then drain/kill connections
+        (reference: server/server.go:605 graceful down + :621 KillAll)."""
+        self._shutdown.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        deadline = threading.Event()
+        deadline.wait(0)  # immediate first check
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < drain_timeout:
+            if self.connection_count() == 0:
+                break
+            deadline.wait(0.05)
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.kill()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=1.0)

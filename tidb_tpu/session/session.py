@@ -41,6 +41,10 @@ class ResultSet:
     column_names: list[str]
     rows: list[tuple[Any, ...]]
     affected: int = 0
+    # column field types when known (SELECT paths); the wire server uses
+    # these for protocol column definitions (reference: server/conn.go
+    # writeResultset column metadata)
+    column_types: Optional[list[FieldType]] = None
 
     def __repr__(self) -> str:
         return f"ResultSet({self.column_names}, {len(self.rows)} rows)"
@@ -146,6 +150,12 @@ class Session:
             self._finish_txn(commit=True)
         return result
 
+    def rollback_if_active(self) -> None:
+        """Abandon any open transaction (connection teardown path —
+        reference: server/conn.go Close rolls back the session txn)."""
+        if self.txn is not None:
+            self._finish_txn(commit=False)
+
     def _commit_implicit(self) -> None:
         if self.txn is not None and not self.in_explicit_txn:
             self._finish_txn(commit=True)
@@ -170,12 +180,10 @@ class Session:
         ctx = ExecContext(self._ensure_txn(), self.cop)
         chunk = run_physical(plan, ctx)
         names = [f.name for f in plan.schema.fields]
-        if not chunk.columns and not names:
-            # SELECT with no FROM and zero cols can't happen; guard anyway
-            return ResultSet(names, [])
+        ftypes = [f.ftype for f in plan.schema.fields]
         if not chunk.columns:
-            return ResultSet(names, [])
-        return ResultSet(names, chunk.to_pylist())
+            return ResultSet(names, [], column_types=ftypes)
+        return ResultSet(names, chunk.to_pylist(), column_types=ftypes)
 
     def _plan(self, stmt: ast.SelectStmt):
         try:
