@@ -1,0 +1,126 @@
+// Ordered KV engine with column families — the native storage substrate.
+//
+// Plays the role the reference delegates to external native stores
+// (reference: TiKV's RocksDB column families; in-tree twin
+// store/mockstore/mocktikv/mvcc_leveldb.go over goleveldb). The MVCC
+// percolator layer (tidb_tpu/kv/mvcc.py) sits on top of this interface;
+// PyOrderedKV is the pure-Python twin used when the shared library is
+// unavailable.
+//
+// Interface contract (mirrors PyOrderedKV):
+//   put/delete/get over (cf, key) -> value bytes
+//   scan(cf, start, end, limit): ordered iteration, end=="" means +inf
+//   seek_prev(cf, key): greatest entry with k <= key
+//
+// Concurrency: a shared_mutex per store; scans snapshot the range into the
+// iterator at creation so mutation during iteration is safe (same
+// semantics the Python twin gets from the GIL + list copy).
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kNumCF = 3;
+
+struct Store {
+    std::map<std::string, std::string> cf[kNumCF];
+    std::shared_mutex mu;
+};
+
+struct Iter {
+    std::vector<std::pair<std::string, std::string>> items;
+    size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open() { return new Store(); }
+
+void kv_close(void* h) { delete static_cast<Store*>(h); }
+
+void kv_put(void* h, int cf, const char* key, size_t klen,
+            const char* val, size_t vlen) {
+    auto* s = static_cast<Store*>(h);
+    std::unique_lock lk(s->mu);
+    s->cf[cf][std::string(key, klen)] = std::string(val, vlen);
+}
+
+void kv_delete(void* h, int cf, const char* key, size_t klen) {
+    auto* s = static_cast<Store*>(h);
+    std::unique_lock lk(s->mu);
+    s->cf[cf].erase(std::string(key, klen));
+}
+
+// returns value length, or -1 if absent; *out borrows until the next
+// mutation — the Python wrapper copies immediately under its own lock.
+long kv_get(void* h, int cf, const char* key, size_t klen,
+            const char** out) {
+    auto* s = static_cast<Store*>(h);
+    std::shared_lock lk(s->mu);
+    auto it = s->cf[cf].find(std::string(key, klen));
+    if (it == s->cf[cf].end()) return -1;
+    *out = it->second.data();
+    return static_cast<long>(it->second.size());
+}
+
+size_t kv_count(void* h, int cf) {
+    auto* s = static_cast<Store*>(h);
+    std::shared_lock lk(s->mu);
+    return s->cf[cf].size();
+}
+
+void* kv_scan(void* h, int cf, const char* start, size_t slen,
+              const char* end, size_t elen, long limit) {
+    auto* s = static_cast<Store*>(h);
+    auto* iter = new Iter();
+    std::shared_lock lk(s->mu);
+    std::string sk(start, slen), ek(end, elen);
+    auto it = s->cf[cf].lower_bound(sk);
+    for (; it != s->cf[cf].end(); ++it) {
+        if (elen > 0 && it->first >= ek) break;
+        if (limit >= 0 && static_cast<long>(iter->items.size()) >= limit)
+            break;
+        iter->items.emplace_back(it->first, it->second);
+    }
+    return iter;
+}
+
+// 1 = produced an entry, 0 = exhausted
+int kv_iter_next(void* hi, const char** k, size_t* klen,
+                 const char** v, size_t* vlen) {
+    auto* iter = static_cast<Iter*>(hi);
+    if (iter->pos >= iter->items.size()) return 0;
+    auto& e = iter->items[iter->pos++];
+    *k = e.first.data();
+    *klen = e.first.size();
+    *v = e.second.data();
+    *vlen = e.second.size();
+    return 1;
+}
+
+void kv_iter_close(void* hi) { delete static_cast<Iter*>(hi); }
+
+// greatest entry with key' <= key; returns value length or -1
+long kv_seek_prev(void* h, int cf, const char* key, size_t klen,
+                  const char** outk, size_t* outklen, const char** outv) {
+    auto* s = static_cast<Store*>(h);
+    std::shared_lock lk(s->mu);
+    auto& m = s->cf[cf];
+    auto it = m.upper_bound(std::string(key, klen));
+    if (it == m.begin()) return -1;
+    --it;
+    *outk = it->first.data();
+    *outklen = it->first.size();
+    *outv = it->second.data();
+    return static_cast<long>(it->second.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""ctypes bindings for the C++ ordered-KV engine (native/kvstore.cpp).
+
+Builds the shared library on first use (g++ is part of the toolchain; no
+pybind11 in this environment, hence the plain C ABI). `NativeOrderedKV`
+is interface-identical to mvcc.PyOrderedKV, so `MVCCStore(NativeOrderedKV())`
+swaps the substrate without touching percolator logic.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import Iterator, Optional
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SO = _NATIVE_DIR / "libtidbkv.so"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _SO.exists():
+            try:
+                subprocess.run(["make", "-C", str(_NATIVE_DIR)],
+                               check=True, capture_output=True, timeout=120)
+            except (subprocess.CalledProcessError, OSError) as e:
+                raise NativeUnavailable(f"cannot build {_SO}: {e}") from e
+        lib = ctypes.CDLL(str(_SO))
+        c = ctypes.c_char_p
+        vp = ctypes.c_void_p
+        sz = ctypes.c_size_t
+        lib.kv_open.restype = vp
+        lib.kv_close.argtypes = [vp]
+        lib.kv_put.argtypes = [vp, ctypes.c_int, c, sz, c, sz]
+        lib.kv_delete.argtypes = [vp, ctypes.c_int, c, sz]
+        lib.kv_get.argtypes = [vp, ctypes.c_int, c, sz,
+                               ctypes.POINTER(ctypes.c_char_p)]
+        lib.kv_get.restype = ctypes.c_long
+        lib.kv_count.argtypes = [vp, ctypes.c_int]
+        lib.kv_count.restype = sz
+        lib.kv_scan.argtypes = [vp, ctypes.c_int, c, sz, c, sz,
+                                ctypes.c_long]
+        lib.kv_scan.restype = vp
+        lib.kv_iter_next.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(sz),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(sz)]
+        lib.kv_iter_next.restype = ctypes.c_int
+        lib.kv_iter_close.argtypes = [vp]
+        lib.kv_seek_prev.argtypes = [
+            vp, ctypes.c_int, c, sz, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(sz), ctypes.POINTER(ctypes.c_char_p)]
+        lib.kv_seek_prev.restype = ctypes.c_long
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+class NativeOrderedKV:
+    """C++-backed ordered KV; drop-in for mvcc.PyOrderedKV."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        self._h = self._lib.kv_open()
+        self._mu = threading.Lock()
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.kv_close(h)
+            self._h = None
+
+    def put(self, cf: int, key: bytes, value: bytes) -> None:
+        with self._mu:
+            self._lib.kv_put(self._h, cf, key, len(key), value, len(value))
+
+    def delete(self, cf: int, key: bytes) -> None:
+        with self._mu:
+            self._lib.kv_delete(self._h, cf, key, len(key))
+
+    def get(self, cf: int, key: bytes) -> Optional[bytes]:
+        out = ctypes.c_char_p()
+        with self._mu:
+            n = self._lib.kv_get(self._h, cf, key, len(key),
+                                 ctypes.byref(out))
+            if n < 0:
+                return None
+            return ctypes.string_at(out, n)
+
+    def scan(self, cf: int, start: bytes, end: bytes,
+             limit: int = -1) -> Iterator[tuple[bytes, bytes]]:
+        with self._mu:
+            it = self._lib.kv_scan(self._h, cf, start, len(start),
+                                   end, len(end), limit)
+        k = ctypes.c_char_p()
+        v = ctypes.c_char_p()
+        kl = ctypes.c_size_t()
+        vl = ctypes.c_size_t()
+        try:
+            while self._lib.kv_iter_next(it, ctypes.byref(k),
+                                         ctypes.byref(kl), ctypes.byref(v),
+                                         ctypes.byref(vl)):
+                yield (ctypes.string_at(k, kl.value),
+                       ctypes.string_at(v, vl.value))
+        finally:
+            self._lib.kv_iter_close(it)
+
+    def seek_prev(self, cf: int, key: bytes) -> Optional[tuple[bytes, bytes]]:
+        outk = ctypes.c_char_p()
+        outkl = ctypes.c_size_t()
+        outv = ctypes.c_char_p()
+        with self._mu:
+            n = self._lib.kv_seek_prev(self._h, cf, key, len(key),
+                                       ctypes.byref(outk),
+                                       ctypes.byref(outkl),
+                                       ctypes.byref(outv))
+            if n < 0:
+                return None
+            return (ctypes.string_at(outk, outkl.value),
+                    ctypes.string_at(outv, n))
+
+    def count(self, cf: int) -> int:
+        with self._mu:
+            return int(self._lib.kv_count(self._h, cf))
