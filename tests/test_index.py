@@ -1,0 +1,258 @@
+"""Index subsystem: point get, index-ranged scans, unique enforcement.
+
+Mirrors the reference's point-get / unique-index test surface
+(executor/point_get_test.go, executor/batch_point_get_test.go,
+executor/insert_test.go duplicate-key cases) in the testkit style.
+"""
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+
+
+@pytest.fixture
+def se():
+    s = Session()
+    s.execute(
+        "CREATE TABLE t (id INT PRIMARY KEY, name VARCHAR(20), "
+        "score INT, UNIQUE KEY uname (name))")
+    s.execute(
+        "INSERT INTO t VALUES (1,'a',10),(2,'b',20),(3,'c',30),(4,'d',40)")
+    yield s
+    s.rollback_if_active()
+
+
+def explain(s, sql):
+    return "\n".join(r[0] for r in s.query("EXPLAIN " + sql))
+
+
+# ---------------- plans ----------------
+
+def test_point_get_plan_pk(se):
+    p = explain(se, "SELECT * FROM t WHERE id = 3")
+    assert "PointGet" in p and "handles=[3]" in p
+
+
+def test_batch_point_get_plan(se):
+    p = explain(se, "SELECT * FROM t WHERE id IN (1, 3)")
+    assert "PointGet" in p
+
+
+def test_point_get_plan_unique_index(se):
+    p = explain(se, "SELECT * FROM t WHERE name = 'b'")
+    assert "PointGet" in p and "uname" in p
+
+
+def test_full_scan_without_index(se):
+    p = explain(se, "SELECT * FROM t WHERE score = 20")
+    assert "PointGet" not in p and "TableRead" in p
+
+
+# ---------------- execution ----------------
+
+def test_point_get_pk(se):
+    assert se.query("SELECT name FROM t WHERE id = 2") == [("b",)]
+    assert se.query("SELECT name FROM t WHERE id = 99") == []
+
+
+def test_batch_point_get(se):
+    rows = se.query("SELECT id FROM t WHERE id IN (4, 1, 4) ORDER BY id")
+    assert rows == [(1,), (4,)]
+
+
+def test_point_get_unique_index(se):
+    assert se.query("SELECT id, score FROM t WHERE name = 'c'") == [(3, 30)]
+    assert se.query("SELECT id FROM t WHERE name = 'zz'") == []
+
+
+def test_point_get_residual_filter(se):
+    assert se.query("SELECT id FROM t WHERE id = 2 AND score > 25") == []
+    assert se.query("SELECT id FROM t WHERE id = 3 AND score > 25") == [(3,)]
+
+
+def test_point_get_sees_txn_buffer(se):
+    se.execute("BEGIN")
+    se.execute("INSERT INTO t VALUES (10,'x',100)")
+    assert se.query("SELECT name FROM t WHERE id = 10") == [("x",)]
+    se.execute("DELETE FROM t WHERE id = 1")
+    assert se.query("SELECT * FROM t WHERE id = 1") == []
+    se.execute("ROLLBACK")
+    assert se.query("SELECT COUNT(*) FROM t WHERE id = 1") == [(1,)]
+
+
+def test_point_get_after_update(se):
+    se.execute("UPDATE t SET score = 99 WHERE id = 2")
+    assert se.query("SELECT score FROM t WHERE id = 2") == [(99,)]
+    assert se.query("SELECT score FROM t WHERE name = 'b'") == [(99,)]
+
+
+# ---------------- secondary (non-unique) index ranged scan ----------------
+
+def test_index_ranged_scan():
+    s = Session()
+    s.execute("CREATE TABLE r (id INT PRIMARY KEY, grp VARCHAR(5), v INT, "
+              "KEY kgrp (grp))")
+    s.execute("INSERT INTO r VALUES (1,'a',1),(2,'b',2),(3,'a',3),"
+              "(4,'c',4),(5,'a',5)")
+    p = explain(s, "SELECT v FROM r WHERE grp = 'a'")
+    assert "index:kgrp" in p
+    assert s.query("SELECT v FROM r WHERE grp = 'a' ORDER BY v") == \
+        [(1,), (3,), (5,)]
+    assert s.query(
+        "SELECT COUNT(*), SUM(v) FROM r WHERE grp = 'a'") == [(3, 9)]
+    # index scan + residual filter
+    assert s.query("SELECT v FROM r WHERE grp = 'a' AND v > 2 ORDER BY v") \
+        == [(3,), (5,)]
+    # absent dictionary string: provably empty
+    assert s.query("SELECT v FROM r WHERE grp = 'zz'") == []
+
+
+def test_index_scan_sees_deltas():
+    s = Session()
+    s.execute("CREATE TABLE r (id INT PRIMARY KEY, grp VARCHAR(5), "
+              "KEY kgrp (grp))")
+    s.execute("INSERT INTO r VALUES (1,'a'),(2,'b')")
+    s.execute("INSERT INTO r VALUES (3,'a')")
+    s.execute("UPDATE r SET grp = 'a' WHERE id = 2")
+    assert s.query("SELECT COUNT(*) FROM r WHERE grp = 'a'") == [(3,)]
+    s.execute("DELETE FROM r WHERE id = 1")
+    assert s.query("SELECT COUNT(*) FROM r WHERE grp = 'a'") == [(2,)]
+
+
+# ---------------- unique enforcement ----------------
+
+def test_insert_duplicate_pk(se):
+    with pytest.raises(SQLError, match="Duplicate entry '2' for key 'PRIMARY'"):
+        se.execute("INSERT INTO t VALUES (2,'zz',0)")
+
+
+def test_insert_duplicate_unique(se):
+    with pytest.raises(SQLError, match="for key 'uname'"):
+        se.execute("INSERT INTO t VALUES (9,'a',0)")
+
+
+def test_insert_duplicate_within_statement(se):
+    with pytest.raises(SQLError, match="Duplicate"):
+        se.execute("INSERT INTO t VALUES (7,'p',0),(8,'p',0)")
+
+
+def test_unique_allows_multiple_nulls(se):
+    se.execute("INSERT INTO t (id, name, score) VALUES (7,NULL,0),(8,NULL,0)")
+    assert se.query("SELECT COUNT(*) FROM t WHERE name IS NULL") == [(2,)]
+
+
+def test_replace_semantics(se):
+    # replace by pk: old row vanishes, affected counts 2 (MySQL)
+    r = se.execute("REPLACE INTO t VALUES (2,'bb',21)")
+    assert r.affected == 2
+    assert se.query("SELECT name, score FROM t WHERE id = 2") == [("bb", 21)]
+    # replace by unique key: displaces the row with name 'a' (id 1)
+    se.execute("REPLACE INTO t VALUES (11,'a',12)")
+    assert se.query("SELECT id FROM t WHERE name = 'a'") == [(11,)]
+    assert se.query("SELECT * FROM t WHERE id = 1") == []
+    # replace with no conflict behaves as plain insert
+    r = se.execute("REPLACE INTO t VALUES (20,'t20',0)")
+    assert r.affected == 1
+
+
+def test_update_duplicate_pk(se):
+    with pytest.raises(SQLError, match="PRIMARY"):
+        se.execute("UPDATE t SET id = 1 WHERE id = 2")
+
+
+def test_update_duplicate_unique(se):
+    with pytest.raises(SQLError, match="uname"):
+        se.execute("UPDATE t SET name = 'a' WHERE id = 2")
+
+
+def test_update_pk_move(se):
+    se.execute("UPDATE t SET id = 50 WHERE id = 2")
+    assert se.query("SELECT * FROM t WHERE id = 2") == []
+    assert se.query("SELECT name FROM t WHERE id = 50") == [("b",)]
+
+
+def test_update_unique_to_self_ok(se):
+    se.execute("UPDATE t SET name = 'b' WHERE id = 2")
+    assert se.query("SELECT name FROM t WHERE id = 2") == [("b",)]
+
+
+def test_string_primary_key():
+    s = Session()
+    s.execute("CREATE TABLE sp (code VARCHAR(8) PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO sp VALUES ('x',1),('y',2)")
+    with pytest.raises(SQLError, match="Duplicate"):
+        s.execute("INSERT INTO sp VALUES ('x',3)")
+    assert s.query("SELECT v FROM sp WHERE code = 'y'") == [(2,)]
+    p = "\n".join(r[0] for r in s.query(
+        "EXPLAIN SELECT v FROM sp WHERE code = 'y'"))
+    assert "PointGet" in p
+
+
+def test_column_level_unique():
+    s = Session()
+    s.execute("CREATE TABLE cu (id INT PRIMARY KEY, email VARCHAR(30) UNIQUE)")
+    s.execute("INSERT INTO cu VALUES (1,'a@x'),(2,'b@x')")
+    with pytest.raises(SQLError, match="Duplicate"):
+        s.execute("INSERT INTO cu VALUES (3,'a@x')")
+
+
+def test_update_unique_vacated_value():
+    # multi-row UPDATE where a later row takes a value an earlier row
+    # vacated must not raise a spurious duplicate (code-review regression)
+    s = Session()
+    s.execute("CREATE TABLE vv (id INT PRIMARY KEY, u INT UNIQUE)")
+    s.execute("INSERT INTO vv VALUES (1,10),(2,20)")
+    s.execute("UPDATE vv SET u = u - 10")
+    assert s.query("SELECT u FROM vv ORDER BY u") == [(0,), (10,)]
+
+
+def test_index_lookup_on_snapshot_older_than_live_epoch():
+    # a snapshot pinned before a compaction must search with ITS epoch's
+    # permutation, not the live store's (code-review regression)
+    s = Session()
+    s.execute("CREATE TABLE ep (id INT PRIMARY KEY, k VARCHAR(4), "
+              "KEY kk (k))")
+    s.execute("INSERT INTO ep VALUES (1,'a'),(2,'b'),(3,'a'),(4,'c')")
+    s.execute("BEGIN")
+    assert s.query("SELECT COUNT(*) FROM ep WHERE k = 'a'") == [(2,)]
+    # concurrent writer folds a bigger epoch while our txn snapshot is live
+    s2 = Session(s.storage)
+    s2.execute("INSERT INTO ep VALUES (5,'a'),(6,'a'),(7,'a'),(8,'a')")
+    s.storage.flush()
+    assert s.query("SELECT COUNT(*) FROM ep WHERE k = 'a'") == [(2,)]
+    s.execute("COMMIT")
+    assert s.query("SELECT COUNT(*) FROM ep WHERE k = 'a'") == [(6,)]
+
+
+def test_contradictory_eq_with_subquery():
+    # contradiction path must not push a scalar subquery into the ranged
+    # DAG (code-review regression)
+    s = Session()
+    s.execute("CREATE TABLE ct (id INT PRIMARY KEY, u INT UNIQUE, v INT)")
+    s.execute("CREATE TABLE o (x INT)")
+    s.execute("INSERT INTO ct VALUES (1,1,1)")
+    s.execute("INSERT INTO o VALUES (1)")
+    assert s.query("SELECT * FROM ct WHERE u = 1 AND u = 2 "
+                   "AND id = (SELECT x FROM o)") == []
+
+
+# ---------------- larger table: index correctness vs scan oracle --------
+
+def test_index_vs_scan_oracle():
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    s = Session()
+    s.execute("CREATE TABLE big (id INT PRIMARY KEY, k INT, v INT, "
+              "KEY kk (k))")
+    rows = ", ".join(
+        f"({i}, {int(rng.integers(0, 50))}, {int(rng.integers(0, 1000))})"
+        for i in range(500))
+    s.execute(f"INSERT INTO big VALUES {rows}")
+    # compaction fold then more deltas on top
+    s.storage.flush()
+    s.execute("INSERT INTO big VALUES (1000, 7, 1), (1001, 7, 2)")
+    s.execute("DELETE FROM big WHERE id < 20")
+    want = s.query("SELECT SUM(v), COUNT(*) FROM big WHERE k + 0 = 7")
+    got = s.query("SELECT SUM(v), COUNT(*) FROM big WHERE k = 7")
+    assert got == want

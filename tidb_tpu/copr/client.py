@@ -95,6 +95,11 @@ class CopClient:
 
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
+        if dag.scan.ranges is not None:
+            # index-ranged scan: the index permutation resolves a (small)
+            # handle set; the DAG runs host-side over the gathered subset
+            # (reference: IndexLookUp double read, executor/distsql.go:353)
+            return host_exec.execute_ranged(dag, snap)
         self._evict_stale(dag.scan.table_id, snap.epoch.epoch_id)
         prepared, fallback = self._prepare(dag, snap)
         if fallback is not None:

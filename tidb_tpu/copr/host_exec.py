@@ -32,18 +32,34 @@ def execute_host(dag: CopDAG, snap: TableSnapshot, reason: str):
     return CopResult(chunks, is_partial_agg=dag.agg is not None)
 
 
+def execute_ranged(dag: CopDAG, snap: TableSnapshot):
+    """Index-ranged scan: resolve handles via the index permutation, gather
+    only the matching rows, run the DAG over the subset."""
+    from ..store.index import probe_and_gather
+    from .client import CopResult
+
+    handles, cols = probe_and_gather(snap, dag.scan.ranges,
+                                     dag.scan.col_offsets)
+    ev = _HostEval(dag, snap, cols=cols, n=len(handles))
+    return CopResult(ev.run(), is_partial_agg=dag.agg is not None)
+
+
 class _HostEval(NumpyEval):
-    def __init__(self, dag: CopDAG, snap: TableSnapshot) -> None:
+    def __init__(self, dag: CopDAG, snap: TableSnapshot,
+                 cols: Optional[list[VV]] = None,
+                 n: Optional[int] = None) -> None:
         self.dag = dag
         self.snap = snap
         dicts: list[Optional[Dictionary]] = [
             snap.dictionaries[off] for off in dag.scan.col_offsets
         ]
-        cols: list[VV] = []
-        for off in dag.scan.col_offsets:
-            col = snap.column(off)
-            cols.append((col.data, col.validity))
-        n = cols[0][0].shape[0] if cols else snap.num_visible_rows
+        if cols is None:
+            cols = []
+            for off in dag.scan.col_offsets:
+                col = snap.column(off)
+                cols.append((col.data, col.validity))
+        if n is None:
+            n = cols[0][0].shape[0] if cols else snap.num_visible_rows
         super().__init__(cols, dicts, n)
 
     # ---- entry -------------------------------------------------------------

@@ -28,6 +28,7 @@ from ..plan.physical import (
     PhysHashAgg,
     PhysHashJoin,
     PhysLimit,
+    PhysPointGet,
     PhysProjection,
     PhysSelection,
     PhysSort,
@@ -95,6 +96,8 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         if not result.chunks:
             return _empty_like(plan)
         return Chunk.concat(result.chunks)
+    if isinstance(plan, PhysPointGet):
+        return _run_point_get(plan, ctx)
     if isinstance(plan, PhysSelection):
         child = run_physical(plan.children[0], ctx)
         ev = _evaluator(child)
@@ -149,6 +152,37 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
     if isinstance(plan, PhysHashJoin):
         return _run_join(plan, ctx)
     raise TypeError(f"run_physical: unknown node {type(plan).__name__}")
+
+
+def _run_point_get(plan: PhysPointGet, ctx: ExecContext) -> Chunk:
+    """Fetch rows by handle / unique key, then apply the residual filter
+    (reference: executor/point_get.go Next; batch_point_get.go)."""
+    from ..store.index import probe_and_gather
+
+    snap = ctx.txn.snapshot(plan.table.id)
+    if plan.handles is not None:
+        handles = np.array(
+            sorted({h for h in plan.handles if snap.has_handle(h)}),
+            dtype=np.int64)
+        gathered = snap.gather(handles, plan.col_offsets)
+    else:
+        handles, gathered = probe_and_gather(snap, plan.ranges,
+                                             plan.col_offsets)
+    columns = []
+    for (data, valid), off, f in zip(gathered, plan.col_offsets,
+                                     plan.schema.fields):
+        columns.append(Column(f.ftype, data,
+                              None if valid.all() else valid,
+                              snap.dictionaries[off]))
+    chunk = Chunk(columns)
+    if plan.conditions and chunk.num_rows:
+        ev = _evaluator(chunk)
+        mask = np.ones(chunk.num_rows, dtype=bool)
+        for c in plan.conditions:
+            v, vl = ev.eval(_subst_subq(c, ctx))
+            mask &= _truthy(np.asarray(v)) & vl
+        chunk = chunk.take(np.nonzero(mask)[0])
+    return chunk
 
 
 def _empty_like(plan: PhysicalPlan) -> Chunk:

@@ -25,6 +25,11 @@ class DAGScan:
     table_id: int
     # offsets into the stored table's columns, in output order
     col_offsets: list[int]
+    # index access ranges (plan/ranger.ScanRanges); None = full scan.
+    # With ranges the coprocessor gathers matching rows via the index
+    # permutation and runs the rest of the DAG host-side over the (small)
+    # subset (reference: IndexLookUp double read, executor/distsql.go:353)
+    ranges: Optional[object] = None
 
 
 @dataclass
@@ -66,7 +71,8 @@ class CopDAG:
     output_types: list[FieldType] = field(default_factory=list)
 
     def describe(self) -> str:
-        parts = [f"scan(t{self.scan.table_id} cols={self.scan.col_offsets})"]
+        rng = f" {self.scan.ranges.describe()}" if self.scan.ranges else ""
+        parts = [f"scan(t{self.scan.table_id} cols={self.scan.col_offsets}{rng})"]
         if self.selection:
             parts.append(f"sel({len(self.selection.conditions)} conds)")
         if self.agg:

@@ -54,6 +54,25 @@ class PhysTableRead(PhysicalPlan):
 
 
 @dataclass
+class PhysPointGet(PhysicalPlan):
+    """Point / batch-point get: resolve rows directly by handle or by a
+    fully-pinned unique index key, bypassing the coprocessor scan entirely
+    (reference: executor/point_get.go, executor/batch_point_get.go; planned
+    by the TryFastPlan bypass, planner/core/point_get_plan.go:413)."""
+
+    table: object  # TableInfo
+    col_offsets: list[int]
+    # pk-is-handle path: literal handles to fetch; else None
+    handles: Optional[list[int]]
+    # unique-index path: ScanRanges with full key points; else None
+    ranges: Optional[object]
+    # residual filter over the output schema
+    conditions: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
 class PhysSelection(PhysicalPlan):
     conditions: list[PlanExpr]
     schema: PlanSchema
@@ -409,6 +428,8 @@ def _node_exprs(plan: PhysicalPlan) -> list[PlanExpr]:
     out: list[PlanExpr] = []
     if isinstance(plan, PhysSelection):
         out += plan.conditions
+    elif isinstance(plan, PhysPointGet):
+        out += plan.conditions
     elif isinstance(plan, PhysProjection):
         out += plan.exprs
     elif isinstance(plan, PhysHashAgg):
@@ -440,12 +461,74 @@ def _bare_scan(tr: PhysTableRead) -> bool:
         dag.projections is None
 
 
+def _has_subq(e: PlanExpr) -> bool:
+    if isinstance(e, ScalarSubq):
+        return True
+    if isinstance(e, Call):
+        return any(_has_subq(a) for a in e.args)
+    return False
+
+
+def _access_path(scan_offsets: list[int], table, conditions):
+    """Choose an index access path from the conjuncts (heuristic, stats-free:
+    equality points only — see plan/ranger.py). Returns
+    ('handles', [int]) | ('unique', ScanRanges) | ('ranges', ScanRanges) |
+    None (full scan). Reference: access-path selection in
+    planner/core/planbuilder.go:933 + point-get bypass point_get_plan.go:413.
+    """
+    from .ranger import _eq_values, extract_points, full_unique_match
+
+    col_map = {i: off for i, off in enumerate(scan_offsets)}
+    if table.pk_handle_offset is not None:
+        for c in conditions:
+            hit = _eq_values(c, col_map)
+            if hit is not None and hit[0] == table.pk_handle_offset:
+                return "handles", [int(v) for v in hit[1]]
+    best = None
+    # the ranged path evals all conjuncts storage-side, which can't host a
+    # scalar subquery; unique/handle point gets filter engine-side, so
+    # they stay eligible
+    has_subq = any(_has_subq(c) for c in conditions)
+    for index in table.indices:
+        r = extract_points(table, index, conditions, col_map)
+        if r is None:
+            continue
+        if full_unique_match(table, r):
+            return "unique", r
+        if has_subq:
+            continue
+        if not r.points:  # contradictory equalities: provably empty
+            return "ranges", r
+        depth = len(r.points[0])
+        if best is None or depth > len(best.points[0]) or (
+                depth == len(best.points[0])
+                and len(r.points) < len(best.points)):
+            best = r
+    return ("ranges", best) if best is not None else None
+
+
 def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
     if isinstance(plan, LogicalScan):
         return _fresh_table_read(plan)
 
     if isinstance(plan, LogicalSelection):
         child = _to_physical(plan.children[0])
+        if isinstance(child, PhysTableRead) and _bare_scan(child) and \
+                isinstance(plan.children[0], LogicalScan):
+            scan = plan.children[0]
+            ap = _access_path(child.dag.scan.col_offsets, scan.table,
+                              plan.conditions)
+            if ap is not None:
+                kind, payload = ap
+                if kind in ("handles", "unique"):
+                    return PhysPointGet(
+                        scan.table, child.dag.scan.col_offsets,
+                        payload if kind == "handles" else None,
+                        payload if kind == "unique" else None,
+                        list(plan.conditions), plan.schema)
+                child.dag.scan.ranges = payload
+                child.dag.selection = DAGSelection(list(plan.conditions))
+                return child
         if (
             isinstance(child, PhysTableRead)
             and _bare_scan(child)
@@ -579,6 +662,12 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
     name = type(plan).__name__
     if isinstance(plan, PhysTableRead):
         line = f"{pad}TableRead[TiTPU]: {plan.dag.describe()}"
+    elif isinstance(plan, PhysPointGet):
+        if plan.handles is not None:
+            what = f"handles={plan.handles}"
+        else:
+            what = plan.ranges.describe()
+        line = f"{pad}PointGet: {plan.table.name} {what}"
     elif isinstance(plan, PhysHashAgg):
         line = (f"{pad}HashAgg({plan.mode}): groups={len(plan.group_by)} "
                 f"aggs={plan.aggs}")

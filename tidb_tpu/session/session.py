@@ -209,13 +209,24 @@ class Session:
                     raise SQLError("column count doesn't match value count")
                 rows.append([self._eval_value(e) for e in value_row])
 
+        checker = _UniqueChecker(info, store, txn)
         count = 0
         for rv in rows:
             if len(rv) != len(col_order):
                 raise SQLError("column count doesn't match value count")
             full = self._complete_row(info, col_order, rv, store)
             handle = self._row_handle(info, full, store)
-            txn.set_row(info.id, handle, store.encode_row(full))
+            enc = store.encode_row(full)
+            conflicts = checker.conflicts(handle, enc)
+            if conflicts:
+                if not stmt.is_replace:
+                    raise SQLError(checker.dup_message(handle, enc, conflicts))
+                for h in conflicts:
+                    txn.delete_row(info.id, h)
+                    checker.note_delete(h)
+                count += len(conflicts)  # MySQL: replaced rows count double
+            txn.set_row(info.id, handle, enc)
+            checker.note_insert(handle, enc)
             count += 1
         return ResultSet([], [], affected=count)
 
@@ -256,6 +267,16 @@ class Session:
                     (col_ft.is_decimal and e.ftype.scale != col_ft.scale)
                 ) else vv
                 new_vals[ci] = (np.asarray(v), np.asarray(vl))
+        # constraint checks only when an assigned column is the handle pk
+        # or part of a unique index
+        pk_changed = info.pk_handle_offset in assigns
+        touches_unique = pk_changed or any(
+            off in assigns
+            for ix in info.indices if ix.unique or ix.primary
+            for off in ix.col_offsets
+        )
+        checker = _UniqueChecker(info, store, txn, snap=snap) \
+            if touches_unique else None
         # hoist full-column materialization out of the per-row loop
         cols = [snap.column(c) for c in range(info.num_columns)]
         col_data = [c.data for c in cols]
@@ -264,6 +285,7 @@ class Session:
         count = 0
         for ri, handle in zip(rows_idx, handles):
             ri = int(ri)
+            handle = int(handle)
             phys = [
                 None if not col_valid[c][ri] else _np_scalar(col_data[c][ri])
                 for c in range(info.num_columns)
@@ -271,7 +293,28 @@ class Session:
             for ci in assigns:
                 v, vl = new_vals[ci]
                 phys[ci] = None if not vl[ri] else _np_scalar(v[ri])
-            txn.set_row(info.id, int(handle), tuple(phys))
+            new_handle = handle
+            if pk_changed:
+                pv = phys[info.pk_handle_offset]
+                if pv is None:
+                    raise SQLError(
+                        f"column {info.columns[info.pk_handle_offset].name} "
+                        "cannot be null")
+                new_handle = int(pv)
+                store.note_handle(new_handle)
+            if checker is not None:
+                conf = checker.conflicts(new_handle, tuple(phys),
+                                         exclude=handle)
+                if conf:
+                    raise SQLError(
+                        checker.dup_message(new_handle, tuple(phys), conf))
+            if new_handle != handle:
+                txn.delete_row(info.id, handle)
+                if checker is not None:
+                    checker.note_delete(handle)
+            txn.set_row(info.id, new_handle, tuple(phys))
+            if checker is not None:
+                checker.note_insert(new_handle, tuple(phys))
             count += 1
         return ResultSet([], [], affected=count)
 
@@ -395,6 +438,10 @@ class Session:
             if cd.primary_key:
                 pk_offsets.append(off)
         indices: list[IndexInfo] = []
+        for off, cd in enumerate(stmt.columns):
+            if getattr(cd, "unique", False) and not cd.primary_key:
+                indices.append(IndexInfo(self.catalog.alloc_id(),
+                                         cd.name, [off], True, False))
         for idef in stmt.indices:
             offs = []
             for name in idef.columns:
@@ -416,6 +463,11 @@ class Session:
         pk_handle = None
         if len(pk_offsets) == 1 and columns[pk_offsets[0]].ftype.is_integer:
             pk_handle = pk_offsets[0]
+        elif pk_offsets and not any(ix.primary for ix in indices):
+            # non-handle pk (string/composite declared at column level):
+            # enforce via a primary unique index
+            indices.append(IndexInfo(self.catalog.alloc_id(), "PRIMARY",
+                                     list(pk_offsets), True, True))
         info = TableInfo(
             id=self.catalog.alloc_id(),
             name=stmt.table.name,
@@ -498,6 +550,98 @@ class Session:
         except KeyError as e:
             raise SQLError(str(e)) from None
         return info, self.storage.table_store(info.id)
+
+
+class _UniqueChecker:
+    """Duplicate-key detection for DML writes: checks new rows against the
+    snapshot (via index lookups) and against rows written earlier in the
+    same statement. Counterpart of the reference's unique-index constraint
+    path (table/tables/index.go Create; executor/insert.go dup handling,
+    REPLACE semantics in executor/replace.go). NULL keys are never
+    duplicates (MySQL unique-index NULL rule)."""
+
+    def __init__(self, info: TableInfo, store: TableStore, txn: Transaction,
+                 snap=None) -> None:
+        from ..store.index import IndexSearcher
+
+        self.info = info
+        self.store = store
+        self.uniques = [ix for ix in info.indices if ix.unique or ix.primary]
+        need = bool(self.uniques) or info.pk_handle_offset is not None
+        self.snap = snap if snap is not None else (
+            txn.snapshot(info.id) if need else None)
+        self._searchers = [
+            IndexSearcher(store, self.snap, ix) for ix in self.uniques
+        ] if self.snap is not None else []
+        self._seen: list[dict] = [dict() for _ in self.uniques]
+        self._deleted: set[int] = set()
+        self._inserted: set[int] = set()
+
+    def _key(self, ix: IndexInfo, enc: tuple):
+        vals = tuple(enc[off] for off in ix.col_offsets)
+        return None if any(v is None for v in vals) else vals
+
+    def conflicts(self, handle: int, enc: tuple,
+                  exclude: Optional[int] = None) -> list[int]:
+        """Visible handles the new row collides with (pk or unique keys).
+        Records the first violated constraint for dup_message."""
+        out: list[int] = []
+        self.last_dup: Optional[tuple[str, tuple]] = None
+        if self.snap is None:
+            return out
+        if self.info.pk_handle_offset is not None:
+            live = handle in self._inserted or (
+                self.snap.has_handle(handle) and handle not in self._deleted)
+            if live and handle != exclude:
+                out.append(handle)
+                self.last_dup = ("PRIMARY", (handle,))
+        for ix, searcher, seen in zip(self.uniques, self._searchers,
+                                      self._seen):
+            key = self._key(ix, enc)
+            if key is None:
+                continue
+            hits: list[int] = []
+            h2 = seen.get(key)
+            if h2 is not None and h2 != exclude and h2 not in self._deleted:
+                hits.append(h2)
+            for h in searcher.eq(key):
+                h = int(h)
+                # _inserted handles were rewritten this statement: their
+                # snapshot index entries are stale (e.g. a multi-row UPDATE
+                # vacating a unique value); their live keys are in `seen`
+                if h != exclude and h not in self._deleted and \
+                        h not in self._inserted:
+                    hits.append(h)
+            for h in hits:
+                if h not in out:
+                    out.append(h)
+            if hits and self.last_dup is None:
+                name = "PRIMARY" if ix.primary else ix.name
+                shown = []  # decode dictionary codes back to strings
+                for v, off in zip(key, ix.col_offsets):
+                    d = self.store.dictionaries[off]
+                    shown.append(d.decode(int(v)) if d is not None else v)
+                self.last_dup = (name, tuple(shown))
+        return out
+
+    def dup_message(self, handle: int, enc: tuple, conflicts: list[int]) -> str:
+        if self.last_dup is None:
+            return "Duplicate entry"
+        name, key = self.last_dup
+        return (f"Duplicate entry '{'-'.join(str(v) for v in key)}' "
+                f"for key '{name}'")
+
+    def note_insert(self, handle: int, enc: tuple) -> None:
+        self._inserted.add(handle)
+        self._deleted.discard(handle)
+        for ix, seen in zip(self.uniques, self._seen):
+            key = self._key(ix, enc)
+            if key is not None:
+                seen[key] = handle
+
+    def note_delete(self, handle: int) -> None:
+        self._deleted.add(handle)
+        self._inserted.discard(handle)
 
 
 def _np_scalar(v):

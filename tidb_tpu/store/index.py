@@ -1,0 +1,159 @@
+"""Secondary-index runtime over columnar epochs.
+
+TPU-first index design. Where the reference materializes per-row index KV
+entries (`t{tid}_i{iid}{vals}` keys written by table/tables/index.go and
+scanned by IndexReader executors), an index here is a *sorted permutation*
+of the immutable column epoch: computed lazily per (epoch, index) with
+np.lexsort, cached on the TableStore, and binary-searched with
+np.searchsorted for point lookups. Snapshot overlay rows (recent commits +
+the txn buffer) are searched linearly — they are small by construction
+(compaction folds them into the epoch).
+
+This matches the storage design: the epoch is immutable, so its sort order
+is immutable too; there is no per-write index maintenance at all (the
+reference pays one index KV write per row per index, table/tables/index.go
+Create). The cost moves to the first lookup after an epoch fold.
+
+String key columns are dictionary-encoded and codes are NOT
+collation-ordered, so string index columns support equality points only;
+range predicates on strings stay as plain filters.
+
+NULL semantics follow MySQL: NULLs sort first inside the permutation (so
+the valid region is a suffix), equality points never match NULL, and
+unique indexes admit any number of NULL keys (enforced by the DML layer
+skipping NULL-keyed uniqueness checks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..catalog.schema import IndexInfo
+from .table_store import TableSnapshot, TableStore
+
+
+def epoch_index_order(store: TableStore, epoch, index: IndexInfo) -> np.ndarray:
+    """Sorted permutation of `epoch` (the one a snapshot pinned — NOT
+    necessarily the store's live epoch; a concurrent commit may have
+    compacted past it) for `index`.
+
+    Sort key: (valid0, data0, valid1, data1, ...) with NULLs (valid=False)
+    first within each column level. Cached per (epoch_id, index_id).
+    """
+    cache = store._index_orders
+    key = (epoch.epoch_id, index.id)
+    order = cache.get(key)
+    if order is not None:
+        return order
+    # np.lexsort: LAST key is the primary sort key
+    keys: list[np.ndarray] = []
+    for off in reversed(index.col_offsets):
+        data = epoch.columns[off]
+        valid = epoch.valids[off]
+        keys.append(data)
+        if valid is not None:
+            keys.append(valid)
+    order = np.lexsort(keys) if keys else np.arange(epoch.num_rows)
+    if len(cache) >= 32:
+        # bounded: drop orders for epochs other than the live one (old
+        # entries belong to snapshots that will release soon)
+        live = store.epoch.epoch_id
+        for k in list(cache):
+            if k[0] != live and k != key:
+                del cache[k]
+    cache[key] = order
+    return order
+
+
+def probe_and_gather(snap: TableSnapshot, ranges,
+                     col_offsets: list[int]):
+    """Resolve a ScanRanges' point set to visible handles and gather those
+    rows' columns — the shared core of the point-get executor and the
+    coprocessor's ranged path. Returns (handles, [(data, valid), ...])."""
+    searcher = IndexSearcher(snap.store, snap, ranges.index)
+    found = [searcher.eq(p) for p in ranges.points]
+    handles = (np.unique(np.concatenate(found)) if found
+               else np.empty(0, dtype=np.int64))
+    return handles, snap.gather(handles, col_offsets)
+
+
+class IndexSearcher:
+    """Point/prefix lookups for one index over one snapshot."""
+
+    def __init__(self, store: TableStore, snap: TableSnapshot,
+                 index: IndexInfo) -> None:
+        self.store = store
+        self.snap = snap
+        self.index = index
+        self._order: Optional[np.ndarray] = None
+
+    def _encode_key(self, values: tuple) -> Optional[list]:
+        """Cast host key values into the physical column domain; None if the
+        key can never match (absent dictionary string)."""
+        out = []
+        for v, off in zip(values, self.index.col_offsets):
+            ft = self.snap.table.columns[off].ftype
+            if ft.is_string:
+                d = self.snap.dictionaries[off]
+                assert d is not None
+                code = d.lookup(v) if isinstance(v, str) else int(v)
+                if code < 0:
+                    return None
+                out.append(code)
+            else:
+                out.append(v)
+        return out
+
+    def eq(self, values: tuple) -> np.ndarray:
+        """Handles of visible rows whose index prefix equals `values`.
+
+        Any None in values returns empty (SQL equality with NULL is never
+        true). len(values) may be a prefix of the index columns.
+        """
+        if any(v is None for v in values):
+            return np.empty(0, dtype=np.int64)
+        key = self._encode_key(values)
+        epoch = self.snap.epoch
+        base = np.empty(0, dtype=np.int64)
+        if key is not None and epoch.num_rows:
+            if self._order is None:
+                self._order = epoch_index_order(self.store, epoch, self.index)
+            order = self._order
+            lo, hi = 0, len(order)
+            for v, off in zip(key, self.index.col_offsets):
+                valid = epoch.valids[off]
+                if valid is not None:
+                    # valid region is the True-suffix at this level
+                    sub_v = valid[order[lo:hi]]
+                    lo += int(np.searchsorted(sub_v, True, "left"))
+                data = epoch.columns[off]
+                sub = data[order[lo:hi]]
+                l = lo + int(np.searchsorted(sub, v, "left"))
+                r = lo + int(np.searchsorted(sub, v, "right"))
+                lo, hi = l, r
+                if lo >= hi:
+                    break
+            if lo < hi:
+                pos = order[lo:hi]
+                pos = pos[self.snap.base_visible[pos]]
+                base = epoch.handles[pos]
+        return np.concatenate([base, self._overlay_eq(values)])
+
+    def _overlay_eq(self, values: tuple) -> np.ndarray:
+        snap = self.snap
+        m = len(snap.overlay_handles)
+        if m == 0:
+            return np.empty(0, dtype=np.int64)
+        key = self._encode_key(values)
+        if key is None:
+            return np.empty(0, dtype=np.int64)
+        mask = np.ones(m, dtype=bool)
+        for v, off in zip(key, self.index.col_offsets):
+            data = snap.overlay_columns[off]
+            valid = snap.overlay_valids[off]
+            mask &= data == data.dtype.type(v)
+            if valid is not None:
+                mask &= valid
+        return snap.overlay_handles[mask]

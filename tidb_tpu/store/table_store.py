@@ -62,10 +62,69 @@ class TableSnapshot:
     overlay_handles: np.ndarray  # int64[m] rows added/updated after fold_ts
     overlay_columns: list[np.ndarray]
     overlay_valids: list[Optional[np.ndarray]]
+    # backref for index lookups (epoch sort-order cache lives on the store)
+    store: Any = None
+    _overlay_pos: Optional[dict] = field(default=None, repr=False)
 
     @property
     def num_visible_rows(self) -> int:
         return int(self.base_visible.sum()) + len(self.overlay_handles)
+
+    def overlay_pos(self) -> dict:
+        if self._overlay_pos is None:
+            self._overlay_pos = {
+                int(h): i for i, h in enumerate(self.overlay_handles)
+            }
+        return self._overlay_pos
+
+    def has_handle(self, handle: int) -> bool:
+        """True if a live row with this handle is visible at the snapshot."""
+        if handle in self.overlay_pos():
+            return True
+        pos = self.epoch.handle_pos.get(handle)
+        return pos is not None and bool(self.base_visible[pos])
+
+    def gather(self, handles: np.ndarray, offsets: list[int]):
+        """Rows for the given (visible) handles as per-offset (data, valid)
+        arrays, in handle-argument order. The point-get / index-lookup read
+        path (reference: executor/point_get.go, executor/distsql.go
+        IndexLookUp table task) — O(k), never materializes the table."""
+        k = len(handles)
+        ov_pos = self.overlay_pos()
+        base_rows = np.empty(k, dtype=np.int64)
+        ov_rows = np.empty(k, dtype=np.int64)
+        from_overlay = np.zeros(k, dtype=bool)
+        for i, h in enumerate(handles):
+            oi = ov_pos.get(int(h))
+            if oi is not None:
+                from_overlay[i] = True
+                ov_rows[i] = oi
+                base_rows[i] = 0
+            else:
+                pos = self.epoch.handle_pos.get(int(h))
+                assert pos is not None and self.base_visible[pos], (
+                    f"gather of non-visible handle {h}")
+                base_rows[i] = pos
+                ov_rows[i] = 0
+        out = []
+        for off in offsets:
+            dt = self.table.columns[off].ftype.np_dtype
+            if self.epoch.num_rows:
+                data = self.epoch.columns[off][base_rows].astype(dt, copy=True)
+            else:
+                data = np.zeros(k, dtype=dt)
+            valid = np.ones(k, dtype=bool)
+            bv = self.epoch.valids[off]
+            if bv is not None and self.epoch.num_rows:
+                valid &= bv[base_rows] | from_overlay
+            if from_overlay.any():
+                data[from_overlay] = self.overlay_columns[off][
+                    ov_rows[from_overlay]]
+                ovv = self.overlay_valids[off]
+                if ovv is not None:
+                    valid[from_overlay] = ovv[ov_rows[from_overlay]]
+            out.append((data, valid))
+        return out
 
     def column(self, offset: int) -> Column:
         """Materialize one full visible column (host path / small tables)."""
@@ -117,6 +176,8 @@ class TableStore:
         self.deltas: list[tuple[int, int, Any]] = []  # (commit_ts, handle, row)
         self._next_handle = 1
         self._lock = threading.RLock()
+        # (epoch_id, index_id) -> sorted permutation; see store/index.py
+        self._index_orders: dict[tuple[int, int], np.ndarray] = {}
 
     # ---- write path --------------------------------------------------------
     def alloc_handle(self) -> int:
@@ -210,6 +271,7 @@ class TableStore:
             overlay_handles=np.array(ov_handles, dtype=np.int64),
             overlay_columns=ov_columns,
             overlay_valids=ov_valids,
+            store=self,
         )
 
     # ---- bulk load ----------------------------------------------------------
