@@ -1,0 +1,223 @@
+"""Statistics subsystem: sketches, histograms, ANALYZE, CBO access paths.
+
+Mirrors the reference's statistics tests (statistics/cmsketch_test.go,
+histogram_test.go, selectivity_test.go) plus planner integration.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.stats import CMSketch, FMSketch, Histogram, StatsHandle
+
+
+# ---------------- sketch units ----------------
+
+def test_cmsketch_point_estimates():
+    rng = np.random.default_rng(0)
+    # zipf-ish: value v appears ~ 10000/v times
+    vals = np.repeat(np.arange(1, 200), (10000 / np.arange(1, 200)).astype(int))
+    sk = CMSketch.build(vals)
+    assert abs(sk.query(1) - 10000) / 10000 < 0.05  # heavy hitter: exact-ish
+    assert abs(sk.query(50) - 200) <= 200  # tail: within a bucket collision
+    rare = sk.query(10**9)  # never-seen value
+    assert rare <= sk.query(2)
+
+
+def test_cmsketch_scaled():
+    vals = np.repeat(np.arange(100), 100)
+    sk = CMSketch.build(vals, scale=10.0)
+    assert 500 <= sk.query(5) <= 2000  # 100 actual * 10 scale
+
+
+def test_fmsketch_ndv():
+    rng = np.random.default_rng(1)
+    vals = rng.integers(0, 50_000, size=300_000)
+    ndv = FMSketch.build(vals).ndv
+    true_ndv = len(np.unique(vals))
+    assert abs(ndv - true_ndv) / true_ndv < 0.15
+
+
+def test_histogram_range_and_eq():
+    rng = np.random.default_rng(2)
+    vals = rng.integers(0, 1000, size=100_000)
+    h = Histogram.build(vals)
+    # uniform: [100, 200) holds ~10%
+    est = h.range_count(100, 200, True, False)
+    assert abs(est - 10_000) / 10_000 < 0.1
+    # unbounded sides
+    assert abs(h.range_count(None, 500, True, False) - 50_000) < 5_000
+    assert abs(h.range_count(500, None, True, True) - 50_000) < 5_000
+    # eq on a repeated upper bound is sane
+    assert 0 < h.eq_count(float(vals[0])) < 1000
+
+
+def test_histogram_skew():
+    vals = np.concatenate([np.zeros(90_000), np.arange(1, 10_001)])
+    h = Histogram.build(vals)
+    assert h.range_count(0, 0, True, True) > 80_000  # the spike
+    est = h.range_count(5000, None, True, True)
+    assert est < 10_000
+
+
+# ---------------- ANALYZE + planner integration ----------------
+
+@pytest.fixture
+def se():
+    s = Session()
+    s.execute("CREATE TABLE ev (id INT PRIMARY KEY, ts INT, grp INT, "
+              "KEY kts (ts), KEY kgrp (grp))")
+    rows = ", ".join(f"({i}, {i % 10_000}, {i % 7})" for i in range(5000))
+    s.execute(f"INSERT INTO ev VALUES {rows}")
+    return s
+
+
+def explain(s, sql):
+    return "\n".join(r[0] for r in s.query("EXPLAIN " + sql))
+
+
+def test_analyze_builds_stats(se):
+    se.execute("ANALYZE TABLE ev")
+    ts = se.storage.stats.table_stats(
+        se.catalog.table("test", "ev").id)
+    assert ts is not None and ts.row_count == 5000
+    cs = ts.columns[0]
+    assert abs(cs.ndv - 5000) / 5000 < 0.15
+    assert cs.histogram is not None
+
+
+def test_interval_index_requires_stats(se):
+    # without stats: comparison predicates never choose the index
+    p = explain(se, "SELECT COUNT(*) FROM ev WHERE ts < 50")
+    assert "index:" not in p
+    se.execute("ANALYZE TABLE ev")
+    # ts < 50 matches ~25/5000 rows (0.5%) -> index range scan
+    p = explain(se, "SELECT COUNT(*) FROM ev WHERE ts < 50")
+    assert "index:kts" in p and "range" in p
+    # ts < 9000 matches ~90% -> stays a device full scan
+    p = explain(se, "SELECT COUNT(*) FROM ev WHERE ts < 9000")
+    assert "index:" not in p
+
+
+def test_interval_scan_correctness(se):
+    se.execute("ANALYZE TABLE ev")
+    want = [(r,) for r in sorted(
+        i for i in range(5000) if 20 <= (i % 10_000) <= 40)]
+    got = se.query("SELECT id FROM ev WHERE ts >= 20 AND ts <= 40 "
+                   "ORDER BY id")
+    assert got == want
+    # interval + residual filter
+    assert se.query(
+        "SELECT COUNT(*) FROM ev WHERE ts >= 20 AND ts <= 40 AND grp = 0"
+    ) == [(sum(1 for i in range(5000)
+               if 20 <= i % 10_000 <= 40 and i % 7 == 0),)]
+
+
+def test_point_index_gated_by_stats(se):
+    # grp has 7 distinct values over 5000 rows (~14% each): with stats the
+    # planner must prefer the device scan over gathering ~700 rows
+    se.execute("ANALYZE TABLE ev")
+    p = explain(se, "SELECT COUNT(*) FROM ev WHERE grp = 3")
+    assert "index:" not in p
+    # correctness unchanged
+    assert se.query("SELECT COUNT(*) FROM ev WHERE grp = 3") == \
+        [(sum(1 for i in range(5000) if i % 7 == 3),)]
+
+
+def test_explain_est_rows(se):
+    se.execute("ANALYZE TABLE ev")
+    p = explain(se, "SELECT COUNT(*) FROM ev WHERE ts < 50")
+    assert "est=" in p
+
+
+def test_auto_analyze_triggers():
+    s = Session()
+    s.execute("CREATE TABLE aa (id INT PRIMARY KEY, v INT, KEY kv (v))")
+    rows = ", ".join(f"({i}, {i})" for i in range(200))
+    s.execute(f"INSERT INTO aa VALUES {rows}")
+    info = s.catalog.table("test", "aa")
+    store = s.storage.table_store(info.id)
+    assert s.storage.stats.needs_auto_analyze(info, store)
+    analyzed = s.storage.stats.auto_analyze(s.storage, s.catalog)
+    assert "aa" in analyzed
+    assert not s.storage.stats.needs_auto_analyze(info, store)
+    # small delta doesn't retrigger; big delta does
+    s.execute("INSERT INTO aa VALUES (1000, 1)")
+    assert not s.storage.stats.needs_auto_analyze(info, store)
+    rows = ", ".join(f"({i}, {i})" for i in range(2000, 2200))
+    s.execute(f"INSERT INTO aa VALUES {rows}")
+    assert s.storage.stats.needs_auto_analyze(info, store)
+
+
+def test_stats_dropped_with_table(se):
+    se.execute("ANALYZE TABLE ev")
+    tid = se.catalog.table("test", "ev").id
+    assert se.storage.stats.table_stats(tid) is not None
+    se.execute("DROP TABLE ev")
+    assert se.storage.stats.table_stats(tid) is None
+
+
+def test_string_eq_after_analyze():
+    # code-review regression: CM sketch is keyed on dictionary codes but
+    # predicates carry raw strings
+    s = Session()
+    s.execute("CREATE TABLE p (id INT PRIMARY KEY, name VARCHAR(5), v INT, "
+              "KEY kn (name))")
+    s.execute("INSERT INTO p VALUES " + ", ".join(
+        f"({i}, '{'abc'[i % 3]}', {i})" for i in range(300)))
+    s.execute("ANALYZE TABLE p")
+    assert s.query("SELECT COUNT(*) FROM p WHERE name = 'b' AND v >= 0") \
+        == [(100,)]
+    assert s.query("SELECT COUNT(*) FROM p WHERE name = 'zz'") == [(0,)]
+
+
+def test_cmsketch_float_heavy_hitter():
+    # code-review regression: float TopN keys must not be int-truncated
+    vals = np.concatenate([np.full(1000, 2.5), np.arange(100) + 0.25])
+    sk = CMSketch.build(vals)
+    assert sk.query(2.5) == 1000
+    assert sk.query(np.float64(2.5)) == 1000
+
+
+def test_histogram_strict_less_at_bucket_edge():
+    # code-review regression: < at a bucket upper bound must exclude repeats
+    vals = np.concatenate([np.arange(100), np.full(100, 100.0),
+                           np.arange(101, 201)])
+    h = Histogram.build(vals)
+    less = h.range_count(None, 100, True, False)
+    ge = h.range_count(100, None, True, True)
+    assert abs(less - 100) < 25
+    assert abs(ge - 200) < 25
+
+
+def test_sampled_ndv_extrapolation():
+    # code-review regression: NDV from a sampled build scales up
+    from tidb_tpu.stats.handle import SAMPLE_CAP, StatsHandle
+    import tidb_tpu.stats.handle as H
+    old = H.SAMPLE_CAP
+    H.SAMPLE_CAP = 10_000
+    try:
+        s = Session()
+        s.execute("CREATE TABLE nx (id INT PRIMARY KEY)")
+        info = s.catalog.table("test", "nx")
+        store = s.storage.table_store(info.id)
+        store.bulk_load([np.arange(100_000, dtype=np.int64)])
+        s.execute("ANALYZE TABLE nx")
+        ndv = s.storage.stats.table_stats(info.id).columns[0].ndv
+        assert ndv > 50_000  # all-distinct column: sampled ndv must scale
+    finally:
+        H.SAMPLE_CAP = old
+
+
+def test_analyze_with_nulls_and_strings():
+    s = Session()
+    s.execute("CREATE TABLE ns (id INT PRIMARY KEY, name VARCHAR(10), v INT)")
+    s.execute("INSERT INTO ns VALUES (1,'a',10),(2,NULL,20),(3,'b',NULL),"
+              "(4,'a',40)")
+    s.execute("ANALYZE TABLE ns")
+    ts = s.storage.stats.table_stats(s.catalog.table("test", "ns").id)
+    name_stats = ts.columns[1]
+    assert name_stats.null_count == 1
+    assert name_stats.histogram is None  # strings: no histogram
+    assert name_stats.ndv == 2
+    assert ts.columns[2].null_count == 1

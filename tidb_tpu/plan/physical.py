@@ -51,6 +51,7 @@ class PhysTableRead(PhysicalPlan):
     dag: CopDAG
     schema: PlanSchema
     children: list[PhysicalPlan] = field(default_factory=list)
+    est_rows: Optional[float] = None  # CBO estimate for EXPLAIN
 
 
 @dataclass
@@ -70,6 +71,7 @@ class PhysPointGet(PhysicalPlan):
     conditions: list[PlanExpr]
     schema: PlanSchema
     children: list[PhysicalPlan] = field(default_factory=list)
+    est_rows: Optional[float] = None
 
 
 @dataclass
@@ -398,30 +400,30 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
 
 # ==================== physical build ====================
 
-def optimize(plan: LogicalPlan) -> PhysicalPlan:
+def optimize(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     plan = push_predicates(plan)
     plan = prune(plan)
-    phys = _to_physical(plan)
-    _optimize_subqueries(phys)
+    phys = _to_physical(plan, stats)
+    _optimize_subqueries(phys, stats)
     return phys
 
 
-def _optimize_subqueries(plan: PhysicalPlan) -> None:
+def _optimize_subqueries(plan: PhysicalPlan, stats=None) -> None:
     """Optimize the logical plan inside every ScalarSubq expression
     (uncorrelated — runs once per statement, engine materializes it)."""
     for e in _node_exprs(plan):
-        _optimize_subq_expr(e)
+        _optimize_subq_expr(e, stats)
     for c in plan.children:
-        _optimize_subqueries(c)
+        _optimize_subqueries(c, stats)
 
 
-def _optimize_subq_expr(e: PlanExpr) -> None:
+def _optimize_subq_expr(e: PlanExpr, stats=None) -> None:
     if isinstance(e, ScalarSubq):
         if e.phys is None:
-            e.phys = optimize(e.logical)
+            e.phys = optimize(e.logical, stats)
     elif isinstance(e, Call):
         for a in e.args:
-            _optimize_subq_expr(a)
+            _optimize_subq_expr(a, stats)
 
 
 def _node_exprs(plan: PhysicalPlan) -> list[PlanExpr]:
@@ -469,22 +471,38 @@ def _has_subq(e: PlanExpr) -> bool:
     return False
 
 
-def _access_path(scan_offsets: list[int], table, conditions):
-    """Choose an index access path from the conjuncts (heuristic, stats-free:
-    equality points only — see plan/ranger.py). Returns
-    ('handles', [int]) | ('unique', ScanRanges) | ('ranges', ScanRanges) |
-    None (full scan). Reference: access-path selection in
-    planner/core/planbuilder.go:933 + point-get bypass point_get_plan.go:413.
+# index path cost gates (fractions of table rows): the device scan is so
+# fast that host-side gather only wins at low selectivity
+POINT_SEL_LIMIT = 0.1     # non-unique equality points (stats available)
+INTERVAL_SEL_LIMIT = 0.05  # interval ranges (require stats to justify)
+
+
+def _access_path(scan_offsets: list[int], table, conditions, stats=None):
+    """Choose an index access path from the conjuncts. Equality points are
+    chosen heuristically (point lookups justify themselves); interval
+    ranges are chosen only when statistics estimate low selectivity.
+    Returns ('handles', [int], est) | ('unique', ScanRanges, est) |
+    ('ranges', ScanRanges, est) | None (full scan). Reference: access-path
+    selection planner/core/planbuilder.go:933 + point-get bypass
+    point_get_plan.go:413 + selectivity feed statistics/selectivity.go.
     """
-    from .ranger import _eq_values, extract_points, full_unique_match
+    from .ranger import (
+        _eq_values,
+        extract_interval,
+        extract_points,
+        full_unique_match,
+        ScanRanges,
+    )
 
     col_map = {i: off for i, off in enumerate(scan_offsets)}
     if table.pk_handle_offset is not None:
         for c in conditions:
             hit = _eq_values(c, col_map)
             if hit is not None and hit[0] == table.pk_handle_offset:
-                return "handles", [int(v) for v in hit[1]]
+                return "handles", [int(v) for v in hit[1]], float(len(hit[1]))
+    ts = stats.table_stats(table.id) if stats is not None else None
     best = None
+    best_est = None
     # the ranged path evals all conjuncts storage-side, which can't host a
     # scalar subquery; unique/handle point gets filter engine-side, so
     # they stay eligible
@@ -494,40 +512,105 @@ def _access_path(scan_offsets: list[int], table, conditions):
         if r is None:
             continue
         if full_unique_match(table, r):
-            return "unique", r
+            return "unique", r, float(len(r.points))
         if has_subq:
             continue
         if not r.points:  # contradictory equalities: provably empty
-            return "ranges", r
+            return "ranges", r, 0.0
+        est = None
+        if ts is not None:
+            off0 = index.col_offsets[0]
+            est = sum(
+                stats.est_eq_rows(table.id, off0, p[0], ts.row_count)
+                for p in r.points)
+            if est > ts.row_count * POINT_SEL_LIMIT:
+                continue  # too many rows: the full scan is cheaper
         depth = len(r.points[0])
         if best is None or depth > len(best.points[0]) or (
                 depth == len(best.points[0])
                 and len(r.points) < len(best.points)):
-            best = r
-    return ("ranges", best) if best is not None else None
+            best, best_est = r, est
+    if best is not None:
+        return "ranges", best, best_est
+    # interval ranges: only with statistics backing the choice
+    if ts is not None and not has_subq:
+        for index in table.indices:
+            off0 = index.col_offsets[0]
+            if table.columns[off0].ftype.is_string:
+                continue
+            interval = extract_interval(off0, conditions, col_map)
+            if interval is None:
+                continue
+            lo, hi, li, hi_i = interval
+            est = stats.est_range_rows(table.id, off0, lo, hi, li, hi_i,
+                                       ts.row_count)
+            if est <= ts.row_count * INTERVAL_SEL_LIMIT:
+                return "ranges", ScanRanges(index, [], interval), est
+    return None
 
 
-def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
+def _est_selection_rows(table, scan_offsets: list[int],
+                        conditions: list[PlanExpr], stats) -> Optional[float]:
+    """Conjunct-product cardinality estimate for EXPLAIN (reference:
+    statistics/selectivity.go — simplified to per-column independence)."""
+    ts = stats.table_stats(table.id) if stats is not None else None
+    if ts is None:
+        return None
+    from .ranger import _eq_values, extract_interval
+
+    col_map = {i: off for i, off in enumerate(scan_offsets)}
+    rows = max(ts.row_count, 1.0)
+    sel = 1.0
+    interval_offs: set[int] = set()
+    for c in conditions:
+        hit = _eq_values(c, col_map)
+        if hit is not None:
+            off, vals = hit
+            est = sum(stats.est_eq_rows(table.id, off, v, rows)
+                      for v in vals)
+            sel *= min(est / rows, 1.0)
+            continue
+        if isinstance(c, Call) and c.op in ("lt", "le", "gt", "ge"):
+            cols: set[int] = set()
+            _expr_cols(c, cols)
+            offs = {col_map[i] for i in cols if i in col_map}
+            if len(offs) == 1:
+                off = next(iter(offs))
+                if off in interval_offs:
+                    continue  # both bounds of one interval: count once
+                interval_offs.add(off)
+                iv = extract_interval(off, conditions, col_map)
+                if iv is not None:
+                    est = stats.est_range_rows(table.id, off, *iv,
+                                               fallback_rows=rows)
+                    sel *= min(est / rows, 1.0)
+                    continue
+        sel *= 0.8  # uninterpretable conjunct: mild filter factor
+    return rows * sel
+
+
+def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     if isinstance(plan, LogicalScan):
         return _fresh_table_read(plan)
 
     if isinstance(plan, LogicalSelection):
-        child = _to_physical(plan.children[0])
+        child = _to_physical(plan.children[0], stats)
         if isinstance(child, PhysTableRead) and _bare_scan(child) and \
                 isinstance(plan.children[0], LogicalScan):
             scan = plan.children[0]
             ap = _access_path(child.dag.scan.col_offsets, scan.table,
-                              plan.conditions)
+                              plan.conditions, stats)
             if ap is not None:
-                kind, payload = ap
+                kind, payload, est = ap
                 if kind in ("handles", "unique"):
                     return PhysPointGet(
                         scan.table, child.dag.scan.col_offsets,
                         payload if kind == "handles" else None,
                         payload if kind == "unique" else None,
-                        list(plan.conditions), plan.schema)
+                        list(plan.conditions), plan.schema, est_rows=est)
                 child.dag.scan.ranges = payload
                 child.dag.selection = DAGSelection(list(plan.conditions))
+                child.est_rows = est
                 return child
         if (
             isinstance(child, PhysTableRead)
@@ -539,11 +622,15 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
                 dag.selection = DAGSelection(list(plan.conditions))
             else:
                 dag.selection.conditions.extend(plan.conditions)
+            if isinstance(plan.children[0], LogicalScan):
+                child.est_rows = _est_selection_rows(
+                    plan.children[0].table, dag.scan.col_offsets,
+                    plan.conditions, stats)
             return child
         return PhysSelection(plan.conditions, plan.schema, [child])
 
     if isinstance(plan, LogicalAggregation):
-        child = _to_physical(plan.children[0])
+        child = _to_physical(plan.children[0], stats)
         if (
             isinstance(child, PhysTableRead)
             and _bare_scan(child)
@@ -570,7 +657,7 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
                            [child])
 
     if isinstance(plan, LogicalProjection):
-        child = _to_physical(plan.children[0])
+        child = _to_physical(plan.children[0], stats)
         if (
             isinstance(child, PhysTableRead)
             and _bare_scan(child)
@@ -585,7 +672,7 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
         return PhysProjection(plan.exprs, plan.schema, [child])
 
     if isinstance(plan, LogicalSort):
-        child = _to_physical(plan.children[0])
+        child = _to_physical(plan.children[0], stats)
         return PhysSort(plan.items, plan.schema, [child])
 
     if isinstance(plan, LogicalLimit):
@@ -609,7 +696,7 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
                 expr_pushable(e) and not e.ftype.is_string
                 for e, _ in sort_node.items
             ):
-                inner = _to_physical(sort_node.children[0])
+                inner = _to_physical(sort_node.children[0], stats)
                 if isinstance(inner, PhysTableRead) and \
                         inner.dag.scan.table_id >= 0 and \
                         inner.dag.agg is None and \
@@ -624,7 +711,7 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
                         return PhysProjection(trim.exprs, trim.schema,
                                               [merged])
                     return merged
-        child = _to_physical(plan.children[0])
+        child = _to_physical(plan.children[0], stats)
         # Limit over a pushable chain lowers to dag.limit (per-region limit is
         # a superset; host PhysLimit still enforces the exact count)
         if isinstance(child, PhysTableRead) and child.dag.agg is None and \
@@ -633,8 +720,8 @@ def _to_physical(plan: LogicalPlan) -> PhysicalPlan:
         return PhysLimit(plan.limit, plan.offset, plan.schema, [child])
 
     if isinstance(plan, LogicalJoin):
-        left = _to_physical(plan.children[0])
-        right = _to_physical(plan.children[1])
+        left = _to_physical(plan.children[0], stats)
+        right = _to_physical(plan.children[1], stats)
         return PhysHashJoin(plan.kind, plan.eq_conditions,
                             plan.other_conditions, plan.schema, [left, right])
 
@@ -661,7 +748,8 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
     pad = "  " * depth
     name = type(plan).__name__
     if isinstance(plan, PhysTableRead):
-        line = f"{pad}TableRead[TiTPU]: {plan.dag.describe()}"
+        est = f" est={plan.est_rows:.0f}" if plan.est_rows is not None else ""
+        line = f"{pad}TableRead[TiTPU]: {plan.dag.describe()}{est}"
     elif isinstance(plan, PhysPointGet):
         if plan.handles is not None:
             what = f"handles={plan.handles}"

@@ -31,14 +31,26 @@ MAX_POINTS = 1024
 
 @dataclass
 class ScanRanges:
-    """Equality-point access ranges on one index: every tuple is a full
-    value list for the first len(tuple) index columns (physical domain,
-    strings as raw str — encoded by the searcher)."""
+    """Access ranges on one index. Two forms:
+
+    * points mode: every tuple is a full value list for the first
+      len(tuple) index columns (physical domain, strings raw — encoded by
+      the searcher)
+    * interval mode: one (lo, hi, lo_incl, hi_incl) interval on the FIRST
+      index column (numeric/temporal only; None bound = unbounded on that
+      side) — chosen only when statistics justify it
+    """
 
     index: IndexInfo
     points: list[tuple]
+    interval: Optional[tuple] = None  # (lo, hi, lo_incl, hi_incl)
 
     def describe(self) -> str:
+        if self.interval is not None:
+            lo, hi, li, hi_i = self.interval
+            lb = ("[" if li else "(") + (str(lo) if lo is not None else "-inf")
+            ub = (str(hi) if hi is not None else "+inf") + ("]" if hi_i else ")")
+            return f"index:{self.index.name} range {lb},{ub}"
         return (f"index:{self.index.name}"
                 f"({len(self.points)} point{'s' if len(self.points) != 1 else ''})")
 
@@ -107,6 +119,41 @@ def extract_points(
     if n_points == 0:
         return ScanRanges(index, [])  # contradictory equalities: empty scan
     return ScanRanges(index, list(itertools.product(*prefix)))
+
+
+_CMP_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+
+
+def extract_interval(offset: int, conditions: list[PlanExpr],
+                     col_map: dict[int, int]) -> Optional[tuple]:
+    """Merged (lo, hi, lo_incl, hi_incl) interval on the column at table
+    offset `offset` from comparison conjuncts; None when no comparison
+    bounds it. BETWEEN arrives here already lowered to ge+le."""
+    lo = hi = None
+    lo_incl = hi_incl = True
+    found = False
+    for c in conditions:
+        if not isinstance(c, Call) or c.op not in ("lt", "le", "gt", "ge"):
+            continue
+        a, b = c.args
+        op = c.op
+        if isinstance(a, Const) and isinstance(b, Col):
+            a, b, op = b, a, _CMP_FLIP[op]
+        if not (isinstance(a, Col) and isinstance(b, Const)):
+            continue
+        if col_map.get(a.idx) != offset or b.value is None:
+            continue
+        v = b.value
+        found = True
+        if op in ("gt", "ge"):
+            incl = op == "ge"
+            if lo is None or v > lo or (v == lo and not incl):
+                lo, lo_incl = v, incl
+        else:
+            incl = op == "le"
+            if hi is None or v < hi or (v == hi and not incl):
+                hi, hi_incl = v, incl
+    return (lo, hi, lo_incl, hi_incl) if found else None
 
 
 def full_unique_match(table: TableInfo, ranges: ScanRanges) -> bool:

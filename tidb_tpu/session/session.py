@@ -60,6 +60,7 @@ class Session:
         self.txn: Optional[Transaction] = None
         self.in_explicit_txn = False
         self.vars: dict[str, Any] = {}
+        self._stmt_seq = 0
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
@@ -72,6 +73,12 @@ class Session:
         result = ResultSet([], [])
         for stmt in stmts:
             result = self._execute_stmt(stmt)
+        # delta-driven auto-analyze at statement boundaries (the reference
+        # runs this in the stats owner's background loop,
+        # statistics/handle/update.go:860; single-process checks inline)
+        self._stmt_seq += 1
+        if self._stmt_seq % 64 == 0 and self.txn is None:
+            self.storage.stats.auto_analyze(self.storage, self.catalog)
         return result
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
@@ -126,8 +133,17 @@ class Session:
                 self.vars[name.lower()] = c.value if c is not None else None
             return ResultSet([], [])
         if isinstance(stmt, ast.AnalyzeTableStmt):
-            return ResultSet([], [])  # stats pipeline arrives with the CBO
+            return self._exec_analyze(stmt)
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> ResultSet:
+        """ANALYZE TABLE: build histograms/sketches from a fresh snapshot
+        (reference: executor/analyze.go over pushdown collectors)."""
+        self._commit_implicit()
+        for tn in stmt.tables:
+            info, store = self._table_for(tn)
+            self.storage.stats.analyze_one(info, store, self.storage)
+        return ResultSet([], [])
 
     # ==================== txn plumbing ====================
     def _ensure_txn(self) -> Transaction:
@@ -189,7 +205,7 @@ class Session:
         try:
             logical = PlanBuilder(self.catalog, self.current_db).build_select(
                 stmt)
-            return optimize(logical)
+            return optimize(logical, self.storage.stats)
         except PlanError as e:
             raise SQLError(str(e)) from None
 
@@ -501,11 +517,13 @@ class Session:
                 raise SQLError(str(e)) from None
             if info is not None:
                 self.storage.unregister_table(info.id)
+                self.storage.stats.drop_table(info.id)
         return ResultSet([], [])
 
     def _exec_truncate(self, stmt: ast.TruncateTableStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)
         self.storage.unregister_table(info.id)
+        self.storage.stats.drop_table(info.id)
         self.storage.register_table(info)
         return ResultSet([], [])
 

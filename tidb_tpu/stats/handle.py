@@ -1,0 +1,188 @@
+"""Stats handle: per-table statistics registry + cardinality estimation.
+
+Counterpart of the reference's statistics/handle (handle.go load/save,
+update.go delta-driven auto-analyze) and selectivity.go estimation entry.
+Single-process: stats live in memory keyed by table id; the delta feed is
+the TableStore's modify counter (the reference accumulates per-session
+deltas into mysql.stats_meta).
+
+Estimation hierarchy per predicate, mirroring the reference's order:
+exact TopN -> CM sketch point query (eq) / histogram interpolation
+(ranges) -> pseudo rates when stats are missing (the reference's
+PseudoTable path, statistics/table.go).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..catalog.schema import TableInfo
+from .histogram import Histogram
+from .sketch import CMSketch, FMSketch
+
+# pseudo rates for columns without stats (reference: statistics/table.go
+# pseudoEqualRate / pseudoLessRate)
+PSEUDO_EQ_RATE = 1.0 / 1000
+PSEUDO_RANGE_RATE = 1.0 / 3
+SAMPLE_CAP = 1 << 20  # build from at most ~1M rows, extrapolated
+
+
+@dataclass
+class ColumnStats:
+    null_count: float
+    ndv: int
+    histogram: Optional[Histogram]  # numeric/temporal only
+    cmsketch: Optional[CMSketch]
+    total: float  # non-null rows (scaled)
+    # string columns: the table's append-only dictionary (codes are stable
+    # across epochs) — planner predicates carry raw strings, the sketch is
+    # keyed on codes
+    dictionary: Any = None
+
+    def eq_rows(self, value) -> float:
+        if value is None:
+            return self.null_count
+        if isinstance(value, str):
+            if self.dictionary is None:
+                return self.total / self.ndv if self.ndv else 0.0
+            code = self.dictionary.lookup(value)
+            if code < 0:
+                return 0.0
+            value = code
+        if self.cmsketch is not None:
+            return float(self.cmsketch.query(value))
+        if self.ndv > 0:
+            return self.total / self.ndv
+        return 0.0
+
+    def range_rows(self, lo, hi, lo_incl: bool, hi_incl: bool) -> float:
+        if self.histogram is None:
+            return self.total * PSEUDO_RANGE_RATE
+        return self.histogram.range_count(lo, hi, lo_incl, hi_incl)
+
+
+@dataclass
+class TableStats:
+    table_id: int
+    row_count: float
+    columns: dict[int, ColumnStats]  # keyed by column offset
+    version: int = 0
+    built_at: float = field(default_factory=time.time)
+
+
+class StatsHandle:
+    """All tables' stats + auto-analyze bookkeeping."""
+
+    AUTO_ANALYZE_RATIO = 0.5  # reference: tidb_auto_analyze_ratio default
+
+    def __init__(self) -> None:
+        self.tables: dict[int, TableStats] = {}
+        # modify counts at last ANALYZE, per table id
+        self._analyzed_at_modify: dict[int, int] = {}
+
+    # ---- build ------------------------------------------------------------
+    def build_table(self, info: TableInfo, snap) -> TableStats:
+        """ANALYZE: build stats from a snapshot's visible rows
+        (reference: executor/analyze.go over pushdown sample collectors)."""
+        n = snap.num_visible_rows
+        rng = np.random.default_rng(info.id)
+        cols: dict[int, ColumnStats] = {}
+        for off in range(info.num_columns):
+            col = snap.column(off)
+            data, valid = col.data, col.validity
+            nn = data[valid] if valid is not None else data
+            scale = 1.0
+            if len(nn) > SAMPLE_CAP:
+                scale = len(nn) / SAMPLE_CAP
+                nn = rng.choice(nn, SAMPLE_CAP, replace=False)
+            null_count = float(n - (len(nn) * scale))
+            ft = info.columns[off].ftype
+            hist = None
+            if not ft.is_string and len(nn):
+                hist = Histogram.build(nn, scale)
+            cm = CMSketch.build(nn, scale) if len(nn) else None
+            if scale == 1.0:
+                ndv = (int(len(np.unique(nn))) if len(nn) <= FMSketch.MAX_SIZE
+                       * 16 else FMSketch.build(nn).ndv)
+            else:
+                # GEE-style scale-up: values seen once in the sample predict
+                # the unseen mass (reference samples feed fmsketch merges,
+                # statistics/builder.go)
+                u, c = np.unique(nn, return_counts=True)
+                f1 = int((c == 1).sum())
+                ndv = min(int(len(u) + (scale - 1.0) * f1),
+                          int(len(nn) * scale))
+            cols[off] = ColumnStats(
+                null_count, ndv, hist, cm, float(len(nn)) * scale,
+                dictionary=snap.dictionaries[off] if ft.is_string else None)
+        ts = TableStats(info.id, float(n), cols,
+                        version=self.tables.get(info.id).version + 1
+                        if info.id in self.tables else 1)
+        self.tables[info.id] = ts
+        return ts
+
+    def analyze_one(self, info: TableInfo, store, storage) -> TableStats:
+        """Analyze one table from a fresh snapshot and record the modify
+        watermark — shared by ANALYZE TABLE and auto-analyze."""
+        txn = storage.begin()
+        try:
+            ts = self.build_table(info, txn.snapshot(info.id))
+            self._analyzed_at_modify[info.id] = store.modify_count
+            return ts
+        finally:
+            txn.rollback()
+
+    def drop_table(self, table_id: int) -> None:
+        self.tables.pop(table_id, None)
+        self._analyzed_at_modify.pop(table_id, None)
+
+    # ---- estimation -------------------------------------------------------
+    def table_stats(self, table_id: int) -> Optional[TableStats]:
+        return self.tables.get(table_id)
+
+    def est_eq_rows(self, table_id: int, offset: int, value,
+                    fallback_rows: float) -> float:
+        ts = self.tables.get(table_id)
+        if ts is None or offset not in ts.columns:
+            return fallback_rows * PSEUDO_EQ_RATE
+        return ts.columns[offset].eq_rows(value)
+
+    def est_range_rows(self, table_id: int, offset: int, lo, hi,
+                       lo_incl: bool, hi_incl: bool,
+                       fallback_rows: float) -> float:
+        ts = self.tables.get(table_id)
+        if ts is None or offset not in ts.columns:
+            return fallback_rows * PSEUDO_RANGE_RATE
+        return ts.columns[offset].range_rows(lo, hi, lo_incl, hi_incl)
+
+    # ---- auto analyze -----------------------------------------------------
+    def needs_auto_analyze(self, info: TableInfo, store) -> bool:
+        """Delta-driven trigger (reference: handle/update.go:860
+        HandleAutoAnalyze, ratio of modify count to row count)."""
+        modified = store.modify_count
+        ts = self.tables.get(info.id)
+        if ts is None:
+            return modified > 0
+        done = self._analyzed_at_modify.get(info.id, 0)
+        delta = modified - done
+        return delta > max(ts.row_count, 1) * self.AUTO_ANALYZE_RATIO and \
+            delta >= 64
+
+    def auto_analyze(self, storage, catalog) -> list[str]:
+        """Run pending auto-analyzes; returns analyzed table names."""
+        out = []
+        for schema in list(catalog.schemas.values()):
+            for info in list(schema.tables.values()):
+                try:
+                    store = storage.table_store(info.id)
+                except KeyError:
+                    continue
+                if not self.needs_auto_analyze(info, store):
+                    continue
+                self.analyze_one(info, store, storage)
+                out.append(info.name)
+        return out

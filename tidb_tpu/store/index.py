@@ -73,9 +73,13 @@ def probe_and_gather(snap: TableSnapshot, ranges,
     rows' columns — the shared core of the point-get executor and the
     coprocessor's ranged path. Returns (handles, [(data, valid), ...])."""
     searcher = IndexSearcher(snap.store, snap, ranges.index)
-    found = [searcher.eq(p) for p in ranges.points]
-    handles = (np.unique(np.concatenate(found)) if found
-               else np.empty(0, dtype=np.int64))
+    if ranges.interval is not None:
+        lo, hi, li, hi_i = ranges.interval
+        handles = np.unique(searcher.range(lo, hi, li, hi_i))
+    else:
+        found = [searcher.eq(p) for p in ranges.points]
+        handles = (np.unique(np.concatenate(found)) if found
+                   else np.empty(0, dtype=np.int64))
     return handles, snap.gather(handles, col_offsets)
 
 
@@ -140,6 +144,49 @@ class IndexSearcher:
                 pos = pos[self.snap.base_visible[pos]]
                 base = epoch.handles[pos]
         return np.concatenate([base, self._overlay_eq(values)])
+
+    def range(self, lo, hi, lo_incl: bool, hi_incl: bool) -> np.ndarray:
+        """Handles of visible rows whose FIRST index column lies in the
+        interval (numeric/temporal only — dictionary codes are unordered).
+        None bounds are unbounded; NULLs never match (MySQL comparison)."""
+        epoch = self.snap.epoch
+        off = self.index.col_offsets[0]
+        base = np.empty(0, dtype=np.int64)
+        if epoch.num_rows:
+            if self._order is None:
+                self._order = epoch_index_order(self.store, epoch, self.index)
+            order = self._order
+            lo_pos, hi_pos = 0, len(order)
+            valid = epoch.valids[off]
+            if valid is not None:
+                lo_pos += int(np.searchsorted(valid[order], True, "left"))
+            data = epoch.columns[off]
+            sub = data[order[lo_pos:hi_pos]]
+            l, r = 0, len(sub)
+            if lo is not None:
+                l = int(np.searchsorted(sub, lo,
+                                        "left" if lo_incl else "right"))
+            if hi is not None:
+                r = int(np.searchsorted(sub, hi,
+                                        "right" if hi_incl else "left"))
+            if l < r:
+                pos = order[lo_pos + l:lo_pos + r]
+                pos = pos[self.snap.base_visible[pos]]
+                base = epoch.handles[pos]
+        snap = self.snap
+        m = len(snap.overlay_handles)
+        if m == 0:
+            return base
+        data = snap.overlay_columns[off]
+        mask = np.ones(m, dtype=bool)
+        ovv = snap.overlay_valids[off]
+        if ovv is not None:
+            mask &= ovv
+        if lo is not None:
+            mask &= (data >= lo) if lo_incl else (data > lo)
+        if hi is not None:
+            mask &= (data <= hi) if hi_incl else (data < hi)
+        return np.concatenate([base, snap.overlay_handles[mask]])
 
     def _overlay_eq(self, values: tuple) -> np.ndarray:
         snap = self.snap

@@ -25,8 +25,11 @@ class WriteConflictError(Exception):
 
 class Storage:
     def __init__(self) -> None:
+        from ..stats import StatsHandle
+
         self.catalog = Catalog()
         self.tso = TimestampOracle()
+        self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
         self._commit_lock = threading.Lock()
         # active snapshot ts registry -> GC/compaction safepoint

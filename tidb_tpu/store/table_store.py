@@ -178,6 +178,9 @@ class TableStore:
         self._lock = threading.RLock()
         # (epoch_id, index_id) -> sorted permutation; see store/index.py
         self._index_orders: dict[tuple[int, int], np.ndarray] = {}
+        # rows touched since creation — the auto-analyze delta feed
+        # (reference: stats delta in handle/update.go)
+        self.modify_count = 0
 
     # ---- write path --------------------------------------------------------
     def alloc_handle(self) -> int:
@@ -207,6 +210,7 @@ class TableStore:
         """Record one committed mutation (row tuple or TOMBSTONE)."""
         with self._lock:
             self.deltas.append((commit_ts, handle, row))
+            self.modify_count += 1
 
     def latest_commit_ts(self, handle: int) -> int:
         """Newest commit touching handle (0 if only in base/absent) —
@@ -305,6 +309,7 @@ class TableStore:
                         f"expected {n}")
         with self._lock:
             epoch = self.epoch
+            self.modify_count += n
             handles = np.arange(self._next_handle, self._next_handle + n,
                                 dtype=np.int64)
             self._next_handle += n
