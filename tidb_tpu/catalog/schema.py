@@ -37,6 +37,10 @@ class IndexInfo:
     col_offsets: list[int]
     unique: bool = False
     primary: bool = False
+    # False while the index is being built online (delete-only/write-only/
+    # write-reorg states, reference ddl/index.go): writes maintain it, the
+    # planner must not read it yet
+    visible: bool = True
 
 
 @dataclass
@@ -153,3 +157,12 @@ class Catalog:
             return self.table(db, name)
         except KeyError:
             return None
+
+    def replace_table(self, db: str, old_name: str, info: TableInfo) -> None:
+        """Swap in a new TableInfo object (DDL publishes new schema versions
+        as fresh immutable-ish objects so in-flight snapshots keep the old
+        one — the schema-version delta apply of infoschema/builder.go)."""
+        schema = self.schema(db)
+        schema.tables.pop(old_name.lower(), None)
+        schema.tables[info.name.lower()] = info
+        self.bump_version()

@@ -1,0 +1,3 @@
+"""Online DDL subsystem (SURVEY.md §2 L9: ddl/ job queue + state machine)."""
+
+from .ddl import DDL, DDLError, DDLJob  # noqa: F401

@@ -508,6 +508,8 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
     # they stay eligible
     has_subq = any(_has_subq(c) for c in conditions)
     for index in table.indices:
+        if not index.visible:
+            continue  # still being built online (ddl/ddl.py)
         r = extract_points(table, index, conditions, col_map)
         if r is None:
             continue
@@ -535,6 +537,8 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
     # interval ranges: only with statistics backing the choice
     if ts is not None and not has_subq:
         for index in table.indices:
+            if not index.visible:
+                continue
             off0 = index.col_offsets[0]
             if table.columns[off0].ftype.is_string:
                 continue

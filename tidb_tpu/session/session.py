@@ -134,7 +134,104 @@ class Session:
             return ResultSet([], [])
         if isinstance(stmt, ast.AnalyzeTableStmt):
             return self._exec_analyze(stmt)
+        if isinstance(stmt, ast.AlterTableStmt):
+            return self._exec_alter(stmt)
+        if isinstance(stmt, ast.CreateIndexStmt):
+            return self._exec_ddl_job("add_index", stmt.table, {
+                "name": stmt.name, "columns": stmt.columns,
+                "unique": stmt.unique})
+        if isinstance(stmt, ast.DropIndexStmt):
+            return self._exec_ddl_job("drop_index", stmt.table,
+                                      {"name": stmt.name})
+        if isinstance(stmt, ast.RenameTableStmt):
+            for old, new in stmt.renames:
+                self._exec_ddl_job("rename_table", old, {
+                    "new_name": new.name,
+                    "new_db": new.db or old.db or self.current_db})
+            return ResultSet([], [])
+        if isinstance(stmt, ast.AdminStmt):
+            if stmt.kind == "SHOW_DDL_JOBS":
+                jobs = (list(self.storage.ddl_jobs)
+                        + list(reversed(self.storage.ddl_history)))
+                return ResultSet(
+                    ["JOB_ID", "DB_NAME", "TABLE_NAME", "JOB_TYPE",
+                     "SCHEMA_STATE", "STATE", "ERROR"],
+                    [j.row() for j in jobs[:32]])
+            raise SQLError(f"unsupported ADMIN {stmt.kind}")
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    # ==================== online DDL ====================
+    def _ddl(self):
+        from ..ddl import DDL
+
+        return DDL(self.storage, self.catalog)
+
+    def _exec_ddl_job(self, kind: str, tn: ast.TableName,
+                      args: dict) -> ResultSet:
+        from ..ddl import DDLError
+
+        self._commit_implicit()  # DDL implicitly commits (MySQL semantics)
+        info, _ = self._table_for(tn)
+        ddl = self._ddl()
+        job = ddl.submit(kind, tn.db or self.current_db, info, args)
+        try:
+            ddl.run_job(job)
+        except DDLError as e:
+            raise SQLError(str(e)) from None
+        return ResultSet([], [])
+
+    def _exec_alter(self, stmt: ast.AlterTableStmt) -> ResultSet:
+        for spec in stmt.specs:
+            if spec.op == "add_index":
+                idef = spec.index
+                if idef.primary:
+                    raise SQLError("ADD PRIMARY KEY after create is "
+                                   "unsupported")
+                name = idef.name or f"idx_{'_'.join(idef.columns)}"
+                self._exec_ddl_job("add_index", stmt.table, {
+                    "name": name, "columns": idef.columns,
+                    "unique": idef.unique})
+            elif spec.op == "drop_index":
+                self._exec_ddl_job("drop_index", stmt.table,
+                                   {"name": spec.name})
+            elif spec.op == "add_column":
+                cd = spec.column
+                ft = _coldef_ftype(cd)
+                default = None
+                if cd.default is not None:
+                    c = _literal_const(cd.default)
+                    default = self._decode_default(c, ft)
+                self._exec_ddl_job("add_column", stmt.table, {
+                    "name": cd.name, "ftype": ft, "default": default,
+                    "phys_default": self._phys_value(default, ft)})
+            elif spec.op == "drop_column":
+                self._exec_ddl_job("drop_column", stmt.table,
+                                   {"name": spec.name})
+            elif spec.op == "modify_column":
+                cd = spec.column
+                self._exec_ddl_job("modify_column", stmt.table,
+                                   {"name": cd.name,
+                                    "ftype": _coldef_ftype(cd)})
+            elif spec.op == "rename":
+                self._exec_ddl_job("rename_table", stmt.table, {
+                    "new_name": spec.name,
+                    "new_db": stmt.table.db or self.current_db})
+                stmt = ast.AlterTableStmt(
+                    ast.TableName(spec.name, stmt.table.db), [])
+            else:
+                raise SQLError(f"unsupported ALTER action {spec.op}")
+        return ResultSet([], [])
+
+    def _phys_value(self, v, ft: FieldType):
+        """Host default -> physical encoding (scaled decimal, day number)."""
+        if v is None:
+            return None
+        from ..chunk.column import _encode_scalar
+
+        d = None
+        if ft.is_string:
+            return str(v)
+        return _encode_scalar(ft, v, d)
 
     def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> ResultSet:
         """ANALYZE TABLE: build histograms/sketches from a fresh snapshot
@@ -568,6 +665,14 @@ class Session:
         except KeyError as e:
             raise SQLError(str(e)) from None
         return info, self.storage.table_store(info.id)
+
+
+def _coldef_ftype(cd) -> FieldType:
+    """Column-definition type with NOT NULL applied."""
+    ft = cd.ftype
+    if cd.not_null:
+        return FieldType(ft.kind, ft.flen, ft.scale, nullable=False)
+    return ft
 
 
 class _UniqueChecker:

@@ -240,6 +240,47 @@ class DropTableStmt(Stmt):
 
 
 @dataclass
+class AlterSpec:
+    """One ALTER TABLE action (reference: ast.AlterTableSpec)."""
+
+    op: str  # add_column | drop_column | add_index | drop_index |
+    #          modify_column | rename
+    column: Optional[ColumnDef] = None
+    index: Optional[IndexDef] = None
+    name: str = ""  # drop target / rename-to name
+
+
+@dataclass
+class AlterTableStmt(Stmt):
+    table: TableName
+    specs: list[AlterSpec] = field(default_factory=list)
+
+
+@dataclass
+class CreateIndexStmt(Stmt):
+    name: str
+    table: TableName
+    columns: list[str]
+    unique: bool = False
+
+
+@dataclass
+class DropIndexStmt(Stmt):
+    name: str
+    table: TableName
+
+
+@dataclass
+class RenameTableStmt(Stmt):
+    renames: list[tuple[TableName, TableName]] = field(default_factory=list)
+
+
+@dataclass
+class AdminStmt(Stmt):
+    kind: str  # 'SHOW_DDL_JOBS'
+
+
+@dataclass
 class CreateDatabaseStmt(Stmt):
     name: str
     if_not_exists: bool = False

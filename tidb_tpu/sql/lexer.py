@@ -66,6 +66,7 @@ KEYWORDS = frozenset(
     FIRST AFTER MODIFY CHANGE RENAME TO TRUNCATE
     GLOBAL SESSION VARIABLES STATUS
     FOR
+    ADMIN DDL JOBS
     """.split()
 )
 

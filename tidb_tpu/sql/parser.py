@@ -176,7 +176,81 @@ class Parser:
             while self.accept_op(","):
                 tables.append(self.parse_table_name())
             return ast.AnalyzeTableStmt(tables)
+        if t.is_kw("ALTER"):
+            return self.parse_alter()
+        if t.is_kw("RENAME"):
+            self.advance()
+            self.expect_kw("TABLE")
+            renames = []
+            while True:
+                old = self.parse_table_name()
+                self.expect_kw("TO")
+                renames.append((old, self.parse_table_name()))
+                if not self.accept_op(","):
+                    break
+            return ast.RenameTableStmt(renames)
+        if t.is_kw("ADMIN"):
+            self.advance()
+            self.expect_kw("SHOW")
+            self.expect_kw("DDL")
+            self.expect_kw("JOBS")
+            return ast.AdminStmt("SHOW_DDL_JOBS")
         raise ParseError("unsupported statement", t)
+
+    def parse_alter(self) -> ast.AlterTableStmt:
+        self.expect_kw("ALTER")
+        self.expect_kw("TABLE")
+        table = self.parse_table_name()
+        specs: list[ast.AlterSpec] = []
+        while True:
+            if self.accept_kw("ADD"):
+                if self.cur.is_kw("PRIMARY"):
+                    self.advance()
+                    self.expect_kw("KEY")
+                    specs.append(ast.AlterSpec(
+                        "add_index",
+                        index=ast.IndexDef("PRIMARY", self._paren_ident_list(),
+                                           unique=True, primary=True)))
+                elif self.cur.is_kw("UNIQUE"):
+                    self.advance()
+                    self.accept_kw("KEY", "INDEX")
+                    name = self._opt_index_name()
+                    specs.append(ast.AlterSpec(
+                        "add_index",
+                        index=ast.IndexDef(name, self._paren_ident_list(),
+                                           unique=True)))
+                elif self.cur.is_kw("KEY", "INDEX"):
+                    self.advance()
+                    name = self._opt_index_name()
+                    specs.append(ast.AlterSpec(
+                        "add_index",
+                        index=ast.IndexDef(name, self._paren_ident_list())))
+                else:
+                    self.accept_kw("COLUMN")
+                    specs.append(ast.AlterSpec(
+                        "add_column", column=self.parse_column_def()))
+            elif self.accept_kw("DROP"):
+                if self.cur.is_kw("KEY", "INDEX"):
+                    self.advance()
+                    specs.append(ast.AlterSpec("drop_index",
+                                               name=self.expect_ident()))
+                else:
+                    self.accept_kw("COLUMN")
+                    specs.append(ast.AlterSpec("drop_column",
+                                               name=self.expect_ident()))
+            elif self.accept_kw("MODIFY"):
+                self.accept_kw("COLUMN")
+                specs.append(ast.AlterSpec(
+                    "modify_column", column=self.parse_column_def()))
+            elif self.accept_kw("RENAME"):
+                self.accept_kw("TO", "AS")
+                specs.append(ast.AlterSpec("rename",
+                                           name=self.expect_ident()))
+            else:
+                raise ParseError("unsupported ALTER action", self.cur)
+            if not self.accept_op(","):
+                break
+        return ast.AlterTableStmt(table, specs)
 
     # ---- SELECT ------------------------------------------------------------
     def parse_select(self) -> ast.SelectStmt:
@@ -386,6 +460,15 @@ class Parser:
         if self.accept_kw("DATABASE", "SCHEMA"):
             ine = self._if_not_exists()
             return ast.CreateDatabaseStmt(self.expect_ident(), ine)
+        unique = bool(self.accept_kw("UNIQUE"))
+        if self.accept_kw("INDEX", "KEY"):
+            name = self.expect_ident()
+            self.expect_kw("ON")
+            table = self.parse_table_name()
+            return ast.CreateIndexStmt(name, table,
+                                       self._paren_ident_list(), unique)
+        if unique:
+            raise ParseError("expected INDEX after CREATE UNIQUE", self.cur)
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         table = self.parse_table_name()
@@ -504,6 +587,10 @@ class Parser:
         if self.accept_kw("DATABASE", "SCHEMA"):
             if_exists = self._if_exists()
             return ast.DropDatabaseStmt(self.expect_ident(), if_exists)
+        if self.accept_kw("INDEX", "KEY"):
+            name = self.expect_ident()
+            self.expect_kw("ON")
+            return ast.DropIndexStmt(name, self.parse_table_name())
         self.expect_kw("TABLE")
         if_exists = self._if_exists()
         tables = [self.parse_table_name()]
@@ -808,6 +895,7 @@ _IDENT_KEYWORDS = frozenset(
     """
     DATE TIME TIMESTAMP DATETIME YEAR STATUS VARIABLES TABLES DATABASES
     COUNT SUM AVG MIN MAX COLUMN FIRST AFTER BEGIN COMMIT IF
+    ADMIN DDL JOBS
     """.split()
 )
 

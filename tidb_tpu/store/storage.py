@@ -31,6 +31,11 @@ class Storage:
         self.tso = TimestampOracle()
         self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
+        # DDL job queue + history (the meta-KV DDLJobList analog,
+        # reference meta/meta.go:571) — lives on storage so a replacement
+        # worker resumes pending jobs with their reorg checkpoints
+        self.ddl_jobs: list = []
+        self.ddl_history: list = []
         self._commit_lock = threading.Lock()
         # active snapshot ts registry -> GC/compaction safepoint
         self._active_snapshots: dict[int, int] = {}
@@ -81,6 +86,14 @@ class Storage:
         if not mutations:
             return txn.start_ts
         with self._commit_lock:
+            for table_id, token in txn.schema_tokens.items():
+                store = self.tables.get(table_id)
+                if store is not None and store.schema_token != token:
+                    # rows were buffered against an older layout (reference:
+                    # schema validator fails the txn, domain/schema_validator.go)
+                    raise WriteConflictError(
+                        "Information schema is changed during the execution "
+                        "of the statement; try again")
             for (table_id, handle), _ in mutations.items():
                 store = self.tables.get(table_id)
                 if store is None:
@@ -117,13 +130,23 @@ class Transaction:
         self.start_ts = start_ts
         self.memdb = MemDB()
         self._finished = False
+        # table_id -> schema_token observed at first buffered write
+        self.schema_tokens: dict[int, int] = {}
 
     # ---- writes ------------------------------------------------------------
     def set_row(self, table_id: int, handle: int, row: tuple) -> None:
+        self._note_schema(table_id)
         self.memdb.set((table_id, handle), row)
 
     def delete_row(self, table_id: int, handle: int) -> None:
+        self._note_schema(table_id)
         self.memdb.set((table_id, handle), TOMBSTONE)
+
+    def _note_schema(self, table_id: int) -> None:
+        if table_id not in self.schema_tokens:
+            store = self.storage.tables.get(table_id)
+            if store is not None:
+                self.schema_tokens[table_id] = store.schema_token
 
     # ---- reads -------------------------------------------------------------
     def snapshot(self, table_id: int) -> TableSnapshot:
