@@ -806,6 +806,11 @@ class Parser:
         if t.kind == TokenKind.KEYWORD and t.text in _AGG_FUNCS:
             self.advance()
             return self.parse_func_call(t.text)
+        # reserved words that double as function names when followed by (
+        if t.kind == TokenKind.KEYWORD and t.text in _FUNC_KEYWORDS and \
+                self.peek().is_op("("):
+            self.advance()
+            return self.parse_func_call(t.text)
         if t.kind == TokenKind.IDENT or (
             t.kind == TokenKind.KEYWORD and t.text in _IDENT_KEYWORDS
         ):
