@@ -904,6 +904,16 @@ _IDENT_KEYWORDS = frozenset(
     """.split()
 )
 
+# Reserved words that double as function names when followed immediately by
+# '(' — mirrors MySQL's treatment of LEFT(), RIGHT(), REPLACE(), etc.
+# Keywords already in _IDENT_KEYWORDS (IF, DATE, YEAR, ...) are handled by
+# the identifier branch and are deliberately not repeated here.
+_FUNC_KEYWORDS = frozenset(
+    """
+    LEFT RIGHT REPLACE MOD TRUNCATE DATABASE SCHEMA CHAR
+    """.split()
+)
+
 
 def parse_sql(text: str) -> list[ast.Stmt]:
     return Parser(text).parse()
