@@ -1,0 +1,179 @@
+"""Host-side integer interval analysis over plan expressions.
+
+Mirrors the device lowering in eval.py (including its decimal scale
+alignment) to compute a conservative [lo, hi] bound for each integer-valued
+expression, from per-column min/max epoch statistics. Two uses:
+
+* staging: an int64 column whose values fit int32 uploads as int32 (halves
+  HBM footprint and host->device transfer);
+* exact MXU aggregation: the one-hot einsum segment-sum (client.py) splits
+  values into 12-bit limbs accumulated in float32; the bound picks the
+  minimal limb count that keeps every partial sum exactly representable.
+
+Returns None when a bound can't be established (floats, strings, unknown
+ops) — callers then assume the full int64 range.
+
+Reference analog: TiDB's planner tracks field length/decimal for overflow
+decisions (types/field_type.go flen/decimal); here the same metadata drives
+physical kernel layout instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..plan.expr import Call, Col, Const, PlanExpr
+
+Bound = Optional[tuple[int, int]]
+
+_I64 = (-(2**63), 2**63 - 1)
+
+
+def _scale(diff: int) -> int:
+    return 10 ** diff
+
+
+def _mul_bound(a: tuple[int, int], b: tuple[int, int]) -> tuple[int, int]:
+    cands = [a[0] * b[0], a[0] * b[1], a[1] * b[0], a[1] * b[1]]
+    return (min(cands), max(cands))
+
+
+def _union(a: Bound, b: Bound) -> Bound:
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
+    """[lo, hi] of the expression's device value (scaled-int semantics)."""
+    if isinstance(e, Col):
+        ft = e.ftype
+        if ft.is_float:
+            return None
+        if ft.is_string:
+            return col_bounds[e.idx]  # dict codes
+        return col_bounds[e.idx]
+    if isinstance(e, Const):
+        if e.value is None:
+            return (0, 0)
+        if isinstance(e.value, (bool, np.bool_)):
+            return (0, 1)
+        if isinstance(e.value, (int, np.integer)):
+            v = int(e.value)
+            return (v, v)
+        return None
+    if not isinstance(e, Call):
+        return None
+
+    op = e.op
+
+    def sub(i: int) -> Bound:
+        return expr_bounds(e.args[i], col_bounds)
+
+    if op in ("and", "or", "not", "isnull", "eq", "ne", "lt", "le", "gt",
+              "ge", "in_values", "like", "dict_lookup"):
+        return (0, 1)
+    if op in ("add", "sub"):
+        a, b = sub(0), sub(1)
+        if a is None or b is None:
+            return None
+        at, bt = e.args[0].ftype, e.args[1].ftype
+        if e.ftype.is_decimal:
+            sa = at.scale if at.is_decimal else 0
+            sb = bt.scale if bt.is_decimal else 0
+            s = e.ftype.scale
+            if sa < s:
+                a = (a[0] * _scale(s - sa), a[1] * _scale(s - sa))
+            if sb < s:
+                b = (b[0] * _scale(s - sb), b[1] * _scale(s - sb))
+        if op == "add":
+            return (a[0] + b[0], a[1] + b[1])
+        return (a[0] - b[1], a[1] - b[0])
+    if op == "mul":
+        a, b = sub(0), sub(1)
+        if a is None or b is None or e.ftype.is_float:
+            return None
+        return _mul_bound(a, b)
+    if op == "neg":
+        a = sub(0)
+        return None if a is None else (-a[1], -a[0])
+    if op == "abs":
+        a = sub(0)
+        if a is None:
+            return None
+        m = max(abs(a[0]), abs(a[1]))
+        lo = 0 if a[0] <= 0 <= a[1] else min(abs(a[0]), abs(a[1]))
+        return (lo, m)
+    if op in ("intdiv", "mod"):
+        a, b = sub(0), sub(1)
+        if a is None:
+            return None
+        m = max(abs(a[0]), abs(a[1]))
+        return (-m, m)
+    if op in ("if",):
+        return _union(sub(1), sub(2))
+    if op == "ifnull":
+        return _union(sub(0), sub(1))
+    if op == "coalesce":
+        out = sub(0)
+        for i in range(1, len(e.args)):
+            out = _union(out, sub(i))
+        return out
+    if op == "case":
+        has_else = len(e.args) % 2 == 1
+        pairs = (len(e.args) - 1) // 2 if has_else else len(e.args) // 2
+        out: Bound = sub(len(e.args) - 1) if has_else else (0, 0)
+        for i in range(pairs):
+            out = _union(out, expr_bounds(e.args[2 * i + 1], col_bounds))
+        return out
+    if op == "year":
+        return (0, 9999)
+    if op == "month":
+        return (0, 12)
+    if op == "day":
+        return (0, 31)
+    if op == "date_add_days":
+        a = sub(0)
+        if a is None:
+            return None
+        d = int(e.extra)
+        return (a[0] + min(d, 0), a[1] + max(d, 0))
+    if op == "cast":
+        src = e.args[0].ftype
+        dst = e.ftype
+        a = sub(0)
+        if a is None:
+            return None
+        if dst.is_float:
+            return None
+        if dst.is_decimal:
+            ss = src.scale if src.is_decimal else 0
+            if ss < dst.scale:
+                f = _scale(dst.scale - ss)
+                return (a[0] * f, a[1] * f)
+            if ss > dst.scale:
+                f = _scale(ss - dst.scale)
+                return (a[0] // f - 1, a[1] // f + 1)
+            return a
+        if dst.is_integer:
+            if src.is_decimal:
+                f = _scale(src.scale)
+                return (a[0] // f - 1, a[1] // f + 1)
+            return a
+        return None
+    return None
+
+
+def fits_int32(b: Bound) -> bool:
+    return b is not None and b[0] >= -(2**31) and b[1] < 2**31
+
+
+def limbs_for(b: Bound, limb_bits: int = 12, max_limbs: int = 6) -> int:
+    """Number of signed limb_bits-bit limbs covering [lo, hi] exactly."""
+    if b is None:
+        return max_limbs
+    need = max(int(abs(b[0])), int(abs(b[1])), 1).bit_length() + 1
+    n = -(-need // limb_bits)
+    return max(1, min(n, max_limbs))

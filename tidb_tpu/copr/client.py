@@ -6,23 +6,34 @@ store/mockstore/unistore/cophandler/closure_exec.go). Differences, TPU-first:
 
 * The scan source is the table's immutable column epoch, cached on device
   and padded to shape buckets (static shapes for XLA; the coprocessor-cache
-  analog of store/tikv/coprocessor_cache.go:30).
+  analog of store/tikv/coprocessor_cache.go:30). int64 columns whose values
+  fit int32 (per epoch min/max stats) upload as int32 — half the HBM
+  footprint and transfer time — and widen back in-register inside the
+  kernel, so arithmetic stays exact int64.
 * scan -> selection -> projection/aggregation/topN lower to ONE jitted
-  program; XLA fuses the elementwise pipeline into the reductions.
-* Partial aggregation uses dense segment ids when group-key cardinality is
-  statically known (string dict codes / booleans): jax.ops.segment_sum over
-  a fixed segment count — the partial stage of P2 (reference
-  executor/aggregate.go two-stage hash agg). Final merge happens host-side
-  in the executor (or via psum across a mesh in the distributed path).
+  program with ONE packed output buffer. This matters enormously: every
+  device->host fetch pays a fixed round-trip, so the kernel gathers/packs
+  everything (TopN rows included) into a single int64 array (+ one float64
+  array only when float aggregates exist).
+* Aggregation is scatter-free (TPU scatter-add serializes): group keys map
+  to a dense mixed-radix segment space; small spaces (<=64) reduce via
+  per-segment masked sums (XLA fuses them into one pass), larger spaces
+  (<=8192) via an exact one-hot einsum on the MXU — values split into
+  signed 12-bit limbs accumulated in float32 with per-block partials kept
+  < 2^24 so every sum is exact, then recombined in int64. Limb counts come
+  from host-side interval analysis (bounds.py). This replaces the partial
+  stage of the reference's two-stage hash agg (executor/aggregate.go:146).
 * MVCC overlay rows (small, host-resident) run through the same kernels in
   a small shape bucket, and partial results merge at the final stage.
 
-Host fallbacks (numpy) cover what the device gate rejects: high-cardinality
-group keys (until the sort-based kernel lands) and multi-key/string TopN.
+Host fallbacks (numpy) cover what the device gate rejects: unbounded or
+>8192-cardinality group keys, min/max or float aggregates over >64 segments,
+multi-key/string TopN, string ordering compares.
 """
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -37,12 +48,19 @@ from ..plan.expr import Call, Col, Const, PlanExpr
 from ..store.table_store import TableSnapshot
 from ..types.field_type import FieldType, TypeKind
 from . import host_exec
+from .bounds import Bound, expr_bounds, fits_int32, limbs_for
 from .eval import CompileError, eval_expr, selection_mask
+from .npeval import NumpyEval
 
 _INT_MAX = np.int64(2**63 - 1)
 _INT_MIN = np.int64(-(2**63) + 1)
 
-MAX_DENSE_SEGMENTS = 1 << 16
+# dense segment space caps per reduction strategy
+MAX_LOOP_SEGMENTS = 64
+MAX_DENSE_SEGMENTS = 1 << 13
+
+_LIMB_BITS = 12
+_EINSUM_BLOCK = 2048
 
 
 def _bucket(n: int) -> int:
@@ -69,29 +87,39 @@ class CopResult:
 
 class CopClient:
     def __init__(self) -> None:
-        # (epoch_id, offset, bucket) -> (device data, device valid)
-        self._col_cache: dict[tuple[int, int, int], tuple[Any, Any]] = {}
-        # (epoch_id, bucket) -> device visibility mask
-        self._mask_cache: dict[tuple[int, int, str], Any] = {}
+        # (epoch_id, offset, bucket, narrowed) -> (device data, device valid)
+        self._col_cache: dict[tuple, tuple[Any, Any]] = {}
+        # (epoch_id, bucket, digest) -> device visibility mask
+        self._mask_cache: dict[tuple, Any] = {}
         # compiled kernel cache
         self._kernels: dict[Any, Any] = {}
         # table_id -> last seen epoch_id, for cache eviction
         self._live_epochs: dict[int, int] = {}
+        # (epoch_id, offset) -> integer (lo, hi) or None
+        self._stats: dict[tuple[int, int], Bound] = {}
+        # guards the caches; kernels themselves are thread-safe to call
+        self._lock = threading.RLock()
 
     def _evict_stale(self, table_id: int, epoch_id: int) -> None:
         """Free device buffers cached for a table's superseded epochs
         (compaction/bulk_load create a fresh epoch; the old one's padded
         device copies would otherwise pin HBM for the session lifetime)."""
-        old = self._live_epochs.get(table_id)
-        if old == epoch_id:
-            return
-        self._live_epochs[table_id] = epoch_id
-        if old is None:
-            return
-        for k in [k for k in self._col_cache if k[0] == old]:
-            del self._col_cache[k]
-        for k in [k for k in self._mask_cache if k[0] == old]:
-            del self._mask_cache[k]
+        with self._lock:
+            old = self._live_epochs.get(table_id)
+            if old is not None and epoch_id <= old:
+                # a session reading an older snapshot must not evict the
+                # current epoch's device buffers (shared CopClient: other
+                # threads are on the newer epoch)
+                return
+            self._live_epochs[table_id] = epoch_id
+            if old is None:
+                return
+            for k in [k for k in self._col_cache if k[0] == old]:
+                del self._col_cache[k]
+            for k in [k for k in self._mask_cache if k[0] == old]:
+                del self._mask_cache[k]
+            for k in [k for k in self._stats if k[0] == old]:
+                del self._stats[k]
 
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
@@ -116,15 +144,60 @@ class CopClient:
         return CopResult(chunks, is_partial_agg=dag.agg is not None)
 
     # ==================== preparation (host-side resolution) ================
+    def _col_stats(self, snap: TableSnapshot, off: int) -> Bound:
+        """Integer (lo, hi) over valid epoch values, cached per epoch."""
+        key = (snap.epoch.epoch_id, off)
+        with self._lock:
+            if key in self._stats:
+                return self._stats[key]
+        data = snap.epoch.columns[off]
+        valid = snap.epoch.valids[off]
+        b: Bound = None
+        if data.dtype.kind in "iub" and len(data):
+            vals = data if valid is None else data[valid]
+            if len(vals):
+                b = (int(vals.min()), int(vals.max()))
+            else:
+                b = (0, 0)
+        elif data.dtype.kind in "iub":
+            b = (0, 0)
+        with self._lock:
+            self._stats[key] = b
+        return b
+
+    def _scan_bounds(self, dag: CopDAG, snap: TableSnapshot) -> list[Bound]:
+        """Per scan-column [lo, hi] covering epoch AND overlay values, so one
+        kernel decision (staging width, limb count, key offset) is valid for
+        both batches of an execute."""
+        out: list[Bound] = []
+        for off in dag.scan.col_offsets:
+            b = self._col_stats(snap, off)
+            if len(snap.overlay_handles):
+                od = snap.overlay_columns[off]
+                ov = snap.overlay_valids[off]
+                if od.dtype.kind in "iub" and len(od):
+                    vals = od if ov is None else od[ov]
+                    if len(vals):
+                        ob = (int(vals.min()), int(vals.max()))
+                        b = None if b is None else (
+                            min(b[0], ob[0]), max(b[1], ob[1]))
+                else:
+                    b = None if od.dtype.kind not in "iub" else b
+            out.append(b)
+        return out
+
     def _prepare(
         self, dag: CopDAG, snap: TableSnapshot
-    ) -> tuple[Optional[dict[int, Any]], Optional[str]]:
-        """Resolve string constants/predicates against column dictionaries.
-        Returns (prepared, None) for the device path or (None, reason) to
-        force the host fallback."""
+    ) -> tuple[Optional[dict[Any, Any]], Optional[str]]:
+        """Resolve string constants/predicates against column dictionaries,
+        pick the aggregation strategy, and bound value ranges. Returns
+        (prepared, None) for the device path or (None, reason) to force the
+        host fallback."""
         prepared: dict[Any, Any] = {}
         prepared["__sig__"] = []  # deterministic cache-key payload signature
         dicts = self._scan_dicts(dag, snap)
+        col_bounds = self._scan_bounds(dag, snap)
+        prepared["__col_bounds__"] = col_bounds
 
         try:
             exprs: list[PlanExpr] = []
@@ -145,10 +218,31 @@ class CopClient:
             return None, str(ce)
 
         if dag.agg is not None:
-            cards = self._dense_cards(dag, dicts)
+            cards, offsets = self._dense_cards(dag, dicts, col_bounds)
             if cards is None:
                 return None, "group keys not dense-encodable on device"
             prepared["__dense_cards__"] = cards
+            prepared["__key_offsets__"] = offsets
+            segments = 1
+            for c in cards:
+                segments *= max(c, 1)
+            strategy = self._agg_strategy(segments, dag.agg.aggs)
+            if strategy is None:
+                return None, (
+                    f"{segments} segments with min/max or float aggregates "
+                    "is host-side")
+            prepared["__strategy__"] = strategy
+            if strategy == "einsum":
+                limbs = []
+                for d in dag.agg.aggs:
+                    if d.arg is None or d.func == "count":
+                        limbs.append(1)
+                    else:
+                        limbs.append(limbs_for(
+                            expr_bounds(d.arg, col_bounds), _LIMB_BITS))
+                prepared["__limbs__"] = limbs
+            prepared["__sig__"].append(
+                (strategy, tuple(cards), tuple(offsets)))
         if dag.topn is not None:
             if len(dag.topn.items) != 1:
                 return None, "multi-key TopN is host-side for now"
@@ -156,6 +250,17 @@ class CopClient:
             if e.ftype.is_string:
                 return None, "string TopN key is host-side"
         return prepared, None
+
+    @staticmethod
+    def _agg_strategy(segments: int, aggs) -> Optional[str]:
+        if segments <= MAX_LOOP_SEGMENTS:
+            return "loop"
+        for d in aggs:
+            if d.func in ("min", "max"):
+                return None
+            if d.arg is not None and d.arg.ftype.is_float:
+                return None
+        return "einsum"
 
     def _scan_dicts(self, dag: CopDAG, snap: TableSnapshot) -> list[Optional[Dictionary]]:
         return [snap.dictionaries[off] for off in dag.scan.col_offsets]
@@ -244,14 +349,6 @@ class CopClient:
             prepared[id(const)] = d.lookup(s)
             prepared["__sig__"].append(prepared[id(const)])
             return
-        # ordering compare vs constant: per-code truth table (binary collation)
-        op = e.op
-        if swapped:
-            op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}[op]
-        fn = {"lt": lambda v: v < s, "le": lambda v: v <= s,
-              "gt": lambda v: v > s, "ge": lambda v: v >= s}[op]
-        table = d.code_table(fn)
-        # rewrite handled in eval via dict_lookup? round 1: host-side
         raise CompileError("string ordering compare is host-side for now")
 
     @staticmethod
@@ -261,27 +358,46 @@ class CopClient:
         return None
 
     def _dense_cards(
-        self, dag: CopDAG, dicts: list[Optional[Dictionary]]
-    ) -> Optional[list[int]]:
-        """Per-group-key cardinality (+1 for the NULL slot) when statically
-        known; None forces the host path."""
+        self, dag: CopDAG, dicts: list[Optional[Dictionary]],
+        col_bounds: list[Bound],
+    ) -> tuple[Optional[list[int]], Optional[list[int]]]:
+        """Per-group-key (cardinality+1 for NULL, value offset). String keys
+        use dictionary codes; integer/date/decimal keys use epoch min/max
+        stats — card = hi-lo+2, key = value-lo (reference analog: the
+        two-stage hash agg key space, executor/aggregate.go:146, made dense
+        so the reduction is a fixed-shape XLA program)."""
         assert dag.agg is not None
         cards: list[int] = []
+        offsets: list[int] = []
         for g in dag.agg.group_by:
             if isinstance(g, Col) and g.ftype.is_string:
                 d = dicts[g.idx]
                 assert d is not None
                 cards.append(len(d) + 1)
+                offsets.append(0)
+            elif g.ftype.is_string:
+                return None, None
             elif isinstance(g, Col) and g.ftype.kind == TypeKind.BOOLEAN:
                 cards.append(3)
+                offsets.append(0)
+            elif g.ftype.is_float:
+                return None, None
             else:
-                return None
+                b = expr_bounds(g, col_bounds)
+                if b is None:
+                    return None, None
+                lo, hi = b
+                card = hi - lo + 2
+                if card > MAX_DENSE_SEGMENTS:
+                    return None, None
+                cards.append(card)
+                offsets.append(lo)
         prod = 1
         for c in cards:
             prod *= max(c, 1)
         if prod > MAX_DENSE_SEGMENTS:
-            return None
-        return cards
+            return None, None
+        return cards, offsets
 
     def _bucket_size(self, n: int) -> int:
         return _bucket(n)
@@ -291,77 +407,130 @@ class CopClient:
         self,
         dag: CopDAG,
         snap: TableSnapshot,
-        prepared: dict[int, Any],
+        prepared: dict[Any, Any],
         overlay: bool,
     ) -> list[Chunk]:
-        cols, row_mask, host_cols = self._stage_inputs(dag, snap, overlay)
+        cols, row_mask, host_cols, narrowed = self._stage_inputs(
+            dag, snap, overlay, col_bounds=prepared.get("__col_bounds__"))
         if dag.agg is not None:
-            return self._run_agg(dag, snap, prepared, cols, row_mask)
+            return self._run_agg(dag, snap, prepared, cols, row_mask, narrowed)
         if dag.topn is not None:
             return self._run_topn(dag, snap, prepared, cols, row_mask,
-                                  host_cols)
-        return self._run_rows(dag, snap, prepared, cols, row_mask, host_cols)
+                                  host_cols, narrowed)
+        return self._run_rows(dag, snap, prepared, cols, row_mask, host_cols,
+                              narrowed)
 
-    def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool):
+    def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool,
+                      col_bounds: Optional[list[Bound]] = None):
         """Pad + upload scan columns; returns device (data, valid) pairs, the
-        row-visibility mask, and the host numpy views for compaction."""
+        row-visibility mask, host numpy views, and per-column narrowed flags
+        (int64 columns staged as int32 when epoch+overlay values fit)."""
         offsets = dag.scan.col_offsets
+        if col_bounds is None:
+            col_bounds = self._scan_bounds(dag, snap)
+        narrowed = tuple(
+            snap.epoch.columns[off].dtype == np.int64
+            and fits_int32(col_bounds[ci])
+            for ci, off in enumerate(offsets)
+        )
         if overlay:
             n = len(snap.overlay_handles)
             b = self._bucket_size(n)
             host_cols = []
             dev_cols = []
-            for off in offsets:
+            for ci, off in enumerate(offsets):
                 data = snap.overlay_columns[off]
                 valid = snap.overlay_valids[off]
                 vfull = np.ones(n, bool) if valid is None else valid
                 host_cols.append((data, vfull))
+                up = data.astype(np.int32) if narrowed[ci] else data
                 dev_cols.append((
-                    jnp.asarray(_pad(data, b)),
+                    jnp.asarray(_pad(up, b)),
                     jnp.asarray(_pad_bool(vfull, b)),
                 ))
             mask = np.zeros(b, bool)
             mask[:n] = True
-            return dev_cols, jnp.asarray(mask), host_cols
+            return dev_cols, jnp.asarray(mask), host_cols, narrowed
 
         epoch = snap.epoch
         n = epoch.num_rows
         b = self._bucket_size(n)
         dev_cols = []
         host_cols = []
-        for off in offsets:
-            key = (epoch.epoch_id, off, b)
+        for ci, off in enumerate(offsets):
+            key = (epoch.epoch_id, off, b, narrowed[ci])
             data = epoch.columns[off]
             valid = epoch.valids[off]
             vfull = np.ones(n, bool) if valid is None else valid
-            if key not in self._col_cache:
-                self._col_cache[key] = (
-                    jnp.asarray(_pad(data, b)),
+            with self._lock:
+                cached = self._col_cache.get(key)
+            if cached is None:
+                up = data.astype(np.int32) if narrowed[ci] else data
+                cached = (
+                    jnp.asarray(_pad(up, b)),
                     jnp.asarray(_pad_bool(vfull, b)),
                 )
-            dev_cols.append(self._col_cache[key])
+                with self._lock:
+                    self._col_cache[key] = cached
+            dev_cols.append(cached)
             host_cols.append((data, vfull))
         vis_key = (epoch.epoch_id, b, _mask_digest(snap.base_visible))
-        if vis_key not in self._mask_cache:
-            self._mask_cache[vis_key] = jnp.asarray(
-                _pad_bool(snap.base_visible, b))
-        return dev_cols, self._mask_cache[vis_key], host_cols
+        with self._lock:
+            vis = self._mask_cache.get(vis_key)
+        if vis is None:
+            vis = jnp.asarray(_pad_bool(snap.base_visible, b))
+            with self._lock:
+                self._mask_cache[vis_key] = vis
+        return dev_cols, vis, host_cols, narrowed
+
+    @staticmethod
+    def _widen_cols(cols, narrowed):
+        """Undo int32 staging in-register (XLA fuses the upcast into the
+        HBM read) so all arithmetic sees the declared int64 width."""
+        out = []
+        for (d, v), nw in zip(cols, narrowed):
+            out.append(((d.astype(jnp.int64) if nw else d), v))
+        return out
+
+    def _kernel(self, key, build):
+        with self._lock:
+            k = self._kernels.get(key)
+        if k is None:
+            k = build()
+            with self._lock:
+                self._kernels[key] = k
+        return k
 
     # ---- aggregation path ---------------------------------------------------
-    def _run_agg(self, dag, snap, prepared, cols, row_mask) -> list[Chunk]:
+    def _float_val_rows(self, dag: CopDAG) -> list[int]:
+        """Aggregate indices whose partial value is float64 (packed into the
+        separate float output buffer)."""
+        out = []
+        for ai, d in enumerate(dag.agg.aggs):
+            if d.func == "count" or d.arg is None:
+                continue
+            if d.arg.ftype.is_float:
+                out.append(ai)
+        return out
+
+    def _run_agg(self, dag, snap, prepared, cols, row_mask, narrowed
+                 ) -> list[Chunk]:
         agg = dag.agg
         cards: list[int] = prepared["__dense_cards__"]
+        offsets: list[int] = prepared["__key_offsets__"]
         segments = 1
         for c in cards:
             segments *= max(c, 1)
         key = ("agg", _dag_key(dag, prepared), cols[0][0].shape[0]
-               if cols else 0, tuple(cards))
-        if key not in self._kernels:
-            self._kernels[key] = self._build_agg_kernel(
-                dag, prepared, cards, segments)
-        out = self._kernels[key](cols, row_mask)
-        out = jax.tree.map(np.asarray, out)
-        rows_per_seg = out["rows"]
+               if cols else 0, tuple(cards), narrowed)
+        kern = self._kernel(key, lambda: self._build_agg_kernel(
+            dag, prepared, cards, segments, narrowed))
+        out = kern(cols, row_mask)
+        float_rows = self._float_val_rows(dag)
+        ints = np.asarray(out["ints"])  # (1 + naggs*? , segments) packed
+        flts = np.asarray(out["flts"]) if float_rows else None
+
+        rows_per_seg = ints[0]
         present = rows_per_seg > 0
         seg_idx = np.nonzero(present)[0]
         if len(seg_idx) == 0:
@@ -380,23 +549,24 @@ class CopClient:
             code = parts[gi]
             ft = g.ftype
             is_null = code == (card - 1)
-            data = code.astype(ft.np_dtype)
-            assert isinstance(g, Col)
-            dictionary = snap.dictionaries[dag.scan.col_offsets[g.idx]] \
-                if ft.is_string else None
+            data = (code + offsets[gi]).astype(ft.np_dtype)
+            dictionary = None
+            if ft.is_string and isinstance(g, Col):
+                dictionary = snap.dictionaries[dag.scan.col_offsets[g.idx]]
             columns.append(Column(
                 ft, data, None if not is_null.any() else ~is_null, dictionary))
+        fi = 0
         for ai, d in enumerate(agg.aggs):
-            val = out[f"val{ai}"][seg_idx]
-            cnt = out[f"cnt{ai}"][seg_idx]
+            cnt = ints[2 + 2 * ai][seg_idx]
+            if ai in float_rows:
+                val = flts[fi][seg_idx]
+                fi += 1
+            else:
+                val = ints[1 + 2 * ai][seg_idx]
             val_t = dag.output_types[len(agg.group_by) + 2 * ai]
             if d.func == "count":
-                val = cnt.astype(np.int64)
-                vcol = Column(val_t, val)
-            elif d.func in ("min", "max"):
-                vcol = Column(val_t, val.astype(val_t.np_dtype),
-                              None if (cnt > 0).all() else (cnt > 0))
-            else:  # sum / avg partial
+                vcol = Column(val_t, cnt.astype(np.int64))
+            else:
                 vcol = Column(val_t, val.astype(val_t.np_dtype),
                               None if (cnt > 0).all() else (cnt > 0))
             columns.append(vcol)
@@ -405,34 +575,85 @@ class CopClient:
                 cnt.astype(np.int64)))
         return [Chunk(columns)]
 
-    def _build_agg_kernel(self, dag, prepared, cards, segments):
-        return jax.jit(self._agg_kernel_body(dag, prepared, cards, segments))
+    def _build_agg_kernel(self, dag, prepared, cards, segments, narrowed):
+        body = self._agg_kernel_body(dag, prepared, cards, segments,
+                                     narrowed=narrowed)
+        float_rows = self._float_val_rows(dag)
+
+        def packed(cols, row_mask):
+            return self._pack_agg(dag, body(cols, row_mask), float_rows)
+
+        return jax.jit(packed)
+
+    def _pack_agg(self, dag, out, float_rows):
+        """Pack partials into one int64 buffer (+ one f64 buffer iff float
+        aggregates exist): rows [rows, val0, cnt0, val1, cnt1, ...]; float
+        vals go to the float buffer in float_rows order (their int64 slot
+        is zero-filled)."""
+        naggs = len(dag.agg.aggs)
+        rows = [out["rows"].astype(jnp.int64)]
+        fl = []
+        for ai in range(naggs):
+            v = out[f"val{ai}"]
+            if ai in float_rows:
+                fl.append(v.astype(jnp.float64))
+                rows.append(jnp.zeros_like(out["rows"], dtype=jnp.int64))
+            else:
+                rows.append(v.astype(jnp.int64))
+            rows.append(out[f"cnt{ai}"].astype(jnp.int64))
+        res = {"ints": jnp.stack(rows)}
+        if fl:
+            res["flts"] = jnp.stack(fl)
+        return res
+
+    def _segment_ids(self, agg, cards, offsets, cols, prepared, mask):
+        """Mixed-radix dense segment id; NULL key -> card-1 slot."""
+        seg = jnp.zeros(mask.shape[0], dtype=jnp.int32)
+        for g, card, off in zip(agg.group_by, cards, offsets):
+            v, vl = eval_expr(g, cols, prepared)
+            # subtract the offset at the value's own width: the span fits
+            # int32 (card <= 8192) but the absolute values may not
+            shifted = (v - jnp.asarray(off, dtype=v.dtype)).astype(jnp.int32)
+            k = jnp.where(vl, shifted, card - 1)
+            k = jnp.clip(k, 0, card - 1)
+            seg = seg * card + k
+        return jnp.where(mask, seg, -1)
 
     def _agg_kernel_body(self, dag, prepared, cards, segments,
-                         keep_sentinels: bool = False):
+                         keep_sentinels: bool = False,
+                         narrowed: tuple = ()):
         """Pure (cols, row_mask) -> {partials} function; the distributed
         client wraps it in shard_map + per-function collectives (psum for
         sums/counts, pmin/pmax for min/max — see parallel/dist.py).
         keep_sentinels leaves +-inf/INT_MIN/MAX in empty min/max segments so
         a cross-device pmin/pmax merge stays correct; the merger zeroes them
         after reducing."""
+        strategy = prepared.get("__strategy__", "loop")
+        if strategy == "einsum":
+            return self._agg_body_einsum(dag, prepared, cards, segments,
+                                         narrowed)
+        return self._agg_body_loop(dag, prepared, cards, segments,
+                                   keep_sentinels, narrowed)
+
+    def _agg_body_loop(self, dag, prepared, cards, segments, keep_sentinels,
+                       narrowed):
+        """Per-segment masked reductions — scatter-free; XLA fuses the
+        whole loop into a single pass over the data for small segment
+        counts."""
         agg = dag.agg
         sel = dag.selection
+        offsets = prepared["__key_offsets__"]
 
         def kernel(cols, row_mask):
+            cols = self._widen_cols(cols, narrowed)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
-            # mixed-radix dense segment id; NULL key -> card-1 slot
-            seg = jnp.zeros(mask.shape[0], dtype=jnp.int32)
-            for g, card in zip(agg.group_by, cards):
-                v, vl = eval_expr(g, cols, prepared)
-                k = jnp.where(vl, v.astype(jnp.int32), card - 1)
-                k = jnp.clip(k, 0, card - 1)
-                seg = seg * card + k
-            seg = jnp.where(mask, seg, 0)
-            mi = mask.astype(jnp.int64)
-            out = {"rows": jax.ops.segment_sum(mi, seg, segments)}
+            seg = self._segment_ids(agg, cards, offsets, cols, prepared, mask)
+            seg_eq = [seg == k for k in range(segments)]
+            out = {"rows": jnp.stack(
+                [jnp.sum(m.astype(jnp.int32)).astype(jnp.int64)
+                 for m in seg_eq])}
             for ai, d in enumerate(agg.aggs):
                 if d.arg is None:
                     out[f"val{ai}"] = out["rows"]
@@ -440,30 +661,29 @@ class CopClient:
                     continue
                 v, vl = eval_expr(d.arg, cols, prepared)
                 contrib = mask & vl
-                ci = contrib.astype(jnp.int64)
-                cnt = jax.ops.segment_sum(ci, seg, segments)
+                cnt = jnp.stack(
+                    [jnp.sum((m & vl).astype(jnp.int32)).astype(jnp.int64)
+                     for m in seg_eq])
+                is_f = jnp.issubdtype(v.dtype, jnp.floating)
                 if d.func in ("sum", "avg", "count"):
-                    if jnp.issubdtype(v.dtype, jnp.floating):
+                    if is_f:
                         vv = jnp.where(contrib, v, 0.0)
+                        val = jnp.stack(
+                            [jnp.sum(jnp.where(m, vv, 0.0)) for m in seg_eq])
                     else:
                         vv = jnp.where(contrib, v.astype(jnp.int64), 0)
-                    val = jax.ops.segment_sum(vv, seg, segments)
-                elif d.func == "min":
-                    sentinel = jnp.inf if jnp.issubdtype(
-                        v.dtype, jnp.floating) else _INT_MAX
-                    vv = jnp.where(contrib, v.astype(
-                        v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
-                        else jnp.int64), sentinel)
-                    val = jax.ops.segment_min(vv, seg, segments)
-                    if not keep_sentinels:
-                        val = jnp.where(cnt > 0, val, 0)
-                elif d.func == "max":
-                    sentinel = -jnp.inf if jnp.issubdtype(
-                        v.dtype, jnp.floating) else _INT_MIN
-                    vv = jnp.where(contrib, v.astype(
-                        v.dtype if jnp.issubdtype(v.dtype, jnp.floating)
-                        else jnp.int64), sentinel)
-                    val = jax.ops.segment_max(vv, seg, segments)
+                        val = jnp.stack(
+                            [jnp.sum(jnp.where(m, vv, 0)) for m in seg_eq])
+                elif d.func in ("min", "max"):
+                    if is_f:
+                        sent = jnp.inf if d.func == "min" else -jnp.inf
+                        vv = jnp.where(contrib, v, sent)
+                    else:
+                        sent = _INT_MAX if d.func == "min" else _INT_MIN
+                        vv = jnp.where(contrib, v.astype(jnp.int64), sent)
+                    red = jnp.min if d.func == "min" else jnp.max
+                    val = jnp.stack(
+                        [red(jnp.where(m, vv, sent)) for m in seg_eq])
                     if not keep_sentinels:
                         val = jnp.where(cnt > 0, val, 0)
                 else:
@@ -474,106 +694,191 @@ class CopClient:
 
         return kernel
 
+    def _agg_body_einsum(self, dag, prepared, cards, segments, narrowed):
+        """Exact segment sums on the MXU for larger dense key spaces:
+        one-hot f32 einsum per 12-bit signed limb, per-block partials kept
+        < 2^24 (exactly representable in f32), recombined in int64. Only
+        additive aggregates (sum/avg/count) qualify — gated in _prepare."""
+        agg = dag.agg
+        sel = dag.selection
+        offsets = prepared["__key_offsets__"]
+        limbs = prepared["__limbs__"]
+        B = _EINSUM_BLOCK
+
+        def seg_sums(v64, seg2, oh, L):
+            """Exact int64 per-segment sums of v64 via L signed limbs."""
+            total = jnp.zeros((segments,), jnp.int64)
+            x = v64
+            for i in range(L):
+                if i < L - 1:
+                    limb = (x & ((1 << _LIMB_BITS) - 1)).astype(jnp.float32)
+                    x = x >> _LIMB_BITS
+                else:
+                    limb = x.astype(jnp.float32)
+                # HIGHEST forces true f32 MXU passes (TPU default can drop
+                # to bf16's 8 mantissa bits, silently rounding 12-bit limbs)
+                part = jnp.einsum("cb,cbk->ck", limb, oh,
+                                  precision=jax.lax.Precision.HIGHEST)
+                total = total + (
+                    part.astype(jnp.int64).sum(axis=0) << (_LIMB_BITS * i))
+            return total
+
+        def kernel(cols, row_mask):
+            cols = self._widen_cols(cols, narrowed)
+            mask = row_mask
+            if sel is not None:
+                mask = selection_mask(sel.conditions, cols, prepared, mask)
+            seg = self._segment_ids(agg, cards, offsets, cols, prepared, mask)
+            n = seg.shape[0]
+            C = -(-n // B)
+            pad = C * B - n
+            seg2 = jnp.pad(seg, (0, pad), constant_values=-1).reshape(C, B)
+            # one_hot of -1 is all-zero -> masked/padded rows vanish
+            oh = jax.nn.one_hot(seg2, segments, dtype=jnp.float32)
+
+            def padded(x, fill=0):
+                return jnp.pad(x, (0, pad), constant_values=fill).reshape(C, B)
+
+            ones = padded(mask.astype(jnp.int64))
+            out = {"rows": seg_sums(ones, seg2, oh, 1)}
+            for ai, d in enumerate(agg.aggs):
+                if d.arg is None:
+                    out[f"val{ai}"] = out["rows"]
+                    out[f"cnt{ai}"] = out["rows"]
+                    continue
+                v, vl = eval_expr(d.arg, cols, prepared)
+                contrib = mask & vl
+                cnt = seg_sums(padded(contrib.astype(jnp.int64)), seg2, oh, 1)
+                vv = padded(jnp.where(contrib, v.astype(jnp.int64), 0))
+                out[f"val{ai}"] = seg_sums(vv, seg2, oh, limbs[ai])
+                out[f"cnt{ai}"] = cnt
+            return out
+
+        return kernel
+
     # ---- row path (scan/selection/projection) -------------------------------
-    def _run_rows(self, dag, snap, prepared, cols, row_mask, host_cols):
-        key = ("rows", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0)
-        if key not in self._kernels:
-            self._kernels[key] = self._build_rows_kernel(dag, prepared)
-        out = self._kernels[key](cols, row_mask)
-        mask = np.asarray(out["mask"])
+    def _run_rows(self, dag, snap, prepared, cols, row_mask, host_cols,
+                  narrowed):
+        """Device evaluates the (fused) filter and returns ONLY a packed
+        bitmask — one small buffer; projections are computed host-side over
+        the selected subset (numpy over the epoch's host columns). Full-width
+        device outputs would pay the device->host transfer for every row."""
+        if dag.selection is None:
+            # pure scan: nothing for the device to do
+            idx = np.nonzero(np.asarray(row_mask))[0]
+            if dag.limit is not None and len(idx) > dag.limit.n:
+                idx = idx[: dag.limit.n]
+            return self._host_rows(dag, snap, host_cols, idx)
+        key = ("rowmask", _dag_key(dag, prepared),
+               cols[0][0].shape[0] if cols else 0, narrowed)
+        kern = self._kernel(key, lambda: self._build_rowmask_kernel(
+            dag, prepared, narrowed))
+        packed = np.asarray(kern(cols, row_mask))
+        n_rows = host_cols[0][0].shape[0] if host_cols else 0
+        mask = np.unpackbits(packed, count=None).astype(bool)[: n_rows] \
+            if n_rows else np.zeros(0, bool)
         idx = np.nonzero(mask)[0]
         if dag.limit is not None and len(idx) > dag.limit.n:
             idx = idx[: dag.limit.n]
+        return self._host_rows(dag, snap, host_cols, idx)
+
+    def _build_rowmask_kernel(self, dag, prepared, narrowed):
+        sel = dag.selection
+
+        @jax.jit
+        def kernel(cols, row_mask):
+            cols = self._widen_cols(cols, narrowed)
+            mask = selection_mask(sel.conditions, cols, prepared, row_mask)
+            return jnp.packbits(mask)
+
+        return kernel
+
+    def _host_rows(self, dag, snap, host_cols, idx) -> list[Chunk]:
+        """Project the selected rows host-side (numpy)."""
+        dicts = self._scan_dicts(dag, snap)
         columns = []
         if dag.projections is not None:
+            sub = [(d[idx], v[idx]) for d, v in host_cols]
+            ev = NumpyEval(sub, dicts, len(idx))
             for pi, e in enumerate(dag.projections):
-                data = np.asarray(out[f"proj{pi}"])[idx]
-                valid = np.asarray(out[f"projv{pi}"])[idx]
+                v, vl = ev.eval(e)
                 ft = dag.output_types[pi]
                 dictionary = None
                 if ft.is_string and isinstance(e, Col):
                     dictionary = snap.dictionaries[dag.scan.col_offsets[e.idx]]
                 columns.append(Column(
-                    ft, data.astype(ft.np_dtype),
-                    None if valid.all() else valid, dictionary))
+                    ft, np.asarray(v).astype(ft.np_dtype),
+                    None if vl.all() else np.asarray(vl), dictionary))
         else:
             for ci, off in enumerate(dag.scan.col_offsets):
                 data, vfull = host_cols[ci]
                 ft = dag.output_types[ci]
-                d = data[idx[idx < len(data)]] if len(data) else data[:0]
-                v = vfull[idx[idx < len(vfull)]] if len(vfull) else vfull[:0]
+                d = data[idx]
+                v = vfull[idx]
                 columns.append(Column(
                     ft, d, None if v.all() else v, snap.dictionaries[off]))
         if not columns:
             return []
         return [Chunk(columns)]
 
-    def _build_rows_kernel(self, dag, prepared):
-        sel = dag.selection
-        projections = dag.projections
-
-        @jax.jit
-        def kernel(cols, row_mask):
-            mask = row_mask
-            if sel is not None:
-                mask = selection_mask(sel.conditions, cols, prepared, mask)
-            out = {"mask": mask}
-            if projections is not None:
-                for pi, e in enumerate(projections):
-                    v, vl = eval_expr(e, cols, prepared)
-                    out[f"proj{pi}"] = v
-                    out[f"projv{pi}"] = vl & mask
-            return out
-
-        return kernel
-
     # ---- TopN path ----------------------------------------------------------
-    def _run_topn(self, dag, snap, prepared, cols, row_mask, host_cols):
+    def _run_topn(self, dag, snap, prepared, cols, row_mask, host_cols,
+                  narrowed):
         expr, desc = dag.topn.items[0]
         n = dag.topn.n
         key = ("topn", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0, n, desc)
-        if key not in self._kernels:
-            self._kernels[key] = self._build_topn_kernel(dag, prepared, expr,
-                                                         desc, n)
-        out = self._kernels[key](cols, row_mask)
-        idx = np.asarray(out["idx"])
-        picked_mask = np.asarray(out["picked_mask"])
-        idx = idx[picked_mask]
+               cols[0][0].shape[0] if cols else 0, n, desc, narrowed)
+        kern = self._kernel(key, lambda: self._build_topn_kernel(
+            dag, prepared, expr, desc, n, narrowed))
+        out = kern(cols, row_mask)
+        ints = np.asarray(out["ints"])  # (2 + n_int_cols*2, k)
+        flts = np.asarray(out["flts"]) if "flts" in out else None
+        idx = ints[0]
+        picked = ints[1].astype(bool)
+        idx = idx[picked]
+        k = len(idx)
         columns = []
         if dag.projections is not None:
-            for pi, e in enumerate(dag.projections):
-                data = np.asarray(out[f"proj{pi}"])[idx]
-                valid = np.asarray(out[f"projv{pi}"])[idx]
-                ft = dag.output_types[pi]
-                dictionary = None
-                if ft.is_string and isinstance(e, Col):
-                    dictionary = snap.dictionaries[dag.scan.col_offsets[e.idx]]
-                columns.append(Column(ft, data.astype(ft.np_dtype),
-                                      None if valid.all() else valid,
-                                      dictionary))
+            exprs = dag.projections
         else:
-            for ci, off in enumerate(dag.scan.col_offsets):
-                data, vfull = host_cols[ci]
-                columns.append(Column(
-                    dag.output_types[ci], data[idx],
-                    None if vfull[idx].all() else vfull[idx],
-                    snap.dictionaries[off]))
+            exprs = [Col(ci, ft) for ci, ft in enumerate(dag.output_types)]
+        ii, fi = 0, 0
+        for pi, e in enumerate(exprs):
+            ft = dag.output_types[pi]
+            if ft.is_float:
+                data = flts[fi][picked]
+                valid = flts[fi + 1][picked] > 0
+                fi += 2
+            else:
+                data = ints[2 + ii][picked]
+                valid = ints[2 + ii + 1][picked].astype(bool)
+                ii += 2
+            dictionary = None
+            if ft.is_string and isinstance(e, Col):
+                dictionary = snap.dictionaries[dag.scan.col_offsets[e.idx]]
+            columns.append(Column(
+                ft, data.astype(ft.np_dtype),
+                None if valid.all() else valid, dictionary))
         if not columns:
             return []
         return [Chunk(columns)]
 
-    def _build_topn_kernel(self, dag, prepared, expr, desc, n):
+    def _build_topn_kernel(self, dag, prepared, expr, desc, n, narrowed):
         sel = dag.selection
         projections = dag.projections
         if projections is not None:
             # sort items were resolved against the projection's output
             # schema; substitute so the key computes over projected values
             expr = _subst_proj_cols(expr, projections)
+        if projections is not None:
+            exprs = projections
+        else:
+            exprs = [Col(ci, ft) for ci, ft in enumerate(dag.output_types)]
+        out_types = dag.output_types
 
         @jax.jit
         def kernel(cols, row_mask):
+            cols = self._widen_cols(cols, narrowed)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
@@ -593,12 +898,24 @@ class CopClient:
             score = jnp.where(mask, score, drop_score)
             k = min(n, score.shape[0])
             _, idx = jax.lax.top_k(score, k)
-            out = {"idx": idx, "picked_mask": mask[idx]}
-            if projections is not None:
-                for pi, e in enumerate(projections):
-                    pv, pvl = eval_expr(e, cols, prepared)
-                    out[f"proj{pi}"] = pv
-                    out[f"projv{pi}"] = pvl & mask
+            # gather the k result rows in-kernel: the packed output is the
+            # ONLY device->host transfer (k rows, not full columns)
+            int_rows = [idx.astype(jnp.int64),
+                        mask[idx].astype(jnp.int64)]
+            flt_rows = []
+            for pi, e in enumerate(exprs):
+                pv, pvl = eval_expr(e, cols, prepared)
+                pvk = pv[idx]
+                pvlk = (pvl & mask)[idx]
+                if out_types[pi].is_float:
+                    flt_rows.append(pvk.astype(jnp.float64))
+                    flt_rows.append(pvlk.astype(jnp.float64))
+                else:
+                    int_rows.append(pvk.astype(jnp.int64))
+                    int_rows.append(pvlk.astype(jnp.int64))
+            out = {"ints": jnp.stack(int_rows)}
+            if flt_rows:
+                out["flts"] = jnp.stack(flt_rows)
             return out
 
         return kernel
@@ -663,11 +980,12 @@ def _mask_digest(m: np.ndarray) -> str:
 
 def _dag_key(dag: CopDAG, prepared: dict[Any, Any]) -> str:
     # structural + constant identity, plus the resolved payload signature
-    # (string codes, dict sizes) collected in deterministic walk order —
-    # append-only dictionaries mean (code values, table lengths) fully
-    # capture staleness
+    # (string codes, dict sizes, strategy/cards/offsets, limb counts)
+    # collected in deterministic walk order — append-only dictionaries mean
+    # (code values, table lengths) fully capture staleness
     sig = tuple(prepared.get("__sig__", ()))
-    return f"{dag.describe()}|{_expr_reprs(dag)}|{sig}"
+    limbs = tuple(prepared.get("__limbs__", ()))
+    return f"{dag.describe()}|{_expr_reprs(dag)}|{sig}|{limbs}"
 
 
 def _expr_reprs(dag: CopDAG) -> str:

@@ -51,10 +51,11 @@ class DistCopClient(CopClient):
         self.mesh = mesh
         self._n = mesh.devices.size
 
-    def _build_agg_kernel(self, dag, prepared, cards, segments):
+    def _build_agg_kernel(self, dag, prepared, cards, segments, narrowed):
         body = self._agg_kernel_body(dag, prepared, cards, segments,
-                                     keep_sentinels=True)
+                                     keep_sentinels=True, narrowed=narrowed)
         aggs = dag.agg.aggs
+        float_rows = self._float_val_rows(dag)
 
         def sharded(cols, row_mask):
             out = body(cols, row_mask)
@@ -75,13 +76,18 @@ class DistCopClient(CopClient):
                     val = jax.lax.psum(val, AXIS)
                 merged[f"val{ai}"] = val
                 merged[f"cnt{ai}"] = cnt
-            return merged
+            # pack inside shard_map (post-collective, replicated) so the
+            # host sees the same single-buffer layout as the one-chip path
+            return self._pack_agg(dag, merged, float_rows)
 
+        out_specs = {"ints": P()}
+        if float_rows:
+            out_specs["flts"] = P()
         mapped = jax.shard_map(
             sharded,
             mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS)),
-            out_specs=P(),
+            out_specs=out_specs,
         )
         return jax.jit(mapped)
 
@@ -92,8 +98,9 @@ class DistCopClient(CopClient):
         lcm = int(np.lcm(256, self._n))
         return -(-b // lcm) * lcm
 
-    def _stage_inputs(self, dag, snap, overlay: bool):
-        cols, row_mask, host_cols = super()._stage_inputs(dag, snap, overlay)
+    def _stage_inputs(self, dag, snap, overlay: bool, col_bounds=None):
+        cols, row_mask, host_cols, narrowed = super()._stage_inputs(
+            dag, snap, overlay, col_bounds=col_bounds)
         n = row_mask.shape[0]
         assert n % self._n == 0, f"bucket {n} vs mesh {self._n}"
         sharding = NamedSharding(self.mesh, P(AXIS))
@@ -102,4 +109,4 @@ class DistCopClient(CopClient):
             for d, v in cols
         ]
         row_mask = jax.device_put(row_mask, sharding)
-        return cols, row_mask, host_cols
+        return cols, row_mask, host_cols, narrowed
