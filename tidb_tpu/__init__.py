@@ -8,13 +8,12 @@ plan DAGs as JAX/XLA kernels over columnar chunks sharded across a TPU mesh.
 Control plane (sessions, planning, transactions, schema) is host-side;
 the data plane is columnar and device-side end-to-end.
 
-int64 is required for exact DECIMAL arithmetic (scaled fixed-point; see
-tidb_tpu/types) and for row handles, so x64 is enabled globally before any
-JAX computation is traced.
+The device programs are 64-bit-free by design: TPUs have no native
+int64/float64 (JAX x64 mode emulates them as u32 pairs, doubling transfer
+bytes and parameter counts), so JAX's default 32-bit mode is kept and
+exactness is carried by interval analysis + limb-exact summation
+(tidb_tpu/copr/bounds.py, sumexact.py). Host-side columns remain numpy
+int64/float64 — numpy is unaffected by the JAX dtype mode.
 """
 
-import jax
-
-jax.config.update("jax_enable_x64", True)
-
-__version__ = "0.1.0"
+__version__ = "0.2.0"

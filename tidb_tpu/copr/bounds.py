@@ -113,20 +113,24 @@ def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
         m = max(abs(a[0]), abs(a[1]))
         return (-m, m)
     if op in ("if",):
-        return _union(sub(1), sub(2))
+        return _union(_branch_bound(e.args[1], e.ftype, col_bounds),
+                      _branch_bound(e.args[2], e.ftype, col_bounds))
     if op == "ifnull":
-        return _union(sub(0), sub(1))
+        return _union(_branch_bound(e.args[0], e.ftype, col_bounds),
+                      _branch_bound(e.args[1], e.ftype, col_bounds))
     if op == "coalesce":
-        out = sub(0)
+        out = _branch_bound(e.args[0], e.ftype, col_bounds)
         for i in range(1, len(e.args)):
-            out = _union(out, sub(i))
+            out = _union(out, _branch_bound(e.args[i], e.ftype, col_bounds))
         return out
     if op == "case":
         has_else = len(e.args) % 2 == 1
         pairs = (len(e.args) - 1) // 2 if has_else else len(e.args) // 2
-        out: Bound = sub(len(e.args) - 1) if has_else else (0, 0)
+        out: Bound = _branch_bound(e.args[-1], e.ftype, col_bounds) \
+            if has_else else (0, 0)
         for i in range(pairs):
-            out = _union(out, expr_bounds(e.args[2 * i + 1], col_bounds))
+            out = _union(out, _branch_bound(e.args[2 * i + 1], e.ftype,
+                                            col_bounds))
         return out
     if op == "year":
         return (0, 9999)
@@ -140,6 +144,13 @@ def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
             return None
         d = int(e.extra)
         return (a[0] + min(d, 0), a[1] + max(d, 0))
+    if op == "shr15":
+        a = sub(0)
+        if a is None:
+            return None
+        return (a[0] >> 15, a[1] >> 15)
+    if op == "and15":
+        return (0, (1 << 15) - 1)
     if op == "cast":
         src = e.args[0].ftype
         dst = e.ftype
@@ -166,8 +177,152 @@ def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
     return None
 
 
+def _branch_bound(arg: PlanExpr, out_t, col_bounds: list[Bound]) -> Bound:
+    """Bound of a control-flow branch AFTER eval's cast to the result type
+    (eval.py _cast_to rescales decimals to out_t.scale on device)."""
+    b = expr_bounds(arg, col_bounds)
+    if b is None:
+        return None
+    st = arg.ftype
+    if out_t.is_decimal:
+        ss = st.scale if st.is_decimal else 0
+        if ss < out_t.scale:
+            f = _scale(out_t.scale - ss)
+            return (b[0] * f, b[1] * f)
+        if ss > out_t.scale:
+            f = _scale(ss - out_t.scale)
+            return (b[0] // f - 1, b[1] // f + 1)
+    return b
+
+
+def _cmp_aligned_bounds(a: PlanExpr, b: PlanExpr,
+                        col_bounds: list[Bound]) -> tuple[Bound, Bound]:
+    """Operand bounds AFTER eval's comparison scale alignment
+    (eval.py _align_numeric multiplies the lower-scale side by 10^diff
+    on device, which itself must fit int32)."""
+    ba = expr_bounds(a, col_bounds)
+    bb = expr_bounds(b, col_bounds)
+    at, bt = a.ftype, b.ftype
+    if at.is_float or bt.is_float:
+        return ba, bb  # compared in f32; no integer overflow
+    sa = at.scale if at.is_decimal else 0
+    sb = bt.scale if bt.is_decimal else 0
+    if sa < sb and ba is not None:
+        f = _scale(sb - sa)
+        ba = (ba[0] * f, ba[1] * f)
+    elif sb < sa and bb is not None:
+        f = _scale(sa - sb)
+        bb = (bb[0] * f, bb[1] * f)
+    return ba, bb
+
+
 def fits_int32(b: Bound) -> bool:
     return b is not None and b[0] >= -(2**31) and b[1] < 2**31
+
+
+_I31 = (-(2**31), 2**31 - 1)
+
+
+def _safe(b: Bound) -> bool:
+    return b is not None and b[0] >= _I31[0] and b[1] <= _I31[1]
+
+
+def expr_device_safe(e: PlanExpr, col_bounds: list[Bound]) -> bool:
+    """True iff every integer-valued node of the tree fits int32 — i.e.
+    int32 device arithmetic computes the expression exactly. Floats and
+    booleans are always "safe" (they lower to f32/bool); the caller decides
+    whether f32 precision is acceptable for the context."""
+    if isinstance(e, Col) or isinstance(e, Const):
+        ft = e.ftype
+        if ft.is_float or ft.is_string:
+            return True
+        return _safe(expr_bounds(e, col_bounds))
+    assert isinstance(e, Call)
+    if e.ftype.is_float:
+        return all(expr_device_safe(a, col_bounds) for a in e.args)
+    if e.op in ("eq", "ne", "lt", "le", "gt", "ge") and len(e.args) == 2:
+        # eval aligns decimal scales by multiplying the lower-scale side
+        # by 10^diff ON DEVICE — the scaled operand must itself fit int32
+        a, b = e.args
+        if not (expr_device_safe(a, col_bounds)
+                and expr_device_safe(b, col_bounds)):
+            return False
+        if a.ftype.is_string or b.ftype.is_string:
+            return True
+        ba, bb = _cmp_aligned_bounds(a, b, col_bounds)
+        if a.ftype.is_float or b.ftype.is_float:
+            return True
+        return _safe(ba) and _safe(bb)
+    if e.op in ("and", "or", "not", "isnull", "in_values", "like",
+                "dict_lookup"):
+        # the predicate itself is boolean; its operands must be safe
+        return all(expr_device_safe(a, col_bounds) for a in e.args)
+    if not _safe(expr_bounds(e, col_bounds)):
+        return False
+    return all(expr_device_safe(a, col_bounds) for a in e.args)
+
+
+def decompose_terms(
+    e: PlanExpr, col_bounds: list[Bound], max_terms: int = 8
+) -> Optional[list[tuple[PlanExpr, int]]]:
+    """Split an integer expression into [(term, shift)] with
+    value == sum(term_i << shift_i), every term int32-safe on device.
+
+    Used for aggregate arguments whose per-row value overflows int32
+    (e.g. TPC-H Q1's price*(1-disc)*(1+tax), ~37 bits): the wide factor of
+    a product is split at bit 15 (hi = a >> 15 arithmetic, lo = a & 0x7fff,
+    a == (hi << 15) + lo in two's complement), distributing the multiply.
+    Each term is summed exactly on device (sumexact.py) and the host
+    recombines sum(e) = sum_i (sum(term_i) << shift_i) in int64.
+
+    Returns None when no safe decomposition exists (caller falls back to
+    the host path). Reference analog: the decimal value words of
+    types/mydecimal.go — multi-word exact arithmetic, here driven by
+    interval analysis instead of a fixed word count.
+    """
+    if expr_device_safe(e, col_bounds):
+        return [(e, 0)]
+    if not isinstance(e, Call):
+        return None
+    if e.op == "neg":
+        inner = decompose_terms(e.args[0], col_bounds, max_terms)
+        if inner is None:
+            return None
+        return [(Call("neg", [t], e.ftype), s) for t, s in inner]
+    if e.op != "mul":
+        return None
+    a, b = e.args
+    ba = expr_bounds(a, col_bounds)
+    bb = expr_bounds(b, col_bounds)
+    if ba is None or bb is None:
+        return None
+    # put the narrow factor on the right; it must fit 15 bits so that
+    # (a & 0x7fff) * b and (a >> 15) * b stay int32-safe after splitting
+    amax = max(abs(ba[0]), abs(ba[1]))
+    bmax = max(abs(bb[0]), abs(bb[1]))
+    if amax < bmax:
+        a, b, ba, bb, amax, bmax = b, a, bb, ba, bmax, amax
+    if not expr_device_safe(b, col_bounds):
+        return None
+    wide = decompose_terms(a, col_bounds, max_terms)
+    if wide is None:
+        return None
+    out: list[tuple[PlanExpr, int]] = []
+    for ta, sa in wide:
+        hi = Call("shr15", [ta], ta.ftype)
+        lo = Call("and15", [ta], ta.ftype)
+        for part, shift in ((Call("mul", [hi, b], e.ftype), sa + 15),
+                            (Call("mul", [lo, b], e.ftype), sa)):
+            if expr_device_safe(part, col_bounds):
+                out.append((part, shift))
+            else:
+                sub2 = decompose_terms(part, col_bounds, max_terms)
+                if sub2 is None:
+                    return None
+                out.extend((t, s + shift) for t, s in sub2)
+            if len(out) > max_terms:
+                return None
+    return out
 
 
 def limbs_for(b: Bound, limb_bits: int = 12, max_limbs: int = 6) -> int:

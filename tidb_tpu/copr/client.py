@@ -6,29 +6,36 @@ store/mockstore/unistore/cophandler/closure_exec.go). Differences, TPU-first:
 
 * The scan source is the table's immutable column epoch, cached on device
   and padded to shape buckets (static shapes for XLA; the coprocessor-cache
-  analog of store/tikv/coprocessor_cache.go:30). int64 columns whose values
-  fit int32 (per epoch min/max stats) upload as int32 — half the HBM
-  footprint and transfer time — and widen back in-register inside the
-  kernel, so arithmetic stays exact int64.
-* scan -> selection -> projection/aggregation/topN lower to ONE jitted
-  program with ONE packed output buffer. This matters enormously: every
-  device->host fetch pays a fixed round-trip, so the kernel gathers/packs
-  everything (TopN rows included) into a single int64 array (+ one float64
-  array only when float aggregates exist).
+  analog of store/tikv/coprocessor_cache.go:30).
+* The device programs are 64-bit-free. TPUs have no native int64/float64
+  (JAX x64 mode emulates them as u32 pairs, doubling parameter counts and
+  transfer bytes), so every staged column is int32 / float32 / bool and
+  every kernel computes in 32-bit. Exactness is preserved by host-side
+  interval analysis (bounds.py): integer columns are admitted only when
+  their values fit int32, wide per-row aggregate values are decomposed
+  into int32-safe shifted terms (bounds.decompose_terms), and sums are
+  accumulated via the exact 12-bit-limb scheme in sumexact.py, recombined
+  to int64 on the host. MySQL DECIMAL semantics (types/mydecimal.go in the
+  reference) hold bit-exactly.
+* scan -> selection -> aggregation/topN lower to ONE jitted program, and
+  ALL outputs come back in ONE jax.device_get. On a remote TPU every
+  synchronous round trip costs ~100ms of tunnel latency regardless of
+  size, so per query the engine pays exactly one dispatch+fetch cycle;
+  aggregate throughput comes from concurrent sessions whose cycles
+  pipeline on the link.
 * Aggregation is scatter-free (TPU scatter-add serializes): group keys map
   to a dense mixed-radix segment space; small spaces (<=64) reduce via
   per-segment masked sums (XLA fuses them into one pass), larger spaces
-  (<=8192) via an exact one-hot einsum on the MXU — values split into
-  signed 12-bit limbs accumulated in float32 with per-block partials kept
-  < 2^24 so every sum is exact, then recombined in int64. Limb counts come
-  from host-side interval analysis (bounds.py). This replaces the partial
-  stage of the reference's two-stage hash agg (executor/aggregate.go:146).
+  (<=8192) via an exact one-hot f32 einsum on the MXU (sumexact.py). This
+  replaces the partial stage of the reference's two-stage hash agg
+  (executor/aggregate.go:146).
 * MVCC overlay rows (small, host-resident) run through the same kernels in
   a small shape bucket, and partial results merge at the final stage.
 
-Host fallbacks (numpy) cover what the device gate rejects: unbounded or
->8192-cardinality group keys, min/max or float aggregates over >64 segments,
-multi-key/string TopN, string ordering compares.
+Host fallbacks (numpy) cover what the device gate rejects: columns or
+expressions too wide for int32, unbounded or >8192-cardinality group keys,
+min/max or float aggregates over >64 segments, multi-key/string TopN,
+string ordering compares.
 """
 
 from __future__ import annotations
@@ -48,19 +55,26 @@ from ..plan.expr import Call, Col, Const, PlanExpr
 from ..store.table_store import TableSnapshot
 from ..types.field_type import FieldType, TypeKind
 from . import host_exec
-from .bounds import Bound, expr_bounds, fits_int32, limbs_for
+from . import sumexact as SE
+from .bounds import (
+    Bound,
+    decompose_terms,
+    expr_bounds,
+    expr_device_safe,
+    fits_int32,
+    limbs_for,
+)
 from .eval import CompileError, eval_expr, selection_mask
 from .npeval import NumpyEval
 
-_INT_MAX = np.int64(2**63 - 1)
-_INT_MIN = np.int64(-(2**63) + 1)
+_I32_MAX = np.int32(2**31 - 1)
+_I32_MIN = np.int32(-(2**31) + 1)
 
 # dense segment space caps per reduction strategy
 MAX_LOOP_SEGMENTS = 64
 MAX_DENSE_SEGMENTS = 1 << 13
 
-_LIMB_BITS = 12
-_EINSUM_BLOCK = 2048
+_FLOAT_BLOCKS = 32  # per-segment f32 block partials (host sums in f64)
 
 
 def _bucket(n: int) -> int:
@@ -87,7 +101,7 @@ class CopResult:
 
 class CopClient:
     def __init__(self) -> None:
-        # (epoch_id, offset, bucket, narrowed) -> (device data, device valid)
+        # (epoch_id, offset, bucket) -> (device data, device valid)
         self._col_cache: dict[tuple, tuple[Any, Any]] = {}
         # (epoch_id, bucket, digest) -> device visibility mask
         self._mask_cache: dict[tuple, Any] = {}
@@ -190,7 +204,8 @@ class CopClient:
         self, dag: CopDAG, snap: TableSnapshot
     ) -> tuple[Optional[dict[Any, Any]], Optional[str]]:
         """Resolve string constants/predicates against column dictionaries,
-        pick the aggregation strategy, and bound value ranges. Returns
+        pick the aggregation strategy, bound value ranges, and build the
+        aggregate schedule (term decomposition + limb counts). Returns
         (prepared, None) for the device path or (None, reason) to force the
         host fallback."""
         prepared: dict[Any, Any] = {}
@@ -199,12 +214,17 @@ class CopClient:
         col_bounds = self._scan_bounds(dag, snap)
         prepared["__col_bounds__"] = col_bounds
 
+        # int64 host columns must fit int32 to stage (staging is 32-bit-only)
+        for ci, off in enumerate(dag.scan.col_offsets):
+            if snap.epoch.columns[off].dtype == np.int64 and \
+                    not fits_int32(col_bounds[ci]):
+                return None, (
+                    f"column offset {off} too wide for int32 device staging")
+
         try:
             exprs: list[PlanExpr] = []
             if dag.selection:
                 exprs.extend(dag.selection.conditions)
-            if dag.projections:
-                exprs.extend(dag.projections)
             if dag.agg:
                 exprs.extend(dag.agg.group_by)
                 for d in dag.agg.aggs:
@@ -212,55 +232,128 @@ class CopClient:
                         exprs.append(d.arg)
             if dag.topn:
                 exprs.extend(e for e, _ in dag.topn.items)
+                if dag.projections:
+                    exprs.extend(dag.projections)
             for e in exprs:
                 self._prepare_expr(e, dicts, prepared)
         except CompileError as ce:
             return None, str(ce)
 
+        if dag.selection:
+            for c in dag.selection.conditions:
+                if not expr_device_safe(c, col_bounds):
+                    return None, "filter condition too wide for int32 device"
+
         if dag.agg is not None:
-            cards, offsets = self._dense_cards(dag, dicts, col_bounds)
-            if cards is None:
-                return None, "group keys not dense-encodable on device"
-            prepared["__dense_cards__"] = cards
-            prepared["__key_offsets__"] = offsets
-            segments = 1
-            for c in cards:
-                segments *= max(c, 1)
-            strategy = self._agg_strategy(segments, dag.agg.aggs)
-            if strategy is None:
-                return None, (
-                    f"{segments} segments with min/max or float aggregates "
-                    "is host-side")
-            prepared["__strategy__"] = strategy
-            if strategy == "einsum":
-                limbs = []
-                for d in dag.agg.aggs:
-                    if d.arg is None or d.func == "count":
-                        limbs.append(1)
-                    else:
-                        limbs.append(limbs_for(
-                            expr_bounds(d.arg, col_bounds), _LIMB_BITS))
-                prepared["__limbs__"] = limbs
-            prepared["__sig__"].append(
-                (strategy, tuple(cards), tuple(offsets)))
+            err = self._prepare_agg(
+                dag, dicts, col_bounds, prepared,
+                snap.epoch.num_rows + len(snap.overlay_handles))
+            if err is not None:
+                return None, err
         if dag.topn is not None:
-            if len(dag.topn.items) != 1:
-                return None, "multi-key TopN is host-side for now"
-            e = dag.topn.items[0][0]
-            if e.ftype.is_string:
-                return None, "string TopN key is host-side"
+            err = self._prepare_topn(dag, col_bounds, prepared)
+            if err is not None:
+                return None, err
         return prepared, None
 
-    @staticmethod
-    def _agg_strategy(segments: int, aggs) -> Optional[str]:
+    def _prepare_agg(self, dag, dicts, col_bounds, prepared,
+                     n_rows: int) -> Optional[str]:
+        cards, offsets = self._dense_cards(dag, dicts, col_bounds)
+        if cards is None:
+            return "group keys not dense-encodable on device"
+        for g in dag.agg.group_by:
+            if not expr_device_safe(g, col_bounds):
+                return "group key too wide for int32 device"
+        prepared["__dense_cards__"] = cards
+        prepared["__key_offsets__"] = offsets
+        segments = 1
+        for c in cards:
+            segments *= max(c, 1)
+
+        sched: list[dict[str, Any]] = []
+        needs_loop = False
+        for d in dag.agg.aggs:
+            if d.arg is None or d.func == "count":
+                sched.append({"kind": "count"})
+                continue
+            is_f = d.arg.ftype.is_float
+            if d.func in ("sum", "avg"):
+                if is_f:
+                    sched.append({"kind": "fsum"})
+                    needs_loop = True
+                else:
+                    terms = decompose_terms(d.arg, col_bounds)
+                    if terms is None:
+                        return (f"agg arg {d.arg!r} not int32-decomposable")
+                    # the TRUE total must fit int64 for the host Horner
+                    # recombination (sumexact.combine_partials)
+                    b = expr_bounds(d.arg, col_bounds)
+                    if b is None:
+                        return "agg arg unbounded"
+                    mag = max(abs(b[0]), abs(b[1]))
+                    if mag * max(n_rows, 1) >= 2**62:
+                        return "sum magnitude exceeds int64 accumulator"
+                    sched.append({
+                        "kind": "isum",
+                        "terms": [
+                            (t, s, limbs_for(expr_bounds(t, col_bounds),
+                                             SE.LIMB_BITS))
+                            for t, s in terms
+                        ],
+                    })
+            elif d.func in ("min", "max"):
+                if not is_f and not expr_device_safe(d.arg, col_bounds):
+                    return "min/max arg too wide for int32 device"
+                sched.append({"kind": d.func, "float": is_f})
+                needs_loop = True
+            else:
+                return f"agg {d.func} not on device"
+
         if segments <= MAX_LOOP_SEGMENTS:
-            return "loop"
-        for d in aggs:
-            if d.func in ("min", "max"):
-                return None
-            if d.arg is not None and d.arg.ftype.is_float:
-                return None
-        return "einsum"
+            strategy = "loop"
+        elif needs_loop:
+            return (f"{segments} segments with min/max or float aggregates "
+                    "is host-side")
+        else:
+            strategy = "einsum"
+        prepared["__strategy__"] = strategy
+        prepared["__agg_sched__"] = sched
+        prepared["__sig__"].append((
+            strategy, tuple(cards), tuple(offsets),
+            # term EXPRESSIONS are part of the identity: the same query over
+            # a different epoch can decompose differently (which factor was
+            # wide) while shifts/limbs coincide — a stale kernel would wrap
+            tuple(
+                (s["kind"],) + tuple(
+                    (repr(t), sh, L) for t, sh, L in s.get("terms", ()))
+                for s in sched
+            ),
+        ))
+        return None
+
+    def _prepare_topn(self, dag, col_bounds, prepared) -> Optional[str]:
+        if len(dag.topn.items) != 1:
+            return "multi-key TopN is host-side for now"
+        e = dag.topn.items[0][0]
+        if e.ftype.is_string:
+            return "string TopN key is host-side"
+        # the sort key references the projection's output schema; substitute
+        # so bounds analysis sees scan-column indices
+        key = _subst_proj_cols(e, dag.projections) if dag.projections else e
+        exprs = [key]
+        if dag.projections:
+            exprs.extend(dag.projections)
+        for x in exprs:
+            if x.ftype.is_string:
+                continue
+            if not x.ftype.is_float and not expr_device_safe(x, col_bounds):
+                return "TopN expression too wide for int32 device"
+        if not e.ftype.is_float:
+            b = expr_bounds(key, col_bounds)
+            # negated scores must also fit (ASC uses -v)
+            if b is None or not fits_int32(b) or not fits_int32((-b[1], -b[0])):
+                return "TopN key too wide for int32 device"
+        return None
 
     def _scan_dicts(self, dag: CopDAG, snap: TableSnapshot) -> list[Optional[Dictionary]]:
         return [snap.dictionaries[off] for off in dag.scan.col_offsets]
@@ -410,29 +503,30 @@ class CopClient:
         prepared: dict[Any, Any],
         overlay: bool,
     ) -> list[Chunk]:
-        cols, row_mask, host_cols, narrowed = self._stage_inputs(
-            dag, snap, overlay, col_bounds=prepared.get("__col_bounds__"))
+        cols, row_mask, host_cols, host_mask = self._stage_inputs(
+            dag, snap, overlay)
         if dag.agg is not None:
-            return self._run_agg(dag, snap, prepared, cols, row_mask, narrowed)
+            return self._run_agg(dag, snap, prepared, cols, row_mask)
         if dag.topn is not None:
             return self._run_topn(dag, snap, prepared, cols, row_mask,
-                                  host_cols, narrowed)
+                                  host_cols)
         return self._run_rows(dag, snap, prepared, cols, row_mask, host_cols,
-                              narrowed)
+                              host_mask)
 
-    def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool,
-                      col_bounds: Optional[list[Bound]] = None):
-        """Pad + upload scan columns; returns device (data, valid) pairs, the
-        row-visibility mask, host numpy views, and per-column narrowed flags
-        (int64 columns staged as int32 when epoch+overlay values fit)."""
+    def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool):
+        """Pad + upload scan columns as 32-bit device buffers; returns device
+        (data, valid) pairs, the device row-visibility mask, host numpy
+        views, and the host-side visibility mask (so paths that need no
+        device work never touch the device)."""
         offsets = dag.scan.col_offsets
-        if col_bounds is None:
-            col_bounds = self._scan_bounds(dag, snap)
-        narrowed = tuple(
-            snap.epoch.columns[off].dtype == np.int64
-            and fits_int32(col_bounds[ci])
-            for ci, off in enumerate(offsets)
-        )
+
+        def narrow(a: np.ndarray) -> np.ndarray:
+            if a.dtype == np.int64:
+                return a.astype(np.int32)
+            if a.dtype == np.float64:
+                return a.astype(np.float32)
+            return a
+
         if overlay:
             n = len(snap.overlay_handles)
             b = self._bucket_size(n)
@@ -443,35 +537,40 @@ class CopClient:
                 valid = snap.overlay_valids[off]
                 vfull = np.ones(n, bool) if valid is None else valid
                 host_cols.append((data, vfull))
-                up = data.astype(np.int32) if narrowed[ci] else data
                 dev_cols.append((
-                    jnp.asarray(_pad(up, b)),
+                    jnp.asarray(_pad(narrow(data), b)),
                     jnp.asarray(_pad_bool(vfull, b)),
                 ))
             mask = np.zeros(b, bool)
             mask[:n] = True
-            return dev_cols, jnp.asarray(mask), host_cols, narrowed
+            return dev_cols, jnp.asarray(mask), host_cols, mask[:n]
 
         epoch = snap.epoch
         n = epoch.num_rows
         b = self._bucket_size(n)
+        with self._lock:
+            # a session on an already-superseded snapshot must not re-seed
+            # the cache: eviction only clears the immediately superseded
+            # epoch, so stale entries would pin HBM for the client lifetime
+            cacheable = self._live_epochs.get(dag.scan.table_id) \
+                == epoch.epoch_id
         dev_cols = []
         host_cols = []
-        for ci, off in enumerate(offsets):
-            key = (epoch.epoch_id, off, b, narrowed[ci])
+        for off in offsets:
+            key = (epoch.epoch_id, off, b)
             data = epoch.columns[off]
             valid = epoch.valids[off]
             vfull = np.ones(n, bool) if valid is None else valid
             with self._lock:
                 cached = self._col_cache.get(key)
             if cached is None:
-                up = data.astype(np.int32) if narrowed[ci] else data
                 cached = (
-                    jnp.asarray(_pad(up, b)),
+                    jnp.asarray(_pad(narrow(data), b)),
                     jnp.asarray(_pad_bool(vfull, b)),
                 )
-                with self._lock:
-                    self._col_cache[key] = cached
+                if cacheable:
+                    with self._lock:
+                        self._col_cache[key] = cached
             dev_cols.append(cached)
             host_cols.append((data, vfull))
         vis_key = (epoch.epoch_id, b, _mask_digest(snap.base_visible))
@@ -479,18 +578,10 @@ class CopClient:
             vis = self._mask_cache.get(vis_key)
         if vis is None:
             vis = jnp.asarray(_pad_bool(snap.base_visible, b))
-            with self._lock:
-                self._mask_cache[vis_key] = vis
-        return dev_cols, vis, host_cols, narrowed
-
-    @staticmethod
-    def _widen_cols(cols, narrowed):
-        """Undo int32 staging in-register (XLA fuses the upcast into the
-        HBM read) so all arithmetic sees the declared int64 width."""
-        out = []
-        for (d, v), nw in zip(cols, narrowed):
-            out.append(((d.astype(jnp.int64) if nw else d), v))
-        return out
+            if cacheable:
+                with self._lock:
+                    self._mask_cache[vis_key] = vis
+        return dev_cols, vis, host_cols, snap.base_visible
 
     def _kernel(self, key, build):
         with self._lock:
@@ -502,35 +593,22 @@ class CopClient:
         return k
 
     # ---- aggregation path ---------------------------------------------------
-    def _float_val_rows(self, dag: CopDAG) -> list[int]:
-        """Aggregate indices whose partial value is float64 (packed into the
-        separate float output buffer)."""
-        out = []
-        for ai, d in enumerate(dag.agg.aggs):
-            if d.func == "count" or d.arg is None:
-                continue
-            if d.arg.ftype.is_float:
-                out.append(ai)
-        return out
-
-    def _run_agg(self, dag, snap, prepared, cols, row_mask, narrowed
-                 ) -> list[Chunk]:
+    def _run_agg(self, dag, snap, prepared, cols, row_mask) -> list[Chunk]:
         agg = dag.agg
         cards: list[int] = prepared["__dense_cards__"]
         offsets: list[int] = prepared["__key_offsets__"]
+        sched = prepared["__agg_sched__"]
         segments = 1
         for c in cards:
             segments *= max(c, 1)
         key = ("agg", _dag_key(dag, prepared), cols[0][0].shape[0]
-               if cols else 0, tuple(cards), narrowed)
+               if cols else 0, tuple(cards))
         kern = self._kernel(key, lambda: self._build_agg_kernel(
-            dag, prepared, cards, segments, narrowed))
-        out = kern(cols, row_mask)
-        float_rows = self._float_val_rows(dag)
-        ints = np.asarray(out["ints"])  # (1 + naggs*? , segments) packed
-        flts = np.asarray(out["flts"]) if float_rows else None
+            dag, prepared, cards, segments))
+        # single synchronous device round trip for the whole query
+        out = jax.device_get(kern(cols, row_mask))
 
-        rows_per_seg = ints[0]
+        rows_per_seg = SE.combine_partials(out["rows"])
         present = rows_per_seg > 0
         seg_idx = np.nonzero(present)[0]
         if len(seg_idx) == 0:
@@ -555,18 +633,28 @@ class CopClient:
                 dictionary = snap.dictionaries[dag.scan.col_offsets[g.idx]]
             columns.append(Column(
                 ft, data, None if not is_null.any() else ~is_null, dictionary))
-        fi = 0
-        for ai, d in enumerate(agg.aggs):
-            cnt = ints[2 + 2 * ai][seg_idx]
-            if ai in float_rows:
-                val = flts[fi][seg_idx]
-                fi += 1
-            else:
-                val = ints[1 + 2 * ai][seg_idx]
+
+        for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+            cnt = SE.combine_partials(out[f"cnt{ai}"])[seg_idx] \
+                if s["kind"] != "count" else rows_if_countstar(
+                    out, ai, rows_per_seg)[seg_idx]
             val_t = dag.output_types[len(agg.group_by) + 2 * ai]
-            if d.func == "count":
+            if s["kind"] == "count":
                 vcol = Column(val_t, cnt.astype(np.int64))
-            else:
+            elif s["kind"] == "isum":
+                total = np.zeros(segments, dtype=np.int64)
+                for ti, (_, shift, _) in enumerate(s["terms"]):
+                    total += SE.combine_partials(out[f"s{ai}_{ti}"]) << shift
+                val = total[seg_idx]
+                vcol = Column(val_t, val.astype(val_t.np_dtype),
+                              None if (cnt > 0).all() else (cnt > 0))
+            elif s["kind"] == "fsum":
+                val = SE.combine_float(out[f"f{ai}"])[seg_idx]
+                vcol = Column(val_t, val.astype(val_t.np_dtype),
+                              None if (cnt > 0).all() else (cnt > 0))
+            else:  # min / max — sentinel-filled where empty; cnt gates
+                val = np.asarray(out[f"m{ai}"])[seg_idx]
+                val = np.where(cnt > 0, val, 0)
                 vcol = Column(val_t, val.astype(val_t.np_dtype),
                               None if (cnt > 0).all() else (cnt > 0))
             columns.append(vcol)
@@ -575,205 +663,111 @@ class CopClient:
                 cnt.astype(np.int64)))
         return [Chunk(columns)]
 
-    def _build_agg_kernel(self, dag, prepared, cards, segments, narrowed):
-        body = self._agg_kernel_body(dag, prepared, cards, segments,
-                                     narrowed=narrowed)
-        float_rows = self._float_val_rows(dag)
-
-        def packed(cols, row_mask):
-            return self._pack_agg(dag, body(cols, row_mask), float_rows)
-
-        return jax.jit(packed)
-
-    def _pack_agg(self, dag, out, float_rows):
-        """Pack partials into one int64 buffer (+ one f64 buffer iff float
-        aggregates exist): rows [rows, val0, cnt0, val1, cnt1, ...]; float
-        vals go to the float buffer in float_rows order (their int64 slot
-        is zero-filled)."""
-        naggs = len(dag.agg.aggs)
-        rows = [out["rows"].astype(jnp.int64)]
-        fl = []
-        for ai in range(naggs):
-            v = out[f"val{ai}"]
-            if ai in float_rows:
-                fl.append(v.astype(jnp.float64))
-                rows.append(jnp.zeros_like(out["rows"], dtype=jnp.int64))
-            else:
-                rows.append(v.astype(jnp.int64))
-            rows.append(out[f"cnt{ai}"].astype(jnp.int64))
-        res = {"ints": jnp.stack(rows)}
-        if fl:
-            res["flts"] = jnp.stack(fl)
-        return res
+    def _build_agg_kernel(self, dag, prepared, cards, segments):
+        body = self._agg_kernel_body(dag, prepared, cards, segments)
+        return jax.jit(body)
 
     def _segment_ids(self, agg, cards, offsets, cols, prepared, mask):
         """Mixed-radix dense segment id; NULL key -> card-1 slot."""
         seg = jnp.zeros(mask.shape[0], dtype=jnp.int32)
         for g, card, off in zip(agg.group_by, cards, offsets):
             v, vl = eval_expr(g, cols, prepared)
-            # subtract the offset at the value's own width: the span fits
-            # int32 (card <= 8192) but the absolute values may not
+            if v.dtype == jnp.bool_:
+                v = v.astype(jnp.int32)  # boolean keys: 0/1 codes
             shifted = (v - jnp.asarray(off, dtype=v.dtype)).astype(jnp.int32)
             k = jnp.where(vl, shifted, card - 1)
             k = jnp.clip(k, 0, card - 1)
             seg = seg * card + k
         return jnp.where(mask, seg, -1)
 
-    def _agg_kernel_body(self, dag, prepared, cards, segments,
-                         keep_sentinels: bool = False,
-                         narrowed: tuple = ()):
-        """Pure (cols, row_mask) -> {partials} function; the distributed
-        client wraps it in shard_map + per-function collectives (psum for
-        sums/counts, pmin/pmax for min/max — see parallel/dist.py).
-        keep_sentinels leaves +-inf/INT_MIN/MAX in empty min/max segments so
-        a cross-device pmin/pmax merge stays correct; the merger zeroes them
-        after reducing."""
-        strategy = prepared.get("__strategy__", "loop")
-        if strategy == "einsum":
-            return self._agg_body_einsum(dag, prepared, cards, segments,
-                                         narrowed)
-        return self._agg_body_loop(dag, prepared, cards, segments,
-                                   keep_sentinels, narrowed)
-
-    def _agg_body_loop(self, dag, prepared, cards, segments, keep_sentinels,
-                       narrowed):
-        """Per-segment masked reductions — scatter-free; XLA fuses the
-        whole loop into a single pass over the data for small segment
-        counts."""
+    def _agg_kernel_body(self, dag, prepared, cards, segments):
+        """Pure (cols, row_mask) -> {partials} function. All leaves are
+        int32 (exact limb partials, sentinel min/max) or f32 (block float
+        sums); the distributed client wraps it in shard_map and merges with
+        native-int32 psum / pmin / pmax (parallel/dist.py)."""
         agg = dag.agg
         sel = dag.selection
         offsets = prepared["__key_offsets__"]
+        sched = prepared["__agg_sched__"]
+        strategy = prepared["__strategy__"]
 
         def kernel(cols, row_mask):
-            cols = self._widen_cols(cols, narrowed)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
             seg = self._segment_ids(agg, cards, offsets, cols, prepared, mask)
-            seg_eq = [seg == k for k in range(segments)]
-            out = {"rows": jnp.stack(
-                [jnp.sum(m.astype(jnp.int32)).astype(jnp.int64)
-                 for m in seg_eq])}
-            for ai, d in enumerate(agg.aggs):
-                if d.arg is None:
-                    out[f"val{ai}"] = out["rows"]
-                    out[f"cnt{ai}"] = out["rows"]
+            one_hot = SE.make_one_hot(seg, segments) \
+                if strategy == "einsum" else None
+            ones = mask.astype(jnp.int32)
+            out = {"rows": SE.seg_sum_partials(ones, seg, segments, 1,
+                                              one_hot=one_hot)}
+            for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+                if s["kind"] == "count":
+                    if d.arg is not None:
+                        _, vl = eval_expr(d.arg, cols, prepared)
+                        cseg = jnp.where(vl, seg, -1)
+                        out[f"cnt{ai}"] = SE.seg_sum_partials(
+                            ones, cseg, segments, 1, one_hot=None
+                            if one_hot is None else SE.make_one_hot(
+                                cseg, segments))
                     continue
-                v, vl = eval_expr(d.arg, cols, prepared)
-                contrib = mask & vl
-                cnt = jnp.stack(
-                    [jnp.sum((m & vl).astype(jnp.int32)).astype(jnp.int64)
-                     for m in seg_eq])
-                is_f = jnp.issubdtype(v.dtype, jnp.floating)
-                if d.func in ("sum", "avg", "count"):
-                    if is_f:
-                        vv = jnp.where(contrib, v, 0.0)
-                        val = jnp.stack(
-                            [jnp.sum(jnp.where(m, vv, 0.0)) for m in seg_eq])
-                    else:
-                        vv = jnp.where(contrib, v.astype(jnp.int64), 0)
-                        val = jnp.stack(
-                            [jnp.sum(jnp.where(m, vv, 0)) for m in seg_eq])
-                elif d.func in ("min", "max"):
-                    if is_f:
-                        sent = jnp.inf if d.func == "min" else -jnp.inf
-                        vv = jnp.where(contrib, v, sent)
-                    else:
-                        sent = _INT_MAX if d.func == "min" else _INT_MIN
-                        vv = jnp.where(contrib, v.astype(jnp.int64), sent)
-                    red = jnp.min if d.func == "min" else jnp.max
-                    val = jnp.stack(
-                        [red(jnp.where(m, vv, sent)) for m in seg_eq])
-                    if not keep_sentinels:
-                        val = jnp.where(cnt > 0, val, 0)
-                else:
-                    raise CompileError(f"agg {d.func} not on device")
-                out[f"val{ai}"] = val
-                out[f"cnt{ai}"] = cnt
-            return out
-
-        return kernel
-
-    def _agg_body_einsum(self, dag, prepared, cards, segments, narrowed):
-        """Exact segment sums on the MXU for larger dense key spaces:
-        one-hot f32 einsum per 12-bit signed limb, per-block partials kept
-        < 2^24 (exactly representable in f32), recombined in int64. Only
-        additive aggregates (sum/avg/count) qualify — gated in _prepare."""
-        agg = dag.agg
-        sel = dag.selection
-        offsets = prepared["__key_offsets__"]
-        limbs = prepared["__limbs__"]
-        B = _EINSUM_BLOCK
-
-        def seg_sums(v64, seg2, oh, L):
-            """Exact int64 per-segment sums of v64 via L signed limbs."""
-            total = jnp.zeros((segments,), jnp.int64)
-            x = v64
-            for i in range(L):
-                if i < L - 1:
-                    limb = (x & ((1 << _LIMB_BITS) - 1)).astype(jnp.float32)
-                    x = x >> _LIMB_BITS
-                else:
-                    limb = x.astype(jnp.float32)
-                # HIGHEST forces true f32 MXU passes (TPU default can drop
-                # to bf16's 8 mantissa bits, silently rounding 12-bit limbs)
-                part = jnp.einsum("cb,cbk->ck", limb, oh,
-                                  precision=jax.lax.Precision.HIGHEST)
-                total = total + (
-                    part.astype(jnp.int64).sum(axis=0) << (_LIMB_BITS * i))
-            return total
-
-        def kernel(cols, row_mask):
-            cols = self._widen_cols(cols, narrowed)
-            mask = row_mask
-            if sel is not None:
-                mask = selection_mask(sel.conditions, cols, prepared, mask)
-            seg = self._segment_ids(agg, cards, offsets, cols, prepared, mask)
-            n = seg.shape[0]
-            C = -(-n // B)
-            pad = C * B - n
-            seg2 = jnp.pad(seg, (0, pad), constant_values=-1).reshape(C, B)
-            # one_hot of -1 is all-zero -> masked/padded rows vanish
-            oh = jax.nn.one_hot(seg2, segments, dtype=jnp.float32)
-
-            def padded(x, fill=0):
-                return jnp.pad(x, (0, pad), constant_values=fill).reshape(C, B)
-
-            ones = padded(mask.astype(jnp.int64))
-            out = {"rows": seg_sums(ones, seg2, oh, 1)}
-            for ai, d in enumerate(agg.aggs):
-                if d.arg is None:
-                    out[f"val{ai}"] = out["rows"]
-                    out[f"cnt{ai}"] = out["rows"]
+                v, vl = eval_expr(d.arg, cols, prepared) \
+                    if s["kind"] != "isum" else (None, None)
+                if s["kind"] == "isum":
+                    # validity from the original arg (cheap: XLA CSEs the
+                    # shared subexpressions with the term evals below)
+                    _, vl = eval_expr(d.arg, cols, prepared)
+                    vseg = jnp.where(vl, seg, -1)
+                    voh = SE.make_one_hot(vseg, segments) \
+                        if one_hot is not None else None
+                    out[f"cnt{ai}"] = SE.seg_sum_partials(
+                        ones, vseg, segments, 1, one_hot=voh)
+                    for ti, (t, shift, L) in enumerate(s["terms"]):
+                        tv, _ = eval_expr(t, cols, prepared)
+                        out[f"s{ai}_{ti}"] = SE.seg_sum_partials(
+                            tv.astype(jnp.int32), vseg, segments, L,
+                            one_hot=voh)
                     continue
-                v, vl = eval_expr(d.arg, cols, prepared)
-                contrib = mask & vl
-                cnt = seg_sums(padded(contrib.astype(jnp.int64)), seg2, oh, 1)
-                vv = padded(jnp.where(contrib, v.astype(jnp.int64), 0))
-                out[f"val{ai}"] = seg_sums(vv, seg2, oh, limbs[ai])
-                out[f"cnt{ai}"] = cnt
+                vseg = jnp.where(vl, seg, -1)
+                out[f"cnt{ai}"] = SE.seg_sum_partials(
+                    ones, vseg, segments, 1)
+                if s["kind"] == "fsum":
+                    out[f"f{ai}"] = SE.float_seg_sums(
+                        v, vseg, segments, _FLOAT_BLOCKS)
+                else:  # min / max with sentinels (kept for pmin/pmax merge)
+                    is_f = jnp.issubdtype(v.dtype, jnp.floating)
+                    if is_f:
+                        sent = jnp.inf if s["kind"] == "min" else -jnp.inf
+                    else:
+                        sent = _I32_MAX if s["kind"] == "min" else _I32_MIN
+                        v = v.astype(jnp.int32)
+                    vv = jnp.where(vseg >= 0, v, sent)
+                    red = jnp.min if s["kind"] == "min" else jnp.max
+                    out[f"m{ai}"] = jnp.stack([
+                        red(jnp.where(vseg == k, vv, sent))
+                        for k in range(segments)])
             return out
 
         return kernel
 
     # ---- row path (scan/selection/projection) -------------------------------
     def _run_rows(self, dag, snap, prepared, cols, row_mask, host_cols,
-                  narrowed):
+                  host_mask):
         """Device evaluates the (fused) filter and returns ONLY a packed
         bitmask — one small buffer; projections are computed host-side over
         the selected subset (numpy over the epoch's host columns). Full-width
         device outputs would pay the device->host transfer for every row."""
         if dag.selection is None:
-            # pure scan: nothing for the device to do
-            idx = np.nonzero(np.asarray(row_mask))[0]
+            # pure scan: nothing for the device to do — host mask suffices
+            idx = np.nonzero(host_mask)[0]
             if dag.limit is not None and len(idx) > dag.limit.n:
                 idx = idx[: dag.limit.n]
             return self._host_rows(dag, snap, host_cols, idx)
         key = ("rowmask", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0, narrowed)
+               cols[0][0].shape[0] if cols else 0)
         kern = self._kernel(key, lambda: self._build_rowmask_kernel(
-            dag, prepared, narrowed))
-        packed = np.asarray(kern(cols, row_mask))
+            dag, prepared))
+        packed = jax.device_get(kern(cols, row_mask))
         n_rows = host_cols[0][0].shape[0] if host_cols else 0
         mask = np.unpackbits(packed, count=None).astype(bool)[: n_rows] \
             if n_rows else np.zeros(0, bool)
@@ -782,12 +776,11 @@ class CopClient:
             idx = idx[: dag.limit.n]
         return self._host_rows(dag, snap, host_cols, idx)
 
-    def _build_rowmask_kernel(self, dag, prepared, narrowed):
+    def _build_rowmask_kernel(self, dag, prepared):
         sel = dag.selection
 
         @jax.jit
         def kernel(cols, row_mask):
-            cols = self._widen_cols(cols, narrowed)
             mask = selection_mask(sel.conditions, cols, prepared, row_mask)
             return jnp.packbits(mask)
 
@@ -822,21 +815,17 @@ class CopClient:
         return [Chunk(columns)]
 
     # ---- TopN path ----------------------------------------------------------
-    def _run_topn(self, dag, snap, prepared, cols, row_mask, host_cols,
-                  narrowed):
+    def _run_topn(self, dag, snap, prepared, cols, row_mask, host_cols):
         expr, desc = dag.topn.items[0]
         n = dag.topn.n
         key = ("topn", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0, n, desc, narrowed)
+               cols[0][0].shape[0] if cols else 0, n, desc)
         kern = self._kernel(key, lambda: self._build_topn_kernel(
-            dag, prepared, expr, desc, n, narrowed))
-        out = kern(cols, row_mask)
-        ints = np.asarray(out["ints"])  # (2 + n_int_cols*2, k)
-        flts = np.asarray(out["flts"]) if "flts" in out else None
-        idx = ints[0]
+            dag, prepared, expr, desc, n))
+        out = jax.device_get(kern(cols, row_mask))
+        ints = out["ints"]  # int32[2 + n_int_cols*2, k]
+        flts = out.get("flts")  # f32[n_flt_cols*2, k]
         picked = ints[1].astype(bool)
-        idx = idx[picked]
-        k = len(idx)
         columns = []
         if dag.projections is not None:
             exprs = dag.projections
@@ -863,7 +852,7 @@ class CopClient:
             return []
         return [Chunk(columns)]
 
-    def _build_topn_kernel(self, dag, prepared, expr, desc, n, narrowed):
+    def _build_topn_kernel(self, dag, prepared, expr, desc, n):
         sel = dag.selection
         projections = dag.projections
         if projections is not None:
@@ -878,7 +867,6 @@ class CopClient:
 
         @jax.jit
         def kernel(cols, row_mask):
-            cols = self._widen_cols(cols, narrowed)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
@@ -887,32 +875,32 @@ class CopClient:
             # sorts NULLs last but they still belong in the result)
             if jnp.issubdtype(v.dtype, jnp.floating):
                 null_score = jnp.inf if not desc else -jnp.finfo(
-                    jnp.float64).max
+                    jnp.float32).max
                 drop_score = -jnp.inf
                 score = jnp.where(vl, v if desc else -v, null_score)
             else:
-                v64 = v.astype(jnp.int64)
-                null_score = _INT_MAX if not desc else _INT_MIN
-                drop_score = jnp.iinfo(jnp.int64).min
-                score = jnp.where(vl, v64 if desc else -v64, null_score)
+                v32 = v.astype(jnp.int32)
+                null_score = _I32_MAX if not desc else _I32_MIN
+                drop_score = jnp.iinfo(jnp.int32).min
+                score = jnp.where(vl, v32 if desc else -v32, null_score)
             score = jnp.where(mask, score, drop_score)
             k = min(n, score.shape[0])
             _, idx = jax.lax.top_k(score, k)
             # gather the k result rows in-kernel: the packed output is the
             # ONLY device->host transfer (k rows, not full columns)
-            int_rows = [idx.astype(jnp.int64),
-                        mask[idx].astype(jnp.int64)]
+            int_rows = [idx.astype(jnp.int32),
+                        mask[idx].astype(jnp.int32)]
             flt_rows = []
             for pi, e in enumerate(exprs):
                 pv, pvl = eval_expr(e, cols, prepared)
                 pvk = pv[idx]
                 pvlk = (pvl & mask)[idx]
                 if out_types[pi].is_float:
-                    flt_rows.append(pvk.astype(jnp.float64))
-                    flt_rows.append(pvlk.astype(jnp.float64))
+                    flt_rows.append(pvk.astype(jnp.float32))
+                    flt_rows.append(pvlk.astype(jnp.float32))
                 else:
-                    int_rows.append(pvk.astype(jnp.int64))
-                    int_rows.append(pvlk.astype(jnp.int64))
+                    int_rows.append(pvk.astype(jnp.int32))
+                    int_rows.append(pvlk.astype(jnp.int32))
             out = {"ints": jnp.stack(int_rows)}
             if flt_rows:
                 out["flts"] = jnp.stack(flt_rows)
@@ -956,6 +944,14 @@ class CopClient:
 
 # ==================== helpers ====================
 
+def rows_if_countstar(out, ai, rows_per_seg):
+    """COUNT(*) uses the row counts; COUNT(x) shipped its own cnt."""
+    key = f"cnt{ai}"
+    if key in out:
+        return SE.combine_partials(out[key])
+    return rows_per_seg
+
+
 def _pad(a: np.ndarray, b: int) -> np.ndarray:
     if len(a) == b:
         return a
@@ -980,12 +976,11 @@ def _mask_digest(m: np.ndarray) -> str:
 
 def _dag_key(dag: CopDAG, prepared: dict[Any, Any]) -> str:
     # structural + constant identity, plus the resolved payload signature
-    # (string codes, dict sizes, strategy/cards/offsets, limb counts)
-    # collected in deterministic walk order — append-only dictionaries mean
+    # (string codes, dict sizes, strategy/cards/offsets, schedule) collected
+    # in deterministic walk order — append-only dictionaries mean
     # (code values, table lengths) fully capture staleness
     sig = tuple(prepared.get("__sig__", ()))
-    limbs = tuple(prepared.get("__limbs__", ()))
-    return f"{dag.describe()}|{_expr_reprs(dag)}|{sig}|{limbs}"
+    return f"{dag.describe()}|{_expr_reprs(dag)}|{sig}"
 
 
 def _expr_reprs(dag: CopDAG) -> str:

@@ -35,7 +35,16 @@ class CompileError(Exception):
 
 
 def _np_dtype_for(ft: FieldType):
-    return ft.np_dtype
+    """Device dtype: TPUs have no native 64-bit — integers/decimals/codes
+    lower to int32 (exactness guaranteed by the planner's interval
+    analysis + limb decomposition), floats to float32."""
+    import numpy as np
+    host = ft.np_dtype
+    if host == np.dtype(np.int64):
+        return np.dtype(np.int32)
+    if host == np.dtype(np.float64):
+        return np.dtype(np.float32)
+    return host
 
 
 def _scale_factor(diff: int) -> int:
@@ -228,10 +237,18 @@ def _eval_call(e: Call, columns: list[VV], prepared: dict[int, Any]) -> VV:
             av = av // 86_400_000_000  # micros -> days
         y, m, d = _civil_from_days(av)
         out = {"year": y, "month": m, "day": d}[op]
-        return out.astype(jnp.int64), avl
+        return out.astype(jnp.int32), avl
     if op == "date_add_days":
         av, avl = ev(e.args[0])
         return av + int(e.extra), avl
+
+    # ---- limb splits (wide-aggregate term decomposition, bounds.py) --------
+    if op == "shr15":
+        av, avl = ev(e.args[0])
+        return av >> 15, avl
+    if op == "and15":
+        av, avl = ev(e.args[0])
+        return av & 0x7FFF, avl
 
     # ---- casts -------------------------------------------------------------
     if op == "cast":
@@ -252,7 +269,7 @@ def _as_bool(vv: VV) -> VV:
 
 def _to_float(v: jnp.ndarray) -> jnp.ndarray:
     if not jnp.issubdtype(v.dtype, jnp.floating):
-        return v.astype(jnp.float64)
+        return v.astype(jnp.float32)
     return v
 
 
@@ -318,7 +335,7 @@ def _cast_to(vv: VV, src: FieldType, dst: FieldType) -> VV:
         if src.is_float:
             scaled = v * _scale_factor(dst.scale)
             q = jnp.floor(jnp.abs(scaled) + 0.5)
-            return jnp.where(scaled < 0, -q, q).astype(jnp.int64), vl
+            return jnp.where(scaled < 0, -q, q).astype(jnp.int32), vl
         raise CompileError(f"cast {src!r} -> {dst!r} not on device")
     if dst.is_integer:
         if src.is_decimal:
@@ -328,16 +345,16 @@ def _cast_to(vv: VV, src: FieldType, dst: FieldType) -> VV:
             return jnp.where(v < 0, -q, q), vl
         if src.is_float:
             q = jnp.floor(jnp.abs(v) + 0.5)
-            return jnp.where(v < 0, -q, q).astype(jnp.int64), vl
+            return jnp.where(v < 0, -q, q).astype(jnp.int32), vl
         if src.is_integer or src.kind == TypeKind.BOOLEAN:
-            return v.astype(jnp.int64), vl
+            return v.astype(jnp.int32), vl
     raise CompileError(f"cast {src!r} -> {dst!r} not on device")
 
 
 def _civil_from_days(z: jnp.ndarray):
     """days-since-epoch -> (year, month, day), branch-free integer math
     (Howard Hinnant's civil_from_days; public-domain algorithm)."""
-    z = z.astype(jnp.int64) + 719_468
+    z = z.astype(jnp.int32) + 719_468
     era = jnp.where(z >= 0, z, z - 146_096) // 146_097
     doe = z - era * 146_097
     yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365

@@ -8,8 +8,10 @@ from planner/core/fragment.go), the TPU framework shards the column epoch
 across devices and lets XLA collectives do the exchange:
 
 * scan fan-out (P1)  -> rows axis sharding of the padded column arrays
-* partial aggregation (P2 partial stage) -> per-shard dense segment_sum
-* final merge (P2 final / P9 exchange)   -> psum over the mesh axis (ICI)
+* partial aggregation (P2 partial stage) -> per-shard exact limb partials
+* final merge (P2 final / P9 exchange)   -> psum/pmin/pmax over the mesh
+  axis (ICI), all in native int32 — the limb partials are exact under
+  addition (sumexact.py), so the collective needs no 64-bit emulation.
 
 The partial layout is identical to the single-chip path, so the host final
 stage is unchanged — it just receives partials that were already reduced
@@ -21,7 +23,6 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -41,8 +42,8 @@ class DistCopClient(CopClient):
 
     Row batches are padded to shape buckets (multiples of 256, so any
     power-of-two mesh divides them); each device reduces its row shard into
-    the full dense segment space, then a psum over the mesh axis yields the
-    global partials on every device. Inputs are placed with row-sharded
+    the full dense segment space, then collectives over the mesh axis yield
+    the global partials on every device. Inputs are placed with row-sharded
     NamedShardings so jit consumes them without host round-trips.
     """
 
@@ -51,43 +52,35 @@ class DistCopClient(CopClient):
         self.mesh = mesh
         self._n = mesh.devices.size
 
-    def _build_agg_kernel(self, dag, prepared, cards, segments, narrowed):
-        body = self._agg_kernel_body(dag, prepared, cards, segments,
-                                     keep_sentinels=True, narrowed=narrowed)
-        aggs = dag.agg.aggs
-        float_rows = self._float_val_rows(dag)
+    def _build_agg_kernel(self, dag, prepared, cards, segments):
+        body = self._agg_kernel_body(dag, prepared, cards, segments)
+        sched = prepared["__agg_sched__"]
+        minmax_kind = {f"m{ai}": s["kind"]
+                       for ai, s in enumerate(sched)
+                       if s["kind"] in ("min", "max")}
 
         def sharded(cols, row_mask):
             out = body(cols, row_mask)
-            # per-function merge: sums/counts are additive; min/max need
-            # pmin/pmax over the sentinel-preserving partials, then empty
-            # segments are zeroed exactly like the single-chip kernel
-            merged = {"rows": jax.lax.psum(out["rows"], AXIS)}
-            for ai, d in enumerate(aggs):
-                cnt = jax.lax.psum(out[f"cnt{ai}"], AXIS)
-                val = out[f"val{ai}"]
-                if d.arg is not None and d.func == "min":
-                    val = jax.lax.pmin(val, AXIS)
-                    val = jnp.where(cnt > 0, val, 0)
-                elif d.arg is not None and d.func == "max":
-                    val = jax.lax.pmax(val, AXIS)
-                    val = jnp.where(cnt > 0, val, 0)
+            merged = {}
+            for key, val in out.items():
+                kind = minmax_kind.get(key)
+                if kind == "min":
+                    merged[key] = jax.lax.pmin(val, AXIS)
+                elif kind == "max":
+                    merged[key] = jax.lax.pmax(val, AXIS)
                 else:
-                    val = jax.lax.psum(val, AXIS)
-                merged[f"val{ai}"] = val
-                merged[f"cnt{ai}"] = cnt
-            # pack inside shard_map (post-collective, replicated) so the
-            # host sees the same single-buffer layout as the one-chip path
-            return self._pack_agg(dag, merged, float_rows)
+                    # limb partials / counts (int32, exact under addition)
+                    # and float block sums — both additive
+                    merged[key] = jax.lax.psum(val, AXIS)
+            return merged
 
-        out_specs = {"ints": P()}
-        if float_rows:
-            out_specs["flts"] = P()
+        # every output is replicated post-collective; a single P() acts
+        # as a pytree prefix matching every leaf of the output dict
         mapped = jax.shard_map(
             sharded,
             mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS)),
-            out_specs=out_specs,
+            out_specs=P(),
         )
         return jax.jit(mapped)
 
@@ -98,9 +91,9 @@ class DistCopClient(CopClient):
         lcm = int(np.lcm(256, self._n))
         return -(-b // lcm) * lcm
 
-    def _stage_inputs(self, dag, snap, overlay: bool, col_bounds=None):
-        cols, row_mask, host_cols, narrowed = super()._stage_inputs(
-            dag, snap, overlay, col_bounds=col_bounds)
+    def _stage_inputs(self, dag, snap, overlay: bool):
+        cols, row_mask, host_cols, host_mask = super()._stage_inputs(
+            dag, snap, overlay)
         n = row_mask.shape[0]
         assert n % self._n == 0, f"bucket {n} vs mesh {self._n}"
         sharding = NamedSharding(self.mesh, P(AXIS))
@@ -109,4 +102,4 @@ class DistCopClient(CopClient):
             for d, v in cols
         ]
         row_mask = jax.device_put(row_mask, sharding)
-        return cols, row_mask, host_cols, narrowed
+        return cols, row_mask, host_cols, host_mask
