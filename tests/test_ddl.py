@@ -283,3 +283,49 @@ def test_multi_spec_alter(se):
     info = se.catalog.table("test", "t")
     assert info.column_by_name("c") is not None
     assert any(ix.name == "kc" for ix in info.indices)
+
+
+# ---------------- lossy MODIFY COLUMN vs unique keys ----------------
+
+def test_modify_column_lossy_on_unique_rejected():
+    """A narrowing cast can collapse distinct values (0.9 and 1.1 -> 1);
+    on a uniquely-indexed column that would admit silent duplicates, so
+    the change is rejected (reference re-validates during modify reorg,
+    ddl/column.go)."""
+    s = Session()
+    s.execute("CREATE TABLE lm (id INT PRIMARY KEY, d DECIMAL(5,2))")
+    s.execute("CREATE UNIQUE INDEX ud ON lm (d)")
+    s.execute("INSERT INTO lm VALUES (1, 0.90), (2, 1.10)")
+    with pytest.raises(SQLError, match="lossy"):
+        s.execute("ALTER TABLE lm MODIFY COLUMN d INT")
+    # non-indexed columns may still narrow (values collapse legally)
+    s.execute("CREATE TABLE lm2 (id INT PRIMARY KEY, d DECIMAL(5,2))")
+    s.execute("INSERT INTO lm2 VALUES (1, 0.90), (2, 1.10)")
+    s.execute("ALTER TABLE lm2 MODIFY COLUMN d INT")
+    assert s.query("SELECT d FROM lm2 ORDER BY id") == [(1,), (1,)]
+
+
+def test_modify_column_lossless_on_unique_allowed():
+    s = Session()
+    s.execute("CREATE TABLE lw (id INT PRIMARY KEY, a INT)")
+    s.execute("CREATE UNIQUE INDEX ua ON lw (a)")
+    s.execute("INSERT INTO lw VALUES (1, 7), (2, 9)")
+    s.execute("ALTER TABLE lw MODIFY COLUMN a BIGINT")
+    assert s.query("SELECT a FROM lw WHERE a = 9") == [(9,)]
+    s.execute("CREATE TABLE lw2 (id INT PRIMARY KEY, a INT)")
+    s.execute("CREATE UNIQUE INDEX ua2 ON lw2 (a)")
+    s.execute("INSERT INTO lw2 VALUES (1, 7)")
+    # INT needs 10 integer digits: DECIMAL(12,2) holds them losslessly
+    s.execute("ALTER TABLE lw2 MODIFY COLUMN a DECIMAL(12,2)")
+    assert s.query("SELECT id FROM lw2 WHERE a = 7") == [(1,)]
+
+
+def test_modify_column_swaps_type_and_data_atomically():
+    """The rewritten epoch and the new TableInfo publish in one step: a
+    DECIMAL(10,2)->DECIMAL(10,4) rescale must never be readable at the
+    old scale."""
+    s = Session()
+    s.execute("CREATE TABLE at2 (id INT PRIMARY KEY, d DECIMAL(10,2))")
+    s.execute("INSERT INTO at2 VALUES (1, 12.34)")
+    s.execute("ALTER TABLE at2 MODIFY COLUMN d DECIMAL(10,4)")
+    assert str(s.query("SELECT d FROM at2")[0][0]) == "12.3400"

@@ -221,3 +221,16 @@ def test_analyze_with_nulls_and_strings():
     assert name_stats.histogram is None  # strings: no histogram
     assert name_stats.ndv == 2
     assert ts.columns[2].null_count == 1
+
+
+def test_cmsketch_rows_all_distribute():
+    """Every depth row must spread values over buckets: a degenerate row
+    (all mass in one bucket) wastes a min() contributor."""
+    import numpy as np
+    from tidb_tpu.stats.sketch import CMSketch
+
+    vals = np.arange(5000, dtype=np.int64)
+    sk = CMSketch.build(vals)
+    for d in range(CMSketch.DEPTH):
+        assert (sk.table[d] > 0).sum() > CMSketch.WIDTH // 4, (
+            f"depth row {d} is degenerate")

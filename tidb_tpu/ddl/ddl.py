@@ -193,6 +193,34 @@ class DDL:
                 done = self._validate_unique_batch(job, info, store, index)
                 if not done:
                     return False
+                # publish race: a txn that buffered rows BEFORE the index
+                # was registered can commit between the last validation
+                # snapshot and the token bump — it was never unique-checked.
+                # Close the window under the commit lock: no commit can land
+                # while we re-validate the overlay and bump the fence
+                # (reference: schema-version sync gates publication,
+                # ddl/util/syncer.go + domain/schema_validator.go).
+                with self.storage._commit_lock:
+                    txn = self.storage.begin()
+                    try:
+                        snap = txn.snapshot(info.id)
+                        # an empty epoch needs no batched scan (and set no
+                        # reorg_epoch); otherwise the epoch must still be
+                        # the one the batches validated — a compaction in
+                        # between folded unvalidated commits into a fresh
+                        # epoch, so restart the scan on it
+                        if snap.epoch.num_rows > 0 and \
+                                snap.epoch.epoch_id != \
+                                job.args.get("reorg_epoch"):
+                            job.args["reorg_epoch"] = None
+                            job.reorg_pos = 0
+                            return False
+                        self._validate_overlay(snap, index, info)
+                    finally:
+                        txn.rollback()
+                    index.visible = True
+                    store.schema_token += 1
+                return True
             index.visible = True
             # fence txns that buffered writes before the index existed —
             # they never unique-checked it (schema_validator analog)
@@ -399,9 +427,22 @@ class DDL:
         if cast_fn is None:
             raise DDLError(
                 f"unsupported column type change {old_ft!r} -> {new_ft!r}")
-        err = store.cast_column(c.offset, cast_fn)
-        if err is not None:
-            raise DDLError(f"data truncated: {err}")
+        if not _is_lossless_cast(old_ft, new_ft):
+            # a narrowing cast can collapse distinct values (0.9 and 1.1
+            # both round to 1), leaving duplicate keys in a unique index
+            # with no error — the reference re-validates uniqueness during
+            # modify-column reorg (ddl/column.go); until that scan exists
+            # here, reject the lossy change on uniquely-keyed columns
+            for ix in info.indices:
+                if ix.unique and c.offset in ix.col_offsets:
+                    raise DDLError(
+                        f"unsupported lossy type change {old_ft!r} -> "
+                        f"{new_ft!r} on column '{c.name}' covered by "
+                        f"unique key '{ix.name}'")
+            if info.pk_handle_offset == c.offset:
+                raise DDLError(
+                    f"unsupported lossy type change {old_ft!r} -> "
+                    f"{new_ft!r} on primary key column '{c.name}'")
         new_cols = [ColumnInfo(oc.id, oc.name,
                                new_ft if oc.offset == c.offset else oc.ftype,
                                oc.offset, oc.default, oc.is_primary,
@@ -409,7 +450,11 @@ class DDL:
                     for oc in info.columns]
         new_info = TableInfo(info.id, info.name, new_cols,
                              list(info.indices), info.pk_handle_offset)
-        store.table = new_info
+        # data rewrite + TableInfo swap are one atomic step under the store
+        # lock: a snapshot must never pair rescaled values with the old type
+        err = store.cast_column(c.offset, cast_fn, new_info)
+        if err is not None:
+            raise DDLError(f"data truncated: {err}")
         self.catalog.replace_table(job.db, info.name, new_info)
         self.storage.stats.drop_table(info.id)
         return True
@@ -431,6 +476,32 @@ class DDL:
         schema.tables.pop(old_name.lower(), None)
         self.catalog.replace_table(new_db, new_name, new_info)
         return True
+
+
+_INT_DIGITS = {TypeKind.TINYINT: 3, TypeKind.SMALLINT: 5, TypeKind.INT: 10,
+               TypeKind.BIGINT: 19, TypeKind.BOOLEAN: 1, TypeKind.YEAR: 4}
+
+
+def _is_lossless_cast(old: FieldType, new: FieldType) -> bool:
+    """True when the MODIFY COLUMN conversion can never collapse two
+    distinct stored values into one (safe on uniquely-indexed columns)."""
+    if old.kind == new.kind and not old.is_decimal:
+        return True
+    if old.is_string and new.is_string:
+        return True
+    if old.is_integer and new.is_integer:
+        return _INT_DIGITS.get(new.kind, 0) >= _INT_DIGITS.get(old.kind, 99)
+    if old.is_integer and new.is_decimal:
+        return (new.flen - new.scale) >= _INT_DIGITS.get(old.kind, 99)
+    if old.is_decimal and new.is_decimal:
+        # scale must not shrink (rounding collapses) and integer-digit
+        # capacity must not shrink (conservative: overflow raises rather
+        # than collapses, but keep the declared capacity honest)
+        return (new.scale >= old.scale
+                and (new.flen - new.scale) >= (old.flen - old.scale))
+    # float targets round to ~15 digits; decimal/float -> int truncates —
+    # all potentially value-collapsing
+    return False
 
 
 def _phys_default(ft: FieldType, default):

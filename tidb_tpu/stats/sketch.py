@@ -87,7 +87,7 @@ class CMSketch:
             h = hash_any(rest_u)
             scaled = np.round(rest_c * scale).astype(np.int64)
             for d in range(cls.DEPTH):
-                idx = ((h >> np.uint64(d * 12)) ^ h) % np.uint64(cls.WIDTH)
+                idx = ((h >> np.uint64((d + 1) * 12)) ^ h) % np.uint64(cls.WIDTH)
                 np.add.at(sk.table[d], idx.astype(np.int64), scaled)
             sk.default = max(1, int(round(float(rest_c.mean()) * scale / 2)))
         return sk
@@ -101,7 +101,8 @@ class CMSketch:
         h = hash_any(arr)
         est = None
         for d in range(self.DEPTH):
-            idx = int(((h >> np.uint64(d * 12)) ^ h)[0] % np.uint64(self.WIDTH))
+            idx = int(((h >> np.uint64((d + 1) * 12)) ^ h)[0]
+                      % np.uint64(self.WIDTH))
             c = int(self.table[d][idx])
             est = c if est is None else min(est, c)
         return est if est and est > 0 else self.default

@@ -401,10 +401,16 @@ class TableStore:
             self._index_orders.clear()
             self.schema_token += 1
 
-    def cast_column(self, offset: int, cast_fn) -> Optional[str]:
+    def cast_column(self, offset: int, cast_fn,
+                    new_info: Optional[TableInfo] = None) -> Optional[str]:
         """Rewrite one column's physical values (MODIFY COLUMN reorg).
         cast_fn(data, valid) -> (new_data, new_valid) or raises ValueError;
-        returns an error string on failure (job rolls back)."""
+        returns an error string on failure (job rolls back).
+
+        new_info, when given, is swapped in atomically with the rewritten
+        epoch: a snapshot must never pair new physical values with the old
+        FieldType (e.g. a DECIMAL(10,2)->INT rescale read back at scale 2)
+        — mirror of apply_schema's atomic table+epoch swap."""
         with self._lock:
             epoch = self.epoch
             try:
@@ -436,6 +442,8 @@ class TableStore:
                 valids=valids,
                 handle_pos=epoch.handle_pos,
             )
+            if new_info is not None:
+                self.table = new_info
             self._index_orders.clear()
             self.schema_token += 1
             return None
