@@ -32,20 +32,20 @@ if TYPE_CHECKING:
 TPCH_DDL: dict[str, str] = {
     "region": """
 create table region (
-  r_regionkey bigint not null,
+  r_regionkey bigint not null primary key,
   r_name char(25) not null,
   r_comment varchar(152) not null
 )""",
     "nation": """
 create table nation (
-  n_nationkey bigint not null,
+  n_nationkey bigint not null primary key,
   n_name char(25) not null,
   n_regionkey bigint not null,
   n_comment varchar(152) not null
 )""",
     "part": """
 create table part (
-  p_partkey bigint not null,
+  p_partkey bigint not null primary key,
   p_name varchar(55) not null,
   p_mfgr char(25) not null,
   p_brand char(10) not null,
@@ -57,7 +57,7 @@ create table part (
 )""",
     "supplier": """
 create table supplier (
-  s_suppkey bigint not null,
+  s_suppkey bigint not null primary key,
   s_name char(25) not null,
   s_address varchar(40) not null,
   s_nationkey bigint not null,
@@ -75,7 +75,7 @@ create table partsupp (
 )""",
     "customer": """
 create table customer (
-  c_custkey bigint not null,
+  c_custkey bigint not null primary key,
   c_name varchar(25) not null,
   c_address varchar(40) not null,
   c_nationkey bigint not null,
@@ -86,7 +86,7 @@ create table customer (
 )""",
     "orders": """
 create table orders (
-  o_orderkey bigint not null,
+  o_orderkey bigint not null primary key,
   o_custkey bigint not null,
   o_orderstatus char(1) not null,
   o_totalprice decimal(15,2) not null,

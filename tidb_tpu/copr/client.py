@@ -596,89 +596,28 @@ class CopClient:
     def _run_agg(self, dag, snap, prepared, cols, row_mask) -> list[Chunk]:
         agg = dag.agg
         cards: list[int] = prepared["__dense_cards__"]
-        offsets: list[int] = prepared["__key_offsets__"]
-        sched = prepared["__agg_sched__"]
+        key = ("agg", _dag_key(dag, prepared), cols[0][0].shape[0]
+               if cols else 0, tuple(cards))
         segments = 1
         for c in cards:
             segments *= max(c, 1)
-        key = ("agg", _dag_key(dag, prepared), cols[0][0].shape[0]
-               if cols else 0, tuple(cards))
         kern = self._kernel(key, lambda: self._build_agg_kernel(
             dag, prepared, cards, segments))
         # single synchronous device round trip for the whole query
         out = jax.device_get(kern(cols, row_mask))
-
-        rows_per_seg = SE.combine_partials(out["rows"])
-        present = rows_per_seg > 0
-        seg_idx = np.nonzero(present)[0]
-        if len(seg_idx) == 0:
-            return []
-
-        columns: list[Column] = []
-        # decode group keys from mixed-radix segment index
-        codes = seg_idx.copy()
-        parts: list[np.ndarray] = []
-        for c in reversed(cards):
-            parts.append(codes % c)
-            codes = codes // c
-        parts.reverse()
-        for gi, g in enumerate(agg.group_by):
-            card = cards[gi]
-            code = parts[gi]
-            ft = g.ftype
-            is_null = code == (card - 1)
-            data = (code + offsets[gi]).astype(ft.np_dtype)
-            dictionary = None
-            if ft.is_string and isinstance(g, Col):
-                dictionary = snap.dictionaries[dag.scan.col_offsets[g.idx]]
-            columns.append(Column(
-                ft, data, None if not is_null.any() else ~is_null, dictionary))
-
-        for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
-            cnt = SE.combine_partials(out[f"cnt{ai}"])[seg_idx] \
-                if s["kind"] != "count" else rows_if_countstar(
-                    out, ai, rows_per_seg)[seg_idx]
-            val_t = dag.output_types[len(agg.group_by) + 2 * ai]
-            if s["kind"] == "count":
-                vcol = Column(val_t, cnt.astype(np.int64))
-            elif s["kind"] == "isum":
-                total = np.zeros(segments, dtype=np.int64)
-                for ti, (_, shift, _) in enumerate(s["terms"]):
-                    total += SE.combine_partials(out[f"s{ai}_{ti}"]) << shift
-                val = total[seg_idx]
-                vcol = Column(val_t, val.astype(val_t.np_dtype),
-                              None if (cnt > 0).all() else (cnt > 0))
-            elif s["kind"] == "fsum":
-                val = SE.combine_float(out[f"f{ai}"])[seg_idx]
-                vcol = Column(val_t, val.astype(val_t.np_dtype),
-                              None if (cnt > 0).all() else (cnt > 0))
-            else:  # min / max — sentinel-filled where empty; cnt gates
-                val = np.asarray(out[f"m{ai}"])[seg_idx]
-                val = np.where(cnt > 0, val, 0)
-                vcol = Column(val_t, val.astype(val_t.np_dtype),
-                              None if (cnt > 0).all() else (cnt > 0))
-            columns.append(vcol)
-            columns.append(Column(
-                FieldType(TypeKind.BIGINT, nullable=False),
-                cnt.astype(np.int64)))
-        return [Chunk(columns)]
+        group_dicts = [
+            snap.dictionaries[dag.scan.col_offsets[g.idx]]
+            if g.ftype.is_string and isinstance(g, Col) else None
+            for g in agg.group_by
+        ]
+        chunk = decode_agg_partials(
+            agg, prepared, cards, out, group_dicts,
+            dag.output_types[len(agg.group_by):])
+        return [] if chunk is None else [chunk]
 
     def _build_agg_kernel(self, dag, prepared, cards, segments):
         body = self._agg_kernel_body(dag, prepared, cards, segments)
         return jax.jit(body)
-
-    def _segment_ids(self, agg, cards, offsets, cols, prepared, mask):
-        """Mixed-radix dense segment id; NULL key -> card-1 slot."""
-        seg = jnp.zeros(mask.shape[0], dtype=jnp.int32)
-        for g, card, off in zip(agg.group_by, cards, offsets):
-            v, vl = eval_expr(g, cols, prepared)
-            if v.dtype == jnp.bool_:
-                v = v.astype(jnp.int32)  # boolean keys: 0/1 codes
-            shifted = (v - jnp.asarray(off, dtype=v.dtype)).astype(jnp.int32)
-            k = jnp.where(vl, shifted, card - 1)
-            k = jnp.clip(k, 0, card - 1)
-            seg = seg * card + k
-        return jnp.where(mask, seg, -1)
 
     def _agg_kernel_body(self, dag, prepared, cards, segments):
         """Pure (cols, row_mask) -> {partials} function. All leaves are
@@ -687,66 +626,12 @@ class CopClient:
         native-int32 psum / pmin / pmax (parallel/dist.py)."""
         agg = dag.agg
         sel = dag.selection
-        offsets = prepared["__key_offsets__"]
-        sched = prepared["__agg_sched__"]
-        strategy = prepared["__strategy__"]
 
         def kernel(cols, row_mask):
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
-            seg = self._segment_ids(agg, cards, offsets, cols, prepared, mask)
-            one_hot = SE.make_one_hot(seg, segments) \
-                if strategy == "einsum" else None
-            ones = mask.astype(jnp.int32)
-            out = {"rows": SE.seg_sum_partials(ones, seg, segments, 1,
-                                              one_hot=one_hot)}
-            for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
-                if s["kind"] == "count":
-                    if d.arg is not None:
-                        _, vl = eval_expr(d.arg, cols, prepared)
-                        cseg = jnp.where(vl, seg, -1)
-                        out[f"cnt{ai}"] = SE.seg_sum_partials(
-                            ones, cseg, segments, 1, one_hot=None
-                            if one_hot is None else SE.make_one_hot(
-                                cseg, segments))
-                    continue
-                v, vl = eval_expr(d.arg, cols, prepared) \
-                    if s["kind"] != "isum" else (None, None)
-                if s["kind"] == "isum":
-                    # validity from the original arg (cheap: XLA CSEs the
-                    # shared subexpressions with the term evals below)
-                    _, vl = eval_expr(d.arg, cols, prepared)
-                    vseg = jnp.where(vl, seg, -1)
-                    voh = SE.make_one_hot(vseg, segments) \
-                        if one_hot is not None else None
-                    out[f"cnt{ai}"] = SE.seg_sum_partials(
-                        ones, vseg, segments, 1, one_hot=voh)
-                    for ti, (t, shift, L) in enumerate(s["terms"]):
-                        tv, _ = eval_expr(t, cols, prepared)
-                        out[f"s{ai}_{ti}"] = SE.seg_sum_partials(
-                            tv.astype(jnp.int32), vseg, segments, L,
-                            one_hot=voh)
-                    continue
-                vseg = jnp.where(vl, seg, -1)
-                out[f"cnt{ai}"] = SE.seg_sum_partials(
-                    ones, vseg, segments, 1)
-                if s["kind"] == "fsum":
-                    out[f"f{ai}"] = SE.float_seg_sums(
-                        v, vseg, segments, _FLOAT_BLOCKS)
-                else:  # min / max with sentinels (kept for pmin/pmax merge)
-                    is_f = jnp.issubdtype(v.dtype, jnp.floating)
-                    if is_f:
-                        sent = jnp.inf if s["kind"] == "min" else -jnp.inf
-                    else:
-                        sent = _I32_MAX if s["kind"] == "min" else _I32_MIN
-                        v = v.astype(jnp.int32)
-                    vv = jnp.where(vseg >= 0, v, sent)
-                    red = jnp.min if s["kind"] == "min" else jnp.max
-                    out[f"m{ai}"] = jnp.stack([
-                        red(jnp.where(vseg == k, vv, sent))
-                        for k in range(segments)])
-            return out
+            return agg_partials(agg, prepared, cards, segments, cols, mask)
 
         return kernel
 
@@ -942,14 +827,145 @@ class CopClient:
         return Chunk(columns)
 
 
-# ==================== helpers ====================
+# ==================== shared aggregation machinery ====================
+# module-level so the fragment executor (copr/fragment.py) builds the same
+# partial-producing programs over its joined column streams
 
-def rows_if_countstar(out, ai, rows_per_seg):
-    """COUNT(*) uses the row counts; COUNT(x) shipped its own cnt."""
-    key = f"cnt{ai}"
-    if key in out:
-        return SE.combine_partials(out[key])
-    return rows_per_seg
+def segment_ids(agg, cards, offsets, cols, prepared, mask):
+    """Mixed-radix dense segment id; NULL key -> card-1 slot."""
+    seg = jnp.zeros(mask.shape[0], dtype=jnp.int32)
+    for g, card, off in zip(agg.group_by, cards, offsets):
+        v, vl = eval_expr(g, cols, prepared)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)  # boolean keys: 0/1 codes
+        shifted = (v - jnp.asarray(off, dtype=v.dtype)).astype(jnp.int32)
+        k = jnp.where(vl, shifted, card - 1)
+        k = jnp.clip(k, 0, card - 1)
+        seg = seg * card + k
+    return jnp.where(mask, seg, -1)
+
+
+def agg_partials(agg, prepared, cards, segments, cols, mask):
+    """(cols, row mask) -> {exact limb partials} per the agg schedule.
+    All leaves int32 (additive, psum-safe) or f32 (block float sums)."""
+    offsets = prepared["__key_offsets__"]
+    sched = prepared["__agg_sched__"]
+    strategy = prepared["__strategy__"]
+    seg = segment_ids(agg, cards, offsets, cols, prepared, mask)
+    one_hot = SE.make_one_hot(seg, segments) \
+        if strategy == "einsum" else None
+    ones = mask.astype(jnp.int32)
+    out = {"rows": SE.seg_sum_partials(ones, seg, segments, 1,
+                                       one_hot=one_hot)}
+    for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+        if s["kind"] == "count":
+            if d.arg is not None:
+                _, vl = eval_expr(d.arg, cols, prepared)
+                cseg = jnp.where(vl, seg, -1)
+                out[f"cnt{ai}"] = SE.seg_sum_partials(
+                    ones, cseg, segments, 1, one_hot=None
+                    if one_hot is None else SE.make_one_hot(cseg, segments))
+            continue
+        v, vl = eval_expr(d.arg, cols, prepared) \
+            if s["kind"] != "isum" else (None, None)
+        if s["kind"] == "isum":
+            # validity from the original arg (cheap: XLA CSEs the shared
+            # subexpressions with the term evals below)
+            _, vl = eval_expr(d.arg, cols, prepared)
+            vseg = jnp.where(vl, seg, -1)
+            voh = SE.make_one_hot(vseg, segments) \
+                if one_hot is not None else None
+            out[f"cnt{ai}"] = SE.seg_sum_partials(
+                ones, vseg, segments, 1, one_hot=voh)
+            for ti, (t, shift, L) in enumerate(s["terms"]):
+                tv, _ = eval_expr(t, cols, prepared)
+                out[f"s{ai}_{ti}"] = SE.seg_sum_partials(
+                    tv.astype(jnp.int32), vseg, segments, L, one_hot=voh)
+            continue
+        vseg = jnp.where(vl, seg, -1)
+        out[f"cnt{ai}"] = SE.seg_sum_partials(ones, vseg, segments, 1)
+        if s["kind"] == "fsum":
+            out[f"f{ai}"] = SE.float_seg_sums(
+                v, vseg, segments, _FLOAT_BLOCKS)
+        else:  # min / max with sentinels (kept for pmin/pmax merge)
+            is_f = jnp.issubdtype(v.dtype, jnp.floating)
+            if is_f:
+                sent = jnp.inf if s["kind"] == "min" else -jnp.inf
+            else:
+                sent = _I32_MAX if s["kind"] == "min" else _I32_MIN
+                v = v.astype(jnp.int32)
+            vv = jnp.where(vseg >= 0, v, sent)
+            red = jnp.min if s["kind"] == "min" else jnp.max
+            out[f"m{ai}"] = jnp.stack([
+                red(jnp.where(vseg == k, vv, sent))
+                for k in range(segments)])
+    return out
+
+
+def decode_agg_partials(agg, prepared, cards, out, group_dicts,
+                        val_types) -> Optional[Chunk]:
+    """Fetched partials -> one partial-layout chunk
+    [group cols..., (val, cnt) per agg] (int64 host columns), or None when
+    no group matched. val_types: per-agg output types in (val, cnt) pair
+    order as laid out by the planner's partial schema."""
+    offsets = prepared["__key_offsets__"]
+    sched = prepared["__agg_sched__"]
+    segments = 1
+    for c in cards:
+        segments *= max(c, 1)
+    rows_per_seg = SE.combine_partials(out["rows"])
+    present = rows_per_seg > 0
+    seg_idx = np.nonzero(present)[0]
+    if len(seg_idx) == 0:
+        return None
+
+    columns: list[Column] = []
+    codes = seg_idx.copy()
+    parts: list[np.ndarray] = []
+    for c in reversed(cards):
+        parts.append(codes % c)
+        codes = codes // c
+    parts.reverse()
+    for gi, g in enumerate(agg.group_by):
+        card = cards[gi]
+        code = parts[gi]
+        ft = g.ftype
+        is_null = code == (card - 1)
+        data = (code + offsets[gi]).astype(ft.np_dtype)
+        columns.append(Column(
+            ft, data, None if not is_null.any() else ~is_null,
+            group_dicts[gi]))
+
+    for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+        cnt = SE.combine_partials(out[f"cnt{ai}"])[seg_idx] \
+            if f"cnt{ai}" in out else rows_per_seg[seg_idx]
+        val_t = val_types[2 * ai]
+        if s["kind"] == "count":
+            vcol = Column(val_t, cnt.astype(np.int64))
+        elif s["kind"] == "isum":
+            total = np.zeros(segments, dtype=np.int64)
+            for ti, (_, shift, _) in enumerate(s["terms"]):
+                total += SE.combine_partials(out[f"s{ai}_{ti}"]) << shift
+            val = total[seg_idx]
+            vcol = Column(val_t, val.astype(val_t.np_dtype),
+                          None if (cnt > 0).all() else (cnt > 0))
+        elif s["kind"] == "fsum":
+            val = SE.combine_float(out[f"f{ai}"])[seg_idx]
+            vcol = Column(val_t, val.astype(val_t.np_dtype),
+                          None if (cnt > 0).all() else (cnt > 0))
+        else:  # min / max — sentinel-filled where empty; cnt gates
+            val = np.asarray(out[f"m{ai}"])[seg_idx]
+            val = np.where(cnt > 0, val, 0)
+            vcol = Column(val_t, val.astype(val_t.np_dtype),
+                          None if (cnt > 0).all() else (cnt > 0))
+        columns.append(vcol)
+        columns.append(Column(
+            FieldType(TypeKind.BIGINT, nullable=False),
+            cnt.astype(np.int64)))
+    return Chunk(columns)
+
+
+# ==================== helpers ====================
 
 
 def _pad(a: np.ndarray, b: int) -> np.ndarray:

@@ -1,0 +1,589 @@
+"""Fragment executor: snowflake join trees as ONE fused device program.
+
+The device half of plan/fragment.py. Where the reference dispatches plan
+fragments to TiFlash nodes and exchanges rows between them (reference:
+store/tikv/mpp.go:372, executor/mpp_gather.go:103,
+store/mockstore/unistore/cophandler/mpp.go in-process equivalent), the TPU
+executes the whole tree in one kernel:
+
+* build (dimension) tables live on device as full column sets plus an
+  int32 permutation table perm[key - lo] -> row index (-1 = absent),
+  cached per epoch like scan columns — the unique-key eligibility from
+  plan time makes every join a static-shape gather;
+* the probe (fact) table streams through: key -> perm lookup -> column
+  gathers, chaining joins (a build table's gathered column can be the
+  next join's key, so snowflakes cost one gather each);
+* build-side filters + MVCC visibility evaluate over the full build
+  columns and gate matches via the gathered bitmap;
+* post-join selection and dense-segment aggregation reuse the exact same
+  kernel machinery as single-table pushdowns (client.agg_partials), and
+  ALL outputs return in one jax.device_get — a whole multi-join
+  aggregation query costs one device round trip.
+
+Runtime gates (key span too wide, int64 columns that don't fit int32,
+overlay rows on build tables, >8192 dense segments) fall back to an
+equivalent host (numpy) interpreter of the same FragmentDAG — same
+results, same partial layout, no replanning.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chunk.chunk import Chunk
+from ..chunk.column import Column
+from ..plan.expr import Col
+from ..plan.fragment import FragmentDAG
+from .bounds import expr_bounds, expr_device_safe, fits_int32
+from .client import (
+    CopClient,
+    CopResult,
+    agg_partials,
+    decode_agg_partials,
+)
+from .eval import CompileError, eval_expr, selection_mask
+from .npeval import NumpyEval
+
+# widest admissible build-key span: perm table of 64M int32 = 256MB HBM
+FRAG_SPAN_CAP = 1 << 26
+
+
+class _Fallback(Exception):
+    pass
+
+
+def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
+                     ) -> CopResult:
+    """snaps: table_id -> TableSnapshot for every fragment table."""
+    try:
+        return _device_fragment(cop, frag, snaps)
+    except _Fallback:
+        return _host_fragment(frag, snaps)
+    except CompileError:
+        return _host_fragment(frag, snaps)
+
+
+# ==================== device path ====================
+
+def _device_fragment(cop, frag, snaps) -> CopResult:
+    probe = frag.tables[0]
+    psnap = snaps[probe.table.id]
+
+    # ---- eligibility over this snapshot ----
+    tab_bounds = []
+    tab_dicts = []
+    for ti, t in enumerate(frag.tables):
+        snap = snaps[t.table.id]
+        if ti > 0 and len(snap.overlay_handles) > 0:
+            raise _Fallback()  # uncommitted/unfolded build rows
+        facade = _facade_dag(t)
+        b = cop._scan_bounds(facade, snap)
+        for ci, off in enumerate(t.col_offsets):
+            if snap.epoch.columns[off].dtype == np.int64 and \
+                    not fits_int32(b[ci]):
+                raise _Fallback()
+        tab_bounds.append(b)
+        tab_dicts.append([snap.dictionaries[off] for off in t.col_offsets])
+        cop._evict_stale(t.table.id, snap.epoch.epoch_id)
+
+    # combined spaces
+    comb_bounds: list = []
+    comb_dicts: list = []
+    for b, d in zip(tab_bounds, tab_dicts):
+        comb_bounds.extend(b)
+        comb_dicts.extend(d)
+
+    prepared: dict[Any, Any] = {"__sig__": [], "__col_bounds__": comb_bounds}
+
+    # per-table filters resolve against their own dictionaries
+    for ti, t in enumerate(frag.tables):
+        for c in t.filters:
+            cop._prepare_expr(c, tab_dicts[ti], prepared)
+            if not expr_device_safe(c, tab_bounds[ti]):
+                raise _Fallback()
+    for c in frag.selection:
+        cop._prepare_expr(c, comb_dicts, prepared)
+        if not expr_device_safe(c, comb_bounds):
+            raise _Fallback()
+    if frag.agg is not None:
+        # group keys and aggregate arguments can embed string predicates
+        # (e.g. CASE WHEN priority = '1-URGENT'); resolve them to codes
+        for g in frag.agg.group_by:
+            cop._prepare_expr(g, comb_dicts, prepared)
+        for d in frag.agg.aggs:
+            if d.arg is not None:
+                cop._prepare_expr(d.arg, comb_dicts, prepared)
+
+    # join key spans
+    spans = []
+    for j in frag.joins:
+        t = frag.tables[j.build]
+        kb = tab_bounds[j.build][j.build_key_local]
+        pb = expr_bounds(j.probe_key, comb_bounds)
+        if kb is None or pb is None or not fits_int32(pb):
+            raise _Fallback()
+        lo, hi = kb
+        span = hi - lo + 1
+        if span > FRAG_SPAN_CAP:
+            raise _Fallback()
+        spans.append((lo, span))
+        prepared["__sig__"].append(("join", j.build, lo, span))
+
+    if frag.agg is not None:
+        n_rows = psnap.epoch.num_rows + len(psnap.overlay_handles)
+        facade = _agg_facade(frag)
+        err = cop._prepare_agg(facade, comb_dicts, comb_bounds, prepared,
+                               n_rows)
+        if err is not None:
+            raise _Fallback()
+
+    # ---- staging ----
+    builds = []
+    for ji, j in enumerate(frag.joins):
+        t = frag.tables[j.build]
+        snap = snaps[t.table.id]
+        cols, vis, host_cols, host_mask = cop._stage_inputs(
+            _facade_dag(t), snap, overlay=False)
+        lo, span = spans[ji]
+        perm = _perm_array(cop, snap, t.col_offsets[j.build_key_local],
+                           lo, span, host_mask)
+        builds.append({"cols": cols, "vis": vis, "perm": perm})
+
+    chunks: list[Chunk] = []
+    if psnap.epoch.num_rows > 0:
+        chunks.extend(_run_frag_batch(cop, frag, snaps, prepared, spans,
+                                      builds, overlay=False))
+    if len(psnap.overlay_handles) > 0:
+        chunks.extend(_run_frag_batch(cop, frag, snaps, prepared, spans,
+                                      builds, overlay=True))
+    if not chunks:
+        chunks = [_empty_chunk(frag, comb_dicts)]
+    return CopResult(chunks, is_partial_agg=frag.agg is not None)
+
+
+def _facade_dag(t):
+    """Minimal CopDAG stand-in for CopClient staging/bounds helpers."""
+    from ..plan.dag import CopDAG, DAGScan
+    return CopDAG(scan=DAGScan(t.table.id, list(t.col_offsets)),
+                  output_types=list(t.col_types))
+
+
+def _agg_facade(frag):
+    from ..plan.dag import CopDAG, DAGScan
+    combined_offsets = []
+    for t in frag.tables:
+        combined_offsets.extend(t.col_offsets)
+    return CopDAG(scan=DAGScan(frag.tables[0].table.id, combined_offsets),
+                  agg=frag.agg, output_types=list(frag.output_types))
+
+
+def _perm_array(cop, snap, key_off: int, lo: int, span: int,
+                host_mask: np.ndarray):
+    """key -> epoch row index (device int32, -1 absent), visible+valid rows
+    only. Cached DEVICE-resident per (epoch, key column, visibility) —
+    re-uploading a multi-MB lookup table per query would cost a tunnel
+    transfer each time."""
+    from .client import _mask_digest
+    # epoch id LEADS the key so _evict_stale (which frees every cache
+    # entry with k[0] == superseded epoch) reclaims perm tables too
+    key = (snap.epoch.epoch_id, "perm", key_off, lo, span,
+           _mask_digest(host_mask))
+    with cop._lock:
+        hit = cop._col_cache.get(key)
+        cacheable = cop._live_epochs.get(snap.store.table.id) \
+            == snap.epoch.epoch_id
+    if hit is not None:
+        return hit
+    keys = snap.epoch.columns[key_off]
+    valid = snap.epoch.valids[key_off]
+    sel = host_mask.copy()
+    if valid is not None:
+        sel &= valid
+    idx = np.nonzero(sel)[0]
+    perm = np.full(span, -1, dtype=np.int32)
+    perm[keys[idx].astype(np.int64) - lo] = idx.astype(np.int32)
+    dev = jnp.asarray(perm)
+    if cacheable:
+        with cop._lock:
+            cop._col_cache[key] = dev
+    return dev
+
+
+def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay):
+    probe = frag.tables[0]
+    psnap = snaps[probe.table.id]
+    pcols, pvis, phost, phost_mask = cop._stage_inputs(
+        _facade_dag(probe), psnap, overlay=overlay)
+
+    mode = "agg" if frag.agg is not None else "rows"
+    key = ("frag", _frag_key(frag), _sig(prepared), mode,
+           pcols[0][0].shape[0] if pcols else 0,
+           tuple(b["cols"][0][0].shape[0] for b in builds))
+    kern = cop._kernel(key, lambda: _build_frag_kernel(
+        frag, prepared, spans, mode))
+    out = jax.device_get(kern(pcols, pvis, builds))
+
+    if mode == "agg":
+        cards = prepared["__dense_cards__"]
+        comb_dicts = []
+        for ti, t in enumerate(frag.tables):
+            snap = snaps[t.table.id]
+            comb_dicts.extend(snap.dictionaries[off]
+                              for off in t.col_offsets)
+        group_dicts = [
+            comb_dicts[g.idx]
+            if g.ftype.is_string and isinstance(g, Col) else None
+            for g in frag.agg.group_by
+        ]
+        chunk = decode_agg_partials(
+            frag.agg, prepared, cards, out, group_dicts,
+            frag.output_types[len(frag.agg.group_by):])
+        return [] if chunk is None else [chunk]
+
+    # row mode: device returned a packed probe-row bitmask; host replays
+    # the (cheap, vectorized) gathers for the passing rows only
+    n_rows = phost[0][0].shape[0] if phost else 0
+    mask = np.unpackbits(out, count=None).astype(bool)[:n_rows] \
+        if n_rows else np.zeros(0, bool)
+    idx = np.nonzero(mask)[0]
+    return _host_rows_for(frag, snaps, idx, overlay)
+
+
+def _build_frag_kernel(frag, prepared, spans, mode):
+    sel = frag.selection
+    agg = frag.agg
+    if mode == "agg":
+        cards = prepared["__dense_cards__"]
+        segments = 1
+        for c in cards:
+            segments *= max(c, 1)
+
+    def kernel(pcols, pvis, builds):
+        cols = list(pcols)
+        mask = pvis
+        if frag.tables[0].filters:
+            # probe-side pushed-down filters (local space == combined
+            # prefix) gate rows before any gather work
+            mask = selection_mask(frag.tables[0].filters, cols, prepared,
+                                  mask)
+        for j, (lo, span), b in zip(frag.joins, spans, builds):
+            key_v, key_vl = eval_expr(j.probe_key, cols, prepared)
+            k = key_v.astype(jnp.int32) - jnp.int32(lo)
+            inrange = (k >= 0) & (k < span)
+            ksafe = jnp.clip(k, 0, span - 1)
+            ridx = b["perm"][ksafe]
+            found = inrange & (ridx >= 0) & key_vl
+            gidx = jnp.clip(ridx, 0)
+            # build-side validity: visibility + pushed-down filters over
+            # the FULL build columns, gathered per probe row
+            t = frag.tables[j.build]
+            bmask = b["vis"]
+            if t.filters:
+                bmask = selection_mask(t.filters, b["cols"], prepared,
+                                       bmask)
+            found = found & bmask[gidx]
+            for (d, v) in b["cols"]:
+                cols.append((d[gidx], v[gidx] & found))
+            mask = mask & found
+        if sel:
+            mask = selection_mask(sel, cols, prepared, mask)
+        if mode == "agg":
+            return agg_partials(agg, prepared, cards, segments, cols, mask)
+        return jnp.packbits(mask)
+
+    return jax.jit(kernel)
+
+
+def _sig(prepared) -> tuple:
+    return tuple(prepared.get("__sig__", ()))
+
+
+def _frag_key(frag: FragmentDAG) -> str:
+    """Structural + full-expression identity (filters and selections of
+    different queries can share shapes — describe() alone collides)."""
+    parts = [frag.describe()]
+    for t in frag.tables:
+        parts.append(repr(t.filters))
+    parts.append(repr(frag.selection))
+    if frag.agg is not None:
+        parts.append(repr(frag.agg.group_by))
+        parts.append(repr(frag.agg.aggs))
+    if frag.out_map is not None:
+        parts.append(repr(frag.out_map))
+    return "|".join(parts)
+
+
+def _host_rows_for(frag, snaps, probe_idx, overlay) -> list[Chunk]:
+    """Materialize joined output rows (tree order) for given probe rows."""
+    cols, valid, dicts = _host_join(frag, snaps, probe_idx,
+                                    overlay=overlay, epoch_only_probe=True)
+    if cols is None:
+        return []
+    return _rows_chunk(frag, cols, valid, dicts)
+
+
+def _rows_chunk(frag, cols, valids, dicts) -> list[Chunk]:
+    columns = []
+    for pos, comb in enumerate(frag.out_map):
+        ft = frag.output_types[pos]
+        v = valids[comb]
+        columns.append(Column(
+            ft, cols[comb].astype(ft.np_dtype),
+            None if v is None or v.all() else v, dicts[comb]))
+    if not columns:
+        return []
+    return [Chunk(columns)]
+
+
+# ==================== host fallback interpreter ====================
+
+def _host_fragment(frag: FragmentDAG, snaps: dict) -> CopResult:
+    """Numpy interpreter of the same FragmentDAG — used when the snapshot
+    fails a device gate. Produces identical chunks (partial agg layout or
+    tree-order rows)."""
+    cols, valid, dicts = _host_join(frag, snaps, None, overlay=None,
+                                    epoch_only_probe=False)
+    if cols is None:
+        if frag.agg is not None:
+            return CopResult([], is_partial_agg=True)
+        return CopResult([], is_partial_agg=False)
+    if frag.agg is None:
+        return CopResult(_rows_chunk(frag, cols, valid, dicts),
+                         is_partial_agg=False)
+    chunk = _host_agg(frag, cols, valid, dicts)
+    return CopResult([] if chunk is None else [chunk], is_partial_agg=True)
+
+
+def _full_host_cols(snap, col_offsets):
+    """(data, valid) per column over visible epoch rows + overlay rows."""
+    vis = snap.base_visible
+    n_o = len(snap.overlay_handles)
+    out = []
+    for off in col_offsets:
+        d = snap.epoch.columns[off][vis]
+        v = snap.epoch.valids[off]
+        v = None if v is None else v[vis]
+        if n_o:
+            od = snap.overlay_columns[off]
+            ov = snap.overlay_valids[off]
+            d = np.concatenate([d, od])
+            if v is None and ov is None:
+                v = None
+            else:
+                va = np.ones(len(d) - n_o, bool) if v is None else v
+                vb = np.ones(n_o, bool) if ov is None else ov
+                v = np.concatenate([va, vb])
+        out.append((d, v))
+    return out
+
+
+def _host_join(frag, snaps, probe_idx, overlay, epoch_only_probe):
+    """Vectorized host join. Returns (cols, valids, dicts) in combined
+    order for the surviving row set, or (None, None, None) if empty.
+
+    probe_idx + epoch_only_probe: device row mode hands back the passing
+    probe row indices of one batch (epoch or overlay) — replay gathers for
+    exactly those rows, with NO further filtering (the device already
+    applied every filter)."""
+    probe = frag.tables[0]
+    psnap = snaps[probe.table.id]
+
+    if epoch_only_probe:
+        base = []
+        for off in probe.col_offsets:
+            if overlay:
+                d, v = psnap.overlay_columns[off], psnap.overlay_valids[off]
+            else:
+                d, v = psnap.epoch.columns[off], psnap.epoch.valids[off]
+            base.append((d[probe_idx],
+                         None if v is None else v[probe_idx]))
+        filtered = False
+    else:
+        base = _full_host_cols(psnap, probe.col_offsets)
+        filtered = True
+
+    cols = [d for d, _ in base]
+    valids = [np.ones(len(cols[0]), bool) if v is None else v.copy()
+              for d, v in base] if cols else []
+    dicts = [psnap.dictionaries[off] for off in probe.col_offsets]
+    nrows = len(cols[0]) if cols else 0
+    keep = np.ones(nrows, bool)
+
+    if filtered and probe.filters:
+        ev = NumpyEval([(c, v) for c, v in zip(cols, valids)],
+                       dicts, nrows)
+        for c in probe.filters:
+            fv, fvl = ev.eval(c)
+            keep &= _truthy(np.asarray(fv)) & fvl
+
+    for j in frag.joins:
+        t = frag.tables[j.build]
+        snap = snaps[t.table.id]
+        bcols = _full_host_cols(snap, t.col_offsets)
+        bn = len(bcols[0][0]) if bcols else 0
+        bkeep = np.ones(bn, bool)
+        bdicts = [snap.dictionaries[off] for off in t.col_offsets]
+        if filtered and t.filters:
+            bev = NumpyEval(
+                [(d, np.ones(bn, bool) if v is None else v)
+                 for d, v in bcols], bdicts, bn)
+            for c in t.filters:
+                fv, fvl = bev.eval(c)
+                bkeep &= _truthy(np.asarray(fv)) & fvl
+        # unique-key mapping via sorted search
+        kd, kv = bcols[j.build_key_local]
+        ok = bkeep.copy()
+        if kv is not None:
+            ok &= kv
+        bidx = np.nonzero(ok)[0]
+        bkeys = kd[bidx].astype(np.int64)
+        order = np.argsort(bkeys, kind="stable")
+        skeys = bkeys[order]
+        srows = bidx[order]
+
+        ev = NumpyEval([(c, v) for c, v in zip(cols, valids)], dicts,
+                       nrows)
+        pk, pkv = ev.eval(j.probe_key)
+        pk = np.asarray(pk).astype(np.int64)
+        pos = np.searchsorted(skeys, pk)
+        pos_safe = np.clip(pos, 0, max(len(skeys) - 1, 0))
+        found = np.zeros(nrows, bool) if len(skeys) == 0 else (
+            (pos < len(skeys)) & (skeys[pos_safe] == pk))
+        found &= np.asarray(pkv)
+        rows = srows[pos_safe] if len(skeys) else np.zeros(nrows, np.int64)
+        keep &= found
+        safe_rows = np.where(found, rows, 0)
+        for (d, v) in bcols:
+            cols.append(d[safe_rows])
+            valids.append((np.ones(nrows, bool) if v is None
+                           else v[safe_rows]) & found)
+        dicts.extend(bdicts)
+
+    if filtered and frag.selection and nrows:
+        ev = NumpyEval([(c, v) for c, v in zip(cols, valids)], dicts,
+                       nrows)
+        for c in frag.selection:
+            fv, fvl = ev.eval(c)
+            keep &= _truthy(np.asarray(fv)) & fvl
+
+    if filtered:
+        idx = np.nonzero(keep)[0]
+        if len(idx) == 0:
+            return None, None, None
+        cols = [c[idx] for c in cols]
+        valids = [v[idx] for v in valids]
+    elif nrows == 0:
+        return None, None, None
+    return cols, valids, dicts
+
+
+def _host_agg(frag, cols, valids, dicts) -> Optional[Chunk]:
+    """Partial-layout aggregation over joined host rows (numpy)."""
+    agg = frag.agg
+    n = len(cols[0]) if cols else 0
+    if n == 0:
+        return None
+    ev = NumpyEval([(c, v) for c, v in zip(cols, valids)], dicts, n)
+    keys = []
+    for g in agg.group_by:
+        gv, gvl = ev.eval(g)
+        gv = np.asarray(gv)
+        enc = gv.astype(np.float64).view(np.int64) \
+            if np.issubdtype(gv.dtype, np.floating) else gv.astype(np.int64)
+        keys.append((np.where(gvl, enc, np.int64(-(2**62))), gv, gvl))
+    if keys:
+        stacked = np.stack([k[0] for k in keys], axis=1)
+        _, first, inv = np.unique(stacked, axis=0, return_index=True,
+                                  return_inverse=True)
+        inv = inv.reshape(-1)
+    else:
+        first = np.zeros(1, np.int64)
+        inv = np.zeros(n, np.int64)
+    n_seg = len(first)
+
+    columns: list[Column] = []
+    for gi, g in enumerate(agg.group_by):
+        _, gv, gvl = keys[gi]
+        data = gv[first]
+        vl = gvl[first]
+        dictionary = dicts[g.idx] \
+            if g.ftype.is_string and isinstance(g, Col) else None
+        columns.append(Column(g.ftype, data.astype(g.ftype.np_dtype),
+                              None if vl.all() else vl, dictionary))
+    from ..types.field_type import FieldType, TypeKind
+    for ai, d in enumerate(agg.aggs):
+        val_t = frag.output_types[len(agg.group_by) + 2 * ai]
+        if d.arg is None:
+            cnt = np.bincount(inv, minlength=n_seg).astype(np.int64)
+            val = cnt
+            vcol = Column(val_t, val)
+        else:
+            av, avl = ev.eval(d.arg)
+            av = np.asarray(av)
+            avl = np.asarray(avl)
+            cnt = np.bincount(inv, weights=avl.astype(np.float64),
+                              minlength=n_seg).astype(np.int64)
+            if d.func == "count":
+                vcol = Column(val_t, cnt)
+            elif d.func in ("sum", "avg"):
+                if np.issubdtype(av.dtype, np.floating):
+                    s = np.bincount(inv, weights=np.where(avl, av, 0.0),
+                                    minlength=n_seg)
+                else:
+                    s = np.zeros(n_seg, np.int64)
+                    np.add.at(s, inv, np.where(avl, av.astype(np.int64), 0))
+                vcol = Column(val_t, s.astype(val_t.np_dtype),
+                              None if (cnt > 0).all() else (cnt > 0))
+            elif d.func in ("min", "max"):
+                if np.issubdtype(av.dtype, np.floating):
+                    sent = np.inf if d.func == "min" else -np.inf
+                    vv = np.where(avl, av, sent)
+                else:
+                    sent = np.int64(2**62) if d.func == "min" \
+                        else np.int64(-(2**62))
+                    vv = np.where(avl, av.astype(np.int64), sent)
+                s = np.full(n_seg, sent, dtype=vv.dtype)
+                red = np.minimum if d.func == "min" else np.maximum
+                red.at(s, inv, vv)
+                s = np.where(cnt > 0, s, 0)
+                vcol = Column(val_t, s.astype(val_t.np_dtype),
+                              None if (cnt > 0).all() else (cnt > 0))
+            else:
+                raise CompileError(f"host fragment agg {d.func}")
+        columns.append(vcol)
+        columns.append(Column(FieldType(TypeKind.BIGINT, nullable=False),
+                              cnt.astype(np.int64)))
+    return Chunk(columns)
+
+
+def _empty_chunk(frag: FragmentDAG, comb_dicts) -> Chunk:
+    columns = []
+    if frag.agg is not None:
+        from ..types.field_type import FieldType, TypeKind
+        for g in frag.agg.group_by:
+            dictionary = comb_dicts[g.idx] \
+                if g.ftype.is_string and isinstance(g, Col) else None
+            columns.append(Column(g.ftype, np.empty(0, g.ftype.np_dtype),
+                                  None, dictionary))
+        for ai, d in enumerate(frag.agg.aggs):
+            vt = frag.output_types[len(frag.agg.group_by) + 2 * ai]
+            columns.append(Column(vt, np.empty(0, vt.np_dtype)))
+            columns.append(Column(
+                FieldType(TypeKind.BIGINT, nullable=False),
+                np.empty(0, np.int64)))
+        return Chunk(columns)
+    for pos, comb in enumerate(frag.out_map):
+        ft = frag.output_types[pos]
+        columns.append(Column(ft, np.empty(0, ft.np_dtype), None,
+                              comb_dicts[comb]))
+    return Chunk(columns)
+
+
+def _truthy(v: np.ndarray) -> np.ndarray:
+    if v.dtype == np.bool_:
+        return v
+    return v != 0

@@ -96,6 +96,15 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         if not result.chunks:
             return _empty_like(plan)
         return Chunk.concat(result.chunks)
+    from ..plan.fragment import PhysFragmentRead
+    if isinstance(plan, PhysFragmentRead):
+        from ..copr.fragment import execute_fragment
+        snaps = {t.table.id: ctx.txn.snapshot(t.table.id)
+                 for t in plan.frag.tables}
+        result = execute_fragment(ctx.cop, plan.frag, snaps)
+        if not result.chunks:
+            return _empty_like(plan)
+        return Chunk.concat(result.chunks)
     if isinstance(plan, PhysPointGet):
         return _run_point_get(plan, ctx)
     if isinstance(plan, PhysSelection):

@@ -1,0 +1,376 @@
+"""Join fragments: multi-table pushdown units for the TiTPU coprocessor.
+
+The reference executes multi-table analytics by shipping plan fragments to
+the columnar MPP tier — exchanges between TiFlash nodes, gathered by TiDB
+(reference: planner/core/fragment.go:45 fragment expansion,
+store/tikv/mpp.go:372 DispatchMPPTasks, executor/mpp_gather.go:103). The
+TPU equivalent keeps whole snowflake join trees inside ONE fused device
+program: dimension ("build") tables become device-resident lookup tables,
+the fact ("probe") table streams through gather-joins, and the post-join
+selection/aggregation reuses the single-table kernel machinery. On a
+remote TPU every synchronous round trip costs ~100ms, so fusing the whole
+join pipeline into one dispatch+fetch is the difference between one RTT
+and five.
+
+Eligibility (recognized bottom-up over the physical plan):
+
+* INNER equi-joins only, one join key per edge;
+* every table but one ("probe") is reachable through a join whose key on
+  that table is unique — the PK handle or a single-column visible unique
+  index — so each probe row matches at most one build row and the join is
+  a static-shape gather (no dynamic output sizes for XLA);
+* leaves are bare full scans (their pushed-down filters ride along and
+  are applied to the build bitmaps);
+* integer join keys (dictionary codes are per-table and don't unify).
+
+Key density, int32 staging width, and MVCC overlay state are runtime
+properties — the executor (copr/fragment.py) checks them per snapshot and
+falls back to an equivalent host (numpy) fragment interpreter, never to a
+different plan shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.field_type import FieldType, TypeKind
+from .dag import DAGAggregation
+from .expr import AggDesc, Call, Col, PlanExpr, ScalarSubq
+from .physical import (
+    PhysHashAgg,
+    PhysHashJoin,
+    PhysSelection,
+    PhysTableRead,
+    PhysicalPlan,
+    _bare_scan,
+    _partial_val_type,
+    agg_pushable,
+)
+from .schema import PlanSchema, ResultField
+
+
+@dataclass
+class FragTable:
+    """One table of the fragment. col_offsets are store offsets in local
+    column order; filters are this table's pushed-down conjuncts in LOCAL
+    index space (Col.idx -> position in col_offsets)."""
+
+    table: object  # TableInfo
+    col_offsets: list[int]
+    filters: list[PlanExpr] = field(default_factory=list)
+    col_types: list[FieldType] = field(default_factory=list)
+
+
+@dataclass
+class FragJoin:
+    """Gather-join of tables[build] onto the probe row stream.
+
+    probe_key evaluates in the COMBINED column space of all previously
+    placed tables; build_key_local indexes tables[build].col_offsets. The
+    build key is unique per eligibility, so the join is
+    idx = perm[key - lo]; found = idx >= 0."""
+
+    build: int
+    probe_key: PlanExpr
+    build_key_local: int
+
+
+@dataclass
+class FragmentDAG:
+    """tables[0] is the probe; joins place tables[1..] in order. The
+    combined column space is concat(tables[i] columns) in table order;
+    selection/agg/out_map all reference it."""
+
+    tables: list[FragTable]
+    joins: list[FragJoin]
+    selection: list[PlanExpr] = field(default_factory=list)
+    agg: Optional[DAGAggregation] = None
+    # row mode: combined idx per output position (tree schema order)
+    out_map: Optional[list[int]] = None
+    output_types: list[FieldType] = field(default_factory=list)
+
+    def combined_types(self) -> list[FieldType]:
+        out: list[FieldType] = []
+        for t in self.tables:
+            out.extend(t.col_types)
+        return out
+
+    def describe(self) -> str:
+        parts = [f"probe(t{self.tables[0].table.id} "
+                 f"cols={self.tables[0].col_offsets})"]
+        for j in self.joins:
+            t = self.tables[j.build]
+            parts.append(f"gather(t{t.table.id} key={j.probe_key!r})")
+        if self.selection:
+            parts.append(f"sel({len(self.selection)})")
+        if self.agg is not None:
+            parts.append(f"agg(groups={len(self.agg.group_by)}, "
+                         f"aggs={self.agg.aggs})")
+        return " -> ".join(parts)
+
+
+@dataclass
+class PhysFragmentRead(PhysicalPlan):
+    """Leaf executing a FragmentDAG on the coprocessor.
+
+    Agg mode outputs the partial layout [group cols..., (val, cnt)...]
+    merged by a PhysHashAgg("final") parent — identical contract to the
+    single-table pushdown (PhysTableRead + dag.agg)."""
+
+    frag: FragmentDAG
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+    est_rows: Optional[float] = None
+
+
+# ==================== recognition ====================
+
+_FRAG_KEY_KINDS = (TypeKind.TINYINT, TypeKind.SMALLINT, TypeKind.INT,
+                   TypeKind.BIGINT, TypeKind.YEAR)
+
+
+def _has_subq(e: PlanExpr) -> bool:
+    if isinstance(e, ScalarSubq):
+        return True
+    if isinstance(e, Call):
+        return any(_has_subq(a) for a in e.args)
+    return False
+
+
+@dataclass
+class _Collected:
+    leaves: list[PhysTableRead]
+    # tree-space equality edges (absolute positions over concat'd leaves)
+    edges: list[tuple[int, int]]
+    # tree-space residual conjuncts (join ON residue + selections above)
+    conds: list[PlanExpr]
+    width: int
+
+
+def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
+    """Flatten a tree of INNER hash joins over bare scans; positions are
+    absolute over the concatenated leaf columns in tree order."""
+    if isinstance(node, PhysSelection):
+        inner = _collect_join_tree(node.children[0])
+        if inner is None:
+            return None
+        if any(_has_subq(c) for c in node.conditions):
+            return None
+        inner.conds = inner.conds + list(node.conditions)
+        return inner
+    if isinstance(node, PhysHashJoin):
+        if node.kind != "INNER":
+            return None
+        left = _collect_join_tree(node.children[0])
+        right = _collect_join_tree(node.children[1])
+        if left is None or right is None:
+            return None
+        lw = left.width
+        edges = list(left.edges)
+        edges += [(a + lw, b + lw) for a, b in right.edges]
+        edges += [(li, ri + lw) for li, ri in node.eq_conditions]
+        conds = list(left.conds) + [
+            _shift_expr(c, lw) for c in right.conds]
+        if node.other_conditions:
+            if any(_has_subq(c) for c in node.other_conditions):
+                return None
+            conds += list(node.other_conditions)
+        return _Collected(left.leaves + right.leaves, edges, conds,
+                          lw + right.width)
+    if isinstance(node, PhysTableRead):
+        if not _bare_scan(node) or node.dag.scan.ranges is not None:
+            return None
+        table = getattr(node, "table", None)
+        if table is None:
+            return None
+        return _Collected([node], [], [],
+                          len(node.dag.scan.col_offsets))
+    return None
+
+
+def _shift_expr(e: PlanExpr, by: int) -> PlanExpr:
+    if by == 0:
+        return e
+    if isinstance(e, Col):
+        return Col(e.idx + by, e.ftype)
+    if isinstance(e, Call):
+        return Call(e.op, [_shift_expr(a, by) for a in e.args], e.ftype,
+                    e.extra)
+    return e
+
+
+def _remap_expr(e: PlanExpr, remap: list[int]) -> PlanExpr:
+    if isinstance(e, Col):
+        return Col(remap[e.idx], e.ftype)
+    if isinstance(e, Call):
+        return Call(e.op, [_remap_expr(a, remap) for a in e.args], e.ftype,
+                    e.extra)
+    return e
+
+
+def _unique_key_offset(table, local_off: int) -> bool:
+    """Is the column at store offset local_off a unique key of table?"""
+    if table.pk_handle_offset == local_off:
+        return True
+    for ix in table.indices:
+        if ix.unique and ix.visible and ix.col_offsets == [local_off]:
+            return True
+    return False
+
+
+def _try_assemble(col: _Collected) -> Optional[tuple[FragmentDAG, list[int]]]:
+    """Pick a probe and a build order; returns (frag, treepos->combined)."""
+    leaves = col.leaves
+    n = len(leaves)
+    if n < 2:
+        return None
+    # leaf index + local position for every tree position
+    leaf_of: list[tuple[int, int]] = []
+    for i, tr in enumerate(leaves):
+        for local in range(len(tr.dag.scan.col_offsets)):
+            leaf_of.append((i, local))
+
+    def leaf_field_type(i: int, local: int) -> FieldType:
+        return leaves[i].dag.output_types[local]
+
+    def key_ok(i: int, local: int) -> bool:
+        off = leaves[i].dag.scan.col_offsets[local]
+        ft = leaf_field_type(i, local)
+        return ft.kind in _FRAG_KEY_KINDS and \
+            _unique_key_offset(leaves[i].table, off)
+
+    # candidates: prefer leaves that are never on a unique side (fact
+    # tables), then larger estimated scans
+    def probe_rank(i: int) -> tuple:
+        never_unique = not any(
+            (leaf_of[a][0] == i and key_ok(*leaf_of[a]))
+            or (leaf_of[b][0] == i and key_ok(*leaf_of[b]))
+            for a, b in col.edges)
+        est = leaves[i].est_rows or 0.0
+        return (0 if never_unique else 1, -est)
+
+    for probe in sorted(range(n), key=probe_rank):
+        placed = [probe]
+        joins_plan: list[tuple[int, int, int]] = []  # (leaf, keypos, local)
+        used_edges: set[int] = set()
+        while len(placed) < n:
+            advanced = False
+            for ei, (a, b) in enumerate(col.edges):
+                if ei in used_edges:
+                    continue
+                for probe_pos, build_pos in ((a, b), (b, a)):
+                    pi, _ = leaf_of[probe_pos]
+                    bi, blocal = leaf_of[build_pos]
+                    if pi not in placed or bi in placed:
+                        continue
+                    if not key_ok(bi, blocal):
+                        continue
+                    pft = leaf_field_type(*leaf_of[probe_pos])
+                    if pft.kind not in _FRAG_KEY_KINDS:
+                        continue
+                    placed.append(bi)
+                    joins_plan.append((bi, probe_pos, blocal))
+                    used_edges.add(ei)
+                    advanced = True
+                    break
+                if advanced:
+                    break
+            if not advanced:
+                break
+        if len(placed) < n:
+            continue
+
+        # combined layout: placement order
+        base_of_leaf: dict[int, int] = {}
+        acc = 0
+        for li in placed:
+            base_of_leaf[li] = acc
+            acc += len(leaves[li].dag.scan.col_offsets)
+        remap = [base_of_leaf[leaf_of[p][0]] + leaf_of[p][1]
+                 for p in range(col.width)]
+
+        tables = []
+        order_index = {li: k for k, li in enumerate(placed)}
+        for li in placed:
+            tr = leaves[li]
+            filters = list(tr.dag.selection.conditions) \
+                if tr.dag.selection else []
+            tables.append(FragTable(
+                tr.table, list(tr.dag.scan.col_offsets), filters,
+                list(tr.dag.output_types)))
+        joins = []
+        for bi, probe_pos, blocal in joins_plan:
+            joins.append(FragJoin(
+                order_index[bi],
+                Col(remap[probe_pos], leaf_field_type(*leaf_of[probe_pos])),
+                blocal))
+        # unused equality edges become plain selection conditions
+        extra = []
+        for ei, (a, b) in enumerate(col.edges):
+            if ei not in used_edges:
+                fa = leaf_field_type(*leaf_of[a])
+                extra.append(Call("eq", [
+                    Col(remap[a], fa), Col(remap[b], leaf_field_type(
+                        *leaf_of[b]))], FieldType(TypeKind.BOOLEAN)))
+        selection = [_remap_expr(c, remap) for c in col.conds] + extra
+        frag = FragmentDAG(tables, joins, selection)
+        return frag, remap
+    return None
+
+
+def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
+    """Top-down, largest-pattern-first rewrite: an aggregation over a join
+    tree must be matched at the AGG level before any inner join subtree is
+    consumed as a row fragment (bottom-up would fuse the joins alone and
+    strand the aggregation on the host). A matched fragment consumes its
+    whole subtree; on no match, recurse into children."""
+    if isinstance(plan, PhysHashAgg) and plan.mode == "complete":
+        col = _collect_join_tree(plan.children[0])
+        if col is not None and agg_pushable(plan.group_by, plan.aggs) \
+                and not any(d.distinct for d in plan.aggs):
+            asm = _try_assemble(col)
+            if asm is not None:
+                frag, remap = asm
+                frag.agg = DAGAggregation(
+                    [_remap_expr(g, remap) for g in plan.group_by],
+                    [AggDesc(d.func,
+                             None if d.arg is None
+                             else _remap_expr(d.arg, remap),
+                             d.ftype, d.distinct, d.name)
+                     for d in plan.aggs])
+                fields = []
+                for i, g in enumerate(plan.group_by):
+                    fields.append(ResultField(f"gk#{i}", g.ftype))
+                for i, d in enumerate(plan.aggs):
+                    fields.append(ResultField(f"pv#{i}",
+                                              _partial_val_type(d)))
+                    fields.append(ResultField(
+                        f"pc#{i}", FieldType(TypeKind.BIGINT,
+                                             nullable=False)))
+                frag.output_types = [f.ftype for f in fields]
+                tr = PhysFragmentRead(frag, PlanSchema(fields))
+                return PhysHashAgg("final", plan.group_by, plan.aggs,
+                                   plan.schema, [tr])
+        plan.children = [apply_fragments(c) for c in plan.children]
+        return plan
+
+    if isinstance(plan, (PhysSelection, PhysHashJoin)):
+        col = _collect_join_tree(plan)
+        if col is not None:
+            asm = _try_assemble(col)
+            if asm is not None:
+                frag, remap = asm
+                frag.out_map = list(remap)
+                frag.output_types = [
+                    leaf_ft for leaf_ft in _tree_types(col)]
+                return PhysFragmentRead(frag, plan.schema)
+    plan.children = [apply_fragments(c) for c in plan.children]
+    return plan
+
+
+def _tree_types(col: _Collected) -> list[FieldType]:
+    out: list[FieldType] = []
+    for tr in col.leaves:
+        out.extend(tr.dag.output_types)
+    return out

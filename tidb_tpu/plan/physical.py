@@ -52,6 +52,7 @@ class PhysTableRead(PhysicalPlan):
     schema: PlanSchema
     children: list[PhysicalPlan] = field(default_factory=list)
     est_rows: Optional[float] = None  # CBO estimate for EXPLAIN
+    table: object = None  # TableInfo (fragment eligibility, plan/fragment.py)
 
 
 @dataclass
@@ -404,6 +405,8 @@ def optimize(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     plan = push_predicates(plan)
     plan = prune(plan)
     phys = _to_physical(plan, stats)
+    from .fragment import apply_fragments
+    phys = apply_fragments(phys)
     _optimize_subqueries(phys, stats)
     return phys
 
@@ -452,7 +455,7 @@ def _fresh_table_read(scan: LogicalScan) -> PhysTableRead:
         scan=DAGScan(scan.table.id, offsets),
         output_types=[f.ftype for f in scan.schema.fields],
     )
-    return PhysTableRead(dag, scan.schema)
+    return PhysTableRead(dag, scan.schema, table=scan.table)
 
 
 def _bare_scan(tr: PhysTableRead) -> bool:
@@ -773,6 +776,8 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
         line = f"{pad}Limit: {plan.limit} offset {plan.offset}"
     elif isinstance(plan, PhysHashJoin):
         line = f"{pad}HashJoin({plan.kind}): eq={plan.eq_conditions}"
+    elif name == "PhysFragmentRead":
+        line = f"{pad}FragmentRead[TiTPU]: {plan.frag.describe()}"
     else:
         line = f"{pad}{name}"
     out = [line]
