@@ -194,3 +194,62 @@ def test_fragment_vs_host_differential(star):
     ]
     for q in queries:
         assert star.query(q) == _oracle(star, q), q
+
+
+# ---------------- high-cardinality TopN aggregation ----------------
+
+@pytest.fixture
+def highcard():
+    s = Session()
+    s.execute("CREATE TABLE hc (k INT NOT NULL PRIMARY KEY, g INT, "
+              "v DECIMAL(8,2))")
+    rng = np.random.default_rng(11)
+    rows = []
+    for i in range(20000):
+        rows.append(f"({i},{int(rng.integers(0, 18000))},"
+                    f"{int(rng.integers(1, 500))}.25)")
+    s.execute("INSERT INTO hc VALUES " + ",".join(rows))
+    _fold(s)
+    return s
+
+
+HC_Q = ("SELECT g, SUM(v) AS sv, COUNT(*) FROM hc GROUP BY g "
+        "ORDER BY sv DESC LIMIT 7")
+
+
+def test_highcard_topn_device_path(highcard, monkeypatch):
+    """GROUP BY over ~14k distinct keys (beyond the dense-segment cap)
+    with an ORDER BY ... LIMIT consumer runs the sorted-run candidate
+    kernel on device, digest-equal to the host engine."""
+    def boom(frag, snaps):
+        raise AssertionError("host fragment fallback taken")
+    monkeypatch.setattr(F, "_host_fragment", boom)
+    ran = {}
+    orig = F._run_frag_batch
+
+    def spy(cop, frag, snaps, prepared, spans, builds, overlay, mode=None):
+        ran["mode"] = mode
+        return orig(cop, frag, snaps, prepared, spans, builds, overlay,
+                    mode=mode)
+    monkeypatch.setattr(F, "_run_frag_batch", spy)
+    got = highcard.query(HC_Q)
+    assert ran.get("mode") == "hc", f"expected hc path, got {ran}"
+    assert got == _oracle(highcard, HC_Q)
+    assert len(got) == 7
+
+
+def test_highcard_topn_join_device_path(star, monkeypatch):
+    """Q3-shaped: join + high-cardinality group key + TopN; the dependent
+    group keys (nation name via the join) ride along without sorting."""
+    # widen fact ids into a high-card group key
+    q = ("SELECT fid, nname, SUM(amount) AS sa FROM fact, customer, nation "
+         "WHERE fact.cust = customer.ck AND customer.nk = nation.nk "
+         "GROUP BY fid, nname ORDER BY sa DESC LIMIT 5")
+    got = star.query(q)
+    assert got == _oracle(star, q)
+    assert len(got) == 5
+
+
+def test_highcard_group_key_order(highcard):
+    q = "SELECT g, SUM(v) FROM hc GROUP BY g ORDER BY g LIMIT 9"
+    assert highcard.query(q) == _oracle(highcard, q)

@@ -133,13 +133,19 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         spans.append((lo, span))
         prepared["__sig__"].append(("join", j.build, lo, span))
 
+    mode = "agg" if frag.agg is not None else "rows"
     if frag.agg is not None:
         n_rows = psnap.epoch.num_rows + len(psnap.overlay_handles)
         facade = _agg_facade(frag)
         err = cop._prepare_agg(facade, comb_dicts, comb_bounds, prepared,
                                n_rows)
         if err is not None:
-            raise _Fallback()
+            # dense segment space rejected; a TopN consumer admits the
+            # high-cardinality sorted-run candidate path (copr/hcagg.py)
+            if frag.hc is None or len(psnap.overlay_handles) > 0 or \
+                    not _prepare_hc(frag, comb_bounds, prepared, n_rows):
+                raise _Fallback()
+            mode = "hc"
 
     # ---- staging ----
     builds = []
@@ -156,10 +162,12 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     chunks: list[Chunk] = []
     if psnap.epoch.num_rows > 0:
         chunks.extend(_run_frag_batch(cop, frag, snaps, prepared, spans,
-                                      builds, overlay=False))
+                                      builds, overlay=False, mode=mode))
     if len(psnap.overlay_handles) > 0:
+        # hc gated overlay out above: a group split across batches would
+        # break the candidate-superset guarantee
         chunks.extend(_run_frag_batch(cop, frag, snaps, prepared, spans,
-                                      builds, overlay=True))
+                                      builds, overlay=True, mode=mode))
     if not chunks:
         chunks = [_empty_chunk(frag, comb_dicts)]
     return CopResult(chunks, is_partial_agg=frag.agg is not None)
@@ -213,13 +221,15 @@ def _perm_array(cop, snap, key_off: int, lo: int, span: int,
     return dev
 
 
-def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay):
+def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
+                    mode=None):
     probe = frag.tables[0]
     psnap = snaps[probe.table.id]
     pcols, pvis, phost, phost_mask = cop._stage_inputs(
         _facade_dag(probe), psnap, overlay=overlay)
 
-    mode = "agg" if frag.agg is not None else "rows"
+    if mode is None:
+        mode = "agg" if frag.agg is not None else "rows"
     key = ("frag", _frag_key(frag), _sig(prepared), mode,
            pcols[0][0].shape[0] if pcols else 0,
            tuple(b["cols"][0][0].shape[0] for b in builds))
@@ -227,6 +237,9 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay):
         frag, prepared, spans, mode))
     out = jax.device_get(kern(pcols, pvis, builds))
 
+    if mode == "hc":
+        chunk = _decode_hc(frag, snaps, prepared, out)
+        return [] if chunk is None else [chunk]
     if mode == "agg":
         cards = prepared["__dense_cards__"]
         comb_dicts = []
@@ -251,6 +264,114 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay):
         if n_rows else np.zeros(0, bool)
     idx = np.nonzero(mask)[0]
     return _host_rows_for(frag, snaps, idx, overlay)
+
+
+def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
+    """Gates + schedule for the sorted-run candidate path. Group keys must
+    be int32-encodable with a collision-free NULL code (bounds hi + 1);
+    aggregates must be additive (count / int-decomposable sum / avg)."""
+    from .bounds import decompose_terms, limbs_for
+    from . import sumexact as _SE
+
+    nulls: list[int] = []
+    spans_ = []
+    for g in frag.agg.group_by:
+        if g.ftype.is_float:
+            return False
+        if not expr_device_safe(g, comb_bounds):
+            return False
+        b = expr_bounds(g, comb_bounds)
+        if b is None or b[1] + 1 >= 2**31 - 1:
+            return False
+        nulls.append(b[1] + 1)
+        spans_.append(b[1] - b[0])
+
+    # ---- segment-key selection (functional dependencies) ----
+    # XLA's variadic sort compile time grows steeply with operand count,
+    # so sort only by group keys that DETERMINE the rest: a build table
+    # reached through a unique join whose key is determined contributes
+    # all its columns (e.g. Q3 groups by l_orderkey + o_orderdate +
+    # o_shippriority — the orders columns are functions of l_orderkey)
+    bases = []
+    acc = 0
+    for t in frag.tables:
+        bases.append((acc, acc + len(t.col_offsets)))
+        acc += len(t.col_offsets)
+
+    def cols_of(e) -> set:
+        out = set()
+
+        def walk(x):
+            if isinstance(x, Col):
+                out.add(x.idx)
+            elif hasattr(x, "args"):
+                for a in x.args:
+                    walk(a)
+        walk(e)
+        return out
+
+    def closure(det: set) -> set:
+        det = set(det)
+        changed = True
+        while changed:
+            changed = False
+            for j in frag.joins:
+                rng = set(range(*bases[j.build]))
+                if rng <= det:
+                    continue
+                if cols_of(j.probe_key) <= det:
+                    det |= rng
+                    changed = True
+        return det
+
+    seg_keys: list[int] = []
+    det: set = set()
+    order = sorted(range(len(frag.agg.group_by)),
+                   key=lambda gi: -spans_[gi])
+    for gi in order:
+        g = frag.agg.group_by[gi]
+        need = cols_of(g)
+        if need and not need <= closure(det):
+            seg_keys.append(gi)
+            # only a PLAIN column key determines its column: a composite
+            # expression (a+b) being constant does not pin its arguments
+            if isinstance(g, Col):
+                det |= need
+    if not seg_keys:
+        seg_keys = [0]
+    if len(seg_keys) > 2:
+        return False
+    sched: list[dict] = []
+    for d in frag.agg.aggs:
+        if d.arg is None or d.func == "count":
+            sched.append({"kind": "count"})
+            continue
+        if d.func not in ("sum", "avg") or d.arg.ftype.is_float:
+            return False
+        terms = decompose_terms(d.arg, comb_bounds)
+        if terms is None:
+            return False
+        b = expr_bounds(d.arg, comb_bounds)
+        if b is None:
+            return False
+        if max(abs(b[0]), abs(b[1])) * max(n_rows, 1) >= 2**62:
+            return False
+        sched.append({
+            "kind": "isum",
+            "terms": [(t, s, limbs_for(expr_bounds(t, comb_bounds),
+                                       _SE.LIMB_BITS))
+                      for t, s in terms],
+        })
+    prepared["__hc_nulls__"] = nulls
+    prepared["__hc_sched__"] = sched
+    prepared["__hc_segkeys__"] = seg_keys
+    prepared["__sig__"].append((
+        "hc", frag.hc.score, frag.hc.desc, frag.hc.cap, tuple(nulls),
+        tuple(seg_keys),
+        tuple((s["kind"],) + tuple((repr(t), sh, L)
+                                   for t, sh, L in s.get("terms", ()))
+              for s in sched)))
+    return True
 
 
 def _build_frag_kernel(frag, prepared, spans, mode):
@@ -293,9 +414,187 @@ def _build_frag_kernel(frag, prepared, spans, mode):
             mask = selection_mask(sel, cols, prepared, mask)
         if mode == "agg":
             return agg_partials(agg, prepared, cards, segments, cols, mask)
+        if mode == "hc":
+            return _hc_body(frag, prepared, cols, mask)
         return jnp.packbits(mask)
 
     return jax.jit(kernel)
+
+
+def _hc_body(frag, prepared, cols, mask):
+    """Sorted-run candidate aggregation (copr/hcagg.py machinery).
+
+    Sorts by the SEGMENT keys only (the functional-dependency analysis in
+    _prepare_hc proved the other group keys constant within a segment) —
+    XLA's variadic sort compile time is the binding constraint. Candidate
+    selection uses approx_max_k over a score recombined from the exact
+    pair sums (elementwise, no global scan)."""
+    from . import hcagg as HC
+    from . import sumexact as _SE
+
+    agg = frag.agg
+    hc = frag.hc
+    nulls = prepared["__hc_nulls__"]
+    sched = prepared["__hc_sched__"]
+    seg_keys = prepared["__hc_segkeys__"]
+    n = mask.shape[0]
+
+    encs = []
+    for gi, g in enumerate(agg.group_by):
+        v, vl = eval_expr(g, cols, prepared)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        encs.append(jnp.where(vl, v.astype(jnp.int32),
+                              jnp.int32(nulls[gi])))
+    sort_keys = []
+    for pos, gi in enumerate(seg_keys):
+        k = encs[gi]
+        if pos == 0:
+            k = jnp.where(mask, k, HC._I32_MAX)
+        sort_keys.append(k)
+    sk, perm = HC.sort_by_keys(sort_keys)
+    valid = sk[0] != HC._I32_MAX
+    is_start, end_idx = HC.segment_bounds(sk, valid)
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    def pair_stack(values_unsorted_i32, n_limbs):
+        """-> int32[n_limbs, 2, n] per-row candidate pair sums."""
+        v_sorted = values_unsorted_i32[perm]
+        outs = []
+        for li in _SE.limbs_of(v_sorted, n_limbs):
+            hi, lo = HC.seg_sum_pairs(li, iota, end_idx)
+            outs.append(jnp.stack([hi, lo]))
+        return jnp.stack(outs)
+
+    def pairs_to_f32(pairs):
+        """[L, 2, n] pair sums -> approximate per-row f32 value."""
+        total = jnp.zeros(n, jnp.float32)
+        for li in range(pairs.shape[0]):
+            v = pairs[li, 0].astype(jnp.float32) * 4096.0 + \
+                pairs[li, 1].astype(jnp.float32)
+            total = total + v * float(1 << (_SE.LIMB_BITS * li))
+        return total
+
+    ones = mask.astype(jnp.int32)
+    out = {"hc_rows": pair_stack(ones, 1)}
+
+    for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+        if s["kind"] == "count":
+            if d.arg is not None:
+                _, vl = eval_expr(d.arg, cols, prepared)
+                out[f"hc_cnt{ai}"] = pair_stack((mask & vl).astype(
+                    jnp.int32), 1)
+            else:
+                out[f"hc_cnt{ai}"] = out["hc_rows"]
+            continue
+        _, vl = eval_expr(d.arg, cols, prepared)
+        contrib = mask & vl
+        out[f"hc_cnt{ai}"] = pair_stack(contrib.astype(jnp.int32), 1)
+        for ti, (t, shift, L) in enumerate(s["terms"]):
+            tv, _ = eval_expr(t, cols, prepared)
+            tv32 = jnp.where(contrib, tv.astype(jnp.int32), 0)
+            out[f"hc_s{ai}_{ti}"] = pair_stack(tv32, L)
+
+    # ---- candidate selection by (approximate) primary sort score ----
+    kind, idx = hc.score
+    if kind == "group":
+        sv = encs[idx][perm].astype(jnp.float32)
+        score_null = encs[idx][perm] == nulls[idx]
+    else:
+        d = agg.aggs[idx]
+        if sched[idx]["kind"] == "count":
+            sv = pairs_to_f32(out[f"hc_cnt{idx}"])
+            score_null = jnp.zeros(n, bool)  # COUNT is never NULL
+        else:
+            sv = jnp.zeros(n, jnp.float32)
+            for ti, (t, shift, L) in enumerate(sched[idx]["terms"]):
+                sv = sv + pairs_to_f32(out[f"hc_s{idx}_{ti}"]) * \
+                    float(1 << shift)
+            cnt = pairs_to_f32(out[f"hc_cnt{idx}"])
+            if d.func == "avg":
+                sv = sv / jnp.maximum(cnt, 1.0)
+            score_null = cnt == 0  # SUM/AVG over no valid rows is NULL
+    signed = sv if hc.desc else -sv
+    # MySQL NULL ordering: first in ASC, last in DESC. ASC -> +inf makes
+    # the NULL group a guaranteed candidate; DESC -> -inf is sound because
+    # a NULL-last group reaches the top-k only when the total group count
+    # is below the candidate cap (then every group is a candidate anyway)
+    signed = jnp.where(score_null,
+                       jnp.float32(-np.inf if hc.desc else np.inf), signed)
+    score = jnp.where(is_start & valid, signed, -jnp.inf)
+
+    k_cap = min(hc.cap, n)
+    # recall_target=1.0 keeps TPU-native compile times (~10s vs ~20s for
+    # lax.top_k at millions of rows) while selecting EXACTLY by score —
+    # required for the candidate-superset guarantee the decode relies on
+    _, cand = jax.lax.approx_max_k(score, k_cap, recall_target=1.0)
+    res = {"picked": (is_start & valid)[cand].astype(jnp.int32),
+           "score": score[cand]}
+    for gi in range(len(agg.group_by)):
+        res[f"gk{gi}"] = encs[gi][perm][cand]
+    for ai, s in enumerate(sched):
+        res[f"cnt{ai}"] = out[f"hc_cnt{ai}"][:, :, cand]
+        for ti in range(len(s.get("terms", ()))):
+            res[f"s{ai}_{ti}"] = out[f"hc_s{ai}_{ti}"][:, :, cand]
+    return res
+
+
+def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
+    """Candidate partials -> partial-layout chunk (subset of groups; the
+    host HashAgg(final) + Sort + Limit above do the exact final ranking)."""
+    from . import sumexact as _SE
+    from ..types.field_type import FieldType, TypeKind
+
+    agg = frag.agg
+    sched = prepared["__hc_sched__"]
+    nulls = prepared["__hc_nulls__"]
+    picked = out["picked"].astype(bool)
+    if not picked.any():
+        return None
+    if picked.all():
+        # more groups may exist beyond the candidate buffer: the result is
+        # sound only if the k-th best score strictly beats the buffer's
+        # worst (f32 scores order-embed the exact primary values, so a
+        # strict gap proves no non-candidate can reach the top-k; a tie at
+        # the boundary is ambiguous -> exact host path)
+        score = out["score"]
+        k = frag.hc.k
+        if k >= len(score) or not (score[k - 1] > score[-1]):
+            raise _Fallback()
+    sel = np.nonzero(picked)[0]
+
+    comb_dicts = []
+    for t in frag.tables:
+        snap = snaps[t.table.id]
+        comb_dicts.extend(snap.dictionaries[off] for off in t.col_offsets)
+
+    columns = []
+    for gi, g in enumerate(agg.group_by):
+        raw = out[f"gk{gi}"][sel]
+        is_null = raw == nulls[gi]
+        data = raw.astype(g.ftype.np_dtype)
+        dictionary = comb_dicts[g.idx] \
+            if g.ftype.is_string and isinstance(g, Col) else None
+        columns.append(Column(
+            g.ftype, data, None if not is_null.any() else ~is_null,
+            dictionary))
+    for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
+        # pair layout matches sumexact partials: value = hi*4096 + lo
+        cnt = _SE.combine_partials(out[f"cnt{ai}"])[sel]
+        val_t = frag.output_types[len(agg.group_by) + 2 * ai]
+        if s["kind"] == "count":
+            vcol = Column(val_t, cnt.astype(np.int64))
+        else:
+            total = np.zeros(len(picked), dtype=np.int64)
+            for ti, (_, shift, _) in enumerate(s["terms"]):
+                total += _SE.combine_partials(out[f"s{ai}_{ti}"]) << shift
+            val = total[sel]
+            vcol = Column(val_t, val.astype(val_t.np_dtype),
+                          None if (cnt > 0).all() else (cnt > 0))
+        columns.append(vcol)
+        columns.append(Column(FieldType(TypeKind.BIGINT, nullable=False),
+                              cnt.astype(np.int64)))
+    return Chunk(columns)
 
 
 def _sig(prepared) -> tuple:

@@ -40,7 +40,10 @@ from .expr import AggDesc, Call, Col, PlanExpr, ScalarSubq
 from .physical import (
     PhysHashAgg,
     PhysHashJoin,
+    PhysLimit,
+    PhysProjection,
     PhysSelection,
+    PhysSort,
     PhysTableRead,
     PhysicalPlan,
     _bare_scan,
@@ -77,6 +80,25 @@ class FragJoin:
 
 
 @dataclass
+class HCTopN:
+    """High-cardinality group-by hint: the aggregation's consumer is
+    ORDER BY <score> LIMIT k, so the device may return only a candidate
+    superset of the top-k groups (sorted-run kernel, copr/hcagg.py)
+    instead of the full group set. score: ("group", j) ranks by group key
+    j; ("agg", ai) ranks by aggregate ai's (approximate) value. The host
+    layers above re-sort exactly."""
+
+    score: tuple[str, int]
+    desc: bool
+    k: int
+
+    @property
+    def cap(self) -> int:
+        # candidate buffer absorbing f32 score ties near the k-th value
+        return max(4 * self.k, self.k + 64)
+
+
+@dataclass
 class FragmentDAG:
     """tables[0] is the probe; joins place tables[1..] in order. The
     combined column space is concat(tables[i] columns) in table order;
@@ -89,6 +111,9 @@ class FragmentDAG:
     # row mode: combined idx per output position (tree schema order)
     out_map: Optional[list[int]] = None
     output_types: list[FieldType] = field(default_factory=list)
+    # set when the agg's consumer is a TopN: permits the high-cardinality
+    # candidate path when the dense-segment gate rejects the group space
+    hc: Optional[HCTopN] = None
 
     def combined_types(self) -> list[FieldType]:
         out: list[FieldType] = []
@@ -160,7 +185,10 @@ def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
         inner.conds = inner.conds + list(node.conditions)
         return inner
     if isinstance(node, PhysHashJoin):
-        if node.kind != "INNER":
+        # CROSS nodes appear when the planner stages a cartesian pair whose
+        # linking equalities live higher in the tree (e.g. Q9's
+        # part x nation); they contribute leaves, later edges key them
+        if node.kind not in ("INNER", "CROSS"):
             return None
         left = _collect_join_tree(node.children[0])
         right = _collect_join_tree(node.children[1])
@@ -196,6 +224,17 @@ def _shift_expr(e: PlanExpr, by: int) -> PlanExpr:
         return Col(e.idx + by, e.ftype)
     if isinstance(e, Call):
         return Call(e.op, [_shift_expr(a, by) for a in e.args], e.ftype,
+                    e.extra)
+    return e
+
+
+def _subst_cols(e: PlanExpr, exprs: list[PlanExpr]) -> PlanExpr:
+    """Compose an expression over a projection's output with the
+    projection itself (Col i -> exprs[i])."""
+    if isinstance(e, Col):
+        return exprs[e.idx]
+    if isinstance(e, Call):
+        return Call(e.op, [_subst_cols(a, exprs) for a in e.args], e.ftype,
                     e.extra)
     return e
 
@@ -319,39 +358,155 @@ def _try_assemble(col: _Collected) -> Optional[tuple[FragmentDAG, list[int]]]:
     return None
 
 
+def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
+                        ) -> Optional[PhysHashAgg]:
+    """HashAgg(complete) over [Projection?] over join tree -> final agg
+    over a fragment read. allow_single admits one bare scan as a
+    degenerate fragment (useful only with an hc TopN hint)."""
+    # a projection between agg and joins (e.g. Q9's amount column)
+    # composes into the agg expressions instead of blocking the match
+    child = plan.children[0]
+    proj = None
+    if isinstance(child, PhysProjection) and \
+            all(not _has_subq(e) for e in child.exprs):
+        proj = child.exprs
+        child = child.children[0]
+    group_by = plan.group_by
+    aggs = plan.aggs
+    if proj is not None:
+        group_by = [_subst_cols(g, proj) for g in group_by]
+        aggs = [AggDesc(d.func,
+                        None if d.arg is None else _subst_cols(d.arg, proj),
+                        d.ftype, d.distinct, d.name) for d in plan.aggs]
+    col = _collect_join_tree(child)
+    if col is None or not agg_pushable(group_by, aggs) \
+            or any(d.distinct for d in plan.aggs):
+        return None
+    if len(col.leaves) == 1:
+        if not allow_single:
+            return None
+        tr = col.leaves[0]
+        frag = FragmentDAG([FragTable(
+            tr.table, list(tr.dag.scan.col_offsets),
+            list(tr.dag.selection.conditions) if tr.dag.selection else [],
+            list(tr.dag.output_types))], [],
+            [c for c in col.conds])
+        remap = list(range(col.width))
+    else:
+        asm = _try_assemble(col)
+        if asm is None:
+            return None
+        frag, remap = asm
+    frag.agg = DAGAggregation(
+        [_remap_expr(g, remap) for g in group_by],
+        [AggDesc(d.func,
+                 None if d.arg is None else _remap_expr(d.arg, remap),
+                 d.ftype, d.distinct, d.name)
+         for d in aggs])
+    fields = []
+    for i, g in enumerate(group_by):
+        fields.append(ResultField(f"gk#{i}", g.ftype))
+    for i, d in enumerate(aggs):
+        fields.append(ResultField(f"pv#{i}", _partial_val_type(d)))
+        fields.append(ResultField(
+            f"pc#{i}", FieldType(TypeKind.BIGINT, nullable=False)))
+    frag.output_types = [f.ftype for f in fields]
+    tr = PhysFragmentRead(frag, PlanSchema(fields))
+    return PhysHashAgg("final", plan.group_by, plan.aggs,
+                       plan.schema, [tr])
+
+
+_HC_SCORE_FUNCS = ("sum", "count", "avg")
+
+
+def _attach_hc(limit_node, sort_node, proj, agg_node,
+               rewritten: PhysHashAgg) -> bool:
+    """Resolve the TopN's primary sort item to a device score and attach
+    the high-cardinality hint to the fragment under `rewritten`.
+    Returns False (no mutation of `rewritten`) when the item cannot score
+    on device."""
+    frag = rewritten.children[0].frag
+    e, desc = sort_node.items[0]
+    if proj is not None:
+        e = _subst_cols(e, proj.exprs)
+    if not isinstance(e, Col):
+        return False
+    ngroups = len(agg_node.group_by)
+    if e.idx < ngroups:
+        g = agg_node.group_by[e.idx]
+        # dictionary codes are not order-preserving; floats stay host
+        if g.ftype.is_string or g.ftype.is_float:
+            return False
+        score = ("group", e.idx)
+    else:
+        ai = e.idx - ngroups
+        if ai >= len(agg_node.aggs) or \
+                agg_node.aggs[ai].func not in _HC_SCORE_FUNCS:
+            return False
+        score = ("agg", ai)
+    frag.hc = HCTopN(score, desc, limit_node.limit)
+    return True
+
+
 def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
     """Top-down, largest-pattern-first rewrite: an aggregation over a join
     tree must be matched at the AGG level before any inner join subtree is
     consumed as a row fragment (bottom-up would fuse the joins alone and
     strand the aggregation on the host). A matched fragment consumes its
     whole subtree; on no match, recurse into children."""
+    # TopN over aggregation: Limit(Sort([Proj?](HashAgg))). Matched above
+    # the agg so the fragment learns its consumer only needs the top-k
+    # groups (high-cardinality candidate path); Sort/Limit stay on the
+    # host and re-sort the (few) surviving groups exactly.
+    if isinstance(plan, PhysLimit) and plan.offset == 0 and \
+            isinstance(plan.children[0], PhysSort) and \
+            plan.children[0].items:
+        sort_node = plan.children[0]
+        below = sort_node.children[0]
+        proj = None
+        if isinstance(below, PhysProjection) and \
+                all(not _has_subq(x) for x in below.exprs):
+            proj = below
+            below = below.children[0]
+        if isinstance(below, PhysHashAgg) and below.mode == "complete":
+            rewritten = _match_agg_fragment(below, allow_single=True)
+            if rewritten is not None:
+                _attach_hc(plan, sort_node, proj, below, rewritten)
+                if proj is not None:
+                    proj.children = [rewritten]
+                else:
+                    sort_node.children = [rewritten]
+                return plan
+        if isinstance(below, PhysHashAgg) and below.mode == "final" and \
+                len(below.children) == 1 and \
+                isinstance(below.children[0], PhysTableRead):
+            # single-table agg already pushed into a CopDAG: lift it into a
+            # degenerate fragment so the high-cardinality candidate path
+            # can serve ORDER BY ... LIMIT k when the dense gate rejects
+            tr = below.children[0]
+            dag = tr.dag
+            if dag.agg is not None and dag.scan.ranges is None and \
+                    getattr(tr, "table", None) is not None and \
+                    dag.topn is None and dag.limit is None:
+                frag = FragmentDAG([FragTable(
+                    tr.table, list(dag.scan.col_offsets),
+                    list(dag.selection.conditions) if dag.selection else [],
+                    _scan_types(tr))], [])
+                frag.agg = dag.agg
+                frag.output_types = list(dag.output_types)
+                frag_tr = PhysFragmentRead(frag, tr.schema)
+                old_children = below.children
+                below.children = [frag_tr]
+                if not _attach_hc(plan, sort_node, proj, below, below):
+                    # the degenerate single-table fragment is useful ONLY
+                    # with the hc hint — keep the CopDAG pushdown otherwise
+                    below.children = old_children
+                return plan
+
     if isinstance(plan, PhysHashAgg) and plan.mode == "complete":
-        col = _collect_join_tree(plan.children[0])
-        if col is not None and agg_pushable(plan.group_by, plan.aggs) \
-                and not any(d.distinct for d in plan.aggs):
-            asm = _try_assemble(col)
-            if asm is not None:
-                frag, remap = asm
-                frag.agg = DAGAggregation(
-                    [_remap_expr(g, remap) for g in plan.group_by],
-                    [AggDesc(d.func,
-                             None if d.arg is None
-                             else _remap_expr(d.arg, remap),
-                             d.ftype, d.distinct, d.name)
-                     for d in plan.aggs])
-                fields = []
-                for i, g in enumerate(plan.group_by):
-                    fields.append(ResultField(f"gk#{i}", g.ftype))
-                for i, d in enumerate(plan.aggs):
-                    fields.append(ResultField(f"pv#{i}",
-                                              _partial_val_type(d)))
-                    fields.append(ResultField(
-                        f"pc#{i}", FieldType(TypeKind.BIGINT,
-                                             nullable=False)))
-                frag.output_types = [f.ftype for f in fields]
-                tr = PhysFragmentRead(frag, PlanSchema(fields))
-                return PhysHashAgg("final", plan.group_by, plan.aggs,
-                                   plan.schema, [tr])
+        rewritten = _match_agg_fragment(plan)
+        if rewritten is not None:
+            return rewritten
         plan.children = [apply_fragments(c) for c in plan.children]
         return plan
 
@@ -374,3 +529,11 @@ def _tree_types(col: _Collected) -> list[FieldType]:
     for tr in col.leaves:
         out.extend(tr.dag.output_types)
     return out
+
+
+def _scan_types(tr: PhysTableRead) -> list[FieldType]:
+    """Field types of the scanned columns (local order) from the table
+    schema — dag.output_types holds the partial-agg layout when an agg was
+    pushed, not the scan columns."""
+    by_off = {c.offset: c.ftype for c in tr.table.columns}
+    return [by_off[off] for off in tr.dag.scan.col_offsets]
