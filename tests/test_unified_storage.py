@@ -1,0 +1,132 @@
+"""Unified storage: SQL commits flow through the percolator/region tier.
+
+VERDICT item: SQL must sit on the transactional KV substrate (one txn
+truth), with a region split + retry exercised at the SQL level — the
+in-process analog of the reference's session/session.go:573 ->
+store/tikv/2pc.go:78 path over region-grouped batches.
+"""
+
+import threading
+
+import pytest
+
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.twopc import Snapshot
+from tidb_tpu.kv import codec
+from tidb_tpu.session import Session, SQLError
+
+
+@pytest.fixture
+def se():
+    s = Session()
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    return s
+
+
+def test_sql_commit_lands_in_percolator_store(se):
+    """Committed SQL rows are readable from the KV tier (write records +
+    versioned values), proving the single-truth path."""
+    st = se.storage
+    snap = Snapshot(st.rm, st.tso, st.tso.next_ts())
+    key = tablecodec.record_key(st.catalog.table("test", "t").id, 2)
+    raw = snap.get(key)
+    assert raw is not None
+    row = codec.decode_key(raw)
+    assert 20 in row
+
+
+def test_sql_delete_lands_as_kv_tombstone(se):
+    st = se.storage
+    tid = st.catalog.table("test", "t").id
+    se.execute("DELETE FROM t WHERE id = 1")
+    snap = Snapshot(st.rm, st.tso, st.tso.next_ts())
+    assert snap.get(tablecodec.record_key(tid, 1)) is None
+    # old version still visible to an old read_ts? (MVCC keeps history)
+    assert snap.get(tablecodec.record_key(tid, 2)) is not None
+
+
+def test_conflicting_txns_percolator_detects(se):
+    """First-committer-wins via percolator write records."""
+    a = Session(se.storage, cop=se.cop)
+    b = Session(se.storage, cop=se.cop)
+    a.execute("BEGIN")
+    b.execute("BEGIN")
+    a.execute("UPDATE t SET v = 100 WHERE id = 1")
+    b.execute("UPDATE t SET v = 200 WHERE id = 1")
+    a.execute("COMMIT")
+    with pytest.raises(SQLError):
+        b.execute("COMMIT")
+    assert se.query("SELECT v FROM t WHERE id = 1") == [(100,)]
+
+
+def test_multi_table_commit_spans_regions(se):
+    """Each table owns a region; a two-table txn runs region-grouped 2PC
+    batches (primary first) and both folds stay consistent."""
+    se.execute("CREATE TABLE u (id INT PRIMARY KEY, w INT)")
+    st = se.storage
+    assert len(st.rm.regions()) >= 3  # boot + per-table splits
+    se.execute("BEGIN")
+    se.execute("INSERT INTO u VALUES (7, 70)")
+    se.execute("UPDATE t SET v = 11 WHERE id = 1")
+    se.execute("COMMIT")
+    assert se.query("SELECT w FROM u") == [(70,)]
+    assert se.query("SELECT v FROM t WHERE id = 1") == [(11,)]
+    # both tables' mutations are in the KV tier under one commit_ts
+    tid_t = st.catalog.table("test", "t").id
+    tid_u = st.catalog.table("test", "u").id
+    snap = Snapshot(st.rm, st.tso, st.tso.next_ts())
+    assert snap.get(tablecodec.record_key(tid_u, 7)) is not None
+    assert snap.get(tablecodec.record_key(tid_t, 1)) is not None
+
+
+def test_split_mid_transaction_retries(se):
+    """A region split between BEGIN and COMMIT invalidates cached routing;
+    the committer retries on RegionError and the txn still lands
+    (reference: region epoch-not-match retry, region_request.go:599)."""
+    st = se.storage
+    tid = st.catalog.table("test", "t").id
+    se.execute("BEGIN")
+    se.execute("INSERT INTO t VALUES (100, 1000), (200, 2000)")
+    # split the table's region between the two new handles mid-txn
+    st.rm.split(tablecodec.record_key(tid, 150))
+    se.execute("COMMIT")
+    assert se.query("SELECT v FROM t WHERE id IN (100, 200) ORDER BY id") \
+        == [(1000,), (2000,)]
+    # the two handles now live in different regions
+    r1 = st.rm.locate(tablecodec.record_key(tid, 100))
+    r2 = st.rm.locate(tablecodec.record_key(tid, 200))
+    assert r1.id != r2.id
+
+
+def test_concurrent_sessions_after_split(se):
+    """Concurrent committers across a fresh split: all commits land, and
+    the columnar fold equals the KV truth."""
+    st = se.storage
+    tid = st.catalog.table("test", "t").id
+    st.rm.split(tablecodec.record_key(tid, 1000))
+    errs = []
+
+    def worker(base):
+        try:
+            s = Session(st, cop=se.cop)
+            s.execute("USE test")
+            for i in range(10):
+                s.execute(
+                    f"INSERT INTO t VALUES ({base + i}, {base + i})")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(b,))
+               for b in (2000, 3000, 800)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    n = se.query("SELECT COUNT(*) FROM t")[0][0]
+    assert n == 3 + 30
+    # spot-check fold == KV truth
+    snap = Snapshot(st.rm, st.tso, st.tso.next_ts())
+    for h in (2000, 3005, 809):
+        assert snap.get(tablecodec.record_key(tid, h)) is not None

@@ -516,11 +516,14 @@ def _hc_body(frag, prepared, cols, mask):
             score_null = cnt == 0  # SUM/AVG over no valid rows is NULL
     signed = sv if hc.desc else -sv
     # MySQL NULL ordering: first in ASC, last in DESC. ASC -> +inf makes
-    # the NULL group a guaranteed candidate; DESC -> -inf is sound because
-    # a NULL-last group reaches the top-k only when the total group count
-    # is below the candidate cap (then every group is a candidate anyway)
+    # the NULL group a guaranteed candidate. DESC uses a FINITE floor
+    # (below any real sum, which is bounded by int64) so NULL groups still
+    # outrank non-start rows (-inf): group starts then always win the
+    # candidate slots, making "not all slots picked" a sound proof that
+    # every group is a candidate. Ties among several NULL groups at the
+    # floor are caught by the decode's strict-gap boundary check.
     signed = jnp.where(score_null,
-                       jnp.float32(-np.inf if hc.desc else np.inf), signed)
+                       jnp.float32(-1e38 if hc.desc else np.inf), signed)
     score = jnp.where(is_start & valid, signed, -jnp.inf)
 
     k_cap = min(hc.cap, n)

@@ -1,26 +1,43 @@
-"""Timestamp oracle: monotonically increasing logical timestamps.
+"""Timestamp oracle: hybrid physical/logical timestamps.
 
 Single-process equivalent of PD's TSO service (reference:
 store/tikv/oracle/oracles/pd.go:77 for the PD-backed oracle,
-oracle/oracles/local.go for the single-node one). start_ts/commit_ts
-ordering is the basis of snapshot-isolation visibility in the MVCC store.
+oracle/oracles/local.go for the single-node one). Timestamps use PD's
+layout — physical milliseconds << 18 | logical counter — because the MVCC
+tier derives lock TTL expiry from `now_ts - lock_ts > ttl << 18`
+(reference: oracle.ExtractPhysical); a plain counter would make abandoned
+prewrite locks effectively immortal. start_ts/commit_ts ordering is the
+basis of snapshot-isolation visibility in the MVCC store.
 """
 
 from __future__ import annotations
 
 import threading
+import time
+
+_LOGICAL_BITS = 18
 
 
 class TimestampOracle:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._ts = 0
+        self._physical = 0
+        self._logical = 0
 
     def next_ts(self) -> int:
         with self._lock:
-            self._ts += 1
-            return self._ts
+            physical = int(time.time() * 1000)
+            if physical <= self._physical:
+                self._logical += 1
+            else:
+                self._physical = physical
+                self._logical = 0
+            return (self._physical << _LOGICAL_BITS) | self._logical
+
+    # the 2PC committer's oracle interface (kv/twopc.py TSO protocol)
+    def ts(self) -> int:
+        return self.next_ts()
 
     def current(self) -> int:
         with self._lock:
-            return self._ts
+            return (self._physical << _LOGICAL_BITS) | self._logical

@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from .mvcc import KeyIsLockedError, Mutation
+from .mvcc import KeyIsLockedError, KVError, Mutation
 from .region import Region, RegionError, RegionManager
 
 
@@ -94,13 +94,19 @@ class TwoPhaseCommitter:
             lambda region: self.rm.commit(region, [primary], start_ts,
                                           commit_ts))
         # secondaries may commit lazily; do them inline (the reference
-        # fires a goroutine — same semantics, resolver covers crashes)
+        # fires a goroutine — same semantics, resolver covers crashes).
+        # IMPORTANT: the txn is already durable — a secondary failure must
+        # NOT surface as a commit failure (the lock resolver rolls the
+        # stragglers forward from the committed primary)
         rest = [m.key for m in mutations if m.key != primary]
         for key in rest:
-            self._retry_region(
-                key, resolver,
-                lambda region, k=key: self.rm.commit(
-                    region, [k], start_ts, commit_ts))
+            try:
+                self._retry_region(
+                    key, resolver,
+                    lambda region, k=key: self.rm.commit(
+                        region, [k], start_ts, commit_ts))
+            except (CommitError, KVError):
+                pass  # resolver recovers from the primary's write record
         return commit_ts
 
     def rollback(self, mutations: list[Mutation], start_ts: int) -> None:

@@ -471,12 +471,18 @@ def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
         if isinstance(below, PhysHashAgg) and below.mode == "complete":
             rewritten = _match_agg_fragment(below, allow_single=True)
             if rewritten is not None:
-                _attach_hc(plan, sort_node, proj, below, rewritten)
-                if proj is not None:
-                    proj.children = [rewritten]
-                else:
-                    sort_node.children = [rewritten]
-                return plan
+                attached = _attach_hc(plan, sort_node, proj, below,
+                                      rewritten)
+                single = len(rewritten.children[0].frag.tables) == 1
+                if attached or not single:
+                    # a join fragment is worthwhile on its own; the
+                    # degenerate single-table fragment only serves the hc
+                    # hint — keep the original plan if it didn't attach
+                    if proj is not None:
+                        proj.children = [rewritten]
+                    else:
+                        sort_node.children = [rewritten]
+                    return plan
         if isinstance(below, PhysHashAgg) and below.mode == "final" and \
                 len(below.children) == 1 and \
                 isinstance(below.children[0], PhysTableRead):

@@ -1,11 +1,20 @@
-"""Storage: the single-node transactional store over per-table MVCC stores.
+"""Storage: the transactional store — percolator KV truth + columnar cache.
 
-Plays the role of the reference's `kv.Storage` + embedded unistore (reference:
-kv/kv.go:462, store/mockstore/unistore.go) for the dev/test topology, and of
-the txn coordinator (store/tikv/2pc.go) reduced to its single-node core:
-optimistic snapshot-isolation transactions with first-committer-wins
-write-conflict detection at commit. The distributed 2PC/percolator protocol
-slots in behind the same Transaction surface once multi-node exists.
+Plays the role of the reference's `kv.Storage` + embedded unistore
+(reference: kv/kv.go:462, store/mockstore/unistore.go). There is ONE
+transaction path: commits run the percolator two-phase protocol through
+the region tier (TwoPhaseCommitter over RegionManager over MVCCStore,
+mirroring session/session.go:573 -> store/tikv/2pc.go:78), with the C++
+ordered-KV engine as the substrate when available. Each table owns its
+region (register_table splits at the table prefix, the create-table
+split-region analog, ddl/split_region.go), so multi-table transactions
+exercise region-grouped batches and RegionError retries for real.
+
+The per-table column epochs (TableStore) are the COPROCESSOR-FACING fold
+of the same committed data — applied under the commit lock immediately
+after the percolator commit lands, the way TiFlash folds the raft log into
+its delta tree. Snapshots read the columnar fold; the KV tier holds the
+write-ahead truth (locks, write records, versioned values).
 """
 
 from __future__ import annotations
@@ -14,13 +23,35 @@ import threading
 from typing import Any, Optional
 
 from ..catalog.schema import Catalog, TableInfo
+from ..kv import codec, tablecodec
 from ..kv.memdb import MemDB, TOMBSTONE
+from ..kv.mvcc import (
+    KVError,
+    MVCCStore,
+    Mutation,
+    OP_DEL,
+    OP_PUT,
+    WriteConflictError as KVWriteConflict,
+)
+from ..kv.region import RegionManager
 from ..kv.tso import TimestampOracle
+from ..kv.twopc import CommitError, TwoPhaseCommitter
 from .table_store import TableSnapshot, TableStore
 
 
 class WriteConflictError(Exception):
     """Another txn committed to a key after our start_ts (optimistic SI)."""
+
+
+def _make_engine():
+    """C++ ordered-KV engine when buildable, pure-python twin otherwise."""
+    try:
+        from ..kv.native import NativeOrderedKV, native_available
+        if native_available():
+            return NativeOrderedKV()
+    except Exception:
+        pass
+    return None
 
 
 class Storage:
@@ -31,6 +62,10 @@ class Storage:
         self.tso = TimestampOracle()
         self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
+        # the transactional KV truth: percolator MVCC over regions
+        self.kv = MVCCStore(engine=_make_engine())
+        self.rm = RegionManager(self.kv)
+        self.committer = TwoPhaseCommitter(self.rm, self.tso)
         # DDL job queue + history (the meta-KV DDLJobList analog,
         # reference meta/meta.go:571) — lives on storage so a replacement
         # worker resumes pending jobs with their reorg checkpoints
@@ -45,6 +80,12 @@ class Storage:
     def register_table(self, info: TableInfo) -> TableStore:
         store = TableStore(info)
         self.tables[info.id] = store
+        # one region per table (reference: split-table-region on create,
+        # ddl/split_region.go) — multi-table commits become multi-region
+        try:
+            self.rm.split(tablecodec.table_prefix(info.id))
+        except ValueError:
+            pass  # split point already a region boundary
         return store
 
     def unregister_table(self, table_id: int) -> None:
@@ -80,11 +121,20 @@ class Storage:
         return Transaction(self, self.acquire_snapshot_ts())
 
     def commit(self, txn: "Transaction") -> int:
-        """Conflict-check + apply. Single commit lock = the degenerate,
-        correct form of region-grouped parallel 2PC (2pc.go:616)."""
+        """THE commit path: schema fence -> percolator 2PC through the
+        region tier -> columnar fold. One source of truth (the KV write
+        records), one fold (the epochs the coprocessor reads)."""
         mutations = txn.memdb.mutations()
         if not mutations:
             return txn.start_ts
+        kv_muts = []
+        for (table_id, handle), row in mutations.items():
+            key = tablecodec.record_key(table_id, handle)
+            if row is TOMBSTONE:
+                kv_muts.append(Mutation(OP_DEL, key))
+            else:
+                kv_muts.append(Mutation(OP_PUT, key,
+                                        codec.encode_key(list(row))))
         with self._commit_lock:
             for table_id, token in txn.schema_tokens.items():
                 store = self.tables.get(table_id)
@@ -94,15 +144,17 @@ class Storage:
                     raise WriteConflictError(
                         "Information schema is changed during the execution "
                         "of the statement; try again")
-            for (table_id, handle), _ in mutations.items():
-                store = self.tables.get(table_id)
-                if store is None:
-                    continue  # table dropped mid-txn; DDL wins
-                if store.latest_commit_ts(handle) > txn.start_ts:
-                    raise WriteConflictError(
-                        f"write conflict on table {table_id} handle {handle}"
-                    )
-            commit_ts = self.tso.next_ts()
+            try:
+                commit_ts = self.committer.commit(kv_muts, txn.start_ts)
+            except KVWriteConflict as e:
+                self._best_effort_rollback(kv_muts, txn.start_ts)
+                raise WriteConflictError(str(e)) from None
+            except (KVError, CommitError) as e:
+                self._best_effort_rollback(kv_muts, txn.start_ts)
+                raise WriteConflictError(f"commit failed: {e}") from None
+            # columnar fold of the committed mutations (the coprocessor's
+            # read view) — inside the lock so no snapshot can observe the
+            # KV commit without the fold
             for (table_id, handle), row in mutations.items():
                 store = self.tables.get(table_id)
                 if store is not None:
@@ -114,6 +166,14 @@ class Storage:
             if store is not None:
                 store.maybe_compact(min(safe, commit_ts - 1) if safe else 0)
         return commit_ts
+
+    def _best_effort_rollback(self, kv_muts, start_ts: int) -> None:
+        """Clear any prewrite locks a failed commit left behind (the lock
+        resolver would also reclaim them by TTL — this is just prompt)."""
+        try:
+            self.committer.rollback(kv_muts, start_ts)
+        except Exception:
+            pass
 
     def flush(self) -> None:
         """Fold all committed deltas into base epochs (test/bench helper)."""
