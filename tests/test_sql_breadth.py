@@ -1,0 +1,255 @@
+"""UNION, window functions, prepared statements (VERDICT SQL breadth).
+
+Window results are differentially checked against sqlite (which
+implements standard window semantics); prepared statements run through
+the real wire protocol with binary parameter encoding — the reference's
+server/conn_stmt.go surface.
+"""
+
+import socket
+import sqlite3
+import struct
+import time
+
+import pytest
+
+from tidb_tpu.server.server import Server
+from tidb_tpu.session import Session, SQLError
+
+
+# ==================== UNION ====================
+
+@pytest.fixture
+def uni():
+    s = Session()
+    s.execute("CREATE TABLE a (x INT, s VARCHAR(5))")
+    s.execute("CREATE TABLE b (y DECIMAL(6,2), t VARCHAR(5))")
+    s.execute("INSERT INTO a VALUES (1,'p'),(2,'q'),(2,'q')")
+    s.execute("INSERT INTO b VALUES (2.50,'q'),(3.00,'r'),(2.00,'q')")
+    return s
+
+
+def test_union_all(uni):
+    got = uni.query("SELECT x FROM a UNION ALL SELECT y FROM b ORDER BY 1")
+    assert [str(v[0]) for v in got] == [
+        "1.00", "2.00", "2.00", "2.00", "2.50", "3.00"]
+
+
+def test_union_distinct(uni):
+    got = uni.query("SELECT x, s FROM a UNION SELECT y, t FROM b ORDER BY 1")
+    assert [(str(a), b) for a, b in got] == [
+        ("1.00", "p"), ("2.00", "q"), ("2.50", "q"), ("3.00", "r")]
+
+
+def test_union_order_limit(uni):
+    got = uni.query(
+        "SELECT x FROM a UNION ALL SELECT y FROM b ORDER BY x DESC LIMIT 2")
+    assert [str(v[0]) for v in got] == ["3.00", "2.50"]
+
+
+def test_union_string_dictionaries_merge(uni):
+    got = uni.query("SELECT s FROM a UNION SELECT t FROM b ORDER BY s")
+    assert [v[0] for v in got] == ["p", "q", "r"]
+
+
+def test_union_column_count_mismatch(uni):
+    with pytest.raises(SQLError, match="number of columns"):
+        uni.query("SELECT x, s FROM a UNION SELECT y FROM b")
+
+
+def test_union_in_derived_table(uni):
+    got = uni.query(
+        "SELECT COUNT(*) FROM (SELECT s FROM a UNION SELECT t FROM b) u")
+    assert got == [(3,)]
+
+
+# ==================== window functions ====================
+
+@pytest.fixture
+def wdata():
+    s = Session()
+    s.execute("CREATE TABLE w (g VARCHAR(3), x INT, v INT)")
+    rows = [("a", 1, 10), ("a", 2, 5), ("a", 2, 1), ("b", 5, 2),
+            ("b", 1, 7), ("a", 9, None), ("c", 4, 4)]
+    s.execute("INSERT INTO w VALUES " + ",".join(
+        f"('{g}',{x},{'NULL' if v is None else v})" for g, x, v in rows))
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE w (g TEXT, x INT, v INT)")
+    conn.executemany("INSERT INTO w VALUES (?,?,?)", rows)
+    return s, conn
+
+
+WINDOW_QUERIES = [
+    "SELECT g, x, ROW_NUMBER() OVER (PARTITION BY g ORDER BY x) AS rn "
+    "FROM w ORDER BY g, x, rn",
+    "SELECT g, x, RANK() OVER (PARTITION BY g ORDER BY x) AS r, "
+    "DENSE_RANK() OVER (PARTITION BY g ORDER BY x) AS dr "
+    "FROM w ORDER BY g, x, r",
+    "SELECT g, x, SUM(v) OVER (PARTITION BY g ORDER BY x) AS s "
+    "FROM w ORDER BY g, x, s",
+    "SELECT g, x, COUNT(v) OVER (PARTITION BY g) AS c "
+    "FROM w ORDER BY g, x, c",
+    "SELECT g, x, MIN(v) OVER (PARTITION BY g ORDER BY x) AS m, "
+    "MAX(v) OVER (PARTITION BY g) AS mx FROM w ORDER BY g, x, m",
+    "SELECT x, LAG(x) OVER (ORDER BY x, v) AS lg, "
+    "LEAD(x) OVER (ORDER BY x, v) AS ld FROM w ORDER BY x, lg",
+    "SELECT g, x, FIRST_VALUE(x) OVER (PARTITION BY g ORDER BY x) AS fv, "
+    "LAST_VALUE(x) OVER (PARTITION BY g ORDER BY x) AS lv "
+    "FROM w ORDER BY g, x, fv",
+    "SELECT g, AVG(v) OVER (PARTITION BY g) AS av FROM w ORDER BY g, av",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(WINDOW_QUERIES)))
+def test_window_vs_sqlite(wdata, qi):
+    s, conn = wdata
+    sql = WINDOW_QUERIES[qi]
+    got = s.query(sql)
+    want = conn.execute(sql).fetchall()
+    def norm(v):
+        # MySQL AVG over INT yields DECIMAL(scale 4); sqlite yields float —
+        # compare at the coarser precision
+        if v is None or isinstance(v, str):
+            return v
+        return round(float(str(v)), 4)
+
+    norm_got = [tuple(norm(v) for v in r) for r in got]
+    norm_want = [tuple(norm(v) for v in r) for r in want]
+    assert norm_got == norm_want, f"{sql}\n got {norm_got}\nwant {norm_want}"
+
+
+def test_window_in_expression(wdata):
+    s, _ = wdata
+    got = s.query(
+        "SELECT x, ROW_NUMBER() OVER (ORDER BY x, v) + 100 AS rn "
+        "FROM w ORDER BY rn")
+    assert [r[1] for r in got] == list(range(101, 108))
+
+
+# ==================== prepared statements (wire protocol) ====================
+
+def _connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+
+    def rd():
+        hdr = b""
+        while len(hdr) < 4:
+            hdr += s.recv(4 - len(hdr))
+        ln = int.from_bytes(hdr[:3], "little")
+        d = b""
+        while len(d) < ln:
+            d += s.recv(ln - len(d))
+        return hdr[3], d
+
+    def wr(seq, payload):
+        s.sendall(len(payload).to_bytes(3, "little") + bytes([seq])
+                  + payload)
+
+    seq, _ = rd()
+    caps = 0x200 | 0x8000 | 0x80000 | 0x8
+    wr(seq + 1, struct.pack("<IIB23x", caps, 1 << 24, 33)
+       + b"root\x00\x00\x00")
+    rd()
+    return s, rd, wr
+
+
+@pytest.fixture
+def wire():
+    srv = Server(host="127.0.0.1", port=0)
+    srv.start()
+    time.sleep(0.2)
+    port = srv.port
+    sock, rd, wr = _connect(port)
+
+    def cmd(payload):
+        wr(0, payload)
+
+    for q in (b"\x03CREATE DATABASE pw", b"\x03USE pw",
+              b"\x03CREATE TABLE t (a INT, b VARCHAR(8), c DECIMAL(6,2))",
+              b"\x03INSERT INTO t VALUES (1,'x',1.50),(2,'y',2.75),"
+              b"(3,'z',3.00)"):
+        cmd(q)
+        rd()
+    yield cmd, rd
+    sock.close()
+    srv.close()
+
+
+def test_stmt_prepare_execute_binary(wire):
+    cmd, rd = wire
+    cmd(b"\x16SELECT a, b, c FROM t WHERE a >= ? ORDER BY a")
+    _, ok = rd()
+    assert ok[0] == 0
+    stmt_id, ncols, nparams = struct.unpack_from("<IHH", ok, 1)
+    assert nparams == 1
+    for _ in range(nparams + 1):
+        rd()  # param defs + eof
+    payload = (b"\x17" + struct.pack("<IBI", stmt_id, 0, 1) + b"\x00\x01"
+               + struct.pack("<BBq", 8, 0, 2))  # LONGLONG a=2
+    cmd(payload)
+    pkts, eofs = [], 0
+    while eofs < 2:
+        _, d = rd()
+        assert d[0] != 0xFF, d[3:]
+        if d[0] == 0xFE and len(d) < 9:
+            eofs += 1
+            continue
+        pkts.append(d)
+    ncols_pkt = pkts[0][0]
+    rows = pkts[1 + ncols_pkt:]
+    assert len(rows) == 2
+    decoded = []
+    for r in rows:
+        a = struct.unpack_from("<i", r, 2)[0]  # INT advertises 4-byte LONG
+        pos = 6
+        blen = r[pos]
+        b = r[pos + 1:pos + 1 + blen].decode()
+        pos += 1 + blen
+        clen = r[pos]
+        c = r[pos + 1:pos + 1 + clen].decode()
+        decoded.append((a, b, c))
+    assert decoded == [(2, "y", "2.75"), (3, "z", "3.00")]
+
+
+def test_stmt_rebind_types_persist(wire):
+    cmd, rd = wire
+    cmd(b"\x16SELECT COUNT(*) FROM t WHERE a = ?")
+    _, ok = rd()
+    stmt_id = struct.unpack_from("<I", ok, 1)[0]
+    for _ in range(2):
+        rd()
+
+    def execute(val, new_bound):
+        p = b"\x17" + struct.pack("<IBI", stmt_id, 0, 1) + b"\x00"
+        if new_bound:
+            p += b"\x01" + struct.pack("<BB", 8, 0)
+        else:
+            p += b"\x00"
+        p += struct.pack("<q", val)
+        cmd(p)
+        cnt = None
+        eofs = 0
+        while eofs < 2:
+            _, d = rd()
+            assert d[0] != 0xFF, d[3:]
+            if d[0] == 0xFE and len(d) < 9:
+                eofs += 1
+                continue
+            if d[0] == 0x00 and len(d) > 2:
+                cnt = struct.unpack_from("<q", d, 2)[0]
+        return cnt
+
+    assert execute(2, True) == 1
+    # second execute reuses the bound types (new-params-bound = 0)
+    assert execute(9, False) == 0
+
+
+def test_stmt_close_frees(wire):
+    cmd, rd = wire
+    cmd(b"\x16SELECT 1")
+    _, ok = rd()
+    stmt_id = struct.unpack_from("<I", ok, 1)[0]
+    cmd(b"\x19" + struct.pack("<I", stmt_id))  # close: no response
+    cmd(b"\x17" + struct.pack("<IBI", stmt_id, 0, 1))
+    _, d = rd()
+    assert d[0] == 0xFF  # unknown prepared statement handler

@@ -32,6 +32,8 @@ from ..plan.physical import (
     PhysProjection,
     PhysSelection,
     PhysSort,
+    PhysUnion,
+    PhysWindow,
     PhysTableRead,
     PhysicalPlan,
 )
@@ -107,6 +109,10 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         return Chunk.concat(result.chunks)
     if isinstance(plan, PhysPointGet):
         return _run_point_get(plan, ctx)
+    if isinstance(plan, PhysUnion):
+        return _run_union(plan, ctx)
+    if isinstance(plan, PhysWindow):
+        return _run_window(plan, ctx)
     if isinstance(plan, PhysSelection):
         child = run_physical(plan.children[0], ctx)
         ev = _evaluator(child)
@@ -205,6 +211,276 @@ def _evaluator(chunk: Chunk) -> NumpyEval:
     cols = [(c.data, c.validity) for c in chunk.columns]
     dicts = [c.dictionary for c in chunk.columns]
     return NumpyEval(cols, dicts, chunk.num_rows)
+
+
+# ==================== union ====================
+
+def _run_union(plan: "PhysUnion", ctx: ExecContext) -> Chunk:
+    """UNION ALL: normalize each child chunk to the unified schema and
+    concatenate (reference: executor union over children; DISTINCT is the
+    aggregation the planner placed above)."""
+    from ..chunk.column import Dictionary
+
+    out_fields = plan.schema.fields
+    shared_dicts = [Dictionary() if f.ftype.is_string else None
+                    for f in out_fields]
+    pieces: list[Chunk] = []
+    for child in plan.children:
+        chunk = run_physical(child, ctx)
+        cols = []
+        for i, f in enumerate(out_fields):
+            src = chunk.columns[i] if i < len(chunk.columns) else None
+            cols.append(_normalize_union_col(src, f.ftype, shared_dicts[i]))
+        pieces.append(Chunk(cols))
+    return Chunk.concat(pieces)
+
+
+def _normalize_union_col(src, ft, shared_dict):
+    """Convert a child column to the union's result type: decimal rescale,
+    integer/float widening, dictionary re-encode into the shared dict."""
+    if src is None:
+        return Column(ft, np.empty(0, ft.np_dtype), None, shared_dict)
+    data = src.data
+    valid = src.validity
+    if ft.is_string:
+        # re-encode through the shared dictionary so codes unify
+        if src.dictionary is not None:
+            remap = np.fromiter(
+                (shared_dict.encode(v) for v in src.dictionary.values),
+                dtype=np.int32, count=len(src.dictionary))
+            codes = remap[data] if len(remap) else np.zeros(len(data),
+                                                           np.int32)
+        else:
+            codes = data.astype(np.int32)
+        return Column(ft, codes, None if valid.all() else valid,
+                      shared_dict)
+    if ft.is_decimal:
+        sscale = src.ftype.scale if src.ftype.is_decimal else 0
+        d = data.astype(np.int64)
+        if sscale < ft.scale:
+            d = d * (10 ** (ft.scale - sscale))
+        return Column(ft, d, None if valid.all() else valid)
+    if ft.is_float:
+        d = data.astype(np.float64)
+        if src.ftype.is_decimal:
+            d = d / (10 ** src.ftype.scale)
+        return Column(ft, d, None if valid.all() else valid)
+    return Column(ft, data.astype(ft.np_dtype),
+                  None if valid.all() else valid)
+
+
+# ==================== window functions ====================
+
+def _run_window(plan: PhysWindow, ctx: ExecContext) -> Chunk:
+    """Window computation over the child chunk (reference:
+    executor/window.go): per item, sort by (partition, order keys),
+    compute vectorized running/whole-partition values, scatter back to the
+    original row order. Default frame semantics: with ORDER BY the value
+    is cumulative with peers sharing results (RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW); without, the whole partition."""
+    child = run_physical(plan.children[0], ctx)
+    n = child.num_rows
+    ev = _evaluator(child)
+    out_cols = list(child.columns)
+    for item, f in zip(plan.items,
+                       plan.schema.fields[len(child.columns):]):
+        data, valid = _window_values(item, f.ftype, child, ev, n, ctx)
+        dictionary = None
+        if f.ftype.is_string:
+            # value-propagating funcs over a string column carry its
+            # dictionary (builder gates out other string-typed windows)
+            arg0 = item.args[0] if item.args else None
+            if isinstance(arg0, Col):
+                dictionary = child.columns[arg0.idx].dictionary
+        out_cols.append(Column(f.ftype, data,
+                               None if valid is None or valid.all()
+                               else valid, dictionary))
+    return Chunk(out_cols)
+
+
+def _window_sort_keys(item, child, ev, n):
+    """lexsort keys: order keys (last = primary is partition)."""
+    keys = []
+    for e, desc in reversed(item.order):
+        v, vl = ev.eval(e)
+        v = np.asarray(v)
+        vl = np.asarray(vl)
+        if e.ftype.is_string and isinstance(e, Col):
+            d = child.columns[e.idx].dictionary
+            if d is not None and len(d):
+                ranks = d.sort_ranks()
+                v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
+        if np.issubdtype(v.dtype, np.floating):
+            key = np.where(vl, v.astype(np.float64), -np.inf)
+        else:
+            key = np.where(vl, v.astype(np.int64), _NULL_KEY + 1)
+        keys.append(-key if desc else key)
+    return keys
+
+
+def _window_values(item, out_t, child, ev, n, ctx):
+    # partition ids
+    if item.partition:
+        pcols = []
+        for e in item.partition:
+            v, vl = ev.eval(e)
+            pcols.append((np.asarray(v), np.asarray(vl)))
+        pid, _ = _group_ids(pcols, n)
+    else:
+        pid = np.zeros(n, np.int64)
+    okeys = _window_sort_keys(item, child, ev, n)
+    order = np.lexsort(tuple(okeys) + (pid,)) if (okeys or n) else         np.arange(n)
+    pid_s = pid[order]
+    iota = np.arange(n, dtype=np.int64)
+    starts = np.r_[True, pid_s[1:] != pid_s[:-1]] if n else         np.zeros(0, bool)
+    pstart = np.maximum.accumulate(np.where(starts, iota, 0)) if n else iota
+
+    # peer groups: same partition AND same order-key values
+    if item.order and n:
+        peer_start = starts.copy()
+        for k in okeys:
+            ks = k[order]
+            peer_start |= np.r_[True, ks[1:] != ks[:-1]]
+    else:
+        peer_start = starts.copy() if n else starts
+
+    def last_of_peer():
+        """index of the last row of each row's peer group (sorted order);
+        without ORDER BY, the last row of the partition."""
+        if n == 0:
+            return iota
+        boundary = peer_start if item.order else starts
+        nxt = np.where(boundary, iota, n)
+        nxt = np.r_[nxt[1:], n]
+        nxt = np.minimum.accumulate(nxt[::-1])[::-1]
+        return np.minimum(nxt - 1, n - 1)
+
+    name = item.func
+    valid_out = None
+    if name == "ROW_NUMBER":
+        vals = (iota - pstart + 1).astype(np.int64)
+    elif name == "RANK":
+        first_peer = np.maximum.accumulate(
+            np.where(peer_start, iota, 0)) if n else iota
+        vals = (first_peer - pstart + 1).astype(np.int64)
+    elif name == "DENSE_RANK":
+        cp = np.cumsum(peer_start) if n else iota
+        cp_at_start = cp[pstart] if n else cp
+        vals = (cp - cp_at_start + 1).astype(np.int64)
+    elif name in ("LEAD", "LAG"):
+        av, avl = ev.eval(item.args[0])
+        av = np.asarray(av)[order]
+        avl = np.asarray(avl)[order]
+        off = 1
+        if len(item.args) > 1:
+            off = int(_const_of(item.args[1]))
+        src = iota + (off if name == "LEAD" else -off)
+        ok = (src >= 0) & (src < n)
+        src_c = np.clip(src, 0, max(n - 1, 0))
+        ok &= pid_s[src_c] == pid_s  # stay inside the partition
+        vals = np.where(ok, av[src_c], 0)
+        valid_s = np.where(ok, avl[src_c], False)
+        if len(item.args) > 2:  # explicit default
+            dv = _const_of(item.args[2])
+            if dv is not None:
+                if isinstance(dv, str):
+                    arg0 = item.args[0]
+                    d = child.columns[arg0.idx].dictionary
+                    dv = d.encode(dv) if d is not None else 0
+                vals = np.where(ok, vals, dv)
+                valid_s = valid_s | ~ok
+        vals, valid_out = vals, valid_s
+    elif name in ("FIRST_VALUE", "LAST_VALUE"):
+        av, avl = ev.eval(item.args[0])
+        av = np.asarray(av)[order]
+        avl = np.asarray(avl)[order]
+        idx = pstart if name == "FIRST_VALUE" else last_of_peer()
+        vals = av[idx]
+        valid_out = avl[idx]
+    else:  # SUM / COUNT / AVG / MIN / MAX
+        func = name.lower()
+        if item.args:
+            av, avl = ev.eval(item.args[0])
+            av = np.asarray(av)[order]
+            avl = np.asarray(avl)[order]
+        else:  # COUNT(*)
+            av = np.ones(n, np.int64)
+            avl = np.ones(n, bool)
+        running = bool(item.order)
+        cnts = _seg_cum(avl.astype(np.int64), starts, pstart, running)
+        if func == "count":
+            vals = cnts[last_of_peer()] if running and n else cnts
+        elif func in ("sum", "avg"):
+            if np.issubdtype(av.dtype, np.floating):
+                masked = np.where(avl, av, 0.0)
+            else:
+                masked = np.where(avl, av.astype(np.int64), 0)
+            sums = _seg_cum(masked, starts, pstart, running)
+            if running and n:
+                lp = last_of_peer()
+                sums = sums[lp]
+                cnts = cnts[lp]
+            if func == "sum":
+                vals = sums
+                valid_out = cnts > 0
+            else:
+                col = _avg_column(
+                    AggDesc("avg", item.args[0], out_t, False, ""),
+                    out_t, sums, cnts)
+                vals = col.data
+                valid_out = col.validity
+        else:  # min / max — running needs a segmented scan
+            red = np.minimum if func == "min" else np.maximum
+            if np.issubdtype(av.dtype, np.floating):
+                sent = np.inf if func == "min" else -np.inf
+                masked = np.where(avl, av, sent)
+            else:
+                sent = np.iinfo(np.int64).max if func == "min" else                     np.iinfo(np.int64).min
+                masked = np.where(avl, av.astype(np.int64), sent)
+            if running and n:
+                vals = masked.copy()
+                # segmented running reduce per partition slice
+                bounds = np.nonzero(starts)[0]
+                for b, e in zip(bounds, np.r_[bounds[1:], n]):
+                    vals[b:e] = red.accumulate(masked[b:e])
+                vals = vals[last_of_peer()]
+            else:
+                bounds = np.nonzero(starts)[0] if n else                     np.zeros(0, np.int64)
+                totals = red.reduceat(masked, bounds) if n else masked
+                seg = np.cumsum(starts) - 1 if n else iota
+                vals = totals[seg] if n else masked
+            valid_out = cnts[last_of_peer()] > 0 if running and n                 else (cnts > 0)
+            vals = np.where(valid_out, vals, 0)
+
+    out = np.zeros(n, dtype=out_t.np_dtype)
+    out[order] = vals.astype(out_t.np_dtype)
+    if valid_out is None:
+        return out, None
+    vo = np.zeros(n, bool)
+    vo[order] = valid_out
+    return out, vo
+
+
+def _seg_cum(vals, starts, pstart, running):
+    """Per-partition cumulative (running) or total (not) sums."""
+    n = len(vals)
+    if n == 0:
+        return vals
+    cum = np.cumsum(vals)
+    run = cum - cum[pstart] + vals[pstart]
+    if running:
+        return run
+    # whole-partition totals: value of the run at the partition's last row
+    bounds = np.nonzero(starts)[0]
+    last = np.r_[bounds[1:], n] - 1
+    seg = np.cumsum(starts) - 1
+    return run[last][seg]
+
+
+def _const_of(e):
+    if isinstance(e, Const):
+        return e.value
+    raise ValueError("LEAD/LAG offset and default must be literals")
 
 
 # ==================== aggregation ====================

@@ -71,7 +71,9 @@ class PlanBuilder:
         self.current_db = current_db
 
     # ==================== SELECT ====================
-    def build_select(self, stmt: ast.SelectStmt) -> LogicalPlan:
+    def build_select(self, stmt) -> LogicalPlan:
+        if isinstance(stmt, ast.SetOpStmt):
+            return self._build_set_op(stmt)
         if stmt.from_ is None:
             plan = self._build_dual(stmt)
         else:
@@ -99,6 +101,9 @@ class PlanBuilder:
         else:
             if stmt.having is not None:
                 raise PlanError("HAVING without aggregation/group-by")
+            if any(f.expr is not None and _contains_window(f.expr)
+                   for f in stmt.fields):
+                plan = self._build_windows(stmt, plan)
             plan = self._build_projection(stmt, plan)
 
         if stmt.distinct:
@@ -107,6 +112,50 @@ class PlanBuilder:
         if stmt.order_by:
             plan = self._build_sort(stmt, plan)
 
+        if stmt.limit is not None or stmt.offset:
+            limit = stmt.limit if stmt.limit is not None else 2**62
+            plan = LogicalLimit(limit, stmt.offset, plan.schema, [plan])
+        return plan
+
+    def _build_set_op(self, stmt: ast.SetOpStmt) -> LogicalPlan:
+        """Fold UNION [ALL] left to right; DISTINCT steps dedupe everything
+        accumulated so far (MySQL cumulative-distinct semantics)."""
+        from .logical import LogicalUnion
+
+        plan = self.build_select(stmt.selects[0])
+        for sel, is_all in zip(stmt.selects[1:], stmt.alls):
+            right = self.build_select(sel)
+            if len(right.schema) != len(plan.schema):
+                raise PlanError(
+                    "The used SELECT statements have a different number "
+                    "of columns")
+            fields = []
+            for lf, rf in zip(plan.schema.fields, right.schema.fields):
+                fields.append(ResultField(
+                    lf.name, _union_ftype(lf.ftype, rf.ftype)))
+            plan = LogicalUnion(PlanSchema(fields), [plan, right])
+            if not is_all:
+                plan = self._build_distinct(plan)
+        if stmt.order_by:
+            items = []
+            for item in stmt.order_by:
+                e = item.expr
+                pe = None
+                if isinstance(e, ast.Literal) and e.tag == "int":
+                    k = int(e.value)
+                    if not (1 <= k <= len(plan.schema)):
+                        raise PlanError(
+                            f"ORDER BY position {k} out of range")
+                    pe = Col(k - 1, plan.schema.fields[k - 1].ftype)
+                elif isinstance(e, ast.ColumnRef) and e.table is None:
+                    idx = plan.schema.resolve(e.name)
+                    if idx is not None:
+                        pe = Col(idx, plan.schema.fields[idx].ftype, e.name)
+                if pe is None:
+                    raise PlanError(
+                        "UNION ORDER BY must reference output columns")
+                items.append((pe, item.desc))
+            plan = LogicalSort(items, plan.schema, [plan])
         if stmt.limit is not None or stmt.offset:
             limit = stmt.limit if stmt.limit is not None else 2**62
             plan = LogicalLimit(limit, stmt.offset, plan.schema, [plan])
@@ -418,6 +467,95 @@ class PlanBuilder:
             if not out:
                 raise PlanError("wildcard expanded to no columns")
         return out
+
+    _WINDOW_ONLY = {"ROW_NUMBER", "RANK", "DENSE_RANK", "LEAD", "LAG",
+                    "FIRST_VALUE", "LAST_VALUE"}
+
+    def _build_windows(self, stmt: ast.SelectStmt,
+                       child: LogicalPlan) -> LogicalPlan:
+        """Plan window computations between the row source and the final
+        projection (reference: planner/core buildWindowFunctions;
+        executor/window.go). Each distinct windowed call appends one
+        "__win#i" column; the select fields are rewritten to reference it.
+        Default frames only."""
+        from .logical import LogicalWindow, WindowItem
+
+        schema = child.schema
+        items: list[WindowItem] = []
+        keys: dict[str, int] = {}
+        for f in stmt.fields:
+            if f.expr is None:
+                continue
+            for call in _find_windows(f.expr):
+                k = ast_key(call)
+                if k in keys:
+                    continue
+                name = call.name
+                args = [self.resolve(a, schema) for a in call.args]
+                if name in ("ROW_NUMBER", "RANK", "DENSE_RANK"):
+                    if args:
+                        raise PlanError(f"{name}() takes no arguments")
+                    ftype = FieldType(TypeKind.BIGINT, nullable=False)
+                elif name in ("LEAD", "LAG"):
+                    if not 1 <= len(args) <= 3:
+                        raise PlanError(f"{name} takes 1-3 arguments")
+                    if args[0].ftype.is_string and \
+                            not isinstance(args[0], Col):
+                        raise PlanError(
+                            f"{name} over computed strings unsupported")
+                    ftype = FieldType(args[0].ftype.kind,
+                                      flen=args[0].ftype.flen,
+                                      scale=args[0].ftype.scale)
+                elif name in ("FIRST_VALUE", "LAST_VALUE"):
+                    if len(args) != 1:
+                        raise PlanError(f"{name} takes one argument")
+                    if args[0].ftype.is_string and \
+                            not isinstance(args[0], Col):
+                        raise PlanError(
+                            f"{name} over computed strings unsupported")
+                    ftype = FieldType(args[0].ftype.kind,
+                                      flen=args[0].ftype.flen,
+                                      scale=args[0].ftype.scale)
+                elif name.upper() in _AGG_NAMES:
+                    if call.distinct:
+                        # MySQL: DISTINCT is not allowed in window aggs
+                        raise PlanError(
+                            f"DISTINCT in window aggregate {name}")
+                    if call.is_star:
+                        args = []
+                    elif len(args) != 1:
+                        raise PlanError(f"{name} takes one argument")
+                    if args and args[0].ftype.is_string and \
+                            name.upper() != "COUNT":
+                        raise PlanError(
+                            f"window {name} over strings unsupported")
+                    ftype = agg_result_type(
+                        name.lower(), args[0] if args else None)
+                else:
+                    raise PlanError(f"unsupported window function {name}")
+                spec = call.window
+                part = [self.resolve(e, schema)
+                        for e in spec.partition_by]
+                order = [(self.resolve(it.expr, schema), it.desc)
+                         for it in spec.order_by]
+                keys[k] = len(items)
+                items.append(WindowItem(name, args, part, order, ftype))
+        if not items:
+            return child
+        fields = list(schema.fields) + [
+            ResultField(f"__win#{i}", it.ftype)
+            for i, it in enumerate(items)
+        ]
+        wplan = LogicalWindow(items, PlanSchema(fields), [child])
+        # rewrite the select fields: windowed calls -> __win#i refs
+        wmap = {k: ast.ColumnRef(f"__win#{i}") for k, i in keys.items()}
+        stmt.fields = [
+            ast.SelectField(
+                None if f.expr is None else _replace_windows(f.expr, wmap),
+                f.alias, f.wildcard_table)
+            for f in stmt.fields
+        ]
+        return wplan
 
     def _build_projection(
         self, stmt: ast.SelectStmt, child: LogicalPlan
@@ -917,13 +1055,75 @@ def _short_sql(e: ast.Expr) -> str:
     return type(e).__name__.lower()
 
 
+def _contains_window(e: ast.Expr) -> bool:
+    return any(True for _ in _find_windows(e))
+
+
+def _find_windows(e: ast.Expr):
+    if isinstance(e, ast.FuncCall) and e.window is not None:
+        yield e
+        return
+    for attr in ("left", "right", "operand", "low", "high", "pattern",
+                 "value", "else_expr"):
+        sub = getattr(e, attr, None)
+        if isinstance(sub, ast.Expr):
+            yield from _find_windows(sub)
+    for attr in ("args", "values", "when_thens"):
+        seq = getattr(e, attr, None)
+        if isinstance(seq, list):
+            for x in seq:
+                if isinstance(x, ast.Expr):
+                    yield from _find_windows(x)
+                elif isinstance(x, tuple):
+                    for y in x:
+                        if isinstance(y, ast.Expr):
+                            yield from _find_windows(y)
+
+
+def _replace_windows(e: ast.Expr, wmap: dict):
+    """Structurally replace windowed calls with their __win#i refs."""
+    import dataclasses as _dc
+
+    if isinstance(e, ast.FuncCall) and e.window is not None:
+        return wmap[ast_key(e)]
+    if not _dc.is_dataclass(e):
+        return e
+    changed = False
+    kwargs = {}
+    for fld in _dc.fields(e):
+        v = getattr(e, fld.name)
+        if isinstance(v, ast.Expr):
+            nv = _replace_windows(v, wmap)
+            changed |= nv is not v
+            kwargs[fld.name] = nv
+        elif isinstance(v, list):
+            nv = []
+            for x in v:
+                if isinstance(x, ast.Expr):
+                    y = _replace_windows(x, wmap)
+                    changed |= y is not x
+                    nv.append(y)
+                elif isinstance(x, tuple):
+                    ny = tuple(_replace_windows(z, wmap)
+                               if isinstance(z, ast.Expr) else z for z in x)
+                    changed |= ny != x
+                    nv.append(ny)
+                else:
+                    nv.append(x)
+            kwargs[fld.name] = nv
+        else:
+            kwargs[fld.name] = v
+    return type(e)(**kwargs) if changed else e
+
+
 def _contains_agg(e: ast.Expr) -> bool:
     return any(True for _ in _find_aggs(e))
 
 
 def _find_aggs(e: ast.Expr):
     if isinstance(e, ast.FuncCall) and e.name in _AGG_NAMES:
-        yield e
+        if e.window is None:
+            yield e
         return
     for attr in ("left", "right", "operand", "low", "high", "pattern",
                  "value", "else_expr"):
@@ -1116,3 +1316,42 @@ def _fold(e: Call) -> PlanExpr:
     except (ZeroDivisionError, OverflowError, ExprError):
         return e
     return e
+
+
+_INT_ORDER = [TypeKind.BOOLEAN, TypeKind.TINYINT, TypeKind.SMALLINT,
+              TypeKind.INT, TypeKind.BIGINT]
+
+
+def _union_ftype(a: FieldType, b: FieldType) -> FieldType:
+    """Result type of a UNION column pair (conservative subset of MySQL's
+    aggregation rules: same family merges; mixed families are rejected at
+    plan time rather than silently coerced)."""
+    if a.is_string and b.is_string:
+        return FieldType(TypeKind.VARCHAR, flen=max(a.flen, b.flen))
+    if a.is_float or b.is_float:
+        if (a.is_float or a.is_integer or a.is_decimal) and \
+                (b.is_float or b.is_integer or b.is_decimal):
+            return FieldType(TypeKind.DOUBLE)
+        raise PlanError("UNION over incompatible column types")
+    if a.is_decimal or b.is_decimal:
+        if not ((a.is_decimal or a.is_integer)
+                and (b.is_decimal or b.is_integer)):
+            raise PlanError("UNION over incompatible column types")
+        sa = a.scale if a.is_decimal else 0
+        sb = b.scale if b.is_decimal else 0
+        ia = (a.flen - a.scale) if a.is_decimal else 19
+        ib = (b.flen - b.scale) if b.is_decimal else 19
+        scale = max(sa, sb)
+        return FieldType(TypeKind.DECIMAL,
+                         flen=min(max(ia, ib) + scale, 18 + scale),
+                         scale=scale)
+    if a.is_integer and b.is_integer:
+        k = max(a.kind, b.kind, key=lambda x: _INT_ORDER.index(x)
+                if x in _INT_ORDER else 99)
+        if k not in _INT_ORDER:
+            k = TypeKind.BIGINT
+        return FieldType(k)
+    if a.kind == b.kind:
+        return FieldType(a.kind, flen=max(a.flen, b.flen),
+                         scale=max(a.scale, b.scale))
+    raise PlanError("UNION over incompatible column types")

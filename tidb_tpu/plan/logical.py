@@ -80,3 +80,38 @@ class LogicalLimit(LogicalPlan):
     offset: int
     schema: PlanSchema
     children: list[LogicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class LogicalUnion(LogicalPlan):
+    """UNION ALL: bag concatenation of same-width children (reference:
+    planner/core LogicalUnionAll; DISTINCT lowers to an aggregation above,
+    exactly like buildDistinct)."""
+
+    schema: PlanSchema
+    children: list[LogicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class WindowItem:
+    """One window computation (reference: planner/core LogicalWindow
+    WindowFuncDesc). Default frame only: with order, running (peers
+    share values — RANGE UNBOUNDED PRECEDING..CURRENT ROW); without,
+    the whole partition."""
+
+    func: str  # upper-case window/agg function name
+    args: list[PlanExpr]
+    partition: list[PlanExpr]
+    order: list[tuple[PlanExpr, bool]]
+    ftype: object
+
+
+@dataclass
+class LogicalWindow(LogicalPlan):
+    """Appends one output column per window item to the child schema
+    (reference: planner/core/logical_plans.go LogicalWindow;
+    executor/window.go)."""
+
+    items: list[WindowItem]
+    schema: PlanSchema
+    children: list[LogicalPlan] = field(default_factory=list)

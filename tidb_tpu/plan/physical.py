@@ -29,6 +29,8 @@ from .logical import (
     LogicalScan,
     LogicalSelection,
     LogicalSort,
+    LogicalUnion,
+    LogicalWindow,
 )
 from .schema import PlanSchema, ResultField
 
@@ -105,6 +107,27 @@ class PhysHashJoin(PhysicalPlan):
     kind: str
     eq_conditions: list[tuple[int, int]]
     other_conditions: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysUnion(PhysicalPlan):
+    """UNION ALL: run children, normalize each child's columns to the
+    unified schema (scale/width/dictionary), concatenate (reference:
+    executor/union iterating children; DISTINCT is an agg above)."""
+
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysWindow(PhysicalPlan):
+    """Host window computation appending one column per item (reference:
+    executor/window.go; shuffle-partition parallelism replaced by
+    vectorized segmented numpy passes)."""
+
+    items: list
     schema: PlanSchema
     children: list[PhysicalPlan] = field(default_factory=list)
 
@@ -193,6 +216,9 @@ def push_predicates(plan: LogicalPlan) -> LogicalPlan:
     accept pushes to their outer side (null-extension safety)."""
     plan.children = [push_predicates(c) for c in plan.children]
 
+    if isinstance(plan, (LogicalUnion, LogicalWindow)):
+        return plan
+
     if isinstance(plan, LogicalSelection):
         child = plan.children[0]
         if isinstance(child, LogicalSelection):
@@ -276,6 +302,18 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
     indices the parent needs (None = all)."""
     if required is None:
         required = set(range(len(plan.schema)))
+
+    if isinstance(plan, LogicalUnion):
+        # children must keep identical widths; prune within each child only
+        plan.children = [prune(c) for c in plan.children]
+        plan._prune_map = {i: i for i in range(len(plan.schema))}  # type: ignore[attr-defined]
+        return plan
+
+    if isinstance(plan, LogicalWindow):
+        # window items reference arbitrary child columns; keep them all
+        plan.children = [prune(c) for c in plan.children]
+        plan._prune_map = {i: i for i in range(len(plan.schema))}  # type: ignore[attr-defined]
+        return plan
 
     if isinstance(plan, LogicalScan):
         keep = sorted(required) or [0] if plan.table.columns else []
@@ -678,6 +716,14 @@ def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
             return child
         return PhysProjection(plan.exprs, plan.schema, [child])
 
+    if isinstance(plan, LogicalUnion):
+        return PhysUnion(plan.schema,
+                         [_to_physical(c, stats) for c in plan.children])
+
+    if isinstance(plan, LogicalWindow):
+        return PhysWindow(plan.items, plan.schema,
+                          [_to_physical(plan.children[0], stats)])
+
     if isinstance(plan, LogicalSort):
         child = _to_physical(plan.children[0], stats)
         return PhysSort(plan.items, plan.schema, [child])
@@ -776,6 +822,10 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
         line = f"{pad}Limit: {plan.limit} offset {plan.offset}"
     elif isinstance(plan, PhysHashJoin):
         line = f"{pad}HashJoin({plan.kind}): eq={plan.eq_conditions}"
+    elif isinstance(plan, PhysUnion):
+        line = f"{pad}Union: {len(plan.children)} children"
+    elif isinstance(plan, PhysWindow):
+        line = f"{pad}Window: {[it.func for it in plan.items]}"
     elif name == "PhysFragmentRead":
         line = f"{pad}FragmentRead[TiTPU]: {plan.frag.describe()}"
     else:

@@ -42,6 +42,8 @@ class ClientConn:
         self.capabilities = 0
         self.user = ""
         self.alive = True
+        # stmt_id -> (n_params, bound param types from the last EXECUTE)
+        self._stmt_meta: dict[int, tuple[int, Optional[list]]] = {}
         self.killed = threading.Event()
 
     # ---- handshake ---------------------------------------------------------
@@ -137,6 +139,18 @@ class ClientConn:
             return self._com_init_db(payload)
         if cmd == P.COM_QUERY:
             return self._com_query(payload.decode("utf-8"))
+        if cmd == P.COM_STMT_PREPARE:
+            return self._com_stmt_prepare(payload.decode("utf-8"))
+        if cmd == P.COM_STMT_EXECUTE:
+            return self._com_stmt_execute(payload)
+        if cmd == P.COM_STMT_CLOSE:
+            sid = struct.unpack_from("<I", payload, 0)[0]
+            self.session.close_prepared(sid)
+            self._stmt_meta.pop(sid, None)
+            return True  # COM_STMT_CLOSE sends no response
+        if cmd == P.COM_STMT_RESET:
+            self.io.write_packet(P.ok_packet(status=self._status()))
+            return True
         if cmd == P.COM_FIELD_LIST:
             # deprecated command: empty column list terminator
             self.io.write_packet(P.eof_packet(status=self._status()))
@@ -166,7 +180,7 @@ class ClientConn:
         self._write_resultset(rs)
         return True
 
-    def _write_resultset(self, rs: ResultSet) -> None:
+    def _write_resultset(self, rs: ResultSet, binary: bool = False) -> None:
         if not rs.column_names:
             self.io.write_packet(P.ok_packet(
                 affected=rs.affected, status=self._status()))
@@ -177,8 +191,46 @@ class ClientConn:
             self.io.write_packet(P.column_def(name, ft))
         self.io.write_packet(P.eof_packet(status=self._status()))
         for row in rs.rows:
-            self.io.write_packet(P.text_row(row))
+            self.io.write_packet(
+                P.binary_row(row, types) if binary else P.text_row(row))
         self.io.write_packet(P.eof_packet(status=self._status()))
+
+    # ---- prepared statements (reference: server/conn_stmt.go) ----------
+    def _com_stmt_prepare(self, sql: str) -> bool:
+        try:
+            sid, n_params = self.session.prepare(sql)
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self.io.write_packet(P.err_packet(1105, str(e)))
+            return True
+        self._stmt_meta[sid] = (n_params, None)
+        self.io.write_packet(P.stmt_prepare_ok(sid, 0, n_params))
+        if n_params:
+            for i in range(n_params):
+                self.io.write_packet(P.column_def(f"?{i}", None))
+            self.io.write_packet(P.eof_packet(status=self._status()))
+        return True
+
+    def _com_stmt_execute(self, payload: bytes) -> bool:
+        sid = struct.unpack_from("<I", payload, 0)[0]
+        meta = self._stmt_meta.get(sid)
+        if meta is None:
+            self.io.write_packet(P.err_packet(
+                1243, f"Unknown prepared statement handler ({sid})"))
+            return True
+        n_params, prev_types = meta
+        pos = 9  # stmt_id(4) + flags(1) + iteration count(4)
+        try:
+            params: list = []
+            if n_params:
+                params, types = P.decode_binary_params(
+                    payload, pos, n_params, prev_types)
+                self._stmt_meta[sid] = (n_params, types)
+            rs = self.session.execute_prepared(sid, params)
+        except Exception as e:  # noqa: BLE001 - wire boundary
+            self.io.write_packet(P.err_packet(1105, str(e)))
+            return True
+        self._write_resultset(rs, binary=True)
+        return True
 
     def _status(self) -> int:
         s = P.SERVER_STATUS_AUTOCOMMIT

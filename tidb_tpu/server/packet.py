@@ -43,6 +43,7 @@ COM_PING = 0x0E
 COM_STMT_PREPARE = 0x16
 COM_STMT_EXECUTE = 0x17
 COM_STMT_CLOSE = 0x19
+COM_STMT_RESET = 0x1A
 
 # ---- MySQL protocol column types -------------------------------------------
 T_TINY = 1
@@ -228,3 +229,150 @@ def text_row(values: Iterable[Any]) -> bytes:
         r = render_text_value(v)
         out += b"\xfb" if r is None else lenenc_str(r)
     return out
+
+
+# ---- prepared statements (binary protocol) ----------------------------------
+# reference: server/conn_stmt.go (COM_STMT_PREPARE/EXECUTE), binary row
+# encoding server/util.go dumpBinaryRow
+
+def stmt_prepare_ok(stmt_id: int, n_cols: int, n_params: int) -> bytes:
+    return (b"\x00" + struct.pack("<IHH", stmt_id, n_cols, n_params)
+            + b"\x00" + struct.pack("<H", 0))
+
+
+def decode_binary_params(payload: bytes, pos: int, n_params: int,
+                         prev_types: Optional[list] = None):
+    """Parse the COM_STMT_EXECUTE parameter block -> (python values, types).
+
+    Layout: null-bitmap ((n+7)//8), new-params-bound flag, [types 2B each],
+    values. Types persist across executions when the flag is 0."""
+    from ..types.value import Decimal as _Dec
+
+    nb = (n_params + 7) // 8
+    null_bitmap = payload[pos:pos + nb]
+    pos += nb
+    new_bound = payload[pos]
+    pos += 1
+    if new_bound:
+        types = []
+        for _ in range(n_params):
+            types.append((payload[pos], payload[pos + 1]))
+            pos += 2
+    else:
+        if prev_types is None:
+            raise ValueError("parameter types were never bound")
+        types = prev_types
+    values = []
+    for i, (tp, flags) in enumerate(types):
+        unsigned = bool(flags & 0x80)
+        if null_bitmap[i // 8] & (1 << (i % 8)):
+            values.append(None)
+            continue
+        if tp == T_TINY:
+            values.append(struct.unpack_from(
+                "<B" if unsigned else "<b", payload, pos)[0])
+            pos += 1
+        elif tp == T_SHORT or tp == T_YEAR:
+            values.append(struct.unpack_from(
+                "<H" if unsigned else "<h", payload, pos)[0])
+            pos += 2
+        elif tp in (T_LONG, 9):  # LONG / INT24
+            values.append(struct.unpack_from(
+                "<I" if unsigned else "<i", payload, pos)[0])
+            pos += 4
+        elif tp == T_LONGLONG:
+            values.append(struct.unpack_from(
+                "<Q" if unsigned else "<q", payload, pos)[0])
+            pos += 8
+        elif tp == T_FLOAT:
+            values.append(struct.unpack_from("<f", payload, pos)[0])
+            pos += 4
+        elif tp == T_DOUBLE:
+            values.append(struct.unpack_from("<d", payload, pos)[0])
+            pos += 8
+        elif tp in (T_DATE, T_DATETIME, 7):  # date / datetime / timestamp
+            ln = payload[pos]
+            pos += 1
+            if ln == 0:
+                values.append("0000-00-00")
+            else:
+                y, = struct.unpack_from("<H", payload, pos)
+                mo, d = payload[pos + 2], payload[pos + 3]
+                if ln >= 7:
+                    h, mi, sec = payload[pos + 4], payload[pos + 5], \
+                        payload[pos + 6]
+                    values.append(
+                        f"{y:04d}-{mo:02d}-{d:02d} "
+                        f"{h:02d}:{mi:02d}:{sec:02d}")
+                else:
+                    values.append(f"{y:04d}-{mo:02d}-{d:02d}")
+                pos += ln
+        else:  # strings, blobs, NEWDECIMAL: length-encoded bytes
+            v, pos = read_lenenc_str(payload, pos)
+            if tp == T_NEWDECIMAL:
+                values.append(_Dec.parse(v.decode()))
+            else:
+                values.append(v.decode("utf-8", "replace"))
+    return values, types
+
+
+def read_lenenc_str(buf: bytes, pos: int) -> tuple[bytes, int]:
+    first = buf[pos]
+    if first < 0xFB:
+        ln, pos = first, pos + 1
+    elif first == 0xFC:
+        ln, pos = struct.unpack_from("<H", buf, pos + 1)[0], pos + 3
+    elif first == 0xFD:
+        ln = int.from_bytes(buf[pos + 1:pos + 4], "little")
+        pos += 4
+    else:
+        ln, pos = struct.unpack_from("<Q", buf, pos + 1)[0], pos + 9
+    return buf[pos:pos + ln], pos + ln
+
+
+def binary_row(values, ftypes) -> bytes:
+    """Binary protocol resultset row (reference: server/util.go
+    dumpBinaryRow): 0x00 header, null bitmap (offset 2), then values
+    encoded per the advertised column type."""
+    n = len(values)
+    null_bitmap = bytearray((n + 9) // 8)
+    out = bytearray()
+    for i, (v, ft) in enumerate(zip(values, ftypes)):
+        if v is None:
+            null_bitmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+            continue
+        tp = mysql_type(ft)[0] if ft is not None else T_VAR_STRING
+        if tp == T_TINY:
+            out += struct.pack("<b", int(v))
+        elif tp in (T_SHORT, T_YEAR):
+            out += struct.pack("<h", int(v))
+        elif tp in (T_LONG, 9):
+            out += struct.pack("<i", int(v))
+        elif tp == T_LONGLONG:
+            out += struct.pack("<q", int(v))
+        elif tp == T_FLOAT:
+            out += struct.pack("<f", float(v))
+        elif tp == T_DOUBLE:
+            out += struct.pack("<d", float(v))
+        elif tp in (T_DATE, T_DATETIME, 7):
+            out += _binary_time(v, tp)
+        else:
+            r = render_text_value(v)
+            out += lenenc_str(r if r is not None else b"")
+    return b"\x00" + bytes(null_bitmap) + bytes(out)
+
+
+def _binary_time(v, tp: int) -> bytes:
+    if isinstance(v, _dt.datetime):
+        return bytes([7]) + struct.pack(
+            "<HBBBBB", v.year, v.month, v.day, v.hour, v.minute, v.second)
+    if isinstance(v, _dt.date):
+        return bytes([4]) + struct.pack("<HBB", v.year, v.month, v.day)
+    # string-rendered temporal
+    txt = str(v)
+    date, _, clock = txt.partition(" ")
+    y, mo, d = (int(x) for x in date.split("-"))
+    if clock:
+        h, mi, sec = (int(float(x)) for x in clock.split(":"))
+        return bytes([7]) + struct.pack("<HBBBBB", y, mo, d, h, mi, sec)
+    return bytes([4]) + struct.pack("<HBB", y, mo, d)

@@ -57,6 +57,8 @@ class Session:
         self.catalog: Catalog = self.storage.catalog
         self.current_db = db
         self.cop = cop if cop is not None else CopClient()
+        self._prepared: dict[int, tuple] = {}
+        self._next_stmt_id = 0
         self.txn: Optional[Transaction] = None
         self.in_explicit_txn = False
         self.vars: dict[str, Any] = {}
@@ -84,9 +86,49 @@ class Session:
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
 
+    # ==================== prepared statements ====================
+    def prepare(self, sql: str) -> tuple[int, int]:
+        """Server-side prepare (reference: server/conn_stmt.go
+        handleStmtPrepare + planner PrepareExec): parse once, count '?'
+        markers; returns (stmt_id, n_params)."""
+        from ..sql.parser import Parser
+
+        try:
+            parser = Parser(sql)
+            stmts = parser.parse()
+        except ParseError as e:
+            raise SQLError(f"parse error: {e}") from None
+        if len(stmts) != 1:
+            raise SQLError("prepared statement must be a single statement")
+        self._next_stmt_id += 1
+        sid = self._next_stmt_id
+        self._prepared[sid] = (stmts[0], parser.param_count)
+        return sid, parser.param_count
+
+    def execute_prepared(self, stmt_id: int, params: list) -> ResultSet:
+        """Bind parameters and run (reference: server/conn_stmt.go
+        handleStmtExecute). Binding substitutes literals into a copy of
+        the AST; the statement replans per execution (plan cache later)."""
+        import copy
+
+        entry = self._prepared.get(stmt_id)
+        if entry is None:
+            raise SQLError(f"unknown prepared statement {stmt_id}")
+        stmt, n_params = entry
+        if len(params) != n_params:
+            raise SQLError(
+                f"expected {n_params} parameters, got {len(params)}")
+        bound = copy.deepcopy(stmt)
+        if n_params:
+            bound = _bind_params(bound, params)
+        return self._execute_stmt(bound)
+
+    def close_prepared(self, stmt_id: int) -> None:
+        self._prepared.pop(stmt_id, None)
+
     # ==================== statement dispatch ====================
     def _execute_stmt(self, stmt: ast.Stmt) -> ResultSet:
-        if isinstance(stmt, ast.SelectStmt):
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return self._run_in_txn(lambda: self._exec_select(stmt))
         if isinstance(stmt, ast.InsertStmt):
             return self._run_in_txn(lambda: self._exec_insert(stmt))
@@ -771,3 +813,40 @@ def _np_scalar(v):
     if isinstance(v, np.generic):
         return v.item()
     return v
+
+
+def _bind_params(node, params: list):
+    """Replace ParamMarker nodes with typed literals (in a deep copy)."""
+    import dataclasses as _dc
+
+    from ..types.value import Decimal as _Dec
+
+    if isinstance(node, ast.ParamMarker):
+        v = params[node.idx]
+        if v is None:
+            return ast.Literal(None, "null")
+        if isinstance(v, bool):
+            return ast.Literal(v, "bool")
+        if isinstance(v, int):
+            return ast.Literal(v, "int")
+        if isinstance(v, float):
+            return ast.Literal(v, "float")
+        if isinstance(v, _Dec):
+            return ast.Literal(v, "decimal")
+        return ast.Literal(str(v), "string")
+    if not _dc.is_dataclass(node):
+        return node
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if _dc.is_dataclass(v) and not isinstance(v, type):
+            setattr(node, f.name, _bind_params(v, params))
+        elif isinstance(v, list):
+            setattr(node, f.name, [
+                _bind_params(x, params)
+                if _dc.is_dataclass(x) and not isinstance(x, type) else
+                (tuple(_bind_params(y, params)
+                       if _dc.is_dataclass(y) and not isinstance(y, type)
+                       else y for y in x) if isinstance(x, tuple) else x)
+                for x in v
+            ])
+    return node

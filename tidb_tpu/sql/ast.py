@@ -78,11 +78,25 @@ class Like(Expr):
 
 
 @dataclass
+class ParamMarker(Expr):
+    """A '?' placeholder in a prepared statement (binds at EXECUTE)."""
+
+    idx: int
+
+
+@dataclass
+class WindowSpec:
+    partition_by: list["Expr"] = field(default_factory=list)
+    order_by: list["OrderItem"] = field(default_factory=list)
+
+
+@dataclass
 class FuncCall(Expr):
     name: str  # upper-cased
     args: list[Expr]
     distinct: bool = False  # COUNT(DISTINCT x)
     is_star: bool = False  # COUNT(*)
+    window: Optional[WindowSpec] = None  # fn(...) OVER (...)
 
 
 @dataclass
@@ -176,6 +190,18 @@ class SelectStmt(Stmt):
     limit: Optional[int] = None
     offset: int = 0
     distinct: bool = False
+
+
+@dataclass
+class SetOpStmt(Stmt):
+    """Chain of UNION [ALL] selects; trailing ORDER BY/LIMIT bind to the
+    whole union (MySQL semantics for unparenthesized selects)."""
+
+    selects: list[SelectStmt]
+    alls: list[bool]  # alls[i]: is selects[i+1] joined with UNION ALL
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
 
 
 @dataclass

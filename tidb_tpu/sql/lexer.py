@@ -67,11 +67,12 @@ KEYWORDS = frozenset(
     GLOBAL SESSION VARIABLES STATUS
     FOR
     ADMIN DDL JOBS
+    OVER PARTITION ROWS RANGE
     """.split()
 )
 
 _MULTI_OPS = ("<=>", "<<", ">>", "<>", "!=", "<=", ">=", ":=", "||", "&&")
-_SINGLE_OPS = "+-*/%(),.;=<>!&|^~@"
+_SINGLE_OPS = "+-*/%(),.;=<>!&|^~@?"
 
 
 class Lexer:

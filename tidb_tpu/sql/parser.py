@@ -129,10 +129,12 @@ class Parser:
             if self.cur.kind != TokenKind.EOF:
                 self.expect_op(";")
 
+    param_count: int = 0
+
     def parse_statement(self) -> ast.Stmt:
         t = self.cur
         if t.is_kw("SELECT"):
-            return self.parse_select()
+            return self.parse_select_statement()
         if t.is_kw("INSERT", "REPLACE"):
             return self.parse_insert()
         if t.is_kw("UPDATE"):
@@ -253,6 +255,32 @@ class Parser:
         return ast.AlterTableStmt(table, specs)
 
     # ---- SELECT ------------------------------------------------------------
+    def parse_select_statement(self) -> ast.Stmt:
+        """SELECT ... [UNION [ALL] SELECT ...]*; a trailing ORDER BY/LIMIT
+        binds to the union (reference: parser union list grammar)."""
+        first = self.parse_select()
+        if not self.cur.is_kw("UNION"):
+            return first
+        selects = [first]
+        alls: list[bool] = []
+        while self.accept_kw("UNION"):
+            if selects[-1].order_by or selects[-1].limit is not None:
+                raise ParseError(
+                    "incorrect usage of UNION and ORDER BY/LIMIT "
+                    "(parenthesize the SELECT)", self.cur)
+            is_all = bool(self.accept_kw("ALL"))
+            if not is_all:
+                self.accept_kw("DISTINCT")
+            selects.append(self.parse_select())
+            alls.append(is_all)
+        # the trailing ORDER BY/LIMIT was consumed by the last SELECT;
+        # it belongs to the union
+        last = selects[-1]
+        stmt = ast.SetOpStmt(selects, alls, last.order_by, last.limit,
+                             last.offset)
+        last.order_by, last.limit, last.offset = [], None, 0
+        return stmt
+
     def parse_select(self) -> ast.SelectStmt:
         self.expect_kw("SELECT")
         distinct = bool(self.accept_kw("DISTINCT"))
@@ -375,7 +403,7 @@ class Parser:
     def parse_table_factor(self) -> ast.TableRef:
         if self.accept_op("("):
             if self.cur.is_kw("SELECT"):
-                sub = self.parse_select()
+                sub = self.parse_select_statement()
                 self.expect_op(")")
                 alias = ""
                 self.accept_kw("AS")
@@ -414,7 +442,8 @@ class Parser:
                 columns.append(self.expect_ident())
             self.expect_op(")")
         if self.cur.is_kw("SELECT"):
-            return ast.InsertStmt(table, columns, select=self.parse_select(),
+            return ast.InsertStmt(table, columns,
+                                  select=self.parse_select_statement(),
                                   is_replace=is_replace)
         self.expect_kw("VALUES")
         rows = [self.parse_value_row()]
@@ -756,6 +785,10 @@ class Parser:
 
     def parse_primary(self) -> ast.Expr:
         t = self.cur
+        if t.is_op("?"):
+            self.advance()
+            self.param_count += 1
+            return ast.ParamMarker(self.param_count - 1)
         if t.kind == TokenKind.INT:
             self.advance()
             return ast.Literal(int(t.text), "int")
@@ -846,14 +879,38 @@ class Parser:
         distinct = bool(self.accept_kw("DISTINCT"))
         if self.accept_op("*"):
             self.expect_op(")")
-            return ast.FuncCall(name, [], is_star=True)
+            return self._maybe_over(ast.FuncCall(name, [], is_star=True))
         if self.accept_op(")"):
-            return ast.FuncCall(name, [])
+            return self._maybe_over(ast.FuncCall(name, []))
         args = [self.parse_expr()]
         while self.accept_op(","):
             args.append(self.parse_expr())
         self.expect_op(")")
-        return ast.FuncCall(name, args, distinct=distinct)
+        return self._maybe_over(ast.FuncCall(name, args, distinct=distinct))
+
+    def _maybe_over(self, fc: ast.FuncCall) -> ast.FuncCall:
+        """fn(...) OVER ([PARTITION BY ...] [ORDER BY ...]) — default
+        frames only (RANGE UNBOUNDED PRECEDING .. CURRENT ROW)."""
+        if not self.cur.is_kw("OVER"):
+            return fc
+        self.advance()
+        self.expect_op("(")
+        spec = ast.WindowSpec()
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            spec.partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                spec.partition_by.append(self.parse_expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            spec.order_by.append(self.parse_order_item())
+            while self.accept_op(","):
+                spec.order_by.append(self.parse_order_item())
+        if self.cur.is_kw("ROWS", "RANGE"):
+            raise ParseError("explicit window frames unsupported", self.cur)
+        self.expect_op(")")
+        fc.window = spec
+        return fc
 
     def _finish_column_ref(self, first: str) -> ast.ColumnRef:
         if self.accept_op("."):
@@ -900,7 +957,7 @@ _IDENT_KEYWORDS = frozenset(
     """
     DATE TIME TIMESTAMP DATETIME YEAR STATUS VARIABLES TABLES DATABASES
     COUNT SUM AVG MIN MAX COLUMN FIRST AFTER BEGIN COMMIT IF
-    ADMIN DDL JOBS
+    ADMIN DDL JOBS OVER PARTITION ROWS RANGE
     """.split()
 )
 
