@@ -253,3 +253,48 @@ def test_stmt_close_frees(wire):
     cmd(b"\x17" + struct.pack("<IBI", stmt_id, 0, 1))
     _, d = rd()
     assert d[0] == 0xFF  # unknown prepared statement handler
+
+
+# ==================== observability ====================
+
+def test_explain_analyze_shows_engine_and_rows():
+    s = Session()
+    s.execute("CREATE TABLE oa (a INT, b INT)")
+    s.execute("INSERT INTO oa VALUES (1,2),(3,4),(5,6)")
+    rows = s.query("EXPLAIN ANALYZE SELECT b, SUM(a) FROM oa "
+                   "WHERE a > 1 GROUP BY b")
+    cols = {r[0].strip().split(":")[0].split("[")[0]: r for r in rows}
+    leaf = next(r for r in rows if "TableRead" in r[0])
+    assert leaf[1] == 2          # actRows
+    assert leaf[2] is not None   # time_ms
+    assert "device" in leaf[3] or "host" in leaf[3]
+
+
+def test_slow_log_and_metrics():
+    from tidb_tpu import obs
+
+    s = Session()
+    s.execute("CREATE TABLE sl (a INT)")
+    s.execute("SET tidb_slow_log_threshold = 0")
+    s.query("SELECT COUNT(*) FROM sl")
+    slow = s.query("SHOW SLOW QUERIES")
+    assert any("SELECT COUNT(*) FROM sl" in r[3] for r in slow)
+    mets = dict(s.query("SHOW METRICS"))
+    assert any(k.startswith("tidb_queries_total") for k in mets)
+    assert obs.QUERY_SECONDS.snapshot()[2] > 0
+
+
+def test_status_http_endpoints():
+    import json
+    import urllib.request
+
+    srv = Server(host="127.0.0.1", port=0, status_port=0)
+    srv.start()
+    time.sleep(0.2)
+    base = f"http://127.0.0.1:{srv.status_port}"
+    st = json.loads(urllib.request.urlopen(base + "/status").read())
+    assert "version" in st and "connections" in st
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "tidb_queries_total" in met
+    urllib.request.urlopen(base + "/slow-query").read()
+    srv.close()

@@ -97,6 +97,8 @@ class CopResult:
 
     chunks: list[Chunk]
     is_partial_agg: bool
+    # which engine served it: "device", "host(<reason>)", "ranged"
+    engine: str = "device"
 
 
 class CopClient:
@@ -137,15 +139,23 @@ class CopClient:
 
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
+        from .. import obs
         if dag.scan.ranges is not None:
             # index-ranged scan: the index permutation resolves a (small)
             # handle set; the DAG runs host-side over the gathered subset
             # (reference: IndexLookUp double read, executor/distsql.go:353)
-            return host_exec.execute_ranged(dag, snap)
+            obs.COPR_REQUESTS.inc(engine="ranged")
+            r = host_exec.execute_ranged(dag, snap)
+            r.engine = "ranged"
+            return r
         self._evict_stale(dag.scan.table_id, snap.epoch.epoch_id)
         prepared, fallback = self._prepare(dag, snap)
         if fallback is not None:
-            return host_exec.execute_host(dag, snap, fallback)
+            obs.COPR_REQUESTS.inc(engine="host")
+            r = host_exec.execute_host(dag, snap, fallback)
+            r.engine = f"host({fallback})"
+            return r
+        obs.COPR_REQUESTS.inc(engine="device")
 
         chunks: list[Chunk] = []
         base_n = snap.epoch.num_rows

@@ -59,12 +59,16 @@ class _Fallback(Exception):
 def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
                      ) -> CopResult:
     """snaps: table_id -> TableSnapshot for every fragment table."""
+    from .. import obs
     try:
-        return _device_fragment(cop, frag, snaps)
-    except _Fallback:
-        return _host_fragment(frag, snaps)
-    except CompileError:
-        return _host_fragment(frag, snaps)
+        r = _device_fragment(cop, frag, snaps)
+        obs.COPR_REQUESTS.inc(engine="device-fragment")
+        return r
+    except (_Fallback, CompileError):
+        obs.COPR_REQUESTS.inc(engine="host-fragment")
+        r = _host_fragment(frag, snaps)
+        r.engine = "host(fragment-fallback)"
+        return r
 
 
 # ==================== device path ====================
@@ -170,7 +174,8 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                                       builds, overlay=True, mode=mode))
     if not chunks:
         chunks = [_empty_chunk(frag, comb_dicts)]
-    return CopResult(chunks, is_partial_agg=frag.agg is not None)
+    return CopResult(chunks, is_partial_agg=frag.agg is not None,
+                     engine=f"device[{mode}]")
 
 
 def _facade_dag(t):

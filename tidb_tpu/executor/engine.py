@@ -48,6 +48,7 @@ _NULL_KEY = np.iinfo(np.int64).min
 class ExecContext:
     txn: Transaction
     cop: CopClient
+    stats: Optional[object] = None  # obs.RuntimeStatsColl for EXPLAIN ANALYZE
 
     def __post_init__(self) -> None:
         self._subq_cache: dict[int, Const] = {}
@@ -90,11 +91,27 @@ def _subst_subq(e: PlanExpr, ctx: ExecContext) -> PlanExpr:
 
 
 def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
+    if ctx.stats is not None:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        engine_tag = [None]
+        chunk = _run_node(plan, ctx, engine_tag)
+        ctx.stats.record(plan, _time.perf_counter() - t0, chunk.num_rows,
+                         engine_tag[0])
+        return chunk
+    return _run_node(plan, ctx, None)
+
+
+def _run_node(plan: PhysicalPlan, ctx: ExecContext,
+              engine_tag: Optional[list]) -> Chunk:
     if isinstance(plan, PhysTableRead):
         if plan.dag.scan.table_id < 0:
             return Chunk([])  # dual pseudo-table: one conceptual row, no cols
         snap = ctx.txn.snapshot(plan.dag.scan.table_id)
         result = ctx.cop.execute(plan.dag, snap)
+        if engine_tag is not None:
+            engine_tag[0] = result.engine
         if not result.chunks:
             return _empty_like(plan)
         return Chunk.concat(result.chunks)
@@ -104,6 +121,8 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         snaps = {t.table.id: ctx.txn.snapshot(t.table.id)
                  for t in plan.frag.tables}
         result = execute_fragment(ctx.cop, plan.frag, snaps)
+        if engine_tag is not None:
+            engine_tag[0] = result.engine
         if not result.chunks:
             return _empty_like(plan)
         return Chunk.concat(result.chunks)
@@ -374,6 +393,8 @@ def _window_values(item, out_t, child, ev, n, ctx):
         off = 1
         if len(item.args) > 1:
             off = int(_const_of(item.args[1]))
+            if off < 0:
+                raise ValueError(f"{name} offset must be non-negative")
         src = iota + (off if name == "LEAD" else -off)
         ok = (src >= 0) & (src < n)
         src_c = np.clip(src, 0, max(n - 1, 0))
@@ -385,8 +406,18 @@ def _window_values(item, out_t, child, ev, n, ctx):
             if dv is not None:
                 if isinstance(dv, str):
                     arg0 = item.args[0]
-                    d = child.columns[arg0.idx].dictionary
-                    dv = d.encode(dv) if d is not None else 0
+                    d = child.columns[arg0.idx].dictionary \
+                        if isinstance(arg0, Col) else None
+                    if d is not None:
+                        dv = d.encode(dv)
+                    else:
+                        # numeric column: coerce MySQL-style or reject
+                        try:
+                            dv = float(dv) if "." in dv else int(dv)
+                        except ValueError:
+                            raise ValueError(
+                                f"{name} default {dv!r} does not coerce "
+                                "to the column type") from None
                 vals = np.where(ok, vals, dv)
                 valid_s = valid_s | ~ok
         vals, valid_out = vals, valid_s

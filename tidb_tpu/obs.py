@@ -1,0 +1,196 @@
+"""Observability: metrics, runtime stats, slow-query log.
+
+The reference wires these into its core loop rather than bolting them on:
+~150 Prometheus collectors registered centrally (reference:
+metrics/metrics.go:61), per-operator runtime stats feeding EXPLAIN ANALYZE
+(util/execdetails/execdetails.go), and a slow-query log with per-stage
+durations (executor/adapter.go:866 LogSlowQuery), queryable back through
+the server. Same shape here: one process-wide registry, a per-statement
+RuntimeStatsColl the engine fills, and an in-memory slow-log ring exposed
+via SHOW SLOW QUERIES and the HTTP status port.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+log = logging.getLogger("tidb_tpu.slowlog")
+
+
+class Counter:
+    __slots__ = ("name", "help", "_values", "_lock")
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (Prometheus-style cumulative)."""
+
+    BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+    __slots__ = ("name", "help", "_counts", "_sum", "_total", "_lock")
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._counts = [0] * (len(self.BUCKETS) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._total += 1
+            for i, b in enumerate(self.BUCKETS):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._counts), self._sum, self._total
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "") -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Counter):
+                out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} counter")
+                for key, v in sorted(m.samples()):
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    out.append(f"{m.name}{{{lbl}}} {v:g}" if lbl
+                               else f"{m.name} {v:g}")
+            else:
+                counts, total_sum, total = m.snapshot()
+                out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} histogram")
+                acc = 0
+                for b, c in zip(m.BUCKETS, counts):
+                    acc += c
+                    out.append(f'{m.name}_bucket{{le="{b}"}} {acc}')
+                out.append(f'{m.name}_bucket{{le="+Inf"}} {total}')
+                out.append(f"{m.name}_sum {total_sum:g}")
+                out.append(f"{m.name}_count {total}")
+        return "\n".join(out) + "\n"
+
+
+METRICS = Registry()
+
+QUERIES = METRICS.counter("tidb_queries_total",
+                          "statements executed, by type")
+QUERY_ERRORS = METRICS.counter("tidb_query_errors_total",
+                               "statements that raised")
+QUERY_SECONDS = METRICS.histogram("tidb_query_duration_seconds",
+                                  "statement wall time")
+COPR_REQUESTS = METRICS.counter(
+    "tidb_copr_requests_total",
+    "coprocessor executions, by engine (device / host fallback)")
+COMMITS = METRICS.counter("tidb_commits_total", "transaction commits")
+CONFLICTS = METRICS.counter("tidb_write_conflicts_total",
+                            "commit-time write conflicts")
+CONNECTIONS = METRICS.counter("tidb_connections_total",
+                              "wire connections accepted")
+SLOW_QUERIES = METRICS.counter("tidb_slow_queries_total",
+                               "statements over the slow-log threshold")
+
+
+# ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------
+
+class RuntimeStatsColl:
+    """Per-plan-node runtime stats (reference:
+    util/execdetails/execdetails.go RuntimeStatsColl): inclusive wall
+    time, output rows, and which engine served a leaf (device kernel vs
+    host fallback, with the gate's reason)."""
+
+    def __init__(self) -> None:
+        self.nodes: dict[int, dict] = {}
+
+    def record(self, plan, seconds: float, rows: int,
+               engine: Optional[str] = None) -> None:
+        ent = self.nodes.setdefault(id(plan), {
+            "time": 0.0, "rows": 0, "loops": 0, "engine": None})
+        ent["time"] += seconds
+        ent["rows"] += rows
+        ent["loops"] += 1
+        if engine:
+            ent["engine"] = engine
+
+    def for_plan(self, plan) -> Optional[dict]:
+        return self.nodes.get(id(plan))
+
+
+# ---- slow query log ---------------------------------------------------------
+
+SLOW_LOG_MAX = 512
+_slow_log: deque = deque(maxlen=SLOW_LOG_MAX)
+_slow_lock = threading.Lock()
+
+DEFAULT_SLOW_THRESHOLD_MS = 300
+
+
+def record_slow(sql: str, db: str, duration_s: float) -> None:
+    SLOW_QUERIES.inc()
+    ent = {
+        "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "db": db,
+        "duration_ms": round(duration_s * 1e3, 1),
+        "sql": sql if len(sql) <= 4096 else sql[:4096] + "...",
+    }
+    with _slow_lock:
+        _slow_log.append(ent)
+    # the reference writes a structured slow log line (adapter.go:866)
+    log.warning("slow query (%.1fms) db=%s: %s",
+                duration_s * 1e3, db, ent["sql"][:400])
+
+
+def slow_queries() -> list[dict]:
+    with _slow_lock:
+        return list(_slow_log)

@@ -103,6 +103,9 @@ class PlanBuilder:
                 raise PlanError("HAVING without aggregation/group-by")
             if any(f.expr is not None and _contains_window(f.expr)
                    for f in stmt.fields):
+                if stmt.from_ is None:
+                    raise PlanError(
+                        "window functions require a FROM clause")
                 plan = self._build_windows(stmt, plan)
             plan = self._build_projection(stmt, plan)
 
@@ -1326,6 +1329,10 @@ def _union_ftype(a: FieldType, b: FieldType) -> FieldType:
     """Result type of a UNION column pair (conservative subset of MySQL's
     aggregation rules: same family merges; mixed families are rejected at
     plan time rather than silently coerced)."""
+    if a.kind == TypeKind.NULL:
+        return FieldType(b.kind, flen=b.flen, scale=b.scale)
+    if b.kind == TypeKind.NULL:
+        return FieldType(a.kind, flen=a.flen, scale=a.scale)
     if a.is_string and b.is_string:
         return FieldType(TypeKind.VARCHAR, flen=max(a.flen, b.flen))
     if a.is_float or b.is_float:

@@ -797,6 +797,14 @@ def _partial_val_type(d: AggDesc) -> FieldType:
 
 # ==================== explain ====================
 
+def explain_nodes(plan: PhysicalPlan, depth: int = 0):
+    """[(node, rendered line)] in display order."""
+    out = [(plan, explain_plan(plan, depth)[0])]
+    for c in plan.children:
+        out.extend(explain_nodes(c, depth + 1))
+    return out
+
+
 def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
     pad = "  " * depth
     name = type(plan).__name__

@@ -28,6 +28,7 @@ class Server:
         users: Optional[dict[str, str]] = None,
         allow_unknown_users: bool = True,
         max_connections: int = 512,
+        status_port: Optional[int] = None,
     ) -> None:
         self.storage = storage if storage is not None else Storage()
         self.host = host
@@ -43,6 +44,10 @@ class Server:
         self._next_conn_id = 1
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        # HTTP status/metrics port (reference: server/http_status.go;
+        # port 10080 by default there — here opt-in via status_port)
+        self.status_port = status_port
+        self._status_server = None
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -57,6 +62,12 @@ class Server:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mysql-accept", daemon=True)
         self._accept_thread.start()
+        if self.status_port is not None:
+            from .status import StatusServer
+            self._status_server = StatusServer(self.host, self.status_port,
+                                               sql_server=self)
+            self._status_server.start()
+            self.status_port = self._status_server.port
 
     def _accept_loop(self) -> None:
         assert self._listener is not None
@@ -72,6 +83,8 @@ class Server:
                 conn_id = self._next_conn_id
                 self._next_conn_id += 1
                 conn = ClientConn(self, sock, conn_id)
+                from .. import obs
+                obs.CONNECTIONS.inc()
                 self._conns[conn_id] = conn
             t = threading.Thread(target=conn.run,
                                  name=f"conn-{conn_id}", daemon=True)
@@ -97,6 +110,9 @@ class Server:
     def close(self, drain_timeout: float = 5.0) -> None:
         """Graceful shutdown: stop accepting, then drain/kill connections
         (reference: server/server.go:605 graceful down + :621 KillAll)."""
+        if self._status_server is not None:
+            self._status_server.close()
+            self._status_server = None
         self._shutdown.set()
         if self._listener is not None:
             try:

@@ -1,0 +1,65 @@
+"""HTTP status server: /status, /metrics, /slow-query.
+
+Counterpart of the reference's status port (reference:
+server/http_status.go:110-151 — /status JSON, /metrics Prometheus handler;
+default port 10080, tidb-server/main.go:144). Runs on a daemon thread
+beside the MySQL wire listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .. import obs
+
+
+class StatusServer:
+    def __init__(self, host: str, port: int, sql_server=None) -> None:
+        self.sql_server = sql_server
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = obs.METRICS.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/status":
+                    from . import conn as _conn
+                    body = json.dumps({
+                        "version": _conn.SERVER_VERSION,
+                        "connections": outer.sql_server.connection_count()
+                        if outer.sql_server else 0,
+                    }).encode()
+                    ctype = "application/json"
+                elif self.path == "/slow-query":
+                    body = json.dumps(obs.slow_queries()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="tidb-tpu-status")
+        self._thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

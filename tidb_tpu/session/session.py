@@ -68,13 +68,17 @@ class Session:
     def execute(self, sql: str) -> ResultSet:
         """Execute one or more ;-separated statements; returns the last
         statement's result."""
+        from .. import obs
+        import time as _time
+
         try:
             stmts = parse_sql(sql)
         except ParseError as e:
+            obs.QUERY_ERRORS.inc()
             raise SQLError(f"parse error: {e}") from None
         result = ResultSet([], [])
         for stmt in stmts:
-            result = self._execute_stmt(stmt)
+            result = self._execute_observed(stmt, sql)
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)
@@ -82,6 +86,33 @@ class Session:
         if self._stmt_seq % 64 == 0 and self.txn is None:
             self.storage.stats.auto_analyze(self.storage, self.catalog)
         return result
+
+    def _execute_observed(self, stmt: ast.Stmt, sql: str) -> ResultSet:
+        """Run one statement with metrics + slow-log accounting — shared by
+        the text protocol and COM_STMT_EXECUTE (reference: both paths pass
+        through ExecStmt in executor/adapter.go)."""
+        import time as _time
+
+        from .. import obs
+
+        t0 = _time.perf_counter()
+        obs.QUERIES.inc(type=type(stmt).__name__.removesuffix("Stmt"))
+        try:
+            return self._execute_stmt(stmt)
+        except Exception:
+            obs.QUERY_ERRORS.inc()
+            raise
+        finally:
+            dt = _time.perf_counter() - t0
+            obs.QUERY_SECONDS.observe(dt)
+            thresh = self.vars.get("tidb_slow_log_threshold",
+                                   obs.DEFAULT_SLOW_THRESHOLD_MS)
+            try:
+                thresh = float(thresh)
+            except (TypeError, ValueError):
+                thresh = obs.DEFAULT_SLOW_THRESHOLD_MS
+            if dt * 1e3 >= thresh:
+                obs.record_slow(sql, self.current_db, dt)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -121,7 +152,7 @@ class Session:
         bound = copy.deepcopy(stmt)
         if n_params:
             bound = _bind_params(bound, params)
-        return self._execute_stmt(bound)
+        return self._execute_observed(bound, f"EXECUTE stmt#{stmt_id}")
 
     def close_prepared(self, stmt_id: int) -> None:
         self._prepared.pop(stmt_id, None)
@@ -668,11 +699,35 @@ class Session:
 
     # ==================== EXPLAIN / SHOW ====================
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
-        if not isinstance(stmt.target, ast.SelectStmt):
+        if not isinstance(stmt.target, (ast.SelectStmt, ast.SetOpStmt)):
             raise SQLError("EXPLAIN supports SELECT only for now")
         plan = self._plan(stmt.target)
-        lines = explain_plan(plan)
-        return ResultSet(["plan"], [(line,) for line in lines])
+        if not stmt.analyze:
+            lines = explain_plan(plan)
+            return ResultSet(["plan"], [(line,) for line in lines])
+        # EXPLAIN ANALYZE: run the plan with per-node runtime stats
+        # (reference: util/execdetails RuntimeStatsColl feeding the
+        # explain output, executor/executor.go:262)
+        from .. import obs
+        from ..plan.physical import explain_nodes
+
+        coll = obs.RuntimeStatsColl()
+
+        def run():
+            ctx = ExecContext(self._ensure_txn(), self.cop, stats=coll)
+            return run_physical(plan, ctx)
+
+        self._run_in_txn(run)
+        rows = []
+        for node, line in explain_nodes(plan):
+            st = coll.for_plan(node)
+            if st is None:
+                rows.append((line, None, None, ""))
+            else:
+                rows.append((line, st["rows"],
+                             round(st["time"] * 1e3, 2),
+                             st["engine"] or ""))
+        return ResultSet(["plan", "actRows", "time_ms", "engine"], rows)
 
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
         if stmt.kind == "TABLES":
@@ -697,6 +752,20 @@ class Session:
         if stmt.kind == "VARIABLES":
             return ResultSet(["Variable_name", "Value"],
                              sorted(self.vars.items()))
+        if stmt.kind == "SLOW":
+            from .. import obs
+            rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"])
+                    for e in obs.slow_queries()]
+            return ResultSet(["Time", "DB", "Duration_ms", "Query"], rows)
+        if stmt.kind == "METRICS":
+            from .. import obs
+            rows = []
+            for line in obs.METRICS.render().splitlines():
+                if line.startswith("#") or not line.strip():
+                    continue
+                name, _, val = line.rpartition(" ")
+                rows.append((name, val))
+            return ResultSet(["Metric", "Value"], rows)
         raise SQLError(f"unsupported SHOW {stmt.kind}")
 
     # ==================== helpers ====================

@@ -645,6 +645,16 @@ class Parser:
             return ast.ShowStmt("TABLES")
         if self.accept_kw("DATABASES"):
             return ast.ShowStmt("DATABASES")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "SLOW":
+            self.advance()
+            if self.cur.kind == TokenKind.IDENT:
+                self.advance()  # optional QUERIES
+            return ast.ShowStmt("SLOW")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "METRICS":
+            self.advance()
+            return ast.ShowStmt("METRICS")
         if self.accept_kw("CREATE"):
             self.expect_kw("TABLE")
             return ast.ShowStmt("CREATE_TABLE", self.parse_table_name())

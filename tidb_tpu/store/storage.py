@@ -147,6 +147,8 @@ class Storage:
             try:
                 commit_ts = self.committer.commit(kv_muts, txn.start_ts)
             except KVWriteConflict as e:
+                from .. import obs
+                obs.CONFLICTS.inc()
                 self._best_effort_rollback(kv_muts, txn.start_ts)
                 raise WriteConflictError(str(e)) from None
             except (KVError, CommitError) as e:
@@ -159,6 +161,8 @@ class Storage:
                 store = self.tables.get(table_id)
                 if store is not None:
                     store.apply_commit(commit_ts, handle, row)
+        from .. import obs
+        obs.COMMITS.inc()
         # opportunistic compaction at the GC-safe ts
         safe = self.safe_ts()
         for (table_id, _), _ in mutations.items():
