@@ -46,3 +46,58 @@ class TestShardedAgg:
         assert n == N_ROWS + 1
         assert single.query("select count(*) from lineitem")[0][0] == \
             N_ROWS + 1
+
+
+def test_dist_fragment_join_agg_device_path(monkeypatch):
+    """Join fragments run probe-sharded with replicated build tables under
+    the mesh — device path, no host fallback (VERDICT: shard the rest of
+    the distributed tier)."""
+    import numpy as np
+
+    import tidb_tpu.copr.fragment as F
+    from tidb_tpu.parallel import DistCopClient, make_mesh
+    from tidb_tpu.session import Session
+
+    def boom(frag, snaps):
+        raise AssertionError("host fragment fallback under mesh")
+    monkeypatch.setattr(F, "_host_fragment", boom)
+
+    single = Session()
+    single.execute("CREATE TABLE d (k INT NOT NULL PRIMARY KEY, "
+                   "g VARCHAR(4))")
+    single.execute("CREATE TABLE f (id INT NOT NULL PRIMARY KEY, k INT, "
+                   "v DECIMAL(8,2))")
+    single.execute("INSERT INTO d VALUES (1,'a'),(2,'b'),(3,'a')")
+    rows = ",".join(f"({i},{(i % 3) + 1},{i % 40}.50)" for i in range(900))
+    single.execute("INSERT INTO f VALUES " + rows)
+    safe = single.storage.safe_ts()
+    for st in single.storage.tables.values():
+        st.compact(safe)
+
+    mesh = make_mesh(jax.devices()[:8])
+    dist = Session(single.storage, cop=DistCopClient(mesh))
+    q = ("SELECT g, SUM(v), COUNT(*), MIN(v), MAX(v) FROM f, d "
+         "WHERE f.k = d.k GROUP BY g ORDER BY g")
+    got = dist.query(q)
+    monkeypatch.undo()
+    want = single.query(q)
+    assert got == want
+
+
+def test_dist_topn_and_rows(monkeypatch):
+    import tidb_tpu.copr.fragment as F  # noqa: F401
+    from tidb_tpu.parallel import DistCopClient, make_mesh
+    from tidb_tpu.session import Session
+
+    single = Session()
+    single.execute("CREATE TABLE s (a INT NOT NULL PRIMARY KEY, b INT)")
+    rows = ",".join(f"({i},{(i * 37) % 1000})" for i in range(2000))
+    single.execute("INSERT INTO s VALUES " + rows)
+    safe = single.storage.safe_ts()
+    for st in single.storage.tables.values():
+        st.compact(safe)
+    mesh = make_mesh(jax.devices()[:8])
+    dist = Session(single.storage, cop=DistCopClient(mesh))
+    for q in ("SELECT a, b FROM s ORDER BY b DESC, a LIMIT 9",
+              "SELECT a FROM s WHERE b < 50 ORDER BY a"):
+        assert dist.query(q) == single.query(q), q

@@ -593,6 +593,21 @@ class CopClient:
                     self._mask_cache[vis_key] = vis
         return dev_cols, vis, host_cols, snap.base_visible
 
+    # ---- fragment placement/compilation hooks (the distributed client
+    # overrides these: probe shards over the mesh, build tables replicate
+    # — the MPP broadcast-join placement, store/tikv/batch_coprocessor.go
+    # analog) ----
+    supports_hc = True
+
+    def _stage_build_table(self, facade, snap):
+        return self._stage_inputs(facade, snap, overlay=False)
+
+    def _place_build_array(self, arr, key=None):
+        return arr
+
+    def _frag_jit(self, kernel, mode, prepared):
+        return jax.jit(kernel)
+
     def _kernel(self, key, build):
         with self._lock:
             k = self._kernels.get(key)
@@ -672,9 +687,11 @@ class CopClient:
         return self._host_rows(dag, snap, host_cols, idx)
 
     def _build_rowmask_kernel(self, dag, prepared):
+        return jax.jit(self._rowmask_body(dag, prepared))
+
+    def _rowmask_body(self, dag, prepared):
         sel = dag.selection
 
-        @jax.jit
         def kernel(cols, row_mask):
             mask = selection_mask(sel.conditions, cols, prepared, row_mask)
             return jnp.packbits(mask)
@@ -748,6 +765,9 @@ class CopClient:
         return [Chunk(columns)]
 
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):
+        return jax.jit(self._topn_body(dag, prepared, expr, desc, n))
+
+    def _topn_body(self, dag, prepared, expr, desc, n):
         sel = dag.selection
         projections = dag.projections
         if projections is not None:
@@ -760,7 +780,6 @@ class CopClient:
             exprs = [Col(ci, ft) for ci, ft in enumerate(dag.output_types)]
         out_types = dag.output_types
 
-        @jax.jit
         def kernel(cols, row_mask):
             mask = row_mask
             if sel is not None:

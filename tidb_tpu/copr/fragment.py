@@ -151,16 +151,23 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                 raise _Fallback()
             mode = "hc"
 
+    if mode == "hc" and not getattr(cop, "supports_hc", True):
+        # sorted-run candidates are per-shard partial groups; a group can
+        # span shards, so the distributed client routes hc to the host
+        raise _Fallback()
+
     # ---- staging ----
     builds = []
     for ji, j in enumerate(frag.joins):
         t = frag.tables[j.build]
         snap = snaps[t.table.id]
-        cols, vis, host_cols, host_mask = cop._stage_inputs(
-            _facade_dag(t), snap, overlay=False)
+        cols, vis, host_cols, host_mask = cop._stage_build_table(
+            _facade_dag(t), snap)
         lo, span = spans[ji]
-        perm = _perm_array(cop, snap, t.col_offsets[j.build_key_local],
-                           lo, span, host_mask)
+        key_off = t.col_offsets[j.build_key_local]
+        perm = _perm_array(cop, snap, key_off, lo, span, host_mask)
+        perm = cop._place_build_array(
+            perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo, span))
         builds.append({"cols": cols, "vis": vis, "perm": perm})
 
     chunks: list[Chunk] = []
@@ -238,8 +245,9 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     key = ("frag", _frag_key(frag), _sig(prepared), mode,
            pcols[0][0].shape[0] if pcols else 0,
            tuple(b["cols"][0][0].shape[0] for b in builds))
-    kern = cop._kernel(key, lambda: _build_frag_kernel(
-        frag, prepared, spans, mode))
+    kern = cop._kernel(key, lambda: cop._frag_jit(
+        _build_frag_kernel(frag, prepared, spans, mode, raw=True),
+        mode, prepared))
     out = jax.device_get(kern(pcols, pvis, builds))
 
     if mode == "hc":
@@ -379,7 +387,7 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
     return True
 
 
-def _build_frag_kernel(frag, prepared, spans, mode):
+def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
     sel = frag.selection
     agg = frag.agg
     if mode == "agg":
@@ -423,7 +431,7 @@ def _build_frag_kernel(frag, prepared, spans, mode):
             return _hc_body(frag, prepared, cols, mask)
         return jnp.packbits(mask)
 
-    return jax.jit(kernel)
+    return kernel if raw else jax.jit(kernel)
 
 
 def _hc_body(frag, prepared, cols, mask):

@@ -55,24 +55,9 @@ class DistCopClient(CopClient):
     def _build_agg_kernel(self, dag, prepared, cards, segments):
         body = self._agg_kernel_body(dag, prepared, cards, segments)
         sched = prepared["__agg_sched__"]
-        minmax_kind = {f"m{ai}": s["kind"]
-                       for ai, s in enumerate(sched)
-                       if s["kind"] in ("min", "max")}
 
         def sharded(cols, row_mask):
-            out = body(cols, row_mask)
-            merged = {}
-            for key, val in out.items():
-                kind = minmax_kind.get(key)
-                if kind == "min":
-                    merged[key] = jax.lax.pmin(val, AXIS)
-                elif kind == "max":
-                    merged[key] = jax.lax.pmax(val, AXIS)
-                else:
-                    # limb partials / counts (int32, exact under addition)
-                    # and float block sums — both additive
-                    merged[key] = jax.lax.psum(val, AXIS)
-            return merged
+            return _collective_merge(body(cols, row_mask), sched)
 
         # every output is replicated post-collective; a single P() acts
         # as a pytree prefix matching every leaf of the output dict
@@ -85,10 +70,13 @@ class DistCopClient(CopClient):
         return jax.jit(mapped)
 
     def _bucket_size(self, n: int) -> int:
-        """Round the shape bucket up to a multiple of the mesh size so the
-        rows axis always shards evenly (any device count, not just 2^k)."""
+        """Round the shape bucket so the rows axis shards evenly AND each
+        shard is a multiple of 8 rows — per-shard jnp.packbits pads to
+        byte boundaries, and concatenating padded shard masks would shift
+        every later shard's rows (seen at 64+ devices where lcm(256, n)
+        alone leaves 4-row shards)."""
         b = super()._bucket_size(n)
-        lcm = int(np.lcm(256, self._n))
+        lcm = int(np.lcm(256, 8 * self._n))
         return -(-b // lcm) * lcm
 
     def _stage_inputs(self, dag, snap, overlay: bool):
@@ -103,3 +91,102 @@ class DistCopClient(CopClient):
         ]
         row_mask = jax.device_put(row_mask, sharding)
         return cols, row_mask, host_cols, host_mask
+
+    # ---- fragment placement: probe shards, build tables replicate ------
+    # (broadcast-join placement — the MPP broadcast exchange mode,
+    # reference: planner/core/fragment.go broadcast vs hash partition)
+    supports_hc = False  # per-shard sorted runs split groups across shards
+
+    def _stage_build_table(self, facade, snap):
+        cols, vis, host_cols, host_mask = CopClient._stage_inputs(
+            self, facade, snap, overlay=False)
+        b = vis.shape[0]
+        eid = snap.epoch.epoch_id
+        rep_cols = []
+        for off, (d, v) in zip(facade.scan.col_offsets, cols):
+            rep_cols.append((
+                self._replicated((eid, "repc", off, b), d),
+                self._replicated((eid, "repv", off, b), v)))
+        from ..copr.client import _mask_digest
+        vis = self._replicated(
+            (eid, "repvis", b, _mask_digest(host_mask)), vis)
+        return rep_cols, vis, host_cols, host_mask
+
+    def _place_build_array(self, arr, key=None):
+        # perm arrays are cached device-resident per epoch; replicate once
+        # under an epoch-led key so _evict_stale reclaims the broadcast
+        if key is None:
+            return jax.device_put(arr, NamedSharding(self.mesh, P()))
+        return self._replicated(key, arr)
+
+    def _replicated(self, key, arr):
+        """Broadcast once per epoch, then reuse: re-placing cached arrays
+        every query would pay a full mesh transfer per fragment run."""
+        with self._lock:
+            hit = self._col_cache.get(key)
+        if hit is not None:
+            return hit
+        placed = jax.device_put(arr, NamedSharding(self.mesh, P()))
+        with self._lock:
+            self._col_cache[key] = placed
+        return placed
+
+    def _frag_jit(self, kernel, mode, prepared):
+        """shard_map the fragment body: probe rows sharded, builds
+        replicated; agg partials merge with native-int32 collectives, row
+        bitmasks concatenate along the rows axis."""
+        if mode == "agg":
+            sched = prepared["__agg_sched__"]
+
+            def merged(pcols, pvis, builds):
+                return _collective_merge(kernel(pcols, pvis, builds), sched)
+
+            mapped = jax.shard_map(
+                merged, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), P()),
+                out_specs=P())
+            return jax.jit(mapped)
+        # row mode: per-shard packed bitmask; shards are 256-multiples so
+        # byte boundaries align and concatenation is the global mask
+        mapped = jax.shard_map(
+            kernel, mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS), P()),
+            out_specs=P(AXIS))
+        return jax.jit(mapped)
+
+    # ---- TopN: local top-k per shard, host merge ------------------------
+    def _build_topn_kernel(self, dag, prepared, expr, desc, n):
+        raw = self._topn_body(dag, prepared, expr, desc, n)
+        mapped = jax.shard_map(
+            raw, mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS)),
+            # per-shard candidate columns concatenate along the k axis;
+            # the host PhysSort+PhysLimit above merge exactly
+            out_specs=P(None, AXIS))
+        return jax.jit(mapped)
+
+    def _build_rowmask_kernel(self, dag, prepared):
+        raw = self._rowmask_body(dag, prepared)
+        mapped = jax.shard_map(
+            raw, mesh=self.mesh,
+            in_specs=(P(AXIS), P(AXIS)),
+            out_specs=P(AXIS))
+        return jax.jit(mapped)
+
+
+def _collective_merge(out: dict, sched) -> dict:
+    """Merge per-shard agg partials over the mesh axis: pmin/pmax for
+    min/max keys, psum for everything else (int32 limb partials and float
+    block sums are both additive)."""
+    minmax_kind = {f"m{ai}": s["kind"] for ai, s in enumerate(sched)
+                   if s["kind"] in ("min", "max")}
+    res = {}
+    for key, val in out.items():
+        kind = minmax_kind.get(key)
+        if kind == "min":
+            res[key] = jax.lax.pmin(val, AXIS)
+        elif kind == "max":
+            res[key] = jax.lax.pmax(val, AXIS)
+        else:
+            res[key] = jax.lax.psum(val, AXIS)
+    return res

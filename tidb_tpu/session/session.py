@@ -69,7 +69,6 @@ class Session:
         """Execute one or more ;-separated statements; returns the last
         statement's result."""
         from .. import obs
-        import time as _time
 
         try:
             stmts = parse_sql(sql)
@@ -77,8 +76,10 @@ class Session:
             obs.QUERY_ERRORS.inc()
             raise SQLError(f"parse error: {e}") from None
         result = ResultSet([], [])
-        for stmt in stmts:
-            result = self._execute_observed(stmt, sql)
+        for i, stmt in enumerate(stmts):
+            label = sql if len(stmts) == 1 else \
+                f"[stmt {i + 1}/{len(stmts)}] {sql}"
+            result = self._execute_observed(stmt, label)
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)

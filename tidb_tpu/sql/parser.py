@@ -648,8 +648,9 @@ class Parser:
         if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "SLOW":
             self.advance()
-            if self.cur.kind == TokenKind.IDENT:
-                self.advance()  # optional QUERIES
+            if self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "QUERIES":
+                self.advance()
             return ast.ShowStmt("SLOW")
         if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "METRICS":
