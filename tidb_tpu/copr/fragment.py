@@ -167,7 +167,8 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         key_off = t.col_offsets[j.build_key_local]
         perm = _perm_array(cop, snap, key_off, lo, span, host_mask)
         perm = cop._place_build_array(
-            perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo, span))
+            perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo, span,
+                       _mask_digest_of(host_mask)))
         builds.append({"cols": cols, "vis": vis, "perm": perm})
 
     chunks: list[Chunk] = []
@@ -183,6 +184,11 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         chunks = [_empty_chunk(frag, comb_dicts)]
     return CopResult(chunks, is_partial_agg=frag.agg is not None,
                      engine=f"device[{mode}]")
+
+
+def _mask_digest_of(mask):
+    from .client import _mask_digest
+    return _mask_digest(mask)
 
 
 def _facade_dag(t):

@@ -112,9 +112,18 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         result = ctx.cop.execute(plan.dag, snap)
         if engine_tag is not None:
             engine_tag[0] = result.engine
-        if not result.chunks:
-            return _empty_like(plan)
-        return Chunk.concat(result.chunks)
+        out = Chunk.concat(result.chunks) if result.chunks else \
+            _empty_like(plan)
+        if plan.dag.agg is None and plan.dag.topn is None and \
+                plan.dag.limit is None and plan.dag.selection is not None:
+            # scan-count feedback: the observed row count corrects the
+            # histogram estimate for this exact conjunct set (reference:
+            # statistics/feedback.go + handle/update.go:551)
+            from ..plan.physical import conds_digest
+            ctx.txn.storage.stats.record_feedback(
+                plan.dag.scan.table_id,
+                conds_digest(plan.dag.selection.conditions), out.num_rows)
+        return out
     from ..plan.fragment import PhysFragmentRead
     if isinstance(plan, PhysFragmentRead):
         from ..copr.fragment import execute_fragment

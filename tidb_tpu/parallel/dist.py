@@ -102,14 +102,18 @@ class DistCopClient(CopClient):
             self, facade, snap, overlay=False)
         b = vis.shape[0]
         eid = snap.epoch.epoch_id
+        with self._lock:
+            cacheable = self._live_epochs.get(
+                facade.scan.table_id) == eid
         rep_cols = []
         for off, (d, v) in zip(facade.scan.col_offsets, cols):
             rep_cols.append((
-                self._replicated((eid, "repc", off, b), d),
-                self._replicated((eid, "repv", off, b), v)))
+                self._replicated((eid, "repc", off, b), d, cacheable),
+                self._replicated((eid, "repv", off, b), v, cacheable)))
         from ..copr.client import _mask_digest
         vis = self._replicated(
-            (eid, "repvis", b, _mask_digest(host_mask)), vis)
+            (eid, "repvis", b, _mask_digest(host_mask)), vis, cacheable)
+        self._frag_cacheable = cacheable
         return rep_cols, vis, host_cols, host_mask
 
     def _place_build_array(self, arr, key=None):
@@ -117,18 +121,22 @@ class DistCopClient(CopClient):
         # under an epoch-led key so _evict_stale reclaims the broadcast
         if key is None:
             return jax.device_put(arr, NamedSharding(self.mesh, P()))
-        return self._replicated(key, arr)
+        return self._replicated(key, arr,
+                                getattr(self, "_frag_cacheable", True))
 
-    def _replicated(self, key, arr):
+    def _replicated(self, key, arr, cacheable: bool = True):
         """Broadcast once per epoch, then reuse: re-placing cached arrays
-        every query would pay a full mesh transfer per fragment run."""
+        every query would pay a full mesh transfer per fragment run. A
+        snapshot on an already-superseded epoch must not seed entries the
+        one-shot eviction transition will never reclaim."""
         with self._lock:
             hit = self._col_cache.get(key)
         if hit is not None:
             return hit
         placed = jax.device_put(arr, NamedSharding(self.mesh, P()))
-        with self._lock:
-            self._col_cache[key] = placed
+        if cacheable:
+            with self._lock:
+                self._col_cache[key] = placed
         return placed
 
     def _frag_jit(self, kernel, mode, prepared):

@@ -594,10 +594,21 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
     return None
 
 
+def conds_digest(conditions: list[PlanExpr]) -> str:
+    """Stable identity of a conjunct set (feedback keying)."""
+    return "&".join(sorted(repr(c) for c in conditions))
+
+
 def _est_selection_rows(table, scan_offsets: list[int],
                         conditions: list[PlanExpr], stats) -> Optional[float]:
     """Conjunct-product cardinality estimate for EXPLAIN (reference:
-    statistics/selectivity.go — simplified to per-column independence)."""
+    statistics/selectivity.go — simplified to per-column independence).
+    An actual-execution feedback record for the same conjunct set
+    overrides the histogram estimate (statistics/feedback.go)."""
+    if stats is not None:
+        fb = stats.feedback_rows(table.id, conds_digest(conditions))
+        if fb is not None:
+            return float(fb)
     ts = stats.table_stats(table.id) if stats is not None else None
     if ts is None:
         return None

@@ -83,6 +83,10 @@ class StatsHandle:
         self.tables: dict[int, TableStats] = {}
         # modify counts at last ANALYZE, per table id
         self._analyzed_at_modify: dict[int, int] = {}
+        # (table_id, condition digest) -> observed row count from actual
+        # executions (reference: statistics/feedback.go — scan-count
+        # feedback correcting the histogram-based estimate)
+        self.feedback: dict[tuple[int, str], float] = {}
 
     # ---- build ------------------------------------------------------------
     def build_table(self, info: TableInfo, snap) -> TableStats:
@@ -132,11 +136,65 @@ class StatsHandle:
         try:
             ts = self.build_table(info, txn.snapshot(info.id))
             self._analyzed_at_modify[info.id] = store.modify_count
+            # fresh stats supersede stale observation feedback
+            self.clear_feedback(info.id)
+            try:
+                self.save_to_kv(storage, info.id)
+            except Exception:
+                pass  # persistence is best-effort; memory stats serve
             return ts
         finally:
             txn.rollback()
 
+    # ---- persistence (reference: statistics/handle/handle.go saves to
+    # mysql.stats_* tables; here the meta-KV plane) ----------------------
+    def save_to_kv(self, storage, table_id: int) -> None:
+        import pickle
+
+        ts = self.tables.get(table_id)
+        if ts is None:
+            return
+        payload = (ts, self._analyzed_at_modify.get(table_id, 0))
+        storage.put_meta(b"stats:%d" % table_id, pickle.dumps(payload))
+
+    def load_from_kv(self, storage, catalog) -> int:
+        """Restore persisted stats for every known table; returns count.
+        The analog of the stats handle's boot-time load
+        (statistics/handle/bootstrap.go)."""
+        import pickle
+
+        n = 0
+        for schema in catalog.schemas.values():
+            for info in schema.tables.values():
+                raw = storage.get_meta(b"stats:%d" % info.id)
+                if raw is not None:
+                    ts, watermark = pickle.loads(raw)
+                    self.tables[info.id] = ts
+                    # restore the analyze watermark too, else auto-analyze
+                    # immediately rebuilds what the reload just restored
+                    self._analyzed_at_modify[info.id] = watermark
+                    n += 1
+        return n
+
+    # ---- execution feedback --------------------------------------------
+    FEEDBACK_CAP = 4096  # distinct conjunct sets retained (process-wide)
+
+    def record_feedback(self, table_id: int, digest: str,
+                        actual_rows: float) -> None:
+        if len(self.feedback) >= self.FEEDBACK_CAP:
+            # drop the oldest observation (insertion-ordered dict)
+            self.feedback.pop(next(iter(self.feedback)))
+        self.feedback[(table_id, digest)] = actual_rows
+
+    def feedback_rows(self, table_id: int, digest: str):
+        return self.feedback.get((table_id, digest))
+
+    def clear_feedback(self, table_id: int) -> None:
+        for k in [k for k in self.feedback if k[0] == table_id]:
+            del self.feedback[k]
+
     def drop_table(self, table_id: int) -> None:
+        self.clear_feedback(table_id)
         self.tables.pop(table_id, None)
         self._analyzed_at_modify.pop(table_id, None)
 

@@ -171,6 +171,20 @@ class Storage:
                 store.maybe_compact(min(safe, commit_ts - 1) if safe else 0)
         return commit_ts
 
+    # ---- meta KV (schema/stats persistence plane) ----------------------
+    def put_meta(self, name: bytes, value: bytes) -> None:
+        """Durable metadata write through the SAME percolator path as row
+        data (reference: meta/meta.go over the m-prefix keyspace)."""
+        key = tablecodec.meta_key(name)
+        start_ts = self.tso.next_ts()
+        with self._commit_lock:
+            self.committer.commit([Mutation(OP_PUT, key, value)], start_ts)
+
+    def get_meta(self, name: bytes) -> Optional[bytes]:
+        from ..kv.twopc import Snapshot
+        snap = Snapshot(self.rm, self.tso, self.tso.next_ts())
+        return snap.get(tablecodec.meta_key(name))
+
     def _best_effort_rollback(self, kv_muts, start_ts: int) -> None:
         """Clear any prewrite locks a failed commit left behind (the lock
         resolver would also reclaim them by TTL — this is just prompt)."""
