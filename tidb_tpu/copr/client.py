@@ -76,6 +76,15 @@ MAX_DENSE_SEGMENTS = 1 << 13
 
 _FLOAT_BLOCKS = 32  # per-segment f32 block partials (host sums in f64)
 
+# rows per device tile: epochs larger than this stream through the fused
+# kernels as fixed-shape tiles whose partials merge exactly like per-shard
+# partials (the region-task split of the reference coprocessor,
+# store/tikv/coprocessor.go:248 buildCopTasks, as static-shape slices —
+# one compiled kernel serves every tile)
+import os as _os
+
+TILE_ROWS_DEFAULT = int(_os.environ.get("TIDB_TPU_TILE_ROWS", 1 << 22))
+
 
 def _bucket(n: int) -> int:
     """Static shape bucket: smallest of {2^k, 1.5*2^k} >= max(n, 256)."""
@@ -102,6 +111,8 @@ class CopResult:
 
 
 class CopClient:
+    TILE_ROWS = TILE_ROWS_DEFAULT
+
     def __init__(self) -> None:
         # (epoch_id, offset, bucket) -> (device data, device valid)
         self._col_cache: dict[tuple, tuple[Any, Any]] = {}
@@ -130,9 +141,12 @@ class CopClient:
             self._live_epochs[table_id] = epoch_id
             if old is None:
                 return
-            for k in [k for k in self._col_cache if k[0] == old]:
+            def stale(k) -> bool:  # plain or "tile"-prefixed cache keys
+                return k[0] == old or (k[0] == "tile" and k[1] == old)
+
+            for k in [k for k in self._col_cache if stale(k)]:
                 del self._col_cache[k]
-            for k in [k for k in self._mask_cache if k[0] == old]:
+            for k in [k for k in self._mask_cache if stale(k)]:
                 del self._mask_cache[k]
             for k in [k for k in self._stats if k[0] == old]:
                 del self._stats[k]
@@ -513,15 +527,98 @@ class CopClient:
         prepared: dict[Any, Any],
         overlay: bool,
     ) -> list[Chunk]:
-        cols, row_mask, host_cols, host_mask = self._stage_inputs(
-            dag, snap, overlay)
+        if overlay:
+            cols, row_mask, host_cols, host_mask = self._stage_inputs(
+                dag, snap, overlay=True)
+            tiles = [(cols, row_mask, len(snap.overlay_handles))]
+        else:
+            tiles = self._stage_tiles(dag, snap)
+            host_cols = host_mask = None  # lazily built by the row path
         if dag.agg is not None:
-            return self._run_agg(dag, snap, prepared, cols, row_mask)
+            return self._run_agg(dag, snap, prepared, tiles)
+        if overlay is False:
+            host_cols, host_mask = self._host_view(dag, snap)
         if dag.topn is not None:
-            return self._run_topn(dag, snap, prepared, cols, row_mask,
-                                  host_cols)
-        return self._run_rows(dag, snap, prepared, cols, row_mask, host_cols,
+            return self._run_topn(dag, snap, prepared, tiles)
+        return self._run_rows(dag, snap, prepared, tiles, host_cols,
                               host_mask)
+
+    def _host_view(self, dag: CopDAG, snap: TableSnapshot):
+        """Host numpy views of the epoch's scan columns (row-path
+        projection input); validity stays None when all-valid so big
+        epochs never allocate full ones-masks per query."""
+        epoch = snap.epoch
+        host_cols = [
+            (epoch.columns[off], epoch.valids[off])
+            for off in dag.scan.col_offsets
+        ]
+        return host_cols, snap.base_visible
+
+    def _stage_tiles(self, dag: CopDAG, snap: TableSnapshot):
+        """Device tiles covering the base epoch: [(dev_cols, vis, n_rows)].
+
+        Epochs at or below TILE_ROWS stage as the single cached tile of
+        _stage_inputs (keeps the SF1-scale path and its cache keys intact);
+        larger epochs split into TILE_ROWS slices all padded to ONE shape
+        bucket, so a single compiled kernel serves every tile and the
+        per-tile partials merge exactly like per-shard partials."""
+        epoch = snap.epoch
+        n = epoch.num_rows
+        if n <= self.TILE_ROWS:
+            cols, vis, _, _ = self._stage_inputs(dag, snap, overlay=False)
+            return [(cols, vis, n)]
+        T = self.TILE_ROWS
+        b = self._bucket_size(T)
+        with self._lock:
+            cacheable = self._live_epochs.get(dag.scan.table_id) \
+                == epoch.epoch_id
+        tiles = []
+        vis_digest = _mask_digest(snap.base_visible)
+        with self._lock:
+            # evict masks of superseded visibility states (same epoch+bucket,
+            # different digest) — one live mask set per epoch
+            for k in [k for k in self._mask_cache
+                      if k[0] == "tile" and k[1] == epoch.epoch_id
+                      and k[2] == b and k[3] != vis_digest]:
+                del self._mask_cache[k]
+        for ti in range(-(-n // T)):
+            lo = ti * T
+            cnt = min(lo + T, n) - lo
+            dev_cols = []
+            for off in dag.scan.col_offsets:
+                key = ("tile", epoch.epoch_id, off, b, ti)
+                with self._lock:
+                    cached = self._col_cache.get(key)
+                if cached is None:
+                    data = epoch.columns[off][lo:lo + cnt]
+                    valid = epoch.valids[off]
+                    vslice = np.ones(cnt, bool) if valid is None \
+                        else valid[lo:lo + cnt]
+                    cached = self._place_cols(
+                        jnp.asarray(_pad(_narrow(data), b)),
+                        jnp.asarray(_pad_bool(vslice, b)))
+                    if cacheable:
+                        with self._lock:
+                            self._col_cache[key] = cached
+                dev_cols.append(cached)
+            vkey = ("tile", epoch.epoch_id, b, vis_digest, ti)
+            with self._lock:
+                vis = self._mask_cache.get(vkey)
+            if vis is None:
+                vis = self._place_mask(jnp.asarray(
+                    _pad_bool(snap.base_visible[lo:lo + cnt], b)))
+                if cacheable:
+                    with self._lock:
+                        self._mask_cache[vkey] = vis
+            tiles.append((dev_cols, vis, cnt))
+        return tiles
+
+    # placement hooks: the distributed client shards tile rows over the mesh
+    def _place_cols(self, data, valid):
+        return data, valid
+
+    def _place_mask(self, mask):
+        return mask
 
     def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool):
         """Pad + upload scan columns as 32-bit device buffers; returns device
@@ -529,13 +626,7 @@ class CopClient:
         views, and the host-side visibility mask (so paths that need no
         device work never touch the device)."""
         offsets = dag.scan.col_offsets
-
-        def narrow(a: np.ndarray) -> np.ndarray:
-            if a.dtype == np.int64:
-                return a.astype(np.int32)
-            if a.dtype == np.float64:
-                return a.astype(np.float32)
-            return a
+        narrow = _narrow
 
         if overlay:
             n = len(snap.overlay_handles)
@@ -590,6 +681,13 @@ class CopClient:
             vis = jnp.asarray(_pad_bool(snap.base_visible, b))
             if cacheable:
                 with self._lock:
+                    # one live mask per (epoch, bucket): every delete/update
+                    # changes the digest, and stale masks would pin HBM
+                    # until the epoch is superseded
+                    for k in [k for k in self._mask_cache
+                              if k[:2] == (epoch.epoch_id, b)
+                              and k != vis_key]:
+                        del self._mask_cache[k]
                     self._mask_cache[vis_key] = vis
         return dev_cols, vis, host_cols, snap.base_visible
 
@@ -618,18 +716,21 @@ class CopClient:
         return k
 
     # ---- aggregation path ---------------------------------------------------
-    def _run_agg(self, dag, snap, prepared, cols, row_mask) -> list[Chunk]:
+    def _run_agg(self, dag, snap, prepared, tiles) -> list[Chunk]:
         agg = dag.agg
         cards: list[int] = prepared["__dense_cards__"]
-        key = ("agg", _dag_key(dag, prepared), cols[0][0].shape[0]
-               if cols else 0, tuple(cards))
+        bucket = tiles[0][1].shape[0]
+        key = ("agg", _dag_key(dag, prepared), bucket, tuple(cards))
         segments = 1
         for c in cards:
             segments *= max(c, 1)
         kern = self._kernel(key, lambda: self._build_agg_kernel(
             dag, prepared, cards, segments))
-        # single synchronous device round trip for the whole query
-        out = jax.device_get(kern(cols, row_mask))
+        # dispatches are async and pipeline on the link; ONE device_get
+        # fetches every tile's partials in a single round trip
+        devs = [kern(cols, vis) for cols, vis, _ in tiles]
+        outs = jax.device_get(devs)
+        out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         group_dicts = [
             snap.dictionaries[dag.scan.col_offsets[g.idx]]
             if g.ftype.is_string and isinstance(g, Col) else None
@@ -661,26 +762,28 @@ class CopClient:
         return kernel
 
     # ---- row path (scan/selection/projection) -------------------------------
-    def _run_rows(self, dag, snap, prepared, cols, row_mask, host_cols,
-                  host_mask):
+    def _run_rows(self, dag, snap, prepared, tiles, host_cols, host_mask):
         """Device evaluates the (fused) filter and returns ONLY a packed
-        bitmask — one small buffer; projections are computed host-side over
-        the selected subset (numpy over the epoch's host columns). Full-width
-        device outputs would pay the device->host transfer for every row."""
+        bitmask — one small buffer per tile; projections are computed
+        host-side over the selected subset (numpy over the epoch's host
+        columns). Full-width device outputs would pay the device->host
+        transfer for every row."""
         if dag.selection is None:
             # pure scan: nothing for the device to do — host mask suffices
             idx = np.nonzero(host_mask)[0]
             if dag.limit is not None and len(idx) > dag.limit.n:
                 idx = idx[: dag.limit.n]
             return self._host_rows(dag, snap, host_cols, idx)
-        key = ("rowmask", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0)
+        bucket = tiles[0][1].shape[0]
+        key = ("rowmask", _dag_key(dag, prepared), bucket)
         kern = self._kernel(key, lambda: self._build_rowmask_kernel(
             dag, prepared))
-        packed = jax.device_get(kern(cols, row_mask))
-        n_rows = host_cols[0][0].shape[0] if host_cols else 0
-        mask = np.unpackbits(packed, count=None).astype(bool)[: n_rows] \
-            if n_rows else np.zeros(0, bool)
+        packs = jax.device_get([kern(cols, vis) for cols, vis, _ in tiles])
+        parts = [
+            np.unpackbits(packed, count=None).astype(bool)[:cnt]
+            for packed, (_, _, cnt) in zip(packs, tiles)
+        ]
+        mask = np.concatenate(parts) if parts else np.zeros(0, bool)
         idx = np.nonzero(mask)[0]
         if dag.limit is not None and len(idx) > dag.limit.n:
             idx = idx[: dag.limit.n]
@@ -702,9 +805,13 @@ class CopClient:
         """Project the selected rows host-side (numpy)."""
         dicts = self._scan_dicts(dag, snap)
         columns = []
+        k = len(idx)
         if dag.projections is not None:
-            sub = [(d[idx], v[idx]) for d, v in host_cols]
-            ev = NumpyEval(sub, dicts, len(idx))
+            sub = [
+                (d[idx], np.ones(k, bool) if v is None else v[idx])
+                for d, v in host_cols
+            ]
+            ev = NumpyEval(sub, dicts, k)
             for pi, e in enumerate(dag.projections):
                 v, vl = ev.eval(e)
                 ft = dag.output_types[pi]
@@ -719,7 +826,7 @@ class CopClient:
                 data, vfull = host_cols[ci]
                 ft = dag.output_types[ci]
                 d = data[idx]
-                v = vfull[idx]
+                v = np.ones(k, bool) if vfull is None else vfull[idx]
                 columns.append(Column(
                     ft, d, None if v.all() else v, snap.dictionaries[off]))
         if not columns:
@@ -727,14 +834,24 @@ class CopClient:
         return [Chunk(columns)]
 
     # ---- TopN path ----------------------------------------------------------
-    def _run_topn(self, dag, snap, prepared, cols, row_mask, host_cols):
+    def _run_topn(self, dag, snap, prepared, tiles):
+        """Per-tile k-candidate gather; the host sort+limit above merges
+        the per-tile (and per-shard) candidate chunks exactly."""
         expr, desc = dag.topn.items[0]
         n = dag.topn.n
-        key = ("topn", _dag_key(dag, prepared),
-               cols[0][0].shape[0] if cols else 0, n, desc)
+        bucket = tiles[0][1].shape[0]
+        key = ("topn", _dag_key(dag, prepared), bucket, n, desc)
         kern = self._kernel(key, lambda: self._build_topn_kernel(
             dag, prepared, expr, desc, n))
-        out = jax.device_get(kern(cols, row_mask))
+        outs = jax.device_get([kern(cols, vis) for cols, vis, _ in tiles])
+        chunks = []
+        for out in outs:
+            c = self._topn_decode(dag, snap, out)
+            if c is not None:
+                chunks.append(c)
+        return chunks
+
+    def _topn_decode(self, dag, snap, out) -> Optional[Chunk]:
         ints = out["ints"]  # int32[2 + n_int_cols*2, k]
         flts = out.get("flts")  # f32[n_flt_cols*2, k]
         picked = ints[1].astype(bool)
@@ -761,8 +878,8 @@ class CopClient:
                 ft, data.astype(ft.np_dtype),
                 None if valid.all() else valid, dictionary))
         if not columns:
-            return []
-        return [Chunk(columns)]
+            return None
+        return Chunk(columns)
 
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):
         return jax.jit(self._topn_body(dag, prepared, expr, desc, n))
@@ -854,6 +971,33 @@ class CopClient:
             columns.append(Column(ft, np.empty(0, ft.np_dtype), None,
                                   dictionary))
         return Chunk(columns)
+
+
+def _merge_tile_outs(outs: list[dict], sched) -> dict:
+    """Merge per-tile agg partials host-side. Int limb partials are
+    additive (summed in int64 so hi/lo sums can exceed int32 across many
+    tiles); float block partials concatenate along the block axis (the
+    host combine already sums blocks in f64); min/max merge elementwise
+    against their sentinels. Mirrors the cross-shard collective merge
+    (parallel/dist.py _collective_merge), but on fetched partials."""
+    if len(outs) == 1:
+        return outs[0]
+    minmax = {f"m{ai}": s["kind"] for ai, s in enumerate(sched)
+              if s["kind"] in ("min", "max")}
+    merged: dict[str, np.ndarray] = {}
+    for k in outs[0]:
+        vals = [np.asarray(o[k]) for o in outs]
+        kind = minmax.get(k)
+        if kind == "min":
+            merged[k] = np.minimum.reduce(vals)
+        elif kind == "max":
+            merged[k] = np.maximum.reduce(vals)
+        elif k.startswith("f"):
+            merged[k] = np.concatenate(vals, axis=0)
+        else:
+            merged[k] = np.sum(
+                np.stack([v.astype(np.int64) for v in vals]), axis=0)
+    return merged
 
 
 # ==================== shared aggregation machinery ====================
@@ -995,6 +1139,16 @@ def decode_agg_partials(agg, prepared, cards, out, group_dicts,
 
 
 # ==================== helpers ====================
+
+
+def _narrow(a: np.ndarray) -> np.ndarray:
+    """64-bit host columns -> 32-bit device staging (the device is
+    64-bit-free; see module docstring)."""
+    if a.dtype == np.int64:
+        return a.astype(np.int32)
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    return a
 
 
 def _pad(a: np.ndarray, b: int) -> np.ndarray:

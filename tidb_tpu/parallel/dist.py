@@ -92,6 +92,15 @@ class DistCopClient(CopClient):
         row_mask = jax.device_put(row_mask, sharding)
         return cols, row_mask, host_cols, host_mask
 
+    # tile placement: shard every tile's rows axis over the mesh (tiles
+    # and shards compose — each TILE_ROWS slice is scanned by all devices)
+    def _place_cols(self, data, valid):
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        return jax.device_put(data, sharding), jax.device_put(valid, sharding)
+
+    def _place_mask(self, mask):
+        return jax.device_put(mask, NamedSharding(self.mesh, P(AXIS)))
+
     # ---- fragment placement: probe shards, build tables replicate ------
     # (broadcast-join placement — the MPP broadcast exchange mode,
     # reference: planner/core/fragment.go broadcast vs hash partition)
