@@ -2,10 +2,18 @@
 //
 // Plays the role the reference delegates to external native stores
 // (reference: TiKV's RocksDB column families; in-tree twin
-// store/mockstore/mocktikv/mvcc_leveldb.go over goleveldb). The MVCC
-// percolator layer (tidb_tpu/kv/mvcc.py) sits on top of this interface;
-// PyOrderedKV is the pure-Python twin used when the shared library is
-// unavailable.
+// store/mockstore/mocktikv/mvcc_leveldb.go over goleveldb, and the
+// badger-backed unistore default, go.mod:34). The MVCC percolator layer
+// (tidb_tpu/kv/mvcc.py) sits on top of this interface; PyOrderedKV is the
+// pure-Python twin used when the shared library is unavailable.
+//
+// Durability (kv_open_at): write-ahead log + snapshot, both in one record
+// format:  u8 op (1=put 2=del), u8 cf, u32 klen, u32 vlen, key, value.
+// Every mutation appends to the WAL before the in-memory map changes;
+// kv_checkpoint() dumps the maps to snapshot.tmp, fsyncs, renames over
+// snapshot.kv and truncates the WAL. Open replays snapshot then WAL;
+// a torn tail record (crash mid-append) is ignored. The Python twin
+// (mvcc.PyOrderedKV) reads and writes the same files.
 //
 // Interface contract (mirrors PyOrderedKV):
 //   put/delete/get over (cf, key) -> value bytes
@@ -16,6 +24,8 @@
 // iterator at creation so mutation during iteration is safe (same
 // semantics the Python twin gets from the GIL + list copy).
 
+#include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -24,6 +34,11 @@
 #include <string>
 #include <vector>
 
+#ifdef _WIN32
+#else
+#include <unistd.h>
+#endif
+
 namespace {
 
 constexpr int kNumCF = 3;
@@ -31,6 +46,8 @@ constexpr int kNumCF = 3;
 struct Store {
     std::map<std::string, std::string> cf[kNumCF];
     std::shared_mutex mu;
+    std::string dir;        // empty = pure in-memory
+    FILE* wal = nullptr;    // append handle when durable
 };
 
 struct Iter {
@@ -38,24 +55,143 @@ struct Iter {
     size_t pos = 0;
 };
 
+bool read_rec(FILE* f, uint8_t* op, uint8_t* cf, std::string* key,
+              std::string* val) {
+    uint8_t hdr[10];
+    if (fread(hdr, 1, sizeof hdr, f) != sizeof hdr) return false;
+    *op = hdr[0];
+    *cf = hdr[1];
+    uint32_t klen, vlen;
+    memcpy(&klen, hdr + 2, 4);
+    memcpy(&vlen, hdr + 6, 4);
+    if (*cf >= kNumCF || (*op != 1 && *op != 2)) return false;
+    key->resize(klen);
+    val->resize(vlen);
+    if (klen && fread(&(*key)[0], 1, klen, f) != klen) return false;
+    if (vlen && fread(&(*val)[0], 1, vlen, f) != vlen) return false;
+    return true;
+}
+
+void write_rec(FILE* f, uint8_t op, uint8_t cf, const char* key, size_t klen,
+               const char* val, size_t vlen) {
+    uint8_t hdr[10];
+    hdr[0] = op;
+    hdr[1] = static_cast<uint8_t>(cf);
+    uint32_t k32 = static_cast<uint32_t>(klen);
+    uint32_t v32 = static_cast<uint32_t>(vlen);
+    memcpy(hdr + 2, &k32, 4);
+    memcpy(hdr + 6, &v32, 4);
+    fwrite(hdr, 1, sizeof hdr, f);
+    if (klen) fwrite(key, 1, klen, f);
+    if (vlen) fwrite(val, 1, vlen, f);
+}
+
+// replays valid records; returns the byte offset of the valid prefix so a
+// torn tail (crash mid-append) can be truncated away — appending after
+// garbage would make every later record unreachable to the next replay
+long replay_file(Store* s, const std::string& path) {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return -1;
+    uint8_t op, cf;
+    std::string key, val;
+    long valid = 0;
+    while (read_rec(f, &op, &cf, &key, &val)) {
+        if (op == 1)
+            s->cf[cf][key] = val;
+        else
+            s->cf[cf].erase(key);
+        valid = ftell(f);
+    }
+    fclose(f);
+    return valid;
+}
+
+void log_mutation(Store* s, uint8_t op, int cf, const char* key, size_t klen,
+                  const char* val, size_t vlen) {
+    if (!s->wal) return;
+    write_rec(s->wal, op, static_cast<uint8_t>(cf), key, klen, val, vlen);
+    fflush(s->wal);
+}
+
 }  // namespace
 
 extern "C" {
 
 void* kv_open() { return new Store(); }
 
-void kv_close(void* h) { delete static_cast<Store*>(h); }
+// durable variant: dir must exist; replays snapshot.kv then wal.log and
+// keeps the WAL open for appends
+void* kv_open_at(const char* dir) {
+    auto* s = new Store();
+    s->dir = dir;
+    replay_file(s, s->dir + "/snapshot.kv");
+    long valid = replay_file(s, s->dir + "/wal.log");
+#ifndef _WIN32
+    if (valid >= 0) truncate((s->dir + "/wal.log").c_str(), valid);
+#endif
+    s->wal = fopen((s->dir + "/wal.log").c_str(), "ab");
+    if (!s->wal) {
+        delete s;
+        return nullptr;
+    }
+    return s;
+}
+
+void kv_close(void* h) {
+    auto* s = static_cast<Store*>(h);
+    if (s->wal) fclose(s->wal);
+    delete s;
+}
+
+// fold WAL + maps into a fresh snapshot, then truncate the WAL
+int kv_checkpoint(void* h) {
+    auto* s = static_cast<Store*>(h);
+    if (s->dir.empty()) return -1;
+    std::unique_lock lk(s->mu);
+    std::string tmp = s->dir + "/snapshot.tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    for (int cf = 0; cf < kNumCF; ++cf) {
+        for (const auto& kv : s->cf[cf]) {
+            write_rec(f, 1, static_cast<uint8_t>(cf), kv.first.data(),
+                      kv.first.size(), kv.second.data(), kv.second.size());
+        }
+    }
+    fflush(f);
+#ifndef _WIN32
+    fsync(fileno(f));
+#endif
+    fclose(f);
+    if (rename(tmp.c_str(), (s->dir + "/snapshot.kv").c_str()) != 0)
+        return -1;
+    if (s->wal) fclose(s->wal);
+    s->wal = fopen((s->dir + "/wal.log").c_str(), "wb");
+    return s->wal ? 0 : -1;
+}
+
+int kv_sync(void* h) {
+    auto* s = static_cast<Store*>(h);
+    if (!s->wal) return 0;
+    std::unique_lock lk(s->mu);
+    fflush(s->wal);
+#ifndef _WIN32
+    fsync(fileno(s->wal));
+#endif
+    return 0;
+}
 
 void kv_put(void* h, int cf, const char* key, size_t klen,
             const char* val, size_t vlen) {
     auto* s = static_cast<Store*>(h);
     std::unique_lock lk(s->mu);
+    log_mutation(s, 1, cf, key, klen, val, vlen);
     s->cf[cf][std::string(key, klen)] = std::string(val, vlen);
 }
 
 void kv_delete(void* h, int cf, const char* key, size_t klen) {
     auto* s = static_cast<Store*>(h);
     std::unique_lock lk(s->mu);
+    log_mutation(s, 2, cf, key, klen, nullptr, 0);
     s->cf[cf].erase(std::string(key, klen));
 }
 

@@ -1,0 +1,228 @@
+"""Durability + restart recovery: nothing committed may die with the process.
+
+The durable Storage(path) keeps three planes (reference analogs cited in
+store/storage.py): the KV WAL+snapshot (unistore/badger persistence,
+go.mod:34), columnar epoch snapshots (the TiFlash-style fold checkpoint),
+and catalog/stats/DDL state in the meta keyspace (meta/meta.go:59).
+Reopening the directory must recover schema, rows, auto-increment,
+pending DDL, and resolve orphaned percolator locks — the bootstrap-from-KV
+path of session/session.go:2090.
+
+"Crash" here = dropping the Storage without close(): the WAL is appended
+synchronously, so an unclean exit loses nothing committed.
+"""
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+
+def crash(storage):
+    """Simulate process death: release file handles WITHOUT checkpointing."""
+    close = getattr(storage.kv.kv, "close", None)
+    if close:
+        close()
+
+
+def test_rows_schema_survive_crash(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT, name VARCHAR(20))")
+    s.execute("INSERT INTO t VALUES (1, 10, 'alpha'), (2, 20, 'beta')")
+    s.execute("UPDATE t SET v = 25 WHERE id = 2")
+    s.execute("INSERT INTO t VALUES (3, 30, NULL)")
+    s.execute("DELETE FROM t WHERE id = 1")
+    crash(st)
+
+    st2 = Storage(p)
+    s2 = Session(st2)
+    assert s2.query("SELECT id, v, name FROM t ORDER BY id") == [
+        (2, 25, "beta"), (3, 30, None)]
+    # schema intact: unknown column still errors, insert works
+    s2.execute("INSERT INTO t VALUES (4, 40, 'gamma')")
+    assert s2.query("SELECT COUNT(*) FROM t")[0][0] == 3
+
+
+def test_duplicate_key_still_enforced_after_reopen(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE u (id INT PRIMARY KEY, email VARCHAR(40) UNIQUE)")
+    s.execute("INSERT INTO u VALUES (1, 'a@x.com')")
+    crash(st)
+
+    s2 = Session(Storage(p))
+    with pytest.raises(Exception, match="Duplicate"):
+        s2.execute("INSERT INTO u VALUES (2, 'a@x.com')")
+    with pytest.raises(Exception, match="Duplicate"):
+        s2.execute("INSERT INTO u VALUES (1, 'b@x.com')")
+
+
+def test_bulk_load_and_compaction_epochs_survive(tmp_path):
+    from tidb_tpu.bench.tpch import TPCH_Q6, load_lineitem
+
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    load_lineitem(s, 2048)
+    want_q6 = s.query(TPCH_Q6)
+    want_cnt = s.query("SELECT COUNT(*) FROM lineitem")
+    crash(st)
+
+    s2 = Session(Storage(p))
+    assert s2.query(TPCH_Q6) == want_q6
+    assert s2.query("SELECT COUNT(*) FROM lineitem") == want_cnt
+
+
+def test_auto_increment_does_not_collide_after_reopen(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE a (id INT PRIMARY KEY AUTO_INCREMENT, v INT)")
+    s.execute("INSERT INTO a (v) VALUES (1), (2), (3)")
+    crash(st)
+
+    s2 = Session(Storage(p))
+    s2.execute("INSERT INTO a (v) VALUES (4)")
+    ids = [r[0] for r in s2.query("SELECT id FROM a ORDER BY id")]
+    assert len(ids) == len(set(ids)) == 4
+
+
+def test_drop_and_truncate_do_not_resurrect(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE d1 (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO d1 VALUES (1, 1)")
+    s.execute("CREATE TABLE d2 (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO d2 VALUES (7, 7)")
+    s.execute("DROP TABLE d1")
+    s.execute("TRUNCATE TABLE d2")
+    s.execute("INSERT INTO d2 VALUES (8, 8)")
+    crash(st)
+
+    s2 = Session(Storage(p))
+    assert s2.query("SELECT * FROM d2") == [(8, 8)]
+    with pytest.raises(Exception, match="unknown table"):
+        s2.query("SELECT * FROM d1")
+
+
+def test_uncommitted_txn_lost_orphan_locks_resolved(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE t (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO t VALUES (1, 1)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (2, 2)")
+    # crash with the txn open: nothing prewritten yet (buffered writes),
+    # so simply lost. Also leave a dangling prewrite lock behind to prove
+    # orphan resolution.
+    from tidb_tpu.kv import tablecodec
+    from tidb_tpu.kv.mvcc import Mutation, OP_PUT
+
+    tid = st.catalog.table("test", "t").id
+    key = tablecodec.record_key(tid, 99)
+    st.kv.prewrite([Mutation(OP_PUT, key, b"\x03" + b"\x80" + b"\x00" * 7)],
+                   key, st.tso.next_ts())
+    crash(st)
+
+    st2 = Storage(p)
+    s2 = Session(st2)
+    assert s2.query("SELECT id FROM t ORDER BY id") == [(1,)]
+    assert st2.kv.all_locks() == []  # orphan rolled back at recovery
+
+
+def test_checkpoint_then_reopen_via_snapshot(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE c (id INT PRIMARY KEY, v VARCHAR(8))")
+    s.execute("INSERT INTO c VALUES (1, 'x')")
+    st.close()  # checkpoint: snapshot written, WAL truncated
+
+    st2 = Storage(p)
+    s2 = Session(st2)
+    s2.execute("INSERT INTO c VALUES (2, 'y')")  # lands in fresh WAL
+    crash(st2)
+
+    s3 = Session(Storage(p))
+    assert s3.query("SELECT id, v FROM c ORDER BY id") == [(1, "x"), (2, "y")]
+
+
+def test_pending_ddl_resumes_after_crash(tmp_path):
+    from tidb_tpu.ddl import DDL
+
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE r (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO r VALUES (1, 5), (2, 6), (3, 7)")
+    info = st.catalog.table("test", "r")
+    ddl = DDL(st, st.catalog)
+    job = ddl.submit("add_index", "test", info, {
+        "name": "iv", "columns": ["v"], "unique": True})
+    ddl.step(job)  # delete-only — then the worker "dies"
+    crash(st)
+
+    st2 = Storage(p)  # recovery resumes the queued job to completion
+    assert st2.ddl_jobs == []
+    info2 = st2.catalog.table("test", "r")
+    ix = next(x for x in info2.indices if x.name == "iv")
+    assert ix.visible and ix.unique
+    s2 = Session(st2)
+    with pytest.raises(Exception, match="Duplicate"):
+        s2.execute("INSERT INTO r VALUES (9, 5)")
+
+
+def test_stats_survive_restart(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE st1 (id INT PRIMARY KEY, v INT)")
+    s.execute("INSERT INTO st1 VALUES " + ",".join(
+        f"({i}, {i % 10})" for i in range(100)))
+    s.execute("ANALYZE TABLE st1")
+    tid = st.catalog.table("test", "st1").id
+    assert st.stats.table_stats(tid) is not None
+    crash(st)
+
+    st2 = Storage(p)
+    ts = st2.stats.table_stats(tid)
+    assert ts is not None and ts.row_count == 100
+
+
+def test_tso_monotonic_across_restart(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE TABLE m (id INT PRIMARY KEY)")
+    s.execute("INSERT INTO m VALUES (1)")
+    last = st.tso.current()
+    crash(st)
+
+    st2 = Storage(p)
+    assert st2.tso.next_ts() > last
+
+
+def test_tpch_differential_against_reopened_store(tmp_path):
+    """The full mini TPC-H corpus answers identically before and after a
+    restart (the strongest end-to-end recovery check)."""
+    from tidb_tpu.bench.tpch_data import TPCH_DDL, generate_tpch, load_table
+    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    data = generate_tpch(0.002, 17)
+    for tname in TPCH_DDL:
+        load_table(s, tname, data[tname])
+    want = {q: s.query(TPCH_QUERIES[q]) for q in ("q1", "q3", "q6", "q12")}
+    crash(st)
+
+    s2 = Session(Storage(p))
+    for q, rows in want.items():
+        assert s2.query(TPCH_QUERIES[q]) == rows, f"{q} diverged"

@@ -82,6 +82,9 @@ class Catalog:
         self.schemas: dict[str, SchemaInfo] = {}
         self.version = 0
         self._next_id = 1
+        # durable storage installs a persistence hook here; fired on every
+        # version bump (the schema-version write of meta/meta.go:264)
+        self.on_change = None
         self.create_schema("test")  # convenience default, like test setups
 
     # ---- id / version ------------------------------------------------------
@@ -92,6 +95,8 @@ class Catalog:
 
     def bump_version(self) -> int:
         self.version += 1
+        if self.on_change is not None:
+            self.on_change()
         return self.version
 
     # ---- schema ops --------------------------------------------------------

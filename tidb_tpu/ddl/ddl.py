@@ -94,6 +94,7 @@ class DDL:
                args: dict[str, Any]) -> DDLJob:
         job = DDLJob(next(_job_ids), kind, db, info.id, info.name, args)
         self.storage.ddl_jobs.append(job)
+        self.storage.persist_ddl_jobs()
         return job
 
     def run_job(self, job: DDLJob) -> None:
@@ -127,6 +128,9 @@ class DDL:
             job.schema_state = S_PUBLIC
             self._finish(job)
             return True
+        # reorg checkpoint (job.reorg_pos / schema_state) survives a crash;
+        # catalog persistence rides the bump_version hook in the handlers
+        self.storage.persist_ddl_jobs()
         return False
 
     def _rollback(self, job: DDLJob) -> None:
@@ -149,6 +153,7 @@ class DDL:
         if job in self.storage.ddl_jobs:
             self.storage.ddl_jobs.remove(job)
         self.storage.ddl_history.append(job)
+        self.storage.persist_ddl_jobs()
         self.catalog.bump_version()
 
     def _info(self, job: DDLJob) -> TableInfo:
@@ -220,6 +225,9 @@ class DDL:
                         txn.rollback()
                     index.visible = True
                     store.schema_token += 1
+                    # NOTE: no bump_version here — the durable on_change
+                    # hook writes meta-KV under _commit_lock, which this
+                    # block already holds; _finish bumps outside the lock
                 return True
             index.visible = True
             # fence txns that buffered writes before the index existed —

@@ -73,19 +73,103 @@ class TxnNotFoundError(KVError):
 
 class PyOrderedKV:
     """Sorted-key in-memory KV with 3 column families. The pure-Python
-    twin of the C++ engine (native/kvstore.cpp); identical interface."""
+    twin of the C++ engine (native/kvstore.cpp); identical interface,
+    including the WAL + snapshot file format when `path` is given (the
+    record layout in kvstore.cpp write_rec), so either engine can reopen
+    a directory the other wrote."""
 
-    def __init__(self) -> None:
+    def __init__(self, path=None) -> None:
         self._maps: list[dict[bytes, bytes]] = [{}, {}, {}]
         self._keys: list[list[bytes]] = [[], [], []]
+        self._dir = None
+        self._wal = None
+        if path is not None:
+            import os
 
-    def put(self, cf: int, key: bytes, value: bytes) -> None:
+            os.makedirs(path, exist_ok=True)
+            self._dir = str(path)
+            self._replay(os.path.join(self._dir, "snapshot.kv"))
+            wal_path = os.path.join(self._dir, "wal.log")
+            valid = self._replay(wal_path)
+            if valid >= 0:
+                # drop a torn tail (crash mid-append): appending after the
+                # garbage would hide every later record from the next replay
+                with open(wal_path, "ab") as f:
+                    f.truncate(valid)
+            self._wal = open(wal_path, "ab")
+
+    # ---- durability --------------------------------------------------------
+    def _replay(self, path: str) -> int:
+        """Apply valid records; returns the valid-prefix byte length
+        (-1 when the file is absent)."""
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return -1
+        valid = 0
+        with f:
+            while True:
+                hdr = f.read(10)
+                if len(hdr) < 10:
+                    return valid
+                op, cf = hdr[0], hdr[1]
+                klen, vlen = struct.unpack_from("<II", hdr, 2)
+                if cf >= 3 or op not in (1, 2):
+                    return valid  # torn/corrupt tail
+                key = f.read(klen)
+                val = f.read(vlen)
+                if len(key) < klen or len(val) < vlen:
+                    return valid
+                if op == 1:
+                    self._apply_put(cf, key, val)
+                else:
+                    self._apply_delete(cf, key)
+                valid = f.tell()
+
+    def _log(self, op: int, cf: int, key: bytes, value: bytes) -> None:
+        if self._wal is not None:
+            self._wal.write(struct.pack("<BBII", op, cf, len(key),
+                                        len(value)) + key + value)
+            self._wal.flush()
+
+    def checkpoint(self) -> None:
+        if self._dir is None or self._wal is None:
+            return
+        import os
+
+        tmp = os.path.join(self._dir, "snapshot.tmp")
+        with open(tmp, "wb") as f:
+            for cf in range(3):
+                for k in self._keys[cf]:
+                    v = self._maps[cf][k]
+                    f.write(struct.pack("<BBII", 1, cf, len(k), len(v))
+                            + k + v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, "snapshot.kv"))
+        self._wal.close()
+        self._wal = open(os.path.join(self._dir, "wal.log"), "wb")
+
+    def sync(self) -> None:
+        if self._wal is not None:
+            import os
+
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+
+    # ---- mutations ---------------------------------------------------------
+    def _apply_put(self, cf: int, key: bytes, value: bytes) -> None:
         m = self._maps[cf]
         if key not in m:
             bisect.insort(self._keys[cf], key)
         m[key] = value
 
-    def delete(self, cf: int, key: bytes) -> None:
+    def _apply_delete(self, cf: int, key: bytes) -> None:
         m = self._maps[cf]
         if key in m:
             del m[key]
@@ -93,6 +177,14 @@ class PyOrderedKV:
             i = bisect.bisect_left(ks, key)
             if i < len(ks) and ks[i] == key:
                 ks.pop(i)
+
+    def put(self, cf: int, key: bytes, value: bytes) -> None:
+        self._log(1, cf, key, value)
+        self._apply_put(cf, key, value)
+
+    def delete(self, cf: int, key: bytes) -> None:
+        self._log(2, cf, key, b"")
+        self._apply_delete(cf, key)
 
     def get(self, cf: int, key: bytes) -> Optional[bytes]:
         return self._maps[cf].get(key)
@@ -381,6 +473,69 @@ class MVCCStore:
             self.commit([key], start_ts, commit_ts)
         else:
             self.rollback([key], start_ts)
+
+    # ---- recovery ----------------------------------------------------------
+    def scan_latest(
+        self, start: bytes, end: bytes
+    ) -> list[tuple[bytes, int, bytes, Optional[bytes]]]:
+        """Newest settled version per key in [start, end):
+        (key, commit_ts, kind, value|None). Rollback/lock markers are
+        skipped. Restart recovery uses this to re-fold committed rows into
+        column epochs (reference analog: bootstrap reads schema + rows
+        straight from the KV truth, session/session.go:2090)."""
+        with self._mu:
+            out: list[tuple[bytes, int, bytes, Optional[bytes]]] = []
+            last_key: Optional[bytes] = None
+            it_start = _wkey(start, 0xFFFFFFFFFFFFFFFF) if start else b""
+            for wk, wv in self.kv.scan(CF_WRITE, it_start,
+                                       end if end else b""):
+                key, commit_ts = _split_vkey(wk)
+                if end and key >= end:
+                    break
+                if key == last_key:
+                    continue
+                start_ts, kind = _write_dec(wv)
+                if kind in (OP_ROLLBACK, OP_LOCK):
+                    continue
+                last_key = key
+                val = self.kv.get(CF_DATA, _dkey(key, start_ts)) \
+                    if kind == OP_PUT else None
+                out.append((key, commit_ts, kind, val))
+            return out
+
+    def max_commit_ts(self) -> int:
+        """Largest commit_ts in the write column (recovery TSO floor)."""
+        with self._mu:
+            best = 0
+            for wk, _ in self.kv.scan(CF_WRITE, b"", b""):
+                _, commit_ts = _split_vkey(wk)
+                if commit_ts > best:
+                    best = commit_ts
+            return best
+
+    def all_locks(self) -> list[LockInfo]:
+        with self._mu:
+            return [_lock_dec(k, v)
+                    for k, v in self.kv.scan(CF_LOCK, b"", b"")]
+
+    def checkpoint(self) -> None:
+        cp = getattr(self.kv, "checkpoint", None)
+        if cp is not None:
+            with self._mu:
+                cp()
+
+    def unsafe_destroy_range(self, start: bytes, end: bytes) -> None:
+        """Physically remove every version, lock and value in [start, end)
+        bypassing MVCC (reference: TiKV UnsafeDestroyRange — the DROP/
+        TRUNCATE TABLE data reclaim path). Callers guarantee no reader
+        needs the range again."""
+        with self._mu:
+            for cf in (CF_LOCK, CF_WRITE, CF_DATA):
+                doomed = [k for k, _ in self.kv.scan(cf, start, end)]
+                # versioned CFs suffix keys with \x00+ts — the plain range
+                # end bound still covers them (suffix sorts below end)
+                for k in doomed:
+                    self.kv.delete(cf, k)
 
     # ---- GC ----------------------------------------------------------------
     def gc(self, safepoint: int) -> int:

@@ -41,6 +41,12 @@ def _load() -> ctypes.CDLL:
         vp = ctypes.c_void_p
         sz = ctypes.c_size_t
         lib.kv_open.restype = vp
+        lib.kv_open_at.argtypes = [c]
+        lib.kv_open_at.restype = vp
+        lib.kv_checkpoint.argtypes = [vp]
+        lib.kv_checkpoint.restype = ctypes.c_int
+        lib.kv_sync.argtypes = [vp]
+        lib.kv_sync.restype = ctypes.c_int
         lib.kv_close.argtypes = [vp]
         lib.kv_put.argtypes = [vp, ctypes.c_int, c, sz, c, sz]
         lib.kv_delete.argtypes = [vp, ctypes.c_int, c, sz]
@@ -74,12 +80,37 @@ def native_available() -> bool:
 
 
 class NativeOrderedKV:
-    """C++-backed ordered KV; drop-in for mvcc.PyOrderedKV."""
+    """C++-backed ordered KV; drop-in for mvcc.PyOrderedKV.
 
-    def __init__(self) -> None:
+    With `path` the engine is durable: every mutation is WAL-appended
+    before the in-memory map changes, and `checkpoint()` folds the state
+    into a snapshot file (truncating the WAL). The file format is shared
+    with the Python twin, so either engine reopens the other's directory."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
         self._lib = _load()
-        self._h = self._lib.kv_open()
+        if path is not None:
+            Path(path).mkdir(parents=True, exist_ok=True)
+            self._h = self._lib.kv_open_at(str(path).encode())
+            if not self._h:
+                raise NativeUnavailable(f"cannot open WAL dir {path}")
+        else:
+            self._h = self._lib.kv_open()
         self._mu = threading.Lock()
+
+    def checkpoint(self) -> None:
+        with self._mu:
+            self._lib.kv_checkpoint(self._h)
+
+    def sync(self) -> None:
+        with self._mu:
+            self._lib.kv_sync(self._h)
+
+    def close(self) -> None:
+        with self._mu:
+            if self._h:
+                self._lib.kv_close(self._h)
+                self._h = None
 
     def __del__(self) -> None:
         h = getattr(self, "_h", None)

@@ -19,10 +19,14 @@ _LOGICAL_BITS = 18
 
 
 class TimestampOracle:
-    def __init__(self) -> None:
+    def __init__(self, floor: int = 0) -> None:
+        """`floor`: restart lower bound — every issued ts is > floor
+        (recovery passes the persisted lease so timestamps never repeat
+        across restarts even under clock skew; reference analog: PD's
+        persisted TSO window, oracle/oracles/pd.go)."""
         self._lock = threading.Lock()
-        self._physical = 0
-        self._logical = 0
+        self._physical = floor >> _LOGICAL_BITS
+        self._logical = floor & ((1 << _LOGICAL_BITS) - 1)
 
     def next_ts(self) -> int:
         with self._lock:

@@ -178,6 +178,7 @@ class Session:
         if isinstance(stmt, ast.DropDatabaseStmt):
             for info in self.catalog.drop_schema(stmt.name, stmt.if_exists):
                 self.storage.unregister_table(info.id)
+                self.storage.destroy_table_data(info.id)
             return ResultSet([], [])
         if isinstance(stmt, ast.TruncateTableStmt):
             return self._exec_truncate(stmt)
@@ -689,12 +690,14 @@ class Session:
             if info is not None:
                 self.storage.unregister_table(info.id)
                 self.storage.stats.drop_table(info.id)
+                self.storage.destroy_table_data(info.id)
         return ResultSet([], [])
 
     def _exec_truncate(self, stmt: ast.TruncateTableStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)
         self.storage.unregister_table(info.id)
         self.storage.stats.drop_table(info.id)
+        self.storage.destroy_table_data(info.id)
         self.storage.register_table(info)
         return ResultSet([], [])
 

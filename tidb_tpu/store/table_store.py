@@ -185,6 +185,28 @@ class TableStore:
         # buffered writes under an older token must abort at commit
         # (reference: schema validator fencing, domain/schema_validator.go)
         self.schema_token = 0
+        # durable-storage hook: fired after every base-epoch replacement
+        # (bulk_load / compact / apply_schema / cast_column) so the owner
+        # can persist the columnar snapshot (Storage._on_epoch_changed).
+        # `required=False` (compaction) only marks the epoch dirty: the
+        # folded deltas are still recoverable from the KV truth, so the
+        # snapshot write can defer to checkpoint()/GC instead of stalling
+        # the committing session on an O(table) file write
+        self.on_epoch = None
+        self.epoch_dirty = False
+
+    def _epoch_changed(self, required: bool = True) -> None:
+        if self.on_epoch is not None:
+            self.on_epoch(self, required)
+
+    def restore_epoch(self, epoch: ColumnEpoch,
+                      dictionaries: list[Optional[Dictionary]],
+                      next_handle: int) -> None:
+        """Install a recovered columnar snapshot (restart recovery path)."""
+        with self._lock:
+            self.epoch = epoch
+            self.dictionaries = dictionaries
+            self._next_handle = max(self._next_handle, next_handle)
 
     # ---- write path --------------------------------------------------------
     def alloc_handle(self) -> int:
@@ -341,6 +363,7 @@ class TableStore:
                 valids=new_valids,
                 handle_pos={int(h): i for i, h in enumerate(all_handles)},
             )
+        self._epoch_changed()
 
     # ---- schema change (DDL reorg primitives) ------------------------------
     def apply_schema(self, new_info: TableInfo,
@@ -400,6 +423,7 @@ class TableStore:
             )
             self._index_orders.clear()
             self.schema_token += 1
+        self._epoch_changed()
 
     def cast_column(self, offset: int, cast_fn,
                     new_info: Optional[TableInfo] = None) -> Optional[str]:
@@ -446,7 +470,8 @@ class TableStore:
                 self.table = new_info
             self._index_orders.clear()
             self.schema_token += 1
-            return None
+        self._epoch_changed()
+        return None
 
     # ---- compaction --------------------------------------------------------
     def maybe_compact(self, safe_ts: int) -> None:
@@ -513,3 +538,4 @@ class TableStore:
             )
             self.epoch = new_epoch
             self.deltas = remaining
+        self._epoch_changed(required=False)
