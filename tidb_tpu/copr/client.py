@@ -696,6 +696,24 @@ class CopClient:
     # — the MPP broadcast-join placement, store/tikv/batch_coprocessor.go
     # analog) ----
     supports_hc = True
+    hc_exchange_blocks = 1  # candidate partitions in hc outputs
+    # builds never partition on a single device (everything is local);
+    # the distributed client sets a row threshold + the staging/routing
+    partition_join_threshold = None
+    frag_axis = None
+
+    def _hc_exchange_fn(self, frag, prepared):
+        """Group-partition exchange for the hc path; None on a single
+        device (all groups are already local). The distributed client
+        returns an all_to_all router (parallel/exchange.py)."""
+        return None
+
+    def _join_exchange_fn(self, frag, prepared, spans):
+        return None
+
+    def _stage_partitioned_build(self, t, snap, lo, span, j):
+        raise NotImplementedError(
+            "partitioned builds require the distributed client")
 
     def _stage_build_table(self, facade, snap):
         return self._stage_inputs(facade, snap, overlay=False)

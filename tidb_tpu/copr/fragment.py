@@ -138,6 +138,36 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         prepared["__sig__"].append(("join", j.build, lo, span))
 
     mode = "agg" if frag.agg is not None else "rows"
+
+    # ---- partitioned (non-broadcast) join election ----
+    # a build too large to replicate is sharded by key range; probe rows
+    # route to the owning device before the gathers (the MPP hash-
+    # partition exchange mode vs broadcast, planner/core/fragment.go:45).
+    # One partitioned join per fragment; output must be merge-safe
+    # partials (agg/hc), since routed rows lose probe-row identity.
+    part_ji = None
+    part_thr = getattr(cop, "partition_join_threshold", None)
+    if part_thr is not None and frag.agg is not None and \
+            getattr(cop, "frag_axis", None) is not None:
+        n_probe_cols = len(frag.tables[0].col_offsets)
+
+        def probe_prefix_only(e) -> bool:
+            # the exchange routes BEFORE any gathers, so the routing key
+            # must be computable from the probe table's own columns — a
+            # key gathered from an earlier build cannot elect
+            if isinstance(e, Col):
+                return e.idx < n_probe_cols
+            return all(probe_prefix_only(a) for a in getattr(e, "args", ()))
+
+        big = [(snaps[frag.tables[j.build].table.id].epoch.num_rows, ji)
+               for ji, j in enumerate(frag.joins)
+               if snaps[frag.tables[j.build].table.id].epoch.num_rows
+               > part_thr and probe_prefix_only(j.probe_key)]
+        if big:
+            part_ji = max(big)[1]
+    prepared["__part_join__"] = part_ji
+    prepared["__n_joins__"] = len(frag.joins)
+
     if frag.agg is not None:
         n_rows = psnap.epoch.num_rows + len(psnap.overlay_handles)
         facade = _agg_facade(frag)
@@ -152,8 +182,8 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
             mode = "hc"
 
     if mode == "hc" and not getattr(cop, "supports_hc", True):
-        # sorted-run candidates are per-shard partial groups; a group can
-        # span shards, so the distributed client routes hc to the host
+        # a client with neither single-device hc nor a group exchange
+        # routes hc to the host
         raise _Fallback()
 
     # ---- staging ----
@@ -161,9 +191,13 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     for ji, j in enumerate(frag.joins):
         t = frag.tables[j.build]
         snap = snaps[t.table.id]
+        lo, span = spans[ji]
+        if ji == part_ji:
+            builds.append(cop._stage_partitioned_build(
+                t, snap, lo, span, j))
+            continue
         cols, vis, host_cols, host_mask = cop._stage_build_table(
             _facade_dag(t), snap)
-        lo, span = spans[ji]
         key_off = t.col_offsets[j.build_key_local]
         perm = _perm_array(cop, snap, key_off, lo, span, host_mask)
         perm = cop._place_build_array(
@@ -250,16 +284,23 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         mode = "agg" if frag.agg is not None else "rows"
     key = ("frag", _frag_key(frag), _sig(prepared), mode,
            pcols[0][0].shape[0] if pcols else 0,
-           tuple(b["cols"][0][0].shape[0] for b in builds))
+           tuple(
+               ("part", b["present"].shape[0]) if "bykey" in b
+               else b["cols"][0][0].shape[0]
+               for b in builds))
     kern = cop._kernel(key, lambda: cop._frag_jit(
-        _build_frag_kernel(frag, prepared, spans, mode, raw=True),
+        _build_frag_kernel(frag, prepared, spans, mode, raw=True, cop=cop),
         mode, prepared))
     out = jax.device_get(kern(pcols, pvis, builds))
 
     if mode == "hc":
+        # candidate blocks = exchange partitions (1 on a single device)
+        prepared["__hc_blocks__"] = getattr(cop, "hc_exchange_blocks", 1)
         chunk = _decode_hc(frag, snaps, prepared, out)
         return [] if chunk is None else [chunk]
     if mode == "agg":
+        if np.any(np.asarray(out.pop("overflow", 0)) > 0):
+            raise _Fallback()  # join-exchange bucket overflow (key skew)
         cards = prepared["__dense_cards__"]
         comb_dicts = []
         for ti, t in enumerate(frag.tables):
@@ -393,7 +434,7 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
     return True
 
 
-def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
+def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
     sel = frag.selection
     agg = frag.agg
     if mode == "agg":
@@ -401,6 +442,22 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
         segments = 1
         for c in cards:
             segments *= max(c, 1)
+    # group-partition exchange hook: the distributed client routes joined
+    # rows by group-key hash so each device owns whole groups (the MPP
+    # hash-partition exchange mode, planner/core/fragment.go:45)
+    hc_exchange = None
+    if mode == "hc" and cop is not None:
+        hc_exchange = cop._hc_exchange_fn(frag, prepared)
+    # partitioned-join exchange: probe rows route by join-key range to the
+    # device holding that slice of the key-ordered build shard
+    part_ji = prepared.get("__part_join__")
+    join_exchange = None
+    if part_ji is not None and cop is not None:
+        join_exchange = cop._join_exchange_fn(frag, prepared, spans)
+        part_axis = cop.frag_axis
+        part_span = spans[part_ji][1]
+        part_n_dev = cop.mesh.devices.size
+        part_per_dev = -(-part_span // part_n_dev)
 
     def kernel(pcols, pvis, builds):
         cols = list(pcols)
@@ -410,9 +467,32 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
             # prefix) gate rows before any gather work
             mask = selection_mask(frag.tables[0].filters, cols, prepared,
                                   mask)
-        for j, (lo, span), b in zip(frag.joins, spans, builds):
+        overflow_j = None
+        if join_exchange is not None:
+            cols, mask, overflow_j = join_exchange(cols, mask)
+        for ji, (j, (lo, span), b) in enumerate(
+                zip(frag.joins, spans, builds)):
             key_v, key_vl = eval_expr(j.probe_key, cols, prepared)
             k = key_v.astype(jnp.int32) - jnp.int32(lo)
+            t = frag.tables[j.build]
+            if ji == part_ji:
+                # rows were routed here by k % n_dev (interleaved build
+                # ownership): gather against the LOCAL slice, whose index
+                # for key k is k // n_dev
+                dev = jax.lax.axis_index(part_axis).astype(jnp.int32)
+                local = k // jnp.int32(part_n_dev)
+                inrange = (k >= 0) & (k < span) & \
+                    (k % jnp.int32(part_n_dev) == dev)
+                gidx = jnp.clip(local, 0, part_per_dev - 1)
+                bmask = b["present"]
+                if t.filters:
+                    bmask = selection_mask(t.filters, b["bykey"], prepared,
+                                           bmask)
+                found = inrange & key_vl & bmask[gidx]
+                for (d, v) in b["bykey"]:
+                    cols.append((d[gidx], v[gidx] & found))
+                mask = mask & found
+                continue
             inrange = (k >= 0) & (k < span)
             ksafe = jnp.clip(k, 0, span - 1)
             ridx = b["perm"][ksafe]
@@ -420,7 +500,6 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
             gidx = jnp.clip(ridx, 0)
             # build-side validity: visibility + pushed-down filters over
             # the FULL build columns, gathered per probe row
-            t = frag.tables[j.build]
             bmask = b["vis"]
             if t.filters:
                 bmask = selection_mask(t.filters, b["cols"], prepared,
@@ -432,9 +511,21 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False):
         if sel:
             mask = selection_mask(sel, cols, prepared, mask)
         if mode == "agg":
-            return agg_partials(agg, prepared, cards, segments, cols, mask)
+            out = agg_partials(agg, prepared, cards, segments, cols, mask)
+            if overflow_j is not None:
+                out["overflow"] = overflow_j
+            return out
         if mode == "hc":
-            return _hc_body(frag, prepared, cols, mask)
+            if hc_exchange is not None:
+                cols, mask, overflow = hc_exchange(cols, mask)
+                res = _hc_body(frag, prepared, cols, mask)
+                res["overflow"] = overflow if overflow_j is None \
+                    else overflow + overflow_j
+                return res
+            res = _hc_body(frag, prepared, cols, mask)
+            if overflow_j is not None:
+                res["overflow"] = overflow_j
+            return res
         return jnp.packbits(mask)
 
     return kernel if raw else jax.jit(kernel)
@@ -570,19 +661,27 @@ def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
     agg = frag.agg
     sched = prepared["__hc_sched__"]
     nulls = prepared["__hc_nulls__"]
+    if np.any(np.asarray(out.pop("overflow", 0)) > 0):
+        raise _Fallback()  # exchange bucket overflow (adversarial skew)
     picked = out["picked"].astype(bool)
     if not picked.any():
         return None
-    if picked.all():
-        # more groups may exist beyond the candidate buffer: the result is
-        # sound only if the k-th best score strictly beats the buffer's
-        # worst (f32 scores order-embed the exact primary values, so a
-        # strict gap proves no non-candidate can reach the top-k; a tie at
-        # the boundary is ambiguous -> exact host path)
-        score = out["score"]
-        k = frag.hc.k
-        if k >= len(score) or not (score[k - 1] > score[-1]):
-            raise _Fallback()
+    # candidate blocks are per-exchange-partition (group spaces disjoint);
+    # each partition's buffer must be verified independently
+    blocks = max(1, int(prepared.get("__hc_blocks__", 1)))
+    kb = len(picked) // blocks
+    for b in range(blocks):
+        pb = picked[b * kb:(b + 1) * kb]
+        if pb.all():
+            # more groups may exist beyond this partition's buffer: the
+            # result is sound only if the k-th best score strictly beats
+            # the buffer's worst (f32 scores order-embed the exact primary
+            # values, so a strict gap proves no non-candidate can reach
+            # the top-k; a tie at the boundary is ambiguous -> exact host)
+            score = out["score"][b * kb:(b + 1) * kb]
+            k = frag.hc.k
+            if k >= kb or not (score[k - 1] > score[-1]):
+                raise _Fallback()
     sel = np.nonzero(picked)[0]
 
     comb_dicts = []

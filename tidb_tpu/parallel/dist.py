@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -104,7 +105,135 @@ class DistCopClient(CopClient):
     # ---- fragment placement: probe shards, build tables replicate ------
     # (broadcast-join placement — the MPP broadcast exchange mode,
     # reference: planner/core/fragment.go broadcast vs hash partition)
-    supports_hc = False  # per-shard sorted runs split groups across shards
+
+    # hc GROUP BY shards via the group-partition exchange: joined rows
+    # route by group-key hash (all_to_all) so each device owns whole
+    # groups, then runs the sorted-run candidate path on its partition
+    supports_hc = True
+
+    @property
+    def hc_exchange_blocks(self) -> int:
+        return self._n
+
+    frag_axis = AXIS
+    # builds larger than this replicate no more: they shard by key range
+    # and probe rows route over ICI (hash-partition vs broadcast exchange,
+    # reference: planner/core/fragment.go:45). Tests shrink it to force
+    # the partitioned path at toy scale.
+    partition_join_threshold = 1 << 21
+
+    def _stage_partitioned_build(self, t, snap, lo, span, j):
+        """Key-interleaved build arrays sharded over the mesh: device d
+        owns keys with (key-lo) % n_dev == d, laid out at local index
+        (key-lo) // n_dev. Round-robin interleaving (not contiguous
+        ranges) matters: probe tables are typically key-SORTED (TPC-H
+        lineitem is orderkey-ordered), so range ownership would route a
+        device's whole shard to one destination and overflow any bounded
+        exchange capacity — interleaving spreads sorted probes uniformly.
+        The perm indirection of the broadcast path disappears: after
+        routing, a probe row gathers its build row by direct local
+        key index."""
+        from ..copr.client import _mask_digest, _narrow
+
+        n_dev = self._n
+        span_pad = -(-span // n_dev) * n_dev
+        per_dev = span_pad // n_dev
+        epoch = snap.epoch
+        key_off = t.col_offsets[j.build_key_local]
+        host_mask = snap.base_visible
+        ck = (epoch.epoch_id, "partb", key_off, lo, span_pad,
+              _mask_digest(host_mask), tuple(t.col_offsets))
+        with self._lock:
+            hit = self._col_cache.get(ck)
+            cacheable = self._live_epochs.get(t.table.id) == epoch.epoch_id
+        if hit is not None:
+            return hit
+        keys = epoch.columns[key_off]
+        kvalid = epoch.valids[key_off]
+        sel = host_mask.copy()
+        if kvalid is not None:
+            sel &= kvalid
+        idx = np.nonzero(sel)[0]
+        k = keys[idx].astype(np.int64) - lo
+        pos = (k % n_dev) * per_dev + k // n_dev  # interleave bijection
+        present = np.zeros(span_pad, dtype=bool)
+        present[pos] = True
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        bykey = []
+        for off in t.col_offsets:
+            data = np.zeros(span_pad, dtype=_narrow(
+                epoch.columns[off][:0]).dtype)
+            data[pos] = _narrow(epoch.columns[off][idx])
+            v = epoch.valids[off]
+            valid = present.copy()
+            if v is not None:
+                valid[pos] = v[idx]
+            bykey.append((jax.device_put(jnp.asarray(data), sharding),
+                          jax.device_put(jnp.asarray(valid), sharding)))
+        build = {"bykey": bykey,
+                 "present": jax.device_put(jnp.asarray(present), sharding)}
+        if cacheable:
+            with self._lock:
+                self._col_cache[ck] = build
+        return build
+
+    def _join_exchange_fn(self, frag, prepared, spans):
+        from ..copr.eval import eval_expr
+        from . import exchange as EX
+
+        part_ji = prepared["__part_join__"]
+        j = frag.joins[part_ji]
+        lo, span = spans[part_ji]
+        n_dev = self._n
+
+        def route(cols, mask):
+            key_v, key_vl = eval_expr(j.probe_key, cols, prepared)
+            k = key_v.astype(jnp.int32) - jnp.int32(lo)
+            m = mask.shape[0]
+            iota = jnp.arange(m, dtype=jnp.int32)
+            live = mask & key_vl & (k >= 0) & (k < span)
+            # interleaved build ownership: key k lives on device k % n.
+            # Dead rows (padding / null / out-of-span keys) spread
+            # round-robin so no bucket overflows on them.
+            dest = jnp.where(live, k % jnp.int32(n_dev),
+                             iota % jnp.int32(n_dev))
+            return EX.route_cols(dest, cols, mask, AXIS, n_dev,
+                                 EX.capacity_for(m, n_dev))
+
+        return route
+
+    def _hc_exchange_fn(self, frag, prepared):
+        from ..copr.eval import eval_expr
+        from . import exchange as EX
+
+        n_dev = self._n
+        seg_keys = prepared["__hc_segkeys__"]
+        nulls = prepared["__hc_nulls__"]
+        group_by = frag.agg.group_by
+
+        def route(cols, mask):
+            # NULL-encoded segment keys (the same encoding _hc_body uses)
+            # determine the destination: every row of a group shares them
+            keys = []
+            for gi in seg_keys:
+                g = group_by[gi]
+                v, vl = eval_expr(g, cols, prepared)
+                if v.dtype == jnp.bool_:
+                    v = v.astype(jnp.int32)
+                keys.append(jnp.where(vl, v.astype(jnp.int32),
+                                      jnp.int32(nulls[gi])))
+            m = mask.shape[0]
+            # dead rows (bucket padding / filtered) spread round-robin —
+            # they'd otherwise hash to one bucket and overflow it
+            iota = jnp.arange(m, dtype=jnp.int32)
+            dest = jnp.where(
+                mask,
+                jnp.abs(EX.mix_hash(keys)) % jnp.int32(n_dev),
+                iota % jnp.int32(n_dev))
+            return EX.route_cols(dest, cols, mask, AXIS, n_dev,
+                                 EX.capacity_for(m, n_dev))
+
+        return route
 
     def _stage_build_table(self, facade, snap):
         cols, vis, host_cols, host_mask = CopClient._stage_inputs(
@@ -152,6 +281,7 @@ class DistCopClient(CopClient):
         """shard_map the fragment body: probe rows sharded, builds
         replicated; agg partials merge with native-int32 collectives, row
         bitmasks concatenate along the rows axis."""
+        build_specs = self._build_in_specs(prepared)
         if mode == "agg":
             sched = prepared["__agg_sched__"]
 
@@ -160,16 +290,44 @@ class DistCopClient(CopClient):
 
             mapped = jax.shard_map(
                 merged, mesh=self.mesh,
-                in_specs=(P(AXIS), P(AXIS), P()),
+                in_specs=(P(AXIS), P(AXIS), build_specs),
                 out_specs=P())
+            return jax.jit(mapped)
+        if mode == "hc":
+            # per-device candidate blocks concatenate (disjoint group
+            # partitions after the exchange); overflow is psum-replicated
+            specs: dict = {"picked": P(AXIS), "score": P(AXIS),
+                           "overflow": P()}
+            for gi in range(len(prepared["__hc_nulls__"])):
+                specs[f"gk{gi}"] = P(AXIS)
+            for ai, s in enumerate(prepared["__hc_sched__"]):
+                specs[f"cnt{ai}"] = P(None, None, AXIS)
+                for ti in range(len(s.get("terms", ()))):
+                    specs[f"s{ai}_{ti}"] = P(None, None, AXIS)
+            mapped = jax.shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), build_specs),
+                out_specs=specs)
             return jax.jit(mapped)
         # row mode: per-shard packed bitmask; shards are 256-multiples so
         # byte boundaries align and concatenation is the global mask
         mapped = jax.shard_map(
             kernel, mesh=self.mesh,
-            in_specs=(P(AXIS), P(AXIS), P()),
+            in_specs=(P(AXIS), P(AXIS), build_specs),
             out_specs=P(AXIS))
         return jax.jit(mapped)
+
+    def _build_in_specs(self, prepared):
+        """Per-build shard_map in_specs: broadcast builds replicate (P()),
+        the partitioned build's key-ordered arrays shard by key range."""
+        part_ji = prepared.get("__part_join__")
+        n_joins = prepared.get("__n_joins__", 0)
+        if part_ji is None:
+            return P()
+        return [
+            {"bykey": P(AXIS), "present": P(AXIS)} if ji == part_ji else P()
+            for ji in range(n_joins)
+        ]
 
     # ---- TopN: local top-k per shard, host merge ------------------------
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):
