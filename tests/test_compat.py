@@ -1,0 +1,350 @@
+"""Client-compatibility plane: sysvars, SET/@@, SHOW, INFORMATION_SCHEMA,
+MySQL error codes, real authentication and privileges.
+
+Covers the round-2 verdict items 5/6 against their reference counterparts:
+sessionctx/variable/sysvar.go (registry + scopes), infoschema/tables.go
+(SCHEMATA/TABLES/COLUMNS memtables), errno/errcode.go (client-visible
+codes), privilege/privileges/cache.go (grant tables + checks hooked
+before planning). Wire-level assertions use the independent MiniClient
+(the stock-driver stand-in; pymysql is not in this image)."""
+
+from __future__ import annotations
+
+import pytest
+
+from mysql_client import MiniClient, MySQLError
+from tidb_tpu.session import Session
+from tidb_tpu.session.session import SQLError
+from tidb_tpu.server import Server
+from tidb_tpu.store.storage import Storage
+
+
+# ==================== sysvars / SET / @@ ====================
+
+def test_set_and_select_sysvars():
+    s = Session()
+    s.execute("SET NAMES utf8mb4")
+    s.execute("SET autocommit = 1, sql_mode = 'STRICT_TRANS_TABLES'")
+    assert s.query("SELECT @@autocommit, @@sql_mode") == [
+        (1, "STRICT_TRANS_TABLES")]
+    assert s.query("SELECT @@version_comment")[0][0].startswith("TiDB-TPU")
+
+
+def test_global_scope_crosses_sessions_and_set_global_rules():
+    s = Session()
+    s.execute("SET @@global.max_connections = 123")
+    s2 = Session(s.storage)
+    assert s2.query("SELECT @@global.max_connections") == [(123,)]
+    # session override shadows global for the setting session only
+    s.execute("SET max_execution_time = 5")
+    assert s.query("SELECT @@max_execution_time") == [(5,)]
+    assert s2.query("SELECT @@max_execution_time") == [(0,)]
+    with pytest.raises(SQLError, match="read only"):
+        s.execute("SET version = 'x'")
+    with pytest.raises(SQLError, match="Unknown system variable"):
+        s.execute("SET no_such_var_at_all = 1")
+
+
+def test_user_variables():
+    s = Session()
+    s.execute("SET @x := 40, @y = 2")
+    assert s.query("SELECT @x + @y") == [(42,)]
+    assert s.query("SELECT @unset") == [(None,)]
+
+
+def test_transaction_isolation_and_names_forms():
+    s = Session()
+    s.execute("SET SESSION TRANSACTION ISOLATION LEVEL READ COMMITTED")
+    assert s.query("SELECT @@tx_isolation") == [("READ-COMMITTED",)]
+    s.execute("SET CHARACTER SET utf8")
+    assert s.query("SELECT @@character_set_client") == [("utf8",)]
+
+
+def test_show_variables_like():
+    s = Session()
+    rows = s.query("SHOW VARIABLES LIKE 'autocommit'")
+    assert rows == [("autocommit", "1")]
+    assert s.query("SHOW VARIABLES LIKE 'no_such%'") == []
+    assert len(s.query("SHOW GLOBAL VARIABLES")) > 30
+    assert s.query("SHOW STATUS LIKE 'Uptime'")[0][0] == "Uptime"
+    assert s.query("SHOW WARNINGS") == []
+
+
+def test_set_global_survives_restart(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    Session(st).execute("SET GLOBAL max_connections = 77")
+    st.close()
+    s2 = Session(Storage(p))
+    assert s2.query("SELECT @@global.max_connections") == [(77,)]
+
+
+# ==================== INFORMATION_SCHEMA ====================
+
+@pytest.fixture()
+def schema_session():
+    s = Session()
+    s.execute("CREATE TABLE t1 (id INT PRIMARY KEY AUTO_INCREMENT, "
+              "name VARCHAR(20) NOT NULL, v DECIMAL(10,2))")
+    s.execute("CREATE UNIQUE INDEX iname ON t1 (name)")
+    s.execute("CREATE TABLE t2 (a BIGINT)")
+    s.execute("INSERT INTO t2 VALUES (1), (2)")
+    return s
+
+
+def test_infoschema_tables(schema_session):
+    s = schema_session
+    rows = s.query("SELECT table_name, table_type FROM "
+                   "information_schema.tables WHERE table_schema = 'test' "
+                   "ORDER BY table_name")
+    assert rows == [("t1", "BASE TABLE"), ("t2", "BASE TABLE")]
+
+
+def test_infoschema_columns(schema_session):
+    s = schema_session
+    rows = s.query(
+        "SELECT column_name, data_type, is_nullable, column_key, extra "
+        "FROM information_schema.columns WHERE table_name = 't1' "
+        "ORDER BY ordinal_position")
+    assert rows == [
+        ("id", "int", "NO", "PRI", "auto_increment"),
+        ("name", "varchar", "NO", "UNI", ""),
+        ("v", "decimal", "YES", "", ""),
+    ]
+
+
+def test_infoschema_reflects_ddl(schema_session):
+    s = schema_session
+    s.execute("ALTER TABLE t2 ADD COLUMN b VARCHAR(8)")
+    rows = s.query("SELECT column_name FROM information_schema.columns "
+                   "WHERE table_name = 't2' ORDER BY ordinal_position")
+    assert rows == [("a",), ("b",)]
+    s.execute("DROP TABLE t2")
+    rows = s.query("SELECT table_name FROM information_schema.tables "
+                   "WHERE table_schema = 'test'")
+    assert rows == [("t1",)]
+
+
+def test_infoschema_statistics_and_schemata(schema_session):
+    s = schema_session
+    rows = s.query("SELECT index_name, column_name FROM "
+                   "information_schema.statistics WHERE table_name = 't1'")
+    assert ("iname", "name") in rows
+    assert ("test",) in s.query(
+        "SELECT schema_name FROM information_schema.schemata")
+
+
+def test_show_columns_and_index(schema_session):
+    s = schema_session
+    cols = s.query("SHOW COLUMNS FROM t1")
+    assert [c[0] for c in cols] == ["id", "name", "v"]
+    idx = s.query("SHOW INDEX FROM t1")
+    assert any(r[2] == "iname" for r in idx)
+
+
+# ==================== wire-level: errno, auth, privileges ====================
+
+@pytest.fixture()
+def server():
+    srv = Server(port=0, users={"root": ""}, allow_unknown_users=False)
+    srv.start()
+    yield srv
+    srv.close(drain_timeout=0.2)
+
+
+def _connect(srv, **kw):
+    return MiniClient("127.0.0.1", srv.port, **kw)
+
+
+def test_mysql_error_codes(server):
+    c = _connect(server)
+    c.execute("create table ec (id int primary key, v varchar(5))")
+    c.execute("insert into ec values (1, 'a')")
+    with pytest.raises(MySQLError) as e:
+        c.execute("insert into ec values (1, 'b')")
+    assert e.value.code == 1062  # duplicate entry
+    with pytest.raises(MySQLError) as e:
+        c.query("select * from zz_missing")
+    assert e.value.code == 1146  # no such table
+    with pytest.raises(MySQLError) as e:
+        c.query("selec 1")
+    assert e.value.code == 1064  # parse error
+    with pytest.raises(MySQLError) as e:
+        c.query("select no_col from ec")
+    assert e.value.code == 1054  # unknown column
+    c.close()
+
+
+def test_orm_connect_sequence(server):
+    """The statement burst a stock driver/ORM issues on connect."""
+    c = _connect(server)
+    assert c.query("SELECT @@version_comment LIMIT 1")
+    c.execute("SET NAMES utf8mb4")
+    c.execute("SET autocommit=1")
+    c.execute("SET sql_mode='STRICT_TRANS_TABLES'")
+    assert c.query("SHOW VARIABLES LIKE 'sql_mode'") == [
+        ("sql_mode", "STRICT_TRANS_TABLES")]
+    c.execute("create table orm (id int primary key, v varchar(10))")
+    rows = c.query("SELECT column_name FROM information_schema.columns "
+                   "WHERE table_schema = 'test' AND table_name = 'orm' "
+                   "ORDER BY ordinal_position")
+    assert rows == [("id",), ("v",)]
+    c.close()
+
+
+def test_create_user_real_auth(server):
+    root = _connect(server)
+    root.execute("CREATE USER 'bob' IDENTIFIED BY 's3cret'")
+    root.execute("GRANT SELECT, INSERT ON test.* TO 'bob'")
+    bob = _connect(server, user="bob", password="s3cret")
+    assert bob.ping()
+    bob.close()
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(server, user="bob", password="wrong")
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(server, user="bob", password="")
+    root.close()
+
+
+def test_privilege_enforcement(server):
+    root = _connect(server)
+    root.execute("create table pt (id int primary key, v int)")
+    root.execute("insert into pt values (1, 10)")
+    root.execute("CREATE USER 'carol' IDENTIFIED BY 'pw'")
+    root.execute("GRANT SELECT ON test.pt TO 'carol'")
+    carol = _connect(server, user="carol", password="pw")
+    assert carol.query("select v from pt") == [("10",)]
+    with pytest.raises(MySQLError) as e:
+        carol.execute("insert into pt values (2, 20)")
+    assert e.value.code == 1142  # table access denied
+    with pytest.raises(MySQLError):
+        carol.execute("drop table pt")
+    with pytest.raises(MySQLError):
+        carol.execute("CREATE USER 'dave'")  # no SUPER
+    # information_schema stays readable without explicit grants
+    assert carol.query("SELECT table_name FROM information_schema.tables "
+                       "WHERE table_schema = 'test' AND table_name = 'pt'")
+    carol.close()
+    root.execute("REVOKE SELECT ON test.pt FROM 'carol'")
+    carol2 = _connect(server, user="carol", password="pw")
+    with pytest.raises(MySQLError):
+        carol2.query("select v from pt")
+    carol2.close()
+    root.close()
+
+
+def test_show_grants(server):
+    root = _connect(server)
+    root.execute("CREATE USER 'erin' IDENTIFIED BY 'x'")
+    root.execute("GRANT SELECT ON test.* TO 'erin'")
+    rows = root.query("SHOW GRANTS FOR 'erin'")
+    assert rows == [("GRANT SELECT ON test.* TO 'erin'@'%'",)]
+    root.close()
+
+
+def test_users_survive_restart(tmp_path):
+    p = str(tmp_path / "db")
+    st = Storage(p)
+    s = Session(st)
+    s.execute("CREATE USER 'frank' IDENTIFIED BY 'pw9'")
+    s.execute("GRANT ALL ON test.* TO 'frank'")
+    st.close()
+
+    st2 = Storage(p)
+    srv = Server(port=0, storage=st2, allow_unknown_users=False)
+    srv.start()
+    try:
+        c = MiniClient("127.0.0.1", srv.port, user="frank", password="pw9")
+        c.execute("create table ft (id int primary key)")
+        c.close()
+        with pytest.raises((MySQLError, ConnectionError)):
+            MiniClient("127.0.0.1", srv.port, user="frank", password="bad")
+    finally:
+        srv.close(drain_timeout=0.2)
+
+
+# ==================== review-regression coverage ====================
+
+def test_set_then_dml_binds_vars():
+    s = Session()
+    s.execute("CREATE TABLE vb (id INT PRIMARY KEY, v INT)")
+    s.execute("SET @x := 7")
+    s.execute("INSERT INTO vb VALUES (1, @x)")
+    s.execute("UPDATE vb SET v = @x + 1 WHERE id = @x - 6")
+    assert s.query("SELECT v FROM vb") == [(8,)]
+    s.execute("DELETE FROM vb WHERE v = @x + 1")
+    assert s.query("SELECT COUNT(*) FROM vb") == [(0,)]
+
+
+def test_unqualified_grant_scopes_to_current_db():
+    st = Storage()
+    root = Session(st)
+    root.execute("CREATE DATABASE d1")
+    root.execute("CREATE DATABASE d2")
+    root.execute("CREATE TABLE d1.t (a INT)")
+    root.execute("CREATE TABLE d2.t (a INT)")
+    root.execute("CREATE USER 'u1'")
+    root.current_db = "d1"
+    root.execute("GRANT SELECT ON t TO 'u1'")
+    pm = st.privileges
+    assert pm.check("u1", "SELECT", "d1", "t")
+    assert not pm.check("u1", "SELECT", "d2", "t")
+
+
+def test_set_global_needs_super():
+    st = Storage()
+    root = Session(st)
+    root.execute("CREATE USER 'low'")
+    low = Session(st)
+    low.user = "low"
+    with pytest.raises(SQLError, match="SUPER"):
+        low.execute("SET GLOBAL max_connections = 1")
+    low.execute("SET max_execution_time = 3")  # session scope still fine
+
+
+def test_dml_subquery_needs_select_not_write():
+    st = Storage()
+    root = Session(st)
+    root.execute("CREATE TABLE tgt (a INT PRIMARY KEY)")
+    root.execute("CREATE TABLE src (a INT PRIMARY KEY)")
+    root.execute("INSERT INTO tgt VALUES (1), (2)")
+    root.execute("INSERT INTO src VALUES (1)")
+    root.execute("CREATE USER 'w'")
+    root.execute("GRANT DELETE ON test.tgt TO 'w'")
+    root.execute("GRANT SELECT ON test.src TO 'w'")
+    w = Session(st)
+    w.user = "w"
+    # the privilege gate runs before planning: the subquery source must
+    # pass under SELECT (not DELETE). Checked directly — the DML planner
+    # itself does not take IN-subqueries yet.
+    from tidb_tpu.sql.parser import parse_one
+
+    stmt = parse_one("DELETE FROM tgt WHERE a IN (SELECT a FROM src)")
+    w._check_privileges(stmt)  # must not raise
+    stmt2 = parse_one("DELETE FROM src WHERE a IN (SELECT a FROM tgt)")
+    with pytest.raises(SQLError, match="DELETE command denied"):
+        w._check_privileges(stmt2)
+
+
+def test_unknown_privilege_rejected():
+    st = Storage()
+    root = Session(st)
+    root.execute("CREATE USER 'z'")
+    with pytest.raises(SQLError, match="unknown privilege"):
+        root.execute("GRANT SLECT ON *.* TO 'z'")
+    root.execute("GRANT USAGE ON *.* TO 'z'")  # MySQL no-op form
+
+
+def test_configured_root_password_wins_over_grant_table(tmp_path):
+    srv = Server(port=0, users={"root": "rootpw"},
+                 allow_unknown_users=False)
+    srv.start()
+    try:
+        c = MiniClient("127.0.0.1", srv.port, user="root",
+                       password="rootpw")
+        assert c.ping()
+        c.close()
+        with pytest.raises((MySQLError, ConnectionError)):
+            MiniClient("127.0.0.1", srv.port, user="root", password="nope")
+    finally:
+        srv.close(drain_timeout=0.2)

@@ -1,0 +1,208 @@
+"""INFORMATION_SCHEMA virtual tables, materialized on demand.
+
+Counterpart of the reference's infoschema memtables (reference:
+infoschema/tables.go — SCHEMATA/TABLES/COLUMNS/... served straight from
+the InfoSchema snapshot by executor/infoschema_reader.go). Here the
+tables are ordinary columnar TableStores rebuilt from the live catalog
+right before a query touches them: the coprocessor then scans them like
+any other table, so filters/joins/aggregations over metadata need no
+special executor.
+
+The information_schema stores never persist (derived data) and never ride
+the KV plane — refresh replaces the whole store in place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types.field_type import FieldType, TypeKind
+from .schema import Catalog, ColumnInfo, SchemaInfo, TableInfo
+
+DB_NAME = "information_schema"
+
+
+def _vc(n: int = 64) -> FieldType:
+    return FieldType(TypeKind.VARCHAR, flen=n)
+
+
+def _bigint() -> FieldType:
+    return FieldType(TypeKind.BIGINT)
+
+
+# table name -> [(column name, ftype)]
+_DEFS: dict[str, list[tuple[str, FieldType]]] = {
+    "schemata": [
+        ("catalog_name", _vc()), ("schema_name", _vc()),
+        ("default_character_set_name", _vc(32)),
+        ("default_collation_name", _vc(32)), ("sql_path", _vc()),
+    ],
+    "tables": [
+        ("table_catalog", _vc()), ("table_schema", _vc()),
+        ("table_name", _vc()), ("table_type", _vc(32)),
+        ("engine", _vc(32)), ("version", _bigint()),
+        ("row_format", _vc(16)), ("table_rows", _bigint()),
+        ("avg_row_length", _bigint()), ("data_length", _bigint()),
+        ("index_length", _bigint()), ("auto_increment", _bigint()),
+        ("table_collation", _vc(32)), ("create_options", _vc()),
+        ("table_comment", _vc(128)),
+    ],
+    "columns": [
+        ("table_catalog", _vc()), ("table_schema", _vc()),
+        ("table_name", _vc()), ("column_name", _vc()),
+        ("ordinal_position", _bigint()), ("column_default", _vc(128)),
+        ("is_nullable", _vc(8)), ("data_type", _vc(32)),
+        ("character_maximum_length", _bigint()),
+        ("numeric_precision", _bigint()), ("numeric_scale", _bigint()),
+        ("character_set_name", _vc(32)), ("collation_name", _vc(32)),
+        ("column_type", _vc(64)), ("column_key", _vc(8)),
+        ("extra", _vc(32)), ("column_comment", _vc(128)),
+    ],
+    "statistics": [
+        ("table_catalog", _vc()), ("table_schema", _vc()),
+        ("table_name", _vc()), ("non_unique", _bigint()),
+        ("index_schema", _vc()), ("index_name", _vc()),
+        ("seq_in_index", _bigint()), ("column_name", _vc()),
+        ("cardinality", _bigint()), ("index_type", _vc(16)),
+    ],
+    "engines": [
+        ("engine", _vc(32)), ("support", _vc(8)), ("comment", _vc(128)),
+        ("transactions", _vc(8)), ("xa", _vc(8)), ("savepoints", _vc(8)),
+    ],
+    "collations": [
+        ("collation_name", _vc(32)), ("character_set_name", _vc(32)),
+        ("id", _bigint()), ("is_default", _vc(8)), ("is_compiled", _vc(8)),
+        ("sortlen", _bigint()),
+    ],
+    "character_sets": [
+        ("character_set_name", _vc(32)), ("default_collate_name", _vc(32)),
+        ("description", _vc(64)), ("maxlen", _bigint()),
+    ],
+}
+
+
+def table_names() -> set[str]:
+    return set(_DEFS)
+
+
+def ensure_schema(storage) -> None:
+    """Create the information_schema tables once (no data yet)."""
+    cat: Catalog = storage.catalog
+    if DB_NAME in cat.schemas and \
+            all(t in cat.schemas[DB_NAME].tables for t in _DEFS):
+        return
+    if DB_NAME not in cat.schemas:
+        cat.schemas[DB_NAME] = SchemaInfo(DB_NAME)
+    schema = cat.schemas[DB_NAME]
+    for tname, cols in _DEFS.items():
+        if tname in schema.tables:
+            continue
+        info = TableInfo(
+            id=cat.alloc_id(),
+            name=tname,
+            columns=[ColumnInfo(cat.alloc_id(), cn, ft, offset=i)
+                     for i, (cn, ft) in enumerate(cols)],
+        )
+        schema.tables[tname] = info
+        store = storage.register_table(info)
+        store.on_epoch = None  # derived data: never persist
+
+
+def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
+    user_schemas = [s for k, s in sorted(catalog.schemas.items())
+                    if k != DB_NAME]
+    rows: list[list] = []
+    if tname == "schemata":
+        for s in user_schemas:
+            rows.append(["def", s.name, "utf8mb4", "utf8mb4_bin", None])
+    elif tname == "tables":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                store = storage.tables.get(t.id)
+                nrows = 0
+                if store is not None:
+                    nrows = store.epoch.num_rows + len(store.deltas)
+                rows.append(["def", s.name, t.name, "BASE TABLE", "TiTPU",
+                             10, "Fixed", nrows, 0, 0, 0, None,
+                             "utf8mb4_bin", "", ""])
+    elif tname == "columns":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                for c in t.columns:
+                    ft = c.ftype
+                    key = "PRI" if c.is_primary else (
+                        "UNI" if any(ix.unique and ix.col_offsets ==
+                                     [c.offset] for ix in t.indices) else "")
+                    rows.append([
+                        "def", s.name, t.name, c.name, c.offset + 1,
+                        None if c.default is None else str(c.default),
+                        "YES" if c.nullable else "NO",
+                        ft.kind.name.lower(),
+                        ft.flen if ft.is_string else None,
+                        ft.flen if ft.is_decimal else None,
+                        ft.scale if ft.is_decimal else None,
+                        "utf8mb4" if ft.is_string else None,
+                        "utf8mb4_bin" if ft.is_string else None,
+                        repr(ft), key,
+                        "auto_increment" if c.auto_increment else "", ""])
+    elif tname == "statistics":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                for ix in t.indices:
+                    if not ix.visible:
+                        continue
+                    for seq, off in enumerate(ix.col_offsets):
+                        rows.append([
+                            "def", s.name, t.name,
+                            0 if ix.unique or ix.primary else 1,
+                            s.name, ix.name, seq + 1,
+                            t.columns[off].name, 0, "BTREE"])
+    elif tname == "engines":
+        rows.append(["InnoDB", "DEFAULT",
+                     "TiTPU columnar engine (InnoDB-compatible surface)",
+                     "YES", "NO", "NO"])
+    elif tname == "collations":
+        rows.append(["utf8mb4_bin", "utf8mb4", 46, "Yes", "Yes", 1])
+        rows.append(["utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1])
+    elif tname == "character_sets":
+        rows.append(["utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4])
+    return rows
+
+
+def refresh(storage, names: set[str]) -> None:
+    """Rebuild the named information_schema stores from the live catalog."""
+    ensure_schema(storage)
+    cat: Catalog = storage.catalog
+    schema = cat.schemas[DB_NAME]
+    from ..store.table_store import TableStore
+
+    for tname in names:
+        if tname not in _DEFS:
+            continue
+        info = schema.tables[tname]
+        # build the fresh store COMPLETELY, then publish in one assignment
+        # — concurrent readers either see the old rows or the new ones,
+        # never an empty/missing table mid-refresh
+        store = TableStore(info)
+        store.on_epoch = None
+        rows = _rows_for(storage, cat, tname)
+        n = len(rows)
+        columns: list[np.ndarray] = []
+        valids: list = []
+        for ci, c in enumerate(info.columns):
+            ft = c.ftype
+            data = np.zeros(n, dtype=ft.np_dtype)
+            valid = np.ones(n, dtype=bool)
+            d = store.dictionaries[ci]
+            for ri, row in enumerate(rows):
+                v = row[ci]
+                if v is None:
+                    valid[ri] = False
+                elif d is not None:
+                    data[ri] = d.encode(str(v))
+                else:
+                    data[ri] = v
+            columns.append(data)
+            valids.append(None if valid.all() else valid)
+        store.bulk_load(columns, valids)
+        storage.tables[info.id] = store  # atomic publish

@@ -18,6 +18,7 @@ from typing import TYPE_CHECKING, Optional
 
 from ..session.session import ResultSet, Session, SQLError
 from . import packet as P
+from .errors import classify
 
 if TYPE_CHECKING:
     from .server import Server
@@ -100,13 +101,25 @@ class ClientConn:
         self.io.flush()
 
     def _check_auth(self, user: str, auth: bytes) -> bool:
+        """Server-config accounts (operator-provisioned, incl. the root
+        bootstrap password) take precedence — otherwise the grant-table
+        root row (empty auth) would accept any password. Accounts created
+        via CREATE USER verify against their stored double-SHA1 and get
+        their grants enforced per statement (reference:
+        privilege/privileges/privileges.go auth + cache)."""
         pwd = self.server.users.get(user)
-        if pwd is None:
-            return self.server.allow_unknown_users
-        if pwd == "":
-            return True
-        want = _native_scramble(pwd, self.salt)
-        return secrets.compare_digest(want, auth)
+        if pwd is not None:
+            if pwd == "":
+                return True
+            want = _native_scramble(pwd, self.salt)
+            return secrets.compare_digest(want, auth)
+        pm = self.server.storage.privileges
+        if pm.exists(user):
+            ok = pm.verify_native(user, self.salt, auth)
+            if ok:
+                self.session.user = user
+            return ok
+        return self.server.allow_unknown_users
 
     # ---- command loop ------------------------------------------------------
     def run(self) -> None:
@@ -175,7 +188,8 @@ class ClientConn:
         try:
             rs = self.session.execute(sql)
         except Exception as e:  # noqa: BLE001 - wire boundary catches all
-            self.io.write_packet(P.err_packet(1105, str(e)))
+            code, state = classify(str(e))
+            self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._write_resultset(rs)
         return True
@@ -200,7 +214,8 @@ class ClientConn:
         try:
             sid, n_params = self.session.prepare(sql)
         except Exception as e:  # noqa: BLE001 - wire boundary
-            self.io.write_packet(P.err_packet(1105, str(e)))
+            code, state = classify(str(e))
+            self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._stmt_meta[sid] = (n_params, None)
         self.io.write_packet(P.stmt_prepare_ok(sid, 0, n_params))
@@ -227,7 +242,8 @@ class ClientConn:
                 self._stmt_meta[sid] = (n_params, types)
             rs = self.session.execute_prepared(sid, params)
         except Exception as e:  # noqa: BLE001 - wire boundary
-            self.io.write_packet(P.err_packet(1105, str(e)))
+            code, state = classify(str(e))
+            self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._write_resultset(rs, binary=True)
         return True

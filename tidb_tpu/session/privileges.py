@@ -1,0 +1,185 @@
+"""User accounts + privilege checks, persisted in the meta keyspace.
+
+Counterpart of the reference's privilege subsystem (reference:
+privilege/privileges/cache.go — the mysql.user/db/tables_priv grant
+tables cached in memory; checks hooked at plan build,
+planner/optimize.go:246). Scaled to the statement surface this engine
+executes: account management (CREATE/DROP USER, GRANT/REVOKE), the
+mysql_native_password verification the wire server needs, and
+table/db/global-scope privilege checks enforced by the session before
+statements run.
+
+Passwords store as SHA1(SHA1(password)) — MySQL's authentication_string
+— so the server can verify the native-password scramble without ever
+holding the cleartext: given client response R and salt s,
+X := R xor SHA1(s + stored) recovers SHA1(password), and SHA1(X) must
+equal stored (reference: server/auth semantics, conn.go:665)."""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import threading
+from typing import Optional
+
+PRIVS = frozenset({
+    "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP", "ALTER",
+    "INDEX", "ALL", "USAGE",
+})
+
+_META_KEY = b"priv:users"
+
+
+def _hash2(password: str) -> bytes:
+    return hashlib.sha1(
+        hashlib.sha1(password.encode("utf-8")).digest()).digest()
+
+
+class PrivilegeError(Exception):
+    pass
+
+
+class PrivilegeManager:
+    """name -> {"auth": SHA1(SHA1(pwd)) bytes | b"" (empty password),
+    "grants": set[(priv, db, tbl)]}; '*' wildcards both scopes.
+    root@empty-password with ALL on *.* bootstraps (reference:
+    session/bootstrap.go:461 creates the root row the same way)."""
+
+    def __init__(self, storage) -> None:
+        self._storage = storage
+        self._lock = threading.Lock()
+        self._users: Optional[dict] = None
+
+    def _load(self) -> dict:
+        with self._lock:
+            if self._users is None:
+                raw = self._storage.get_meta(_META_KEY)
+                if raw is not None:
+                    self._users = pickle.loads(raw)
+                else:
+                    self._users = {
+                        "root": {"auth": b"",
+                                 "grants": {("ALL", "*", "*")}},
+                    }
+            return self._users
+
+    def _persist(self) -> None:
+        self._storage.put_meta(_META_KEY, pickle.dumps(self._users))
+
+    # ---- account management -------------------------------------------
+    def create_user(self, name: str, password: str,
+                    if_not_exists: bool = False) -> None:
+        users = self._load()
+        with self._lock:
+            if name in users:
+                if if_not_exists:
+                    return
+                raise PrivilegeError(
+                    f"Operation CREATE USER failed for '{name}'")
+            users[name] = {
+                "auth": _hash2(password) if password else b"",
+                "grants": set(),
+            }
+            self._persist()
+
+    def drop_user(self, name: str, if_exists: bool = False) -> None:
+        users = self._load()
+        with self._lock:
+            if name not in users:
+                if if_exists:
+                    return
+                raise PrivilegeError(
+                    f"Operation DROP USER failed for '{name}'")
+            del users[name]
+            self._persist()
+
+    def set_password(self, name: str, password: str) -> None:
+        users = self._load()
+        with self._lock:
+            if name not in users:
+                raise PrivilegeError(f"unknown user '{name}'")
+            users[name]["auth"] = _hash2(password) if password else b""
+            self._persist()
+
+    @staticmethod
+    def _validate(privs: list[str]) -> list[str]:
+        out = []
+        for p in privs:
+            p = p.upper()
+            if p not in PRIVS:
+                raise PrivilegeError(f"unknown privilege '{p}'")
+            if p != "USAGE":  # USAGE = "no privileges" (MySQL): a no-op
+                out.append(p)
+        return out
+
+    def grant(self, privs: list[str], db: str, tbl: str,
+              name: str) -> None:
+        privs = self._validate(privs)
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            if u is None:
+                raise PrivilegeError(f"unknown user '{name}'")
+            for p in privs:
+                u["grants"].add((p, db.lower(), tbl.lower()))
+            self._persist()
+
+    def revoke(self, privs: list[str], db: str, tbl: str,
+               name: str) -> None:
+        privs = self._validate(privs)
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            if u is None:
+                raise PrivilegeError(f"unknown user '{name}'")
+            for p in privs:
+                u["grants"].discard((p, db.lower(), tbl.lower()))
+            self._persist()
+
+    def grants_for(self, name: str) -> list[tuple[str, str, str]]:
+        u = self._load().get(name)
+        return sorted(u["grants"]) if u else []
+
+    def exists(self, name: str) -> bool:
+        return name in self._load()
+
+    # ---- checks --------------------------------------------------------
+    def check(self, name: Optional[str], priv: str, db: str,
+              tbl: str = "*") -> bool:
+        """None user = internal session (unchecked); information_schema is
+        world-readable (reference: infoschema needs no grants)."""
+        if name is None:
+            return True
+        if priv == "SELECT" and db.lower() == "information_schema":
+            return True
+        u = self._load().get(name)
+        if u is None:
+            return False
+        db = db.lower()
+        tbl = tbl.lower()
+        for gp, gdb, gtbl in u["grants"]:
+            if gp not in (priv, "ALL"):
+                continue
+            if gdb not in (db, "*"):
+                continue
+            if gtbl in (tbl, "*"):
+                return True
+        return False
+
+    # ---- wire auth -----------------------------------------------------
+    def verify_native(self, name: str, salt: bytes,
+                      response: bytes) -> bool:
+        """mysql_native_password check against the stored double-SHA1."""
+        u = self._load().get(name)
+        if u is None:
+            return False
+        stored = u["auth"]
+        if stored == b"":
+            return True  # empty password accepts any/empty response
+        if len(response) != 20:
+            return False
+        mask = hashlib.sha1(salt + stored).digest()
+        candidate = bytes(a ^ b for a, b in zip(response, mask))
+        import secrets
+        return secrets.compare_digest(hashlib.sha1(candidate).digest(),
+                                      stored)

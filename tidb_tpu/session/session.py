@@ -61,7 +61,13 @@ class Session:
         self._next_stmt_id = 0
         self.txn: Optional[Transaction] = None
         self.in_explicit_txn = False
+        # authenticated account for privilege checks; None = internal
+        # session, unchecked (reference: planner/optimize.go:246 hook)
+        self.user: Optional[str] = None
+        # session-scope system variable overrides + user variables
+        # (reference: sessionctx/variable/session.go SessionVars)
         self.vars: dict[str, Any] = {}
+        self.user_vars: dict[str, Any] = {}
         self._stmt_seq = 0
 
     # ==================== public API ====================
@@ -160,8 +166,44 @@ class Session:
 
     # ==================== statement dispatch ====================
     def _execute_stmt(self, stmt: ast.Stmt) -> ResultSet:
+        if self.user is not None:
+            self._check_privileges(stmt)
+        if isinstance(stmt, ast.CreateUserStmt):
+            self._require_super()
+            from .privileges import PrivilegeError
+            try:
+                self.storage.privileges.create_user(
+                    stmt.name, stmt.password, stmt.if_not_exists)
+            except PrivilegeError as e:
+                raise SQLError(str(e)) from None
+            return ResultSet([], [])
+        if isinstance(stmt, ast.DropUserStmt):
+            self._require_super()
+            from .privileges import PrivilegeError
+            try:
+                self.storage.privileges.drop_user(stmt.name, stmt.if_exists)
+            except PrivilegeError as e:
+                raise SQLError(str(e)) from None
+            return ResultSet([], [])
+        if isinstance(stmt, ast.GrantStmt):
+            self._require_super()
+            from .privileges import PrivilegeError
+            db = stmt.db if stmt.db else self.current_db
+            try:
+                if stmt.revoke:
+                    self.storage.privileges.revoke(
+                        stmt.privs, db, stmt.table, stmt.user)
+                else:
+                    self.storage.privileges.grant(
+                        stmt.privs, db, stmt.table, stmt.user)
+            except PrivilegeError as e:
+                raise SQLError(str(e)) from None
+            return ResultSet([], [])
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return self._run_in_txn(lambda: self._exec_select(stmt))
+        if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
+                             ast.DeleteStmt)):
+            stmt = self._maybe_bind_vars(stmt)
         if isinstance(stmt, ast.InsertStmt):
             return self._run_in_txn(lambda: self._exec_insert(stmt))
         if isinstance(stmt, ast.UpdateStmt):
@@ -183,6 +225,9 @@ class Session:
         if isinstance(stmt, ast.TruncateTableStmt):
             return self._exec_truncate(stmt)
         if isinstance(stmt, ast.UseStmt):
+            from ..catalog import infoschema as I
+            if stmt.db.lower() == I.DB_NAME:
+                I.ensure_schema(self.storage)
             self.catalog.schema(stmt.db)  # raises if unknown
             self.current_db = stmt.db
             return ResultSet([], [])
@@ -202,11 +247,7 @@ class Session:
         if isinstance(stmt, ast.ShowStmt):
             return self._exec_show(stmt)
         if isinstance(stmt, ast.SetStmt):
-            for scope, name, expr in stmt.items:
-                c = _literal_const(expr) if isinstance(expr, ast.Literal) \
-                    else None
-                self.vars[name.lower()] = c.value if c is not None else None
-            return ResultSet([], [])
+            return self._exec_set(stmt)
         if isinstance(stmt, ast.AnalyzeTableStmt):
             return self._exec_analyze(stmt)
         if isinstance(stmt, ast.AlterTableStmt):
@@ -234,6 +275,204 @@ class Session:
                     [j.row() for j in jobs[:32]])
             raise SQLError(f"unsupported ADMIN {stmt.kind}")
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
+
+    # ==================== system / user variables ====================
+    def _exec_set(self, stmt: ast.SetStmt) -> ResultSet:
+        """SET handling over the sysvar registry (reference:
+        executor/set.go; registry in sessionctx/variable/sysvar.go)."""
+        from .sysvars import SCOPE_GLOBAL, SCOPE_SESSION, SYSVARS
+
+        for scope, name, expr in stmt.items:
+            value = self._set_value(expr)
+            if scope == "USERVAR":
+                self.user_vars[name] = value
+                continue
+            if scope == "NAMES":
+                for v in ("character_set_client", "character_set_connection",
+                          "character_set_results"):
+                    self.vars[v] = value
+                continue
+            sv = SYSVARS.get(name)
+            if sv is None:
+                # tolerate unknown tidb_/engine-prefixed knobs (forward
+                # compat); reject arbitrary unknowns like MySQL does
+                if name.startswith(("tidb_", "innodb_", "sql_")):
+                    self.vars[name] = value
+                    continue
+                raise SQLError(f"Unknown system variable '{name}'")
+            if sv.read_only:
+                raise SQLError(
+                    f"Variable '{name}' is a read only variable")
+            if isinstance(expr, ast.Literal) and expr.tag == "default":
+                value = sv.default
+            if scope == "GLOBAL":
+                if not sv.scope & SCOPE_GLOBAL:
+                    raise SQLError(
+                        f"Variable '{name}' is a SESSION variable and "
+                        "can't be used with SET GLOBAL")
+                # cluster-wide durable state: superuser only (reference:
+                # SUPER/SYSTEM_VARIABLES_ADMIN requirement)
+                self._require_super()
+                self.storage.sysvars.set_global(name, value)
+            else:
+                if not sv.scope & SCOPE_SESSION:
+                    raise SQLError(
+                        f"Variable '{name}' is a GLOBAL variable and "
+                        "should be set with SET GLOBAL")
+                self.vars[name] = value
+        return ResultSet([], [])
+
+    def _set_value(self, expr: ast.Expr) -> Any:
+        if isinstance(expr, ast.Literal):
+            if expr.tag == "decimal":
+                return Decimal(expr.value.unscaled, expr.value.scale) \
+                    if hasattr(expr.value, "unscaled") else expr.value
+            return expr.value
+        if isinstance(expr, ast.ColumnRef):
+            return expr.name  # bare ident value (utf8mb4, ON, ...)
+        if isinstance(expr, ast.SysVarExpr):
+            return self._sysvar_value(expr.name, expr.scope)
+        if isinstance(expr, ast.UserVarExpr):
+            return self.user_vars.get(expr.name)
+        if isinstance(expr, ast.UnaryOp) and isinstance(
+                expr.operand, ast.Literal):
+            v = expr.operand.value
+            return -v if expr.op == "-" else v
+        raise SQLError("unsupported SET value expression")
+
+    def _sysvar_value(self, name: str, scope: str = "SESSION") -> Any:
+        from .sysvars import SYSVARS
+
+        if scope != "GLOBAL" and name in self.vars:
+            return self.vars[name]
+        v = self.storage.sysvars.get_global(name)
+        if v is None and name not in SYSVARS:
+            raise SQLError(f"Unknown system variable '{name}'")
+        return v
+
+    def _bind_vars(self, node):
+        """Substitute @@sysvar / @user_var reads with typed literals before
+        planning (the planner sees plain constants)."""
+
+        def lit(v):
+            if v is None:
+                return ast.Literal(None, "null")
+            if isinstance(v, bool):
+                return ast.Literal(int(v), "int")
+            if isinstance(v, int):
+                return ast.Literal(v, "int")
+            if isinstance(v, float):
+                return ast.Literal(v, "float")
+            return ast.Literal(str(v), "string")
+
+        def fn(n):
+            if isinstance(n, ast.SysVarExpr):
+                return lit(self._sysvar_value(n.name, n.scope))
+            if isinstance(n, ast.UserVarExpr):
+                return lit(self.user_vars.get(n.name))
+            return n
+
+        return ast.transform(node, fn)
+
+    @staticmethod
+    def _has_var_reads(node) -> bool:
+        found = False
+
+        def visit(n):
+            nonlocal found
+            if isinstance(n, (ast.SysVarExpr, ast.UserVarExpr)):
+                found = True
+                return False
+            return None
+
+        ast.walk(node, visit)
+        return found
+
+    def _maybe_bind_vars(self, stmt):
+        """@var / @@var reads bind in every expression-bearing statement
+        (SELECT and DML alike — the SET-then-DML pattern is standard)."""
+        if self._has_var_reads(stmt):
+            import copy as _copy
+            return self._bind_vars(_copy.deepcopy(stmt))
+        return stmt
+
+    # ==================== privileges ====================
+    def _require_super(self) -> None:
+        if self.user is not None and not self.storage.privileges.check(
+                self.user, "ALL", "*", "*"):
+            raise SQLError(
+                f"Access denied; you need SUPER privilege(s) "
+                f"for this operation (user '{self.user}')")
+
+    @staticmethod
+    def _collect_table_names(stmt) -> list[ast.TableName]:
+        out: list[ast.TableName] = []
+
+        def visit(n):
+            if isinstance(n, ast.TableName):
+                out.append(n)
+                return False
+            return None
+
+        ast.walk(stmt, visit)
+        return out
+
+    _STMT_PRIV = {
+        ast.InsertStmt: "INSERT", ast.UpdateStmt: "UPDATE",
+        ast.DeleteStmt: "DELETE", ast.CreateTableStmt: "CREATE",
+        ast.DropTableStmt: "DROP", ast.TruncateTableStmt: "DROP",
+        ast.AlterTableStmt: "ALTER", ast.CreateIndexStmt: "INDEX",
+        ast.DropIndexStmt: "INDEX", ast.RenameTableStmt: "ALTER",
+        ast.CreateDatabaseStmt: "CREATE", ast.DropDatabaseStmt: "DROP",
+    }
+
+    def _check_privileges(self, stmt: ast.Stmt) -> None:
+        """Statement-level grant checks before planning (reference:
+        visitInfo checks at planner/optimize.go:246)."""
+        pm = self.storage.privileges
+
+        def deny(priv: str, obj: str):
+            raise SQLError(
+                f"{priv} command denied to user '{self.user}' "
+                f"for table '{obj}'")
+
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt,
+                             ast.ExplainStmt, ast.AnalyzeTableStmt)):
+            for tn in self._collect_table_names(stmt):
+                db = tn.db or self.current_db
+                if not pm.check(self.user, "SELECT", db, tn.name):
+                    deny("SELECT", f"{db}.{tn.name}")
+            return
+        priv = self._STMT_PRIV.get(type(stmt))
+        if priv is None:
+            return  # txn control, SET, SHOW, USE, admin: unchecked
+        if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):
+            if not pm.check(self.user, priv, stmt.name, "*"):
+                deny(priv, stmt.name)
+            return
+        # the DML privilege applies to the statement's TARGET table;
+        # every other referenced table (subqueries, INSERT..SELECT
+        # sources) needs SELECT
+        target = getattr(stmt, "table", None)
+        for tn in self._collect_table_names(stmt):
+            db = tn.db or self.current_db
+            need = priv if (tn is target or target is None) else "SELECT"
+            if not pm.check(self.user, need, db, tn.name):
+                deny(need, f"{db}.{tn.name}")
+
+    # ==================== information_schema ====================
+    def _refresh_infoschema(self, stmt) -> None:
+        """Rebuild any information_schema tables this statement touches
+        from the live catalog (reference: infoschema memtables are served
+        from the InfoSchema snapshot, executor/infoschema_reader.go)."""
+        from ..catalog import infoschema as I
+
+        names: set[str] = set()
+        for tn in self._collect_table_names(stmt):
+            if (tn.db or self.current_db).lower() == I.DB_NAME:
+                names.add(tn.name.lower())
+        if names:
+            I.refresh(self.storage, names)
 
     # ==================== online DDL ====================
     def _ddl(self):
@@ -364,6 +603,8 @@ class Session:
 
     # ==================== SELECT ====================
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
+        stmt = self._maybe_bind_vars(stmt)
+        self._refresh_infoschema(stmt)
         plan = self._plan(stmt)
         ctx = ExecContext(self._ensure_txn(), self.cop)
         chunk = run_physical(plan, ctx)
@@ -736,7 +977,8 @@ class Session:
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
         if stmt.kind == "TABLES":
             schema = self.catalog.schema(self.current_db)
-            names = sorted(t.name for t in schema.tables.values())
+            names = sorted(t.name for t in schema.tables.values()
+                           if _like_match(stmt.pattern, t.name))
             return ResultSet([f"Tables_in_{self.current_db}"],
                              [(n,) for n in names])
         if stmt.kind == "DATABASES":
@@ -754,8 +996,71 @@ class Session:
             ddl = f"CREATE TABLE `{info.name}` (\n  {cols}\n)"
             return ResultSet(["Table", "Create Table"], [(info.name, ddl)])
         if stmt.kind == "VARIABLES":
+            vals = dict(self.storage.sysvars.all_globals())
+            if stmt.scope != "GLOBAL":
+                vals.update({k: v for k, v in self.vars.items()})
+            rows = [(k, "" if v is None else str(v))
+                    for k, v in sorted(vals.items())
+                    if _like_match(stmt.pattern, k)]
+            return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.kind == "STATUS":
+            rows = [("Uptime", "0"), ("Threads_connected", "1"),
+                    ("Questions", str(self._stmt_seq)),
+                    ("Ssl_cipher", "")]
             return ResultSet(["Variable_name", "Value"],
-                             sorted(self.vars.items()))
+                             [r for r in rows
+                              if _like_match(stmt.pattern, r[0])])
+        if stmt.kind == "GRANTS":
+            target = stmt.pattern or self.user or "root"
+            rows = []
+            for p, db, tbl in self.storage.privileges.grants_for(target):
+                obj = "*.*" if db == "*" and tbl == "*" else f"{db}.{tbl}"
+                rows.append((f"GRANT {p} ON {obj} TO '{target}'@'%'",))
+            return ResultSet([f"Grants for {target}@%"], rows)
+        if stmt.kind == "WARNINGS":
+            return ResultSet(["Level", "Code", "Message"], [])
+        if stmt.kind == "ENGINES":
+            return ResultSet(
+                ["Engine", "Support", "Comment", "Transactions", "XA",
+                 "Savepoints"],
+                [("InnoDB", "DEFAULT",
+                  "TiTPU columnar engine (InnoDB-compatible surface)",
+                  "YES", "NO", "NO")])
+        if stmt.kind == "COLLATION":
+            return ResultSet(
+                ["Collation", "Charset", "Id", "Default", "Compiled",
+                 "Sortlen"],
+                [("utf8mb4_bin", "utf8mb4", 46, "Yes", "Yes", 1)])
+        if stmt.kind == "COLUMNS":
+            assert stmt.target is not None
+            info, _ = self._table_for(stmt.target)
+            rows = []
+            for c in info.columns:
+                key = "PRI" if c.is_primary else ""
+                rows.append((c.name, repr(c.ftype),
+                             "YES" if c.nullable else "NO", key,
+                             None if c.default is None else str(c.default),
+                             "auto_increment" if c.auto_increment else ""))
+            return ResultSet(
+                ["Field", "Type", "Null", "Key", "Default", "Extra"],
+                [r for r in rows if _like_match(stmt.pattern, r[0])])
+        if stmt.kind == "INDEX":
+            assert stmt.target is not None
+            info, _ = self._table_for(stmt.target)
+            rows = []
+            for ix in info.indices:
+                if not ix.visible:
+                    continue
+                for seq, off in enumerate(ix.col_offsets):
+                    rows.append((
+                        info.name, 0 if ix.unique or ix.primary else 1,
+                        ix.name, seq + 1, info.columns[off].name, "A",
+                        0, None, None, "", "BTREE", "", ""))
+            return ResultSet(
+                ["Table", "Non_unique", "Key_name", "Seq_in_index",
+                 "Column_name", "Collation", "Cardinality", "Sub_part",
+                 "Packed", "Null", "Index_type", "Comment",
+                 "Index_comment"], rows)
         if stmt.kind == "SLOW":
             from .. import obs
             rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"])
@@ -780,6 +1085,23 @@ class Session:
         except KeyError as e:
             raise SQLError(str(e)) from None
         return info, self.storage.table_store(info.id)
+
+
+def _like_match(pattern: Optional[str], s: str) -> bool:
+    """MySQL LIKE over SHOW output (case-insensitive, % and _)."""
+    if pattern is None:
+        return True
+    import re
+
+    rx = []
+    for ch in pattern:
+        if ch == "%":
+            rx.append(".*")
+        elif ch == "_":
+            rx.append(".")
+        else:
+            rx.append(re.escape(ch))
+    return re.fullmatch("".join(rx), s, re.IGNORECASE) is not None
 
 
 def _coldef_ftype(cd) -> FieldType:

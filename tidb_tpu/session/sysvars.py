@@ -1,0 +1,156 @@
+"""System-variable framework: registry, scopes, persistence.
+
+Counterpart of the reference's sysvar subsystem (reference:
+sessionctx/variable/sysvar.go — ~400 vars with scope flags;
+session/session.go:1048 loads GLOBAL values from mysql.global_variables;
+SET handling in executor/set.go). Scaled to the variables real clients,
+ORMs and BI tools actually touch on connect, plus the engine's own knobs.
+
+GLOBAL writes persist through the meta keyspace of the storage (the
+mysql.global_variables analog), so SET GLOBAL survives restarts on a
+durable store. SESSION reads fall back GLOBAL -> default.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+SCOPE_GLOBAL = 1
+SCOPE_SESSION = 2
+SCOPE_BOTH = SCOPE_GLOBAL | SCOPE_SESSION
+
+
+@dataclass(frozen=True)
+class SysVar:
+    name: str
+    default: Any
+    scope: int = SCOPE_BOTH
+    read_only: bool = False
+
+
+def _v(name, default, scope=SCOPE_BOTH, read_only=False):
+    return SysVar(name, default, scope, read_only)
+
+
+# the connect-time surface of MySQL clients/ORMs + engine knobs
+_VARS = [
+    _v("version", "5.7.25-TiDB-TPU", read_only=True),
+    _v("version_comment", "TiDB-TPU Server (tidb_tpu)", read_only=True),
+    _v("version_compile_os", "linux", read_only=True),
+    _v("version_compile_machine", "tpu", read_only=True),
+    _v("protocol_version", 10, read_only=True),
+    _v("license", "Apache License 2.0", read_only=True),
+    _v("port", 4000, scope=SCOPE_GLOBAL, read_only=True),
+    _v("socket", "", scope=SCOPE_GLOBAL, read_only=True),
+    _v("datadir", "/tmp/tidb_tpu", scope=SCOPE_GLOBAL, read_only=True),
+    _v("hostname", "localhost", scope=SCOPE_GLOBAL, read_only=True),
+    _v("autocommit", 1),
+    _v("sql_mode", "ONLY_FULL_GROUP_BY,STRICT_TRANS_TABLES,"
+       "NO_ZERO_IN_DATE,NO_ZERO_DATE,ERROR_FOR_DIVISION_BY_ZERO,"
+       "NO_AUTO_CREATE_USER,NO_ENGINE_SUBSTITUTION"),
+    _v("sql_select_limit", 2 ** 64 - 1),
+    _v("max_allowed_packet", 67108864),
+    _v("net_buffer_length", 16384),
+    _v("net_write_timeout", 60),
+    _v("net_read_timeout", 30),
+    _v("interactive_timeout", 28800),
+    _v("wait_timeout", 28800),
+    _v("lock_wait_timeout", 31536000),
+    _v("innodb_lock_wait_timeout", 50),
+    _v("max_execution_time", 0),
+    _v("character_set_client", "utf8mb4"),
+    _v("character_set_connection", "utf8mb4"),
+    _v("character_set_results", "utf8mb4"),
+    _v("character_set_server", "utf8mb4"),
+    _v("character_set_database", "utf8mb4"),
+    _v("character_set_system", "utf8", read_only=True),
+    _v("collation_connection", "utf8mb4_bin"),
+    _v("collation_server", "utf8mb4_bin"),
+    _v("collation_database", "utf8mb4_bin"),
+    _v("init_connect", "", scope=SCOPE_GLOBAL),
+    _v("time_zone", "SYSTEM"),
+    _v("system_time_zone", "UTC", read_only=True),
+    _v("lower_case_table_names", 2, scope=SCOPE_GLOBAL, read_only=True),
+    _v("explicit_defaults_for_timestamp", 1),
+    _v("foreign_key_checks", 0),
+    _v("unique_checks", 1),
+    _v("auto_increment_increment", 1),
+    _v("auto_increment_offset", 1),
+    _v("last_insert_id", 0, scope=SCOPE_SESSION),
+    _v("identity", 0, scope=SCOPE_SESSION),
+    _v("warning_count", 0, scope=SCOPE_SESSION, read_only=True),
+    _v("error_count", 0, scope=SCOPE_SESSION, read_only=True),
+    _v("tx_isolation", "REPEATABLE-READ"),
+    _v("transaction_isolation", "REPEATABLE-READ"),
+    _v("tx_read_only", 0),
+    _v("transaction_read_only", 0),
+    _v("performance_schema", 0, scope=SCOPE_GLOBAL, read_only=True),
+    _v("query_cache_type", "OFF", scope=SCOPE_GLOBAL, read_only=True),
+    _v("query_cache_size", 0, scope=SCOPE_GLOBAL, read_only=True),
+    _v("have_openssl", "DISABLED", read_only=True),
+    _v("have_ssl", "DISABLED", read_only=True),
+    _v("max_connections", 0, scope=SCOPE_GLOBAL),
+    _v("default_storage_engine", "InnoDB", read_only=True),
+    _v("default_authentication_plugin", "mysql_native_password",
+       scope=SCOPE_GLOBAL, read_only=True),
+    # engine knobs (reference: sessionctx/variable/tidb_vars.go)
+    _v("tidb_slow_log_threshold", 300),
+    _v("tidb_snapshot", ""),
+    _v("tidb_distsql_scan_concurrency", 15),
+    _v("tidb_index_lookup_concurrency", 4),
+    _v("tidb_mem_quota_query", 1 << 30),
+    _v("tidb_enable_plan_cache", 1),
+    _v("tidb_txn_mode", "optimistic"),
+    _v("tidb_retry_limit", 10),
+    _v("tidb_tile_rows", 1 << 22),
+]
+
+SYSVARS: dict[str, SysVar] = {v.name: v for v in _VARS}
+
+_META_PREFIX = b"sysvar:"
+
+
+class SysVarManager:
+    """Process-wide GLOBAL values; owned by the Storage (one per 'cluster').
+
+    put/get ride the meta keyspace, so on a durable store SET GLOBAL
+    survives restart (mysql.global_variables analog)."""
+
+    def __init__(self, storage) -> None:
+        self._storage = storage
+        self._globals: dict[str, Any] = {}
+        self._loaded = False
+
+    def _load(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        for name, sv in SYSVARS.items():
+            raw = self._storage.get_meta(_META_PREFIX + name.encode())
+            if raw is not None:
+                val: Any = raw.decode("utf-8")
+                if isinstance(sv.default, int):
+                    try:
+                        val = int(val)
+                    except ValueError:
+                        pass
+                self._globals[name] = val
+
+    def get_global(self, name: str) -> Optional[Any]:
+        self._load()
+        v = SYSVARS.get(name)
+        if v is None:
+            return None
+        return self._globals.get(name, v.default)
+
+    def set_global(self, name: str, value: Any) -> None:
+        self._load()
+        self._globals[name] = value
+        self._storage.put_meta(_META_PREFIX + name.encode(),
+                               str(value).encode("utf-8"))
+
+    def all_globals(self) -> dict[str, Any]:
+        self._load()
+        return {name: self._globals.get(name, v.default)
+                for name, v in SYSVARS.items()}

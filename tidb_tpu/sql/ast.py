@@ -12,6 +12,49 @@ from typing import Any, Optional
 from ..types.field_type import FieldType
 
 
+# ---- generic traversal ------------------------------------------------------
+
+def walk(node, visit) -> None:
+    """Depth-first visit of every dataclass node (lists and tuples of
+    nodes included). visit(node) returning False prunes that subtree."""
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(node) and not isinstance(node, type):
+        if visit(node) is False:
+            return
+        for f in _dc.fields(node):
+            walk_value(getattr(node, f.name), visit)
+
+
+def walk_value(v, visit) -> None:
+    import dataclasses as _dc
+
+    if _dc.is_dataclass(v) and not isinstance(v, type):
+        walk(v, visit)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            walk_value(x, visit)
+
+
+def transform(node, fn):
+    """Bottom-up rewrite: fn(node) -> replacement (or the node itself).
+    Mutates dataclass fields in place; lists/tuples are rebuilt."""
+    import dataclasses as _dc
+
+    def rec(v):
+        if _dc.is_dataclass(v) and not isinstance(v, type):
+            for f in _dc.fields(v):
+                setattr(v, f.name, rec(getattr(v, f.name)))
+            return fn(v)
+        if isinstance(v, list):
+            return [rec(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(rec(x) for x in v)
+        return v
+
+    return rec(node)
+
+
 # ---- expressions ------------------------------------------------------------
 
 class Expr:
@@ -82,6 +125,22 @@ class ParamMarker(Expr):
     """A '?' placeholder in a prepared statement (binds at EXECUTE)."""
 
     idx: int
+
+
+@dataclass
+class SysVarExpr(Expr):
+    """@@name / @@global.name / @@session.name — substituted with the
+    variable's current value before planning."""
+
+    name: str
+    scope: str = "SESSION"
+
+
+@dataclass
+class UserVarExpr(Expr):
+    """@name user variable read (session-scoped, SET @name = ...)."""
+
+    name: str
 
 
 @dataclass
@@ -351,8 +410,10 @@ class ExplainStmt(Stmt):
 
 @dataclass
 class ShowStmt(Stmt):
-    kind: str  # 'TABLES' | 'DATABASES' | 'CREATE_TABLE' | 'VARIABLES'
+    kind: str  # 'TABLES' | 'DATABASES' | 'CREATE_TABLE' | 'VARIABLES' | ...
     target: Optional[TableName] = None
+    pattern: Optional[str] = None  # LIKE pattern (VARIABLES/STATUS/COLUMNS)
+    scope: str = "SESSION"  # SHOW GLOBAL|SESSION VARIABLES
 
 
 @dataclass
@@ -364,3 +425,25 @@ class SetStmt(Stmt):
 @dataclass
 class AnalyzeTableStmt(Stmt):
     tables: list[TableName] = field(default_factory=list)
+
+
+@dataclass
+class CreateUserStmt(Stmt):
+    name: str
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt(Stmt):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt(Stmt):
+    privs: list[str] = field(default_factory=list)  # upper-case names
+    db: str = "*"
+    table: str = "*"
+    user: str = ""
+    revoke: bool = False

@@ -64,7 +64,9 @@ KEYWORDS = frozenset(
     CHAR VARCHAR TEXT BOOLEAN BOOL
     DIV MOD
     FIRST AFTER MODIFY CHANGE RENAME TO TRUNCATE
-    GLOBAL SESSION VARIABLES STATUS
+    GLOBAL SESSION VARIABLES STATUS SCHEMAS WARNINGS ERRORS ENGINES
+    COLLATION COLUMNS FIELDS INDEXES KEYS NAMES
+    GRANT REVOKE USER IDENTIFIED PRIVILEGES GRANTS
     FOR
     ADMIN DDL JOBS
     OVER PARTITION ROWS RANGE

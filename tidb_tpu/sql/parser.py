@@ -197,7 +197,42 @@ class Parser:
             self.expect_kw("DDL")
             self.expect_kw("JOBS")
             return ast.AdminStmt("SHOW_DDL_JOBS")
+        if t.is_kw("GRANT", "REVOKE"):
+            return self.parse_grant(revoke=t.is_kw("REVOKE"))
         raise ParseError("unsupported statement", t)
+
+    def parse_grant(self, revoke: bool) -> ast.GrantStmt:
+        """GRANT/REVOKE priv[, priv] ON [db.]tbl TO/FROM user
+        (reference: privilege checks fed by mysql.user/db/tables_priv)."""
+        self.advance()  # GRANT / REVOKE
+        privs: list[str] = []
+        while True:
+            if self.accept_kw("ALL"):
+                self.accept_kw("PRIVILEGES")
+                privs.append("ALL")
+            else:
+                t = self.advance()
+                privs.append(t.text.upper())
+            if not self.accept_op(","):
+                break
+        self.expect_kw("ON")
+        db = tbl = "*"
+        if self.accept_op("*"):
+            if self.accept_op("."):
+                self.expect_op("*")
+        else:
+            first = self.expect_ident()
+            if self.accept_op("."):
+                db = first
+                tbl = "*" if self.accept_op("*") else self.expect_ident()
+            else:
+                # unqualified table scopes to the CURRENT database (MySQL
+                # semantics) — resolved at execution, marked "" here
+                db = ""
+                tbl = first
+        self.expect_kw("FROM" if revoke else "TO")
+        user = self._parse_account_name()
+        return ast.GrantStmt(privs, db, tbl, user, revoke)
 
     def parse_alter(self) -> ast.AlterTableStmt:
         self.expect_kw("ALTER")
@@ -484,11 +519,31 @@ class Parser:
         return ast.DeleteStmt(table, where)
 
     # ---- DDL ---------------------------------------------------------------
+    def _parse_account_name(self) -> str:
+        """'user'[@'host'] — host accepted and discarded (single-host)."""
+        t = self.cur
+        if t.kind in (TokenKind.STRING, TokenKind.IDENT):
+            self.advance()
+            name = t.text
+        else:
+            name = self.expect_ident()
+        if self.accept_op("@"):
+            self.advance()  # host (ident or string)
+        return name
+
     def parse_create(self) -> ast.Stmt:
         self.expect_kw("CREATE")
         if self.accept_kw("DATABASE", "SCHEMA"):
             ine = self._if_not_exists()
             return ast.CreateDatabaseStmt(self.expect_ident(), ine)
+        if self.accept_kw("USER"):
+            ine = self._if_not_exists()
+            name = self._parse_account_name()
+            password = ""
+            if self.accept_kw("IDENTIFIED"):
+                self.expect_kw("BY")
+                password = self.advance().text
+            return ast.CreateUserStmt(name, password, ine)
         unique = bool(self.accept_kw("UNIQUE"))
         if self.accept_kw("INDEX", "KEY"):
             name = self.expect_ident()
@@ -616,6 +671,9 @@ class Parser:
         if self.accept_kw("DATABASE", "SCHEMA"):
             if_exists = self._if_exists()
             return ast.DropDatabaseStmt(self.expect_ident(), if_exists)
+        if self.accept_kw("USER"):
+            if_exists = self._if_exists()
+            return ast.DropUserStmt(self._parse_account_name(), if_exists)
         if self.accept_kw("INDEX", "KEY"):
             name = self.expect_ident()
             self.expect_kw("ON")
@@ -639,12 +697,47 @@ class Parser:
         analyze = bool(self.accept_kw("ANALYZE"))
         return ast.ExplainStmt(self.parse_statement(), analyze)
 
+    def _show_like(self, stmt: ast.ShowStmt) -> ast.ShowStmt:
+        if self.cur.is_kw("LIKE"):
+            self.advance()
+            stmt.pattern = self.advance().text
+        elif self.cur.is_kw("WHERE"):
+            self.advance()
+            self.parse_expr()  # accepted, unfiltered (compat tolerance)
+        return stmt
+
     def parse_show(self) -> ast.ShowStmt:
         self.expect_kw("SHOW")
+        scope = "SESSION"
+        if self.accept_kw("GLOBAL"):
+            scope = "GLOBAL"
+        elif self.accept_kw("SESSION"):
+            scope = "SESSION"
+        self.accept_kw("FULL")
         if self.accept_kw("TABLES"):
-            return ast.ShowStmt("TABLES")
-        if self.accept_kw("DATABASES"):
-            return ast.ShowStmt("DATABASES")
+            return self._show_like(ast.ShowStmt("TABLES"))
+        if self.accept_kw("DATABASES", "SCHEMAS"):
+            return self._show_like(ast.ShowStmt("DATABASES"))
+        if self.accept_kw("STATUS"):
+            return self._show_like(ast.ShowStmt("STATUS", scope=scope))
+        if self.accept_kw("WARNINGS", "ERRORS"):
+            return ast.ShowStmt("WARNINGS")
+        if self.accept_kw("ENGINES"):
+            return ast.ShowStmt("ENGINES")
+        if self.accept_kw("COLLATION"):
+            return self._show_like(ast.ShowStmt("COLLATION"))
+        if self.accept_kw("COLUMNS", "FIELDS"):
+            self.expect_kw("FROM")
+            return self._show_like(
+                ast.ShowStmt("COLUMNS", self.parse_table_name()))
+        if self.accept_kw("INDEX", "INDEXES", "KEYS"):
+            self.expect_kw("FROM")
+            return ast.ShowStmt("INDEX", self.parse_table_name())
+        if self.accept_kw("GRANTS"):
+            stmt = ast.ShowStmt("GRANTS")
+            if self.accept_kw("FOR"):
+                stmt.pattern = self._parse_account_name()
+            return stmt
         if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "SLOW":
             self.advance()
@@ -660,29 +753,96 @@ class Parser:
             self.expect_kw("TABLE")
             return ast.ShowStmt("CREATE_TABLE", self.parse_table_name())
         if self.accept_kw("VARIABLES"):
-            return ast.ShowStmt("VARIABLES")
+            return self._show_like(ast.ShowStmt("VARIABLES", scope=scope))
         raise ParseError("unsupported SHOW", self.cur)
 
     def parse_set(self) -> ast.SetStmt:
+        """SET assignments + the special client forms: SET NAMES cs,
+        SET CHARACTER SET cs, SET [scope] TRANSACTION ISOLATION LEVEL x
+        (reference: executor/set.go + ast SetStmt variants)."""
         self.expect_kw("SET")
         items = []
         while True:
             scope = "SESSION"
-            if self.accept_kw("GLOBAL"):
-                scope = "GLOBAL"
-            elif self.accept_kw("SESSION"):
-                scope = "SESSION"
-            elif self.accept_op("@"):
-                self.expect_op("@")  # @@var
-                if self.cur.kind == TokenKind.IDENT and self.peek().is_op("."):
-                    scope = self.advance().text.upper()
+            if self.cur.is_kw("NAMES") or (
+                    self.cur.kind == TokenKind.IDENT
+                    and self.cur.text.upper() == "NAMES"):
+                self.advance()
+                cs = self.advance().text  # ident or string literal
+                if self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) \
+                        and self.cur.text.upper() == "COLLATE":
                     self.advance()
-            name = self.expect_ident()
-            if not self.accept_op("=") and not self.accept_op(":="):
-                raise ParseError("expected = in SET", self.cur)
-            items.append((scope, name, self.parse_expr()))
+                    self.advance()  # collation name (accepted, ignored)
+                items.append(("NAMES", "names", ast.Literal(cs, "string")))
+            elif self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) and \
+                    self.cur.text.upper() == "CHARACTER" and \
+                    self.peek().is_kw("SET"):
+                self.advance()
+                self.advance()
+                cs = self.advance().text
+                items.append(("NAMES", "names", ast.Literal(cs, "string")))
+            else:
+                if self.accept_kw("GLOBAL"):
+                    scope = "GLOBAL"
+                elif self.accept_kw("SESSION"):
+                    scope = "SESSION"
+                if self.cur.is_kw("TRANSACTION"):
+                    self.advance()
+                    if not (self.cur.kind == TokenKind.IDENT
+                            and self.cur.text.upper() == "ISOLATION"):
+                        raise ParseError("expected ISOLATION LEVEL",
+                                         self.cur)
+                    self.advance()
+                    if not (self.cur.kind == TokenKind.IDENT
+                            and self.cur.text.upper() == "LEVEL"):
+                        raise ParseError("expected LEVEL", self.cur)
+                    self.advance()
+                    words = [self.advance().text.upper()]
+                    while self.cur.kind in (TokenKind.IDENT,
+                                            TokenKind.KEYWORD) and \
+                            self.cur.text.upper() in ("READ", "COMMITTED",
+                                                      "UNCOMMITTED",
+                                                      "REPEATABLE",
+                                                      "SERIALIZABLE"):
+                        words.append(self.advance().text.upper())
+                    level = "-".join(words)
+                    items.append((scope, "tx_isolation",
+                                  ast.Literal(level, "string")))
+                    if not self.accept_op(","):
+                        return ast.SetStmt(items)
+                    continue
+                if self.accept_op("@"):
+                    if self.accept_op("@"):  # @@[scope.]var
+                        if self.cur.kind in (TokenKind.IDENT,
+                                             TokenKind.KEYWORD) and \
+                                self.cur.text.upper() in ("GLOBAL",
+                                                          "SESSION") and \
+                                self.peek().is_op("."):
+                            scope = self.advance().text.upper()
+                            self.advance()
+                    else:
+                        scope = "USERVAR"
+                name = self.expect_ident()
+                if not self.accept_op("=") and not self.accept_op(":="):
+                    raise ParseError("expected = in SET", self.cur)
+                items.append((scope, name.lower(), self.parse_set_value()))
             if not self.accept_op(","):
                 return ast.SetStmt(items)
+
+    def parse_set_value(self) -> ast.Expr:
+        """SET values admit bare idents/keywords (utf8mb4, ON, DEFAULT) as
+        string-ish tokens in addition to ordinary expressions."""
+        t = self.cur
+        if t.is_kw("DEFAULT"):
+            self.advance()
+            return ast.Literal(None, "default")
+        if t.kind == TokenKind.IDENT and not self.peek().is_op("(", "."):
+            self.advance()
+            return ast.Literal(t.text, "string")
+        if t.kind == TokenKind.KEYWORD and t.text in ("ON", "OFF") :
+            self.advance()
+            return ast.Literal(t.text, "string")
+        return self.parse_expr()
 
     # ---- expressions (Pratt) -----------------------------------------------
     def parse_expr(self) -> ast.Expr:
@@ -796,6 +956,17 @@ class Parser:
 
     def parse_primary(self) -> ast.Expr:
         t = self.cur
+        if t.is_op("@"):
+            self.advance()
+            if self.accept_op("@"):
+                scope = "SESSION"
+                if self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) \
+                        and self.cur.text.upper() in ("GLOBAL", "SESSION") \
+                        and self.peek().is_op("."):
+                    scope = self.advance().text.upper()
+                    self.advance()
+                return ast.SysVarExpr(self.expect_ident().lower(), scope)
+            return ast.UserVarExpr(self.expect_ident().lower())
         if t.is_op("?"):
             self.advance()
             self.param_count += 1
@@ -969,6 +1140,8 @@ _IDENT_KEYWORDS = frozenset(
     DATE TIME TIMESTAMP DATETIME YEAR STATUS VARIABLES TABLES DATABASES
     COUNT SUM AVG MIN MAX COLUMN FIRST AFTER BEGIN COMMIT IF
     ADMIN DDL JOBS OVER PARTITION ROWS RANGE
+    SCHEMAS WARNINGS ERRORS ENGINES COLLATION COLUMNS FIELDS INDEXES KEYS
+    NAMES USER IDENTIFIED PRIVILEGES GRANTS
     """.split()
 )
 

@@ -95,6 +95,14 @@ class Storage:
         self.tso = TimestampOracle(floor=self._tso_lease)
         self.rm = RegionManager(self.kv)
         self.committer = TwoPhaseCommitter(self.rm, self.tso)
+        # GLOBAL sysvar plane (mysql.global_variables analog) — rides the
+        # meta keyspace, so durable stores keep SET GLOBAL across restarts
+        from ..session.privileges import PrivilegeManager
+        from ..session.sysvars import SysVarManager
+
+        self.sysvars = SysVarManager(self)
+        # grant tables (mysql.user analog) — same persistence plane
+        self.privileges = PrivilegeManager(self)
         # DDL job queue + history (the meta-KV DDLJobList analog,
         # reference meta/meta.go:571) — lives on storage so a replacement
         # worker resumes pending jobs with their reorg checkpoints
