@@ -34,6 +34,10 @@ class Chunk:
     def num_cols(self) -> int:
         return len(self.columns)
 
+    @property
+    def nbytes(self) -> int:
+        return sum(c.nbytes for c in self.columns)
+
     def column(self, i: int) -> Column:
         return self.columns[i]
 

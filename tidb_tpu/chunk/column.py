@@ -99,6 +99,15 @@ class Column:
         return len(self.data)
 
     @property
+    def nbytes(self) -> int:
+        """Buffer bytes held by this column (dictionary excluded: it is
+        shared table state, not per-chunk working set)."""
+        n = self.data.nbytes
+        if self.valid is not None:
+            n += self.valid.nbytes
+        return n
+
+    @property
     def validity(self) -> np.ndarray:
         if self.valid is None:
             return np.ones(len(self.data), dtype=bool)

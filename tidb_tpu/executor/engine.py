@@ -40,6 +40,7 @@ from ..plan.physical import (
 from ..store.storage import Transaction
 from ..types.field_type import FieldType, TypeKind
 from ..types.value import Decimal
+from ..util.memory import MemTracker, QueryMemExceeded, SpillDir
 
 _NULL_KEY = np.iinfo(np.int64).min
 
@@ -49,9 +50,38 @@ class ExecContext:
     txn: Transaction
     cop: CopClient
     stats: Optional[object] = None  # obs.RuntimeStatsColl for EXPLAIN ANALYZE
+    mem: Optional[MemTracker] = None  # per-query quota tracker
 
     def __post_init__(self) -> None:
         self._subq_cache: dict[int, Const] = {}
+        if self.mem is None:
+            self.mem = MemTracker()
+        self._spill: Optional[SpillDir] = None
+
+    @property
+    def spill(self) -> SpillDir:
+        if self._spill is None:
+            self._spill = SpillDir()
+        return self._spill
+
+    def close(self) -> None:
+        if self._spill is not None:
+            self._spill.close()
+            self._spill = None
+
+
+def _overflow(ctx: ExecContext, est: int, label: str) -> bool:
+    """True when `est` bytes don't fit the query quota and the operator
+    should switch to its partitioned on-disk strategy; raises when the
+    configured action is CANCEL (reference: util/memory/action.go:28 —
+    spill actions vs PanicOnExceed)."""
+    if not ctx.mem.over_budget(est):
+        return False
+    ctx.mem.check(est, label)  # raises under CANCEL
+    ctx.mem.note_spill()
+    if ctx.stats is not None and hasattr(ctx.stats, "note_spill"):
+        ctx.stats.note_spill(label)
+    return True
 
 
 def _subst_subq(e: PlanExpr, ctx: ExecContext) -> PlanExpr:
@@ -185,6 +215,9 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
     if isinstance(plan, PhysSort):
         child = run_physical(plan.children[0], ctx)
         items = [(_subst_subq(e, ctx), d) for e, d in plan.items]
+        est = child.nbytes + child.num_rows * 8 * max(1, len(items))
+        if items and child.num_rows and _overflow(ctx, est, "Sort"):
+            return _spill_sort(child, items, ctx)
         order = _sort_order(child, items)
         return child.take(order)
     if isinstance(plan, PhysLimit):
@@ -535,7 +568,58 @@ def _run_agg(plan: PhysHashAgg, ctx: ExecContext) -> Chunk:
         [AggDesc(d.func, None if d.arg is None else _subst_subq(d.arg, ctx),
                  d.ftype, d.distinct, d.name) for d in plan.aggs],
         plan.schema, plan.children)
+    # group-id working set: sort order + unique + inverse over all rows
+    if plan.group_by and child.num_rows and \
+            _overflow(ctx, child.nbytes * 2, "HashAgg"):
+        return _spill_agg(plan, child, ctx)
     return _complete_agg(plan, child)
+
+
+def _spill_agg(plan: PhysHashAgg, child: Chunk, ctx: ExecContext) -> Chunk:
+    """Hash-partitioned aggregation: rows split by group-key hash into
+    on-disk partitions, each aggregated independently, results
+    concatenated — group keys are disjoint across partitions, so the
+    union of per-partition groups IS the global answer (the same
+    disjointness the mesh hc-agg exchange relies on; reference:
+    executor/aggregate.go spill + parallel partial workers)."""
+    ev = _evaluator(child)
+    n = child.num_rows
+    enc = []
+    for g in plan.group_by:
+        if g.ftype.is_string and not isinstance(g, Col):
+            sv, svl = ev.eval_str(g)
+            e = np.fromiter(
+                (hash(s) if ok else _NULL_KEY for s, ok in zip(sv, svl)),
+                np.int64, count=n)
+        else:
+            v, vl = ev.eval(g)
+            v = np.asarray(v)
+            if np.issubdtype(v.dtype, np.floating):
+                e = v.astype(np.float64).view(np.int64)
+            else:
+                e = v.astype(np.int64)
+            e = np.where(np.asarray(vl), e, _NULL_KEY)
+        enc.append(e)
+    stack = np.stack(enc, axis=1)
+    need = child.nbytes * 2
+    parts = int(min(64, max(2, -(-need * 2 // max(ctx.mem.available(), 1)))))
+    pid = (_key_hash(stack) % np.uint64(parts)).astype(np.int64)
+    del stack, enc, ev
+    files = []
+    for p in range(parts):
+        idx = np.nonzero(pid == p)[0]
+        if len(idx):
+            files.append(ctx.spill.spill(child.take(idx)))
+    del child, pid
+    outs = []
+    for f in files:
+        part = f.read()
+        ctx.mem.consume(part.nbytes)
+        outs.append(_complete_agg(plan, part))
+        ctx.mem.release(part.nbytes)
+    if not outs:
+        return _complete_agg(plan, Chunk([]))
+    return Chunk.concat(outs)
 
 
 def _group_ids(key_cols: list[tuple[np.ndarray, np.ndarray]], n: int):
@@ -812,28 +896,69 @@ def _distinct_agg(d: AggDesc, av, avl, inv, n_seg, out_t: FieldType) -> Column:
 
 # ==================== sort ====================
 
+def _sort_key(chunk: Chunk, e: PlanExpr, desc: bool,
+              ev: Optional[NumpyEval] = None) -> np.ndarray:
+    """One encoded sort key: larger-encodes-later, desc folded in, NULLs
+    first (MySQL NULL ordering)."""
+    if ev is None:
+        ev = _evaluator(chunk)
+    v, vl = ev.eval(e)
+    v = np.asarray(v)
+    vl = np.asarray(vl)
+    if e.ftype.is_string and isinstance(e, Col):
+        d = chunk.columns[e.idx].dictionary
+        if d is not None and len(d):
+            ranks = d.sort_ranks()
+            v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
+    if np.issubdtype(v.dtype, np.floating):
+        key = np.where(vl, v.astype(np.float64), -np.inf)
+    else:
+        key = np.where(vl, v.astype(np.int64), _NULL_KEY + 1)
+    return -key if desc else key
+
+
 def _sort_order(chunk: Chunk, items: list[tuple[PlanExpr, bool]]) -> np.ndarray:
     ev = _evaluator(chunk)
-    keys = []
-    for e, desc in reversed(items):  # lexsort: last key is primary
-        v, vl = ev.eval(e)
-        v = np.asarray(v)
-        vl = np.asarray(vl)
-        if e.ftype.is_string and isinstance(e, Col):
-            d = chunk.columns[e.idx].dictionary
-            if d is not None and len(d):
-                ranks = d.sort_ranks()
-                v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
-        if np.issubdtype(v.dtype, np.floating):
-            key = np.where(vl, v.astype(np.float64), -np.inf)
-            key = -key if desc else key
-        else:
-            key = np.where(vl, v.astype(np.int64), _NULL_KEY + 1)
-            key = -key if desc else key
-        keys.append(key)
+    keys = [_sort_key(chunk, e, desc, ev)
+            for e, desc in reversed(items)]  # lexsort: last key is primary
     if not keys:
         return np.arange(chunk.num_rows)
     return np.lexsort(keys)
+
+
+def _spill_sort(child: Chunk, items: list[tuple[PlanExpr, bool]],
+                ctx: ExecContext) -> Chunk:
+    """External sample sort: range-partition on the primary key into
+    on-disk buckets, sort each bucket in memory, emit in bucket order.
+
+    Counterpart of the reference's sort spill (executor/sort.go:176 +
+    row_container.go:493 SortAndSpillDiskAction) re-shaped for the
+    vectorized engine: sorted runs + k-way merge become quantile
+    buckets + per-bucket lexsort — same bounded working set, and the
+    output equals the in-memory path bit-for-bit (equal primary keys
+    land in one bucket, lexsort stability does the rest).
+    """
+    n = child.num_rows
+    key0 = _sort_key(child, items[0][0], items[0][1])
+    need = child.nbytes + n * 8 * max(1, len(items))
+    parts = int(min(64, max(2, -(-need * 2 // max(ctx.mem.available(), 1)))))
+    sample = key0[:: max(1, n // 4096)]
+    qs = np.quantile(sample, np.linspace(0, 1, parts + 1)[1:-1])
+    bucket = np.searchsorted(qs, key0, side="right")
+    files = []
+    for b in range(parts):
+        idx = np.nonzero(bucket == b)[0]
+        if len(idx):
+            files.append(ctx.spill.spill(child.take(idx)))
+    del child, key0, bucket
+    pieces = []
+    for f in files:
+        part = f.read()
+        ctx.mem.consume(part.nbytes)
+        order = _sort_order(part, items)
+        pieces.append(part.take(order))
+        ctx.mem.release(part.nbytes)
+    return Chunk.concat(pieces)
 
 
 # ==================== join ====================
@@ -855,6 +980,12 @@ def _run_join(plan: PhysHashJoin, ctx: ExecContext) -> Chunk:
         li = np.repeat(np.arange(left.num_rows), right.num_rows)
         ri = np.tile(np.arange(right.num_rows), left.num_rows)
     else:
+        # key-unify working set: ~4 int64 copies per key column per row
+        # (stack, concat, unique, inverse) on both sides
+        est = (left.num_rows + right.num_rows) * \
+            (len(plan.eq_conditions) * 8 * 4 + 16)
+        if _overflow(ctx, est, "HashJoin"):
+            return _grace_join(plan, left, right, ctx)
         li, ri = _equi_match(plan, left, right)
 
     # residual ON conditions filter matched pairs
@@ -897,8 +1028,13 @@ def _run_join(plan: PhysHashJoin, ctx: ExecContext) -> Chunk:
     return _merge_chunks(left.take(li), right.take(ri))
 
 
-def _equi_match(plan: PhysHashJoin, left: Chunk, right: Chunk):
-    """Vectorized equi-join: unify key ids across sides, sort-merge expand."""
+def _encode_join_keys(plan: PhysHashJoin, left: Chunk, right: Chunk):
+    """Per-side comparable int64 key stacks [n, nkeys] + validity masks.
+
+    Encodings unify the key domains across sides (dictionary remap,
+    decimal rescale, float bit patterns) so equal SQL values encode to
+    equal int64s; both the in-memory unify and the grace partitioner
+    hash these."""
     lkeys = []
     rkeys = []
     lvalid = np.ones(left.num_rows, dtype=bool)
@@ -941,9 +1077,13 @@ def _equi_match(plan: PhysHashJoin, left: Chunk, right: Chunk):
         rkeys.append(rv)
         lvalid &= lc.validity
         rvalid &= rc.validity
+    return (np.stack(lkeys, axis=1), np.stack(rkeys, axis=1),
+            lvalid, rvalid)
 
-    lstack = np.stack(lkeys, axis=1)
-    rstack = np.stack(rkeys, axis=1)
+
+def _equi_match(plan: PhysHashJoin, left: Chunk, right: Chunk):
+    """Vectorized equi-join: unify key ids across sides, sort-merge expand."""
+    lstack, rstack, lvalid, rvalid = _encode_join_keys(plan, left, right)
     all_keys = np.concatenate([lstack, rstack], axis=0)
     _, inv = np.unique(all_keys, axis=0, return_inverse=True)
     inv = inv.reshape(-1)
@@ -961,6 +1101,110 @@ def _equi_match(plan: PhysHashJoin, left: Chunk, right: Chunk):
     offsets = np.arange(total) - np.repeat(np.cumsum(counts) - counts, counts)
     ri = rorder[starts + offsets]
     return li, ri
+
+
+def _key_hash(stack: np.ndarray) -> np.ndarray:
+    """FNV-1a-style mix of an [n, k] int64 key stack to uint64."""
+    h = np.full(stack.shape[0], 14695981039346656037, np.uint64)
+    for j in range(stack.shape[1]):
+        h = (h ^ stack[:, j].astype(np.uint64)) * np.uint64(1099511628211)
+    return h
+
+
+def _grace_join(plan: PhysHashJoin, left: Chunk, right: Chunk,
+                ctx: ExecContext) -> Chunk:
+    """Partitioned (grace) hash join: hash both sides by join key into
+    on-disk partitions, free the inputs, join partition pairs one at a
+    time, then restore the in-memory path's row order from the global
+    row indices carried with each partition.
+
+    Counterpart of the reference's spilling hash join
+    (executor/join.go + util/chunk/row_container.go:63); partition
+    co-location is sound because matching pairs encode to equal int64
+    keys (see _encode_join_keys) and therefore equal hashes.
+    """
+    lstack, rstack, lvalid, rvalid = _encode_join_keys(plan, left, right)
+    need = (lstack.nbytes + rstack.nbytes) * 4
+    parts = int(min(64, max(2, -(-need * 2 // max(ctx.mem.available(), 1)))))
+    lh = (_key_hash(lstack) % np.uint64(parts)).astype(np.int64)
+    rh = (_key_hash(rstack) % np.uint64(parts)).astype(np.int64)
+    del lstack, rstack, lvalid, rvalid
+    part_files = []
+    for p in range(parts):
+        lidx = np.nonzero(lh == p)[0]
+        ridx = np.nonzero(rh == p)[0]
+        if not len(lidx) and not len(ridx):
+            continue  # nothing to join or null-fill from this partition
+        part_files.append((lidx, ctx.spill.spill(left.take(lidx)),
+                           ridx, ctx.spill.spill(right.take(ridx))))
+    n_right_total = right.num_rows
+    del left, right, lh, rh
+
+    matched: list[tuple[np.ndarray, np.ndarray, Chunk]] = []
+    extras: list[tuple[np.ndarray, Chunk]] = []  # LEFT/RIGHT outer fill
+    plains: list[tuple[np.ndarray, Chunk]] = []  # SEMI/ANTI left rows
+    for lidx, lf, ridx, rf in part_files:
+        lpart = lf.read()
+        rpart = rf.read()
+        ctx.mem.consume(lpart.nbytes + rpart.nbytes)
+        li, ri = _equi_match(plan, lpart, rpart)
+        if plan.other_conditions:
+            joined = _merge_chunks(lpart.take(li), rpart.take(ri))
+            ev = _evaluator(joined)
+            mask = np.ones(len(li), dtype=bool)
+            for c in plan.other_conditions:
+                v, vl = ev.eval(_subst_subq(c, ctx))
+                mask &= _truthy(np.asarray(v)) & vl
+            li, ri = li[mask], ri[mask]
+        if plan.kind == "SEMI":
+            ul = np.unique(li)
+            plains.append((lidx[ul], lpart.take(ul)))
+        elif plan.kind in ("ANTI", "ANTI_NULL"):
+            keep = np.ones(lpart.num_rows, dtype=bool)
+            keep[li] = False
+            if plan.kind == "ANTI_NULL" and n_right_total:
+                keep &= lpart.columns[plan.eq_conditions[0][0]].validity
+            kidx = np.nonzero(keep)[0]
+            plains.append((lidx[kidx], lpart.take(kidx)))
+        elif plan.kind == "LEFT":
+            matched.append((lidx[li], ridx[ri],
+                            _merge_chunks(lpart.take(li), rpart.take(ri))))
+            um = np.zeros(lpart.num_rows, dtype=bool)
+            um[li] = True
+            extra = np.nonzero(~um)[0]
+            extras.append((lidx[extra], _merge_chunks(
+                lpart.take(extra),
+                _append_nulls(rpart.take(np.empty(0, np.int64)),
+                              len(extra)))))
+        elif plan.kind == "RIGHT":
+            matched.append((lidx[li], ridx[ri],
+                            _merge_chunks(lpart.take(li), rpart.take(ri))))
+            um = np.zeros(rpart.num_rows, dtype=bool)
+            um[ri] = True
+            extra = np.nonzero(~um)[0]
+            extras.append((ridx[extra], _merge_chunks(
+                _append_nulls(lpart.take(np.empty(0, np.int64)),
+                              len(extra)),
+                rpart.take(extra))))
+        else:  # INNER
+            matched.append((lidx[li], ridx[ri],
+                            _merge_chunks(lpart.take(li), rpart.take(ri))))
+        ctx.mem.release(lpart.nbytes + rpart.nbytes)
+
+    if plan.kind in ("SEMI", "ANTI", "ANTI_NULL"):
+        gli = np.concatenate([g for g, _ in plains])
+        out = Chunk.concat([c for _, c in plains])
+        return out.take(np.argsort(gli, kind="stable"))
+    gli = np.concatenate([g for g, _, _ in matched])
+    gri = np.concatenate([r for _, r, _ in matched])
+    out = Chunk.concat([c for _, _, c in matched])
+    out = out.take(np.lexsort((gri, gli)))
+    if plan.kind in ("LEFT", "RIGHT"):
+        gex = np.concatenate([g for g, _ in extras])
+        ex = Chunk.concat([c for _, c in extras])
+        ex = ex.take(np.argsort(gex, kind="stable"))
+        return Chunk.concat([out, ex])
+    return out
 
 
 def _merge_chunks(a: Chunk, b: Chunk) -> Chunk:

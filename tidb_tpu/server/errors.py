@@ -40,6 +40,7 @@ ER_SPECIFIC_ACCESS_DENIED = 1227
 # TiDB-specific (reference: errno/errcode.go TiDB range)
 ER_WRITE_CONFLICT = 9007
 ER_SCHEMA_CHANGED = 8028
+ER_QUERY_MEM_EXCEEDED = 8175
 
 _RULES: list[tuple[re.Pattern, int, str]] = [
     (re.compile(r"^Duplicate entry"), ER_DUP_ENTRY, "23000"),
@@ -57,11 +58,16 @@ _RULES: list[tuple[re.Pattern, int, str]] = [
     (re.compile(r"^Unknown system variable"), ER_UNKNOWN_SYSTEM_VARIABLE,
      "HY000"),
     (re.compile(r"is a read only variable"), ER_VAR_READONLY, "HY000"),
+    # privilege-escalation denials carry their own code; must match before
+    # the generic login-failure rule (clients treat 1045 as bad creds)
+    (re.compile(r"you need .* privilege"), ER_SPECIFIC_ACCESS_DENIED,
+     "42000"),
     (re.compile(r"^Access denied"), ER_ACCESS_DENIED, "28000"),
     (re.compile(r"command denied"), ER_TABLEACCESS_DENIED, "42000"),
     (re.compile(r"^Information schema is changed"), ER_SCHEMA_CHANGED,
      "HY000"),
     (re.compile(r"write conflict"), ER_WRITE_CONFLICT, "HY000"),
+    (re.compile(r"^Out Of Memory Quota"), ER_QUERY_MEM_EXCEEDED, "HY000"),
     (re.compile(r"[Dd]eadlock"), ER_LOCK_DEADLOCK, "40001"),
     (re.compile(r"[Ll]ock wait timeout"), ER_LOCK_WAIT_TIMEOUT, "HY000"),
 ]

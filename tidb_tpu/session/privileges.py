@@ -137,11 +137,15 @@ class PrivilegeManager:
             self._persist()
 
     def grants_for(self, name: str) -> list[tuple[str, str, str]]:
-        u = self._load().get(name)
-        return sorted(u["grants"]) if u else []
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            return sorted(u["grants"]) if u else []
 
     def exists(self, name: str) -> bool:
-        return name in self._load()
+        users = self._load()
+        with self._lock:
+            return name in users
 
     # ---- checks --------------------------------------------------------
     def check(self, name: Optional[str], priv: str, db: str,
@@ -152,12 +156,18 @@ class PrivilegeManager:
             return True
         if priv == "SELECT" and db.lower() == "information_schema":
             return True
-        u = self._load().get(name)
-        if u is None:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            # snapshot under the lock: grant/revoke mutate the set from
+            # other connection threads (reference caches are swapped
+            # atomically, privileges/cache.go)
+            grants = list(u["grants"]) if u is not None else None
+        if grants is None:
             return False
         db = db.lower()
         tbl = tbl.lower()
-        for gp, gdb, gtbl in u["grants"]:
+        for gp, gdb, gtbl in grants:
             if gp not in (priv, "ALL"):
                 continue
             if gdb not in (db, "*"):
@@ -170,12 +180,16 @@ class PrivilegeManager:
     def verify_native(self, name: str, salt: bytes,
                       response: bytes) -> bool:
         """mysql_native_password check against the stored double-SHA1."""
-        u = self._load().get(name)
-        if u is None:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            stored = u["auth"] if u is not None else None
+        if stored is None:
             return False
-        stored = u["auth"]
         if stored == b"":
-            return True  # empty password accepts any/empty response
+            # empty-password account: MySQL accepts only an EMPTY auth
+            # response (a client that sent a scramble used a password)
+            return response == b""
         if len(response) != 20:
             return False
         mask = hashlib.sha1(salt + stored).digest()

@@ -69,6 +69,8 @@ class Session:
         self.vars: dict[str, Any] = {}
         self.user_vars: dict[str, Any] = {}
         self._stmt_seq = 0
+        self.last_mem_peak = 0  # bytes; per-statement tracker peak
+        self.last_spill_count = 0
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
@@ -295,9 +297,14 @@ class Session:
             sv = SYSVARS.get(name)
             if sv is None:
                 # tolerate unknown tidb_/engine-prefixed knobs (forward
-                # compat); reject arbitrary unknowns like MySQL does
+                # compat); reject arbitrary unknowns like MySQL does.
+                # GLOBAL keeps its semantics: SUPER-gated + stored globally
                 if name.startswith(("tidb_", "innodb_", "sql_")):
-                    self.vars[name] = value
+                    if scope == "GLOBAL":
+                        self._require_super()
+                        self.storage.sysvars.set_global(name, value)
+                    else:
+                        self.vars[name] = value
                     continue
                 raise SQLError(f"Unknown system variable '{name}'")
             if sv.read_only:
@@ -601,13 +608,30 @@ class Session:
         else:
             txn.rollback()
 
+    def _exec_ctx(self, stats=None) -> ExecContext:
+        """ExecContext with the session's memory quota attached
+        (reference: sessionVars.MemQuotaQuery feeding the per-query
+        tracker, executor/adapter.go + util/memory/tracker.go:42)."""
+        from ..util.memory import MemTracker
+
+        quota = int(self._sysvar_value("tidb_mem_quota_query") or 0)
+        action = str(self._sysvar_value("tidb_mem_oom_action") or "SPILL")
+        mem = MemTracker("query", quota, action=action.upper())
+        return ExecContext(self._ensure_txn(), self.cop, stats=stats,
+                           mem=mem)
+
     # ==================== SELECT ====================
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
         stmt = self._maybe_bind_vars(stmt)
         self._refresh_infoschema(stmt)
         plan = self._plan(stmt)
-        ctx = ExecContext(self._ensure_txn(), self.cop)
-        chunk = run_physical(plan, ctx)
+        ctx = self._exec_ctx()
+        try:
+            chunk = run_physical(plan, ctx)
+        finally:
+            ctx.close()
+        self.last_mem_peak = ctx.mem.peak
+        self.last_spill_count = ctx.mem.spill_count
         names = [f.name for f in plan.schema.fields]
         ftypes = [f.ftype for f in plan.schema.fields]
         if not chunk.columns:
@@ -959,8 +983,11 @@ class Session:
         coll = obs.RuntimeStatsColl()
 
         def run():
-            ctx = ExecContext(self._ensure_txn(), self.cop, stats=coll)
-            return run_physical(plan, ctx)
+            ctx = self._exec_ctx(stats=coll)
+            try:
+                return run_physical(plan, ctx)
+            finally:
+                ctx.close()
 
         self._run_in_txn(run)
         rows = []
@@ -1088,20 +1115,16 @@ class Session:
 
 
 def _like_match(pattern: Optional[str], s: str) -> bool:
-    """MySQL LIKE over SHOW output (case-insensitive, % and _)."""
+    """MySQL LIKE over SHOW output (case-insensitive, %, _ and \\-escapes;
+    same conversion the coprocessor's LIKE kernel uses)."""
     if pattern is None:
         return True
     import re
 
-    rx = []
-    for ch in pattern:
-        if ch == "%":
-            rx.append(".*")
-        elif ch == "_":
-            rx.append(".")
-        else:
-            rx.append(re.escape(ch))
-    return re.fullmatch("".join(rx), s, re.IGNORECASE) is not None
+    from ..copr.client import _like_to_regex
+
+    return re.fullmatch(_like_to_regex(pattern), s,
+                        re.IGNORECASE) is not None
 
 
 def _coldef_ftype(cd) -> FieldType:

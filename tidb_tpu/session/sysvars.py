@@ -100,6 +100,7 @@ _VARS = [
     _v("tidb_distsql_scan_concurrency", 15),
     _v("tidb_index_lookup_concurrency", 4),
     _v("tidb_mem_quota_query", 1 << 30),
+    _v("tidb_mem_oom_action", "SPILL"),  # SPILL | CANCEL (action.go:28)
     _v("tidb_enable_plan_cache", 1),
     _v("tidb_txn_mode", "optimistic"),
     _v("tidb_retry_limit", 10),
@@ -139,10 +140,10 @@ class SysVarManager:
 
     def get_global(self, name: str) -> Optional[Any]:
         self._load()
+        if name in self._globals:  # includes tolerated unknown knobs
+            return self._globals[name]
         v = SYSVARS.get(name)
-        if v is None:
-            return None
-        return self._globals.get(name, v.default)
+        return v.default if v is not None else None
 
     def set_global(self, name: str, value: Any) -> None:
         self._load()

@@ -1,0 +1,1 @@
+"""Host-side utility belt (reference: util/ — memory quota, spill, tracing)."""
