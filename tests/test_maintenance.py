@@ -1,0 +1,228 @@
+"""Failpoint registry + background maintenance loops.
+
+Counterpart of the reference's fault-injected txn tests and GC worker
+tests (reference: store/tikv/2pc_fail_test.go via failpoint.Enable;
+gcworker/gc_worker_test.go — safepoint vs active transactions;
+lock_resolver.go TTL expiry)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tidb_tpu.kv.mvcc import (KeyIsLockedError, MVCCStore, Mutation,
+                              OP_PUT)
+from tidb_tpu.kv.region import RegionManager
+from tidb_tpu.kv.twopc import Snapshot, TSO, TwoPhaseCommitter
+from tidb_tpu.session import Session
+from tidb_tpu.store.daemon import MaintenanceWorker, parse_duration
+from tidb_tpu.util import failpoint
+
+from testkit import TestKit
+
+
+class CrashError(Exception):
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def test_failpoint_registry_basics():
+    assert failpoint.inject("nope") is None
+    failpoint.enable("p1", 42)
+    assert failpoint.inject("p1") == 42
+    assert failpoint.hits("p1") == 1
+    failpoint.disable("p1")
+    assert failpoint.inject("p1") is None
+    with failpoint.failpoint("p2", CrashError("boom")):
+        with pytest.raises(CrashError):
+            failpoint.inject("p2")
+    assert failpoint.inject("p2") is None
+    calls = []
+    failpoint.enable("p3", lambda: calls.append(1))
+    failpoint.inject("p3")
+    assert calls == [1]
+
+
+def test_parse_duration():
+    assert parse_duration("10m0s") == 600
+    assert parse_duration("1h30m") == 5400
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("600") == 600
+    assert parse_duration("", 123) == 123
+    assert parse_duration("junk", 99) == 99
+
+
+def _kv_fixture():
+    store = MVCCStore()
+    tso = TSO()
+    rm = RegionManager(store)
+    committer = TwoPhaseCommitter(rm, tso, lock_ttl=1)
+    return store, tso, rm, committer
+
+
+def test_crash_after_primary_commit_rolls_secondaries_forward():
+    """Committed primary + orphaned secondary locks: the resolver must
+    roll secondaries FORWARD (reference: 2pc.go:1027 failpoint test)."""
+    store, tso, rm, committer = _kv_fixture()
+    muts = [Mutation(OP_PUT, b"a", b"v1"), Mutation(OP_PUT, b"b", b"v2")]
+    start = tso.ts()
+    with failpoint.failpoint("twopc/after-primary-commit", CrashError):
+        with pytest.raises(CrashError):
+            committer.commit(muts, start)
+    # secondary 'b' still locked; a read resolves it from the primary
+    locks = store.all_locks()
+    assert [l.key for l in locks] == [b"b"]
+    snap = Snapshot(rm, tso, tso.ts())
+    assert snap.get(b"b") == b"v2"
+    assert snap.get(b"a") == b"v1"
+    assert store.all_locks() == []
+
+
+def test_crash_after_prewrite_rolls_back_on_ttl_expiry():
+    """Uncommitted prewrite: locks expire by TTL and roll BACK
+    (reference: gc_worker resolveLocks phase; lock_resolver TTL)."""
+    store, tso, rm, committer = _kv_fixture()
+    base = tso.ts()
+    committer.commit([Mutation(OP_PUT, b"k", b"old")], base)
+    start = tso.ts()
+    with failpoint.failpoint("twopc/after-prewrite", CrashError):
+        with pytest.raises(CrashError):
+            committer.commit([Mutation(OP_PUT, b"k", b"new")], start)
+    assert len(store.all_locks()) == 1
+    # ttl=1ms: already expired relative to a fresh ts; a reader resolves
+    snap = Snapshot(rm, tso, tso.ts())
+    assert snap.get(b"k") == b"old"
+    assert store.all_locks() == []
+
+
+def test_maintenance_resolves_expired_locks():
+    tk = TestKit()
+    s = tk.session
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 10), (2, 20)")
+    # leave an expired orphan lock on the row range via direct prewrite
+    storage = s.storage
+    from tidb_tpu.kv import codec, tablecodec
+    info = s.catalog.table("test", "t")
+    key = tablecodec.record_key(info.id, 1)
+    start = storage.tso.next_ts()
+    storage.kv.prewrite([Mutation(OP_PUT, key, codec.encode_key([1, 99]))],
+                        key, start, ttl=0)
+    assert len(storage.kv.all_locks()) == 1
+    worker = storage.maintenance
+    n = worker.resolve_expired_locks()
+    assert n == 1 and storage.kv.all_locks() == []
+    # the uncommitted write must NOT be visible
+    assert tk.must_query("select b from t where a = 1") == [(10,)]
+
+
+def test_gc_reclaims_versions_protects_active_snapshots():
+    tk = TestKit()
+    s = tk.session
+    storage = s.storage
+    tk.must_exec("create table g (a int primary key, b int)")
+    tk.must_exec("insert into g values (1, 0)")
+    # hold a snapshot over the first version
+    held = storage.begin()
+    from tidb_tpu.kv import tablecodec
+    info = s.catalog.table("test", "g")
+    key = tablecodec.record_key(info.id, 1)
+    v0 = storage.kv.get(key, held.start_ts)
+    assert v0 is not None
+    for i in range(1, 6):
+        tk.must_exec(f"update g set b = {i} where a = 1")
+    tk.must_exec("set global tidb_gc_life_time = '0s'")
+    worker = storage.maintenance
+    removed = worker.run_gc()
+    # versions newer than the held snapshot are protected; the held
+    # snapshot still reads its version
+    assert storage.kv.get(key, held.start_ts) == v0
+    assert tk.must_query("select b from g where a = 1") == [(5,)]
+    held.rollback()  # releases the snapshot ts
+    removed2 = worker.run_gc()
+    assert removed + removed2 >= 4  # old versions reclaimed after release
+    assert tk.must_query("select b from g where a = 1") == [(5,)]
+
+
+def test_gc_never_drops_newest_version():
+    tk = TestKit()
+    storage = tk.session.storage
+    tk.must_exec("create table n (a int primary key, b int)")
+    tk.must_exec("insert into n values (1, 1), (2, 2)")
+    tk.must_exec("delete from n where a = 2")
+    tk.must_exec("set global tidb_gc_life_time = '0s'")
+    storage.maintenance.tick()
+    assert tk.must_query("select a, b from n order by a") == [(1, 1)]
+    # deleted key's tombstone history is fully reclaimable
+    tk.must_exec("insert into n values (2, 22)")
+    assert tk.must_query("select b from n where a = 2") == [(22,)]
+
+
+def test_auto_analyze_via_maintenance_tick():
+    tk = TestKit()
+    storage = tk.session.storage
+    tk.must_exec("create table aa (a int, b int)")
+    rows = ",".join(f"({i},{i % 7})" for i in range(2000))
+    tk.must_exec(f"insert into aa values {rows}")
+    out = storage.maintenance.tick()
+    assert "aa" in out["auto_analyzed"]
+    st = storage.stats.table_stats(tk.session.catalog.table("test", "aa").id)
+    assert st is not None
+
+
+def test_ddl_crash_between_steps_resumes():
+    """Owner crash mid-ADD-INDEX via the registry; a new worker resumes
+    from the persisted job queue (reference: ddl_worker crash tests)."""
+    from tidb_tpu.ddl import DDL, DDLError
+
+    tk = TestKit()
+    s = tk.session
+    tk.must_exec("create table d (a int primary key, b int)")
+    rows = ",".join(f"({i},{i % 50})" for i in range(500))
+    tk.must_exec(f"insert into d values {rows}")
+
+    crashes = {"n": 0}
+
+    def crash_on_third():
+        crashes["n"] += 1
+        if crashes["n"] == 3:
+            raise CrashError("owner died")
+
+    failpoint.enable("ddl/before-step", crash_on_third)
+    with pytest.raises(CrashError):
+        tk.must_exec("alter table d add index ib (b)")
+    failpoint.disable("ddl/before-step")
+    assert s.storage.ddl_jobs  # job still queued with its checkpoint
+    ddl = DDL(s.storage, s.catalog)
+    ddl.resume_pending()
+    assert not s.storage.ddl_jobs
+    info = s.catalog.table("test", "d")
+    assert any(ix.name == "ib" for ix in info.indices)
+    assert tk.must_query("select count(*) from d where b = 7") == [(10,)]
+
+
+def test_storage_before_fold_failpoint_counts():
+    tk = TestKit()
+    tk.must_exec("create table f (a int primary key)")
+    failpoint.enable("storage/before-fold")
+    tk.must_exec("insert into f values (1)")
+    assert failpoint.hits("storage/before-fold") == 1
+
+
+def test_maintenance_thread_lifecycle():
+    tk = TestKit()
+    storage = tk.session.storage
+    worker = storage.maintenance
+    worker.start(interval_s=0.05)
+    tk.must_exec("create table z (a int primary key, b int)")
+    tk.must_exec("insert into z values (1, 1)")
+    import time
+
+    time.sleep(0.2)
+    worker.stop()
+    assert worker._thread is None

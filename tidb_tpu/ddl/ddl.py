@@ -113,6 +113,11 @@ class DDL:
     def step(self, job: DDLJob) -> bool:
         """One transition (or one reorg batch). Returns True when the job
         left the queue (done or rolled back)."""
+        from ..util import failpoint
+        # simulated owner crash between persisted transitions (reference
+        # failpoint pattern in ddl_worker tests); job state on storage is
+        # the recovery truth
+        failpoint.inject("ddl/before-step")
         job.state = RUNNING
         try:
             handler = getattr(self, "_on_" + job.kind)

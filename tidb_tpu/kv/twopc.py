@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from ..util import failpoint
 from .mvcc import KeyIsLockedError, KVError, Mutation
 from .region import Region, RegionError, RegionManager
 
@@ -80,19 +81,29 @@ class TwoPhaseCommitter:
 
         # phase 1: prewrite, grouped by region, primary's batch first
         # (reference: 2pc.go:730 prewrite primary first for async recovery)
+        failpoint.inject("twopc/before-prewrite")
         self._run_batches(
             mutations, primary, resolver,
             lambda region, batch: self.rm.prewrite(
                 region, batch, primary, start_ts, self.lock_ttl))
+        # crash here = fully-prewritten, uncommitted txn: every lock is
+        # orphaned and must roll BACK once its TTL expires (reference
+        # failpoint site: 2pc.go:704 prewrite fail injection)
+        failpoint.inject("twopc/after-prewrite")
 
         commit_ts = self.tso.ts()
 
         # phase 2: commit the primary synchronously — the txn is durable
         # once this lands (reference: 2pc.go:741)
+        failpoint.inject("twopc/before-commit-primary")
         self._retry_region(
             primary, resolver,
             lambda region: self.rm.commit(region, [primary], start_ts,
                                           commit_ts))
+        # crash here = committed txn with secondary locks left behind:
+        # the resolver must roll them FORWARD from the primary's write
+        # record (reference failpoint site: 2pc.go:1027)
+        failpoint.inject("twopc/after-primary-commit")
         # secondaries may commit lazily; do them inline (the reference
         # fires a goroutine — same semantics, resolver covers crashes).
         # IMPORTANT: the txn is already durable — a secondary failure must

@@ -25,13 +25,19 @@ def main(argv: list[str] | None = None) -> int:
                    help="MySQL protocol port")
     p.add_argument("--default-db", default="test")
     p.add_argument("--max-connections", type=int, default=512)
+    p.add_argument("--path", default=None,
+                   help="durable storage directory (default: in-memory)")
     args = p.parse_args(argv)
 
-    storage = Storage()
+    storage = Storage(args.path)
     srv = Server(storage, host=args.host, port=args.port,
                  default_db=args.default_db,
                  max_connections=args.max_connections)
     srv.start()
+    # background GC / lock-TTL / auto-analyze / checkpoint loop; the
+    # interval re-reads tidb_gc_run_interval every cycle (reference:
+    # gcworker started with the store, gc_worker.go:95)
+    storage.maintenance.start()
     print(f"tidb-tpu-server listening on {args.host}:{srv.port}",
           flush=True)
 
@@ -45,7 +51,7 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     done.wait()
     srv.close()
-    storage.flush()
+    storage.close()  # stops maintenance; checkpoints durable stores
     return 0
 
 

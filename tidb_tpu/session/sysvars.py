@@ -105,6 +105,9 @@ _VARS = [
     _v("tidb_txn_mode", "optimistic"),
     _v("tidb_retry_limit", 10),
     _v("tidb_tile_rows", 1 << 22),
+    _v("tidb_gc_life_time", "10m0s", scope=SCOPE_GLOBAL),
+    _v("tidb_gc_run_interval", "10m0s", scope=SCOPE_GLOBAL),
+    _v("tidb_auto_analyze_ratio", 0.5, scope=SCOPE_GLOBAL),
 ]
 
 SYSVARS: dict[str, SysVar] = {v.name: v for v in _VARS}

@@ -218,20 +218,28 @@ class StatsHandle:
         return ts.columns[offset].range_rows(lo, hi, lo_incl, hi_incl)
 
     # ---- auto analyze -----------------------------------------------------
-    def needs_auto_analyze(self, info: TableInfo, store) -> bool:
+    def needs_auto_analyze(self, info: TableInfo, store,
+                           ratio: Optional[float] = None) -> bool:
         """Delta-driven trigger (reference: handle/update.go:860
         HandleAutoAnalyze, ratio of modify count to row count)."""
+        if ratio is None:
+            ratio = self.AUTO_ANALYZE_RATIO
         modified = store.modify_count
         ts = self.tables.get(info.id)
         if ts is None:
             return modified > 0
         done = self._analyzed_at_modify.get(info.id, 0)
         delta = modified - done
-        return delta > max(ts.row_count, 1) * self.AUTO_ANALYZE_RATIO and \
-            delta >= 64
+        return delta > max(ts.row_count, 1) * ratio and delta >= 64
 
     def auto_analyze(self, storage, catalog) -> list[str]:
-        """Run pending auto-analyzes; returns analyzed table names."""
+        """Run pending auto-analyzes; returns analyzed table names.
+        The trigger ratio honors SET GLOBAL tidb_auto_analyze_ratio."""
+        try:
+            ratio = float(storage.sysvars.get_global(
+                "tidb_auto_analyze_ratio"))
+        except (TypeError, ValueError):
+            ratio = self.AUTO_ANALYZE_RATIO
         out = []
         for schema in list(catalog.schemas.values()):
             for info in list(schema.tables.values()):
@@ -239,7 +247,7 @@ class StatsHandle:
                     store = storage.table_store(info.id)
                 except KeyError:
                     continue
-                if not self.needs_auto_analyze(info, store):
+                if not self.needs_auto_analyze(info, store, ratio):
                     continue
                 self.analyze_one(info, store, storage)
                 out.append(info.name)

@@ -112,6 +112,7 @@ class Storage:
         # active snapshot ts registry -> GC/compaction safepoint
         self._active_snapshots: dict[int, int] = {}
         self._snap_lock = threading.Lock()
+        self._maintenance = None
         if path is not None:
             self._recover()
             self._extend_tso_lease()
@@ -380,7 +381,19 @@ class Storage:
             store.epoch_dirty = False
         self.kv.checkpoint()
 
+    @property
+    def maintenance(self):
+        """The storage's background worker (GC / lock-TTL / auto-analyze /
+        checkpoint); created lazily, started by the server or tests
+        (reference: gcworker started by the tikv store, gc_worker.go:95)."""
+        if self._maintenance is None:
+            from .daemon import MaintenanceWorker
+            self._maintenance = MaintenanceWorker(self, self.catalog)
+        return self._maintenance
+
     def close(self) -> None:
+        if self._maintenance is not None:
+            self._maintenance.stop()
         if self.path is None:
             return
         self.checkpoint()
@@ -475,6 +488,8 @@ class Storage:
             # columnar fold of the committed mutations (the coprocessor's
             # read view) — inside the lock so no snapshot can observe the
             # KV commit without the fold
+            from ..util import failpoint
+            failpoint.inject("storage/before-fold")
             for (table_id, handle), row in mutations.items():
                 store = self.tables.get(table_id)
                 if store is not None:

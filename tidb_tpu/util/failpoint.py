@@ -1,0 +1,84 @@
+"""Fault-injection registry: named points compiled into the runtime.
+
+Counterpart of the reference's failpoint usage (reference:
+pingcap/failpoint macros threaded through 66 files — e.g.
+store/tikv/2pc.go:704,1027,1264, coprocessor.go:835 — enabled per-test
+via failpoint.Enable). Python needs no code rewriting: call sites invoke
+`inject(name)` unconditionally; a disabled point is one dict probe.
+
+An enabled point's value drives behavior at the site:
+  * an Exception instance or class — raised (simulated failure),
+  * a callable — invoked (custom behavior: sleep, crash flag, counter),
+  * anything else — returned to the call site for it to interpret.
+
+Tests use the context manager so points never leak:
+
+    with failpoint("twopc/after-primary-commit", CrashError()):
+        ...
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+_lock = threading.Lock()
+_active: dict[str, Any] = {}
+_hits: dict[str, int] = {}
+
+
+def enable(name: str, value: Any = True) -> None:
+    with _lock:
+        _active[name] = value
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+def disable_all() -> None:
+    with _lock:
+        _active.clear()
+        _hits.clear()
+
+
+def is_enabled(name: str) -> bool:
+    with _lock:
+        return name in _active
+
+
+def hits(name: str) -> int:
+    with _lock:
+        return _hits.get(name, 0)
+
+
+def inject(name: str) -> Optional[Any]:
+    """The call-site hook. Returns None when the point is disabled;
+    otherwise raises/calls/returns per the enabled value."""
+    with _lock:
+        if name not in _active:
+            return None
+        value = _active[name]
+        _hits[name] = _hits.get(name, 0) + 1
+    if isinstance(value, BaseException):
+        raise value
+    if isinstance(value, type) and issubclass(value, BaseException):
+        raise value(f"failpoint {name}")
+    if callable(value):
+        return value()
+    return value
+
+
+@contextmanager
+def failpoint(name: str, value: Any = True) -> Iterator[None]:
+    enable(name, value)
+    try:
+        yield
+    finally:
+        disable(name)
+
+
+__all__ = ["enable", "disable", "disable_all", "is_enabled", "inject",
+           "hits", "failpoint"]
