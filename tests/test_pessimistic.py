@@ -1,0 +1,234 @@
+"""Pessimistic transactions: lock-wait serialization, FOR UPDATE,
+deadlock detection, lock-wait timeout.
+
+Counterpart of the reference's pessimistic txn tests (reference:
+store/tikv/pessimistic.go; session tests around adapter.go:533
+handlePessimisticDML; deadlock detection in TiKV's detector)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+
+def _two_sessions():
+    storage = Storage()
+    s1 = Session(storage)
+    s2 = Session(storage, cop=s1.cop)
+    return s1, s2
+
+
+def _run(fn):
+    """Run fn in a thread; returns (thread, box) where box collects the
+    result or exception."""
+    box = {}
+
+    def wrap():
+        try:
+            box["ok"] = fn()
+        except Exception as e:  # noqa: BLE001
+            box["err"] = e
+
+    t = threading.Thread(target=wrap)
+    t.start()
+    return t, box
+
+
+def test_begin_pessimistic_parses_and_commits():
+    s1, _ = _two_sessions()
+    s1.execute("create table t (a int primary key, b int)")
+    s1.execute("insert into t values (1, 1)")
+    s1.execute("begin pessimistic")
+    assert s1.txn.pessimistic
+    s1.execute("update t set b = 2 where a = 1")
+    s1.execute("commit")
+    assert s1.execute("select b from t").rows == [(2,)]
+    # tidb_txn_mode drives plain BEGIN
+    s1.execute("set tidb_txn_mode = 'pessimistic'")
+    s1.execute("begin")
+    assert s1.txn.pessimistic
+    s1.execute("rollback")
+    s1.execute("begin optimistic")
+    assert not s1.txn.pessimistic
+    s1.execute("rollback")
+
+
+def test_concurrent_updates_both_commit():
+    """The lost-update scenario: pessimistic mode serializes instead of
+    aborting — BOTH sessions commit (round-2 verdict item #9 done
+    criterion)."""
+    s1, s2 = _two_sessions()
+    s1.execute("create table c (a int primary key, v int)")
+    s1.execute("insert into c values (1, 0)")
+
+    s1.execute("begin pessimistic")
+    s1.execute("update c set v = v + 1 where a = 1")  # holds the row lock
+
+    t, box = _run(lambda: (
+        s2.execute("begin pessimistic"),
+        s2.execute("update c set v = v + 1 where a = 1"),
+        s2.execute("commit")))
+    time.sleep(0.15)
+    assert t.is_alive(), "s2 should be blocked on s1's row lock"
+    s1.execute("commit")
+    t.join(timeout=10)
+    assert "err" not in box, box.get("err")
+    # both increments applied: s2 re-read the committed v=1
+    assert s1.execute("select v from c").rows == [(2,)]
+
+
+def test_optimistic_mode_still_conflicts():
+    s1, s2 = _two_sessions()
+    s1.execute("create table o (a int primary key, v int)")
+    s1.execute("insert into o values (1, 0)")
+    s1.execute("begin optimistic")
+    s1.execute("update o set v = v + 1 where a = 1")
+    s2.execute("begin optimistic")
+    s2.execute("update o set v = v + 1 where a = 1")
+    s1.execute("commit")
+    with pytest.raises(Exception, match="conflict|changed"):
+        s2.execute("commit")
+    assert s1.execute("select v from o").rows == [(1,)]
+
+
+def test_select_for_update_blocks_writer():
+    s1, s2 = _two_sessions()
+    s1.execute("create table f (a int primary key, v int)")
+    s1.execute("insert into f values (1, 10), (2, 20)")
+    s1.execute("begin pessimistic")
+    rows = s1.execute("select a, v from f where a = 1 for update").rows
+    assert rows == [(1, 10)]
+
+    t, box = _run(lambda: s2.execute("update f set v = 99 where a = 1"))
+    time.sleep(0.15)
+    assert t.is_alive(), "autocommit writer must wait on the FOR UPDATE lock"
+    s1.execute("commit")
+    t.join(timeout=10)
+    assert "err" not in box, box.get("err")
+    assert s1.execute("select v from f where a = 1").rows == [(99,)]
+    # unlocked row was never blocked
+    assert s1.execute("select v from f where a = 2").rows == [(20,)]
+
+
+def test_for_update_lock_released_on_rollback():
+    s1, s2 = _two_sessions()
+    s1.execute("create table r (a int primary key, v int)")
+    s1.execute("insert into r values (1, 1)")
+    s1.execute("begin pessimistic")
+    s1.execute("select * from r where a = 1 for update")
+    s1.execute("rollback")
+    # no residual lock: the write goes straight through
+    s2.execute("update r set v = 5 where a = 1")
+    assert s2.execute("select v from r").rows == [(5,)]
+
+
+def test_lock_wait_timeout():
+    s1, s2 = _two_sessions()
+    s1.execute("create table w (a int primary key, v int)")
+    s1.execute("insert into w values (1, 1)")
+    s1.execute("begin pessimistic")
+    s1.execute("update w set v = 2 where a = 1")
+    s2.execute("set innodb_lock_wait_timeout = 1")
+    s2.execute("begin pessimistic")
+    t0 = time.monotonic()
+    with pytest.raises(Exception, match="Lock wait timeout"):
+        s2.execute("update w set v = 3 where a = 1")
+    assert 0.5 < time.monotonic() - t0 < 8
+    s2.execute("rollback")
+    s1.execute("commit")
+    assert s1.execute("select v from w").rows == [(2,)]
+
+
+def test_deadlock_detected():
+    s1, s2 = _two_sessions()
+    s1.execute("create table d (a int primary key, v int)")
+    s1.execute("insert into d values (1, 1), (2, 2)")
+    s1.execute("begin pessimistic")
+    s2.execute("begin pessimistic")
+    s1.execute("update d set v = 10 where a = 1")  # s1 holds row 1
+    s2.execute("update d set v = 20 where a = 2")  # s2 holds row 2
+
+    # s1 waits for row 2; then s2 closing the cycle must get the error
+    t, box = _run(lambda: s1.execute("update d set v = 11 where a = 2"))
+    time.sleep(0.15)
+    assert t.is_alive()
+    with pytest.raises(Exception, match="Deadlock"):
+        s2.execute("update d set v = 21 where a = 1")
+    s2.execute("rollback")  # releases row 2; s1 proceeds
+    t.join(timeout=10)
+    assert "err" not in box, box.get("err")
+    s1.execute("commit")
+    assert s1.execute("select a, v from d order by a").rows == \
+        [(1, 10), (2, 11)]
+
+
+def test_pessimistic_insert_duplicate_after_wait():
+    s1, s2 = _two_sessions()
+    s1.execute("create table i (a int primary key, v int)")
+    s1.execute("begin pessimistic")
+    s1.execute("insert into i values (10, 1)")
+
+    def racing_insert():
+        s2.execute("begin pessimistic")
+        s2.execute("insert into i values (10, 2)")
+
+    t, box = _run(racing_insert)
+    time.sleep(0.15)
+    assert t.is_alive(), "second insert should wait on the key lock"
+    s1.execute("commit")
+    t.join(timeout=10)
+    assert "err" in box and "Duplicate entry" in str(box["err"])
+    s2.execute("rollback")
+    assert s1.execute("select v from i where a = 10").rows == [(1,)]
+
+
+def test_heartbeat_extends_primary_ttl():
+    """The keepalive grows the primary lock's TTL so an idle pessimistic
+    txn survives past the base TTL (reference: 2pc.go ttlManager ->
+    TiKV TxnHeartBeat)."""
+    s1, _ = _two_sessions()
+    s1.execute("create table hb (a int primary key, v int)")
+    s1.execute("insert into hb values (1, 1)")
+    s1.execute("begin pessimistic")
+    s1.execute("update hb set v = 2 where a = 1")
+    txn = s1.txn
+    assert txn._heartbeat_stop is not None  # keepalive running
+    primary = txn.pessimistic_primary
+    base_ttl = next(l.ttl for l in s1.storage.kv.all_locks()
+                    if l.key == primary)
+    # simulate a later heartbeat: ttl grows, never shrinks
+    assert s1.storage.kv.txn_heart_beat(primary, txn.start_ts,
+                                        base_ttl + 60000)
+    grown = next(l.ttl for l in s1.storage.kv.all_locks()
+                 if l.key == primary)
+    assert grown == base_ttl + 60000
+    assert s1.storage.kv.txn_heart_beat(primary, txn.start_ts, 1)
+    assert next(l.ttl for l in s1.storage.kv.all_locks()
+                if l.key == primary) == grown
+    s1.execute("commit")
+    # wrong start_ts / gone lock: heartbeat reports failure
+    assert not s1.storage.kv.txn_heart_beat(primary, txn.start_ts, 99)
+
+
+def test_pessimistic_delete_serializes():
+    s1, s2 = _two_sessions()
+    s1.execute("create table x (a int primary key, v int)")
+    s1.execute("insert into x values (1, 1), (2, 2), (3, 3)")
+    s1.execute("begin pessimistic")
+    s1.execute("update x set v = 100 where a = 2")
+    t, box = _run(lambda: s2.execute("delete from x where v >= 100"))
+    time.sleep(0.15)
+    # s2's scan at latest ts sees no v>=100 rows yet OR waits on the
+    # lock; after s1 commits it must delete exactly the updated row
+    s1.execute("commit")
+    t.join(timeout=10)
+    assert "err" not in box, box.get("err")
+    remaining = s1.execute("select a from x order by a").rows
+    # delete ran before or after s1's commit became visible; both are
+    # serializable outcomes
+    assert remaining in ([(1,), (3,)], [(1,), (2,), (3,)])

@@ -432,6 +432,65 @@ class MVCCStore:
                 return kind
         return None
 
+    # ---- pessimistic locks -------------------------------------------------
+    def pessimistic_lock(self, keys: list[bytes], primary: bytes,
+                         start_ts: int, for_update_ts: int,
+                         ttl: int = 20000) -> None:
+        """Acquire lock-only (OP_LOCK) locks for a pessimistic txn
+        (reference: store/tikv/pessimistic.go actionPessimisticLock;
+        TiKV acquire_pessimistic_lock). Readers pass over OP_LOCK locks
+        (see _check_lock); writers block on them. All-or-nothing: checks
+        every key before writing any lock.
+
+        Raises KeyIsLockedError when another txn holds any key and
+        WriteConflictError when a commit newer than for_update_ts exists
+        (the caller retries with a fresh for_update_ts)."""
+        with self._mu:
+            for key in keys:
+                lv = self.kv.get(CF_LOCK, key)
+                if lv is not None:
+                    lock = _lock_dec(key, lv)
+                    if lock.start_ts != start_ts:
+                        raise KeyIsLockedError(lock)
+                    continue  # ours already (idempotent re-lock)
+                latest = self._latest_commit(key)
+                if latest is not None and latest[0] > for_update_ts:
+                    raise WriteConflictError(key, start_ts, latest[0])
+            for key in keys:
+                if self.kv.get(CF_LOCK, key) is None:
+                    self.kv.put(CF_LOCK, key, _lock_enc(
+                        LockInfo(key, primary, start_ts, OP_LOCK, ttl)))
+
+    def txn_heart_beat(self, primary: bytes, start_ts: int,
+                       ttl: int) -> bool:
+        """Extend the primary lock's TTL (reference: TiKV TxnHeartBeat —
+        the ttlManager keepalive for long pessimistic txns). TTL only
+        grows; returns False when the lock is gone (resolved/expired)."""
+        with self._mu:
+            lv = self.kv.get(CF_LOCK, primary)
+            if lv is None:
+                return False
+            lock = _lock_dec(primary, lv)
+            if lock.start_ts != start_ts:
+                return False
+            if ttl > lock.ttl:
+                lock.ttl = ttl
+                self.kv.put(CF_LOCK, primary, _lock_enc(lock))
+            return True
+
+    def pessimistic_rollback(self, keys: list[bytes],
+                             start_ts: int) -> None:
+        """Release this txn's lock-only locks without leaving a rollback
+        marker (reference: TiKV PessimisticRollback — the txn may still
+        commit later; only the guards are dropped)."""
+        with self._mu:
+            for key in keys:
+                lv = self.kv.get(CF_LOCK, key)
+                if lv is not None:
+                    lock = _lock_dec(key, lv)
+                    if lock.start_ts == start_ts and lock.op == OP_LOCK:
+                        self.kv.delete(CF_LOCK, key)
+
     # ---- lock resolution ---------------------------------------------------
     def check_txn_status(self, primary: bytes, lock_ts: int,
                          current_ts: int) -> tuple[int, bool]:

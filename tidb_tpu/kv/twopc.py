@@ -77,7 +77,12 @@ class TwoPhaseCommitter:
             return start_ts
         resolver = LockResolver(self.rm, self.tso)
         mutations = sorted(mutations, key=lambda m: m.key)
-        primary = mutations[0].key
+        # the primary must leave a write record: a lock-only (OP_LOCK)
+        # primary would give crash recovery nothing to roll forward from
+        # (reference: 2pc.go primary selection skips lock-only keys)
+        from .mvcc import OP_LOCK
+        primary = next((m.key for m in mutations if m.op != OP_LOCK),
+                       mutations[0].key)
 
         # phase 1: prewrite, grouped by region, primary's batch first
         # (reference: 2pc.go:730 prewrite primary first for async recovery)

@@ -235,7 +235,10 @@ class Session:
             return ResultSet([], [])
         if isinstance(stmt, ast.BeginStmt):
             self._commit_implicit()
-            self.txn = self.storage.begin()
+            mode = stmt.mode or str(
+                self._sysvar_value("tidb_txn_mode") or "")
+            self.txn = self.storage.begin(
+                pessimistic=mode.upper() == "PESSIMISTIC")
             self.in_explicit_txn = True
             return ResultSet([], [])
         if isinstance(stmt, ast.CommitStmt):
@@ -624,12 +627,21 @@ class Session:
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
         stmt = self._maybe_bind_vars(stmt)
         self._refresh_infoschema(stmt)
-        plan = self._plan(stmt)
-        ctx = self._exec_ctx()
         try:
-            chunk = run_physical(plan, ctx)
+            if getattr(stmt, "for_update", False):
+                self._lock_for_update(stmt)
+            plan = self._plan(stmt)
+            ctx = self._exec_ctx()
+            try:
+                chunk = run_physical(plan, ctx)
+            finally:
+                ctx.close()
         finally:
-            ctx.close()
+            # always clear the per-statement read-ts override — a plan
+            # error after FOR UPDATE locking must not leak for_update_ts
+            # into later statements' snapshots
+            if self.txn is not None:
+                self.txn.stmt_read_ts = None
         self.last_mem_peak = ctx.mem.peak
         self.last_spill_count = ctx.mem.spill_count
         names = [f.name for f in plan.schema.fields]
@@ -637,6 +649,20 @@ class Session:
         if not chunk.columns:
             return ResultSet(names, [], column_types=ftypes)
         return ResultSet(names, chunk.to_pylist(), column_types=ftypes)
+
+    def _lock_for_update(self, stmt: ast.SelectStmt) -> None:
+        """SELECT ... FOR UPDATE row locks (reference: point-get/scan
+        executors lock keys under pessimistic txns). Only pessimistic
+        transactions take locks; optimistic ones keep commit-time
+        conflict detection (the reference behaves the same)."""
+        txn = self._ensure_txn()
+        if not txn.pessimistic or stmt.from_ is None:
+            return
+        if not isinstance(stmt.from_, ast.TableName):
+            raise SQLError(
+                "FOR UPDATE supports single-table queries only")
+        info, _ = self._table_for(stmt.from_)
+        self._pessimistic_scan(info, stmt.from_, stmt.where, txn)
 
     def _plan(self, stmt: ast.SelectStmt):
         try:
@@ -662,33 +688,86 @@ class Session:
                     raise SQLError("column count doesn't match value count")
                 rows.append([self._eval_value(e) for e in value_row])
 
-        checker = _UniqueChecker(info, store, txn)
-        count = 0
-        for rv in rows:
-            if len(rv) != len(col_order):
-                raise SQLError("column count doesn't match value count")
-            full = self._complete_row(info, col_order, rv, store)
-            handle = self._row_handle(info, full, store)
-            enc = store.encode_row(full)
-            conflicts = checker.conflicts(handle, enc)
-            if conflicts:
-                if not stmt.is_replace:
-                    raise SQLError(checker.dup_message(handle, enc, conflicts))
-                for h in conflicts:
-                    txn.delete_row(info.id, h)
-                    checker.note_delete(h)
-                count += len(conflicts)  # MySQL: replaced rows count double
-            txn.set_row(info.id, handle, enc)
-            checker.note_insert(handle, enc)
-            count += 1
-        return ResultSet([], [], affected=count)
+        # pessimistic txns lock + duplicate-check at the latest committed
+        # view (a concurrent INSERT of the same key surfaces as a
+        # duplicate here instead of a conflict at commit)
+        from ..kv import tablecodec
+
+        if txn.pessimistic:
+            txn.stmt_read_ts = txn.refresh_for_update_ts()
+        timeout = float(
+            self._sysvar_value("innodb_lock_wait_timeout") or 50)
+        try:
+            checker = _UniqueChecker(info, store, txn)
+            count = 0
+            for rv in rows:
+                if len(rv) != len(col_order):
+                    raise SQLError("column count doesn't match value count")
+                full = self._complete_row(info, col_order, rv, store)
+                handle = self._row_handle(info, full, store)
+                enc = store.encode_row(full)
+                if txn.pessimistic:
+                    # lock the new key AND any REPLACE victims, re-checking
+                    # duplicates whenever a newer commit invalidates the
+                    # view (reference: pessimistic lock-then-recheck loop)
+                    from ..kv.mvcc import WriteConflictError as KVConflict
+                    key = tablecodec.record_key(info.id, handle)
+                    for _ in range(16):
+                        try:
+                            self.storage.pessimistic_lock_keys(
+                                txn, [key], timeout)
+                            conflicts = checker.conflicts(handle, enc)
+                            if conflicts and stmt.is_replace:
+                                self.storage.pessimistic_lock_keys(
+                                    txn,
+                                    [tablecodec.record_key(info.id, h)
+                                     for h in conflicts], timeout)
+                            break
+                        except KVConflict:
+                            # a commit landed past our for_update_ts:
+                            # re-check duplicates at a fresher view
+                            txn.stmt_read_ts = txn.refresh_for_update_ts()
+                            checker = _UniqueChecker(info, store, txn)
+                        except (Storage.DeadlockError,
+                                Storage.LockWaitTimeout) as e:
+                            raise SQLError(str(e)) from None
+                    else:
+                        raise SQLError(
+                            "pessimistic lock retries exhausted")
+                else:
+                    conflicts = checker.conflicts(handle, enc)
+                if conflicts:
+                    if not stmt.is_replace:
+                        raise SQLError(
+                            checker.dup_message(handle, enc, conflicts))
+                    for h in conflicts:
+                        txn.delete_row(info.id, h)
+                        checker.note_delete(h)
+                    count += len(conflicts)  # MySQL: replaced rows count 2x
+                txn.set_row(info.id, handle, enc)
+                checker.note_insert(handle, enc)
+                count += 1
+            return ResultSet([], [], affected=count)
+        finally:
+            txn.stmt_read_ts = None
 
     def _exec_update(self, stmt: ast.UpdateStmt) -> ResultSet:
         info, store = self._table_for(stmt.table)
         txn = self._ensure_txn()
-        snap = txn.snapshot(info.id)
-        mask, ev = self._where_mask(info, stmt.table, stmt.where, snap)
-        handles = snap.handles()[mask]
+        try:
+            return self._exec_update_inner(stmt, info, store, txn)
+        finally:
+            txn.stmt_read_ts = None
+
+    def _exec_update_inner(self, stmt: ast.UpdateStmt, info, store,
+                           txn) -> ResultSet:
+        if txn.pessimistic:
+            snap, mask, ev, handles = self._pessimistic_scan(
+                info, stmt.table, stmt.where, txn)
+        else:
+            snap = txn.snapshot(info.id)
+            mask, ev = self._where_mask(info, stmt.table, stmt.where, snap)
+            handles = snap.handles()[mask]
         if len(handles) == 0:
             return ResultSet([], [], affected=0)
         # resolve assignments against the scan schema
@@ -774,12 +853,51 @@ class Session:
     def _exec_delete(self, stmt: ast.DeleteStmt) -> ResultSet:
         info, store = self._table_for(stmt.table)
         txn = self._ensure_txn()
-        snap = txn.snapshot(info.id)
-        mask, _ = self._where_mask(info, stmt.table, stmt.where, snap)
-        handles = snap.handles()[mask]
-        for h in handles:
-            txn.delete_row(info.id, int(h))
-        return ResultSet([], [], affected=len(handles))
+        try:
+            if txn.pessimistic:
+                snap, mask, _, handles = self._pessimistic_scan(
+                    info, stmt.table, stmt.where, txn)
+            else:
+                snap = txn.snapshot(info.id)
+                mask, _ = self._where_mask(info, stmt.table, stmt.where,
+                                           snap)
+                handles = snap.handles()[mask]
+            for h in handles:
+                txn.delete_row(info.id, int(h))
+            return ResultSet([], [], affected=len(handles))
+        finally:
+            txn.stmt_read_ts = None
+
+    def _pessimistic_scan(self, info: TableInfo, table: ast.TableName,
+                          where: Optional[ast.Expr], txn):
+        """Lock the matching rows at a fresh for_update_ts, retrying the
+        scan whenever a newer commit invalidates it (reference:
+        executor/adapter.go:533 handlePessimisticDML + :623 lock-error
+        retry). Leaves txn.stmt_read_ts at the locked for_update_ts so
+        every read this statement makes sees the locked versions; the
+        caller clears it when the statement ends."""
+        from ..kv import tablecodec
+        from ..kv.mvcc import WriteConflictError as KVConflict
+
+        timeout = float(
+            self._sysvar_value("innodb_lock_wait_timeout") or 50)
+        for _ in range(64):
+            ts = txn.refresh_for_update_ts()
+            txn.stmt_read_ts = ts
+            snap = txn.snapshot(info.id)
+            mask, ev = self._where_mask(info, table, where, snap)
+            handles = snap.handles()[mask]
+            keys = [tablecodec.record_key(info.id, int(h))
+                    for h in handles]
+            try:
+                self.storage.pessimistic_lock_keys(txn, keys, timeout)
+                return snap, mask, ev, handles
+            except KVConflict:
+                continue  # newer commit: rescan at a fresh for_update_ts
+            except (Storage.DeadlockError,
+                    Storage.LockWaitTimeout) as e:
+                raise SQLError(str(e)) from None
+        raise SQLError("pessimistic lock retries exhausted")
 
     def _where_mask(self, info: TableInfo, table: ast.TableName,
                     where: Optional[ast.Expr], snap):

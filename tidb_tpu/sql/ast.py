@@ -249,6 +249,7 @@ class SelectStmt(Stmt):
     limit: Optional[int] = None
     offset: int = 0
     distinct: bool = False
+    for_update: bool = False  # SELECT ... FOR UPDATE row locks
 
 
 @dataclass
@@ -389,7 +390,7 @@ class UseStmt(Stmt):
 
 @dataclass
 class BeginStmt(Stmt):
-    pass
+    mode: str = ""  # '' (tidb_txn_mode default) | PESSIMISTIC | OPTIMISTIC
 
 
 @dataclass

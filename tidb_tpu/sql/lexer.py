@@ -54,7 +54,7 @@ KEYWORDS = frozenset(
     INSERT INTO VALUES UPDATE SET DELETE REPLACE
     CREATE TABLE DATABASE SCHEMA DROP ALTER ADD COLUMN INDEX KEY PRIMARY
     UNIQUE DEFAULT AUTO_INCREMENT IF EXISTS USE
-    BEGIN START TRANSACTION COMMIT ROLLBACK
+    BEGIN START TRANSACTION COMMIT ROLLBACK PESSIMISTIC OPTIMISTIC
     EXPLAIN ANALYZE SHOW TABLES DATABASES DESC DESCRIBE
     ASC CASE WHEN THEN ELSE END CAST AS CONVERT
     INTERVAL DATE TIME TIMESTAMP DATETIME YEAR

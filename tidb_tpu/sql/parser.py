@@ -154,7 +154,11 @@ class Parser:
             return ast.UseStmt(self.expect_ident())
         if t.is_kw("BEGIN"):
             self.advance()
-            return ast.BeginStmt()
+            mode = ""
+            m = self.accept_kw("PESSIMISTIC", "OPTIMISTIC")
+            if m is not None:
+                mode = m.text
+            return ast.BeginStmt(mode)
         if t.is_kw("START"):
             self.advance()
             self.expect_kw("TRANSACTION")
@@ -351,6 +355,9 @@ class Parser:
                 stmt.limit = first
                 if self.accept_kw("OFFSET"):
                     stmt.offset = self.parse_uint("OFFSET")
+        if self.accept_kw("FOR"):
+            self.expect_kw("UPDATE")
+            stmt.for_update = True
         return stmt
 
     def parse_uint(self, what: str) -> int:
@@ -1141,7 +1148,7 @@ _IDENT_KEYWORDS = frozenset(
     COUNT SUM AVG MIN MAX COLUMN FIRST AFTER BEGIN COMMIT IF
     ADMIN DDL JOBS OVER PARTITION ROWS RANGE
     SCHEMAS WARNINGS ERRORS ENGINES COLLATION COLUMNS FIELDS INDEXES KEYS
-    NAMES USER IDENTIFIED PRIVILEGES GRANTS
+    NAMES USER IDENTIFIED PRIVILEGES GRANTS PESSIMISTIC OPTIMISTIC
     """.split()
 )
 

@@ -97,7 +97,7 @@ class MaintenanceWorker:
             return 0
         failpoint.inject("daemon/before-gc")
         removed = self.storage.kv.gc(sp)
-        for store in self.storage.tables.values():
+        for store in list(self.storage.tables.values()):  # DDL may race
             store.maybe_compact(sp)
         self.last_safepoint = sp
         self.gc_removed_total += removed
@@ -115,13 +115,7 @@ class MaintenanceWorker:
         The WAL folds unconditionally: meta-plane writes (sysvars, stats,
         DDL jobs) dirty no epoch but still grow it, and crash recovery
         replays whatever is left unfolded."""
-        if self.storage.path is None:
-            return
-        for store in self.storage.tables.values():
-            if getattr(store, "epoch_dirty", False):
-                self.storage._persist_epoch(store)
-                store.epoch_dirty = False
-        self.storage.kv.checkpoint()
+        self.storage.checkpoint(dirty_only=True)
 
     def tick(self) -> dict:
         locks = self.resolve_expired_locks()

@@ -113,6 +113,10 @@ class Storage:
         self._active_snapshots: dict[int, int] = {}
         self._snap_lock = threading.Lock()
         self._maintenance = None
+        # waits-for edges for pessimistic deadlock detection
+        # (reference: TiKV's deadlock detector service; util/deadlock)
+        self._waits_for: dict[int, int] = {}
+        self._waits_lock = threading.Lock()
         if path is not None:
             self._recover()
             self._extend_tso_lease()
@@ -371,12 +375,16 @@ class Storage:
             except _KVError:
                 pass
 
-    def checkpoint(self) -> None:
-        """Fold the KV WAL into a snapshot file and persist every table's
-        epoch (clean-shutdown / periodic maintenance entry)."""
+    def checkpoint(self, dirty_only: bool = False) -> None:
+        """Fold the KV WAL into a snapshot file and persist table epochs
+        (clean-shutdown / periodic maintenance entry). dirty_only skips
+        epochs whose snapshot is already current (the background loop's
+        mode); the WAL always folds."""
         if self.path is None:
             return
-        for store in self.tables.values():
+        for store in list(self.tables.values()):  # DDL may race the daemon
+            if dirty_only and not getattr(store, "epoch_dirty", False):
+                continue
             self._persist_epoch(store)
             store.epoch_dirty = False
         self.kv.checkpoint()
@@ -445,8 +453,79 @@ class Storage:
         return self.tso.current()
 
     # ---- transactions ------------------------------------------------------
-    def begin(self) -> "Transaction":
-        return Transaction(self, self.acquire_snapshot_ts())
+    def begin(self, pessimistic: bool = False) -> "Transaction":
+        return Transaction(self, self.acquire_snapshot_ts(),
+                           pessimistic=pessimistic)
+
+    class DeadlockError(Exception):
+        pass
+
+    class LockWaitTimeout(Exception):
+        pass
+
+    def pessimistic_lock_keys(self, txn: "Transaction", keys: list[bytes],
+                              timeout_s: float = 50.0) -> None:
+        """Acquire pessimistic locks with wait + deadlock detection
+        (reference: executor/adapter.go:533 handlePessimisticDML ->
+        pessimistic.go lock-wait; deadlock detection is TiKV's detector
+        service, here a local waits-for graph).
+
+        WriteConflictError (a commit newer than txn.for_update_ts)
+        propagates to the caller, which retries its whole statement at a
+        fresh for_update_ts — the same retry the reference drives via
+        ErrWriteConflict in pessimistic mode (adapter.go:623)."""
+        import time as _time
+
+        if not keys:
+            return
+        keys = sorted(keys)
+        if txn.pessimistic_primary is None:
+            txn.pessimistic_primary = keys[0]
+        deadline = _time.monotonic() + timeout_s
+        backoff = 0.001
+        while True:
+            try:
+                self.kv.pessimistic_lock(keys, txn.pessimistic_primary,
+                                         txn.start_ts, txn.for_update_ts)
+                with self._waits_lock:
+                    self._waits_for.pop(txn.start_ts, None)
+                txn.locked_keys.update(keys)
+                txn.start_heartbeat()
+                return
+            except KVError as e:
+                from ..kv.mvcc import KeyIsLockedError
+                if not isinstance(e, KeyIsLockedError):
+                    with self._waits_lock:
+                        self._waits_for.pop(txn.start_ts, None)
+                    raise
+                holder = e.lock.start_ts
+                with self._waits_lock:
+                    # cycle check before we block on `holder`
+                    self._waits_for[txn.start_ts] = holder
+                    seen = {txn.start_ts}
+                    cur = holder
+                    while cur in self._waits_for:
+                        cur = self._waits_for[cur]
+                        if cur in seen:
+                            self._waits_for.pop(txn.start_ts, None)
+                            raise Storage.DeadlockError(
+                                "Deadlock found when trying to get lock; "
+                                "try restarting transaction")
+                        seen.add(cur)
+                # the holder may be dead: TTL-expired locks resolve now
+                from ..kv.twopc import LockResolver
+                try:
+                    LockResolver(self.rm, self.tso).resolve(e.lock)
+                except KVError:
+                    pass
+                if _time.monotonic() >= deadline:
+                    with self._waits_lock:
+                        self._waits_for.pop(txn.start_ts, None)
+                    raise Storage.LockWaitTimeout(
+                        "Lock wait timeout exceeded; try restarting "
+                        "transaction") from None
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 0.05)
 
     def commit(self, txn: "Transaction") -> int:
         """THE commit path: schema fence -> percolator 2PC through the
@@ -454,6 +533,11 @@ class Storage:
         records), one fold (the epochs the coprocessor reads)."""
         mutations = txn.memdb.mutations()
         if not mutations:
+            if txn.locked_keys:
+                # lock-only txn (SELECT FOR UPDATE with no writes): the
+                # guards served their purpose; drop them
+                self.kv.pessimistic_rollback(sorted(txn.locked_keys),
+                                             txn.start_ts)
             return txn.start_ts
         self._maybe_extend_lease()
         with self._commit_lock:
@@ -468,13 +552,21 @@ class Storage:
             # encode AFTER the fence: _kv_row decodes dictionary codes, and
             # a fenced txn's codes may not exist in the post-DDL dictionaries
             kv_muts = []
+            written = set()
             for (table_id, handle), row in mutations.items():
                 key = tablecodec.record_key(table_id, handle)
+                written.add(key)
                 if row is TOMBSTONE:
                     kv_muts.append(Mutation(OP_DEL, key))
                 else:
                     kv_muts.append(Mutation(OP_PUT, key, codec.encode_key(
                         self._kv_row(self.tables.get(table_id), row))))
+            # pessimistic guards on unwritten keys commit as lock-only
+            # records so 2PC clears them atomically (reference: OP_LOCK
+            # mutations through prewrite; kv/memdb lock-only entries)
+            from ..kv.mvcc import OP_LOCK
+            for key in sorted(txn.locked_keys - written):
+                kv_muts.append(Mutation(OP_LOCK, key))
             try:
                 commit_ts = self.committer.commit(kv_muts, txn.start_ts)
             except KVWriteConflict as e:
@@ -534,15 +626,60 @@ class Storage:
 
 
 class Transaction:
-    """An optimistic snapshot-isolation transaction."""
+    """A snapshot-isolation transaction; optimistic by default.
 
-    def __init__(self, storage: Storage, start_ts: int) -> None:
+    Pessimistic mode (reference: session/txn pessimistic flag +
+    store/tikv/pessimistic.go): DML acquires OP_LOCK guards at execution
+    time via Storage.pessimistic_lock_keys, reads for DML happen at
+    for_update_ts (latest), and commit converts the guards through the
+    normal 2PC prewrite."""
+
+    def __init__(self, storage: Storage, start_ts: int,
+                 pessimistic: bool = False) -> None:
         self.storage = storage
         self.start_ts = start_ts
         self.memdb = MemDB()
         self._finished = False
         # table_id -> schema_token observed at first buffered write
         self.schema_tokens: dict[int, int] = {}
+        self.pessimistic = pessimistic
+        self.for_update_ts = start_ts
+        self.pessimistic_primary: Optional[bytes] = None
+        self.locked_keys: set[bytes] = set()
+        # per-statement read-ts override (FOR UPDATE / pessimistic DML
+        # read latest; plain SELECT keeps the start_ts snapshot)
+        self.stmt_read_ts: Optional[int] = None
+        self._heartbeat_stop: Optional[threading.Event] = None
+
+    def start_heartbeat(self) -> None:
+        """TTL keepalive for the pessimistic primary lock (reference:
+        2pc.go ttlManager goroutine -> TiKV TxnHeartBeat): without it an
+        idle txn's locks expire after the initial TTL and contenders
+        roll the txn back, failing its eventual COMMIT."""
+        if self._heartbeat_stop is not None or \
+                self.pessimistic_primary is None:
+            return
+        stop = threading.Event()
+        self._heartbeat_stop = stop
+        primary = self.pessimistic_primary
+        start_physical = self.start_ts >> 18
+
+        def beat() -> None:
+            import time as _time
+            while not stop.wait(5.0):
+                elapsed_ms = int(_time.time() * 1000) - start_physical
+                if not self.storage.kv.txn_heart_beat(
+                        primary, self.start_ts, elapsed_ms + 20000):
+                    return  # lock gone: resolved or finished
+        threading.Thread(target=beat, name="titpu-txn-ttl",
+                         daemon=True).start()
+
+    def refresh_for_update_ts(self) -> int:
+        """New for_update_ts for a (re)tried pessimistic statement
+        (reference: session tells the txn to refresh forUpdateTS on
+        each pessimistic DML, executor/adapter.go:533)."""
+        self.for_update_ts = self.storage.tso.next_ts()
+        return self.for_update_ts
 
     # ---- writes ------------------------------------------------------------
     def set_row(self, table_id: int, handle: int, row: tuple) -> None:
@@ -561,10 +698,13 @@ class Transaction:
 
     # ---- reads -------------------------------------------------------------
     def snapshot(self, table_id: int) -> TableSnapshot:
-        """Snapshot at start_ts unioned with our own uncommitted writes."""
+        """Snapshot at start_ts (or the statement's read-ts override)
+        unioned with our own uncommitted writes."""
         store = self.storage.table_store(table_id)
         overlay = {h: v for h, v in self.memdb.iter_table(table_id)}
-        return store.snapshot(self.start_ts, overlay or None)
+        ts = self.stmt_read_ts if self.stmt_read_ts is not None \
+            else self.start_ts
+        return store.snapshot(ts, overlay or None)
 
     # ---- lifecycle ---------------------------------------------------------
     def commit(self) -> int:
@@ -576,8 +716,14 @@ class Transaction:
 
     def rollback(self) -> None:
         if not self._finished:
+            if self.locked_keys:
+                self.storage.kv.pessimistic_rollback(
+                    sorted(self.locked_keys), self.start_ts)
             self._finish()
 
     def _finish(self) -> None:
         self._finished = True
+        if self._heartbeat_stop is not None:
+            self._heartbeat_stop.set()
+            self._heartbeat_stop = None
         self.storage.release_snapshot_ts(self.start_ts)
