@@ -187,6 +187,25 @@ def test_pessimistic_insert_duplicate_after_wait():
     assert s1.execute("select v from i where a = 10").rows == [(1,)]
 
 
+def test_optimistic_writer_waits_out_pessimistic_holder():
+    """An autocommit (optimistic) writer must wait on a pessimistic
+    lock held for ~1s, not die with 'retries exhausted' (the 2PC lock
+    wait is time-based, reference: backoff.go txnLockFastBackoff)."""
+    s1, s2 = _two_sessions()
+    s1.execute("create table ow (a int primary key, v int)")
+    s1.execute("insert into ow values (1, 0)")
+    s1.execute("begin pessimistic")
+    s1.execute("update ow set v = 1 where a = 1")
+
+    t, box = _run(lambda: s2.execute("update ow set v = 2 where a = 1"))
+    time.sleep(1.0)
+    assert t.is_alive(), "optimistic writer should still be waiting"
+    s1.execute("commit")
+    t.join(timeout=15)
+    assert "err" not in box, box.get("err")
+    assert s1.execute("select v from ow").rows == [(2,)]
+
+
 def test_heartbeat_extends_primary_ttl():
     """The keepalive grows the primary lock's TTL so an idle pessimistic
     txn survives past the base TTL (reference: 2pc.go ttlManager ->
@@ -213,6 +232,39 @@ def test_heartbeat_extends_primary_ttl():
     s1.execute("commit")
     # wrong start_ts / gone lock: heartbeat reports failure
     assert not s1.storage.kv.txn_heart_beat(primary, txn.start_ts, 99)
+
+
+def test_pessimistic_insert_unique_value_race():
+    """Two pessimistic inserts of the same UNIQUE value under DIFFERENT
+    handles must serialize on the unique-index lock key; the loser sees
+    a duplicate, never a constraint violation (reference: unique key
+    constraint enforced through the index KV, tables/index.go)."""
+    s1, s2 = _two_sessions()
+    s1.execute("create table u (a int primary key, b int, unique key (b))")
+    s1.execute("begin pessimistic")
+    s1.execute("insert into u values (1, 7)")
+
+    def racing():
+        s2.execute("begin pessimistic")
+        s2.execute("insert into u values (2, 7)")
+
+    t, box = _run(racing)
+    time.sleep(0.15)
+    assert t.is_alive(), "same unique value must wait on the index lock"
+    s1.execute("commit")
+    t.join(timeout=10)
+    assert "err" in box and "Duplicate entry" in str(box["err"]), \
+        box.get("err")
+    s2.execute("rollback")
+    assert s1.execute("select a, b from u").rows == [(1, 7)]
+    # different unique values never block each other
+    s1.execute("begin pessimistic")
+    s1.execute("insert into u values (3, 8)")
+    s2.execute("begin pessimistic")
+    s2.execute("insert into u values (4, 9)")
+    s1.execute("commit")
+    s2.execute("commit")
+    assert len(s1.execute("select * from u").rows) == 3
 
 
 def test_pessimistic_delete_serializes():

@@ -416,9 +416,22 @@ def _window_values(item, out_t, child, ev, n, ctx):
         nxt = np.minimum.accumulate(nxt[::-1])[::-1]
         return np.minimum(nxt - 1, n - 1)
 
+    # per-row partition end + size (frame clipping, ntile, cume_dist)
+    bnds = np.nonzero(starts)[0] if n else np.zeros(0, np.int64)
+    pend = (np.r_[bnds[1:], n] - 1)[np.cumsum(starts) - 1] if n else iota
+    psize = pend - pstart + 1 if n else iota
+
     name = item.func
     valid_out = None
-    if name == "ROW_NUMBER":
+    frame = getattr(item, "frame", None)
+    if frame is not None and n and name in (
+            "SUM", "COUNT", "AVG", "MIN", "MAX",
+            "FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
+        fs, fe = _frame_bounds(frame, item, iota, pstart, pend,
+                               peer_start, last_of_peer, okeys, order, n)
+        vals, valid_out = _frame_agg(name, item, out_t, ev, order,
+                                     fs, fe, n)
+    elif name == "ROW_NUMBER":
         vals = (iota - pstart + 1).astype(np.int64)
     elif name == "RANK":
         first_peer = np.maximum.accumulate(
@@ -463,13 +476,49 @@ def _window_values(item, out_t, child, ev, n, ctx):
                 vals = np.where(ok, vals, dv)
                 valid_s = valid_s | ~ok
         vals, valid_out = vals, valid_s
-    elif name in ("FIRST_VALUE", "LAST_VALUE"):
+    elif name in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
         av, avl = ev.eval(item.args[0])
         av = np.asarray(av)[order]
         avl = np.asarray(avl)[order]
-        idx = pstart if name == "FIRST_VALUE" else last_of_peer()
-        vals = av[idx]
-        valid_out = avl[idx]
+        if name == "NTH_VALUE":
+            nth = int(_const_of(item.args[1]))
+            if nth < 1:
+                raise ValueError("NTH_VALUE position must be >= 1")
+            idx = pstart + nth - 1
+            # default frame end: peers with ORDER BY, else partition end
+            end = last_of_peer() if item.order else pend
+            ok = idx <= end
+            idx = np.minimum(idx, np.maximum(end, pstart))
+            vals = np.where(ok, av[idx], 0)
+            valid_out = np.where(ok, avl[idx], False)
+        else:
+            idx = pstart if name == "FIRST_VALUE" else last_of_peer()
+            vals = av[idx]
+            valid_out = avl[idx]
+    elif name == "NTILE":
+        k = int(_const_of(item.args[0]))
+        if k < 1:
+            raise ValueError("NTILE argument must be >= 1")
+        r = iota - pstart
+        small = psize // k
+        big = psize % k
+        cut = big * (small + 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            in_big = r < cut
+            vals = np.where(
+                in_big,
+                r // np.maximum(small + 1, 1),
+                big + np.where(small > 0, (r - cut) // np.maximum(small, 1),
+                               0)) + 1
+        vals = vals.astype(np.int64)
+    elif name == "PERCENT_RANK":
+        first_peer = np.maximum.accumulate(
+            np.where(peer_start, iota, 0)) if n else iota
+        rank = first_peer - pstart
+        denom = np.maximum(psize - 1, 1)
+        vals = np.where(psize > 1, rank / denom, 0.0)
+    elif name == "CUME_DIST":
+        vals = (last_of_peer() - pstart + 1) / np.maximum(psize, 1)
     else:  # SUM / COUNT / AVG / MIN / MAX
         func = name.lower()
         if item.args:
@@ -532,6 +581,149 @@ def _window_values(item, out_t, child, ev, n, ctx):
     vo = np.zeros(n, bool)
     vo[order] = valid_out
     return out, vo
+
+
+def _frame_bounds(frame, item, iota, pstart, pend, peer_start,
+                  last_of_peer, okeys, order, n):
+    """Inclusive frame [fs, fe] per row in sorted order (reference:
+    executor/window.go frame builders rowFrameWindowProcessor /
+    rangeFrameWindowProcessor). ROWS bounds are index arithmetic; RANGE
+    bounds are key-offset searches within each partition's sorted run.
+    Empty frames surface as fs > fe."""
+    if frame.unit == "ROWS":
+        def rows_bound(btype, val, is_start):
+            if btype == "unbounded":
+                return pstart
+            if btype == "unbounded_following":
+                return pend
+            if btype == "current":
+                return iota
+            off = val if btype == "following" else -val
+            return iota + off
+        fs = rows_bound(frame.start_type, frame.start_value, True)
+        fe = rows_bound(frame.end_type, frame.end_value, False)
+        return np.maximum(fs, pstart), np.minimum(fe, pend)
+
+    # RANGE: offsets move along the primary ORDER BY key; direction is
+    # already folded into the encoded key (desc keys are negated), so
+    # PRECEDING is always key - off in encoded space
+    key = okeys[-1] if okeys else None  # primary key, pre-sort order
+    key_s = key[order] if key is not None else None
+    scale = 1
+    if item.order and getattr(item.order[0][0].ftype, "is_decimal", False):
+        scale = 10 ** item.order[0][0].ftype.scale
+
+    def range_bound(btype, val, is_start):
+        if btype == "unbounded":
+            return pstart
+        if btype == "unbounded_following":
+            return pend
+        if btype == "current":
+            if is_start:  # first peer
+                return np.maximum.accumulate(np.where(peer_start, iota, 0))
+            return last_of_peer()
+        off = val * scale * (1 if btype == "following" else -1)
+        out = np.empty(n, np.int64)
+        bnds = np.nonzero(np.r_[True, pstart[1:] != pstart[:-1]])[0]
+        for b, e in zip(bnds, np.r_[bnds[1:], n]):
+            seg = key_s[b:e]
+            target = key_s[b:e] + off
+            if is_start:
+                out[b:e] = b + np.searchsorted(seg, target, side="left")
+            else:
+                out[b:e] = b + np.searchsorted(seg, target,
+                                               side="right") - 1
+        return out
+
+    fs = range_bound(frame.start_type, frame.start_value, True)
+    fe = range_bound(frame.end_type, frame.end_value, False)
+    return np.maximum(fs, pstart), np.minimum(fe, pend)
+
+
+def _sparse_minmax(vals, fs, fe, fn, empty):
+    """Vectorized range min/max over inclusive [fs, fe] via a sparse
+    table (O(n log n) build, O(1) per query)."""
+    n = len(vals)
+    table = [vals]
+    k = 1
+    while (1 << k) <= n:
+        prev = table[-1]
+        half = 1 << (k - 1)
+        m = n - (1 << k) + 1
+        table.append(fn(prev[:m], prev[half:half + m]))
+        k += 1
+    length = np.maximum(fe - fs + 1, 1)
+    kq = np.floor(np.log2(length)).astype(np.int64)
+    out = np.full(n, empty, dtype=vals.dtype)
+    for kk in range(len(table)):
+        mask = kq == kk
+        if not mask.any():
+            continue
+        s = fs[mask]
+        e = fe[mask]
+        out[mask] = fn(table[kk][s], table[kk][e - (1 << kk) + 1])
+    return out
+
+
+def _frame_agg(name, item, out_t, ev, order, fs, fe, n):
+    """Apply an aggregate/value function over per-row frames [fs, fe]
+    (sorted order); returns (vals, valid) in sorted order."""
+    nonempty = fs <= fe
+    fs_c = np.minimum(fs, n - 1)
+    fe_c = np.clip(fe, 0, n - 1)
+    if item.args:
+        av, avl = ev.eval(item.args[0])
+        av = np.asarray(av)[order]
+        avl = np.asarray(avl)[order]
+    else:  # COUNT(*)
+        av = np.ones(n, np.int64)
+        avl = np.ones(n, bool)
+
+    if name == "FIRST_VALUE":
+        return (np.where(nonempty, av[fs_c], 0),
+                np.where(nonempty, avl[fs_c], False))
+    if name == "LAST_VALUE":
+        return (np.where(nonempty, av[fe_c], 0),
+                np.where(nonempty, avl[fe_c], False))
+    if name == "NTH_VALUE":
+        nth = int(_const_of(item.args[1]))
+        if nth < 1:
+            raise ValueError("NTH_VALUE position must be >= 1")
+        idx = fs + nth - 1
+        ok = nonempty & (idx <= fe)
+        idx = np.clip(idx, 0, n - 1)
+        return np.where(ok, av[idx], 0), np.where(ok, avl[idx], False)
+
+    cnt_ps = np.r_[0, np.cumsum(avl.astype(np.int64))]
+    cnts = np.where(nonempty, cnt_ps[fe_c + 1] - cnt_ps[fs_c], 0)
+    if name == "COUNT":
+        return cnts.astype(np.int64), None
+    if name in ("SUM", "AVG"):
+        if np.issubdtype(av.dtype, np.floating):
+            masked = np.where(avl, av, 0.0)
+        else:
+            masked = np.where(avl, av.astype(np.int64), 0)
+        ps = np.r_[masked.dtype.type(0), np.cumsum(masked)]
+        sums = np.where(nonempty, ps[fe_c + 1] - ps[fs_c], 0)
+        if name == "SUM":
+            valid = cnts > 0
+            return sums, valid
+        col = _avg_column(AggDesc("avg", item.args[0], out_t, False, ""),
+                          out_t, sums, cnts)
+        return col.data, (col.validity if col.valid is not None
+                          else cnts > 0)
+    # MIN / MAX
+    red = np.minimum if name == "MIN" else np.maximum
+    if np.issubdtype(av.dtype, np.floating):
+        sent = np.inf if name == "MIN" else -np.inf
+        masked = np.where(avl, av, sent)
+    else:
+        sent = np.iinfo(np.int64).max if name == "MIN" else \
+            np.iinfo(np.int64).min
+        masked = np.where(avl, av.astype(np.int64), sent)
+    vals = _sparse_minmax(masked, fs_c, fe_c, red, sent)
+    valid = cnts > 0
+    return np.where(valid, vals, 0), valid
 
 
 def _seg_cum(vals, starts, pstart, running):

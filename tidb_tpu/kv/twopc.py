@@ -70,11 +70,25 @@ class TwoPhaseCommitter:
     tso: TSO
     lock_ttl: int = 3000
     max_retries: int = 12
+    # how long a commit waits on someone else's (live) lock before giving
+    # up — pessimistic txns hold locks for arbitrary user-paced durations,
+    # so this is time-based, unlike the count-based region retries
+    # (reference: backoff.go txnLockFastBackoff with a total budget)
+    lock_wait_timeout_s: float = 50.0
 
     def commit(self, mutations: list[Mutation], start_ts: int) -> int:
         """Run 2PC; returns commit_ts (reference: 2pc.go execute :1050)."""
         if not mutations:
             return start_ts
+        state = self.prewrite_phase(mutations, start_ts)
+        return self.commit_phase(state, start_ts)
+
+    def prewrite_phase(self, mutations: list[Mutation], start_ts: int):
+        """Phase 1 only. This is where commit blocks on other txns' locks
+        (possibly for the whole lock-wait timeout), so callers must NOT
+        hold serializing locks across it — the storage runs it outside
+        its commit lock (the reference has no such global lock; its fold
+        equivalent is TiFlash's async raft apply)."""
         resolver = LockResolver(self.rm, self.tso)
         mutations = sorted(mutations, key=lambda m: m.key)
         # the primary must leave a write record: a lock-only (OP_LOCK)
@@ -84,7 +98,7 @@ class TwoPhaseCommitter:
         primary = next((m.key for m in mutations if m.op != OP_LOCK),
                        mutations[0].key)
 
-        # phase 1: prewrite, grouped by region, primary's batch first
+        # prewrite grouped by region, primary's batch first
         # (reference: 2pc.go:730 prewrite primary first for async recovery)
         failpoint.inject("twopc/before-prewrite")
         self._run_batches(
@@ -95,10 +109,15 @@ class TwoPhaseCommitter:
         # orphaned and must roll BACK once its TTL expires (reference
         # failpoint site: 2pc.go:704 prewrite fail injection)
         failpoint.inject("twopc/after-prewrite")
+        return mutations, primary, resolver
 
+    def commit_phase(self, state, start_ts: int) -> int:
+        """Phase 2: never waits on foreign locks (we hold every key),
+        so it is safe inside the storage commit lock."""
+        mutations, primary, resolver = state
         commit_ts = self.tso.ts()
 
-        # phase 2: commit the primary synchronously — the txn is durable
+        # commit the primary synchronously — the txn is durable
         # once this lands (reference: 2pc.go:741)
         failpoint.inject("twopc/before-commit-primary")
         self._retry_region(
@@ -152,18 +171,26 @@ class TwoPhaseCommitter:
 
     def _retry(self, fn, keys, resolver) -> None:
         backoff = 0.001
-        for attempt in range(self.max_retries):
+        region_errs = 0
+        deadline = time.monotonic() + self.lock_wait_timeout_s
+        while True:
             try:
                 fn()
                 return
             except RegionError:
-                continue  # refreshed routing on next call
+                region_errs += 1  # refreshed routing on next call
+                if region_errs >= self.max_retries:
+                    raise CommitError(
+                        f"region retries exhausted for keys {keys[:2]}...")
             except KeyIsLockedError as e:
                 if resolver.resolve(e.lock):
                     continue
+                if time.monotonic() >= deadline:
+                    raise CommitError(
+                        "Lock wait timeout exceeded; try restarting "
+                        "transaction") from None
                 time.sleep(backoff)
-                backoff = min(backoff * 2, 0.1)
-        raise CommitError(f"retries exhausted for keys {keys[:2]}...")
+                backoff = min(backoff * 2, 0.05)
 
 
 class Snapshot:

@@ -69,15 +69,32 @@ class PlanBuilder:
     def __init__(self, catalog: Catalog, current_db: str = "test") -> None:
         self.catalog = catalog
         self.current_db = current_db
+        self._hints: list[tuple[str, list[str]]] = []
 
     # ==================== SELECT ====================
     def build_select(self, stmt) -> LogicalPlan:
         if isinstance(stmt, ast.SetOpStmt):
             return self._build_set_op(stmt)
+        # hint scope is per-SELECT: nested build_select calls (derived
+        # tables, subqueries) must neither clobber the outer statement's
+        # hints nor leak theirs outward
+        prev_hints = self._hints
+        self._hints = list(getattr(stmt, "hints", []) or [])
+        try:
+            return self._build_select_inner(stmt)
+        finally:
+            self._hints = prev_hints
+
+    def _build_select_inner(self, stmt) -> LogicalPlan:
         if stmt.from_ is None:
             plan = self._build_dual(stmt)
         else:
             plan = self.build_table_refs(stmt.from_)
+        # LEADING join-order hint travels on the plan for the reorder rule
+        # (reference: hints.go HintLeading -> rule_join_reorder.go)
+        for name, args in self._hints:
+            if name == "LEADING" and args:
+                plan._leading_hint = args  # type: ignore[attr-defined]
 
         if stmt.where is not None:
             plain, with_subq = [], []
@@ -191,7 +208,17 @@ class PlanBuilder:
             ResultField(c.name.lower(), c.ftype, alias, source_offset=c.offset)
             for c in info.columns
         ]
-        return LogicalScan(info, alias, PlanSchema(fields))
+        scan = LogicalScan(info, alias, PlanSchema(fields))
+        # USE_INDEX / IGNORE_INDEX hints pin this scan's access path
+        # (reference: hints.go HintUseIndex -> access-path filtering,
+        # planbuilder.go:933)
+        for name, args in self._hints:
+            if len(args) >= 1 and args[0] in (alias, tn.name.lower()):
+                if name in ("USE_INDEX", "FORCE_INDEX"):
+                    scan.hint_use_index = args[1:]  # type: ignore[attr-defined]
+                elif name == "IGNORE_INDEX":
+                    scan.hint_ignore_index = args[1:]  # type: ignore[attr-defined]
+        return scan
 
     def _build_join(self, j: ast.Join) -> LogicalPlan:
         left = self.build_table_refs(j.left)
@@ -472,7 +499,8 @@ class PlanBuilder:
         return out
 
     _WINDOW_ONLY = {"ROW_NUMBER", "RANK", "DENSE_RANK", "LEAD", "LAG",
-                    "FIRST_VALUE", "LAST_VALUE"}
+                    "FIRST_VALUE", "LAST_VALUE", "NTH_VALUE", "NTILE",
+                    "PERCENT_RANK", "CUME_DIST"}
 
     def _build_windows(self, stmt: ast.SelectStmt,
                        child: LogicalPlan) -> LogicalPlan:
@@ -509,9 +537,10 @@ class PlanBuilder:
                     ftype = FieldType(args[0].ftype.kind,
                                       flen=args[0].ftype.flen,
                                       scale=args[0].ftype.scale)
-                elif name in ("FIRST_VALUE", "LAST_VALUE"):
-                    if len(args) != 1:
-                        raise PlanError(f"{name} takes one argument")
+                elif name in ("FIRST_VALUE", "LAST_VALUE", "NTH_VALUE"):
+                    want = 2 if name == "NTH_VALUE" else 1
+                    if len(args) != want:
+                        raise PlanError(f"{name} takes {want} argument(s)")
                     if args[0].ftype.is_string and \
                             not isinstance(args[0], Col):
                         raise PlanError(
@@ -519,6 +548,14 @@ class PlanBuilder:
                     ftype = FieldType(args[0].ftype.kind,
                                       flen=args[0].ftype.flen,
                                       scale=args[0].ftype.scale)
+                elif name == "NTILE":
+                    if len(args) != 1:
+                        raise PlanError("NTILE takes one argument")
+                    ftype = FieldType(TypeKind.BIGINT)
+                elif name in ("PERCENT_RANK", "CUME_DIST"):
+                    if args:
+                        raise PlanError(f"{name}() takes no arguments")
+                    ftype = FieldType(TypeKind.DOUBLE, nullable=False)
                 elif name.upper() in _AGG_NAMES:
                     if call.distinct:
                         # MySQL: DISTINCT is not allowed in window aggs
@@ -541,8 +578,25 @@ class PlanBuilder:
                         for e in spec.partition_by]
                 order = [(self.resolve(it.expr, schema), it.desc)
                          for it in spec.order_by]
+                frame = spec.frame
+                if frame is not None:
+                    # MySQL semantics: ranking funcs ignore the frame
+                    if name in ("ROW_NUMBER", "RANK", "DENSE_RANK",
+                                "NTILE", "PERCENT_RANK", "CUME_DIST",
+                                "LEAD", "LAG"):
+                        frame = None
+                    elif frame.unit == "RANGE" and (
+                            frame.start_value is not None
+                            or frame.end_value is not None):
+                        # value-offset RANGE needs exactly one numeric
+                        # ORDER BY key (reference: MySQL 3593 checks)
+                        if len(order) != 1 or order[0][0].ftype.is_string:
+                            raise PlanError(
+                                "RANGE frame with offset requires a "
+                                "single numeric ORDER BY expression")
                 keys[k] = len(items)
-                items.append(WindowItem(name, args, part, order, ftype))
+                items.append(WindowItem(name, args, part, order, ftype,
+                                        frame))
         if not items:
             return child
         fields = list(schema.fields) + [

@@ -95,15 +95,17 @@ class LogicalUnion(LogicalPlan):
 @dataclass
 class WindowItem:
     """One window computation (reference: planner/core LogicalWindow
-    WindowFuncDesc). Default frame only: with order, running (peers
-    share values — RANGE UNBOUNDED PRECEDING..CURRENT ROW); without,
-    the whole partition."""
+    WindowFuncDesc). frame=None means the default frame: with order,
+    running (peers share values — RANGE UNBOUNDED PRECEDING..CURRENT
+    ROW); without, the whole partition. An explicit frame is the AST
+    WindowFrame (ROWS/RANGE bounds)."""
 
     func: str  # upper-case window/agg function name
     args: list[PlanExpr]
     partition: list[PlanExpr]
     order: list[tuple[PlanExpr, bool]]
     ftype: object
+    frame: object = None  # ast.WindowFrame | None
 
 
 @dataclass

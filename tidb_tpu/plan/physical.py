@@ -338,6 +338,10 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
 
     if isinstance(plan, LogicalProjection):
         keep = sorted(required)
+        if not keep and plan.exprs:
+            # a zero-column chunk cannot carry a row count: keep one
+            # expr so '(select 1) d' cross joins still contribute rows
+            keep = [0]
         exprs = [plan.exprs[i] for i in keep]
         need: set[int] = set()
         for e in exprs:
@@ -441,6 +445,8 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
 
 def optimize(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     plan = push_predicates(plan)
+    from .reorder import reorder_joins
+    plan = reorder_joins(plan, stats)
     plan = prune(plan)
     phys = _to_physical(plan, stats)
     from .fragment import apply_fragments
@@ -518,10 +524,13 @@ POINT_SEL_LIMIT = 0.1     # non-unique equality points (stats available)
 INTERVAL_SEL_LIMIT = 0.05  # interval ranges (require stats to justify)
 
 
-def _access_path(scan_offsets: list[int], table, conditions, stats=None):
+def _access_path(scan_offsets: list[int], table, conditions, stats=None,
+                 scan=None):
     """Choose an index access path from the conjuncts. Equality points are
     chosen heuristically (point lookups justify themselves); interval
     ranges are chosen only when statistics estimate low selectivity.
+    USE_INDEX/IGNORE_INDEX hints on the scan constrain the candidate set
+    and bypass the selectivity gates (reference: hints.go).
     Returns ('handles', [int], est) | ('unique', ScanRanges, est) |
     ('ranges', ScanRanges, est) | None (full scan). Reference: access-path
     selection planner/core/planbuilder.go:933 + point-get bypass
@@ -535,8 +544,18 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
         ScanRanges,
     )
 
+    use_hint = [n.lower() for n in
+                getattr(scan, "hint_use_index", [])] if scan else []
+    ignore_hint = {n.lower() for n in
+                   getattr(scan, "hint_ignore_index", [])} if scan else set()
+
+    def allowed(index) -> bool:
+        if index.name.lower() in ignore_hint:
+            return False
+        return not use_hint or index.name.lower() in use_hint
+
     col_map = {i: off for i, off in enumerate(scan_offsets)}
-    if table.pk_handle_offset is not None:
+    if table.pk_handle_offset is not None and not use_hint:
         for c in conditions:
             hit = _eq_values(c, col_map)
             if hit is not None and hit[0] == table.pk_handle_offset:
@@ -551,6 +570,8 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
     for index in table.indices:
         if not index.visible:
             continue  # still being built online (ddl/ddl.py)
+        if not allowed(index):
+            continue
         r = extract_points(table, index, conditions, col_map)
         if r is None:
             continue
@@ -566,7 +587,8 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
             est = sum(
                 stats.est_eq_rows(table.id, off0, p[0], ts.row_count)
                 for p in r.points)
-            if est > ts.row_count * POINT_SEL_LIMIT:
+            if est > ts.row_count * POINT_SEL_LIMIT and \
+                    index.name.lower() not in use_hint:
                 continue  # too many rows: the full scan is cheaper
         depth = len(r.points[0])
         if best is None or depth > len(best.points[0]) or (
@@ -575,10 +597,11 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
             best, best_est = r, est
     if best is not None:
         return "ranges", best, best_est
-    # interval ranges: only with statistics backing the choice
-    if ts is not None and not has_subq:
+    # interval ranges: only with statistics backing the choice (a USE_INDEX
+    # hint overrides the gate — the user asserted the path is good)
+    if (ts is not None or use_hint) and not has_subq:
         for index in table.indices:
-            if not index.visible:
+            if not index.visible or not allowed(index):
                 continue
             off0 = index.col_offsets[0]
             if table.columns[off0].ftype.is_string:
@@ -587,6 +610,10 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None):
             if interval is None:
                 continue
             lo, hi, li, hi_i = interval
+            if index.name.lower() in use_hint:
+                return "ranges", ScanRanges(index, [], interval), None
+            if ts is None:
+                continue
             est = stats.est_range_rows(table.id, off0, lo, hi, li, hi_i,
                                        ts.row_count)
             if est <= ts.row_count * INTERVAL_SEL_LIMIT:
@@ -655,7 +682,7 @@ def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
                 isinstance(plan.children[0], LogicalScan):
             scan = plan.children[0]
             ap = _access_path(child.dag.scan.col_offsets, scan.table,
-                              plan.conditions, stats)
+                              plan.conditions, stats, scan=scan)
             if ap is not None:
                 kind, payload, est = ap
                 if kind in ("handles", "unique"):

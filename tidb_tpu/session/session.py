@@ -71,6 +71,13 @@ class Session:
         self._stmt_seq = 0
         self.last_mem_peak = 0  # bytes; per-statement tracker peak
         self.last_spill_count = 0
+        # SQL-text plan cache: key -> (invalidation gen, physical plan)
+        # (reference: prepared-plan cache, planner/core/common_plans.go +
+        # kvcache LRU; text-keyed here because identical statement replay
+        # dominates the workloads the cache exists for)
+        self._plan_cache: dict = {}
+        self._plan_cache_key: Optional[str] = None
+        self.plan_cache_hits = 0
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
@@ -87,7 +94,14 @@ class Session:
         for i, stmt in enumerate(stmts):
             label = sql if len(stmts) == 1 else \
                 f"[stmt {i + 1}/{len(stmts)}] {sql}"
-            result = self._execute_observed(stmt, label)
+            # single-statement SELECT text is the plan-cache key
+            self._plan_cache_key = sql if (
+                len(stmts) == 1 and isinstance(
+                    stmt, (ast.SelectStmt, ast.SetOpStmt))) else None
+            try:
+                result = self._execute_observed(stmt, label)
+            finally:
+                self._plan_cache_key = None
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)
@@ -161,7 +175,15 @@ class Session:
         bound = copy.deepcopy(stmt)
         if n_params:
             bound = _bind_params(bound, params)
-        return self._execute_observed(bound, f"EXECUTE stmt#{stmt_id}")
+        # prepared plans cache per (stmt, bound params): repeated
+        # identical executions reuse the physical plan (reference:
+        # prepared-plan cache, common_plans.go getPhysicalPlan)
+        if isinstance(bound, (ast.SelectStmt, ast.SetOpStmt)):
+            self._plan_cache_key = f"#stmt{stmt_id}:{params!r}"
+        try:
+            return self._execute_observed(bound, f"EXECUTE stmt#{stmt_id}")
+        finally:
+            self._plan_cache_key = None
 
     def close_prepared(self, stmt_id: int) -> None:
         self._prepared.pop(stmt_id, None)
@@ -398,10 +420,14 @@ class Session:
         ast.walk(node, visit)
         return found
 
-    def _maybe_bind_vars(self, stmt):
+    def _maybe_bind_vars(self, stmt, has_vars: Optional[bool] = None):
         """@var / @@var reads bind in every expression-bearing statement
-        (SELECT and DML alike — the SET-then-DML pattern is standard)."""
-        if self._has_var_reads(stmt):
+        (SELECT and DML alike — the SET-then-DML pattern is standard).
+        `has_vars` skips re-walking the AST when the caller already
+        checked."""
+        if has_vars is None:
+            has_vars = self._has_var_reads(stmt)
+        if has_vars:
             import copy as _copy
             return self._bind_vars(_copy.deepcopy(stmt))
         return stmt
@@ -573,19 +599,36 @@ class Session:
         return self.txn
 
     def _run_in_txn(self, fn):
-        txn = self._ensure_txn()
-        stage = txn.memdb.staging()
-        try:
-            result = fn()
-        except Exception:
-            txn.memdb.cleanup(stage)
-            if not self.in_explicit_txn:
-                self._finish_txn(commit=False)
-            raise
-        txn.memdb.release(stage)
-        if not self.in_explicit_txn:
-            self._finish_txn(commit=True)
-        return result
+        """One statement in the session txn; autocommit statements that
+        lose an optimistic write conflict re-execute at a fresh start_ts
+        up to tidb_retry_limit times (reference: session.go:690
+        retryable auto-commit retry — explicit txns never auto-retry)."""
+        retries = 0
+        if not self.in_explicit_txn and self.txn is None:
+            try:
+                retries = int(self._sysvar_value("tidb_retry_limit") or 0)
+            except (TypeError, ValueError):
+                retries = 0
+        for attempt in range(retries + 1):
+            txn = self._ensure_txn()
+            stage = txn.memdb.staging()
+            try:
+                result = fn()
+            except Exception:
+                txn.memdb.cleanup(stage)
+                if not self.in_explicit_txn:
+                    self._finish_txn(commit=False)
+                raise
+            txn.memdb.release(stage)
+            if self.in_explicit_txn:
+                return result
+            try:
+                self._finish_txn(commit=True)
+            except SQLError as e:
+                if attempt < retries and "write conflict" in str(e):
+                    continue  # fresh ts, statement re-executes
+                raise
+            return result
 
     def rollback_if_active(self) -> None:
         """Abandon any open transaction (connection teardown path —
@@ -625,12 +668,15 @@ class Session:
 
     # ==================== SELECT ====================
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
-        stmt = self._maybe_bind_vars(stmt)
+        # var reads must be detected BEFORE binding substitutes them with
+        # literals, or the cache would freeze the first-seen values
+        has_vars = self._has_var_reads(stmt)
+        stmt = self._maybe_bind_vars(stmt, has_vars)
         self._refresh_infoschema(stmt)
         try:
             if getattr(stmt, "for_update", False):
                 self._lock_for_update(stmt)
-            plan = self._plan(stmt)
+            plan = self._plan_cached(stmt, uncacheable=has_vars)
             ctx = self._exec_ctx()
             try:
                 chunk = run_physical(plan, ctx)
@@ -663,6 +709,30 @@ class Session:
                 "FOR UPDATE supports single-table queries only")
         info, _ = self._table_for(stmt.from_)
         self._pessimistic_scan(info, stmt.from_, stmt.where, txn)
+
+    def _plan_cached(self, stmt: ast.SelectStmt, uncacheable: bool = False):
+        """Plan, going through the SQL-text plan cache when the statement
+        is cache-safe (no @@var reads, no FOR UPDATE locking) and the
+        cache is enabled. Entries invalidate on schema version or stats
+        generation change (reference: planCacheKey carries schema
+        version + stats, planner/core/cache.go)."""
+        key = self._plan_cache_key
+        if (key is None or uncacheable
+                or not int(self._sysvar_value("tidb_enable_plan_cache")
+                           or 0)
+                or getattr(stmt, "for_update", False)):
+            return self._plan(stmt)
+        gen = (self.catalog.version, self.storage.stats.generation,
+               self.current_db)
+        entry = self._plan_cache.get(key)
+        if entry is not None and entry[0] == gen:
+            self.plan_cache_hits += 1
+            return entry[1]
+        plan = self._plan(stmt)
+        if len(self._plan_cache) >= 128:  # LRU-ish: drop oldest insert
+            self._plan_cache.pop(next(iter(self._plan_cache)))
+        self._plan_cache[key] = (gen, plan)
+        return plan
 
     def _plan(self, stmt: ast.SelectStmt):
         try:
@@ -707,30 +777,42 @@ class Session:
                 handle = self._row_handle(info, full, store)
                 enc = store.encode_row(full)
                 if txn.pessimistic:
-                    # lock the new key AND any REPLACE victims, re-checking
-                    # duplicates whenever a newer commit invalidates the
-                    # view (reference: pessimistic lock-then-recheck loop)
+                    # lock the new record key AND every unique-index key
+                    # this row claims (lock-only keys need no data record)
+                    # so a concurrent insert of the same UNIQUE value —
+                    # under ANY handle — serializes behind us; after any
+                    # wait, re-check duplicates at a fresh view, since the
+                    # holder may have committed the very value we carry
+                    # (reference: pessimistic lock-then-recheck;
+                    # tables/index.go unique key constraint via KV)
                     from ..kv.mvcc import WriteConflictError as KVConflict
-                    key = tablecodec.record_key(info.id, handle)
+                    lock_keys = [tablecodec.record_key(info.id, handle)]
+                    lock_keys += self._unique_lock_keys(info, enc)
                     for _ in range(16):
                         try:
-                            self.storage.pessimistic_lock_keys(
-                                txn, [key], timeout)
-                            conflicts = checker.conflicts(handle, enc)
-                            if conflicts and stmt.is_replace:
-                                self.storage.pessimistic_lock_keys(
-                                    txn,
-                                    [tablecodec.record_key(info.id, h)
-                                     for h in conflicts], timeout)
-                            break
+                            waited = self.storage.pessimistic_lock_keys(
+                                txn, lock_keys, timeout)
                         except KVConflict:
-                            # a commit landed past our for_update_ts:
-                            # re-check duplicates at a fresher view
+                            # a commit landed past our for_update_ts
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
                             checker = _UniqueChecker(info, store, txn)
+                            continue
                         except (Storage.DeadlockError,
                                 Storage.LockWaitTimeout) as e:
                             raise SQLError(str(e)) from None
+                        if waited:
+                            txn.stmt_read_ts = txn.refresh_for_update_ts()
+                            checker = _UniqueChecker(info, store, txn)
+                        conflicts = checker.conflicts(handle, enc)
+                        if not (conflicts and stmt.is_replace):
+                            break
+                        victims = [tablecodec.record_key(info.id, h)
+                                   for h in conflicts
+                                   if tablecodec.record_key(info.id, h)
+                                   not in txn.locked_keys]
+                        if not victims:
+                            break
+                        lock_keys = victims  # lock them, then re-check
                     else:
                         raise SQLError(
                             "pessimistic lock retries exhausted")
@@ -867,6 +949,24 @@ class Session:
             return ResultSet([], [], affected=len(handles))
         finally:
             txn.stmt_read_ts = None
+
+    def _unique_lock_keys(self, info: TableInfo, enc: tuple) -> list[bytes]:
+        """Lock-only keys representing the unique-index entries a new row
+        would claim (NULL-bearing keys skipped — MySQL allows repeated
+        NULLs in unique indexes). Physical values (dictionary codes) are
+        per-store deterministic, so equal SQL values from any session
+        encode to equal lock keys."""
+        from ..kv import tablecodec
+
+        keys: list[bytes] = []
+        for ix in info.indices:
+            if not (ix.unique or ix.primary):
+                continue
+            vals = [enc[off] for off in ix.col_offsets]
+            if any(v is None for v in vals):
+                continue
+            keys.append(tablecodec.index_key(info.id, ix.id, vals))
+        return keys
 
     def _pessimistic_scan(self, info: TableInfo, table: ast.TableName,
                           where: Optional[ast.Expr], txn):

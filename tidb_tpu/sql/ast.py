@@ -144,9 +144,22 @@ class UserVarExpr(Expr):
 
 
 @dataclass
+class WindowFrame:
+    """ROWS|RANGE BETWEEN <start> AND <end>. Bound types: 'unbounded',
+    'current', 'preceding', 'following'; value set for the offset kinds."""
+
+    unit: str  # 'ROWS' | 'RANGE'
+    start_type: str
+    start_value: Optional[int] = None
+    end_type: str = "current"
+    end_value: Optional[int] = None
+
+
+@dataclass
 class WindowSpec:
     partition_by: list["Expr"] = field(default_factory=list)
     order_by: list["OrderItem"] = field(default_factory=list)
+    frame: Optional[WindowFrame] = None
 
 
 @dataclass
@@ -250,6 +263,8 @@ class SelectStmt(Stmt):
     offset: int = 0
     distinct: bool = False
     for_update: bool = False  # SELECT ... FOR UPDATE row locks
+    # optimizer hints from /*+ ... */: (NAME, [args]) in source order
+    hints: list[tuple[str, list[str]]] = field(default_factory=list)
 
 
 @dataclass

@@ -27,6 +27,7 @@ class TokenKind(enum.Enum):
     FLOAT = "float"  # scientific notation -> double
     STRING = "string"
     OP = "op"
+    HINT = "hint"  # /*+ ... */ optimizer hint; text = inner content
     EOF = "eof"
 
 
@@ -69,7 +70,7 @@ KEYWORDS = frozenset(
     GRANT REVOKE USER IDENTIFIED PRIVILEGES GRANTS
     FOR
     ADMIN DDL JOBS
-    OVER PARTITION ROWS RANGE
+    OVER PARTITION ROWS RANGE UNBOUNDED PRECEDING FOLLOWING CURRENT ROW
     """.split()
 )
 
@@ -97,6 +98,14 @@ class Lexer:
             return Token(TokenKind.EOF, "", pos)
         c = text[pos]
 
+        if text.startswith("/*+", pos):
+            # optimizer hint comment survives as a token (reference: the
+            # parser yields hints to planner/core/hints.go)
+            end = text.find("*/", pos + 3)
+            if end < 0:
+                raise LexError("unterminated hint comment", pos)
+            self.pos = end + 2
+            return Token(TokenKind.HINT, text[pos + 3:end].strip(), pos)
         if c.isdigit() or (c == "." and pos + 1 < len(text) and text[pos + 1].isdigit()):
             return self._number()
         if c.isalpha() or c == "_":
@@ -129,7 +138,8 @@ class Lexer:
             elif c == "#":
                 nl = text.find("\n", self.pos)
                 self.pos = len(text) if nl < 0 else nl + 1
-            elif text.startswith("/*", self.pos):
+            elif text.startswith("/*", self.pos) and not text.startswith(
+                    "/*+", self.pos):
                 end = text.find("*/", self.pos + 2)
                 if end < 0:
                     raise LexError("unterminated comment", self.pos)

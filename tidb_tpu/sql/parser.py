@@ -66,7 +66,15 @@ class ParseError(Exception):
 
 class Parser:
     def __init__(self, text: str) -> None:
-        self.toks = list(Lexer(text).tokens())
+        toks = list(Lexer(text).tokens())
+        # optimizer hints are meaningful only right after SELECT; stray
+        # hint comments elsewhere degrade to plain comments (MySQL does
+        # the same — hints in unsupported positions are ignored)
+        self.toks = [
+            t for i, t in enumerate(toks)
+            if t.kind != TokenKind.HINT
+            or (i > 0 and toks[i - 1].is_kw("SELECT"))
+        ]
         self.i = 0
 
     # ---- token helpers -----------------------------------------------------
@@ -322,6 +330,9 @@ class Parser:
 
     def parse_select(self) -> ast.SelectStmt:
         self.expect_kw("SELECT")
+        hints: list[tuple[str, list[str]]] = []
+        if self.cur.kind == TokenKind.HINT:
+            hints = _parse_hints(self.advance().text)
         distinct = bool(self.accept_kw("DISTINCT"))
         self.accept_kw("ALL")
 
@@ -329,7 +340,8 @@ class Parser:
         while self.accept_op(","):
             fields.append(self.parse_select_field())
 
-        stmt = ast.SelectStmt(fields=fields, distinct=distinct)
+        stmt = ast.SelectStmt(fields=fields, distinct=distinct,
+                              hints=hints)
         if self.accept_kw("FROM"):
             stmt.from_ = self.parse_table_refs()
         if self.accept_kw("WHERE"):
@@ -1096,10 +1108,41 @@ class Parser:
             while self.accept_op(","):
                 spec.order_by.append(self.parse_order_item())
         if self.cur.is_kw("ROWS", "RANGE"):
-            raise ParseError("explicit window frames unsupported", self.cur)
+            spec.frame = self._parse_frame()
         self.expect_op(")")
         fc.window = spec
         return fc
+
+    def _parse_frame(self) -> ast.WindowFrame:
+        """ROWS|RANGE BETWEEN <bound> AND <bound>, or the single-bound
+        form (bound .. CURRENT ROW)."""
+        unit = self.advance().text  # ROWS | RANGE
+
+        def bound() -> tuple[str, Optional[int]]:
+            if self.accept_kw("UNBOUNDED"):
+                kw = self.expect_kw("PRECEDING", "FOLLOWING")
+                return ("unbounded" if kw.text == "PRECEDING"
+                        else "unbounded_following"), None
+            if self.accept_kw("CURRENT"):
+                self.expect_kw("ROW")
+                return "current", None
+            t = self.cur
+            if t.kind != TokenKind.INT:
+                raise ParseError("expected frame bound", t)
+            self.advance()
+            kw = self.expect_kw("PRECEDING", "FOLLOWING")
+            return kw.text.lower(), int(t.text)
+
+        if self.accept_kw("BETWEEN"):
+            s_type, s_val = bound()
+            self.expect_kw("AND")
+            e_type, e_val = bound()
+        else:
+            s_type, s_val = bound()
+            e_type, e_val = "current", None
+        if s_type == "unbounded_following" or e_type == "unbounded":
+            raise ParseError("invalid window frame bounds", self.cur)
+        return ast.WindowFrame(unit, s_type, s_val, e_type, e_val)
 
     def _finish_column_ref(self, first: str) -> ast.ColumnRef:
         if self.accept_op("."):
@@ -1142,6 +1185,22 @@ class Parser:
 # Keywords that may double as identifiers (table/column names) when not in
 # keyword position — mirrors MySQL's non-reserved keyword list for the subset
 # we actually reserve.
+def _parse_hints(text: str) -> list[tuple[str, list[str]]]:
+    """'LEADING(a, b) USE_INDEX(t, i)' -> [('LEADING', ['a','b']), ...]
+    (reference: planner/core/hints.go hint table). Unknown hints are
+    carried through; the planner ignores what it doesn't implement."""
+    import re as _re
+
+    out: list[tuple[str, list[str]]] = []
+    for m in _re.finditer(r"([A-Za-z_][A-Za-z0-9_]*)\s*(\(([^)]*)\))?",
+                          text):
+        name = m.group(1).upper()
+        args = [a.strip().strip("`").lower()
+                for a in (m.group(3) or "").split(",") if a.strip()]
+        out.append((name, args))
+    return out
+
+
 _IDENT_KEYWORDS = frozenset(
     """
     DATE TIME TIMESTAMP DATETIME YEAR STATUS VARIABLES TABLES DATABASES
@@ -1149,6 +1208,7 @@ _IDENT_KEYWORDS = frozenset(
     ADMIN DDL JOBS OVER PARTITION ROWS RANGE
     SCHEMAS WARNINGS ERRORS ENGINES COLLATION COLUMNS FIELDS INDEXES KEYS
     NAMES USER IDENTIFIED PRIVILEGES GRANTS PESSIMISTIC OPTIMISTIC
+    UNBOUNDED PRECEDING FOLLOWING CURRENT ROW
     """.split()
 )
 

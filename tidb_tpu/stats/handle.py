@@ -81,6 +81,9 @@ class StatsHandle:
 
     def __init__(self) -> None:
         self.tables: dict[int, TableStats] = {}
+        # bumped whenever stats materially change (ANALYZE/load/drop);
+        # plan-cache entries key on it for invalidation
+        self.generation = 0
         # modify counts at last ANALYZE, per table id
         self._analyzed_at_modify: dict[int, int] = {}
         # (table_id, condition digest) -> observed row count from actual
@@ -135,6 +138,7 @@ class StatsHandle:
         txn = storage.begin()
         try:
             ts = self.build_table(info, txn.snapshot(info.id))
+            self.generation += 1  # invalidates cached plans (cache key)
             self._analyzed_at_modify[info.id] = store.modify_count
             # fresh stats supersede stale observation feedback
             self.clear_feedback(info.id)
@@ -194,6 +198,7 @@ class StatsHandle:
             del self.feedback[k]
 
     def drop_table(self, table_id: int) -> None:
+        self.generation += 1
         self.clear_feedback(table_id)
         self.tables.pop(table_id, None)
         self._analyzed_at_modify.pop(table_id, None)

@@ -464,7 +464,7 @@ class Storage:
         pass
 
     def pessimistic_lock_keys(self, txn: "Transaction", keys: list[bytes],
-                              timeout_s: float = 50.0) -> None:
+                              timeout_s: float = 50.0) -> bool:
         """Acquire pessimistic locks with wait + deadlock detection
         (reference: executor/adapter.go:533 handlePessimisticDML ->
         pessimistic.go lock-wait; deadlock detection is TiKV's detector
@@ -477,12 +477,13 @@ class Storage:
         import time as _time
 
         if not keys:
-            return
+            return False
         keys = sorted(keys)
         if txn.pessimistic_primary is None:
             txn.pessimistic_primary = keys[0]
         deadline = _time.monotonic() + timeout_s
         backoff = 0.001
+        waited = False
         while True:
             try:
                 self.kv.pessimistic_lock(keys, txn.pessimistic_primary,
@@ -491,7 +492,10 @@ class Storage:
                     self._waits_for.pop(txn.start_ts, None)
                 txn.locked_keys.update(keys)
                 txn.start_heartbeat()
-                return
+                # True = we blocked on someone: the caller's read view may
+                # predate whatever that someone committed and needs a
+                # refresh before constraint checks
+                return waited
             except KVError as e:
                 from ..kv.mvcc import KeyIsLockedError
                 if not isinstance(e, KeyIsLockedError):
@@ -524,6 +528,7 @@ class Storage:
                     raise Storage.LockWaitTimeout(
                         "Lock wait timeout exceeded; try restarting "
                         "transaction") from None
+                waited = True
                 _time.sleep(backoff)
                 backoff = min(backoff * 2, 0.05)
 
@@ -540,19 +545,15 @@ class Storage:
                                              txn.start_ts)
             return txn.start_ts
         self._maybe_extend_lease()
-        with self._commit_lock:
-            for table_id, token in txn.schema_tokens.items():
-                store = self.tables.get(table_id)
-                if store is not None and store.schema_token != token:
-                    # rows were buffered against an older layout (reference:
-                    # schema validator fails the txn, domain/schema_validator.go)
-                    raise WriteConflictError(
-                        "Information schema is changed during the execution "
-                        "of the statement; try again")
-            # encode AFTER the fence: _kv_row decodes dictionary codes, and
-            # a fenced txn's codes may not exist in the post-DDL dictionaries
-            kv_muts = []
-            written = set()
+        # fence + encode happen OUTSIDE the commit lock: prewrite can
+        # block on other txns' row locks for the whole lock-wait budget,
+        # and holding the commit lock there would stall every other
+        # commit — including the lock holder's, a guaranteed deadlock.
+        # The fence re-check inside the lock stays authoritative.
+        self._check_schema_fence(txn)
+        kv_muts = []
+        written = set()
+        try:
             for (table_id, handle), row in mutations.items():
                 key = tablecodec.record_key(table_id, handle)
                 written.add(key)
@@ -561,19 +562,36 @@ class Storage:
                 else:
                     kv_muts.append(Mutation(OP_PUT, key, codec.encode_key(
                         self._kv_row(self.tables.get(table_id), row))))
-            # pessimistic guards on unwritten keys commit as lock-only
-            # records so 2PC clears them atomically (reference: OP_LOCK
-            # mutations through prewrite; kv/memdb lock-only entries)
-            from ..kv.mvcc import OP_LOCK
-            for key in sorted(txn.locked_keys - written):
-                kv_muts.append(Mutation(OP_LOCK, key))
+        except (IndexError, KeyError):
+            # dictionary codes no longer decode: DDL rewrote the column
+            # between our buffering and this encode
+            raise WriteConflictError(
+                "Information schema is changed during the execution "
+                "of the statement; try again") from None
+        # pessimistic guards on unwritten keys commit as lock-only
+        # records so 2PC clears them atomically (reference: OP_LOCK
+        # mutations through prewrite; kv/memdb lock-only entries)
+        from ..kv.mvcc import OP_LOCK
+        for key in sorted(txn.locked_keys - written):
+            kv_muts.append(Mutation(OP_LOCK, key))
+        try:
+            state = self.committer.prewrite_phase(kv_muts, txn.start_ts)
+        except KVWriteConflict as e:
+            from .. import obs
+            obs.CONFLICTS.inc()
+            self._best_effort_rollback(kv_muts, txn.start_ts)
+            raise WriteConflictError(str(e)) from None
+        except (KVError, CommitError) as e:
+            self._best_effort_rollback(kv_muts, txn.start_ts)
+            raise WriteConflictError(f"commit failed: {e}") from None
+        with self._commit_lock:
             try:
-                commit_ts = self.committer.commit(kv_muts, txn.start_ts)
-            except KVWriteConflict as e:
-                from .. import obs
-                obs.CONFLICTS.inc()
+                self._check_schema_fence(txn)
+            except WriteConflictError:
                 self._best_effort_rollback(kv_muts, txn.start_ts)
-                raise WriteConflictError(str(e)) from None
+                raise
+            try:
+                commit_ts = self.committer.commit_phase(state, txn.start_ts)
             except (KVError, CommitError) as e:
                 self._best_effort_rollback(kv_muts, txn.start_ts)
                 raise WriteConflictError(f"commit failed: {e}") from None
@@ -595,6 +613,16 @@ class Storage:
             if store is not None:
                 store.maybe_compact(min(safe, commit_ts - 1) if safe else 0)
         return commit_ts
+
+    def _check_schema_fence(self, txn: "Transaction") -> None:
+        """Fail txns whose buffered rows target a superseded table layout
+        (reference: schema validator, domain/schema_validator.go)."""
+        for table_id, token in txn.schema_tokens.items():
+            store = self.tables.get(table_id)
+            if store is not None and store.schema_token != token:
+                raise WriteConflictError(
+                    "Information schema is changed during the execution "
+                    "of the statement; try again")
 
     # ---- meta KV (schema/stats persistence plane) ----------------------
     def put_meta(self, name: bytes, value: bytes) -> None:
