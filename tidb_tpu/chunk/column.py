@@ -41,11 +41,13 @@ class Dictionary:
     (see copr/kernels). Equality is exact on codes.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_ci_cache", "_ci_len")
 
     def __init__(self, values: Optional[Iterable[str]] = None) -> None:
         self.values: list[str] = []
         self._index: dict[str, int] = {}
+        self._ci_cache: Optional[dict[str, int]] = None
+        self._ci_len = 0
         if values:
             for v in values:
                 self.encode(v)
@@ -77,13 +79,72 @@ class Dictionary:
         return np.fromiter((pred(v) for v in self.values), dtype=bool,
                            count=len(self.values))
 
-    def sort_ranks(self) -> np.ndarray:
-        """int32[len(dict)] rank of each code in (binary-collation) sorted
-        order; device maps codes -> ranks to get order-correct comparisons."""
-        order = np.argsort(np.array(self.values, dtype=object), kind="stable")
+    def sort_ranks(self, ci: bool = False) -> np.ndarray:
+        """int32[len(dict)] rank of each code in sorted order; device maps
+        codes -> ranks to get order-correct comparisons. ci=True ranks by
+        casefolded value (the *_ci collation family, reference:
+        util/collate/collate.go:62)."""
+        if ci:
+            keyed = np.array([v.casefold() for v in self.values],
+                             dtype=object)
+        else:
+            keyed = np.array(self.values, dtype=object)
+        order = np.argsort(keyed, kind="stable")
         ranks = np.empty(len(self.values), dtype=np.int32)
         ranks[order] = np.arange(len(self.values), dtype=np.int32)
         return ranks
+
+    def _ci_map(self) -> dict[str, int]:
+        """casefolded value -> first (canonical) code; grown
+        incrementally as the append-only dictionary grows, so repeated
+        ci joins/IN-lists stay O(1) per probe."""
+        m = self._ci_cache
+        if m is None:
+            m = {}
+            self._ci_cache = m
+            self._ci_len = 0
+        for i in range(self._ci_len, len(self.values)):
+            m.setdefault(self.values[i].casefold(), i)
+        self._ci_len = len(self.values)
+        return m
+
+    def ci_canonical(self) -> np.ndarray:
+        """int64[len(dict)] canonical code per code: the first code whose
+        value casefolds equally. Grouping/joining ci-collated columns maps
+        codes through this so 'A' and 'a' land together."""
+        m = self._ci_map()
+        return np.fromiter((m[v.casefold()] for v in self.values),
+                           np.int64, count=len(self.values))
+
+    def lookup_ci(self, s: str) -> int:
+        """Canonical code of any value casefold-equal to s, or -1."""
+        return self._ci_map().get(s.casefold(), -1)
+
+
+class EnumDictionary(Dictionary):
+    """Fixed, definition-ordered dictionary for ENUM columns: encode
+    validates membership (case-insensitively, like MySQL) and sort order
+    is definition order, not lexicographic (reference: ENUM compares by
+    index, types/enum.go)."""
+
+    __slots__ = ()
+
+    def __init__(self, elems) -> None:
+        super().__init__()
+        for e in elems:
+            Dictionary.encode(self, e)  # seed bypasses validation
+
+    def encode(self, s: str) -> int:
+        code = self._index.get(s)
+        if code is not None:
+            return code
+        code = self.lookup_ci(s)
+        if code < 0:
+            raise ValueError(f"Data truncated: invalid ENUM value {s!r}")
+        return code
+
+    def sort_ranks(self, ci: bool = False) -> np.ndarray:
+        return np.arange(len(self.values), dtype=np.int32)
 
 
 @dataclass
@@ -123,6 +184,10 @@ class Column:
             return None
         raw = self.data[i]
         k = self.ftype.kind
+        if k == TypeKind.SET:
+            mask = int(raw)
+            return ",".join(e for j, e in enumerate(self.ftype.elems)
+                            if mask >> j & 1)
         if self.ftype.is_decimal:
             return Decimal(int(raw), self.ftype.scale)
         if k == TypeKind.DATE:
@@ -214,6 +279,44 @@ class Column:
 def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -> Any:
     """Host scalar -> physical representation for one cell."""
     k = ftype.kind
+    if k == TypeKind.SET:
+        if isinstance(v, (int, np.integer)):
+            mask = int(v)
+            if mask >> len(ftype.elems):
+                raise ValueError(f"invalid SET bitmask {mask}")
+            return mask
+        lowered = {e.lower(): j for j, e in enumerate(ftype.elems)}
+        mask = 0
+        for part in str(v).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            j = lowered.get(part.lower())
+            if j is None:
+                raise ValueError(
+                    f"Data truncated: invalid SET value {part!r}")
+            mask |= 1 << j
+        return mask
+    if k == TypeKind.BIT:
+        n = int(v)
+        width = min(ftype.flen if ftype.flen > 0 else 1, 63)
+        if n < 0 or n >> width:
+            raise ValueError(f"BIT({width}) value {n} out of range")
+        return n
+    if k == TypeKind.JSON:
+        import json as _json
+
+        assert dictionary is not None
+        s = v if isinstance(v, str) else _json.dumps(v)
+        try:
+            # normalize so equal documents encode to equal codes
+            # (reference: types/json/binary.go canonical binary form)
+            s = _json.dumps(_json.loads(s), sort_keys=True,
+                            separators=(", ", ": "))
+        except ValueError:
+            raise ValueError(
+                f"Invalid JSON text: {s[:40]!r}") from None
+        return dictionary.encode(s)
     if ftype.is_decimal:
         if isinstance(v, Decimal):
             d = v.rescale(ftype.scale)

@@ -100,6 +100,39 @@ class NumpyEval:
             for i, s in enumerate(av):
                 out[i] = _substring(s, start, length)
             return out, avl
+        if op == "json_extract":
+            av, avl = self.eval_str(A[0])
+            out = np.full(self.n, "", dtype=object)
+            ok = np.zeros(self.n, bool)
+            for i, (s, v) in enumerate(zip(av, avl)):
+                if not v:
+                    continue
+                r = _json_extract(s, str(e.extra))
+                if r is not None:
+                    out[i] = r
+                    ok[i] = True
+            return out, ok
+        if op == "json_unquote":
+            av, avl = self.eval_str(A[0])
+            out = np.empty(self.n, dtype=object)
+            for i, s in enumerate(av):
+                out[i] = _json_unquote(s)
+            return out, avl
+        if op == "json_type":
+            import json as _json
+
+            av, avl = self.eval_str(A[0])
+            out = np.full(self.n, "", dtype=object)
+            ok = np.zeros(self.n, bool)
+            for i, (s, v) in enumerate(zip(av, avl)):
+                if not v:
+                    continue
+                try:
+                    out[i] = _json_type_name(_json.loads(s))
+                    ok[i] = True
+                except ValueError:
+                    pass
+            return out, ok
         raise NotImplementedError(f"string eval: {op}")
 
     # ---- evaluation ---------------------------------------------------------
@@ -153,7 +186,14 @@ class NumpyEval:
                 av, avl = self.eval(arg)
                 d = self.dicts[arg.idx]
                 assert d is not None
-                codes = [d.lookup(str(v)) for v in e.extra]
+                if arg.ftype.is_ci:
+                    canon = d.ci_canonical() if len(d) else \
+                        np.zeros(0, np.int64)
+                    codes = [d.lookup_ci(str(v)) for v in e.extra]
+                    av = canon[np.clip(av, 0, max(len(d) - 1, 0))] \
+                        if len(d) else av
+                else:
+                    codes = [d.lookup(str(v)) for v in e.extra]
                 hit = np.isin(av, [c for c in codes if c >= 0])
             elif arg.ftype.is_string:
                 # computed string (e.g. substring): string-domain membership
@@ -171,7 +211,10 @@ class NumpyEval:
 
             from .client import _like_to_regex
             arg = A[0]
-            rx = re.compile(_like_to_regex(str(e.extra)), re.DOTALL)
+            flags = re.DOTALL
+            if arg.ftype.is_ci:
+                flags |= re.IGNORECASE  # ci collation LIKE
+            rx = re.compile(_like_to_regex(str(e.extra)), flags)
             if not isinstance(arg, Col):
                 sv, svl = self.eval_str(arg)
                 hit = np.fromiter((rx.fullmatch(s) is not None for s in sv),
@@ -291,17 +334,80 @@ class NumpyEval:
         if op == "cast":
             return self._cast(self.eval(A[0]), A[0].ftype, e.ftype)
 
+        if op == "json_valid":
+            import json as _json
+
+            av, avl = self.eval_str(A[0])
+            out = np.zeros(self.n, np.int64)
+            for i, (s, v) in enumerate(zip(av, avl)):
+                if v:
+                    try:
+                        _json.loads(s)
+                        out[i] = 1
+                    except ValueError:
+                        pass
+            return out, avl
+        if op == "json_length":
+            import json as _json
+
+            av, avl = self.eval_str(A[0])
+            out = np.zeros(self.n, np.int64)
+            ok = np.zeros(self.n, bool)
+            for i, (s, v) in enumerate(zip(av, avl)):
+                if not v:
+                    continue
+                try:
+                    doc = _json.loads(s)
+                except ValueError:
+                    continue
+                out[i] = len(doc) if isinstance(doc, (list, dict)) else 1
+                ok[i] = True
+            return out, ok
+        if op == "find_in_set":
+            needle, nvl = self.eval_str(A[0])
+            target = A[1]
+            out = np.zeros(self.n, np.int64)
+            if target.ftype.kind == TypeKind.SET:
+                mv, mvl = self.eval(target)
+                elems = target.ftype.elems
+                for i, (s, m) in enumerate(zip(needle, mv)):
+                    labels = [e for j, e in enumerate(elems)
+                              if int(m) >> j & 1]
+                    if s in labels:
+                        out[i] = labels.index(s) + 1
+                return out, nvl & mvl
+            hv, hvl = self.eval_str(target)
+            for i, (s, h) in enumerate(zip(needle, hv)):
+                parts = h.split(",") if h else []
+                if s in parts:
+                    out[i] = parts.index(s) + 1
+            return out, nvl & hvl
+
         raise NotImplementedError(f"host eval: {op}")
 
     def _compare(self, e: Call) -> VV:
         op = e.op
         a, b = e.args
-        av, avl = self.eval(a)
-        bv, bvl = self.eval(b)
-        # string comparisons via dictionaries
         if a.ftype.is_string or b.ftype.is_string:
-            av2, bv2 = self._string_operands(a, av, b, bv, op)
+            ci = a.ftype.is_ci or b.ftype.is_ci
+            if ci or isinstance(a, Call) or isinstance(b, Call):
+                # ci collation or computed strings: compare in the
+                # (casefolded) string domain (reference: collation-aware
+                # compare, util/collate/collate.go:141)
+                av2, avl = self.eval_str(a)
+                bv2, bvl = self.eval_str(b)
+                if ci:
+                    av2 = np.array([s.casefold() for s in av2],
+                                   dtype=object)
+                    bv2 = np.array([s.casefold() for s in bv2],
+                                   dtype=object)
+            else:
+                av, avl = self.eval(a)
+                bv, bvl = self.eval(b)
+                av2, bv2 = self._string_operands(a, av, b, bv, op)
         else:
+            av, avl = self.eval(a)
+            bv, bvl = self.eval(b)
             av2, bv2 = _align(a.ftype, av, b.ftype, bv)
         fn = {"eq": np.equal, "ne": np.not_equal, "lt": np.less,
               "le": np.less_equal, "gt": np.greater, "ge": np.greater_equal}[op]
@@ -392,6 +498,76 @@ def _substring(s: str, start: int, length: Optional[int]) -> str:
     if length <= 0:
         return ""
     return s[i:i + length]
+
+
+def _json_path_steps(path: str) -> Optional[list]:
+    """'$.a.b[2]' -> ['a', 'b', 2]; None for malformed paths.
+    Subset of the reference's path grammar (types/json/path_expr.go):
+    member access and array indexing, no wildcards."""
+    import re as _re
+
+    if not path.startswith("$"):
+        return None
+    steps: list = []
+    for m in _re.finditer(r"\.(\w+)|\.\"([^\"]+)\"|\[(\d+)\]|(.)",
+                          path[1:]):
+        if m.group(4) is not None:
+            return None  # junk character
+        if m.group(3) is not None:
+            steps.append(int(m.group(3)))
+        else:
+            steps.append(m.group(1) or m.group(2))
+    return steps
+
+
+def _json_extract(doc: str, path: str):
+    """JSON-serialized value at path, or None (missing/invalid)."""
+    import json as _json
+
+    try:
+        v = _json.loads(doc)
+    except ValueError:
+        return None
+    steps = _json_path_steps(path)
+    if steps is None:
+        return None
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or s >= len(v):
+                return None
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None
+            v = v[s]
+    return _json.dumps(v, sort_keys=True, separators=(", ", ": "))
+
+
+def _json_unquote(s: str) -> str:
+    import json as _json
+
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        try:
+            return str(_json.loads(s))
+        except ValueError:
+            return s
+    return s
+
+
+def _json_type_name(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "BOOLEAN"
+    if isinstance(v, int):
+        return "INTEGER"
+    if isinstance(v, float):
+        return "DOUBLE"
+    if isinstance(v, str):
+        return "STRING"
+    if isinstance(v, list):
+        return "ARRAY"
+    return "OBJECT"
 
 
 def _b(vv: VV) -> VV:

@@ -369,7 +369,7 @@ def _window_sort_keys(item, child, ev, n):
         if e.ftype.is_string and isinstance(e, Col):
             d = child.columns[e.idx].dictionary
             if d is not None and len(d):
-                ranks = d.sort_ranks()
+                ranks = d.sort_ranks(ci=e.ftype.is_ci)
                 v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
         if np.issubdtype(v.dtype, np.floating):
             key = np.where(vl, v.astype(np.float64), -np.inf)
@@ -786,6 +786,10 @@ def _spill_agg(plan: PhysHashAgg, child: Chunk, ctx: ExecContext) -> Chunk:
         else:
             v, vl = ev.eval(g)
             v = np.asarray(v)
+            if g.ftype.is_string and isinstance(g, Col) and g.ftype.is_ci:
+                d = child.columns[g.idx].dictionary
+                if d is not None and len(d):
+                    v = d.ci_canonical()[np.clip(v, 0, len(d) - 1)]
             if np.issubdtype(v.dtype, np.floating):
                 e = v.astype(np.float64).view(np.int64)
             else:
@@ -962,10 +966,15 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
             key_dicts.append(d)
         else:
             v, vl = ev.eval(g)
-            key_vv.append((np.asarray(v), np.asarray(vl)))
-            key_dicts.append(child.columns[g.idx].dictionary
-                             if g.ftype.is_string and isinstance(g, Col)
-                             else None)
+            v = np.asarray(v)
+            d = child.columns[g.idx].dictionary \
+                if g.ftype.is_string and isinstance(g, Col) else None
+            if d is not None and len(d) and g.ftype.is_ci:
+                # ci collation: group on canonical codes so case
+                # variants merge; output shows the first-seen spelling
+                v = d.ci_canonical()[np.clip(v, 0, len(d) - 1)]
+            key_vv.append((v, np.asarray(vl)))
+            key_dicts.append(d)
     inv, first = _group_ids(key_vv, n)
     n_seg = len(first) if n else 0
     order = np.argsort(inv[:n], kind="stable") if n else np.empty(0, np.int64)
@@ -1034,7 +1043,7 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
                 dictionary = child.columns[d.arg.idx].dictionary
                 if dictionary is not None and len(dictionary):
                     # min/max over dict codes is order-wrong; use ranks
-                    ranks = dictionary.sort_ranks()
+                    ranks = dictionary.sort_ranks(ci=d.arg.ftype.is_ci)
                     rank_of = ranks[np.clip(av, 0, len(dictionary) - 1)]
                     masked_r = np.where(avl, rank_of.astype(np.int64),
                                         sentinel)
@@ -1100,7 +1109,7 @@ def _sort_key(chunk: Chunk, e: PlanExpr, desc: bool,
     if e.ftype.is_string and isinstance(e, Col):
         d = chunk.columns[e.idx].dictionary
         if d is not None and len(d):
-            ranks = d.sort_ranks()
+            ranks = d.sort_ranks(ci=e.ftype.is_ci)
             v = ranks[np.clip(v, 0, len(d) - 1)].astype(np.int64)
     if np.issubdtype(v.dtype, np.floating):
         key = np.where(vl, v.astype(np.float64), -np.inf)
@@ -1236,13 +1245,24 @@ def _encode_join_keys(plan: PhysHashJoin, left: Chunk, right: Chunk):
         rc = right.columns[ri_idx]
         lv = lc.data
         rv = rc.data
-        # dictionary columns across different dicts: remap right into left's
         if lc.ftype.is_string and lc.dictionary is not None and \
-                rc.dictionary is not None and rc.dictionary is not lc.dictionary:
-            remap = np.fromiter(
-                (lc.dictionary.lookup(s) for s in rc.dictionary.values),
-                dtype=np.int64, count=len(rc.dictionary))
-            rv = remap[rc.data] if len(rc.dictionary) else rc.data
+                rc.dictionary is not None:
+            ci = lc.ftype.is_ci or rc.ftype.is_ci
+            ld = lc.dictionary
+            # dictionary columns across different dicts: remap right into
+            # left's (ci: casefold-equal values unify)
+            if rc.dictionary is not ld:
+                lookup = ld.lookup_ci if ci else ld.lookup
+                remap = np.fromiter(
+                    (lookup(s) for s in rc.dictionary.values),
+                    dtype=np.int64, count=len(rc.dictionary))
+                rv = remap[rc.data] if len(rc.dictionary) else rc.data
+            if ci and len(ld):
+                canon = ld.ci_canonical()
+                lv = canon[np.clip(lv, 0, len(ld) - 1)]
+                rv = np.where(np.asarray(rv) >= 0,
+                              canon[np.clip(rv, 0, len(ld) - 1)],
+                              np.asarray(rv))
         # unify key domains: if either side is float, compare both as
         # float64 bit patterns (with -0.0 normalized); otherwise align
         # decimal scales and compare as int64

@@ -945,6 +945,24 @@ class PlanBuilder:
         """Fold a literal into the physical domain of the other operand."""
         if c.value is None:
             return Const(None, target)
+        if target.kind == TypeKind.JSON and c.ftype.is_string:
+            # stored JSON is normalized; normalize the literal the same
+            # way or equality on the just-inserted spelling never matches
+            import json as _json
+            try:
+                return Const(_json.dumps(_json.loads(str(c.value)),
+                                         sort_keys=True,
+                                         separators=(", ", ": ")), target)
+            except ValueError:
+                return c  # non-JSON literal: compare as plain text
+        if target.kind == TypeKind.SET and c.ftype.is_string:
+            # 'a,b' literal -> element bitmask for SET-column compares
+            from ..chunk.column import _encode_scalar
+            try:
+                return Const(_encode_scalar(target, str(c.value), None),
+                             target)
+            except ValueError:
+                return Const(-1, target)  # unknown elems: never equal
         if target.kind == TypeKind.DATE and c.ftype.is_string:
             return Const(parse_date(str(c.value)), target)
         if target.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP) and \
@@ -1056,6 +1074,44 @@ class PlanBuilder:
             from ..types.field_type import varchar_type
             return Call("substring", [args[0]], varchar_type(),
                         extra=(start, length))
+        # ---- JSON function family (host-evaluated; reference:
+        # types/json/binary.go + expression/builtin_json.go) ----------
+        from ..types.field_type import varchar_type as _vt
+        if name == "JSON_EXTRACT":
+            if len(args) != 2 or not isinstance(args[1], Const):
+                raise PlanError(
+                    "JSON_EXTRACT expects (doc, constant path)")
+            return Call("json_extract", [args[0]], _vt(),
+                        extra=str(args[1].value))
+        if name == "JSON_UNQUOTE":
+            need(1)
+            return Call("json_unquote", args, _vt())
+        if name == "JSON_VALID":
+            need(1)
+            return Call("json_valid", args, FieldType(TypeKind.BIGINT))
+        if name == "JSON_TYPE":
+            need(1)
+            return Call("json_type", args, _vt())
+        if name == "JSON_LENGTH":
+            need(1)
+            return Call("json_length", args, FieldType(TypeKind.BIGINT))
+        if name in ("JSON_OBJECT", "JSON_ARRAY"):
+            for a in args:
+                if not isinstance(a, Const):
+                    raise PlanError(f"{name} supports constant arguments")
+            import json as _json
+            if name == "JSON_ARRAY":
+                doc = _json.dumps([a.value for a in args])
+            else:
+                if len(args) % 2:
+                    raise PlanError("JSON_OBJECT needs key/value pairs")
+                doc = _json.dumps(
+                    {str(args[i].value): args[i + 1].value
+                     for i in range(0, len(args), 2)}, sort_keys=True)
+            return Const(doc, _vt())
+        if name == "FIND_IN_SET":
+            need(2)
+            return Call("find_in_set", args, FieldType(TypeKind.BIGINT))
         raise PlanError(f"unsupported function {name}")
 
     def _resolve_case(

@@ -113,7 +113,7 @@ class AggDesc:
 
 _NUMERIC_RANK = {
     TypeKind.BOOLEAN: 0, TypeKind.TINYINT: 1, TypeKind.SMALLINT: 2,
-    TypeKind.YEAR: 2, TypeKind.INT: 3, TypeKind.BIGINT: 4,
+    TypeKind.YEAR: 2, TypeKind.BIT: 3, TypeKind.INT: 3, TypeKind.BIGINT: 4,
     TypeKind.DECIMAL: 5, TypeKind.FLOAT: 6, TypeKind.DOUBLE: 7,
 }
 
@@ -191,6 +191,9 @@ def comparable(a: FieldType, b: FieldType) -> bool:
         return True
     if b.is_temporal and a.is_string:
         return True
+    from ..types.field_type import TypeKind as _TK
+    if a.kind == _TK.SET and b.kind == _TK.SET:
+        return True  # bitmask compare after const coercion
     return False
 
 

@@ -170,6 +170,13 @@ def _type_on_device(ft: FieldType) -> bool:
 def expr_pushable(e: PlanExpr) -> bool:
     """The canFuncBePushed analog for the TiTPU store."""
     if isinstance(e, (Col, Const)):
+        if e.ftype.is_string and e.ftype.is_ci:
+            # ci collations compare casefolded strings; the device code
+            # tables are built per-predicate host-side, but keeping ci
+            # columns host-only keeps code-space semantics simple
+            # (reference gates new collations similarly,
+            # expression.go:921 canFuncBePushed collation check)
+            return False
         return _type_on_device(e.ftype)
     if isinstance(e, Call):
         if e.op not in _DEVICE_OPS:
@@ -194,6 +201,8 @@ def agg_pushable(group_by: list[PlanExpr], aggs: list[AggDesc]) -> bool:
         if g.ftype.is_float:
             # float group keys are ill-defined on device hashing; host handles
             return False
+        if g.ftype.is_string and g.ftype.is_ci:
+            return False  # ci grouping merges case variants host-side
     for d in aggs:
         if d.distinct:
             return False

@@ -41,6 +41,8 @@ ER_SPECIFIC_ACCESS_DENIED = 1227
 ER_WRITE_CONFLICT = 9007
 ER_SCHEMA_CHANGED = 8028
 ER_QUERY_MEM_EXCEEDED = 8175
+WARN_DATA_TRUNCATED = 1265
+ER_INVALID_JSON_TEXT = 3140
 
 _RULES: list[tuple[re.Pattern, int, str]] = [
     (re.compile(r"^Duplicate entry"), ER_DUP_ENTRY, "23000"),
@@ -68,6 +70,8 @@ _RULES: list[tuple[re.Pattern, int, str]] = [
      "HY000"),
     (re.compile(r"write conflict"), ER_WRITE_CONFLICT, "HY000"),
     (re.compile(r"^Out Of Memory Quota"), ER_QUERY_MEM_EXCEEDED, "HY000"),
+    (re.compile(r"^Data truncated"), WARN_DATA_TRUNCATED, "01000"),
+    (re.compile(r"^Invalid JSON text"), ER_INVALID_JSON_TEXT, "22032"),
     (re.compile(r"[Dd]eadlock"), ER_LOCK_DEADLOCK, "40001"),
     (re.compile(r"[Ll]ock wait timeout"), ER_LOCK_WAIT_TIMEOUT, "HY000"),
 ]

@@ -74,7 +74,8 @@ KEYWORDS = frozenset(
     """.split()
 )
 
-_MULTI_OPS = ("<=>", "<<", ">>", "<>", "!=", "<=", ">=", ":=", "||", "&&")
+_MULTI_OPS = ("<=>", "->>", "->", "<<", ">>", "<>", "!=", "<=", ">=",
+              ":=", "||", "&&")
 _SINGLE_OPS = "+-*/%(),.;=<>!&|^~@?"
 
 
@@ -108,6 +109,17 @@ class Lexer:
             return Token(TokenKind.HINT, text[pos + 3:end].strip(), pos)
         if c.isdigit() or (c == "." and pos + 1 < len(text) and text[pos + 1].isdigit()):
             return self._number()
+        if c in "bB" and pos + 1 < len(text) and text[pos + 1] == "'":
+            # bit literal b'0101' -> integer token (reference: parser
+            # BitValueLit)
+            end = text.find("'", pos + 2)
+            if end < 0:
+                raise LexError("unterminated bit literal", pos)
+            bits = text[pos + 2:end]
+            if bits and not set(bits) <= {"0", "1"}:
+                raise LexError(f"invalid bit literal b'{bits}'", pos)
+            self.pos = end + 1
+            return Token(TokenKind.INT, str(int(bits or "0", 2)), pos)
         if c.isalpha() or c == "_":
             return self._word()
         if c == "`":

@@ -646,10 +646,24 @@ class Parser:
                 d.auto_increment = True
             elif self.accept_kw("DEFAULT"):
                 d.default = self.parse_primary()
-            elif self.cur.kind == TokenKind.IDENT and self.cur.text.upper() in (
-                "CHARACTER", "COLLATE", "COMMENT"
-            ):
-                # swallow charset/collation/comment clauses
+            elif self.cur.is_kw("COLLATE") or (
+                    self.cur.kind == TokenKind.IDENT
+                    and self.cur.text.upper() == "COLLATE"):
+                self.advance()
+                name = self.advance().text.lower()
+                if d.ftype.is_string:
+                    d.ftype = FieldType(
+                        d.ftype.kind, flen=d.ftype.flen,
+                        scale=d.ftype.scale, nullable=d.ftype.nullable,
+                        elems=d.ftype.elems, collate=name)
+            elif self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "CHARACTER":
+                self.advance()  # CHARACTER SET <name> — swallowed
+                self.accept_kw("SET")
+                if self.cur.kind in (TokenKind.IDENT, TokenKind.STRING):
+                    self.advance()
+            elif self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "COMMENT":
                 self.advance()
                 if self.cur.kind in (TokenKind.IDENT, TokenKind.STRING,
                                      TokenKind.KEYWORD):
@@ -660,17 +674,43 @@ class Parser:
     def parse_field_type(self) -> FieldType:
         t = self.cur
         kind = None
+        upper = t.text.upper() if t.kind == TokenKind.IDENT else ""
         if t.kind == TokenKind.KEYWORD and t.text in _TYPE_KEYWORDS:
             kind = _TYPE_KEYWORDS[t.text]
             self.advance()
-        elif t.kind == TokenKind.IDENT and t.text.upper() in ("SIGNED", "UNSIGNED"):
+        elif t.is_kw("SET"):  # SET('a','b',...) in type position
+            kind = TypeKind.SET
+            self.advance()
+        elif upper in ("ENUM", "BIT", "JSON"):
+            kind = {"ENUM": TypeKind.ENUM, "BIT": TypeKind.BIT,
+                    "JSON": TypeKind.JSON}[upper]
+            self.advance()
+        elif upper in ("SIGNED", "UNSIGNED"):
             self.advance()
             self.accept_kw("INT", "INTEGER")
             kind = TypeKind.BIGINT
         else:
             raise ParseError("expected type name", t)
         flen, scale = -1, 0
-        if self.accept_op("("):
+        elems: tuple = ()
+        if kind in (TypeKind.ENUM, TypeKind.SET):
+            self.expect_op("(")
+            vals = []
+            while True:
+                s = self.cur
+                if s.kind != TokenKind.STRING:
+                    raise ParseError("expected string element", s)
+                self.advance()
+                vals.append(s.text)
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            if kind == TypeKind.SET and len(vals) > 64:
+                raise ParseError("SET supports at most 64 elements", t)
+            if len(set(v.lower() for v in vals)) != len(vals):
+                raise ParseError("duplicate element in ENUM/SET", t)
+            elems = tuple(vals)
+        elif self.accept_op("("):
             flen = self.parse_uint("type length")
             if self.accept_op(","):
                 scale = self.parse_uint("type scale")
@@ -681,9 +721,17 @@ class Parser:
             if flen > 18:
                 raise ParseError(f"DECIMAL({flen}) exceeds supported precision 18",
                                  t)
+        if kind == TypeKind.BIT:
+            if flen < 0:
+                flen = 1
+            if flen > 63:
+                # the int64 physical buffer holds 63 value bits; MySQL's
+                # BIT(64) tail is rejected loudly (same policy as the
+                # DECIMAL>18 gate)
+                raise ParseError("BIT width exceeds supported 63", t)
         if self.cur.kind == TokenKind.IDENT and self.cur.text.upper() == "UNSIGNED":
             self.advance()  # accepted but not tracked yet
-        return FieldType(kind, flen=flen, scale=scale)
+        return FieldType(kind, flen=flen, scale=scale, elems=elems)
 
     def parse_drop(self) -> ast.Stmt:
         self.expect_kw("DROP")
@@ -959,7 +1007,21 @@ class Parser:
             value = self.parse_primary()
             unit = self._interval_unit()
             return ast.IntervalExpr(value, unit)
-        return self.parse_primary()
+        e = self.parse_primary()
+        # JSON path extraction operators: col->'$.k' / col->>'$.k'
+        # (reference: parser maps -> to JSON_EXTRACT and ->> to
+        # JSON_UNQUOTE(JSON_EXTRACT))
+        while self.cur.is_op("->", "->>"):
+            op = self.advance().text
+            p = self.cur
+            if p.kind != TokenKind.STRING:
+                raise ParseError("expected JSON path string", p)
+            self.advance()
+            e = ast.FuncCall("JSON_EXTRACT",
+                             [e, ast.Literal(p.text, "string")])
+            if op == "->>":
+                e = ast.FuncCall("JSON_UNQUOTE", [e])
+        return e
 
     def _interval_unit(self) -> str:
         t = self.cur

@@ -260,7 +260,13 @@ class Storage:
             ]
             dicts: list = []
             for ci in range(ncols):
-                if f"dict{ci}" in z:
+                cft = store.table.columns[ci].ftype
+                if getattr(cft, "elems", ()) and cft.is_string:
+                    # ENUM: the fixed validating dictionary, rebuilt from
+                    # the schema (codes are definition positions)
+                    from .table_store import _column_dictionary
+                    dicts.append(_column_dictionary(cft))
+                elif f"dict{ci}" in z:
                     d = Dictionary()
                     for s in z[f"dict{ci}"]:
                         d.encode(str(s))

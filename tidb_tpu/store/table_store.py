@@ -28,8 +28,17 @@ from typing import Any, Optional
 import numpy as np
 
 from ..catalog.schema import TableInfo
-from ..chunk.column import Column, Dictionary, _encode_scalar
+from ..chunk.column import Column, Dictionary, EnumDictionary, _encode_scalar
 from ..kv.memdb import TOMBSTONE
+from ..types.field_type import TypeKind
+
+
+def _column_dictionary(ftype) -> Optional[Dictionary]:
+    """Dictionary for string-physical columns; ENUM gets the fixed
+    definition-ordered validating dictionary."""
+    if ftype.kind == TypeKind.ENUM:
+        return EnumDictionary(ftype.elems)
+    return Dictionary() if ftype.is_string else None
 
 _epoch_ids = itertools.count(1)
 
@@ -169,7 +178,7 @@ class TableStore:
     def __init__(self, table: TableInfo) -> None:
         self.table = table
         self.dictionaries: list[Optional[Dictionary]] = [
-            Dictionary() if c.ftype.is_string else None for c in table.columns
+            _column_dictionary(c.ftype) for c in table.columns
         ]
         self.epoch = _empty_epoch(table)
         # committed mutations after epoch.fold_ts, in commit-ts order
@@ -388,7 +397,7 @@ class TableStore:
                 if src is None:
                     dv, dvalid = fills[i]
                     dt = c.ftype.np_dtype
-                    d = Dictionary() if c.ftype.is_string else None
+                    d = _column_dictionary(c.ftype)
                     if dvalid and isinstance(dv, str):
                         dv = d.encode(dv)  # string default -> fresh code
                     cols.append(np.full(n, dv if dvalid else 0, dtype=dt))

@@ -50,24 +50,46 @@ class TypeKind(enum.IntEnum):
     BOOLEAN = 14
     YEAR = 15
     TIME = 16  # MySQL TIME (duration); int64 microseconds
+    ENUM = 17  # dictionary code over a fixed, definition-ordered elem set
+    SET = 18   # int64 bitmask over up to 64 elems
+    BIT = 19   # int64 (BIT(n), n <= 64)
+    JSON = 20  # dictionary-coded normalized JSON text
 
 
 INT_KINDS = frozenset(
     {TypeKind.TINYINT, TypeKind.SMALLINT, TypeKind.INT, TypeKind.BIGINT,
-     TypeKind.BOOLEAN, TypeKind.YEAR}
+     TypeKind.BOOLEAN, TypeKind.YEAR, TypeKind.BIT}
 )
 FLOAT_KINDS = frozenset({TypeKind.FLOAT, TypeKind.DOUBLE})
-STRING_KINDS = frozenset({TypeKind.CHAR, TypeKind.VARCHAR, TypeKind.TEXT})
+# ENUM and JSON ride the dictionary-string machinery: predicates, joins,
+# grouping and rendering all go through codes (reference: types/json
+# binary docs + enum/set in types/etc.go — re-based on the columnar
+# dictionary layout instead of row bytes)
+STRING_KINDS = frozenset({TypeKind.CHAR, TypeKind.VARCHAR, TypeKind.TEXT,
+                          TypeKind.ENUM, TypeKind.JSON})
 TIME_KINDS = frozenset({TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIMESTAMP})
+
+# collations with case-insensitive equality (reference:
+# util/collate/collate.go:62 — the general_ci/unicode_ci family)
+_CI_SUFFIXES = ("_ci", "_ai_ci")
 
 
 @dataclass(frozen=True)
 class FieldType:
     kind: TypeKind
-    # DECIMAL precision/scale; flen doubles as CHAR/VARCHAR length.
+    # DECIMAL precision/scale; flen doubles as CHAR/VARCHAR length and
+    # BIT width.
     flen: int = -1
     scale: int = 0
     nullable: bool = True
+    # ENUM/SET element labels in definition order
+    elems: tuple = ()
+    # '' = binary collation (code-space compares); *_ci = case-insensitive
+    collate: str = ""
+
+    @property
+    def is_ci(self) -> bool:
+        return self.collate.endswith(_CI_SUFFIXES)
 
     # ---- classification ----------------------------------------------------
     @property
@@ -104,6 +126,8 @@ class FieldType:
             return np.dtype(np.int64)
         if self.is_string:
             return np.dtype(np.int32)  # dictionary code
+        if self.kind == TypeKind.SET:
+            return np.dtype(np.int64)  # element bitmask
         if self.kind == TypeKind.NULL:
             return np.dtype(np.int64)
         raise TypeError(f"no physical dtype for {self.kind!r}")
@@ -117,6 +141,10 @@ class FieldType:
         name = self.kind.name.lower()
         if self.is_decimal:
             return f"{name}({self.flen},{self.scale})"
+        if self.kind in (TypeKind.ENUM, TypeKind.SET):
+            return f"{name}({','.join(repr(e) for e in self.elems)})"
+        if self.kind == TypeKind.BIT and self.flen >= 0:
+            return f"{name}({self.flen})"
         if self.is_string and self.flen >= 0:
             return f"{name}({self.flen})"
         return name
