@@ -1,0 +1,149 @@
+"""Config system: TOML load, strict validation, flag override, hot reload.
+
+Counterpart of the reference's config tests (reference:
+config/config_test.go strict-decode cases; tidb-server/main.go:408
+flag precedence; :369 reloadable subset)."""
+
+from __future__ import annotations
+
+import pytest
+
+from tidb_tpu.config import Config, ConfigError, EXAMPLE
+from tidb_tpu.server.__main__ import build_parser, resolve_config
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "cfg.toml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_defaults_and_example_roundtrip(tmp_path):
+    cfg = Config()
+    cfg.validate()
+    loaded = Config.load(_write(tmp_path, EXAMPLE))
+    loaded.validate()
+    assert loaded == cfg  # example documents the defaults exactly
+
+
+def test_load_sections(tmp_path):
+    path = _write(tmp_path, """
+port = 4444
+path = "/tmp/x"
+[log]
+slow-threshold = 50
+level = "warn"
+[gc]
+life-time = "1h"
+[plan-cache]
+enabled = false
+""")
+    cfg = Config.load(path)
+    assert cfg.port == 4444 and cfg.path == "/tmp/x"
+    assert cfg.log.slow_threshold == 50 and cfg.log.level == "warn"
+    assert cfg.gc.life_time == "1h"
+    assert cfg.plan_cache.enabled is False
+
+
+def test_strict_unknown_key(tmp_path):
+    with pytest.raises(ConfigError, match="unknown config key"):
+        Config.load(_write(tmp_path, "prot = 4000\n"))
+    with pytest.raises(ConfigError, match="unknown config key 'log.lvl'"):
+        Config.load(_write(tmp_path, "[log]\nlvl = 'info'\n"))
+
+
+def test_type_mismatch(tmp_path):
+    with pytest.raises(ConfigError, match="expects an integer"):
+        Config.load(_write(tmp_path, "port = 'x'\n"))
+    with pytest.raises(ConfigError, match="expects a boolean"):
+        Config.load(_write(tmp_path,
+                           "[plan-cache]\nenabled = 'yes'\n"))
+
+
+def test_validation():
+    cfg = Config()
+    cfg.port = 99999
+    with pytest.raises(ConfigError, match="out of range"):
+        cfg.validate()
+    cfg = Config()
+    cfg.log.level = "loud"
+    with pytest.raises(ConfigError, match="log level"):
+        cfg.validate()
+
+
+def test_flag_precedence(tmp_path):
+    path = _write(tmp_path, "port = 4444\n[log]\nslow-threshold = 50\n")
+    args = build_parser().parse_args(
+        ["--config", path, "-P", "5555", "--gc-life-time", "30m"])
+    cfg = resolve_config(args)
+    assert cfg.port == 5555           # flag beats file
+    assert cfg.log.slow_threshold == 50  # file beats default
+    assert cfg.gc.life_time == "30m"
+
+
+def test_hot_reload_subset(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("port = 4444\n[log]\nslow-threshold = 100\n")
+    cfg = Config.load(str(p))
+    p.write_text("port = 9999\n[log]\nslow-threshold = 250\n"
+                 "[gc]\nlife-time = '20m'\n")
+    applied = cfg.hot_reload(str(p))
+    assert "log.slow_threshold" in applied
+    assert "gc.life_time" in applied
+    assert cfg.log.slow_threshold == 250
+    assert cfg.gc.life_time == "20m"
+    assert cfg.port == 4444  # port is NOT reloadable
+
+
+def test_seed_sysvars():
+    from tidb_tpu.store.storage import Storage
+
+    cfg = Config()
+    cfg.log.slow_threshold = 123
+    cfg.performance.mem_quota_query = 777
+    cfg.plan_cache.enabled = False
+    storage = Storage()
+    cfg.seed_sysvars(storage)
+    assert storage.sysvars.get_global("tidb_slow_log_threshold") == 123
+    assert storage.sysvars.get_global("tidb_mem_quota_query") == 777
+    assert storage.sysvars.get_global("tidb_enable_plan_cache") == 0
+    # a user SET GLOBAL survives re-seeding (config provides defaults,
+    # not overrides)
+    storage.sysvars.set_global("tidb_slow_log_threshold", 999)
+    cfg.seed_sysvars(storage)
+    assert storage.sysvars.get_global("tidb_slow_log_threshold") == 999
+
+
+def test_malformed_toml(tmp_path):
+    with pytest.raises(ConfigError, match="malformed TOML"):
+        Config.load(_write(tmp_path, 'port = "unclosed\n'))
+
+
+def test_bool_flag_spellings():
+    p = build_parser()
+    assert p.parse_args(["--plan-cache", "0"]).plan_cache is False
+    assert p.parse_args(["--plan-cache", "False"]).plan_cache is False
+    assert p.parse_args(["--report-status", "on"]).report_status is True
+    with pytest.raises(SystemExit):
+        p.parse_args(["--plan-cache", "maybe"])
+
+
+def test_hot_reload_respects_cli_pins(tmp_path):
+    p = tmp_path / "cfg.toml"
+    p.write_text("[log]\nslow-threshold = 300\n")
+    args = build_parser().parse_args(
+        ["--config", str(p), "--log-slow-threshold", "100"])
+    cfg = resolve_config(args)
+    assert cfg.log.slow_threshold == 100
+    # SIGHUP with an unchanged file must not revert the CLI override
+    applied = cfg.hot_reload(str(p))
+    assert applied == []
+    assert cfg.log.slow_threshold == 100
+
+
+def test_print_example_config(capsys):
+    from tidb_tpu.server.__main__ import main
+
+    assert main(["--print-example-config"]) == 0
+    out = capsys.readouterr().out
+    assert "[performance]" in out and "status-port" in out

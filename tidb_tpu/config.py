@@ -1,0 +1,247 @@
+"""Server configuration: TOML file + CLI flags + hot-reloadable subset.
+
+Counterpart of the reference's config system (reference:
+config/config.go:94 — the Config struct with ~20 TOML sections,
+strict-decode validation; tidb-server/main.go:168 file load, :408
+flag overrides, :369 hot reload of the reloadable subset;
+config.toml.example documents every knob).
+
+Precedence matches the reference: defaults < config file < CLI flags.
+Unknown keys in the file are an error (strict decode) so typos fail
+loudly at startup instead of silently running with defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"
+    slow_threshold: int = 300        # ms (reference: log.slow-threshold)
+    slow_query_file: str = ""
+    format: str = "text"
+
+
+@dataclass
+class StatusConfig:
+    report_status: bool = True
+    status_host: str = "0.0.0.0"
+    status_port: int = 10080
+    metrics_interval: int = 15
+
+
+@dataclass
+class PerformanceConfig:
+    max_procs: int = 0
+    server_memory_quota: int = 0          # bytes; 0 = unlimited
+    mem_quota_query: int = 1 << 30        # per-query default
+    txn_total_size_limit: int = 100 * 1024 * 1024
+    stats_lease: str = "3s"
+    tile_rows: int = 1 << 22              # device tile granularity
+
+
+@dataclass
+class PlanCacheConfig:
+    enabled: bool = True
+    capacity: int = 128
+
+
+@dataclass
+class GCConfig:
+    life_time: str = "10m0s"
+    run_interval: str = "10m0s"
+
+
+@dataclass
+class SecurityConfig:
+    skip_grant_table: bool = False
+    ssl_ca: str = ""
+    ssl_cert: str = ""
+    ssl_key: str = ""
+
+
+@dataclass
+class Config:
+    host: str = "0.0.0.0"
+    port: int = 4000
+    path: str = ""                   # durable storage dir; '' = in-memory
+    socket: str = ""
+    max_connections: int = 512
+    default_db: str = "test"
+    lease: str = "45s"               # schema lease (reference: --lease)
+    log: LogConfig = field(default_factory=LogConfig)
+    status: StatusConfig = field(default_factory=StatusConfig)
+    performance: PerformanceConfig = field(default_factory=PerformanceConfig)
+    plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
+    gc: GCConfig = field(default_factory=GCConfig)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
+    # dotted names pinned by CLI flags: hot reload must not revert them
+    # (defaults < file < flags precedence; reference: main.go:408)
+    cli_overrides: set = field(default_factory=set, compare=False,
+                               repr=False)
+
+    # ---- loading -------------------------------------------------------
+    @staticmethod
+    def load(path: str) -> "Config":
+        """Strict TOML decode (reference: config.go strict check — an
+        undecoded key is an error)."""
+        import tomllib
+
+        try:
+            with open(path, "rb") as f:
+                raw = tomllib.load(f)
+        except tomllib.TOMLDecodeError as e:
+            raise ConfigError(f"malformed TOML in {path}: {e}") from None
+        cfg = Config()
+        cfg.apply(raw)
+        return cfg
+
+    def apply(self, raw: dict) -> None:
+        _apply_section(self, raw, "")
+
+    # ---- validation ----------------------------------------------------
+    def validate(self) -> None:
+        if not 0 <= self.port <= 65535:
+            raise ConfigError(f"port {self.port} out of range")
+        if not 0 <= self.status.status_port <= 65535:
+            raise ConfigError(
+                f"status-port {self.status.status_port} out of range")
+        if self.max_connections < 1:
+            raise ConfigError("max-connections must be >= 1")
+        if self.log.level not in ("debug", "info", "warn", "error"):
+            raise ConfigError(f"unknown log level {self.log.level!r}")
+        if self.performance.mem_quota_query < 0:
+            raise ConfigError("mem-quota-query must be >= 0")
+
+    # ---- hot reload ----------------------------------------------------
+    # keys that may change at runtime (reference: the hot-reloadable
+    # subset, tidb-server/main.go:369 ReloadGlobalConfig)
+    RELOADABLE = frozenset({
+        "log.slow_threshold", "log.level",
+        "gc.life_time", "gc.run_interval",
+        "performance.mem_quota_query",
+        "plan_cache.enabled",
+    })
+
+    def hot_reload(self, path: str) -> list[str]:
+        """Re-read the file, apply ONLY reloadable keys not pinned by a
+        CLI flag; returns the dotted names applied. Non-reloadable
+        changes are ignored (the reference logs and skips them the same
+        way, main.go:369)."""
+        fresh = Config.load(path)
+        fresh.validate()
+        applied = []
+        for dotted in sorted(self.RELOADABLE - self.cli_overrides):
+            section, _, leaf = dotted.partition(".")
+            src = getattr(fresh, section)
+            dst = getattr(self, section)
+            if getattr(dst, leaf) != getattr(src, leaf):
+                setattr(dst, leaf, getattr(src, leaf))
+                applied.append(dotted)
+        return applied
+
+    # ---- sysvar seeding ------------------------------------------------
+    def seed_sysvars(self, storage) -> None:
+        """Push config-derived values into the sysvar plane as DEFAULTS:
+        they beat the registry defaults but never override values a user
+        persisted via SET GLOBAL (reference: config feeds sysvar
+        bootstrap values without rewriting mysql.global_variables)."""
+        sv = storage.sysvars
+        sv.set_config_default("tidb_slow_log_threshold",
+                              self.log.slow_threshold)
+        sv.set_config_default("tidb_mem_quota_query",
+                              self.performance.mem_quota_query)
+        sv.set_config_default("tidb_enable_plan_cache",
+                              1 if self.plan_cache.enabled else 0)
+        sv.set_config_default("tidb_gc_life_time", self.gc.life_time)
+        sv.set_config_default("tidb_gc_run_interval",
+                              self.gc.run_interval)
+        sv.set_config_default("tidb_tile_rows", self.performance.tile_rows)
+        sv.set_config_default("max_connections", self.max_connections)
+
+
+def _apply_section(obj, raw: dict, prefix: str) -> None:
+    fields = {f.name: f for f in dataclasses.fields(obj)}
+    for key, value in raw.items():
+        norm = key.replace("-", "_")
+        f = fields.get(norm)
+        if f is None:
+            raise ConfigError(
+                f"unknown config key {prefix + key!r}")
+        current = getattr(obj, norm)
+        if dataclasses.is_dataclass(current):
+            if not isinstance(value, dict):
+                raise ConfigError(
+                    f"config section {prefix + key!r} must be a table")
+            _apply_section(current, value, prefix + key + ".")
+        else:
+            if isinstance(current, bool) and not isinstance(value, bool):
+                raise ConfigError(
+                    f"config key {prefix + key!r} expects a boolean")
+            if isinstance(current, int) and not isinstance(current, bool) \
+                    and not isinstance(value, int):
+                raise ConfigError(
+                    f"config key {prefix + key!r} expects an integer")
+            if isinstance(current, str) and not isinstance(value, str):
+                raise ConfigError(
+                    f"config key {prefix + key!r} expects a string")
+            setattr(obj, norm, value)
+
+
+EXAMPLE = """\
+# tidb-tpu-server configuration (reference: config.toml.example)
+# Every key is optional; values below are the defaults.
+
+host = "0.0.0.0"
+port = 4000
+# durable storage directory; empty = in-memory store
+path = ""
+max-connections = 512
+default-db = "test"
+# schema lease (informational; single-process DDL applies instantly)
+lease = "45s"
+
+[log]
+level = "info"                 # debug | info | warn | error
+slow-threshold = 300           # ms; statements slower than this are logged
+slow-query-file = ""
+format = "text"
+
+[status]
+report-status = true           # expose /status /metrics /slow-query
+status-host = "0.0.0.0"
+status-port = 10080
+metrics-interval = 15
+
+[performance]
+server-memory-quota = 0        # bytes; 0 = unlimited
+mem-quota-query = 1073741824   # per-query working-set budget (bytes)
+txn-total-size-limit = 104857600
+stats-lease = "3s"
+tile-rows = 4194304            # device tile granularity (rows)
+
+[plan-cache]
+enabled = true
+capacity = 128
+
+[gc]
+life-time = "10m0s"            # versions younger than this survive GC
+run-interval = "10m0s"         # background maintenance cadence
+
+[security]
+skip-grant-table = false
+ssl-ca = ""
+ssl-cert = ""
+ssl-key = ""
+"""
+
+
+__all__ = ["Config", "ConfigError", "EXAMPLE"]

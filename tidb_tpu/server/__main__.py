@@ -1,8 +1,10 @@
 """Process entry point: `python -m tidb_tpu.server [flags]`.
 
 Counterpart of the reference's tidb-server binary (reference:
-tidb-server/main.go:160 — flag parsing :76-151, store+domain creation :263,
-signal handling + graceful shutdown :652,703).
+tidb-server/main.go:160 — flag parsing :76-151, config load + flag
+override :168,408, store+domain creation :263, signal handling +
+graceful shutdown :652,703; SIGHUP-style hot reload of the reloadable
+config subset :369).
 """
 
 from __future__ import annotations
@@ -12,33 +14,124 @@ import signal
 import sys
 import threading
 
+from ..config import Config, ConfigError
 from ..store.storage import Storage
 from .server import Server
 
 
-def main(argv: list[str] | None = None) -> int:
+def _parse_bool(v: str) -> bool:
+    """strconv.ParseBool spellings (reference: flagBoolean)."""
+    lv = v.strip().lower()
+    if lv in ("1", "t", "true", "on", "yes"):
+        return True
+    if lv in ("0", "f", "false", "off", "no"):
+        return False
+    raise argparse.ArgumentTypeError(f"invalid boolean value {v!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tidb-tpu-server",
         description="TPU-native MySQL-compatible SQL server")
-    p.add_argument("-host", default="0.0.0.0", help="listen address")
-    p.add_argument("-P", "--port", type=int, default=4000,
+    p.add_argument("--config", default=None, help="TOML config file")
+    p.add_argument("--print-example-config", action="store_true",
+                   help="print the example config and exit")
+    p.add_argument("-host", "--host", default=None, help="listen address")
+    p.add_argument("-P", "--port", type=int, default=None,
                    help="MySQL protocol port")
-    p.add_argument("--default-db", default="test")
-    p.add_argument("--max-connections", type=int, default=512)
     p.add_argument("--path", default=None,
                    help="durable storage directory (default: in-memory)")
-    args = p.parse_args(argv)
+    p.add_argument("--socket", default=None, help="unix socket (unused)")
+    p.add_argument("--default-db", default=None)
+    p.add_argument("--max-connections", type=int, default=None)
+    p.add_argument("--lease", default=None, help="schema lease")
+    p.add_argument("-L", "--log-level", default=None,
+                   choices=["debug", "info", "warn", "error"])
+    p.add_argument("--log-slow-threshold", type=int, default=None,
+                   help="slow-log threshold (ms)")
+    p.add_argument("--report-status", type=_parse_bool,
+                   default=None, help="expose the HTTP status port")
+    p.add_argument("--status-host", default=None)
+    p.add_argument("--status", "--status-port", dest="status_port",
+                   type=int, default=None, help="HTTP status port")
+    p.add_argument("--mem-quota-query", type=int, default=None,
+                   help="per-query memory budget (bytes)")
+    p.add_argument("--gc-life-time", default=None)
+    p.add_argument("--gc-run-interval", default=None)
+    p.add_argument("--plan-cache", type=_parse_bool, default=None)
+    p.add_argument("--tile-rows", type=int, default=None,
+                   help="device tile granularity (rows)")
+    p.add_argument("--skip-grant-table", action="store_true",
+                   default=None)
+    return p
 
-    storage = Storage(args.path)
-    srv = Server(storage, host=args.host, port=args.port,
-                 default_db=args.default_db,
-                 max_connections=args.max_connections)
+
+def resolve_config(args) -> Config:
+    """defaults < config file < CLI flags (reference: main.go:408)."""
+    cfg = Config.load(args.config) if args.config else Config()
+    flag_map = [
+        ("host", cfg, "host"), ("port", cfg, "port"),
+        ("path", cfg, "path"), ("socket", cfg, "socket"),
+        ("default_db", cfg, "default_db"),
+        ("max_connections", cfg, "max_connections"),
+        ("lease", cfg, "lease"),
+        ("log_level", cfg.log, "level"),
+        ("log_slow_threshold", cfg.log, "slow_threshold"),
+        ("report_status", cfg.status, "report_status"),
+        ("status_host", cfg.status, "status_host"),
+        ("status_port", cfg.status, "status_port"),
+        ("mem_quota_query", cfg.performance, "mem_quota_query"),
+        ("tile_rows", cfg.performance, "tile_rows"),
+        ("gc_life_time", cfg.gc, "life_time"),
+        ("gc_run_interval", cfg.gc, "run_interval"),
+        ("plan_cache", cfg.plan_cache, "enabled"),
+        ("skip_grant_table", cfg.security, "skip_grant_table"),
+    ]
+    dotted = {
+        "log_slow_threshold": "log.slow_threshold",
+        "log_level": "log.level",
+        "gc_life_time": "gc.life_time",
+        "gc_run_interval": "gc.run_interval",
+        "mem_quota_query": "performance.mem_quota_query",
+        "plan_cache": "plan_cache.enabled",
+    }
+    for flag, obj, attr in flag_map:
+        v = getattr(args, flag, None)
+        if v is not None:
+            setattr(obj, attr, v)
+            if flag in dotted:
+                cfg.cli_overrides.add(dotted[flag])
+    cfg.validate()
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.print_example_config:
+        from ..config import EXAMPLE
+        print(EXAMPLE, end="")
+        return 0
+    try:
+        cfg = resolve_config(args)
+    except (ConfigError, OSError) as e:
+        print(f"invalid configuration: {e}", file=sys.stderr)
+        return 1
+
+    storage = Storage(cfg.path or None)
+    cfg.seed_sysvars(storage)
+    srv = Server(storage, host=cfg.host, port=cfg.port,
+                 default_db=cfg.default_db,
+                 max_connections=cfg.max_connections,
+                 status_port=(cfg.status.status_port
+                              if cfg.status.report_status else None),
+                 status_host=cfg.status.status_host,
+                 skip_grant_table=cfg.security.skip_grant_table)
     srv.start()
     # background GC / lock-TTL / auto-analyze / checkpoint loop; the
     # interval re-reads tidb_gc_run_interval every cycle (reference:
     # gcworker started with the store, gc_worker.go:95)
     storage.maintenance.start()
-    print(f"tidb-tpu-server listening on {args.host}:{srv.port}",
+    print(f"tidb-tpu-server listening on {cfg.host}:{srv.port}",
           flush=True)
 
     done = threading.Event()
@@ -47,8 +140,21 @@ def main(argv: list[str] | None = None) -> int:
         print("shutting down...", flush=True)
         done.set()
 
+    def _reload(signum, frame):  # noqa: ARG001
+        if not args.config:
+            return
+        try:
+            applied = cfg.hot_reload(args.config)
+            cfg.seed_sysvars(storage)
+            print(f"config reloaded: {applied or 'no reloadable changes'}",
+                  flush=True)
+        except (ConfigError, OSError) as e:
+            print(f"config reload failed: {e}", flush=True)
+
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, _reload)
     done.wait()
     srv.close()
     storage.close()  # stops maintenance; checkpoints durable stores

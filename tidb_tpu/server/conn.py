@@ -107,6 +107,10 @@ class ClientConn:
         via CREATE USER verify against their stored double-SHA1 and get
         their grants enforced per statement (reference:
         privilege/privileges/privileges.go auth + cache)."""
+        if getattr(self.server, "skip_grant_table", False):
+            # --skip-grant-table: accept anyone as an unchecked internal
+            # session (reference: privileges.SkipWithGrant)
+            return True
         pwd = self.server.users.get(user)
         if pwd is not None:
             if pwd == "":

@@ -29,6 +29,8 @@ class Server:
         allow_unknown_users: bool = True,
         max_connections: int = 512,
         status_port: Optional[int] = None,
+        status_host: Optional[str] = None,
+        skip_grant_table: bool = False,
     ) -> None:
         self.storage = storage if storage is not None else Storage()
         self.host = host
@@ -45,9 +47,16 @@ class Server:
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         # HTTP status/metrics port (reference: server/http_status.go;
-        # port 10080 by default there — here opt-in via status_port)
+        # port 10080 by default there — here opt-in via status_port).
+        # status_host lets operators keep /metrics on loopback while SQL
+        # listens externally.
         self.status_port = status_port
+        self.status_host = status_host if status_host is not None else host
         self._status_server = None
+        # --skip-grant-table: every connection authenticates as an
+        # all-privilege session regardless of credentials (reference:
+        # privileges.SkipWithGrant; the account-lockout escape hatch)
+        self.skip_grant_table = skip_grant_table
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -64,7 +73,8 @@ class Server:
         self._accept_thread.start()
         if self.status_port is not None:
             from .status import StatusServer
-            self._status_server = StatusServer(self.host, self.status_port,
+            self._status_server = StatusServer(self.status_host,
+                                               self.status_port,
                                                sql_server=self)
             self._status_server.start()
             self.status_port = self._status_server.port

@@ -124,6 +124,10 @@ class SysVarManager:
     def __init__(self, storage) -> None:
         self._storage = storage
         self._globals: dict[str, Any] = {}
+        # config-derived defaults: consulted after user SET GLOBALs but
+        # before the registry defaults; never persisted (the config file
+        # is their durable form)
+        self._config_defaults: dict[str, Any] = {}
         self._loaded = False
 
     def _load(self) -> None:
@@ -145,6 +149,8 @@ class SysVarManager:
         self._load()
         if name in self._globals:  # includes tolerated unknown knobs
             return self._globals[name]
+        if name in self._config_defaults:
+            return self._config_defaults[name]
         v = SYSVARS.get(name)
         return v.default if v is not None else None
 
@@ -154,7 +160,14 @@ class SysVarManager:
         self._storage.put_meta(_META_PREFIX + name.encode(),
                                str(value).encode("utf-8"))
 
+    def set_config_default(self, name: str, value: Any) -> None:
+        """Config-file seeding: wins over registry defaults, loses to
+        any persisted/user SET GLOBAL (reference: config feeds sysvar
+        bootstrap values without overriding mysql.global_variables)."""
+        self._config_defaults[name] = value
+
     def all_globals(self) -> dict[str, Any]:
         self._load()
-        return {name: self._globals.get(name, v.default)
+        return {name: self._globals.get(
+                    name, self._config_defaults.get(name, v.default))
                 for name, v in SYSVARS.items()}
