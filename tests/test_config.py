@@ -141,6 +141,21 @@ def test_hot_reload_respects_cli_pins(tmp_path):
     assert cfg.log.slow_threshold == 100
 
 
+def test_example_file_in_sync():
+    """config.toml.example must stay byte-identical to the EXAMPLE the
+    binary prints (single source of truth, enforced here)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "config.toml.example")
+    assert open(path).read() == EXAMPLE
+
+
+def test_bool_literal_rejected_for_int_key(tmp_path):
+    with pytest.raises(ConfigError, match="expects an integer"):
+        Config.load(_write(tmp_path, "port = true\n"))
+
+
 def test_print_example_config(capsys):
     from tidb_tpu.server.__main__ import main
 

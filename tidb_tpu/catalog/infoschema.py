@@ -78,6 +78,25 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("character_set_name", _vc(32)), ("default_collate_name", _vc(32)),
         ("description", _vc(64)), ("maxlen", _bigint()),
     ],
+    # aggregated statement digests (reference: util/stmtsummary feeding
+    # infoschema statements_summary, statement_summary.go)
+    "statements_summary": [
+        ("digest", _vc(32)), ("schema_name", _vc()),
+        ("digest_text", _vc(512)), ("query_sample_text", _vc(512)),
+        ("exec_count", _bigint()), ("sum_errors", _bigint()),
+        ("sum_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("sum_result_rows", _bigint()),
+        ("first_seen", _vc(20)), ("last_seen", _vc(20)),
+    ],
+    # the queryable slow log (reference: executor/slow_query.go parsing
+    # the slow-log file back into INFORMATION_SCHEMA.SLOW_QUERY)
+    "slow_query": [
+        ("time", _vc(20)), ("db", _vc()),
+        ("query_time_ms", FieldType(TypeKind.DOUBLE)),
+        ("query", _vc(4096)),
+    ],
 }
 
 
@@ -166,6 +185,19 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
         rows.append(["utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1])
     elif tname == "character_sets":
         rows.append(["utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4])
+    elif tname == "statements_summary":
+        for e in sorted(storage.obs.statements.snapshot(),
+                        key=lambda e: -e["sum_latency_ms"]):
+            rows.append([
+                e["digest"], e["schema_name"], e["digest_text"],
+                e["sample_text"], e["exec_count"], e["errors"],
+                round(e["sum_latency_ms"], 3),
+                round(e["sum_latency_ms"] / max(e["exec_count"], 1), 3),
+                round(e["max_latency_ms"], 3), e["sum_rows"],
+                e["first_seen"], e["last_seen"]])
+    elif tname == "slow_query":
+        for e in storage.obs.slow_queries():
+            rows.append([e["ts"], e["db"], e["duration_ms"], e["sql"]])
     return rows
 
 

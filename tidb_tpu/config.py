@@ -148,6 +148,16 @@ class Config:
                 applied.append(dotted)
         return applied
 
+    def apply_log_level(self) -> None:
+        """Point the package loggers at the configured level (startup +
+        hot reload both call this; reference: logutil.InitLogger)."""
+        import logging
+
+        level = {"debug": logging.DEBUG, "info": logging.INFO,
+                 "warn": logging.WARNING, "error": logging.ERROR}[
+                     self.log.level]
+        logging.getLogger("tidb_tpu").setLevel(level)
+
     # ---- sysvar seeding ------------------------------------------------
     def seed_sysvars(self, storage) -> None:
         """Push config-derived values into the sysvar plane as DEFAULTS:
@@ -187,7 +197,9 @@ def _apply_section(obj, raw: dict, prefix: str) -> None:
                 raise ConfigError(
                     f"config key {prefix + key!r} expects a boolean")
             if isinstance(current, int) and not isinstance(current, bool) \
-                    and not isinstance(value, int):
+                    and (not isinstance(value, int)
+                         or isinstance(value, bool)):
+                # bool is an int subclass: `port = true` must still fail
                 raise ConfigError(
                     f"config key {prefix + key!r} expects an integer")
             if isinstance(current, str) and not isinstance(value, str):

@@ -117,6 +117,7 @@ def main(argv: list[str] | None = None) -> int:
         print(f"invalid configuration: {e}", file=sys.stderr)
         return 1
 
+    cfg.apply_log_level()
     storage = Storage(cfg.path or None)
     cfg.seed_sysvars(storage)
     srv = Server(storage, host=cfg.host, port=cfg.port,
@@ -146,6 +147,7 @@ def main(argv: list[str] | None = None) -> int:
         try:
             applied = cfg.hot_reload(args.config)
             cfg.seed_sysvars(storage)
+            cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",
                   flush=True)
         except (ConfigError, OSError) as e:

@@ -93,8 +93,7 @@ class Server:
                 conn_id = self._next_conn_id
                 self._next_conn_id += 1
                 conn = ClientConn(self, sock, conn_id)
-                from .. import obs
-                obs.CONNECTIONS.inc()
+                self.storage.obs.connections.inc()
                 self._conns[conn_id] = conn
             t = threading.Thread(target=conn.run,
                                  name=f"conn-{conn_id}", daemon=True)

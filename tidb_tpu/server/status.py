@@ -26,8 +26,13 @@ class StatusServer:
                 pass
 
             def do_GET(self):
+                server_obs = (outer.sql_server.storage.obs
+                              if outer.sql_server else obs.DEFAULT)
                 if self.path == "/metrics":
-                    body = obs.METRICS.render().encode()
+                    # this server's registry + the process-wide one
+                    # (disjoint families: copr/device counters only)
+                    body = (server_obs.render()
+                            + obs.PROCESS_METRICS.render()).encode()
                     ctype = "text/plain; version=0.0.4"
                 elif self.path == "/status":
                     from . import conn as _conn
@@ -38,7 +43,11 @@ class StatusServer:
                     }).encode()
                     ctype = "application/json"
                 elif self.path == "/slow-query":
-                    body = json.dumps(obs.slow_queries()).encode()
+                    body = json.dumps(server_obs.slow_queries()).encode()
+                    ctype = "application/json"
+                elif self.path == "/statements-summary":
+                    body = json.dumps(
+                        server_obs.statements.snapshot()).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -83,23 +83,27 @@ class Session:
     def execute(self, sql: str) -> ResultSet:
         """Execute one or more ;-separated statements; returns the last
         statement's result."""
-        from .. import obs
-
         try:
             stmts = parse_sql(sql)
         except ParseError as e:
-            obs.QUERY_ERRORS.inc()
+            self.storage.obs.query_errors.inc()
             raise SQLError(f"parse error: {e}") from None
         result = ResultSet([], [])
+        single = len(stmts) == 1
         for i, stmt in enumerate(stmts):
-            label = sql if len(stmts) == 1 else \
+            label = sql if single else \
                 f"[stmt {i + 1}/{len(stmts)}] {sql}"
             # single-statement SELECT text is the plan-cache key
             self._plan_cache_key = sql if (
-                len(stmts) == 1 and isinstance(
+                single and isinstance(
                     stmt, (ast.SelectStmt, ast.SetOpStmt))) else None
             try:
-                result = self._execute_observed(stmt, label)
+                # batch members skip digest recording: per-statement text
+                # isn't recoverable from the batch label, and raw batch
+                # text would flood the digest table with unnormalizable
+                # entries
+                result = self._execute_observed(
+                    stmt, label, digest_sql=sql if single else None)
             finally:
                 self._plan_cache_key = None
         # delta-driven auto-analyze at statement boundaries (the reference
@@ -110,32 +114,42 @@ class Session:
             self.storage.stats.auto_analyze(self.storage, self.catalog)
         return result
 
-    def _execute_observed(self, stmt: ast.Stmt, sql: str) -> ResultSet:
-        """Run one statement with metrics + slow-log accounting — shared by
-        the text protocol and COM_STMT_EXECUTE (reference: both paths pass
-        through ExecStmt in executor/adapter.go)."""
+    def _execute_observed(self, stmt: ast.Stmt, sql: str,
+                          digest_sql: Optional[str] = None) -> ResultSet:
+        """Run one statement with metrics + slow-log + statement-digest
+        accounting — shared by the text protocol and COM_STMT_EXECUTE
+        (reference: both paths pass through ExecStmt in
+        executor/adapter.go; digests feed util/stmtsummary)."""
         import time as _time
 
-        from .. import obs
+        from ..obs import DEFAULT_SLOW_THRESHOLD_MS
 
+        o = self.storage.obs
         t0 = _time.perf_counter()
-        obs.QUERIES.inc(type=type(stmt).__name__.removesuffix("Stmt"))
+        o.queries.inc(type=type(stmt).__name__.removesuffix("Stmt"))
+        failed = False
+        rows_out = 0
         try:
-            return self._execute_stmt(stmt)
+            rs = self._execute_stmt(stmt)
+            rows_out = len(rs.rows)
+            return rs
         except Exception:
-            obs.QUERY_ERRORS.inc()
+            failed = True
+            o.query_errors.inc()
             raise
         finally:
             dt = _time.perf_counter() - t0
-            obs.QUERY_SECONDS.observe(dt)
-            thresh = self.vars.get("tidb_slow_log_threshold",
-                                   obs.DEFAULT_SLOW_THRESHOLD_MS)
+            o.query_seconds.observe(dt)
+            if digest_sql is not None:
+                o.statements.record(digest_sql, self.current_db, dt,
+                                    rows_out, failed)
             try:
-                thresh = float(thresh)
-            except (TypeError, ValueError):
-                thresh = obs.DEFAULT_SLOW_THRESHOLD_MS
+                thresh = float(
+                    self._sysvar_value("tidb_slow_log_threshold"))
+            except (TypeError, ValueError, SQLError):
+                thresh = DEFAULT_SLOW_THRESHOLD_MS
             if dt * 1e3 >= thresh:
-                obs.record_slow(sql, self.current_db, dt)
+                o.record_slow(sql, self.current_db, dt)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -271,6 +285,8 @@ class Session:
             return ResultSet([], [])
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, ast.TraceStmt):
+            return self._exec_trace(stmt)
         if isinstance(stmt, ast.ShowStmt):
             return self._exec_show(stmt)
         if isinstance(stmt, ast.SetStmt):
@@ -472,6 +488,10 @@ class Session:
                 f"{priv} command denied to user '{self.user}' "
                 f"for table '{obj}'")
 
+        if isinstance(stmt, ast.TraceStmt):
+            # TRACE runs the target for real: same checks as running it
+            self._check_privileges(stmt.target)
+            return
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt,
                              ast.ExplainStmt, ast.AnalyzeTableStmt)):
             for tn in self._collect_table_names(stmt):
@@ -1219,6 +1239,55 @@ class Session:
                              st["engine"] or ""))
         return ResultSet(["plan", "actRows", "time_ms", "engine"], rows)
 
+    def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
+        """TRACE <select>: execute with span accounting and return the
+        span tree (reference: executor/trace.go rendering the collected
+        spans; per-operator rows come from the same runtime-stats
+        collector EXPLAIN ANALYZE uses)."""
+        import time as _time
+
+        from .. import obs
+        from ..plan.physical import explain_nodes
+
+        target = stmt.target
+        if not isinstance(target, (ast.SelectStmt, ast.SetOpStmt)):
+            raise SQLError("TRACE supports SELECT statements only")
+        spans: list[tuple[str, float, float]] = []
+        t_begin = _time.perf_counter()
+
+        def mark(name: str, t0: float) -> None:
+            spans.append((name, (t0 - t_begin) * 1e3,
+                          (_time.perf_counter() - t0) * 1e3))
+
+        t0 = _time.perf_counter()
+        target = self._maybe_bind_vars(target)
+        self._refresh_infoschema(target)
+        mark("session.prepare", t0)
+        t0 = _time.perf_counter()
+        plan = self._plan(target)
+        mark("planner.optimize", t0)
+        coll = obs.RuntimeStatsColl()
+        t0 = _time.perf_counter()
+
+        def run():
+            ctx = self._exec_ctx(stats=coll)
+            try:
+                return run_physical(plan, ctx)
+            finally:
+                ctx.close()
+
+        self._run_in_txn(run)
+        mark("executor.run", t0)
+        rows: list[tuple] = [
+            (name, round(start, 3), round(dur, 3))
+            for name, start, dur in spans
+        ]
+        for node, line in explain_nodes(plan):
+            st = coll.for_plan(node)
+            dur = round(st["time"] * 1e3, 3) if st else None
+            rows.append((f"  {line}", None, dur))
+        return ResultSet(["operation", "start_ms", "duration_ms"], rows)
+
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
         if stmt.kind == "TABLES":
             schema = self.catalog.schema(self.current_db)
@@ -1307,14 +1376,16 @@ class Session:
                  "Packed", "Null", "Index_type", "Comment",
                  "Index_comment"], rows)
         if stmt.kind == "SLOW":
-            from .. import obs
             rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"])
-                    for e in obs.slow_queries()]
+                    for e in self.storage.obs.slow_queries()]
             return ResultSet(["Time", "DB", "Duration_ms", "Query"], rows)
         if stmt.kind == "METRICS":
             from .. import obs
             rows = []
-            for line in obs.METRICS.render().splitlines():
+            # this server's registry plus the process-wide one (copr);
+            # the two registries hold disjoint metric families
+            text = self.storage.obs.render() + obs.PROCESS_METRICS.render()
+            for line in text.splitlines():
                 if line.startswith("#") or not line.strip():
                     continue
                 name, _, val = line.rpartition(" ")

@@ -425,6 +425,14 @@ class ExplainStmt(Stmt):
 
 
 @dataclass
+class TraceStmt(Stmt):
+    """TRACE <stmt>: runs the statement, returns the span tree
+    (reference: executor/trace.go)."""
+
+    target: Stmt
+
+
+@dataclass
 class ShowStmt(Stmt):
     kind: str  # 'TABLES' | 'DATABASES' | 'CREATE_TABLE' | 'VARIABLES' | ...
     target: Optional[TableName] = None

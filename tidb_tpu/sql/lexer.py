@@ -56,7 +56,7 @@ KEYWORDS = frozenset(
     CREATE TABLE DATABASE SCHEMA DROP ALTER ADD COLUMN INDEX KEY PRIMARY
     UNIQUE DEFAULT AUTO_INCREMENT IF EXISTS USE
     BEGIN START TRANSACTION COMMIT ROLLBACK PESSIMISTIC OPTIMISTIC
-    EXPLAIN ANALYZE SHOW TABLES DATABASES DESC DESCRIBE
+    EXPLAIN ANALYZE SHOW TABLES DATABASES DESC DESCRIBE TRACE
     ASC CASE WHEN THEN ELSE END CAST AS CONVERT
     INTERVAL DATE TIME TIMESTAMP DATETIME YEAR
     UNION EXCEPT INTERSECT

@@ -179,6 +179,9 @@ class Parser:
             return ast.RollbackStmt()
         if t.is_kw("EXPLAIN", "DESC", "DESCRIBE"):
             return self.parse_explain()
+        if t.is_kw("TRACE"):
+            self.advance()
+            return ast.TraceStmt(self.parse_statement())
         if t.is_kw("SHOW"):
             return self.parse_show()
         if t.is_kw("SET"):
@@ -1270,7 +1273,7 @@ _IDENT_KEYWORDS = frozenset(
     ADMIN DDL JOBS OVER PARTITION ROWS RANGE
     SCHEMAS WARNINGS ERRORS ENGINES COLLATION COLUMNS FIELDS INDEXES KEYS
     NAMES USER IDENTIFIED PRIVILEGES GRANTS PESSIMISTIC OPTIMISTIC
-    UNBOUNDED PRECEDING FOLLOWING CURRENT ROW
+    UNBOUNDED PRECEDING FOLLOWING CURRENT ROW TRACE
     """.split()
 )
 
