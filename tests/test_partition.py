@@ -1,0 +1,282 @@
+"""Partitioned tables: hash/range, pruning, DML routing, DDL, restart.
+
+Counterpart of the reference's partition machinery (reference:
+ddl/partition.go build+checks, table/tables/partition.go routing,
+planner/core/rule_partition_processor.go pruning)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+from testkit import TestKit
+
+
+def _hash_table(tk, n=40):
+    tk.must_exec("create table h (id int primary key, v int) "
+                 "partition by hash(id) partitions 4")
+    tk.must_exec("insert into h values " + ",".join(
+        f"({i},{i * 10})" for i in range(n)))
+
+
+def _range_table(tk):
+    tk.must_exec(
+        "create table r (d int, amt int) partition by range (d) ("
+        "partition p0 values less than (10), "
+        "partition p1 values less than (20), "
+        "partition pmax values less than maxvalue)")
+    tk.must_exec("insert into r values (1,1),(5,2),(12,3),(18,4),"
+                 "(25,5),(100,6)")
+
+
+def test_hash_partition_dml_roundtrip():
+    tk = TestKit()
+    _hash_table(tk)
+    tk.check("select count(*) from h", [(40,)])
+    tk.check("select v from h where id = 7", [(70,)])
+    tk.check("select id, v from h order by id limit 3",
+             [(0, 0), (1, 10), (2, 20)])
+    tk.must_exec("update h set v = v + 1 where id < 5")
+    tk.check("select sum(v) from h where id < 5", [(105,)])
+    tk.must_exec("delete from h where id >= 30")
+    tk.check("select count(*) from h", [(30,)])
+    # aggregate across all partitions
+    tk.check("select sum(v) from h",
+             [(sum(i * 10 for i in range(30)) + 5,)])
+
+
+def test_range_partition_pruning_plan():
+    tk = TestKit()
+    _range_table(tk)
+    plan = "\n".join(r[0] for r in tk.must_query(
+        "explain select sum(amt) from r where d < 10"))
+    assert plan.count("TableRead") == 1  # p1/pmax pruned
+    plan = "\n".join(r[0] for r in tk.must_query(
+        "explain select sum(amt) from r where d >= 12 and d < 20"))
+    assert plan.count("TableRead") == 1  # only p1
+    plan = "\n".join(r[0] for r in tk.must_query(
+        "explain select sum(amt) from r"))
+    assert plan.count("TableRead") == 3  # no bound: all partitions
+    tk.check("select sum(amt) from r where d < 10", [(3,)])
+    tk.check("select sum(amt) from r where d >= 12 and d < 20", [(7,)])
+
+
+def test_hash_partition_point_route():
+    tk = TestKit()
+    _hash_table(tk)
+    plan = "\n".join(r[0] for r in tk.must_query(
+        "explain select v from h where id = 7"))
+    assert plan.count("PointGet") + plan.count("TableRead") == 1
+    tk.check("select v from h where id in (3, 8)", [(30,), (80,)],
+             ordered=False)
+
+
+def test_partition_column_update_moves_row():
+    tk = TestKit()
+    _range_table(tk)
+    tk.must_exec("update r set d = 15 where d = 1")
+    tk.check("select sum(amt) from r where d >= 10 and d < 20", [(8,)])
+    tk.check("select count(*) from r where d < 10", [(1,)])
+    tk.check("select count(*) from r", [(6,)])
+
+
+def test_drop_and_truncate_partition():
+    tk = TestKit()
+    _range_table(tk)
+    tk.must_exec("alter table r drop partition p0")
+    tk.check("select count(*) from r", [(4,)])
+    tk.must_exec("alter table r truncate partition p1")
+    tk.check("select count(*) from r", [(2,)])
+    # hash partitions cannot be dropped
+    _hash_table(tk, 4)
+    with pytest.raises(Exception, match="RANGE"):
+        tk.must_exec("alter table h drop partition p0")
+
+
+def test_partition_information_schema():
+    tk = TestKit()
+    _range_table(tk)
+    rows = tk.must_query(
+        "select partition_name, partition_method, partition_description, "
+        "table_rows from information_schema.partitions "
+        "where table_name = 'r' order by partition_ordinal_position")
+    assert [r[0] for r in rows] == ["p0", "p1", "pmax"]
+    assert rows[0][1] == "RANGE" and rows[0][2] == "10"
+    assert rows[2][2] == "MAXVALUE"
+    assert sum(r[3] for r in rows) == 6
+
+
+def test_partition_constraints():
+    tk = TestKit()
+    with pytest.raises(Exception, match="UNIQUE INDEX must include"):
+        tk.must_exec("create table bad (a int, b int, unique key (b)) "
+                     "partition by hash(a) partitions 2")
+    with pytest.raises(Exception, match="PRIMARY KEY must include"):
+        tk.must_exec("create table bad2 (a int primary key, b int) "
+                     "partition by hash(b) partitions 2")
+    with pytest.raises(Exception, match="strictly increasing"):
+        tk.must_exec(
+            "create table bad3 (a int) partition by range (a) ("
+            "partition p0 values less than (10), "
+            "partition p1 values less than (5))")
+    # no partition for value
+    tk.must_exec("create table nr (a int) partition by range (a) ("
+                 "partition p0 values less than (10))")
+    with pytest.raises(Exception, match="no partition"):
+        tk.must_exec("insert into nr values (50)")
+
+
+def test_partition_duplicate_detection():
+    tk = TestKit()
+    _hash_table(tk, 10)
+    with pytest.raises(Exception, match="Duplicate entry"):
+        tk.must_exec("insert into h values (3, 999)")
+    # REPLACE routes to the right partition
+    tk.must_exec("replace into h values (3, 999)")
+    tk.check("select v from h where id = 3", [(999,)])
+
+
+def test_partition_group_by_across_partitions():
+    tk = TestKit()
+    tk.must_exec("create table g (k int, grp int, v int) "
+                 "partition by hash(k) partitions 3")
+    rng = np.random.default_rng(3)
+    rows = [(i, int(g), int(v)) for i, (g, v) in enumerate(
+        zip(rng.integers(0, 5, 300), rng.integers(0, 100, 300)))]
+    tk.must_exec("insert into g values " + ",".join(
+        f"({a},{b},{c})" for a, b, c in rows))
+    want = {}
+    for _, g, v in rows:
+        want[g] = want.get(g, 0) + v
+    got = tk.must_query("select grp, sum(v) from g group by grp "
+                        "order by grp")
+    assert got == sorted(want.items())
+
+
+def test_partition_join():
+    tk = TestKit()
+    _hash_table(tk, 20)
+    tk.must_exec("create table dim (id int primary key, tag varchar(8))")
+    tk.must_exec("insert into dim values " + ",".join(
+        f"({i},'t{i % 3}')" for i in range(20)))
+    got = tk.must_query(
+        "select dim.tag, sum(h.v) from h join dim on h.id = dim.id "
+        "group by dim.tag order by dim.tag")
+    want = {}
+    for i in range(20):
+        want.setdefault(f"t{i % 3}", 0)
+        want[f"t{i % 3}"] += i * 10
+    assert got == sorted(want.items())
+
+
+def test_move_into_occupied_slot_raises_duplicate():
+    """A partition-column update that would land on an existing primary
+    key in the target partition raises 1062 instead of silently
+    replacing the row."""
+    tk = TestKit()
+    tk.must_exec("create table m (d int primary key, v int) "
+                 "partition by range (d) ("
+                 "partition p0 values less than (10), "
+                 "partition p1 values less than (20))")
+    tk.must_exec("insert into m values (1, 1), (15, 2)")
+    with pytest.raises(Exception, match="Duplicate entry"):
+        tk.must_exec("update m set d = 15 where d = 1")
+    tk.check("select d, v from m order by d", [(1, 1), (15, 2)])
+
+
+def test_no_cross_partition_halloween():
+    """'d = d + 10' must move each row exactly once, not cascade it
+    through later partitions."""
+    tk = TestKit()
+    tk.must_exec("create table hw (d int, v int) "
+                 "partition by range (d) ("
+                 "partition p0 values less than (10), "
+                 "partition p1 values less than (20), "
+                 "partition pmax values less than maxvalue)")
+    tk.must_exec("insert into hw values (1, 1), (11, 2), (25, 3)")
+    rs = tk.must_exec("update hw set d = d + 10")
+    assert rs.affected == 3
+    tk.check("select d, v from hw order by v",
+             [(11, 1), (21, 2), (35, 3)])
+
+
+def test_allocator_survives_partition_ddl():
+    """Auto-handles never get re-issued after TRUNCATE/DROP of the
+    allocator partition (silent row overwrite otherwise)."""
+    tk = TestKit()
+    tk.must_exec(
+        "create table ta (d int, v int) partition by range (d) ("
+        "partition p0 values less than (10), "
+        "partition p1 values less than (20), "
+        "partition pmax values less than maxvalue)")
+    tk.must_exec("insert into ta values (1,1),(12,2),(25,3)")
+    tk.must_exec("alter table ta truncate partition p0")
+    tk.must_exec("insert into ta values (13, 4), (14, 5)")
+    tk.check("select count(*) from ta", [(4,)])
+    tk.check("select v from ta where d >= 10 and d < 20 order by v",
+             [(2,), (4,), (5,)])
+    tk.must_exec("alter table ta drop partition p0")
+    tk.must_exec("insert into ta values (15, 6)")
+    tk.check("select count(*) from ta", [(5,)])
+    tk.check("select v from ta order by v",
+             [(2,), (3,), (4,), (5,), (6,)])
+
+
+def test_allocator_restart_covers_all_partitions(tmp_path):
+    """After reopen, the shared allocator's counter covers handles that
+    live in sibling partitions."""
+    path = str(tmp_path / "store")
+    st = Storage(path)
+    s = Session(st)
+    # values 1,3 hash to partition 1 of 2: partition 0 (the allocator)
+    # holds no rows, so only the max-fold protects its counter
+    s.execute("create table al (a int) partition by hash(a) partitions 2")
+    s.execute("insert into al values (1), (3)")
+    st.close()
+    st2 = Storage(path)
+    s2 = Session(st2)
+    s2.execute("insert into al values (5)")
+    assert sorted(s2.execute("select a from al").rows) == \
+        [(1,), (3,), (5,)]
+    st2.close()
+
+
+def test_float_bound_does_not_overprune():
+    tk = TestKit()
+    tk.must_exec("create table fb (d int, v int) "
+                 "partition by range (d) ("
+                 "partition p0 values less than (10), "
+                 "partition p1 values less than (20))")
+    tk.must_exec("insert into fb values (9, 1), (10, 2), (11, 3)")
+    tk.check("select sum(v) from fb where d < 10.5", [(3,)])
+    tk.check("select sum(v) from fb where d > 9.5", [(5,)])
+
+
+def test_partitioned_survive_restart(tmp_path):
+    path = str(tmp_path / "store")
+    st = Storage(path)
+    s = Session(st)
+    s.execute("create table p (id int primary key, v int) "
+              "partition by hash(id) partitions 3")
+    s.execute("insert into p values (1,10),(2,20),(3,30),(4,40)")
+    s.execute("update p set v = 99 where id = 2")
+    st.close()
+    st2 = Storage(path)
+    s2 = Session(st2)
+    assert s2.execute("select id, v from p order by id").rows == \
+        [(1, 10), (2, 99), (3, 30), (4, 40)]
+    s2.execute("insert into p values (5, 50)")
+    assert s2.execute("select count(*) from p").rows == [(5,)]
+    st2.close()
+
+
+def test_partition_analyze():
+    tk = TestKit()
+    _hash_table(tk, 100)
+    tk.must_exec("analyze table h")
+    info = tk.session.catalog.table("test", "h")
+    for d in info.partition.defs:
+        assert tk.session.storage.stats.table_stats(d.id) is not None

@@ -97,6 +97,14 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)),
     ],
+    "partitions": [
+        ("table_catalog", _vc()), ("table_schema", _vc()),
+        ("table_name", _vc()), ("partition_name", _vc()),
+        ("partition_ordinal_position", _bigint()),
+        ("partition_method", _vc(16)),
+        ("partition_expression", _vc(64)),
+        ("partition_description", _vc(32)), ("table_rows", _bigint()),
+    ],
 }
 
 
@@ -127,6 +135,13 @@ def ensure_schema(storage) -> None:
         store.on_epoch = None  # derived data: never persist
 
 
+def _store_rows(storage, table_id: int) -> int:
+    store = storage.tables.get(table_id)
+    if store is None:
+        return 0
+    return store.epoch.num_rows + len(store.deltas)
+
+
 def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
     user_schemas = [s for k, s in sorted(catalog.schemas.items())
                     if k != DB_NAME]
@@ -137,10 +152,12 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
     elif tname == "tables":
         for s in user_schemas:
             for t in sorted(s.tables.values(), key=lambda t: t.name):
-                store = storage.tables.get(t.id)
-                nrows = 0
-                if store is not None:
-                    nrows = store.epoch.num_rows + len(store.deltas)
+                part = getattr(t, "partition", None)
+                if part is not None:
+                    nrows = sum(_store_rows(storage, d.id)
+                                for d in part.defs)
+                else:
+                    nrows = _store_rows(storage, t.id)
                 rows.append(["def", s.name, t.name, "BASE TABLE", "TiTPU",
                              10, "Fixed", nrows, 0, 0, 0, None,
                              "utf8mb4_bin", "", ""])
@@ -185,6 +202,24 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
         rows.append(["utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1])
     elif tname == "character_sets":
         rows.append(["utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4])
+    elif tname == "partitions":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                part = getattr(t, "partition", None)
+                if part is None:
+                    rows.append(["def", s.name, t.name, None, None,
+                                 None, None, None, _store_rows(storage,
+                                                               t.id)])
+                    continue
+                for i, d in enumerate(part.defs):
+                    desc = "MAXVALUE" if part.kind == "range" and \
+                        d.less_than is None else (
+                        str(d.less_than) if part.kind == "range" else "")
+                    rows.append([
+                        "def", s.name, t.name, d.name, i + 1,
+                        part.kind.upper(),
+                        t.columns[part.col_offset].name, desc,
+                        _store_rows(storage, d.id)])
     elif tname == "statements_summary":
         for e in sorted(storage.obs.statements.snapshot(),
                         key=lambda e: -e["sum_latency_ms"]):

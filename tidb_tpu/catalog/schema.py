@@ -44,6 +44,52 @@ class IndexInfo:
 
 
 @dataclass
+class PartitionDef:
+    """One partition: own table id = own physical TableStore + KV range
+    (reference: model.PartitionDefinition — each partition is a physical
+    table, table/tables/partition.go)."""
+
+    name: str
+    id: int
+    # RANGE: exclusive upper bound; None = MAXVALUE. HASH: unused.
+    less_than: Optional[int] = None
+
+
+@dataclass
+class PartitionInfo:
+    """PARTITION BY metadata (reference: model.PartitionInfo;
+    ddl/partition.go builds it, planner prunes on it)."""
+
+    kind: str  # 'hash' | 'range'
+    col_offset: int
+    defs: list[PartitionDef] = field(default_factory=list)
+
+    def route(self, value) -> PartitionDef:
+        """Partition for a column value (reference: partitionedTable
+        locatePartition, table/tables/partition.go)."""
+        if value is None:
+            if self.kind == "hash":
+                return self.defs[0]  # MySQL: NULL hashes to partition 0
+            # RANGE: NULL sorts below every bound -> first partition
+            return self.defs[0]
+        v = int(value)
+        if self.kind == "hash":
+            return self.defs[v % len(self.defs)]
+        for d in self.defs:
+            if d.less_than is None or v < d.less_than:
+                return d
+        raise ValueError(
+            f"Table has no partition for value {v}")
+
+    def by_name(self, name: str) -> Optional[PartitionDef]:
+        lname = name.lower()
+        for d in self.defs:
+            if d.name.lower() == lname:
+                return d
+        return None
+
+
+@dataclass
 class TableInfo:
     id: int
     name: str
@@ -53,6 +99,10 @@ class TableInfo:
     # handle (reference: pk-is-handle tables, table/tables.go); None means
     # rows get auto-allocated internal handles.
     pk_handle_offset: Optional[int] = None
+    # PARTITION BY metadata; None = unpartitioned. Access via
+    # getattr(info, 'partition', None) where old pickled catalogs may
+    # lack the field.
+    partition: Optional[PartitionInfo] = None
 
     def column_by_name(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()

@@ -313,9 +313,30 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
         required = set(range(len(plan.schema)))
 
     if isinstance(plan, LogicalUnion):
-        # children must keep identical widths; prune within each child only
-        plan.children = [prune(c) for c in plan.children]
-        plan._prune_map = {i: i for i in range(len(plan.schema))}  # type: ignore[attr-defined]
+        # union columns align by position, so the parent's requirement
+        # prunes every child at the same positions; a child that must
+        # keep extra columns (its selection's condition columns) gets an
+        # aligning projection. Essential for partitioned scans, whose
+        # unions would otherwise read every column of wide tables.
+        keep = sorted(required)
+        if not keep and plan.schema.fields:
+            keep = [0]
+        new_children = []
+        for c in plan.children:
+            c2 = prune(c, set(keep))
+            m = c2._prune_map  # type: ignore[attr-defined]
+            positions = [m[old] for old in keep]
+            if positions != list(range(len(c2.schema))):
+                exprs = [Col(m[old], c2.schema.fields[m[old]].ftype)
+                         for old in keep]
+                c2 = LogicalProjection(
+                    exprs,
+                    PlanSchema([c2.schema.fields[m[old]] for old in keep]),
+                    [c2])
+            new_children.append(c2)
+        plan.children = new_children
+        plan.schema = PlanSchema([plan.schema.fields[i] for i in keep])
+        plan._prune_map = {old: new for new, old in enumerate(keep)}  # type: ignore[attr-defined]
         return plan
 
     if isinstance(plan, LogicalWindow):
@@ -454,6 +475,8 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
 
 def optimize(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     plan = push_predicates(plan)
+    from .partition import expand_partitions
+    plan = expand_partitions(plan)
     from .reorder import reorder_joins
     plan = reorder_joins(plan, stats)
     plan = prune(plan)

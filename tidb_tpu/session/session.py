@@ -552,6 +552,16 @@ class Session:
 
     def _exec_alter(self, stmt: ast.AlterTableStmt) -> ResultSet:
         for spec in stmt.specs:
+            if spec.op in ("drop_partition", "truncate_partition"):
+                self._exec_alter_partition(stmt.table, spec)
+                continue
+            info = self.catalog.try_table(
+                stmt.table.db or self.current_db, stmt.table.name)
+            if info is not None and getattr(info, "partition",
+                                            None) is not None:
+                raise SQLError(
+                    f"ALTER {spec.op} on partitioned tables is "
+                    "unsupported")
             if spec.op == "add_index":
                 idef = spec.index
                 if idef.primary:
@@ -592,6 +602,55 @@ class Session:
                 raise SQLError(f"unsupported ALTER action {spec.op}")
         return ResultSet([], [])
 
+    def _exec_alter_partition(self, tn: ast.TableName,
+                              spec: ast.AlterSpec) -> None:
+        """DROP/TRUNCATE PARTITION (reference: ddl/partition.go
+        onDropTablePartition + truncate — partition data reclaim via
+        delete-range, here unsafe_destroy_range on the child id)."""
+        info, _ = self._table_for(tn)
+        part = getattr(info, "partition", None)
+        if part is None:
+            raise SQLError(f"table {info.name} is not partitioned")
+        d = part.by_name(spec.name)
+        if d is None:
+            raise SQLError(f"unknown partition {spec.name}")
+        self._commit_implicit()
+        # the first partition's store is the table's shared handle
+        # allocator (_table_for): its counter must survive this DDL or
+        # re-issued handles would overwrite live rows elsewhere
+        alloc = self.storage.table_store(part.defs[0].id)._next_handle
+        if spec.op == "drop_partition":
+            if part.kind != "range":
+                raise SQLError(
+                    "DROP PARTITION is only supported for RANGE "
+                    "partitioning (use a smaller PARTITIONS count "
+                    "for HASH)")
+            if len(part.defs) == 1:
+                raise SQLError("cannot drop the last partition")
+            part.defs.remove(d)
+            self.storage.unregister_table(d.id)
+            self.storage.stats.drop_table(d.id)
+            self.storage.destroy_table_data(d.id)
+            new_first = self.storage.table_store(part.defs[0].id)
+            new_first._next_handle = max(new_first._next_handle, alloc)
+            self.catalog.bump_version()
+        else:  # truncate_partition: fresh store, same identity
+            self.storage.destroy_table_data(d.id)
+            self.storage.stats.drop_table(d.id)
+            store = TableStore(Storage.child_table_info(info, d))
+            # keep the shared dictionaries (other partitions still
+            # reference their codes)
+            other = next((p for p in part.defs if p.id != d.id), None)
+            if other is not None:
+                store.dictionaries = \
+                    self.storage.table_store(other.id).dictionaries
+            if self.storage.path is not None:
+                store.on_epoch = self.storage._on_epoch_changed
+            self.storage.tables[d.id] = store
+            if d.id == part.defs[0].id:
+                store._next_handle = alloc
+            self.catalog.bump_version()
+
     def _phys_value(self, v, ft: FieldType):
         """Host default -> physical encoding (scaled decimal, day number)."""
         if v is None:
@@ -608,8 +667,9 @@ class Session:
         (reference: executor/analyze.go over pushdown collectors)."""
         self._commit_implicit()
         for tn in stmt.tables:
-            info, store = self._table_for(tn)
-            self.storage.stats.analyze_one(info, store, self.storage)
+            info, _ = self._table_for(tn)
+            for child, store in self._partition_children(info):
+                self.storage.stats.analyze_one(child, store, self.storage)
         return ResultSet([], [])
 
     # ==================== txn plumbing ====================
@@ -728,7 +788,8 @@ class Session:
             raise SQLError(
                 "FOR UPDATE supports single-table queries only")
         info, _ = self._table_for(stmt.from_)
-        self._pessimistic_scan(info, stmt.from_, stmt.where, txn)
+        for child, _store in self._partition_children(info):
+            self._pessimistic_scan(child, stmt.from_, stmt.where, txn)
 
     def _plan_cached(self, stmt: ast.SelectStmt, uncacheable: bool = False):
         """Plan, going through the SQL-text plan cache when the statement
@@ -787,8 +848,18 @@ class Session:
             txn.stmt_read_ts = txn.refresh_for_update_ts()
         timeout = float(
             self._sysvar_value("innodb_lock_wait_timeout") or 50)
+        part = getattr(info, "partition", None)
+        children = {c.id: (c, s) for c, s in
+                    self._partition_children(info)}
+        checkers: dict[int, _UniqueChecker] = {}
+
+        def checker_for(tid: int, fresh: bool = False) -> _UniqueChecker:
+            if fresh or tid not in checkers:
+                cinfo, cstore = children[tid]
+                checkers[tid] = _UniqueChecker(cinfo, cstore, txn)
+            return checkers[tid]
+
         try:
-            checker = _UniqueChecker(info, store, txn)
             count = 0
             for rv in rows:
                 if len(rv) != len(col_order):
@@ -796,6 +867,18 @@ class Session:
                 full = self._complete_row(info, col_order, rv, store)
                 handle = self._row_handle(info, full, store)
                 enc = store.encode_row(full)
+                if part is not None:
+                    # route by partition column (reference:
+                    # table/tables/partition.go locatePartition); unique
+                    # keys include the partition column, so duplicate
+                    # checks stay within the target partition
+                    try:
+                        tid = part.route(enc[part.col_offset]).id
+                    except ValueError as e:
+                        raise SQLError(str(e)) from None
+                else:
+                    tid = info.id
+                tinfo = children[tid][0]
                 if txn.pessimistic:
                     # lock the new record key AND every unique-index key
                     # this row claims (lock-only keys need no data record)
@@ -806,29 +889,31 @@ class Session:
                     # (reference: pessimistic lock-then-recheck;
                     # tables/index.go unique key constraint via KV)
                     from ..kv.mvcc import WriteConflictError as KVConflict
-                    lock_keys = [tablecodec.record_key(info.id, handle)]
-                    lock_keys += self._unique_lock_keys(info, enc)
+                    lock_keys = [tablecodec.record_key(tid, handle)]
+                    lock_keys += self._unique_lock_keys(tinfo, enc)
                     for _ in range(16):
                         try:
                             waited = self.storage.pessimistic_lock_keys(
                                 txn, lock_keys, timeout)
                         except KVConflict:
-                            # a commit landed past our for_update_ts
+                            # a commit landed past our for_update_ts:
+                            # EVERY cached checker's snapshot is stale
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
-                            checker = _UniqueChecker(info, store, txn)
+                            checkers.clear()
                             continue
                         except (Storage.DeadlockError,
                                 Storage.LockWaitTimeout) as e:
                             raise SQLError(str(e)) from None
                         if waited:
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
-                            checker = _UniqueChecker(info, store, txn)
+                            checkers.clear()
+                        checker = checker_for(tid)
                         conflicts = checker.conflicts(handle, enc)
                         if not (conflicts and stmt.is_replace):
                             break
-                        victims = [tablecodec.record_key(info.id, h)
+                        victims = [tablecodec.record_key(tid, h)
                                    for h in conflicts
-                                   if tablecodec.record_key(info.id, h)
+                                   if tablecodec.record_key(tid, h)
                                    not in txn.locked_keys]
                         if not victims:
                             break
@@ -837,16 +922,17 @@ class Session:
                         raise SQLError(
                             "pessimistic lock retries exhausted")
                 else:
+                    checker = checker_for(tid)
                     conflicts = checker.conflicts(handle, enc)
                 if conflicts:
                     if not stmt.is_replace:
                         raise SQLError(
                             checker.dup_message(handle, enc, conflicts))
                     for h in conflicts:
-                        txn.delete_row(info.id, h)
+                        txn.delete_row(tid, h)
                         checker.note_delete(h)
                     count += len(conflicts)  # MySQL: replaced rows count 2x
-                txn.set_row(info.id, handle, enc)
+                txn.set_row(tid, handle, enc)
                 checker.note_insert(handle, enc)
                 count += 1
             return ResultSet([], [], affected=count)
@@ -854,15 +940,43 @@ class Session:
             txn.stmt_read_ts = None
 
     def _exec_update(self, stmt: ast.UpdateStmt) -> ResultSet:
-        info, store = self._table_for(stmt.table)
+        info, _ = self._table_for(stmt.table)
         txn = self._ensure_txn()
         try:
-            return self._exec_update_inner(stmt, info, store, txn)
+            total = 0
+            # rows moving across partitions are buffered and applied
+            # AFTER every partition's snapshot-scan: writing them inline
+            # would make them visible to later partitions' scans in the
+            # same statement (cross-partition Halloween problem;
+            # reference: the update executor collects row changes before
+            # applying partition moves)
+            moves: list[tuple[int, int, tuple]] = []
+            for child, store in self._partition_children(info):
+                rs = self._exec_update_inner(stmt, child, store, txn,
+                                             parent=info, moves=moves)
+                total += rs.affected
+            for target_id, new_handle, phys in moves:
+                tinfo = next(c for c, _s in self._partition_children(info)
+                             if c.id == target_id)
+                tstore = self.storage.table_store(target_id)
+                checker = _UniqueChecker(tinfo, tstore, txn)
+                conf = checker.conflicts(new_handle, phys)
+                if conf:
+                    raise SQLError(
+                        checker.dup_message(new_handle, phys, conf))
+                tstore.note_handle(new_handle)
+                # the shared allocator must never re-issue this handle
+                _, alloc_store = self._table_for(stmt.table)
+                alloc_store.note_handle(new_handle)
+                txn.set_row(target_id, new_handle, phys)
+            return ResultSet([], [], affected=total)
         finally:
             txn.stmt_read_ts = None
 
     def _exec_update_inner(self, stmt: ast.UpdateStmt, info, store,
-                           txn) -> ResultSet:
+                           txn, parent=None, moves=None) -> ResultSet:
+        part = getattr(parent, "partition", None) if parent is not None \
+            else None
         if txn.pessimistic:
             snap, mask, ev, handles = self._pessimistic_scan(
                 info, stmt.table, stmt.where, txn)
@@ -942,6 +1056,24 @@ class Session:
                 if conf:
                     raise SQLError(
                         checker.dup_message(new_handle, tuple(phys), conf))
+            target_id = info.id
+            if part is not None:
+                # a partition-column update may move the row
+                # (reference: partition.go row movement on update)
+                try:
+                    target_id = part.route(phys[part.col_offset]).id
+                except ValueError as e:
+                    raise SQLError(str(e)) from None
+            if target_id != info.id:
+                # cross-partition move: delete here, apply after every
+                # partition scanned (uniqueness checked at apply time)
+                txn.delete_row(info.id, handle)
+                if checker is not None:
+                    checker.note_delete(handle)
+                assert moves is not None
+                moves.append((target_id, new_handle, tuple(phys)))
+                count += 1
+                continue
             if new_handle != handle:
                 txn.delete_row(info.id, handle)
                 if checker is not None:
@@ -953,20 +1085,23 @@ class Session:
         return ResultSet([], [], affected=count)
 
     def _exec_delete(self, stmt: ast.DeleteStmt) -> ResultSet:
-        info, store = self._table_for(stmt.table)
+        info, _ = self._table_for(stmt.table)
         txn = self._ensure_txn()
         try:
-            if txn.pessimistic:
-                snap, mask, _, handles = self._pessimistic_scan(
-                    info, stmt.table, stmt.where, txn)
-            else:
-                snap = txn.snapshot(info.id)
-                mask, _ = self._where_mask(info, stmt.table, stmt.where,
-                                           snap)
-                handles = snap.handles()[mask]
-            for h in handles:
-                txn.delete_row(info.id, int(h))
-            return ResultSet([], [], affected=len(handles))
+            total = 0
+            for child, _store in self._partition_children(info):
+                if txn.pessimistic:
+                    snap, mask, _, handles = self._pessimistic_scan(
+                        child, stmt.table, stmt.where, txn)
+                else:
+                    snap = txn.snapshot(child.id)
+                    mask, _ = self._where_mask(child, stmt.table,
+                                               stmt.where, snap)
+                    handles = snap.handles()[mask]
+                for h in handles:
+                    txn.delete_row(child.id, int(h))
+                total += len(handles)
+            return ResultSet([], [], affected=total)
         finally:
             txn.stmt_read_ts = None
 
@@ -1159,12 +1294,17 @@ class Session:
             # enforce via a primary unique index
             indices.append(IndexInfo(self.catalog.alloc_id(), "PRIMARY",
                                      list(pk_offsets), True, True))
+        partition = None
+        if stmt.partition_by is not None:
+            partition = self._build_partition_info(
+                stmt.partition_by, columns, indices, pk_handle)
         info = TableInfo(
             id=self.catalog.alloc_id(),
             name=stmt.table.name,
             columns=columns,
             indices=indices,
             pk_handle_offset=pk_handle,
+            partition=partition,
         )
         try:
             created = self.catalog.add_table(db, info, stmt.if_not_exists)
@@ -1173,6 +1313,50 @@ class Session:
         if created:
             self.storage.register_table(info)
         return ResultSet([], [])
+
+    def _build_partition_info(self, pb, columns, indices, pk_handle):
+        """Validate + build PartitionInfo (reference: ddl/partition.go
+        checkPartitionByHash/Range + checkPartitionKeysConstraint — every
+        unique key must include the partition column)."""
+        from ..catalog.schema import PartitionDef, PartitionInfo
+
+        col = next((c for c in columns
+                    if c.name.lower() == pb.column.lower()), None)
+        if col is None:
+            raise SQLError(f"unknown partition column {pb.column}")
+        ft = col.ftype
+        if not (ft.is_integer or ft.kind == TypeKind.DATE):
+            raise SQLError(
+                "partition column must be integer or DATE typed")
+        for ix in indices:
+            if (ix.unique or ix.primary) and \
+                    col.offset not in ix.col_offsets:
+                raise SQLError(
+                    "A UNIQUE INDEX must include all columns in the "
+                    "table's partitioning function")
+        if pk_handle is not None and pk_handle != col.offset:
+            raise SQLError(
+                "A PRIMARY KEY must include all columns in the "
+                "table's partitioning function")
+        defs: list = []
+        if pb.kind == "hash":
+            for i in range(pb.count):
+                defs.append(PartitionDef(f"p{i}", self.catalog.alloc_id()))
+        else:
+            prev = None
+            for name, less_than in pb.ranges:
+                if any(d.name.lower() == name.lower() for d in defs):
+                    raise SQLError(f"duplicate partition name {name}")
+                if prev is not None and prev[1] is None:
+                    raise SQLError("MAXVALUE must be the last partition")
+                if less_than is not None and prev is not None and \
+                        prev[1] is not None and less_than <= prev[1]:
+                    raise SQLError(
+                        "VALUES LESS THAN must be strictly increasing")
+                defs.append(PartitionDef(name, self.catalog.alloc_id(),
+                                         less_than))
+                prev = (name, less_than)
+        return PartitionInfo(pb.kind, col.offset, defs)
 
     def _decode_default(self, c, ft: FieldType) -> Any:
         if c.value is None:
@@ -1191,16 +1375,23 @@ class Session:
             except KeyError as e:
                 raise SQLError(str(e)) from None
             if info is not None:
-                self.storage.unregister_table(info.id)
-                self.storage.stats.drop_table(info.id)
-                self.storage.destroy_table_data(info.id)
+                part = getattr(info, "partition", None)
+                ids = [d.id for d in part.defs] if part is not None \
+                    else [info.id]
+                for tid in ids:
+                    self.storage.unregister_table(tid)
+                    self.storage.stats.drop_table(tid)
+                    self.storage.destroy_table_data(tid)
         return ResultSet([], [])
 
     def _exec_truncate(self, stmt: ast.TruncateTableStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)
-        self.storage.unregister_table(info.id)
-        self.storage.stats.drop_table(info.id)
-        self.storage.destroy_table_data(info.id)
+        part = getattr(info, "partition", None)
+        ids = [d.id for d in part.defs] if part is not None else [info.id]
+        for tid in ids:
+            self.storage.unregister_table(tid)
+            self.storage.stats.drop_table(tid)
+            self.storage.destroy_table_data(tid)
         self.storage.register_table(info)
         return ResultSet([], [])
 
@@ -1400,7 +1591,21 @@ class Session:
             info = self.catalog.table(db, tn.name)
         except KeyError as e:
             raise SQLError(str(e)) from None
+        part = getattr(info, "partition", None)
+        if part is not None:
+            # first partition's store: the shared allocator + shared
+            # dictionaries (see Storage._register_partitioned)
+            return info, self.storage.table_store(part.defs[0].id)
         return info, self.storage.table_store(info.id)
+
+    def _partition_children(self, info: TableInfo):
+        """[(child TableInfo, store)] — a single pair for unpartitioned
+        tables, so DML loops uniformly over physical tables."""
+        part = getattr(info, "partition", None)
+        if part is None:
+            return [(info, self.storage.table_store(info.id))]
+        return [(Storage.child_table_info(info, d),
+                 self.storage.table_store(d.id)) for d in part.defs]
 
 
 def _like_match(pattern: Optional[str], s: str) -> bool:

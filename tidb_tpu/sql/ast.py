@@ -327,11 +327,23 @@ class IndexDef:
 
 
 @dataclass
+class PartitionByDef:
+    """PARTITION BY clause (reference: ast.PartitionOptions)."""
+
+    kind: str  # 'hash' | 'range'
+    column: str
+    # hash: partition count; range: [(name, less_than|None=MAXVALUE)]
+    count: int = 0
+    ranges: list[tuple[str, Optional[int]]] = field(default_factory=list)
+
+
+@dataclass
 class CreateTableStmt(Stmt):
     table: TableName
     columns: list[ColumnDef]
     indices: list[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
+    partition_by: Optional[PartitionByDef] = None
 
 
 @dataclass
@@ -345,10 +357,10 @@ class AlterSpec:
     """One ALTER TABLE action (reference: ast.AlterTableSpec)."""
 
     op: str  # add_column | drop_column | add_index | drop_index |
-    #          modify_column | rename
+    #          modify_column | rename | drop_partition | truncate_partition
     column: Optional[ColumnDef] = None
     index: Optional[IndexDef] = None
-    name: str = ""  # drop target / rename-to name
+    name: str = ""  # drop target / rename-to / partition name
 
 
 @dataclass

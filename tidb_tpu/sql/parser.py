@@ -286,10 +286,18 @@ class Parser:
                     self.advance()
                     specs.append(ast.AlterSpec("drop_index",
                                                name=self.expect_ident()))
+                elif self.cur.is_kw("PARTITION"):
+                    self.advance()
+                    specs.append(ast.AlterSpec("drop_partition",
+                                               name=self.expect_ident()))
                 else:
                     self.accept_kw("COLUMN")
                     specs.append(ast.AlterSpec("drop_column",
                                                name=self.expect_ident()))
+            elif self.accept_kw("TRUNCATE"):
+                self.expect_kw("PARTITION")
+                specs.append(ast.AlterSpec("truncate_partition",
+                                           name=self.expect_ident()))
             elif self.accept_kw("MODIFY"):
                 self.accept_kw("COLUMN")
                 specs.append(ast.AlterSpec(
@@ -601,10 +609,79 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        # swallow table options (ENGINE=..., CHARSET=..., etc.)
+        # table options (ENGINE=..., CHARSET=...) are swallowed up to the
+        # PARTITION BY clause (which we parse) or end of statement
+        partition_by = None
         while self.cur.kind != TokenKind.EOF and not self.cur.is_op(";"):
+            if self.cur.is_kw("PARTITION"):
+                partition_by = self._parse_partition_by()
+                break
             self.advance()
-        return ast.CreateTableStmt(table, columns, indices, ine)
+        return ast.CreateTableStmt(table, columns, indices, ine,
+                                   partition_by)
+
+    def _parse_partition_by(self) -> ast.PartitionByDef:
+        """PARTITION BY HASH(col) PARTITIONS n |
+        PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (v|
+        MAXVALUE), ...) (reference: parser partition options ->
+        model.PartitionInfo, ddl/partition.go)."""
+        self.expect_kw("PARTITION")
+        self.expect_kw("BY")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "HASH":
+            self.advance()
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            count = 1
+            if self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "PARTITIONS":
+                self.advance()
+                count = self.parse_uint("PARTITIONS")
+            if count < 1:
+                raise ParseError("PARTITIONS must be >= 1", self.cur)
+            return ast.PartitionByDef("hash", col, count=count)
+        if self.cur.is_kw("RANGE"):
+            self.advance()
+            self.expect_op("(")
+            col = self.expect_ident()
+            self.expect_op(")")
+            self.expect_op("(")
+            ranges: list[tuple[str, Optional[int]]] = []
+            while True:
+                self.expect_kw("PARTITION")
+                name = self.expect_ident()
+                self.expect_kw("VALUES")
+                kw = self.cur
+                if not (kw.kind == TokenKind.IDENT
+                        and kw.text.upper() == "LESS"):
+                    raise ParseError("expected LESS THAN", kw)
+                self.advance()
+                if not (self.cur.kind == TokenKind.IDENT
+                        and self.cur.text.upper() == "THAN"):
+                    raise ParseError("expected THAN", self.cur)
+                self.advance()
+                if self.cur.kind == TokenKind.IDENT and \
+                        self.cur.text.upper() == "MAXVALUE":
+                    self.advance()
+                    ranges.append((name, None))
+                else:
+                    self.expect_op("(")
+                    neg = bool(self.accept_op("-"))
+                    t = self.cur
+                    if t.kind != TokenKind.INT:
+                        raise ParseError(
+                            "expected integer partition bound", t)
+                    self.advance()
+                    v = -int(t.text) if neg else int(t.text)
+                    self.expect_op(")")
+                    ranges.append((name, v))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.PartitionByDef("range", col, ranges=ranges)
+        raise ParseError("expected HASH or RANGE after PARTITION BY",
+                         self.cur)
 
     def _if_not_exists(self) -> bool:
         if self.accept_kw("IF"):

@@ -130,6 +130,9 @@ class Storage:
 
     # ---- schema ------------------------------------------------------------
     def register_table(self, info: TableInfo) -> TableStore:
+        part = getattr(info, "partition", None)
+        if part is not None:
+            return self._register_partitioned(info, part)
         store = TableStore(info)
         self.tables[info.id] = store
         if self.path is not None:
@@ -141,6 +144,42 @@ class Storage:
         except ValueError:
             pass  # split point already a region boundary
         return store
+
+    def _register_partitioned(self, info: TableInfo, part) -> TableStore:
+        """Each partition is a full physical TableStore under its own
+        table id/region (reference: partitions ARE tables,
+        table/tables/partition.go); they share the parent's string
+        dictionaries so cross-partition unions need no code remapping.
+        Returns the first partition's store (the shared allocator)."""
+        first: Optional[TableStore] = None
+        shared_dicts = None
+        for d in part.defs:
+            child = self.child_table_info(info, d)
+            store = TableStore(child)
+            if shared_dicts is None:
+                shared_dicts = store.dictionaries
+            else:
+                store.dictionaries = shared_dicts
+            self.tables[d.id] = store
+            if self.path is not None:
+                store.on_epoch = self._on_epoch_changed
+            try:
+                self.rm.split(tablecodec.table_prefix(d.id))
+            except ValueError:
+                pass
+            if first is None:
+                first = store
+        assert first is not None
+        return first
+
+    @staticmethod
+    def child_table_info(info: TableInfo, d) -> TableInfo:
+        """A partition's physical TableInfo: parent schema, own id."""
+        import dataclasses
+        return dataclasses.replace(info, id=d.id,
+                                   name=f"{info.name}#{d.name}",
+                                   partition=None)
+
 
     # ---- durability plane ---------------------------------------------------
     def _lease_file(self) -> str:
@@ -335,24 +374,38 @@ class Storage:
         self.catalog.version = state["version"]
         for schema in self.catalog.schemas.values():
             for info in schema.tables.values():
-                store = self.register_table(info)
-                self._load_epoch(store)
-                lo, hi = tablecodec.record_range(info.id)
-                folds = []
-                for key, commit_ts, kind, val in self.kv.scan_latest(lo, hi):
-                    if commit_ts <= store.epoch.fold_ts:
-                        continue
-                    _, handle = tablecodec.decode_record_key(key)
-                    if kind == OP_DEL:
-                        if handle in store.epoch.handle_pos:
-                            folds.append((commit_ts, handle, TOMBSTONE))
-                    else:
-                        row = self._fold_row(store, codec.decode_key(val))
-                        folds.append((commit_ts, handle, row))
-                        store.note_handle(handle)
-                folds.sort(key=lambda t: t[0])
-                for commit_ts, handle, row in folds:
-                    store.apply_commit(commit_ts, handle, row)
+                self.register_table(info)
+                part = getattr(info, "partition", None)
+                ids = [d.id for d in part.defs] if part is not None \
+                    else [info.id]
+                for tid in ids:
+                    store = self.tables[tid]
+                    self._load_epoch(store)
+                    lo, hi = tablecodec.record_range(tid)
+                    folds = []
+                    for key, commit_ts, kind, val in self.kv.scan_latest(
+                            lo, hi):
+                        if commit_ts <= store.epoch.fold_ts:
+                            continue
+                        _, handle = tablecodec.decode_record_key(key)
+                        if kind == OP_DEL:
+                            if handle in store.epoch.handle_pos:
+                                folds.append((commit_ts, handle, TOMBSTONE))
+                        else:
+                            row = self._fold_row(store,
+                                                 codec.decode_key(val))
+                            folds.append((commit_ts, handle, row))
+                            store.note_handle(handle)
+                    folds.sort(key=lambda t: t[0])
+                    for commit_ts, handle, row in folds:
+                        store.apply_commit(commit_ts, handle, row)
+                if part is not None:
+                    # the first partition's store allocates handles for
+                    # the WHOLE table: its counter must cover handles
+                    # living in every sibling partition
+                    first = self.tables[ids[0]]
+                    first._next_handle = max(
+                        self.tables[tid]._next_handle for tid in ids)
         self.stats.load_from_kv(self, self.catalog)
         raw = self.get_meta(b"ddl:jobs")
         if raw:
