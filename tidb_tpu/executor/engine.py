@@ -150,9 +150,15 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
             # histogram estimate for this exact conjunct set (reference:
             # statistics/feedback.go + handle/update.go:551)
             from ..plan.physical import conds_digest
-            ctx.txn.storage.stats.record_feedback(
+            stats = ctx.txn.storage.stats
+            stats.record_feedback(
                 plan.dag.scan.table_id,
                 conds_digest(plan.dag.selection.conditions), out.num_rows)
+            # column-attributable predicates also correct the histogram
+            # buckets / point estimates themselves
+            stats.record_condition_feedback(
+                plan.dag.scan.table_id, plan.dag.scan.col_offsets,
+                plan.dag.selection.conditions, out.num_rows)
         return out
     from ..plan.fragment import PhysFragmentRead
     if isinstance(plan, PhysFragmentRead):

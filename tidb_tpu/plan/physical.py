@@ -660,10 +660,13 @@ def conds_digest(conditions: list[PlanExpr]) -> str:
 
 def _est_selection_rows(table, scan_offsets: list[int],
                         conditions: list[PlanExpr], stats) -> Optional[float]:
-    """Conjunct-product cardinality estimate for EXPLAIN (reference:
-    statistics/selectivity.go — simplified to per-column independence).
-    An actual-execution feedback record for the same conjunct set
-    overrides the histogram estimate (statistics/feedback.go)."""
+    """Cardinality estimate for a conjunct set (reference:
+    statistics/selectivity.go): per-conjunct selectivities combined
+    with exponential backoff (most selective factor fully, later ones
+    with diminishing exponents) so correlated predicates don't compound
+    into wild underestimates. An actual-execution feedback record for
+    the same conjunct set overrides everything
+    (statistics/feedback.go)."""
     if stats is not None:
         fb = stats.feedback_rows(table.id, conds_digest(conditions))
         if fb is not None:
@@ -675,15 +678,15 @@ def _est_selection_rows(table, scan_offsets: list[int],
 
     col_map = {i: off for i, off in enumerate(scan_offsets)}
     rows = max(ts.row_count, 1.0)
-    sel = 1.0
     interval_offs: set[int] = set()
+    sels: list[float] = []
     for c in conditions:
         hit = _eq_values(c, col_map)
         if hit is not None:
             off, vals = hit
             est = sum(stats.est_eq_rows(table.id, off, v, rows)
                       for v in vals)
-            sel *= min(est / rows, 1.0)
+            sels.append(min(est / rows, 1.0))
             continue
         if isinstance(c, Call) and c.op in ("lt", "le", "gt", "ge"):
             cols: set[int] = set()
@@ -698,9 +701,20 @@ def _est_selection_rows(table, scan_offsets: list[int],
                 if iv is not None:
                     est = stats.est_range_rows(table.id, off, *iv,
                                                fallback_rows=rows)
-                    sel *= min(est / rows, 1.0)
+                    sels.append(min(est / rows, 1.0))
                     continue
-        sel *= 0.8  # uninterpretable conjunct: mild filter factor
+        sels.append(0.8)  # uninterpretable conjunct: mild filter factor
+    # exponential backoff instead of naive independence: correlated
+    # predicates make the product wildly underestimate, so later (less
+    # selective... sorted ascending) factors contribute with diminishing
+    # exponents s0 * s1^(1/2) * s2^(1/4) * ... (reference: the
+    # selectivity ordering in statistics/selectivity.go; the backoff
+    # form is TiDB's tidb_opt_correlation-era estimator)
+    sel = 1.0
+    for k, s in enumerate(sorted(sels)):
+        if k >= 4:
+            break  # factors beyond the 4th add nothing measurable
+        sel *= s ** (1.0 / (1 << k))
     return rows * sel
 
 

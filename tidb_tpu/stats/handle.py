@@ -31,6 +31,8 @@ PSEUDO_RANGE_RATE = 1.0 / 3
 SAMPLE_CAP = 1 << 20  # build from at most ~1M rows, extrapolated
 
 
+
+
 @dataclass
 class ColumnStats:
     null_count: float
@@ -42,6 +44,11 @@ class ColumnStats:
     # across epochs) — planner predicates carry raw strings, the sketch is
     # keyed on codes
     dictionary: Any = None
+    # observed per-value row counts from actual executions, overriding
+    # the sketch estimate (reference: feedback.go point feedback)
+    eq_feedback: dict = field(default_factory=dict)
+
+    MAX_EQ_FEEDBACK = 128
 
     def eq_rows(self, value) -> float:
         if value is None:
@@ -53,16 +60,45 @@ class ColumnStats:
             if code < 0:
                 return 0.0
             value = code
+        fb = self.eq_feedback.get(_fb_key(value))
+        if fb is not None:
+            return fb
         if self.cmsketch is not None:
             return float(self.cmsketch.query(value))
         if self.ndv > 0:
             return self.total / self.ndv
         return 0.0
 
+    def note_eq_feedback(self, value, actual: float) -> None:
+        if value is None:
+            return
+        if isinstance(value, str):
+            # key on the dictionary code, exactly as eq_rows looks up —
+            # raw-string keys would never be hit and numeric-looking
+            # strings would collide with codes
+            if self.dictionary is None:
+                return
+            code = self.dictionary.lookup(value)
+            if code < 0:
+                return
+            value = code
+        key = _fb_key(value)
+        if key not in self.eq_feedback and \
+                len(self.eq_feedback) >= self.MAX_EQ_FEEDBACK:
+            self.eq_feedback.pop(next(iter(self.eq_feedback)))
+        self.eq_feedback[key] = float(actual)
+
     def range_rows(self, lo, hi, lo_incl: bool, hi_incl: bool) -> float:
         if self.histogram is None:
             return self.total * PSEUDO_RANGE_RATE
         return self.histogram.range_count(lo, hi, lo_incl, hi_incl)
+
+
+def _fb_key(value):
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        return value
 
 
 @dataclass
@@ -182,6 +218,53 @@ class StatsHandle:
 
     # ---- execution feedback --------------------------------------------
     FEEDBACK_CAP = 4096  # distinct conjunct sets retained (process-wide)
+
+    def record_condition_feedback(self, table_id: int,
+                                  col_offsets: list[int],
+                                  conditions, actual: float) -> None:
+        """Merge an actual scan count back into column-level stats when
+        the conjunct set is attributable to one column: a single
+        equality updates the point-feedback table, an interval rescales
+        the histogram buckets (reference: statistics/feedback.go +
+        handle/update.go:551 merging range feedback)."""
+        ts = self.tables.get(table_id)
+        if ts is None:
+            return
+        from ..plan.expr import Call
+        from ..plan.physical import _expr_cols
+        from ..plan.ranger import _eq_values, extract_interval
+
+        col_map = {i: off for i, off in enumerate(col_offsets)}
+        if len(conditions) == 1:
+            hit = _eq_values(conditions[0], col_map)
+            if hit is not None and len(hit[1]) == 1:
+                cs = ts.columns.get(hit[0])
+                if cs is not None:
+                    cs.note_eq_feedback(hit[1][0], actual)
+                return
+        # interval feedback is sound only when EVERY conjunct bounds the
+        # same column (extra predicates would shrink `actual` and the
+        # correction would wrongly deflate the histogram)
+        offs: set[int] = set()
+        for c in conditions:
+            cols: set[int] = set()
+            _expr_cols(c, cols)
+            if not (isinstance(c, Call)
+                    and c.op in ("lt", "le", "gt", "ge")):
+                return
+            offs.update(col_map.get(i, -1) for i in cols)
+        if len(offs) != 1 or -1 in offs:
+            return
+        off = next(iter(offs))
+        cs = ts.columns.get(off)
+        if cs is None or cs.histogram is None:
+            return
+        interval = extract_interval(off, conditions, col_map)
+        if interval is None:
+            return
+        lo, hi, lo_incl, hi_incl = interval
+        cs.histogram.apply_range_feedback(lo, hi, lo_incl, hi_incl,
+                                          actual)
 
     def record_feedback(self, table_id: int, digest: str,
                         actual_rows: float) -> None:

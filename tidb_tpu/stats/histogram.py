@@ -83,6 +83,43 @@ class Histogram:
             else 0.0
         return max(hi_c - lo_c, 0.0)
 
+    def apply_range_feedback(self, lo, hi, lo_incl: bool, hi_incl: bool,
+                             actual: float) -> None:
+        """Scale the buckets overlapping [lo, hi] so the interval's
+        estimate matches the observed row count (reference:
+        statistics/feedback.go merging actual scan counts back into
+        histogram buckets). The correction factor is clamped so one
+        noisy observation can't destroy the histogram."""
+        est = self.range_count(lo, hi, lo_incl, hi_incl)
+        if est <= 0 or actual < 0:
+            return
+        factor = max(0.1, min(actual / est, 10.0))
+        if abs(factor - 1.0) < 0.05:
+            return
+        lo_f = -np.inf if lo is None else float(lo)
+        hi_f = np.inf if hi is None else float(hi)
+        # per-bucket overlap fraction (same linear interpolation the
+        # estimator uses): only the in-interval mass gets corrected, so
+        # a narrow observation can't inflate a whole wide bucket
+        width = np.maximum(self.uppers - self.lowers, 0.0)
+        cover_lo = np.maximum(self.lowers, lo_f)
+        cover_hi = np.minimum(self.uppers, hi_f)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            frac = np.where(
+                width > 0,
+                np.clip((cover_hi - cover_lo) / np.where(width > 0, width,
+                                                         1.0), 0.0, 1.0),
+                ((self.lowers >= lo_f) & (self.uppers <= hi_f))
+                .astype(np.float64))
+        frac = np.where(cover_hi < cover_lo, 0.0, frac)
+        if not (frac > 0).any():
+            return
+        delta = self.counts * frac * (factor - 1.0)
+        self.counts = np.maximum(self.counts + delta, 0.0)
+        self.repeats = np.minimum(self.repeats, self.counts)
+        self.cum = np.cumsum(self.counts)
+        self.total = float(self.counts.sum())
+
     def eq_count(self, x: float) -> float:
         b = int(np.searchsorted(self.uppers, x, side="left"))
         if b >= len(self.uppers):
