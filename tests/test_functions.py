@@ -1,0 +1,155 @@
+"""Scalar function library: string/math/date/control + session functions.
+
+Counterpart of the reference's builtin families (reference:
+expression/builtin_string.go, builtin_math.go, builtin_time.go,
+builtin_compare.go, builtin_info.go)."""
+
+from __future__ import annotations
+
+import pytest
+
+from testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    k = TestKit()
+    k.must_exec("create table t (id int primary key, s varchar(20), "
+                "d decimal(8,2), f double, dt date, ts datetime)")
+    k.must_exec("insert into t values (1, 'Hello World', 123.45, 2.5, "
+                "'2024-02-15', '2024-02-15 13:45:30'), "
+                "(2, NULL, -7.89, 0.0, '2023-12-31', "
+                "'2023-12-31 23:59:59')")
+    return k
+
+
+def _one(tk, sql):
+    return tk.must_query(sql + " from t where id = 1")[0]
+
+
+def test_string_functions(tk):
+    assert _one(tk, "select upper(s), lower(s), reverse(s)") == \
+        ("HELLO WORLD", "hello world", "dlroW olleH")
+    assert _one(tk, "select length(s), char_length(s), ascii(s)") == \
+        (11, 11, 72)
+    assert _one(tk, "select concat(s, '!', id), "
+                    "concat_ws('-', 'a', s, 'z')") == \
+        ("Hello World!1", "a-Hello World-z")
+    assert _one(tk, "select left(s, 5), right(s, 5), repeat('ab', 3)") == \
+        ("Hello", "World", "ababab")
+    assert _one(tk, "select replace(s, 'World', 'There'), "
+                    "trim('  x  '), ltrim('  x'), rtrim('x  ')") == \
+        ("Hello There", "x", "x", "x")
+    assert _one(tk, "select lpad('5', 3, '0'), rpad('ab', 5, 'xy')") == \
+        ("005", "abxyx")
+    assert _one(tk, "select locate('World', s), instr(s, 'World'), "
+                    "locate('zz', s)") == (7, 7, 0)
+
+
+def test_string_null_propagation(tk):
+    # CONCAT: NULL poison; CONCAT_WS: NULL args skipped
+    assert tk.must_query(
+        "select concat(s, 'x'), concat_ws(',', 'a', s, 'b') "
+        "from t where id = 2") == [(None, "a,b")]
+    assert tk.must_query(
+        "select upper(s) from t where id = 2") == [(None,)]
+
+
+def test_math_functions(tk):
+    r = _one(tk, "select round(d), round(d, 1), truncate(d, 1), "
+                 "floor(d), ceil(d)")
+    assert (str(r[0]), str(r[1]), str(r[2]), r[3], r[4]) == \
+        ("123", "123.5", "123.4", 123, 124)
+    # negative decimals round away from zero, floor/ceil flip
+    r = tk.must_query("select round(d, 1), floor(d), ceil(d) from t "
+                      "where id = 2")[0]
+    assert (str(r[0]), r[1], r[2]) == ("-7.9", -8, -7)
+    assert str(_one(tk, "select round(2.5)")[0]) == "3"  # half away
+    r = _one(tk, "select sqrt(16), pow(2, 10), exp(0), sign(-3), "
+                 "sign(0), sign(9)")
+    assert r == (4.0, 1024.0, 1.0, -1, 0, 1)
+    r = _one(tk, "select log2(8), log10(1000), log(3, 81), ln(1)")
+    assert r == (3.0, 3.0, 4.0, 0.0)
+    # out-of-domain -> NULL
+    assert _one(tk, "select sqrt(0 - 1), ln(0)") == (None, None)
+    assert _one(tk, "select round(f, 2), floor(f), ceil(f)") == \
+        (2.5, 2.0, 3.0)
+    assert abs(_one(tk, "select pi()")[0] - 3.14159265) < 1e-6
+
+
+def test_greatest_least_nullif(tk):
+    assert _one(tk, "select greatest(1, 5, 3), least(1, 5, 3)") == (5, 1)
+    assert _one(tk, "select greatest(1.5, d, 2)") == \
+        _one(tk, "select d")
+    assert _one(tk, "select greatest(1, s is null, 3), least(id, 0)") == \
+        (3, 0)
+    # MySQL: any NULL operand -> NULL
+    assert tk.must_query("select greatest(1, s is not null, 3) "
+                         "from t where id = 1") == [(3,)]
+    assert _one(tk, "select nullif(id, 1), nullif(id, 9)") == (None, 1)
+
+
+def test_date_functions(tk):
+    # 2024-02-15 is a Thursday in Q1, day 46 of a leap year
+    assert _one(tk, "select dayofweek(dt), weekday(dt), dayofyear(dt), "
+                    "quarter(dt)") == (5, 3, 46, 1)
+    assert _one(tk, "select hour(ts), minute(ts), second(ts)") == \
+        (13, 45, 30)
+    r = _one(tk, "select date(ts), last_day(dt), "
+                 "datediff(dt, '2024-01-01')")
+    assert (str(r[0]), str(r[1]), r[2]) == \
+        ("2024-02-15", "2024-02-29", 45)
+    # functions compose with WHERE
+    assert tk.must_query(
+        "select id from t where quarter(dt) = 4") == [(2,)]
+
+
+def test_session_functions(tk):
+    r = tk.must_query("select version(), database(), user()")[0]
+    assert "TiDB" in r[0] and r[1] == "test" and "@" in r[2]
+    now = tk.must_query("select now(), curdate(), current_date")[0]
+    assert now[0][:4] == now[1][:4]
+    # NOW() keeps the statement out of the plan cache
+    h = tk.session.plan_cache_hits
+    tk.must_query("select now()")
+    tk.must_query("select now()")
+    assert tk.session.plan_cache_hits == h
+
+
+def test_review_edge_cases(tk):
+    # string GREATEST/LEAST compares strings, not dictionary codes
+    assert _one(tk, "select greatest(s, 'Zz'), least(s, 'Aa')") == \
+        ("Zz", "Aa")
+    # ROUND with NULL digits -> NULL
+    assert _one(tk, "select round(d, null)") == (None,)
+    # string literals coerce for date functions
+    assert tk.must_query(
+        "select dayofweek('2024-02-15'), last_day('2024-02-15'), "
+        "hour('26:30:00')")[0][0:1] == (5,)
+    r = tk.must_query("select hour('26:30:00'), hour('-01:30:00')")[0]
+    assert r == (26, 1)
+    # LPAD negative length -> NULL
+    assert tk.must_query("select lpad('hi', 0-1, 'x')") == [(None,)]
+
+
+def test_ci_collation_string_functions():
+    tk2 = TestKit()
+    tk2.must_exec("create table ci (s varchar(30) collate "
+                  "utf8mb4_general_ci)")
+    tk2.must_exec("insert into ci values ('Hello World')")
+    assert tk2.must_query(
+        "select locate('hello', s), instr(s, 'WORLD') from ci") == \
+        [(1, 7)]
+    assert tk2.must_query(
+        "select replace(s, 'WORLD', 'x') from ci") == [("Hello x",)]
+
+
+def test_functions_in_group_by_and_order(tk):
+    tk.must_exec("create table g (w varchar(10), v int)")
+    tk.must_exec("insert into g values ('aa',1),('AA',2),('bb',3)")
+    rows = tk.must_query(
+        "select upper(w), sum(v) from g group by upper(w) "
+        "order by upper(w)")
+    assert rows == [("AA", 3), ("BB", 3)]
+    rows = tk.must_query("select w from g order by lower(w), v")
+    assert rows == [("aa",), ("AA",), ("bb",)]

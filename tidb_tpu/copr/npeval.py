@@ -100,6 +100,86 @@ class NumpyEval:
             for i, s in enumerate(av):
                 out[i] = _substring(s, start, length)
             return out, avl
+        if op in ("greatest", "least"):
+            # string-domain comparison (numeric GREATEST lives in _call)
+            fn = max if op == "greatest" else min
+            parts = [self.eval_str(a) for a in A]
+            valid = parts[0][1].copy()
+            for _, vl in parts[1:]:
+                valid = valid & vl  # MySQL: any NULL -> NULL
+            out = np.array([fn(p[0][i] for p in parts)
+                            for i in range(self.n)], dtype=object)
+            return out, valid
+        if op in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+            av, avl = self.eval_str(A[0])
+            fn = {"upper": str.upper, "lower": str.lower,
+                  "trim": str.strip, "ltrim": str.lstrip,
+                  "rtrim": str.rstrip,
+                  "reverse": lambda s: s[::-1]}[op]
+            return (np.array([fn(s) for s in av], dtype=object), avl)
+        if op in ("concat", "concat_ws"):
+            parts = [self._any_str(a) for a in A]
+            n = self.n
+            if op == "concat":
+                # MySQL: any NULL argument -> NULL
+                valid = parts[0][1].copy()
+                for _, vl in parts[1:]:
+                    valid = valid & vl
+                out = np.array(
+                    ["".join(p[0][i] for p in parts) for i in range(n)],
+                    dtype=object)
+                return out, valid
+            sep, sep_ok = parts[0]
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                out[i] = sep[i].join(p[0][i] for p in parts[1:]
+                                     if p[1][i])  # NULL args skipped
+            return out, sep_ok
+        if op in ("left", "right", "repeat"):
+            av, avl = self.eval_str(A[0])
+            nv, nvl = self.eval(A[1])
+            out = np.empty(self.n, dtype=object)
+            for i, (s, k) in enumerate(zip(av, nv)):
+                k = max(int(k), 0)
+                out[i] = (s[:k] if op == "left" else
+                          s[-k:] if (op == "right" and k) else
+                          s * k if op == "repeat" else "")
+            return out, avl & nvl
+        if op == "replace":
+            av, avl = self.eval_str(A[0])
+            fv, fvl = self.eval_str(A[1])
+            tv, tvl = self.eval_str(A[2])
+            if any(a.ftype.is_ci for a in A):
+                import re as _re
+                out = np.array(
+                    [_re.sub(_re.escape(f), t.replace("\\", "\\\\"), s,
+                             flags=_re.IGNORECASE) if f else s
+                     for s, f, t in zip(av, fv, tv)], dtype=object)
+            else:
+                out = np.array([s.replace(f, t) if f else s
+                                for s, f, t in zip(av, fv, tv)],
+                               dtype=object)
+            return out, avl & fvl & tvl
+        if op in ("lpad", "rpad"):
+            av, avl = self.eval_str(A[0])
+            nv, nvl = self.eval(A[1])
+            pv, pvl = self.eval_str(A[2])
+            out = np.empty(self.n, dtype=object)
+            ok = avl & nvl & pvl
+            for i, (s, k, p) in enumerate(zip(av, nv, pv)):
+                k = int(k)
+                if k < 0:  # MySQL: negative length -> NULL
+                    out[i] = ""
+                    ok[i] = False
+                elif k < len(s):
+                    out[i] = s[:k]
+                elif not p:
+                    out[i] = s if k <= len(s) else ""
+                    ok[i] = ok[i] and k <= len(s)
+                else:
+                    pad = (p * ((k - len(s)) // len(p) + 1))[:k - len(s)]
+                    out[i] = pad + s if op == "lpad" else s + pad
+            return out, ok
         if op == "json_extract":
             av, avl = self.eval_str(A[0])
             out = np.full(self.n, "", dtype=object)
@@ -383,7 +463,205 @@ class NumpyEval:
                     out[i] = parts.index(s) + 1
             return out, nvl & hvl
 
+        if op in ("length", "char_length", "ascii"):
+            sv, svl = self.eval_str(A[0])
+            if op == "ascii":
+                out = np.array([ord(s[0]) if s else 0 for s in sv],
+                               np.int64)
+            elif op == "length":
+                out = np.array([len(s.encode("utf-8")) for s in sv],
+                               np.int64)
+            else:
+                out = np.array([len(s) for s in sv], np.int64)
+            return out, svl
+        if op == "locate":
+            nv, nvl = self.eval_str(A[0])
+            hv, hvl = self.eval_str(A[1])
+            if any(a.ftype.is_ci for a in A):
+                out = np.array(
+                    [h.casefold().find(sub.casefold()) + 1
+                     for sub, h in zip(nv, hv)], np.int64)
+            else:
+                out = np.array([h.find(sub) + 1
+                                for sub, h in zip(nv, hv)], np.int64)
+            return out, nvl & hvl
+
+        if op in ("round", "truncate"):
+            av, avl = self.eval(A[0])
+            d = int(e.extra or 0)
+            at = A[0].ftype
+            if at.is_float:
+                scaled = np.asarray(av, np.float64) * (10.0 ** d)
+                if op == "round":
+                    q = np.floor(np.abs(scaled) + 0.5)
+                else:
+                    q = np.floor(np.abs(scaled))
+                return np.where(scaled < 0, -q, q) / (10.0 ** d), avl
+            s = at.scale if at.is_decimal else 0
+            target = e.ftype.scale if e.ftype.is_decimal else 0
+            drop = s - max(target, 0) if s > max(target, 0) else 0
+            v = np.asarray(av, np.int64)
+            if drop > 0:
+                f = 10 ** drop
+                q = (np.abs(v) + (f // 2 if op == "round" else 0)) // f
+                v = np.where(v < 0, -q, q)
+            if d < 0:  # ROUND(x, -2): zero out low decimal digits
+                f = 10 ** (-d)
+                q = (np.abs(v) + (f // 2 if op == "round" else 0)) // f * f
+                v = np.where(v < 0, -q, q)
+            return v, avl
+        if op in ("floor", "ceil"):
+            av, avl = self.eval(A[0])
+            at = A[0].ftype
+            if at.is_float:
+                f = np.floor if op == "floor" else np.ceil
+                return f(np.asarray(av, np.float64)), avl
+            if at.is_decimal:
+                s = 10 ** at.scale
+                v = np.asarray(av, np.int64)
+                if op == "floor":
+                    return v // s, avl
+                return -((-v) // s), avl
+            return np.asarray(av, np.int64), avl
+        if op in ("sqrt", "exp", "ln", "log2", "log10"):
+            av, avl = self.eval(A[0])
+            f = _f(np.asarray(av), A[0].ftype)
+            fn = {"sqrt": np.sqrt, "exp": np.exp, "ln": np.log,
+                  "log2": np.log2, "log10": np.log10}[op]
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = fn(f)
+            ok = np.isfinite(out)  # MySQL: out-of-domain -> NULL
+            return np.where(ok, out, 0.0), avl & ok
+        if op == "log_base":
+            bv, bvl = self.eval(A[0])
+            xv, xvl = self.eval(A[1])
+            b = _f(np.asarray(bv), A[0].ftype)
+            x = _f(np.asarray(xv), A[1].ftype)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = np.log(x) / np.log(b)
+            ok = np.isfinite(out)
+            return np.where(ok, out, 0.0), bvl & xvl & ok
+        if op == "pow":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+            with np.errstate(invalid="ignore", over="ignore"):
+                out = np.power(_f(np.asarray(av), A[0].ftype),
+                               _f(np.asarray(bv), A[1].ftype))
+            ok = np.isfinite(out)
+            return np.where(ok, out, 0.0), avl & bvl & ok
+        if op == "sign":
+            av, avl = self.eval(A[0])
+            return np.sign(np.asarray(av)).astype(np.int64), avl
+        if op in ("greatest", "least"):
+            if e.ftype.is_string:
+                raise NotImplementedError(
+                    "string GREATEST/LEAST evaluates via eval_str")
+            fn = np.maximum if op == "greatest" else np.minimum
+            out_v, out_vl = None, None
+            for a in A:
+                v, vl = self.eval(a)
+                v = np.asarray(v)
+                if e.ftype.is_float:
+                    v = _f(v, a.ftype)
+                elif e.ftype.is_decimal:
+                    v = _rescale(v, a.ftype, e.ftype.scale)
+                if out_v is None:
+                    out_v, out_vl = v, vl
+                else:
+                    out_v = fn(out_v, v)
+                    out_vl = out_vl & vl  # MySQL: any NULL -> NULL
+            return out_v, out_vl
+
+        if op in ("dayofweek", "weekday", "dayofyear", "quarter"):
+            av, avl = self.eval(A[0])
+            days = np.asarray(av, np.int64)
+            if A[0].ftype.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+                days = days // 86_400_000_000
+            if op == "dayofweek":   # 1 = Sunday (1970-01-01 is Thursday)
+                return (days + 4) % 7 + 1, avl
+            if op == "weekday":     # 0 = Monday
+                return (days + 3) % 7, avl
+            y, m, d = _civil(days)
+            if op == "quarter":
+                return ((m - 1) // 3 + 1).astype(np.int64), avl
+            jan1 = _days_from_civil(y, np.ones_like(m), np.ones_like(d))
+            return days - jan1 + 1, avl
+        if op in ("hour", "minute", "second"):
+            av, avl = self.eval(A[0])
+            us = np.asarray(av, np.int64)
+            if A[0].ftype.kind == TypeKind.TIME:
+                # TIME is a signed duration: components of |t|, hours
+                # unbounded (MySQL HOUR('-26:30:00') = 26)
+                sec = np.abs(us) // 1_000_000
+                if op == "hour":
+                    return sec // 3600, avl
+            else:
+                sec = us // 1_000_000
+                if op == "hour":
+                    return (sec // 3600) % 24, avl
+            if op == "minute":
+                return (sec // 60) % 60, avl
+            return sec % 60, avl
+        if op == "to_date":
+            av, avl = self.eval(A[0])
+            v = np.asarray(av, np.int64)
+            if A[0].ftype.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+                v = v // 86_400_000_000
+            return v.astype(np.int32), avl
+        if op == "last_day":
+            av, avl = self.eval(A[0])
+            days = np.asarray(av, np.int64)
+            if A[0].ftype.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+                days = days // 86_400_000_000
+            y, m, _d = _civil(days)
+            ny = np.where(m == 12, y + 1, y)
+            nm = np.where(m == 12, 1, m + 1)
+            nxt = _days_from_civil(ny, nm, np.ones_like(nm))
+            return (nxt - 1).astype(np.int32), avl
+        if op == "datediff":
+            av, avl = self.eval(A[0])
+            bv, bvl = self.eval(A[1])
+
+            def to_days(v, ft):
+                v = np.asarray(v, np.int64)
+                if ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+                    v = v // 86_400_000_000
+                return v
+            return (to_days(av, A[0].ftype) - to_days(bv, A[1].ftype),
+                    avl & bvl)
+
         raise NotImplementedError(f"host eval: {op}")
+
+    def _any_str(self, a: PlanExpr) -> VV:
+        """Any-typed expression stringified MySQL-style (CONCAT coercion:
+        ints plain, decimals at column scale, dates ISO)."""
+        if a.ftype.is_string:
+            return self.eval_str(a)
+        v, vl = self.eval(a)
+        v = np.asarray(v)
+        ft = a.ftype
+        out = np.empty(self.n, dtype=object)
+        if ft.is_decimal:
+            from ..types.value import Decimal as _D
+            s = ft.scale
+            for i, x in enumerate(v):
+                out[i] = str(_D(int(x), s))
+        elif ft.kind == TypeKind.DATE:
+            from ..types.value import decode_date
+            for i, x in enumerate(v):
+                out[i] = decode_date(int(x)).isoformat()
+        elif ft.kind in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+            from ..types.value import decode_datetime
+            for i, x in enumerate(v):
+                out[i] = decode_datetime(int(x)).isoformat(" ")
+        elif ft.is_float:
+            for i, x in enumerate(v):
+                f = float(x)
+                out[i] = repr(f) if not f.is_integer() else str(int(f))
+        else:
+            for i, x in enumerate(v):
+                out[i] = str(int(x))
+        return out, np.asarray(vl)
 
     def _compare(self, e: Call) -> VV:
         op = e.op
@@ -609,6 +887,21 @@ def _align(at: FieldType, av, bt: FieldType, bv):
     elif sb < sa:
         bv = bv.astype(np.int64) * 10 ** (sa - sb)
     return av, bv
+
+
+def _days_from_civil(y: np.ndarray, m: np.ndarray,
+                     d: np.ndarray) -> np.ndarray:
+    """(year, month, day) -> days since 1970-01-01 (inverse of _civil;
+    Hinnant's days_from_civil)."""
+    y = np.asarray(y, np.int64) - (np.asarray(m, np.int64) <= 2)
+    m = np.asarray(m, np.int64)
+    d = np.asarray(d, np.int64)
+    era = np.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = (m + 9) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146_097 + doe - 719_468
 
 
 def _civil(z: np.ndarray):

@@ -65,6 +65,45 @@ def ast_key(node: object) -> str:
     return repr(node).lower()
 
 
+def _coerce_date_arg(a: PlanExpr, fname: str) -> PlanExpr:
+    """Date-bearing argument: DATE/DATETIME/TIMESTAMP columns pass
+    through; string literals parse (reference: implicit temporal casts,
+    types/convert.go). TIME is a duration, not a calendar point."""
+    from ..types.field_type import TypeKind as _TK
+
+    if a.ftype.is_string and isinstance(a, Const) and a.value is not None:
+        from ..types.value import parse_date, parse_datetime
+        s = str(a.value)
+        try:
+            if " " in s or "T" in s:
+                return Const(parse_datetime(s),
+                             FieldType(_TK.DATETIME))
+            return Const(parse_date(s), FieldType(_TK.DATE))
+        except ValueError:
+            raise PlanError(
+                f"invalid date literal {s!r} for {fname}") from None
+    if a.ftype.kind in (_TK.DATE, _TK.DATETIME, _TK.TIMESTAMP):
+        return a
+    raise PlanError(f"{fname} requires a date argument")
+
+
+def _parse_time_us(s: str) -> int:
+    """'[-]HH:MM:SS[.ffffff]' -> signed microseconds (TIME domain)."""
+    neg = s.startswith("-")
+    body = s[1:] if neg else s
+    parts = body.split(":")
+    if len(parts) != 3:
+        raise PlanError(f"invalid TIME literal {s!r}")
+    try:
+        h = int(parts[0])
+        m = int(parts[1])
+        sec = float(parts[2])
+    except ValueError:
+        raise PlanError(f"invalid TIME literal {s!r}") from None
+    us = int(round((h * 3600 + m * 60 + sec) * 1_000_000))
+    return -us if neg else us
+
+
 class PlanBuilder:
     def __init__(self, catalog: Catalog, current_db: str = "test") -> None:
         self.catalog = catalog
@@ -1112,7 +1151,142 @@ class PlanBuilder:
         if name == "FIND_IN_SET":
             need(2)
             return Call("find_in_set", args, FieldType(TypeKind.BIGINT))
+        out = self._resolve_builtin(name, args, need)
+        if out is not None:
+            return out
         raise PlanError(f"unsupported function {name}")
+
+    def _resolve_builtin(self, name: str, args: list[PlanExpr],
+                         need) -> Optional[PlanExpr]:
+        """The everyday MySQL scalar library (reference:
+        expression/builtin_string.go / builtin_math.go /
+        builtin_time.go / builtin_compare.go — host-evaluated here, the
+        device gate keeps them off the pushdown path)."""
+        from ..types.field_type import varchar_type as _vt
+
+        bigint = FieldType(TypeKind.BIGINT)
+        double = FieldType(TypeKind.DOUBLE)
+
+        # ---- string functions ----
+        if name in ("UPPER", "UCASE", "LOWER", "LCASE", "TRIM", "LTRIM",
+                    "RTRIM", "REVERSE"):
+            need(1)
+            op = {"UPPER": "upper", "UCASE": "upper", "LOWER": "lower",
+                  "LCASE": "lower", "TRIM": "trim", "LTRIM": "ltrim",
+                  "RTRIM": "rtrim", "REVERSE": "reverse"}[name]
+            return (Call(op, args, _vt()))
+        if name in ("CONCAT", "CONCAT_WS"):
+            if len(args) < (2 if name == "CONCAT_WS" else 1):
+                raise PlanError(f"{name} needs more arguments")
+            return (Call(name.lower(), args, _vt()))
+        if name in ("LEFT", "RIGHT", "REPEAT"):
+            need(2)
+            return (Call(name.lower(), args, _vt()))
+        if name == "REPLACE":
+            need(3)
+            return (Call("replace", args, _vt()))
+        if name in ("LPAD", "RPAD"):
+            need(3)
+            return (Call(name.lower(), args, _vt()))
+        if name in ("LENGTH", "CHAR_LENGTH", "CHARACTER_LENGTH",
+                    "OCTET_LENGTH", "ASCII"):
+            need(1)
+            op = {"LENGTH": "length", "OCTET_LENGTH": "length",
+                  "CHAR_LENGTH": "char_length",
+                  "CHARACTER_LENGTH": "char_length",
+                  "ASCII": "ascii"}[name]
+            return Call(op, args, bigint)
+        if name in ("LOCATE", "INSTR"):
+            need(2)
+            if name == "INSTR":  # INSTR(str, substr) = LOCATE(substr, str)
+                args = [args[1], args[0]]
+            return Call("locate", args, bigint)
+
+        # ---- math functions ----
+        if name in ("ROUND", "TRUNCATE"):
+            if len(args) not in (1, 2):
+                raise PlanError(f"{name} expects 1 or 2 arguments")
+            d = 0
+            if len(args) == 2:
+                if not isinstance(args[1], Const):
+                    raise PlanError(f"{name} digits must be constant")
+                if args[1].value is None:  # MySQL: NULL digits -> NULL
+                    return Const(None, args[0].ftype)
+                d = int(args[1].value)
+            at = args[0].ftype
+            if at.is_float:
+                ft = double
+            elif at.is_decimal:
+                ft = FieldType(TypeKind.DECIMAL, flen=at.flen,
+                               scale=max(0, min(d, at.scale)))
+            else:
+                ft = bigint
+            return Call(name.lower(), [args[0]], ft, extra=d)
+        if name in ("FLOOR", "CEIL", "CEILING"):
+            need(1)
+            ft = double if args[0].ftype.is_float else bigint
+            op = "floor" if name == "FLOOR" else "ceil"
+            return Call(op, args, ft)
+        if name in ("SQRT", "EXP", "LN", "LOG2", "LOG10"):
+            need(1)
+            return Call(name.lower(), args, double)
+        if name == "LOG":
+            if len(args) == 1:
+                return Call("ln", args, double)
+            need(2)  # LOG(base, x)
+            return Call("log_base", args, double)
+        if name in ("POW", "POWER"):
+            need(2)
+            return Call("pow", args, double)
+        if name == "SIGN":
+            need(1)
+            return Call("sign", args, bigint)
+        if name == "PI":
+            need(0)
+            import math
+            return Const(math.pi, double)
+        if name in ("GREATEST", "LEAST"):
+            if len(args) < 2:
+                raise PlanError(f"{name} needs at least 2 arguments")
+            ft = args[0].ftype
+            for a in args[1:]:
+                ft = _unify_types(ft, a.ftype)
+            return Call(name.lower(), args, ft)
+        if name == "NULLIF":
+            need(2)
+            # NULLIF(a, b) = IF(a = b, NULL, a)
+            cond = self._resolve_cmp("eq", args[0], args[1])
+            return Call("if", [cond, Const(None, args[0].ftype),
+                               args[0]], args[0].ftype)
+
+        # ---- date/time functions ----
+        if name in ("DAYOFWEEK", "WEEKDAY", "DAYOFYEAR", "QUARTER"):
+            need(1)
+            a = _coerce_date_arg(args[0], name)
+            return Call(name.lower(), [a], bigint)
+        if name in ("HOUR", "MINUTE", "SECOND"):
+            need(1)
+            a = args[0]
+            if a.ftype.is_string and isinstance(a, Const):
+                a = Const(_parse_time_us(str(a.value)),
+                          FieldType(TypeKind.TIME))
+            if a.ftype.kind not in (TypeKind.DATETIME,
+                                    TypeKind.TIMESTAMP, TypeKind.TIME):
+                raise PlanError(f"{name} requires a time argument")
+            return Call(name.lower(), [a], bigint)
+        if name == "DATE":
+            need(1)
+            a = _coerce_date_arg(args[0], name)
+            return Call("to_date", [a], FieldType(TypeKind.DATE))
+        if name == "LAST_DAY":
+            need(1)
+            a = _coerce_date_arg(args[0], name)
+            return Call("last_day", [a], FieldType(TypeKind.DATE))
+        if name == "DATEDIFF":
+            need(2)
+            coerced = [_coerce_date_arg(a, name) for a in args]
+            return Call("datediff", coerced, bigint)
+        return None
 
     def _resolve_case(
         self, node: ast.Case, r: Callable[[ast.Expr], PlanExpr]

@@ -418,9 +418,43 @@ class Session:
                 return lit(self._sysvar_value(n.name, n.scope))
             if isinstance(n, ast.UserVarExpr):
                 return lit(self.user_vars.get(n.name))
+            if isinstance(n, ast.FuncCall) and n.name in _SESSION_FUNCS:
+                return lit(self._session_func_value(n))
+            if isinstance(n, ast.ColumnRef) and n.table is None and \
+                    n.name.upper() in _NILADIC_FUNCS:
+                # bare CURRENT_DATE etc. — reserved niladic functions
+                return lit(self._session_func_value(
+                    ast.FuncCall(n.name.upper(), [])))
             return n
 
         return ast.transform(node, fn)
+
+    def _session_func_value(self, n: ast.FuncCall) -> Any:
+        """Session-dependent function -> value at statement-bind time
+        (reference: these evaluate against the session context,
+        expression/builtin_info.go + builtin_time.go nondeterministic
+        set; binding keeps them out of the plan cache)."""
+        import time as _time
+
+        name = n.name
+        if name in ("NOW", "CURRENT_TIMESTAMP", "SYSDATE",
+                    "LOCALTIME", "LOCALTIMESTAMP"):
+            return _time.strftime("%Y-%m-%d %H:%M:%S")
+        if name in ("CURDATE", "CURRENT_DATE"):
+            return _time.strftime("%Y-%m-%d")
+        if name in ("CURTIME", "CURRENT_TIME"):
+            return _time.strftime("%H:%M:%S")
+        if name == "UNIX_TIMESTAMP" and not n.args:
+            return int(_time.time())
+        if name == "VERSION":
+            return str(self._sysvar_value("version"))
+        if name in ("DATABASE", "SCHEMA"):
+            return self.current_db
+        if name in ("USER", "CURRENT_USER", "SESSION_USER"):
+            return f"{self.user or 'root'}@%"
+        if name == "CONNECTION_ID":
+            return getattr(self, "connection_id", 0)
+        raise SQLError(f"unsupported function {name}")
 
     @staticmethod
     def _has_var_reads(node) -> bool:
@@ -429,6 +463,17 @@ class Session:
         def visit(n):
             nonlocal found
             if isinstance(n, (ast.SysVarExpr, ast.UserVarExpr)):
+                found = True
+                return False
+            if isinstance(n, ast.FuncCall) and \
+                    n.name in _SESSION_FUNCS:
+                # session-dependent/nondeterministic functions bind to
+                # literals before planning (and keep the statement out
+                # of the plan cache)
+                found = True
+                return False
+            if isinstance(n, ast.ColumnRef) and n.table is None and \
+                    n.name.upper() in _NILADIC_FUNCS:
                 found = True
                 return False
             return None
@@ -1606,6 +1651,22 @@ class Session:
             return [(info, self.storage.table_store(info.id))]
         return [(Storage.child_table_info(info, d),
                  self.storage.table_store(d.id)) for d in part.defs]
+
+
+# functions whose value depends on the session/clock: bound to literals
+# pre-planning and excluded from the plan cache
+_SESSION_FUNCS = frozenset({
+    "NOW", "CURRENT_TIMESTAMP", "SYSDATE", "LOCALTIME", "LOCALTIMESTAMP",
+    "CURDATE", "CURRENT_DATE", "CURTIME", "CURRENT_TIME",
+    "VERSION", "DATABASE", "SCHEMA", "USER", "CURRENT_USER",
+    "SESSION_USER", "CONNECTION_ID", "UNIX_TIMESTAMP",
+})
+
+# reserved words usable WITHOUT parentheses (MySQL niladic functions)
+_NILADIC_FUNCS = frozenset({
+    "CURRENT_DATE", "CURRENT_TIME", "CURRENT_TIMESTAMP", "CURRENT_USER",
+    "LOCALTIME", "LOCALTIMESTAMP",
+})
 
 
 def _like_match(pattern: Optional[str], s: str) -> bool:
