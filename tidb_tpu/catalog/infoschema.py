@@ -97,6 +97,29 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)),
     ],
+    "key_column_usage": [
+        ("constraint_catalog", _vc()), ("constraint_schema", _vc()),
+        ("constraint_name", _vc()), ("table_catalog", _vc()),
+        ("table_schema", _vc()), ("table_name", _vc()),
+        ("column_name", _vc()), ("ordinal_position", _bigint()),
+        ("position_in_unique_constraint", _bigint()),
+        ("referenced_table_schema", _vc()),
+        ("referenced_table_name", _vc()),
+        ("referenced_column_name", _vc()),
+    ],
+    "referential_constraints": [
+        ("constraint_catalog", _vc()), ("constraint_schema", _vc()),
+        ("constraint_name", _vc()),
+        ("unique_constraint_schema", _vc()),
+        ("update_rule", _vc(16)), ("delete_rule", _vc(16)),
+        ("table_name", _vc()), ("referenced_table_name", _vc()),
+    ],
+    "sequences": [
+        ("sequence_schema", _vc()), ("sequence_name", _vc()),
+        ("start_value", _bigint()), ("increment", _bigint()),
+        ("min_value", _bigint()), ("max_value", _bigint()),
+        ("cycle", _bigint()),
+    ],
     "partitions": [
         ("table_catalog", _vc()), ("table_schema", _vc()),
         ("table_name", _vc()), ("partition_name", _vc()),
@@ -202,6 +225,39 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
         rows.append(["utf8mb4_general_ci", "utf8mb4", 45, "", "Yes", 1])
     elif tname == "character_sets":
         rows.append(["utf8mb4", "utf8mb4_bin", "UTF-8 Unicode", 4])
+    elif tname == "key_column_usage":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                for ix in t.indices:
+                    if not (ix.unique or ix.primary):
+                        continue
+                    cname = "PRIMARY" if ix.primary else ix.name
+                    for seq, off in enumerate(ix.col_offsets):
+                        rows.append(["def", s.name, cname, "def", s.name,
+                                     t.name, t.columns[off].name, seq + 1,
+                                     None, None, None, None])
+                for fk in getattr(t, "foreign_keys", []) or []:
+                    for seq, off in enumerate(fk.col_offsets):
+                        ref_col = fk.ref_cols[seq] \
+                            if seq < len(fk.ref_cols) else None
+                        rows.append(["def", s.name, fk.name, "def",
+                                     s.name, t.name, t.columns[off].name,
+                                     seq + 1, seq + 1, fk.ref_db,
+                                     fk.ref_table, ref_col])
+    elif tname == "referential_constraints":
+        for s in user_schemas:
+            for t in sorted(s.tables.values(), key=lambda t: t.name):
+                for fk in getattr(t, "foreign_keys", []) or []:
+                    rows.append(["def", s.name, fk.name, fk.ref_db,
+                                 fk.on_update, fk.on_delete, t.name,
+                                 fk.ref_table])
+    elif tname == "sequences":
+        for s in user_schemas:
+            for seq in sorted((getattr(s, "sequences", {}) or {})
+                              .values(), key=lambda x: x.name):
+                rows.append([s.name, seq.name, seq.start, seq.increment,
+                             seq.min_value, seq.max_value,
+                             1 if seq.cycle else 0])
     elif tname == "partitions":
         for s in user_schemas:
             for t in sorted(s.tables.values(), key=lambda t: t.name):

@@ -44,6 +44,38 @@ class IndexInfo:
 
 
 @dataclass
+class FKInfo:
+    """Foreign-key metadata (reference: model.FKInfo; the v5.0 reference
+    PARSES and stores FK constraints but does not enforce them —
+    ddl/foreign_key.go builds metadata only, foreign_key_checks defaults
+    off. Same here: catalog + information_schema surface, no runtime
+    enforcement)."""
+
+    name: str
+    col_offsets: list[int]
+    ref_db: str
+    ref_table: str
+    ref_cols: list[str]
+    on_delete: str = "RESTRICT"  # RESTRICT|CASCADE|SET NULL|NO ACTION
+    on_update: str = "RESTRICT"
+
+
+@dataclass
+class SequenceInfo:
+    """CREATE SEQUENCE state (reference: model.SequenceInfo +
+    ddl/sequence.go; TiDB's MariaDB-compatible sequences)."""
+
+    id: int
+    name: str
+    start: int = 1
+    increment: int = 1
+    min_value: int = 1
+    max_value: int = (1 << 63) - 1
+    cycle: bool = False
+    next_value: int = 1
+
+
+@dataclass
 class PartitionDef:
     """One partition: own table id = own physical TableStore + KV range
     (reference: model.PartitionDefinition — each partition is a physical
@@ -103,6 +135,8 @@ class TableInfo:
     # getattr(info, 'partition', None) where old pickled catalogs may
     # lack the field.
     partition: Optional[PartitionInfo] = None
+    # foreign-key constraints (metadata only; see FKInfo)
+    foreign_keys: list = field(default_factory=list)
 
     def column_by_name(self, name: str) -> Optional[ColumnInfo]:
         lname = name.lower()
@@ -120,6 +154,7 @@ class TableInfo:
 class SchemaInfo:
     name: str
     tables: dict[str, TableInfo] = field(default_factory=dict)  # lower-name keyed
+    sequences: dict[str, SequenceInfo] = field(default_factory=dict)
 
 
 class Catalog:

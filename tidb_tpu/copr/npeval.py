@@ -499,15 +499,19 @@ class NumpyEval:
                 return np.where(scaled < 0, -q, q) / (10.0 ** d), avl
             s = at.scale if at.is_decimal else 0
             target = e.ftype.scale if e.ftype.is_decimal else 0
-            drop = s - max(target, 0) if s > max(target, 0) else 0
             v = np.asarray(av, np.int64)
+            if d < 0:
+                # single division covering both the scale drop and the
+                # coarse digits (two-step rounding would compound:
+                # ROUND(44.5, -1) must be 40, not 50)
+                f = 10 ** (s - d)
+                q = (np.abs(v) + (f // 2 if op == "round" else 0)) // f
+                q = q * 10 ** (-d)
+                return np.where(v < 0, -q, q), avl
+            drop = s - max(target, 0) if s > max(target, 0) else 0
             if drop > 0:
                 f = 10 ** drop
                 q = (np.abs(v) + (f // 2 if op == "round" else 0)) // f
-                v = np.where(v < 0, -q, q)
-            if d < 0:  # ROUND(x, -2): zero out low decimal digits
-                f = 10 ** (-d)
-                q = (np.abs(v) + (f // 2 if op == "round" else 0)) // f * f
                 v = np.where(v < 0, -q, q)
             return v, avl
         if op in ("floor", "ceil"):

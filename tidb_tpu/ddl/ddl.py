@@ -98,6 +98,18 @@ class DDL:
         return job
 
     def run_job(self, job: DDLJob) -> None:
+        """Drive one job to completion as the DDL owner (reference: the
+        owner-gated worker loop, ddl_worker.go:419; ownership comes from
+        the election manager — mock in-process, flock across processes
+        sharing a durable dir)."""
+        owner = getattr(self.storage, "ddl_owner", None)
+        if owner is None:
+            self._run_job_steps(job)
+            return
+        with owner:
+            self._run_job_steps(job)
+
+    def _run_job_steps(self, job: DDLJob) -> None:
         while not self.step(job):
             pass
         if job.state == ROLLED_BACK:

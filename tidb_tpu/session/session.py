@@ -262,6 +262,10 @@ class Session:
             return ResultSet([], [])
         if isinstance(stmt, ast.TruncateTableStmt):
             return self._exec_truncate(stmt)
+        if isinstance(stmt, ast.CreateSequenceStmt):
+            return self._exec_create_sequence(stmt)
+        if isinstance(stmt, ast.DropSequenceStmt):
+            return self._exec_drop_sequence(stmt)
         if isinstance(stmt, ast.UseStmt):
             from ..catalog import infoschema as I
             if stmt.db.lower() == I.DB_NAME:
@@ -454,6 +458,25 @@ class Session:
             return f"{self.user or 'root'}@%"
         if name == "CONNECTION_ID":
             return getattr(self, "connection_id", 0)
+        if name == "NEXTVAL":
+            if len(n.args) != 1:
+                raise SQLError("NEXTVAL takes a sequence name")
+            seq = self._sequence_for(n.args[0])
+            try:
+                v = self.storage.sequence_next(seq)
+            except ValueError as e:
+                raise SQLError(str(e)) from None
+            self._seq_lastval = v
+            return v
+        if name == "LASTVAL":
+            return getattr(self, "_seq_lastval", None)
+        if name == "SETVAL":
+            if len(n.args) != 2 or not isinstance(n.args[1], ast.Literal):
+                raise SQLError("SETVAL takes (sequence, constant)")
+            seq = self._sequence_for(n.args[0])
+            v = int(n.args[1].value)
+            self.storage.sequence_set(seq, v)
+            return v
         raise SQLError(f"unsupported function {name}")
 
     @staticmethod
@@ -489,9 +512,45 @@ class Session:
         if has_vars is None:
             has_vars = self._has_var_reads(stmt)
         if has_vars:
+            self._guard_per_row_sequences(stmt)
             import copy as _copy
             return self._bind_vars(_copy.deepcopy(stmt))
         return stmt
+
+    def _guard_per_row_sequences(self, stmt) -> None:
+        """NEXTVAL binds once per statement, so any per-row context
+        would hand every row the same value — reject loudly instead of
+        silently duplicating ids (reference evaluates sequences per row
+        through expression/builtin_other.go; VALUES lists are fine here
+        because each row's FuncCall node binds separately)."""
+        def contains_seq(node) -> bool:
+            hit = False
+
+            def v(n):
+                nonlocal hit
+                if isinstance(n, ast.FuncCall) and \
+                        n.name in ("NEXTVAL", "SETVAL"):
+                    hit = True
+                    return False
+                return None
+
+            ast.walk(node, v)
+            return hit
+
+        def visit(n):
+            if isinstance(n, ast.SelectStmt) and n.from_ is not None \
+                    and contains_seq(n):
+                raise SQLError(
+                    "NEXTVAL/SETVAL in per-row contexts (SELECT with "
+                    "FROM, INSERT ... SELECT) is unsupported")
+            if isinstance(n, ast.UpdateStmt) and any(
+                    contains_seq(a.value) for a in n.assignments):
+                raise SQLError(
+                    "NEXTVAL/SETVAL in UPDATE assignments is "
+                    "unsupported")
+            return None
+
+        ast.walk(stmt, visit)
 
     # ==================== privileges ====================
     def _require_super(self) -> None:
@@ -1343,6 +1402,26 @@ class Session:
         if stmt.partition_by is not None:
             partition = self._build_partition_info(
                 stmt.partition_by, columns, indices, pk_handle)
+        # FK metadata: stored and surfaced, not enforced — exactly the
+        # v5.0 reference's behavior (ddl/foreign_key.go builds FKInfo;
+        # no runtime checks; foreign_key_checks defaults off)
+        from ..catalog.schema import FKInfo
+        fk_infos = []
+        for i, fk in enumerate(getattr(stmt, "foreign_keys", []) or []):
+            offs = []
+            for cn in fk.columns:
+                hit = next((c for c in columns
+                            if c.name.lower() == cn.lower()), None)
+                if hit is None:
+                    raise SQLError(f"unknown column {cn} in foreign key")
+                offs.append(hit.offset)
+            if len(offs) != len(fk.ref_columns):
+                raise SQLError(
+                    "foreign key column count mismatch")
+            fk_infos.append(FKInfo(
+                fk.name or f"fk_{stmt.table.name}_{i + 1}", offs,
+                (fk.ref_table.db or db).lower(), fk.ref_table.name,
+                list(fk.ref_columns), fk.on_delete, fk.on_update))
         info = TableInfo(
             id=self.catalog.alloc_id(),
             name=stmt.table.name,
@@ -1350,6 +1429,7 @@ class Session:
             indices=indices,
             pk_handle_offset=pk_handle,
             partition=partition,
+            foreign_keys=fk_infos,
         )
         try:
             created = self.catalog.add_table(db, info, stmt.if_not_exists)
@@ -1428,6 +1508,53 @@ class Session:
                     self.storage.stats.drop_table(tid)
                     self.storage.destroy_table_data(tid)
         return ResultSet([], [])
+
+    # ==================== sequences ====================
+    def _exec_create_sequence(self, stmt: ast.CreateSequenceStmt
+                              ) -> ResultSet:
+        from ..catalog.schema import SequenceInfo
+
+        db = stmt.name.db or self.current_db
+        schema = self.catalog.schema(db)
+        seqs = getattr(schema, "sequences", None)
+        if seqs is None:  # catalogs pickled before the field existed
+            schema.sequences = seqs = {}
+        key = stmt.name.name.lower()
+        if key in seqs or self.catalog.try_table(db, stmt.name.name):
+            if stmt.if_not_exists:
+                return ResultSet([], [])
+            raise SQLError(f"table exists: {db}.{stmt.name.name}")
+        seqs[key] = SequenceInfo(
+            id=self.catalog.alloc_id(), name=stmt.name.name,
+            start=stmt.start, increment=stmt.increment,
+            min_value=stmt.min_value, max_value=stmt.max_value,
+            cycle=stmt.cycle, next_value=stmt.start)
+        self.catalog.bump_version()
+        return ResultSet([], [])
+
+    def _exec_drop_sequence(self, stmt: ast.DropSequenceStmt) -> ResultSet:
+        for tn in stmt.names:
+            db = tn.db or self.current_db
+            schema = self.catalog.schema(db)
+            seqs = getattr(schema, "sequences", {}) or {}
+            if tn.name.lower() not in seqs:
+                if stmt.if_exists:
+                    continue
+                raise SQLError(f"unknown table: {db}.{tn.name}")
+            del seqs[tn.name.lower()]
+        self.catalog.bump_version()
+        return ResultSet([], [])
+
+    def _sequence_for(self, node) -> "SequenceInfo":
+        if not isinstance(node, ast.ColumnRef):
+            raise SQLError("sequence functions take a sequence name")
+        db = node.table or self.current_db
+        schema = self.catalog.schema(db)
+        seq = (getattr(schema, "sequences", {}) or {}).get(
+            node.name.lower())
+        if seq is None:
+            raise SQLError(f"unknown sequence: {db}.{node.name}")
+        return seq
 
     def _exec_truncate(self, stmt: ast.TruncateTableStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)
@@ -1539,11 +1666,24 @@ class Session:
         if stmt.kind == "CREATE_TABLE":
             assert stmt.target is not None
             info, _ = self._table_for(stmt.target)
-            cols = ",\n  ".join(
-                f"`{c.name}` {c.ftype!r}{'' if c.ftype.nullable else ' NOT NULL'}"
+            lines = [
+                f"`{c.name}` {c.ftype!r}"
+                f"{'' if c.ftype.nullable else ' NOT NULL'}"
                 for c in info.columns
-            )
-            ddl = f"CREATE TABLE `{info.name}` (\n  {cols}\n)"
+            ]
+            for fk in getattr(info, "foreign_keys", []) or []:
+                cols_s = ", ".join(f"`{info.columns[o].name}`"
+                                   for o in fk.col_offsets)
+                refs = ", ".join(f"`{c}`" for c in fk.ref_cols)
+                lines.append(
+                    f"CONSTRAINT `{fk.name}` FOREIGN KEY ({cols_s}) "
+                    f"REFERENCES `{fk.ref_table}` ({refs})"
+                    + (f" ON DELETE {fk.on_delete}"
+                       if fk.on_delete != "RESTRICT" else "")
+                    + (f" ON UPDATE {fk.on_update}"
+                       if fk.on_update != "RESTRICT" else ""))
+            body = ",\n  ".join(lines)
+            ddl = f"CREATE TABLE `{info.name}` (\n  {body}\n)"
             return ResultSet(["Table", "Create Table"], [(info.name, ddl)])
         if stmt.kind == "VARIABLES":
             vals = dict(self.storage.sysvars.all_globals())
@@ -1660,6 +1800,7 @@ _SESSION_FUNCS = frozenset({
     "CURDATE", "CURRENT_DATE", "CURTIME", "CURRENT_TIME",
     "VERSION", "DATABASE", "SCHEMA", "USER", "CURRENT_USER",
     "SESSION_USER", "CONNECTION_ID", "UNIX_TIMESTAMP",
+    "NEXTVAL", "LASTVAL", "SETVAL",
 })
 
 # reserved words usable WITHOUT parentheses (MySQL niladic functions)

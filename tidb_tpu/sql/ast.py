@@ -327,6 +327,36 @@ class IndexDef:
 
 
 @dataclass
+class FKDef:
+    """FOREIGN KEY clause (reference: ast.Constraint with
+    ConstraintForeignKey refs)."""
+
+    name: Optional[str]
+    columns: list[str]
+    ref_table: "TableName"
+    ref_columns: list[str]
+    on_delete: str = "RESTRICT"
+    on_update: str = "RESTRICT"
+
+
+@dataclass
+class CreateSequenceStmt(Stmt):
+    name: "TableName"
+    start: int = 1
+    increment: int = 1
+    min_value: int = 1
+    max_value: int = (1 << 63) - 1
+    cycle: bool = False
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequenceStmt(Stmt):
+    names: list["TableName"]
+    if_exists: bool = False
+
+
+@dataclass
 class PartitionByDef:
     """PARTITION BY clause (reference: ast.PartitionOptions)."""
 
@@ -344,6 +374,7 @@ class CreateTableStmt(Stmt):
     indices: list[IndexDef] = field(default_factory=list)
     if_not_exists: bool = False
     partition_by: Optional[PartitionByDef] = None
+    foreign_keys: list = field(default_factory=list)  # [FKDef]
 
 
 @dataclass

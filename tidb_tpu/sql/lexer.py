@@ -68,6 +68,7 @@ KEYWORDS = frozenset(
     GLOBAL SESSION VARIABLES STATUS SCHEMAS WARNINGS ERRORS ENGINES
     COLLATION COLUMNS FIELDS INDEXES KEYS NAMES
     GRANT REVOKE USER IDENTIFIED PRIVILEGES GRANTS
+    CONSTRAINT FOREIGN REFERENCES
     FOR
     ADMIN DDL JOBS
     OVER PARTITION ROWS RANGE UNBOUNDED PRECEDING FOLLOWING CURRENT ROW

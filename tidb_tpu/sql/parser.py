@@ -583,14 +583,21 @@ class Parser:
                                        self._paren_ident_list(), unique)
         if unique:
             raise ParseError("expected INDEX after CREATE UNIQUE", self.cur)
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "SEQUENCE":
+            self.advance()
+            return self._parse_create_sequence()
         self.expect_kw("TABLE")
         ine = self._if_not_exists()
         table = self.parse_table_name()
         self.expect_op("(")
         columns: list[ast.ColumnDef] = []
         indices: list[ast.IndexDef] = []
+        fks: list[ast.FKDef] = []
         while True:
-            if self.cur.is_kw("PRIMARY"):
+            if self.cur.is_kw("CONSTRAINT", "FOREIGN"):
+                fks.append(self._parse_fk_clause())
+            elif self.cur.is_kw("PRIMARY"):
                 self.advance()
                 self.expect_kw("KEY")
                 cols = self._paren_ident_list()
@@ -617,8 +624,104 @@ class Parser:
                 partition_by = self._parse_partition_by()
                 break
             self.advance()
+        # column-level REFERENCES lift into table-level FK metadata
+        for cd in columns:
+            ref = getattr(cd, "references", None)
+            if ref is not None:
+                fks.append(ast.FKDef(None, [cd.name], ref[0], ref[1]))
         return ast.CreateTableStmt(table, columns, indices, ine,
-                                   partition_by)
+                                   partition_by, fks)
+
+    def _parse_fk_clause(self) -> ast.FKDef:
+        """[CONSTRAINT [name]] FOREIGN KEY (cols) REFERENCES tbl (cols)
+        [ON DELETE action] [ON UPDATE action]."""
+        name = None
+        if self.accept_kw("CONSTRAINT"):
+            if self.cur.kind == TokenKind.IDENT:
+                name = self.advance().text
+        self.expect_kw("FOREIGN")
+        self.expect_kw("KEY")
+        if self.cur.kind == TokenKind.IDENT:  # optional index name
+            name = name or self.advance().text
+        cols = self._paren_ident_list()
+        self.expect_kw("REFERENCES")
+        ref_table = self.parse_table_name()
+        ref_cols = self._paren_ident_list()
+        on_delete = on_update = "RESTRICT"
+        while self.accept_kw("ON"):
+            which = self.expect_kw("DELETE", "UPDATE").text
+            action = self._parse_fk_action()
+            if which == "DELETE":
+                on_delete = action
+            else:
+                on_update = action
+        return ast.FKDef(name, cols, ref_table, ref_cols,
+                         on_delete, on_update)
+
+    def _parse_fk_action(self) -> str:
+        if self.accept_kw("SET"):
+            self.expect_kw("NULL")
+            return "SET NULL"
+        t = self.cur
+        word = t.text.upper()
+        if word in ("RESTRICT", "CASCADE"):
+            self.advance()
+            return word
+        if word == "NO":
+            self.advance()
+            nxt = self.advance()
+            if nxt.text.upper() != "ACTION":
+                raise ParseError("expected NO ACTION", nxt)
+            return "NO ACTION"
+        raise ParseError("expected referential action", t)
+
+    def _parse_create_sequence(self) -> ast.CreateSequenceStmt:
+        """CREATE SEQUENCE (reference: TiDB's MariaDB-style sequences,
+        ddl/sequence.go; CACHE is accepted and ignored — caching is the
+        allocator's concern)."""
+        ine = self._if_not_exists()
+        stmt = ast.CreateSequenceStmt(self.parse_table_name(),
+                                      if_not_exists=ine)
+        while self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) and \
+                not self.cur.is_op(";"):
+            word = self.cur.text.upper()
+            if word == "START":
+                self.advance()
+                if self.cur.kind == TokenKind.IDENT and \
+                        self.cur.text.upper() == "WITH":
+                    self.advance()
+                stmt.start = self._parse_signed_int("START")
+            elif word == "INCREMENT":
+                self.advance()
+                if self.cur.is_kw("BY"):
+                    self.advance()
+                stmt.increment = self._parse_signed_int("INCREMENT")
+                if stmt.increment == 0:
+                    raise ParseError("INCREMENT must not be 0", self.cur)
+            elif word == "MINVALUE":
+                self.advance()
+                stmt.min_value = self._parse_signed_int("MINVALUE")
+            elif word == "MAXVALUE":
+                self.advance()
+                stmt.max_value = self._parse_signed_int("MAXVALUE")
+            elif word == "CACHE":
+                self.advance()
+                self.parse_uint("CACHE")  # accepted, allocator decides
+            elif word in ("CYCLE", "NOCYCLE"):
+                self.advance()
+                stmt.cycle = word == "CYCLE"
+            elif word in ("NOCACHE", "NOMINVALUE", "NOMAXVALUE"):
+                self.advance()
+            else:
+                break
+        if stmt.start < stmt.min_value or stmt.start > stmt.max_value:
+            raise ParseError("START out of MINVALUE..MAXVALUE", self.cur)
+        return stmt
+
+    def _parse_signed_int(self, what: str) -> int:
+        neg = bool(self.accept_op("-"))
+        v = self.parse_uint(what)
+        return -v if neg else v
 
     def _parse_partition_by(self) -> ast.PartitionByDef:
         """PARTITION BY HASH(col) PARTITIONS n |
@@ -726,6 +829,11 @@ class Parser:
                 d.auto_increment = True
             elif self.accept_kw("DEFAULT"):
                 d.default = self.parse_primary()
+            elif self.accept_kw("REFERENCES"):
+                # column-level FK shorthand: REFERENCES tbl (col)
+                rt = self.parse_table_name()
+                rc = self._paren_ident_list()
+                d.references = (rt, rc)  # type: ignore[attr-defined]
             elif self.cur.is_kw("COLLATE") or (
                     self.cur.kind == TokenKind.IDENT
                     and self.cur.text.upper() == "COLLATE"):
@@ -815,6 +923,14 @@ class Parser:
 
     def parse_drop(self) -> ast.Stmt:
         self.expect_kw("DROP")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "SEQUENCE":
+            self.advance()
+            if_exists = self._if_exists()
+            names = [self.parse_table_name()]
+            while self.accept_op(","):
+                names.append(self.parse_table_name())
+            return ast.DropSequenceStmt(names, if_exists)
         if self.accept_kw("DATABASE", "SCHEMA"):
             if_exists = self._if_exists()
             return ast.DropDatabaseStmt(self.expect_ident(), if_exists)

@@ -118,8 +118,19 @@ class MaintenanceWorker:
         self.storage.checkpoint(dirty_only=True)
 
     def tick(self) -> dict:
+        # GC runs on the elected owner only (reference: the GC worker is
+        # leader-elected, gc_worker.go:95); lock resolution,
+        # auto-analyze and checkpointing of THIS process's dirty state
+        # are per-process work and never skip
+        owner = getattr(self.storage, "gc_owner", None)
         locks = self.resolve_expired_locks()
-        removed = self.run_gc()
+        removed = 0
+        if owner is None or owner.try_campaign():
+            try:
+                removed = self.run_gc()
+            finally:
+                if owner is not None:
+                    owner.resign()
         analyzed = self.run_auto_analyze()
         self.run_checkpoint()
         return {"locks_resolved": locks, "gc_removed": removed,

@@ -113,6 +113,13 @@ class Storage:
         # worker resumes pending jobs with their reorg checkpoints
         self.ddl_jobs: list = []
         self.ddl_history: list = []
+        # owner election: DDL jobs and background GC run on the owner
+        # only (reference: owner/manager.go etcd campaign; the mock at
+        # owner/mock.go:35 for single-process; flock for processes
+        # sharing this durable directory)
+        from ..owner import owner_manager
+        self.ddl_owner = owner_manager(path, "ddl")
+        self.gc_owner = owner_manager(path, "gc")
         self._commit_lock = threading.Lock()
         # active snapshot ts registry -> GC/compaction safepoint
         self._active_snapshots: dict[int, int] = {}
@@ -122,6 +129,12 @@ class Storage:
         # (reference: TiKV's deadlock detector service; util/deadlock)
         self._waits_for: dict[int, int] = {}
         self._waits_lock = threading.Lock()
+        # sequence allocation cursors (runtime); the catalog's
+        # SequenceInfo.next_value is the DURABLE high-water persisted
+        # ahead of handed-out values, so a crash skips at most one cache
+        # batch (reference: ddl/sequence.go cache allocation)
+        self._seq_cursors: dict[int, int] = {}
+        self._seq_lock = threading.Lock()
         if path is not None:
             self._recover()
             self._extend_tso_lease()
@@ -446,6 +459,7 @@ class Storage:
         mode); the WAL always folds."""
         if self.path is None:
             return
+        self._flush_sequence_cursors()
         for store in list(self.tables.values()):  # DDL may race the daemon
             if dirty_only and not getattr(store, "epoch_dirty", False):
                 continue
@@ -466,6 +480,8 @@ class Storage:
     def close(self) -> None:
         if self._maintenance is not None:
             self._maintenance.stop()
+        self.ddl_owner.close()
+        self.gc_owner.close()
         if self.path is None:
             return
         self.checkpoint()
@@ -675,6 +691,70 @@ class Storage:
             if store is not None:
                 store.maybe_compact(min(safe, commit_ts - 1) if safe else 0)
         return commit_ts
+
+    SEQ_CACHE = 1000
+
+    def sequence_next(self, seq) -> int:
+        """Allocate the next value; persists the durable high-water a
+        cache batch ahead (clamped at the exhaustion sentinel) so a
+        CRASH never re-issues a handed-out non-cycle value; a clean
+        checkpoint writes the exact cursor back, so clean restarts
+        waste nothing (reference: ddl/sequence.go + meta autoid-style
+        batching)."""
+        with self._seq_lock:
+            cur = self._seq_cursors.get(seq.id, seq.next_value)
+            v = cur
+            wrapped = False
+            if v > seq.max_value or v < seq.min_value:
+                if not seq.cycle:
+                    raise ValueError(
+                        f"sequence {seq.name} has run out")
+                v = seq.start
+                wrapped = True
+            nxt = v + seq.increment
+            self._seq_cursors[seq.id] = nxt
+            if wrapped or (seq.increment > 0 and nxt > seq.next_value) \
+                    or (seq.increment < 0 and nxt < seq.next_value):
+                high = nxt + seq.increment * self.SEQ_CACHE
+                if seq.increment > 0:
+                    # never persist past "just exhausted": restart must
+                    # still hand out the values below max_value
+                    high = min(high, seq.max_value + seq.increment)
+                else:
+                    high = max(high, seq.min_value + seq.increment)
+                seq.next_value = high
+                self.persist_catalog()
+            return v
+
+    def sequence_set(self, seq, value: int) -> None:
+        with self._seq_lock:
+            self._seq_cursors[seq.id] = value + seq.increment
+            seq.next_value = value + seq.increment * (self.SEQ_CACHE + 1)
+            if seq.increment > 0:
+                seq.next_value = min(seq.next_value,
+                                     seq.max_value + seq.increment)
+            self.persist_catalog()
+
+    def sequence_peek(self, seq) -> int:
+        """Next value WITHOUT consuming (EXPLAIN must not burn one)."""
+        with self._seq_lock:
+            return self._seq_cursors.get(seq.id, seq.next_value)
+
+    def _flush_sequence_cursors(self) -> None:
+        """Write exact cursors into the catalog so a clean shutdown
+        loses no sequence values (crash recovery falls back to the
+        batched high-water)."""
+        dirty = False
+        with self._seq_lock:
+            for schema in self.catalog.schemas.values():
+                for seq in (getattr(schema, "sequences", {}) or {}
+                            ).values():
+                    cur = self._seq_cursors.get(seq.id)
+                    if cur is not None and cur != seq.next_value:
+                        seq.next_value = cur
+                        dirty = True
+        if dirty:
+            self.persist_catalog()
 
     def _check_schema_fence(self, txn: "Transaction") -> None:
         """Fail txns whose buffered rows target a superseded table layout
