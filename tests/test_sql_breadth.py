@@ -299,3 +299,55 @@ def test_status_http_endpoints():
     assert "tidb_queries_total" in met
     urllib.request.urlopen(base + "/slow-query").read()
     srv.close()
+
+
+def test_insert_on_duplicate_key_update():
+    """INSERT ... ON DUPLICATE KEY UPDATE with VALUES() refs
+    (reference: executor/insert.go doDupRowUpdate)."""
+    from testkit import TestKit
+
+    tk = TestKit()
+    tk.must_exec("create table od (k varchar(10) primary key, cnt int, "
+                 "note varchar(20))")
+    assert tk.must_exec(
+        "insert into od values ('a', 1, 'first') "
+        "on duplicate key update cnt = cnt + 1").affected == 1
+    assert tk.must_exec(
+        "insert into od values ('a', 1, 'again') "
+        "on duplicate key update cnt = cnt + 1, note = values(note)"
+    ).affected == 2
+    tk.check("select k, cnt, note from od", [("a", 2, "again")])
+    # VALUES() inside arithmetic
+    tk.must_exec("insert into od values ('a', 10, 'x') "
+                 "on duplicate key update cnt = cnt + values(cnt)")
+    tk.check("select cnt from od", [(12,)])
+    # unchanged row counts 0 (MySQL semantics)
+    assert tk.must_exec(
+        "insert into od values ('a', 9, 'z') "
+        "on duplicate key update cnt = cnt").affected == 0
+    # mixed batch: one update (2) + one plain insert (1)
+    assert tk.must_exec(
+        "insert into od values ('a', 1, 'q'), ('b', 5, 'new') "
+        "on duplicate key update cnt = cnt + 1").affected == 3
+    tk.check("select k, cnt from od order by k", [("a", 13), ("b", 5)])
+    # secondary unique key conflicts route through the same path
+    tk.must_exec("create table od2 (id int primary key, u int, "
+                 "v int, unique key (u))")
+    tk.must_exec("insert into od2 values (1, 7, 0)")
+    tk.must_exec("insert into od2 values (2, 7, 5) "
+                 "on duplicate key update v = v + values(v)")
+    tk.check("select id, u, v from od2", [(1, 7, 5)])
+
+
+def test_on_duplicate_values_not_baked_across_rows():
+    """VALUES() literals must resolve per conflicting row, not bake the
+    first row's values into the shared assignment AST."""
+    from testkit import TestKit
+
+    tk = TestKit()
+    tk.must_exec("create table odb (k varchar(5) primary key, cnt int)")
+    tk.must_exec("insert into odb values ('a', 1), ('b', 2)")
+    tk.must_exec("insert into odb values ('a', 100), ('b', 200) "
+                 "on duplicate key update cnt = cnt + values(cnt)")
+    tk.check("select k, cnt from odb order by k",
+             [("a", 101), ("b", 202)])

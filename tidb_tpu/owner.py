@@ -32,24 +32,37 @@ class MockOwnerManager:
     def __init__(self, key: str = "ddl") -> None:
         self.key = key
         self._lock = threading.RLock()  # serialize same-process workers
+        self._owner_thread: Optional[int] = None
+        self._depth = 0
 
     def campaign(self, timeout_s: float = 10.0) -> bool:
-        return self._lock.acquire(timeout=timeout_s)
+        if not self._lock.acquire(timeout=timeout_s):
+            return False
+        self._owner_thread = threading.get_ident()
+        self._depth += 1
+        return True
 
     def try_campaign(self) -> bool:
-        return self._lock.acquire(blocking=False)
+        if not self._lock.acquire(blocking=False):
+            return False
+        self._owner_thread = threading.get_ident()
+        self._depth += 1
+        return True
 
     def resign(self) -> None:
         try:
+            self._depth -= 1
+            if self._depth <= 0:
+                self._owner_thread = None
+                self._depth = 0
             self._lock.release()
         except RuntimeError:
             pass
 
     def is_owner(self) -> bool:
-        if self._lock.acquire(blocking=False):
-            self._lock.release()
-            return False  # nobody held it -> no current owner session
-        return True
+        """Is the CALLING thread the current owner (reference:
+        mockManager.IsOwner)."""
+        return self._owner_thread == threading.get_ident()
 
     def close(self) -> None:
         pass

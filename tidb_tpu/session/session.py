@@ -543,10 +543,21 @@ class Session:
                 raise SQLError(
                     "NEXTVAL/SETVAL in per-row contexts (SELECT with "
                     "FROM, INSERT ... SELECT) is unsupported")
-            if isinstance(n, ast.UpdateStmt) and any(
-                    contains_seq(a.value) for a in n.assignments):
+            if isinstance(n, ast.UpdateStmt) and (
+                    any(contains_seq(a.value) for a in n.assignments)
+                    or (n.where is not None and contains_seq(n.where))):
                 raise SQLError(
-                    "NEXTVAL/SETVAL in UPDATE assignments is "
+                    "NEXTVAL/SETVAL in UPDATE statements is "
+                    "unsupported")
+            if isinstance(n, ast.DeleteStmt) and n.where is not None \
+                    and contains_seq(n.where):
+                raise SQLError(
+                    "NEXTVAL/SETVAL in DELETE is unsupported")
+            if isinstance(n, ast.InsertStmt) and any(
+                    contains_seq(a.value)
+                    for a in getattr(n, "on_dup", []) or []):
+                raise SQLError(
+                    "NEXTVAL/SETVAL in ON DUPLICATE KEY UPDATE is "
                     "unsupported")
             return None
 
@@ -1013,7 +1024,11 @@ class Session:
                             checkers.clear()
                         checker = checker_for(tid)
                         conflicts = checker.conflicts(handle, enc)
-                        if not (conflicts and stmt.is_replace):
+                        # REPLACE deletes its victims and ON DUPLICATE
+                        # updates the first one: both write rows they
+                        # didn't insert, so those record keys need locks
+                        if not (conflicts
+                                and (stmt.is_replace or stmt.on_dup)):
                             break
                         victims = [tablecodec.record_key(tid, h)
                                    for h in conflicts
@@ -1029,6 +1044,11 @@ class Session:
                     checker = checker_for(tid)
                     conflicts = checker.conflicts(handle, enc)
                 if conflicts:
+                    if stmt.on_dup:
+                        count += self._apply_on_dup(
+                            stmt, info, tinfo, tid, store, txn, checker,
+                            conflicts[0], full)
+                        continue  # the new row itself is not inserted
                     if not stmt.is_replace:
                         raise SQLError(
                             checker.dup_message(handle, enc, conflicts))
@@ -1042,6 +1062,126 @@ class Session:
             return ResultSet([], [], affected=count)
         finally:
             txn.stmt_read_ts = None
+
+    def _apply_on_dup(self, stmt, info, tinfo, tid: int, store, txn,
+                      checker, handle: int, full: list) -> int:
+        """ON DUPLICATE KEY UPDATE: update the first conflicting row
+        with the assignment list; VALUES(col) refers to the would-be
+        inserted row (reference: executor/insert.go
+        doDupRowUpdate + expression/builtin_other.go VALUES)."""
+        handle = int(handle)
+        snap = txn.snapshot(tid)
+        gathered = snap.gather(np.array([handle], np.int64),
+                               list(range(tinfo.num_columns)))
+        existing: list[Any] = []
+        for data, valid in gathered:
+            existing.append(None if not valid[0]
+                            else _np_scalar(data[0]))
+        builder = PlanBuilder(self.catalog, self.current_db)
+        scan = builder._build_scan(stmt.table)
+        # 1-row evaluator over the existing row
+        cols = []
+        dicts = []
+        for off in range(tinfo.num_columns):
+            ft = tinfo.columns[off].ftype
+            arr = np.zeros(1, ft.np_dtype)
+            vl = np.ones(1, bool)
+            if existing[off] is None:
+                vl[0] = False
+            else:
+                arr[0] = existing[off]
+            cols.append((arr, vl))
+            dicts.append(store.dictionaries[off])
+        ev = NumpyEval(cols, dicts, 1)
+        col_by_name = {c.name.lower(): c for c in tinfo.columns}
+        new_phys = list(existing)
+        for a in stmt.on_dup:
+            target = col_by_name.get(a.column.name.lower())
+            if target is None:
+                raise SQLError(f"unknown column {a.column.name}")
+            ci = target.offset
+            col_ft = target.ftype
+            # col = VALUES(col2): direct host-value re-encode (keeps
+            # temporal/decimal domains exact)
+            av = a.value
+            if isinstance(av, ast.FuncCall) and av.name == "VALUES":
+                src = col_by_name.get(av.args[0].name.lower())
+                if src is None:
+                    raise SQLError(
+                        f"unknown column {av.args[0].name} in VALUES()")
+                from ..chunk.column import _encode_scalar
+                v = full[src.offset]
+                new_phys[ci] = None if v is None else _encode_scalar(
+                    col_ft, v, store.dictionaries[ci])
+            else:
+                expr_ast = self._subst_values_refs(av, col_by_name, full)
+                try:
+                    pe = builder.resolve(expr_ast, scan.schema)
+                except PlanError as e:
+                    raise SQLError(str(e)) from None
+                if col_ft.is_string:
+                    sv, svl = ev.eval_str(pe)
+                    d = store.dictionaries[ci]
+                    new_phys[ci] = d.encode(sv[0]) if svl[0] else None
+                else:
+                    vv = ev.eval(pe)
+                    if pe.ftype.kind != col_ft.kind or (
+                            col_ft.is_decimal
+                            and pe.ftype.scale != col_ft.scale):
+                        vv = ev._cast(vv, pe.ftype, col_ft)
+                    v, vl = vv
+                    new_phys[ci] = None if not np.asarray(vl)[0] \
+                        else _np_scalar(np.asarray(v)[0])
+            if new_phys[ci] is None and not col_ft.nullable:
+                raise SQLError(
+                    f"column {target.name} cannot be null")
+        if info.pk_handle_offset is not None and \
+                new_phys[info.pk_handle_offset] != \
+                existing[info.pk_handle_offset]:
+            raise SQLError(
+                "changing the primary key in ON DUPLICATE KEY UPDATE "
+                "is unsupported")
+        if tuple(new_phys) == tuple(existing):
+            return 0  # MySQL: unchanged row counts 0
+        conf = checker.conflicts(handle, tuple(new_phys), exclude=handle)
+        if conf:
+            raise SQLError(
+                checker.dup_message(handle, tuple(new_phys), conf))
+        txn.set_row(tid, handle, tuple(new_phys))
+        checker.note_delete(handle)
+        checker.note_insert(handle, tuple(new_phys))
+        return 2  # MySQL: an updated duplicate counts 2
+
+    def _subst_values_refs(self, node, col_by_name, full: list):
+        """Replace VALUES(col) with the new row's host value as a typed
+        literal (non-temporal domains; plain `col = VALUES(col)` takes
+        the exact re-encode path above). Transforms a COPY: the on_dup
+        AST is shared across conflicting rows, and baking one row's
+        values into it would replay them for every later conflict."""
+        import copy as _copy
+        node = _copy.deepcopy(node)
+
+        def fn(n):
+            if isinstance(n, ast.FuncCall) and n.name == "VALUES":
+                src = col_by_name.get(n.args[0].name.lower())
+                if src is None:
+                    raise SQLError(
+                        f"unknown column {n.args[0].name} in VALUES()")
+                v = full[src.offset]
+                if v is None:
+                    return ast.Literal(None, "null")
+                if isinstance(v, bool):
+                    return ast.Literal(int(v), "int")
+                if isinstance(v, int):
+                    return ast.Literal(v, "int")
+                if isinstance(v, float):
+                    return ast.Literal(v, "float")
+                if isinstance(v, Decimal):
+                    return ast.Literal(v, "decimal")
+                return ast.Literal(str(v), "string")
+            return n
+
+        return ast.transform(node, fn)
 
     def _exec_update(self, stmt: ast.UpdateStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)

@@ -286,6 +286,8 @@ class InsertStmt(Stmt):
     rows: list[list[Expr]] = field(default_factory=list)
     select: Optional[SelectStmt] = None  # INSERT ... SELECT
     is_replace: bool = False
+    # ON DUPLICATE KEY UPDATE assignments; VALUES(col) refs allowed
+    on_dup: list = field(default_factory=list)
 
 
 @dataclass

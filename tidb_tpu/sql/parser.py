@@ -507,14 +507,34 @@ class Parser:
                 columns.append(self.expect_ident())
             self.expect_op(")")
         if self.cur.is_kw("SELECT"):
-            return ast.InsertStmt(table, columns,
-                                  select=self.parse_select_statement(),
-                                  is_replace=is_replace)
+            sel = self.parse_select_statement()
+            return ast.InsertStmt(table, columns, select=sel,
+                                  is_replace=is_replace,
+                                  on_dup=self._parse_on_dup())
         self.expect_kw("VALUES")
         rows = [self.parse_value_row()]
         while self.accept_op(","):
             rows.append(self.parse_value_row())
-        return ast.InsertStmt(table, columns, rows=rows, is_replace=is_replace)
+        return ast.InsertStmt(table, columns, rows=rows,
+                              is_replace=is_replace,
+                              on_dup=self._parse_on_dup())
+
+    def _parse_on_dup(self) -> list[ast.Assignment]:
+        """ON DUPLICATE KEY UPDATE col = expr, ... (reference: ast
+        OnDuplicateAssignment; VALUES(col) refers to the would-be
+        inserted value)."""
+        if not self.accept_kw("ON"):
+            return []
+        for kw in ("DUPLICATE", "KEY", "UPDATE"):
+            t = self.cur
+            if not (t.is_kw(kw) or (t.kind == TokenKind.IDENT
+                                    and t.text.upper() == kw)):
+                raise ParseError(f"expected {kw}", t)
+            self.advance()
+        out = [self.parse_assignment()]
+        while self.accept_op(","):
+            out.append(self.parse_assignment())
+        return out
 
     def parse_value_row(self) -> list[ast.Expr]:
         self.expect_op("(")
@@ -1203,6 +1223,13 @@ class Parser:
             value = self.parse_primary()
             unit = self._interval_unit()
             return ast.IntervalExpr(value, unit)
+        if self.cur.is_kw("VALUES") and self.peek().is_op("("):
+            # VALUES(col) inside ON DUPLICATE KEY UPDATE
+            self.advance()
+            self.expect_op("(")
+            ref = self.parse_column_ref()
+            self.expect_op(")")
+            return ast.FuncCall("VALUES", [ref])
         e = self.parse_primary()
         # JSON path extraction operators: col->'$.k' / col->>'$.k'
         # (reference: parser maps -> to JSON_EXTRACT and ->> to

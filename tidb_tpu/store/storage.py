@@ -735,11 +735,6 @@ class Storage:
                                      seq.max_value + seq.increment)
             self.persist_catalog()
 
-    def sequence_peek(self, seq) -> int:
-        """Next value WITHOUT consuming (EXPLAIN must not burn one)."""
-        with self._seq_lock:
-            return self._seq_cursors.get(seq.id, seq.next_value)
-
     def _flush_sequence_cursors(self) -> None:
         """Write exact cursors into the catalog so a clean shutdown
         loses no sequence values (crash recovery falls back to the
