@@ -142,6 +142,8 @@ class CopClient:
             if old is None:
                 return
             def stale(k) -> bool:  # plain or "tile"-prefixed cache keys
+                if len(k) > 2 and k[1] == "aligned" and k[2] == old:
+                    return True  # build-side epoch of an aligned join
                 return k[0] == old or (k[0] == "tile" and k[1] == old)
 
             for k in [k for k in self._col_cache if stale(k)]:
@@ -202,6 +204,38 @@ class CopClient:
         with self._lock:
             self._stats[key] = b
         return b
+
+    def _runs_ordered(self, snap: TableSnapshot, offsets) -> bool:
+        """True when the epoch columns at `offsets` are lexicographically
+        non-decreasing in storage order with no NULLs: every group-key
+        value then occupies ONE contiguous run, so segment aggregation
+        needs no sort (the StreamAgg-over-ordered-input eligibility;
+        reference: planner/core/exhaust_physical_plans.go getStreamAggs).
+        Cached per epoch — one ~10ms host pass amortized over the epoch
+        lifetime."""
+        key = (snap.epoch.epoch_id, "runord", tuple(offsets))
+        with self._lock:
+            hit = self._stats.get(key)
+        if hit is None:
+            hit = _lex_runs_ordered(snap, offsets)
+            with self._lock:
+                self._stats[key] = hit
+        return bool(hit)
+
+    def _rank_meta(self, snap: TableSnapshot, offsets):
+        """Host rank metadata for the streamseg kernel over the epoch
+        columns at `offsets` (must already be run-ordered). Cached per
+        epoch; None when a kernel gate fails."""
+        key = (snap.epoch.epoch_id, "rankmeta", tuple(offsets))
+        with self._lock:
+            hit = self._stats.get(key)
+        if hit is None:
+            from . import streamseg as SS
+            hit = SS.rank_meta(
+                [snap.epoch.columns[off] for off in offsets])
+            with self._lock:
+                self._stats[key] = hit if hit is not None else False
+        return hit or None
 
     def _scan_bounds(self, dag: CopDAG, snap: TableSnapshot) -> list[Bound]:
         """Per scan-column [lo, hi] covering epoch AND overlay values, so one
@@ -595,7 +629,8 @@ class CopClient:
                     vslice = np.ones(cnt, bool) if valid is None \
                         else valid[lo:lo + cnt]
                     cached = self._place_cols(
-                        jnp.asarray(_pad(_narrow(data), b)),
+                        jnp.asarray(_pad(_narrow_stats(
+                            data, self._col_stats(snap, off)), b)),
                         jnp.asarray(_pad_bool(vslice, b)))
                     if cacheable:
                         with self._lock:
@@ -772,6 +807,7 @@ class CopClient:
         sel = dag.selection
 
         def kernel(cols, row_mask):
+            cols = widen32(cols)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
@@ -814,6 +850,7 @@ class CopClient:
         sel = dag.selection
 
         def kernel(cols, row_mask):
+            cols = widen32(cols)
             mask = selection_mask(sel.conditions, cols, prepared, row_mask)
             return jnp.packbits(mask)
 
@@ -916,6 +953,7 @@ class CopClient:
         out_types = dag.output_types
 
         def kernel(cols, row_mask):
+            cols = widen32(cols)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
@@ -1159,6 +1197,31 @@ def decode_agg_partials(agg, prepared, cards, out, group_dicts,
 # ==================== helpers ====================
 
 
+def _narrow_stats(a: np.ndarray, bound) -> np.ndarray:
+    """Stats-driven staging width for the big-scan tile path: columns
+    whose value bounds fit int8/int16 stage at that width (an SF100
+    lineitem needs ~7 columns resident in HBM — int64 staging would not
+    fit). Kernels upcast to int32 at entry (`widen32`), so compute
+    semantics are unchanged; XLA fuses the converts into the consumers."""
+    if a.dtype.kind in "iu" and bound is not None:
+        lo, hi = bound
+        if -128 <= lo and hi <= 127:
+            return a.astype(np.int8)
+        if -32768 <= lo and hi <= 32767:
+            return a.astype(np.int16)
+    return _narrow(a)
+
+
+def widen32(cols):
+    """Upcast narrow staged tile columns to int32 for kernel compute."""
+    out = []
+    for d, v in cols:
+        if d.dtype in (jnp.int8, jnp.int16):
+            d = d.astype(jnp.int32)
+        out.append((d, v))
+    return out
+
+
 def _narrow(a: np.ndarray) -> np.ndarray:
     """64-bit host columns -> 32-bit device staging (the device is
     64-bit-free; see module docstring)."""
@@ -1181,6 +1244,31 @@ def _pad_bool(a: np.ndarray, b: int) -> np.ndarray:
     out = np.zeros(b, dtype=bool)
     out[: len(a)] = a
     return out
+
+
+def _lex_runs_ordered(snap, offsets) -> bool:
+    """Lexicographic non-decreasing check over epoch columns (NULL-free):
+    proves every distinct key tuple forms one contiguous storage run."""
+    tie = None
+    for off in offsets:
+        v = snap.epoch.valids[off]
+        if v is not None and not v.all():
+            return False  # NULL codes sort above every value: order breaks
+        d = snap.epoch.columns[off]
+        if d.dtype.kind not in "iub":
+            return False
+        if len(d) < 2:
+            continue
+        a, b = d[:-1], d[1:]
+        if tie is None:
+            if np.any(a > b):
+                return False
+            tie = a == b
+        else:
+            if np.any(tie & (a > b)):
+                return False
+            tie = tie & (a == b)
+    return True
 
 
 def _mask_digest(m: np.ndarray) -> str:

@@ -53,7 +53,12 @@ FRAG_SPAN_CAP = 1 << 26
 
 
 class _Fallback(Exception):
-    pass
+    """Raised by a device gate; carries the gate's reason so operators can
+    see WHY a query left the device path (obs label + engine string)."""
+
+    def __init__(self, reason: str = "gate") -> None:
+        super().__init__(reason)
+        self.reason = reason
 
 
 def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
@@ -64,10 +69,13 @@ def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
         r = _device_fragment(cop, frag, snaps)
         obs.COPR_REQUESTS.inc(engine="device-fragment")
         return r
-    except (_Fallback, CompileError):
+    except (_Fallback, CompileError, jax.errors.JaxRuntimeError) as e:
+        reason = getattr(e, "reason", None) or (
+            "device-oom" if "RESOURCE_EXHAUSTED" in str(e) else "compile")
         obs.COPR_REQUESTS.inc(engine="host-fragment")
+        obs.FRAG_FALLBACKS.inc(reason=reason)
         r = _host_fragment(frag, snaps)
-        r.engine = "host(fragment-fallback)"
+        r.engine = f"host(fragment:{reason})"
         return r
 
 
@@ -83,13 +91,13 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     for ti, t in enumerate(frag.tables):
         snap = snaps[t.table.id]
         if ti > 0 and len(snap.overlay_handles) > 0:
-            raise _Fallback()  # uncommitted/unfolded build rows
+            raise _Fallback("build-overlay")  # uncommitted/unfolded build rows
         facade = _facade_dag(t)
         b = cop._scan_bounds(facade, snap)
         for ci, off in enumerate(t.col_offsets):
             if snap.epoch.columns[off].dtype == np.int64 and \
                     not fits_int32(b[ci]):
-                raise _Fallback()
+                raise _Fallback("int64-column")
         tab_bounds.append(b)
         tab_dicts.append([snap.dictionaries[off] for off in t.col_offsets])
         cop._evict_stale(t.table.id, snap.epoch.epoch_id)
@@ -108,11 +116,11 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         for c in t.filters:
             cop._prepare_expr(c, tab_dicts[ti], prepared)
             if not expr_device_safe(c, tab_bounds[ti]):
-                raise _Fallback()
+                raise _Fallback("filter-unsafe")
     for c in frag.selection:
         cop._prepare_expr(c, comb_dicts, prepared)
         if not expr_device_safe(c, comb_bounds):
-            raise _Fallback()
+            raise _Fallback("selection-unsafe")
     if frag.agg is not None:
         # group keys and aggregate arguments can embed string predicates
         # (e.g. CASE WHEN priority = '1-URGENT'); resolve them to codes
@@ -129,11 +137,11 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         kb = tab_bounds[j.build][j.build_key_local]
         pb = expr_bounds(j.probe_key, comb_bounds)
         if kb is None or pb is None or not fits_int32(pb):
-            raise _Fallback()
+            raise _Fallback("key-width")
         lo, hi = kb
         span = hi - lo + 1
         if span > FRAG_SPAN_CAP:
-            raise _Fallback()
+            raise _Fallback("key-span")
         spans.append((lo, span))
         prepared["__sig__"].append(("join", j.build, lo, span))
 
@@ -175,16 +183,50 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                                n_rows)
         if err is not None:
             # dense segment space rejected; a TopN consumer admits the
-            # high-cardinality sorted-run candidate path (copr/hcagg.py)
-            if frag.hc is None or len(psnap.overlay_handles) > 0 or \
+            # high-cardinality sorted-run candidate path (copr/hcagg.py);
+            # a HAVING consumer admits the rank-space filtered path
+            if (frag.hc is None and not frag.having) or \
+                    len(psnap.overlay_handles) > 0 or \
                     not _prepare_hc(frag, comb_bounds, prepared, n_rows):
-                raise _Fallback()
+                raise _Fallback("group-space")
             mode = "hc"
 
     if mode == "hc" and not getattr(cop, "supports_hc", True):
         # a client with neither single-device hc nor a group exchange
         # routes hc to the host
-        raise _Fallback()
+        raise _Fallback("hc-unsupported")
+
+    if mode == "hc":
+        # run-ordered fast path: storage order already groups the segment
+        # keys (fact tables are clustered by their join/PK key), so the
+        # kernel skips the lexicographic sort — segment boundaries come
+        # from raw key-change points and filtered-out rows contribute
+        # zeros. Exchanges (group hash or partitioned join) re-order rows
+        # across devices, so the path is single-device only.
+        segcols = prepared.get("__hc_segcols__")
+        if segcols is not None and part_ji is None and \
+                getattr(cop, "frag_axis", None) is None and \
+                cop._runs_ordered(psnap, segcols):
+            prepared["__hc_runordered__"] = True
+            prepared["__sig__"].append(("runord",))
+            # streamseg (Pallas) eligibility: rank-space per-group sums
+            # in one pass; K value arrays must fit the kernel's VMEM
+            # window and per-key row counts its f32 exactness bound
+            from . import streamseg as SS
+            n_arrays = 1
+            for s_ in prepared["__hc_sched__"]:
+                n_arrays += 1 + sum(t[2] for t in s_.get("terms", ()))
+            if n_arrays <= SS.MAX_ARRAYS:
+                meta = cop._rank_meta(psnap, segcols)
+                if meta is not None:
+                    prepared["__rank_meta__"] = meta
+                    prepared["__sig__"].append(
+                        ("rankseg", meta["nd"], meta["maxd"],
+                         meta["n0"], meta["identity"]))
+        if frag.hc is None and prepared.get("__rank_meta__") is None:
+            # the HAVING-filtered path exists only in rank space — there
+            # is no sorted-run equivalent (no top-k bound to verify)
+            raise _Fallback("having-unordered")
 
     # ---- staging ----
     builds = []
@@ -280,18 +322,35 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     pcols, pvis, phost, phost_mask = cop._stage_inputs(
         _facade_dag(probe), psnap, overlay=overlay)
 
+    # single-device epoch batches swap the in-kernel perm gathers for
+    # epoch-cached ALIGNED build columns (see _stage_aligned): the first
+    # query against an epoch pays the gathers once; every later fragment
+    # query over the same epochs is pure elementwise + MXU work
+    kern_builds = builds
+    if builds and not overlay and \
+            getattr(cop, "frag_axis", None) is None and \
+            prepared.get("__part_join__") is None:
+        kern_builds = _stage_aligned(cop, frag, snaps, prepared, spans,
+                                     builds, pcols)
+
     if mode is None:
         mode = "agg" if frag.agg is not None else "rows"
+    aux = None
+    if mode == "hc" and not overlay and \
+            prepared.get("__rank_meta__") is not None:
+        aux = _stage_rank_aux(cop, psnap, prepared)
     key = ("frag", _frag_key(frag), _sig(prepared), mode,
            pcols[0][0].shape[0] if pcols else 0,
            tuple(
                ("part", b["present"].shape[0]) if "bykey" in b
+               else ("al", b["found"].shape[0]) if "acols" in b
                else b["cols"][0][0].shape[0]
-               for b in builds))
+               for b in kern_builds))
     kern = cop._kernel(key, lambda: cop._frag_jit(
         _build_frag_kernel(frag, prepared, spans, mode, raw=True, cop=cop),
         mode, prepared))
-    out = jax.device_get(kern(pcols, pvis, builds))
+    out = jax.device_get(kern(pcols, pvis, kern_builds) if aux is None
+                         else kern(pcols, pvis, kern_builds, aux))
 
     if mode == "hc":
         # candidate blocks = exchange partitions (1 on a single device)
@@ -300,7 +359,7 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         return [] if chunk is None else [chunk]
     if mode == "agg":
         if np.any(np.asarray(out.pop("overflow", 0)) > 0):
-            raise _Fallback()  # join-exchange bucket overflow (key skew)
+            raise _Fallback("exchange-overflow")  # join bucket skew
         cards = prepared["__dense_cards__"]
         comb_dicts = []
         for ti, t in enumerate(frag.tables):
@@ -324,6 +383,95 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         if n_rows else np.zeros(0, bool)
     idx = np.nonzero(mask)[0]
     return _host_rows_for(frag, snaps, idx, overlay)
+
+
+def _stage_rank_aux(cop, snap, prepared):
+    """Device-resident epoch arrays for the streamseg rank kernel: change
+    flags f and first-row-per-rank r0 (cached per epoch)."""
+    meta = prepared["__rank_meta__"]
+    key = (snap.epoch.epoch_id, "rankaux", meta["n0"], meta["nd"])
+    with cop._lock:
+        hit = cop._col_cache.get(key)
+        cacheable = cop._live_epochs.get(snap.store.table.id) \
+            == snap.epoch.epoch_id
+    if hit is None:
+        hit = {"f": jnp.asarray(meta["f"]),
+               "r0": jnp.asarray(meta["r0"])}
+        if cacheable:
+            with cop._lock:
+                cop._col_cache[key] = hit
+    return hit
+
+
+def _stage_aligned(cop, frag, snaps, prepared, spans, builds, pcols):
+    """Materialize build columns ALIGNED to the padded probe rows as
+    epoch-cached device arrays.
+
+    The in-kernel join (perm lookup + per-row column gathers) is the same
+    computation for every query over an epoch pair — only the filters and
+    aggregates change. TPU random gather runs ~50M elem/s (orders of
+    magnitude under the elementwise/MXU paths), so paying it per query
+    dominated join fragments. Instead the gathers run ONCE per (probe
+    epoch, build epoch) and the results — one probe-length column per
+    referenced build column plus a 'found' bitmap — stay device-resident,
+    like the reference caching a TiFlash co-located/denormalized layout
+    rather than re-shipping rows per query (reference:
+    store/tikv/batch_coprocessor.go keeps region data local to a store;
+    executor/index_lookup_join.go re-probes per batch, which this design
+    deliberately avoids).
+
+    Returns a per-join list: {'acols': ((data, valid), ...), 'found': m}
+    for joins it could align (probe key is a plain Col over the probe
+    prefix or an earlier aligned column), else the original builds entry
+    (the kernel gathers those as before)."""
+    probe = frag.tables[0]
+    psnap = snaps[probe.table.id]
+    pep = psnap.epoch.epoch_id
+    bucket = pcols[0][0].shape[0] if pcols else 0
+    # combined-index -> (data, valid) device pair, or None if that slot
+    # belongs to a join the kernel will gather itself
+    combined: list = list(pcols)
+    out = []
+    for ji, (j, (lo, span), b) in enumerate(
+            zip(frag.joins, spans, builds)):
+        t = frag.tables[j.build]
+        key_e = j.probe_key
+        src = None
+        if "cols" in b and isinstance(key_e, Col) and \
+                key_e.idx < len(combined) and \
+                combined[key_e.idx] is not None:
+            src = combined[key_e.idx]
+        if src is None:
+            out.append(b)
+            combined.extend([None] * len(t.col_offsets))
+            continue
+        bsnap = snaps[t.table.id]
+        bep = bsnap.epoch.epoch_id
+        ckey = (pep, "aligned", bep, t.table.id, ji, key_e.idx, bucket,
+                lo, span, tuple(t.col_offsets),
+                _mask_digest_of(psnap.base_visible),
+                _mask_digest_of(bsnap.base_visible))
+        with cop._lock:
+            hit = cop._col_cache.get(ckey)
+            cacheable = (
+                cop._live_epochs.get(probe.table.id) == pep
+                and cop._live_epochs.get(t.table.id) == bep)
+        if hit is None:
+            kd, kv = src
+            k = kd.astype(jnp.int32) - jnp.int32(lo)
+            inrange = (k >= 0) & (k < span)
+            ridx = b["perm"][jnp.clip(k, 0, span - 1)]
+            gidx = jnp.clip(ridx, 0)
+            found = inrange & (ridx >= 0) & kv & b["vis"][gidx]
+            acols = tuple((d[gidx], v[gidx] & found)
+                          for (d, v) in b["cols"])
+            hit = {"acols": acols, "found": found}
+            if cacheable:
+                with cop._lock:
+                    cop._col_cache[ckey] = hit
+        out.append(hit)
+        combined.extend(hit["acols"])
+    return out
 
 
 def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
@@ -358,6 +506,16 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
         bases.append((acc, acc + len(t.col_offsets)))
         acc += len(t.col_offsets)
 
+    # a table's PK handle column determines every other column of that
+    # table (row identity) — without this rule Q10-style group lists
+    # (c_custkey, c_name, c_acctbal, ...) would need one sort key per
+    # column and overflow the seg-key budget
+    pk_comb: dict[int, int] = {}
+    for ti, t in enumerate(frag.tables):
+        off = getattr(t.table, "pk_handle_offset", None)
+        if off is not None and off in t.col_offsets:
+            pk_comb[ti] = bases[ti][0] + t.col_offsets.index(off)
+
     def cols_of(e) -> set:
         out = set()
 
@@ -382,21 +540,38 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
                 if cols_of(j.probe_key) <= det:
                     det |= rng
                     changed = True
+            for ti, pc in pk_comb.items():
+                rng = set(range(*bases[ti]))
+                if pc in det and not rng <= det:
+                    det |= rng
+                    changed = True
         return det
 
-    seg_keys: list[int] = []
-    det: set = set()
     order = sorted(range(len(frag.agg.group_by)),
                    key=lambda gi: -spans_[gi])
+    all_needed: set = set()
+    for g in frag.agg.group_by:
+        all_needed |= cols_of(g)
+    # one plain key that determines every group column (a PK or a join
+    # chain root) sorts alone — the common OLAP shape
+    seg_keys: list[int] = []
     for gi in order:
         g = frag.agg.group_by[gi]
-        need = cols_of(g)
-        if need and not need <= closure(det):
-            seg_keys.append(gi)
-            # only a PLAIN column key determines its column: a composite
-            # expression (a+b) being constant does not pin its arguments
-            if isinstance(g, Col):
-                det |= need
+        if isinstance(g, Col) and all_needed <= closure({g.idx}):
+            seg_keys = [gi]
+            break
+    if not seg_keys:
+        det: set = set()
+        for gi in order:
+            g = frag.agg.group_by[gi]
+            need = cols_of(g)
+            if need and not need <= closure(det):
+                seg_keys.append(gi)
+                # only a PLAIN column key determines its column: a
+                # composite expression (a+b) being constant does not pin
+                # its arguments
+                if isinstance(g, Col):
+                    det |= need
     if not seg_keys:
         seg_keys = [0]
     if len(seg_keys) > 2:
@@ -425,8 +600,47 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
     prepared["__hc_nulls__"] = nulls
     prepared["__hc_sched__"] = sched
     prepared["__hc_segkeys__"] = seg_keys
+    # run-order eligibility: when every segment key resolves to a plain
+    # PROBE column, the executor can test whether storage order already
+    # groups them (clustered-PK aggregation — TPC-H lineitem is
+    # orderkey-ordered) and skip the device sort entirely (the
+    # StreamAgg-over-ordered-input choice; reference:
+    # planner/core/exhaust_physical_plans.go getStreamAggs requires input
+    # order, executor/aggregate.go StreamAgg). A group key that IS the
+    # unique build key of a join (Q18's o_orderkey) substitutes to the
+    # join's probe key: equal wherever the inner join matches, and
+    # unmatched segments are gated out by the zero row count.
+    n_probe = len(frag.tables[0].col_offsets)
+
+    def probe_local_of(e) -> Optional[int]:
+        if not isinstance(e, Col):
+            return None
+        if e.idx < n_probe:
+            return e.idx
+        for j in frag.joins:
+            b0, _ = bases[j.build]
+            if e.idx == b0 + j.build_key_local and \
+                    isinstance(j.probe_key, Col) and \
+                    j.probe_key.idx < n_probe:
+                return j.probe_key.idx
+        return None
+
+    segcols = []
+    segprobe = []
+    for gi in seg_keys:
+        local = probe_local_of(frag.agg.group_by[gi])
+        if local is None:
+            segcols = None
+            break
+        segprobe.append(local)
+        segcols.append(frag.tables[0].col_offsets[local])
+    prepared["__hc_segcols__"] = segcols
+    prepared["__hc_segprobe__"] = segprobe if segcols else None
     prepared["__sig__"].append((
-        "hc", frag.hc.score, frag.hc.desc, frag.hc.cap, tuple(nulls),
+        "hc",
+        (frag.hc.score, frag.hc.desc, frag.hc.cap) if frag.hc
+        else ("having", tuple(frag.having or ())),
+        tuple(nulls),
         tuple(seg_keys),
         tuple((s["kind"],) + tuple((repr(t), sh, L)
                                    for t, sh, L in s.get("terms", ()))
@@ -459,7 +673,7 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
         part_n_dev = cop.mesh.devices.size
         part_per_dev = -(-part_span // part_n_dev)
 
-    def kernel(pcols, pvis, builds):
+    def kernel(pcols, pvis, builds, aux=None):
         cols = list(pcols)
         mask = pvis
         if frag.tables[0].filters:
@@ -472,6 +686,18 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
             cols, mask, overflow_j = join_exchange(cols, mask)
         for ji, (j, (lo, span), b) in enumerate(
                 zip(frag.joins, spans, builds)):
+            if "acols" in b:
+                # pre-aligned join: columns already sit in probe-row
+                # order; only the query's build-side filters remain
+                t = frag.tables[j.build]
+                found = b["found"]
+                if t.filters:
+                    found = selection_mask(t.filters, list(b["acols"]),
+                                           prepared, found)
+                for (d, v) in b["acols"]:
+                    cols.append((d, v & found))
+                mask = mask & found
+                continue
             key_v, key_vl = eval_expr(j.probe_key, cols, prepared)
             k = key_v.astype(jnp.int32) - jnp.int32(lo)
             t = frag.tables[j.build]
@@ -522,7 +748,7 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
                 res["overflow"] = overflow if overflow_j is None \
                     else overflow + overflow_j
                 return res
-            res = _hc_body(frag, prepared, cols, mask)
+            res = _hc_body(frag, prepared, cols, mask, aux)
             if overflow_j is not None:
                 res["overflow"] = overflow_j
             return res
@@ -531,14 +757,165 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
     return kernel if raw else jax.jit(kernel)
 
 
-def _hc_body(frag, prepared, cols, mask):
+def _hc_rank_body(frag, prepared, cols, mask, aux):
+    """Rank-space hc aggregation over run-ordered input (streamseg).
+
+    The Pallas kernel turns per-row masked value arrays into exact
+    per-GROUP sums indexed by rank (= position among distinct key runs);
+    score, candidate top-k, and the decode layout all then work on the
+    rank axis (~rows/4 long) with only O(cap)-sized device fetches. Group
+    keys for candidates are gathered at each rank's first row (r0):
+    within a run every group key is constant (functional dependency), so
+    any row serves; fully-masked runs are gated by a zero row count."""
+    from . import streamseg as SS
+    from . import sumexact as _SE
+
+    agg = frag.agg
+    hc = frag.hc
+    nulls = prepared["__hc_nulls__"]
+    sched = prepared["__hc_sched__"]
+    meta = prepared["__rank_meta__"]
+
+    encs = []
+    for gi, g in enumerate(agg.group_by):
+        v, vl = eval_expr(g, cols, prepared)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        encs.append(jnp.where(vl, v.astype(jnp.int32),
+                              jnp.int32(nulls[gi])))
+
+    arrs = [mask.astype(jnp.float32)]
+    cnt_ix: list[int] = []
+    term_ix: list[list] = []
+    for ai, (d, s_) in enumerate(zip(agg.aggs, sched)):
+        if s_["kind"] == "count":
+            if d.arg is not None:
+                _, vl = eval_expr(d.arg, cols, prepared)
+                arrs.append((mask & vl).astype(jnp.float32))
+            else:
+                arrs.append(mask.astype(jnp.float32))
+            cnt_ix.append(len(arrs) - 1)
+            term_ix.append([])
+            continue
+        _, vl = eval_expr(d.arg, cols, prepared)
+        contrib = mask & vl
+        arrs.append(contrib.astype(jnp.float32))
+        cnt_ix.append(len(arrs) - 1)
+        t_list = []
+        for (t, shift, L) in s_["terms"]:
+            tv, _ = eval_expr(t, cols, prepared)
+            tv32 = jnp.where(contrib, tv.astype(jnp.int32), 0)
+            limb_ids = []
+            for li in _SE.limbs_of(tv32, L):
+                arrs.append(li.astype(jnp.float32))
+                limb_ids.append(len(arrs) - 1)
+            t_list.append((shift, limb_ids))
+        term_ix.append(t_list)
+
+    tot = SS.rank_sums(jnp.stack(arrs), aux["f"], meta)  # f32[K, nd_pad]
+    gate = tot[0] > 0
+    r0 = aux["r0"]
+
+    def agg_f32(ai):
+        """(approximate f32 value, count) of aggregate ai per rank."""
+        cnt = tot[cnt_ix[ai]]
+        if sched[ai]["kind"] == "count":
+            return cnt, cnt
+        sv = jnp.zeros_like(cnt)
+        for shift, limb_ids in term_ix[ai]:
+            t = jnp.zeros_like(cnt)
+            for pos, ix in enumerate(limb_ids):
+                t = t + tot[ix] * float(1 << (_SE.LIMB_BITS * pos))
+            sv = sv + t * float(1 << shift)
+        return sv, cnt
+
+    if hc is None:
+        # HAVING-filtered groups: the device passes a safely WIDENED
+        # predicate (f32 relative error margin) — completeness is what
+        # matters; the host Selection above re-applies it exactly
+        pass_m = gate
+        for (ai, op, thr) in frag.having:
+            sv, _cnt = agg_f32(ai)
+            eps = jnp.abs(sv) * jnp.float32(2.0 ** -18) + jnp.float32(2.0)
+            thr_f = jnp.float32(thr)
+            if op == "gt":
+                ok = sv > thr_f - eps
+            elif op == "ge":
+                ok = sv >= thr_f - eps
+            elif op == "lt":
+                ok = sv < thr_f + eps
+            else:
+                ok = sv <= thr_f + eps
+            pass_m = pass_m & ok
+        score = jnp.where(pass_m, 1.0, -jnp.inf)
+        k_cap = min(FragmentDAG.HAVING_CAP, score.shape[0])
+        _, cand = jax.lax.approx_max_k(score, k_cap, recall_target=1.0)
+        rows_of = r0[cand]
+        res = {"picked": pass_m[cand].astype(jnp.int32),
+               "score": score[cand]}
+        for gi in range(len(agg.group_by)):
+            res[f"gk{gi}"] = encs[gi][rows_of]
+        _emit_pairs(res, sched, term_ix, cnt_ix, tot, cand)
+        return res
+
+    # ---- candidate selection by (approximate) primary sort score ----
+    kind, idx = hc.score
+    if kind == "group":
+        enc_r = encs[idx][r0]
+        sv = enc_r.astype(jnp.float32)
+        score_null = enc_r == nulls[idx]
+    else:
+        d = agg.aggs[idx]
+        sv, cnt = agg_f32(idx)
+        if sched[idx]["kind"] == "count":
+            score_null = jnp.zeros_like(gate)
+        else:
+            if d.func == "avg":
+                sv = sv / jnp.maximum(cnt, 1.0)
+            score_null = cnt == 0
+    signed = sv if hc.desc else -sv
+    signed = jnp.where(score_null,
+                       jnp.float32(-1e38 if hc.desc else np.inf), signed)
+    score = jnp.where(gate, signed, -jnp.inf)
+
+    k_cap = min(hc.cap, score.shape[0])
+    _, cand = jax.lax.approx_max_k(score, k_cap, recall_target=1.0)
+    rows_of = r0[cand]
+    res = {"picked": gate[cand].astype(jnp.int32), "score": score[cand]}
+    for gi in range(len(agg.group_by)):
+        res[f"gk{gi}"] = encs[gi][rows_of]
+    _emit_pairs(res, sched, term_ix, cnt_ix, tot, cand)
+    return res
+
+
+def _emit_pairs(res, sched, term_ix, cnt_ix, tot, cand):
+    """Candidate rank sums -> the decode's [limbs, 2, cap] pair layout
+    (hi*4096 + lo == value; exact for the gated per-rank totals)."""
+    from . import sumexact as _SE
+
+    def pairs(v_f32):
+        v = v_f32.astype(jnp.int32)
+        return jnp.stack([v >> _SE.LIMB_BITS,
+                          v & ((1 << _SE.LIMB_BITS) - 1)])
+
+    for ai, s_ in enumerate(sched):
+        res[f"cnt{ai}"] = pairs(tot[cnt_ix[ai]][cand])[None]
+        for ti, (shift, limb_ids) in enumerate(term_ix[ai]):
+            res[f"s{ai}_{ti}"] = jnp.stack(
+                [pairs(tot[ix][cand]) for ix in limb_ids])
+
+
+def _hc_body(frag, prepared, cols, mask, aux=None):
     """Sorted-run candidate aggregation (copr/hcagg.py machinery).
 
     Sorts by the SEGMENT keys only (the functional-dependency analysis in
     _prepare_hc proved the other group keys constant within a segment) —
     XLA's variadic sort compile time is the binding constraint. Candidate
     selection uses approx_max_k over a score recombined from the exact
-    pair sums (elementwise, no global scan)."""
+    pair sums (elementwise, no global scan). Run-ordered epochs with rank
+    metadata dispatch to the streamseg rank-space body instead."""
+    if aux is not None and prepared.get("__rank_meta__") is not None:
+        return _hc_rank_body(frag, prepared, cols, mask, aux)
     from . import hcagg as HC
     from . import sumexact as _SE
 
@@ -547,6 +924,7 @@ def _hc_body(frag, prepared, cols, mask):
     nulls = prepared["__hc_nulls__"]
     sched = prepared["__hc_sched__"]
     seg_keys = prepared["__hc_segkeys__"]
+    runord = bool(prepared.get("__hc_runordered__"))
     n = mask.shape[0]
 
     encs = []
@@ -556,20 +934,36 @@ def _hc_body(frag, prepared, cols, mask):
             v = v.astype(jnp.int32)
         encs.append(jnp.where(vl, v.astype(jnp.int32),
                               jnp.int32(nulls[gi])))
-    sort_keys = []
-    for pos, gi in enumerate(seg_keys):
-        k = encs[gi]
-        if pos == 0:
-            k = jnp.where(mask, k, HC._I32_MAX)
-        sort_keys.append(k)
-    sk, perm = HC.sort_by_keys(sort_keys)
-    valid = sk[0] != HC._I32_MAX
-    is_start, end_idx = HC.segment_bounds(sk, valid)
+    if runord:
+        # storage order already groups the segment keys: boundaries are
+        # raw key-change points (of the PROBE columns — a substituted
+        # build-key group enc would carry null codes at unmatched rows);
+        # rows dropped by the filter mask stay in place and contribute
+        # zero to every segment sum, and a segment whose rows were ALL
+        # dropped is gated out after hc_rows below
+        perm = None
+        sk = [cols[i][0].astype(jnp.int32)
+              for i in prepared["__hc_segprobe__"]]
+        is_start, end_idx = HC.segment_bounds(sk, jnp.ones(n, bool))
+        valid = None
+    else:
+        sort_keys = []
+        for pos, gi in enumerate(seg_keys):
+            k = encs[gi]
+            if pos == 0:
+                k = jnp.where(mask, k, HC._I32_MAX)
+            sort_keys.append(k)
+        sk, perm = HC.sort_by_keys(sort_keys)
+        valid = sk[0] != HC._I32_MAX
+        is_start, end_idx = HC.segment_bounds(sk, valid)
     iota = jnp.arange(n, dtype=jnp.int32)
+
+    def P(x):
+        return x if perm is None else x[perm]
 
     def pair_stack(values_unsorted_i32, n_limbs):
         """-> int32[n_limbs, 2, n] per-row candidate pair sums."""
-        v_sorted = values_unsorted_i32[perm]
+        v_sorted = P(values_unsorted_i32)
         outs = []
         for li in _SE.limbs_of(v_sorted, n_limbs):
             hi, lo = HC.seg_sum_pairs(li, iota, end_idx)
@@ -605,11 +999,22 @@ def _hc_body(frag, prepared, cols, mask):
             tv32 = jnp.where(contrib, tv.astype(jnp.int32), 0)
             out[f"hc_s{ai}_{ti}"] = pair_stack(tv32, L)
 
+    # a raw segment whose rows were ALL filtered out is not a group at
+    # all (run-ordered mode only; the sort path pushes dropped rows to
+    # the end, so every surviving start is a real group)
+    if runord:
+        rp = out["hc_rows"]
+        seg_rows = rp[0, 0].astype(jnp.float32) * 4096.0 + \
+            rp[0, 1].astype(jnp.float32)  # exact: counts < 2^24
+        gate = is_start & (seg_rows > 0)
+    else:
+        gate = is_start & valid
+
     # ---- candidate selection by (approximate) primary sort score ----
     kind, idx = hc.score
     if kind == "group":
-        sv = encs[idx][perm].astype(jnp.float32)
-        score_null = encs[idx][perm] == nulls[idx]
+        sv = P(encs[idx]).astype(jnp.float32)
+        score_null = P(encs[idx]) == nulls[idx]
     else:
         d = agg.aggs[idx]
         if sched[idx]["kind"] == "count":
@@ -634,17 +1039,17 @@ def _hc_body(frag, prepared, cols, mask):
     # floor are caught by the decode's strict-gap boundary check.
     signed = jnp.where(score_null,
                        jnp.float32(-1e38 if hc.desc else np.inf), signed)
-    score = jnp.where(is_start & valid, signed, -jnp.inf)
+    score = jnp.where(gate, signed, -jnp.inf)
 
     k_cap = min(hc.cap, n)
     # recall_target=1.0 keeps TPU-native compile times (~10s vs ~20s for
     # lax.top_k at millions of rows) while selecting EXACTLY by score —
     # required for the candidate-superset guarantee the decode relies on
     _, cand = jax.lax.approx_max_k(score, k_cap, recall_target=1.0)
-    res = {"picked": (is_start & valid)[cand].astype(jnp.int32),
+    res = {"picked": gate[cand].astype(jnp.int32),
            "score": score[cand]}
     for gi in range(len(agg.group_by)):
-        res[f"gk{gi}"] = encs[gi][perm][cand]
+        res[f"gk{gi}"] = P(encs[gi])[cand]
     for ai, s in enumerate(sched):
         res[f"cnt{ai}"] = out[f"hc_cnt{ai}"][:, :, cand]
         for ti in range(len(s.get("terms", ()))):
@@ -655,17 +1060,17 @@ def _hc_body(frag, prepared, cols, mask):
 def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
     """Candidate partials -> partial-layout chunk (subset of groups; the
     host HashAgg(final) + Sort + Limit above do the exact final ranking)."""
-    from . import sumexact as _SE
-    from ..types.field_type import FieldType, TypeKind
-
-    agg = frag.agg
-    sched = prepared["__hc_sched__"]
-    nulls = prepared["__hc_nulls__"]
     if np.any(np.asarray(out.pop("overflow", 0)) > 0):
-        raise _Fallback()  # exchange bucket overflow (adversarial skew)
+        raise _Fallback("exchange-overflow")  # adversarial skew
     picked = out["picked"].astype(bool)
     if not picked.any():
         return None
+    if frag.hc is None:
+        # HAVING mode: sound iff the candidate buffer was not exhausted
+        # (every margined-passing group fit; the host re-filters exactly)
+        if picked.all():
+            raise _Fallback("having-overflow")
+        return _decode_hc_rows(frag, snaps, prepared, out, picked)
     # candidate blocks are per-exchange-partition (group spaces disjoint);
     # each partition's buffer must be verified independently
     blocks = max(1, int(prepared.get("__hc_blocks__", 1)))
@@ -681,7 +1086,18 @@ def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
             score = out["score"][b * kb:(b + 1) * kb]
             k = frag.hc.k
             if k >= kb or not (score[k - 1] > score[-1]):
-                raise _Fallback()
+                raise _Fallback("hc-boundary")
+    return _decode_hc_rows(frag, snaps, prepared, out, picked)
+
+
+def _decode_hc_rows(frag, snaps, prepared, out, picked) -> Chunk:
+    """Materialize the picked candidates as a partial-layout chunk."""
+    from . import sumexact as _SE
+    from ..types.field_type import FieldType, TypeKind
+
+    agg = frag.agg
+    sched = prepared["__hc_sched__"]
+    nulls = prepared["__hc_nulls__"]
     sel = np.nonzero(picked)[0]
 
     comb_dicts = []

@@ -273,6 +273,9 @@ PROCESS_METRICS = Registry()
 COPR_REQUESTS = PROCESS_METRICS.counter(
     "tidb_copr_requests_total",
     "coprocessor executions, by engine (device / host fallback)")
+FRAG_FALLBACKS = PROCESS_METRICS.counter(
+    "tidb_copr_fragment_fallbacks_total",
+    "device-fragment gate rejections, by reason")
 
 
 # ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------

@@ -36,7 +36,7 @@ from typing import Optional
 
 from ..types.field_type import FieldType, TypeKind
 from .dag import DAGAggregation
-from .expr import AggDesc, Call, Col, PlanExpr, ScalarSubq
+from .expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from .physical import (
     PhysHashAgg,
     PhysHashJoin,
@@ -114,6 +114,14 @@ class FragmentDAG:
     # set when the agg's consumer is a TopN: permits the high-cardinality
     # candidate path when the dense-segment gate rejects the group space
     hc: Optional[HCTopN] = None
+    # set when the agg's consumer filters on an aggregate value (HAVING
+    # sum(x) > c): the device may return only groups passing a safely
+    # widened version of these predicates — the host Selection above
+    # re-applies them exactly. Each entry is (agg_index, op, const) with
+    # op in lt/le/gt/ge and const already scaled to the aggregate's
+    # integer representation.
+    having: Optional[list] = None
+    HAVING_CAP = 65536  # candidate buffer for having-filtered groups
 
     def combined_types(self) -> list[FieldType]:
         out: list[FieldType] = []
@@ -418,6 +426,62 @@ def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
 
 _HC_SCORE_FUNCS = ("sum", "count", "avg")
 
+_FLIP = {"gt": "lt", "lt": "gt", "ge": "le", "le": "ge"}
+
+
+def _having_entries(conds: list[PlanExpr], agg_node: PhysHashAgg):
+    """Extract device-checkable HAVING predicates: comparisons of one
+    SUM/COUNT aggregate against a constant, with the threshold converted
+    to the aggregate's integer representation. Unconvertible conjuncts
+    are simply not pushed — the host Selection re-applies every conjunct
+    exactly, so the device filter only needs to be a superset."""
+    from ..types.field_type import TypeKind
+    from ..types.value import Decimal as Dec
+
+    ngroups = len(agg_node.group_by)
+    out = []
+    for c in conds:
+        if not (isinstance(c, Call) and c.op in _FLIP and
+                len(c.args) == 2):
+            continue
+        a, b = c.args
+        op = c.op
+        if isinstance(a, Const) and isinstance(b, Col):
+            a, b, op = b, a, _FLIP[op]
+        if not (isinstance(a, Col) and isinstance(b, Const)):
+            continue
+        ai = a.idx - ngroups
+        if ai < 0 or ai >= len(agg_node.aggs):
+            continue
+        d = agg_node.aggs[ai]
+        if d.func not in ("sum", "count"):
+            continue
+        # normalize the constant to an exact Decimal (a Const's value is
+        # already in ITS OWN ftype's integer representation)
+        v = b.value
+        try:
+            if isinstance(v, Dec):
+                dv = v
+            elif b.ftype.kind == TypeKind.DECIMAL:
+                dv = Dec(int(v), b.ftype.scale)
+            elif isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            elif isinstance(v, int):
+                dv = Dec(v, 0)
+            else:
+                dv = Dec.parse(repr(float(v)))
+        except (TypeError, ValueError, OverflowError):
+            continue
+        # the device computes sums in the ARGUMENT's integer
+        # representation (the partial layout); the final output type may
+        # carry a different (wider) scale
+        ft = d.arg.ftype if d.func == "sum" and d.arg is not None \
+            else d.ftype
+        sc = ft.scale if ft.kind == TypeKind.DECIMAL else 0
+        thr = dv.rescale(sc).unscaled
+        out.append((ai, op, thr))
+    return out
+
 
 def _attach_hc(limit_node, sort_node, proj, agg_node,
                rewritten: PhysHashAgg) -> bool:
@@ -507,6 +571,43 @@ def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
                     # the degenerate single-table fragment is useful ONLY
                     # with the hc hint — keep the CopDAG pushdown otherwise
                     below.children = old_children
+                return plan
+
+    # HAVING over an aggregation: push a safely-widened version of the
+    # aggregate-vs-constant predicates into the fragment so the device
+    # returns only (a superset of) the passing groups; this Selection
+    # stays and re-applies the predicates exactly (reference: HAVING
+    # evaluates above the aggregate, planner/core/logical_plan_builder.go
+    # buildSelection over LogicalAggregation)
+    if isinstance(plan, PhysSelection) and plan.children and \
+            isinstance(plan.children[0], PhysHashAgg):
+        below = plan.children[0]
+        if below.mode == "complete":
+            entries = _having_entries(plan.conditions, below)
+            if entries:
+                rewritten = _match_agg_fragment(below, allow_single=True)
+                if rewritten is not None:
+                    rewritten.children[0].frag.having = entries
+                    plan.children = [rewritten]
+                    return plan
+        elif below.mode == "final" and len(below.children) == 1 and \
+                isinstance(below.children[0], PhysTableRead):
+            tr = below.children[0]
+            dag = tr.dag
+            entries = _having_entries(plan.conditions, below)
+            huge = (tr.est_rows or 0) > 2e8
+            if entries and not huge and dag.agg is not None and \
+                    dag.scan.ranges is None and \
+                    getattr(tr, "table", None) is not None and \
+                    dag.topn is None and dag.limit is None:
+                frag = FragmentDAG([FragTable(
+                    tr.table, list(dag.scan.col_offsets),
+                    list(dag.selection.conditions) if dag.selection
+                    else [], _scan_types(tr))], [])
+                frag.agg = dag.agg
+                frag.output_types = list(dag.output_types)
+                frag.having = entries
+                below.children = [PhysFragmentRead(frag, tr.schema)]
                 return plan
 
     if isinstance(plan, PhysHashAgg) and plan.mode == "complete":

@@ -352,8 +352,15 @@ class TableStore:
             new_valids: list[Optional[np.ndarray]] = []
             for ci in range(self.table.num_columns):
                 dt = self.table.columns[ci].ftype.np_dtype
-                new_cols.append(np.concatenate(
-                    [epoch.columns[ci], columns[ci].astype(dt)]))
+                if epoch.num_rows == 0:
+                    # adopt the caller's arrays without copying: a SF100
+                    # load is ~60GB of columns and a concatenate would
+                    # double the peak footprint. Epoch columns are
+                    # treated as immutable everywhere.
+                    new_cols.append(columns[ci].astype(dt, copy=False))
+                else:
+                    new_cols.append(np.concatenate(
+                        [epoch.columns[ci], columns[ci].astype(dt)]))
                 add_v = valids[ci] if valids is not None else None
                 old_v = epoch.valids[ci]
                 if old_v is None and add_v is None:
