@@ -1,6 +1,8 @@
-"""Benchmark: TPC-H on the TiTPU engine — SF10 Q6/Q1 scans + SF1 Q3 join.
+"""Benchmark board: TPC-H (SF10 + SF100), SSB, ClickBench-style configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — the
+headline is TPC-H Q6 at the north-star SF100 scale (BASELINE.json
+metric: "TPC-H rows/sec/chip; Q1+Q6 p50 latency at SF100").
 
 Comparison basis (BASELINE.md): the reference publishes no absolute
 numbers in-repo and its Go toolchain isn't present here, so the floor is
@@ -8,24 +10,27 @@ a row-at-a-time interpreted coprocessor baseline measured in-process —
 the execution model of the reference's mocktikv interpreter (reference:
 store/mockstore/mocktikv/cop_handler_dag.go:150, row loop over MVCC
 pairs) — timed on a sample and scaled. BOTH sides of the headline ratio
-are SINGLE-STREAM: vs_baseline = engine single-stream Q6 rows/s divided
-by interpreter rows/s (round-2 verdict asked for an apples-to-apples
-basis; concurrent throughput is reported separately on stderr, labeled).
+are SINGLE-STREAM.
 
-Configs (BASELINE.md table):
-  q6_sf10  — scan+filter+SUM over 60M rows (tiled device execution)
-  q1_sf10  — scan + 4-group segment aggregation over 60M rows
-  q3_sf1   — customer x orders x lineitem snowflake join fragment + hc agg
-Correctness gates: Q6/Q1 against exact numpy oracles at full scale; Q3
-against the sqlite differential oracle at SF 0.1 (same generator seed
-corpus the test suite uses; SF1 timing runs the identical plan shape).
+Configs (BASELINE.json configs[0..4] + the r04 join target):
+  q6_sf10 / q1_sf10     — scan flight at SF10 (series continuity)
+  q3_sf10 / q5_sf10     — snowflake join fragments at SF10 (digest vs
+                          exact numpy oracle; plan verified vs sqlite at
+                          SF0.1 by the test suite)
+  ssb q1.1-1.3          — SSB flight at BENCH_SSB_SF (default 100)
+  cb_*                  — ClickBench-style wide scan/TopN at
+                          BENCH_CB_ROWS (default 100M)
+  q6_sf100 / q1_sf100   — the north star (BENCH_SF_BIG, default 100)
 
-Environment knobs: BENCH_SF (default 10), BENCH_JOIN_SF (default 1.0),
-BENCH_REPEAT, BENCH_CLIENTS, BENCH_PLATFORM.
+Every timed query passes an exact digest check against a numpy oracle
+first. Environment knobs: BENCH_SF (10), BENCH_JOIN_SF (10),
+BENCH_SSB_SF (100), BENCH_CB_ROWS (1e8), BENCH_SF_BIG (100),
+BENCH_REPEAT (5), BENCH_CLIENTS (8), BENCH_PLATFORM.
 """
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import sys
@@ -36,12 +41,13 @@ import numpy as np
 ROWS_PER_SF = 6_001_215
 
 
-def interpreted_q6_baseline(arrays: dict[str, np.ndarray],
-                            sample: int = 200_000) -> float:
-    """Row-at-a-time interpreted Q6 (mocktikv-style) rows/sec.
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
 
-    Median of 3 runs — a single pass is noisy (GC, turbo, co-tenants) and
-    the ratio metric inherits that noise."""
+
+def interpreted_q6_baseline(arrays, sample: int = 200_000) -> float:
+    """Row-at-a-time interpreted Q6 (mocktikv-style) rows/sec, median of
+    3 (single passes are noisy and the ratio inherits it)."""
     from tidb_tpu.types.value import parse_date
 
     n = min(sample, len(arrays["l_shipdate"]))
@@ -60,8 +66,7 @@ def interpreted_q6_baseline(arrays: dict[str, np.ndarray],
                 d = disc[i]
                 if 5 <= d <= 7 and qty[i] < 2400:
                     acc += price[i] * d
-        dt = time.perf_counter() - t0
-        rates.append(n / dt)
+        rates.append(n / (time.perf_counter() - t0))
     return sorted(rates)[1]
 
 
@@ -76,10 +81,8 @@ def q6_oracle(arrays) -> int:
                 * arrays["l_discount"][m]).sum())
 
 
-def q1_oracle(arrays) -> dict[tuple[int, int], tuple[int, ...]]:
-    """Exact int64 aggregates per (returnflag, linestatus) group:
-    (sum_qty, sum_base, sum_disc_price, sum_charge, count) in unscaled
-    decimal units (scales 2, 2, 4, 6)."""
+def q1_oracle(arrays):
+    """Exact int64 aggregates per (returnflag, linestatus) group."""
     from tidb_tpu.types.value import parse_date
 
     cutoff = parse_date("1998-12-01") - 90
@@ -91,17 +94,16 @@ def q1_oracle(arrays) -> dict[tuple[int, int], tuple[int, ...]]:
     disc = arrays["l_discount"][m].astype(np.int64)
     tax = arrays["l_tax"][m].astype(np.int64)
     key = rf * 2 + ls
-    nseg = 6
     out = {}
     for name, vals in (("qty", qty), ("base", ext),
                        ("disc_price", ext * (100 - disc)),
                        ("charge", ext * (100 - disc) * (100 + tax)),
                        ("count", np.ones(len(key), np.int64))):
-        acc = np.zeros(nseg, dtype=np.int64)
+        acc = np.zeros(6, dtype=np.int64)
         np.add.at(acc, key, vals)
         out[name] = acc
     res = {}
-    for k in range(nseg):
+    for k in range(6):
         if out["count"][k]:
             res[(k // 2, k % 2)] = tuple(int(out[n][k]) for n in (
                 "qty", "base", "disc_price", "charge", "count"))
@@ -109,7 +111,6 @@ def q1_oracle(arrays) -> dict[tuple[int, int], tuple[int, ...]]:
 
 
 def check_q1(rows, arrays) -> None:
-    """Session Q1 rows vs the exact oracle (integer digests only)."""
     want = q1_oracle(arrays)
     flag_code = {"A": 0, "R": 1, "N": 2}
     status_code = {"F": 0, "O": 1}
@@ -119,46 +120,102 @@ def check_q1(rows, arrays) -> None:
         w = want[key]
         got = (r[2].unscaled, r[3].unscaled, r[4].unscaled, r[5].unscaled,
                r[9])
-        assert got == w, f"Q1 digest mismatch for {r[0]}/{r[1]}: {got} vs {w}"
+        assert got == w, f"Q1 digest mismatch {r[0]}/{r[1]}: {got} vs {w}"
 
 
-def verify_q3_sf01() -> None:
-    """Differential-check Q3 against sqlite at SF 0.1 (the suite's oracle
-    corpus); the SF1 timing below runs the identical plan shape."""
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "tests"))
-    from tpch_oracle import (load_sqlite, normalize_cell, rows_equal,
-                             to_sqlite_sql)
+def q3_oracle(jdata):
+    """Exact top-10 (orderkey, revenue_unscaled) for TPC-H Q3."""
+    from tidb_tpu.types.value import parse_date
 
-    from tidb_tpu.bench.tpch_data import TPCH_DDL, generate_tpch, load_table
-    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
-    from tidb_tpu.session import Session
+    cutoff = parse_date("1995-03-15")
+    segs, ccodes = jdata["customer"]["c_mktsegment"]
+    bld = list(segs).index("BUILDING")
+    cust = jdata["customer"]["c_custkey"]
+    cust_ok = np.zeros(int(cust.max()) + 1, bool)
+    cust_ok[cust[np.asarray(ccodes) == bld]] = True
+    o = jdata["orders"]
+    o_ok = (o["o_orderdate"] < cutoff) & cust_ok[o["o_custkey"]]
+    span = int(o["o_orderkey"].max()) + 1
+    ok_arr = np.zeros(span, bool)
+    ok_arr[o["o_orderkey"][o_ok]] = True
+    odate = np.zeros(span, np.int64)
+    odate[o["o_orderkey"][o_ok]] = o["o_orderdate"][o_ok]
+    li = jdata["lineitem"]
+    lm = (li["l_shipdate"] > cutoff) & ok_arr[li["l_orderkey"]]
+    rev = np.zeros(span, np.int64)
+    np.add.at(rev, li["l_orderkey"][lm],
+              li["l_extendedprice"][lm] * (100 - li["l_discount"][lm]))
+    nz = np.nonzero(rev)[0]
+    top = nz[np.lexsort((nz, odate[nz], -rev[nz]))[:10]]
+    return [(int(k), int(rev[k])) for k in top]
 
-    s = Session()
-    data = generate_tpch(0.1, 11)
-    need = ("region", "nation", "customer", "orders", "lineitem")
-    for t in need:
-        load_table(s, t, data[t])
-    conn = load_sqlite({t: data[t] for t in need},
-                       {t: TPCH_DDL[t] for t in need})
-    sql = TPCH_QUERIES["q3"]
-    got = [tuple(normalize_cell(c) for c in r) for r in s.query(sql)]
-    want = [tuple(normalize_cell(c) for c in r)
-            for r in conn.execute(to_sqlite_sql(sql)).fetchall()]
-    ok, why = rows_equal(got, want, ordered=True)
-    assert ok, f"Q3 differential failed at SF0.1: {why}"
+
+def q5_oracle(jdata):
+    """Exact (nation, revenue_unscaled) rows for TPC-H Q5 (ASIA/1994)."""
+    from tidb_tpu.types.value import parse_date
+
+    d1, d2 = parse_date("1994-01-01"), parse_date("1995-01-01")
+    rnames, rcodes = jdata["region"]["r_name"]
+    asia = list(rnames).index("ASIA")
+    r_ok = np.asarray(rcodes) == asia
+    reg_ok = np.zeros(int(jdata["region"]["r_regionkey"].max()) + 1, bool)
+    reg_ok[jdata["region"]["r_regionkey"][r_ok]] = True
+    nat = jdata["nation"]
+    n_ok = reg_ok[nat["n_regionkey"]]
+    nspan = int(nat["n_nationkey"].max()) + 1
+    nat_ok = np.zeros(nspan, bool)
+    nat_ok[nat["n_nationkey"][n_ok]] = True
+    cust = jdata["customer"]
+    cspan = int(cust["c_custkey"].max()) + 1
+    c_nat = np.full(cspan, -1, np.int64)
+    c_nat[cust["c_custkey"]] = cust["c_nationkey"]
+    supp = jdata["supplier"]
+    sspan = int(supp["s_suppkey"].max()) + 1
+    s_nat = np.full(sspan, -1, np.int64)
+    s_nat[supp["s_suppkey"]] = supp["s_nationkey"]
+    o = jdata["orders"]
+    o_ok = (o["o_orderdate"] >= d1) & (o["o_orderdate"] < d2)
+    ospan = int(o["o_orderkey"].max()) + 1
+    o_cnat = np.full(ospan, -1, np.int64)
+    o_cnat[o["o_orderkey"][o_ok]] = c_nat[o["o_custkey"][o_ok]]
+    li = jdata["lineitem"]
+    lnat = s_nat[li["l_suppkey"]]
+    onat = o_cnat[li["l_orderkey"]]
+    m = (lnat >= 0) & (lnat == onat) & nat_ok[np.clip(lnat, 0, None)]
+    rev = np.zeros(nspan, np.int64)
+    np.add.at(rev, lnat[m],
+              li["l_extendedprice"][m] * (100 - li["l_discount"][m]))
+    return {int(k): int(rev[k]) for k in np.nonzero(rev)[0]}
+
+
+def times(run, repeat) -> list[float]:
+    run()  # warm
+    ts = []
+    for _ in range(repeat):
+        t = time.perf_counter()
+        run()
+        ts.append(time.perf_counter() - t)
+    ts.sort()
+    return ts
+
+
+def report(name, ts, rows) -> tuple[str, float]:
+    p50 = ts[len(ts) // 2]
+    line = (f"{name}: p50={p50 * 1e3:.1f}ms max={ts[-1] * 1e3:.1f}ms "
+            f"(of {len(ts)}) {rows / p50 / 1e6:.1f}M rows/s single-stream")
+    return line, rows / p50
 
 
 def main() -> None:
     sf = float(os.environ.get("BENCH_SF", 10))
-    join_sf = float(os.environ.get("BENCH_JOIN_SF", 1.0))
-    n_rows = int(os.environ.get("BENCH_ROWS", int(ROWS_PER_SF * sf)))
+    join_sf = float(os.environ.get("BENCH_JOIN_SF", 10))
+    ssb_sf = float(os.environ.get("BENCH_SSB_SF", 100))
+    cb_rows = int(float(os.environ.get("BENCH_CB_ROWS", 1e8)))
+    sf_big = float(os.environ.get("BENCH_SF_BIG", 100))
     repeat = int(os.environ.get("BENCH_REPEAT", 5))
     n_clients = int(os.environ.get("BENCH_CLIENTS", 8))
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
-        # this image pre-imports jax at interpreter startup, so
-        # JAX_PLATFORMS in the env is ignored; the config path still works
         import jax
         jax.config.update("jax_platforms", platform)
 
@@ -170,60 +227,30 @@ def main() -> None:
     )
     from tidb_tpu.session import Session
 
-    t0 = time.perf_counter()
-    arrays = generate_lineitem_arrays(n_rows)
-    gen_s = time.perf_counter() - t0
+    lines: list[str] = []
 
+    # ---- 1. TPC-H SF10 scan flight + interpreted baseline ----
+    n10 = int(ROWS_PER_SF * sf)
+    t0 = time.perf_counter()
+    arrays = generate_lineitem_arrays(n10)
+    gen_s = time.perf_counter() - t0
     session = Session()
     t0 = time.perf_counter()
-    load_lineitem(session, n_rows, arrays=arrays)
-    load_s = time.perf_counter() - t0
-
+    load_lineitem(session, n10, arrays=arrays)
+    log(f"tpch sf{sf:g}: gen={gen_s:.0f}s load="
+        f"{time.perf_counter() - t0:.0f}s")
     baseline_rps = interpreted_q6_baseline(arrays)
-
-    # correctness gates before timing (exact digests vs numpy oracles)
-    got = session.query(TPCH_Q6)[0][0]  # warms compile + device tile cache
-    assert got is not None and got.unscaled == q6_oracle(arrays), \
-        f"Q6 digest mismatch: {got.unscaled} vs {q6_oracle(arrays)}"
+    got = session.query(TPCH_Q6)[0][0]
+    assert got is not None and got.unscaled == q6_oracle(arrays), "q6"
     check_q1(session.query(TPCH_Q1), arrays)
-    verify_q3_sf01()
+    q6_ts = times(lambda: session.query(TPCH_Q6), repeat)
+    q1_ts = times(lambda: session.query(TPCH_Q1), repeat)
+    l6, q6_sf10_rps = report(f"q6_sf{sf:g}", q6_ts, n10)
+    l1, _ = report(f"q1_sf{sf:g}", q1_ts, n10)
+    lines += [l6, l1]
 
-    def times(run) -> list[float]:
-        run()  # warm
-        ts = []
-        for _ in range(repeat):
-            t = time.perf_counter()
-            run()
-            ts.append(time.perf_counter() - t)
-        ts.sort()
-        return ts
-
-    def report(name: str, ts: list[float], rows: int) -> str:
-        p50 = ts[len(ts) // 2]
-        worst = ts[-1]
-        return (f"{name}: p50={p50 * 1e3:.1f}ms max={worst * 1e3:.1f}ms "
-                f"(of {len(ts)}) {rows / p50 / 1e6:.1f}M rows/s "
-                f"single-stream")
-
-    q6_ts = times(lambda: session.query(TPCH_Q6))
-    q1_ts = times(lambda: session.query(TPCH_Q1))
-
-    # join config: full snowflake fragment at SF1 (separate storage)
-    from tidb_tpu.bench.tpch_data import TPCH_DDL, generate_tpch, load_table
-    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
-
-    js = Session()
-    t0 = time.perf_counter()
-    jdata = generate_tpch(join_sf, 11)
-    for t in ("region", "nation", "customer", "orders", "lineitem"):
-        load_table(js, t, jdata[t])
-    jload_s = time.perf_counter() - t0
-    jrows = len(jdata["lineitem"]["l_orderkey"])
-    q3_ts = times(lambda: js.query(TPCH_QUERIES["q3"]))
-
-    # concurrent throughput (separate, labeled: N clients pipelining on
-    # the dispatch round-trip vs the single-threaded interpreter)
-    def throughput(sql: str, per: int = 2) -> float:
+    # concurrent throughput (separate, labeled)
+    def throughput(sql, per=2) -> float:
         import threading
 
         sessions = [Session(session.storage, cop=session.cop)
@@ -248,39 +275,135 @@ def main() -> None:
                 t.start()
             for t in threads:
                 t.join()
-            dt = time.perf_counter() - t0
             if errs:
                 raise errs[0]
-            best = max(best, n_clients * per * n_rows / dt)
+            best = max(best, n_clients * per * n10 /
+                       (time.perf_counter() - t0))
         return best
 
-    q6_tput = throughput(TPCH_Q6)
+    tput = throughput(TPCH_Q6)
+    lines.append(f"q6 concurrent throughput ({n_clients} clients): "
+                 f"{tput / 1e6:.1f}M rows/s "
+                 f"({tput / baseline_rps:.1f}x the interpreted baseline)")
+    del session, arrays
+    gc.collect()
 
-    q6_p50 = q6_ts[len(q6_ts) // 2]
-    single_stream_rps = n_rows / q6_p50
+    # ---- 2. TPC-H join corpus at join_sf ----
+    from tidb_tpu.bench.tpch_data import generate_tpch, load_table
+    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+
+    t0 = time.perf_counter()
+    jdata = generate_tpch(join_sf, 11)
+    js = Session()
+    for t in jdata:
+        load_table(js, t, jdata[t])
+    jrows = len(jdata["lineitem"]["l_orderkey"])
+    log(f"tpch join corpus sf{join_sf:g}: gen+load="
+        f"{time.perf_counter() - t0:.0f}s ({jrows} lineitem rows)")
+    want3 = q3_oracle(jdata)
+    got3 = [(int(r[0]), r[1].unscaled) for r in js.query(
+        TPCH_QUERIES["q3"])]
+    assert got3 == want3, f"q3 digest: {got3[:3]} vs {want3[:3]}"
+    want5 = q5_oracle(jdata)
+    got5 = {r[0]: r[1].unscaled for r in js.query(TPCH_QUERIES["q5"])}
+    nnames, _ = jdata["nation"]["n_name"]
+    nat_by_name = {nm: int(k) for nm, k in zip(
+        nnames, jdata["nation"]["n_nationkey"])}
+    got5 = {nat_by_name[name]: v for name, v in got5.items()}
+    assert got5 == want5, f"q5 digest: {got5} vs {want5}"
+    q3_ts = times(lambda: js.query(TPCH_QUERIES["q3"]), repeat)
+    q5_ts = times(lambda: js.query(TPCH_QUERIES["q5"]), repeat)
+    l3, q3_rps = report(f"q3_sf{join_sf:g}", q3_ts, jrows)
+    l5, _ = report(f"q5_sf{join_sf:g}", q5_ts, jrows)
+    lines += [l3 + f" ({q3_rps / baseline_rps:.1f}x interpreted baseline)",
+              l5]
+    del js, jdata
+    gc.collect()
+
+    # ---- 3. SSB Q1 flight ----
+    from tidb_tpu.bench import ssb
+
+    t0 = time.perf_counter()
+    lo = ssb.generate_lineorder(ssb_sf)
+    ss = Session()
+    nrows_ssb = ssb.load_ssb(ss, ssb_sf, lineorder=lo)
+    log(f"ssb sf{ssb_sf:g}: gen+load={time.perf_counter() - t0:.0f}s "
+        f"({nrows_ssb} lineorder rows)")
+    for q in ("q1.1", "q1.2", "q1.3"):
+        got = ss.query(ssb.SSB_QUERIES[q])[0][0]
+        assert got is not None and int(got) == ssb.q1_oracle(lo, q), q
+        ts = times(lambda sql=ssb.SSB_QUERIES[q]: ss.query(sql), repeat)
+        line, _ = report(f"ssb_{q}_sf{ssb_sf:g}", ts, nrows_ssb)
+        lines.append(line)
+    del ss, lo
+    gc.collect()
+
+    # ---- 4. ClickBench-style hits ----
+    from tidb_tpu.bench import clickbench as cbench
+
+    t0 = time.perf_counter()
+    hits = cbench.generate_hits(cb_rows)
+    cs = Session()
+    cbench.load_hits(cs, cb_rows, hits=hits)
+    log(f"clickbench hits_{cb_rows // 1_000_000}m: gen+load="
+        f"{time.perf_counter() - t0:.0f}s")
+    for q, sql in cbench.CB_QUERIES.items():
+        got = cs.query(sql)
+        want = cbench.cb_oracle(hits, q)
+        if q in ("cb_scan", "cb_sum"):
+            ok = int(got[0][0]) == want
+        elif q == "cb_agg":
+            ok = (int(got[0][0]), int(got[0][1])) == want
+        else:
+            ok = [(int(a), int(b)) for a, b in got] == want
+        assert ok, f"{q} digest"
+        ts = times(lambda s2=sql: cs.query(s2), repeat)
+        line, _ = report(q, ts, cb_rows)
+        lines.append(line)
+    del cs, hits
+    gc.collect()
+
+    # ---- 5. North star: TPC-H SF100 Q1/Q6 ----
+    headline_rps = q6_sf10_rps
+    headline_name = f"q6_sf{sf:g}"
+    try:
+        nbig = int(ROWS_PER_SF * sf_big)
+        t0 = time.perf_counter()
+        big_arrays = generate_lineitem_arrays(nbig)
+        gen_s = time.perf_counter() - t0
+        bs = Session()
+        t0 = time.perf_counter()
+        load_lineitem(bs, nbig, arrays=big_arrays)
+        log(f"tpch sf{sf_big:g}: gen={gen_s:.0f}s load="
+            f"{time.perf_counter() - t0:.0f}s ({nbig} rows)")
+        got = bs.query(TPCH_Q6)[0][0]
+        assert got is not None and got.unscaled == q6_oracle(big_arrays)
+        check_q1(bs.query(TPCH_Q1), big_arrays)
+        q6b = times(lambda: bs.query(TPCH_Q6), repeat)
+        q1b = times(lambda: bs.query(TPCH_Q1), repeat)
+        l6b, q6_big_rps = report(f"q6_sf{sf_big:g}", q6b, nbig)
+        l1b, _ = report(f"q1_sf{sf_big:g}", q1b, nbig)
+        lines += [l6b, l1b]
+        headline_rps = q6_big_rps
+        headline_name = f"q6_sf{sf_big:g}"
+        del bs, big_arrays
+        gc.collect()
+    except Exception as e:  # report the failure, keep the SF10 headline
+        lines.append(f"sf{sf_big:g} flight FAILED: {type(e).__name__}: "
+                     f"{str(e)[:200]}")
+
     print(json.dumps({
         "metric": "tpch_q6_rows_per_sec",
-        "value": round(single_stream_rps),
+        "value": round(headline_rps),
         "unit": "rows/s",
-        "vs_baseline": round(single_stream_rps / baseline_rps, 2),
+        "vs_baseline": round(headline_rps / baseline_rps, 2),
     }))
-    # context on stderr so the JSON line stays clean
-    print(
-        f"# basis: single-stream engine vs single-stream interpreted "
-        f"row-loop baseline ({baseline_rps / 1e3:.0f}K rows/s); "
-        f"platform={__import__('jax').default_backend()}\n"
-        f"# lineitem SF{sf:g} ({n_rows} rows, gen={gen_s:.0f}s "
-        f"load={load_s:.0f}s) | join corpus SF{join_sf:g} "
-        f"({jrows} lineitem rows, load={jload_s:.0f}s)\n"
-        f"# {report(f'q6_sf{sf:g}', q6_ts, n_rows)}\n"
-        f"# {report(f'q1_sf{sf:g}', q1_ts, n_rows)}\n"
-        f"# {report(f'q3_sf{join_sf:g}', q3_ts, jrows)}\n"
-        f"# q6 concurrent throughput ({n_clients} clients): "
-        f"{q6_tput / 1e6:.1f}M rows/s "
-        f"({q6_tput / baseline_rps:.1f}x the single-threaded baseline; "
-        f"round-trips pipeline across clients)",
-        file=sys.stderr,
-    )
+    log(f"headline={headline_name}; basis: single-stream engine vs "
+        f"single-stream interpreted row-loop baseline "
+        f"({baseline_rps / 1e3:.0f}K rows/s); "
+        f"platform={__import__('jax').default_backend()}")
+    for ln in lines:
+        log(ln)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+"""ClickBench-style wide-table scan/TopN benchmark config.
+
+BASELINE.json configs[4] names "ClickBench hits_100m (wide-column scan +
+TopN/window)". The real dataset cannot be downloaded in this environment
+(zero egress), so this module generates a synthetic `hits` table with the
+ClickBench column shapes that the classic queries touch, clustered by
+CounterID like the original table's ORDER BY (CounterID, EventDate, ...)
+physical layout — which is what makes the run-ordered aggregation path
+representative.
+
+Queries mirror well-known ClickBench shapes:
+  cb_scan  - Q1-style filtered count:   count(*) WHERE AdvEngineID <> 0
+  cb_agg   - Q6-style global aggregate: min/max of EventDate
+  cb_topn  - Q12-style group TopN:      top 10 CounterID by count(*)
+  cb_sum   - Q7-style sum:              sum(AdvEngineID)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..session import Session
+
+HITS_DDL = """
+create table hits (
+  CounterID int not null,
+  EventDate int not null,
+  UserID bigint not null,
+  AdvEngineID int not null,
+  RegionID int not null,
+  SearchPhraseID int not null,
+  IsRefresh int not null,
+  ResolutionWidth int not null,
+  Age int not null,
+  Income int not null
+)
+"""
+
+CB_QUERIES = {
+    "cb_scan": "select count(*) from hits where AdvEngineID <> 0",
+    "cb_agg": "select min(EventDate), max(EventDate) from hits",
+    "cb_sum": "select sum(AdvEngineID) from hits",
+    "cb_topn": ("select CounterID, count(*) as c from hits "
+                "group by CounterID order by c desc limit 10"),
+}
+
+
+def generate_hits(n_rows: int, seed: int = 3) -> dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    n_counters = max(2, n_rows // 500)
+    # zipf-ish skew over counters, clustered (sorted) like the original
+    weights = 1.0 / np.arange(1, n_counters + 1) ** 0.8
+    counts = rng.multinomial(n_rows, weights / weights.sum())
+    counter = np.repeat(np.arange(1, n_counters + 1, dtype=np.int64),
+                        counts)[:n_rows]
+    if len(counter) < n_rows:
+        counter = np.concatenate(
+            [counter, np.full(n_rows - len(counter), n_counters,
+                              np.int64)])
+    return {
+        "CounterID": counter,
+        "EventDate": rng.integers(19000, 19100, n_rows, dtype=np.int64),
+        "UserID": rng.integers(0, 1 << 40, n_rows, dtype=np.int64),
+        "AdvEngineID": np.where(rng.random(n_rows) < 0.95, 0,
+                                rng.integers(1, 90, n_rows)),
+        "RegionID": rng.integers(0, 5000, n_rows, dtype=np.int64),
+        "SearchPhraseID": rng.integers(0, 1 << 20, n_rows,
+                                       dtype=np.int64),
+        "IsRefresh": (rng.random(n_rows) < 0.1).astype(np.int64),
+        "ResolutionWidth": rng.integers(0, 2600, n_rows, dtype=np.int64),
+        "Age": rng.integers(0, 100, n_rows, dtype=np.int64),
+        "Income": rng.integers(0, 10_000_00, n_rows, dtype=np.int64),
+    }
+
+
+def load_hits(session: Session, n_rows: int, seed: int = 3,
+              hits: dict[str, np.ndarray] | None = None) -> None:
+    session.execute("drop table if exists hits")
+    session.execute(HITS_DDL)
+    info = session.catalog.table(session.current_db, "hits")
+    store = session.storage.table_store(info.id)
+    data = hits if hits is not None else generate_hits(n_rows, seed)
+    store.bulk_load([data[c.name] for c in info.columns])
+
+
+def cb_oracle(hits: dict[str, np.ndarray], which: str):
+    if which == "cb_scan":
+        return int((hits["AdvEngineID"] != 0).sum())
+    if which == "cb_agg":
+        return (int(hits["EventDate"].min()), int(hits["EventDate"].max()))
+    if which == "cb_sum":
+        return int(hits["AdvEngineID"].sum())
+    # cb_topn: top 10 (CounterID, count) ordered by count desc
+    ids, counts = np.unique(hits["CounterID"], return_counts=True)
+    order = np.lexsort((ids, -counts))[:10]
+    return [(int(ids[i]), int(counts[i])) for i in order]

@@ -1,0 +1,206 @@
+"""Star Schema Benchmark (SSB) generator + Q1 flight.
+
+BASELINE.json configs[2] names "SSB SF100 Q1.1-1.3 (selection + aggregate
+copr pushdown)". The reference would run these through the coprocessor
+DAG pushdown (reference: distsql/distsql.go Select, the copr allowlist in
+expression/expr_to_pb.go); here the lineorder x date join plans as a
+device fragment with an epoch-cached aligned date dimension, and the
+scalar aggregate runs in the same fused kernel.
+
+Only the date dimension is generated (Q1.x touches no other dim); the
+lineorder table carries the full 17-column SSB layout. Distributions are
+SSB-spec-shaped (discount 0..10, quantity 1..50, dates uniform over
+1992-1998); per-seed deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..session import Session
+
+ROWS_PER_SF = 6_000_000
+
+DATE_DDL = """
+create table ssb_date (
+  d_datekey int not null primary key,
+  d_year int not null,
+  d_yearmonthnum int not null,
+  d_monthnuminyear int not null,
+  d_weeknuminyear int not null,
+  d_daynuminweek int not null,
+  d_sellingseason char(12) not null,
+  d_lastdayinmonthfl int not null,
+  d_holidayfl int not null,
+  d_weekdayfl int not null
+)
+"""
+
+LINEORDER_DDL = """
+create table lineorder (
+  lo_orderkey bigint not null,
+  lo_linenumber int not null,
+  lo_custkey int not null,
+  lo_partkey int not null,
+  lo_suppkey int not null,
+  lo_orderdate int not null,
+  lo_orderpriority char(15) not null,
+  lo_shippriority int not null,
+  lo_quantity int not null,
+  lo_extendedprice int not null,
+  lo_ordtotalprice int not null,
+  lo_discount int not null,
+  lo_revenue int not null,
+  lo_supplycost int not null,
+  lo_tax int not null,
+  lo_commitdate int not null,
+  lo_shipmode char(10) not null
+)
+"""
+
+SSB_Q11 = """
+select sum(lo_extendedprice * lo_discount) as revenue
+from lineorder, ssb_date
+where lo_orderdate = d_datekey
+  and d_year = 1993
+  and lo_discount between 1 and 3
+  and lo_quantity < 25
+"""
+
+SSB_Q12 = """
+select sum(lo_extendedprice * lo_discount) as revenue
+from lineorder, ssb_date
+where lo_orderdate = d_datekey
+  and d_yearmonthnum = 199401
+  and lo_discount between 4 and 6
+  and lo_quantity between 26 and 35
+"""
+
+SSB_Q13 = """
+select sum(lo_extendedprice * lo_discount) as revenue
+from lineorder, ssb_date
+where lo_orderdate = d_datekey
+  and d_weeknuminyear = 6
+  and d_year = 1994
+  and lo_discount between 5 and 7
+  and lo_quantity between 26 and 35
+"""
+
+SSB_QUERIES = {"q1.1": SSB_Q11, "q1.2": SSB_Q12, "q1.3": SSB_Q13}
+
+
+def _date_dim():
+    """One row per calendar day 1992-01-01 .. 1998-12-31, with the Q1.x
+    attributes derived exactly (datekey = yyyymmdd)."""
+    days = np.arange(np.datetime64("1992-01-01"),
+                     np.datetime64("1999-01-01"))
+    y = days.astype("datetime64[Y]").astype(int) + 1970
+    m = days.astype("datetime64[M]").astype(int) % 12 + 1
+    d = (days - days.astype("datetime64[M]")).astype(int) + 1
+    datekey = y * 10000 + m * 100 + d
+    doy = (days - days.astype("datetime64[Y]")).astype(int)
+    week = doy // 7 + 1
+    dow = (days.astype("datetime64[D]").astype(int) + 4) % 7  # 0=Sunday
+    seasons = np.array(["Winter", "Spring", "Summer", "Fall"])
+    month_end = np.concatenate([m[1:] != m[:-1], [True]])
+    return {
+        "d_datekey": datekey.astype(np.int64),
+        "d_year": y.astype(np.int64),
+        "d_yearmonthnum": (y * 100 + m).astype(np.int64),
+        "d_monthnuminyear": m.astype(np.int64),
+        "d_weeknuminyear": week.astype(np.int64),
+        "d_daynuminweek": (dow + 1).astype(np.int64),
+        "d_sellingseason": seasons[(m - 1) // 3],
+        "d_lastdayinmonthfl": month_end.astype(np.int64),
+        "d_holidayfl": (week % 10 == 0).astype(np.int64),
+        "d_weekdayfl": ((dow >= 1) & (dow <= 5)).astype(np.int64),
+    }
+
+
+def generate_lineorder(sf: float, seed: int = 7) -> dict[str, np.ndarray]:
+    n = int(ROWS_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    dates = _date_dim()["d_datekey"]
+    orderkey = np.repeat(np.arange(1, n // 4 + 2, dtype=np.int64), 4)[:n]
+    qty = rng.integers(1, 51, n, dtype=np.int64)
+    price = rng.integers(90000, 200001, n, dtype=np.int64)
+    extended = qty * price // 50
+    discount = rng.integers(0, 11, n, dtype=np.int64)
+    odate = dates[rng.integers(0, len(dates), n)]
+    prio = rng.integers(0, 5, n)
+    ship = rng.integers(0, 7, n)
+    return {
+        "lo_orderkey": orderkey,
+        "lo_linenumber": np.tile(np.arange(1, 5, dtype=np.int64),
+                                 n // 4 + 1)[:n],
+        "lo_custkey": rng.integers(1, max(2, n // 200), n, dtype=np.int64),
+        "lo_partkey": rng.integers(1, max(2, n // 30), n, dtype=np.int64),
+        "lo_suppkey": rng.integers(1, max(2, n // 3000), n,
+                                   dtype=np.int64),
+        "lo_orderdate": odate.astype(np.int64),
+        "lo_orderpriority": np.array(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECI",
+             "5-LOW"])[prio],
+        "lo_shippriority": np.zeros(n, dtype=np.int64),
+        "lo_quantity": qty,
+        "lo_extendedprice": extended,
+        "lo_ordtotalprice": extended * 4,
+        "lo_discount": discount,
+        "lo_revenue": extended * (100 - discount) // 100,
+        "lo_supplycost": extended * 6 // 10,
+        "lo_tax": rng.integers(0, 9, n, dtype=np.int64),
+        "lo_commitdate": odate.astype(np.int64),
+        "lo_shipmode": np.array(
+            ["RAIL", "AIR", "TRUCK", "SHIP", "MAIL", "FOB",
+             "REG AIR"])[ship],
+    }
+
+
+def load_ssb(session: Session, sf: float, seed: int = 7,
+             lineorder: dict[str, np.ndarray] | None = None) -> int:
+    """Create + bulk-load ssb_date and lineorder; returns lineorder rows."""
+    for ddl, name, data in (
+        (DATE_DDL, "ssb_date", _date_dim()),
+        (LINEORDER_DDL, "lineorder",
+         lineorder if lineorder is not None else generate_lineorder(
+             sf, seed)),
+    ):
+        session.execute(f"drop table if exists {name}")
+        session.execute(ddl)
+        info = session.catalog.table(session.current_db, name)
+        store = session.storage.table_store(info.id)
+        cols = []
+        for c in info.columns:
+            v = data[c.name]
+            if v.dtype.kind in "US":  # dictionary-encode strings
+                d = store.dictionaries[c.offset]
+                uniq, inv = np.unique(v, return_inverse=True)
+                codes = np.array([d.encode(s) for s in uniq],
+                                 dtype=np.int64)
+                cols.append(codes[inv])
+            else:
+                cols.append(v)
+        store.bulk_load(cols)
+        n = len(cols[0])
+    return n
+
+
+def q1_oracle(lo: dict[str, np.ndarray], which: str) -> int:
+    """Exact int64 revenue for Q1.x over the generated arrays."""
+    od = lo["lo_orderdate"]
+    disc = lo["lo_discount"]
+    qty = lo["lo_quantity"]
+    if which == "q1.1":
+        m = (od // 10000 == 1993) & (disc >= 1) & (disc <= 3) & (qty < 25)
+    elif which == "q1.2":
+        m = (od // 100 == 199401) & (disc >= 4) & (disc <= 6) & \
+            (qty >= 26) & (qty <= 35)
+    else:
+        dd = _date_dim()
+        wk = dict(zip(dd["d_datekey"].tolist(),
+                      dd["d_weeknuminyear"].tolist()))
+        uniq, inv = np.unique(od, return_inverse=True)
+        wku = np.array([wk[int(x)] for x in uniq])
+        m = (od // 10000 == 1994) & (wku[inv] == 6) & \
+            (disc >= 5) & (disc <= 7) & (qty >= 26) & (qty <= 35)
+    return int((lo["lo_extendedprice"][m] * disc[m]).sum())

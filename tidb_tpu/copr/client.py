@@ -701,7 +701,8 @@ class CopClient:
                 cached = self._col_cache.get(key)
             if cached is None:
                 cached = (
-                    jnp.asarray(_pad(narrow(data), b)),
+                    jnp.asarray(_pad(_narrow_stats(
+                        data, self._col_stats(snap, off)), b)),
                     jnp.asarray(_pad_bool(vfull, b)),
                 )
                 if cacheable:
@@ -781,7 +782,11 @@ class CopClient:
             dag, prepared, cards, segments))
         # dispatches are async and pipeline on the link; ONE device_get
         # fetches every tile's partials in a single round trip
-        devs = [kern(cols, vis) for cols, vis, _ in tiles]
+        from ..util import interrupt
+        devs = []
+        for cols, vis, _ in tiles:
+            interrupt.check()  # KILL QUERY checkpoint between tiles
+            devs.append(kern(cols, vis))
         outs = jax.device_get(devs)
         out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         group_dicts = [

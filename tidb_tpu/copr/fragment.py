@@ -44,6 +44,7 @@ from .client import (
     CopResult,
     agg_partials,
     decode_agg_partials,
+    widen32,
 )
 from .eval import CompileError, eval_expr, selection_mask
 from .npeval import NumpyEval
@@ -674,7 +675,7 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
         part_per_dev = -(-part_span // part_n_dev)
 
     def kernel(pcols, pvis, builds, aux=None):
-        cols = list(pcols)
+        cols = widen32(list(pcols))
         mask = pvis
         if frag.tables[0].filters:
             # probe-side pushed-down filters (local space == combined
@@ -691,10 +692,11 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
                 # order; only the query's build-side filters remain
                 t = frag.tables[j.build]
                 found = b["found"]
+                acols = widen32(list(b["acols"]))
                 if t.filters:
-                    found = selection_mask(t.filters, list(b["acols"]),
-                                           prepared, found)
-                for (d, v) in b["acols"]:
+                    found = selection_mask(t.filters, acols, prepared,
+                                           found)
+                for (d, v) in acols:
                     cols.append((d, v & found))
                 mask = mask & found
                 continue
@@ -726,12 +728,13 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
             gidx = jnp.clip(ridx, 0)
             # build-side validity: visibility + pushed-down filters over
             # the FULL build columns, gathered per probe row
+            bcols = widen32(list(b["cols"]))
             bmask = b["vis"]
             if t.filters:
-                bmask = selection_mask(t.filters, b["cols"], prepared,
+                bmask = selection_mask(t.filters, bcols, prepared,
                                        bmask)
             found = found & bmask[gidx]
-            for (d, v) in b["cols"]:
+            for (d, v) in bcols:
                 cols.append((d[gidx], v[gidx] & found))
             mask = mask & found
         if sel:

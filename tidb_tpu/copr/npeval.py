@@ -34,6 +34,70 @@ class NumpyEval:
         self.n = n
 
     # ---- string-domain evaluation -------------------------------------------
+    def _registry_call(self, e: Call) -> VV:
+        """Breadth-layer builtins (copr/funcs.py): rowwise Python with
+        the registry's NULL semantics; args arrive in their natural
+        domains (str / day-number int / decimal-as-float / int)."""
+        from .funcs import REGISTRY
+
+        fd = REGISTRY[e.op[3:]]
+        arg_vv = []
+        for a in e.args:
+            if a.ftype.is_string:
+                v, vl = self.eval_str(a)
+            else:
+                v, vl = self.eval(a)
+                v = np.asarray(v)
+                if a.ftype.is_decimal and a.ftype.scale:
+                    v = v.astype(np.float64) / (10.0 ** a.ftype.scale)
+            arg_vv.append((v, np.asarray(vl)))
+        n = self.n
+        out = np.empty(n, dtype=object)
+        valid = np.zeros(n, bool)
+        for i in range(n):
+            vals = []
+            has_null = False
+            for v, vl in arg_vv:
+                if vl[i]:
+                    x = v[i]
+                    vals.append(x.item() if hasattr(x, "item") else x)
+                else:
+                    vals.append(None)
+                    has_null = True
+            if has_null and fd.null_prop:
+                continue
+            try:
+                r = fd.fn(*vals)
+            except (ValueError, TypeError, OverflowError,
+                    ZeroDivisionError):
+                r = None
+            if r is not None:
+                out[i] = r
+                valid[i] = True
+        if fd.ret == "str":
+            # string consumers read through eval_str (object array)
+            for i in range(n):
+                if not valid[i]:
+                    out[i] = ""
+            return out, valid
+        idx = np.nonzero(valid)[0]
+        if fd.ret == "float" or (fd.ret == "arg0" and e.ftype.is_float):
+            arr = np.zeros(n, np.float64)
+            if len(idx):
+                arr[idx] = [float(out[i]) for i in idx]
+        elif fd.ret == "arg0" and e.ftype.is_decimal:
+            # results computed in the float domain scale back to the
+            # output type's fixed-point representation
+            arr = np.zeros(n, np.int64)
+            if len(idx):
+                m = 10 ** e.ftype.scale
+                arr[idx] = [int(round(float(out[i]) * m)) for i in idx]
+        else:
+            arr = np.zeros(n, np.int64)
+            if len(idx):
+                arr[idx] = [int(out[i]) for i in idx]
+        return arr, valid
+
     def eval_str(self, e: PlanExpr) -> VV:
         """Evaluate a string-typed expression to (object array of str, valid).
 
@@ -57,6 +121,8 @@ class NumpyEval:
         assert isinstance(e, Call)
         op = e.op
         A = e.args
+        if op.startswith("fx:"):
+            return self._registry_call(e)
         if op == "if":
             cv, cvl = _b(self.eval(A[0]))
             tv, tvl = self.eval_str(A[1])
@@ -238,6 +304,8 @@ class NumpyEval:
         op = e.op
         A = e.args
 
+        if op.startswith("fx:"):
+            return self._registry_call(e)
         if op == "and":
             av, avl = _b(self.eval(A[0]))
             bv, bvl = _b(self.eval(A[1]))

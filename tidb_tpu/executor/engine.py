@@ -40,6 +40,7 @@ from ..plan.physical import (
 from ..store.storage import Transaction
 from ..types.field_type import FieldType, TypeKind
 from ..types.value import Decimal
+from ..util import interrupt
 from ..util.memory import MemTracker, QueryMemExceeded, SpillDir
 
 _NULL_KEY = np.iinfo(np.int64).min
@@ -135,6 +136,7 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
 
 def _run_node(plan: PhysicalPlan, ctx: ExecContext,
               engine_tag: Optional[list]) -> Chunk:
+    interrupt.check()  # KILL QUERY checkpoint between plan nodes
     if isinstance(plan, PhysTableRead):
         if plan.dag.scan.table_id < 0:
             return Chunk([])  # dual pseudo-table: one conceptual row, no cols
@@ -914,6 +916,24 @@ def _merge_partials(plan: PhysHashAgg, child: Chunk) -> Chunk:
     return Chunk(out_cols)
 
 
+def _gc_render(v, ft) -> str:
+    """GROUP_CONCAT element rendering (MySQL text form of the value)."""
+    from ..types.value import decode_date
+    if ft.is_decimal:
+        s = ft.scale
+        u = int(v)
+        if s <= 0:
+            return str(u)
+        sign = "-" if u < 0 else ""
+        u = abs(u)
+        return f"{sign}{u // 10 ** s}.{u % 10 ** s:0{s}d}"
+    if ft.kind == TypeKind.DATE:
+        return decode_date(int(v)).isoformat()
+    if ft.is_float:
+        return repr(float(v))
+    return str(int(v))
+
+
 def _seg_reduce(ufunc, values: np.ndarray, order: np.ndarray,
                 bounds: np.ndarray) -> np.ndarray:
     if len(order) == 0:
@@ -1061,6 +1081,67 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
             out_cols.append(Column(out_t, vals.astype(out_t.np_dtype),
                                    None if valid.all() else valid,
                                    dictionary))
+            continue
+        if d.func in ("std", "stddev", "stddev_pop", "stddev_samp",
+                      "variance", "var_pop", "var_samp"):
+            # population/sample moments (reference:
+            # executor/aggfuncs/func_varpop.go): sum + sum of squares
+            scale = 10.0 ** d.arg.ftype.scale if d.arg.ftype.is_decimal \
+                else 1.0
+            fv = np.where(avl, av.astype(np.float64) / scale, 0.0)
+            sums = _seg_reduce(np.add, fv, order, bounds)
+            sqs = _seg_reduce(np.add, fv * fv, order, bounds)
+            mean = sums / np.maximum(cnts, 1)
+            var = sqs / np.maximum(cnts, 1) - mean * mean
+            var = np.maximum(var, 0.0)
+            samp = d.func in ("stddev_samp", "var_samp")
+            if samp:
+                var = np.where(cnts > 1,
+                               var * cnts / np.maximum(cnts - 1, 1), 0.0)
+            if d.func in ("std", "stddev", "stddev_pop", "stddev_samp"):
+                var = np.sqrt(var)
+            valid = cnts > (1 if samp else 0)
+            out_cols.append(Column(out_t, var,
+                                   None if valid.all() else valid))
+            continue
+        if d.func in ("bit_and", "bit_or", "bit_xor"):
+            # never NULL; empty-group identities match MySQL (reference:
+            # executor/aggfuncs/func_bitfuncs.go)
+            ident = -1 if d.func == "bit_and" else 0
+            fn = {"bit_and": np.bitwise_and, "bit_or": np.bitwise_or,
+                  "bit_xor": np.bitwise_xor}[d.func]
+            masked = np.where(avl, av.astype(np.int64), ident)
+            vals = _seg_reduce(fn, masked, order, bounds)
+            out_cols.append(Column(out_t, vals.astype(np.int64)))
+            continue
+        if d.func == "any_value":
+            gidx = order[bounds] if n else np.empty(0, np.int64)
+            dictionary = child.columns[d.arg.idx].dictionary \
+                if out_t.is_string and isinstance(d.arg, Col) else None
+            vals = av[gidx]
+            valid = avl[gidx]
+            out_cols.append(Column(out_t, vals.astype(out_t.np_dtype),
+                                   None if valid.all() else valid,
+                                   dictionary))
+            continue
+        if d.func == "group_concat":
+            if d.arg.ftype.is_string:
+                sv, svl = ev.eval_str(d.arg)
+            else:
+                sv, svl = [_gc_render(x, d.arg.ftype) for x in av], avl
+            dct = Dictionary()
+            data = np.zeros(n_seg, np.int64)
+            valid = np.zeros(n_seg, bool)
+            parts: list[list[str]] = [[] for _ in range(n_seg)]
+            for i in range(n):
+                if svl[i]:
+                    parts[inv[i]].append(str(sv[i]))
+            for gi2 in range(n_seg):
+                if parts[gi2]:
+                    data[gi2] = dct.encode(",".join(parts[gi2]))
+                    valid[gi2] = True
+            out_cols.append(Column(out_t, data,
+                                   None if valid.all() else valid, dct))
             continue
         raise NotImplementedError(d.func)
     if not out_cols:

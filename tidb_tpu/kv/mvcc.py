@@ -78,11 +78,16 @@ class PyOrderedKV:
     record layout in kvstore.cpp write_rec), so either engine can reopen
     a directory the other wrote."""
 
-    def __init__(self, path=None) -> None:
+    def __init__(self, path=None, shared: bool = False) -> None:
         self._maps: list[dict[bytes, bytes]] = [{}, {}, {}]
         self._keys: list[list[bytes]] = [[], [], []]
         self._dir = None
         self._wal = None
+        self._shared = shared
+        self._applied_off = 0
+        # records applied by refresh() that the Storage layer has not yet
+        # folded into columnar epochs / catalog (shared mode only)
+        self.pending_refresh: list[tuple[int, int, bytes, bytes]] = []
         if path is not None:
             import os
 
@@ -91,11 +96,12 @@ class PyOrderedKV:
             self._replay(os.path.join(self._dir, "snapshot.kv"))
             wal_path = os.path.join(self._dir, "wal.log")
             valid = self._replay(wal_path)
-            if valid >= 0:
+            if valid >= 0 and not shared:
                 # drop a torn tail (crash mid-append): appending after the
                 # garbage would hide every later record from the next replay
                 with open(wal_path, "ab") as f:
                     f.truncate(valid)
+            self._applied_off = max(valid, 0)
             self._wal = open(wal_path, "ab")
 
     # ---- durability --------------------------------------------------------
@@ -128,12 +134,80 @@ class PyOrderedKV:
 
     def _log(self, op: int, cf: int, key: bytes, value: bytes) -> None:
         if self._wal is not None:
-            self._wal.write(struct.pack("<BBII", op, cf, len(key),
-                                        len(value)) + key + value)
+            rec = struct.pack("<BBII", op, cf, len(key),
+                              len(value)) + key + value
+            self._wal.write(rec)
             self._wal.flush()
+            # shared mode: our own appends are already in memory — advance
+            # the tail cursor so refresh() skips them. Writes happen only
+            # inside the coordinator section after refresh(), so the
+            # cursor was at EOF when this append started.
+            self._applied_off += len(rec)
+
+    def refresh(self) -> int:
+        """Apply records other processes appended past our cursor
+        (shared mode); applied records are also queued on
+        `pending_refresh` for the storage layer's columnar fold. Returns
+        the number of records applied."""
+        if self._dir is None or not self._shared:
+            return 0
+        import os
+
+        path = os.path.join(self._dir, "wal.log")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        if size <= self._applied_off:
+            return 0
+        n = 0
+        with open(path, "rb") as f:
+            f.seek(self._applied_off)
+            while True:
+                hdr = f.read(10)
+                if len(hdr) < 10:
+                    break
+                op, cf = hdr[0], hdr[1]
+                klen, vlen = struct.unpack_from("<II", hdr, 2)
+                if cf >= 3 or op not in (1, 2):
+                    break  # torn tail; tail_clean truncates under flock
+                key = f.read(klen)
+                val = f.read(vlen)
+                if len(key) < klen or len(val) < vlen:
+                    break
+                if op == 1:
+                    self._apply_put(cf, key, val)
+                else:
+                    self._apply_delete(cf, key)
+                self.pending_refresh.append((op, cf, key, val))
+                self._applied_off = f.tell()
+                n += 1
+        return n
+
+    def tail_clean(self) -> None:
+        """Truncate a torn tail left by a writer that crashed mid-append.
+        Callers must hold the coordinator flock (nobody else can be
+        appending) and have refresh()ed to the valid prefix."""
+        if self._dir is None or not self._shared:
+            return
+        import os
+
+        path = os.path.join(self._dir, "wal.log")
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size > self._applied_off:
+            with open(path, "r+b") as f:
+                f.truncate(self._applied_off)
 
     def checkpoint(self) -> None:
         if self._dir is None or self._wal is None:
+            return
+        if self._shared:
+            # snapshot+truncate would destroy sibling processes' WAL
+            # cursors and any records we have not refreshed yet; shared
+            # dirs compact only via a dedicated offline pass
             return
         import os
 
@@ -261,9 +335,30 @@ class Mutation:
 
 
 class MVCCStore:
-    def __init__(self, engine=None) -> None:
+    def __init__(self, engine=None, coord=None) -> None:
         self.kv = engine if engine is not None else PyOrderedKV()
         self._mu = threading.RLock()
+        # shared-directory coordinator (multi-process deployments): every
+        # mutation runs inside its flock with the WAL tail caught up, so
+        # percolator lock/write records from sibling processes are always
+        # visible to conflict checks (store/coordinator.py)
+        self.coord = coord
+
+    def _mutate(self):
+        return _MutationSection(self)
+
+    def refresh(self) -> int:
+        """Locked WAL catch-up (shared mode): serializes with in-process
+        mutators so the tail cursor never moves backwards under a
+        concurrent append."""
+        with self._mu:
+            return self.kv.refresh()
+
+    def drain_pending(self) -> list:
+        with self._mu:
+            out = self.kv.pending_refresh
+            self.kv.pending_refresh = []
+            return out
 
     # ---- reads -------------------------------------------------------------
     def get(self, key: bytes, read_ts: int) -> Optional[bytes]:
@@ -338,7 +433,7 @@ class MVCCStore:
                  start_ts: int, ttl: int = 3000) -> None:
         """First phase (reference: mvcc_leveldb.go Prewrite; tikv
         prewrite.rs). All-or-nothing per call under the store mutex."""
-        with self._mu:
+        with self._mutate():
             errs: list[KVError] = []
             for m in mutations:
                 e = self._prewrite_check(m.key, start_ts)
@@ -379,7 +474,7 @@ class MVCCStore:
     def commit(self, keys: list[bytes], start_ts: int,
                commit_ts: int) -> None:
         """Second phase (reference: mvcc_leveldb.go Commit)."""
-        with self._mu:
+        with self._mutate():
             for key in keys:
                 lv = self.kv.get(CF_LOCK, key)
                 if lv is None:
@@ -403,7 +498,7 @@ class MVCCStore:
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Abort a txn's keys (reference: mvcc_leveldb.go Rollback);
         writes a rollback marker so late prewrites cannot resurrect it."""
-        with self._mu:
+        with self._mutate():
             for key in keys:
                 lv = self.kv.get(CF_LOCK, key)
                 if lv is not None:
@@ -445,7 +540,7 @@ class MVCCStore:
         Raises KeyIsLockedError when another txn holds any key and
         WriteConflictError when a commit newer than for_update_ts exists
         (the caller retries with a fresh for_update_ts)."""
-        with self._mu:
+        with self._mutate():
             for key in keys:
                 lv = self.kv.get(CF_LOCK, key)
                 if lv is not None:
@@ -466,7 +561,7 @@ class MVCCStore:
         """Extend the primary lock's TTL (reference: TiKV TxnHeartBeat —
         the ttlManager keepalive for long pessimistic txns). TTL only
         grows; returns False when the lock is gone (resolved/expired)."""
-        with self._mu:
+        with self._mutate():
             lv = self.kv.get(CF_LOCK, primary)
             if lv is None:
                 return False
@@ -483,7 +578,7 @@ class MVCCStore:
         """Release this txn's lock-only locks without leaving a rollback
         marker (reference: TiKV PessimisticRollback — the txn may still
         commit later; only the guards are dropped)."""
-        with self._mu:
+        with self._mutate():
             for key in keys:
                 lv = self.kv.get(CF_LOCK, key)
                 if lv is not None:
@@ -497,7 +592,7 @@ class MVCCStore:
         """(commit_ts, lock_expired): commit_ts>0 means committed;
         0 + expired means safe to roll back (reference:
         lock_resolver.go getTxnStatus)."""
-        with self._mu:
+        with self._mutate():
             lv = self.kv.get(CF_LOCK, primary)
             if lv is not None:
                 lock = _lock_dec(primary, lv)
@@ -588,7 +683,7 @@ class MVCCStore:
         bypassing MVCC (reference: TiKV UnsafeDestroyRange — the DROP/
         TRUNCATE TABLE data reclaim path). Callers guarantee no reader
         needs the range again."""
-        with self._mu:
+        with self._mutate():
             for cf in (CF_LOCK, CF_WRITE, CF_DATA):
                 doomed = [k for k, _ in self.kv.scan(cf, start, end)]
                 # versioned CFs suffix keys with \x00+ts — the plain range
@@ -600,7 +695,7 @@ class MVCCStore:
     def gc(self, safepoint: int) -> int:
         """Drop versions not visible at/after safepoint (reference:
         gcworker/gc_worker.go DoGC). Returns removed version count."""
-        with self._mu:
+        with self._mutate():
             removed = 0
             drop_w: list[bytes] = []
             drop_d: list[bytes] = []
@@ -628,3 +723,29 @@ class MVCCStore:
             for dk in drop_d:
                 self.kv.delete(CF_DATA, dk)
             return removed
+
+
+class _MutationSection:
+    """Mutation critical section: the coordinator flock (when present)
+    plus the in-process mutex, entered with the shared WAL caught up so
+    conflict checks see every sibling process's records."""
+
+    __slots__ = ("store",)
+
+    def __init__(self, store: MVCCStore) -> None:
+        self.store = store
+
+    def __enter__(self):
+        c = self.store.coord
+        if c is not None:
+            c.acquire()
+            self.store.kv.refresh()
+            self.store.kv.tail_clean()
+        self.store._mu.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.store._mu.release()
+        c = self.store.coord
+        if c is not None:
+            c.release()

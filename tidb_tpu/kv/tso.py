@@ -19,24 +19,63 @@ _LOGICAL_BITS = 18
 
 
 class TimestampOracle:
-    def __init__(self, floor: int = 0) -> None:
+    def __init__(self, floor: int = 0, node_id: int = 0,
+                 n_nodes: int = 1) -> None:
         """`floor`: restart lower bound — every issued ts is > floor
         (recovery passes the persisted lease so timestamps never repeat
         across restarts even under clock skew; reference analog: PD's
-        persisted TSO window, oracle/oracles/pd.go)."""
+        persisted TSO window, oracle/oracles/pd.go).
+
+        `node_id`/`n_nodes`: multi-process deployments slice the logical
+        bits per node so timestamps are unique across processes sharing
+        one store directory with no hot-path coordination (the PD role
+        without a PD; store/coordinator.py)."""
         self._lock = threading.Lock()
+        self._slice = (1 << _LOGICAL_BITS) // max(n_nodes, 1)
+        self._base = node_id * self._slice
         self._physical = floor >> _LOGICAL_BITS
-        self._logical = floor & ((1 << _LOGICAL_BITS) - 1)
+        logical = floor & ((1 << _LOGICAL_BITS) - 1)
+        self._logical = max(logical - self._base, 0) \
+            if n_nodes > 1 else logical
 
     def next_ts(self) -> int:
         with self._lock:
             physical = int(time.time() * 1000)
             if physical <= self._physical:
                 self._logical += 1
+                if self._logical >= self._slice:
+                    # logical slice exhausted within one millisecond:
+                    # borrow the next physical tick
+                    self._physical += 1
+                    self._logical = 0
             else:
                 self._physical = physical
                 self._logical = 0
-            return (self._physical << _LOGICAL_BITS) | self._logical
+            return (self._physical << _LOGICAL_BITS) | \
+                (self._base + self._logical)
+
+    def observe(self, ts: int) -> None:
+        """Advance past an externally observed timestamp (a sibling
+        process's commit seen during WAL refresh) so every timestamp we
+        issue afterwards is strictly greater — required for the sibling's
+        commits to be VISIBLE to our snapshots (commit_ts <= read_ts)."""
+        with self._lock:
+            phys = ts >> _LOGICAL_BITS
+            logi = ts & ((1 << _LOGICAL_BITS) - 1)
+            if phys < self._physical:
+                return
+            if phys > self._physical:
+                self._physical = phys
+                self._logical = 0
+            if logi >= self._base + self._logical:
+                need = logi - self._base
+                if need + 1 >= self._slice:
+                    # observed logical beyond our slice in this tick:
+                    # borrow the next physical tick
+                    self._physical = phys + 1
+                    self._logical = 0
+                else:
+                    self._logical = need
 
     # the 2PC committer's oracle interface (kv/twopc.py TSO protocol)
     def ts(self) -> int:

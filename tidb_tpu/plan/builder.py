@@ -46,7 +46,10 @@ from .logical import (
 )
 from .schema import PlanSchema, ResultField
 
-_AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX"}
+_AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX",
+              "GROUP_CONCAT", "STD", "STDDEV", "STDDEV_POP",
+              "STDDEV_SAMP", "VARIANCE", "VAR_POP", "VAR_SAMP",
+              "BIT_AND", "BIT_OR", "BIT_XOR", "ANY_VALUE"}
 
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div",
               "DIV": "intdiv", "%": "mod"}
@@ -1154,6 +1157,26 @@ class PlanBuilder:
         out = self._resolve_builtin(name, args, need)
         if out is not None:
             return out
+        # breadth layer: the declarative host-function registry
+        # (copr/funcs.py). LOCATE's 3-arg form shares a name with the
+        # vectorized 2-arg core — registered under an alias.
+        from ..copr.funcs import lookup
+        reg_name = "LOCATE3" if name == "LOCATE" and len(args) == 3 \
+            else name
+        fd = lookup(reg_name)
+        if fd is not None:
+            if not fd.min_args <= len(args) <= fd.max_args:
+                raise PlanError(
+                    f"{name} expects {fd.min_args}..{fd.max_args} "
+                    f"argument(s)")
+            from ..types.field_type import varchar_type
+            ret = {"str": varchar_type(),
+                   "int": FieldType(TypeKind.BIGINT),
+                   "float": FieldType(TypeKind.DOUBLE),
+                   "date": FieldType(TypeKind.DATE)}.get(fd.ret)
+            if ret is None:  # arg0
+                ret = args[0].ftype
+            return _fold(Call(f"fx:{fd.name}", args, ret))
         raise PlanError(f"unsupported function {name}")
 
     def _resolve_builtin(self, name: str, args: list[PlanExpr],
@@ -1196,7 +1219,7 @@ class PlanBuilder:
                   "CHARACTER_LENGTH": "char_length",
                   "ASCII": "ascii"}[name]
             return Call(op, args, bigint)
-        if name in ("LOCATE", "INSTR"):
+        if (name == "LOCATE" and len(args) == 2) or name == "INSTR":
             need(2)
             if name == "INSTR":  # INSTR(str, substr) = LOCATE(substr, str)
                 args = [args[1], args[0]]

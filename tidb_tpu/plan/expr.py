@@ -160,6 +160,18 @@ def agg_result_type(func: str, arg: Optional[PlanExpr]) -> FieldType:
         return FieldType(TypeKind.BIGINT, nullable=False)
     assert arg is not None
     at = arg.ftype
+    if func in ("std", "stddev", "stddev_pop", "stddev_samp",
+                "variance", "var_pop", "var_samp"):
+        # reference: executor/aggfuncs/func_varpop.go family -> DOUBLE
+        return FieldType(TypeKind.DOUBLE)
+    if func in ("bit_and", "bit_or", "bit_xor"):
+        # reference: executor/aggfuncs/func_bitfuncs.go -> BIGINT UNSIGNED
+        return FieldType(TypeKind.BIGINT, nullable=False)
+    if func == "any_value":
+        return at
+    if func == "group_concat":
+        # reference: executor/aggfuncs/func_group_concat.go -> TEXT
+        return FieldType(TypeKind.VARCHAR, flen=1024)
     if func in ("min", "max"):
         return at
     if func == "sum":

@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-host", "--host", default=None, help="listen address")
     p.add_argument("-P", "--port", type=int, default=None,
                    help="MySQL protocol port")
+    p.add_argument("--shared", action="store_true",
+                   help="multi-process mode: coordinate with sibling "
+                        "servers sharing --path (flock'd WAL, schema "
+                        "reload, cross-server KILL)")
     p.add_argument("--path", default=None,
                    help="durable storage directory (default: in-memory)")
     p.add_argument("--socket", default=None, help="unix socket (unused)")
@@ -118,7 +122,8 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     cfg.apply_log_level()
-    storage = Storage(cfg.path or None)
+    storage = Storage(cfg.path or None,
+                      shared=getattr(args, 'shared', False))
     cfg.seed_sysvars(storage)
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,

@@ -38,6 +38,7 @@ class ClientConn:
         self.sock = sock
         self.conn_id = conn_id
         self.session = Session(server.storage, db=server.default_db)
+        self.session.conn_id = conn_id
         self.io = P.PacketIO(sock.makefile("rb"), sock.makefile("wb"))
         self.salt = secrets.token_bytes(20)
         self.capabilities = 0

@@ -71,6 +71,15 @@ class Server:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mysql-accept", daemon=True)
         self._accept_thread.start()
+        # KILL routing: sessions resolve KILL <id> through the storage so
+        # statements on ANY server can target connections on THIS one
+        self.storage.kill_router = self.kill
+        coord = getattr(self.storage, "coord", None)
+        if coord is not None:
+            coord.register_server(self.port, self.status_port)
+            t = threading.Thread(target=self._kill_mailbox_loop,
+                                 name="kill-mailbox", daemon=True)
+            t.start()
         if self.status_port is not None:
             from .status import StatusServer
             self._status_server = StatusServer(self.status_host,
@@ -92,6 +101,11 @@ class Server:
                     continue
                 conn_id = self._next_conn_id
                 self._next_conn_id += 1
+                coord = getattr(self.storage, "coord", None)
+                if coord is not None:
+                    # server-id-carrying global ids (reference:
+                    # util/globalconn GCID; tests/globalkilltest)
+                    conn_id = coord.global_conn_id(coord.node_id, conn_id)
                 conn = ClientConn(self, sock, conn_id)
                 self.storage.obs.connections.inc()
                 self._conns[conn_id] = conn
@@ -105,12 +119,34 @@ class Server:
 
     def kill_connection(self, conn_id: int) -> bool:
         """KILL <id> semantics (reference: server/server.go:548)."""
+        return self.kill(conn_id, query_only=False)
+
+    def kill(self, conn_id: int, query_only: bool) -> bool:
+        """KILL QUERY interrupts the running statement (the engine polls
+        the session's kill flag between plan nodes / tiles); KILL
+        CONNECTION also tears the socket down."""
         with self._lock:
             conn = self._conns.get(conn_id)
         if conn is None:
             return False
-        conn.kill()
+        conn.session.killed.set()
+        if not query_only:
+            conn.kill()
         return True
+
+    def _kill_mailbox_loop(self) -> None:
+        """Poll the shared-dir kill mailbox for requests addressed to
+        this server (reference: the etcd-watch kill channel the
+        globalkilltest suite exercises)."""
+        coord = self.storage.coord
+        while not self._shutdown.is_set():
+            try:
+                for local, query_only in coord.poll_kills():
+                    self.kill(coord.global_conn_id(coord.node_id, local),
+                              query_only)
+            except OSError:
+                pass
+            self._shutdown.wait(0.1)
 
     def connection_count(self) -> int:
         with self._lock:

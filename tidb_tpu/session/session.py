@@ -13,6 +13,7 @@ statement-level staging gives per-statement rollback inside a txn
 from __future__ import annotations
 
 from dataclasses import dataclass
+import threading
 from typing import Any, Optional
 
 import numpy as np
@@ -78,11 +79,21 @@ class Session:
         self._plan_cache: dict = {}
         self._plan_cache_key: Optional[str] = None
         self.plan_cache_hits = 0
+        # KILL plane: QUERY kill interrupts the running statement;
+        # CONNECTION kill is handled by the server (socket teardown).
+        # Global connection id (embeds the server/node id in shared mode)
+        self.conn_id: Optional[int] = None
+        self.killed = threading.Event()
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
         """Execute one or more ;-separated statements; returns the last
         statement's result."""
+        if self.storage.shared:
+            # multi-process deployments: catch up with sibling servers'
+            # commits + schema changes before planning (the per-statement
+            # domain-reload; store/storage.py refresh)
+            self.storage.refresh()
         try:
             stmts = parse_sql(sql)
         except ParseError as e:
@@ -124,20 +135,32 @@ class Session:
 
         from ..obs import DEFAULT_SLOW_THRESHOLD_MS
 
+        from ..util import interrupt
+
         o = self.storage.obs
         t0 = _time.perf_counter()
         o.queries.inc(type=type(stmt).__name__.removesuffix("Stmt"))
         failed = False
         rows_out = 0
+        # arm the per-statement kill flag (KILL QUERY clears with the
+        # statement; KILL CONNECTION leaves it set and the server drops
+        # the socket)
+        self.killed.clear()
+        interrupt.install(self.killed)
         try:
             rs = self._execute_stmt(stmt)
             rows_out = len(rs.rows)
             return rs
+        except interrupt.QueryInterrupted:
+            failed = True
+            o.query_errors.inc()
+            raise SQLError("Query execution was interrupted") from None
         except Exception:
             failed = True
             o.query_errors.inc()
             raise
         finally:
+            interrupt.install(None)
             dt = _time.perf_counter() - t0
             o.query_seconds.observe(dt)
             if digest_sql is not None:
@@ -206,6 +229,9 @@ class Session:
     def _execute_stmt(self, stmt: ast.Stmt) -> ResultSet:
         if self.user is not None:
             self._check_privileges(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            self._exec_kill(stmt)
+            return ResultSet([], [])
         if isinstance(stmt, ast.CreateUserStmt):
             self._require_super()
             from .privileges import PrivilegeError
@@ -457,7 +483,7 @@ class Session:
         if name in ("USER", "CURRENT_USER", "SESSION_USER"):
             return f"{self.user or 'root'}@%"
         if name == "CONNECTION_ID":
-            return getattr(self, "connection_id", 0)
+            return self.conn_id or 0
         if name == "NEXTVAL":
             if len(n.args) != 1:
                 raise SQLError("NEXTVAL takes a sequence name")
@@ -824,6 +850,26 @@ class Session:
                     continue  # fresh ts, statement re-executes
                 raise
             return result
+
+    def _exec_kill(self, stmt) -> None:
+        """Route KILL to the owning server: local registry when the id
+        belongs to this node, the shared-dir kill mailbox otherwise
+        (reference: server/server.go:548 Kill; tests/globalkilltest
+        cross-server kill with server-id-carrying conn ids)."""
+        storage = self.storage
+        # SUPER required to kill anything but your own connection
+        # (reference: server.go Kill checks SuperPriv / same user)
+        if self.user is not None and stmt.conn_id != self.conn_id:
+            self._require_super()
+        coord = getattr(storage, "coord", None)
+        if coord is not None:
+            nid, _local = coord.split_conn_id(stmt.conn_id)
+            if nid != coord.node_id:
+                coord.post_kill(stmt.conn_id, stmt.query_only)
+                return
+        router = getattr(storage, "kill_router", None)
+        if router is None or not router(stmt.conn_id, stmt.query_only):
+            raise SQLError(f"Unknown thread id: {stmt.conn_id}")
 
     def rollback_if_active(self) -> None:
         """Abandon any open transaction (connection teardown path —

@@ -516,3 +516,13 @@ class GrantStmt(Stmt):
     table: str = "*"
     user: str = ""
     revoke: bool = False
+
+
+@dataclass
+class KillStmt(Stmt):
+    """KILL [QUERY | CONNECTION] <id> (reference: server/server.go:548
+    Kill; QUERY interrupts the running statement, CONNECTION also drops
+    the session)."""
+
+    conn_id: int
+    query_only: bool = False

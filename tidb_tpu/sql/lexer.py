@@ -70,7 +70,7 @@ KEYWORDS = frozenset(
     GRANT REVOKE USER IDENTIFIED PRIVILEGES GRANTS
     CONSTRAINT FOREIGN REFERENCES
     FOR
-    ADMIN DDL JOBS
+    ADMIN DDL JOBS KILL QUERY CONNECTION
     OVER PARTITION ROWS RANGE UNBOUNDED PRECEDING FOLLOWING CURRENT ROW
     """.split()
 )

@@ -182,6 +182,18 @@ class Parser:
         if t.is_kw("TRACE"):
             self.advance()
             return ast.TraceStmt(self.parse_statement())
+        if t.is_kw("KILL"):
+            self.advance()
+            query_only = self.accept_kw("QUERY") is not None
+            if not query_only:
+                self.accept_kw("CONNECTION")
+            tok = self.cur
+            self.advance()
+            try:
+                cid = int(tok.text)
+            except ValueError:
+                raise ParseError("expected connection id after KILL", tok)
+            return ast.KillStmt(cid, query_only)
         if t.is_kw("SHOW"):
             return self.parse_show()
         if t.is_kw("SET"):
@@ -1326,7 +1338,9 @@ class Parser:
             self.advance()
             return self.parse_func_call(t.text)
         # reserved words that double as function names when followed by (
-        if t.kind == TokenKind.KEYWORD and t.text in _FUNC_KEYWORDS and \
+        if t.kind == TokenKind.KEYWORD and \
+                (t.text in _FUNC_KEYWORDS or t.text in ("INSERT",
+                                                        "REPLACE")) and \
                 self.peek().is_op("("):
             self.advance()
             return self.parse_func_call(t.text)
@@ -1494,6 +1508,7 @@ _IDENT_KEYWORDS = frozenset(
     SCHEMAS WARNINGS ERRORS ENGINES COLLATION COLUMNS FIELDS INDEXES KEYS
     NAMES USER IDENTIFIED PRIVILEGES GRANTS PESSIMISTIC OPTIMISTIC
     UNBOUNDED PRECEDING FOLLOWING CURRENT ROW TRACE
+    KILL QUERY CONNECTION
     """.split()
 )
 

@@ -65,19 +65,31 @@ _TSO_LEASE_MS = 120_000
 
 
 class Storage:
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(self, path: Optional[str] = None,
+                 shared: bool = False) -> None:
         """`path=None`: ephemeral in-memory store (tests, benches).
         `path=dir`: durable — KV WAL+snapshot under dir/kv, columnar epoch
         snapshots under dir/epochs, catalog/stats/DDL state in the meta
         keyspace of the same KV; reopening the directory recovers
         everything committed (reference: unistore's badger persistence,
         go.mod:34 + bootstrap-from-KV, session/session.go:2090,
-        meta/meta.go:59)."""
+        meta/meta.go:59).
+
+        `shared=True` (requires path): MULTI-PROCESS mode — several
+        server processes over one directory, coordinated by
+        store/coordinator.py (shared WAL with flock'd mutation sections,
+        cross-process schema reload + fence, node-sliced TSO, kill
+        mailbox). The reference's many-tidb-servers-one-cluster shape."""
         import os
 
         from ..stats import StatsHandle
 
         self.path = path
+        self.shared = bool(shared and path is not None)
+        self.coord = None
+        if self.shared:
+            from .coordinator import SharedDirCoordinator
+            self.coord = SharedDirCoordinator(path)
         self.catalog = Catalog()
         # per-server observability (metrics/slow log/statement digests);
         # module-global singletons clobbered each other when two servers
@@ -91,13 +103,24 @@ class Storage:
         self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
         # the transactional KV truth: percolator MVCC over regions
-        self.kv = MVCCStore(engine=_make_engine(
-            os.path.join(path, "kv") if path is not None else None))
+        if self.shared:
+            # the shared-WAL refresh protocol lives in the Python engine;
+            # the flock'd sections make its appends safe cross-process
+            from ..kv.mvcc import PyOrderedKV
+            engine = PyOrderedKV(os.path.join(path, "kv"), shared=True)
+        else:
+            engine = _make_engine(
+                os.path.join(path, "kv") if path is not None else None)
+        self.kv = MVCCStore(engine=engine, coord=self.coord)
         if path is not None and self._tso_lease == 0:
             # lease file missing/corrupt: floor from the largest commit ts
             # in the reopened KV so timestamps still never repeat
             self._tso_lease = self.kv.max_commit_ts()
-        self.tso = TimestampOracle(floor=self._tso_lease)
+        from .coordinator import TSO_NODE_SLICES
+        self.tso = TimestampOracle(
+            floor=self._tso_lease,
+            node_id=self.coord.node_id if self.coord else 0,
+            n_nodes=TSO_NODE_SLICES if self.coord else 1)
         self.rm = RegionManager(self.kv)
         self.committer = TwoPhaseCommitter(self.rm, self.tso)
         # GLOBAL sysvar plane (mysql.global_variables analog) — rides the
@@ -120,7 +143,7 @@ class Storage:
         from ..owner import owner_manager
         self.ddl_owner = owner_manager(path, "ddl")
         self.gc_owner = owner_manager(path, "gc")
-        self._commit_lock = threading.Lock()
+        self._commit_lock = threading.RLock()
         # active snapshot ts registry -> GC/compaction safepoint
         self._active_snapshots: dict[int, int] = {}
         self._snap_lock = threading.Lock()
@@ -355,7 +378,18 @@ class Storage:
         return out
 
     def _fold_row(self, store: TableStore, values: list) -> tuple:
-        """KV value -> physical row (inverse of _kv_row)."""
+        """KV value -> physical row (inverse of _kv_row). Rows written
+        before an ADD COLUMN carry the old arity: pad with the new
+        columns' defaults (the instant-add-column read path; reference:
+        rows keep origin version, defaults fill at decode,
+        table/tables/tables.go DecodeRawRowData)."""
+        cols = store.table.columns
+        if len(values) < len(cols):
+            from ..ddl.ddl import _phys_default
+            values = list(values) + [
+                None if c.default is None
+                else _phys_default(c.ftype, c.default)
+                for c in cols[len(values):]]
         out = []
         for v, col, d in zip(values, store.table.columns,
                              store.dictionaries):
@@ -664,6 +698,11 @@ class Storage:
             self._best_effort_rollback(kv_muts, txn.start_ts)
             raise WriteConflictError(f"commit failed: {e}") from None
         with self._commit_lock:
+            if self.shared:
+                # fold sibling commits observed during prewrite and adopt
+                # any schema change BEFORE the authoritative fence check
+                self.kv.refresh()
+                self._drain_refresh()
             try:
                 self._check_schema_fence(txn)
             except WriteConflictError:
@@ -750,6 +789,140 @@ class Storage:
                         dirty = True
         if dirty:
             self.persist_catalog()
+
+    # ---- multi-process refresh (shared mode) ---------------------------
+    def refresh(self) -> None:
+        """Catch up with sibling processes sharing this directory: tail
+        the WAL, fold their committed rows into our columnar epochs, and
+        reload the catalog when the meta plane moved. The domain-reload
+        loop of the reference (domain/domain.go:352) collapsed into an
+        on-demand call — sessions invoke it per statement, and every
+        mutation section refreshes implicitly (kv/mvcc._MutationSection)."""
+        if not self.shared:
+            return
+        self.kv.refresh()
+        self._drain_refresh()
+
+    def _drain_refresh(self) -> None:
+        from ..kv.mvcc import (
+            CF_DATA,
+            CF_WRITE,
+            OP_DEL,
+            OP_PUT,
+            _dkey,
+            _split_vkey,
+            _write_dec,
+        )
+        from ..kv import codec
+        from .table_store import TOMBSTONE as TS
+
+        eng = self.kv.kv
+        pending = self.kv.drain_pending()
+        if not pending:
+            return
+        catalog_moved = False
+        meta_catalog = tablecodec.meta_key(b"catalog")
+        with self._commit_lock:
+            for op, cf, key, val in pending:
+                if cf != CF_WRITE or op != 1:
+                    continue
+                try:
+                    ukey, commit_ts = _split_vkey(key)
+                except Exception:
+                    continue
+                self.tso.observe(commit_ts)
+                if ukey == meta_catalog:
+                    catalog_moved = True
+                    continue
+                try:
+                    table_id, handle = tablecodec.decode_record_key(ukey)
+                except Exception:
+                    continue  # non-row key (meta/stats/index planes)
+                store = self.tables.get(table_id)
+                if store is None:
+                    continue
+                start_ts, kind = _write_dec(val)
+                if kind == OP_DEL:
+                    store.apply_commit(commit_ts, handle, TS)
+                elif kind == OP_PUT:
+                    data = eng.get(CF_DATA, _dkey(ukey, start_ts))
+                    if data is not None:
+                        store.apply_commit(
+                            commit_ts, handle,
+                            self._fold_row(store, codec.decode_key(data)))
+        if catalog_moved:
+            self._reload_catalog()
+
+    def _reload_catalog(self) -> None:
+        """Adopt a sibling's schema change: rebuild the stores of tables
+        whose definition moved (their schema_token changes, so in-flight
+        local transactions abort at the fence — the reference's schema
+        validator behavior, domain/schema_validator.go) and register new
+        tables. Unchanged tables keep their stores and epochs."""
+        import pickle
+
+        raw = self.get_meta(b"catalog")
+        if raw is None:
+            return
+        state = pickle.loads(raw)
+        if state["version"] == self.catalog.version:
+            return
+        old_infos = {}
+        for schema in self.catalog.schemas.values():
+            for info in schema.tables.values():
+                old_infos[info.id] = pickle.dumps(info)
+        self.catalog.schemas = state["schemas"]
+        self.catalog._next_id = max(self.catalog._next_id,
+                                    state["next_id"])
+        self.catalog.version = state["version"]
+        for schema in self.catalog.schemas.values():
+            for info in schema.tables.values():
+                part = getattr(info, "partition", None)
+                ids = [d.id for d in part.defs] if part is not None \
+                    else [info.id]
+                changed = pickle.dumps(info) != old_infos.get(info.id)
+                if info.id in old_infos and not changed and \
+                        all(tid in self.tables for tid in ids):
+                    continue
+                old_tokens = {tid: self.tables[tid].schema_token
+                              for tid in ids if tid in self.tables}
+                self.register_table(info)
+                for tid in ids:
+                    # a rebuilt store must present a NEW schema token so
+                    # in-flight local transactions that buffered against
+                    # the old layout abort at the commit fence
+                    self.tables[tid].schema_token = \
+                        old_tokens.get(tid, 0) + 1
+                    self._refold_table(self.tables[tid])
+        live = set()
+        for schema in self.catalog.schemas.values():
+            for info in schema.tables.values():
+                part = getattr(info, "partition", None)
+                live.update(d.id for d in part.defs) \
+                    if part is not None else live.add(info.id)
+        for tid in [t for t in self.tables if t not in live]:
+            del self.tables[tid]
+
+    def _refold_table(self, store: TableStore) -> None:
+        """Rebuild a store's rows from the KV truth (epoch snapshot when
+        current, committed deltas above its fold)."""
+        self._load_epoch(store)
+        lo, hi = tablecodec.record_range(store.table.id)
+        folds = []
+        for key, commit_ts, kind, val in self.kv.scan_latest(lo, hi):
+            if commit_ts <= store.epoch.fold_ts:
+                continue
+            from ..kv import codec
+            from .table_store import TOMBSTONE as TS
+            _, handle = tablecodec.decode_record_key(key)
+            if kind == b"D":
+                folds.append((commit_ts, handle, TS))
+            else:
+                folds.append((commit_ts, handle, self._fold_row(
+                    store, codec.decode_key(val))))
+        for commit_ts, handle, row in folds:
+            store.apply_commit(commit_ts, handle, row)
+            store._next_handle = max(store._next_handle, handle + 1)
 
     def _check_schema_fence(self, txn: "Transaction") -> None:
         """Fail txns whose buffered rows target a superseded table layout
