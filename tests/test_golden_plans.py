@@ -1,0 +1,81 @@
+"""Golden plan-shape tests: EXPLAIN snapshots for the TPC-H corpus.
+
+The reference pins plan shapes with the explaintest corpus
+(reference: cmd/explaintest/main.go, t/tpch.test, r/tpch.result): result
+diff-tests alone cannot catch a plan regression that silently degrades a
+device fragment into a host hash join while staying correct. These
+goldens pin the EXPLAIN text of all 22 TPC-H queries (plus join-shape
+probes) at a fixed tiny scale.
+
+Re-record after an intentional planner change with:
+    RECORD_GOLDEN=1 python -m pytest tests/test_golden_plans.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tidb_tpu.bench.tpch_data import generate_tpch, load_table
+from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+from tidb_tpu.session import Session
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "tpch_plans.txt")
+
+EXTRA_QUERIES = {
+    "having_pushdown": (
+        "select l_orderkey from lineitem group by l_orderkey "
+        "having sum(l_quantity) > 300"),
+    "topn_agg": (
+        "select l_orderkey, sum(l_quantity) q from lineitem "
+        "group by l_orderkey order by q desc limit 5"),
+}
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = Session()
+    data = generate_tpch(0.01, 11)
+    for t in data:
+        load_table(s, t, data[t])
+    s.execute("analyze table lineitem, orders, customer, supplier, "
+              "part, partsupp, nation, region")
+    return s
+
+
+def _plans(session) -> str:
+    out = []
+    queries = dict(sorted(TPCH_QUERIES.items()))
+    queries.update(EXTRA_QUERIES)
+    for name, sql in queries.items():
+        out.append(f"==== {name} ====")
+        try:
+            rows = session.query("explain " + sql)
+            out.extend(r[0] for r in rows)
+        except Exception as e:  # noqa: BLE001 - recorded as part of golden
+            out.append(f"ERROR: {type(e).__name__}: {e}")
+        out.append("")
+    return "\n".join(out)
+
+
+def test_tpch_plan_shapes(session):
+    got = _plans(session)
+    if os.environ.get("RECORD_GOLDEN"):
+        os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
+        with open(GOLDEN, "w") as f:
+            f.write(got)
+        pytest.skip("golden plans re-recorded")
+    assert os.path.exists(GOLDEN), \
+        "golden file missing - run with RECORD_GOLDEN=1"
+    with open(GOLDEN) as f:
+        want = f.read()
+    if got != want:
+        import difflib
+        diff = "\n".join(difflib.unified_diff(
+            want.splitlines(), got.splitlines(), "golden", "current",
+            lineterm=""))
+        raise AssertionError(
+            "plan shapes changed (RECORD_GOLDEN=1 to re-record):\n"
+            + diff[:8000])

@@ -83,10 +83,15 @@ def test_scalar_subquery_in_agg_arg(s):
     assert rows == [(59,)]  # (5-1)+(50-1)+(7-1)
 
 
-def test_exists_with_aggregate_rejected(s):
+def test_exists_with_ungrouped_aggregate_is_true(s):
+    # an ungrouped aggregate always yields exactly one row, so EXISTS
+    # is constant TRUE regardless of the WHERE (MySQL semantics)
+    rows = s.query("select count(*) from t where "
+                   "exists (select max(b) from u where u.k = 99)")
+    assert rows == [(3,)]
     with pytest.raises(SQLError):
-        s.query("select id from t where "
-                "exists (select max(b) from u where u.k = 99)")
+        s.query("select id from t where exists "
+                "(select k from u group by k having count(*) > 1)")
 
 
 def test_exists_uncorrelated_true(s):
@@ -130,3 +135,41 @@ def test_distributed_min_max():
     sql = ("select g, min(v), max(v), sum(v), count(*) from m "
            "group by g order by g")
     assert dist.query(sql) == single.query(sql)
+
+
+# ---- round-4 decorrelation extensions (reference: rule_decorrelate.go) ----
+
+def test_correlated_in_subquery():
+    from tidb_tpu.session import Session
+    s = Session()
+    s.execute("create table co (k bigint primary key, a bigint not null)")
+    s.execute("create table ci (k bigint not null, b bigint not null)")
+    s.execute("insert into co values (1, 10), (2, 20), (3, 30)")
+    s.execute("insert into ci values (1, 10), (1, 11), (3, 99)")
+    assert s.query("select k from co where a in "
+                   "(select b from ci where ci.k = co.k) order by k") \
+        == [(1,)]
+    assert s.query("select k from co where a not in "
+                   "(select b from ci where ci.k = co.k) order by k") \
+        == [(2,), (3,)]
+    # correlated IN with extra inner predicates
+    assert s.query("select k from co where a in (select b from ci "
+                   "where ci.k = co.k and ci.b > 10) order by k") == []
+
+
+def test_exists_limit_and_trivial_aggregate():
+    from tidb_tpu.session import Session
+    s = Session()
+    s.execute("create table eo (k bigint primary key)")
+    s.execute("create table ei (k bigint)")
+    s.execute("insert into eo values (1), (2)")
+    s.execute("insert into ei values (1)")
+    assert s.query("select k from eo where exists "
+                   "(select 1 from ei where ei.k = eo.k limit 1) "
+                   "order by k") == [(1,)]
+    # ungrouped aggregate always yields one row: EXISTS is constant true
+    assert s.query("select k from eo where exists "
+                   "(select max(k) from ei where ei.k = eo.k) "
+                   "order by k") == [(1,), (2,)]
+    assert s.query("select k from eo where not exists "
+                   "(select max(k) from ei where ei.k = eo.k)") == []

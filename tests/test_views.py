@@ -1,0 +1,60 @@
+"""CREATE/DROP VIEW + plan-time expansion (reference: ddl/ddl_api.go
+CreateView; planner/core/logical_plan_builder.go
+BuildDataSourceFromView)."""
+
+import pytest
+
+from tidb_tpu.session import Session, SQLError
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create table t (id bigint primary key, v bigint, g bigint)")
+    s.execute("insert into t values (1,10,1),(2,20,1),(3,30,2)")
+    return s
+
+
+def test_view_basics(s):
+    s.execute("create view vs as select g, sum(v) total from t group by g")
+    assert s.query("select * from vs order by g") == [(1, 30), (2, 30)]
+    assert s.query("select total from vs where g = 2") == [(30,)]
+
+
+def test_view_column_list_and_join(s):
+    s.execute("create view v2 (grp, tot) as select g, sum(v) from t "
+              "group by g")
+    got = s.query("select t.id, v2.tot from t, v2 where t.g = v2.grp "
+                  "order by t.id")
+    assert got == [(1, 30), (2, 30), (3, 30)]
+
+
+def test_view_tracks_dml_and_nesting(s):
+    s.execute("create view v1 as select g, sum(v) tot from t group by g")
+    s.execute("create view v3 as select g, tot from v1 where tot > 25")
+    s.execute("insert into t values (4, 40, 2)")
+    assert s.query("select g, tot from v3 order by g") == [(1, 30),
+                                                          (2, 70)]
+
+
+def test_view_replace_drop_errors(s):
+    s.execute("create view w as select id from t")
+    with pytest.raises(SQLError):
+        s.execute("create view w as select v from t")
+    s.execute("create or replace view w as select v from t")
+    assert s.query("select count(*) from w") == [(3,)]
+    s.execute("drop view w")
+    with pytest.raises(SQLError):
+        s.query("select * from w")
+    s.execute("drop view if exists w")  # no error
+    with pytest.raises(SQLError):
+        s.execute("drop view w")
+
+
+def test_view_name_collision_and_validation(s):
+    with pytest.raises(SQLError):
+        s.execute("create view t as select 1")  # table exists
+    with pytest.raises(SQLError):
+        s.execute("create view bad as select nosuch from t")
+    with pytest.raises(SQLError):
+        s.execute("create view bad (a, b) as select id from t")

@@ -155,6 +155,20 @@ class SchemaInfo:
     name: str
     tables: dict[str, TableInfo] = field(default_factory=dict)  # lower-name keyed
     sequences: dict[str, SequenceInfo] = field(default_factory=dict)
+    views: dict[str, "ViewInfo"] = field(default_factory=dict)
+
+
+@dataclass
+class ViewInfo:
+    """A named stored SELECT, expanded at plan-build time (reference:
+    ddl/ddl_api.go CreateView; planner/core/logical_plan_builder.go
+    BuildDataSourceFromView re-parses the stored SELECT). Column aliases
+    (when given) rename the underlying SELECT's output columns."""
+
+    name: str
+    sql: str            # the SELECT text
+    columns: tuple = ()  # optional explicit column-name list
+    definer: str = "root@%"
 
 
 class Catalog:

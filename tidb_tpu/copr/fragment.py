@@ -320,9 +320,21 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
                     mode=None):
     probe = frag.tables[0]
     psnap = snaps[probe.table.id]
+    if mode is None:
+        mode = "agg" if frag.agg is not None else "rows"
+    # big epochs stream through TILES exactly like the single-table path:
+    # one compiled kernel, per-tile partials merged host-side — an
+    # untiled 60M-row fragment kernel plans ~16GB of HBM intermediates
+    # and fails to compile. The rank-space hc kernel streams internally
+    # (bounded VMEM window) and keeps whole-epoch staging.
+    if mode in ("agg", "rows") and not overlay and \
+            getattr(cop, "frag_axis", None) is None and \
+            prepared.get("__part_join__") is None and \
+            psnap.epoch.num_rows > cop.TILE_ROWS:
+        return _run_frag_tiled(cop, frag, snaps, prepared, spans, builds,
+                               mode)
     pcols, pvis, phost, phost_mask = cop._stage_inputs(
         _facade_dag(probe), psnap, overlay=overlay)
-
     # single-device epoch batches swap the in-kernel perm gathers for
     # epoch-cached ALIGNED build columns (see _stage_aligned): the first
     # query against an epoch pays the gathers once; every later fragment
@@ -334,8 +346,6 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         kern_builds = _stage_aligned(cop, frag, snaps, prepared, spans,
                                      builds, pcols)
 
-    if mode is None:
-        mode = "agg" if frag.agg is not None else "rows"
     aux = None
     if mode == "hc" and not overlay and \
             prepared.get("__rank_meta__") is not None:
@@ -359,23 +369,7 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         chunk = _decode_hc(frag, snaps, prepared, out)
         return [] if chunk is None else [chunk]
     if mode == "agg":
-        if np.any(np.asarray(out.pop("overflow", 0)) > 0):
-            raise _Fallback("exchange-overflow")  # join bucket skew
-        cards = prepared["__dense_cards__"]
-        comb_dicts = []
-        for ti, t in enumerate(frag.tables):
-            snap = snaps[t.table.id]
-            comb_dicts.extend(snap.dictionaries[off]
-                              for off in t.col_offsets)
-        group_dicts = [
-            comb_dicts[g.idx]
-            if g.ftype.is_string and isinstance(g, Col) else None
-            for g in frag.agg.group_by
-        ]
-        chunk = decode_agg_partials(
-            frag.agg, prepared, cards, out, group_dicts,
-            frag.output_types[len(frag.agg.group_by):])
-        return [] if chunk is None else [chunk]
+        return _decode_frag_agg(frag, snaps, prepared, out)
 
     # row mode: device returned a packed probe-row bitmask; host replays
     # the (cheap, vectorized) gathers for the passing rows only
@@ -384,6 +378,76 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         if n_rows else np.zeros(0, bool)
     idx = np.nonzero(mask)[0]
     return _host_rows_for(frag, snaps, idx, overlay)
+
+
+def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
+    """Stream the probe through shape-bucketed tiles: the same compiled
+    fragment kernel serves every tile, aligned join columns are cached
+    per (epoch pair, tile), and the per-tile agg partials merge exactly
+    like the single-table tiled path (client._merge_tile_outs)."""
+    from .client import _merge_tile_outs
+
+    probe = frag.tables[0]
+    psnap = snaps[probe.table.id]
+    tiles = cop._stage_tiles(_facade_dag(probe), psnap)
+    bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
+    kern = None
+    devs = []
+    for ti, (cols, vis, cnt) in enumerate(tiles):
+        kb = builds
+        if builds:
+            kb = _stage_aligned(cop, frag, snaps, prepared, spans,
+                                builds, cols, tag=("tile", ti))
+        if kern is None:
+            key = ("frag", _frag_key(frag), _sig(prepared), mode, bucket,
+                   tuple(
+                       ("al", b["found"].shape[0]) if "acols" in b
+                       else b["cols"][0][0].shape[0]
+                       for b in kb))
+            kern = cop._kernel(key, lambda: cop._frag_jit(
+                _build_frag_kernel(frag, prepared, spans, mode, raw=True,
+                                   cop=cop), mode, prepared))
+        from ..util import interrupt
+        interrupt.check()
+        devs.append(kern(cols, vis, kb))
+    outs = jax.device_get(devs)
+
+    if mode == "agg":
+        out = _merge_tile_outs(outs, prepared["__agg_sched__"])
+        return _decode_frag_agg(frag, snaps, prepared, out)
+
+    # rows: per-tile packed bitmasks -> global epoch row indices
+    T = cop.TILE_ROWS
+    idx_parts = []
+    for ti, (packed, (_, _, cnt)) in enumerate(zip(outs, tiles)):
+        mask = np.unpackbits(packed, count=None).astype(bool)[:cnt]
+        local = np.nonzero(mask)[0]
+        if len(local):
+            idx_parts.append(local + ti * T)
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    return _host_rows_for(frag, snaps, idx, overlay=False)
+
+
+def _decode_frag_agg(frag, snaps, prepared, out) -> list[Chunk]:
+    """Fetched dense-agg partials -> partial-layout chunks (shared by the
+    whole-epoch and tiled executions)."""
+    if np.any(np.asarray(out.pop("overflow", 0)) > 0):
+        raise _Fallback("exchange-overflow")  # join bucket skew
+    cards = prepared["__dense_cards__"]
+    comb_dicts = []
+    for t in frag.tables:
+        snap = snaps[t.table.id]
+        comb_dicts.extend(snap.dictionaries[off]
+                          for off in t.col_offsets)
+    group_dicts = [
+        comb_dicts[g.idx]
+        if g.ftype.is_string and isinstance(g, Col) else None
+        for g in frag.agg.group_by
+    ]
+    chunk = decode_agg_partials(
+        frag.agg, prepared, cards, out, group_dicts,
+        frag.output_types[len(frag.agg.group_by):])
+    return [] if chunk is None else [chunk]
 
 
 def _stage_rank_aux(cop, snap, prepared):
@@ -404,7 +468,8 @@ def _stage_rank_aux(cop, snap, prepared):
     return hit
 
 
-def _stage_aligned(cop, frag, snaps, prepared, spans, builds, pcols):
+def _stage_aligned(cop, frag, snaps, prepared, spans, builds, pcols,
+                   tag=None):
     """Materialize build columns ALIGNED to the padded probe rows as
     epoch-cached device arrays.
 
@@ -451,7 +516,7 @@ def _stage_aligned(cop, frag, snaps, prepared, spans, builds, pcols):
         ckey = (pep, "aligned", bep, t.table.id, ji, key_e.idx, bucket,
                 lo, span, tuple(t.col_offsets),
                 _mask_digest_of(psnap.base_visible),
-                _mask_digest_of(bsnap.base_visible))
+                _mask_digest_of(bsnap.base_visible), tag)
         with cop._lock:
             hit = cop._col_cache.get(ckey)
             cacheable = (

@@ -239,11 +239,14 @@ class PlanBuilder:
             return sub
         raise PlanError(f"unsupported table reference {type(ref).__name__}")
 
-    def _build_scan(self, tn: ast.TableName) -> LogicalScan:
+    def _build_scan(self, tn: ast.TableName):
         db = tn.db or self.current_db
         try:
             info = self.catalog.table(db, tn.name)
         except KeyError as e:
+            view = self._lookup_view(db, tn.name)
+            if view is not None:
+                return self._expand_view(db, tn, view)
             raise PlanError(str(e)) from None
         alias = (tn.alias or tn.name).lower()
         fields = [
@@ -261,6 +264,46 @@ class PlanBuilder:
                 elif name == "IGNORE_INDEX":
                     scan.hint_ignore_index = args[1:]  # type: ignore[attr-defined]
         return scan
+
+    _VIEW_DEPTH_CAP = 16
+
+    def _lookup_view(self, db: str, name: str):
+        try:
+            schema = self.catalog.schema(db)
+        except KeyError:
+            return None
+        return getattr(schema, "views", {}).get(name.lower())
+
+    def _expand_view(self, db: str, tn: ast.TableName, view) -> LogicalPlan:
+        """Inline the view's stored SELECT as a derived table (reference:
+        planner/core/logical_plan_builder.go BuildDataSourceFromView —
+        the stored text re-parses against the CURRENT schema, so views
+        track later DDL on their base tables)."""
+        from ..sql.parser import parse_sql as _parse
+
+        depth = getattr(self, "_view_depth", 0)
+        if depth >= self._VIEW_DEPTH_CAP:
+            raise PlanError(f"view nesting too deep at {view.name}")
+        self._view_depth = depth + 1
+        try:
+            stmts = _parse(view.sql)
+            sub = self.build_select(stmts[0])
+        except Exception as e:
+            if isinstance(e, PlanError):
+                raise
+            raise PlanError(
+                f"view {view.name} is invalid: {e}") from None
+        finally:
+            self._view_depth = depth
+        alias = (tn.alias or tn.name).lower()
+        names = list(view.columns) if view.columns else [
+            f.name for f in sub.schema.fields]
+        if len(names) != len(sub.schema.fields):
+            raise PlanError(f"view {view.name} column list mismatch")
+        sub.schema = PlanSchema([
+            ResultField(n.lower(), f.ftype, alias)
+            for n, f in zip(names, sub.schema.fields)])
+        return sub
 
     def _build_join(self, j: ast.Join) -> LogicalPlan:
         left = self.build_table_refs(j.left)
@@ -390,14 +433,45 @@ class PlanBuilder:
     def _build_exists(
         self, sub: ast.SelectStmt, plan: LogicalPlan, anti: bool
     ) -> LogicalPlan:
-        # EXISTS truth depends only on row existence in FROM+WHERE; forms
-        # where that is not true (aggregates always yield a row, LIMIT /
-        # HAVING change the row set) are rejected loudly
-        if sub.group_by or sub.having or sub.limit is not None or any(
-                f.expr is not None and _contains_agg(f.expr)
-                for f in sub.fields):
-            raise PlanError("EXISTS subquery with aggregation/HAVING/LIMIT "
-                            "is not supported")
+        # EXISTS truth depends only on row existence in FROM+WHERE.
+        # LIMIT k>=1 does not change existence — drop it (the common
+        # EXISTS(... LIMIT 1) idiom); LIMIT 0 yields no rows, so EXISTS
+        # is constant FALSE. An UNgrouped aggregate always yields exactly
+        # one row, so EXISTS is constant TRUE (reference:
+        # rule_decorrelate.go handles these as trivial cases).
+        if sub.limit == 0:
+            const = Const(1 if anti else 0, FieldType(TypeKind.BOOLEAN))
+            return LogicalSelection([const], plan.schema, [plan])
+        if sub.limit is not None and sub.limit >= 1 and not sub.offset:
+            import dataclasses
+            sub = dataclasses.replace(sub, limit=None)
+        has_agg = any(f.expr is not None and _contains_agg(f.expr)
+                      for f in sub.fields)
+        if has_agg and not sub.group_by and sub.having is None and \
+                sub.limit is None and not sub.offset:
+            # still VALIDATE the subquery (names, correlation) before
+            # constant-folding it away
+            splan, _eq, _res = self._build_sub_source(sub, plan.schema)
+            comb = PlanSchema(plan.schema.fields + splan.schema.fields)
+            try:
+                for f in sub.fields:
+                    if f.expr is None:
+                        continue
+                    for call in _find_aggs(f.expr):
+                        if call.args and not call.is_star:
+                            # inner scope shadows outer (SQL resolution)
+                            try:
+                                self.resolve(call.args[0], splan.schema)
+                            except (PlanError, KeyError):
+                                self.resolve(call.args[0], comb)
+            except KeyError as e:
+                raise PlanError(str(e)) from None
+            const = Const(0 if anti else 1, FieldType(TypeKind.BOOLEAN))
+            return LogicalSelection([const], plan.schema, [plan])
+        if sub.group_by or sub.having or sub.limit is not None or \
+                sub.offset or has_agg:
+            raise PlanError("EXISTS subquery with aggregation/HAVING/"
+                            "LIMIT/OFFSET is not supported")
         splan, eq_pairs, residual = self._build_sub_source(sub, plan.schema)
         # remap residuals: outer indices stay, sub indices shift to
         # len(plan.schema) .. (they were resolved over outer++sub already)
@@ -411,13 +485,65 @@ class PlanBuilder:
         lhs = self.resolve(node.operand, plan.schema)
         if not isinstance(lhs, Col):
             raise PlanError("IN (subquery) requires a column operand")
-        sub = self.build_select(node.query)
+        anti = negate != node.negated
+        try:
+            sub = self.build_select(node.query)
+        except PlanError as e:
+            # correlated IN: the subquery references outer columns —
+            # recognizable as an unresolved-column error. Anything else
+            # is a genuine error; re-raise it undisguised.
+            # x IN (SELECT y FROM ... WHERE corr) decorrelates to a SEMI
+            # join carrying both the correlation and the x = y equality
+            # (reference: rule_decorrelate.go pulls the correlated
+            # conditions into the semi join). NOT IN needs null-aware
+            # anti semantics; with a correlated body we support it only
+            # when both compared columns are non-nullable.
+            if "unknown column" not in str(e).lower():
+                raise
+            return self._build_corr_in(node, plan, lhs, anti)
         if len(sub.schema) != 1:
             raise PlanError("IN subquery must return exactly one column")
-        anti = negate != node.negated
         kind = "ANTI_NULL" if anti else "SEMI"
         return LogicalJoin(kind, [(lhs.idx, 0)], [], plan.schema,
                            [plan, sub])
+
+    def _build_corr_in(self, node: ast.InSubquery, plan: LogicalPlan,
+                       lhs: Col, anti: bool) -> LogicalPlan:
+        sub = node.query
+        if sub.group_by or sub.having or sub.limit is not None or \
+                len(sub.fields) != 1 or sub.fields[0].expr is None or \
+                _contains_agg(sub.fields[0].expr):
+            raise PlanError("correlated IN subquery must be a bare "
+                            "single-column SELECT")
+        splan, eq_pairs, residual = self._build_sub_source(
+            sub, plan.schema)
+        # inner scope shadows outer for the selected column (SQL name
+        # resolution); fall back to the combined space for qualified refs
+        try:
+            rhs_local = self.resolve(sub.fields[0].expr, splan.schema)
+            rhs = Col(rhs_local.idx + len(plan.schema),
+                      rhs_local.ftype) \
+                if isinstance(rhs_local, Col) else None
+        except (PlanError, KeyError):
+            rhs = None
+        if rhs is None:
+            try:
+                rhs = self.resolve(
+                    sub.fields[0].expr,
+                    PlanSchema(plan.schema.fields + splan.schema.fields))
+            except KeyError as e:
+                raise PlanError(str(e)) from None
+        if not isinstance(rhs, Col) or rhs.idx < len(plan.schema):
+            raise PlanError("correlated IN subquery selects a non-column")
+        if anti and (lhs.ftype.nullable or rhs.ftype.nullable):
+            raise PlanError(
+                "correlated NOT IN over nullable columns is not "
+                "supported (null-aware anti join)")
+        kind = "ANTI" if anti else "SEMI"
+        eq_pairs = list(eq_pairs) + [(lhs.idx,
+                                      rhs.idx - len(plan.schema))]
+        return LogicalJoin(kind, eq_pairs, residual, plan.schema,
+                           [plan, splan])
 
     def _build_scalar_cmp(
         self, lhs_ast: ast.Expr, op: str, sub: ast.SelectStmt,

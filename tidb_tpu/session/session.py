@@ -232,6 +232,35 @@ class Session:
         if isinstance(stmt, ast.KillStmt):
             self._exec_kill(stmt)
             return ResultSet([], [])
+        if isinstance(stmt, ast.CreateViewStmt):
+            from ..catalog.schema import ViewInfo
+            db = stmt.db or self.current_db
+            schema = self.catalog.schema(db)
+            key = stmt.name.lower()
+            if not hasattr(schema, "views"):
+                schema.views = {}
+            if key in schema.tables:
+                raise SQLError(f"Table '{stmt.name}' already exists")
+            if key in schema.views and not stmt.or_replace:
+                raise SQLError(f"Table '{stmt.name}' already exists")
+            # validate the stored SELECT against the current catalog
+            self._plan_view_select(db, stmt.select_sql, stmt.columns)
+            schema.views[key] = ViewInfo(
+                stmt.name, stmt.select_sql, tuple(stmt.columns),
+                definer=f"{self.user or 'root'}@%")
+            self.catalog.bump_version()
+            return ResultSet([], [])
+        if isinstance(stmt, ast.DropViewStmt):
+            db = stmt.db or self.current_db
+            schema = self.catalog.schema(db)
+            views = getattr(schema, "views", {})
+            if stmt.name.lower() not in views:
+                if stmt.if_exists:
+                    return ResultSet([], [])
+                raise SQLError(f"Unknown view '{stmt.name}'")
+            del views[stmt.name.lower()]
+            self.catalog.bump_version()
+            return ResultSet([], [])
         if isinstance(stmt, ast.CreateUserStmt):
             self._require_super()
             from .privileges import PrivilegeError
@@ -617,6 +646,7 @@ class Session:
         ast.AlterTableStmt: "ALTER", ast.CreateIndexStmt: "INDEX",
         ast.DropIndexStmt: "INDEX", ast.RenameTableStmt: "ALTER",
         ast.CreateDatabaseStmt: "CREATE", ast.DropDatabaseStmt: "DROP",
+        ast.CreateViewStmt: "CREATE", ast.DropViewStmt: "DROP",
     }
 
     def _check_privileges(self, stmt: ast.Stmt) -> None:
@@ -850,6 +880,22 @@ class Session:
                     continue  # fresh ts, statement re-executes
                 raise
             return result
+
+    def _plan_view_select(self, db: str, sql: str, columns) -> None:
+        """Validate a view definition by building its plan now (the
+        reference re-parses/validates at CreateView, ddl/ddl_api.go)."""
+        from ..plan.builder import PlanBuilder, PlanError
+        from ..sql.parser import parse_sql as _parse
+        try:
+            stmts = _parse(sql)
+            if len(stmts) != 1 or not isinstance(
+                    stmts[0], (ast.SelectStmt, ast.SetOpStmt)):
+                raise SQLError("view definition must be one SELECT")
+            plan = PlanBuilder(self.catalog, db).build_select(stmts[0])
+        except PlanError as e:
+            raise SQLError(str(e)) from None
+        if columns and len(columns) != len(plan.schema.fields):
+            raise SQLError("view column list length mismatch")
 
     def _exec_kill(self, stmt) -> None:
         """Route KILL to the owning server: local registry when the id

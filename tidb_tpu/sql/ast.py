@@ -526,3 +526,19 @@ class KillStmt(Stmt):
 
     conn_id: int
     query_only: bool = False
+
+
+@dataclass
+class CreateViewStmt(Stmt):
+    name: str
+    select_sql: str
+    columns: tuple = ()
+    or_replace: bool = False
+    db: Optional[str] = None
+
+
+@dataclass
+class DropViewStmt(Stmt):
+    name: str
+    if_exists: bool = False
+    db: Optional[str] = None

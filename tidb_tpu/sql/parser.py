@@ -66,6 +66,7 @@ class ParseError(Exception):
 
 class Parser:
     def __init__(self, text: str) -> None:
+        self.text = text
         toks = list(Lexer(text).tokens())
         # optimizer hints are meaningful only right after SELECT; stray
         # hint comments elsewhere degrade to plain comments (MySQL does
@@ -595,6 +596,34 @@ class Parser:
 
     def parse_create(self) -> ast.Stmt:
         self.expect_kw("CREATE")
+        or_replace = False
+        if self.cur.is_kw("OR"):
+            self.advance()
+            if not (self.cur.kind == TokenKind.IDENT
+                    and self.cur.text.upper() == "REPLACE") and \
+                    not self.cur.is_kw("REPLACE"):
+                raise ParseError("expected REPLACE after OR", self.cur)
+            self.advance()
+            or_replace = True
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "VIEW":
+            self.advance()
+            tn = self.parse_table_name()
+            cols: tuple = ()
+            if self.cur.is_op("("):
+                cols = tuple(self._paren_ident_list())
+            self.expect_kw("AS")
+            start = self.cur.pos
+            self.parse_select()  # validate; the TEXT is what's stored
+            sql = self.text[start:
+                            self.cur.pos if self.cur.kind
+                            != TokenKind.EOF else len(self.text)].strip()
+            if sql.endswith(";"):
+                sql = sql[:-1]
+            return ast.CreateViewStmt(tn.name, sql, cols, or_replace,
+                                      tn.db)
+        if or_replace:
+            raise ParseError("OR REPLACE supports only VIEW", self.cur)
         if self.accept_kw("DATABASE", "SCHEMA"):
             ine = self._if_not_exists()
             return ast.CreateDatabaseStmt(self.expect_ident(), ine)
@@ -955,6 +984,12 @@ class Parser:
 
     def parse_drop(self) -> ast.Stmt:
         self.expect_kw("DROP")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "VIEW":
+            self.advance()
+            if_exists = self._if_exists()
+            tn = self.parse_table_name()
+            return ast.DropViewStmt(tn.name, if_exists, tn.db)
         if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "SEQUENCE":
             self.advance()
