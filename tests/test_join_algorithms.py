@@ -1,0 +1,83 @@
+"""Cost-based join algorithm selection: hash vs index-lookup vs merge
+(reference: planner/core/exhaust_physical_plans.go getIndexJoin /
+merge-join eligibility; executor/index_lookup_join.go,
+executor/merge_join.go)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def s():
+    s = Session()
+    s.execute("create table big (id bigint, v bigint)")
+    store = s.storage.table_store(s.catalog.table("test", "big").id)
+    n = 300_000
+    store.bulk_load([np.arange(1, n + 1), np.arange(1, n + 1) * 7])
+    s.execute("create index big_id on big (id)")
+    s.execute("create table small (k bigint, tag bigint)")
+    s.execute("insert into small values " + ", ".join(
+        f"({i * 37 + 5}, {i})" for i in range(200)))
+    s.execute("analyze table big, small")
+    return s
+
+
+def _explain(s, sql) -> str:
+    return "\n".join(r[0] for r in s.query("explain " + sql))
+
+
+def test_index_join_chosen_and_correct(s):
+    sql = "select sum(big.v) from small, big where small.k = big.id"
+    assert "IndexJoin(INNER)" in _explain(s, sql)
+    want = sum((i * 37 + 5) * 7 for i in range(200))
+    assert int(s.query(sql)[0][0]) == want
+
+
+def test_index_join_residual_and_filters(s):
+    sql = ("select count(*) from small, big "
+           "where small.k = big.id and big.v > 70 and small.tag < 100")
+    assert "IndexJoin(INNER)" in _explain(s, sql)
+    want = sum(1 for i in range(100) if (i * 37 + 5) * 7 > 70)
+    assert int(s.query(sql)[0][0]) == want
+
+
+def test_index_join_sees_uncommitted_overlay(s):
+    s.execute("begin")
+    s.execute("insert into big values (99999999, 123)")
+    s.execute("insert into small values (99999999, 777)")
+    sql = ("select big.v from small, big "
+           "where small.k = big.id and small.tag = 777")
+    assert s.query(sql) == [(123,)]
+    s.execute("rollback")
+
+
+def test_hash_join_when_no_index(s):
+    s.execute("drop index big_id on big")
+    sql = "select sum(big.v) from small, big where small.k = big.id"
+    assert "HashJoin(INNER)" in _explain(s, sql)
+    want = sum((i * 37 + 5) * 7 for i in range(200))
+    assert int(s.query(sql)[0][0]) == want
+
+
+def test_hash_join_when_outer_large(s):
+    # both sides big: probing per outer row would lose; hash stays
+    sql = "select count(*) from big a, big b where a.id = b.v"
+    assert "IndexJoin" not in _explain(s, sql)
+
+
+def test_merge_join_on_pk_pk(s):
+    s.execute("create table p1 (id bigint primary key, a bigint)")
+    s.execute("create table p2 (id bigint primary key, b bigint)")
+    s.execute("insert into p1 values (1, 10), (2, 20), (3, 30)")
+    s.execute("insert into p2 values (2, 200), (3, 300), (4, 400)")
+    # no analyze: fragments need unique-key metadata regardless; force
+    # the host path with a non-fragment-eligible shape (no stats is fine)
+    sql = ("select p1.id, p1.a + p2.b from p1, p2 "
+           "where p1.id = p2.id order by p1.id")
+    plan = _explain(s, sql)
+    assert "MergeJoin(INNER)" in plan or "FragmentRead" in plan
+    assert s.query(sql) == [(2, 220), (3, 330)]

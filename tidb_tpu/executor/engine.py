@@ -27,6 +27,8 @@ from ..plan.expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from ..plan.physical import (
     PhysHashAgg,
     PhysHashJoin,
+    PhysIndexJoin,
+    PhysMergeJoin,
     PhysLimit,
     PhysPointGet,
     PhysProjection,
@@ -233,8 +235,12 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         start = min(plan.offset, child.num_rows)
         stop = min(plan.offset + plan.limit, child.num_rows)
         return child.slice(start, stop)
-    if isinstance(plan, PhysHashJoin):
+    if isinstance(plan, (PhysHashJoin, PhysMergeJoin)):
+        # the merge join reuses the join driver: its single-key match is
+        # the sort-free searchsorted alignment (_equi_match fast path)
         return _run_join(plan, ctx)
+    if isinstance(plan, PhysIndexJoin):
+        return _run_index_join(plan, ctx)
     raise TypeError(f"run_physical: unknown node {type(plan).__name__}")
 
 
@@ -1251,7 +1257,139 @@ def _spill_sort(child: Chunk, items: list[tuple[PlanExpr, bool]],
 
 # ==================== join ====================
 
-def _run_join(plan: PhysHashJoin, ctx: ExecContext) -> Chunk:
+def _run_index_join(plan, ctx: ExecContext) -> Chunk:
+    """Outer-driven index probe (reference: executor/index_lookup_join.go
+    innerWorker buildTask): evaluate the outer child, look the keys up in
+    the inner table's sorted-permutation epoch index (one vectorized
+    searchsorted pass) plus the overlay, gather only matching inner rows,
+    then apply the inner scan's pushed-down filters and residual ON
+    conditions."""
+    from ..store.index import epoch_column_order, epoch_index_order
+
+    outer = run_physical(plan.children[0], ctx)
+    inner_tr = plan.children[1]
+    snap = ctx.txn.snapshot(inner_tr.table.id)
+    oi, ii = plan.eq_conditions[0]
+    okey = outer.columns[oi]
+    keys = okey.data.astype(np.int64)
+    kvalid = okey.validity
+
+    epoch = snap.epoch
+    off = plan.inner_offset
+    # epoch side: the table's LAZY sorted-permutation — built once per
+    # (epoch, column) and cached on the store (store/index.py), so
+    # repeated probes pay only the searchsorted. NULL rows sort first;
+    # the search runs over the non-NULL suffix only.
+    store = ctx.txn.storage.tables[inner_tr.table.id]
+    index = next((ix for ix in inner_tr.table.indices
+                  if ix.visible and ix.col_offsets == [off]), None)
+    li_parts = []
+    pos_parts = []
+    if epoch.num_rows:
+        data = epoch.columns[off]
+        valid = epoch.valids[off]
+        if index is not None:
+            order = epoch_index_order(store, epoch, index)
+            start = 0 if valid is None else int(
+                np.searchsorted(valid[order], True, "left"))
+        else:  # PK-handle column (no named index object)
+            order, start = epoch_column_order(store, epoch, off)
+        order = order[start:]
+        sorted_vals = data[order]
+        lo = np.searchsorted(sorted_vals, keys, side="left")
+        hi = np.searchsorted(sorted_vals, keys, side="right")
+        counts = np.where(kvalid, hi - lo, 0)
+        total = int(counts.sum())
+        li = np.repeat(np.arange(outer.num_rows), counts)
+        starts = np.repeat(lo, counts)
+        offs = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        pos = order[starts + offs]
+        keep = snap.base_visible[pos]
+        li_parts.append(li[keep])
+        pos_parts.append(pos[keep])
+    # overlay side (uncommitted / unfolded rows): small — match by scan
+    n_over = len(snap.overlay_handles)
+    ov_li = ov_rows = None
+    if n_over:
+        od = snap.overlay_columns[off].astype(np.int64)
+        ovl = snap.overlay_valids[off]
+        om = np.ones(n_over, bool) if ovl is None else ovl
+        oorder = np.argsort(od, kind="stable")
+        osorted = od[oorder]
+        lo = np.searchsorted(osorted, keys, side="left")
+        hi = np.searchsorted(osorted, keys, side="right")
+        counts = np.where(kvalid, hi - lo, 0)
+        total = int(counts.sum())
+        ov_li = np.repeat(np.arange(outer.num_rows), counts)
+        starts = np.repeat(lo, counts)
+        offs = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        orows = oorder[starts + offs]
+        keep = om[orows]
+        ov_li, ov_rows = ov_li[keep], orows[keep]
+
+    # inner chunk in the scan's column order
+    col_offsets = inner_tr.dag.scan.col_offsets
+    cols = []
+    for ci, coff in enumerate(col_offsets):
+        parts_d, parts_v = [], []
+        if pos_parts:
+            d = epoch.columns[coff][pos_parts[0]]
+            v = epoch.valids[coff]
+            parts_d.append(d)
+            parts_v.append(np.ones(len(d), bool) if v is None
+                           else v[pos_parts[0]])
+        if ov_rows is not None and len(ov_rows):
+            d = snap.overlay_columns[coff][ov_rows]
+            v = snap.overlay_valids[coff]
+            parts_d.append(d)
+            parts_v.append(np.ones(len(d), bool) if v is None
+                           else v[ov_rows])
+        ft = inner_tr.dag.output_types[ci]
+        if parts_d:
+            data = np.concatenate(parts_d)
+            vv = np.concatenate(parts_v)
+        else:
+            data = np.empty(0, ft.np_dtype)
+            vv = np.empty(0, bool)
+        cols.append(Column(ft, data.astype(ft.np_dtype),
+                           None if vv.all() else vv,
+                           snap.dictionaries[coff]))
+    inner = Chunk(cols)
+    li = np.concatenate(li_parts + ([ov_li] if ov_li is not None
+                                    and len(ov_li) else []))         if (li_parts or ov_li is not None) else np.empty(0, np.int64)
+    ri = np.arange(inner.num_rows)
+
+    # inner pushed-down filters (the scan's dag.selection)
+    if inner_tr.dag.selection is not None and inner.num_rows:
+        ev = _evaluator(inner)
+        mask = np.ones(inner.num_rows, bool)
+        for c in inner_tr.dag.selection.conditions:
+            v, vl = ev.eval(_subst_subq(c, ctx))
+            mask &= _truthy(np.asarray(v)) & vl
+        sel = np.nonzero(mask)[0]
+        inner = inner.take(sel)
+        keepm = mask[ri[: len(li)]] if len(li) else mask[:0]
+        li = li[keepm]
+        ri = np.arange(inner.num_rows)
+
+    if plan.other_conditions:
+        joined = _merge_chunks(outer.take(li), inner)
+        ev = _evaluator(joined)
+        mask = np.ones(len(li), dtype=bool)
+        for c in plan.other_conditions:
+            v, vl = ev.eval(_subst_subq(c, ctx))
+            mask &= _truthy(np.asarray(v)) & vl
+        li = li[mask]
+        inner = inner.take(np.nonzero(mask)[0])
+
+    if plan.kind == "SEMI":
+        return outer.take(np.unique(li))
+    return _merge_chunks(outer.take(li), inner)
+
+
+def _run_join(plan, ctx: ExecContext) -> Chunk:
     left = run_physical(plan.children[0], ctx)
     right = run_physical(plan.children[1], ctx)
     nleft = len(left.columns)
@@ -1380,20 +1518,36 @@ def _encode_join_keys(plan: PhysHashJoin, left: Chunk, right: Chunk):
             lvalid, rvalid)
 
 
-def _equi_match(plan: PhysHashJoin, left: Chunk, right: Chunk):
-    """Vectorized equi-join: unify key ids across sides, sort-merge expand."""
-    lstack, rstack, lvalid, rvalid = _encode_join_keys(plan, left, right)
-    all_keys = np.concatenate([lstack, rstack], axis=0)
-    _, inv = np.unique(all_keys, axis=0, return_inverse=True)
-    inv = inv.reshape(-1)
-    lids = np.where(lvalid, inv[: left.num_rows], -1)
-    rids = np.where(rvalid, inv[left.num_rows:], -2)
+def _equi_match(plan, left: Chunk, right: Chunk):
+    """Vectorized equi-join: sort-merge expand over unified key ids.
 
-    rorder = np.argsort(rids, kind="stable")
-    rsorted = rids[rorder]
+    Single-column keys skip the np.unique id-unification entirely (the
+    encoded int64 values are directly comparable — this is the sort-merge
+    join inner loop, reference: executor/merge_join.go); multi-column
+    keys unify via unique-row ids first."""
+    lstack, rstack, lvalid, rvalid = _encode_join_keys(plan, left, right)
+    if lstack.shape[1] == 1:
+        # NULL rows are excluded from the domains outright — no sentinel
+        # values that a real key could collide with
+        lids = lstack[:, 0]
+        rvalid_idx = np.nonzero(rvalid)[0]
+        rvals = rstack[rvalid_idx, 0]
+        ro = np.argsort(rvals, kind="stable")
+        rorder = rvalid_idx[ro]
+        rsorted = rvals[ro]
+        null_gate = lvalid
+    else:
+        all_keys = np.concatenate([lstack, rstack], axis=0)
+        _, inv = np.unique(all_keys, axis=0, return_inverse=True)
+        inv = inv.reshape(-1)
+        lids = np.where(lvalid, inv[: left.num_rows], -1)
+        rids = np.where(rvalid, inv[left.num_rows:], -2)
+        null_gate = lids >= 0
+        rorder = np.argsort(rids, kind="stable")
+        rsorted = rids[rorder]
     lo = np.searchsorted(rsorted, lids, side="left")
     hi = np.searchsorted(rsorted, lids, side="right")
-    counts = np.where(lids >= 0, hi - lo, 0)
+    counts = np.where(null_gate, hi - lo, 0)
     total = int(counts.sum())
     li = np.repeat(np.arange(left.num_rows), counts)
     starts = np.repeat(lo, counts)

@@ -112,6 +112,40 @@ class PhysHashJoin(PhysicalPlan):
 
 
 @dataclass
+class PhysIndexJoin(PhysicalPlan):
+    """Outer-driven index lookup join: per outer batch, probe the inner
+    table's lazy sorted-permutation index with the outer keys and gather
+    only the matching rows — no build of the full inner side (reference:
+    executor/index_lookup_join.go; chosen by cost like
+    planner/core/exhaust_physical_plans.go getIndexJoin when the outer is
+    far smaller than the indexed inner). children = [outer, inner scan];
+    the inner PhysTableRead is for EXPLAIN/stats — execution probes its
+    table's index directly."""
+
+    kind: str
+    eq_conditions: list[tuple[int, int]]   # [(outer idx, inner LOCAL idx)]
+    other_conditions: list[PlanExpr]
+    schema: PlanSchema
+    inner_offset: int = 0                  # store offset of the join col
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
+class PhysMergeJoin(PhysicalPlan):
+    """Sort-merge equi-join over key-ordered inputs (both sides join on
+    their PK handles, which the columnar epochs keep ordered) — no hash
+    table, a single searchsorted alignment (reference:
+    executor/merge_join.go; picked by exhaust_physical_plans.go when both
+    children provide the key order)."""
+
+    kind: str
+    eq_conditions: list[tuple[int, int]]
+    other_conditions: list[PlanExpr]
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+
+
+@dataclass
 class PhysUnion(PhysicalPlan):
     """UNION ALL: run children, normalize each child's columns to the
     unified schema (scale/width/dictionary), concatenate (reference:
@@ -483,8 +517,18 @@ def optimize(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     phys = _to_physical(plan, stats)
     from .fragment import apply_fragments
     phys = apply_fragments(phys)
+    # joins the device fragment rewriter left on the host pick their
+    # algorithm by cost (hash / index-lookup / merge)
+    phys = apply_join_algorithms(phys)
     _optimize_subqueries(phys, stats)
     return phys
+
+
+def apply_join_algorithms(plan: PhysicalPlan) -> PhysicalPlan:
+    plan.children = [apply_join_algorithms(c) for c in plan.children]
+    if isinstance(plan, PhysHashJoin):
+        return _choose_join(plan, plan.children[0], plan.children[1])
+    return plan
 
 
 def _optimize_subqueries(plan: PhysicalPlan, stats=None) -> None:
@@ -720,7 +764,12 @@ def _est_selection_rows(table, scan_offsets: list[int],
 
 def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
     if isinstance(plan, LogicalScan):
-        return _fresh_table_read(plan)
+        tr = _fresh_table_read(plan)
+        ts = stats.table_stats(plan.table.id) if stats is not None \
+            else None
+        if ts is not None:
+            tr.est_rows = float(ts.row_count)
+        return tr
 
     if isinstance(plan, LogicalSelection):
         child = _to_physical(plan.children[0], stats)
@@ -860,9 +909,95 @@ def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
         left = _to_physical(plan.children[0], stats)
         right = _to_physical(plan.children[1], stats)
         return PhysHashJoin(plan.kind, plan.eq_conditions,
-                            plan.other_conditions, plan.schema, [left, right])
+                            plan.other_conditions, plan.schema,
+                            [left, right])
 
     raise TypeError(f"optimize: unknown node {type(plan).__name__}")
+
+
+# outer side must be this much smaller (and absolutely small) before
+# an index probe beats building one hash of the inner
+_INDEX_JOIN_RATIO = 32
+_INDEX_JOIN_MAX_OUTER = 200_000
+
+
+def _join_col_index(table, off: int) -> bool:
+    """Does the inner table have a usable single-column index (or the PK
+    handle) on store offset `off`?"""
+    if table.pk_handle_offset == off:
+        return True
+    for ix in table.indices:
+        if ix.visible and ix.col_offsets == [off]:
+            return True
+    return False
+
+
+def _bare_inner_scan(node) -> bool:
+    return (isinstance(node, PhysTableRead)
+            and getattr(node, "table", None) is not None
+            and node.dag.agg is None and node.dag.topn is None
+            and node.dag.limit is None and node.dag.scan.ranges is None
+            and node.dag.projections is None)
+
+
+def _choose_join(plan: PhysHashJoin, left, right):
+    """Cost-based physical join selection (reference:
+    planner/core/exhaust_physical_plans.go): index-lookup join when one
+    side is a bare indexed scan and the other side is much smaller;
+    merge join when both sides arrive ordered on their join keys (PK
+    handles); hash join otherwise. Runs AFTER the device-fragment
+    rewriter — only host-remaining joins choose an algorithm."""
+    hash_join = plan
+    if len(plan.eq_conditions) != 1:
+        return hash_join
+
+    def est(node):
+        return getattr(node, "est_rows", None)
+
+    # ---- merge join: both sides PK-ordered on the join key ----
+    if plan.kind == "INNER" and _bare_inner_scan(left) and \
+            _bare_inner_scan(right):
+        # LogicalJoin eq pairs are (left idx, right-LOCAL idx)
+        li, ri = plan.eq_conditions[0]
+        l_off = left.dag.scan.col_offsets[li] if li < len(
+            left.dag.scan.col_offsets) else None
+        r_off = right.dag.scan.col_offsets[ri] \
+            if ri < len(right.dag.scan.col_offsets) else None
+        if l_off == left.table.pk_handle_offset and \
+                r_off == right.table.pk_handle_offset and \
+                l_off is not None and r_off is not None:
+            return PhysMergeJoin(plan.kind, plan.eq_conditions,
+                                 plan.other_conditions, plan.schema,
+                                 [left, right])
+
+    # ---- index join: inner is a bare indexed scan, outer is small ----
+    if plan.kind in ("INNER", "SEMI"):
+        oi, ii = plan.eq_conditions[0]
+        inner, outer = right, left
+        if _bare_inner_scan(inner) and ii < len(
+                inner.dag.scan.col_offsets):
+            off = inner.dag.scan.col_offsets[ii]
+            ft = inner.dag.output_types[ii]
+            # BOTH key types must be integral: the probe casts outer
+            # keys to int64, which would silently truncate float or
+            # misread scaled-decimal keys
+            oft = outer.schema.fields[oi].ftype \
+                if oi < len(outer.schema.fields) else None
+            o_est, i_est = est(outer), est(inner)
+            if oft is not None and oft.kind in _INT_JOIN_KINDS and \
+                    ft.kind in _INT_JOIN_KINDS and \
+                    _join_col_index(inner.table, off) and \
+                    o_est is not None and i_est is not None and \
+                    o_est < _INDEX_JOIN_MAX_OUTER and \
+                    o_est * _INDEX_JOIN_RATIO < i_est:
+                return PhysIndexJoin(plan.kind, plan.eq_conditions,
+                                     plan.other_conditions, plan.schema,
+                                     off, [outer, inner])
+    return hash_join
+
+
+_INT_JOIN_KINDS = (TypeKind.TINYINT, TypeKind.SMALLINT, TypeKind.INT,
+                   TypeKind.BIGINT, TypeKind.YEAR)
 
 
 def _partial_val_type(d: AggDesc) -> FieldType:
@@ -914,6 +1049,11 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
         line = f"{pad}Limit: {plan.limit} offset {plan.offset}"
     elif isinstance(plan, PhysHashJoin):
         line = f"{pad}HashJoin({plan.kind}): eq={plan.eq_conditions}"
+    elif isinstance(plan, PhysIndexJoin):
+        line = (f"{pad}IndexJoin({plan.kind}): eq={plan.eq_conditions} "
+                f"inner_offset={plan.inner_offset}")
+    elif isinstance(plan, PhysMergeJoin):
+        line = f"{pad}MergeJoin({plan.kind}): eq={plan.eq_conditions}"
     elif isinstance(plan, PhysUnion):
         line = f"{pad}Union: {len(plan.children)} children"
     elif isinstance(plan, PhysWindow):

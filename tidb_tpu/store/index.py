@@ -67,6 +67,34 @@ def epoch_index_order(store: TableStore, epoch, index: IndexInfo) -> np.ndarray:
     return order
 
 
+def epoch_column_order(store: TableStore, epoch, off: int
+                       ) -> tuple[np.ndarray, int]:
+    """(sorted permutation, start) for a single column: NULL rows sort
+    first, `start` is the index of the first non-NULL position, so
+    data[order[start:]] is monotone. Cached per (epoch, column) beside
+    the index orders (same eviction policy)."""
+    cache = store._index_orders
+    key = (epoch.epoch_id, ("col", off))
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    data = epoch.columns[off]
+    valid = epoch.valids[off]
+    if valid is None:
+        order = np.argsort(data, kind="stable")
+        start = 0
+    else:
+        order = np.lexsort((data, valid))
+        start = int(np.searchsorted(valid[order], True, "left"))
+    if len(cache) >= 32:
+        live = store.epoch.epoch_id
+        for k in list(cache):
+            if k[0] != live and k != key:
+                del cache[k]
+    cache[key] = (order, start)
+    return order, start
+
+
 def probe_and_gather(snap: TableSnapshot, ranges,
                      col_offsets: list[int]):
     """Resolve a ScanRanges' point set to visible handles and gather those
