@@ -41,8 +41,19 @@ import numpy as np
 ROWS_PER_SF = 6_001_215
 
 
+def _rss_gb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS"):
+                    return int(ln.split()[1]) / 1e6
+    except OSError:
+        pass
+    return 0.0
+
+
 def log(msg: str) -> None:
-    print(f"# {msg}", file=sys.stderr, flush=True)
+    print(f"# [rss={_rss_gb():.1f}G] {msg}", file=sys.stderr, flush=True)
 
 
 def interpreted_q6_baseline(arrays, sample: int = 200_000) -> float:
@@ -285,8 +296,9 @@ def main() -> None:
     lines.append(f"q6 concurrent throughput ({n_clients} clients): "
                  f"{tput / 1e6:.1f}M rows/s "
                  f"({tput / baseline_rps:.1f}x the interpreted baseline)")
-    del session, arrays
+    del session, arrays, throughput  # noqa: F821 - drop the closure too
     gc.collect()
+    log("sf10 flight freed")
 
     # ---- 2. TPC-H join corpus at join_sf ----
     from tidb_tpu.bench.tpch_data import generate_tpch, load_table
@@ -300,6 +312,7 @@ def main() -> None:
     jrows = len(jdata["lineitem"]["l_orderkey"])
     log(f"tpch join corpus sf{join_sf:g}: gen+load="
         f"{time.perf_counter() - t0:.0f}s ({jrows} lineitem rows)")
+    log("join corpus loaded; computing oracles")
     want3 = q3_oracle(jdata)
     got3 = [(int(r[0]), r[1].unscaled) for r in js.query(
         TPCH_QUERIES["q3"])]
@@ -311,6 +324,7 @@ def main() -> None:
         nnames, jdata["nation"]["n_nationkey"])}
     got5 = {nat_by_name[name]: v for name, v in got5.items()}
     assert got5 == want5, f"q5 digest: {got5} vs {want5}"
+    log("join digests OK; timing q3/q5")
     q3_ts = times(lambda: js.query(TPCH_QUERIES["q3"]), repeat)
     q5_ts = times(lambda: js.query(TPCH_QUERIES["q5"]), repeat)
     l3, q3_rps = report(f"q3_sf{join_sf:g}", q3_ts, jrows)

@@ -1,0 +1,152 @@
+"""ANALYZE pushdown: full-column statistics as device reduction kernels.
+
+The reference pushes ANALYZE to the store as sample collectors + FM
+sketches per region (reference: executor/analyze.go,
+statistics/fmsketch.go, distsql/distsql.go:137 Analyze); only histogram
+assembly happens centrally. The TPU analog (SURVEY §2.3 P13): one fused
+reduction kernel per column batch over the SAME shape-bucketed tiles the
+query path stages (cached device columns are reused), producing
+
+  * non-null row count,
+  * min / max,
+  * 64 HLL-style registers from a 32-bit splitmix hash (the device is
+    64-bit-free) — the NDV estimator that replaces a host np.unique over
+    the full column.
+
+Histograms and CM sketches still build host-side from a bounded SAMPLE
+(statistics/builder.go builds histograms from samples in the reference
+too); the device pass removes the full-column host scans that dominate
+ANALYZE wall time at SF10+.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_REG = 64          # HLL registers
+_REG_BITS = 6
+
+# splitmix32-style avalanche (device-side; uint32 lanes)
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+
+def _hash32(x):
+    h = x.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(_M1)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(_M2)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash32_host(x: np.ndarray) -> np.ndarray:
+    """Host twin of the device hash (sketches built on either side must
+    agree)."""
+    with np.errstate(over="ignore"):
+        h = x.astype(np.uint32)
+        h ^= h >> 16
+        h *= _M1
+        h ^= h >> 13
+        h *= _M2
+        h ^= h >> 16
+    return h
+
+
+def _column_partials(data, valid):
+    """Reduction body for one staged column (int32/f32 + validity)."""
+    v32 = data.astype(jnp.int32) if data.dtype in (
+        jnp.int8, jnp.int16, jnp.int32) else data
+    cnt = jnp.sum(valid.astype(jnp.int32))
+    if v32.dtype == jnp.float32:
+        big = jnp.float32(np.inf)
+        mn = jnp.min(jnp.where(valid, v32, big))
+        mx = jnp.max(jnp.where(valid, v32, -big))
+    else:
+        big = jnp.int32(2**31 - 1)
+        mn = jnp.min(jnp.where(valid, v32, big))
+        mx = jnp.max(jnp.where(valid, v32, -big - 1))
+    # HLL registers over a 32-bit hash: bucket = low 6 bits, rank =
+    # trailing zeros of the remaining bits + 1 (isolated low bit is a
+    # power of two -> exact f32 log2)
+    hsrc = jax.lax.bitcast_convert_type(v32, jnp.int32) \
+        if v32.dtype == jnp.float32 else v32
+    h = _hash32(hsrc)
+    bucket = (h & jnp.uint32(N_REG - 1)).astype(jnp.int32)
+    rest = (h >> _REG_BITS) | jnp.uint32(1 << (32 - _REG_BITS))
+    low = rest & (~rest + jnp.uint32(1))
+    rank = jnp.log2(low.astype(jnp.float32)).astype(jnp.int32) + 1
+    rank = jnp.where(valid, rank, 0)
+    regs = jnp.stack([
+        jnp.max(jnp.where(bucket == b, rank, 0)) for b in range(N_REG)])
+    return {"cnt": cnt, "mn": mn, "mx": mx, "regs": regs}
+
+
+def _merge(parts: list[dict]) -> dict:
+    out = dict(parts[0])
+    for p in parts[1:]:
+        out["cnt"] = out["cnt"] + p["cnt"]
+        out["mn"] = np.minimum(out["mn"], p["mn"])
+        out["mx"] = np.maximum(out["mx"], p["mx"])
+        out["regs"] = np.maximum(out["regs"], p["regs"])
+    return out
+
+
+def hll_ndv(regs: np.ndarray, nonnull: float) -> int:
+    """Standard HLL estimate with small-range correction."""
+    m = float(N_REG)
+    regs = np.asarray(regs, dtype=np.float64)
+    est = 0.709 * m * m / np.sum(np.exp2(-regs))
+    zeros = float((regs == 0).sum())
+    if est <= 2.5 * m and zeros > 0:
+        est = m * np.log(m / zeros)
+    return max(1, min(int(round(est)), int(nonnull)))
+
+
+def device_column_stats(cop, snap, offsets: list[int]):
+    """off -> (nonnull_count, min, max, ndv) via one kernel per tile,
+    reusing the query path's cached tile staging. Columns whose staged
+    width cannot represent the values (host int64 beyond int32) are
+    skipped — the caller falls back to host stats for those."""
+    from ..plan.dag import CopDAG, DAGScan
+
+    usable = []
+    for off in offsets:
+        d = snap.epoch.columns[off]
+        if d.dtype == np.int64:
+            b = cop._col_stats(snap, off)
+            if b is None or b[0] < -(2**31) or b[1] >= 2**31:
+                continue
+        usable.append(off)
+    if not usable:
+        return {}
+    dag = CopDAG(scan=DAGScan(snap.store.table.id, usable))
+    tiles = cop._stage_tiles(dag, snap)
+    bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
+
+    def build():
+        def kernel(d, v, vis):
+            from .client import widen32
+            (d, v), = widen32([(d, v)])
+            return _column_partials(d, v & vis)
+        return jax.jit(kernel)
+
+    # one kernel per (dtype, bucket) — shared across all columns of that
+    # width, so the first ANALYZE compiles a handful of tiny programs
+    devs = []
+    for ci in range(len(usable)):
+        dt = str(tiles[0][0][ci][0].dtype)
+        kern = cop._kernel(("analyze", dt, bucket), build)
+        devs.append([kern(cols[ci][0], cols[ci][1], vis)
+                     for cols, vis, _ in tiles])
+    outs = jax.device_get(devs)
+    result = {}
+    for ci, off in enumerate(usable):
+        p = _merge(list(outs[ci]))
+        nonnull = float(p["cnt"])
+        result[off] = (nonnull, p["mn"], p["mx"],
+                       hll_ndv(p["regs"], nonnull) if nonnull else 0)
+    return result

@@ -840,7 +840,8 @@ class Session:
         for tn in stmt.tables:
             info, _ = self._table_for(tn)
             for child, store in self._partition_children(info):
-                self.storage.stats.analyze_one(child, store, self.storage)
+                self.storage.stats.analyze_one(child, store, self.storage,
+                                               cop=self.cop)
         return ResultSet([], [])
 
     # ==================== txn plumbing ====================

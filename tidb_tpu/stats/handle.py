@@ -128,11 +128,27 @@ class StatsHandle:
         self.feedback: dict[tuple[int, str], float] = {}
 
     # ---- build ------------------------------------------------------------
-    def build_table(self, info: TableInfo, snap) -> TableStats:
+    # full-column device reductions replace the host scans above this
+    # many rows (ANALYZE pushdown; copr/analyze.py)
+    DEVICE_ANALYZE_MIN = 2_000_000
+
+    def build_table(self, info: TableInfo, snap, cop=None) -> TableStats:
         """ANALYZE: build stats from a snapshot's visible rows
-        (reference: executor/analyze.go over pushdown sample collectors)."""
+        (reference: executor/analyze.go over pushdown sample collectors).
+        With a coprocessor client and a big table, the full-column pass
+        (counts, min/max, NDV) runs as device reduction kernels over the
+        query path's tiles; histograms/CM build from a host sample."""
         n = snap.num_visible_rows
         rng = np.random.default_rng(info.id)
+        dev_stats = {}
+        if cop is not None and n >= self.DEVICE_ANALYZE_MIN and \
+                len(snap.overlay_handles) == 0:
+            try:
+                from ..copr.analyze import device_column_stats
+                dev_stats = device_column_stats(
+                    cop, snap, list(range(info.num_columns)))
+            except Exception:
+                dev_stats = {}  # any device issue -> host path
         cols: dict[int, ColumnStats] = {}
         for off in range(info.num_columns):
             col = snap.column(off)
@@ -148,7 +164,10 @@ class StatsHandle:
             if not ft.is_string and len(nn):
                 hist = Histogram.build(nn, scale)
             cm = CMSketch.build(nn, scale) if len(nn) else None
-            if scale == 1.0:
+            if off in dev_stats:
+                nonnull, _mn, _mx, ndv = dev_stats[off]
+                null_count = float(n - nonnull)
+            elif scale == 1.0:
                 ndv = (int(len(np.unique(nn))) if len(nn) <= FMSketch.MAX_SIZE
                        * 16 else FMSketch.build(nn).ndv)
             else:
@@ -168,12 +187,13 @@ class StatsHandle:
         self.tables[info.id] = ts
         return ts
 
-    def analyze_one(self, info: TableInfo, store, storage) -> TableStats:
+    def analyze_one(self, info: TableInfo, store, storage,
+                    cop=None) -> TableStats:
         """Analyze one table from a fresh snapshot and record the modify
         watermark — shared by ANALYZE TABLE and auto-analyze."""
         txn = storage.begin()
         try:
-            ts = self.build_table(info, txn.snapshot(info.id))
+            ts = self.build_table(info, txn.snapshot(info.id), cop=cop)
             self.generation += 1  # invalidates cached plans (cache key)
             self._analyzed_at_modify[info.id] = store.modify_count
             # fresh stats supersede stale observation feedback
