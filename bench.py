@@ -1,44 +1,74 @@
 """Benchmark board: TPC-H (SF10 + SF100), SSB, ClickBench-style configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — the
-headline is TPC-H Q6 at the north-star SF100 scale (BASELINE.json
-metric: "TPC-H rows/sec/chip; Q1+Q6 p50 latency at SF100").
+Prints the headline JSON line {"metric", "value", "unit", "vs_baseline"}
+to stdout — INCREMENTALLY: once after every completed flight (latest line
+supersedes earlier ones), so a later flight's failure can never erase the
+board. The headline is TPC-H Q6 at the north-star SF100 scale
+(BASELINE.json metric: "TPC-H rows/sec/chip; Q1+Q6 p50 latency at SF100").
+
+Isolation: each flight runs in its OWN SUBPROCESS. The parent holds only
+numpy and a few MB; a flight that exhausts RAM is the biggest process on
+the box, so the OOM killer takes the flight, not the board (round 4
+lesson: one in-process SSB SF100 flight OOM-killed the whole board,
+BENCH_r04.json rc=137). Flights auto-scale their dataset to MemAvailable.
 
 Comparison basis (BASELINE.md): the reference publishes no absolute
 numbers in-repo and its Go toolchain isn't present here, so the floor is
-a row-at-a-time interpreted coprocessor baseline measured in-process —
-the execution model of the reference's mocktikv interpreter (reference:
+a COMPILED (C++ -O3) row-at-a-time Q6 loop over row-major storage — the
+execution model of the reference's mocktikv interpreter (reference:
 store/mockstore/mocktikv/cop_handler_dag.go:150, row loop over MVCC
-pairs) — timed on a sample and scaled. BOTH sides of the headline ratio
-are SINGLE-STREAM.
+pairs) without its per-row decode overhead, i.e. a conservative floor
+(native/baseline.cpp). The old Python row-loop baseline is still measured
+and reported for series continuity with BENCH_r01..r04. BOTH sides of
+the headline ratio are SINGLE-STREAM.
 
 Configs (BASELINE.json configs[0..4] + the r04 join target):
   q6_sf10 / q1_sf10     — scan flight at SF10 (series continuity)
-  q3_sf10 / q5_sf10     — snowflake join fragments at SF10 (digest vs
-                          exact numpy oracle; plan verified vs sqlite at
-                          SF0.1 by the test suite)
+  q6_sf100 / q1_sf100   — the north star (BENCH_SF_BIG, default 100)
+  q3_sf10 / q5_sf10     — snowflake join fragments at SF10
   ssb q1.1-1.3          — SSB flight at BENCH_SSB_SF (default 100)
   cb_*                  — ClickBench-style wide scan/TopN at
                           BENCH_CB_ROWS (default 100M)
-  q6_sf100 / q1_sf100   — the north star (BENCH_SF_BIG, default 100)
 
 Every timed query passes an exact digest check against a numpy oracle
 first. Environment knobs: BENCH_SF (10), BENCH_JOIN_SF (10),
 BENCH_SSB_SF (100), BENCH_CB_ROWS (1e8), BENCH_SF_BIG (100),
-BENCH_REPEAT (5), BENCH_CLIENTS (8), BENCH_PLATFORM.
+BENCH_REPEAT (5), BENCH_CLIENTS (8), BENCH_PLATFORM,
+BENCH_FLIGHT_TIMEOUT (5400s), BENCH_RAM_FRACTION (0.75),
+BENCH_FLIGHTS (comma list to run a subset).
 """
 
 from __future__ import annotations
 
-import gc
+import ctypes
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
 
 ROWS_PER_SF = 6_001_215
+
+# lineitem physical column order (matches bench.tpch.LINEITEM_DDL)
+_LI_COLS = [
+    "l_orderkey", "l_partkey", "l_suppkey", "l_linenumber", "l_quantity",
+    "l_extendedprice", "l_discount", "l_tax", "l_returnflag",
+    "l_linestatus", "l_shipdate", "l_commitdate", "l_receiptdate",
+]
+
+
+def _meminfo_gb(field: str) -> float:
+    try:
+        with open("/proc/meminfo") as f:
+            for ln in f:
+                if ln.startswith(field):
+                    return int(ln.split()[1]) / 1e6
+    except OSError:
+        pass
+    return 0.0
 
 
 def _rss_gb() -> float:
@@ -56,9 +86,89 @@ def log(msg: str) -> None:
     print(f"# [rss={_rss_gb():.1f}G] {msg}", file=sys.stderr, flush=True)
 
 
+# ---------------------------------------------------------------------------
+# Baselines (parent-side: numpy + ctypes only, no jax import)
+# ---------------------------------------------------------------------------
+
+def _load_baseline_lib():
+    so = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "native", "libbaseline.so")
+    try:  # no-op when fresh; rebuilds after baseline.cpp edits
+        subprocess.run(["make", "-C", os.path.dirname(so), "libbaseline.so"],
+                       check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, OSError):
+        if not os.path.exists(so):
+            raise
+    lib = ctypes.CDLL(so)
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.q6_kv_rowloop.restype = ctypes.c_double
+    lib.q6_kv_rowloop.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+    lib.q6_columnar_rowloop.restype = ctypes.c_double
+    lib.q6_columnar_rowloop.argtypes = [
+        i64p, i64p, i64p, i64p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
+    lib.q1_kv_rowloop.restype = ctypes.c_double
+    lib.q1_kv_rowloop.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")]
+    return lib
+
+
+def compiled_baselines(arrays, sample: int = 6_000_000):
+    """(q6_kv_rps, q6_columnar_rps, q1_kv_rps) from native/baseline.cpp,
+    median of 3 runs each over a `sample`-row prefix. The q6 kv variant
+    is the vs_baseline denominator: a compiled row-loop over row-major
+    rows, the mocktikv execution model (cop_handler_dag.go:150) minus
+    its decode cost — i.e. a floor that flatters the reference."""
+    from tidb_tpu.types.value import parse_date
+
+    lib = _load_baseline_lib()
+    n = min(sample, len(arrays["l_shipdate"]))
+    rows = np.empty((n, len(_LI_COLS)), dtype=np.int64)
+    for i, c in enumerate(_LI_COLS):
+        rows[:, i] = arrays[c][:n]
+    ship, disc = _LI_COLS.index("l_shipdate"), _LI_COLS.index("l_discount")
+    qty, price = _LI_COLS.index("l_quantity"), _LI_COLS.index(
+        "l_extendedprice")
+    d1, d2 = parse_date("1994-01-01"), parse_date("1995-01-01")
+    out = ctypes.c_int64()
+    want = q6_oracle({k: arrays[k][:n] for k in (
+        "l_shipdate", "l_discount", "l_quantity", "l_extendedprice")})
+    kv = sorted(lib.q6_kv_rowloop(rows, n, len(_LI_COLS), ship, disc, qty,
+                                  price, d1, d2, ctypes.byref(out))
+                for _ in range(3))[1]
+    assert out.value == want, "compiled kv baseline digest"
+    cship = np.ascontiguousarray(arrays["l_shipdate"][:n])
+    cdisc = np.ascontiguousarray(arrays["l_discount"][:n])
+    cqty = np.ascontiguousarray(arrays["l_quantity"][:n])
+    cprice = np.ascontiguousarray(arrays["l_extendedprice"][:n])
+    col = sorted(lib.q6_columnar_rowloop(cship, cdisc, cqty, cprice, n,
+                                         d1, d2, ctypes.byref(out))
+                 for _ in range(3))[1]
+    assert out.value == want, "compiled columnar baseline digest"
+    cutoff = parse_date("1998-12-01") - 90
+    acc = np.zeros(30, dtype=np.int64)
+    q1 = sorted(lib.q1_kv_rowloop(
+        rows, n, len(_LI_COLS), ship, _LI_COLS.index("l_returnflag"),
+        _LI_COLS.index("l_linestatus"), qty, price, disc,
+        _LI_COLS.index("l_tax"), cutoff, acc) for _ in range(3))[1]
+    w1 = q1_oracle({k: arrays[k][:n] for k in (
+        "l_shipdate", "l_returnflag", "l_linestatus", "l_quantity",
+        "l_extendedprice", "l_discount", "l_tax")})
+    got1 = {(k // 2, k % 2): tuple(int(v) for v in acc[k * 5:k * 5 + 5])
+            for k in range(6) if acc[k * 5 + 4]}
+    assert got1 == w1, "compiled q1 baseline digest"
+    return n / kv, n / col, n / q1
+
+
 def interpreted_q6_baseline(arrays, sample: int = 200_000) -> float:
-    """Row-at-a-time interpreted Q6 (mocktikv-style) rows/sec, median of
-    3 (single passes are noisy and the ratio inherits it)."""
+    """Row-at-a-time *Python* interpreted Q6 rows/sec (median of 3) —
+    the BENCH_r01..r04 denominator, kept for series continuity."""
     from tidb_tpu.types.value import parse_date
 
     n = min(sample, len(arrays["l_shipdate"]))
@@ -81,42 +191,55 @@ def interpreted_q6_baseline(arrays, sample: int = 200_000) -> float:
     return sorted(rates)[1]
 
 
+# ---------------------------------------------------------------------------
+# Oracles (chunked: SF100 masks of 600M rows must not clone the table)
+# ---------------------------------------------------------------------------
+
 def q6_oracle(arrays) -> int:
     from tidb_tpu.types.value import parse_date
 
     d1, d2 = parse_date("1994-01-01"), parse_date("1995-01-01")
-    m = ((arrays["l_shipdate"] >= d1) & (arrays["l_shipdate"] < d2)
-         & (arrays["l_discount"] >= 5) & (arrays["l_discount"] <= 7)
-         & (arrays["l_quantity"] < 2400))
-    return int((arrays["l_extendedprice"][m].astype(np.int64)
-                * arrays["l_discount"][m]).sum())
+    total, n = 0, len(arrays["l_shipdate"])
+    for lo in range(0, n, 50_000_000):
+        sl = slice(lo, min(lo + 50_000_000, n))
+        ship = arrays["l_shipdate"][sl]
+        m = ((ship >= d1) & (ship < d2)
+             & (arrays["l_discount"][sl] >= 5)
+             & (arrays["l_discount"][sl] <= 7)
+             & (arrays["l_quantity"][sl] < 2400))
+        total += int((arrays["l_extendedprice"][sl][m].astype(np.int64)
+                      * arrays["l_discount"][sl][m]).sum())
+    return total
 
 
 def q1_oracle(arrays):
-    """Exact int64 aggregates per (returnflag, linestatus) group."""
+    """Exact int64 aggregates per (returnflag, linestatus) group,
+    computed in 50M-row chunks (SF100: a 98%-selective mask must not
+    materialise masked copies of the whole table)."""
     from tidb_tpu.types.value import parse_date
 
     cutoff = parse_date("1998-12-01") - 90
-    m = arrays["l_shipdate"] <= cutoff
-    rf = arrays["l_returnflag"][m]
-    ls = arrays["l_linestatus"][m]
-    qty = arrays["l_quantity"][m].astype(np.int64)
-    ext = arrays["l_extendedprice"][m].astype(np.int64)
-    disc = arrays["l_discount"][m].astype(np.int64)
-    tax = arrays["l_tax"][m].astype(np.int64)
-    key = rf * 2 + ls
-    out = {}
-    for name, vals in (("qty", qty), ("base", ext),
-                       ("disc_price", ext * (100 - disc)),
-                       ("charge", ext * (100 - disc) * (100 + tax)),
-                       ("count", np.ones(len(key), np.int64))):
-        acc = np.zeros(6, dtype=np.int64)
-        np.add.at(acc, key, vals)
-        out[name] = acc
+    n = len(arrays["l_shipdate"])
+    acc = {name: np.zeros(6, dtype=np.int64)
+           for name in ("qty", "base", "disc_price", "charge", "count")}
+    for lo in range(0, n, 50_000_000):
+        sl = slice(lo, min(lo + 50_000_000, n))
+        m = arrays["l_shipdate"][sl] <= cutoff
+        key = arrays["l_returnflag"][sl][m] * 2 + \
+            arrays["l_linestatus"][sl][m]
+        qty = arrays["l_quantity"][sl][m].astype(np.int64)
+        ext = arrays["l_extendedprice"][sl][m].astype(np.int64)
+        disc = arrays["l_discount"][sl][m].astype(np.int64)
+        tax = arrays["l_tax"][sl][m].astype(np.int64)
+        for name, vals in (("qty", qty), ("base", ext),
+                           ("disc_price", ext * (100 - disc)),
+                           ("charge", ext * (100 - disc) * (100 + tax)),
+                           ("count", np.ones(len(key), np.int64))):
+            np.add.at(acc[name], key, vals)
     res = {}
     for k in range(6):
-        if out["count"][k]:
-            res[(k // 2, k % 2)] = tuple(int(out[n][k]) for n in (
+        if acc["count"][k]:
+            res[(k // 2, k % 2)] = tuple(int(acc[nm][k]) for nm in (
                 "qty", "base", "disc_price", "charge", "count"))
     return res
 
@@ -199,6 +322,10 @@ def q5_oracle(jdata):
     return {int(k): int(rev[k]) for k in np.nonzero(rev)[0]}
 
 
+# ---------------------------------------------------------------------------
+# Flight harness
+# ---------------------------------------------------------------------------
+
 def times(run, repeat) -> list[float]:
     run()  # warm
     ts = []
@@ -217,19 +344,56 @@ def report(name, ts, rows) -> tuple[str, float]:
     return line, rows / p50
 
 
-def main() -> None:
-    sf = float(os.environ.get("BENCH_SF", 10))
-    join_sf = float(os.environ.get("BENCH_JOIN_SF", 10))
-    ssb_sf = float(os.environ.get("BENCH_SSB_SF", 100))
-    cb_rows = int(float(os.environ.get("BENCH_CB_ROWS", 1e8)))
-    sf_big = float(os.environ.get("BENCH_SF_BIG", 100))
-    repeat = int(os.environ.get("BENCH_REPEAT", 5))
-    n_clients = int(os.environ.get("BENCH_CLIENTS", 8))
+def _scale_to_ram(requested_rows: int, bytes_per_row: float,
+                  label: str, lines: list[str]) -> int:
+    """Cap a dataset to MemAvailable * BENCH_RAM_FRACTION."""
+    frac = float(os.environ.get("BENCH_RAM_FRACTION", 0.75))
+    avail = _meminfo_gb("MemAvailable") * 1e9
+    cap = int(avail * frac / bytes_per_row)
+    if cap <= 0:  # /proc/meminfo unreadable: unknown, keep requested
+        return requested_rows
+    if requested_rows > cap:
+        lines.append(
+            f"{label}: auto-scaled {requested_rows} -> {cap} rows "
+            f"(MemAvailable={avail / 1e9:.0f}GB x {frac} / "
+            f"{bytes_per_row:.0f}B/row)")
+        return cap
+    return requested_rows
+
+
+def _session_env():
+    """Flight-local engine setup: quiet the slow log (it drowned the
+    r04 board's output tail), pick the platform."""
+    import logging
+
+    logging.getLogger("tidb_tpu.slowlog").setLevel(logging.ERROR)
     platform = os.environ.get("BENCH_PLATFORM")
     if platform:
         import jax
         jax.config.update("jax_platforms", platform)
 
+
+def _hbm_line(name: str, p50: float, n: int, col_bytes: float) -> str:
+    """Estimated device bytes touched per pass vs nominal HBM bandwidth.
+    col_bytes = per-row data bytes at staged (narrowed) widths; each
+    staged column also carries a 1-byte validity lane + one shared
+    visibility lane."""
+    import jax
+
+    bw = {"tpu": 819e9}.get(jax.default_backend())  # v5e: ~819 GB/s
+    touched = n * col_bytes
+    line = (f"{name}: ~{touched / p50 / 1e9:.0f} GB/s device scan "
+            f"({touched / 1e9:.1f}GB staged bytes / {p50 * 1e3:.1f}ms)")
+    if bw:
+        line += f" = {touched / p50 / bw * 100:.0f}% of nominal HBM bw"
+    return line
+
+
+# ---------------------------------------------------------------------------
+# Flights (each runs in its own subprocess)
+# ---------------------------------------------------------------------------
+
+def flight_tpch(res: dict, big: bool) -> None:
     from tidb_tpu.bench.tpch import (
         TPCH_Q1,
         TPCH_Q6,
@@ -238,29 +402,54 @@ def main() -> None:
     )
     from tidb_tpu.session import Session
 
-    lines: list[str] = []
-
-    # ---- 1. TPC-H SF10 scan flight + interpreted baseline ----
-    n10 = int(ROWS_PER_SF * sf)
+    _session_env()
+    lines = res["lines"]
+    sf = float(os.environ.get("BENCH_SF_BIG", 100)) if big else \
+        float(os.environ.get("BENCH_SF", 10))
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
+    # 13 int64 columns adopted zero-copy by bulk_load + ~35B/row of
+    # generator/oracle transients
+    n = _scale_to_ram(int(ROWS_PER_SF * sf), 140.0, f"tpch sf{sf:g}",
+                      lines)
+    sf_label = f"sf{sf:g}" if n == int(ROWS_PER_SF * sf) else \
+        f"sf{n / ROWS_PER_SF:.0f}"
     t0 = time.perf_counter()
-    arrays = generate_lineitem_arrays(n10)
+    arrays = generate_lineitem_arrays(n)
     gen_s = time.perf_counter() - t0
     session = Session()
     t0 = time.perf_counter()
-    load_lineitem(session, n10, arrays=arrays)
-    log(f"tpch sf{sf:g}: gen={gen_s:.0f}s load="
-        f"{time.perf_counter() - t0:.0f}s")
-    baseline_rps = interpreted_q6_baseline(arrays)
+    load_lineitem(session, n, arrays=arrays)
+    log(f"tpch {sf_label}: gen={gen_s:.0f}s "
+        f"load={time.perf_counter() - t0:.0f}s ({n} rows)")
+    if not big:
+        res["values"]["py_baseline"] = interpreted_q6_baseline(arrays)
     got = session.query(TPCH_Q6)[0][0]
     assert got is not None and got.unscaled == q6_oracle(arrays), "q6"
     check_q1(session.query(TPCH_Q1), arrays)
+    log("digests OK; timing")
     q6_ts = times(lambda: session.query(TPCH_Q6), repeat)
     q1_ts = times(lambda: session.query(TPCH_Q1), repeat)
-    l6, q6_sf10_rps = report(f"q6_sf{sf:g}", q6_ts, n10)
-    l1, _ = report(f"q1_sf{sf:g}", q1_ts, n10)
+    l6, q6_rps = report(f"q6_{sf_label}", q6_ts, n)
+    l1, q1_rps = report(f"q1_{sf_label}", q1_ts, n)
     lines += [l6, l1]
+    res["values"][f"q6_{'big' if big else 'small'}"] = q6_rps
+    res["values"][f"q1_{'big' if big else 'small'}"] = q1_rps
+    res["values"]["rows_" + ("big" if big else "small")] = n
+    if big:
+        # staged widths (client._narrow_stats): shipdate int16, discount
+        # int8, quantity int16, extendedprice int32 (+1B valid lane each,
+        # +1B shared visibility)
+        lines.append(_hbm_line(f"q6_{sf_label}", q6_ts[len(q6_ts) // 2],
+                               n, (2 + 1 + 2 + 4) + 4 + 1))
+        # q1 staged widths: shipdate/quantity int16, extendedprice int32,
+        # returnflag/linestatus/discount/tax int8 (+7 valid lanes +vis)
+        lines.append(_hbm_line(f"q1_{sf_label}", q1_ts[len(q1_ts) // 2],
+                               n, (2 + 2 + 4 + 4) + 7 + 1))
+        return
 
     # concurrent throughput (separate, labeled)
+    n_clients = int(os.environ.get("BENCH_CLIENTS", 8))
+
     def throughput(sql, per=2) -> float:
         import threading
 
@@ -288,22 +477,25 @@ def main() -> None:
                 t.join()
             if errs:
                 raise errs[0]
-            best = max(best, n_clients * per * n10 /
+            best = max(best, n_clients * per * n /
                        (time.perf_counter() - t0))
         return best
 
     tput = throughput(TPCH_Q6)
+    res["values"]["q6_concurrent"] = tput
     lines.append(f"q6 concurrent throughput ({n_clients} clients): "
-                 f"{tput / 1e6:.1f}M rows/s "
-                 f"({tput / baseline_rps:.1f}x the interpreted baseline)")
-    del session, arrays, throughput  # noqa: F821 - drop the closure too
-    gc.collect()
-    log("sf10 flight freed")
+                 f"{tput / 1e6:.1f}M rows/s")
 
-    # ---- 2. TPC-H join corpus at join_sf ----
+
+def flight_joins(res: dict) -> None:
     from tidb_tpu.bench.tpch_data import generate_tpch, load_table
     from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+    from tidb_tpu.session import Session
 
+    _session_env()
+    lines = res["lines"]
+    join_sf = float(os.environ.get("BENCH_JOIN_SF", 10))
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
     t0 = time.perf_counter()
     jdata = generate_tpch(join_sf, 11)
     js = Session()
@@ -312,7 +504,6 @@ def main() -> None:
     jrows = len(jdata["lineitem"]["l_orderkey"])
     log(f"tpch join corpus sf{join_sf:g}: gen+load="
         f"{time.perf_counter() - t0:.0f}s ({jrows} lineitem rows)")
-    log("join corpus loaded; computing oracles")
     want3 = q3_oracle(jdata)
     got3 = [(int(r[0]), r[1].unscaled) for r in js.query(
         TPCH_QUERIES["q3"])]
@@ -328,33 +519,49 @@ def main() -> None:
     q3_ts = times(lambda: js.query(TPCH_QUERIES["q3"]), repeat)
     q5_ts = times(lambda: js.query(TPCH_QUERIES["q5"]), repeat)
     l3, q3_rps = report(f"q3_sf{join_sf:g}", q3_ts, jrows)
-    l5, _ = report(f"q5_sf{join_sf:g}", q5_ts, jrows)
-    lines += [l3 + f" ({q3_rps / baseline_rps:.1f}x interpreted baseline)",
-              l5]
-    del js, jdata
-    gc.collect()
+    l5, q5_rps = report(f"q5_sf{join_sf:g}", q5_ts, jrows)
+    lines += [l3, l5]
+    res["values"]["q3"] = q3_rps
+    res["values"]["q5"] = q5_rps
 
-    # ---- 3. SSB Q1 flight ----
+
+def flight_ssb(res: dict) -> None:
     from tidb_tpu.bench import ssb
+    from tidb_tpu.session import Session
 
+    _session_env()
+    lines = res["lines"]
+    ssb_sf = float(os.environ.get("BENCH_SSB_SF", 100))
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
+    # 14 distinct int64 column buffers (commitdate shares orderdate's)
+    # adopted zero-copy + 2 int8 code arrays + int32 dict columns +
+    # generator transients
+    n = _scale_to_ram(int(ssb.ROWS_PER_SF * ssb_sf), 155.0, "ssb", lines)
+    sf = n / ssb.ROWS_PER_SF
     t0 = time.perf_counter()
-    lo = ssb.generate_lineorder(ssb_sf)
+    lo = ssb.generate_lineorder(sf)
     ss = Session()
-    nrows_ssb = ssb.load_ssb(ss, ssb_sf, lineorder=lo)
-    log(f"ssb sf{ssb_sf:g}: gen+load={time.perf_counter() - t0:.0f}s "
+    nrows_ssb = ssb.load_ssb(ss, sf, lineorder=lo)
+    log(f"ssb sf{sf:g}: gen+load={time.perf_counter() - t0:.0f}s "
         f"({nrows_ssb} lineorder rows)")
     for q in ("q1.1", "q1.2", "q1.3"):
         got = ss.query(ssb.SSB_QUERIES[q])[0][0]
         assert got is not None and int(got) == ssb.q1_oracle(lo, q), q
         ts = times(lambda sql=ssb.SSB_QUERIES[q]: ss.query(sql), repeat)
-        line, _ = report(f"ssb_{q}_sf{ssb_sf:g}", ts, nrows_ssb)
+        line, rps = report(f"ssb_{q}_sf{sf:g}", ts, nrows_ssb)
         lines.append(line)
-    del ss, lo
-    gc.collect()
+        res["values"][f"ssb_{q}"] = rps
 
-    # ---- 4. ClickBench-style hits ----
+
+def flight_cb(res: dict) -> None:
     from tidb_tpu.bench import clickbench as cbench
+    from tidb_tpu.session import Session
 
+    _session_env()
+    lines = res["lines"]
+    cb_rows = int(float(os.environ.get("BENCH_CB_ROWS", 1e8)))
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
+    cb_rows = _scale_to_ram(cb_rows, 110.0, "clickbench", lines)
     t0 = time.perf_counter()
     hits = cbench.generate_hits(cb_rows)
     cs = Session()
@@ -372,52 +579,140 @@ def main() -> None:
             ok = [(int(a), int(b)) for a, b in got] == want
         assert ok, f"{q} digest"
         ts = times(lambda s2=sql: cs.query(s2), repeat)
-        line, _ = report(q, ts, cb_rows)
+        line, rps = report(q, ts, cb_rows)
         lines.append(line)
-    del cs, hits
-    gc.collect()
+        res["values"][q] = rps
 
-    # ---- 5. North star: TPC-H SF100 Q1/Q6 ----
-    headline_rps = q6_sf10_rps
-    headline_name = f"q6_sf{sf:g}"
+
+FLIGHTS = {
+    "tpch_small": lambda res: flight_tpch(res, big=False),
+    "tpch_big": lambda res: flight_tpch(res, big=True),
+    "joins": flight_joins,
+    "ssb": flight_ssb,
+    "cb": flight_cb,
+}
+
+
+def run_flight_child(name: str, out_path: str) -> None:
+    res = {"ok": False, "lines": [], "values": {}}
     try:
-        nbig = int(ROWS_PER_SF * sf_big)
-        t0 = time.perf_counter()
-        big_arrays = generate_lineitem_arrays(nbig)
-        gen_s = time.perf_counter() - t0
-        bs = Session()
-        t0 = time.perf_counter()
-        load_lineitem(bs, nbig, arrays=big_arrays)
-        log(f"tpch sf{sf_big:g}: gen={gen_s:.0f}s load="
-            f"{time.perf_counter() - t0:.0f}s ({nbig} rows)")
-        got = bs.query(TPCH_Q6)[0][0]
-        assert got is not None and got.unscaled == q6_oracle(big_arrays)
-        check_q1(bs.query(TPCH_Q1), big_arrays)
-        q6b = times(lambda: bs.query(TPCH_Q6), repeat)
-        q1b = times(lambda: bs.query(TPCH_Q1), repeat)
-        l6b, q6_big_rps = report(f"q6_sf{sf_big:g}", q6b, nbig)
-        l1b, _ = report(f"q1_sf{sf_big:g}", q1b, nbig)
-        lines += [l6b, l1b]
-        headline_rps = q6_big_rps
-        headline_name = f"q6_sf{sf_big:g}"
-        del bs, big_arrays
-        gc.collect()
-    except Exception as e:  # report the failure, keep the SF10 headline
-        lines.append(f"sf{sf_big:g} flight FAILED: {type(e).__name__}: "
-                     f"{str(e)[:200]}")
+        FLIGHTS[name](res)
+        res["ok"] = True
+    except BaseException as e:  # noqa: BLE001 - report, parent decides
+        res["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    with open(out_path, "w") as f:
+        json.dump(res, f)
+    if not res["ok"]:
+        log(f"flight {name} FAILED: {res.get('error')}")
+        sys.exit(1)
 
-    print(json.dumps({
+
+# ---------------------------------------------------------------------------
+# Parent board
+# ---------------------------------------------------------------------------
+
+def _headline(values: dict, baseline_rps: float, lines_done: int) -> str:
+    big = bool(values.get("q6_big"))
+    rps = values.get("q6_big") or values.get("q6_small") or 0.0
+    rows = values.get("rows_big" if big else "rows_small", 0)
+    return json.dumps({
         "metric": "tpch_q6_rows_per_sec",
-        "value": round(headline_rps),
+        "value": round(rps),
         "unit": "rows/s",
-        "vs_baseline": round(headline_rps / baseline_rps, 2),
-    }))
-    log(f"headline={headline_name}; basis: single-stream engine vs "
-        f"single-stream interpreted row-loop baseline "
-        f"({baseline_rps / 1e3:.0f}K rows/s); "
-        f"platform={__import__('jax').default_backend()}")
-    for ln in lines:
+        "vs_baseline": round(rps / baseline_rps, 2) if baseline_rps else
+        None,
+        "scale": f"sf{round(rows / ROWS_PER_SF, 2):g}" if rows else
+        "unknown",
+        "baseline": "compiled C++ row-loop (native/baseline.cpp), "
+                    "single-stream",
+        "flights_done": lines_done,
+    })
+
+
+def main() -> None:
+    if len(sys.argv) >= 4 and sys.argv[1] == "--flight":
+        run_flight_child(sys.argv[2], sys.argv[4] if sys.argv[3] == "--out"
+                         else sys.argv[3])
+        return
+
+    # ---- parent: measure the compiled baseline first (numpy-only) ----
+    from tidb_tpu.bench.tpch import generate_lineitem_arrays
+
+    t0 = time.perf_counter()
+    sample = generate_lineitem_arrays(6_000_000)
+    kv_rps, col_rps, q1_rps = compiled_baselines(sample)
+    del sample
+    log(f"compiled baselines ({time.perf_counter() - t0:.0f}s): "
+        f"q6-kv-rowloop={kv_rps / 1e6:.0f}M rows/s, "
+        f"q6-columnar-rowloop={col_rps / 1e6:.0f}M rows/s, "
+        f"q1-kv-rowloop={q1_rps / 1e6:.0f}M rows/s (C++ -O3, "
+        f"single-stream, native/baseline.cpp)")
+
+    flight_names = os.environ.get(
+        "BENCH_FLIGHTS", "tpch_small,tpch_big,joins,ssb,cb").split(",")
+    timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
+    values: dict = {}
+    all_lines: list[str] = [
+        f"baseline_c_q6_kv_rowloop: {kv_rps / 1e6:.0f}M rows/s",
+        f"baseline_c_q6_columnar_rowloop: {col_rps / 1e6:.0f}M rows/s",
+        f"baseline_c_q1_kv_rowloop: {q1_rps / 1e6:.0f}M rows/s",
+    ]
+    done = 0
+    for name in flight_names:
+        name = name.strip()
+        if name not in FLIGHTS:
+            log(f"unknown flight {name!r}; skipping")
+            continue
+        out = tempfile.NamedTemporaryFile(
+            suffix=f".{name}.json", delete=False)
+        out.close()
+        log(f"=== flight {name} ===")
+        t0 = time.perf_counter()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--flight",
+                 name, "--out", out.name],
+                stdout=sys.stderr, stderr=sys.stderr, timeout=timeout)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+            all_lines.append(f"flight {name} TIMED OUT after {timeout}s")
+        try:
+            with open(out.name) as f:
+                res = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            res = {"ok": False, "lines": [],
+                   "error": f"no result file (rc={rc}"
+                            f"{', likely OOM-killed' if rc == -9 else ''})"}
+        os.unlink(out.name)
+        all_lines += res.get("lines", [])
+        if res.get("ok"):
+            values.update(res.get("values", {}))
+            done += 1
+        else:
+            all_lines.append(
+                f"flight {name} FAILED: {res.get('error', f'rc={rc}')}")
+        log(f"flight {name}: {'ok' if res.get('ok') else 'FAILED'} "
+            f"in {time.perf_counter() - t0:.0f}s")
+        # incremental headline: supersedes earlier lines, survives any
+        # later flight's death
+        if values.get("q6_big") or values.get("q6_small"):
+            print(_headline(values, kv_rps, done), flush=True)
+
+    if values.get("py_baseline"):
+        all_lines.append(
+            f"baseline_py_rowloop: {values['py_baseline'] / 1e3:.0f}K "
+            f"rows/s (r01-r04 series denominator; r04 headline would be "
+            f"{(values.get('q6_big') or values.get('q6_small', 0)) / values['py_baseline']:.1f}x against it)")
+    for ln in all_lines:
         log(ln)
+    if values.get("q6_big") or values.get("q6_small"):
+        print(_headline(values, kv_rps, done), flush=True)
+    else:
+        print(json.dumps({
+            "metric": "tpch_q6_rows_per_sec", "value": 0,
+            "unit": "rows/s", "vs_baseline": 0,
+            "error": "no flight produced a headline"}), flush=True)
 
 
 if __name__ == "__main__":

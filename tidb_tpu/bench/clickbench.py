@@ -17,9 +17,12 @@ Queries mirror well-known ClickBench shapes:
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import numpy as np
 
-from ..session import Session
+if TYPE_CHECKING:  # lazy: bench.py's parent process must not pull jax
+    from ..session import Session
 
 HITS_DDL = """
 create table hits (
@@ -73,7 +76,7 @@ def generate_hits(n_rows: int, seed: int = 3) -> dict[str, np.ndarray]:
     }
 
 
-def load_hits(session: Session, n_rows: int, seed: int = 3,
+def load_hits(session: "Session", n_rows: int, seed: int = 3,
               hits: dict[str, np.ndarray] | None = None) -> None:
     session.execute("drop table if exists hits")
     session.execute(HITS_DDL)

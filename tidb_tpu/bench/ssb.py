@@ -15,9 +15,12 @@ SSB-spec-shaped (discount 0..10, quantity 1..50, dates uniform over
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import numpy as np
 
-from ..session import Session
+if TYPE_CHECKING:  # lazy: bench.py's parent process must not pull jax
+    from ..session import Session
 
 ROWS_PER_SF = 6_000_000
 
@@ -117,47 +120,59 @@ def _date_dim():
     }
 
 
-def generate_lineorder(sf: float, seed: int = 7) -> dict[str, np.ndarray]:
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECI", "5-LOW"]
+SHIPMODES = ["RAIL", "AIR", "TRUCK", "SHIP", "MAIL", "FOB", "REG AIR"]
+
+
+def generate_lineorder(sf: float, seed: int = 7) -> dict[str, object]:
+    """Lineorder arrays sized for zero-copy adoption by bulk_load.
+
+    Numeric columns are generated directly as int64 — the store's host
+    dtype — so bulk_load into an empty epoch ADOPTS the buffers instead
+    of copying (an SF100 table is ~72GB of columns; the r04 board's
+    extra copy OOM-killed it). String columns are (vocab, int8-codes)
+    tuples, never materialised as unicode arrays (lo_orderpriority alone
+    was ~26GB of unicode at SF100). lo_commitdate shares lo_orderdate's
+    buffer (epoch columns are immutable)."""
     n = int(ROWS_PER_SF * sf)
     rng = np.random.default_rng(seed)
     dates = _date_dim()["d_datekey"]
-    orderkey = np.repeat(np.arange(1, n // 4 + 2, dtype=np.int64), 4)[:n]
     qty = rng.integers(1, 51, n, dtype=np.int64)
     price = rng.integers(90000, 200001, n, dtype=np.int64)
-    extended = qty * price // 50
+    extended = price
+    extended *= qty  # in-place: price buffer becomes extendedprice
+    extended //= 50
     discount = rng.integers(0, 11, n, dtype=np.int64)
-    odate = dates[rng.integers(0, len(dates), n)]
-    prio = rng.integers(0, 5, n)
-    ship = rng.integers(0, 7, n)
+    revenue = (100 - discount) * extended
+    revenue //= 100
+    odate = dates[rng.integers(0, len(dates), n)].astype(np.int64)
     return {
-        "lo_orderkey": orderkey,
+        "lo_orderkey": np.repeat(
+            np.arange(1, n // 4 + 2, dtype=np.int64), 4)[:n],
         "lo_linenumber": np.tile(np.arange(1, 5, dtype=np.int64),
                                  n // 4 + 1)[:n],
         "lo_custkey": rng.integers(1, max(2, n // 200), n, dtype=np.int64),
         "lo_partkey": rng.integers(1, max(2, n // 30), n, dtype=np.int64),
         "lo_suppkey": rng.integers(1, max(2, n // 3000), n,
                                    dtype=np.int64),
-        "lo_orderdate": odate.astype(np.int64),
-        "lo_orderpriority": np.array(
-            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECI",
-             "5-LOW"])[prio],
+        "lo_orderdate": odate,
+        "lo_orderpriority": (PRIORITIES,
+                             rng.integers(0, 5, n, dtype=np.int8)),
         "lo_shippriority": np.zeros(n, dtype=np.int64),
         "lo_quantity": qty,
         "lo_extendedprice": extended,
         "lo_ordtotalprice": extended * 4,
         "lo_discount": discount,
-        "lo_revenue": extended * (100 - discount) // 100,
+        "lo_revenue": revenue,
         "lo_supplycost": extended * 6 // 10,
         "lo_tax": rng.integers(0, 9, n, dtype=np.int64),
-        "lo_commitdate": odate.astype(np.int64),
-        "lo_shipmode": np.array(
-            ["RAIL", "AIR", "TRUCK", "SHIP", "MAIL", "FOB",
-             "REG AIR"])[ship],
+        "lo_commitdate": odate,
+        "lo_shipmode": (SHIPMODES, rng.integers(0, 7, n, dtype=np.int8)),
     }
 
 
-def load_ssb(session: Session, sf: float, seed: int = 7,
-             lineorder: dict[str, np.ndarray] | None = None) -> int:
+def load_ssb(session: "Session", sf: float, seed: int = 7,
+             lineorder: dict[str, object] | None = None) -> int:
     """Create + bulk-load ssb_date and lineorder; returns lineorder rows."""
     for ddl, name, data in (
         (DATE_DDL, "ssb_date", _date_dim()),
@@ -172,8 +187,14 @@ def load_ssb(session: Session, sf: float, seed: int = 7,
         cols = []
         for c in info.columns:
             v = data[c.name]
-            if v.dtype.kind in "US":  # dictionary-encode strings
-                d = store.dictionaries[c.offset]
+            d = store.dictionaries[c.offset]
+            if isinstance(v, tuple):  # (vocab, codes) — no unicode array
+                vocab, codes = v
+                remap = np.array([d.encode(s) for s in vocab],
+                                 dtype=np.int32)
+                cols.append(remap[codes])
+            elif getattr(v, "dtype", None) is not None and \
+                    v.dtype.kind in "US":
                 uniq, inv = np.unique(v, return_inverse=True)
                 codes = np.array([d.encode(s) for s in uniq],
                                  dtype=np.int64)

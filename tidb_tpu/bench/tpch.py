@@ -13,10 +13,14 @@ returnflag A/N/R correlated with receiptdate, linestatus from shipdate).
 
 from __future__ import annotations
 
+from typing import TYPE_CHECKING
+
 import numpy as np
 
-from ..session import Session
 from ..types.value import parse_date
+
+if TYPE_CHECKING:  # lazy: bench.py's parent process must not pull jax
+    from ..session import Session
 
 LINEITEM_DDL = """
 create table lineitem (
@@ -114,7 +118,7 @@ def generate_lineitem_arrays(n_rows: int, seed: int = 42) -> dict[str, np.ndarra
     }
 
 
-def load_lineitem(session: Session, n_rows: int, seed: int = 42,
+def load_lineitem(session: "Session", n_rows: int, seed: int = 42,
                   arrays: dict[str, np.ndarray] | None = None) -> None:
     """Create + bulk-load lineitem into the session's storage. Pass
     pre-generated `arrays` to avoid generating twice (SF10 = ~30s/gen)."""
