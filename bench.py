@@ -96,7 +96,8 @@ def _load_baseline_lib():
     try:  # no-op when fresh; rebuilds after baseline.cpp edits
         subprocess.run(["make", "-C", os.path.dirname(so), "libbaseline.so"],
                        check=True, capture_output=True, timeout=120)
-    except (subprocess.CalledProcessError, OSError):
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            OSError):
         if not os.path.exists(so):
             raise
     lib = ctypes.CDLL(so)

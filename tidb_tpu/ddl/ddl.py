@@ -107,6 +107,16 @@ class DDL:
             self._run_job_steps(job)
             return
         with owner:
+            # fold any sibling's schema changes BEFORE applying ours:
+            # two servers altering different tables otherwise each
+            # persist a full-catalog snapshot built from the other's
+            # stale pre-image — a lost update whose colliding version
+            # numbers also suppress the sibling reload (the reference
+            # serializes on one owner AND reloads the schema at job
+            # start, ddl_worker.go:419 + domain Reload)
+            refresh = getattr(self.storage, "refresh", None)
+            if refresh is not None:
+                refresh()
             self._run_job_steps(job)
 
     def _run_job_steps(self, job: DDLJob) -> None:

@@ -21,14 +21,14 @@ directory:
   columnar epochs and reloading the catalog when the meta plane moved —
   the domain-reload equivalent, with the schema fence aborting stale
   in-flight transactions exactly like the reference's schema validator;
-* timestamp uniqueness across processes comes from node-sliced logical
-  bits in the TSO (no coordination on the hot path). KNOWN LIMITATION:
-  without a central TSO service, a sibling's commit in the same
-  millisecond can carry a commit_ts below a snapshot ts this node
-  already issued; a refresh can then surface that commit inside an
-  open transaction (bounded-staleness SI rather than strict SI). The
-  reference closes this with PD's TSO (oracle/oracles/pd.go); a
-  DCN TSO service is the planned equivalent;
+* timestamps come from ONE shared allocator (`kv/tso.py SharedTSO`:
+  mmap'd counter + flock + fsync'd allocation window — the PD TSO role,
+  reference oracle/oracles/pd.go:77), so snapshot isolation is STRICT
+  across processes: any sibling commit_ts is below every later snapshot
+  ts, and a refresh can never surface a commit inside an open
+  transaction (the round-4 node-sliced TSO admitted a same-millisecond
+  anomaly here; tests/test_multiproc.py::test_strict_si_same_millisecond
+  pins the fix);
 * a `procs/` registry + `kill/` mailbox implement cross-process KILL:
   global connection ids embed the server id (reference's
   globalconn.GCID layout), and each server's daemon polls its mailbox.
@@ -43,10 +43,10 @@ import threading
 import time
 from typing import Optional
 
-# logical-bit slice of the TSO per node: 2^18 logical ids split into 32
-# slices of 8192 — uniqueness across processes without coordination
+# size of the procs/ node-slot table (node ids feed global connection
+# ids and the kill mailbox; timestamps come from the ONE SharedTSO
+# allocator in kv/tso.py, not from per-node slicing)
 TSO_NODE_SLICES = 32
-TSO_SLICE = (1 << 18) // TSO_NODE_SLICES
 
 
 class SharedDirCoordinator:

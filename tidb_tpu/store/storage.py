@@ -116,11 +116,15 @@ class Storage:
             # lease file missing/corrupt: floor from the largest commit ts
             # in the reopened KV so timestamps still never repeat
             self._tso_lease = self.kv.max_commit_ts()
-        from .coordinator import TSO_NODE_SLICES
-        self.tso = TimestampOracle(
-            floor=self._tso_lease,
-            node_id=self.coord.node_id if self.coord else 0,
-            n_nodes=TSO_NODE_SLICES if self.coord else 1)
+        if self.shared:
+            # ONE allocator for every process on this directory — strict
+            # SI across servers (the PD TSO role, oracle/oracles/pd.go:77;
+            # replaces the round-4 node-sliced oracle whose same-
+            # millisecond interleavings were only bounded-staleness)
+            from ..kv.tso import SharedTSO
+            self.tso = SharedTSO(path, floor=self._tso_lease)
+        else:
+            self.tso = TimestampOracle(floor=self._tso_lease)
         self.rm = RegionManager(self.kv)
         self.committer = TwoPhaseCommitter(self.rm, self.tso)
         # GLOBAL sysvar plane (mysql.global_variables analog) — rides the
