@@ -414,9 +414,12 @@ def flight_tpch(res: dict, big: bool) -> None:
                       lines)
     sf_label = f"sf{sf:g}" if n == int(ROWS_PER_SF * sf) else \
         f"sf{n / ROWS_PER_SF:.0f}"
+    log(f"tpch {sf_label}: generating {n} rows "
+        f"(MemAvailable={_meminfo_gb('MemAvailable'):.0f}GB)")
     t0 = time.perf_counter()
     arrays = generate_lineitem_arrays(n)
     gen_s = time.perf_counter() - t0
+    log(f"tpch {sf_label}: gen={gen_s:.0f}s; loading")
     session = Session()
     t0 = time.perf_counter()
     load_lineitem(session, n, arrays=arrays)

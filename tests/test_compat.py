@@ -348,3 +348,24 @@ def test_configured_root_password_wins_over_grant_table(tmp_path):
             MiniClient("127.0.0.1", srv.port, user="root", password="nope")
     finally:
         srv.close(drain_timeout=0.2)
+
+
+def test_errno_attached_at_raise_sites():
+    """Codes come from CodedError attributes, not message regexes
+    (tidb_tpu/errno.py; reference terror, util/dbterror/terror.go): an
+    exception whose MESSAGE matches no classifier rule still reports its
+    raise-site errno, and rewording can no longer change a code."""
+    from tidb_tpu.errno import error_of
+    from tidb_tpu.session.session import SQLError
+
+    e = SQLError("a freshly reworded message nobody regexes", errno=1062)
+    assert error_of(e) == (1062, "23000")
+    # classes carry defaults from their definition site
+    from tidb_tpu.catalog.schema import CatalogError
+    from tidb_tpu.store.storage import Storage
+
+    assert error_of(CatalogError("whatever", errno=1049)) == (1049, "42000")
+    assert error_of(Storage.DeadlockError("x"))[0] == 1213
+    assert error_of(Storage.LockWaitTimeout("x"))[0] == 1205
+    # foreign exceptions still ride the legacy classifier net
+    assert error_of(ValueError("Duplicate entry 'k' for key 'u'"))[0] == 1062

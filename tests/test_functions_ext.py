@@ -146,3 +146,26 @@ def test_new_aggregates(session):
     assert abs(float(r3[0][0]) - var_pop) < 1e-9
     assert abs(float(r3[0][1]) - math.sqrt(var_pop * 5 / 4)) < 1e-9
     assert r3[0][2] == 31
+
+
+def test_breadth_layer_decimal_exactness():
+    """Registry builtins receive DECIMAL args as exact decimal.Decimal
+    (no float round trip) and decimal results rescale exactly — the
+    reference keeps MyDecimal exact through every builtin
+    (types/mydecimal.go). 999999999999.123457 has 18 significant digits,
+    beyond float64's ~15.9, so any float path changes the digits."""
+    import decimal
+
+    s = Session()
+    s.execute("create table dexact (a decimal(18,6), b decimal(18,6))")
+    s.execute("insert into dexact values (999999999999.123457, 7.000003)")
+    assert s.query("select format(a, 4) from dexact")[0][0] == \
+        "999,999,999,999.1235"
+    got = s.query("select mod(a, b) from dexact")[0][0]
+    want = decimal.Decimal("999999999999.123457") % \
+        decimal.Decimal("7.000003")
+    assert str(got) == str(want)
+    # MOD sign follows the dividend (MySQL), exactly
+    s.execute("insert into dexact values (-10.000001, 3.000000)")
+    got2 = s.query("select mod(a, b) from dexact where a < 0")[0][0]
+    assert str(got2) == "-1.000001"

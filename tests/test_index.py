@@ -256,3 +256,71 @@ def test_index_vs_scan_oracle():
     want = s.query("SELECT SUM(v), COUNT(*) FROM big WHERE k + 0 = 7")
     got = s.query("SELECT SUM(v), COUNT(*) FROM big WHERE k = 7")
     assert got == want
+
+
+# ---------------- index merge (reference: index_merge_reader.go) --------
+
+
+@pytest.fixture
+def merge_se():
+    s = Session()
+    s.execute(
+        "CREATE TABLE im (id INT PRIMARY KEY, a INT, b INT, c INT, "
+        "KEY ka (a), KEY kb (b))")
+    s.execute(
+        "INSERT INTO im VALUES (1,1,10,100),(2,2,20,200),(3,3,30,300),"
+        "(4,1,40,400),(5,5,10,500),(6,6,60,600)")
+    yield s
+    s.rollback_if_active()
+
+
+def test_index_merge_plan_shape(merge_se):
+    plan = explain(merge_se, "select * from im where a = 1 or b = 10")
+    assert "IndexMerge(union)" in plan, plan
+    assert "ka" in plan and "kb" in plan
+
+
+def test_index_merge_union_correctness(merge_se):
+    rows = merge_se.query(
+        "select id from im where a = 1 or b = 10 order by id")
+    assert [r[0] for r in rows] == [1, 4, 5]
+    # three-way OR incl. the pk-handle column
+    rows = merge_se.query(
+        "select id from im where a = 1 or b = 60 or id = 2 order by id")
+    assert [r[0] for r in rows] == [1, 2, 4, 6]
+
+
+def test_index_merge_residual_conjunct(merge_se):
+    # extra AND conjunct not covered by either index is re-checked
+    rows = merge_se.query(
+        "select id from im where (a = 1 or b = 10) and c >= 400 "
+        "order by id")
+    assert [r[0] for r in rows] == [4, 5]
+
+
+def test_index_merge_disjunct_conjunction(merge_se):
+    # a disjunct that is itself a conjunction: branch over-approximates,
+    # residual filter restores exactness
+    rows = merge_se.query(
+        "select id from im where (a = 1 and c = 100) or b = 60 "
+        "order by id")
+    assert [r[0] for r in rows] == [1, 6]
+
+
+def test_index_merge_no_path_without_full_cover(merge_se):
+    # c has no index: one disjunct unservable -> no IndexMerge
+    plan = explain(merge_se, "select * from im where a = 1 or c = 100")
+    assert "IndexMerge" not in plan
+
+
+def test_index_merge_sees_txn_buffer(merge_se):
+    s = merge_se
+    s.execute("begin")
+    s.execute("insert into im values (7,1,70,700)")
+    s.execute("update im set b = 10 where id = 2")
+    s.execute("delete from im where id = 5")
+    rows = s.query("select id from im where a = 1 or b = 10 order by id")
+    assert [r[0] for r in rows] == [1, 2, 4, 7]
+    s.execute("rollback")
+    rows = s.query("select id from im where a = 1 or b = 10 order by id")
+    assert [r[0] for r in rows] == [1, 4, 5]

@@ -12,6 +12,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ..errno import (
+    ER_BAD_DB,
+    ER_DB_CREATE_EXISTS,
+    ER_NO_SUCH_TABLE,
+    ER_TABLE_EXISTS,
+    CodedError,
+)
 from ..types.field_type import FieldType
 
 
@@ -150,6 +157,15 @@ class TableInfo:
         return len(self.columns)
 
 
+class CatalogError(CodedError, KeyError):
+    """Schema lookup/namespace error. Subclasses KeyError so existing
+    `except KeyError` callers keep working; __str__ stays Exception's
+    (KeyError would repr-quote the message)."""
+
+    def __str__(self) -> str:  # noqa: D105
+        return Exception.__str__(self)
+
+
 @dataclass
 class SchemaInfo:
     name: str
@@ -204,7 +220,7 @@ class Catalog:
         if key in self.schemas:
             if if_not_exists:
                 return self.schemas[key]
-            raise KeyError(f"database exists: {name}")
+            raise CatalogError(f"database exists: {name}", errno=ER_DB_CREATE_EXISTS)
         info = SchemaInfo(name)
         self.schemas[key] = info
         self.bump_version()
@@ -215,7 +231,7 @@ class Catalog:
         if key not in self.schemas:
             if if_exists:
                 return []
-            raise KeyError(f"unknown database: {name}")
+            raise CatalogError(f"unknown database: {name}", errno=ER_BAD_DB)
         dropped = list(self.schemas.pop(key).tables.values())
         self.bump_version()
         return dropped
@@ -223,7 +239,7 @@ class Catalog:
     def schema(self, name: str) -> SchemaInfo:
         key = name.lower()
         if key not in self.schemas:
-            raise KeyError(f"unknown database: {name}")
+            raise CatalogError(f"unknown database: {name}", errno=ER_BAD_DB)
         return self.schemas[key]
 
     # ---- table ops ---------------------------------------------------------
@@ -233,7 +249,7 @@ class Catalog:
         if key in schema.tables:
             if if_not_exists:
                 return False
-            raise KeyError(f"table exists: {db}.{tbl.name}")
+            raise CatalogError(f"table exists: {db}.{tbl.name}", errno=ER_TABLE_EXISTS)
         schema.tables[key] = tbl
         self.bump_version()
         return True
@@ -244,7 +260,7 @@ class Catalog:
         if key not in schema.tables:
             if if_exists:
                 return None
-            raise KeyError(f"unknown table: {db}.{name}")
+            raise CatalogError(f"unknown table: {db}.{name}", errno=ER_NO_SUCH_TABLE)
         info = schema.tables.pop(key)
         self.bump_version()
         return info
@@ -253,7 +269,7 @@ class Catalog:
         schema = self.schema(db)
         key = name.lower()
         if key not in schema.tables:
-            raise KeyError(f"unknown table: {db}.{name}")
+            raise CatalogError(f"unknown table: {db}.{name}", errno=ER_NO_SUCH_TABLE)
         return schema.tables[key]
 
     def try_table(self, db: str, name: str) -> Optional[TableInfo]:

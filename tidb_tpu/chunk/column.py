@@ -21,6 +21,7 @@ from typing import Any, Iterable, Optional, Sequence
 
 import numpy as np
 
+from ..errno import ER_INVALID_JSON_TEXT, WARN_DATA_TRUNCATED, CodedError
 from ..types.field_type import FieldType, TypeKind
 from ..types.value import (
     Decimal,
@@ -140,11 +141,25 @@ class EnumDictionary(Dictionary):
             return code
         code = self.lookup_ci(s)
         if code < 0:
-            raise ValueError(f"Data truncated: invalid ENUM value {s!r}")
+            raise TruncateError(
+                f"Data truncated: invalid ENUM value {s!r}")
         return code
 
     def sort_ranks(self, ci: bool = False) -> np.ndarray:
         return np.arange(len(self.values), dtype=np.int32)
+
+
+
+class TruncateError(CodedError, ValueError):
+    """Value does not fit the column's domain (ENUM/SET membership)."""
+
+    errno = WARN_DATA_TRUNCATED
+    sqlstate = "01000"
+
+
+class InvalidJSONError(CodedError, ValueError):
+    errno = ER_INVALID_JSON_TEXT
+    sqlstate = "22032"
 
 
 @dataclass
@@ -293,7 +308,7 @@ def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -
                 continue
             j = lowered.get(part.lower())
             if j is None:
-                raise ValueError(
+                raise TruncateError(
                     f"Data truncated: invalid SET value {part!r}")
             mask |= 1 << j
         return mask
@@ -314,7 +329,7 @@ def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -
             s = _json.dumps(_json.loads(s), sort_keys=True,
                             separators=(", ", ": "))
         except ValueError:
-            raise ValueError(
+            raise InvalidJSONError(
                 f"Invalid JSON text: {s[:40]!r}") from None
         return dictionary.encode(s)
     if ftype.is_decimal:

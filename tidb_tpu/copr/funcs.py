@@ -15,10 +15,13 @@ coprocessor pushdown allowlist (expression/expr_to_pb.go
 canFuncBePushed).
 
 Value domains at the registry boundary: strings -> str, DATE -> day
-number (int; helpers below convert), DECIMAL -> float (documented
-precision loss for these long-tail functions), other numerics ->
-int/float. Returning None yields SQL NULL. With null_prop=True (default)
-any NULL argument short-circuits to NULL, matching most MySQL builtins.
+number (int; helpers below convert), DECIMAL -> stdlib decimal.Decimal
+(EXACT — the evaluator converts unscaled ints without a float round
+trip, and decimal-typed results rescale exactly; reference keeps
+MyDecimal exact through every builtin, types/mydecimal.go), other
+numerics -> int/float. Returning None yields SQL NULL. With
+null_prop=True (default) any NULL argument short-circuits to NULL,
+matching most MySQL builtins.
 """
 
 from __future__ import annotations
@@ -133,9 +136,14 @@ def _hex(v):
 
 
 def _format_num(x, d):
+    import decimal as _pydec
+
     d = max(int(d), 0)
-    s = f"{float(x):,.{d}f}"
-    return s
+    if isinstance(x, _pydec.Decimal):  # exact decimal formatting
+        q = x.quantize(_pydec.Decimal(1).scaleb(-d),
+                       rounding=_pydec.ROUND_HALF_UP)
+        return f"{q:,.{d}f}"
+    return f"{float(x):,.{d}f}"
 
 
 def _soundex(s):
@@ -339,12 +347,23 @@ _reg("SINH", 1, 1, "float", lambda x: math.sinh(float(x)))
 _reg("COSH", 1, 1, "float", lambda x: math.cosh(float(x)))
 _reg("TANH", 1, 1, "float", lambda x: math.tanh(float(x)))
 def _mod(a, b):
-    if float(b) == 0:
+    """MySQL MOD: result carries the dividend's sign. Exact for int and
+    decimal.Decimal operands (no float round trip); float when an operand
+    is one, and string operands coerce numerically (MySQL MOD('7',2)=1)."""
+    import decimal as _pydec
+
+    if not isinstance(a, (int, float, _pydec.Decimal)):
+        a = float(a)
+    if not isinstance(b, (int, float, _pydec.Decimal)):
+        b = float(b)
+    if isinstance(a, float) or isinstance(b, float):
+        if float(b) == 0:
+            return None
+        return math.fmod(float(a), float(b))
+    if b == 0:
         return None
-    r = math.fmod(float(a), float(b))
-    if isinstance(a, int) and isinstance(b, int):
-        return int(r)
-    return r
+    r = abs(a) % abs(b)
+    return -r if a < 0 else r
 
 
 _reg("MOD", 2, 2, "arg0", _mod)

@@ -37,7 +37,12 @@ class NumpyEval:
     def _registry_call(self, e: Call) -> VV:
         """Breadth-layer builtins (copr/funcs.py): rowwise Python with
         the registry's NULL semantics; args arrive in their natural
-        domains (str / day-number int / decimal-as-float / int)."""
+        domains (str / day-number int / EXACT stdlib decimal.Decimal for
+        DECIMAL columns / int). The reference keeps exact MyDecimal
+        semantics through every builtin (types/mydecimal.go); the r04
+        decimal-as-float shortcut was a silent precision loss."""
+        import decimal as _pydec
+
         from .funcs import REGISTRY
 
         fd = REGISTRY[e.op[3:]]
@@ -45,22 +50,27 @@ class NumpyEval:
         for a in e.args:
             if a.ftype.is_string:
                 v, vl = self.eval_str(a)
+                dec_scale = None
             else:
                 v, vl = self.eval(a)
                 v = np.asarray(v)
-                if a.ftype.is_decimal and a.ftype.scale:
-                    v = v.astype(np.float64) / (10.0 ** a.ftype.scale)
-            arg_vv.append((v, np.asarray(vl)))
+                dec_scale = a.ftype.scale if a.ftype.is_decimal else None
+            arg_vv.append((v, np.asarray(vl), dec_scale))
         n = self.n
         out = np.empty(n, dtype=object)
         valid = np.zeros(n, bool)
         for i in range(n):
             vals = []
             has_null = False
-            for v, vl in arg_vv:
+            for v, vl, dec_scale in arg_vv:
                 if vl[i]:
                     x = v[i]
-                    vals.append(x.item() if hasattr(x, "item") else x)
+                    x = x.item() if hasattr(x, "item") else x
+                    if dec_scale is not None:
+                        # exact: unscaled int / 10**scale in the decimal
+                        # domain, no float round trip
+                        x = _pydec.Decimal(int(x)).scaleb(-dec_scale)
+                    vals.append(x)
                 else:
                     vals.append(None)
                     has_null = True
@@ -86,12 +96,24 @@ class NumpyEval:
             if len(idx):
                 arr[idx] = [float(out[i]) for i in idx]
         elif fd.ret == "arg0" and e.ftype.is_decimal:
-            # results computed in the float domain scale back to the
-            # output type's fixed-point representation
+            # exact fixed-point: Decimal/int results rescale without a
+            # float round trip (MySQL half-away-from-zero on narrowing);
+            # float results (float-natured fns) round at their precision
+            import decimal as _pydec
+
             arr = np.zeros(n, np.int64)
             if len(idx):
-                m = 10 ** e.ftype.scale
-                arr[idx] = [int(round(float(out[i]) * m)) for i in idx]
+                m = e.ftype.scale
+
+                def _fix(r):
+                    if isinstance(r, float):
+                        r = _pydec.Decimal(repr(r))
+                    elif not isinstance(r, _pydec.Decimal):
+                        r = _pydec.Decimal(int(r))
+                    return int(r.scaleb(m).to_integral_value(
+                        rounding=_pydec.ROUND_HALF_UP))
+
+                arr[idx] = [_fix(out[i]) for i in idx]
         else:
             arr = np.zeros(n, np.int64)
             if len(idx):

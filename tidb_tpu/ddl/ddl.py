@@ -37,8 +37,12 @@ from ..catalog.schema import ColumnInfo, IndexInfo, TableInfo
 from ..types.field_type import FieldType, TypeKind
 
 
-class DDLError(Exception):
-    pass
+from ..errno import ER_DUP_ENTRY, ER_DUP_FIELDNAME, ER_DUP_KEYNAME, \
+    CodedError
+
+
+class DDLError(CodedError):
+    """Schema-change error; duplicate-identity sites attach 106x codes."""
 
 
 # job states (reference: model.JobState)
@@ -197,7 +201,8 @@ class DDL:
         if job.schema_state == S_NONE:
             if any(ix.name.lower() == a["name"].lower()
                    for ix in info.indices):
-                raise DDLError(f"Duplicate key name '{a['name']}'")
+                raise DDLError(f"Duplicate key name '{a['name']}'",
+                               errno=ER_DUP_KEYNAME)
             offs = []
             for cname in a["columns"]:
                 c = info.column_by_name(cname)
@@ -316,7 +321,8 @@ class DDL:
                         str(epoch.columns[off][rows[i + 1]])
                         for off in index.col_offsets)
                     raise DDLError(
-                        f"Duplicate entry '{key}' for key '{index.name}'")
+                        f"Duplicate entry '{key}' for key '{index.name}'",
+                        errno=ER_DUP_ENTRY)
             # overlay rows (small): checked against whole key space via the
             # DML-time unique checker; validate among themselves + epoch
             self._validate_overlay(snap, index, info)
@@ -350,13 +356,13 @@ class DDL:
             if seen.get(key_t, h) != h:
                 raise DDLError(
                     f"Duplicate entry '{'-'.join(map(str, key_t))}' "
-                    f"for key '{index.name}'")
+                    f"for key '{index.name}'", errno=ER_DUP_ENTRY)
             seen[key_t] = h
             hits = [x for x in searcher.eq(key_t) if int(x) != h]
             if hits:
                 raise DDLError(
                     f"Duplicate entry '{'-'.join(map(str, key_t))}' "
-                    f"for key '{index.name}'")
+                    f"for key '{index.name}'", errno=ER_DUP_ENTRY)
 
     # ---- DROP INDEX --------------------------------------------------------
     def _on_drop_index(self, job: DDLJob) -> bool:
@@ -386,7 +392,8 @@ class DDL:
         store = self.storage.table_store(info.id)
         a = job.args
         if info.column_by_name(a["name"]) is not None:
-            raise DDLError(f"Duplicate column name '{a['name']}'")
+            raise DDLError(f"Duplicate column name '{a['name']}'",
+                           errno=ER_DUP_FIELDNAME)
         ft: FieldType = a["ftype"]
         default = a.get("default")
         if default is None and not ft.nullable:

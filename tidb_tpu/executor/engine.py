@@ -30,6 +30,7 @@ from ..plan.physical import (
     PhysIndexJoin,
     PhysMergeJoin,
     PhysLimit,
+    PhysIndexMerge,
     PhysPointGet,
     PhysProjection,
     PhysSelection,
@@ -177,6 +178,8 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         return Chunk.concat(result.chunks)
     if isinstance(plan, PhysPointGet):
         return _run_point_get(plan, ctx)
+    if isinstance(plan, PhysIndexMerge):
+        return _run_index_merge(plan, ctx)
     if isinstance(plan, PhysUnion):
         return _run_union(plan, ctx)
     if isinstance(plan, PhysWindow):
@@ -244,6 +247,28 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
     raise TypeError(f"run_physical: unknown node {type(plan).__name__}")
 
 
+def _gathered_chunk(snap, gathered, col_offsets, schema, conditions,
+                    ctx: ExecContext) -> Chunk:
+    """Shared fetch tail of the point-get and index-merge readers:
+    assemble gathered columns into a chunk and apply the residual
+    filter engine-side."""
+    columns = []
+    for (data, valid), off, f in zip(gathered, col_offsets,
+                                     schema.fields):
+        columns.append(Column(f.ftype, data,
+                              None if valid.all() else valid,
+                              snap.dictionaries[off]))
+    chunk = Chunk(columns)
+    if conditions and chunk.num_rows:
+        ev = _evaluator(chunk)
+        mask = np.ones(chunk.num_rows, dtype=bool)
+        for c in conditions:
+            v, vl = ev.eval(_subst_subq(c, ctx))
+            mask &= _truthy(np.asarray(v)) & vl
+        chunk = chunk.take(np.nonzero(mask)[0])
+    return chunk
+
+
 def _run_point_get(plan: PhysPointGet, ctx: ExecContext) -> Chunk:
     """Fetch rows by handle / unique key, then apply the residual filter
     (reference: executor/point_get.go Next; batch_point_get.go)."""
@@ -258,21 +283,36 @@ def _run_point_get(plan: PhysPointGet, ctx: ExecContext) -> Chunk:
     else:
         handles, gathered = probe_and_gather(snap, plan.ranges,
                                              plan.col_offsets)
-    columns = []
-    for (data, valid), off, f in zip(gathered, plan.col_offsets,
-                                     plan.schema.fields):
-        columns.append(Column(f.ftype, data,
-                              None if valid.all() else valid,
-                              snap.dictionaries[off]))
-    chunk = Chunk(columns)
-    if plan.conditions and chunk.num_rows:
-        ev = _evaluator(chunk)
-        mask = np.ones(chunk.num_rows, dtype=bool)
-        for c in plan.conditions:
-            v, vl = ev.eval(_subst_subq(c, ctx))
-            mask &= _truthy(np.asarray(v)) & vl
-        chunk = chunk.take(np.nonzero(mask)[0])
-    return chunk
+    return _gathered_chunk(snap, gathered, plan.col_offsets, plan.schema,
+                           plan.conditions, ctx)
+
+
+def _run_index_merge(plan: "PhysIndexMerge", ctx: ExecContext) -> Chunk:
+    """Union every branch's handle set, gather once, re-check the full
+    filter (reference: executor/index_merge_reader.go — the partial
+    workers' union then table fetch, collapsed to vector ops). A branch
+    with index=None carries literal pk-handle points."""
+    from ..store.index import IndexSearcher
+
+    snap = ctx.txn.snapshot(plan.table.id)
+    found: list[np.ndarray] = []
+    for r in plan.branches:
+        if r.index is None:
+            hs = np.array([h for (h,) in r.points if snap.has_handle(h)],
+                          dtype=np.int64)
+            found.append(hs)
+            continue
+        searcher = IndexSearcher(snap.store, snap, r.index)
+        if r.interval is not None:
+            lo, hi, li, hi_i = r.interval
+            found.append(searcher.range(lo, hi, li, hi_i))
+        else:
+            found.extend(searcher.eq(p) for p in r.points)
+    handles = (np.unique(np.concatenate(found)) if found
+               else np.empty(0, dtype=np.int64))
+    gathered = snap.gather(handles, plan.col_offsets)
+    return _gathered_chunk(snap, gathered, plan.col_offsets, plan.schema,
+                           plan.conditions, ctx)
 
 
 def _empty_like(plan: PhysicalPlan) -> Chunk:

@@ -43,7 +43,8 @@ class TSO:
 
 
 class CommitError(Exception):
-    pass
+    errno = 9007  # ER_WRITE_CONFLICT (tidb_tpu/errno.py)
+    sqlstate = "HY000"
 
 
 class LockResolver:
@@ -186,9 +187,11 @@ class TwoPhaseCommitter:
                 if resolver.resolve(e.lock):
                     continue
                 if time.monotonic() >= deadline:
-                    raise CommitError(
+                    err = CommitError(
                         "Lock wait timeout exceeded; try restarting "
-                        "transaction") from None
+                        "transaction")
+                    err.errno = 1205  # ER_LOCK_WAIT_TIMEOUT
+                    raise err from None
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.05)
 

@@ -59,8 +59,12 @@ _CMP_SWAP = {"eq": "eq", "ne": "ne", "lt": "gt", "le": "ge", "gt": "lt",
              "ge": "le"}
 
 
-class PlanError(Exception):
-    pass
+from ..errno import ER_BAD_FIELD, CodedError
+from ..errno import wrap as err_wrap
+
+
+class PlanError(CodedError):
+    """Planner error; name-resolution sites attach 1054/1146 etc."""
 
 
 def ast_key(node: object) -> str:
@@ -247,7 +251,7 @@ class PlanBuilder:
             view = self._lookup_view(db, tn.name)
             if view is not None:
                 return self._expand_view(db, tn, view)
-            raise PlanError(str(e)) from None
+            raise err_wrap(PlanError, e) from None
         alias = (tn.alias or tn.name).lower()
         fields = [
             ResultField(c.name.lower(), c.ftype, alias, source_offset=c.offset)
@@ -403,7 +407,8 @@ class PlanBuilder:
                                str(node))
                 idx = outer.resolve(node.name, node.table)
                 if idx is None:
-                    raise PlanError(f"unknown column {node}")
+                    raise PlanError(f"unknown column {node}",
+                                    errno=ER_BAD_FIELD)
                 return Col(idx, outer.fields[idx].ftype, str(node))
             return self._resolve_composite(node, r_scoped)
 
@@ -465,7 +470,7 @@ class PlanBuilder:
                             except (PlanError, KeyError):
                                 self.resolve(call.args[0], comb)
             except KeyError as e:
-                raise PlanError(str(e)) from None
+                raise err_wrap(PlanError, e) from None
             const = Const(0 if anti else 1, FieldType(TypeKind.BOOLEAN))
             return LogicalSelection([const], plan.schema, [plan])
         if sub.group_by or sub.having or sub.limit is not None or \
@@ -532,7 +537,7 @@ class PlanBuilder:
                     sub.fields[0].expr,
                     PlanSchema(plan.schema.fields + splan.schema.fields))
             except KeyError as e:
-                raise PlanError(str(e)) from None
+                raise err_wrap(PlanError, e) from None
         if not isinstance(rhs, Col) or rhs.idx < len(plan.schema):
             raise PlanError("correlated IN subquery selects a non-column")
         if anti and (lhs.ftype.nullable or rhs.ftype.nullable):
@@ -984,7 +989,8 @@ class PlanBuilder:
             if isinstance(node, ast.ColumnRef):
                 idx = schema.resolve(node.name, node.table)
                 if idx is None:
-                    raise PlanError(f"unknown column {node}")
+                    raise PlanError(f"unknown column {node}",
+                                    errno=ER_BAD_FIELD)
                 return Col(idx, schema.fields[idx].ftype, str(node))
             return self._resolve_composite(node, r)
 
@@ -1093,7 +1099,7 @@ class PlanBuilder:
             try:
                 ftype = arith_result_type(tag, a.ftype, b.ftype)
             except ExprError as e:
-                raise PlanError(str(e)) from None
+                raise err_wrap(PlanError, e) from None
             return _fold(Call(tag, [a, b], ftype))
         raise PlanError(f"unsupported operator {op}")
 

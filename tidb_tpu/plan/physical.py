@@ -78,6 +78,24 @@ class PhysPointGet(PhysicalPlan):
 
 
 @dataclass
+class PhysIndexMerge(PhysicalPlan):
+    """Union several index paths' handle sets, fetch once, re-check the
+    full filter (reference: executor/index_merge_reader.go; planned by
+    generateIndexMergePath, planner/core/stats.go). Chosen when the
+    filter has one OR conjunct whose EVERY disjunct is servable by some
+    index — each branch over-approximates its disjunct, so the union
+    over-approximates the OR and the residual filter restores exactness."""
+
+    table: object  # TableInfo
+    col_offsets: list[int]
+    branches: list[object]  # one ScanRanges per OR disjunct
+    conditions: list[PlanExpr]  # FULL conjunct list, re-checked on fetch
+    schema: PlanSchema
+    children: list[PhysicalPlan] = field(default_factory=list)
+    est_rows: Optional[float] = None
+
+
+@dataclass
 class PhysSelection(PhysicalPlan):
     conditions: list[PlanExpr]
     schema: PlanSchema
@@ -697,6 +715,97 @@ def _access_path(scan_offsets: list[int], table, conditions, stats=None,
     return None
 
 
+MERGE_SEL_LIMIT = 0.3  # union of branch estimates vs full scan
+
+
+def _flatten_bool(e: PlanExpr, op: str) -> list[PlanExpr]:
+    if isinstance(e, Call) and e.op == op:
+        out: list[PlanExpr] = []
+        for a in e.args:
+            out.extend(_flatten_bool(a, op))
+        return out
+    return [e]
+
+
+def _index_merge_path(scan_offsets: list[int], table, conditions,
+                      stats=None, scan=None):
+    """(branches, est) for an index-merge UNION read, or None.
+
+    Looks for ONE conjunct that is an OR whose every disjunct (itself a
+    conjunction) is servable by an index equality-point set — or by the
+    pk-handle column. Estimates sum per-branch; with statistics the sum
+    must clear MERGE_SEL_LIMIT (without them, points-only branches are
+    allowed on the same heuristic as the single-index path). Reference:
+    planner/core/stats.go generateIndexMergePath + its accessPaths-per-
+    disjunct check."""
+    from .ranger import _eq_values, extract_points
+
+    use_hint = [n.lower() for n in
+                getattr(scan, "hint_use_index", [])] if scan else []
+    ignore_hint = {n.lower() for n in
+                   getattr(scan, "hint_ignore_index", [])} if scan else set()
+    col_map = {i: off for i, off in enumerate(scan_offsets)}
+    or_cond = None
+    for c in conditions:
+        if isinstance(c, Call) and c.op == "or":
+            if _has_subq(c):
+                return None
+            if or_cond is not None:
+                return None  # one mergeable OR at a time (ref parity)
+            or_cond = c
+    if or_cond is None:
+        return None
+    disjuncts = _flatten_bool(or_cond, "or")
+    if len(disjuncts) < 2:
+        return None
+    ts = stats.table_stats(table.id) if stats is not None else None
+    branches = []
+    total_est = 0.0 if ts is not None else None
+    for d in disjuncts:
+        conjs = _flatten_bool(d, "and")
+        # pk-handle branch: col = const / IN on the handle column
+        handle_rng = None
+        if table.pk_handle_offset is not None:
+            for c in conjs:
+                hit = _eq_values(c, col_map)
+                if hit is not None and hit[0] == table.pk_handle_offset:
+                    from .ranger import ScanRanges
+                    handle_rng = ScanRanges(
+                        None, [(int(v),) for v in hit[1]])
+                    break
+        best = None
+        for index in table.indices:
+            if not index.visible or index.name.lower() in ignore_hint:
+                continue
+            if use_hint and index.name.lower() not in use_hint:
+                continue
+            r = extract_points(table, index, conjs, col_map)
+            if r is None or not r.points:
+                continue
+            depth = len(r.points[0])
+            if best is None or depth > len(best.points[0]) or (
+                    depth == len(best.points[0])
+                    and len(r.points) < len(best.points)):
+                best = r
+        if best is None:
+            best = handle_rng
+        if best is None:
+            return None  # a disjunct with no index: merge can't win
+        branches.append(best)
+        if ts is not None:
+            if best.index is None:
+                total_est += len(best.points)
+            else:
+                off0 = best.index.col_offsets[0]
+                total_est += sum(
+                    stats.est_eq_rows(table.id, off0, p[0], ts.row_count)
+                    for p in best.points)
+    if ts is not None and total_est > ts.row_count * MERGE_SEL_LIMIT \
+            and not use_hint:
+        return None
+    return branches, total_est
+
+
 def conds_digest(conditions: list[PlanExpr]) -> str:
     """Stable identity of a conjunct set (feedback keying)."""
     return "&".join(sorted(repr(c) for c in conditions))
@@ -790,6 +899,13 @@ def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
                 child.dag.selection = DAGSelection(list(plan.conditions))
                 child.est_rows = est
                 return child
+            im = _index_merge_path(child.dag.scan.col_offsets, scan.table,
+                                   plan.conditions, stats, scan=scan)
+            if im is not None:
+                branches, est = im
+                return PhysIndexMerge(
+                    scan.table, child.dag.scan.col_offsets, branches,
+                    list(plan.conditions), plan.schema, est_rows=est)
         if (
             isinstance(child, PhysTableRead)
             and _bare_scan(child)
@@ -1036,6 +1152,16 @@ def explain_plan(plan: PhysicalPlan, depth: int = 0) -> list[str]:
         else:
             what = plan.ranges.describe()
         line = f"{pad}PointGet: {plan.table.name} {what}"
+    elif isinstance(plan, PhysIndexMerge):
+        parts = []
+        for r in plan.branches:
+            if r.index is None:
+                parts.append(f"handle[{len(r.points)} pts]")
+            else:
+                parts.append(r.describe())
+        est = f" est={plan.est_rows:.0f}" if plan.est_rows is not None else ""
+        line = (f"{pad}IndexMerge(union): {plan.table.name} "
+                f"{' | '.join(parts)}{est}")
     elif isinstance(plan, PhysHashAgg):
         line = (f"{pad}HashAgg({plan.mode}): groups={len(plan.group_by)} "
                 f"aggs={plan.aggs}")

@@ -18,7 +18,7 @@ from typing import TYPE_CHECKING, Optional
 
 from ..session.session import ResultSet, Session, SQLError
 from . import packet as P
-from .errors import classify
+from ..errno import error_of
 
 if TYPE_CHECKING:
     from .server import Server
@@ -193,7 +193,7 @@ class ClientConn:
         try:
             rs = self.session.execute(sql)
         except Exception as e:  # noqa: BLE001 - wire boundary catches all
-            code, state = classify(str(e))
+            code, state = error_of(e)
             self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._write_resultset(rs)
@@ -219,7 +219,7 @@ class ClientConn:
         try:
             sid, n_params = self.session.prepare(sql)
         except Exception as e:  # noqa: BLE001 - wire boundary
-            code, state = classify(str(e))
+            code, state = error_of(e)
             self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._stmt_meta[sid] = (n_params, None)
@@ -247,7 +247,7 @@ class ClientConn:
                 self._stmt_meta[sid] = (n_params, types)
             rs = self.session.execute_prepared(sid, params)
         except Exception as e:  # noqa: BLE001 - wire boundary
-            code, state = classify(str(e))
+            code, state = error_of(e)
             self.io.write_packet(P.err_packet(code, str(e), state))
             return True
         self._write_resultset(rs, binary=True)

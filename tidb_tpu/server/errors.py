@@ -5,9 +5,13 @@ Counterpart of the reference's errno package (reference: errno/errcode.go
 these codes (duplicate-key retry loops look for 1062, ORMs probe 1146,
 migration tools parse 1064), so the generic 1105 catch-all breaks them.
 
-Engine errors carry text, not codes, so the classifier maps message
-shapes to (errno, sqlstate); raise-site coverage is tested in
-tests/test_server.py.
+Since r05 engine errors are CodedError subclasses carrying (errno,
+sqlstate) FROM THE RAISE SITE (tidb_tpu/errno.py, the terror pattern of
+util/dbterror/terror.go); the wire layer reads the attributes via
+errno.error_of(). The regex classifier below remains ONLY as a net for
+foreign exceptions (KeyError/ValueError from library code) and is no
+longer the source of truth — rewording a message cannot change a code
+anymore.
 """
 
 from __future__ import annotations

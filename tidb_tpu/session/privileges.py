@@ -35,8 +35,12 @@ def _hash2(password: str) -> bytes:
         hashlib.sha1(password.encode("utf-8")).digest()).digest()
 
 
-class PrivilegeError(Exception):
-    pass
+from ..errno import ER_SPECIFIC_ACCESS_DENIED, CodedError
+
+
+class PrivilegeError(CodedError):
+    errno = ER_SPECIFIC_ACCESS_DENIED
+    sqlstate = "42000"
 
 
 class PrivilegeManager:

@@ -33,8 +33,27 @@ from ..types.field_type import FieldType, TypeKind
 from ..types.value import Decimal
 
 
-class SQLError(Exception):
-    pass
+from ..errno import wrap as err_wrap
+from ..errno import (
+    ER_BAD_FIELD,
+    ER_BAD_NULL,
+    ER_DUP_ENTRY,
+    ER_NO_SUCH_TABLE,
+    ER_PARSE_ERROR,
+    ER_QUERY_INTERRUPTED,
+    ER_SPECIFIC_ACCESS_DENIED,
+    ER_TABLE_EXISTS,
+    ER_TABLEACCESS_DENIED,
+    ER_UNKNOWN_SYSTEM_VARIABLE,
+    ER_VAR_READONLY,
+    ER_WRONG_VALUE_COUNT_ON_ROW,
+    CodedError,
+)
+
+
+class SQLError(CodedError):
+    """Session-layer error; raise sites attach specific errnos
+    (reference terror pattern, util/dbterror/terror.go)."""
 
 
 @dataclass
@@ -98,7 +117,8 @@ class Session:
             stmts = parse_sql(sql)
         except ParseError as e:
             self.storage.obs.query_errors.inc()
-            raise SQLError(f"parse error: {e}") from None
+            raise SQLError(f"parse error: {e}",
+                           errno=getattr(e, 'errno', ER_PARSE_ERROR)) from None
         result = ResultSet([], [])
         single = len(stmts) == 1
         for i, stmt in enumerate(stmts):
@@ -154,7 +174,8 @@ class Session:
         except interrupt.QueryInterrupted:
             failed = True
             o.query_errors.inc()
-            raise SQLError("Query execution was interrupted") from None
+            raise SQLError("Query execution was interrupted",
+                           errno=ER_QUERY_INTERRUPTED) from None
         except Exception:
             failed = True
             o.query_errors.inc()
@@ -188,7 +209,8 @@ class Session:
             parser = Parser(sql)
             stmts = parser.parse()
         except ParseError as e:
-            raise SQLError(f"parse error: {e}") from None
+            raise SQLError(f"parse error: {e}",
+                           errno=getattr(e, 'errno', ER_PARSE_ERROR)) from None
         if len(stmts) != 1:
             raise SQLError("prepared statement must be a single statement")
         self._next_stmt_id += 1
@@ -268,7 +290,7 @@ class Session:
                 self.storage.privileges.create_user(
                     stmt.name, stmt.password, stmt.if_not_exists)
             except PrivilegeError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
             return ResultSet([], [])
         if isinstance(stmt, ast.DropUserStmt):
             self._require_super()
@@ -276,7 +298,7 @@ class Session:
             try:
                 self.storage.privileges.drop_user(stmt.name, stmt.if_exists)
             except PrivilegeError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
             return ResultSet([], [])
         if isinstance(stmt, ast.GrantStmt):
             self._require_super()
@@ -290,7 +312,7 @@ class Session:
                     self.storage.privileges.grant(
                         stmt.privs, db, stmt.table, stmt.user)
             except PrivilegeError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
             return ResultSet([], [])
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
             return self._run_in_txn(lambda: self._exec_select(stmt))
@@ -406,10 +428,12 @@ class Session:
                     else:
                         self.vars[name] = value
                     continue
-                raise SQLError(f"Unknown system variable '{name}'")
+                raise SQLError(f"Unknown system variable '{name}'",
+                           errno=ER_UNKNOWN_SYSTEM_VARIABLE)
             if sv.read_only:
                 raise SQLError(
-                    f"Variable '{name}' is a read only variable")
+                    f"Variable '{name}' is a read only variable",
+                    errno=ER_VAR_READONLY)
             if isinstance(expr, ast.Literal) and expr.tag == "default":
                 value = sv.default
             if scope == "GLOBAL":
@@ -454,7 +478,8 @@ class Session:
             return self.vars[name]
         v = self.storage.sysvars.get_global(name)
         if v is None and name not in SYSVARS:
-            raise SQLError(f"Unknown system variable '{name}'")
+            raise SQLError(f"Unknown system variable '{name}'",
+                           errno=ER_UNKNOWN_SYSTEM_VARIABLE)
         return v
 
     def _bind_vars(self, node):
@@ -520,7 +545,7 @@ class Session:
             try:
                 v = self.storage.sequence_next(seq)
             except ValueError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
             self._seq_lastval = v
             return v
         if name == "LASTVAL":
@@ -624,7 +649,8 @@ class Session:
                 self.user, "ALL", "*", "*"):
             raise SQLError(
                 f"Access denied; you need SUPER privilege(s) "
-                f"for this operation (user '{self.user}')")
+                f"for this operation (user '{self.user}')",
+                errno=ER_SPECIFIC_ACCESS_DENIED)
 
     @staticmethod
     def _collect_table_names(stmt) -> list[ast.TableName]:
@@ -657,7 +683,7 @@ class Session:
         def deny(priv: str, obj: str):
             raise SQLError(
                 f"{priv} command denied to user '{self.user}' "
-                f"for table '{obj}'")
+                f"for table '{obj}'", errno=ER_TABLEACCESS_DENIED)
 
         if isinstance(stmt, ast.TraceStmt):
             # TRACE runs the target for real: same checks as running it
@@ -718,7 +744,7 @@ class Session:
         try:
             ddl.run_job(job)
         except DDLError as e:
-            raise SQLError(str(e)) from None
+            raise err_wrap(SQLError, e) from None
         return ResultSet([], [])
 
     def _exec_alter(self, stmt: ast.AlterTableStmt) -> ResultSet:
@@ -894,7 +920,7 @@ class Session:
                 raise SQLError("view definition must be one SELECT")
             plan = PlanBuilder(self.catalog, db).build_select(stmts[0])
         except PlanError as e:
-            raise SQLError(str(e)) from None
+            raise err_wrap(SQLError, e) from None
         if columns and len(columns) != len(plan.schema.fields):
             raise SQLError("view column list length mismatch")
 
@@ -938,7 +964,7 @@ class Session:
             try:
                 txn.commit()
             except WriteConflictError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
         else:
             txn.rollback()
 
@@ -1029,7 +1055,7 @@ class Session:
                 stmt)
             return optimize(logical, self.storage.stats)
         except PlanError as e:
-            raise SQLError(str(e)) from None
+            raise err_wrap(SQLError, e) from None
 
     # ==================== DML ====================
     def _exec_insert(self, stmt: ast.InsertStmt) -> ResultSet:
@@ -1044,7 +1070,8 @@ class Session:
         else:
             for value_row in stmt.rows:
                 if len(value_row) != len(col_order):
-                    raise SQLError("column count doesn't match value count")
+                    raise SQLError("column count doesn't match value count",
+                                   errno=ER_WRONG_VALUE_COUNT_ON_ROW)
                 rows.append([self._eval_value(e) for e in value_row])
 
         # pessimistic txns lock + duplicate-check at the latest committed
@@ -1071,7 +1098,8 @@ class Session:
             count = 0
             for rv in rows:
                 if len(rv) != len(col_order):
-                    raise SQLError("column count doesn't match value count")
+                    raise SQLError("column count doesn't match value count",
+                                   errno=ER_WRONG_VALUE_COUNT_ON_ROW)
                 full = self._complete_row(info, col_order, rv, store)
                 handle = self._row_handle(info, full, store)
                 enc = store.encode_row(full)
@@ -1083,7 +1111,7 @@ class Session:
                     try:
                         tid = part.route(enc[part.col_offset]).id
                     except ValueError as e:
-                        raise SQLError(str(e)) from None
+                        raise err_wrap(SQLError, e) from None
                 else:
                     tid = info.id
                 tinfo = children[tid][0]
@@ -1111,7 +1139,7 @@ class Session:
                             continue
                         except (Storage.DeadlockError,
                                 Storage.LockWaitTimeout) as e:
-                            raise SQLError(str(e)) from None
+                            raise err_wrap(SQLError, e) from None
                         if waited:
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
                             checkers.clear()
@@ -1144,7 +1172,8 @@ class Session:
                         continue  # the new row itself is not inserted
                     if not stmt.is_replace:
                         raise SQLError(
-                            checker.dup_message(handle, enc, conflicts))
+                            checker.dup_message(handle, enc, conflicts),
+                            errno=ER_DUP_ENTRY)
                     for h in conflicts:
                         txn.delete_row(tid, h)
                         checker.note_delete(h)
@@ -1191,7 +1220,8 @@ class Session:
         for a in stmt.on_dup:
             target = col_by_name.get(a.column.name.lower())
             if target is None:
-                raise SQLError(f"unknown column {a.column.name}")
+                raise SQLError(f"unknown column {a.column.name}",
+                               errno=ER_BAD_FIELD)
             ci = target.offset
             col_ft = target.ftype
             # col = VALUES(col2): direct host-value re-encode (keeps
@@ -1211,7 +1241,7 @@ class Session:
                 try:
                     pe = builder.resolve(expr_ast, scan.schema)
                 except PlanError as e:
-                    raise SQLError(str(e)) from None
+                    raise err_wrap(SQLError, e) from None
                 if col_ft.is_string:
                     sv, svl = ev.eval_str(pe)
                     d = store.dictionaries[ci]
@@ -1300,7 +1330,8 @@ class Session:
                 conf = checker.conflicts(new_handle, phys)
                 if conf:
                     raise SQLError(
-                        checker.dup_message(new_handle, phys, conf))
+                        checker.dup_message(new_handle, phys, conf),
+                        errno=ER_DUP_ENTRY)
                 tstore.note_handle(new_handle)
                 # the shared allocator must never re-issue this handle
                 _, alloc_store = self._table_for(stmt.table)
@@ -1330,7 +1361,8 @@ class Session:
         for a in stmt.assignments:
             ci = scan.schema.resolve(a.column.name, a.column.table)
             if ci is None:
-                raise SQLError(f"unknown column {a.column}")
+                raise SQLError(f"unknown column {a.column}",
+                               errno=ER_BAD_FIELD)
             assigns[ci] = builder.resolve(a.value, scan.schema)
         # evaluate each assignment once over the whole snapshot, in the
         # column's own physical domain
@@ -1400,7 +1432,7 @@ class Session:
                 try:
                     target_id = part.route(phys[part.col_offset]).id
                 except ValueError as e:
-                    raise SQLError(str(e)) from None
+                    raise err_wrap(SQLError, e) from None
             if target_id != info.id:
                 # cross-partition move: delete here, apply after every
                 # partition scanned (uniqueness checked at apply time)
@@ -1488,7 +1520,7 @@ class Session:
                 continue  # newer commit: rescan at a fresh for_update_ts
             except (Storage.DeadlockError,
                     Storage.LockWaitTimeout) as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
         raise SQLError("pessimistic lock retries exhausted")
 
     def _where_mask(self, info: TableInfo, table: ast.TableName,
@@ -1537,7 +1569,8 @@ class Session:
         for n in names:
             c = info.column_by_name(n)
             if c is None:
-                raise SQLError(f"unknown column {n}")
+                raise SQLError(f"unknown column {n}",
+                               errno=ER_BAD_FIELD)
             out.append(c.offset)
         return out
 
@@ -1556,11 +1589,13 @@ class Session:
             elif c.auto_increment:
                 full[c.offset] = store.alloc_handle()
             elif not c.nullable:
-                raise SQLError(f"column {c.name} cannot be null")
+                raise SQLError(f"column {c.name} cannot be null",
+                               errno=ER_BAD_NULL)
         for c in info.columns:
             if full[c.offset] is None and not c.nullable and \
                     not c.auto_increment:
-                raise SQLError(f"column {c.name} cannot be null")
+                raise SQLError(f"column {c.name} cannot be null",
+                               errno=ER_BAD_NULL)
         return full
 
     def _row_handle(self, info: TableInfo, row: list[Any],
@@ -1646,7 +1681,8 @@ class Session:
                 hit = next((c for c in columns
                             if c.name.lower() == cn.lower()), None)
                 if hit is None:
-                    raise SQLError(f"unknown column {cn} in foreign key")
+                    raise SQLError(f"unknown column {cn} in foreign key",
+                               errno=ER_BAD_FIELD)
                 offs.append(hit.offset)
             if len(offs) != len(fk.ref_columns):
                 raise SQLError(
@@ -1667,7 +1703,7 @@ class Session:
         try:
             created = self.catalog.add_table(db, info, stmt.if_not_exists)
         except KeyError as e:
-            raise SQLError(str(e)) from None
+            raise err_wrap(SQLError, e) from None
         if created:
             self.storage.register_table(info)
         return ResultSet([], [])
@@ -1731,7 +1767,7 @@ class Session:
             try:
                 info = self.catalog.drop_table(db, tn.name, stmt.if_exists)
             except KeyError as e:
-                raise SQLError(str(e)) from None
+                raise err_wrap(SQLError, e) from None
             if info is not None:
                 part = getattr(info, "partition", None)
                 ids = [d.id for d in part.defs] if part is not None \
@@ -1756,7 +1792,8 @@ class Session:
         if key in seqs or self.catalog.try_table(db, stmt.name.name):
             if stmt.if_not_exists:
                 return ResultSet([], [])
-            raise SQLError(f"table exists: {db}.{stmt.name.name}")
+            raise SQLError(f"table exists: {db}.{stmt.name.name}",
+                           errno=ER_TABLE_EXISTS)
         seqs[key] = SequenceInfo(
             id=self.catalog.alloc_id(), name=stmt.name.name,
             start=stmt.start, increment=stmt.increment,
@@ -1773,7 +1810,8 @@ class Session:
             if tn.name.lower() not in seqs:
                 if stmt.if_exists:
                     continue
-                raise SQLError(f"unknown table: {db}.{tn.name}")
+                raise SQLError(f"unknown table: {db}.{tn.name}",
+                               errno=ER_NO_SUCH_TABLE)
             del seqs[tn.name.lower()]
         self.catalog.bump_version()
         return ResultSet([], [])
@@ -2008,7 +2046,7 @@ class Session:
         try:
             info = self.catalog.table(db, tn.name)
         except KeyError as e:
-            raise SQLError(str(e)) from None
+            raise err_wrap(SQLError, e) from None
         part = getattr(info, "partition", None)
         if part is not None:
             # first partition's store: the shared allocator + shared

@@ -14,6 +14,9 @@ from typing import Iterator, Optional
 
 
 class LexError(Exception):
+    errno = 1064  # ER_PARSE_ERROR
+    sqlstate = "42000"
+
     def __init__(self, msg: str, pos: int) -> None:
         super().__init__(f"{msg} at position {pos}")
         self.pos = pos

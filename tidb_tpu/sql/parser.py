@@ -58,6 +58,9 @@ _TYPE_KEYWORDS = {
 
 
 class ParseError(Exception):
+    errno = 1064  # ER_PARSE_ERROR (tidb_tpu/errno.py; avoids the import)
+    sqlstate = "42000"
+
     def __init__(self, msg: str, token: Token) -> None:
         where = f"near {token.text!r}" if token.text else "at end of input"
         super().__init__(f"{msg} {where} (pos {token.pos})")

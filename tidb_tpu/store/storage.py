@@ -39,8 +39,13 @@ from ..kv.twopc import CommitError, TwoPhaseCommitter
 from .table_store import TableSnapshot, TableStore
 
 
-class WriteConflictError(Exception):
+from ..errno import ER_SCHEMA_CHANGED, ER_WRITE_CONFLICT, CodedError
+
+
+class WriteConflictError(CodedError):
     """Another txn committed to a key after our start_ts (optimistic SI)."""
+
+    errno = ER_WRITE_CONFLICT
 
 
 def _make_engine(path: Optional[str] = None):
@@ -575,11 +580,12 @@ class Storage:
         return Transaction(self, self.acquire_snapshot_ts(),
                            pessimistic=pessimistic)
 
-    class DeadlockError(Exception):
-        pass
+    class DeadlockError(CodedError):
+        errno = 1213  # ER_LOCK_DEADLOCK
+        sqlstate = "40001"
 
-    class LockWaitTimeout(Exception):
-        pass
+    class LockWaitTimeout(CodedError):
+        errno = 1205  # ER_LOCK_WAIT_TIMEOUT
 
     def pessimistic_lock_keys(self, txn: "Transaction", keys: list[bytes],
                               timeout_s: float = 50.0) -> bool:
@@ -685,7 +691,8 @@ class Storage:
             # between our buffering and this encode
             raise WriteConflictError(
                 "Information schema is changed during the execution "
-                "of the statement; try again") from None
+                "of the statement; try again",
+                errno=ER_SCHEMA_CHANGED) from None
         # pessimistic guards on unwritten keys commit as lock-only
         # records so 2PC clears them atomically (reference: OP_LOCK
         # mutations through prewrite; kv/memdb lock-only entries)
@@ -936,7 +943,8 @@ class Storage:
             if store is not None and store.schema_token != token:
                 raise WriteConflictError(
                     "Information schema is changed during the execution "
-                    "of the statement; try again")
+                    "of the statement; try again",
+                    errno=ER_SCHEMA_CHANGED)
 
     # ---- meta KV (schema/stats persistence plane) ----------------------
     def put_meta(self, name: bytes, value: bytes) -> None:

@@ -31,6 +31,9 @@ class QueryMemExceeded(Exception):
     """Raised when a query's working set exceeds tidb_mem_quota_query and
     the operator cannot (or may not) spill."""
 
+    errno = 8175  # ER_QUERY_MEM_EXCEEDED
+    sqlstate = "HY000"
+
     def __init__(self, label: str, need: int, quota: int) -> None:
         super().__init__(
             f"Out Of Memory Quota![conn] operator {label} needs {need} "
