@@ -93,14 +93,19 @@ def generate_lineitem_arrays(n_rows: int, seed: int = 42) -> dict[str, np.ndarra
     tax = rng.integers(0, 9, n_rows, dtype=np.int64)  # 0.00..0.08
     start = parse_date("1992-01-02")
     end = parse_date("1998-12-01")
-    shipdate = rng.integers(start, end + 1, n_rows, dtype=np.int64)
-    commitdate = shipdate + rng.integers(-30, 31, n_rows)
-    receiptdate = shipdate + rng.integers(1, 31, n_rows)
+    # dates/flags in the store's host dtypes (DATE=int32, dict
+    # code=int32-able int8): bulk_load adopts without an int64->int32
+    # cast copy, and the caller's oracle copy stays small (the r05 SF100
+    # flight died of exactly these duplications)
+    shipdate = rng.integers(start, end + 1, n_rows, dtype=np.int32)
+    commitdate = shipdate + rng.integers(-30, 31, n_rows, dtype=np.int32)
+    receiptdate = shipdate + rng.integers(1, 31, n_rows, dtype=np.int32)
     cutoff = parse_date("1995-06-17")
     # returnflag: R/A split for old receipts, N for recent (spec-shaped)
-    ra = rng.integers(0, 2, n_rows)
-    returnflag = np.where(receiptdate <= cutoff, ra, 2)  # 0=A 1=R 2=N
-    linestatus = (shipdate > cutoff).astype(np.int64)  # 0=F 1=O
+    ra = rng.integers(0, 2, n_rows, dtype=np.int8)
+    returnflag = np.where(receiptdate <= cutoff, ra,
+                          np.int8(2)).astype(np.int8)  # 0=A 1=R 2=N
+    linestatus = (shipdate > cutoff).astype(np.int8)  # 0=F 1=O
     return {
         "l_orderkey": orderkey,
         "l_partkey": partkey,
@@ -133,9 +138,9 @@ def load_lineitem(session: "Session", n_rows: int, seed: int = 42,
     rf_dict = store.dictionaries[info.column_by_name("l_returnflag").offset]
     ls_dict = store.dictionaries[info.column_by_name("l_linestatus").offset]
     rf_codes = np.array([rf_dict.encode(c) for c in ("A", "R", "N")],
-                        dtype=np.int64)
+                        dtype=np.int32)
     ls_codes = np.array([ls_dict.encode(c) for c in ("F", "O")],
-                        dtype=np.int64)
+                        dtype=np.int32)
     arrays = dict(arrays)
     arrays["l_returnflag"] = rf_codes[arrays["l_returnflag"]]
     arrays["l_linestatus"] = ls_codes[arrays["l_linestatus"]]

@@ -368,7 +368,6 @@ class Storage:
                 handles=handles,
                 columns=columns,
                 valids=valids,
-                handle_pos={int(h): i for i, h in enumerate(handles)},
             )
             store.restore_epoch(epoch, dicts, int(z["next_handle"]))
 

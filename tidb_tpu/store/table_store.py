@@ -43,6 +43,57 @@ def _column_dictionary(ftype) -> Optional[Dictionary]:
 _epoch_ids = itertools.count(1)
 
 
+class HandleIndex:
+    """handle -> row-position map over an epoch's handle array.
+
+    Replaces the eager {int(h): i} dict, whose 180M-entry incarnation
+    cost ~15GB of small-int objects at bench scale (the r05 SF100 OOM).
+    Nothing is built until the first point lookup: bulk-load + scan
+    workloads never pay. Contiguous handles (the bulk-load shape) answer
+    with arithmetic; anything else argsorts once and binary-searches."""
+
+    __slots__ = ("_handles", "_mode", "_base", "_sorted", "_order")
+
+    def __init__(self, handles: np.ndarray) -> None:
+        self._handles = handles
+        self._mode: Optional[str] = None
+
+    def _resolve(self) -> None:
+        h = self._handles
+        n = len(h)
+        if n == 0:
+            self._mode = "empty"
+            return
+        base = int(h[0])
+        if int(h[-1]) - base == n - 1 and bool(
+                (h == np.arange(base, base + n, dtype=np.int64)).all()):
+            self._base = base
+            self._mode = "contig"
+            return
+        self._order = np.argsort(h, kind="stable")
+        self._sorted = h[self._order]
+        self._mode = "sorted"
+
+    def get(self, handle: int, default=None):
+        if self._mode is None:
+            self._resolve()
+        if self._mode == "empty":
+            return default
+        if self._mode == "contig":
+            i = handle - self._base
+            return int(i) if 0 <= i < len(self._handles) else default
+        j = int(np.searchsorted(self._sorted, handle))
+        if j < len(self._sorted) and int(self._sorted[j]) == handle:
+            return int(self._order[j])
+        return default
+
+    def __contains__(self, handle: int) -> bool:
+        return self.get(handle) is not None
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+
 @dataclass
 class ColumnEpoch:
     """Immutable columnar snapshot of all rows folded up to fold_ts."""
@@ -52,7 +103,13 @@ class ColumnEpoch:
     handles: np.ndarray  # int64[n]
     columns: list[np.ndarray]  # physical data per table column
     valids: list[Optional[np.ndarray]]  # None = all valid
-    handle_pos: dict[int, int] = field(default_factory=dict)  # handle -> row
+    # handle -> row position; built lazily from handles when not carried
+    # over from a predecessor epoch with identical handles
+    handle_pos: Optional[HandleIndex] = None
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.handle_pos, HandleIndex):
+            self.handle_pos = HandleIndex(self.handles)
 
     @property
     def num_rows(self) -> int:
@@ -377,7 +434,6 @@ class TableStore:
                 handles=all_handles,
                 columns=new_cols,
                 valids=new_valids,
-                handle_pos={int(h): i for i, h in enumerate(all_handles)},
             )
         self._epoch_changed()
 
@@ -550,7 +606,6 @@ class TableStore:
                 handles=handles,
                 columns=columns,
                 valids=valids,
-                handle_pos={int(h): i for i, h in enumerate(handles)},
             )
             self.epoch = new_epoch
             self.deltas = remaining
