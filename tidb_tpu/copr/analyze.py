@@ -9,7 +9,7 @@ query path stages (cached device columns are reused), producing
 
   * non-null row count,
   * min / max,
-  * 64 HLL-style registers from a 32-bit splitmix hash (the device is
+  * 256 HLL-style registers from a 32-bit splitmix hash (the device is
     64-bit-free) — the NDV estimator that replaces a host np.unique over
     the full column.
 
@@ -25,8 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-N_REG = 64          # HLL registers
-_REG_BITS = 6
+N_REG = 256         # HLL registers (2^8: ~6.5% standard error)
+_REG_BITS = 8
 
 # splitmix32-style avalanche (device-side; uint32 lanes)
 _M1 = np.uint32(0x85EBCA6B)
@@ -56,6 +56,62 @@ def hash32_host(x: np.ndarray) -> np.ndarray:
     return h
 
 
+def hll_bucket_rank(v32):
+    """Device (bucket, rank) per lane for HLL register updates: bucket =
+    low 8 hash bits, rank = 1 + trailing zeros of the remaining bits
+    (isolated low bit is a power of two -> exact f32 log2). Shared by
+    ANALYZE NDV and the APPROX_COUNT_DISTINCT aggregate so their sketches
+    merge."""
+    h = _hash32(v32)
+    bucket = (h & jnp.uint32(N_REG - 1)).astype(jnp.int32)
+    rest = (h >> _REG_BITS) | jnp.uint32(1 << (32 - _REG_BITS))
+    low = rest & (~rest + jnp.uint32(1))
+    rank = jnp.log2(low.astype(jnp.float32)).astype(jnp.int32) + 1
+    return bucket, rank
+
+
+def hll_bucket_rank_host(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host twin of hll_bucket_rank (bit-identical registers)."""
+    h = hash32_host(x)
+    bucket = (h & np.uint32(N_REG - 1)).astype(np.int32)
+    rest = (h >> np.uint32(_REG_BITS)) | np.uint32(1 << (32 - _REG_BITS))
+    low = rest & (~rest + np.uint32(1))
+    rank = np.log2(low.astype(np.float64)).astype(np.int32) + 1
+    return bucket, rank
+
+
+def hll_group_registers_host(av: np.ndarray, avl: np.ndarray,
+                             inv: np.ndarray, n_seg: int) -> np.ndarray:
+    """Per-group HLL registers host-side: (n_seg, N_REG) int32 max-rank,
+    bit-identical to the device scatter (copr/client.agg_partials hll
+    branch) so host-fallback partials merge with device partials."""
+    regs = np.zeros((n_seg, N_REG), np.int32)
+    rows = np.nonzero(avl)[0]
+    if len(rows):
+        bucket, rank = hll_bucket_rank_host(av[rows])
+        np.maximum.at(regs, (inv[rows], bucket), rank)
+    return regs
+
+
+def hll_pack_words(regs: np.ndarray) -> np.ndarray:
+    """(n, N_REG) int32 registers -> (n, N_REG // 8) int64 byte-packed."""
+    regs = regs.astype(np.int64)
+    words = np.zeros((regs.shape[0], N_REG // 8), np.int64)
+    for w in range(N_REG // 8):
+        for b in range(8):
+            words[:, w] |= regs[:, w * 8 + b] << (8 * b)
+    return words
+
+
+def hll_unpack_words(words: np.ndarray) -> np.ndarray:
+    """(n, N_REG // 8) int64 byte-packed -> (n, N_REG) int32 registers."""
+    out = np.zeros((words.shape[0], N_REG), np.int32)
+    for w in range(words.shape[1]):
+        for b in range(8):
+            out[:, w * 8 + b] = (words[:, w] >> (8 * b)) & 0xFF
+    return out
+
+
 def _column_partials(data, valid):
     """Reduction body for one staged column (int32/f32 + validity)."""
     v32 = data.astype(jnp.int32) if data.dtype in (
@@ -69,19 +125,14 @@ def _column_partials(data, valid):
         big = jnp.int32(2**31 - 1)
         mn = jnp.min(jnp.where(valid, v32, big))
         mx = jnp.max(jnp.where(valid, v32, -big - 1))
-    # HLL registers over a 32-bit hash: bucket = low 6 bits, rank =
+    # HLL registers over a 32-bit hash: bucket = low _REG_BITS bits, rank =
     # trailing zeros of the remaining bits + 1 (isolated low bit is a
     # power of two -> exact f32 log2)
     hsrc = jax.lax.bitcast_convert_type(v32, jnp.int32) \
         if v32.dtype == jnp.float32 else v32
-    h = _hash32(hsrc)
-    bucket = (h & jnp.uint32(N_REG - 1)).astype(jnp.int32)
-    rest = (h >> _REG_BITS) | jnp.uint32(1 << (32 - _REG_BITS))
-    low = rest & (~rest + jnp.uint32(1))
-    rank = jnp.log2(low.astype(jnp.float32)).astype(jnp.int32) + 1
+    bucket, rank = hll_bucket_rank(hsrc)
     rank = jnp.where(valid, rank, 0)
-    regs = jnp.stack([
-        jnp.max(jnp.where(bucket == b, rank, 0)) for b in range(N_REG)])
+    regs = jnp.zeros(N_REG, jnp.int32).at[bucket].max(rank)
     return {"cnt": cnt, "mn": mn, "mx": mx, "regs": regs}
 
 
@@ -98,8 +149,10 @@ def _merge(parts: list[dict]) -> dict:
 def hll_ndv(regs: np.ndarray, nonnull: float) -> int:
     """Standard HLL estimate with small-range correction."""
     m = float(N_REG)
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(
+        N_REG, 0.7213 / (1 + 1.079 / m))
     regs = np.asarray(regs, dtype=np.float64)
-    est = 0.709 * m * m / np.sum(np.exp2(-regs))
+    est = alpha * m * m / np.sum(np.exp2(-regs))
     zeros = float((regs == 0).sum())
     if est <= 2.5 * m and zeros > 0:
         est = m * np.log(m / zeros)

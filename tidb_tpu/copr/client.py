@@ -364,6 +364,12 @@ class CopClient:
                     return "min/max arg too wide for int32 device"
                 sched.append({"kind": d.func, "float": is_f})
                 needs_loop = True
+            elif d.func == "approx_count_distinct":
+                # hashes the exact int32 value; the planner already kept
+                # floats/strings host-side (plan/physical.agg_pushable)
+                if is_f or not expr_device_safe(d.arg, col_bounds):
+                    return "approx_count_distinct arg not int32-hashable"
+                sched.append({"kind": "hll"})
             else:
                 return f"agg {d.func} not on device"
 
@@ -1011,12 +1017,13 @@ class CopClient:
                         if g.idx < len(dag.scan.col_offsets) else None
                 columns.append(Column(
                     g.ftype, np.empty(0, g.ftype.np_dtype), None, dictionary))
+            from ..plan.dag import agg_partial_starts, agg_partial_width
+            starts = agg_partial_starts(
+                dag.agg.aggs, len(dag.agg.group_by))
             for ai, d in enumerate(dag.agg.aggs):
-                vt = dag.output_types[len(dag.agg.group_by) + 2 * ai]
-                columns.append(Column(vt, np.empty(0, vt.np_dtype)))
-                columns.append(Column(
-                    FieldType(TypeKind.BIGINT, nullable=False),
-                    np.empty(0, np.int64)))
+                for j in range(agg_partial_width(d)):
+                    vt = dag.output_types[starts[ai] + j]
+                    columns.append(Column(vt, np.empty(0, vt.np_dtype)))
             return Chunk(columns)
         for i, ft in enumerate(dag.output_types):
             dictionary = None
@@ -1045,13 +1052,16 @@ def _merge_tile_outs(outs: list[dict], sched) -> dict:
         return outs[0]
     minmax = {f"m{ai}": s["kind"] for ai, s in enumerate(sched)
               if s["kind"] in ("min", "max")}
+    hll_keys = {f"h{ai}" for ai, s in enumerate(sched)
+                if s["kind"] == "hll"}
     merged: dict[str, np.ndarray] = {}
     for k in outs[0]:
         vals = [np.asarray(o[k]) for o in outs]
         kind = minmax.get(k)
         if kind == "min":
             merged[k] = np.minimum.reduce(vals)
-        elif kind == "max":
+        elif kind == "max" or k in hll_keys:
+            # hll registers merge by elementwise max (sketch union)
             merged[k] = np.maximum.reduce(vals)
         elif k.startswith("f"):
             merged[k] = np.concatenate(vals, axis=0)
@@ -1117,6 +1127,21 @@ def agg_partials(agg, prepared, cards, segments, cols, mask):
                     tv.astype(jnp.int32), vseg, segments, L, one_hot=voh)
             continue
         vseg = jnp.where(vl, seg, -1)
+        if s["kind"] == "hll":
+            from .analyze import N_REG, hll_bucket_rank
+            out[f"cnt{ai}"] = SE.seg_sum_partials(
+                ones, vseg, segments, 1, one_hot=None
+                if one_hot is None else SE.make_one_hot(vseg, segments))
+            v32 = v.astype(jnp.int32) if v.dtype == jnp.bool_ else v
+            bucket, rank = hll_bucket_rank(v32)
+            # (segments, N_REG) max-rank registers. Masked/NULL rows carry
+            # seg -1, which JAX scatter WRAPS (not drops) — zero their
+            # rank so the wrapped update is a no-op against the 0-init
+            rank = jnp.where(vseg >= 0, rank, 0)
+            out[f"h{ai}"] = jnp.zeros(
+                (segments, N_REG), jnp.int32
+            ).at[jnp.maximum(vseg, 0), bucket].max(rank)
+            continue
         out[f"cnt{ai}"] = SE.seg_sum_partials(ones, vseg, segments, 1)
         if s["kind"] == "fsum":
             out[f"f{ai}"] = SE.float_seg_sums(
@@ -1170,10 +1195,27 @@ def decode_agg_partials(agg, prepared, cards, out, group_dicts,
             ft, data, None if not is_null.any() else ~is_null,
             group_dicts[gi]))
 
+    from ..plan.dag import HLL_WORDS, agg_partial_starts
+    starts = agg_partial_starts(agg.aggs, 0)  # offsets into val_types
     for ai, (d, s) in enumerate(zip(agg.aggs, sched)):
         cnt = SE.combine_partials(out[f"cnt{ai}"])[seg_idx] \
             if f"cnt{ai}" in out else rows_per_seg[seg_idx]
-        val_t = val_types[2 * ai]
+        val_t = val_types[starts[ai]]
+        if s["kind"] == "hll":
+            # byte-pack the registers into HLL_WORDS int64 words; the
+            # final merge unpacks and maxes them (executor/engine.py
+            # _merge_partials) — partials from overlay batches, partitions
+            # or host-fallback siblings union correctly
+            from .analyze import hll_pack_words
+            words = hll_pack_words(np.asarray(out[f"h{ai}"])[seg_idx])
+            for w in range(HLL_WORDS):
+                columns.append(Column(
+                    FieldType(TypeKind.BIGINT, nullable=False),
+                    words[:, w].copy()))
+            columns.append(Column(
+                FieldType(TypeKind.BIGINT, nullable=False),
+                cnt.astype(np.int64)))
+            continue
         if s["kind"] == "count":
             vcol = Column(val_t, cnt.astype(np.int64))
         elif s["kind"] == "isum":

@@ -44,6 +44,19 @@ def execute_ranged(dag: CopDAG, snap: TableSnapshot):
     return CopResult(ev.run(), is_partial_agg=dag.agg is not None)
 
 
+def _hll_partial_columns(av: np.ndarray, avl: np.ndarray,
+                         inv: np.ndarray, n_seg: int) -> list[Column]:
+    """HLL_WORDS byte-packed register word columns for one
+    approx_count_distinct aggregate (plan/dag.agg_partial_width layout),
+    hash-identical to the device sketch."""
+    from .analyze import hll_group_registers_host, hll_pack_words
+    regs = hll_group_registers_host(av, avl, inv, n_seg)
+    words = hll_pack_words(regs)
+    return [Column(FieldType(TypeKind.BIGINT, nullable=False),
+                   words[:, w].copy())
+            for w in range(words.shape[1])]
+
+
 class _HostEval(NumpyEval):
     def __init__(self, dag: CopDAG, snap: TableSnapshot,
                  cols: Optional[list[VV]] = None,
@@ -190,8 +203,19 @@ class _HostEval(NumpyEval):
                 g.ftype, gfirst.astype(g.ftype.np_dtype),
                 None if gvalid.all() else gvalid, dictionary))
         rows_per_seg = seg_sum(np.ones(len(idx), np.int64))
+        from ..plan.dag import agg_partial_starts
+        starts = agg_partial_starts(agg.aggs, ngroups_cols)
         for ai, d in enumerate(agg.aggs):
-            val_t = self.dag.output_types[ngroups_cols + 2 * ai]
+            val_t = self.dag.output_types[starts[ai]]
+            if d.func == "approx_count_distinct":
+                av, avl = self.eval(d.arg)
+                av = np.asarray(av)[idx]
+                avl = np.asarray(avl)[idx]
+                cnt = seg_sum(avl.astype(np.int64))
+                columns.extend(_hll_partial_columns(av, avl, inv, n_seg))
+                columns.append(Column(
+                    FieldType(TypeKind.BIGINT, nullable=False), cnt))
+                continue
             if d.arg is None:
                 cnt = rows_per_seg
                 val = cnt

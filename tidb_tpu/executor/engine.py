@@ -916,11 +916,29 @@ def _merge_partials(plan: PhysHashAgg, child: Chunk) -> Chunk:
                                None if valid.all() else valid,
                                src.dictionary))
 
+    from ..plan.dag import HLL_WORDS, agg_partial_starts
+    starts = agg_partial_starts(plan.aggs, ngroups)
     for ai, d in enumerate(plan.aggs):
-        vcol = child.columns[ngroups + 2 * ai]
-        ccol = child.columns[ngroups + 2 * ai + 1]
-        cnts = _seg_reduce(np.add, ccol.data.astype(np.int64), order, bounds)
         out_t = plan.schema.fields[ngroups + ai].ftype
+        if d.func == "approx_count_distinct":
+            from ..copr.analyze import hll_ndv, hll_unpack_words
+            words = np.stack(
+                [child.columns[starts[ai] + w].data.astype(np.int64)
+                 for w in range(HLL_WORDS)], axis=1)
+            ccol = child.columns[starts[ai] + HLL_WORDS]
+            cnts = _seg_reduce(np.add, ccol.data.astype(np.int64),
+                               order, bounds)
+            regs = hll_unpack_words(words)
+            merged = _seg_reduce(np.maximum, regs, order, bounds) \
+                if n else np.zeros((0, regs.shape[1]), np.int32)
+            vals = np.array(
+                [hll_ndv(merged[i], cnts[i]) if cnts[i] else 0
+                 for i in range(len(cnts))], np.int64)
+            out_cols.append(Column(out_t, vals))
+            continue
+        vcol = child.columns[starts[ai]]
+        ccol = child.columns[starts[ai] + 1]
+        cnts = _seg_reduce(np.add, ccol.data.astype(np.int64), order, bounds)
         if d.func == "count":
             out_cols.append(Column(out_t, cnts))
             continue
@@ -1012,7 +1030,7 @@ def _scalar_agg_empty_row(plan: PhysHashAgg) -> Chunk:
     cols = []
     for ai, d in enumerate(plan.aggs):
         f = plan.schema.fields[len(plan.group_by) + ai]
-        if d.func == "count":
+        if d.func in ("count", "approx_count_distinct"):
             cols.append(Column(f.ftype, np.array([0], np.int64)))
         else:
             cols.append(Column(f.ftype, np.zeros(1, f.ftype.np_dtype),
@@ -1083,6 +1101,15 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
         cnts = _seg_reduce(np.add, avl.astype(np.int64), order, bounds)
         if d.func == "count":
             out_cols.append(Column(out_t, cnts))
+            continue
+        if d.func == "approx_count_distinct":
+            from ..copr.analyze import hll_group_registers_host, hll_ndv
+            hsrc = _hll_hash_src(d, av, child)
+            regs = hll_group_registers_host(hsrc, avl, inv, n_seg)
+            vals = np.array(
+                [hll_ndv(regs[i], cnts[i]) if cnts[i] else 0
+                 for i in range(n_seg)], np.int64)
+            out_cols.append(Column(out_t, vals))
             continue
         if d.func in ("sum", "avg"):
             if np.issubdtype(av.dtype, np.floating):
@@ -1195,6 +1222,36 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
     if ngroups == 0 and (n == 0):
         return _scalar_agg_empty_row(plan)
     return Chunk(out_cols)
+
+
+def _hll_hash_src(d: AggDesc, av: np.ndarray, child: Chunk) -> np.ndarray:
+    """uint32 hash input per row for host-side APPROX_COUNT_DISTINCT.
+
+    Integers in int32 range use their low 32 bits — bit-identical to the
+    device sketch (copr/client.agg_partials), so the two paths agree.
+    Wider ints and floats fold high bits in (plain truncation would
+    collide every integral-valued double); dictionary strings hash the
+    string bytes, stable across partition dictionaries."""
+    import zlib
+    if d.arg.ftype.is_string and isinstance(d.arg, Col):
+        dct = child.columns[d.arg.idx].dictionary
+        if dct is not None and len(dct):
+            entry = np.array(
+                [zlib.crc32(s.encode("utf-8")) for s in dct.values],
+                np.uint32)
+            return entry[np.clip(av.astype(np.int64), 0, len(dct) - 1)]
+        return av.astype(np.int64).astype(np.uint32)
+    if np.issubdtype(av.dtype, np.floating):
+        norm = np.where(av == 0, 0.0, av.astype(np.float64))
+        bits = norm.view(np.uint64)
+        return ((bits ^ (bits >> np.uint64(32))) &
+                np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    v = av.astype(np.int64)
+    if len(v) and (v.min() < -(2 ** 31) or v.max() >= 2 ** 31):
+        u = v.view(np.uint64)
+        return ((u ^ (u >> np.uint64(32))) &
+                np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return v.astype(np.uint32)
 
 
 def _distinct_agg(d: AggDesc, av, avl, inv, n_seg, out_t: FieldType) -> Column:

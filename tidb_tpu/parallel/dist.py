@@ -355,12 +355,15 @@ def _collective_merge(out: dict, sched) -> dict:
     block sums are both additive)."""
     minmax_kind = {f"m{ai}": s["kind"] for ai, s in enumerate(sched)
                    if s["kind"] in ("min", "max")}
+    hll_keys = {f"h{ai}" for ai, s in enumerate(sched)
+                if s["kind"] == "hll"}
     res = {}
     for key, val in out.items():
         kind = minmax_kind.get(key)
         if kind == "min":
             res[key] = jax.lax.pmin(val, AXIS)
-        elif kind == "max":
+        elif kind == "max" or key in hll_keys:
+            # hll registers union across shards by elementwise max
             res[key] = jax.lax.pmax(val, AXIS)
         else:
             res[key] = jax.lax.psum(val, AXIS)

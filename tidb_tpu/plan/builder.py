@@ -49,7 +49,8 @@ from .schema import PlanSchema, ResultField
 _AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX",
               "GROUP_CONCAT", "STD", "STDDEV", "STDDEV_POP",
               "STDDEV_SAMP", "VARIANCE", "VAR_POP", "VAR_SAMP",
-              "BIT_AND", "BIT_OR", "BIT_XOR", "ANY_VALUE"}
+              "BIT_AND", "BIT_OR", "BIT_XOR", "ANY_VALUE",
+              "APPROX_COUNT_DISTINCT"}
 
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div",
               "DIV": "intdiv", "%": "mod"}

@@ -44,6 +44,34 @@ class DAGAggregation:
     aggs: list[AggDesc]
 
 
+# ---- partial-aggregate column layout ---------------------------------------
+# Most aggregates ship (val, cnt) column pairs from the coprocessor to the
+# final merge. APPROX_COUNT_DISTINCT ships its HLL sketch instead:
+# byte-packed max-rank registers in HLL_WORDS int64 words, then cnt — the
+# only representation that merges correctly across partial producers
+# (overlay batches, partitions, shards); a scalar estimate would not
+# (reference: executor/aggfuncs/func_hybrid_count_distinct.go keeps the
+# sketch through partial merge for the same reason).
+
+HLL_WORDS = 32  # 256 registers / 8 per int64 word (one byte per register)
+
+
+def agg_partial_width(d: AggDesc) -> int:
+    """Number of partial columns the aggregate contributes (incl. cnt)."""
+    return (HLL_WORDS + 1) if d.func == "approx_count_distinct" else 2
+
+
+def agg_partial_starts(aggs: list[AggDesc], ngroups: int) -> list[int]:
+    """Per-agg first partial-column index in the partial chunk layout
+    [group cols..., per-agg partial cols...]."""
+    starts = []
+    o = ngroups
+    for d in aggs:
+        starts.append(o)
+        o += agg_partial_width(d)
+    return starts
+
+
 @dataclass
 class DAGTopN:
     # (expr, desc) sort items over scan output, then keep n

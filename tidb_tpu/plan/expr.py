@@ -156,7 +156,9 @@ def arith_result_type(op: str, a: FieldType, b: FieldType) -> FieldType:
 
 
 def agg_result_type(func: str, arg: Optional[PlanExpr]) -> FieldType:
-    if func == "count":
+    if func in ("count", "approx_count_distinct"):
+        # reference: executor/aggfuncs/builder.go:63 buildApproxCountDistinct
+        # -> BIGINT, never NULL (0 on empty input), like COUNT
         return FieldType(TypeKind.BIGINT, nullable=False)
     assert arg is not None
     at = arg.ftype

@@ -388,7 +388,10 @@ def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
                         d.ftype, d.distinct, d.name) for d in plan.aggs]
     col = _collect_join_tree(child)
     if col is None or not agg_pushable(group_by, aggs) \
-            or any(d.distinct for d in plan.aggs):
+            or any(d.distinct for d in plan.aggs) \
+            or any(d.func == "approx_count_distinct" for d in aggs):
+        # hll sketches don't flow through the fragment partial machinery
+        # (streamseg/hcagg are sum-shaped); the scan path carries them
         return None
     if len(col.leaves) == 1:
         if not allow_single:

@@ -18,7 +18,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..types.field_type import FieldType, TypeKind
-from .dag import CopDAG, DAGAggregation, DAGScan, DAGSelection, DAGTopN, DAGLimit
+from .dag import (CopDAG, DAGAggregation, DAGScan, DAGSelection, DAGTopN,
+                  DAGLimit, HLL_WORDS)
 from .expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from .logical import (
     LogicalAggregation,
@@ -258,8 +259,18 @@ def agg_pushable(group_by: list[PlanExpr], aggs: list[AggDesc]) -> bool:
     for d in aggs:
         if d.distinct:
             return False
-        if d.func not in ("sum", "count", "avg", "min", "max"):
+        if d.func not in ("sum", "count", "avg", "min", "max",
+                          "approx_count_distinct"):
             return False
+        if d.func == "approx_count_distinct":
+            # device HLL hashes the widened int32 value; floats would hash
+            # their f32 staging (host values are f64 — sketch mismatch) and
+            # string dict codes differ across partition dictionaries, so
+            # both stay host-side
+            if d.arg is None or not expr_pushable(d.arg) \
+                    or d.arg.ftype.is_string or d.arg.ftype.is_float:
+                return False
+            continue
         if d.arg is not None:
             if not expr_pushable(d.arg):
                 return False
@@ -932,13 +943,21 @@ def _to_physical(plan: LogicalPlan, stats=None) -> PhysicalPlan:
         ):
             dag = child.dag
             dag.agg = DAGAggregation(list(plan.group_by), list(plan.aggs))
-            # partial layout: group cols, then (val, cnt) per agg
+            # partial layout: group cols, then (val, cnt) per agg —
+            # except approx_count_distinct, which ships HLL_WORDS packed
+            # register words + cnt (plan/dag.agg_partial_width)
             fields = []
             for i, g in enumerate(plan.group_by):
                 fields.append(ResultField(f"gk#{i}", g.ftype))
             for i, d in enumerate(plan.aggs):
-                val_t = _partial_val_type(d)
-                fields.append(ResultField(f"pv#{i}", val_t))
+                if d.func == "approx_count_distinct":
+                    for w in range(HLL_WORDS):
+                        fields.append(ResultField(
+                            f"ph#{i}_{w}",
+                            FieldType(TypeKind.BIGINT, nullable=False)))
+                else:
+                    val_t = _partial_val_type(d)
+                    fields.append(ResultField(f"pv#{i}", val_t))
                 fields.append(
                     ResultField(f"pc#{i}",
                                 FieldType(TypeKind.BIGINT, nullable=False))
