@@ -122,6 +122,19 @@ def test_approx_in_expression_and_having(tk):
         assert v > 0 and v % 2 == 0
 
 
+def test_wide_bigint_values_host_fallback(tk):
+    """Values beyond int32 can't stage on device; the host tier must fold
+    the high 32 bits into the hash (plain truncation would collide every
+    value sharing low bits)."""
+    tk.must_exec("create table w (a bigint)")
+    tk.must_exec("insert into w values " +
+                 ",".join(f"({7 + (k << 32)})" for k in range(500)))
+    exact = _one(tk, "select count(distinct a) from w")
+    approx = _one(tk, "select approx_count_distinct(a) from w")
+    assert exact == 500
+    assert abs(approx - exact) <= REL_TOL * exact
+
+
 def test_analyze_ndv_uses_same_sketch(tk):
     """ANALYZE's device NDV and the aggregate share hash + estimator, so
     both land within tolerance of the exact count."""

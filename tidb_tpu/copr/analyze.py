@@ -80,6 +80,19 @@ def hll_bucket_rank_host(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return bucket, rank
 
 
+def hll_hash_src_int(v: np.ndarray) -> np.ndarray:
+    """uint32 hash input for integer values: low 32 bits when everything
+    fits int32 (bit-identical to the device sketch), high-bit fold
+    otherwise (plain truncation would collide every pair of values
+    differing only above bit 31)."""
+    v = np.asarray(v).astype(np.int64)
+    if len(v) and (int(v.min()) < -(2 ** 31) or int(v.max()) >= 2 ** 31):
+        u = v.view(np.uint64)
+        return ((u ^ (u >> np.uint64(32))) &
+                np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return v.astype(np.uint32)
+
+
 def hll_group_registers_host(av: np.ndarray, avl: np.ndarray,
                              inv: np.ndarray, n_seg: int) -> np.ndarray:
     """Per-group HLL registers host-side: (n_seg, N_REG) int32 max-rank,

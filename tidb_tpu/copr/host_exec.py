@@ -48,9 +48,11 @@ def _hll_partial_columns(av: np.ndarray, avl: np.ndarray,
                          inv: np.ndarray, n_seg: int) -> list[Column]:
     """HLL_WORDS byte-packed register word columns for one
     approx_count_distinct aggregate (plan/dag.agg_partial_width layout),
-    hash-identical to the device sketch."""
-    from .analyze import hll_group_registers_host, hll_pack_words
-    regs = hll_group_registers_host(av, avl, inv, n_seg)
+    hash-identical to the device sketch for int32-range values; wider
+    int64 batches fold their high bits (the device gate rejects those)."""
+    from .analyze import (hll_group_registers_host, hll_hash_src_int,
+                          hll_pack_words)
+    regs = hll_group_registers_host(hll_hash_src_int(av), avl, inv, n_seg)
     words = hll_pack_words(regs)
     return [Column(FieldType(TypeKind.BIGINT, nullable=False),
                    words[:, w].copy())

@@ -1246,12 +1246,8 @@ def _hll_hash_src(d: AggDesc, av: np.ndarray, child: Chunk) -> np.ndarray:
         bits = norm.view(np.uint64)
         return ((bits ^ (bits >> np.uint64(32))) &
                 np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    v = av.astype(np.int64)
-    if len(v) and (v.min() < -(2 ** 31) or v.max() >= 2 ** 31):
-        u = v.view(np.uint64)
-        return ((u ^ (u >> np.uint64(32))) &
-                np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    return v.astype(np.uint32)
+    from ..copr.analyze import hll_hash_src_int
+    return hll_hash_src_int(av)
 
 
 def _distinct_agg(d: AggDesc, av, avl, inv, n_seg, out_t: FieldType) -> Column:

@@ -37,13 +37,18 @@ from ..errno import wrap as err_wrap
 from ..errno import (
     ER_BAD_FIELD,
     ER_BAD_NULL,
+    ER_CANT_CREATE_FILE,
+    ER_DATA_INCONSISTENT,
     ER_DUP_ENTRY,
+    ER_FILE_EXISTS,
+    ER_FILE_NOT_FOUND,
     ER_NO_SUCH_TABLE,
     ER_PARSE_ERROR,
     ER_QUERY_INTERRUPTED,
     ER_SPECIFIC_ACCESS_DENIED,
     ER_TABLE_EXISTS,
     ER_TABLEACCESS_DENIED,
+    ER_TEXTFILE_NOT_READABLE,
     ER_UNKNOWN_SYSTEM_VARIABLE,
     ER_VAR_READONLY,
     ER_WRONG_VALUE_COUNT_ON_ROW,
@@ -315,12 +320,18 @@ class Session:
                 raise err_wrap(SQLError, e) from None
             return ResultSet([], [])
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
-            return self._run_in_txn(lambda: self._exec_select(stmt))
+            rs = self._run_in_txn(lambda: self._exec_select(stmt))
+            outfile = getattr(stmt, "into_outfile", None)
+            if outfile is not None:
+                return self._write_outfile(rs, outfile)
+            return rs
         if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                              ast.DeleteStmt)):
             stmt = self._maybe_bind_vars(stmt)
         if isinstance(stmt, ast.InsertStmt):
             return self._run_in_txn(lambda: self._exec_insert(stmt))
+        if isinstance(stmt, ast.LoadDataStmt):
+            return self._run_in_txn(lambda: self._exec_load_data(stmt))
         if isinstance(stmt, ast.UpdateStmt):
             return self._run_in_txn(lambda: self._exec_update(stmt))
         if isinstance(stmt, ast.DeleteStmt):
@@ -397,6 +408,9 @@ class Session:
                     ["JOB_ID", "DB_NAME", "TABLE_NAME", "JOB_TYPE",
                      "SCHEMA_STATE", "STATE", "ERROR"],
                     [j.row() for j in jobs[:32]])
+            if stmt.kind == "CHECK_TABLE":
+                return self._run_in_txn(
+                    lambda: self._exec_admin_check(stmt))
             raise SQLError(f"unsupported ADMIN {stmt.kind}")
         raise SQLError(f"unsupported statement {type(stmt).__name__}")
 
@@ -673,6 +687,7 @@ class Session:
         ast.DropIndexStmt: "INDEX", ast.RenameTableStmt: "ALTER",
         ast.CreateDatabaseStmt: "CREATE", ast.DropDatabaseStmt: "DROP",
         ast.CreateViewStmt: "CREATE", ast.DropViewStmt: "DROP",
+        ast.LoadDataStmt: "INSERT",
     }
 
     def _check_privileges(self, stmt: ast.Stmt) -> None:
@@ -1058,13 +1073,17 @@ class Session:
             raise err_wrap(SQLError, e) from None
 
     # ==================== DML ====================
-    def _exec_insert(self, stmt: ast.InsertStmt) -> ResultSet:
+    def _exec_insert(self, stmt: ast.InsertStmt,
+                     rows_override: Optional[list[list[Any]]] = None,
+                     load_ignore: bool = False) -> ResultSet:
         info, store = self._table_for(stmt.table)
         col_order = self._insert_columns(info, stmt.columns)
         txn = self._ensure_txn()
 
         rows: list[list[Any]] = []
-        if stmt.select is not None:
+        if rows_override is not None:
+            rows = rows_override
+        elif stmt.select is not None:
             sub = self._exec_select(stmt.select)
             rows = [list(r) for r in sub.rows]
         else:
@@ -1165,6 +1184,8 @@ class Session:
                     checker = checker_for(tid)
                     conflicts = checker.conflicts(handle, enc)
                 if conflicts:
+                    if load_ignore:
+                        continue  # LOAD DATA IGNORE / INSERT IGNORE: skip
                     if stmt.on_dup:
                         count += self._apply_on_dup(
                             stmt, info, tinfo, tid, store, txn, checker,
@@ -1184,6 +1205,181 @@ class Session:
             return ResultSet([], [], affected=count)
         finally:
             txn.stmt_read_ts = None
+
+    # ==================== LOAD DATA / INTO OUTFILE / ADMIN CHECK ==========
+    def _exec_load_data(self, stmt: ast.LoadDataStmt) -> ResultSet:
+        """LOAD DATA INFILE: parse the file host-side, then feed the rows
+        through the transactional insert path so duplicate checks,
+        partition routing and indexes all apply (reference:
+        executor/load_data.go; TiDB too batches through the txn layer)."""
+        import os
+        info, store = self._table_for(stmt.table)
+        col_order = self._insert_columns(info, stmt.columns)
+        path = stmt.fmt.path
+        if not os.path.isfile(path):
+            raise SQLError(f"File '{path}' not found",
+                           errno=ER_FILE_NOT_FOUND)
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError as e:
+            raise SQLError(f"Can't read file '{path}': {e}",
+                           errno=ER_TEXTFILE_NOT_READABLE) from None
+        records = _parse_load_file(text, stmt.fmt)
+        records = records[stmt.ignore_lines:]
+        ftypes = [info.columns[off].ftype for off in col_order]
+        rows: list[list[Any]] = []
+        for fields in records:
+            vals = []
+            for i, ft in enumerate(ftypes):
+                s = fields[i] if i < len(fields) else None
+                vals.append(_load_convert(ft, s))
+            rows.append(vals)
+        shim = ast.InsertStmt(stmt.table, stmt.columns,
+                              is_replace=stmt.dup_mode == "replace")
+        return self._exec_insert(shim, rows_override=rows,
+                                 load_ignore=stmt.dup_mode == "ignore")
+
+    def _write_outfile(self, rs: ResultSet, fmt) -> ResultSet:
+        """SELECT ... INTO OUTFILE (reference: executor/select_into.go).
+        Refuses to overwrite, like MySQL."""
+        import os
+        if os.path.exists(fmt.path):
+            raise SQLError(f"File '{fmt.path}' already exists",
+                           errno=ER_FILE_EXISTS)
+        esc, enc = fmt.escaped, fmt.enclosed
+        specials = {esc or "", enc or "",
+                    fmt.field_term[:1], fmt.line_term[:1]}
+        specials.discard("")
+
+        def render(v) -> str:
+            if v is None:
+                return esc + "N" if esc else "NULL"
+            s = _outfile_text(v)
+            if esc:
+                s = "".join(esc + c if c in specials else c for c in s)
+            return enc + s + enc if enc else s
+
+        lines = [fmt.field_term.join(render(v) for v in row)
+                 for row in rs.rows]
+        body = fmt.line_term.join(lines)
+        if lines:
+            body += fmt.line_term
+        try:
+            with open(fmt.path, "x", encoding="utf-8") as f:
+                f.write(body)
+        except OSError as e:
+            raise SQLError(f"Can't create file '{fmt.path}': {e}",
+                           errno=ER_CANT_CREATE_FILE) from None
+        return ResultSet([], [], affected=len(rs.rows))
+
+    def _exec_admin_check(self, stmt: ast.AdminStmt) -> ResultSet:
+        """ADMIN CHECK TABLE: verify storage/index invariants per table
+        (reference: executor/admin.go CheckTable). The TPU index design
+        has no per-row index KV to drift, so the checked invariants are
+        the ones THIS storage can violate: epoch column/validity shapes,
+        handle uniqueness, cached index permutations actually sorting
+        their epoch, unique-key duplicates among visible rows, and
+        partition routing."""
+        for tn in stmt.tables:
+            info, _ = self._table_for(tn)
+            for cinfo, cstore in self._partition_children(info):
+                self._admin_check_store(info, cinfo, cstore)
+        return ResultSet([], [])
+
+    def _admin_check_store(self, root: TableInfo, info: TableInfo,
+                           store: TableStore) -> None:
+        from ..store.index import epoch_index_order
+
+        def fail(what: str) -> None:
+            raise SQLError(
+                f"admin check table {root.name} failed: {what}",
+                errno=ER_DATA_INCONSISTENT)
+
+        txn = self._ensure_txn()
+        snap = txn.snapshot(info.id)
+        epoch = snap.epoch
+        n = epoch.num_rows
+        for ci in range(info.num_columns):
+            if len(epoch.columns[ci]) != n:
+                fail(f"column {info.columns[ci].name} has "
+                     f"{len(epoch.columns[ci])} rows, epoch has {n}")
+            v = epoch.valids[ci]
+            if v is not None and len(v) != n:
+                fail(f"validity of {info.columns[ci].name} has {len(v)} "
+                     f"rows, epoch has {n}")
+        if len(np.unique(epoch.handles)) != n:
+            fail("duplicate handles in epoch")
+        for idx in info.indices:
+            if not idx.visible:
+                continue
+            order = epoch_index_order(store, epoch, idx)
+            if len(order) != n or (
+                    n and not np.array_equal(np.sort(order),
+                                             np.arange(n))):
+                fail(f"index {idx.name}: cached order is not a "
+                     "permutation of the epoch")
+            # key columns must be lexicographically non-decreasing along
+            # the permutation (NULLs-first per level)
+            if n:
+                prev_eq = np.ones(n - 1, bool)
+                for off in idx.col_offsets:
+                    data = epoch.columns[off][order]
+                    valid = epoch.valids[off]
+                    vv = valid[order] if valid is not None else \
+                        np.ones(n, bool)
+                    lvl = np.stack([vv.astype(np.int64),
+                                    np.where(vv, data, 0)], axis=1)
+                    cmp_lt = (lvl[:-1, 0] < lvl[1:, 0]) | (
+                        (lvl[:-1, 0] == lvl[1:, 0])
+                        & (lvl[:-1, 1] < lvl[1:, 1]))
+                    cmp_eq = (lvl[:-1] == lvl[1:]).all(axis=1)
+                    if not np.all(~prev_eq | cmp_lt | cmp_eq):
+                        fail(f"index {idx.name}: epoch not sorted by key")
+                    prev_eq &= cmp_eq
+            if idx.unique:
+                self._admin_check_unique(info, snap, idx, fail)
+        part = getattr(root, "partition", None)
+        if part is not None and info.id != root.id:
+            off = part.col_offset
+            vals = epoch.columns[off]
+            vv = epoch.valids[off]
+            check_vals = vals if vv is None else vals[vv]
+            for u in np.unique(check_vals):
+                if part.route(int(u)).id != info.id:
+                    fail(f"row with partition key {u} stored in wrong "
+                         f"partition {info.name}")
+
+    def _admin_check_unique(self, info: TableInfo, snap, idx, fail) -> None:
+        """No duplicate fully-non-NULL unique-key tuples among rows
+        visible at this snapshot (epoch ∩ base_visible + overlay)."""
+        keys = []
+        valid_all = None
+        vis = snap.base_visible
+        for off in idx.col_offsets:
+            base = snap.epoch.columns[off][vis]
+            ov = snap.overlay_columns[off]
+            col = np.concatenate([base, ov])
+            if np.issubdtype(col.dtype, np.floating):
+                # dedup on bit patterns (normalize -0.0), not truncation
+                col = np.where(col == 0, 0.0,
+                               col.astype(np.float64)).view(np.int64)
+            else:
+                col = col.astype(np.int64)
+            bvl = snap.epoch.valids[off]
+            bv = bvl[vis] if bvl is not None else np.ones(len(base), bool)
+            ovl = snap.overlay_valids[off]
+            o = ovl if ovl is not None else np.ones(len(ov), bool)
+            vcat = np.concatenate([bv, o])
+            keys.append(col)
+            valid_all = vcat if valid_all is None else (valid_all & vcat)
+        if not keys or valid_all is None or not valid_all.any():
+            return
+        stacked = np.stack(keys, axis=1)[valid_all]
+        uniq = np.unique(stacked, axis=0)
+        if len(uniq) != len(stacked):
+            fail(f"unique index {idx.name}: duplicate key values among "
+                 "visible rows")
 
     def _apply_on_dup(self, stmt, info, tinfo, tid: int, store, txn,
                       checker, handle: int, full: list) -> int:
@@ -2079,6 +2275,122 @@ _NILADIC_FUNCS = frozenset({
     "CURRENT_DATE", "CURRENT_TIME", "CURRENT_TIMESTAMP", "CURRENT_USER",
     "LOCALTIME", "LOCALTIMESTAMP",
 })
+
+
+def _parse_load_file(text: str, fmt) -> list[list[Optional[str]]]:
+    """One-pass LOAD DATA record/field splitter honoring FIELDS TERMINATED/
+    ENCLOSED/ESCAPED BY and LINES TERMINATED BY (reference:
+    executor/load_data.go field splitting). esc+'N' as a whole field is
+    SQL NULL; escapes are processed before terminator matching, so
+    escaped terminator characters stay literal."""
+    ft, lt = fmt.field_term, fmt.line_term
+    enc, esc = fmt.enclosed, fmt.escaped
+    esc_map = {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "Z": "\x1a"}
+    rows: list[list[Optional[str]]] = []
+    fields: list[Optional[str]] = []
+    cur: list[str] = []
+    null_pending = False
+    i, n = 0, len(text)
+
+    def end_field() -> None:
+        nonlocal cur, null_pending
+        if null_pending and not cur:
+            fields.append(None)
+        else:
+            fields.append("".join(cur))
+        cur = []
+        null_pending = False
+
+    def end_line() -> None:
+        nonlocal fields
+        end_field()
+        rows.append(fields)
+        fields = []
+
+    while i < n:
+        c = text[i]
+        if enc and not cur and not null_pending and c == enc:
+            # enclosed field: scan to the closing quote (enc+enc = literal)
+            i += 1
+            while i < n:
+                c = text[i]
+                if esc and c == esc and i + 1 < n:
+                    nxt = text[i + 1]
+                    cur.append(esc_map.get(nxt, nxt))
+                    i += 2
+                    continue
+                if c == enc:
+                    if i + 1 < n and text[i + 1] == enc:
+                        cur.append(enc)
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                cur.append(c)
+                i += 1
+            # fall through: next chars should be a terminator
+            continue
+        if esc and c == esc and i + 1 < n:
+            nxt = text[i + 1]
+            if nxt == "N" and not cur and not null_pending:
+                null_pending = True
+            else:
+                if null_pending:
+                    cur.append("N")
+                    null_pending = False
+                cur.append(esc_map.get(nxt, nxt))
+            i += 2
+            continue
+        if text.startswith(lt, i):
+            end_line()
+            i += len(lt)
+            continue
+        if text.startswith(ft, i):
+            end_field()
+            i += len(ft)
+            continue
+        if null_pending:
+            cur.append("N")
+            null_pending = False
+        cur.append(c)
+        i += 1
+    if cur or fields or null_pending:
+        end_line()
+    return rows
+
+
+def _load_convert(ft: FieldType, s: Optional[str]) -> Any:
+    """LOAD DATA text field -> host value for the insert path. Follows
+    MySQL coercions: \\N is NULL; empty numeric/decimal fields load as 0;
+    empty temporal fields load as NULL (no zero-date type here);
+    fractional text into integer columns rounds half away from zero."""
+    if s is None:
+        return None
+    if ft.is_string or ft.kind == TypeKind.JSON:
+        return s
+    s = s.strip()
+    if ft.kind in (TypeKind.DATE, TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        return s if s else None
+    if ft.is_decimal:
+        return s if s else "0"
+    if not s:
+        return 0
+    if ft.is_float:
+        return float(s)
+    try:
+        return int(s)
+    except ValueError:
+        f = float(s)
+        return int(f + 0.5) if f >= 0 else -int(-f + 0.5)
+
+
+def _outfile_text(v) -> str:
+    """INTO OUTFILE cell rendering (MySQL text form)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
 
 
 def _like_match(pattern: Optional[str], s: str) -> bool:

@@ -265,6 +265,20 @@ class SelectStmt(Stmt):
     for_update: bool = False  # SELECT ... FOR UPDATE row locks
     # optimizer hints from /*+ ... */: (NAME, [args]) in source order
     hints: list[tuple[str, list[str]]] = field(default_factory=list)
+    # SELECT ... INTO OUTFILE 'path' (reference: executor/select_into.go)
+    into_outfile: Optional["FileFormat"] = None
+
+
+@dataclass
+class FileFormat:
+    """FIELDS/LINES clauses shared by LOAD DATA and INTO OUTFILE
+    (reference: ast.FieldsClause/LinesClause; defaults per MySQL docs)."""
+
+    path: str
+    field_term: str = "\t"
+    enclosed: Optional[str] = None
+    escaped: str = "\\"
+    line_term: str = "\n"
 
 
 @dataclass
@@ -277,6 +291,7 @@ class SetOpStmt(Stmt):
     order_by: list[OrderItem] = field(default_factory=list)
     limit: Optional[int] = None
     offset: int = 0
+    into_outfile: Optional["FileFormat"] = None
 
 
 @dataclass
@@ -288,6 +303,18 @@ class InsertStmt(Stmt):
     is_replace: bool = False
     # ON DUPLICATE KEY UPDATE assignments; VALUES(col) refs allowed
     on_dup: list = field(default_factory=list)
+
+
+@dataclass
+class LoadDataStmt(Stmt):
+    """LOAD DATA [LOCAL] INFILE (reference: executor/load_data.go)."""
+
+    table: TableName
+    fmt: FileFormat
+    columns: Optional[list[str]] = None  # None => all, in order
+    local: bool = False
+    dup_mode: str = "error"  # error | ignore | replace
+    ignore_lines: int = 0
 
 
 @dataclass
@@ -423,7 +450,8 @@ class RenameTableStmt(Stmt):
 
 @dataclass
 class AdminStmt(Stmt):
-    kind: str  # 'SHOW_DDL_JOBS'
+    kind: str  # 'SHOW_DDL_JOBS' | 'CHECK_TABLE'
+    tables: list[TableName] = field(default_factory=list)
 
 
 @dataclass

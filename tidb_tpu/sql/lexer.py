@@ -75,6 +75,8 @@ KEYWORDS = frozenset(
     FOR
     ADMIN DDL JOBS KILL QUERY CONNECTION
     OVER PARTITION ROWS RANGE UNBOUNDED PRECEDING FOLLOWING CURRENT ROW
+    LOAD DATA LOCAL INFILE OUTFILE TERMINATED ENCLOSED ESCAPED LINES IGNORE
+    OPTIONALLY CHECK
     """.split()
 )
 

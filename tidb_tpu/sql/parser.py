@@ -224,13 +224,91 @@ class Parser:
             return ast.RenameTableStmt(renames)
         if t.is_kw("ADMIN"):
             self.advance()
+            if self.accept_kw("CHECK"):
+                self.expect_kw("TABLE")
+                tables = [self.parse_table_name()]
+                while self.accept_op(","):
+                    tables.append(self.parse_table_name())
+                return ast.AdminStmt("CHECK_TABLE", tables)
             self.expect_kw("SHOW")
             self.expect_kw("DDL")
             self.expect_kw("JOBS")
             return ast.AdminStmt("SHOW_DDL_JOBS")
+        if t.is_kw("LOAD"):
+            return self.parse_load_data()
         if t.is_kw("GRANT", "REVOKE"):
             return self.parse_grant(revoke=t.is_kw("REVOKE"))
         raise ParseError("unsupported statement", t)
+
+    def _string_lit(self, what: str) -> str:
+        t = self.cur
+        if t.kind != TokenKind.STRING:
+            raise ParseError(f"expected string literal for {what}", t)
+        self.advance()
+        return t.text
+
+    def _parse_file_format(self, path: str) -> "ast.FileFormat":
+        """[FIELDS|COLUMNS TERMINATED BY s [OPTIONALLY] ENCLOSED BY s
+        ESCAPED BY s] [LINES TERMINATED BY s] — shared by LOAD DATA and
+        SELECT INTO OUTFILE (MySQL defaults: tab fields, newline lines)."""
+        fmt = ast.FileFormat(path)
+        if self.accept_kw("FIELDS", "COLUMNS"):
+            seen = False
+            while True:
+                if self.accept_kw("TERMINATED"):
+                    self.expect_kw("BY")
+                    fmt.field_term = self._string_lit("TERMINATED BY")
+                elif self.cur.is_kw("OPTIONALLY") or \
+                        self.cur.is_kw("ENCLOSED"):
+                    self.accept_kw("OPTIONALLY")
+                    self.expect_kw("ENCLOSED")
+                    self.expect_kw("BY")
+                    fmt.enclosed = self._string_lit("ENCLOSED BY")
+                elif self.accept_kw("ESCAPED"):
+                    self.expect_kw("BY")
+                    fmt.escaped = self._string_lit("ESCAPED BY")
+                else:
+                    if not seen:
+                        raise ParseError("expected TERMINATED/ENCLOSED/"
+                                         "ESCAPED BY", self.cur)
+                    break
+                seen = True
+        if self.accept_kw("LINES"):
+            self.expect_kw("TERMINATED")
+            self.expect_kw("BY")
+            fmt.line_term = self._string_lit("LINES TERMINATED BY")
+        return fmt
+
+    def parse_load_data(self) -> ast.LoadDataStmt:
+        """LOAD DATA [LOCAL] INFILE 'path' [REPLACE|IGNORE] INTO TABLE t
+        [format] [IGNORE n LINES] [(col, ...)]
+        (reference: executor/load_data.go)."""
+        self.expect_kw("LOAD")
+        self.expect_kw("DATA")
+        local = bool(self.accept_kw("LOCAL"))
+        self.expect_kw("INFILE")
+        path = self._string_lit("INFILE")
+        dup = "error"
+        if self.accept_kw("REPLACE"):
+            dup = "replace"
+        elif self.accept_kw("IGNORE"):
+            dup = "ignore"
+        self.expect_kw("INTO")
+        self.expect_kw("TABLE")
+        table = self.parse_table_name()
+        fmt = self._parse_file_format(path)
+        ignore_lines = 0
+        if self.accept_kw("IGNORE"):
+            ignore_lines = self.parse_uint("IGNORE")
+            self.expect_kw("LINES")
+        columns = None
+        if self.accept_op("("):
+            columns = [self.expect_ident()]
+            while self.accept_op(","):
+                columns.append(self.expect_ident())
+            self.expect_op(")")
+        return ast.LoadDataStmt(table, fmt, columns, local, dup,
+                                ignore_lines)
 
     def parse_grant(self, revoke: bool) -> ast.GrantStmt:
         """GRANT/REVOKE priv[, priv] ON [db.]tbl TO/FROM user
@@ -347,12 +425,14 @@ class Parser:
                 self.accept_kw("DISTINCT")
             selects.append(self.parse_select())
             alls.append(is_all)
-        # the trailing ORDER BY/LIMIT was consumed by the last SELECT;
-        # it belongs to the union
+        # the trailing ORDER BY/LIMIT/INTO OUTFILE was consumed by the
+        # last SELECT; it belongs to the union
         last = selects[-1]
         stmt = ast.SetOpStmt(selects, alls, last.order_by, last.limit,
                              last.offset)
+        stmt.into_outfile = last.into_outfile
         last.order_by, last.limit, last.offset = [], None, 0
+        last.into_outfile = None
         return stmt
 
     def parse_select(self) -> ast.SelectStmt:
@@ -397,6 +477,11 @@ class Parser:
         if self.accept_kw("FOR"):
             self.expect_kw("UPDATE")
             stmt.for_update = True
+        if self.cur.is_kw("INTO") and self.peek().is_kw("OUTFILE"):
+            self.advance()
+            self.advance()
+            path = self._string_lit("OUTFILE")
+            stmt.into_outfile = self._parse_file_format(path)
         return stmt
 
     def parse_uint(self, what: str) -> int:
@@ -1547,6 +1632,7 @@ _IDENT_KEYWORDS = frozenset(
     NAMES USER IDENTIFIED PRIVILEGES GRANTS PESSIMISTIC OPTIMISTIC
     UNBOUNDED PRECEDING FOLLOWING CURRENT ROW TRACE
     KILL QUERY CONNECTION
+    DATA LOCAL TERMINATED ENCLOSED ESCAPED LINES
     """.split()
 )
 
