@@ -22,7 +22,7 @@ class MySQLError(Exception):
 class MiniClient:
     def __init__(self, host: str, port: int, user: str = "root",
                  password: str = "", db: str = "",
-                 timeout: float = 120.0) -> None:
+                 timeout: float = 120.0, use_ssl: bool = False) -> None:
         # generous default: under full-suite load (one core, a jax
         # compile in a sibling) a first query can take tens of seconds;
         # a 10s cap made test_multiproc flaky (round-4 verdict weak #3)
@@ -30,7 +30,8 @@ class MiniClient:
         self.rfile = self.sock.makefile("rb")
         self.wfile = self.sock.makefile("wb")
         self.seq = 0
-        self._handshake(user, password, db)
+        self.tls = False
+        self._handshake(user, password, db, use_ssl)
 
     # ---- framing -----------------------------------------------------------
     def _read_packet(self) -> bytes:
@@ -51,17 +52,37 @@ class MiniClient:
         self.seq = (self.seq + 1) % 256
 
     # ---- handshake ---------------------------------------------------------
-    def _handshake(self, user: str, password: str, db: str) -> None:
+    def _handshake(self, user: str, password: str, db: str,
+                   use_ssl: bool) -> None:
         greet = self._read_packet()
         assert greet[0] == 0x0A, "expected protocol v10 handshake"
         pos = greet.index(b"\x00", 1) + 1  # server version
         pos += 4  # thread id
         salt = greet[pos:pos + 8]
         pos += 9  # salt part1 + filler
-        pos += 2 + 1 + 2 + 2  # caps low, charset, status, caps high
+        server_caps = int.from_bytes(greet[pos:pos + 2], "little")
+        pos += 2 + 1 + 2  # caps low, charset, status
+        server_caps |= int.from_bytes(greet[pos:pos + 2], "little") << 16
+        pos += 2  # caps high
         pos += 1 + 10  # auth len + reserved
         salt += greet[pos:pos + 12]
         caps = 0x0F7FF  # PROTOCOL_41 | SECURE_CONNECTION | CONNECT_WITH_DB...
+        if use_ssl:
+            if not server_caps & 0x800:
+                raise MySQLError(2026, "server does not support SSL")
+            import ssl as _ssl
+            caps |= 0x800  # CLIENT_SSL
+            # SSLRequest: caps + max packet + charset + 23 filler bytes,
+            # then upgrade the socket and continue the sequence encrypted
+            self._write_packet(
+                struct.pack("<IIB", caps, 2**24 - 1, 255) + b"\x00" * 23)
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = _ssl.CERT_NONE
+            self.sock = ctx.wrap_socket(self.sock)
+            self.rfile = self.sock.makefile("rb")
+            self.wfile = self.sock.makefile("wb")
+            self.tls = True
         auth = _scramble(password, salt) if password else b""
         payload = struct.pack("<IIB", caps, 2**24 - 1, 255) + b"\x00" * 23
         payload += user.encode() + b"\x00"

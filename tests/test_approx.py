@@ -135,6 +135,22 @@ def test_wide_bigint_values_host_fallback(tk):
     assert abs(approx - exact) <= REL_TOL * exact
 
 
+def test_mixed_width_partitions_agree(tk):
+    """The truncate-vs-fold hash choice is per element: a value must
+    hash identically whether its partial batch also contains wide
+    (beyond-int32) values or not, or the register merge double-counts."""
+    tk.must_exec("create table mw (k int, v bigint) "
+                 "partition by hash(k) partitions 2")
+    # -5 lands in both partitions; one partition also holds wide values
+    rows = [(0, -5), (1, -5), (2, 1 << 40), (4, (1 << 40) + 1)]
+    rows += [(2 * i, i) for i in range(5, 100)]
+    tk.must_exec("insert into mw values " +
+                 ",".join(f"({k},{v})" for k, v in rows))
+    exact = _one(tk, "select count(distinct v) from mw")
+    approx = _one(tk, "select approx_count_distinct(v) from mw")
+    assert abs(approx - exact) <= max(2, REL_TOL * exact)
+
+
 def test_analyze_ndv_uses_same_sketch(tk):
     """ANALYZE's device NDV and the aggregate share hash + estimator, so
     both land within tolerance of the exact count."""

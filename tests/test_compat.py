@@ -45,6 +45,24 @@ def test_global_scope_crosses_sessions_and_set_global_rules():
         s.execute("SET no_such_var_at_all = 1")
 
 
+def test_sysvar_breadth():
+    """The registry covers the connect-time surface real clients, ORMs
+    and admin tools probe (reference: sessionctx/variable/sysvar.go)."""
+    from tidb_tpu.session.sysvars import SYSVARS
+    assert len(SYSVARS) >= 150
+    s = Session()
+    # a sample of the breadth: every one resolves without
+    # unknown-variable errors, in one round trip
+    probe = ("select @@max_allowed_packet, @@optimizer_switch, "
+             "@@innodb_buffer_pool_size, @@tidb_executor_concurrency, "
+             "@@secure_file_priv, @@have_ssl, @@gtid_mode, "
+             "@@group_concat_max_len, @@slow_query_log, @@read_only")
+    assert len(s.execute(probe).rows[0]) == 10
+    # engine knobs round-trip through SET SESSION
+    s.execute("set tidb_max_chunk_size = 512")
+    assert s.execute("select @@tidb_max_chunk_size").rows[0][0] == 512
+
+
 def test_user_variables():
     s = Session()
     s.execute("SET @x := 40, @y = 2")

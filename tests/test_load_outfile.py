@@ -135,6 +135,70 @@ def test_admin_check_detects_corrupted_index_cache(tk):
     assert getattr(ei.value, "errno", None) == 8133
 
 
+def test_file_priv_gates_load_and_outfile(tk, tmp_path):
+    """LOAD DATA INFILE / INTO OUTFILE need the global FILE privilege
+    (reference: planner visitInfo FILE checks)."""
+    from tidb_tpu.session import Session
+    p = tmp_path / "x.tsv"
+    p.write_text("1\n")
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("create user 'bob' identified by ''")
+    tk.must_exec("grant select, insert on test.* to 'bob'")
+    bob = Session(tk.session.storage)
+    bob.execute("use test")
+    bob.user = "bob"
+    with pytest.raises(Exception) as ei:
+        bob.execute(f"load data infile '{p}' into table t")
+    assert getattr(ei.value, "errno", None) == 1227
+    with pytest.raises(Exception) as ei:
+        bob.execute(f"select a from t into outfile '{tmp_path}/o.txt'")
+    assert getattr(ei.value, "errno", None) == 1227
+    tk.must_exec("grant file on *.* to 'bob'")
+    assert bob.execute(f"load data infile '{p}' into table t").affected == 1
+
+
+def test_secure_file_priv_confines_paths(tk, tmp_path):
+    import os
+    allowed = tmp_path / "allowed"
+    os.makedirs(allowed)
+    (allowed / "in.tsv").write_text("5\n")
+    (tmp_path / "outside.tsv").write_text("6\n")
+    tk.must_exec("create table t (a int)")
+    tk.session.vars["secure_file_priv"] = str(allowed)
+    tk.must_exec(f"load data infile '{allowed}/in.tsv' into table t")
+    with pytest.raises(Exception) as ei:
+        tk.must_exec(
+            f"load data infile '{tmp_path}/outside.tsv' into table t")
+    assert getattr(ei.value, "errno", None) == 1290
+
+
+def test_load_bad_numeric_text_is_data_error(tk, tmp_path):
+    p = tmp_path / "bad.tsv"
+    p.write_text("abc\n")
+    tk.must_exec("create table t (a int)")
+    with pytest.raises(Exception) as ei:
+        tk.must_exec(f"load data infile '{p}' into table t")
+    assert getattr(ei.value, "errno", None) == 1292
+
+
+def test_final_enclosed_empty_record_not_dropped(tk, tmp_path):
+    p = tmp_path / "e.csv"
+    p.write_text('"a"\n""')  # no trailing newline; last row is ""
+    tk.must_exec("create table t (s varchar(10))")
+    tk.must_exec(f"load data infile '{p}' into table t "
+                 "fields terminated by ',' enclosed by '\"'")
+    assert tk.must_query("select s from t order by s") == [("",), ("a",)]
+
+
+def test_empty_terminators_rejected(tk, tmp_path):
+    p = tmp_path / "x.tsv"
+    p.write_text("1\n")
+    tk.must_exec("create table t (a int)")
+    for clause in ("fields terminated by ''", "lines terminated by ''"):
+        with pytest.raises(Exception):
+            tk.must_exec(f"load data infile '{p}' into table t {clause}")
+
+
 def test_admin_check_leaves_no_open_txn(tk):
     """ADMIN CHECK must not leak its read txn: a sibling commit after the
     check is visible to the next statement."""

@@ -1,0 +1,101 @@
+"""TLS on the MySQL wire (reference: server/server.go:227
+LoadTLSCertificates, server/conn.go:665 optional SSLRequest upgrade,
+require_secure_transport sysvar semantics)."""
+
+from __future__ import annotations
+
+import pytest
+
+from mysql_client import MiniClient, MySQLError
+from tidb_tpu.server import Server
+
+
+@pytest.fixture()
+def tls_server():
+    srv = Server(port=0, auto_tls=True)
+    srv.start()
+    assert srv.ssl_ctx is not None, "auto-TLS context must build"
+    yield srv
+    srv.close(drain_timeout=0.2)
+
+
+def _connect(srv, **kw):
+    return MiniClient("127.0.0.1", srv.port, **kw)
+
+
+def test_tls_handshake_and_queries(tls_server):
+    c = _connect(tls_server, use_ssl=True)
+    assert c.tls
+    assert c.query("select 1 + 1") == [("2",)]
+    c.execute("create table t (a int, b varchar(10))")
+    c.execute("insert into t values (1, 'enc'), (2, 'rypted')")
+    assert c.query("select b from t order by a") == [("enc",), ("rypted",)]
+    c.close()
+
+
+def test_plaintext_still_allowed_by_default(tls_server):
+    c = _connect(tls_server, use_ssl=False)
+    assert not c.tls
+    assert c.query("select 2 + 2") == [("4",)]
+    c.close()
+
+
+def test_tls_with_password_auth(tls_server):
+    tls_server.users["alice"] = "secret"
+    c = _connect(tls_server, use_ssl=True, user="alice",
+                 password="secret")
+    assert c.query("select 1") == [("1",)]
+    c.close()
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(tls_server, use_ssl=True, user="alice", password="wrong")
+
+
+def test_require_secure_transport_rejects_plaintext():
+    srv = Server(port=0, auto_tls=True, require_secure_transport=True)
+    srv.start()
+    try:
+        with pytest.raises((MySQLError, ConnectionError)) as ei:
+            _connect(srv, use_ssl=False)
+        if isinstance(ei.value, MySQLError):
+            assert ei.value.code == 3159
+        c = _connect(srv, use_ssl=True)
+        assert c.query("select 5") == [("5",)]
+        c.close()
+    finally:
+        srv.close(drain_timeout=0.2)
+
+
+def test_set_global_require_secure_transport_takes_effect(tls_server):
+    """The enforcement reads the live sysvar, so SET GLOBAL flips it for
+    new connections without a restart."""
+    c = _connect(tls_server, use_ssl=False)  # plaintext OK initially
+    c.execute("set global require_secure_transport = 1")
+    with pytest.raises((MySQLError, ConnectionError)):
+        _connect(tls_server, use_ssl=False)
+    c2 = _connect(tls_server, use_ssl=True)
+    c2.execute("set global require_secure_transport = 0")
+    c2.close()
+    c3 = _connect(tls_server, use_ssl=False)
+    assert c3.query("select 7") == [("7",)]
+    c3.close()
+    c.close()
+
+
+def test_require_secure_transport_without_tls_refuses_start():
+    with pytest.raises(RuntimeError):
+        Server(port=0, require_secure_transport=True)
+
+
+def test_client_against_non_tls_server_fails_cleanly():
+    srv = Server(port=0)
+    srv.start()
+    try:
+        assert srv.ssl_ctx is None
+        with pytest.raises(MySQLError) as ei:
+            _connect(srv, use_ssl=True)
+        assert ei.value.code == 2026
+        c = _connect(srv)
+        assert c.query("select 3") == [("3",)]
+        c.close()
+    finally:
+        srv.close(drain_timeout=0.2)

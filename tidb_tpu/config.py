@@ -66,6 +66,10 @@ class SecurityConfig:
     ssl_ca: str = ""
     ssl_cert: str = ""
     ssl_key: str = ""
+    # generate an ephemeral self-signed pair when no cert is configured
+    # (reference: config auto-tls)
+    auto_tls: bool = False
+    require_secure_transport: bool = False
 
 
 @dataclass

@@ -81,16 +81,31 @@ def hll_bucket_rank_host(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def hll_hash_src_int(v: np.ndarray) -> np.ndarray:
-    """uint32 hash input for integer values: low 32 bits when everything
-    fits int32 (bit-identical to the device sketch), high-bit fold
-    otherwise (plain truncation would collide every pair of values
-    differing only above bit 31)."""
+    """uint32 hash input for integer values. The choice is PER ELEMENT:
+    int32-range values use their low 32 bits (bit-identical to the device
+    sketch), wider values fold their high 32 bits in (plain truncation
+    would collide every pair differing only above bit 31). A per-batch
+    choice would hash the same in-range value differently across partial
+    producers (partitions/overlay), double-counting it in the register
+    merge."""
     v = np.asarray(v).astype(np.int64)
-    if len(v) and (int(v.min()) < -(2 ** 31) or int(v.max()) >= 2 ** 31):
-        u = v.view(np.uint64)
-        return ((u ^ (u >> np.uint64(32))) &
-                np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    return v.astype(np.uint32)
+    u = v.view(np.uint64)
+    low = (u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    in_range = (v >= -(2 ** 31)) & (v < 2 ** 31)
+    if in_range.all():
+        return low
+    folded = ((u ^ (u >> np.uint64(32))) &
+              np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return np.where(in_range, low, folded)
+
+
+def float_bits_key(x: np.ndarray) -> np.ndarray:
+    """Canonical int64 bit-key for float64 values: -0.0 normalizes to
+    0.0 so the two zero encodings compare equal. Shared by distinct
+    aggregation, the host HLL hash, and ADMIN CHECK unique scans — one
+    canonicalization, three consumers."""
+    norm = np.where(x == 0, 0.0, np.asarray(x, np.float64))
+    return norm.view(np.int64)
 
 
 def hll_group_registers_host(av: np.ndarray, avl: np.ndarray,

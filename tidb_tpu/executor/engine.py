@@ -1241,21 +1241,21 @@ def _hll_hash_src(d: AggDesc, av: np.ndarray, child: Chunk) -> np.ndarray:
                 np.uint32)
             return entry[np.clip(av.astype(np.int64), 0, len(dct) - 1)]
         return av.astype(np.int64).astype(np.uint32)
+    from ..copr.analyze import float_bits_key, hll_hash_src_int
     if np.issubdtype(av.dtype, np.floating):
-        norm = np.where(av == 0, 0.0, av.astype(np.float64))
-        bits = norm.view(np.uint64)
+        bits = float_bits_key(av).view(np.uint64)
         return ((bits ^ (bits >> np.uint64(32))) &
                 np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    from ..copr.analyze import hll_hash_src_int
     return hll_hash_src_int(av)
 
 
 def _distinct_agg(d: AggDesc, av, avl, inv, n_seg, out_t: FieldType) -> Column:
     is_float = np.issubdtype(av.dtype, np.floating)
     if is_float:
-        # dedup on exact bit patterns (normalize -0.0 so it equals 0.0)
-        norm = np.where(av == 0, 0.0, av.astype(np.float64))
-        enc = norm.view(np.int64)
+        # dedup on exact bit patterns (copr/analyze.float_bits_key
+        # normalizes -0.0 so it equals 0.0)
+        from ..copr.analyze import float_bits_key
+        enc = float_bits_key(av)
     else:
         enc = av.astype(np.int64)
     enc = np.where(avl, enc, _NULL_KEY)

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device tile granularity (rows)")
     p.add_argument("--skip-grant-table", action="store_true",
                    default=None)
+    p.add_argument("--ssl-cert", default=None)
+    p.add_argument("--ssl-key", default=None)
+    p.add_argument("--auto-tls", type=_parse_bool, default=None)
+    p.add_argument("--require-secure-transport", type=_parse_bool,
+                   default=None)
     return p
 
 
@@ -90,6 +95,11 @@ def resolve_config(args) -> Config:
         ("gc_run_interval", cfg.gc, "run_interval"),
         ("plan_cache", cfg.plan_cache, "enabled"),
         ("skip_grant_table", cfg.security, "skip_grant_table"),
+        ("ssl_cert", cfg.security, "ssl_cert"),
+        ("ssl_key", cfg.security, "ssl_key"),
+        ("auto_tls", cfg.security, "auto_tls"),
+        ("require_secure_transport", cfg.security,
+         "require_secure_transport"),
     ]
     dotted = {
         "log_slow_threshold": "log.slow_threshold",
@@ -131,7 +141,12 @@ def main(argv: list[str] | None = None) -> int:
                  status_port=(cfg.status.status_port
                               if cfg.status.report_status else None),
                  status_host=cfg.status.status_host,
-                 skip_grant_table=cfg.security.skip_grant_table)
+                 skip_grant_table=cfg.security.skip_grant_table,
+                 ssl_cert=cfg.security.ssl_cert or None,
+                 ssl_key=cfg.security.ssl_key or None,
+                 auto_tls=cfg.security.auto_tls,
+                 require_secure_transport=(
+                     cfg.security.require_secure_transport))
     srv.start()
     # background GC / lock-TTL / auto-analyze / checkpoint loop; the
     # interval re-reads tidb_gc_run_interval every cycle (reference:

@@ -32,6 +32,41 @@ _CAPS = (P.CLIENT_LONG_PASSWORD | P.CLIENT_LONG_FLAG
          | P.CLIENT_PLUGIN_AUTH)
 
 
+class _SockIO:
+    """Exact-length socket reads for PacketIO. A buffered makefile reader
+    would be faster per syscall but over-reads: at the TLS upgrade the
+    client's first handshake bytes can land in the Python buffer while
+    ssl wraps the raw fd — a deadlock. recv(n) never takes more than the
+    current packet needs, so the upgrade sees a clean socket."""
+
+    __slots__ = ("sock", "_wbuf")
+
+    def __init__(self, sock) -> None:
+        self.sock = sock
+        self._wbuf = bytearray()
+
+    def read(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                break
+            buf += chunk
+        return bytes(buf)
+
+    def write(self, data: bytes) -> None:
+        # buffer until flush: the command loop flushes once per command,
+        # so a large resultset coalesces instead of one send per row
+        self._wbuf += data
+        if len(self._wbuf) >= 1 << 16:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._wbuf:
+            self.sock.sendall(self._wbuf)
+            self._wbuf.clear()
+
+
 class ClientConn:
     def __init__(self, server: "Server", sock, conn_id: int) -> None:
         self.server = server
@@ -39,14 +74,30 @@ class ClientConn:
         self.conn_id = conn_id
         self.session = Session(server.storage, db=server.default_db)
         self.session.conn_id = conn_id
-        self.io = P.PacketIO(sock.makefile("rb"), sock.makefile("wb"))
+        sio = _SockIO(sock)
+        self.io = P.PacketIO(sio, sio)
         self.salt = secrets.token_bytes(20)
         self.capabilities = 0
         self.user = ""
         self.alive = True
+        self.tls = False
         # stmt_id -> (n_params, bound param types from the last EXECUTE)
         self._stmt_meta: dict[int, tuple[int, Optional[list]]] = {}
         self.killed = threading.Event()
+
+    def _caps(self) -> int:
+        caps = _CAPS
+        if self.server.ssl_ctx is not None:
+            caps |= P.CLIENT_SSL
+        return caps
+
+    def _secure_transport_required(self) -> bool:
+        """Live sysvar, not the constructor flag: SET GLOBAL
+        require_secure_transport takes effect for new connections (the
+        server start mirrors its config flag into the sysvar default)."""
+        v = self.server.storage.sysvars.get_global(
+            "require_secure_transport")
+        return str(v).lower() in ("1", "on", "true", "yes")
 
     # ---- handshake ---------------------------------------------------------
     def write_initial_handshake(self) -> None:
@@ -54,10 +105,10 @@ class ClientConn:
             b"\x0a" + SERVER_VERSION.encode() + b"\x00"
             + struct.pack("<I", self.conn_id)
             + self.salt[:8] + b"\x00"
-            + struct.pack("<H", _CAPS & 0xFFFF)
+            + struct.pack("<H", self._caps() & 0xFFFF)
             + bytes([P._CHARSET_UTF8MB4 & 0xFF])
             + struct.pack("<H", P.SERVER_STATUS_AUTOCOMMIT)
-            + struct.pack("<H", (_CAPS >> 16) & 0xFFFF)
+            + struct.pack("<H", (self._caps() >> 16) & 0xFFFF)
             + bytes([21])  # auth plugin data length
             + b"\x00" * 10
             + self.salt[8:20] + b"\x00"
@@ -69,7 +120,31 @@ class ClientConn:
     def read_handshake_response(self) -> None:
         data = self.io.read_packet()
         caps = struct.unpack_from("<I", data, 0)[0]
+        if caps & P.CLIENT_SSL and self.server.ssl_ctx is not None \
+                and len(data) <= 32:
+            # SSLRequest (reference: server/conn.go:665
+            # readOptionalSSLRequestAndHandshakeResponse): upgrade the
+            # socket, keep the packet sequence running, then read the
+            # real (now encrypted) handshake response
+            seq = self.io.sequence
+            self.sock = self.server.ssl_ctx.wrap_socket(
+                self.sock, server_side=True)
+            sio = _SockIO(self.sock)
+            self.io = P.PacketIO(sio, sio)
+            self.io.sequence = seq
+            self.tls = True
+            data = self.io.read_packet()
+            caps = struct.unpack_from("<I", data, 0)[0]
         self.capabilities = caps
+        if self._secure_transport_required() and not self.tls:
+            from ..errno import ER_SECURE_TRANSPORT_REQUIRED
+            self.io.write_packet(P.err_packet(
+                ER_SECURE_TRANSPORT_REQUIRED,
+                "Connections using insecure transport are "
+                "prohibited while --require_secure_transport=ON.",
+                "HY000"))
+            self.io.flush()
+            raise ConnectionError("insecure transport rejected")
         pos = 4 + 4 + 1 + 23  # caps, max packet, charset, filler
         end = data.index(b"\x00", pos)
         self.user = data[pos:end].decode()

@@ -24,6 +24,7 @@ CLIENT_FOUND_ROWS = 1 << 1
 CLIENT_LONG_FLAG = 1 << 2
 CLIENT_CONNECT_WITH_DB = 1 << 3
 CLIENT_PROTOCOL_41 = 1 << 9
+CLIENT_SSL = 1 << 11
 CLIENT_TRANSACTIONS = 1 << 13
 CLIENT_SECURE_CONNECTION = 1 << 15
 CLIENT_MULTI_STATEMENTS = 1 << 16

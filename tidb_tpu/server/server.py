@@ -31,6 +31,10 @@ class Server:
         status_port: Optional[int] = None,
         status_host: Optional[str] = None,
         skip_grant_table: bool = False,
+        ssl_cert: Optional[str] = None,
+        ssl_key: Optional[str] = None,
+        auto_tls: bool = False,
+        require_secure_transport: bool = False,
     ) -> None:
         self.storage = storage if storage is not None else Storage()
         self.host = host
@@ -57,6 +61,48 @@ class Server:
         # all-privilege session regardless of credentials (reference:
         # privileges.SkipWithGrant; the account-lockout escape hatch)
         self.skip_grant_table = skip_grant_table
+        # TLS (reference: server/server.go:227 LoadTLSCertificates +
+        # auto-tls cert generation in config). ssl_cert/ssl_key load an
+        # operator-provided pair; auto_tls generates an ephemeral
+        # self-signed pair at startup. require_secure_transport rejects
+        # plaintext connections like the MySQL sysvar.
+        self.require_secure_transport = require_secure_transport
+        self.ssl_ctx = self._build_ssl_ctx(ssl_cert, ssl_key, auto_tls)
+        if require_secure_transport and self.ssl_ctx is None:
+            # with no TLS context every connection would be rejected —
+            # an unrecoverable lockout; refuse to start instead
+            raise RuntimeError(
+                "require_secure_transport needs ssl-cert/ssl-key or "
+                "auto-tls")
+
+    @staticmethod
+    def _build_ssl_ctx(cert: Optional[str], key: Optional[str],
+                       auto_tls: bool):
+        import ssl as _ssl
+        if not cert and not auto_tls:
+            return None
+        ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        if cert:
+            ctx.load_cert_chain(cert, key or cert)
+            return ctx
+        try:
+            pem = _self_signed_pem()
+        except Exception as e:  # noqa: BLE001 - cryptography unavailable
+            # fail fast: a silent downgrade to plaintext (or, with
+            # require_secure_transport, a server that rejects everyone
+            # with no explanation) is worse than refusing to start
+            raise RuntimeError(
+                f"auto-tls certificate generation failed: {e!r}; "
+                "provide ssl-cert/ssl-key or disable auto-tls") from e
+        import tempfile
+        with tempfile.NamedTemporaryFile(
+                "wb", suffix=".pem", delete=False) as f:
+            f.write(pem)
+            path = f.name
+        ctx.load_cert_chain(path, path)
+        import os
+        os.unlink(path)
+        return ctx
 
     # ---- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -68,6 +114,13 @@ class Server:
         ls.listen(128)
         self.port = ls.getsockname()[1]
         self._listener = ls
+        sv = self.storage.sysvars
+        sv.set_config_default("require_secure_transport",
+                              int(self.require_secure_transport))
+        if self.ssl_ctx is not None:
+            # reflect TLS support in the compat sysvars clients probe
+            sv.set_config_default("have_ssl", "YES")
+            sv.set_config_default("have_openssl", "YES")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="mysql-accept", daemon=True)
         self._accept_thread.start()
@@ -178,3 +231,35 @@ class Server:
             c.kill()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=1.0)
+
+
+def _self_signed_pem() -> bytes:
+    """Ephemeral self-signed cert+key PEM for auto-TLS (the analog of the
+    reference's auto-tls generated certificates)."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, "TiDB-TPU auto TLS")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .sign(key, hashes.SHA256())
+    )
+    return (
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption())
+        + cert.public_bytes(serialization.Encoding.PEM)
+    )

@@ -24,7 +24,8 @@ from typing import Optional
 
 PRIVS = frozenset({
     "SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP", "ALTER",
-    "INDEX", "ALL", "USAGE",
+    "INDEX", "ALL", "USAGE", "FILE", "SUPER", "PROCESS", "RELOAD",
+    "REFERENCES", "CREATE VIEW", "SHOW VIEW", "TRIGGER", "EXECUTE",
 })
 
 _META_KEY = b"priv:users"

@@ -43,12 +43,14 @@ from ..errno import (
     ER_FILE_EXISTS,
     ER_FILE_NOT_FOUND,
     ER_NO_SUCH_TABLE,
+    ER_OPTION_PREVENTS_STATEMENT,
     ER_PARSE_ERROR,
     ER_QUERY_INTERRUPTED,
     ER_SPECIFIC_ACCESS_DENIED,
     ER_TABLE_EXISTS,
     ER_TABLEACCESS_DENIED,
     ER_TEXTFILE_NOT_READABLE,
+    ER_TRUNCATED_WRONG_VALUE,
     ER_UNKNOWN_SYSTEM_VARIABLE,
     ER_VAR_READONLY,
     ER_WRONG_VALUE_COUNT_ON_ROW,
@@ -1207,6 +1209,26 @@ class Session:
             txn.stmt_read_ts = None
 
     # ==================== LOAD DATA / INTO OUTFILE / ADMIN CHECK ==========
+    def _require_file_priv(self, path: str) -> None:
+        """Server-side file access needs the global FILE privilege, and
+        secure_file_priv (when set) confines paths to that directory —
+        both per MySQL (reference: planner visitInfo FILE checks;
+        executor/load_data.go / select_into.go)."""
+        import os
+        if self.user is not None and not self.storage.privileges.check(
+                self.user, "FILE", "*", "*"):
+            raise SQLError(
+                "Access denied; you need (at least one of) the FILE "
+                f"privilege(s) for this operation (user '{self.user}')",
+                errno=ER_SPECIFIC_ACCESS_DENIED)
+        base = str(self._sysvar_value("secure_file_priv") or "")
+        if base and not os.path.realpath(path).startswith(
+                os.path.realpath(base) + os.sep):
+            raise SQLError(
+                "The MySQL server is running with the "
+                "--secure-file-priv option so it cannot execute this "
+                "statement", errno=ER_OPTION_PREVENTS_STATEMENT)
+
     def _exec_load_data(self, stmt: ast.LoadDataStmt) -> ResultSet:
         """LOAD DATA INFILE: parse the file host-side, then feed the rows
         through the transactional insert path so duplicate checks,
@@ -1216,6 +1238,7 @@ class Session:
         info, store = self._table_for(stmt.table)
         col_order = self._insert_columns(info, stmt.columns)
         path = stmt.fmt.path
+        self._require_file_priv(path)
         if not os.path.isfile(path):
             raise SQLError(f"File '{path}' not found",
                            errno=ER_FILE_NOT_FOUND)
@@ -1244,6 +1267,7 @@ class Session:
         """SELECT ... INTO OUTFILE (reference: executor/select_into.go).
         Refuses to overwrite, like MySQL."""
         import os
+        self._require_file_priv(fmt.path)
         if os.path.exists(fmt.path):
             raise SQLError(f"File '{fmt.path}' already exists",
                            errno=ER_FILE_EXISTS)
@@ -1361,9 +1385,9 @@ class Session:
             ov = snap.overlay_columns[off]
             col = np.concatenate([base, ov])
             if np.issubdtype(col.dtype, np.floating):
-                # dedup on bit patterns (normalize -0.0), not truncation
-                col = np.where(col == 0, 0.0,
-                               col.astype(np.float64)).view(np.int64)
+                # dedup on bit patterns, not truncation
+                from ..copr.analyze import float_bits_key
+                col = float_bits_key(col)
             else:
                 col = col.astype(np.int64)
             bvl = snap.epoch.valids[off]
@@ -2284,22 +2308,28 @@ def _parse_load_file(text: str, fmt) -> list[list[Optional[str]]]:
     SQL NULL; escapes are processed before terminator matching, so
     escaped terminator characters stay literal."""
     ft, lt = fmt.field_term, fmt.line_term
+    if not ft or not lt:
+        # parser rejects these; belt-and-braces against an infinite loop
+        # (startswith("") is always True)
+        raise ValueError("empty field/line terminator")
     enc, esc = fmt.enclosed, fmt.escaped
     esc_map = {"n": "\n", "t": "\t", "r": "\r", "0": "\0", "Z": "\x1a"}
     rows: list[list[Optional[str]]] = []
     fields: list[Optional[str]] = []
     cur: list[str] = []
     null_pending = False
+    enclosure_seen = False  # an empty enclosed field ("") still counts
     i, n = 0, len(text)
 
     def end_field() -> None:
-        nonlocal cur, null_pending
+        nonlocal cur, null_pending, enclosure_seen
         if null_pending and not cur:
             fields.append(None)
         else:
             fields.append("".join(cur))
         cur = []
         null_pending = False
+        enclosure_seen = False
 
     def end_line() -> None:
         nonlocal fields
@@ -2311,6 +2341,7 @@ def _parse_load_file(text: str, fmt) -> list[list[Optional[str]]]:
         c = text[i]
         if enc and not cur and not null_pending and c == enc:
             # enclosed field: scan to the closing quote (enc+enc = literal)
+            enclosure_seen = True
             i += 1
             while i < n:
                 c = text[i]
@@ -2354,7 +2385,7 @@ def _parse_load_file(text: str, fmt) -> list[list[Optional[str]]]:
             null_pending = False
         cur.append(c)
         i += 1
-    if cur or fields or null_pending:
+    if cur or fields or null_pending or enclosure_seen:
         end_line()
     return rows
 
@@ -2375,13 +2406,19 @@ def _load_convert(ft: FieldType, s: Optional[str]) -> Any:
         return s if s else "0"
     if not s:
         return 0
-    if ft.is_float:
-        return float(s)
     try:
-        return int(s)
+        if ft.is_float:
+            return float(s)
+        try:
+            return int(s)
+        except ValueError:
+            f = float(s)
+            return int(f + 0.5) if f >= 0 else -int(-f + 0.5)
     except ValueError:
-        f = float(s)
-        return int(f + 0.5) if f >= 0 else -int(-f + 0.5)
+        raise SQLError(
+            f"Truncated incorrect {'DOUBLE' if ft.is_float else 'INTEGER'}"
+            f" value: '{s}'",
+            errno=ER_TRUNCATED_WRONG_VALUE) from None
 
 
 def _outfile_text(v) -> str:

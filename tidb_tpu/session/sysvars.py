@@ -108,6 +108,173 @@ _VARS = [
     _v("tidb_gc_life_time", "10m0s", scope=SCOPE_GLOBAL),
     _v("tidb_gc_run_interval", "10m0s", scope=SCOPE_GLOBAL),
     _v("tidb_auto_analyze_ratio", 0.5, scope=SCOPE_GLOBAL),
+    # ---- file / transport security ------------------------------------
+    _v("secure_file_priv", "", scope=SCOPE_GLOBAL, read_only=True),
+    _v("require_secure_transport", 0, scope=SCOPE_GLOBAL),
+    _v("ssl_ca", "", scope=SCOPE_GLOBAL, read_only=True),
+    _v("ssl_cert", "", scope=SCOPE_GLOBAL, read_only=True),
+    _v("ssl_key", "", scope=SCOPE_GLOBAL, read_only=True),
+    # ---- SQL behavior toggles (accepted; engine behavior noted) -------
+    _v("div_precision_increment", 4),
+    _v("group_concat_max_len", 1024),
+    _v("max_sort_length", 1024),
+    _v("sql_safe_updates", 0),
+    _v("sql_log_bin", 1),
+    _v("sql_notes", 1),
+    _v("sql_warnings", 0),
+    _v("sql_quote_show_create", 1),
+    _v("sql_auto_is_null", 0),
+    _v("sql_big_selects", 1),
+    _v("sql_buffer_result", 0),
+    _v("timestamp", 0, scope=SCOPE_SESSION),
+    _v("insert_id", 0, scope=SCOPE_SESSION),
+    _v("pseudo_thread_id", 0, scope=SCOPE_SESSION),
+    _v("rand_seed1", 0, scope=SCOPE_SESSION),
+    _v("rand_seed2", 0, scope=SCOPE_SESSION),
+    _v("default_week_format", 0),
+    _v("lc_time_names", "en_US"),
+    _v("lc_messages", "en_US"),
+    _v("big_tables", 0),
+    _v("low_priority_updates", 0),
+    _v("completion_type", "NO_CHAIN"),
+    _v("concurrent_insert", "AUTO", scope=SCOPE_GLOBAL, read_only=True),
+    _v("delay_key_write", "ON", scope=SCOPE_GLOBAL, read_only=True),
+    _v("character_set_filesystem", "binary"),
+    # ---- buffers / limits (accepted for client compat) ----------------
+    _v("max_heap_table_size", 16777216),
+    _v("tmp_table_size", 16777216),
+    _v("sort_buffer_size", 262144),
+    _v("join_buffer_size", 262144),
+    _v("read_buffer_size", 131072),
+    _v("read_rnd_buffer_size", 262144),
+    _v("bulk_insert_buffer_size", 8388608),
+    _v("max_join_size", 2 ** 64 - 1),
+    _v("max_seeks_for_key", 2 ** 64 - 1),
+    _v("range_optimizer_max_mem_size", 8388608),
+    _v("eq_range_index_dive_limit", 200),
+    _v("optimizer_switch", "index_merge=on,index_merge_union=on",
+       scope=SCOPE_BOTH),
+    _v("optimizer_search_depth", 62),
+    _v("table_open_cache", 2000, scope=SCOPE_GLOBAL, read_only=True),
+    _v("table_definition_cache", 2000, scope=SCOPE_GLOBAL,
+       read_only=True),
+    _v("open_files_limit", 65535, scope=SCOPE_GLOBAL, read_only=True),
+    _v("thread_cache_size", 0, scope=SCOPE_GLOBAL, read_only=True),
+    _v("max_prepared_stmt_count", 16382, scope=SCOPE_GLOBAL),
+    _v("max_user_connections", 0, scope=SCOPE_GLOBAL),
+    _v("max_connect_errors", 100, scope=SCOPE_GLOBAL),
+    _v("connect_timeout", 10, scope=SCOPE_GLOBAL),
+    _v("skip_name_resolve", 1, scope=SCOPE_GLOBAL, read_only=True),
+    # ---- replication-shaped surface (inert; single-plane engine) ------
+    _v("log_bin", 0, scope=SCOPE_GLOBAL, read_only=True),
+    _v("server_id", 0, scope=SCOPE_GLOBAL),
+    _v("server_uuid", "00000000-0000-0000-0000-000000000000",
+       scope=SCOPE_GLOBAL, read_only=True),
+    _v("binlog_format", "ROW", scope=SCOPE_GLOBAL),
+    _v("binlog_row_image", "FULL", scope=SCOPE_GLOBAL),
+    _v("gtid_mode", "OFF", scope=SCOPE_GLOBAL, read_only=True),
+    _v("enforce_gtid_consistency", "OFF", scope=SCOPE_GLOBAL,
+       read_only=True),
+    _v("read_only", 0, scope=SCOPE_GLOBAL),
+    _v("super_read_only", 0, scope=SCOPE_GLOBAL),
+    _v("offline_mode", 0, scope=SCOPE_GLOBAL),
+    # ---- logging surface ----------------------------------------------
+    _v("event_scheduler", "OFF", scope=SCOPE_GLOBAL, read_only=True),
+    _v("log_output", "FILE", scope=SCOPE_GLOBAL),
+    _v("general_log", 0, scope=SCOPE_GLOBAL),
+    _v("slow_query_log", 1, scope=SCOPE_GLOBAL),
+    _v("slow_query_log_file", "", scope=SCOPE_GLOBAL),
+    _v("long_query_time", 10.0, scope=SCOPE_GLOBAL),
+    _v("log_queries_not_using_indexes", 0, scope=SCOPE_GLOBAL),
+    _v("profiling", 0, scope=SCOPE_SESSION),
+    _v("profiling_history_size", 15, scope=SCOPE_SESSION),
+    # ---- innodb-shaped surface (inert; columnar-epoch engine) ---------
+    _v("innodb_buffer_pool_size", 134217728, scope=SCOPE_GLOBAL,
+       read_only=True),
+    _v("innodb_flush_log_at_trx_commit", 1, scope=SCOPE_GLOBAL),
+    _v("innodb_io_capacity", 200, scope=SCOPE_GLOBAL),
+    _v("innodb_file_per_table", 1, scope=SCOPE_GLOBAL, read_only=True),
+    _v("innodb_large_prefix", "ON", scope=SCOPE_GLOBAL, read_only=True),
+    _v("innodb_strict_mode", 1, scope=SCOPE_GLOBAL),
+    _v("innodb_print_all_deadlocks", 0, scope=SCOPE_GLOBAL),
+    _v("innodb_read_io_threads", 4, scope=SCOPE_GLOBAL, read_only=True),
+    _v("innodb_write_io_threads", 4, scope=SCOPE_GLOBAL, read_only=True),
+    _v("innodb_page_size", 16384, scope=SCOPE_GLOBAL, read_only=True),
+    _v("innodb_version", "5.7.25", scope=SCOPE_GLOBAL, read_only=True),
+    _v("ft_min_word_len", 4, scope=SCOPE_GLOBAL, read_only=True),
+    _v("ngram_token_size", 2, scope=SCOPE_GLOBAL, read_only=True),
+    _v("default_tmp_storage_engine", "InnoDB"),
+    _v("internal_tmp_disk_storage_engine", "InnoDB", scope=SCOPE_GLOBAL,
+       read_only=True),
+    # ---- engine knobs (reference: sessionctx/variable/tidb_vars.go) ---
+    _v("tidb_current_ts", 0, scope=SCOPE_SESSION, read_only=True),
+    _v("tidb_config", "", scope=SCOPE_SESSION, read_only=True),
+    _v("tidb_general_log", 0, scope=SCOPE_GLOBAL),
+    _v("tidb_enable_window_function", 1),
+    _v("tidb_enable_vectorized_expression", 1),
+    _v("tidb_enable_cascades_planner", 0),
+    _v("tidb_enable_index_merge", 1),
+    _v("tidb_enable_table_partition", "on"),
+    _v("tidb_enable_list_partition", 0),
+    _v("tidb_hash_join_concurrency", 5),
+    _v("tidb_projection_concurrency", 4),
+    _v("tidb_hashagg_partial_concurrency", 4),
+    _v("tidb_hashagg_final_concurrency", 4),
+    _v("tidb_window_concurrency", 4),
+    _v("tidb_executor_concurrency", 5),
+    _v("tidb_index_serial_scan_concurrency", 1),
+    _v("tidb_index_join_batch_size", 25000),
+    _v("tidb_index_lookup_size", 20000),
+    _v("tidb_index_lookup_join_concurrency", 4),
+    _v("tidb_init_chunk_size", 32),
+    _v("tidb_max_chunk_size", 1024),
+    _v("tidb_skip_utf8_check", 0),
+    _v("tidb_skip_ascii_check", 0),
+    _v("tidb_opt_agg_push_down", 1),
+    _v("tidb_opt_distinct_agg_push_down", 0),
+    _v("tidb_opt_join_reorder_threshold", 0),
+    _v("tidb_opt_correlation_threshold", 0.9),
+    _v("tidb_opt_correlation_exp_factor", 1),
+    _v("tidb_opt_insubq_to_join_and_agg", 1),
+    _v("tidb_opt_prefer_range_scan", 0),
+    _v("tidb_ddl_reorg_worker_cnt", 4, scope=SCOPE_GLOBAL),
+    _v("tidb_ddl_reorg_batch_size", 256, scope=SCOPE_GLOBAL),
+    _v("tidb_ddl_error_count_limit", 512, scope=SCOPE_GLOBAL),
+    _v("tidb_max_delta_schema_count", 1024, scope=SCOPE_GLOBAL),
+    _v("tidb_scatter_region", 0, scope=SCOPE_GLOBAL),
+    _v("tidb_wait_split_region_finish", 1),
+    _v("tidb_wait_split_region_timeout", 300),
+    _v("tidb_backoff_lock_fast", 100),
+    _v("tidb_backoff_weight", 2),
+    _v("tidb_dml_batch_size", 0),
+    _v("tidb_batch_insert", 0),
+    _v("tidb_batch_delete", 0),
+    _v("tidb_batch_commit", 0),
+    _v("tidb_constraint_check_in_place", 0),
+    _v("tidb_checksum_table_concurrency", 4),
+    _v("tidb_isolation_read_engines", "tpu,host", scope=SCOPE_SESSION),
+    _v("tidb_store_limit", 0, scope=SCOPE_GLOBAL),
+    _v("tidb_low_resolution_tso", 0, scope=SCOPE_SESSION),
+    _v("tidb_replica_read", "leader", scope=SCOPE_SESSION),
+    _v("tidb_allow_batch_cop", 1),
+    _v("tidb_enable_stmt_summary", 1, scope=SCOPE_GLOBAL),
+    _v("tidb_stmt_summary_refresh_interval", 1800, scope=SCOPE_GLOBAL),
+    _v("tidb_stmt_summary_history_size", 24, scope=SCOPE_GLOBAL),
+    _v("tidb_stmt_summary_max_stmt_count", 3000, scope=SCOPE_GLOBAL),
+    _v("tidb_stmt_summary_internal_query", 0, scope=SCOPE_GLOBAL),
+    _v("tidb_enable_collect_execution_info", 1),
+    _v("tidb_enable_async_commit", 1),
+    _v("tidb_enable_1pc", 1),
+    _v("tidb_enable_clustered_index", "INT_ONLY"),
+    _v("tidb_analyze_version", 1),
+    _v("tidb_build_stats_concurrency", 4),
+    _v("tidb_enable_fast_analyze", 0),
+    _v("tidb_expensive_query_time_threshold", 60, scope=SCOPE_GLOBAL),
+    _v("tidb_force_priority", "NO_PRIORITY"),
+    _v("tidb_enable_noop_functions", 0),
+    _v("tidb_row_format_version", 2, scope=SCOPE_GLOBAL),
+    _v("tidb_enable_chunk_rpc", 1, scope=SCOPE_SESSION),
+    _v("tidb_query_log_max_len", 4096, scope=SCOPE_GLOBAL),
 ]
 
 SYSVARS: dict[str, SysVar] = {v.name: v for v in _VARS}

@@ -258,6 +258,10 @@ class Parser:
                 if self.accept_kw("TERMINATED"):
                     self.expect_kw("BY")
                     fmt.field_term = self._string_lit("TERMINATED BY")
+                    if not fmt.field_term:
+                        raise ParseError(
+                            "FIELDS TERMINATED BY must not be empty",
+                            self.cur)
                 elif self.cur.is_kw("OPTIONALLY") or \
                         self.cur.is_kw("ENCLOSED"):
                     self.accept_kw("OPTIONALLY")
@@ -277,6 +281,9 @@ class Parser:
             self.expect_kw("TERMINATED")
             self.expect_kw("BY")
             fmt.line_term = self._string_lit("LINES TERMINATED BY")
+            if not fmt.line_term:
+                raise ParseError(
+                    "LINES TERMINATED BY must not be empty", self.cur)
         return fmt
 
     def parse_load_data(self) -> ast.LoadDataStmt:
