@@ -144,10 +144,13 @@ def compiled_baselines(arrays, sample: int = 6_000_000):
                                   price, d1, d2, ctypes.byref(out))
                 for _ in range(3))[1]
     assert out.value == want, "compiled kv baseline digest"
-    cship = np.ascontiguousarray(arrays["l_shipdate"][:n])
-    cdisc = np.ascontiguousarray(arrays["l_discount"][:n])
-    cqty = np.ascontiguousarray(arrays["l_quantity"][:n])
-    cprice = np.ascontiguousarray(arrays["l_extendedprice"][:n])
+    # generator columns may be narrowed (int8/int16/int32 staging); the
+    # C loop's ABI is int64 pointers
+    cship = np.ascontiguousarray(arrays["l_shipdate"][:n], dtype=np.int64)
+    cdisc = np.ascontiguousarray(arrays["l_discount"][:n], dtype=np.int64)
+    cqty = np.ascontiguousarray(arrays["l_quantity"][:n], dtype=np.int64)
+    cprice = np.ascontiguousarray(
+        arrays["l_extendedprice"][:n], dtype=np.int64)
     col = sorted(lib.q6_columnar_rowloop(cship, cdisc, cqty, cprice, n,
                                          d1, d2, ctypes.byref(out))
                  for _ in range(3))[1]
@@ -642,17 +645,27 @@ def main() -> None:
         return
 
     # ---- parent: measure the compiled baseline first (numpy-only) ----
-    from tidb_tpu.bench.tpch import generate_lineitem_arrays
-
+    # A baseline failure must never cost the round its headline (the
+    # round-4 lesson, generalized): flights still run, vs_baseline is
+    # null, and the error is on the board.
+    kv_rps = col_rps = q1_rps = 0.0
+    baseline_err = None
     t0 = time.perf_counter()
-    sample = generate_lineitem_arrays(6_000_000)
-    kv_rps, col_rps, q1_rps = compiled_baselines(sample)
-    del sample
-    log(f"compiled baselines ({time.perf_counter() - t0:.0f}s): "
-        f"q6-kv-rowloop={kv_rps / 1e6:.0f}M rows/s, "
-        f"q6-columnar-rowloop={col_rps / 1e6:.0f}M rows/s, "
-        f"q1-kv-rowloop={q1_rps / 1e6:.0f}M rows/s (C++ -O3, "
-        f"single-stream, native/baseline.cpp)")
+    try:
+        from tidb_tpu.bench.tpch import generate_lineitem_arrays
+
+        sample = generate_lineitem_arrays(6_000_000)
+        kv_rps, col_rps, q1_rps = compiled_baselines(sample)
+        del sample
+        log(f"compiled baselines ({time.perf_counter() - t0:.0f}s): "
+            f"q6-kv-rowloop={kv_rps / 1e6:.0f}M rows/s, "
+            f"q6-columnar-rowloop={col_rps / 1e6:.0f}M rows/s, "
+            f"q1-kv-rowloop={q1_rps / 1e6:.0f}M rows/s (C++ -O3, "
+            f"single-stream, native/baseline.cpp)")
+    except Exception as e:  # noqa: BLE001 - headline must survive
+        # (Exception, not BaseException: Ctrl-C/SystemExit still exit)
+        baseline_err = f"{type(e).__name__}: {str(e)[:200]}"
+        log(f"compiled baseline FAILED: {baseline_err}")
 
     flight_names = os.environ.get(
         "BENCH_FLIGHTS", "tpch_small,tpch_big,joins,ssb,cb").split(",")
@@ -662,7 +675,8 @@ def main() -> None:
         f"baseline_c_q6_kv_rowloop: {kv_rps / 1e6:.0f}M rows/s",
         f"baseline_c_q6_columnar_rowloop: {col_rps / 1e6:.0f}M rows/s",
         f"baseline_c_q1_kv_rowloop: {q1_rps / 1e6:.0f}M rows/s",
-    ]
+    ] if baseline_err is None else [f"compiled baseline FAILED: "
+                                    f"{baseline_err}"]
     done = 0
     for name in flight_names:
         name = name.strip()

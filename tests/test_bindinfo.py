@@ -1,0 +1,125 @@
+"""SQL plan management: CREATE/DROP BINDING, SHOW BINDINGS, hint
+injection at plan time (reference: bindinfo/handle.go,
+bindinfo/session_handle.go, mysql.bind_info)."""
+
+import pytest
+
+from testkit import TestKit
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create table bt (a int primary key, b int, key kb (b))")
+    t.must_exec("insert into bt values " +
+                ",".join(f"({i},{i % 7})" for i in range(200)))
+    t.must_exec("create table ct (a int primary key, c int)")
+    t.must_exec("insert into ct values " +
+                ",".join(f"({i},{i})" for i in range(50)))
+    return t
+
+
+def _explain(tk, sql):
+    return "\n".join(r[0] for r in tk.must_query("explain " + sql))
+
+
+def test_session_binding_injects_hints(tk):
+    base = _explain(tk, "select * from bt where b = 3")
+    tk.must_exec(
+        "create binding for select * from bt where b = 3 "
+        "using select /*+ IGNORE_INDEX(bt, kb) */ * from bt where b = 3")
+    bound = _explain(tk, "select * from bt where b = 3")
+    # EXPLAIN shows the bound plan: the index path is forced off
+    assert bound != base, (base, bound)
+    # the query itself still answers correctly and reports the binding
+    assert len(tk.must_query("select * from bt where b = 3")) == 29
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 1
+    # different literals, same shape: binding still matches
+    tk.must_query("select * from bt where b = 5")
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 1
+    # a different statement shape does not match
+    tk.must_query("select a from bt where b = 3 and a > 1")
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 0
+
+
+def test_show_and_drop_binding(tk):
+    tk.must_exec(
+        "create binding for select * from bt where b = 1 "
+        "using select /*+ USE_INDEX(bt, kb) */ * from bt where b = 1")
+    rows = tk.must_query("show bindings")
+    assert len(rows) == 1
+    orig, bind_sql, db, status = rows[0][:4]
+    assert "?" in orig and "bt" in orig
+    assert "USE_INDEX" in bind_sql
+    assert db == "test" and status == "enabled"
+    tk.must_exec("drop binding for select * from bt where b = 99")
+    assert tk.must_query("show bindings") == []
+
+
+def test_global_binding_persists_and_crosses_sessions(tk):
+    tk.must_exec(
+        "create global binding for select * from bt where b = 2 "
+        "using select /*+ USE_INDEX(bt, kb) */ * from bt where b = 2")
+    assert len(tk.must_query("show global bindings")) == 1
+    sib = Session(tk.session.storage)
+    sib.execute("use test")
+    sib.execute("select * from bt where b = 2")
+    assert sib.execute(
+        "select @@last_plan_from_binding").rows[0][0] == 1
+    tk.must_exec("drop global binding for select * from bt where b = 2")
+    assert tk.must_query("show global bindings") == []
+
+
+def test_mismatched_using_statement_rejected(tk):
+    with pytest.raises(Exception):
+        tk.must_exec(
+            "create binding for select * from bt where b = 1 "
+            "using select /*+ USE_INDEX(bt, kb) */ * from ct")
+
+
+def test_baselines_toggle(tk):
+    tk.must_exec(
+        "create binding for select * from bt where b = 4 "
+        "using select /*+ USE_INDEX(bt, kb) */ * from bt where b = 4")
+    tk.must_exec("set tidb_use_plan_baselines = 0")
+    tk.must_query("select * from bt where b = 4")
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 0
+    tk.must_exec("set tidb_use_plan_baselines = 1")
+    tk.must_query("select * from bt where b = 4")
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 1
+
+
+def test_binding_leading_join_order(tk):
+    """A LEADING hint through a binding changes the join order the
+    planner picks (observable in EXPLAIN)."""
+    sql = "select count(*) from bt, ct where bt.a = ct.a"
+    base = _explain(tk, sql)
+    tk.must_exec(
+        f"create binding for {sql} using "
+        f"select /*+ LEADING(ct, bt) */ count(*) "
+        f"from bt, ct where bt.a = ct.a")
+    bound = _explain(tk, sql)
+    assert bound != base, (base, bound)
+    assert tk.must_query(sql) == [(50,)]
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 1
+
+
+def test_binding_matches_prepared_statements(tk):
+    """PREPARE text '?' markers line up with the literal-normalized
+    binding key, so EXECUTE picks the binding up too."""
+    tk.must_exec(
+        "create binding for select * from bt where b = 1 "
+        "using select /*+ IGNORE_INDEX(bt, kb) */ * from bt where b = 1")
+    sid, n = tk.session.prepare("select * from bt where b = ?")
+    assert n == 1
+    rows = tk.session.execute_prepared(sid, [6]).rows
+    assert len(rows) == 28
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 1

@@ -104,6 +104,11 @@ class Session:
         # dominates the workloads the cache exists for)
         self._plan_cache: dict = {}
         self._plan_cache_key: Optional[str] = None
+        # SESSION-scope plan bindings (bindinfo/session_handle.go analog)
+        self.session_bindings: dict[str, dict] = {}
+        self._binding_gen = 0
+        self._binding_match_sql: Optional[str] = None
+        self._raw_sql: Optional[str] = None
         self.plan_cache_hits = 0
         # KILL plane: QUERY kill interrupts the running statement;
         # CONNECTION kill is handled by the server (socket teardown).
@@ -135,6 +140,8 @@ class Session:
             self._plan_cache_key = sql if (
                 single and isinstance(
                     stmt, (ast.SelectStmt, ast.SetOpStmt))) else None
+            self._binding_match_sql = self._plan_cache_key
+            self._raw_sql = sql if single else None
             try:
                 # batch members skip digest recording: per-statement text
                 # isn't recoverable from the batch label, and raw batch
@@ -144,6 +151,7 @@ class Session:
                     stmt, label, digest_sql=sql if single else None)
             finally:
                 self._plan_cache_key = None
+                self._binding_match_sql = None
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)
@@ -222,7 +230,7 @@ class Session:
             raise SQLError("prepared statement must be a single statement")
         self._next_stmt_id += 1
         sid = self._next_stmt_id
-        self._prepared[sid] = (stmts[0], parser.param_count)
+        self._prepared[sid] = (stmts[0], parser.param_count, sql)
         return sid, parser.param_count
 
     def execute_prepared(self, stmt_id: int, params: list) -> ResultSet:
@@ -234,7 +242,7 @@ class Session:
         entry = self._prepared.get(stmt_id)
         if entry is None:
             raise SQLError(f"unknown prepared statement {stmt_id}")
-        stmt, n_params = entry
+        stmt, n_params, raw_sql = entry
         if len(params) != n_params:
             raise SQLError(
                 f"expected {n_params} parameters, got {len(params)}")
@@ -246,10 +254,14 @@ class Session:
         # prepared-plan cache, common_plans.go getPhysicalPlan)
         if isinstance(bound, (ast.SelectStmt, ast.SetOpStmt)):
             self._plan_cache_key = f"#stmt{stmt_id}:{params!r}"
+            # bindings match on the PREPARE text: its '?' markers line up
+            # with the literal-normalized binding key
+            self._binding_match_sql = raw_sql
         try:
             return self._execute_observed(bound, f"EXECUTE stmt#{stmt_id}")
         finally:
             self._plan_cache_key = None
+            self._binding_match_sql = None
 
     def close_prepared(self, stmt_id: int) -> None:
         self._prepared.pop(stmt_id, None)
@@ -402,6 +414,10 @@ class Session:
                     "new_name": new.name,
                     "new_db": new.db or old.db or self.current_db})
             return ResultSet([], [])
+        if isinstance(stmt, ast.CreateBindingStmt):
+            return self._exec_create_binding(stmt)
+        if isinstance(stmt, ast.DropBindingStmt):
+            return self._exec_drop_binding(stmt)
         if isinstance(stmt, ast.AdminStmt):
             if stmt.kind == "SHOW_DDL_JOBS":
                 jobs = (list(self.storage.ddl_jobs)
@@ -1003,6 +1019,7 @@ class Session:
         # literals, or the cache would freeze the first-seen values
         has_vars = self._has_var_reads(stmt)
         stmt = self._maybe_bind_vars(stmt, has_vars)
+        stmt = self._apply_binding(stmt)
         self._refresh_infoschema(stmt)
         try:
             if getattr(stmt, "for_update", False):
@@ -1021,6 +1038,8 @@ class Session:
                 self.txn.stmt_read_ts = None
         self.last_mem_peak = ctx.mem.peak
         self.last_spill_count = ctx.mem.spill_count
+        self.vars["last_plan_from_binding"] = getattr(
+            self, "_lpfb_next", 0)
         names = [f.name for f in plan.schema.fields]
         ftypes = [f.ftype for f in plan.schema.fields]
         if not chunk.columns:
@@ -1055,7 +1074,8 @@ class Session:
                 or getattr(stmt, "for_update", False)):
             return self._plan(stmt)
         gen = (self.catalog.version, self.storage.stats.generation,
-               self.current_db)
+               self.current_db, self._binding_gen,
+               self.storage.bindings.fingerprint())
         entry = self._plan_cache.get(key)
         if entry is not None and entry[0] == gen:
             self.plan_cache_hits += 1
@@ -1207,6 +1227,77 @@ class Session:
             return ResultSet([], [], affected=count)
         finally:
             txn.stmt_read_ts = None
+
+    # ==================== SQL plan management (bindinfo) ==================
+    def _exec_create_binding(self, stmt: ast.CreateBindingStmt
+                             ) -> ResultSet:
+        """CREATE [GLOBAL|SESSION] BINDING (reference: bindinfo
+        CreateBindRecord). The FOR and USING statements must normalize
+        identically modulo hints."""
+        from .bindinfo import (binding_digest, normalize_binding_sql)
+        norm_orig = normalize_binding_sql(stmt.orig_sql)
+        norm_bind = normalize_binding_sql(stmt.bind_sql)
+        if norm_orig != norm_bind:
+            raise SQLError(
+                "create binding only supports a USING statement that "
+                "differs from the original by optimizer hints")
+        bs = stmt.bind_stmt
+        hints = list(getattr(bs, "hints", []) or (
+            bs.selects[0].hints if isinstance(bs, ast.SetOpStmt) else []))
+        if stmt.scope == "GLOBAL":
+            self._require_super()
+            self.storage.bindings.create(
+                norm_orig, stmt.bind_sql, self.current_db, hints)
+        else:
+            from .bindinfo import make_record
+            self.session_bindings[
+                binding_digest(norm_orig, self.current_db)] = make_record(
+                norm_orig, stmt.bind_sql, self.current_db, hints)
+        self._binding_gen += 1
+        return ResultSet([], [])
+
+    def _exec_drop_binding(self, stmt: ast.DropBindingStmt) -> ResultSet:
+        from .bindinfo import binding_digest, normalize_binding_sql
+        norm = normalize_binding_sql(stmt.orig_sql)
+        if stmt.scope == "GLOBAL":
+            self._require_super()
+            self.storage.bindings.drop(norm, self.current_db)
+        else:
+            self.session_bindings.pop(
+                binding_digest(norm, self.current_db), None)
+        self._binding_gen += 1
+        return ResultSet([], [])
+
+    def _apply_binding(self, stmt):
+        """Hint injection for a matched binding: SESSION bindings shadow
+        GLOBAL ones; the user's literals are kept and only the binding's
+        hint set transfers (reference: bindinfo/bind_record.go).
+
+        @@last_plan_from_binding describes the PREVIOUS statement, so the
+        new value lands in session vars only when this statement
+        finishes (_exec_select) — a probe SELECT reading the variable at
+        runtime still sees its predecessor's value."""
+        self._lpfb_next = 0
+        sql = self._binding_match_sql
+        if not sql or (not self.session_bindings
+                       and not self.storage.bindings.all()):
+            return stmt
+        if not int(self._sysvar_value("tidb_use_plan_baselines") or 0):
+            return stmt
+        from .bindinfo import binding_digest, normalize_binding_sql
+        norm = normalize_binding_sql(sql)
+        rec = self.session_bindings.get(
+            binding_digest(norm, self.current_db)) \
+            or self.storage.bindings.match(norm, self.current_db)
+        if not rec or rec.get("status") != "enabled":
+            return stmt
+        hints = [(h[0], list(h[1])) for h in rec.get("hints", [])]
+        if isinstance(stmt, ast.SetOpStmt):
+            stmt.selects[0].hints = hints
+        else:
+            stmt.hints = hints
+        self._lpfb_next = 1
+        return stmt
 
     # ==================== LOAD DATA / INTO OUTFILE / ADMIN CHECK ==========
     def _require_file_priv(self, path: str) -> None:
@@ -2062,6 +2153,19 @@ class Session:
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         if not isinstance(stmt.target, (ast.SelectStmt, ast.SetOpStmt)):
             raise SQLError("EXPLAIN supports SELECT only for now")
+        # bindings apply to the displayed plan too — EXPLAIN must show
+        # what would actually run (reference: bindinfo matched in the
+        # common optimize path, planner/optimize.go)
+        import re
+        m = re.match(r"(?is)\s*explain\s+(?:analyze\s+)?(.*)$",
+                     self._raw_sql or "")
+        if m and m.group(1):
+            prev = self._binding_match_sql
+            self._binding_match_sql = m.group(1)
+            try:
+                stmt.target = self._apply_binding(stmt.target)
+            finally:
+                self._binding_match_sql = prev
         plan = self._plan(stmt.target)
         if not stmt.analyze:
             lines = explain_plan(plan)
@@ -2198,6 +2302,16 @@ class Session:
                 obj = "*.*" if db == "*" and tbl == "*" else f"{db}.{tbl}"
                 rows.append((f"GRANT {p} ON {obj} TO '{target}'@'%'",))
             return ResultSet([f"Grants for {target}@%"], rows)
+        if stmt.kind == "BINDINGS":
+            recs = self.storage.bindings.all() if stmt.scope == "GLOBAL" \
+                else list(self.session_bindings.values())
+            cols = ["Original_sql", "Bind_sql", "Default_db", "Status",
+                    "Create_time", "Update_time", "Charset", "Collation",
+                    "Source"]
+            return ResultSet(cols, [
+                (r["original_sql"], r["bind_sql"], r["default_db"],
+                 r["status"], r["create_time"], r["update_time"],
+                 "utf8mb4", "utf8mb4_bin", "manual") for r in recs])
         if stmt.kind == "WARNINGS":
             return ResultSet(["Level", "Code", "Message"], [])
         if stmt.kind == "ENGINES":

@@ -275,6 +275,8 @@ _VARS = [
     _v("tidb_row_format_version", 2, scope=SCOPE_GLOBAL),
     _v("tidb_enable_chunk_rpc", 1, scope=SCOPE_SESSION),
     _v("tidb_query_log_max_len", 4096, scope=SCOPE_GLOBAL),
+    _v("last_plan_from_binding", 0, scope=SCOPE_SESSION, read_only=True),
+    _v("tidb_use_plan_baselines", 1),
 ]
 
 SYSVARS: dict[str, SysVar] = {v.name: v for v in _VARS}

@@ -455,6 +455,23 @@ class AdminStmt(Stmt):
 
 
 @dataclass
+class CreateBindingStmt(Stmt):
+    """CREATE [GLOBAL|SESSION] BINDING FOR <stmt> USING <hinted stmt>
+    (reference: bindinfo/handle.go; ast CreateBindingStmt)."""
+
+    scope: str  # 'GLOBAL' | 'SESSION'
+    orig_sql: str  # raw text of the FOR statement
+    bind_sql: str  # raw text of the USING statement
+    bind_stmt: SelectStmt = None  # parsed USING stmt (hints source)
+
+
+@dataclass
+class DropBindingStmt(Stmt):
+    scope: str
+    orig_sql: str
+
+
+@dataclass
 class CreateDatabaseStmt(Stmt):
     name: str
     if_not_exists: bool = False

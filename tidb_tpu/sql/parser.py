@@ -689,8 +689,37 @@ class Parser:
             self.advance()  # host (ident or string)
         return name
 
+    def _parse_binding_tail(self) -> tuple[str, str, "ast.Stmt"]:
+        """FOR <stmt> USING <stmt> -> (orig raw text, bind raw text,
+        parsed bind stmt). The raw texts are what bindinfo stores
+        (reference: bindinfo/handle.go normalizes and persists both)."""
+        self.expect_kw("FOR")
+        start = self.cur.pos
+        self.parse_select_statement()
+        if not self.cur.is_kw("USING"):
+            raise ParseError("expected USING in BINDING", self.cur)
+        orig = self.text[start:self.cur.pos].strip()
+        self.advance()
+        bstart = self.cur.pos
+        bind_stmt = self.parse_select_statement()
+        bend = self.cur.pos if self.cur.kind != TokenKind.EOF \
+            else len(self.text)
+        bind = self.text[bstart:bend].strip().rstrip(";").strip()
+        return orig, bind, bind_stmt
+
     def parse_create(self) -> ast.Stmt:
         self.expect_kw("CREATE")
+        scope_t = None
+        if self.cur.is_kw("GLOBAL", "SESSION") and \
+                self.peek().kind == TokenKind.IDENT and \
+                self.peek().text.upper() == "BINDING":
+            scope_t = self.advance().text
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "BINDING":
+            self.advance()
+            orig, bind, bind_stmt = self._parse_binding_tail()
+            return ast.CreateBindingStmt(scope_t or "SESSION", orig,
+                                         bind, bind_stmt)
         or_replace = False
         if self.cur.is_kw("OR"):
             self.advance()
@@ -1079,6 +1108,21 @@ class Parser:
 
     def parse_drop(self) -> ast.Stmt:
         self.expect_kw("DROP")
+        scope_t = None
+        if self.cur.is_kw("GLOBAL", "SESSION") and \
+                self.peek().kind == TokenKind.IDENT and \
+                self.peek().text.upper() == "BINDING":
+            scope_t = self.advance().text
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "BINDING":
+            self.advance()
+            self.expect_kw("FOR")
+            start = self.cur.pos
+            self.parse_select_statement()
+            end = self.cur.pos if self.cur.kind != TokenKind.EOF \
+                else len(self.text)
+            orig = self.text[start:end].strip().rstrip(";").strip()
+            return ast.DropBindingStmt(scope_t or "SESSION", orig)
         if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "VIEW":
             self.advance()
@@ -1145,6 +1189,10 @@ class Parser:
             return self._show_like(ast.ShowStmt("DATABASES"))
         if self.accept_kw("STATUS"):
             return self._show_like(ast.ShowStmt("STATUS", scope=scope))
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "BINDINGS":
+            self.advance()
+            return ast.ShowStmt("BINDINGS", scope=scope)
         if self.accept_kw("WARNINGS", "ERRORS"):
             return ast.ShowStmt("WARNINGS")
         if self.accept_kw("ENGINES"):

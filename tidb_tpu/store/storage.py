@@ -140,6 +140,10 @@ class Storage:
         self.sysvars = SysVarManager(self)
         # grant tables (mysql.user analog) — same persistence plane
         self.privileges = PrivilegeManager(self)
+        # SQL plan management bindings (mysql.bind_info analog)
+        from ..session.bindinfo import BindingManager
+
+        self.bindings = BindingManager(self)
         # DDL job queue + history (the meta-KV DDLJobList analog,
         # reference meta/meta.go:571) — lives on storage so a replacement
         # worker resumes pending jobs with their reorg checkpoints
@@ -812,6 +816,9 @@ class Storage:
             return
         self.kv.refresh()
         self._drain_refresh()
+        # sibling CREATE/DROP BINDING lands in the meta plane; drop the
+        # cache so the next match reloads (bindinfo load loop analog)
+        self.bindings.invalidate()
 
     def _drain_refresh(self) -> None:
         from ..kv.mvcc import (
