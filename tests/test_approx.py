@@ -151,6 +151,38 @@ def test_mixed_width_partitions_agree(tk):
     assert abs(approx - exact) <= max(2, REL_TOL * exact)
 
 
+def test_approx_percentile(tk):
+    """APPROX_PERCENTILE(expr, p): the element at ceil(p% * n) in sort
+    order, per group (reference: executor/aggfuncs/builder.go:110,
+    func_percentile.go)."""
+    tk.must_exec("create table pc (g int, v int, d decimal(8,2))")
+    tk.must_exec("insert into pc values " +
+                 ",".join(f"({i % 2},{i},{i}.50)" for i in range(1, 101)))
+    assert _one(tk, "select approx_percentile(v, 50) from pc") == 50
+    assert tk.must_query(
+        "select g, approx_percentile(v, 90) from pc group by g "
+        "order by g") == [(0, 90), (1, 89)]
+    assert str(_one(tk, "select approx_percentile(d, 25) from pc")) \
+        == "25.50"
+    assert _one(tk, "select approx_percentile(v, 100) from pc") == 100
+    # NULL-only input -> NULL; out-of-range percent rejected
+    tk.must_exec("create table pcn (v int)")
+    tk.must_exec("insert into pcn values (NULL)")
+    assert _one(tk, "select approx_percentile(v, 50) from pcn") is None
+    with pytest.raises(Exception):
+        tk.must_query("select approx_percentile(v, 0) from pc")
+    with pytest.raises(Exception):
+        tk.must_query("select approx_percentile(v, 101) from pc")
+    # non-numeric percent and string arguments are plan errors, not
+    # internal crashes
+    with pytest.raises(Exception):
+        tk.must_query("select approx_percentile(v, 'x') from pc")
+    tk.must_exec("create table pcs (s varchar(8))")
+    tk.must_exec("insert into pcs values ('a'), ('b')")
+    with pytest.raises(Exception):
+        tk.must_query("select approx_percentile(s, 50) from pcs")
+
+
 def test_analyze_ndv_uses_same_sketch(tk):
     """ANALYZE's device NDV and the aggregate share hash + estimator, so
     both land within tolerance of the exact count."""

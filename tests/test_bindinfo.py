@@ -111,6 +111,21 @@ def test_binding_leading_join_order(tk):
         "select @@last_plan_from_binding")[0][0] == 1
 
 
+def test_prepared_explain_does_not_reuse_stale_raw_sql(tk):
+    """A prepared EXPLAIN must not regex-match the PREVIOUS direct
+    statement's text for binding application."""
+    tk.must_exec(
+        "create binding for select * from bt where b = 1 "
+        "using select /*+ IGNORE_INDEX(bt, kb) */ * from bt where b = 1")
+    # direct EXPLAIN leaves _raw_sql behind unless cleared
+    tk.must_query("explain select * from bt where b = 1")
+    sid, _ = tk.session.prepare("select a from ct where a = ?")
+    rows = tk.session.execute_prepared(sid, [1]).rows
+    assert rows == [(1,)]
+    assert tk.must_query(
+        "select @@last_plan_from_binding")[0][0] == 0
+
+
 def test_binding_matches_prepared_statements(tk):
     """PREPARE text '?' markers line up with the literal-normalized
     binding key, so EXECUTE picks the binding up too."""

@@ -80,6 +80,68 @@ def session():
     return Session()
 
 
+JSON_DOC = '{"a": 1, "b": [1, 2, 3], "c": {"d": "x"}}'
+
+JSON_CASES = [
+    (f"select json_set('{JSON_DOC}', '$.e', 5)",
+     '{"a": 1, "b": [1, 2, 3], "c": {"d": "x"}, "e": 5}'),
+    (f"select json_insert('{JSON_DOC}', '$.a', 9)", JSON_DOC.replace(
+        '", "', '", "')),  # existing path: insert is a no-op
+    (f"select json_replace('{JSON_DOC}', '$.a', 9)",
+     '{"a": 9, "b": [1, 2, 3], "c": {"d": "x"}}'),
+    (f"select json_remove('{JSON_DOC}', '$.b[0]', '$.c')",
+     '{"a": 1, "b": [2, 3]}'),
+    (f"select json_keys('{JSON_DOC}')", '["a", "b", "c"]'),
+    (f"select json_keys('{JSON_DOC}', '$.c')", '["d"]'),
+    (f"select json_contains('{JSON_DOC}', '2', '$.b')", "1"),
+    (f"select json_contains('{JSON_DOC}', '9', '$.b')", "0"),
+    (f"select json_contains_path('{JSON_DOC}', 'one', '$.z', '$.a')",
+     "1"),
+    (f"select json_contains_path('{JSON_DOC}', 'all', '$.z', '$.a')",
+     "0"),
+    (f"select json_depth('{JSON_DOC}')", "3"),
+    ("select json_depth('1')", "1"),
+    ("select json_quote('a\"b')", '"a\\"b"'),
+    ("select json_merge_patch('{\"a\": 1, \"b\": 2}', "
+     "'{\"b\": null, \"c\": 3}')", '{"a": 1, "c": 3}'),
+    ("select json_merge_preserve('{\"a\": 1}', '{\"a\": 2}')",
+     '{"a": [1, 2]}'),
+    ("select json_array_append('[1, 2]', '$', 3)", "[1, 2, 3]"),
+    ("select json_search('{\"x\": \"abc\", \"y\": [\"abc\"]}', "
+     "'one', 'abc')", '"$.x"'),
+    ("select json_search('{\"x\": \"abc\", \"y\": [\"abc\"]}', "
+     "'all', 'abc')", '["$.x", "$.y[0]"]'),
+    ("select json_overlaps('[1, 2]', '[2, 9]')", "1"),
+    ("select json_overlaps('[1, 2]', '[8, 9]')", "0"),
+    # objects overlap on ANY shared key/value pair (MySQL semantics)
+    ("select json_overlaps('{\"a\": 1, \"b\": 2}', "
+     "'{\"a\": 1, \"c\": 3}')", "1"),
+    ("select json_overlaps('{\"a\": 1}', '{\"a\": 2}')", "0"),
+    # JSON true and integer 1 are distinct types
+    ("select json_contains('[1]', 'true')", "0"),
+    ("select json_contains('[true]', 'true')", "1"),
+    ("select json_storage_size('[1]')", "3"),
+]
+
+MISC_CASES = [
+    ("select from_unixtime(86400)", "1970-01-02 00:00:00"),
+    ("select from_unixtime(86400, '%Y-%m-%d')", "1970-01-02"),
+    ("select is_uuid('6ccd780c-baba-1026-9564-5b8c656024db')", "1"),
+    ("select is_uuid('not-a-uuid')", "0"),
+    ("select is_ipv6('::1')", "1"),
+    ("select is_ipv6('10.0.0.1')", "0"),
+    ("select inet6_ntoa(inet6_aton('fe80::1'))", "fe80::1"),
+    ("select uncompress(compress('payload'))", "payload"),
+    ("select uncompressed_length(compress('payload'))", "7"),
+    ("select charset('x')", "utf8mb4"),
+    ("select collation('x')", "utf8mb4_bin"),
+    ("select name_const('k', 42)", "42"),
+    ("select format_bytes(1048576)", "1.00 MiB"),
+]
+
+CASES = CASES + JSON_CASES + MISC_CASES
+
+
 @pytest.mark.parametrize("sql,want", CASES, ids=[c[0][:60] for c in CASES])
 def test_registry_function(session, sql, want):
     got = session.query(sql)[0][0]

@@ -585,3 +585,438 @@ _reg("IS_IPV4", 1, 1, "int",
      lambda s: 1 if _re.fullmatch(
          r"(\d{1,3}\.){3}\d{1,3}", s) and all(
          int(p) <= 255 for p in s.split(".")) else 0)
+
+
+# ---------------------------------------------------------------------------
+# JSON modification/query family (reference: expression/builtin_json.go;
+# docs arrive as canonical JSON text, results re-canonicalize on encode)
+# ---------------------------------------------------------------------------
+
+import json as _json
+
+
+def _jload(doc):
+    try:
+        return _json.loads(doc)
+    except (ValueError, TypeError):
+        return _JSON_BAD
+
+
+_JSON_BAD = object()
+
+
+def _jdump(v) -> str:
+    return _json.dumps(v, sort_keys=True, separators=(", ", ": "))
+
+
+def _jpath(path):
+    from .npeval import _json_path_steps
+    return _json_path_steps(path)
+
+
+def _jval(v):
+    """Registry argument -> JSON value (MySQL: non-JSON string args are
+    string values; ints/floats/bools pass through)."""
+    import decimal
+    if isinstance(v, decimal.Decimal):
+        f = float(v)
+        return int(v) if f.is_integer() else f
+    return v
+
+
+def _j_walk_set(v, steps, new, mode):
+    """Immutable set/insert/replace at path; returns updated value."""
+    if not steps:
+        return new if mode in ("set", "replace") else v
+    s = steps[0]
+    if isinstance(s, int):
+        if not isinstance(v, list):
+            return v
+        out = list(v)
+        if s < len(v):
+            out[s] = _j_walk_set(v[s], steps[1:], new, mode)
+        elif len(steps) == 1 and mode in ("set", "insert"):
+            out.append(new)
+        return out
+    if not isinstance(v, dict):
+        return v
+    out = dict(v)
+    if s in v:
+        out[s] = _j_walk_set(v[s], steps[1:], new, mode)
+    elif len(steps) == 1 and mode in ("set", "insert"):
+        out[s] = new
+    return out
+
+
+def _j_modify(mode):
+    def fn(doc, *pairs):
+        v = _jload(doc)
+        if v is _JSON_BAD or len(pairs) % 2:
+            return None
+        for i in range(0, len(pairs), 2):
+            steps = _jpath(pairs[i])
+            if steps is None:
+                return None
+            v = _j_walk_set(v, steps, _jval(pairs[i + 1]), mode)
+        return _jdump(v)
+    return fn
+
+
+def _j_remove(doc, *paths):
+    v = _jload(doc)
+    if v is _JSON_BAD:
+        return None
+
+    def rm(val, steps):
+        if not steps:
+            return val
+        s = steps[0]
+        if isinstance(s, int) and isinstance(val, list) and s < len(val):
+            out = list(val)
+            if len(steps) == 1:
+                del out[s]
+            else:
+                out[s] = rm(val[s], steps[1:])
+            return out
+        if isinstance(s, str) and isinstance(val, dict) and s in val:
+            out = dict(val)
+            if len(steps) == 1:
+                del out[s]
+            else:
+                out[s] = rm(val[s], steps[1:])
+            return out
+        return val
+
+    for p in paths:
+        steps = _jpath(p)
+        if not steps:  # '$' itself is not removable
+            return None
+        v = rm(v, steps)
+    return _jdump(v)
+
+
+def _j_at(doc, path):
+    """(parsed value at path, found) over a JSON text."""
+    v = _jload(doc)
+    if v is _JSON_BAD:
+        return None, False
+    steps = _jpath(path) if path is not None else []
+    if steps is None:
+        return None, False
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or s >= len(v):
+                return None, False
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None, False
+            v = v[s]
+    return v, True
+
+
+def _j_contains_val(hay, needle):
+    """MySQL containment: arrays contain elements/subsets; objects
+    contain key-subset docs; scalars contain equal scalars."""
+    if isinstance(hay, list):
+        if isinstance(needle, list):
+            return all(any(_j_contains_val(h, n) for h in hay)
+                       for n in needle)
+        return any(_j_contains_val(h, needle) for h in hay)
+    if isinstance(hay, dict):
+        if not isinstance(needle, dict):
+            return False
+        return all(k in hay and _j_contains_val(hay[k], v)
+                   for k, v in needle.items())
+    # scalars: equal values of the same JSON type; booleans are a
+    # distinct type from numbers (bool subclasses int in Python, so the
+    # bool-ness must match explicitly on both sides)
+    if isinstance(hay, bool) != isinstance(needle, bool):
+        return False
+    if isinstance(hay, bool):
+        return hay == needle
+    if isinstance(hay, (int, float)) and isinstance(needle, (int, float)):
+        return hay == needle
+    return type(hay) is type(needle) and hay == needle
+
+
+def _j_contains(doc, cand, path=None):
+    hay, ok = _j_at(doc, path)
+    if not ok:
+        return None
+    needle = _jload(cand)
+    if needle is _JSON_BAD:
+        return None
+    return 1 if _j_contains_val(hay, needle) else 0
+
+
+def _j_contains_path(doc, one_or_all, *paths):
+    mode = str(one_or_all).lower()
+    if mode not in ("one", "all") or not paths:
+        return None
+    found = [_j_at(doc, p)[1] for p in paths]
+    return 1 if (any(found) if mode == "one" else all(found)) else 0
+
+
+def _j_keys(doc, path=None):
+    v, ok = _j_at(doc, path)
+    if not ok or not isinstance(v, dict):
+        return None
+    return _jdump(sorted(v.keys()))
+
+
+def _j_depth(doc):
+    v = _jload(doc)
+    if v is _JSON_BAD:
+        return None
+
+    def d(x):
+        if isinstance(x, dict):
+            return 1 + max((d(v2) for v2 in x.values()), default=0)
+        if isinstance(x, list):
+            return 1 + max((d(v2) for v2 in x), default=0)
+        return 1
+    return d(v)
+
+
+def _j_merge_patch(*docs):
+    vals = [_jload(d) for d in docs]
+    if any(v is _JSON_BAD for v in vals):
+        return None
+
+    def patch(a, b):
+        if not isinstance(b, dict):
+            return b
+        out = dict(a) if isinstance(a, dict) else {}
+        for k, v in b.items():
+            if v is None:
+                out.pop(k, None)
+            else:
+                out[k] = patch(out.get(k), v)
+        return out
+
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = patch(acc, v)
+    return _jdump(acc)
+
+
+def _j_merge_preserve(*docs):
+    vals = [_jload(d) for d in docs]
+    if any(v is _JSON_BAD for v in vals):
+        return None
+
+    def merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = merge(out[k], v) if k in out else v
+            return out
+        la = a if isinstance(a, list) else [a]
+        lb = b if isinstance(b, list) else [b]
+        return la + lb
+
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = merge(acc, v)
+    return _jdump(acc)
+
+
+def _j_array_append(doc, *pairs):
+    v = _jload(doc)
+    if v is _JSON_BAD or len(pairs) % 2:
+        return None
+    for i in range(0, len(pairs), 2):
+        steps = _jpath(pairs[i])
+        if steps is None:
+            return None
+        cur, ok = _j_at(_jdump(v), pairs[i])
+        if not ok:
+            continue
+        new = (cur + [_jval(pairs[i + 1])]) if isinstance(cur, list) \
+            else [cur, _jval(pairs[i + 1])]
+        v = _j_walk_set(v, steps, new, "set") if steps else new
+    return _jdump(v)
+
+
+def _j_search(doc, one_or_all, target):
+    mode = str(one_or_all).lower()
+    if mode not in ("one", "all"):
+        return None
+    v = _jload(doc)
+    if v is _JSON_BAD:
+        return None
+    hits: list[str] = []
+
+    def like(s):
+        import re
+        pat = "".join(".*" if c == "%" else "." if c == "_"
+                      else re.escape(c) for c in str(target))
+        return re.fullmatch(pat, s) is not None
+
+    def walk(x, path):
+        if isinstance(x, str) and like(x):
+            hits.append(path)
+        elif isinstance(x, dict):
+            for k in sorted(x):
+                walk(x[k], f"{path}.{k}")
+        elif isinstance(x, list):
+            for i, e in enumerate(x):
+                walk(e, f"{path}[{i}]")
+
+    walk(v, "$")
+    if not hits:
+        return None
+    if mode == "one":
+        return _jdump(hits[0])
+    return _jdump(hits[0] if len(hits) == 1 else hits)
+
+
+_reg("JSON_QUOTE", 1, 1, "str", lambda s: _json.dumps(str(s)))
+_reg("JSON_DEPTH", 1, 1, "int", _j_depth)
+_reg("JSON_KEYS", 1, 2, "str", _j_keys)
+_reg("JSON_CONTAINS", 2, 3, "int", _j_contains)
+_reg("JSON_CONTAINS_PATH", 3, 8, "int", _j_contains_path)
+_reg("JSON_SET", 3, 13, "str", _j_modify("set"))
+_reg("JSON_INSERT", 3, 13, "str", _j_modify("insert"))
+_reg("JSON_REPLACE", 3, 13, "str", _j_modify("replace"))
+_reg("JSON_REMOVE", 2, 8, "str", _j_remove)
+_reg("JSON_MERGE_PATCH", 2, 8, "str", _j_merge_patch)
+_reg("JSON_MERGE_PRESERVE", 2, 8, "str", _j_merge_preserve)
+_reg("JSON_MERGE", 2, 8, "str", _j_merge_preserve)
+_reg("JSON_ARRAY_APPEND", 3, 13, "str", _j_array_append)
+_reg("JSON_SEARCH", 3, 3, "str", _j_search)
+_reg("JSON_PRETTY", 1, 1, "str",
+     lambda d: None if _jload(d) is _JSON_BAD
+     else _json.dumps(_jload(d), indent=2, sort_keys=True))
+_reg("JSON_STORAGE_SIZE", 1, 1, "int",
+     lambda d: None if _jload(d) is _JSON_BAD else len(d))
+_reg("JSON_OVERLAPS", 2, 2, "int",
+     lambda a, b: None if _jload(a) is _JSON_BAD
+     or _jload(b) is _JSON_BAD
+     else (1 if _j_overlaps(_jload(a), _jload(b)) else 0))
+
+
+def _j_overlaps(a, b):
+    if isinstance(a, list) and isinstance(b, list):
+        return any(_j_contains_val([x], y) for x in a for y in b)
+    if isinstance(a, list):
+        return _j_contains_val(a, b)
+    if isinstance(b, list):
+        return _j_contains_val(b, a)
+    if isinstance(a, dict) and isinstance(b, dict):
+        # MySQL: objects overlap when ANY key/value pair is shared
+        return any(k in b and _j_contains_val(b[k], v)
+                   and _j_contains_val(v, b[k]) for k, v in a.items())
+    return _j_contains_val(a, b)
+
+
+# ---------------------------------------------------------------------------
+# misc compat (reference: builtin_miscellaneous.go, builtin_info.go)
+# ---------------------------------------------------------------------------
+
+_FU_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%-m", "d": "%d",
+           "e": "%-d", "H": "%H", "k": "%-H", "i": "%M", "s": "%S",
+           "S": "%S", "p": "%p", "W": "%A", "a": "%a", "b": "%b",
+           "M": "%B", "j": "%j", "T": "%H:%M:%S", "%": "%%"}
+
+
+def _from_unixtime(ts, fmt=None):
+    if float(ts) < 0:
+        return None
+    t = _time.gmtime(float(ts))
+    if fmt is None:
+        return _time.strftime("%Y-%m-%d %H:%M:%S", t)
+    py = []
+    i = 0
+    fmt = str(fmt)
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            py.append(_FU_FMT.get(fmt[i + 1], fmt[i + 1]))
+            i += 2
+        else:
+            py.append("%%" if c == "%" else c)
+            i += 1
+    try:
+        return _time.strftime("".join(py), t)
+    except ValueError:
+        return None
+
+
+_reg("UUID", 0, 0, "str",
+     lambda: __import__("uuid").uuid1().hex[:8] + "-" +
+     __import__("uuid").uuid4().hex[:4] + "-" +
+     __import__("uuid").uuid4().hex[:4] + "-" +
+     __import__("uuid").uuid4().hex[:4] + "-" +
+     __import__("uuid").uuid4().hex[:12], null_prop=False)
+_reg("IS_UUID", 1, 1, "int",
+     lambda s: 1 if _re.fullmatch(
+         r"[0-9a-fA-F]{8}-?[0-9a-fA-F]{4}-?[0-9a-fA-F]{4}-?"
+         r"[0-9a-fA-F]{4}-?[0-9a-fA-F]{12}", str(s)) else 0)
+_reg("IS_IPV6", 1, 1, "int",
+     lambda s: 1 if _is_ipv6(s) else 0)
+_reg("INET6_ATON", 1, 1, "str", lambda s: _inet6_aton(s))
+_reg("INET6_NTOA", 1, 1, "str", lambda s: _inet6_ntoa(s))
+_reg("COMPRESS", 1, 1, "str",
+     lambda s: "" if s == "" else
+     (len(s.encode()).to_bytes(4, "little")
+      + zlib.compress(s.encode())).hex())
+_reg("UNCOMPRESS", 1, 1, "str", lambda h: _uncompress(h))
+_reg("UNCOMPRESSED_LENGTH", 1, 1, "int",
+     lambda h: 0 if h == "" else int.from_bytes(
+         bytes.fromhex(h)[:4], "little"))
+_reg("CHARSET", 1, 1, "str", lambda s: "utf8mb4", null_prop=False)
+_reg("COLLATION", 1, 1, "str", lambda s: "utf8mb4_bin",
+     null_prop=False)
+_reg("COERCIBILITY", 1, 1, "int", lambda s: 2, null_prop=False)
+_reg("FROM_UNIXTIME", 1, 2, "str", _from_unixtime)
+_reg("NAME_CONST", 2, 2, "arg1", lambda n, v: v, null_prop=False)
+_reg("FORMAT_BYTES", 1, 1, "str", lambda n: _format_bytes(float(n)))
+
+
+def _is_ipv6(s) -> bool:
+    import ipaddress
+    try:
+        ipaddress.IPv6Address(str(s))
+        return True
+    except ValueError:
+        return False
+
+
+def _inet6_aton(s):
+    import ipaddress
+    try:
+        return ipaddress.ip_address(str(s)).packed.hex()
+    except ValueError:
+        return None
+
+
+def _inet6_ntoa(h):
+    import ipaddress
+    try:
+        b = bytes.fromhex(str(h))
+        if len(b) == 4 or len(b) == 16:
+            return str(ipaddress.ip_address(b))
+    except ValueError:
+        pass
+    return None
+
+
+def _uncompress(h):
+    if h == "":
+        return ""
+    try:
+        raw = bytes.fromhex(str(h))
+        return zlib.decompress(raw[4:]).decode("utf-8", "replace")
+    except (ValueError, zlib.error):
+        return None
+
+
+def _format_bytes(n: float) -> str:
+    units = ["bytes", "KiB", "MiB", "GiB", "TiB", "PiB", "EiB"]
+    i = 0
+    while abs(n) >= 1024 and i < len(units) - 1:
+        n /= 1024
+        i += 1
+    return f"{n:.0f} {units[0]}" if i == 0 else f"{n:.2f} {units[i]}"

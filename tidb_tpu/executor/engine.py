@@ -812,7 +812,8 @@ def _run_agg(plan: PhysHashAgg, ctx: ExecContext) -> Chunk:
         plan.mode,
         [_subst_subq(g, ctx) for g in plan.group_by],
         [AggDesc(d.func, None if d.arg is None else _subst_subq(d.arg, ctx),
-                 d.ftype, d.distinct, d.name) for d in plan.aggs],
+                 d.ftype, d.distinct, d.name, d.params)
+         for d in plan.aggs],
         plan.schema, plan.children)
     # group-id working set: sort order + unique + inverse over all rows
     if plan.group_by and child.num_rows and \
@@ -1110,6 +1111,29 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
                 [hll_ndv(regs[i], cnts[i]) if cnts[i] else 0
                  for i in range(n_seg)], np.int64)
             out_cols.append(Column(out_t, vals))
+            continue
+        if d.func == "approx_percentile":
+            # per-group percentile: the value at ceil(p% * n) in sort
+            # order (reference: executor/aggfuncs/func_percentile.go
+            # picks an element, not an interpolation)
+            pct = float(d.params[0]) if d.params else 50.0
+            vals = np.zeros(n_seg, av.dtype if not np.issubdtype(
+                av.dtype, np.bool_) else np.int64)
+            valid = np.zeros(n_seg, bool)
+            srt_v = av[order]
+            srt_l = avl[order]
+            # rows are grouped contiguously along `order`; per-segment
+            # slices keep this O(n log n) overall
+            for gi2 in range(n_seg):
+                lo = bounds[gi2]
+                hi = bounds[gi2 + 1] if gi2 + 1 < n_seg else n
+                g = np.sort(srt_v[lo:hi][srt_l[lo:hi]])
+                if len(g):
+                    k = max(int(np.ceil(pct / 100.0 * len(g))) - 1, 0)
+                    vals[gi2] = g[k]
+                    valid[gi2] = True
+            out_cols.append(Column(out_t, vals.astype(out_t.np_dtype),
+                                   None if valid.all() else valid))
             continue
         if d.func in ("sum", "avg"):
             if np.issubdtype(av.dtype, np.floating):

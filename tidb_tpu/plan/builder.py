@@ -50,7 +50,7 @@ _AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX",
               "GROUP_CONCAT", "STD", "STDDEV", "STDDEV_POP",
               "STDDEV_SAMP", "VARIANCE", "VAR_POP", "VAR_SAMP",
               "BIT_AND", "BIT_OR", "BIT_XOR", "ANY_VALUE",
-              "APPROX_COUNT_DISTINCT"}
+              "APPROX_COUNT_DISTINCT", "APPROX_PERCENTILE"}
 
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div",
               "DIV": "intdiv", "%": "mod"}
@@ -834,16 +834,38 @@ class PlanBuilder:
                 if key in agg_keys:
                     continue
                 func = call.name.lower()
+                params: tuple = ()
                 if call.is_star:
                     arg = None
                 elif len(call.args) == 1:
                     arg = self.resolve(call.args[0], child_schema)
+                elif func == "approx_percentile" and len(call.args) == 2:
+                    # APPROX_PERCENTILE(expr, percent): percent must be a
+                    # constant 1..100 (reference: builder.go:110)
+                    arg = self.resolve(call.args[0], child_schema)
+                    if arg.ftype.is_string:
+                        raise PlanError(
+                            "APPROX_PERCENTILE requires a numeric or "
+                            "temporal argument")
+                    p = self.resolve(call.args[1], child_schema)
+                    if not isinstance(p, Const):
+                        raise PlanError(
+                            "APPROX_PERCENTILE percent must be constant")
+                    try:
+                        pv = float(p.value)
+                    except (TypeError, ValueError):
+                        raise PlanError(
+                            "Percentage value 0-100 required") from None
+                    if not 0 < pv <= 100:
+                        raise PlanError(
+                            "Percentage value 0-100 required")
+                    params = (pv,)
                 else:
                     raise PlanError(f"{call.name} takes one argument")
                 if func != "count" and arg is None:
                     raise PlanError(f"{call.name}(*) is not valid")
                 desc = AggDesc(func, arg, agg_result_type(func, arg),
-                               call.distinct, name=key)
+                               call.distinct, name=key, params=params)
                 agg_keys[key] = len(aggs)
                 aggs.append(desc)
 
@@ -1307,8 +1329,9 @@ class PlanBuilder:
                    "int": FieldType(TypeKind.BIGINT),
                    "float": FieldType(TypeKind.DOUBLE),
                    "date": FieldType(TypeKind.DATE)}.get(fd.ret)
-            if ret is None:  # arg0
-                ret = args[0].ftype
+            if ret is None:  # argN: result typed like that argument
+                i = 1 if fd.ret == "arg1" and len(args) > 1 else 0
+                ret = args[i].ftype
             return _fold(Call(f"fx:{fd.name}", args, ret))
         raise PlanError(f"unsupported function {name}")
 

@@ -102,6 +102,8 @@ class AggDesc:
     ftype: FieldType
     distinct: bool = False
     name: str = ""
+    # constant extra parameters (e.g. APPROX_PERCENTILE's percent)
+    params: tuple = ()
 
     def __repr__(self) -> str:
         inner = "*" if self.arg is None else repr(self.arg)
@@ -169,7 +171,9 @@ def agg_result_type(func: str, arg: Optional[PlanExpr]) -> FieldType:
     if func in ("bit_and", "bit_or", "bit_xor"):
         # reference: executor/aggfuncs/func_bitfuncs.go -> BIGINT UNSIGNED
         return FieldType(TypeKind.BIGINT, nullable=False)
-    if func == "any_value":
+    if func in ("any_value", "approx_percentile"):
+        # reference: executor/aggfuncs/builder.go:110
+        # buildApproxPercentile -> the argument's type
         return at
     if func == "group_concat":
         # reference: executor/aggfuncs/func_group_concat.go -> TEXT

@@ -385,7 +385,8 @@ def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
         group_by = [_subst_cols(g, proj) for g in group_by]
         aggs = [AggDesc(d.func,
                         None if d.arg is None else _subst_cols(d.arg, proj),
-                        d.ftype, d.distinct, d.name) for d in plan.aggs]
+                        d.ftype, d.distinct, d.name, d.params)
+                for d in plan.aggs]
     col = _collect_join_tree(child)
     if col is None or not agg_pushable(group_by, aggs) \
             or any(d.distinct for d in plan.aggs) \
@@ -412,7 +413,7 @@ def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
         [_remap_expr(g, remap) for g in group_by],
         [AggDesc(d.func,
                  None if d.arg is None else _remap_expr(d.arg, remap),
-                 d.ftype, d.distinct, d.name)
+                 d.ftype, d.distinct, d.name, d.params)
          for d in aggs])
     fields = []
     for i, g in enumerate(group_by):

@@ -463,7 +463,7 @@ def prune(plan: LogicalPlan, required: Optional[set[int]] = None) -> LogicalPlan
         plan.group_by = [_remap_expr(g, m) for g in plan.group_by]
         plan.aggs = [
             AggDesc(d.func, None if d.arg is None else _remap_expr(d.arg, m),
-                    d.ftype, d.distinct, d.name)
+                    d.ftype, d.distinct, d.name, d.params)
             for d in plan.aggs
         ]
         fields = plan.schema.fields[:ngroups] + [

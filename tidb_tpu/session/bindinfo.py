@@ -74,6 +74,7 @@ class BindingManager:
         self._storage = storage
         self._lock = threading.Lock()
         self._cache: Optional[dict[str, dict]] = None
+        self._fp: Optional[int] = None  # memoized fingerprint()
 
     def _load_locked(self) -> dict[str, dict]:
         if self._cache is not None:
@@ -98,6 +99,7 @@ class BindingManager:
                                    json.dumps(rec).encode())
             self._storage.put_meta(
                 _META_INDEX, json.dumps(sorted(recs)).encode())
+            self._fp = None
 
     def drop(self, norm_sql: str, db: str) -> bool:
         digest = binding_digest(norm_sql, db)
@@ -109,28 +111,38 @@ class BindingManager:
             self._storage.put_meta(_META_PREFIX + digest.encode(), b"")
             self._storage.put_meta(
                 _META_INDEX, json.dumps(sorted(recs)).encode())
+            self._fp = None
             return True
 
     def match(self, norm_sql: str, db: str) -> Optional[dict]:
         with self._lock:
             return self._load_locked().get(binding_digest(norm_sql, db))
 
+    def has_any(self) -> bool:
+        """O(1) emptiness probe for the per-SELECT fast path."""
+        with self._lock:
+            return bool(self._load_locked())
+
     def invalidate(self) -> None:
         """Sibling servers reload on catalog refresh (the bind-info
         load loop analog, bindinfo/handle.go:139 Update)."""
         with self._lock:
             self._cache = None
+            self._fp = None
 
     def fingerprint(self) -> int:
         """Content hash of the binding set (digests AND hint sets) —
         part of the plan-cache key, so cached plans can't outlive a
         binding change (including a same-second re-create with different
-        hints) while an unchanged set keeps the cache warm."""
+        hints) while an unchanged set keeps the cache warm. Memoized
+        until the set mutates or a refresh invalidates."""
         with self._lock:
-            recs = self._load_locked()
-            return hash(tuple(sorted(
-                (d, json.dumps(r.get("hints", [])))
-                for d, r in recs.items())))
+            if self._fp is None:
+                recs = self._load_locked()
+                self._fp = hash(tuple(sorted(
+                    (d, json.dumps(r.get("hints", [])))
+                    for d, r in recs.items())))
+            return self._fp
 
     def all(self) -> list[dict[str, Any]]:
         with self._lock:

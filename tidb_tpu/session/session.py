@@ -152,6 +152,7 @@ class Session:
             finally:
                 self._plan_cache_key = None
                 self._binding_match_sql = None
+                self._raw_sql = None
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)
@@ -1280,7 +1281,7 @@ class Session:
         self._lpfb_next = 0
         sql = self._binding_match_sql
         if not sql or (not self.session_bindings
-                       and not self.storage.bindings.all()):
+                       and not self.storage.bindings.has_any()):
             return stmt
         if not int(self._sysvar_value("tidb_use_plan_baselines") or 0):
             return stmt
