@@ -1,0 +1,165 @@
+"""Roles: CREATE/DROP ROLE, GRANT role TO user, SET [DEFAULT] ROLE,
+privilege flow through active roles (reference: privilege/privileges
+role graph, executor/set_role, MySQL 8 semantics)."""
+
+import pytest
+
+from testkit import TestKit
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create table rt (a int)")
+    t.must_exec("insert into rt values (1), (2)")
+    return t
+
+
+def _user_session(tk, name):
+    s = Session(tk.session.storage)
+    s.execute("use test")
+    s.user = name
+    return s
+
+
+def test_role_grants_flow_through_activation(tk):
+    tk.must_exec("create role 'reader'")
+    tk.must_exec("grant select on test.* to 'reader'")
+    tk.must_exec("create user 'u1' identified by ''")
+    tk.must_exec("grant 'reader' to 'u1'")
+    u = _user_session(tk, "u1")
+    # granted but NOT active: access denied
+    with pytest.raises(Exception):
+        u.execute("select a from rt")
+    u.execute("set role 'reader'")
+    assert u.execute("select a from rt order by a").rows == [(1,), (2,)]
+    u.execute("set role none")
+    with pytest.raises(Exception):
+        u.execute("select a from rt")
+    u.execute("set role all")
+    assert len(u.execute("select a from rt").rows) == 2
+
+
+def test_set_role_requires_granted(tk):
+    tk.must_exec("create role 'r2'")
+    tk.must_exec("create user 'u2' identified by ''")
+    u = _user_session(tk, "u2")
+    with pytest.raises(Exception):
+        u.execute("set role 'r2'")
+
+
+def test_default_roles_and_login_activation(tk):
+    tk.must_exec("create role 'writer'")
+    tk.must_exec("grant select, insert on test.* to 'writer'")
+    tk.must_exec("create user 'u3' identified by ''")
+    tk.must_exec("grant 'writer' to 'u3'")
+    tk.must_exec("set default role all to 'u3'")
+    pm = tk.session.storage.privileges
+    assert pm.default_roles("u3") == {"writer"}
+    # set role default picks them up
+    u = _user_session(tk, "u3")
+    u.execute("set role default")
+    u.execute("insert into rt values (3)")
+    assert len(u.execute("select a from rt").rows) == 3
+
+
+def test_nested_roles_expand_transitively(tk):
+    tk.must_exec("create role 'base', 'derived'")
+    tk.must_exec("grant select on test.* to 'base'")
+    tk.must_exec("grant 'base' to 'derived'")
+    tk.must_exec("create user 'u4' identified by ''")
+    tk.must_exec("grant 'derived' to 'u4'")
+    u = _user_session(tk, "u4")
+    u.execute("set role 'derived'")
+    assert len(u.execute("select a from rt").rows) == 2
+
+
+def test_drop_role_removes_edges_and_access(tk):
+    tk.must_exec("create role 'temp'")
+    tk.must_exec("grant select on test.* to 'temp'")
+    tk.must_exec("create user 'u5' identified by ''")
+    tk.must_exec("grant 'temp' to 'u5'")
+    u = _user_session(tk, "u5")
+    u.execute("set role 'temp'")
+    assert len(u.execute("select a from rt").rows) == 2
+    tk.must_exec("drop role 'temp'")
+    # the active role's account is gone: grants no longer resolve
+    with pytest.raises(Exception):
+        u.execute("select a from rt")
+
+
+def test_revoke_role(tk):
+    tk.must_exec("create role 'rr'")
+    tk.must_exec("grant select on test.* to 'rr'")
+    tk.must_exec("create user 'u6' identified by ''")
+    tk.must_exec("grant 'rr' to 'u6'")
+    tk.must_exec("revoke 'rr' from 'u6'")
+    u = _user_session(tk, "u6")
+    with pytest.raises(Exception):
+        u.execute("set role 'rr'")
+
+
+def test_show_grants_lists_roles(tk):
+    tk.must_exec("create role 'viewer'")
+    tk.must_exec("create user 'u7' identified by ''")
+    tk.must_exec("grant 'viewer' to 'u7'")
+    rows = tk.must_query("show grants for 'u7'")
+    assert any("'viewer'" in r[0] for r in rows)
+
+
+def test_roles_cannot_login(tk):
+    tk.must_exec("create role 'nologin'")
+    pm = tk.session.storage.privileges
+    assert not pm.verify_native("nologin", b"x" * 20, b"")
+
+
+def test_show_grants_output_parses_back(tk):
+    """The 'role'@'host' form SHOW GRANTS emits must round-trip."""
+    tk.must_exec("create role 'rt1'")
+    tk.must_exec("create user 'u9' identified by ''")
+    tk.must_exec("grant 'rt1'@'%' to 'u9'@'%'")
+    assert tk.session.storage.privileges.roles_of("u9") == {"rt1"}
+
+
+def test_partial_failure_mutates_nothing(tk):
+    pm = tk.session.storage.privileges
+    tk.must_exec("create role 'ok1'")
+    with pytest.raises(Exception):
+        tk.must_exec("create role 'fresh', 'ok1'")  # ok1 exists
+    assert not pm.is_role("fresh")
+    with pytest.raises(Exception):
+        tk.must_exec("grant 'ok1' to 'ghost_user'")
+    tk.must_exec("create user 'u10' identified by ''")
+    with pytest.raises(Exception):
+        # second target unknown: first must stay unmodified
+        tk.session.execute("grant 'ok1' to 'u10', 'ghost_user'")
+    assert pm.roles_of("u10") == set()
+
+
+def test_drop_user_clears_role_edges(tk):
+    pm = tk.session.storage.privileges
+    tk.must_exec("create role 'edge'")
+    tk.must_exec("create user 'u11' identified by ''")
+    tk.must_exec("grant 'edge' to 'u11'")
+    tk.must_exec("drop user 'edge'")  # DROP USER drops roles too
+    assert pm.roles_of("u11") == set()
+    tk.must_exec("create role 'edge'")  # re-created: NOT re-granted
+    assert pm.roles_of("u11") == set()
+
+
+def test_roles_survive_restart(tmp_path):
+    from tidb_tpu.store.storage import Storage
+    st = Storage(str(tmp_path))
+    s = Session(st)
+    s.execute("create role 'persisted'")
+    s.execute("grant select on *.* to 'persisted'")
+    s.execute("create user 'u8' identified by ''")
+    s.execute("grant 'persisted' to 'u8'")
+    st.close()
+    st2 = Storage(str(tmp_path))
+    pm = st2.privileges
+    assert pm.is_role("persisted")
+    assert pm.roles_of("u8") == {"persisted"}
+    assert pm.check("u8", "SELECT", "any", "t", roles={"persisted"})
+    st2.close()

@@ -198,6 +198,9 @@ class ClientConn:
             ok = pm.verify_native(user, self.salt, auth)
             if ok:
                 self.session.user = user
+                # login activates the account's DEFAULT roles (MySQL
+                # semantics with activate_all_roles_on_login=OFF)
+                self.session.active_roles = pm.default_roles(user)
             return ok
         return self.server.allow_unknown_users
 

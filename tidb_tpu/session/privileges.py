@@ -96,7 +96,130 @@ class PrivilegeManager:
                 raise PrivilegeError(
                     f"Operation DROP USER failed for '{name}'")
             del users[name]
+            # the account may have been a role (DROP USER drops roles in
+            # MySQL too): clear edges so a future same-named role isn't
+            # silently re-granted to old grantees
+            for other in users.values():
+                other.get("roles", set()).discard(name)
+                other.get("default_roles", set()).discard(name)
             self._persist()
+
+    # ---- roles (reference: privilege/privileges role graph; MySQL 8
+    # roles are locked accounts linked by role edges) -------------------
+    def create_role(self, names: list[str],
+                    if_not_exists: bool = False) -> None:
+        users = self._load()
+        with self._lock:
+            # validate FIRST: a mid-loop failure must not leave partial
+            # mutations for a later unrelated _persist to commit
+            todo = []
+            for name in names:
+                if name in users:
+                    if if_not_exists:
+                        continue
+                    raise PrivilegeError(
+                        f"Operation CREATE ROLE failed for '{name}'")
+                todo.append(name)
+            for name in todo:
+                users[name] = {"auth": None, "grants": set(),
+                               "is_role": True}
+            self._persist()
+
+    def drop_role(self, names: list[str], if_exists: bool = False) -> None:
+        users = self._load()
+        with self._lock:
+            todo = []
+            for name in names:
+                u = users.get(name)
+                if u is None or not u.get("is_role"):
+                    if if_exists:
+                        continue
+                    raise PrivilegeError(
+                        f"Operation DROP ROLE failed for '{name}'")
+                todo.append(name)
+            for name in todo:
+                del users[name]
+                for other in users.values():
+                    other.get("roles", set()).discard(name)
+                    other.get("default_roles", set()).discard(name)
+            self._persist()
+
+    def is_role(self, name: str) -> bool:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            return bool(u and u.get("is_role"))
+
+    def grant_roles(self, roles: list[str], targets: list[str],
+                    revoke: bool = False) -> None:
+        users = self._load()
+        with self._lock:
+            for r in roles:
+                ru = users.get(r)
+                if ru is None or not ru.get("is_role"):
+                    raise PrivilegeError(f"Unknown role '{r}'")
+            for t in targets:  # validate all targets before any mutation
+                if t not in users:
+                    raise PrivilegeError(f"unknown user '{t}'")
+            for t in targets:
+                u = users[t]
+                edges = u.setdefault("roles", set())
+                for r in roles:
+                    if revoke:
+                        edges.discard(r)
+                        u.get("default_roles", set()).discard(r)
+                    else:
+                        edges.add(r)
+            self._persist()
+
+    def roles_of(self, name: str) -> set[str]:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            return set(u.get("roles", ())) if u else set()
+
+    def set_default_roles(self, user: str, mode: str,
+                          roles: list[str]) -> None:
+        users = self._load()
+        with self._lock:
+            u = users.get(user)
+            if u is None:
+                raise PrivilegeError(f"unknown user '{user}'")
+            granted = u.get("roles", set())
+            if mode == "ALL":
+                u["default_roles"] = set(granted)
+            elif mode == "NONE":
+                u["default_roles"] = set()
+            else:
+                missing = [r for r in roles if r not in granted]
+                if missing:
+                    raise PrivilegeError(
+                        f"role '{missing[0]}' is not granted to "
+                        f"'{user}'")
+                u["default_roles"] = set(roles)
+            self._persist()
+
+    def default_roles(self, name: str) -> set[str]:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            return set(u.get("default_roles", ())) if u else set()
+
+    def _expand_roles(self, users: dict, roles) -> set[str]:
+        """Transitive closure over role->role edges (roles can be
+        granted to roles, MySQL 8 semantics)."""
+        out: set[str] = set()
+        stack = list(roles)
+        while stack:
+            r = stack.pop()
+            if r in out:
+                continue
+            ru = users.get(r)
+            if ru is None or not ru.get("is_role"):
+                continue
+            out.add(r)
+            stack.extend(ru.get("roles", ()))
+        return out
 
     def set_password(self, name: str, password: str) -> None:
         users = self._load()
@@ -154,9 +277,11 @@ class PrivilegeManager:
 
     # ---- checks --------------------------------------------------------
     def check(self, name: Optional[str], priv: str, db: str,
-              tbl: str = "*") -> bool:
+              tbl: str = "*", roles=()) -> bool:
         """None user = internal session (unchecked); information_schema is
-        world-readable (reference: infoschema needs no grants)."""
+        world-readable (reference: infoschema needs no grants). `roles`
+        are the session's ACTIVE roles — their grants (transitively, for
+        roles granted to roles) union with the user's own."""
         if name is None:
             return True
         if priv == "SELECT" and db.lower() == "information_schema":
@@ -168,6 +293,9 @@ class PrivilegeManager:
             # other connection threads (reference caches are swapped
             # atomically, privileges/cache.go)
             grants = list(u["grants"]) if u is not None else None
+            if grants is not None and roles:
+                for r in self._expand_roles(users, roles):
+                    grants.extend(users[r]["grants"])
         if grants is None:
             return False
         db = db.lower()
@@ -189,6 +317,8 @@ class PrivilegeManager:
         with self._lock:
             u = users.get(name)
             stored = u["auth"] if u is not None else None
+            if u is not None and u.get("is_role"):
+                stored = None  # roles are locked accounts: no login
         if stored is None:
             return False
         if stored == b"":

@@ -109,6 +109,8 @@ class Session:
         self._binding_gen = 0
         self._binding_match_sql: Optional[str] = None
         self._raw_sql: Optional[str] = None
+        # ACTIVE roles (SET ROLE); wire login activates default roles
+        self.active_roles: set[str] = set()
         self.plan_cache_hits = 0
         # KILL plane: QUERY kill interrupts the running statement;
         # CONNECTION kill is handled by the server (socket teardown).
@@ -419,6 +421,10 @@ class Session:
             return self._exec_create_binding(stmt)
         if isinstance(stmt, ast.DropBindingStmt):
             return self._exec_drop_binding(stmt)
+        if isinstance(stmt, (ast.CreateRoleStmt, ast.DropRoleStmt,
+                             ast.GrantRoleStmt, ast.SetRoleStmt,
+                             ast.SetDefaultRoleStmt)):
+            return self._exec_role_stmt(stmt)
         if isinstance(stmt, ast.AdminStmt):
             if stmt.kind == "SHOW_DDL_JOBS":
                 jobs = (list(self.storage.ddl_jobs)
@@ -679,7 +685,7 @@ class Session:
     # ==================== privileges ====================
     def _require_super(self) -> None:
         if self.user is not None and not self.storage.privileges.check(
-                self.user, "ALL", "*", "*"):
+                self.user, "ALL", "*", "*", roles=self.active_roles):
             raise SQLError(
                 f"Access denied; you need SUPER privilege(s) "
                 f"for this operation (user '{self.user}')",
@@ -727,14 +733,16 @@ class Session:
                              ast.ExplainStmt, ast.AnalyzeTableStmt)):
             for tn in self._collect_table_names(stmt):
                 db = tn.db or self.current_db
-                if not pm.check(self.user, "SELECT", db, tn.name):
+                if not pm.check(self.user, "SELECT", db, tn.name,
+                                roles=self.active_roles):
                     deny("SELECT", f"{db}.{tn.name}")
             return
         priv = self._STMT_PRIV.get(type(stmt))
         if priv is None:
             return  # txn control, SET, SHOW, USE, admin: unchecked
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt)):
-            if not pm.check(self.user, priv, stmt.name, "*"):
+            if not pm.check(self.user, priv, stmt.name, "*",
+                            roles=self.active_roles):
                 deny(priv, stmt.name)
             return
         # the DML privilege applies to the statement's TARGET table;
@@ -744,7 +752,8 @@ class Session:
         for tn in self._collect_table_names(stmt):
             db = tn.db or self.current_db
             need = priv if (tn is target or target is None) else "SELECT"
-            if not pm.check(self.user, need, db, tn.name):
+            if not pm.check(self.user, need, db, tn.name,
+                            roles=self.active_roles):
                 deny(need, f"{db}.{tn.name}")
 
     # ==================== information_schema ====================
@@ -1229,6 +1238,49 @@ class Session:
         finally:
             txn.stmt_read_ts = None
 
+    # ==================== roles ===========================================
+    def _exec_role_stmt(self, stmt) -> ResultSet:
+        """Role management + activation (reference:
+        privilege/privileges role graph, executor/set_role;
+        tests: privileges_test.go TestRole*)."""
+        from .privileges import PrivilegeError
+        pm = self.storage.privileges
+        try:
+            if isinstance(stmt, ast.CreateRoleStmt):
+                self._require_super()
+                pm.create_role(stmt.names, stmt.if_not_exists)
+            elif isinstance(stmt, ast.DropRoleStmt):
+                self._require_super()
+                pm.drop_role(stmt.names, stmt.if_exists)
+            elif isinstance(stmt, ast.GrantRoleStmt):
+                self._require_super()
+                pm.grant_roles(stmt.roles, stmt.users, stmt.revoke)
+            elif isinstance(stmt, ast.SetDefaultRoleStmt):
+                # users may set their OWN default roles; SUPER for others
+                if any(u != (self.user or "root") for u in stmt.users):
+                    self._require_super()
+                for u in stmt.users:
+                    pm.set_default_roles(u, stmt.mode, stmt.roles)
+            else:  # SetRoleStmt: activate for THIS session
+                me = self.user or "root"
+                granted = pm.roles_of(me)
+                if stmt.mode == "ALL":
+                    self.active_roles = set(granted)
+                elif stmt.mode == "NONE":
+                    self.active_roles = set()
+                elif stmt.mode == "DEFAULT":
+                    self.active_roles = pm.default_roles(me)
+                else:
+                    missing = [r for r in stmt.roles if r not in granted]
+                    if missing:
+                        raise SQLError(
+                            f"Role '{missing[0]}' has not been granted "
+                            f"to '{me}'", errno=ER_SPECIFIC_ACCESS_DENIED)
+                    self.active_roles = set(stmt.roles)
+        except PrivilegeError as e:
+            raise err_wrap(SQLError, e) from None
+        return ResultSet([], [])
+
     # ==================== SQL plan management (bindinfo) ==================
     def _exec_create_binding(self, stmt: ast.CreateBindingStmt
                              ) -> ResultSet:
@@ -1308,7 +1360,7 @@ class Session:
         executor/load_data.go / select_into.go)."""
         import os
         if self.user is not None and not self.storage.privileges.check(
-                self.user, "FILE", "*", "*"):
+                self.user, "FILE", "*", "*", roles=self.active_roles):
             raise SQLError(
                 "Access denied; you need (at least one of) the FILE "
                 f"privilege(s) for this operation (user '{self.user}')",
@@ -2302,6 +2354,10 @@ class Session:
             for p, db, tbl in self.storage.privileges.grants_for(target):
                 obj = "*.*" if db == "*" and tbl == "*" else f"{db}.{tbl}"
                 rows.append((f"GRANT {p} ON {obj} TO '{target}'@'%'",))
+            roles = sorted(self.storage.privileges.roles_of(target))
+            if roles:
+                rs = ", ".join(f"'{r}'@'%'" for r in roles)
+                rows.append((f"GRANT {rs} TO '{target}'@'%'",))
             return ResultSet([f"Grants for {target}@%"], rows)
         if stmt.kind == "BINDINGS":
             recs = self.storage.bindings.all() if stmt.scope == "GLOBAL" \

@@ -564,6 +564,41 @@ class GrantStmt(Stmt):
 
 
 @dataclass
+class CreateRoleStmt(Stmt):
+    names: list[str]
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropRoleStmt(Stmt):
+    names: list[str]
+    if_exists: bool = False
+
+
+@dataclass
+class GrantRoleStmt(Stmt):
+    """GRANT role[, ...] TO user[, ...] / REVOKE ... FROM ...
+    (reference: privilege/privileges roles; executor/grant.go)."""
+
+    roles: list[str]
+    users: list[str]
+    revoke: bool = False
+
+
+@dataclass
+class SetRoleStmt(Stmt):
+    mode: str  # 'ALL' | 'NONE' | 'DEFAULT' | 'LIST'
+    roles: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SetDefaultRoleStmt(Stmt):
+    mode: str  # 'ALL' | 'NONE' | 'LIST'
+    roles: list[str]
+    users: list[str]
+
+
+@dataclass
 class KillStmt(Stmt):
     """KILL [QUERY | CONNECTION] <id> (reference: server/server.go:548
     Kill; QUERY interrupts the running statement, CONNECTION also drops

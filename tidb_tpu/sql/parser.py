@@ -317,20 +317,39 @@ class Parser:
         return ast.LoadDataStmt(table, fmt, columns, local, dup,
                                 ignore_lines)
 
-    def parse_grant(self, revoke: bool) -> ast.GrantStmt:
+    def parse_grant(self, revoke: bool) -> ast.Stmt:
         """GRANT/REVOKE priv[, priv] ON [db.]tbl TO/FROM user
         (reference: privilege checks fed by mysql.user/db/tables_priv)."""
         self.advance()  # GRANT / REVOKE
         privs: list[str] = []
+        role_names: list[str] = []
         while True:
             if self.accept_kw("ALL"):
                 self.accept_kw("PRIVILEGES")
                 privs.append("ALL")
+                role_names = []  # ALL can't be a role name
             else:
+                if self.cur.kind in (TokenKind.STRING, TokenKind.IDENT):
+                    role_names.append(self.cur.text)
+                else:
+                    role_names = []
                 t = self.advance()
                 privs.append(t.text.upper())
+                if self.cur.is_op("@"):
+                    # 'role'@'host' account form (what SHOW GRANTS
+                    # emits); host accepted and discarded (single-host)
+                    self.advance()
+                    self.advance()
             if not self.accept_op(","):
                 break
+        # GRANT role[, ...] TO user / REVOKE role FROM user: no ON clause
+        if len(role_names) == len(privs) and (
+                self.cur.is_kw("FROM") if revoke else self.cur.is_kw("TO")):
+            self.advance()
+            users = [self._parse_account_name()]
+            while self.accept_op(","):
+                users.append(self._parse_account_name())
+            return ast.GrantRoleStmt(role_names, users, revoke)
         self.expect_kw("ON")
         db = tbl = "*"
         if self.accept_op("*"):
@@ -720,6 +739,14 @@ class Parser:
             orig, bind, bind_stmt = self._parse_binding_tail()
             return ast.CreateBindingStmt(scope_t or "SESSION", orig,
                                          bind, bind_stmt)
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "ROLE":
+            self.advance()
+            ine = self._if_not_exists()
+            names = [self._parse_account_name()]
+            while self.accept_op(","):
+                names.append(self._parse_account_name())
+            return ast.CreateRoleStmt(names, ine)
         or_replace = False
         if self.cur.is_kw("OR"):
             self.advance()
@@ -1124,6 +1151,14 @@ class Parser:
             orig = self.text[start:end].strip().rstrip(";").strip()
             return ast.DropBindingStmt(scope_t or "SESSION", orig)
         if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "ROLE":
+            self.advance()
+            if_exists = self._if_exists()
+            names = [self._parse_account_name()]
+            while self.accept_op(","):
+                names.append(self._parse_account_name())
+            return ast.DropRoleStmt(names, if_exists)
+        if self.cur.kind == TokenKind.IDENT and \
                 self.cur.text.upper() == "VIEW":
             self.advance()
             if_exists = self._if_exists()
@@ -1234,6 +1269,33 @@ class Parser:
         SET CHARACTER SET cs, SET [scope] TRANSACTION ISOLATION LEVEL x
         (reference: executor/set.go + ast SetStmt variants)."""
         self.expect_kw("SET")
+        # SET [DEFAULT] ROLE (reference: executor/set_role; roles in
+        # privilege/privileges) — statement forms, not var assignments
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "ROLE":
+            self.advance()
+            return self._parse_set_role_tail()
+        if self.cur.is_kw("DEFAULT") and \
+                self.peek().kind == TokenKind.IDENT and \
+                self.peek().text.upper() == "ROLE":
+            self.advance()
+            self.advance()
+            if self.accept_kw("ALL"):
+                mode, roles = "ALL", []
+            elif self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "NONE":
+                self.advance()
+                mode, roles = "NONE", []
+            else:
+                mode = "LIST"
+                roles = [self._parse_account_name()]
+                while self.accept_op(","):
+                    roles.append(self._parse_account_name())
+            self.expect_kw("TO")
+            users = [self._parse_account_name()]
+            while self.accept_op(","):
+                users.append(self._parse_account_name())
+            return ast.SetDefaultRoleStmt(mode, roles, users)
         items = []
         while True:
             scope = "SESSION"
@@ -1301,6 +1363,21 @@ class Parser:
                 items.append((scope, name.lower(), self.parse_set_value()))
             if not self.accept_op(","):
                 return ast.SetStmt(items)
+
+    def _parse_set_role_tail(self) -> "ast.SetRoleStmt":
+        if self.accept_kw("ALL"):
+            return ast.SetRoleStmt("ALL")
+        if self.cur.is_kw("DEFAULT"):
+            self.advance()
+            return ast.SetRoleStmt("DEFAULT")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "NONE":
+            self.advance()
+            return ast.SetRoleStmt("NONE")
+        roles = [self._parse_account_name()]
+        while self.accept_op(","):
+            roles.append(self._parse_account_name())
+        return ast.SetRoleStmt("LIST", roles)
 
     def parse_set_value(self) -> ast.Expr:
         """SET values admit bare idents/keywords (utf8mb4, ON, DEFAULT) as
