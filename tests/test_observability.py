@@ -27,9 +27,28 @@ def test_trace_statement():
     assert any("executor.run" in o for o in ops)
     # per-operator spans from the runtime-stats collector
     assert any("TableRead" in o for o in ops)
-    # durations are populated
-    exec_row = next(r for r in rows if r[0] == "executor.run")
+    # durations are populated; spans are a TREE (children indent under
+    # session.run)
+    exec_row = next(r for r in rows if r[0].strip() == "executor.run")
     assert exec_row[2] > 0
+    assert rows[0][0] == "session.run"
+    assert exec_row[0].startswith("  ")
+    # cross-layer: the coprocessor span nests under the executor
+    assert any("copr." in o for o in ops)
+
+
+def test_trace_dml_and_inactive_spans():
+    tk = TestKit()
+    tk.must_exec("create table td (a int primary key)")
+    rows = tk.must_query("trace insert into td values (1)")
+    assert rows[0][0] == "session.run"
+    assert any("executor.dml" in r[0] for r in rows)
+    # TRACE executes for real
+    assert tk.must_query("select a from td") == [(1,)]
+    # spans are a no-op without an active collector
+    from tidb_tpu import obs
+    with obs.span("nothing") as sp:
+        assert sp is None
 
 
 def test_trace_rejects_ddl():

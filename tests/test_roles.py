@@ -137,6 +137,23 @@ def test_partial_failure_mutates_nothing(tk):
     assert pm.roles_of("u10") == set()
 
 
+def test_set_default_role_multi_user_atomic(tk):
+    pm = tk.session.storage.privileges
+    tk.must_exec("create role 'dr'")
+    tk.must_exec("create user 'u12' identified by ''")
+    tk.must_exec("grant 'dr' to 'u12'")
+    with pytest.raises(Exception):
+        tk.must_exec("set default role all to 'u12', 'ghost'")
+    assert pm.default_roles("u12") == set()
+
+
+def test_trace_dml_shows_twopc_spans(tk):
+    rows = tk.must_query("trace insert into rt values (42)")
+    ops = [r[0] for r in rows]
+    assert any("twopc.prewrite" in o for o in ops), ops
+    assert any("twopc.commit" in o for o in ops), ops
+
+
 def test_drop_user_clears_role_edges(tk):
     pm = tk.session.storage.privileges
     tk.must_exec("create role 'edge'")

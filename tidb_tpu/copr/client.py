@@ -156,32 +156,46 @@ class CopClient:
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
         from .. import obs
-        if dag.scan.ranges is not None:
-            # index-ranged scan: the index permutation resolves a (small)
-            # handle set; the DAG runs host-side over the gathered subset
-            # (reference: IndexLookUp double read, executor/distsql.go:353)
-            obs.COPR_REQUESTS.inc(engine="ranged")
-            r = host_exec.execute_ranged(dag, snap)
-            r.engine = "ranged"
-            return r
-        self._evict_stale(dag.scan.table_id, snap.epoch.epoch_id)
-        prepared, fallback = self._prepare(dag, snap)
-        if fallback is not None:
-            obs.COPR_REQUESTS.inc(engine="host")
-            r = host_exec.execute_host(dag, snap, fallback)
-            r.engine = f"host({fallback})"
-            return r
-        obs.COPR_REQUESTS.inc(engine="device")
+        with obs.span(f"copr.execute(t{dag.scan.table_id})") as sp:
+            if dag.scan.ranges is not None:
+                # index-ranged scan: the index permutation resolves a
+                # (small) handle set; the DAG runs host-side over the
+                # gathered subset (reference: IndexLookUp double read,
+                # executor/distsql.go:353)
+                obs.COPR_REQUESTS.inc(engine="ranged")
+                r = host_exec.execute_ranged(dag, snap)
+                r.engine = "ranged"
+                if sp:
+                    sp.note = "ranged"
+                return r
+            self._evict_stale(dag.scan.table_id, snap.epoch.epoch_id)
+            with obs.span("copr.prepare"):
+                prepared, fallback = self._prepare(dag, snap)
+            if fallback is not None:
+                obs.COPR_REQUESTS.inc(engine="host")
+                with obs.span("copr.host_fallback") as hsp:
+                    if hsp:
+                        hsp.note = fallback
+                    r = host_exec.execute_host(dag, snap, fallback)
+                r.engine = f"host({fallback})"
+                return r
+            obs.COPR_REQUESTS.inc(engine="device")
+            if sp:
+                sp.note = "device"
 
-        chunks: list[Chunk] = []
-        base_n = snap.epoch.num_rows
-        if base_n > 0:
-            chunks.extend(self._run_batch(dag, snap, prepared, overlay=False))
-        if len(snap.overlay_handles) > 0:
-            chunks.extend(self._run_batch(dag, snap, prepared, overlay=True))
-        if not chunks:
-            chunks = [self._empty_chunk(dag, snap)]
-        return CopResult(chunks, is_partial_agg=dag.agg is not None)
+            chunks: list[Chunk] = []
+            base_n = snap.epoch.num_rows
+            if base_n > 0:
+                with obs.span("device.batch(base)"):
+                    chunks.extend(
+                        self._run_batch(dag, snap, prepared, overlay=False))
+            if len(snap.overlay_handles) > 0:
+                with obs.span("device.batch(overlay)"):
+                    chunks.extend(
+                        self._run_batch(dag, snap, prepared, overlay=True))
+            if not chunks:
+                chunks = [self._empty_chunk(dag, snap)]
+            return CopResult(chunks, is_partial_agg=dag.agg is not None)
 
     # ==================== preparation (host-side resolution) ================
     def _col_stats(self, snap: TableSnapshot, off: int) -> Bound:
@@ -770,7 +784,11 @@ class CopClient:
         with self._lock:
             k = self._kernels.get(key)
         if k is None:
-            k = build()
+            from .. import obs
+            with obs.span("xla.compile") as sp:
+                if sp:
+                    sp.note = str(key[0])
+                k = build()
             with self._lock:
                 self._kernels[key] = k
         return k
@@ -788,12 +806,17 @@ class CopClient:
             dag, prepared, cards, segments))
         # dispatches are async and pipeline on the link; ONE device_get
         # fetches every tile's partials in a single round trip
+        from .. import obs
         from ..util import interrupt
-        devs = []
-        for cols, vis, _ in tiles:
-            interrupt.check()  # KILL QUERY checkpoint between tiles
-            devs.append(kern(cols, vis))
-        outs = jax.device_get(devs)
+        with obs.span("device.dispatch") as sp:
+            if sp:
+                sp.note = f"{len(tiles)} tile(s)"
+            devs = []
+            for cols, vis, _ in tiles:
+                interrupt.check()  # KILL QUERY checkpoint between tiles
+                devs.append(kern(cols, vis))
+        with obs.span("device.fetch"):
+            outs = jax.device_get(devs)
         out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         group_dicts = [
             snap.dictionaries[dag.scan.col_offsets[g.idx]]

@@ -67,7 +67,10 @@ def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
     """snaps: table_id -> TableSnapshot for every fragment table."""
     from .. import obs
     try:
-        r = _device_fragment(cop, frag, snaps)
+        with obs.span("copr.fragment") as sp:
+            if sp:
+                sp.note = f"{len(frag.tables)} tables"
+            r = _device_fragment(cop, frag, snaps)
         obs.COPR_REQUESTS.inc(engine="device-fragment")
         return r
     except (_Fallback, CompileError, jax.errors.JaxRuntimeError) as e:

@@ -90,6 +90,13 @@ class TwoPhaseCommitter:
         hold serializing locks across it — the storage runs it outside
         its commit lock (the reference has no such global lock; its fold
         equivalent is TiFlash's async raft apply)."""
+        from .. import obs
+        with obs.span("twopc.prewrite") as sp:
+            if sp:
+                sp.note = f"{len(mutations)} keys"
+            return self._prewrite_phase(mutations, start_ts)
+
+    def _prewrite_phase(self, mutations: list[Mutation], start_ts: int):
         resolver = LockResolver(self.rm, self.tso)
         mutations = sorted(mutations, key=lambda m: m.key)
         # the primary must leave a write record: a lock-only (OP_LOCK)
@@ -115,6 +122,11 @@ class TwoPhaseCommitter:
     def commit_phase(self, state, start_ts: int) -> int:
         """Phase 2: never waits on foreign locks (we hold every key),
         so it is safe inside the storage commit lock."""
+        from .. import obs
+        with obs.span("twopc.commit"):
+            return self._commit_phase(state, start_ts)
+
+    def _commit_phase(self, state, start_ts: int) -> int:
         mutations, primary, resolver = state
         commit_ts = self.tso.ts()
 

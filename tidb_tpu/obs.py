@@ -278,6 +278,93 @@ FRAG_FALLBACKS = PROCESS_METRICS.counter(
     "device-fragment gate rejections, by reason")
 
 
+# ---- cross-layer span trees (TRACE) -----------------------------------------
+
+class Span:
+    """One timed span with children; durations in seconds."""
+
+    __slots__ = ("name", "start", "end", "children", "note")
+
+    def __init__(self, name: str, start: float) -> None:
+        self.name = name
+        self.start = start
+        self.end = start
+        self.children: list["Span"] = []
+        self.note: Optional[str] = None
+
+
+_span_tls = threading.local()
+
+
+class SpanCollector:
+    """Hierarchical span collection across layers (reference:
+    sessionctx + tracing spans rendered by executor/trace.go; spans are
+    opened by the layer doing the work — session, planner, executor,
+    coprocessor client, storage — and nest via a thread-local stack).
+
+    Activation is thread-local and scoped: when no collector is active,
+    `span()` is a no-op `yield`, so the production path pays one TLS
+    read per instrumented site."""
+
+    def __init__(self, name: str = "trace") -> None:
+        self.t0 = time.perf_counter()
+        self.root = Span(name, 0.0)
+        self._stack = [self.root]
+
+    def __enter__(self) -> "SpanCollector":
+        _span_tls.coll = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.root.end = time.perf_counter() - self.t0
+        _span_tls.coll = None
+
+    def rows(self) -> list[tuple]:
+        """(indented name, start_ms, duration_ms) depth-first."""
+        out: list[tuple] = []
+
+        def walk(s: Span, depth: int) -> None:
+            label = "  " * depth + s.name + (
+                f" [{s.note}]" if s.note else "")
+            out.append((label, round(s.start * 1e3, 3),
+                        round((s.end - s.start) * 1e3, 3)))
+            for c in s.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        return out
+
+
+class _SpanCtx:
+    __slots__ = ("name", "coll", "sp")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.coll = getattr(_span_tls, "coll", None)
+        self.sp: Optional[Span] = None
+
+    def __enter__(self) -> Optional[Span]:
+        c = self.coll
+        if c is None:
+            return None
+        self.sp = Span(self.name, time.perf_counter() - c.t0)
+        c._stack[-1].children.append(self.sp)
+        c._stack.append(self.sp)
+        return self.sp
+
+    def __exit__(self, *exc) -> None:
+        c = self.coll
+        if c is not None and self.sp is not None:
+            self.sp.end = time.perf_counter() - c.t0
+            c._stack.pop()
+
+
+def span(name: str) -> _SpanCtx:
+    """`with obs.span("copr.execute"):` — nests under the active
+    collector's current span; no-op without an active TRACE."""
+    return _SpanCtx(name)
+
+
 # ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------
 
 class RuntimeStatsColl:

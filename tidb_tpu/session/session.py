@@ -1259,6 +1259,21 @@ class Session:
                 # users may set their OWN default roles; SUPER for others
                 if any(u != (self.user or "root") for u in stmt.users):
                     self._require_super()
+                # validate every user (existence AND grantedness of the
+                # listed roles) before mutating any — same atomicity
+                # contract as the other role mutations
+                for u in stmt.users:
+                    if not pm.exists(u):
+                        raise SQLError(f"unknown user '{u}'",
+                                       errno=ER_SPECIFIC_ACCESS_DENIED)
+                    if stmt.mode == "LIST":
+                        granted = pm.roles_of(u)
+                        for r in stmt.roles:
+                            if r not in granted:
+                                raise SQLError(
+                                    f"role '{r}' is not granted to "
+                                    f"'{u}'",
+                                    errno=ER_SPECIFIC_ACCESS_DENIED)
                 for u in stmt.users:
                     pm.set_default_roles(u, stmt.mode, stmt.roles)
             else:  # SetRoleStmt: activate for THIS session
@@ -2255,48 +2270,43 @@ class Session:
         span tree (reference: executor/trace.go rendering the collected
         spans; per-operator rows come from the same runtime-stats
         collector EXPLAIN ANALYZE uses)."""
-        import time as _time
-
         from .. import obs
         from ..plan.physical import explain_nodes
 
         target = stmt.target
-        if not isinstance(target, (ast.SelectStmt, ast.SetOpStmt)):
-            raise SQLError("TRACE supports SELECT statements only")
-        spans: list[tuple[str, float, float]] = []
-        t_begin = _time.perf_counter()
-
-        def mark(name: str, t0: float) -> None:
-            spans.append((name, (t0 - t_begin) * 1e3,
-                          (_time.perf_counter() - t0) * 1e3))
-
-        t0 = _time.perf_counter()
-        target = self._maybe_bind_vars(target)
-        self._refresh_infoschema(target)
-        mark("session.prepare", t0)
-        t0 = _time.perf_counter()
-        plan = self._plan(target)
-        mark("planner.optimize", t0)
+        if not isinstance(target, (ast.SelectStmt, ast.SetOpStmt,
+                                   ast.InsertStmt, ast.UpdateStmt,
+                                   ast.DeleteStmt)):
+            raise SQLError("TRACE supports SELECT and DML statements")
+        is_select = isinstance(target, (ast.SelectStmt, ast.SetOpStmt))
         coll = obs.RuntimeStatsColl()
-        t0 = _time.perf_counter()
+        plan = None
+        with obs.SpanCollector("session.run") as spans:
+            if is_select:
+                with obs.span("session.prepare"):
+                    target = self._maybe_bind_vars(target)
+                    self._refresh_infoschema(target)
+                with obs.span("planner.optimize"):
+                    plan = self._plan(target)
 
-        def run():
-            ctx = self._exec_ctx(stats=coll)
-            try:
-                return run_physical(plan, ctx)
-            finally:
-                ctx.close()
+                def run():
+                    ctx = self._exec_ctx(stats=coll)
+                    try:
+                        return run_physical(plan, ctx)
+                    finally:
+                        ctx.close()
 
-        self._run_in_txn(run)
-        mark("executor.run", t0)
-        rows: list[tuple] = [
-            (name, round(start, 3), round(dur, 3))
-            for name, start, dur in spans
-        ]
-        for node, line in explain_nodes(plan):
-            st = coll.for_plan(node)
-            dur = round(st["time"] * 1e3, 3) if st else None
-            rows.append((f"  {line}", None, dur))
+                with obs.span("executor.run"):
+                    self._run_in_txn(run)
+            else:
+                with obs.span("executor.dml"):
+                    self._execute_stmt(target)
+        rows: list[tuple] = spans.rows()
+        if plan is not None:
+            for node, line in explain_nodes(plan):
+                st = coll.for_plan(node)
+                dur = round(st["time"] * 1e3, 3) if st else None
+                rows.append((f"  {line}", None, dur))
         return ResultSet(["operation", "start_ms", "duration_ms"], rows)
 
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:

@@ -814,8 +814,10 @@ class Storage:
         mutation section refreshes implicitly (kv/mvcc._MutationSection)."""
         if not self.shared:
             return
-        self.kv.refresh()
-        self._drain_refresh()
+        from .. import obs
+        with obs.span("domain.refresh"):
+            self.kv.refresh()
+            self._drain_refresh()
         # sibling CREATE/DROP BINDING lands in the meta plane; drop the
         # cache so the next match reloads (bindinfo load loop analog)
         self.bindings.invalidate()
