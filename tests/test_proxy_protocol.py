@@ -1,0 +1,80 @@
+"""PROXY protocol v1/v2 on the wire listener (reference:
+server/server.go:273 go-proxyprotocol wrapping with allowed networks)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import pytest
+
+from mysql_client import MiniClient
+from tidb_tpu.server import Server
+
+
+@pytest.fixture()
+def psrv():
+    srv = Server(port=0, proxy_protocol_networks="*")
+    srv.start()
+    yield srv
+    srv.close(drain_timeout=0.2)
+
+
+def _conn_of(srv):
+    with srv._lock:
+        return next(iter(srv._conns.values()))
+
+
+def test_proxy_v1_header(psrv):
+    hdr = b"PROXY TCP4 203.0.113.7 10.0.0.1 56324 4000\r\n"
+    c = MiniClient("127.0.0.1", psrv.port, preamble=hdr)
+    assert c.query("select 1 + 1") == [("2",)]
+    assert _conn_of(psrv).client_addr == "203.0.113.7"
+    # SHOW PROCESSLIST surfaces the REAL client address as Host
+    plist = c.query("show processlist")
+    assert any(r[2] == "203.0.113.7" for r in plist), plist
+    c.close()
+
+
+def test_proxy_v2_header(psrv):
+    sig = b"\r\n\r\n\x00\r\nQUIT\n"
+    src = socket.inet_aton("198.51.100.9")
+    dst = socket.inet_aton("10.0.0.1")
+    body = src + dst + struct.pack(">HH", 55555, 4000)
+    hdr = sig + bytes([0x21, 0x11]) + struct.pack(">H", len(body)) + body
+    c = MiniClient("127.0.0.1", psrv.port, preamble=hdr)
+    assert c.query("select 2 + 2") == [("4",)]
+    assert _conn_of(psrv).client_addr == "198.51.100.9"
+    c.close()
+
+
+def test_proxy_network_required_rejects_bare_connection(psrv):
+    # a connection from an allowed LB network that sends NO header is
+    # protocol garbage; the server must drop it, not misparse
+    with pytest.raises((ConnectionError, OSError, AssertionError)):
+        MiniClient("127.0.0.1", psrv.port, timeout=10)
+
+
+def test_non_proxy_network_unaffected():
+    srv = Server(port=0, proxy_protocol_networks="192.0.2.0/24")
+    srv.start()
+    try:
+        # 127.0.0.1 is outside the LB network: plain handshake works
+        c = MiniClient("127.0.0.1", srv.port)
+        assert c.query("select 3") == [("3",)]
+        c.close()
+    finally:
+        srv.close(drain_timeout=0.2)
+
+
+def test_proxy_then_tls():
+    srv = Server(port=0, proxy_protocol_networks="*", auto_tls=True)
+    srv.start()
+    try:
+        hdr = b"PROXY TCP4 203.0.113.8 10.0.0.1 5 6\r\n"
+        c = MiniClient("127.0.0.1", srv.port, use_ssl=True, preamble=hdr)
+        assert c.tls
+        assert c.query("select 5") == [("5",)]
+        c.close()
+    finally:
+        srv.close(drain_timeout=0.2)

@@ -70,6 +70,9 @@ class SecurityConfig:
     # (reference: config auto-tls)
     auto_tls: bool = False
     require_secure_transport: bool = False
+    # PROXY protocol: allowed LB networks, comma CIDRs or "*"
+    # (reference: config.ProxyProtocol.Networks)
+    proxy_protocol_networks: str = ""
 
 
 @dataclass

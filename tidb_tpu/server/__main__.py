@@ -72,6 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-tls", type=_parse_bool, default=None)
     p.add_argument("--require-secure-transport", type=_parse_bool,
                    default=None)
+    p.add_argument("--proxy-protocol-networks", default=None)
     return p
 
 
@@ -100,6 +101,8 @@ def resolve_config(args) -> Config:
         ("auto_tls", cfg.security, "auto_tls"),
         ("require_secure_transport", cfg.security,
          "require_secure_transport"),
+        ("proxy_protocol_networks", cfg.security,
+         "proxy_protocol_networks"),
     ]
     dotted = {
         "log_slow_threshold": "log.slow_threshold",
@@ -146,7 +149,9 @@ def main(argv: list[str] | None = None) -> int:
                  ssl_key=cfg.security.ssl_key or None,
                  auto_tls=cfg.security.auto_tls,
                  require_secure_transport=(
-                     cfg.security.require_secure_transport))
+                     cfg.security.require_secure_transport),
+                 proxy_protocol_networks=(
+                     cfg.security.proxy_protocol_networks))
     srv.start()
     # background GC / lock-TTL / auto-analyze / checkpoint loop; the
     # interval re-reads tidb_gc_run_interval every cycle (reference:

@@ -81,6 +81,7 @@ class ClientConn:
         self.user = ""
         self.alive = True
         self.tls = False
+        self.client_addr: Optional[str] = None  # PROXY-header real client
         # stmt_id -> (n_params, bound param types from the last EXECUTE)
         self._stmt_meta: dict[int, tuple[int, Optional[list]]] = {}
         self.killed = threading.Event()
@@ -204,9 +205,63 @@ class ClientConn:
             return ok
         return self.server.allow_unknown_users
 
+    # ---- PROXY protocol ----------------------------------------------------
+    def _read_proxy_header(self) -> None:
+        """Consume a PROXY protocol v1/v2 header when the peer is a
+        configured load balancer (reference: server/server.go:273 wraps
+        the listener in go-proxyprotocol). The real client address
+        replaces the socket peer for observability. The LB sends the
+        header before any MySQL bytes, so reading it first is safe even
+        though MySQL is a server-speaks-first protocol."""
+        try:
+            peer = self.sock.getpeername()[0]
+        except OSError:
+            return
+        if not self.server.proxy_expected(peer):
+            return
+        sio = _SockIO(self.sock)
+        sig = sio.read(6)
+        if sig == b"PROXY ":
+            line = bytearray()
+            while not line.endswith(b"\r\n"):
+                if len(line) >= 101:  # v1 max line is 107 bytes total
+                    raise ConnectionError("PROXY v1 line too long")
+                c = sio.read(1)
+                if not c:
+                    raise ConnectionError("truncated PROXY header")
+                line += c
+            parts = line[:-2].decode("ascii", "replace").split()
+            # TCP4/TCP6 src dst sport dport | UNKNOWN
+            if len(parts) >= 4 and parts[0] in ("TCP4", "TCP6"):
+                self.client_addr = parts[1]
+            return
+        if sig == b"\r\n\r\n\x00\r":
+            rest = sio.read(6)  # remaining v2 signature
+            if rest != b"\nQUIT\n":
+                raise ConnectionError("bad PROXY v2 signature")
+            hdr = sio.read(4)  # ver/cmd, family, length (BE16)
+            if len(hdr) < 4:
+                raise ConnectionError("truncated PROXY v2 header")
+            ln = int.from_bytes(hdr[2:4], "big")
+            body = sio.read(ln)
+            if len(body) < ln:
+                raise ConnectionError("truncated PROXY v2 body")
+            fam = hdr[1] >> 4
+            if fam == 1 and ln >= 12:  # AF_INET
+                import socket as _s
+                self.client_addr = _s.inet_ntoa(body[0:4])
+            elif fam == 2 and ln >= 36:  # AF_INET6
+                import socket as _s
+                self.client_addr = _s.inet_ntop(_s.AF_INET6, body[0:16])
+            return
+        raise ConnectionError(
+            "connection from a proxy-protocol network sent no PROXY "
+            "header")
+
     # ---- command loop ------------------------------------------------------
     def run(self) -> None:
         try:
+            self._read_proxy_header()
             self.write_initial_handshake()
             self.read_handshake_response()
             while self.alive and not self.killed.is_set():

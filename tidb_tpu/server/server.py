@@ -35,6 +35,7 @@ class Server:
         ssl_key: Optional[str] = None,
         auto_tls: bool = False,
         require_secure_transport: bool = False,
+        proxy_protocol_networks: str = "",
     ) -> None:
         self.storage = storage if storage is not None else Storage()
         self.host = host
@@ -74,6 +75,48 @@ class Server:
             raise RuntimeError(
                 "require_secure_transport needs ssl-cert/ssl-key or "
                 "auto-tls")
+        # PROXY protocol (reference: server/server.go:273 wraps the
+        # listener via go-proxyprotocol with an allowed-network list):
+        # comma list of CIDRs/hosts the LB connects from, or "*" for any
+        self.proxy_networks = self._parse_networks(proxy_protocol_networks)
+
+    @staticmethod
+    def _parse_networks(spec: str):
+        if not spec:
+            return None
+        import ipaddress
+        if spec.strip() == "*":
+            return "*"
+        nets = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "/" not in part:
+                # single host: full-length prefix for its address family
+                # (a bare IPv6 with /32 would trust 2^96 hosts)
+                part += f"/{ipaddress.ip_address(part).max_prefixlen}"
+            nets.append(ipaddress.ip_network(part, strict=False))
+        return nets or None
+
+    def proxy_expected(self, peer_ip: str) -> bool:
+        """True when a PROXY header must precede this peer's stream."""
+        if self.proxy_networks is None:
+            return False
+        if self.proxy_networks == "*":
+            return True
+        import ipaddress
+        try:
+            ip = ipaddress.ip_address(peer_ip)
+        except ValueError:
+            return False
+        # dual-stack listeners report IPv4 peers as ::ffff:a.b.c.d
+        mapped = getattr(ip, "ipv4_mapped", None)
+        if mapped is not None:
+            ip = mapped
+        return any(
+            ip in n for n in self.proxy_networks
+            if n.version == ip.version)
 
     @staticmethod
     def _build_ssl_ctx(cert: Optional[str], key: Optional[str],
@@ -127,6 +170,9 @@ class Server:
         # KILL routing: sessions resolve KILL <id> through the storage so
         # statements on ANY server can target connections on THIS one
         self.storage.kill_router = self.kill
+        # SHOW PROCESSLIST provider (reference: infoschema PROCESSLIST
+        # rows built from the server's client connections)
+        self.storage.processlist = self._processlist
         coord = getattr(self.storage, "coord", None)
         if coord is not None:
             coord.register_server(self.port, self.status_port)
@@ -204,6 +250,29 @@ class Server:
     def connection_count(self) -> int:
         with self._lock:
             return len(self._conns)
+
+    def _processlist(self) -> list[tuple]:
+        """(Id, User, Host, db, Command, Time, State, Info) per live
+        connection; Host prefers the PROXY-header real client address."""
+        import time
+        with self._lock:
+            conns = list(self._conns.values())
+        rows = []
+        for c in conns:
+            s = c.session
+            host = c.client_addr
+            if host is None:
+                try:
+                    host = "%s:%s" % c.sock.getpeername()[:2]
+                except OSError:
+                    host = ""
+            info = s.in_flight_sql
+            t = int(time.time() - s.in_flight_since) \
+                if info and s.in_flight_since else 0
+            rows.append((c.conn_id, c.user or s.user or "", host,
+                         s.current_db, "Query" if info else "Sleep", t,
+                         "" if info is None else "executing", info))
+        return rows
 
     def close(self, drain_timeout: float = 5.0) -> None:
         """Graceful shutdown: stop accepting, then drain/kill connections

@@ -111,6 +111,9 @@ class Session:
         self._raw_sql: Optional[str] = None
         # ACTIVE roles (SET ROLE); wire login activates default roles
         self.active_roles: set[str] = set()
+        # processlist state (Info/Time columns)
+        self.in_flight_sql: Optional[str] = None
+        self.in_flight_since: Optional[float] = None
         self.plan_cache_hits = 0
         # KILL plane: QUERY kill interrupts the running statement;
         # CONNECTION kill is handled by the server (socket teardown).
@@ -185,6 +188,9 @@ class Session:
         # the socket)
         self.killed.clear()
         interrupt.install(self.killed)
+        # processlist state (SHOW PROCESSLIST reads these from siblings)
+        self.in_flight_sql = sql[:256]
+        self.in_flight_since = _time.time()
         try:
             rs = self._execute_stmt(stmt)
             rows_out = len(rs.rows)
@@ -200,6 +206,7 @@ class Session:
             raise
         finally:
             interrupt.install(None)
+            self.in_flight_sql = None
             dt = _time.perf_counter() - t0
             o.query_seconds.observe(dt)
             if digest_sql is not None:
@@ -2379,6 +2386,23 @@ class Session:
                 (r["original_sql"], r["bind_sql"], r["default_db"],
                  r["status"], r["create_time"], r["update_time"],
                  "utf8mb4", "utf8mb4_bin", "manual") for r in recs])
+        if stmt.kind == "PROCESSLIST":
+            provider = getattr(self.storage, "processlist", None)
+            if provider is not None:
+                rows = list(provider())
+            else:
+                # embedded session: no wire server; list this session
+                import time as _t
+                info = self.in_flight_sql
+                t = int(_t.time() - self.in_flight_since) \
+                    if info and self.in_flight_since else 0
+                rows = [(getattr(self, "conn_id", 0),
+                         self.user or "root", "localhost",
+                         self.current_db, "Query", t, "executing",
+                         info)]
+            return ResultSet(
+                ["Id", "User", "Host", "db", "Command", "Time",
+                 "State", "Info"], rows)
         if stmt.kind == "WARNINGS":
             return ResultSet(["Level", "Code", "Message"], [])
         if stmt.kind == "ENGINES":

@@ -1228,6 +1228,10 @@ class Parser:
                 self.cur.text.upper() == "BINDINGS":
             self.advance()
             return ast.ShowStmt("BINDINGS", scope=scope)
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "PROCESSLIST":
+            self.advance()
+            return ast.ShowStmt("PROCESSLIST")
         if self.accept_kw("WARNINGS", "ERRORS"):
             return ast.ShowStmt("WARNINGS")
         if self.accept_kw("ENGINES"):
