@@ -1,0 +1,138 @@
+"""Column-level privileges: GRANT SELECT (a, b) ON t — enforcement at
+the plan's pruned scan columns for reads and at the target column list
+for DML (reference: mysql.columns_priv; privilege/privileges/cache.go
+columnsPriv; executor/grant.go column scope)."""
+
+import pytest
+
+from testkit import TestKit
+from tidb_tpu.session import Session
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create table ct (a int, b int, secret int)")
+    t.must_exec("insert into ct values (1, 10, 99), (2, 20, 98)")
+    return t
+
+
+def _user(tk, name):
+    tk.must_exec(f"create user '{name}' identified by ''")
+    s = Session(tk.session.storage)
+    s.execute("use test")
+    s.user = name
+    return s
+
+
+def test_column_select_scope(tk):
+    u = _user(tk, "c1")
+    tk.must_exec("grant select (a, b) on ct to 'c1'")
+    assert u.execute("select a, b from ct order by a").rows == \
+        [(1, 10), (2, 20)]
+    # the projection is pruned, so an unused column is not touched
+    assert u.execute("select a from ct where b > 15").rows == [(2,)]
+    with pytest.raises(Exception) as ei:
+        u.execute("select secret from ct")
+    assert "secret" in str(ei.value)
+    with pytest.raises(Exception):
+        u.execute("select * from ct")  # star expands to secret
+    with pytest.raises(Exception):
+        u.execute("select a from ct where secret > 0")  # filter touch
+
+
+def test_column_insert_update_scope(tk):
+    u = _user(tk, "c2")
+    tk.must_exec("grant insert (a, b), select (a, b) on ct to 'c2'")
+    u.execute("insert into ct (a, b) values (3, 30)")
+    with pytest.raises(Exception):
+        u.execute("insert into ct (a, secret) values (4, 1)")
+    tk.must_exec("grant update (b) on ct to 'c2'")
+    u.execute("update ct set b = 31 where a = 3")
+    with pytest.raises(Exception):
+        u.execute("update ct set secret = 0 where a = 3")
+
+
+def test_full_table_grant_bypasses_column_checks(tk):
+    u = _user(tk, "c3")
+    tk.must_exec("grant select on ct to 'c3'")
+    assert len(u.execute("select * from ct").rows) == 2
+
+
+def test_revoke_column_grant(tk):
+    u = _user(tk, "c4")
+    tk.must_exec("grant select (a, b) on ct to 'c4'")
+    assert len(u.execute("select a from ct").rows) == 2
+    tk.must_exec("revoke select (b) on ct from 'c4'")
+    with pytest.raises(Exception):
+        u.execute("select b from ct")
+    assert len(u.execute("select a from ct").rows) == 2
+
+
+def test_show_grants_renders_columns(tk):
+    _user(tk, "c5")
+    tk.must_exec("grant select (b, a) on ct to 'c5'")
+    rows = tk.must_query("show grants for 'c5'")
+    assert any("SELECT (a, b) ON test.ct" in r[0] for r in rows), rows
+
+
+def test_usage_alignment_with_column_lists(tk):
+    """GRANT USAGE, SELECT (a) must scope SELECT to column a — not
+    table-wide via index misalignment."""
+    u = _user(tk, "c7")
+    tk.must_exec("grant usage, select (a) on ct to 'c7'")
+    assert len(u.execute("select a from ct").rows) == 2
+    with pytest.raises(Exception):
+        u.execute("select secret from ct")
+
+
+def test_view_mediated_access_still_works(tk):
+    u = _user(tk, "c8")
+    tk.must_exec("create view vw as select a, b from ct")
+    tk.must_exec("grant select on vw to 'c8'")
+    assert len(u.execute("select a from vw").rows) == 2
+
+
+def test_partial_grant_failure_mutates_nothing(tk):
+    pm = tk.session.storage.privileges
+    _user(tk, "c9")
+    with pytest.raises(Exception):
+        # column scope on a db wildcard is invalid: the whole statement
+        # must apply nothing
+        tk.must_exec("grant select, insert (a) on test.* to 'c9'")
+    assert pm.grants_for("c9") == []
+
+
+def test_update_requires_select_on_read_columns(tk):
+    u = _user(tk, "c10")
+    tk.must_exec("grant update (a), select (a) on ct to 'c10'")
+    u.execute("update ct set a = 5 where a = 1")
+    with pytest.raises(Exception):
+        u.execute("update ct set a = 6 where secret = 99")
+    with pytest.raises(Exception):
+        u.execute("update ct set a = secret where a = 5")
+
+
+def test_processlist_requires_process_priv(tk):
+    # embedded sessions list only themselves; the gate matters on the
+    # wire path — exercised via the provider directly
+    tk.session.storage.processlist = lambda: [
+        (1, "root", "h", "test", "Query", 0, "", "select 1"),
+        (2, "c11", "h", "test", "Query", 0, "", "select 2")]
+    u = _user(tk, "c11")
+    rows = u.execute("show processlist").rows
+    assert [r[1] for r in rows] == ["c11"]
+    tk.must_exec("grant process on *.* to 'c11'")
+    assert len(u.execute("show processlist").rows) == 2
+    del tk.session.storage.processlist
+
+
+def test_column_grants_through_roles(tk):
+    tk.must_exec("create role 'colrole'")
+    tk.must_exec("grant select (a) on ct to 'colrole'")
+    u = _user(tk, "c6")
+    tk.must_exec("grant 'colrole' to 'c6'")
+    u.execute("set role 'colrole'")
+    assert len(u.execute("select a from ct").rows) == 2
+    with pytest.raises(Exception):
+        u.execute("select b from ct")

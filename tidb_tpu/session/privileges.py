@@ -240,28 +240,60 @@ class PrivilegeManager:
                 out.append(p)
         return out
 
+    @staticmethod
+    def _paired(privs: list[str], cols: Optional[list]):
+        """(PRIV, cols|None) pairs validated WITHOUT dropping entries,
+        keeping priv<->column alignment (USAGE filtered pairwise); all
+        validation happens before any mutation."""
+        out = []
+        for i, p in enumerate(privs):
+            p = p.upper()
+            if p not in PRIVS:
+                raise PrivilegeError(f"unknown privilege '{p}'")
+            if p == "USAGE":  # "no privileges" (MySQL): a no-op
+                continue
+            pc = cols[i] if cols is not None and i < len(cols) else None
+            out.append((p, pc))
+        return out
+
     def grant(self, privs: list[str], db: str, tbl: str,
-              name: str) -> None:
-        privs = self._validate(privs)
+              name: str, cols: Optional[list] = None) -> None:
+        """cols[i] is an optional column list for privs[i] — the
+        mysql.columns_priv analog (reference: executor/grant.go column
+        scope; privilege/privileges/cache.go columnsPriv)."""
+        pairs = self._paired(privs, cols)
+        if any(pc for _, pc in pairs) and tbl in ("*", ""):
+            raise PrivilegeError(
+                "column privileges need a specific table")
         users = self._load()
         with self._lock:
             u = users.get(name)
             if u is None:
                 raise PrivilegeError(f"unknown user '{name}'")
-            for p in privs:
-                u["grants"].add((p, db.lower(), tbl.lower()))
+            for p, pc in pairs:
+                if pc:
+                    cg = u.setdefault("col_grants", set())
+                    for c in pc:
+                        cg.add((p, db.lower(), tbl.lower(), c.lower()))
+                else:
+                    u["grants"].add((p, db.lower(), tbl.lower()))
             self._persist()
 
     def revoke(self, privs: list[str], db: str, tbl: str,
-               name: str) -> None:
-        privs = self._validate(privs)
+               name: str, cols: Optional[list] = None) -> None:
+        pairs = self._paired(privs, cols)
         users = self._load()
         with self._lock:
             u = users.get(name)
             if u is None:
                 raise PrivilegeError(f"unknown user '{name}'")
-            for p in privs:
-                u["grants"].discard((p, db.lower(), tbl.lower()))
+            for p, pc in pairs:
+                if pc:
+                    cg = u.get("col_grants", set())
+                    for c in pc:
+                        cg.discard((p, db.lower(), tbl.lower(), c.lower()))
+                else:
+                    u["grants"].discard((p, db.lower(), tbl.lower()))
             self._persist()
 
     def grants_for(self, name: str) -> list[tuple[str, str, str]]:
@@ -269,6 +301,12 @@ class PrivilegeManager:
         with self._lock:
             u = users.get(name)
             return sorted(u["grants"]) if u else []
+
+    def col_grants_for(self, name: str) -> list[tuple[str, str, str, str]]:
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            return sorted(u.get("col_grants", ())) if u else []
 
     def exists(self, name: str) -> bool:
         users = self._load()
@@ -293,13 +331,26 @@ class PrivilegeManager:
             # other connection threads (reference caches are swapped
             # atomically, privileges/cache.go)
             grants = list(u["grants"]) if u is not None else None
+            col_grants = list(u.get("col_grants", ())) if u is not None \
+                else []
             if grants is not None and roles:
                 for r in self._expand_roles(users, roles):
                     grants.extend(users[r]["grants"])
+                    col_grants.extend(users[r].get("col_grants", ()))
         if grants is None:
             return False
         db = db.lower()
         tbl = tbl.lower()
+        if self._match(grants, priv, db, tbl):
+            return True
+        # MySQL: holding the privilege on ANY column of the table passes
+        # the table-level gate; exact columns check at resolution
+        # (check_columns)
+        return any(gp in (priv, "ALL") and gdb == db and gtbl == tbl
+                   for gp, gdb, gtbl, _ in col_grants)
+
+    @staticmethod
+    def _match(grants, priv: str, db: str, tbl: str) -> bool:
         for gp, gdb, gtbl in grants:
             if gp not in (priv, "ALL"):
                 continue
@@ -308,6 +359,61 @@ class PrivilegeManager:
             if gtbl in (tbl, "*"):
                 return True
         return False
+
+    def has_col_grants(self, name: Optional[str], roles=()) -> bool:
+        """O(1)-ish probe: does this principal hold ANY column-scoped
+        grant? The hot read path skips all column enforcement when not
+        (full-table access is already gated statement-level)."""
+        if name is None:
+            return False
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            if u is None:
+                return False
+            if u.get("col_grants"):
+                return True
+            if roles:
+                return any(users[r].get("col_grants")
+                           for r in self._expand_roles(users, roles))
+        return False
+
+    def check_columns(self, name: Optional[str], priv: str, db: str,
+                      tbl: str, cols, roles=()) -> Optional[str]:
+        """First column of `cols` the user may NOT touch, or None when
+        all are allowed. Enforcement applies only to principals whose
+        access to THIS table comes through column grants; users with a
+        full table/db/global grant — or with no grants on the base table
+        at all (e.g. access mediated by a view they hold SELECT on,
+        already gated statement-level) — pass."""
+        if name is None:
+            return None
+        db = db.lower()
+        tbl = tbl.lower()
+        if priv == "SELECT" and db == "information_schema":
+            return None
+        users = self._load()
+        with self._lock:
+            u = users.get(name)
+            if u is None:
+                return None
+            grants = list(u["grants"])
+            col_grants = set(u.get("col_grants", ()))
+            if roles:
+                for r in self._expand_roles(users, roles):
+                    grants.extend(users[r]["grants"])
+                    col_grants.update(users[r].get("col_grants", ()))
+        if self._match(grants, priv, db, tbl):
+            return None
+        if not any(gdb == db and gtbl == tbl
+                   for _, gdb, gtbl, _c in col_grants):
+            return None  # no column route to this table: defer to gates
+        for c in cols:
+            c = c.lower()
+            if (priv, db, tbl, c) not in col_grants and \
+                    ("ALL", db, tbl, c) not in col_grants:
+                return c
+        return None
 
     # ---- wire auth -----------------------------------------------------
     def verify_native(self, name: str, salt: bytes,

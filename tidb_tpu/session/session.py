@@ -336,10 +336,12 @@ class Session:
             try:
                 if stmt.revoke:
                     self.storage.privileges.revoke(
-                        stmt.privs, db, stmt.table, stmt.user)
+                        stmt.privs, db, stmt.table, stmt.user,
+                        stmt.priv_cols or None)
                 else:
                     self.storage.privileges.grant(
-                        stmt.privs, db, stmt.table, stmt.user)
+                        stmt.privs, db, stmt.table, stmt.user,
+                        stmt.priv_cols or None)
             except PrivilegeError as e:
                 raise err_wrap(SQLError, e) from None
             return ResultSet([], [])
@@ -722,6 +724,65 @@ class Session:
         ast.LoadDataStmt: "INSERT",
     }
 
+    def _check_column_privs(self, plan) -> None:
+        """Column-scope SELECT enforcement (mysql.columns_priv analog):
+        the physical plan's scan leaves carry the PRUNED column sets,
+        i.e. exactly what the query touches per table (reference:
+        privilege columns checked at resolution, planner visitInfo +
+        privileges/cache.go columnsPriv)."""
+        if self.user is None:
+            return
+        pm = self.storage.privileges
+        if not pm.has_col_grants(self.user, self.active_roles):
+            return  # hot path: no column-scoped grants anywhere
+        from ..plan.fragment import PhysFragmentRead
+        from ..plan.physical import (PhysIndexMerge, PhysPointGet,
+                                     PhysTableRead)
+
+        def leaf_tables(p):
+            if isinstance(p, PhysTableRead) and p.table is not None:
+                yield p.table, p.dag.scan.col_offsets
+            elif isinstance(p, (PhysPointGet, PhysIndexMerge)):
+                yield p.table, p.col_offsets
+            elif isinstance(p, PhysFragmentRead):
+                for t in p.frag.tables:
+                    yield t.table, t.col_offsets
+            for c in getattr(p, "children", ()) or ():
+                yield from leaf_tables(c)
+
+        def db_of(info) -> str:
+            for s in self.catalog.schemas.values():
+                t = s.tables.get(info.name.lower())
+                if t is not None and t.id == info.id:
+                    return s.name
+            return self.current_db
+
+        for info, offsets in leaf_tables(plan):
+            names = [info.columns[o].name for o in offsets
+                     if o < len(info.columns)]
+            denied = pm.check_columns(self.user, "SELECT", db_of(info),
+                                      info.name, names,
+                                      roles=self.active_roles)
+            if denied is not None:
+                raise SQLError(
+                    f"SELECT command denied to user '{self.user}' for "
+                    f"column '{denied}' in table '{info.name}'",
+                    errno=ER_TABLEACCESS_DENIED)
+
+    def _check_dml_columns(self, tn: ast.TableName, info, priv: str,
+                           names: list[str]) -> None:
+        if self.user is None:
+            return
+        db = tn.db or self.current_db
+        denied = self.storage.privileges.check_columns(
+            self.user, priv, db, info.name, names,
+            roles=self.active_roles)
+        if denied is not None:
+            raise SQLError(
+                f"{priv} command denied to user '{self.user}' for "
+                f"column '{denied}' in table '{info.name}'",
+                errno=ER_TABLEACCESS_DENIED)
+
     def _check_privileges(self, stmt: ast.Stmt) -> None:
         """Statement-level grant checks before planning (reference:
         visitInfo checks at planner/optimize.go:246)."""
@@ -1042,6 +1103,7 @@ class Session:
             if getattr(stmt, "for_update", False):
                 self._lock_for_update(stmt)
             plan = self._plan_cached(stmt, uncacheable=has_vars)
+            self._check_column_privs(plan)
             ctx = self._exec_ctx()
             try:
                 chunk = run_physical(plan, ctx)
@@ -1117,6 +1179,9 @@ class Session:
                      load_ignore: bool = False) -> ResultSet:
         info, store = self._table_for(stmt.table)
         col_order = self._insert_columns(info, stmt.columns)
+        self._check_dml_columns(
+            stmt.table, info, "INSERT",
+            [info.columns[o].name for o in col_order])
         txn = self._ensure_txn()
 
         rows: list[list[Any]] = []
@@ -1694,6 +1759,25 @@ class Session:
 
     def _exec_update(self, stmt: ast.UpdateStmt) -> ResultSet:
         info, _ = self._table_for(stmt.table)
+        self._check_dml_columns(
+            stmt.table, info, "UPDATE",
+            [a.column.name for a in stmt.assignments])
+        # columns READ by the update (WHERE + assignment RHS) need
+        # SELECT, or matched-row counts leak unreadable values (MySQL
+        # requires the same)
+        read_cols: list[str] = []
+
+        def visit(n):
+            if isinstance(n, ast.ColumnRef):
+                read_cols.append(n.name)
+            return None
+
+        if stmt.where is not None:
+            ast.walk(stmt.where, visit)
+        for a in stmt.assignments:
+            ast.walk(a.value, visit)
+        if read_cols:
+            self._check_dml_columns(stmt.table, info, "SELECT", read_cols)
         txn = self._ensure_txn()
         try:
             total = 0
@@ -2371,6 +2455,14 @@ class Session:
             for p, db, tbl in self.storage.privileges.grants_for(target):
                 obj = "*.*" if db == "*" and tbl == "*" else f"{db}.{tbl}"
                 rows.append((f"GRANT {p} ON {obj} TO '{target}'@'%'",))
+            by_scope: dict[tuple, list[str]] = {}
+            for p, db, tbl, col in \
+                    self.storage.privileges.col_grants_for(target):
+                by_scope.setdefault((p, db, tbl), []).append(col)
+            for (p, db, tbl), cols in sorted(by_scope.items()):
+                rows.append((
+                    f"GRANT {p} ({', '.join(cols)}) ON {db}.{tbl} "
+                    f"TO '{target}'@'%'",))
             roles = sorted(self.storage.privileges.roles_of(target))
             if roles:
                 rs = ", ".join(f"'{r}'@'%'" for r in roles)
@@ -2390,6 +2482,13 @@ class Session:
             provider = getattr(self.storage, "processlist", None)
             if provider is not None:
                 rows = list(provider())
+                # MySQL: without the PROCESS privilege, only your own
+                # connections' rows are visible
+                if self.user is not None and not (
+                        self.storage.privileges.check(
+                            self.user, "PROCESS", "*", "*",
+                            roles=self.active_roles)):
+                    rows = [r for r in rows if r[1] == self.user]
             else:
                 # embedded session: no wire server; list this session
                 import time as _t

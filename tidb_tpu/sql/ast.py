@@ -561,6 +561,8 @@ class GrantStmt(Stmt):
     table: str = "*"
     user: str = ""
     revoke: bool = False
+    # per-priv optional column list: GRANT SELECT (a, b) ON t
+    priv_cols: list = field(default_factory=list)
 
 
 @dataclass

@@ -322,11 +322,13 @@ class Parser:
         (reference: privilege checks fed by mysql.user/db/tables_priv)."""
         self.advance()  # GRANT / REVOKE
         privs: list[str] = []
+        priv_cols: list = []
         role_names: list[str] = []
         while True:
             if self.accept_kw("ALL"):
                 self.accept_kw("PRIVILEGES")
                 privs.append("ALL")
+                priv_cols.append(None)
                 role_names = []  # ALL can't be a role name
             else:
                 if self.cur.kind in (TokenKind.STRING, TokenKind.IDENT):
@@ -335,6 +337,12 @@ class Parser:
                     role_names = []
                 t = self.advance()
                 privs.append(t.text.upper())
+                if self.cur.is_op("("):
+                    # column-scoped privilege: GRANT SELECT (a, b) ON t
+                    priv_cols.append(self._paren_ident_list())
+                    role_names = []
+                else:
+                    priv_cols.append(None)
                 if self.cur.is_op("@"):
                     # 'role'@'host' account form (what SHOW GRANTS
                     # emits); host accepted and discarded (single-host)
@@ -367,7 +375,7 @@ class Parser:
                 tbl = first
         self.expect_kw("FROM" if revoke else "TO")
         user = self._parse_account_name()
-        return ast.GrantStmt(privs, db, tbl, user, revoke)
+        return ast.GrantStmt(privs, db, tbl, user, revoke, priv_cols)
 
     def parse_alter(self) -> ast.AlterTableStmt:
         self.expect_kw("ALTER")
