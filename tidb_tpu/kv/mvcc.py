@@ -490,10 +490,15 @@ class MVCCStore:
                         f"txn {start_ts} lock not found on {key!r} "
                         f"(held by {lock.start_ts})")
                 self.kv.delete(CF_LOCK, key)
-                if lock.op != OP_LOCK:
-                    kind = OP_PUT if lock.op == OP_PUT else OP_DEL
-                    self.kv.put(CF_WRITE, _wkey(key, commit_ts),
-                                _write_enc(start_ts, kind))
+                # lock-only mutations leave a LOCK-kind write record too
+                # (reference: TiKV WriteType::Lock): readers skip it, but
+                # the prewrite conflict check MUST see it — it is how a
+                # second optimistic claim of the same unique-index guard
+                # key loses instead of silently double-committing
+                kind = OP_PUT if lock.op == OP_PUT else (
+                    OP_LOCK if lock.op == OP_LOCK else OP_DEL)
+                self.kv.put(CF_WRITE, _wkey(key, commit_ts),
+                            _write_enc(start_ts, kind))
 
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Abort a txn's keys (reference: mvcc_leveldb.go Rollback);
@@ -709,11 +714,18 @@ class MVCCStore:
                 start_ts, kind = _write_dec(wv)
                 if commit_ts >= safepoint:
                     continue
+                if kind in (OP_LOCK, OP_ROLLBACK):
+                    # markers never settle a key: collect the marker but
+                    # keep looking for the newest REAL version — treating
+                    # a marker as the kept version would delete the live
+                    # PUT beneath it
+                    drop_w.append(wk)
+                    continue
                 if not kept_newest:
                     kept_newest = True
-                    if kind in (OP_PUT,):
+                    if kind == OP_PUT:
                         continue  # newest visible version stays
-                    # newest record below safepoint is DEL/ROLLBACK: drop it
+                    # newest real record below safepoint is DEL: drop it
                 drop_w.append(wk)
                 if kind == OP_PUT:
                     drop_d.append(_dkey(key, start_ts))

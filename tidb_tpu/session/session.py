@@ -284,34 +284,11 @@ class Session:
             self._exec_kill(stmt)
             return ResultSet([], [])
         if isinstance(stmt, ast.CreateViewStmt):
-            from ..catalog.schema import ViewInfo
-            db = stmt.db or self.current_db
-            schema = self.catalog.schema(db)
-            key = stmt.name.lower()
-            if not hasattr(schema, "views"):
-                schema.views = {}
-            if key in schema.tables:
-                raise SQLError(f"Table '{stmt.name}' already exists")
-            if key in schema.views and not stmt.or_replace:
-                raise SQLError(f"Table '{stmt.name}' already exists")
-            # validate the stored SELECT against the current catalog
-            self._plan_view_select(db, stmt.select_sql, stmt.columns)
-            schema.views[key] = ViewInfo(
-                stmt.name, stmt.select_sql, tuple(stmt.columns),
-                definer=f"{self.user or 'root'}@%")
-            self.catalog.bump_version()
-            return ResultSet([], [])
+            with self.storage.ddl_section():
+                return self._exec_create_view(stmt)
         if isinstance(stmt, ast.DropViewStmt):
-            db = stmt.db or self.current_db
-            schema = self.catalog.schema(db)
-            views = getattr(schema, "views", {})
-            if stmt.name.lower() not in views:
-                if stmt.if_exists:
-                    return ResultSet([], [])
-                raise SQLError(f"Unknown view '{stmt.name}'")
-            del views[stmt.name.lower()]
-            self.catalog.bump_version()
-            return ResultSet([], [])
+            with self.storage.ddl_section():
+                return self._exec_drop_view(stmt)
         if isinstance(stmt, ast.CreateUserStmt):
             self._require_super()
             from .privileges import PrivilegeError
@@ -363,23 +340,31 @@ class Session:
         if isinstance(stmt, ast.DeleteStmt):
             return self._run_in_txn(lambda: self._exec_delete(stmt))
         if isinstance(stmt, ast.CreateTableStmt):
-            return self._exec_create_table(stmt)
+            with self.storage.ddl_section():
+                return self._exec_create_table(stmt)
         if isinstance(stmt, ast.DropTableStmt):
-            return self._exec_drop_table(stmt)
+            with self.storage.ddl_section():
+                return self._exec_drop_table(stmt)
         if isinstance(stmt, ast.CreateDatabaseStmt):
-            self.catalog.create_schema(stmt.name, stmt.if_not_exists)
-            return ResultSet([], [], affected=0)
+            with self.storage.ddl_section():
+                self.catalog.create_schema(stmt.name, stmt.if_not_exists)
+                return ResultSet([], [], affected=0)
         if isinstance(stmt, ast.DropDatabaseStmt):
-            for info in self.catalog.drop_schema(stmt.name, stmt.if_exists):
-                self.storage.unregister_table(info.id)
-                self.storage.destroy_table_data(info.id)
-            return ResultSet([], [])
+            with self.storage.ddl_section():
+                for info in self.catalog.drop_schema(stmt.name,
+                                                     stmt.if_exists):
+                    self.storage.unregister_table(info.id)
+                    self.storage.destroy_table_data(info.id)
+                return ResultSet([], [])
         if isinstance(stmt, ast.TruncateTableStmt):
-            return self._exec_truncate(stmt)
+            with self.storage.ddl_section():
+                return self._exec_truncate(stmt)
         if isinstance(stmt, ast.CreateSequenceStmt):
-            return self._exec_create_sequence(stmt)
+            with self.storage.ddl_section():
+                return self._exec_create_sequence(stmt)
         if isinstance(stmt, ast.DropSequenceStmt):
-            return self._exec_drop_sequence(stmt)
+            with self.storage.ddl_section():
+                return self._exec_drop_sequence(stmt)
         if isinstance(stmt, ast.UseStmt):
             from ..catalog import infoschema as I
             if stmt.db.lower() == I.DB_NAME:
@@ -844,11 +829,44 @@ class Session:
 
         return DDL(self.storage, self.catalog)
 
+    def _exec_create_view(self, stmt: ast.CreateViewStmt) -> ResultSet:
+        from ..catalog.schema import ViewInfo
+        db = stmt.db or self.current_db
+        schema = self.catalog.schema(db)
+        key = stmt.name.lower()
+        if not hasattr(schema, "views"):
+            schema.views = {}
+        if key in schema.tables:
+            raise SQLError(f"Table '{stmt.name}' already exists")
+        if key in schema.views and not stmt.or_replace:
+            raise SQLError(f"Table '{stmt.name}' already exists")
+        # validate the stored SELECT against the current catalog
+        self._plan_view_select(db, stmt.select_sql, stmt.columns)
+        schema.views[key] = ViewInfo(
+            stmt.name, stmt.select_sql, tuple(stmt.columns),
+            definer=f"{self.user or 'root'}@%")
+        self.catalog.bump_version()
+        return ResultSet([], [])
+
+    def _exec_drop_view(self, stmt: ast.DropViewStmt) -> ResultSet:
+        db = stmt.db or self.current_db
+        schema = self.catalog.schema(db)
+        views = getattr(schema, "views", {})
+        if stmt.name.lower() not in views:
+            if stmt.if_exists:
+                return ResultSet([], [])
+            raise SQLError(f"Unknown view '{stmt.name}'")
+        del views[stmt.name.lower()]
+        self.catalog.bump_version()
+        return ResultSet([], [])
+
     def _exec_ddl_job(self, kind: str, tn: ast.TableName,
                       args: dict) -> ResultSet:
         from ..ddl import DDLError
 
         self._commit_implicit()  # DDL implicitly commits (MySQL semantics)
+        # no ddl_section here: run_job takes the owner lock itself and
+        # folds sibling schema changes inside it
         info, _ = self._table_for(tn)
         ddl = self._ddl()
         job = ddl.submit(kind, tn.db or self.current_db, info, args)
@@ -1303,6 +1321,15 @@ class Session:
                         txn.delete_row(tid, h)
                         checker.note_delete(h)
                     count += len(conflicts)  # MySQL: replaced rows count 2x
+                if not txn.pessimistic:
+                    # claim the unique values as lock-only guard keys so
+                    # a CONCURRENT optimistic insert of the same value
+                    # collides at 2PC prewrite instead of both committing
+                    # (race found by test_race_harness.py). Only for rows
+                    # actually staged — an IGNORE/ON DUP skip must not
+                    # leave guard records on values it never wrote.
+                    txn.guard_keys.update(
+                        self._unique_lock_keys(tinfo, enc))
                 txn.set_row(tid, handle, enc)
                 checker.note_insert(handle, enc)
                 count += 1
@@ -1895,6 +1922,11 @@ class Session:
                 if conf:
                     raise SQLError(
                         checker.dup_message(new_handle, tuple(phys), conf))
+                if not txn.pessimistic:
+                    # optimistic unique-value claim (same guard as the
+                    # insert path; see test_race_harness.py)
+                    txn.guard_keys.update(
+                        self._unique_lock_keys(info, tuple(phys)))
             target_id = info.id
             if part is not None:
                 # a partition-column update may move the row

@@ -20,6 +20,7 @@ write-ahead truth (locks, write records, versioned values).
 from __future__ import annotations
 
 import threading
+from contextlib import contextmanager
 from typing import Any, Optional
 
 from ..catalog.schema import Catalog, TableInfo
@@ -700,7 +701,7 @@ class Storage:
         # records so 2PC clears them atomically (reference: OP_LOCK
         # mutations through prewrite; kv/memdb lock-only entries)
         from ..kv.mvcc import OP_LOCK
-        for key in sorted(txn.locked_keys - written):
+        for key in sorted((txn.locked_keys | txn.guard_keys) - written):
             kv_muts.append(Mutation(OP_LOCK, key))
         try:
             state = self.committer.prewrite_phase(kv_muts, txn.start_ts)
@@ -955,13 +956,54 @@ class Storage:
                     errno=ER_SCHEMA_CHANGED)
 
     # ---- meta KV (schema/stats persistence plane) ----------------------
+    @contextmanager
+    def ddl_section(self):
+        """Critical section for direct catalog DDL (CREATE/DROP TABLE
+        and friends). The whole-catalog persist is last-writer-wins, so
+        {fold sibling catalog -> mutate -> persist} must be atomic
+        against sibling DDL — otherwise two servers' concurrent CREATE
+        TABLEs either conflict at the meta commit (9007 to the client)
+        or silently drop one table. Gated on the DDL OWNER lock — the
+        same lock ALTER-family jobs take in ddl.run_job — so the lock
+        order everywhere is owner -> mutation/coordinator (taking the
+        coordinator flock here instead would invert against background
+        owners that hold owner-then-commit and deadlock)."""
+        owner = getattr(self, "ddl_owner", None)
+        if owner is None:
+            yield
+            return
+        with owner:
+            self.refresh()  # adopt sibling catalog inside the gate
+            yield
+
     def put_meta(self, name: bytes, value: bytes) -> None:
         """Durable metadata write through the SAME percolator path as row
-        data (reference: meta/meta.go over the m-prefix keyspace)."""
+        data (reference: meta/meta.go over the m-prefix keyspace).
+
+        Non-catalog keys are last-writer-wins snapshots, so a cross-
+        process conflict (sibling wrote the same key between our ts
+        allocation and prewrite) just retries with a fresh ts. The
+        CATALOG key never blind-retries: its payload is a whole-catalog
+        pickle built BEFORE the conflict, and replaying it would erase
+        the sibling's DDL — catalog writers serialize via ddl_section()
+        and any residual conflict must stay loud."""
         key = tablecodec.meta_key(name)
-        start_ts = self.tso.next_ts()
-        with self._commit_lock:
-            self.committer.commit([Mutation(OP_PUT, key, value)], start_ts)
+        retriable = name != b"catalog"
+        for _ in range(16):
+            start_ts = self.tso.next_ts()
+            try:
+                with self._commit_lock:
+                    self.committer.commit(
+                        [Mutation(OP_PUT, key, value)], start_ts)
+                return
+            except KVWriteConflict:
+                if not retriable:
+                    raise
+                if self.shared:
+                    self.kv.refresh()
+                continue
+        raise WriteConflictError(
+            f"meta write on {name!r} kept conflicting")
 
     def get_meta(self, name: bytes) -> Optional[bytes]:
         from ..kv.twopc import Snapshot
@@ -1004,6 +1046,12 @@ class Transaction:
         self.for_update_ts = start_ts
         self.pessimistic_primary: Optional[bytes] = None
         self.locked_keys: set[bytes] = set()
+        # unique-index guard keys claimed by OPTIMISTIC DML: committed
+        # as lock-only mutations so two concurrent claims of the same
+        # unique value collide in 2PC prewrite (the index-KV write
+        # conflict the reference gets for free from table/tables/index.go
+        # entries; this engine's indexes are permutations with no KV row)
+        self.guard_keys: set[bytes] = set()
         # per-statement read-ts override (FOR UPDATE / pessimistic DML
         # read latest; plain SELECT keeps the start_ts snapshot)
         self.stmt_read_ts: Optional[int] = None
@@ -1057,12 +1105,25 @@ class Transaction:
     # ---- reads -------------------------------------------------------------
     def snapshot(self, table_id: int) -> TableSnapshot:
         """Snapshot at start_ts (or the statement's read-ts override)
-        unioned with our own uncommitted writes."""
+        unioned with our own uncommitted writes.
+
+        Built under the storage commit lock: a sibling's commit releases
+        its KV row locks in commit_phase but appends the columnar fold a
+        moment later (both inside _commit_lock). A pessimistic lock-wait
+        retry resumes the instant the KV lock clears and re-snapshots at
+        a for_update_ts ABOVE that commit — without this fence it could
+        read the pre-commit columnar state while its lock validation
+        says the commit is covered, and overwrite it (lost update; found
+        by tests/test_race_harness.py bank-transfer conservation). Any
+        commit still unfolded once we hold the lock necessarily gets a
+        commit_ts later than our read-ts (TSO order), so it is correctly
+        invisible."""
         store = self.storage.table_store(table_id)
         overlay = {h: v for h, v in self.memdb.iter_table(table_id)}
         ts = self.stmt_read_ts if self.stmt_read_ts is not None \
             else self.start_ts
-        return store.snapshot(ts, overlay or None)
+        with self.storage._commit_lock:
+            return store.snapshot(ts, overlay or None)
 
     # ---- lifecycle ---------------------------------------------------------
     def commit(self) -> int:
