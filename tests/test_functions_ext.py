@@ -162,6 +162,39 @@ def test_float_functions(session):
     assert abs(float(q[4]) - math.pi) < 1e-12
 
 
+def test_json_aggregates(session):
+    """JSON_ARRAYAGG / JSON_OBJECTAGG (reference:
+    executor/aggfuncs/func_json_arrayagg.go, func_json_objectagg.go)."""
+    s = session
+    s.execute("drop table if exists ja")
+    s.execute("create table ja (g int, k varchar(10), v int, "
+              "d decimal(6,2), doc json)")
+    s.execute("insert into ja values "
+              "(1,'a',10,1.50,'{\"x\": 1}'), (1,'b',20,2.50,'[2]'), "
+              "(2,'c',30,3.25,'3'), (2,NULL,NULL,NULL,NULL)")
+    assert s.query("select g, json_arrayagg(v) from ja group by g "
+                   "order by g") == \
+        [(1, "[10, 20]"), (2, "[30, null]")]
+    assert s.query("select json_objectagg(k, v) from ja "
+                   "where k is not null") == \
+        [('{"a": 10, "b": 20, "c": 30}',)]
+    # JSON-typed values embed as JSON, not as strings
+    assert s.query("select json_arrayagg(doc) from ja where g = 1") == \
+        [('[{"x": 1}, [2]]',)]
+    # decimals become JSON numbers at their EXACT scale
+    assert s.query("select json_arrayagg(d) from ja where g = 1") == \
+        [("[1.50, 2.50]",)]
+    # exact beyond float64 precision (17+ significant digits)
+    s.execute("create table jb (d decimal(18,6))")
+    s.execute("insert into jb values (123456789012.345678)")
+    assert s.query("select json_arrayagg(d) from jb") == \
+        [("[123456789012.345678]",)]
+    # NULL keys are an error (MySQL errno 3158)
+    with pytest.raises(Exception) as ei:
+        s.query("select json_objectagg(k, v) from ja")
+    assert getattr(ei.value, "errno", None) == 3158
+
+
 def test_vectorized_over_rows(session):
     s = session
     s.execute("drop table if exists fxt")

@@ -171,6 +171,28 @@ def test_ddl_races_dml():
     assert a == b
 
 
+def test_failed_statement_unwinds_unique_guards():
+    """A statement aborted mid-way (duplicate on its second row) must not
+    leave guard claims for rows it staged then rolled back — a sibling
+    inserting that value immediately after must succeed conflict-free."""
+    tk = TestKit()
+    tk.must_exec("create table ug (a int, unique key ua (a))")
+    tk.must_exec("insert into ug values (5)")
+    s = Session(tk.session.storage)
+    s.execute("use test")
+    s.execute("begin")
+    with pytest.raises(SQLError):
+        s.execute("insert into ug values (7), (5)")  # 5 duplicates
+    s.execute("insert into ug values (9)")
+    s.execute("commit")
+    # value 7 was never written: a sibling's claim must not conflict
+    sib = Session(tk.session.storage)
+    sib.execute("use test")
+    sib.execute("insert into ug values (7)")
+    assert tk.must_query("select a from ug order by a") == \
+        [(5,), (7,), (9,)]
+
+
 def test_gc_keeps_rows_under_lock_markers(tmp_path):
     """A committed LOCK-kind marker (unique guard / FOR UPDATE commit)
     atop a row's PUT must be transparent to GC — dropping the marker

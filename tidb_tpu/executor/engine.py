@@ -981,6 +981,20 @@ def _merge_partials(plan: PhysHashAgg, child: Chunk) -> Chunk:
     return Chunk(out_cols)
 
 
+class _RawDec(str):
+    """Marker for an exact decimal literal inside a JSON aggregate: the
+    value dumps as a tagged string, then _raw_dumps strips the quotes so
+    the EXACT number lands in the document (json floats cap at ~17
+    significant digits)."""
+
+
+def _raw_dumps(o) -> str:
+    import json as _json
+    import re as _re
+    s = _json.dumps(o, sort_keys=True, separators=(", ", ": "))
+    return _re.sub(r'"\\u0000RAWD:(-?[0-9.]+)"', r"\1", s)
+
+
 def _gc_render(v, ft) -> str:
     """GROUP_CONCAT element rendering (MySQL text form of the value)."""
     from ..types.value import decode_date
@@ -1091,6 +1105,72 @@ def _complete_agg(plan: PhysHashAgg, child: Chunk) -> Chunk:
             ones = np.ones(n, np.int64)
             cnts = _seg_reduce(np.add, ones, order, bounds)
             out_cols.append(Column(out_t, cnts))
+            continue
+        if d.func in ("json_arrayagg", "json_objectagg"):
+            import json as _json
+            from ..chunk.column import Dictionary as _Dct
+
+            def jvals(e):
+                """Per-row python JSON values for one expression."""
+                if e.ftype.kind == TypeKind.JSON or e.ftype.is_string:
+                    sv, svl = ev.eval_str(e)
+                    if e.ftype.kind == TypeKind.JSON:
+                        return [
+                            _json.loads(s) if ok else None
+                            for s, ok in zip(sv, svl)], np.asarray(svl)
+                    return [s if ok else None
+                            for s, ok in zip(sv, svl)], np.asarray(svl)
+                vv, vl = ev.eval(e)
+                vv = np.asarray(vv)
+                out = []
+                for i2 in range(n):
+                    if not vl[i2]:
+                        out.append(None)
+                    elif e.ftype.is_decimal:
+                        # exact: a float division would round >15
+                        # significant digits; _RawDec embeds the exact
+                        # literal at dump time
+                        out.append(_RawDec(
+                            "\x00RAWD:" + _gc_render(int(vv[i2]),
+                                                     e.ftype)))
+                    elif e.ftype.kind == TypeKind.DATE:
+                        from ..types.value import decode_date
+                        out.append(decode_date(int(vv[i2])).isoformat())
+                    elif e.ftype.kind in (TypeKind.DATETIME,
+                                          TypeKind.TIMESTAMP):
+                        from ..types.value import decode_datetime
+                        out.append(decode_datetime(int(vv[i2])).isoformat(
+                            sep=" "))
+                    elif e.ftype.is_float:
+                        out.append(float(vv[i2]))
+                    else:
+                        out.append(int(vv[i2]))
+                return out, np.asarray(vl)
+
+            if d.func == "json_arrayagg":
+                vals_py, _vl = jvals(d.arg)
+                groups: list[list] = [[] for _ in range(n_seg)]
+                for i2 in range(n):
+                    # SQL NULLs become JSON nulls (MySQL semantics,
+                    # func_json_arrayagg.go)
+                    groups[inv[i2]].append(vals_py[i2])
+                docs = [_raw_dumps(g2) for g2 in groups]
+            else:
+                keys_py, kvl = jvals(d.arg.args[0])
+                vals_py, _vl = jvals(d.arg.args[1])
+                objs: list[dict] = [{} for _ in range(n_seg)]
+                for i2 in range(n):
+                    if not kvl[i2]:
+                        from ..session.session import SQLError
+                        raise SQLError(
+                            "JSON documents may not contain NULL member "
+                            "names", errno=3158)
+                    objs[inv[i2]][str(keys_py[i2])] = vals_py[i2]
+                docs = [_raw_dumps(o) for o in objs]
+            dct = _Dct()
+            data = np.fromiter((dct.encode(s) for s in docs),
+                               np.int64, count=n_seg)
+            out_cols.append(Column(out_t, data, None, dct))
             continue
         av, avl = ev.eval(d.arg)
         av = np.asarray(av)

@@ -495,10 +495,8 @@ class MVCCStore:
                 # the prewrite conflict check MUST see it — it is how a
                 # second optimistic claim of the same unique-index guard
                 # key loses instead of silently double-committing
-                kind = OP_PUT if lock.op == OP_PUT else (
-                    OP_LOCK if lock.op == OP_LOCK else OP_DEL)
                 self.kv.put(CF_WRITE, _wkey(key, commit_ts),
-                            _write_enc(start_ts, kind))
+                            _write_enc(start_ts, lock.op))
 
     def rollback(self, keys: list[bytes], start_ts: int) -> None:
         """Abort a txn's keys (reference: mvcc_leveldb.go Rollback);

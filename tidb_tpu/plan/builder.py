@@ -50,7 +50,8 @@ _AGG_NAMES = {"COUNT", "SUM", "AVG", "MIN", "MAX",
               "GROUP_CONCAT", "STD", "STDDEV", "STDDEV_POP",
               "STDDEV_SAMP", "VARIANCE", "VAR_POP", "VAR_SAMP",
               "BIT_AND", "BIT_OR", "BIT_XOR", "ANY_VALUE",
-              "APPROX_COUNT_DISTINCT", "APPROX_PERCENTILE"}
+              "APPROX_COUNT_DISTINCT", "APPROX_PERCENTILE",
+              "JSON_ARRAYAGG", "JSON_OBJECTAGG"}
 
 _ARITH_OPS = {"+": "add", "-": "sub", "*": "mul", "/": "div",
               "DIV": "intdiv", "%": "mod"}
@@ -835,10 +836,22 @@ class PlanBuilder:
                     continue
                 func = call.name.lower()
                 params: tuple = ()
+                if func == "json_objectagg" and len(call.args) != 2:
+                    raise PlanError(
+                        "Incorrect parameter count in the call to "
+                        "native function 'json_objectagg'")
                 if call.is_star:
                     arg = None
-                elif len(call.args) == 1:
+                elif func != "json_objectagg" and len(call.args) == 1:
                     arg = self.resolve(call.args[0], child_schema)
+                elif func == "json_objectagg" and len(call.args) == 2:
+                    # two-arg aggregate: pack (key, value) as a synthetic
+                    # Call so pruning/remap walk both expressions; the
+                    # engine evaluates the parts, never the call itself
+                    k = self.resolve(call.args[0], child_schema)
+                    v = self.resolve(call.args[1], child_schema)
+                    arg = Call("json_kv", [k, v],
+                               FieldType(TypeKind.JSON))
                 elif func == "approx_percentile" and len(call.args) == 2:
                     # APPROX_PERCENTILE(expr, percent): percent must be a
                     # constant 1..100 (reference: builder.go:110)

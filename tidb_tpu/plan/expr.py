@@ -178,6 +178,10 @@ def agg_result_type(func: str, arg: Optional[PlanExpr]) -> FieldType:
     if func == "group_concat":
         # reference: executor/aggfuncs/func_group_concat.go -> TEXT
         return FieldType(TypeKind.VARCHAR, flen=1024)
+    if func in ("json_arrayagg", "json_objectagg"):
+        # reference: executor/aggfuncs/func_json_arrayagg.go /
+        # func_json_objectagg.go -> JSON
+        return FieldType(TypeKind.JSON)
     if func in ("min", "max"):
         return at
     if func == "sum":

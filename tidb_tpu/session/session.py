@@ -1019,10 +1019,15 @@ class Session:
         for attempt in range(retries + 1):
             txn = self._ensure_txn()
             stage = txn.memdb.staging()
+            guards_before = set(txn.guard_keys)
             try:
                 result = fn()
             except Exception:
                 txn.memdb.cleanup(stage)
+                # unwind unique-guard claims with the staged rows: a
+                # failed statement must not leave LOCK markers on values
+                # it never wrote
+                txn.guard_keys = guards_before
                 if not self.in_explicit_txn:
                     self._finish_txn(commit=False)
                 raise

@@ -158,6 +158,12 @@ class Storage:
         self.ddl_owner = owner_manager(path, "ddl")
         self.gc_owner = owner_manager(path, "gc")
         self._commit_lock = threading.RLock()
+        # seqlock generation for snapshot/fold consistency: odd while a
+        # commit/refresh fold is in flight inside _commit_lock, even when
+        # quiescent. Readers snapshot lock-free and retry on movement;
+        # only a reader racing an active fold falls back to the lock.
+        self._fold_seq = 0
+        self._fold_depth = 0  # reentrancy: only the outermost bumps seq
         # active snapshot ts registry -> GC/compaction safepoint
         self._active_snapshots: dict[int, int] = {}
         self._snap_lock = threading.Lock()
@@ -712,7 +718,7 @@ class Storage:
         except (KVError, CommitError) as e:
             self._best_effort_rollback(kv_muts, txn.start_ts)
             raise WriteConflictError(f"commit failed: {e}") from None
-        with self._commit_lock:
+        with self._commit_lock, self._fold_section():
             if self.shared:
                 # fold sibling commits observed during prewrite and adopt
                 # any schema change BEFORE the authoritative fence check
@@ -842,7 +848,7 @@ class Storage:
             return
         catalog_moved = False
         meta_catalog = tablecodec.meta_key(b"catalog")
-        with self._commit_lock:
+        with self._commit_lock, self._fold_section():
             for op, cf, key, val in pending:
                 if cf != CF_WRITE or op != 1:
                     continue
@@ -954,6 +960,24 @@ class Storage:
                     "Information schema is changed during the execution "
                     "of the statement; try again",
                     errno=ER_SCHEMA_CHANGED)
+
+    @contextmanager
+    def _fold_section(self):
+        """Marks a fold in flight for the snapshot seqlock. Must be
+        entered while holding _commit_lock. Reentrant: the commit path
+        nests _drain_refresh's section inside its own — only the
+        outermost transition flips the seq, or the inner exit would
+        advertise quiescence mid-fold and let a lock-free snapshot read
+        a half-applied sibling commit."""
+        if self._fold_depth == 0:
+            self._fold_seq += 1  # odd: writer active
+        self._fold_depth += 1
+        try:
+            yield
+        finally:
+            self._fold_depth -= 1
+            if self._fold_depth == 0:
+                self._fold_seq += 1  # even: quiescent
 
     # ---- meta KV (schema/stats persistence plane) ----------------------
     @contextmanager
@@ -1117,11 +1141,24 @@ class Transaction:
         by tests/test_race_harness.py bank-transfer conservation). Any
         commit still unfolded once we hold the lock necessarily gets a
         commit_ts later than our read-ts (TSO order), so it is correctly
-        invisible."""
+        invisible.
+
+        Seqlock fast path: when no fold is in flight (_fold_seq even and
+        unchanged across the build) the snapshot is lock-free, so
+        concurrent readers never serialize on the commit lock; only a
+        reader racing an active fold retries and then waits — that wait
+        is the fence."""
         store = self.storage.table_store(table_id)
         overlay = {h: v for h, v in self.memdb.iter_table(table_id)}
         ts = self.stmt_read_ts if self.stmt_read_ts is not None \
             else self.start_ts
+        for _ in range(4):
+            seq = self.storage._fold_seq
+            if seq & 1:
+                break  # fold active: wait on the lock
+            snap = store.snapshot(ts, overlay or None)
+            if self.storage._fold_seq == seq:
+                return snap
         with self.storage._commit_lock:
             return store.snapshot(ts, overlay or None)
 
