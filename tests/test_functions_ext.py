@@ -123,6 +123,32 @@ JSON_CASES = [
     ("select json_storage_size('[1]')", "3"),
 ]
 
+TIME_CASES = [
+    ("select sec_to_time(3661)", "01:01:01"),
+    ("select sec_to_time(-7200)", "-02:00:00"),
+    ("select time_to_sec('01:01:01')", "3661"),
+    ("select time_to_sec('-02:00:00')", "-7200"),
+    ("select maketime(2, 30, 15)", "02:30:15"),
+    ("select maketime(1, 99, 0)", None),
+    ("select time('2024-01-05 13:45:09')", "13:45:09"),
+    ("select addtime('10:00:00', '01:30:30')", "11:30:30"),
+    ("select addtime('2024-01-01 23:30:00', '01:00:00')",
+     "2024-01-02 00:30:00"),
+    ("select subtime('10:00:00', '01:30:00')", "08:30:00"),
+    ("select timediff('10:00:00', '08:30:00')", "01:30:00"),
+    ("select timediff('2024-01-02 01:00:00', '2024-01-01 23:00:00')",
+     "02:00:00"),
+    ("select time_format('13:05:09', '%h:%i %p')", "01:05 PM"),
+    ("select convert_tz('2024-01-01 00:00:00', '+00:00', '+05:30')",
+     "2024-01-01 05:30:00"),
+    ("select bit_count(7)", "3"),
+    ("select bit_count(-1)", "64"),
+    ("select aes_decrypt(aes_encrypt('secret', 'k1'), 'k1')", "secret"),
+    ("select aes_decrypt('zz', 'k1')", None),
+    ("select validate_password_strength('aB3$xyzq') >= 75", "1"),
+    ("select weight_string('ab')", "6162"),
+]
+
 MISC_CASES = [
     ("select from_unixtime(86400)", "1970-01-02 00:00:00"),
     ("select from_unixtime(86400, '%Y-%m-%d')", "1970-01-02"),
@@ -139,7 +165,7 @@ MISC_CASES = [
     ("select format_bytes(1048576)", "1.00 MiB"),
 ]
 
-CASES = CASES + JSON_CASES + MISC_CASES
+CASES = CASES + JSON_CASES + MISC_CASES + TIME_CASES
 
 
 @pytest.mark.parametrize("sql,want", CASES, ids=[c[0][:60] for c in CASES])
@@ -160,6 +186,45 @@ def test_float_functions(session):
     assert abs(float(q[2]) - 0.7854) < 1e-9
     assert abs(float(q[3]) - 0.6421) < 1e-4
     assert abs(float(q[4]) - math.pi) < 1e-12
+
+
+def test_session_info_functions(session):
+    """LAST_INSERT_ID / FOUND_ROWS / ROW_COUNT / CURRENT_ROLE and the
+    GET_LOCK family (reference: builtin_info.go,
+    builtin_miscellaneous.go)."""
+    s = session
+    s.execute("drop table if exists sif")
+    s.execute("create table sif (id bigint primary key auto_increment, "
+              "v int)")
+    s.execute("insert into sif (v) values (10), (20)")
+    first = s.query("select last_insert_id()")[0][0]
+    assert first >= 1
+    s.query("select * from sif")
+    assert s.query("select found_rows()") == [(2,)]
+    s.execute("update sif set v = v + 1")
+    assert s.query("select row_count()") == [(2,)]
+    s.query("select 1")
+    assert s.query("select row_count()") == [(-1,)]
+    assert s.query("select get_lock('lk', 0)") == [(1,)]
+    assert s.query("select is_free_lock('lk')") == [(0,)]
+    assert s.query("select release_lock('lk')") == [(1,)]
+    assert s.query("select is_free_lock('lk')") == [(1,)]
+    assert s.query("select release_lock('lk')") == [(None,)]
+    assert s.query("select current_role()") == [("NONE",)]
+
+
+def test_user_locks_block_across_sessions(session):
+    from tidb_tpu.session import Session
+    s2 = Session(session.storage)
+    s2.execute("use test")
+    s2.conn_id = 424242
+    session.execute("select get_lock('contended', 0)")
+    assert s2.execute("select get_lock('contended', 0)").rows == [(0,)]
+    session.execute("select release_lock('contended')")
+    assert s2.execute("select get_lock('contended', 0)").rows == [(1,)]
+    s2.rollback_if_active()  # connection teardown frees its locks
+    assert session.execute(
+        "select is_free_lock('contended')").rows == [(1,)]
 
 
 def test_json_aggregates(session):

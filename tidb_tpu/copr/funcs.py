@@ -1020,3 +1020,278 @@ def _format_bytes(n: float) -> str:
         n /= 1024
         i += 1
     return f"{n:.0f} {units[0]}" if i == 0 else f"{n:.2f} {units[i]}"
+
+
+# ---------------------------------------------------------------------------
+# TIME-of-day functions over 'HH:MM:SS' strings (no TIME column type:
+# the reference's TIME value domain maps to text here; reference:
+# expression/builtin_time.go)
+# ---------------------------------------------------------------------------
+
+def _parse_tod(s):
+    """'[-]H:MM:SS[.ffffff]' | 'YYYY-MM-DD HH:MM:SS' -> signed seconds
+    (fractional kept), or None."""
+    s = str(s).strip()
+    if " " in s:  # datetime literal: take the time part
+        s = s.split(" ", 1)[1]
+    neg = s.startswith("-")
+    if neg:
+        s = s[1:]
+    parts = s.split(":")
+    try:
+        if len(parts) == 3:
+            h, m, sec = int(parts[0]), int(parts[1]), float(parts[2])
+        elif len(parts) == 2:
+            h, m, sec = int(parts[0]), int(parts[1]), 0.0
+        elif len(parts) == 1 and parts[0]:
+            h, m, sec = 0, 0, float(parts[0])
+        else:
+            return None
+    except ValueError:
+        return None
+    if m >= 60 or sec >= 60:
+        return None
+    tot = h * 3600 + m * 60 + sec
+    return -tot if neg else tot
+
+
+def _fmt_tod(total) -> str:
+    neg = total < 0
+    # integer microseconds FIRST so fraction rounding carries into
+    # seconds instead of printing a 7-digit fraction
+    us = round(abs(total) * 1_000_000)
+    sec, us = divmod(us, 1_000_000)
+    h, rem = divmod(sec, 3600)
+    m, s = divmod(rem, 60)
+    out = f"{'-' if neg else ''}{h:02d}:{m:02d}:{s:02d}"
+    if us:
+        out += f".{us:06d}"
+    return out
+
+
+def _sec_to_time(n):
+    return _fmt_tod(float(n))
+
+
+def _time_to_sec(s):
+    t = _parse_tod(s)
+    return None if t is None else int(t)
+
+
+def _maketime(h, m, s):
+    h, m = int(h), int(m)
+    if m < 0 or m >= 60 or float(s) < 0 or float(s) >= 60:
+        return None
+    sign = -1 if h < 0 else 1
+    return _fmt_tod(sign * (abs(h) * 3600 + m * 60 + float(s)))
+
+
+def _addtime(a, b, sign=1):
+    ta = str(a).strip()
+    tb = _parse_tod(b)
+    if tb is None:
+        return None
+    if " " in ta or "-" in ta[1:]:  # datetime form: add to full stamp
+        from datetime import datetime, timedelta
+        for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S"):
+            try:
+                dt = datetime.strptime(ta, fmt)
+                break
+            except ValueError:
+                dt = None
+        if dt is None:
+            return None
+        out = dt + timedelta(seconds=sign * tb)
+        s = out.strftime("%Y-%m-%d %H:%M:%S.%f")
+        return s[:-7] if s.endswith("000000") else s
+    t = _parse_tod(ta)
+    if t is None:
+        return None
+    return _fmt_tod(t + sign * tb)
+
+
+def _timediff(a, b):
+    sa = str(a).strip()
+    sb = str(b).strip()
+    both_dt = (" " in sa) == (" " in sb)
+    if not both_dt:
+        return None  # MySQL: mixed TIME/DATETIME -> NULL
+    if " " in sa:
+        from datetime import datetime
+        try:
+            da = datetime.fromisoformat(sa)
+            db = datetime.fromisoformat(sb)
+        except ValueError:
+            return None
+        return _fmt_tod((da - db).total_seconds())
+    ta, tb = _parse_tod(sa), _parse_tod(sb)
+    if ta is None or tb is None:
+        return None
+    return _fmt_tod(ta - tb)
+
+
+_TF_MAP = {"H": lambda t: f"{int(t // 3600):02d}",
+           "k": lambda t: str(int(t // 3600)),
+           "h": lambda t: f"{int(t // 3600) % 12 or 12:02d}",
+           "i": lambda t: f"{int((t % 3600) // 60):02d}",
+           "s": lambda t: f"{int(t % 60):02d}",
+           "S": lambda t: f"{int(t % 60):02d}",
+           "f": lambda t: f"{round((t - int(t)) * 1e6):06d}",
+           "p": lambda t: "AM" if (t // 3600) % 24 < 12 else "PM",
+           "%": lambda t: "%"}
+
+
+def _time_format(s, fmt):
+    t = _parse_tod(s)
+    if t is None:
+        return None
+    out = []
+    i = 0
+    fmt = str(fmt)
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            f = _TF_MAP.get(fmt[i + 1])
+            out.append(f(abs(t)) if f else fmt[i + 1])
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _convert_tz(dtv, from_tz, to_tz):
+    from datetime import datetime
+    try:
+        from zoneinfo import ZoneInfo
+    except ImportError:
+        return None
+
+    def tz(name):
+        name = str(name)
+        if name in ("SYSTEM", "UTC", "+00:00", "+0:00"):
+            from datetime import timezone
+            return timezone.utc
+        if name and name[0] in "+-":
+            from datetime import timedelta, timezone
+            sign = -1 if name[0] == "-" else 1
+            hh, mm = name[1:].split(":")
+            return timezone(sign * timedelta(hours=int(hh),
+                                             minutes=int(mm)))
+        try:
+            return ZoneInfo(name)
+        except Exception:  # noqa: BLE001 - unknown tz -> NULL
+            return None
+
+    fz, tzo = tz(from_tz), tz(to_tz)
+    if fz is None or tzo is None:
+        return None
+    try:
+        dt = datetime.fromisoformat(str(dtv))
+    except ValueError:
+        return None
+    out = dt.replace(tzinfo=fz).astimezone(tzo)
+    return out.strftime("%Y-%m-%d %H:%M:%S")
+
+
+_reg("SEC_TO_TIME", 1, 1, "str", _sec_to_time)
+_reg("TIME_TO_SEC", 1, 1, "int", _time_to_sec)
+_reg("MAKETIME", 3, 3, "str", _maketime)
+def _time_fn(s):
+    t = _parse_tod(s)
+    return None if t is None else _fmt_tod(t)
+
+
+_reg("TIME", 1, 1, "str", _time_fn)
+_reg("ADDTIME", 2, 2, "str", _addtime)
+_reg("SUBTIME", 2, 2, "str", lambda a, b: _addtime(a, b, -1))
+_reg("TIMEDIFF", 2, 2, "str", _timediff)
+_reg("TIME_FORMAT", 2, 2, "str", _time_format)
+_reg("CONVERT_TZ", 3, 3, "str", _convert_tz)
+
+
+# ---------------------------------------------------------------------------
+# misc / crypto compat (reference: builtin_miscellaneous.go,
+# builtin_encryption.go; AES via the cryptography package like the
+# reference's openssl-compatible aes-128-ecb default)
+# ---------------------------------------------------------------------------
+
+def _aes_key(key: str) -> bytes:
+    """MySQL key folding: XOR the UTF-8 key bytes into 16 bytes."""
+    out = bytearray(16)
+    for i, b in enumerate(str(key).encode("utf-8")):
+        out[i % 16] ^= b
+    return bytes(out)
+
+
+def _aes_encrypt(s, key):
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+        from cryptography.hazmat.primitives import padding
+    except ImportError:
+        return None
+    data = str(s).encode("utf-8")
+    p = padding.PKCS7(128).padder()
+    data = p.update(data) + p.finalize()
+    enc = Cipher(algorithms.AES(_aes_key(key)), modes.ECB()).encryptor()
+    return (enc.update(data) + enc.finalize()).hex()
+
+
+def _aes_decrypt(h, key):
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+        from cryptography.hazmat.primitives import padding
+    except ImportError:
+        return None
+    try:
+        raw = bytes.fromhex(str(h))
+        dec = Cipher(algorithms.AES(_aes_key(key)),
+                     modes.ECB()).decryptor()
+        data = dec.update(raw) + dec.finalize()
+        u = padding.PKCS7(128).unpadder()
+        return (u.update(data) + u.finalize()).decode("utf-8", "replace")
+    except Exception:  # noqa: BLE001 - bad input -> NULL (MySQL)
+        return None
+
+
+_reg("BIT_COUNT", 1, 1, "int", lambda n: bin(int(n) & (2**64 - 1)).count("1"))
+_reg("IS_IPV4_COMPAT", 1, 1, "int",
+     lambda h: 1 if len(str(h)) == 32 and str(h)[:24] == "0" * 24 else 0)
+_reg("IS_IPV4_MAPPED", 1, 1, "int",
+     lambda h: 1 if len(str(h)) == 32
+     and str(h)[:24] == "0" * 20 + "ffff" else 0)
+_reg("RANDOM_BYTES", 1, 1, "str",
+     lambda n: __import__("secrets").token_bytes(int(n)).hex()
+     if 1 <= int(n) <= 1024 else None, null_prop=False)
+_reg("UUID_SHORT", 0, 0, "int",
+     lambda: __import__("secrets").randbits(63), null_prop=False)
+# RAND() (no seed): independent value per row. RAND(seed) is resolved
+# by the planner into a vectorized per-statement sequence
+# (plan/builder.py rand_seeded) — a per-row Random(seed) here would
+# return the same value on every row.
+_reg("RAND", 0, 0, "float",
+     lambda: __import__("random").random(), null_prop=False)
+_reg("BENCHMARK", 2, 2, "int", lambda n, e: 0)
+_reg("PASSWORD", 1, 1, "str",
+     lambda s: "*" + hashlib.sha1(hashlib.sha1(
+         str(s).encode()).digest()).hexdigest().upper())
+_reg("VALIDATE_PASSWORD_STRENGTH", 1, 1, "int",
+     lambda s: 0 if len(str(s)) < 4 else
+     25 if len(str(s)) < 8 else
+     50 + 25 * (any(c.isdigit() for c in str(s))
+                and any(c.isalpha() for c in str(s)))
+     + 25 * any(not c.isalnum() for c in str(s)))
+_reg("WEIGHT_STRING", 1, 1, "str",
+     lambda s: str(s).encode("utf-8").hex().upper())
+_reg("AES_ENCRYPT", 2, 2, "str", _aes_encrypt)
+_reg("AES_DECRYPT", 2, 2, "str", _aes_decrypt)
+_reg("TIDB_VERSION", 0, 0, "str",
+     lambda: "5.7.25-TiDB-TPU\nEdition: Community\n"
+     "Engine: JAX/XLA columnar coprocessor", null_prop=False)
+_reg("TIDB_PARSE_TSO", 1, 1, "str",
+     lambda ts: __import__("time").strftime(
+         "%Y-%m-%d %H:%M:%S",
+         __import__("time").gmtime((int(ts) >> 18) / 1000))
+     if int(ts) > 0 else None)

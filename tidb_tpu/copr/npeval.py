@@ -346,6 +346,14 @@ class NumpyEval:
         if op == "isnull":
             _, avl = self.eval(A[0])
             return ~avl, np.ones_like(avl)
+        if op == "rand_seeded":
+            # one Random(seed) per evaluation, successive draws per row
+            # (MySQL RAND(N) semantics, builtin_math.go randWithSeed)
+            import random as _random
+            rng = _random.Random(int(A[0].value))
+            vals = np.fromiter((rng.random() for _ in range(self.n)),
+                               np.float64, count=self.n)
+            return vals, np.ones(self.n, bool)
 
         if op in ("eq", "ne", "lt", "le", "gt", "ge"):
             return self._compare(e)

@@ -1422,6 +1422,14 @@ class PlanBuilder:
         if name in ("SQRT", "EXP", "LN", "LOG2", "LOG10"):
             need(1)
             return Call(name.lower(), args, double)
+        if name == "RAND" and args:
+            # RAND(seed): per-STATEMENT seeded sequence, one draw per row
+            # (reference: builtin_math.go randWithSeed). The registry's
+            # per-row call model would repeat the first draw.
+            need(1)
+            if not isinstance(args[0], Const):
+                raise PlanError("RAND seed must be constant")
+            return Call("rand_seeded", args, double)
         if name == "LOG":
             if len(args) == 1:
                 return Call("ln", args, double)

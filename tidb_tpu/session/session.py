@@ -114,6 +114,9 @@ class Session:
         # processlist state (Info/Time columns)
         self.in_flight_sql: Optional[str] = None
         self.in_flight_since: Optional[float] = None
+        self._stmt_auto_id: Optional[int] = None
+        self._found_rows = 0
+        self._row_count = -1
         self.plan_cache_hits = 0
         # KILL plane: QUERY kill interrupts the running statement;
         # CONNECTION kill is handled by the server (socket teardown).
@@ -191,9 +194,16 @@ class Session:
         # processlist state (SHOW PROCESSLIST reads these from siblings)
         self.in_flight_sql = sql[:256]
         self.in_flight_since = _time.time()
+        self._stmt_auto_id = None
         try:
             rs = self._execute_stmt(stmt)
             rows_out = len(rs.rows)
+            if self._stmt_auto_id is not None:
+                self.vars["last_insert_id"] = self._stmt_auto_id
+            # ROW_COUNT(): affected rows of the last DML, -1 otherwise
+            self._row_count = rs.affected if isinstance(
+                stmt, (ast.InsertStmt, ast.UpdateStmt, ast.DeleteStmt,
+                       ast.LoadDataStmt)) else -1
             return rs
         except interrupt.QueryInterrupted:
             failed = True
@@ -590,7 +600,49 @@ class Session:
             v = int(n.args[1].value)
             self.storage.sequence_set(seq, v)
             return v
+        if name == "SYSTEM_USER":
+            return f"{self.user or 'root'}@%"
+        if name == "LAST_INSERT_ID":
+            return int(self.vars.get("last_insert_id", 0) or 0)
+        if name == "FOUND_ROWS":
+            return int(getattr(self, "_found_rows", 0))
+        if name == "ROW_COUNT":
+            return int(getattr(self, "_row_count", -1))
+        if name == "CURRENT_ROLE":
+            return ", ".join(f"`{r}`@`%`"
+                             for r in sorted(self.active_roles)) or "NONE"
+        if name == "TIDB_IS_DDL_OWNER":
+            owner = getattr(self.storage, "ddl_owner", None)
+            if owner is None:
+                return 1
+            return int(bool(getattr(owner, "is_owner", lambda: True)()))
+        if name in ("GET_LOCK", "RELEASE_LOCK", "IS_FREE_LOCK",
+                    "IS_USED_LOCK", "RELEASE_ALL_LOCKS"):
+            return self._user_lock_func(n)
         raise SQLError(f"unsupported function {name}")
+
+    def _user_lock_func(self, n: ast.FuncCall) -> Any:
+        """User-level named locks (reference: builtin_miscellaneous.go
+        GET_LOCK family; lock table lives on the Storage so siblings in
+        one process contend correctly)."""
+        me = self.conn_id or id(self)
+        if n.name == "RELEASE_ALL_LOCKS":
+            return self.storage.user_locks.release_all(me)
+        if not n.args:
+            raise SQLError(f"{n.name} takes a lock name")
+        name = str(self._eval_value(n.args[0]))
+        if n.name == "GET_LOCK":
+            timeout = 0.0
+            if len(n.args) > 1:
+                # constant expression (covers unary minus: -1 = forever)
+                timeout = float(self._eval_value(n.args[1]))
+            return int(self.storage.user_locks.acquire(name, me, timeout))
+        if n.name == "RELEASE_LOCK":
+            return self.storage.user_locks.release(name, me)
+        if n.name == "IS_FREE_LOCK":
+            return int(self.storage.user_locks.holder(name) is None)
+        holder = self.storage.user_locks.holder(name)
+        return holder  # IS_USED_LOCK: holder conn id or NULL
 
     @staticmethod
     def _has_var_reads(node) -> bool:
@@ -1080,9 +1132,12 @@ class Session:
 
     def rollback_if_active(self) -> None:
         """Abandon any open transaction (connection teardown path —
-        reference: server/conn.go Close rolls back the session txn)."""
+        reference: server/conn.go Close rolls back the session txn).
+        Also releases the session's GET_LOCK user locks (MySQL frees
+        them on connection exit)."""
         if self.txn is not None:
             self._finish_txn(commit=False)
+        self.storage.user_locks.release_all(self.conn_id or id(self))
 
     def _commit_implicit(self) -> None:
         if self.txn is not None and not self.in_explicit_txn:
@@ -1142,6 +1197,7 @@ class Session:
         self.last_spill_count = ctx.mem.spill_count
         self.vars["last_plan_from_binding"] = getattr(
             self, "_lpfb_next", 0)
+        self._found_rows = chunk.num_rows  # FOUND_ROWS()
         names = [f.name for f in plan.schema.fields]
         ftypes = [f.ftype for f in plan.schema.fields]
         if not chunk.columns:
@@ -2094,7 +2150,12 @@ class Session:
             if c.default is not None:
                 full[c.offset] = c.default
             elif c.auto_increment:
-                full[c.offset] = store.alloc_handle()
+                v = store.alloc_handle()
+                full[c.offset] = v
+                # LAST_INSERT_ID: first auto-generated value of the
+                # statement (reference: builtin_info.go lastInsertID)
+                if self._stmt_auto_id is None:
+                    self._stmt_auto_id = v
             elif not c.nullable:
                 raise SQLError(f"column {c.name} cannot be null",
                                errno=ER_BAD_NULL)
@@ -2631,8 +2692,11 @@ _SESSION_FUNCS = frozenset({
     "NOW", "CURRENT_TIMESTAMP", "SYSDATE", "LOCALTIME", "LOCALTIMESTAMP",
     "CURDATE", "CURRENT_DATE", "CURTIME", "CURRENT_TIME",
     "VERSION", "DATABASE", "SCHEMA", "USER", "CURRENT_USER",
-    "SESSION_USER", "CONNECTION_ID", "UNIX_TIMESTAMP",
+    "SESSION_USER", "SYSTEM_USER", "CONNECTION_ID", "UNIX_TIMESTAMP",
     "NEXTVAL", "LASTVAL", "SETVAL",
+    "LAST_INSERT_ID", "FOUND_ROWS", "ROW_COUNT", "CURRENT_ROLE",
+    "GET_LOCK", "RELEASE_LOCK", "RELEASE_ALL_LOCKS", "IS_FREE_LOCK",
+    "IS_USED_LOCK", "TIDB_IS_DDL_OWNER",
 })
 
 # reserved words usable WITHOUT parentheses (MySQL niladic functions)
