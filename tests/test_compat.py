@@ -45,6 +45,44 @@ def test_global_scope_crosses_sessions_and_set_global_rules():
         s.execute("SET no_such_var_at_all = 1")
 
 
+def test_infoschema_views_privileges_processlist():
+    s = Session()
+    s.execute("create table vt (a int)")
+    s.execute("create view vv as select a from vt")
+    s.execute("create user 'ipu' identified by ''")
+    s.execute("grant all on *.* to 'ipu'")
+    assert s.execute(
+        "select table_schema, table_name, view_definition "
+        "from information_schema.views").rows == \
+        [("test", "vv", "select a from vt")]
+    # views also appear in TABLES with table_type='VIEW' (ORMs probe it)
+    assert s.execute(
+        "select table_type from information_schema.tables "
+        "where table_name = 'vv'").rows == [("VIEW",)]
+    # ALL expands into one row per privilege, never grantable (no
+    # GRANT OPTION grammar)
+    rows = s.execute(
+        "select privilege_type, is_grantable from "
+        "information_schema.user_privileges "
+        "where grantee = \"'ipu'@'%'\" and privilege_type = 'SELECT'"
+    ).rows
+    assert rows == [("SELECT", "NO")]
+    # embedded session: own row, consistent with SHOW PROCESSLIST
+    assert s.execute(
+        "select count(*) from information_schema.processlist").rows \
+        == [(1,)]
+    # an unprivileged viewer sees only their own grants
+    u = Session(s.storage)
+    u.execute("use test")
+    u.user = "ipu"
+    s.execute("revoke all on *.* from 'ipu'")
+    s.execute("grant select on *.* to 'ipu'")
+    rows = u.execute(
+        "select distinct grantee from "
+        "information_schema.user_privileges").rows
+    assert rows == [("'ipu'@'%'",)]
+
+
 def test_sysvar_breadth():
     """The registry covers the connect-time surface real clients, ORMs
     and admin tools probe (reference: sessionctx/variable/sysvar.go)."""

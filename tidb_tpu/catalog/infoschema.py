@@ -128,6 +128,23 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("partition_expression", _vc(64)),
         ("partition_description", _vc(32)), ("table_rows", _bigint()),
     ],
+    # live connections (reference: infoschema_reader.go PROCESSLIST fed
+    # by the server's client connections)
+    "processlist": [
+        ("id", _bigint()), ("user", _vc()), ("host", _vc()),
+        ("db", _vc()), ("command", _vc(16)), ("time", _bigint()),
+        ("state", _vc(16)), ("info", _vc(512)),
+    ],
+    "views": [
+        ("table_catalog", _vc()), ("table_schema", _vc()),
+        ("table_name", _vc()), ("view_definition", _vc(1024)),
+        ("check_option", _vc(8)), ("is_updatable", _vc(8)),
+        ("definer", _vc()), ("security_type", _vc(16)),
+    ],
+    "user_privileges": [
+        ("grantee", _vc()), ("table_catalog", _vc()),
+        ("privilege_type", _vc(32)), ("is_grantable", _vc(8)),
+    ],
 }
 
 
@@ -165,7 +182,8 @@ def _store_rows(storage, table_id: int) -> int:
     return store.epoch.num_rows + len(store.deltas)
 
 
-def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
+def _rows_for(storage, catalog: Catalog, tname: str,
+              viewer=None) -> list[list]:
     user_schemas = [s for k, s in sorted(catalog.schemas.items())
                     if k != DB_NAME]
     rows: list[list] = []
@@ -184,6 +202,12 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
                 rows.append(["def", s.name, t.name, "BASE TABLE", "TiTPU",
                              10, "Fixed", nrows, 0, 0, 0, None,
                              "utf8mb4_bin", "", ""])
+            for v in sorted(getattr(s, "views", {}).values(),
+                            key=lambda v: v.name):
+                # views list here too (MySQL: table_type='VIEW')
+                rows.append(["def", s.name, v.name, "VIEW", None, 10,
+                             None, None, None, None, None, None, None,
+                             "", "VIEW"])
     elif tname == "columns":
         for s in user_schemas:
             for t in sorted(s.tables.values(), key=lambda t: t.name):
@@ -289,11 +313,56 @@ def _rows_for(storage, catalog: Catalog, tname: str) -> list[list]:
     elif tname == "slow_query":
         for e in storage.obs.slow_queries():
             rows.append([e["ts"], e["db"], e["duration_ms"], e["sql"]])
+    elif tname == "processlist":
+        provider = getattr(storage, "processlist", None)
+        plist = list(provider()) if provider is not None else []
+        if not plist and viewer is not None:
+            # embedded session (no wire server): own row, matching the
+            # SHOW PROCESSLIST fallback
+            import time as _t
+            info = viewer.in_flight_sql
+            t = int(_t.time() - viewer.in_flight_since)                 if info and viewer.in_flight_since else 0
+            plist = [(getattr(viewer, "conn_id", 0) or 0,
+                      viewer.user or "root", "localhost",
+                      viewer.current_db, "Query", t, "executing", info)]
+        if viewer is not None and viewer.user is not None and not                 storage.privileges.check(viewer.user, "PROCESS", "*",
+                                         "*", roles=viewer.active_roles):
+            # without PROCESS only your own connections are visible
+            # (same rule SHOW PROCESSLIST applies)
+            plist = [r for r in plist if r[1] == viewer.user]
+        for r in plist:
+            rows.append([int(r[0]), r[1], r[2], r[3], r[4], int(r[5]),
+                         r[6], r[7]])
+    elif tname == "views":
+        for s in user_schemas:
+            for v in sorted(getattr(s, "views", {}).values(),
+                            key=lambda v: v.name):
+                rows.append(["def", s.name, v.name, v.sql, "NONE", "NO",
+                             getattr(v, "definer", "root@%"), "DEFINER"])
+    elif tname == "user_privileges":
+        pm = storage.privileges
+        names = pm.account_names()
+        if viewer is not None and viewer.user is not None and not                 pm.check(viewer.user, "ALL", "*", "*",
+                         roles=viewer.active_roles):
+            # non-admins see their own grants only (MySQL scopes this
+            # to accounts the caller can administer)
+            names = [n for n in names if n == viewer.user]
+        for name in names:
+            globals_ = [p for p, db, tbl in pm.grants_for(name)
+                        if db == "*" and tbl == "*"]
+            if "ALL" in globals_:
+                # MySQL expands ALL into one row per privilege
+                from ..session.privileges import PRIVS
+                globals_ = sorted(PRIVS - {"ALL", "USAGE"})
+            for p in (globals_ or ["USAGE"]):
+                rows.append([f"'{name}'@'%'", "def", p, "NO"])
     return rows
 
 
-def refresh(storage, names: set[str]) -> None:
-    """Rebuild the named information_schema stores from the live catalog."""
+def refresh(storage, names: set[str], viewer=None) -> None:
+    """Rebuild the named information_schema stores from the live catalog.
+    `viewer` is the reading Session for the tables whose contents are
+    per-viewer (PROCESSLIST visibility, USER_PRIVILEGES scope)."""
     ensure_schema(storage)
     cat: Catalog = storage.catalog
     schema = cat.schemas[DB_NAME]
@@ -308,7 +377,7 @@ def refresh(storage, names: set[str]) -> None:
         # never an empty/missing table mid-refresh
         store = TableStore(info)
         store.on_epoch = None
-        rows = _rows_for(storage, cat, tname)
+        rows = _rows_for(storage, cat, tname, viewer)
         n = len(rows)
         columns: list[np.ndarray] = []
         valids: list = []

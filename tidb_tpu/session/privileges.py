@@ -308,6 +308,14 @@ class PrivilegeManager:
             u = users.get(name)
             return sorted(u.get("col_grants", ())) if u else []
 
+    def account_names(self) -> list[str]:
+        """Sorted non-role account names (a locked snapshot — callers
+        must never iterate the live users dict)."""
+        users = self._load()
+        with self._lock:
+            return sorted(n for n, u in users.items()
+                          if not u.get("is_role"))
+
     def exists(self, name: str) -> bool:
         users = self._load()
         with self._lock:

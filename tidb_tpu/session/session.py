@@ -873,7 +873,7 @@ class Session:
             if (tn.db or self.current_db).lower() == I.DB_NAME:
                 names.add(tn.name.lower())
         if names:
-            I.refresh(self.storage, names)
+            I.refresh(self.storage, names, viewer=self)
 
     # ==================== online DDL ====================
     def _ddl(self):
