@@ -45,6 +45,45 @@ def test_global_scope_crosses_sessions_and_set_global_rules():
         s.execute("SET no_such_var_at_all = 1")
 
 
+def test_show_table_status_charset_privileges_profiles():
+    s = Session()
+    s.execute("create table st1 (a int)")
+    s.execute("insert into st1 values (1), (2), (3)")
+    s.execute("create view sv1 as select a from st1")
+    rows = s.execute("show table status").rows
+    byname = {r[0]: r for r in rows}
+    assert byname["st1"][4] == 3  # Rows
+    assert byname["sv1"][-1] == "VIEW"
+    assert s.execute("show table status like 'st%'").rows[0][0] == "st1"
+    charsets = [r[0] for r in s.execute("show character set").rows]
+    assert "utf8mb4" in charsets
+    privs = [r[0] for r in s.execute("show privileges").rows]
+    assert "Select" in privs and "File" in privs
+    assert s.execute("show profiles").rows == []
+    assert "CREATE DATABASE `test`" in s.execute(
+        "show create database test").rows[0][1]
+    assert s.execute("show create view sv1").rows[0][1] == \
+        "CREATE VIEW `sv1` AS select a from st1"
+
+
+def test_checksum_table():
+    s = Session()
+    s.execute("create table ck (a int, b varchar(8))")
+    s.execute("insert into ck values (1, 'x'), (2, 'y')")
+    c1 = s.execute("checksum table ck").rows
+    assert c1[0][0] == "test.ck" and c1[0][1] > 0
+    # stable across repeated runs, changes with content
+    assert s.execute("checksum table ck").rows == c1
+    s.execute("insert into ck values (3, 'z')")
+    assert s.execute("checksum table ck").rows != c1
+    # partitioned tables sum their children deterministically
+    s.execute("create table ckp (k int, v int) "
+              "partition by hash(k) partitions 3")
+    s.execute("insert into ckp values (1, 10), (2, 20), (3, 30)")
+    p1 = s.execute("checksum table ckp").rows
+    assert p1 == s.execute("checksum table ckp").rows
+
+
 def test_infoschema_views_privileges_processlist():
     s = Session()
     s.execute("create table vt (a int)")

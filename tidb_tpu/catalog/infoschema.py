@@ -176,10 +176,14 @@ def ensure_schema(storage) -> None:
 
 
 def _store_rows(storage, table_id: int) -> int:
+    """LIVE row count: a delete/update delta must not count as a row
+    (epoch.num_rows + len(deltas) would inflate until compaction)."""
     store = storage.tables.get(table_id)
     if store is None:
         return 0
-    return store.epoch.num_rows + len(store.deltas)
+    if not store.deltas:
+        return store.epoch.num_rows
+    return store.snapshot(storage.tso.next_ts()).num_visible_rows
 
 
 def _rows_for(storage, catalog: Catalog, tname: str,

@@ -117,6 +117,7 @@ class Session:
         self._stmt_auto_id: Optional[int] = None
         self._found_rows = 0
         self._row_count = -1
+        self._is_guard = None  # held infoschema viewer lock, if any
         self.plan_cache_hits = 0
         # KILL plane: QUERY kill interrupts the running statement;
         # CONNECTION kill is handled by the server (socket teardown).
@@ -217,6 +218,9 @@ class Session:
         finally:
             interrupt.install(None)
             self.in_flight_sql = None
+            if self._is_guard is not None:
+                self._is_guard.release()
+                self._is_guard = None
             dt = _time.perf_counter() - t0
             o.query_seconds.observe(dt)
             if digest_sql is not None:
@@ -429,6 +433,8 @@ class Session:
                              ast.GrantRoleStmt, ast.SetRoleStmt,
                              ast.SetDefaultRoleStmt)):
             return self._exec_role_stmt(stmt)
+        if isinstance(stmt, ast.ChecksumTableStmt):
+            return self._run_in_txn(lambda: self._exec_checksum(stmt))
         if isinstance(stmt, ast.AdminStmt):
             if stmt.kind == "SHOW_DDL_JOBS":
                 jobs = (list(self.storage.ddl_jobs)
@@ -835,7 +841,9 @@ class Session:
             self._check_privileges(stmt.target)
             return
         if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt,
-                             ast.ExplainStmt, ast.AnalyzeTableStmt)):
+                             ast.ExplainStmt, ast.AnalyzeTableStmt,
+                             ast.ChecksumTableStmt)):
+            # CHECKSUM fingerprints content: same SELECT requirement
             for tn in self._collect_table_names(stmt):
                 db = tn.db or self.current_db
                 if not pm.check(self.user, "SELECT", db, tn.name,
@@ -862,18 +870,32 @@ class Session:
                 deny(need, f"{db}.{tn.name}")
 
     # ==================== information_schema ====================
+    _VIEWER_SENSITIVE_IS = frozenset({"processlist", "user_privileges"})
+
     def _refresh_infoschema(self, stmt) -> None:
         """Rebuild any information_schema tables this statement touches
         from the live catalog (reference: infoschema memtables are served
-        from the InfoSchema snapshot, executor/infoschema_reader.go)."""
+        from the InfoSchema snapshot, executor/infoschema_reader.go).
+
+        Viewer-sensitive tables (PROCESSLIST visibility, USER_PRIVILEGES
+        scope) materialize per-viewer content into the SHARED store, so
+        refresh+scan must be exclusive: another session's refresh
+        between ours and our scan would serve us its view (or ours to
+        it). The statement holds storage.infoschema_lock until it
+        finishes (_execute_observed releases)."""
         from ..catalog import infoschema as I
 
         names: set[str] = set()
         for tn in self._collect_table_names(stmt):
             if (tn.db or self.current_db).lower() == I.DB_NAME:
                 names.add(tn.name.lower())
-        if names:
-            I.refresh(self.storage, names, viewer=self)
+        if not names:
+            return
+        if names & self._VIEWER_SENSITIVE_IS and self._is_guard is None:
+            lock = self.storage.infoschema_lock
+            lock.acquire()
+            self._is_guard = lock
+        I.refresh(self.storage, names, viewer=self)
 
     # ==================== online DDL ====================
     def _ddl(self):
@@ -1397,6 +1419,45 @@ class Session:
             return ResultSet([], [], affected=count)
         finally:
             txn.stmt_read_ts = None
+
+    def _exec_checksum(self, stmt: ast.ChecksumTableStmt) -> ResultSet:
+        """CHECKSUM TABLE: deterministic crc32 over the visible rows'
+        physical columns, summed across partitions (reference:
+        executor/checksum.go; the exact polynomial differs — within this
+        engine the value is stable across servers/restarts, which is
+        what replication-drift checks need)."""
+        import zlib
+
+        txn = self._ensure_txn()
+        rows = []
+        for tn in stmt.tables:
+            info, _ = self._table_for(tn)
+            crc = 0
+            for cinfo, _store in self._partition_children(info):
+                snap = txn.snapshot(cinfo.id)
+                n = snap.num_visible_rows
+                for off in range(cinfo.num_columns):
+                    col = snap.column(off)
+                    d = col.dictionary
+                    if d is not None and len(d) and \
+                            cinfo.columns[off].ftype.is_string:
+                        # dictionary CODES are per-store assignment
+                        # order: hash the DECODED values so identical
+                        # content gives identical checksums everywhere
+                        vals = d.values
+                        vl = col.validity
+                        for ri in range(n):
+                            crc = zlib.crc32(
+                                vals[col.data[ri]].encode()
+                                if vl[ri] else b"\x00N", crc)
+                    else:
+                        data = np.ascontiguousarray(col.data)
+                        crc = zlib.crc32(data.tobytes(), crc)
+                        crc = zlib.crc32(col.validity.tobytes(), crc)
+                crc = zlib.crc32(str(n).encode(), crc)
+            db = tn.db or self.current_db
+            rows.append((f"{db}.{info.name}", crc & 0xFFFFFFFF))
+        return ResultSet(["Table", "Checksum"], rows)
 
     # ==================== roles ===========================================
     def _exec_role_stmt(self, stmt) -> ResultSet:
@@ -2600,6 +2661,70 @@ class Session:
             return ResultSet(
                 ["Id", "User", "Host", "db", "Command", "Time",
                  "State", "Info"], rows)
+        if stmt.kind == "TABLE_STATUS":
+            schema = self.catalog.schema(self.current_db)
+            rows = []
+            for t in sorted(schema.tables.values(), key=lambda t: t.name):
+                if not _like_match(stmt.pattern, t.name):
+                    continue
+                from ..catalog.infoschema import _store_rows
+                part = getattr(t, "partition", None)
+                ids = [d.id for d in part.defs] if part else [t.id]
+                nrows = sum(_store_rows(self.storage, tid)
+                            for tid in ids)
+                rows.append((t.name, "InnoDB", 10, "Fixed", nrows, 0,
+                             0, 0, 0, 0, None, None, None, None,
+                             "utf8mb4_bin", None,
+                             "partitioned" if part else "", ""))
+            for v in sorted(getattr(schema, "views", {}).values(),
+                            key=lambda v: v.name):
+                if _like_match(stmt.pattern, v.name):
+                    rows.append((v.name, None, None, None, None, None,
+                                 None, None, None, None, None, None,
+                                 None, None, None, None, None, "VIEW"))
+            return ResultSet(
+                ["Name", "Engine", "Version", "Row_format", "Rows",
+                 "Avg_row_length", "Data_length", "Max_data_length",
+                 "Index_length", "Data_free", "Auto_increment",
+                 "Create_time", "Update_time", "Check_time", "Collation",
+                 "Checksum", "Create_options", "Comment"], rows)
+        if stmt.kind == "CHARSET":
+            rows = [("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
+                    ("binary", "Binary pseudo charset", "binary", 1),
+                    ("utf8", "UTF-8 Unicode", "utf8_bin", 3)]
+            rows = [r for r in rows if _like_match(stmt.pattern, r[0])]
+            return ResultSet(
+                ["Charset", "Description", "Default collation",
+                 "Maxlen"], rows)
+        if stmt.kind == "PRIVILEGES":
+            from .privileges import PRIVS
+            return ResultSet(
+                ["Privilege", "Context", "Comment"],
+                [(p.title(), "Tables,Databases,Global", "")
+                 for p in sorted(PRIVS - {"ALL", "USAGE"})])
+        if stmt.kind == "PROFILES":
+            return ResultSet(["Query_ID", "Duration", "Query"], [])
+        if stmt.kind == "CREATE_DATABASE":
+            name = stmt.pattern or ""
+            self.catalog.schema(name)  # raises if unknown
+            return ResultSet(
+                ["Database", "Create Database"],
+                [(name, f"CREATE DATABASE `{name}` /*!40100 DEFAULT "
+                  f"CHARACTER SET utf8mb4 */")])
+        if stmt.kind == "CREATE_VIEW":
+            assert stmt.target is not None
+            db = stmt.target.db or self.current_db
+            schema = self.catalog.schema(db)
+            v = getattr(schema, "views", {}).get(stmt.target.name.lower())
+            if v is None:
+                raise SQLError(f"Unknown view '{stmt.target.name}'",
+                               errno=ER_NO_SUCH_TABLE)
+            return ResultSet(
+                ["View", "Create View", "character_set_client",
+                 "collation_connection"],
+                [(v.name,
+                  f"CREATE VIEW `{v.name}` AS {v.sql}",
+                  "utf8mb4", "utf8mb4_bin")])
         if stmt.kind == "WARNINGS":
             return ResultSet(["Level", "Code", "Message"], [])
         if stmt.kind == "ENGINES":

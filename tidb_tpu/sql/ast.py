@@ -455,6 +455,13 @@ class AdminStmt(Stmt):
 
 
 @dataclass
+class ChecksumTableStmt(Stmt):
+    """CHECKSUM TABLE t[, ...] (reference: executor/checksum.go)."""
+
+    tables: list[TableName]
+
+
+@dataclass
 class CreateBindingStmt(Stmt):
     """CREATE [GLOBAL|SESSION] BINDING FOR <stmt> USING <hinted stmt>
     (reference: bindinfo/handle.go; ast CreateBindingStmt)."""

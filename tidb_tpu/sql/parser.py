@@ -236,6 +236,13 @@ class Parser:
             return ast.AdminStmt("SHOW_DDL_JOBS")
         if t.is_kw("LOAD"):
             return self.parse_load_data()
+        if t.kind == TokenKind.IDENT and t.text.upper() == "CHECKSUM":
+            self.advance()
+            self.expect_kw("TABLE")
+            tables = [self.parse_table_name()]
+            while self.accept_op(","):
+                tables.append(self.parse_table_name())
+            return ast.ChecksumTableStmt(tables)
         if t.is_kw("GRANT", "REVOKE"):
             return self.parse_grant(revoke=t.is_kw("REVOKE"))
         raise ParseError("unsupported statement", t)
@@ -1226,6 +1233,11 @@ class Parser:
         elif self.accept_kw("SESSION"):
             scope = "SESSION"
         self.accept_kw("FULL")
+        if self.cur.is_kw("TABLE") and \
+                self.peek().is_kw("STATUS"):
+            self.advance()
+            self.advance()
+            return self._show_like(ast.ShowStmt("TABLE_STATUS"))
         if self.accept_kw("TABLES"):
             return self._show_like(ast.ShowStmt("TABLES"))
         if self.accept_kw("DATABASES", "SCHEMAS"):
@@ -1246,6 +1258,22 @@ class Parser:
             return ast.ShowStmt("ENGINES")
         if self.accept_kw("COLLATION"):
             return self._show_like(ast.ShowStmt("COLLATION"))
+        if self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) and \
+                self.cur.text.upper() in ("CHARACTER", "CHARSET"):
+            if self.cur.text.upper() == "CHARACTER":
+                self.advance()
+                self.expect_kw("SET")
+            else:
+                self.advance()
+            return self._show_like(ast.ShowStmt("CHARSET"))
+        if self.cur.kind == TokenKind.KEYWORD and \
+                self.cur.text == "PRIVILEGES":
+            self.advance()
+            return ast.ShowStmt("PRIVILEGES")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "PROFILES":
+            self.advance()
+            return ast.ShowStmt("PROFILES")
         if self.accept_kw("COLUMNS", "FIELDS"):
             self.expect_kw("FROM")
             return self._show_like(
@@ -1270,6 +1298,13 @@ class Parser:
             self.advance()
             return ast.ShowStmt("METRICS")
         if self.accept_kw("CREATE"):
+            if self.accept_kw("DATABASE", "SCHEMA"):
+                return ast.ShowStmt("CREATE_DATABASE",
+                                    pattern=self.expect_ident())
+            if self.cur.kind == TokenKind.IDENT and \
+                    self.cur.text.upper() == "VIEW":
+                self.advance()
+                return ast.ShowStmt("CREATE_VIEW", self.parse_table_name())
             self.expect_kw("TABLE")
             return ast.ShowStmt("CREATE_TABLE", self.parse_table_name())
         if self.accept_kw("VARIABLES"):

@@ -147,6 +147,9 @@ class Storage:
         self.bindings = BindingManager(self)
         # GET_LOCK user locks (builtin_miscellaneous.go lock family)
         self.user_locks = UserLocks()
+        # viewer-sensitive information_schema refresh+scan exclusion
+        # (session._refresh_infoschema holds this for the statement)
+        self.infoschema_lock = threading.RLock()
         # DDL job queue + history (the meta-KV DDLJobList analog,
         # reference meta/meta.go:571) — lives on storage so a replacement
         # worker resumes pending jobs with their reorg checkpoints
