@@ -1,0 +1,57 @@
+"""Typed retry backoff (reference: store/tikv/backoff.go)."""
+
+import pytest
+
+from tidb_tpu.kv.backoff import (BO_META, BO_TXN_CONFLICT, BO_TXN_LOCK,
+                                 Backoffer, BackoffExhausted)
+
+
+def test_exponential_growth_capped():
+    bo = Backoffer(budget_ms=10_000)
+    bo.sleep(BO_TXN_LOCK)
+    bo.sleep(BO_TXN_LOCK)
+    bo.sleep(BO_TXN_LOCK)
+    assert bo.attempts["txnLock"] == 3
+    assert 0 < bo.total_ms < 100
+
+
+def test_budget_exhaustion_carries_history():
+    bo = Backoffer(budget_ms=5)
+    with pytest.raises(BackoffExhausted) as ei:
+        for _ in range(50):
+            bo.sleep(BO_TXN_CONFLICT)
+            bo.sleep(BO_META)
+    msg = str(ei.value)
+    assert "txnConflict" in msg and "budget 5ms" in msg
+    assert getattr(ei.value, "errno", None) == 9001
+
+
+def test_charge_external_wait():
+    bo = Backoffer(budget_ms=100)
+    bo.charge(BO_TXN_LOCK, 0.05)
+    assert bo.total_ms == pytest.approx(50.0)
+    with pytest.raises(BackoffExhausted):
+        bo.charge(BO_TXN_LOCK, 0.06)
+
+
+def test_contended_pessimistic_statement_reports_taxonomy():
+    """An impossible budget surfaces the typed history, not a bare
+    'retries exhausted'."""
+    import threading
+
+    from testkit import TestKit
+    from tidb_tpu.session import Session, SQLError
+
+    tk = TestKit()
+    tk.must_exec("create table bk (id int primary key, v int)")
+    tk.must_exec("insert into bk values (1, 0)")
+    tk.must_exec("set innodb_lock_wait_timeout = 1")
+    s2 = Session(tk.session.storage)
+    s2.execute("use test")
+    s2.execute("begin pessimistic")
+    s2.execute("update bk set v = 1 where id = 1")  # holds the lock
+    tk.session.execute("begin pessimistic")
+    with pytest.raises(SQLError):
+        tk.session.execute("update bk set v = 2 where id = 1")
+    tk.session.execute("rollback")
+    s2.execute("rollback")

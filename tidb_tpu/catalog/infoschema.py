@@ -183,7 +183,9 @@ def _store_rows(storage, table_id: int) -> int:
         return 0
     if not store.deltas:
         return store.epoch.num_rows
-    return store.snapshot(storage.tso.next_ts()).num_visible_rows
+    # current() is read-only: all committed deltas are <= the last
+    # issued ts, so no TSO allocation on this read path
+    return store.snapshot(storage.tso.current()).num_visible_rows
 
 
 def _rows_for(storage, catalog: Catalog, tname: str,

@@ -50,6 +50,7 @@ from ..errno import (
     ER_TABLE_EXISTS,
     ER_TABLEACCESS_DENIED,
     ER_TEXTFILE_NOT_READABLE,
+    ER_TIKV_SERVER_BUSY,
     ER_TRUNCATED_WRONG_VALUE,
     ER_UNKNOWN_SYSTEM_VARIABLE,
     ER_VAR_READONLY,
@@ -892,8 +893,14 @@ class Session:
         if not names:
             return
         if names & self._VIEWER_SENSITIVE_IS and self._is_guard is None:
+            # bounded: a statement stuck on row locks while holding this
+            # would otherwise stall every sibling's PROCESSLIST read for
+            # its whole duration
             lock = self.storage.infoschema_lock
-            lock.acquire()
+            if not lock.acquire(timeout=10.0):
+                raise SQLError(
+                    "information_schema busy; try again",
+                    errno=ER_TIKV_SERVER_BUSY)
             self._is_guard = lock
         I.refresh(self.storage, names, viewer=self)
 
@@ -1348,10 +1355,13 @@ class Session:
                     # holder may have committed the very value we carry
                     # (reference: pessimistic lock-then-recheck;
                     # tables/index.go unique key constraint via KV)
+                    from ..kv.backoff import (BO_TXN_CONFLICT, Backoffer,
+                                              BackoffExhausted)
                     from ..kv.mvcc import WriteConflictError as KVConflict
                     lock_keys = [tablecodec.record_key(tid, handle)]
                     lock_keys += self._unique_lock_keys(tinfo, enc)
-                    for _ in range(16):
+                    bo = Backoffer(budget_ms=int(timeout * 1000))
+                    for _ in range(64):
                         try:
                             waited = self.storage.pessimistic_lock_keys(
                                 txn, lock_keys, timeout)
@@ -1360,6 +1370,10 @@ class Session:
                             # EVERY cached checker's snapshot is stale
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
                             checkers.clear()
+                            try:
+                                bo.sleep(BO_TXN_CONFLICT)
+                            except BackoffExhausted as e:
+                                raise err_wrap(SQLError, e) from None
                             continue
                         except (Storage.DeadlockError,
                                 Storage.LockWaitTimeout) as e:
@@ -1436,24 +1450,38 @@ class Session:
             for cinfo, _store in self._partition_children(info):
                 snap = txn.snapshot(cinfo.id)
                 n = snap.num_visible_rows
+                # HANDLE order, not storage order: compaction reorders
+                # rows physically, and two replicas with identical
+                # content but different compaction state must agree
+                handles = snap.handles()
+                order = np.argsort(handles, kind="stable")
+                cols = []
                 for off in range(cinfo.num_columns):
                     col = snap.column(off)
                     d = col.dictionary
-                    if d is not None and len(d) and \
-                            cinfo.columns[off].ftype.is_string:
-                        # dictionary CODES are per-store assignment
-                        # order: hash the DECODED values so identical
-                        # content gives identical checksums everywhere
-                        vals = d.values
-                        vl = col.validity
-                        for ri in range(n):
-                            crc = zlib.crc32(
-                                vals[col.data[ri]].encode()
-                                if vl[ri] else b"\x00N", crc)
-                    else:
-                        data = np.ascontiguousarray(col.data)
-                        crc = zlib.crc32(data.tobytes(), crc)
-                        crc = zlib.crc32(col.validity.tobytes(), crc)
+                    is_str = d is not None and len(d) and \
+                        cinfo.columns[off].ftype.is_string
+                    cols.append((col.data[order], col.validity[order],
+                                 d.values if is_str else None))
+                hs = handles[order]
+                for ri in range(n):
+                    crc = zlib.crc32(int(hs[ri]).to_bytes(8, "little",
+                                                          signed=True),
+                                     crc)
+                    for data, vl, svals in cols:
+                        if not vl[ri]:
+                            crc = zlib.crc32(b"\xff\xff\xff\xff", crc)
+                            continue
+                        if svals is not None:
+                            b = svals[data[ri]].encode()
+                        elif data.dtype.kind in "iub":
+                            b = int(data[ri]).to_bytes(8, "little",
+                                                       signed=True)
+                        else:
+                            b = data[ri].tobytes()
+                        # length prefix: ("ab","c") != ("a","bc")
+                        crc = zlib.crc32(
+                            len(b).to_bytes(4, "little") + b, crc)
                 crc = zlib.crc32(str(n).encode(), crc)
             db = tn.db or self.current_db
             rows.append((f"{db}.{info.name}", crc & 0xFFFFFFFF))
@@ -2125,11 +2153,18 @@ class Session:
         every read this statement makes sees the locked versions; the
         caller clears it when the statement ends."""
         from ..kv import tablecodec
+        from ..kv.backoff import (BO_TXN_CONFLICT, Backoffer,
+                                  BackoffExhausted)
         from ..kv.mvcc import WriteConflictError as KVConflict
+
+        import time as _time
+
+        from ..kv.backoff import BO_TXN_LOCK
 
         timeout = float(
             self._sysvar_value("innodb_lock_wait_timeout") or 50)
-        for _ in range(64):
+        bo = Backoffer(budget_ms=int(timeout * 1000))
+        while True:
             ts = txn.refresh_for_update_ts()
             txn.stmt_read_ts = ts
             snap = txn.snapshot(info.id)
@@ -2137,15 +2172,24 @@ class Session:
             handles = snap.handles()[mask]
             keys = [tablecodec.record_key(info.id, int(h))
                     for h in handles]
+            t0 = _time.monotonic()
             try:
                 self.storage.pessimistic_lock_keys(txn, keys, timeout)
                 return snap, mask, ev, handles
             except KVConflict:
-                continue  # newer commit: rescan at a fresh for_update_ts
+                try:
+                    # time blocked on foreign locks counts against the
+                    # SAME budget, or a contended statement could run
+                    # far beyond innodb_lock_wait_timeout
+                    waited = _time.monotonic() - t0
+                    if waited > 0.001:
+                        bo.charge(BO_TXN_LOCK, waited)
+                    bo.sleep(BO_TXN_CONFLICT)  # then rescan fresh
+                except BackoffExhausted as e:
+                    raise err_wrap(SQLError, e) from None
             except (Storage.DeadlockError,
                     Storage.LockWaitTimeout) as e:
                 raise err_wrap(SQLError, e) from None
-        raise SQLError("pessimistic lock retries exhausted")
 
     def _where_mask(self, info: TableInfo, table: ast.TableName,
                     where: Optional[ast.Expr], snap):
@@ -2706,7 +2750,10 @@ class Session:
             return ResultSet(["Query_ID", "Duration", "Query"], [])
         if stmt.kind == "CREATE_DATABASE":
             name = stmt.pattern or ""
-            self.catalog.schema(name)  # raises if unknown
+            try:
+                self.catalog.schema(name)  # raises if unknown
+            except KeyError as e:
+                raise err_wrap(SQLError, e) from None
             return ResultSet(
                 ["Database", "Create Database"],
                 [(name, f"CREATE DATABASE `{name}` /*!40100 DEFAULT "

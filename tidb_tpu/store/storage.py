@@ -1016,9 +1016,12 @@ class Storage:
         pickle built BEFORE the conflict, and replaying it would erase
         the sibling's DDL — catalog writers serialize via ddl_section()
         and any residual conflict must stay loud."""
+        from ..kv.backoff import BO_META, Backoffer, BackoffExhausted
+
         key = tablecodec.meta_key(name)
         retriable = name != b"catalog"
-        for _ in range(16):
+        bo = Backoffer(budget_ms=2000)
+        while True:
             start_ts = self.tso.next_ts()
             try:
                 with self._commit_lock:
@@ -1030,9 +1033,11 @@ class Storage:
                     raise
                 if self.shared:
                     self.kv.refresh()
-                continue
-        raise WriteConflictError(
-            f"meta write on {name!r} kept conflicting")
+                try:
+                    bo.sleep(BO_META)
+                except BackoffExhausted as e:
+                    raise WriteConflictError(
+                        f"meta write on {name!r}: {e}") from None
 
     def get_meta(self, name: bytes) -> Optional[bytes]:
         from ..kv.twopc import Snapshot
