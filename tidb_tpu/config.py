@@ -258,8 +258,11 @@ run-interval = "10m0s"         # background maintenance cadence
 [security]
 skip-grant-table = false
 ssl-ca = ""
-ssl-cert = ""
+ssl-cert = ""                  # PEM chain; with ssl-key enables TLS
 ssl-key = ""
+auto-tls = false               # ephemeral self-signed cert at startup
+require-secure-transport = false
+proxy-protocol-networks = ""   # LB CIDRs (or "*") sending PROXY headers
 """
 
 
