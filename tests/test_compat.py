@@ -45,6 +45,31 @@ def test_global_scope_crosses_sessions_and_set_global_rules():
         s.execute("SET no_such_var_at_all = 1")
 
 
+def test_alter_user_set_password_rename_user(server):
+    root = MiniClient("127.0.0.1", server.port)
+    root.execute("create user 'pw1' identified by 'first'")
+    root.execute("alter user 'pw1' identified by 'second'")
+    # old password rejected, new accepted, over the REAL wire auth
+    with pytest.raises(Exception):
+        MiniClient("127.0.0.1", server.port, user="pw1",
+                   password="first")
+    c = MiniClient("127.0.0.1", server.port, user="pw1",
+                   password="second")
+    # a user changes their OWN password without SUPER
+    c.execute("set password = 'third'")
+    c.close()
+    c2 = MiniClient("127.0.0.1", server.port, user="pw1",
+                    password="third")
+    with pytest.raises(Exception):
+        c2.execute("alter user 'root' identified by 'x'")
+    c2.close()
+    root.execute("rename user 'pw1' to 'pw2'")
+    c3 = MiniClient("127.0.0.1", server.port, user="pw2",
+                    password="third")
+    c3.close()
+    root.close()
+
+
 def test_show_table_status_charset_privileges_profiles():
     s = Session()
     s.execute("create table st1 (a int)")

@@ -308,6 +308,33 @@ class PrivilegeManager:
             u = users.get(name)
             return sorted(u.get("col_grants", ())) if u else []
 
+    def rename_users(self, pairs: list) -> None:
+        """RENAME USER a TO b (reference: executor/simple.go
+        executeRenameUser): validate every pair before mutating any."""
+        users = self._load()
+        with self._lock:
+            taken = set(users)
+            for old, new in pairs:
+                if old not in taken:
+                    raise PrivilegeError(f"unknown user '{old}'")
+                if new in taken:  # includes earlier pairs' targets
+                    raise PrivilegeError(
+                        f"Operation RENAME USER failed for '{new}'")
+                taken.discard(old)
+                taken.add(new)
+            for old, new in pairs:
+                users[new] = users.pop(old)
+                for other in users.values():
+                    edges = other.get("roles")
+                    if edges and old in edges:
+                        edges.discard(old)
+                        edges.add(new)
+                    dflt = other.get("default_roles")
+                    if dflt and old in dflt:
+                        dflt.discard(old)
+                        dflt.add(new)
+            self._persist()
+
     def account_names(self) -> list[str]:
         """Sorted non-role account names (a locked snapshot — callers
         must never iterate the live users dict)."""

@@ -304,6 +304,27 @@ class Session:
         if isinstance(stmt, ast.DropViewStmt):
             with self.storage.ddl_section():
                 return self._exec_drop_view(stmt)
+        if isinstance(stmt, ast.AlterUserStmt):
+            from .privileges import PrivilegeError
+            target = stmt.name or self.user or "root"
+            if target != (self.user or "root"):
+                self._require_super()  # changing OWN password needs none
+            try:
+                self.storage.privileges.set_password(target,
+                                                     stmt.password)
+            except PrivilegeError as e:
+                if stmt.if_exists:
+                    return ResultSet([], [])
+                raise err_wrap(SQLError, e) from None
+            return ResultSet([], [])
+        if isinstance(stmt, ast.RenameUserStmt):
+            self._require_super()
+            from .privileges import PrivilegeError
+            try:
+                self.storage.privileges.rename_users(stmt.pairs)
+            except PrivilegeError as e:
+                raise err_wrap(SQLError, e) from None
+            return ResultSet([], [])
         if isinstance(stmt, ast.CreateUserStmt):
             self._require_super()
             from .privileges import PrivilegeError

@@ -455,6 +455,21 @@ class AdminStmt(Stmt):
 
 
 @dataclass
+class AlterUserStmt(Stmt):
+    """ALTER USER 'u' IDENTIFIED BY 'pwd' (reference: executor/simple.go
+    executeAlterUser; SET PASSWORD maps here too)."""
+
+    name: str
+    password: str
+    if_exists: bool = False
+
+
+@dataclass
+class RenameUserStmt(Stmt):
+    pairs: list  # [(old, new)]
+
+
+@dataclass
 class ChecksumTableStmt(Stmt):
     """CHECKSUM TABLE t[, ...] (reference: executor/checksum.go)."""
 

@@ -213,6 +213,15 @@ class Parser:
             return self.parse_alter()
         if t.is_kw("RENAME"):
             self.advance()
+            if self.accept_kw("USER"):
+                pairs = []
+                while True:
+                    old = self._parse_account_name()
+                    self.expect_kw("TO")
+                    pairs.append((old, self._parse_account_name()))
+                    if not self.accept_op(","):
+                        break
+                return ast.RenameUserStmt(pairs)
             self.expect_kw("TABLE")
             renames = []
             while True:
@@ -384,8 +393,15 @@ class Parser:
         user = self._parse_account_name()
         return ast.GrantStmt(privs, db, tbl, user, revoke, priv_cols)
 
-    def parse_alter(self) -> ast.AlterTableStmt:
+    def parse_alter(self) -> ast.Stmt:
         self.expect_kw("ALTER")
+        if self.accept_kw("USER"):
+            if_exists = self._if_exists()
+            name = self._parse_account_name()
+            self.expect_kw("IDENTIFIED")
+            self.expect_kw("BY")
+            pwd = self._string_lit("IDENTIFIED BY")
+            return ast.AlterUserStmt(name, pwd, if_exists)
         self.expect_kw("TABLE")
         table = self.parse_table_name()
         specs: list[ast.AlterSpec] = []
@@ -1316,6 +1332,18 @@ class Parser:
         SET CHARACTER SET cs, SET [scope] TRANSACTION ISOLATION LEVEL x
         (reference: executor/set.go + ast SetStmt variants)."""
         self.expect_kw("SET")
+        # SET PASSWORD [FOR 'u'] = 'pwd' (maps to ALTER USER; reference:
+        # executor/simple.go executeSetPwd)
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "PASSWORD" and \
+                (self.peek().is_kw("FOR") or self.peek().is_op("=")):
+            self.advance()
+            name = ""
+            if self.accept_kw("FOR"):
+                name = self._parse_account_name()
+            self.expect_op("=")
+            pwd = self._string_lit("SET PASSWORD")
+            return ast.AlterUserStmt(name, pwd)
         # SET [DEFAULT] ROLE (reference: executor/set_role; roles in
         # privilege/privileges) — statement forms, not var assignments
         if self.cur.kind == TokenKind.IDENT and \
