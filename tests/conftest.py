@@ -10,7 +10,15 @@ vars set here would be ignored. jax.config updates still work because no
 backend has been initialized yet at conftest import time.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the device count is an XLA flag, read at backend
+    # initialization (which has not happened yet at conftest import)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")

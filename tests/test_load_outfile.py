@@ -29,6 +29,21 @@ def test_load_data_basic_tsv(tk, tmp_path):
              [(1, "alpha"), (2, "beta"), (3, None)])
 
 
+def test_load_data_local_rejected(tk, tmp_path):
+    """LOCAL INFILE's client-side transfer sub-protocol is not
+    implemented: the statement must fail clearly (errno 1235), not
+    silently read a SERVER-side path — that spelling difference is a
+    FILE-privilege boundary."""
+    p = tmp_path / "t.tsv"
+    p.write_text("1\n")
+    tk.must_exec("create table t (a int primary key)")
+    with pytest.raises(Exception) as exc:
+        tk.must_exec(f"load data local infile '{p}' into table t")
+    assert "local" in str(exc.value).lower()
+    assert getattr(exc.value, "errno", None) == 1235
+    tk.check("select count(*) from t", [(0,)])
+
+
 def test_load_data_csv_enclosed_ignore_lines(tk, tmp_path):
     p = tmp_path / "t.csv"
     p.write_text('a,b\n1,"hello, world"\n2,"say ""hi"""\n3,plain\n')

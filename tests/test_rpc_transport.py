@@ -1,0 +1,419 @@
+"""Socket transport for the multi-process plane: two servers, one
+database, NO shared disk — and the same suite under injected faults.
+
+The leader owns the durable directory and serves the coordination RPC
+tier (TSO, WAL append/tail, KILL mailbox, leases); the follower joins
+over a socket with a disjoint working dir. The scenarios port
+tests/test_multiproc.py's cluster behaviors (DDL visibility, strict SI,
+schema fence, cross-server KILL) onto the socket transport, then re-run
+the replication round-trip with each `rpc/*` failpoint armed: the
+system must recover within the typed backoff budget or fail with a
+typed error — never hang, never diverge (reference:
+store/tikv/client_fail_test.go + region_request_test.go fault matrix,
+driven by pingcap/failpoint)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from mysql_client import MiniClient, MySQLError  # noqa: E402
+
+from tidb_tpu.errno import CodedError  # noqa: E402
+from tidb_tpu.kv.backoff import BackoffExhausted  # noqa: E402
+from tidb_tpu.rpc.client import RpcClient, RpcOptions  # noqa: E402
+from tidb_tpu.rpc.errors import (  # noqa: E402
+    LeaderUnavailable,
+    StaleLeaseError,
+    WalOffsetMismatch,
+)
+from tidb_tpu.session import Session  # noqa: E402
+from tidb_tpu.store.storage import Storage  # noqa: E402
+from tidb_tpu.util import failpoint  # noqa: E402
+
+# tight budgets so fault tests bound their own runtime; generous enough
+# that a loaded CI box doesn't trip them on the happy path
+OPTS = RpcOptions(connect_timeout_ms=1000, request_timeout_ms=4000,
+                  backoff_budget_ms=3000, lock_budget_ms=8000,
+                  lease_ms=2000)
+
+RPC_FAILPOINTS = ["rpc/conn-drop", "rpc/delay", "rpc/partial-write",
+                  "rpc/stale-response"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    follower = Storage(str(tmp_path / "follower"),
+                       remote=f"127.0.0.1:{leader.rpc_server.port}",
+                       rpc_options=OPTS)
+    try:
+        yield leader, follower
+    finally:
+        follower.close()
+        leader.close()
+
+
+def _fire_on_test_thread(n, effect):
+    """A failpoint value firing `effect` for the first `n` hits on the
+    test thread only — background pollers (heartbeat, kill mailbox)
+    must not eat the chaos aimed at the statement path."""
+    state = {"left": n}
+
+    def fire():
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        if state["left"] <= 0:
+            return None
+        state["left"] -= 1
+        return effect()
+
+    return fire
+
+
+# ---- the multiproc scenarios, over the socket ------------------------------
+def test_ddl_and_data_visible_over_socket(cluster):
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table t (id bigint primary key, v bigint)")
+    sl.execute("insert into t values (1, 10), (2, 20)")
+    # DDL + rows made through the leader serve on the follower with no
+    # shared filesystem in between
+    assert sf.execute("select id, v from t order by id").rows == \
+        [(1, 10), (2, 20)]
+    sf.execute("insert into t values (3, 30)")
+    assert sl.execute("select sum(v) from t").rows == [(60,)]
+    # second round: the FOLLOWER alters, the leader uses it immediately
+    sf.execute("alter table t add column w bigint")
+    sl.execute("update t set w = id * 100 where id = 1")
+    assert sf.execute("select w from t where id = 1").rows == [(100,)]
+
+
+def test_conflicting_writes_over_socket(cluster):
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table c (id bigint primary key, v bigint)")
+    sl.execute("insert into c values (1, 0)")
+    for i in range(6):
+        (sl if i % 2 == 0 else sf).execute(
+            "update c set v = v + 1 where id = 1")
+    assert sl.execute("select v from c").rows == [(6,)]
+    assert sf.execute("select v from c").rows == [(6,)]
+
+
+def test_stale_schema_commit_aborts_over_socket(cluster):
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table f (id bigint primary key, v bigint)")
+    sl.execute("insert into f values (1, 1)")
+    sf.execute("begin")
+    sf.execute("update f set v = 2 where id = 1")
+    sl.execute("alter table f add column extra bigint")
+    with pytest.raises(CodedError) as exc:
+        sf.execute("commit")
+    assert "schema" in str(exc.value).lower() or \
+        "try again" in str(exc.value).lower()
+    assert sl.execute("select v from f").rows == [(1,)]
+
+
+def test_strict_si_over_socket(cluster):
+    """A leader commit issued after the follower's snapshot opened can
+    never surface inside that snapshot, and the next snapshot must see
+    it — the tso strictness the shared allocator guarantees, inherited
+    over RPC because the leader's allocator issues EVERY timestamp."""
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table t (id bigint primary key, v bigint)")
+    sl.execute("insert into t values (1, 10)")
+    assert sf.execute("select v from t").rows == [(10,)]
+    sf.execute("begin")
+    assert sf.execute("select v from t").rows == [(10,)]
+    sl.execute("update t set v = 99 where id = 1")
+    assert sf.execute("select v from t").rows == [(10,)]
+    sf.execute("commit")
+    assert sf.execute("select v from t").rows == [(99,)]
+
+
+def test_cross_server_kill_over_socket(cluster):
+    """KILL QUERY issued on the leader lands on a follower connection
+    via the RPC kill mailbox (the socket port of the shared-dir
+    mailbox; reference: tests/globalkilltest)."""
+    from tidb_tpu.server.server import Server
+
+    leader, follower = cluster
+    srv_l = Server(leader, host="127.0.0.1", port=0)
+    srv_f = Server(follower, host="127.0.0.1", port=0)
+    srv_l.start()
+    srv_f.start()
+    cl = cf = None
+    try:
+        cl = MiniClient("127.0.0.1", srv_l.port)
+        cf = MiniClient("127.0.0.1", srv_f.port)
+        conn_id = int(cf.query("select connection_id()")[0][0])
+        errs: list = []
+
+        def long_query():
+            try:
+                cf.query("select sleep(25)")
+            except MySQLError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=long_query)
+        t.start()
+        time.sleep(1.0)
+        t0 = time.time()
+        cl.execute(f"kill query {conn_id}")
+        t.join(timeout=20)
+        assert not t.is_alive(), "query was not killed"
+        assert time.time() - t0 < 15, "cross-server kill took too long"
+        assert errs and "interrupt" in str(errs[0]).lower()
+        assert cf.query("select 1") == [("1",)]  # connection survives
+    finally:
+        for c in (cl, cf):
+            if c is not None:
+                c.close()
+        srv_f.close()
+        srv_l.close()
+
+
+# ---- the same round-trip with every transport failpoint armed --------------
+@pytest.mark.parametrize("fp", RPC_FAILPOINTS)
+def test_replication_roundtrip_under_failpoint(cluster, fp):
+    """Each transport edge severed mid-protocol: the client must retry
+    within the typed backoff budget and the round-trip must stay exact
+    — recovered, not corrupted, not hung."""
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table c (id bigint primary key, v bigint)")
+    sl.execute("insert into c values (0, 0)")
+    assert sf.execute("select v from c").rows == [(0,)]
+
+    if fp == "rpc/conn-drop":
+        value = _fire_on_test_thread(
+            2, lambda: (_ for _ in ()).throw(
+                ConnectionResetError("chaos conn-drop")))
+    elif fp == "rpc/delay":
+        value = _fire_on_test_thread(3, lambda: 0.05)
+    elif fp == "rpc/partial-write":
+        value = _fire_on_test_thread(2, lambda: True)
+    else:  # rpc/stale-response
+        value = _fire_on_test_thread(2, lambda: True)
+
+    with failpoint.failpoint(fp, value):
+        sf.execute("insert into c values (1, 11)")
+        assert sl.execute("select v from c where id = 1").rows == [(11,)]
+        sf.execute("update c set v = v + 1 where id = 1")
+        assert sf.execute("select v from c where id = 1").rows == [(12,)]
+    assert failpoint.hits(fp) > 0, f"{fp} never fired"
+    # and with the fault gone the cluster is still exact on both sides
+    sl.execute("insert into c values (2, 22)")
+    assert sf.execute("select sum(v) from c").rows == [(34,)]
+    assert sl.execute("select sum(v) from c").rows == [(34,)]
+
+
+def test_ddl_visibility_under_conn_drop(cluster):
+    """The multiproc DDL-visibility scenario with the connection dying
+    repeatedly mid-protocol: catalog replication must survive retries
+    (appends are deduplicated by client-assigned sequence, so a retried
+    WAL publish cannot double-apply a DDL)."""
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    value = _fire_on_test_thread(
+        3, lambda: (_ for _ in ()).throw(
+            ConnectionResetError("chaos conn-drop")))
+    with failpoint.failpoint("rpc/conn-drop", value):
+        sf.execute("create table d (id bigint primary key, v bigint)")
+        sf.execute("insert into d values (1, 1)")
+    assert failpoint.hits("rpc/conn-drop") > 0
+    assert sl.execute("select v from d").rows == [(1,)]
+    sl.execute("alter table d add column w bigint")
+    assert sf.execute("select w from d where id = 1").rows == [(None,)]
+
+
+# ---- degraded mode / typed failure surface ---------------------------------
+def test_leader_down_degrades_to_readonly(cluster):
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table t (id bigint primary key, v bigint)")
+    sl.execute("insert into t values (1, 10)")
+    assert sf.execute("select v from t").rows == [(10,)]
+    leader.rpc_server.close()
+    # reads: served from the last replicated state; the first statement
+    # may pay one backoff budget before the degrade flag flips, later
+    # ones are fast — and nothing hangs
+    t0 = time.time()
+    assert sf.execute("select v from t").rows == [(10,)]
+    assert time.time() - t0 < 20, "degraded read took too long"
+    t0 = time.time()
+    assert sf.execute("select v from t").rows == [(10,)]
+    assert time.time() - t0 < 2, "degraded fast-path not engaged"
+    # writes: typed CodedError (9001), promptly — never a hang
+    t0 = time.time()
+    with pytest.raises(CodedError) as exc:
+        sf.execute("insert into t values (2, 2)")
+    assert exc.value.errno == 9001
+    assert "read" in str(exc.value).lower()
+    assert time.time() - t0 < 10
+    # DDL is a write too
+    with pytest.raises(CodedError):
+        sf.execute("create table nope (id bigint primary key)")
+
+
+def test_backoff_exhaustion_surfaces_typed_history(tmp_path):
+    """A dead leader exhausts the per-call budget and the error carries
+    the typed retry history (the BO_RPC kind), not a bare timeout."""
+    client = RpcClient("127.0.0.1:1",  # nothing listens there
+                       RpcOptions(connect_timeout_ms=200,
+                                  request_timeout_ms=200,
+                                  backoff_budget_ms=400))
+    t0 = time.time()
+    with pytest.raises((LeaderUnavailable, BackoffExhausted)) as exc:
+        client.call("ping")
+    assert time.time() - t0 < 10
+    assert "tikvRPC" in str(exc.value), "typed history missing"
+    assert exc.value.errno == 9001
+    client.close()
+
+
+# ---- protocol-level protections --------------------------------------------
+def _record(key: bytes, val: bytes) -> bytes:
+    """A well-formed engine WAL record (put into CF 2 = data)."""
+    return struct.pack("<BBII", 1, 2, len(key), len(val)) + key + val
+
+
+def test_wal_append_dedup_and_fencing(tmp_path):
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    try:
+        client = RpcClient(f"127.0.0.1:{leader.rpc_server.port}", OPTS)
+        client.call("hello")
+        grant = client.call("lock_acquire", name="mutation")
+        assert grant["granted"]
+        token = grant["token"]
+        wal = os.path.join(str(tmp_path / "leader"), "kv", "wal.log")
+        base = os.path.getsize(wal)
+        rec = _record(b"zz-chaos-key", b"v1")
+        r1 = client.call("wal_append", seq=7, expected=base, data=rec,
+                         token=token)
+        # an idempotent retry of the SAME sequence (lost response) must
+        # return the same offset without double-appending
+        r2 = client.call("wal_append", seq=7, expected=base, data=rec,
+                         token=token)
+        assert r1["offset"] == r2["offset"] == base + len(rec)
+        assert os.path.getsize(wal) == base + len(rec)
+        # fencing: a superseded/invalid token is rejected typed
+        with pytest.raises(StaleLeaseError):
+            client.call("wal_append", seq=8,
+                        expected=base + len(rec),
+                        data=_record(b"zz-chaos-key", b"v2"),
+                        token=token + 999)
+        # offset mismatch (fencing bypass net) is rejected typed
+        with pytest.raises(WalOffsetMismatch):
+            client.call("wal_append", seq=9, expected=base,
+                        data=_record(b"zz-chaos-key", b"v3"),
+                        token=token)
+        assert os.path.getsize(wal) == base + len(rec)  # nothing leaked
+        client.call("lock_release", name="mutation", token=token)
+        client.close()
+    finally:
+        leader.close()
+
+
+def test_chunked_bootstrap_and_tail(tmp_path):
+    """Snapshot and WAL both stream in chunks: a follower joins a store
+    whose snapshot is many times the per-response chunk, with single
+    records LARGER than the chunk (the client grows its ask instead of
+    spinning), and incremental tails keep working at the same tiny
+    chunk. Guards the no-shared-frame-constant protocol: termination is
+    the server's `more` flag, never a size comparison."""
+    small = RpcOptions(connect_timeout_ms=1000, request_timeout_ms=4000,
+                       backoff_budget_ms=3000, lock_budget_ms=8000,
+                       lease_ms=2000, tail_chunk=64)
+    big = "x" * 300  # one KV record ≈ 5x the 64-byte chunk
+    # pre-shared life: a plain durable store whose close() checkpoints
+    # the KV into snapshot.kv (shared mode never truncates the WAL)
+    pre = Storage(str(tmp_path / "leader"))
+    sp = Session(pre)
+    sp.execute("create table big (id bigint primary key, s varchar(500))")
+    for i in range(8):
+        sp.execute(f"insert into big values ({i}, '{big}')")
+    pre.close()
+    assert os.path.getsize(
+        str(tmp_path / "leader" / "kv" / "snapshot.kv")) > 10 * 64
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=small)
+    follower = None
+    try:
+        follower = Storage(str(tmp_path / "follower"),
+                           remote=f"127.0.0.1:{leader.rpc_server.port}",
+                           rpc_options=small)
+        sf, sl = Session(follower), Session(leader)
+        assert sf.execute(
+            "select count(*), max(length(s)) from big").rows == [(8, 300)]
+        sl.execute(f"insert into big values (100, '{big}')")
+        assert sf.execute("select count(*) from big").rows == [(9,)]
+        sf.execute(f"insert into big values (101, '{big}')")
+        assert sl.execute("select count(*) from big").rows == [(10,)]
+    finally:
+        if follower is not None:
+            follower.close()
+        leader.close()
+
+
+def test_mutation_lease_blocks_second_client(tmp_path):
+    """The leased mutation section is exclusive across clients: a
+    second client's acquire is refused while the lease is held, and
+    granted after release."""
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    try:
+        a = RpcClient(f"127.0.0.1:{leader.rpc_server.port}", OPTS)
+        b = RpcClient(f"127.0.0.1:{leader.rpc_server.port}", OPTS)
+        ga = a.call("lock_acquire", name="mutation")
+        assert ga["granted"]
+        assert not b.call("lock_acquire", name="mutation")["granted"]
+        a.call("lock_release", name="mutation", token=ga["token"])
+        gb = b.call("lock_acquire", name="mutation")
+        assert gb["granted"] and gb["token"] != ga["token"]
+        b.call("lock_release", name="mutation", token=gb["token"])
+        a.close()
+        b.close()
+    finally:
+        leader.close()
+
+
+def test_status_port_reports_transport_health(cluster):
+    import json
+    import urllib.request
+
+    from tidb_tpu.server.server import Server
+
+    leader, follower = cluster
+    srv = Server(follower, host="127.0.0.1", port=0,
+                 status_port=0, status_host="127.0.0.1")
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/status",
+                timeout=10) as resp:
+            status = json.load(resp)
+        t = status["transport"]
+        assert t["mode"] == "socket-follower"
+        assert t["degraded"] is False
+        assert t["calls"] > 0
+        assert leader.transport_health()["mode"] == "socket-leader"
+    finally:
+        srv.close()

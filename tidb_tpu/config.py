@@ -76,6 +76,27 @@ class SecurityConfig:
 
 
 @dataclass
+class TransportConfig:
+    """Multi-process plane transport (reference: the tikv-client section
+    of config.go — timeouts/retries for the store RPC tier).
+
+    mode selection: `listen` makes this server the store LEADER, also
+    serving coordination RPC (TSO, WAL append/tail, KILL mailbox) on
+    that address; `remote` makes it a FOLLOWER joining a leader's
+    cluster over the socket with `path` as its private working dir.
+    Both empty: local/shared-dir modes, exactly as before."""
+
+    listen: str = ""             # leader RPC address (host:port|unix:/p)
+    remote: str = ""             # follower: the leader's RPC address
+    connect_timeout_ms: int = 1000
+    request_timeout_ms: int = 5000
+    backoff_budget_ms: int = 4000   # per-call typed-retry budget
+    lock_budget_ms: int = 30000     # mutation-lease acquisition budget
+    lease_ms: int = 3000            # leader-granted lease horizon
+    stale_reads: bool = True        # degraded followers serve stale reads
+
+
+@dataclass
 class Config:
     host: str = "0.0.0.0"
     port: int = 4000
@@ -90,6 +111,7 @@ class Config:
     plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
     gc: GCConfig = field(default_factory=GCConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
+    transport: TransportConfig = field(default_factory=TransportConfig)
     # dotted names pinned by CLI flags: hot reload must not revert them
     # (defaults < file < flags precedence; reference: main.go:408)
     cli_overrides: set = field(default_factory=set, compare=False,
@@ -100,13 +122,24 @@ class Config:
     def load(path: str) -> "Config":
         """Strict TOML decode (reference: config.go strict check — an
         undecoded key is an error)."""
-        import tomllib
-
         try:
-            with open(path, "rb") as f:
-                raw = tomllib.load(f)
-        except tomllib.TOMLDecodeError as e:
-            raise ConfigError(f"malformed TOML in {path}: {e}") from None
+            import tomllib
+        except ImportError:  # Python < 3.11: the minimal subset parser
+            tomllib = None
+        if tomllib is not None:
+            try:
+                with open(path, "rb") as f:
+                    raw = tomllib.load(f)
+            except tomllib.TOMLDecodeError as e:
+                raise ConfigError(
+                    f"malformed TOML in {path}: {e}") from None
+        else:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    raw = _parse_toml_subset(f.read())
+            except _TomlError as e:
+                raise ConfigError(
+                    f"malformed TOML in {path}: {e}") from None
         cfg = Config()
         cfg.apply(raw)
         return cfg
@@ -127,6 +160,19 @@ class Config:
             raise ConfigError(f"unknown log level {self.log.level!r}")
         if self.performance.mem_quota_query < 0:
             raise ConfigError("mem-quota-query must be >= 0")
+        t = self.transport
+        if t.listen and t.remote:
+            raise ConfigError(
+                "transport.listen (leader) and transport.remote "
+                "(follower) are mutually exclusive")
+        if t.listen and not self.path:
+            raise ConfigError(
+                "transport.listen requires path (the leader owns the "
+                "durable store directory)")
+        for knob in ("connect_timeout_ms", "request_timeout_ms",
+                     "backoff_budget_ms", "lock_budget_ms", "lease_ms"):
+            if getattr(t, knob) <= 0:
+                raise ConfigError(f"transport.{knob} must be > 0")
 
     # ---- hot reload ----------------------------------------------------
     # keys that may change at runtime (reference: the hot-reloadable
@@ -165,6 +211,19 @@ class Config:
                      self.log.level]
         logging.getLogger("tidb_tpu").setLevel(level)
 
+    def rpc_options(self):
+        """The transport knobs as the RPC tier's options object."""
+        from .rpc.client import RpcOptions
+        t = self.transport
+        return RpcOptions(
+            connect_timeout_ms=t.connect_timeout_ms,
+            request_timeout_ms=t.request_timeout_ms,
+            backoff_budget_ms=t.backoff_budget_ms,
+            lock_budget_ms=t.lock_budget_ms,
+            lease_ms=t.lease_ms,
+            stale_reads=t.stale_reads,
+        )
+
     # ---- sysvar seeding ------------------------------------------------
     def seed_sysvars(self, storage) -> None:
         """Push config-derived values into the sysvar plane as DEFAULTS:
@@ -183,6 +242,60 @@ class Config:
                               self.gc.run_interval)
         sv.set_config_default("tidb_tile_rows", self.performance.tile_rows)
         sv.set_config_default("max_connections", self.max_connections)
+
+
+class _TomlError(Exception):
+    pass
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Fallback decoder for interpreters without tomllib: the subset the
+    config format actually uses — [section] tables, key = value with
+    quoted strings, integers, floats and booleans, # comments. Malformed
+    input raises (strictness preserved: the caller maps to ConfigError)."""
+    root: dict = {}
+    cur = root
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            if not line.endswith("]"):
+                raise _TomlError(f"line {ln}: unterminated table header")
+            cur = root
+            for part in line[1:-1].strip().split("."):
+                if not part:
+                    raise _TomlError(f"line {ln}: empty table name")
+                cur = cur.setdefault(part, {})
+            continue
+        key, eq, value = line.partition("=")
+        if not eq:
+            raise _TomlError(f"line {ln}: expected key = value")
+        cur[key.strip()] = _toml_value(value.strip(), ln)
+    return root
+
+
+def _toml_value(v: str, ln: int):
+    if v and v[0] in "\"'":
+        q = v[0]
+        end = v.find(q, 1)
+        if end < 0:
+            raise _TomlError(f"line {ln}: unterminated string")
+        rest = v[end + 1:].strip()
+        if rest and not rest.startswith("#"):
+            raise _TomlError(f"line {ln}: trailing characters {rest!r}")
+        return v[1:end]
+    v = v.split("#", 1)[0].strip()
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v, 0)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        raise _TomlError(f"line {ln}: unsupported value {v!r}") from None
 
 
 def _apply_section(obj, raw: dict, prefix: str) -> None:
@@ -254,6 +367,26 @@ capacity = 128
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC
 run-interval = "10m0s"         # background maintenance cadence
+
+[transport]
+# Multi-process plane transport. Default (both addresses empty): local
+# single-process store, or flock-coordinated shared directory when the
+# server starts with --shared. Socket mode needs no shared disk:
+#   leader:   set `listen` on the server that owns `path`; it serves
+#             TSO allocation, WAL append/tail and the KILL mailbox.
+#   follower: set `remote` to the leader's address; `path` (or a
+#             throwaway dir) is then this server's PRIVATE working dir.
+# On leader loss a follower keeps serving READS at the last replicated
+# state (bounded staleness) and rejects writes with errno 9001 until
+# the lease renews; set stale-reads = false to fail reads instead.
+listen = ""                    # leader RPC address (host:port | unix:/p)
+remote = ""                    # follower: leader's RPC address
+connect-timeout-ms = 1000
+request-timeout-ms = 5000
+backoff-budget-ms = 4000       # per-call typed-retry budget
+lock-budget-ms = 30000         # mutation-lease acquisition budget
+lease-ms = 3000                # leader-granted lease horizon
+stale-reads = true             # degraded followers serve stale reads
 
 [security]
 skip-grant-table = false

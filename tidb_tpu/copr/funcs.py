@@ -915,10 +915,17 @@ def _j_overlaps(a, b):
 # misc compat (reference: builtin_miscellaneous.go, builtin_info.go)
 # ---------------------------------------------------------------------------
 
-_FU_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%-m", "d": "%d",
-           "e": "%-d", "H": "%H", "k": "%-H", "i": "%M", "s": "%S",
+_FU_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "d": "%d",
+           "H": "%H", "i": "%M", "s": "%S",
            "S": "%S", "p": "%p", "W": "%A", "a": "%a", "b": "%b",
            "M": "%B", "j": "%j", "T": "%H:%M:%S", "%": "%%"}
+
+# MySQL's non-padded codes have no PORTABLE strftime equivalent ("%-m"
+# is a glibc extension that raises on other libcs): format the struct
+# component directly instead
+_FU_DIRECT = {"c": lambda t: str(t.tm_mon),   # month, no leading zero
+              "e": lambda t: str(t.tm_mday),  # day, no leading zero
+              "k": lambda t: str(t.tm_hour)}  # hour, no leading zero
 
 
 def _from_unixtime(ts, fmt=None):
@@ -927,21 +934,34 @@ def _from_unixtime(ts, fmt=None):
     t = _time.gmtime(float(ts))
     if fmt is None:
         return _time.strftime("%Y-%m-%d %H:%M:%S", t)
-    py = []
+    out = []
+    run = []  # literal/strftime-safe segment being accumulated
+
+    def flush():
+        if run:
+            out.append(_time.strftime("".join(run), t))
+            del run[:]
+
     i = 0
     fmt = str(fmt)
-    while i < len(fmt):
-        c = fmt[i]
-        if c == "%" and i + 1 < len(fmt):
-            py.append(_FU_FMT.get(fmt[i + 1], fmt[i + 1]))
-            i += 2
-        else:
-            py.append("%%" if c == "%" else c)
-            i += 1
     try:
-        return _time.strftime("".join(py), t)
+        while i < len(fmt):
+            c = fmt[i]
+            if c == "%" and i + 1 < len(fmt):
+                nxt = fmt[i + 1]
+                if nxt in _FU_DIRECT:
+                    flush()
+                    out.append(_FU_DIRECT[nxt](t))
+                else:
+                    run.append(_FU_FMT.get(nxt, nxt))
+                i += 2
+            else:
+                run.append("%%" if c == "%" else c)
+                i += 1
+        flush()
     except ValueError:
         return None
+    return "".join(out)
 
 
 _reg("UUID", 0, 0, "str",

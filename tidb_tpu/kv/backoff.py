@@ -33,6 +33,8 @@ BO_TXN_CONFLICT = BackoffKind("txnConflict", 2, 100)  # write conflict rescan
 BO_REGION_MISS = BackoffKind("regionMiss", 2, 40)     # region map stale
 BO_META = BackoffKind("metaConflict", 2, 100)         # meta-key CAS retry
 BO_MAX_TS = BackoffKind("tsoWait", 1, 20)             # TSO window refill
+BO_RPC = BackoffKind("tikvRPC", 10, 400)              # transport retry
+
 
 
 class BackoffExhausted(CodedError):

@@ -755,7 +755,14 @@ class _MutationSection:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.store._mu.release()
+        # coordinator release FIRST, while the engine mutex is still
+        # held: a remote coordinator publishes (or reverts) the
+        # section's buffered records in release(), and doing that
+        # outside the mutex would let a concurrent local reader observe
+        # a commit that a fenced flush then reverts
         c = self.store.coord
-        if c is not None:
-            c.release()
+        try:
+            if c is not None:
+                c.release()
+        finally:
+            self.store._mu.release()

@@ -9,9 +9,18 @@ tier derives lock TTL expiry from `now_ts - lock_ts > ttl << 18`
 prewrite locks effectively immortal. start_ts/commit_ts ordering is the
 basis of snapshot-isolation visibility in the MVCC store.
 
-Two implementations:
+Three implementations:
 
 * `TimestampOracle` — in-process allocator (single-server stores).
+* `RemoteTSO` — RPC proxy to the store leader's allocator (socket
+  followers; the PD-client role, reference: oracle/oracles/pd.go
+  GetTimestamp over the PD RPC pool). Strictness is inherited: every
+  timestamp is issued by the ONE leader allocator. When the leader is
+  unreachable past the backoff budget the oracle can degrade to
+  re-issuing the last replicated timestamp for READS (bounded-staleness
+  follower reads); such timestamps sit at or below `stale_watermark`,
+  and the storage layer refuses to let a transaction whose start_ts is
+  under the watermark write — degraded followers are read-only.
 * `SharedTSO` — ONE allocator for all processes sharing a durable store
   directory: an mmap'd shared counter advanced under a dedicated flock,
   with a persisted allocation window (fsync'd every `_WINDOW_MS` of
@@ -84,6 +93,63 @@ class TimestampOracle:
     def current(self) -> int:
         with self._lock:
             return (self._physical << _LOGICAL_BITS) | self._logical
+
+
+class RemoteTSO:
+    """Leader-allocated timestamps over RPC (PD-client role).
+
+    `next_ts` (snapshot acquisition) may fall back to a stale re-issue
+    when degraded; `ts` (the 2PC committer's interface) NEVER does — a
+    commit timestamp must come from the live allocator or the commit
+    must fail typed."""
+
+    def __init__(self, client, allow_stale: bool = True) -> None:
+        self._client = client
+        self._allow_stale = allow_stale
+        self._lock = threading.Lock()
+        self._seen = 0            # highest leader-issued ts witnessed
+        self.stale_watermark = 0  # every stale re-issue is <= this
+
+    def _remote_next(self) -> int:
+        ts = int(self._client.call("tso_next")["ts"])
+        with self._lock:
+            if ts > self._seen:
+                self._seen = ts
+        return ts
+
+    def next_ts(self) -> int:
+        from ..rpc.errors import RPCError
+        if not (self._client.degraded and self._allow_stale):
+            try:
+                return self._remote_next()
+            except RPCError:
+                if not self._allow_stale:
+                    raise
+        # degraded read-only mode: re-issue the last replicated ts.
+        # Re-issuing (rather than bumping) keeps every fallback value
+        # strictly below anything the live allocator will ever hand
+        # out, so the watermark check cleanly fences writes.
+        with self._lock:
+            if self.stale_watermark < self._seen:
+                self.stale_watermark = self._seen
+            return self._seen
+
+    def ts(self) -> int:
+        return self._remote_next()
+
+    def observe(self, ts: int) -> None:
+        """Track replicated commit timestamps locally (they were issued
+        by the leader allocator, so no RPC is needed to stay ordered)."""
+        with self._lock:
+            if ts > self._seen:
+                self._seen = ts
+
+    def current(self) -> int:
+        with self._lock:
+            return self._seen
+
+    def close(self) -> None:
+        pass
 
 
 # window persisted ahead of issued timestamps: every issued ts is < the

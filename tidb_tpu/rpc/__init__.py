@@ -1,0 +1,25 @@
+"""Socket RPC tier for the multi-process plane.
+
+Counterpart of the reference's TiKV client stack (reference:
+store/tikv/client.go sendRequest over gRPC, client_batch.go request
+batching/recycling, region_request.go typed retry against region and
+transport errors). The shared-directory deployment keeps working as the
+fast local mode; this package carries the same three coordination
+services — TSO allocation, WAL append/tail, KILL mailbox — over a
+length-prefixed-frame protocol on TCP or unix sockets, so a second
+tidb_tpu server can join a cluster WITHOUT sharing a disk.
+
+Layers:
+
+* frame.py  — wire format: u32 length-prefixed frames carrying a
+  tagged binary encoding (None/bool/int/bytes/str/list/dict).
+* errors.py — the typed error surface (all CodedError subclasses, so
+  exhaustion/lease-loss reach MySQL clients with real errnos).
+* server.py — CoordRPCServer: embedded in the store-owning process,
+  granting leases/locks via the SAME flocks the shared-dir mode uses
+  (local and remote mutators stay mutually exclusive).
+* client.py — RpcClient: per-request Backoffer (BO_RPC), connect/read
+  timeouts, transparent reconnect, failpoint sites at every edge.
+* remote.py — the follower-side adapters (RemoteKV, RemoteCoordinator,
+  RemoteOwnerManager) that plug the client into storage unchanged.
+"""

@@ -1,0 +1,263 @@
+"""RPC client: typed retries, timeouts, reconnect, failpoint edges.
+
+Counterpart of the reference's RPC client + retry plumbing (reference:
+store/tikv/client.go sendRequest, region_request.go sendReqToRegion —
+every send runs under a Backoffer, transport errors reconnect and
+retry as boTiKVRPC, and exhaustion surfaces the typed history). Four
+failpoint sites cover the transport edges chaos tests sever:
+
+  rpc/conn-drop      — the connection dies before the request is sent
+  rpc/delay          — latency injection ahead of the send
+  rpc/partial-write  — the frame tears mid-write (half a header on the
+                       wire), then the connection dies
+  rpc/stale-response — a duplicated earlier response arrives first and
+                       must be discarded by request-id matching
+
+Retryable failures are OS/socket errors and timeouts; application
+errors (a CodedError raised by a handler) are re-raised typed and are
+NEVER retried here — idempotency of the retried ops is the server's
+contract (WAL appends dedup on a client-assigned sequence)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..errno import CodedError
+from ..kv.backoff import BO_RPC, Backoffer, BackoffExhausted
+from ..util import failpoint
+from .errors import WIRE_ERRORS, LeaderUnavailable, RPCError
+from .frame import (FrameError, decode, encode, parse_addr, recv_frame,
+                    send_frame)
+
+
+@dataclass
+class RpcOptions:
+    """Transport knobs (config [transport] section; reference: the
+    tikv-client timeouts in config.go TiKVClient)."""
+
+    connect_timeout_ms: int = 1000
+    request_timeout_ms: int = 5000
+    # per-call retry budget; exhaustion raises LeaderUnavailable with
+    # the typed history
+    backoff_budget_ms: int = 4000
+    # mutation-lock acquisition budget (lock waits are long-lived and
+    # budgeted separately from transport retries)
+    lock_budget_ms: int = 30000
+    # leader-granted lease horizon; heartbeats renew it, and a grant
+    # whose holder missed it is force-released (fencing tokens protect
+    # the WAL from the deposed holder)
+    lease_ms: int = 3000
+    # degraded mode: serve reads at the last replicated timestamp when
+    # the leader is unreachable (writes always fail typed)
+    stale_reads: bool = True
+    # max bytes per wal_tail response
+    tail_chunk: int = 4 << 20
+
+
+class RpcClient:
+    """One logical peer connection with transparent reconnect.
+
+    Thread-safe: one in-flight request at a time (the reference batches
+    concurrent requests onto one stream, client_batch.go; serializing
+    is the same correctness with less machinery). The heartbeat runs on
+    its OWN socket so lease renewal never queues behind a slow call."""
+
+    def __init__(self, addr, options: Optional[RpcOptions] = None,
+                 client_id: Optional[str] = None,
+                 _heartbeat: bool = True) -> None:
+        self.addr = addr
+        self.options = options or RpcOptions()
+        self.client_id = client_id or uuid.uuid4().hex
+        self._mu = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._req_id = 0
+        self._last_resp: Optional[bytes] = None  # stale-response replay
+        self._closed = False
+        # transport health (surfaced on the status port)
+        self.calls = 0
+        self.retries = 0
+        self.degraded = False
+        self.last_contact = 0.0
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._want_heartbeat = _heartbeat
+
+    # ---- connection management --------------------------------------------
+    def _connect(self) -> socket.socket:
+        fam, target = parse_addr(self.addr)
+        s = socket.socket(fam, socket.SOCK_STREAM)
+        s.settimeout(self.options.connect_timeout_ms / 1000.0)
+        try:
+            s.connect(target)
+        except OSError:
+            s.close()
+            raise
+        s.settimeout(self.options.request_timeout_ms / 1000.0)
+        if fam == socket.AF_INET:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _drop_conn(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # ---- the call path -----------------------------------------------------
+    def call(self, method: str, _budget_ms: Optional[int] = None,
+             **params: Any) -> dict:
+        """One request with typed-retry semantics. Transport failures
+        reconnect and retry under BO_RPC until the budget is spent;
+        exhaustion raises LeaderUnavailable carrying the history and
+        flips the client into degraded mode."""
+        bo = Backoffer(budget_ms=_budget_ms
+                       if _budget_ms is not None
+                       else self.options.backoff_budget_ms)
+        last: Optional[BaseException] = None
+        while True:
+            if self._closed:
+                raise RPCError("rpc client closed")
+            t0 = time.monotonic()
+            try:
+                r = self._call_once(method, params)
+                self.degraded = False
+                self.last_contact = time.monotonic()
+                return r
+            except (OSError, FrameError, FrameProtocolError) as e:
+                # covers ConnectionError, socket.timeout, refused, reset
+                last = e
+                self._drop_conn()
+                self.retries += 1
+                try:
+                    # time burned BLOCKED in connect/read timeouts
+                    # counts against the same budget as the sleeps — a
+                    # stalled (not refusing) leader must exhaust in
+                    # ~budget wall time, not timeout x attempts
+                    bo.charge(BO_RPC, time.monotonic() - t0)
+                    bo.sleep(BO_RPC)
+                except BackoffExhausted as exhausted:
+                    self.degraded = True
+                    raise LeaderUnavailable(
+                        f"rpc {method} to {self.addr!r} failed: "
+                        f"{last!r}; {exhausted}") from None
+
+    def _call_once(self, method: str, params: dict) -> dict:
+        with self._mu:
+            if self._sock is None:
+                self._sock = self._connect()
+            sock = self._sock
+            # -- transport-edge failpoints (armed by chaos tests) --
+            v = failpoint.inject("rpc/conn-drop")
+            if v:
+                self._drop_conn()
+                raise ConnectionResetError("failpoint rpc/conn-drop")
+            d = failpoint.inject("rpc/delay")
+            if isinstance(d, (int, float)) and not isinstance(d, bool) \
+                    and d > 0:
+                time.sleep(float(d))
+            self._req_id += 1
+            req_id = self._req_id
+            self.calls += 1
+            payload = encode({"id": req_id, "m": method, "p": params,
+                              "c": self.client_id})
+            self._send(sock, payload)
+            # evaluated ONCE per request: a persistently-enabled point
+            # must inject one duplicated response, not starve the real
+            # read forever
+            stale = failpoint.inject("rpc/stale-response")
+            while True:
+                if stale and self._last_resp is not None:
+                    raw, stale = self._last_resp, None  # old response
+                else:
+                    raw = recv_frame(sock)
+                try:
+                    resp = decode(raw)
+                except Exception as e:  # torn/corrupt payload
+                    raise FrameProtocolError(str(e)) from None
+                if not isinstance(resp, dict) \
+                        or resp.get("id") != req_id:
+                    # stale or duplicated response: discard and keep
+                    # reading — request ids fence every reply
+                    continue
+                # retained only while the chaos point is armed: keeping
+                # every response would pin a full tail chunk per client
+                if failpoint.is_enabled("rpc/stale-response"):
+                    self._last_resp = raw
+                break
+        err = resp.get("err")
+        if err is not None:
+            cls = WIRE_ERRORS.get(err.get("type"), CodedError)
+            raise cls(err.get("msg", "rpc error"),
+                      errno=err.get("errno"))
+        return resp.get("r") or {}
+
+    def _send(self, sock: socket.socket, payload: bytes) -> None:
+        cut = failpoint.inject("rpc/partial-write")
+        if cut:
+            import struct as _struct
+            data = _struct.pack("<I", len(payload)) + payload
+            try:
+                sock.sendall(data[:max(1, len(data) // 2)])
+            finally:
+                self._drop_conn()
+            raise ConnectionResetError("failpoint rpc/partial-write")
+        send_frame(sock, payload)
+
+    # ---- liveness ----------------------------------------------------------
+    def start_heartbeat(self) -> None:
+        """Lease keepalive on a dedicated socket (reference: the
+        store's liveness probes; oracle lease renewal in pd.go). Ping
+        failures flip `degraded`; the next success clears it — that
+        transition is what lets a follower recover automatically."""
+        if not self._want_heartbeat or self._hb_thread is not None:
+            return
+        hb = RpcClient(self.addr, self.options,
+                       client_id=self.client_id, _heartbeat=False)
+        interval = max(0.2, self.options.lease_ms / 3000.0)
+
+        def beat() -> None:
+            while not self._hb_stop.wait(interval):
+                try:
+                    hb.call("ping", _budget_ms=min(
+                        self.options.backoff_budget_ms, 500))
+                    self.degraded = False
+                    self.last_contact = time.monotonic()
+                except RPCError:
+                    self.degraded = True
+            hb.close()
+
+        self._hb_thread = threading.Thread(
+            target=beat, name="titpu-rpc-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def health(self) -> dict:
+        return {
+            "peer": str(self.addr),
+            "degraded": self.degraded,
+            "calls": self.calls,
+            "retries": self.retries,
+            "last_contact_age_s": round(
+                time.monotonic() - self.last_contact, 3)
+            if self.last_contact else None,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        self._hb_stop.set()
+        with self._mu:
+            self._drop_conn()
+
+
+class FrameProtocolError(Exception):
+    """Client-side wrapper for torn/corrupt payloads: retried like a
+    connection failure (the stream is unusable either way)."""
+
+
+__all__ = ["RpcClient", "RpcOptions", "FrameProtocolError"]

@@ -1,0 +1,77 @@
+"""Typed transport errors (reference: store/tikv/region_request.go's
+error taxonomy — every failure class retries differently, and what
+cannot be retried surfaces to the session with a real error code).
+
+All of these are CodedError subclasses: a follower whose leader is gone
+answers MySQL clients with errno 9001 (ER_TIKV_SERVER_TIMEOUT), not a
+hang and not a bare 1105.
+"""
+
+from __future__ import annotations
+
+from ..errno import ER_TIKV_SERVER_TIMEOUT, ER_WRITE_CONFLICT, CodedError
+
+
+class RPCError(CodedError):
+    """Base of the transport error surface."""
+
+    errno = ER_TIKV_SERVER_TIMEOUT
+    sqlstate = "HY000"
+
+
+class LeaderUnavailable(RPCError):
+    """The store leader could not be reached within the backoff budget.
+
+    Carries the Backoffer's typed retry history in the message so an
+    operator sees WHY the budget burned (reference: backoff.go
+    exhaustion strings). Followers raise this from every write path
+    while degraded — reads keep serving the last replicated state."""
+
+
+class StaleLeaseError(RPCError):
+    """A fenced operation arrived with a superseded lease token.
+
+    The holder lost its lease (partition/pause) and another mutator may
+    have run; the local buffered mutations were reverted, so retrying
+    the whole statement at a fresh view is safe — hence the
+    write-conflict errno clients already retry on."""
+
+    errno = ER_WRITE_CONFLICT
+    sqlstate = "40001"
+
+
+class ResultUndetermined(RPCError):
+    """A WAL publish may or may not have landed (the leader became
+    unreachable after the request was sent and before a response
+    arrived, and retries exhausted the budget).
+
+    The reference surfaces exactly this as ErrResultUndetermined
+    (store/tikv terror): the client must treat the statement's outcome
+    as unknown rather than failed. Locally the buffered records are
+    reverted to the last replicated state; if the append DID land, the
+    next successful tail re-applies it."""
+
+
+class WalOffsetMismatch(RPCError):
+    """An append's expected WAL position no longer matches the file.
+
+    Only reachable when fencing was bypassed (or the leader lost state);
+    kept distinct from StaleLeaseError so chaos tests can tell the two
+    protections apart."""
+
+    errno = ER_WRITE_CONFLICT
+    sqlstate = "40001"
+
+
+# wire name -> class, for re-raising a server-side error client-side
+WIRE_ERRORS = {
+    "LeaderUnavailable": LeaderUnavailable,
+    "StaleLeaseError": StaleLeaseError,
+    "ResultUndetermined": ResultUndetermined,
+    "WalOffsetMismatch": WalOffsetMismatch,
+    "RPCError": RPCError,
+}
+
+
+__all__ = ["RPCError", "LeaderUnavailable", "StaleLeaseError",
+           "ResultUndetermined", "WalOffsetMismatch", "WIRE_ERRORS"]

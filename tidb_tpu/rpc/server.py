@@ -1,0 +1,437 @@
+"""CoordRPCServer: the store-owning process's coordination endpoint.
+
+Embedded in the leader (the one server whose Storage owns the durable
+directory), it exports the three services every other deployment shape
+gets from the shared directory — TSO allocation, WAL append/tail, KILL
+mailbox — plus the named leases (mutation section, DDL/GC owner) that
+serialize cluster mutators. The reference splits these across PD (TSO,
+store/tikv/oracle/oracles/pd.go), TiKV raftstore (the log), and etcd
+(owner election, owner/manager.go); one process plays all three here
+because the storage tier is embedded.
+
+Crucial property: remote grants take the SAME flocks the shared-dir
+mode uses (store.lock, ddl.owner.lock, ...), so a socket follower and a
+disk-sharing sibling can coexist against one directory — local and
+remote mutators stay mutually exclusive through the kernel.
+
+Safety under lease loss: every grant carries a fencing token; a WAL
+append from a deposed holder (lease expired while it was paused or
+partitioned) is rejected with StaleLeaseError BEFORE touching the file,
+and the append offset is double-checked against the file size as a
+second net (reference analog: raft terms fencing a deposed leader's
+proposals)."""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import socket
+import threading
+import time
+from typing import Any, Optional
+
+from ..errno import CodedError
+from .errors import StaleLeaseError, WalOffsetMismatch
+from .frame import MAX_FRAME, FrameError, decode, encode, parse_addr, \
+    recv_frame, send_frame
+
+# one tail response carries at most this many bytes; clients loop
+TAIL_CHUNK = 4 << 20
+
+
+class _Client:
+    __slots__ = ("last_seen", "node_id", "node_fd", "last_seq",
+                 "last_seq_result", "kill_seq", "kill_result")
+
+    def __init__(self) -> None:
+        self.last_seen = time.monotonic()
+        self.node_id: Optional[int] = None
+        self.node_fd: Optional[int] = None
+        self.last_seq = -1
+        self.last_seq_result: Optional[int] = None
+        self.kill_seq = -1
+        self.kill_result: Optional[list] = None
+
+
+class _Grant:
+    __slots__ = ("client_id", "token")
+
+    def __init__(self, client_id: str, token: int) -> None:
+        self.client_id = client_id
+        self.token = token
+
+
+class CoordRPCServer:
+    def __init__(self, storage, listen="127.0.0.1:0",
+                 lease_ms: int = 3000,
+                 tail_chunk: int = TAIL_CHUNK) -> None:
+        if storage.path is None:
+            raise ValueError("RPC coordination needs a durable store dir")
+        self.storage = storage
+        self.path = storage.path
+        self.lease_ms = lease_ms
+        # the server owns the chunk size; clients drive the tail loop
+        # off the response's `more` flag, never off their own constant
+        self.tail_chunk = tail_chunk
+        self._mu = threading.Lock()
+        self._clients: dict[str, _Client] = {}
+        self._grants: dict[str, _Grant] = {}   # lock name -> grant
+        self._lock_fds: dict[str, int] = {}    # lock name -> flock fd
+        self._next_token = 1
+        self._wal_path = os.path.join(self.path, "kv", "wal.log")
+        self._snap_path = os.path.join(self.path, "kv", "snapshot.kv")
+        os.makedirs(os.path.join(self.path, "kv"), exist_ok=True)
+        # O_APPEND handle for remote records: interleaves safely with
+        # the leader engine's own appends (both under the mutation flock)
+        self._append_f = open(self._wal_path, "ab")
+        self._shutdown = threading.Event()
+        self._conns: set[socket.socket] = set()
+        fam, target = parse_addr(listen)
+        ls = socket.socket(fam, socket.SOCK_STREAM)
+        if fam == socket.AF_INET:
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(target)
+        ls.listen(64)
+        self._listener = ls
+        self.port = ls.getsockname()[1] if fam == socket.AF_INET else 0
+        self.address = (f"127.0.0.1:{self.port}"
+                        if fam == socket.AF_INET else f"unix:{target}")
+        threading.Thread(target=self._accept_loop,
+                         name="titpu-rpc-accept", daemon=True).start()
+        threading.Thread(target=self._reaper_loop,
+                         name="titpu-rpc-reaper", daemon=True).start()
+
+    # ---- lifecycle ---------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            with self._mu:
+                self._conns.add(sock)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             name="titpu-rpc-conn", daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    raw = recv_frame(sock)
+                    req = decode(raw)
+                except (ConnectionError, FrameError, OSError):
+                    return  # torn stream: client reconnects
+                resp = self._dispatch(req)
+                payload = encode(resp)
+                if len(payload) > MAX_FRAME:
+                    # never tear the connection down silently over an
+                    # oversized response — answer typed so the client
+                    # stops retrying a deterministic failure
+                    payload = encode({"id": resp.get("id"), "err": {
+                        "type": "RPCError",
+                        "msg": f"response too large for one frame "
+                               f"({len(payload)} > {MAX_FRAME})"}})
+                try:
+                    send_frame(sock, payload)
+                except OSError:
+                    return
+        finally:
+            with self._mu:
+                self._conns.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._mu:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._mu:
+            for name in list(self._grants):
+                self._release_locked(name)
+            for fd in self._lock_fds.values():
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            self._lock_fds.clear()
+            for c in self._clients.values():
+                if c.node_fd is not None:
+                    try:
+                        os.close(c.node_fd)
+                    except OSError:
+                        pass
+            self._clients.clear()
+        try:
+            self._append_f.close()
+        except OSError:
+            pass
+
+    # ---- dispatch ----------------------------------------------------------
+    def _dispatch(self, req: Any) -> dict:
+        if not isinstance(req, dict) or "m" not in req:
+            return {"id": None,
+                    "err": {"type": "RPCError", "msg": "bad request"}}
+        rid = req.get("id")
+        method = req.get("m")
+        params = req.get("p") or {}
+        client_id = str(req.get("c") or "")
+        handler = getattr(self, f"_h_{method}", None)
+        if handler is None:
+            return {"id": rid, "err": {"type": "RPCError",
+                                       "msg": f"unknown method {method}"}}
+        with self._mu:
+            c = self._clients.get(client_id)
+            if c is None:
+                c = self._clients[client_id] = _Client()
+            c.last_seen = time.monotonic()
+        try:
+            return {"id": rid, "r": handler(client_id, **params)}
+        except CodedError as e:
+            return {"id": rid, "err": {"type": type(e).__name__,
+                                       "msg": str(e), "errno": e.errno}}
+        except Exception as e:  # noqa: BLE001 — keep the server alive
+            return {"id": rid, "err": {"type": "RPCError",
+                                       "msg": f"{type(e).__name__}: {e}"}}
+
+    # ---- liveness ----------------------------------------------------------
+    def _h_ping(self, client_id: str) -> dict:
+        return {"ok": True, "lease_ms": self.lease_ms}
+
+    def _h_hello(self, client_id: str) -> dict:
+        return {"lease_ms": self.lease_ms,
+                "wal_size": self._wal_size()}
+
+    def client_count(self) -> int:
+        with self._mu:
+            horizon = time.monotonic() - 3 * self.lease_ms / 1000.0
+            return sum(1 for c in self._clients.values()
+                       if c.last_seen >= horizon)
+
+    # ---- TSO ---------------------------------------------------------------
+    def _h_tso_next(self, client_id: str) -> dict:
+        return {"ts": self.storage.tso.next_ts()}
+
+    # ---- named leases (mutation section, ddl/gc owner) ---------------------
+    def _lock_file(self, name: str) -> str:
+        if name == "mutation":
+            return os.path.join(self.path, "store.lock")
+        if name in ("ddl", "gc"):
+            return os.path.join(self.path, f"{name}.owner.lock")
+        safe = "".join(ch if ch.isalnum() else "_" for ch in name)
+        return os.path.join(self.path, f"rpc.{safe}.lock")
+
+    def _lock_fd(self, name: str) -> int:
+        fd = self._lock_fds.get(name)
+        if fd is None:
+            fd = os.open(self._lock_file(name),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+            self._lock_fds[name] = fd
+        return fd
+
+    def _expired(self, client_id: str) -> bool:
+        c = self._clients.get(client_id)
+        return c is None or \
+            time.monotonic() - c.last_seen > self.lease_ms / 1000.0
+
+    def _release_locked(self, name: str) -> None:
+        """Drop a grant; caller holds self._mu."""
+        self._grants.pop(name, None)
+        fd = self._lock_fds.get(name)
+        if fd is not None:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+
+    def _h_lock_acquire(self, client_id: str, name: str = "") -> dict:
+        with self._mu:
+            grant = self._grants.get(name)
+            if grant is not None:
+                if grant.client_id == client_id:
+                    return {"granted": True, "token": grant.token}
+                if not self._expired(grant.client_id):
+                    return {"granted": False}
+                # deposed holder: force-release; its token is now stale
+                self._release_locked(name)
+            fd = self._lock_fd(name)
+            try:
+                # non-blocking: a local process (shared-dir sibling or
+                # the leader itself) may hold the kernel lock
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return {"granted": False}
+            token = self._next_token
+            self._next_token += 1
+            self._grants[name] = _Grant(client_id, token)
+            return {"granted": True, "token": token}
+
+    def _h_lock_release(self, client_id: str, name: str = "",
+                        token: int = 0) -> dict:
+        with self._mu:
+            grant = self._grants.get(name)
+            if grant is not None and grant.client_id == client_id \
+                    and grant.token == int(token):
+                self._release_locked(name)
+        return {}  # stale releases are no-ops (lease already reaped)
+
+    def _reaper_loop(self) -> None:
+        """Expire grants whose holder stopped heartbeating — this is
+        what unblocks leader-local mutators stuck in the kernel flock
+        behind a dead remote client."""
+        interval = max(0.1, self.lease_ms / 2000.0)
+        while not self._shutdown.wait(interval):
+            with self._mu:
+                for name, grant in list(self._grants.items()):
+                    if self._expired(grant.client_id):
+                        self._release_locked(name)
+                horizon = time.monotonic() - \
+                    max(10 * self.lease_ms / 1000.0, 30.0)
+                for cid, c in list(self._clients.items()):
+                    if c.last_seen < horizon:
+                        if c.node_fd is not None:
+                            try:
+                                os.close(c.node_fd)  # frees the slot
+                            except OSError:
+                                pass
+                        del self._clients[cid]
+
+    # ---- WAL append/tail ---------------------------------------------------
+    def _wal_size(self) -> int:
+        try:
+            return os.path.getsize(self._wal_path)
+        except OSError:
+            return 0
+
+    def _h_wal_bootstrap(self, client_id: str, offset: int = 0) -> dict:
+        """Initial mirror: the snapshot file (same record format as the
+        WAL; present only when the directory had a pre-shared life),
+        streamed in chunks exactly like wal_tail so neither the snapshot
+        nor the log ever has to fit one frame."""
+        try:
+            with open(self._snap_path, "rb") as f:
+                f.seek(int(offset))
+                snap = f.read(self.tail_chunk)
+                more = bool(snap) and f.read(1) != b""
+        except OSError:
+            snap, more = b"", False
+        return {"snapshot": snap, "more": more,
+                "wal_size": self._wal_size()}
+
+    def _h_wal_tail(self, client_id: str, offset: int = 0,
+                    limit: int = 0) -> dict:
+        """Position-based incremental tail: bytes past `offset`. `more`
+        tells the client whether the file extends past this response —
+        the loop's ONLY termination signal, so server and client need no
+        shared chunk constant. `limit` lets a client outgrow the default
+        chunk when a single record spans it."""
+        n = min(int(limit) or self.tail_chunk, MAX_FRAME - 4096)
+        try:
+            with open(self._wal_path, "rb") as f:
+                f.seek(int(offset))
+                data = f.read(max(n, 1))
+                more = bool(data) and f.read(1) != b""
+        except OSError:
+            data, more = b"", False
+        return {"data": data, "more": more}
+
+    def _h_wal_append(self, client_id: str, seq: int = 0,
+                      expected: int = 0, data: bytes = b"",
+                      token: int = 0) -> dict:
+        seq = int(seq)
+        with self._mu:
+            c = self._clients[client_id]
+            if seq == c.last_seq and c.last_seq_result is not None:
+                # idempotent retry of the in-flight append (the response
+                # was lost, not the write) — reference analog: region
+                # request replay after a recycled connection
+                return {"offset": c.last_seq_result}
+            grant = self._grants.get("mutation")
+            if grant is None or grant.client_id != client_id \
+                    or grant.token != int(token):
+                raise StaleLeaseError(
+                    "wal append fenced: mutation lease "
+                    f"{'lost' if grant is None else 'superseded'} "
+                    f"(token {token})")
+            size = self._wal_size()
+            if int(expected) != size:
+                raise WalOffsetMismatch(
+                    f"append expected WAL at {expected} but file is at "
+                    f"{size}")
+            self._append_f.write(bytes(data))
+            self._append_f.flush()
+            off = size + len(data)
+            c.last_seq = seq
+            c.last_seq_result = off
+            return {"offset": off}
+
+    # ---- node registry + kill mailbox --------------------------------------
+    def _h_node_claim(self, client_id: str) -> dict:
+        from ..store.coordinator import TSO_NODE_SLICES
+        with self._mu:
+            c = self._clients[client_id]
+            if c.node_id is not None:
+                return {"node_id": c.node_id}
+            for nid in range(TSO_NODE_SLICES):
+                fd = os.open(
+                    os.path.join(self.path, "procs", f"node{nid}.lock"),
+                    os.O_CREAT | os.O_RDWR, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    os.close(fd)
+                    continue
+                c.node_id, c.node_fd = nid, fd
+                return {"node_id": nid}
+        raise CodedError("no free node slots in store dir")
+
+    def _h_node_register(self, client_id: str, node_id: int = 0,
+                         port: int = 0, status_port=None) -> dict:
+        import json
+        info = {"pid": -1, "client": client_id, "port": int(port),
+                "status_port": status_port, "started": time.time(),
+                "remote": True}
+        p = os.path.join(self.path, "procs", f"node{int(node_id)}.json")
+        with open(p + ".tmp", "w") as f:
+            json.dump(info, f)
+        os.replace(p + ".tmp", p)
+        return {}
+
+    def _h_servers(self, client_id: str) -> dict:
+        coord = self.storage.coord
+        return {"servers": coord.servers() if coord is not None else {}}
+
+    def _h_kill_post(self, client_id: str, conn_id: int = 0,
+                     query_only: bool = False) -> dict:
+        self.storage.coord.post_kill(int(conn_id), bool(query_only))
+        return {}
+
+    def _h_kill_poll(self, client_id: str, node_id: int = 0,
+                     seq: int = 0) -> dict:
+        seq = int(seq)
+        with self._mu:
+            c = self._clients[client_id]
+            if seq and seq == c.kill_seq and c.kill_result is not None:
+                # retry of a poll that already drained the mailbox (the
+                # response was lost): replay, don't lose the kills
+                return {"kills": c.kill_result}
+        kills = [[local, qo] for local, qo
+                 in self.storage.coord.poll_kills(int(node_id))]
+        with self._mu:
+            c = self._clients[client_id]
+            c.kill_seq, c.kill_result = seq, kills
+        return {"kills": kills}
+
+
+__all__ = ["CoordRPCServer", "TAIL_CHUNK"]

@@ -43,6 +43,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="multi-process mode: coordinate with sibling "
                         "servers sharing --path (flock'd WAL, schema "
                         "reload, cross-server KILL)")
+    p.add_argument("--transport-listen", default=None,
+                   help="store leader: serve the coordination RPC tier "
+                        "(TSO/WAL/KILL) on host:port or unix:/path so "
+                        "followers can join without sharing --path")
+    p.add_argument("--transport-remote", default=None,
+                   help="follower: join the leader at host:port over "
+                        "the socket transport; --path becomes this "
+                        "server's private working dir")
     p.add_argument("--path", default=None,
                    help="durable storage directory (default: in-memory)")
     p.add_argument("--socket", default=None, help="unix socket (unused)")
@@ -103,6 +111,8 @@ def resolve_config(args) -> Config:
          "require_secure_transport"),
         ("proxy_protocol_networks", cfg.security,
          "proxy_protocol_networks"),
+        ("transport_listen", cfg.transport, "listen"),
+        ("transport_remote", cfg.transport, "remote"),
     ]
     dotted = {
         "log_slow_threshold": "log.slow_threshold",
@@ -135,8 +145,20 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     cfg.apply_log_level()
-    storage = Storage(cfg.path or None,
-                      shared=getattr(args, 'shared', False))
+    # transport selection: follower joins a leader over the socket; a
+    # leader additionally serves the coordination RPC tier; otherwise
+    # the local / flock-shared-dir modes (reference: main.go:263 creates
+    # the store from the store-type flag the same way)
+    if cfg.transport.remote:
+        storage = Storage(cfg.path or None, remote=cfg.transport.remote,
+                          rpc_options=cfg.rpc_options())
+    elif cfg.transport.listen:
+        storage = Storage(cfg.path or None, shared=True,
+                          rpc_listen=cfg.transport.listen,
+                          rpc_options=cfg.rpc_options())
+    else:
+        storage = Storage(cfg.path or None,
+                          shared=getattr(args, 'shared', False))
     cfg.seed_sysvars(storage)
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
@@ -159,6 +181,9 @@ def main(argv: list[str] | None = None) -> int:
     storage.maintenance.start()
     print(f"tidb-tpu-server listening on {cfg.host}:{srv.port}",
           flush=True)
+    if storage.rpc_server is not None:
+        print(f"coordination rpc on {storage.rpc_server.address}",
+              flush=True)
 
     done = threading.Event()
 

@@ -36,11 +36,20 @@ class StatusServer:
                     ctype = "text/plain; version=0.0.4"
                 elif self.path == "/status":
                     from . import conn as _conn
-                    body = json.dumps({
+                    status = {
                         "version": _conn.SERVER_VERSION,
                         "connections": outer.sql_server.connection_count()
                         if outer.sql_server else 0,
-                    }).encode()
+                    }
+                    if outer.sql_server is not None:
+                        # multi-process transport health: mode, peer,
+                        # degraded flag, retry counters (reference:
+                        # http_status.go exposes store state the same way)
+                        health = getattr(outer.sql_server.storage,
+                                         "transport_health", None)
+                        if health is not None:
+                            status["transport"] = health()
+                    body = json.dumps(status).encode()
                     ctype = "application/json"
                 elif self.path == "/slow-query":
                     body = json.dumps(server_obs.slow_queries()).encode()

@@ -142,9 +142,12 @@ class SharedDirCoordinator:
             f.write(str(conn_id))
         os.replace(p + ".tmp", p)
 
-    def poll_kills(self) -> list[tuple[int, bool]]:
-        """(local_conn_id, query_only) requests addressed to this node;
-        consumed on read."""
+    def poll_kills(self, node_id: Optional[int] = None
+                   ) -> list[tuple[int, bool]]:
+        """(local_conn_id, query_only) requests addressed to `node_id`
+        (default: this node); consumed on read. The RPC tier polls on
+        behalf of socket followers, so the target node is a parameter."""
+        target = self.node_id if node_id is None else node_id
         out = []
         d = os.path.join(self.path, "kill")
         for name in os.listdir(d):
@@ -155,7 +158,7 @@ class SharedDirCoordinator:
                 nid, local = int(parts[0]), int(parts[1])
             except ValueError:
                 continue
-            if nid != self.node_id:
+            if nid != target:
                 continue
             out.append((local, parts[2] == "q"))
             try:

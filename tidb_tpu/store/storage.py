@@ -72,7 +72,8 @@ _TSO_LEASE_MS = 120_000
 
 class Storage:
     def __init__(self, path: Optional[str] = None,
-                 shared: bool = False) -> None:
+                 shared: bool = False, remote=None,
+                 rpc_listen=None, rpc_options=None) -> None:
         """`path=None`: ephemeral in-memory store (tests, benches).
         `path=dir`: durable — KV WAL+snapshot under dir/kv, columnar epoch
         snapshots under dir/epochs, catalog/stats/DDL state in the meta
@@ -84,16 +85,44 @@ class Storage:
         `shared=True` (requires path): MULTI-PROCESS mode — several
         server processes over one directory, coordinated by
         store/coordinator.py (shared WAL with flock'd mutation sections,
-        cross-process schema reload + fence, node-sliced TSO, kill
-        mailbox). The reference's many-tidb-servers-one-cluster shape."""
+        cross-process schema reload + fence, shared TSO, kill mailbox).
+        The reference's many-tidb-servers-one-cluster shape.
+
+        `rpc_listen='host:port'|'unix:/path'` (leader; implies shared):
+        also serve the coordination services over the socket RPC tier
+        (rpc/server.py) so followers can join WITHOUT sharing the disk.
+
+        `remote='host:port'` (follower): join a leader's cluster over
+        the socket — `path` is this server's PRIVATE working dir (epoch
+        cache/scratch), the KV truth mirrors the leader's WAL via RPC.
+        A `path` of the form 'rpc://host:port' selects this mode with a
+        throwaway working dir (the store-URL shape of the reference's
+        tikv:// store paths, store/store.go)."""
         import os
 
         from ..stats import StatsHandle
 
+        if isinstance(path, str) and path.startswith("rpc://"):
+            remote, path = path[len("rpc://"):], None
+        self._owns_tmp_dir = remote is not None and path is None
+        if self._owns_tmp_dir:
+            import tempfile
+            path = tempfile.mkdtemp(prefix="titpu-follower-")
         self.path = path
-        self.shared = bool(shared and path is not None)
+        self.remote = remote is not None
+        self.shared = bool((shared or self.remote) and path is not None)
         self.coord = None
-        if self.shared:
+        self.rpc_server = None
+        self._rpc_client = None
+        if self.remote:
+            from ..rpc.client import RpcClient, RpcOptions
+            from ..rpc.remote import RemoteCoordinator
+            opts = rpc_options or RpcOptions()
+            self._rpc_client = RpcClient(remote, opts)
+            self._rpc_client.call("hello")  # fail fast on a dead leader
+            self._rpc_client.start_heartbeat()
+            self.coord = RemoteCoordinator(self._rpc_client, opts)
+        elif self.shared:
             from .coordinator import SharedDirCoordinator
             self.coord = SharedDirCoordinator(path)
         self.catalog = Catalog()
@@ -109,7 +138,15 @@ class Storage:
         self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
         # the transactional KV truth: percolator MVCC over regions
-        if self.shared:
+        if self.remote:
+            # socket follower: the engine mirrors the leader's WAL over
+            # RPC; its appends publish through the leased mutation
+            # section (rpc/remote.py)
+            from ..rpc.remote import RemoteKV
+            engine = RemoteKV(self._rpc_client)
+            engine.bootstrap()
+            self.coord.engine = engine
+        elif self.shared:
             # the shared-WAL refresh protocol lives in the Python engine;
             # the flock'd sections make its appends safe cross-process
             from ..kv.mvcc import PyOrderedKV
@@ -118,11 +155,22 @@ class Storage:
             engine = _make_engine(
                 os.path.join(path, "kv") if path is not None else None)
         self.kv = MVCCStore(engine=engine, coord=self.coord)
-        if path is not None and self._tso_lease == 0:
+        if path is not None and self._tso_lease == 0 and not self.remote:
             # lease file missing/corrupt: floor from the largest commit ts
             # in the reopened KV so timestamps still never repeat
             self._tso_lease = self.kv.max_commit_ts()
-        if self.shared:
+        if self.remote:
+            # leader-allocated timestamps (the PD-client role); strict
+            # SI because the ONE leader allocator issues every ts
+            from ..kv.tso import RemoteTSO
+            self.tso = RemoteTSO(
+                self._rpc_client,
+                allow_stale=self._rpc_client.options.stale_reads)
+            # floor the stale-read fallback at the newest replicated
+            # commit: a leader lost right after bootstrap must degrade
+            # to "last replicated state", not to an empty ts-0 snapshot
+            self.tso.observe(self.kv.max_commit_ts())
+        elif self.shared:
             # ONE allocator for every process on this directory — strict
             # SI across servers (the PD TSO role, oracle/oracles/pd.go:77;
             # replaces the round-4 node-sliced oracle whose same-
@@ -159,9 +207,16 @@ class Storage:
         # only (reference: owner/manager.go etcd campaign; the mock at
         # owner/mock.go:35 for single-process; flock for processes
         # sharing this durable directory)
-        from ..owner import owner_manager
-        self.ddl_owner = owner_manager(path, "ddl")
-        self.gc_owner = owner_manager(path, "gc")
+        if self.remote:
+            # owner leases are cluster-wide, so a follower campaigns
+            # through the leader (a local flock would elect everybody)
+            from ..rpc.remote import RemoteOwnerManager
+            self.ddl_owner = RemoteOwnerManager(self._rpc_client, "ddl")
+            self.gc_owner = RemoteOwnerManager(self._rpc_client, "gc")
+        else:
+            from ..owner import owner_manager
+            self.ddl_owner = owner_manager(path, "ddl")
+            self.gc_owner = owner_manager(path, "gc")
         self._commit_lock = threading.RLock()
         # seqlock generation for snapshot/fold consistency: odd while a
         # commit/refresh fold is in flight inside _commit_lock, even when
@@ -185,9 +240,23 @@ class Storage:
         self._seq_lock = threading.Lock()
         if path is not None:
             self._recover()
-            self._extend_tso_lease()
+            if not self.remote:
+                self._extend_tso_lease()
             # persist schema on every catalog version bump from here on
             self.catalog.on_change = lambda: self.persist_catalog()
+        if rpc_listen is not None:
+            # leader: serve TSO/WAL/KILL coordination over the socket
+            # so followers can join without sharing this directory
+            if not self.shared or self.remote:
+                raise ValueError(
+                    "rpc_listen needs shared=True on the store-owning "
+                    "server (a follower cannot re-serve the store)")
+            from ..rpc.client import RpcOptions
+            from ..rpc.server import CoordRPCServer
+            opts = rpc_options or RpcOptions()
+            self.rpc_server = CoordRPCServer(self, listen=rpc_listen,
+                                             lease_ms=opts.lease_ms,
+                                             tail_chunk=opts.tail_chunk)
 
     # ---- schema ------------------------------------------------------------
     def register_table(self, info: TableInfo) -> TableStore:
@@ -268,6 +337,8 @@ class Storage:
         self._tso_lease = lease
 
     def _maybe_extend_lease(self) -> None:
+        if self.remote:
+            return  # the leader persists the TSO horizon
         if self.path is not None and \
                 self.tso.current() >= self._tso_lease - (
                     (_TSO_LEASE_MS // 2) << 18):
@@ -438,7 +509,11 @@ class Storage:
         raw = self.get_meta(b"catalog")
         if raw is None:
             return  # fresh directory
-        self._resolve_orphans()
+        if not self.remote:
+            # a JOINING follower must not touch locks: siblings may have
+            # live transactions (the leader resolved true orphans at its
+            # own startup)
+            self._resolve_orphans()
         state = pickle.loads(raw)
         self.catalog.schemas = state["schemas"]
         self.catalog._next_id = state["next_id"]
@@ -535,12 +610,45 @@ class Storage:
             self._maintenance = MaintenanceWorker(self, self.catalog)
         return self._maintenance
 
+    def transport_health(self) -> dict:
+        """Multi-process transport state for the status port (reference:
+        http_status.go exposes store health the same way)."""
+        if self.remote:
+            h = self._rpc_client.health()
+            h["mode"] = "socket-follower"
+            h["node_id"] = self.coord.node_id
+            return h
+        if self.rpc_server is not None:
+            return {"mode": "socket-leader",
+                    "address": self.rpc_server.address,
+                    "clients": self.rpc_server.client_count()}
+        if self.shared:
+            return {"mode": "shared-dir", "node_id": self.coord.node_id}
+        return {"mode": "local"}
+
     def close(self) -> None:
         if self._maintenance is not None:
             self._maintenance.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.close()
         self.ddl_owner.close()
         self.gc_owner.close()
         if self.path is None:
+            return
+        if self.remote:
+            from ..kv.backoff import BackoffExhausted
+            from ..rpc.errors import RPCError
+            try:
+                # a follower's checkpoint writes through the leader; a
+                # dead leader must not turn shutdown into a hang
+                self.checkpoint()
+            except (RPCError, BackoffExhausted):
+                pass
+            self._rpc_client.close()
+            if self._owns_tmp_dir:
+                # rpc:// shorthand: the throwaway scratch dir is ours
+                import shutil
+                shutil.rmtree(self.path, ignore_errors=True)
             return
         self.checkpoint()
         close = getattr(self.kv.kv, "close", None)
@@ -592,8 +700,22 @@ class Storage:
 
     # ---- transactions ------------------------------------------------------
     def begin(self, pessimistic: bool = False) -> "Transaction":
-        return Transaction(self, self.acquire_snapshot_ts(),
-                           pessimistic=pessimistic)
+        txn = Transaction(self, self.acquire_snapshot_ts(),
+                          pessimistic=pessimistic)
+        # a snapshot ts at/below the oracle's stale watermark was
+        # re-issued while the leader was unreachable: reads are fine
+        # (bounded staleness), writes must fail typed (_check_writable)
+        wm = getattr(self.tso, "stale_watermark", None)
+        txn.degraded = wm is not None and txn.start_ts <= wm
+        return txn
+
+    def _check_writable(self, txn: "Transaction") -> None:
+        if getattr(txn, "degraded", False):
+            from ..rpc.errors import LeaderUnavailable
+            raise LeaderUnavailable(
+                "store leader unreachable: this server is serving "
+                "stale reads only; writes are rejected until the "
+                "leader lease is renewed")
 
     class DeadlockError(CodedError):
         errno = 1213  # ER_LOCK_DEADLOCK
@@ -617,6 +739,7 @@ class Storage:
 
         if not keys:
             return False
+        self._check_writable(txn)
         keys = sorted(keys)
         if txn.pessimistic_primary is None:
             txn.pessimistic_primary = keys[0]
@@ -676,6 +799,8 @@ class Storage:
         region tier -> columnar fold. One source of truth (the KV write
         records), one fold (the epochs the coprocessor reads)."""
         mutations = txn.memdb.mutations()
+        if mutations:
+            self._check_writable(txn)
         if not mutations:
             if txn.locked_keys:
                 # lock-only txn (SELECT FOR UPDATE with no writes): the
@@ -1022,7 +1147,10 @@ class Storage:
         retriable = name != b"catalog"
         bo = Backoffer(budget_ms=2000)
         while True:
-            start_ts = self.tso.next_ts()
+            # .ts() is the STRICT allocator interface: on a degraded
+            # follower it raises typed instead of re-issuing a stale
+            # timestamp that a WRITE would then carry
+            start_ts = self.tso.ts()
             try:
                 with self._commit_lock:
                     self.committer.commit(
@@ -1136,6 +1264,9 @@ class Transaction:
         # table_id -> schema_token observed at first buffered write
         self.schema_tokens: dict[int, int] = {}
         self.pessimistic = pessimistic
+        # set by Storage.begin: ts re-issued while the leader was
+        # unreachable — transaction may read (stale) but never write
+        self.degraded = False
         self.for_update_ts = start_ts
         self.pessimistic_primary: Optional[bytes] = None
         self.locked_keys: set[bytes] = set()
