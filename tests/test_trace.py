@@ -1,0 +1,361 @@
+"""Query tracing + dispatch-stage profiling surface.
+
+Covers the TRACE span tree's dispatch stages (reference:
+executor/trace.go), EXPLAIN ANALYZE's per-node stage breakdown
+(util/execdetails), the @@profiling sampling profiler lifecycle
+(util/profile), the /debug status routes, and metric hygiene for the
+per-stage histograms.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tidb_tpu import obs
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+from testkit import TestKit
+
+
+def _q6_kit() -> TestKit:
+    """A TPC-H Q6-shaped corpus: filter + scalar agg over arithmetic."""
+    tk = TestKit()
+    tk.must_exec("create table lineitem (l_orderkey int primary key, "
+                 "l_quantity int, l_extendedprice int, l_discount int)")
+    rows = ",".join(f"({i},{i % 50},{100 + i},{i % 10})"
+                    for i in range(1, 201))
+    tk.must_exec(f"insert into lineitem values {rows}")
+    return tk
+
+
+Q6 = ("select sum(l_extendedprice * l_discount) from lineitem "
+      "where l_quantity < 24 and l_discount >= 1 and l_discount <= 6")
+
+
+def _parse_stages(s: str) -> dict[str, float]:
+    """'staging:0.2ms kernel:1.5ms' -> {'staging': 0.0002, ...}."""
+    out = {}
+    for part in (s or "").split():
+        k, _, v = part.partition(":")
+        out[k] = float(v.removesuffix("ms")) / 1e3
+    return out
+
+
+# ==================== TRACE ====================
+
+def test_trace_q6_dispatch_stages():
+    tk = _q6_kit()
+    tk.must_query(Q6)  # warm: compile + staging caches
+    rows = tk.must_query("trace " + Q6)
+    ops = [r[0].strip() for r in rows]
+    # the dispatch path is split into named stage spans
+    assert any(o.startswith("copr.staging") for o in ops)
+    assert any(o.startswith("device.dispatch") for o in ops)
+    assert any(o.startswith("device.fetch") for o in ops)
+    assert any(o.startswith("planner.optimize") for o in ops)
+    # spans nest: every child start+duration fits inside session.run
+    root = rows[0]
+    assert root[0] == "session.run"
+    for r in rows:
+        if r[1] is not None and r[2] is not None:
+            assert r[1] + r[2] <= root[2] + 1.0  # ms, rounding slack
+
+
+def test_trace_stage_sum_matches_explain_analyze_wall():
+    """The named dispatch stages account for the query's wall time:
+    their (exclusive, additive) sum is bounded by — and a substantial
+    fraction of — the root node's EXPLAIN ANALYZE time."""
+    tk = _q6_kit()
+    tk.must_query(Q6)  # warm
+    rs = tk.session.execute("explain analyze " + Q6)
+    assert rs.column_names == ["plan", "actRows", "time_ms", "engine",
+                               "stages"]
+    root = rs.rows[0]
+    leaf = next(r for r in rs.rows if "TableRead" in r[0])
+    assert "device" in leaf[3]
+    stages = _parse_stages(leaf[4])
+    for want in ("staging", "kernel", "device_get"):
+        assert want in stages, (want, stages)
+    wall_s = root[2] / 1e3
+    total = sum(stages.values())
+    # exclusive accounting: never more than the wall (plus rounding);
+    # and the stages must explain a real fraction of it
+    assert total <= wall_s * 1.10 + 1e-3
+    assert total >= wall_s * 0.10
+
+
+def test_trace_span_cap_bounds_the_tree():
+    tk = _q6_kit()
+    tk.must_exec("set tidb_trace_span_cap = 4")
+    rows = tk.must_query("trace " + Q6)
+    # plan rows ride along, but the span tree itself stayed bounded
+    span_rows = [r for r in rows if r[1] is not None]
+    assert len(span_rows) <= 4
+    assert "dropped at cap" in rows[0][0]
+
+
+def test_trace_served_on_debug_route_ring():
+    tk = _q6_kit()
+    tk.session.conn_id = 42
+    tk.must_query("trace " + Q6)
+    tr = tk.session.storage.obs.trace_for(42)
+    assert tr is not None
+    assert tr["spans"][0][0] == "session.run"
+    assert tk.session.storage.obs.trace_for(99999) is None
+
+
+def test_tracing_disabled_allocates_no_spans(monkeypatch):
+    """The hot path must not build Span objects when no TRACE is
+    active — stage()/span() only pay a TLS read + histogram update."""
+    tk = _q6_kit()
+    tk.must_query(Q6)  # warm compile first
+
+    made: list[str] = []
+    orig = obs.Span.__init__
+
+    def counting(self, name, start):
+        made.append(name)
+        orig(self, name, start)
+
+    monkeypatch.setattr(obs.Span, "__init__", counting)
+    tk.must_query(Q6)
+    assert made == []
+    # and with TRACE active the same statement does build spans
+    tk.must_query("trace " + Q6)
+    assert made
+
+
+# ==================== sampling profiler ====================
+
+def _profiler_threads() -> list[threading.Thread]:
+    return [t for t in threading.enumerate()
+            if t.name == "tidb-tpu-profiler" and t.is_alive()]
+
+
+def test_profiler_lifecycle_no_leaked_thread():
+    tk = _q6_kit()
+    assert tk.must_query("show profiles") == []
+    tk.must_exec("set profiling = 1")
+    tk.must_exec("set tidb_profiler_sample_hz = 400")
+    tk.must_query(Q6)
+    tk.must_query("select count(*) from lineitem")
+    tk.must_exec("set profiling = 0")
+    assert _profiler_threads() == []  # stop() joined every sampler
+    profiles = tk.must_query("show profiles")
+    assert len(profiles) == 2
+    assert profiles[0][0] == 1 and profiles[1][0] == 2
+    assert "sum(l_extendedprice" in profiles[0][2]
+    assert all(p[1] > 0 for p in profiles)
+    # profiling off: no new entries
+    tk.must_query(Q6)
+    assert len(tk.must_query("show profiles")) == 2
+
+
+def test_profiler_history_size_trims_ring():
+    tk = _q6_kit()
+    tk.must_exec("set profiling = 1")
+    tk.must_exec("set profiling_history_size = 3")
+    for _ in range(5):
+        tk.must_query("select count(*) from lineitem")
+    tk.must_exec("set profiling = 0")
+    profiles = tk.must_query("show profiles")
+    assert len(profiles) == 3
+    assert [p[0] for p in profiles] == [3, 4, 5]  # oldest evicted
+
+
+def test_show_profile_names_host_frames():
+    """A host-heavy statement's profile names engine-side frames."""
+    tk = _q6_kit()
+    tk.must_exec("set profiling = 1")
+    tk.must_exec("set tidb_profiler_sample_hz = 997")
+    # host-tier work: string group keys force the numpy fallback path,
+    # and 40k generated rows keep the statement on-CPU long enough to
+    # catch samples at ~1kHz
+    tk.must_exec("create table h (a int primary key, b int)")
+    rows = ",".join(f"({i},{i % 97})" for i in range(4000))
+    tk.must_exec(f"insert into h values {rows}")
+    tk.must_query("select b, count(*) from h group by b order by b")
+    tk.must_exec("set profiling = 0")
+    rows = tk.must_query("show profile")
+    assert rows, "profiler captured no frames"
+    frames = " ".join(r[0] for r in rows)
+    if "no samples" not in frames:
+        # host-tier hot frames are attributable to real code locations
+        assert "(" in frames and ".py:" in frames
+        assert all(r[2] >= 0 for r in rows)
+    # SHOW PROFILE FOR QUERY n addresses one ring entry
+    qid = tk.must_query("show profiles")[-1][0]
+    assert tk.must_query(f"show profile for query {qid}") is not None
+    with pytest.raises(Exception, match="no profile"):
+        tk.must_query("show profile for query 9999")
+
+
+def test_information_schema_profiling_rows():
+    tk = _q6_kit()
+    tk.must_exec("set profiling = 1")
+    tk.must_exec("set tidb_profiler_sample_hz = 400")
+    tk.must_query(Q6)
+    tk.must_exec("set profiling = 0")
+    rows = tk.must_query(
+        "select query_id, seq, state, duration, samples "
+        "from information_schema.profiling")
+    # fast statements can land between ticks; the ring entry still
+    # exists, rows appear when samples were caught
+    for qid, seq, state, duration, samples in rows:
+        assert qid == 1 and seq >= 1 and samples >= 0
+        assert isinstance(state, str) and state
+
+
+def test_profile_tree_rows_aggregation():
+    p = obs.Profile({("a (x.py:1)", "b (x.py:2)"): 3,
+                     ("a (x.py:1)", "c (x.py:3)"): 1}, hz=100.0,
+                    duration_s=0.04)
+    rows = p.tree_rows()
+    assert rows[0][0] == "a (x.py:1)" and rows[0][2] == 4
+    assert rows[1][0] == "  b (x.py:2)" and rows[1][2] == 3
+    assert p.hot_frames()[0] == ("b (x.py:2)", 3)
+    assert p.total_samples == 4
+
+
+# ==================== slow log breakdown ====================
+
+def test_slow_log_carries_digest_and_stages():
+    tk = _q6_kit()
+    tk.must_exec("set tidb_slow_log_threshold = 0")
+    tk.must_query(Q6)
+    tk.must_exec("set tidb_slow_log_threshold = 100000")
+    rs = tk.session.execute("show slow queries")
+    assert rs.column_names == ["Time", "DB", "Duration_ms", "Query",
+                               "Plan_digest", "Stages"]
+    ent = next(r for r in rs.rows if "l_extendedprice" in r[3])
+    assert len(ent[4]) == 32  # digest joins against statements_summary
+    digests = {r[0] for r in tk.must_query(
+        "select digest from information_schema.statements_summary")}
+    assert ent[4] in digests
+    stages = _parse_stages(ent[5])
+    assert "kernel" in stages and "staging" in stages
+    # the JSON surface carries the same fields
+    raw = tk.session.storage.obs.slow_queries()
+    e = next(e for e in raw if "l_extendedprice" in e["sql"])
+    assert e["plan_digest"] == ent[4]
+    assert "kernel" in e["stages"]
+    # information_schema.slow_query exposes them to SQL too
+    rows = tk.must_query(
+        "select plan_digest, stages from information_schema.slow_query "
+        "where query like '%l_extendedprice%'")
+    assert rows and rows[0][0] == ent[4]
+
+
+# ==================== metric hygiene ====================
+
+def test_every_metric_family_has_tidb_prefix():
+    tk = _q6_kit()
+    tk.must_query(Q6)
+    for reg in (tk.session.storage.obs.metrics, obs.PROCESS_METRICS):
+        for fam in reg.families():
+            assert fam.startswith("tidb_"), fam
+        for line in reg.render().splitlines():
+            if line and not line.startswith("#"):
+                assert line.startswith("tidb_"), line
+
+
+def test_histogram_text_format_order_and_labels():
+    tk = _q6_kit()
+    tk.must_query(Q6)
+    text = (tk.session.storage.obs.render()
+            + obs.PROCESS_METRICS.render())
+    lines = text.splitlines()
+    hist_fams = [ln.split()[2] for ln in lines
+                 if ln.startswith("# TYPE") and ln.endswith("histogram")]
+    assert "tidb_dispatch_stage_duration_seconds" in hist_fams
+    for fam in hist_fams:
+        fam_lines = [ln for ln in lines
+                     if ln.startswith(fam) and not ln.startswith("#")]
+        assert fam_lines, fam
+        # per series: ascending le buckets, +Inf == count, then
+        # _sum and _count (prometheus text-format order)
+        i = 0
+        while i < len(fam_lines):
+            assert fam_lines[i].startswith(fam + "_bucket{le="), \
+                fam_lines[i]
+            prev = -1.0
+            while "+Inf" not in fam_lines[i]:
+                le = float(fam_lines[i].split('le="')[1].split('"')[0])
+                assert le > prev
+                prev = le
+                i += 1
+            inf_count = int(fam_lines[i].split()[-1])
+            i += 1
+            assert fam_lines[i].startswith(fam + "_sum")
+            i += 1
+            assert fam_lines[i].startswith(fam + "_count")
+            assert int(fam_lines[i].split()[-1]) == inf_count
+            i += 1
+
+
+def test_sub_millisecond_buckets_exist():
+    b = obs.Histogram.BUCKETS
+    assert b[0] <= 1e-5 and 0.0001 in b and 0.0005 in b
+    assert list(b) == sorted(b)
+    # a 50µs observation is distinguishable from a 500µs one
+    h = obs.Histogram("tidb_x", "")
+    h.observe(0.00005)
+    h.observe(0.0005)
+    counts, _, total = h.snapshot()
+    assert total == 2 and counts[b.index(0.00005)] == 1
+
+
+def test_duplicate_registration_type_mismatch_raises():
+    r = obs.Registry()
+    r.counter("tidb_thing_total")
+    with pytest.raises(TypeError):
+        r.histogram("tidb_thing_total")
+    # same-type re-registration returns the same instance
+    assert r.counter("tidb_thing_total") is r.counter("tidb_thing_total")
+
+
+def test_dispatch_stage_cache_counters_move():
+    tk = _q6_kit()
+    base_hit = obs.JIT_CACHE.get(result="hit")
+    base_miss = obs.JIT_CACHE.get(result="miss")
+    tk.must_query(Q6)
+    assert obs.JIT_CACHE.get(result="miss") > base_miss
+    tk.must_query(Q6)
+    assert obs.JIT_CACHE.get(result="hit") > base_hit
+    assert (obs.COL_CACHE.get(result="hit")
+            + obs.COL_CACHE.get(result="miss")) > 0
+
+
+# ==================== /debug status routes ====================
+
+def test_debug_routes_trace_and_profile():
+    import json
+    import urllib.request
+
+    from tidb_tpu.server.server import Server
+
+    storage = Storage()
+    srv = Server(storage, host="127.0.0.1", port=0, status_port=0)
+    srv.start()
+    try:
+        s = Session(storage)
+        s.conn_id = 5
+        s.execute("create table d (a int primary key)")
+        s.execute("insert into d values (1),(2)")
+        base = f"http://127.0.0.1:{srv.status_port}"
+        with pytest.raises(Exception):
+            urllib.request.urlopen(base + "/debug/trace/5", timeout=10)
+        s.execute("trace select count(*) from d")
+        tr = json.loads(urllib.request.urlopen(
+            base + "/debug/trace/5", timeout=10).read())
+        assert tr["spans"][0][0] == "session.run"
+        prof = json.loads(urllib.request.urlopen(
+            base + "/debug/profile?seconds=0.1&hz=200",
+            timeout=10).read())
+        assert prof["hz"] == 200 and "tree" in prof
+        assert _profiler_threads() == []
+    finally:
+        srv.close()

@@ -96,6 +96,16 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("time", _vc(20)), ("db", _vc()),
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)),
+        ("plan_digest", _vc(32)), ("stages", _vc(256)),
+    ],
+    # per-statement sampling-profiler frames of THIS session's
+    # @@profiling ring (reference: INFORMATION_SCHEMA.PROFILING fed by
+    # the session profile history)
+    "profiling": [
+        ("query_id", _bigint()), ("seq", _bigint()),
+        ("state", _vc(256)),
+        ("duration", FieldType(TypeKind.DOUBLE)),
+        ("samples", _bigint()),
     ],
     "key_column_usage": [
         ("constraint_catalog", _vc()), ("constraint_schema", _vc()),
@@ -317,8 +327,17 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                 round(e["max_latency_ms"], 3), e["sum_rows"],
                 e["first_seen"], e["last_seen"]])
     elif tname == "slow_query":
+        from .. import obs as _obs
         for e in storage.obs.slow_queries():
-            rows.append([e["ts"], e["db"], e["duration_ms"], e["sql"]])
+            rows.append([e["ts"], e["db"], e["duration_ms"], e["sql"],
+                         e.get("plan_digest", ""),
+                         _obs.fmt_stages_ms(e.get("stages"))])
+    elif tname == "profiling":
+        for p in (getattr(viewer, "_profiles", None) or []):
+            prof = p["profile"]
+            for seq, (frame, secs, samples) in enumerate(
+                    prof.tree_rows(), 1):
+                rows.append([p["query_id"], seq, frame, secs, samples])
     elif tname == "processlist":
         provider = getattr(storage, "processlist", None)
         plist = list(provider()) if provider is not None else []

@@ -46,6 +46,8 @@ class PerformanceConfig:
     txn_total_size_limit: int = 100 * 1024 * 1024
     stats_lease: str = "3s"
     tile_rows: int = 1 << 22              # device tile granularity
+    profiler_sample_hz: int = 97          # @@profiling / /debug/profile
+    trace_span_cap: int = 4096            # TRACE drops spans past this
 
 
 @dataclass
@@ -160,6 +162,10 @@ class Config:
             raise ConfigError(f"unknown log level {self.log.level!r}")
         if self.performance.mem_quota_query < 0:
             raise ConfigError("mem-quota-query must be >= 0")
+        if self.performance.profiler_sample_hz < 1:
+            raise ConfigError("profiler-sample-hz must be >= 1")
+        if self.performance.trace_span_cap < 16:
+            raise ConfigError("trace-span-cap must be >= 16")
         t = self.transport
         if t.listen and t.remote:
             raise ConfigError(
@@ -242,6 +248,10 @@ class Config:
                               self.gc.run_interval)
         sv.set_config_default("tidb_tile_rows", self.performance.tile_rows)
         sv.set_config_default("max_connections", self.max_connections)
+        sv.set_config_default("tidb_profiler_sample_hz",
+                              self.performance.profiler_sample_hz)
+        sv.set_config_default("tidb_trace_span_cap",
+                              self.performance.trace_span_cap)
 
 
 class _TomlError(Exception):
@@ -359,6 +369,8 @@ mem-quota-query = 1073741824   # per-query working-set budget (bytes)
 txn-total-size-limit = 104857600
 stats-lease = "3s"
 tile-rows = 4194304            # device tile granularity (rows)
+profiler-sample-hz = 97        # @@profiling / /debug/profile tick rate
+trace-span-cap = 4096          # TRACE drops spans past this cap
 
 [plan-cache]
 enabled = true

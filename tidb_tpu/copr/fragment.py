@@ -233,23 +233,25 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
             raise _Fallback("having-unordered")
 
     # ---- staging ----
+    from .. import obs
     builds = []
-    for ji, j in enumerate(frag.joins):
-        t = frag.tables[j.build]
-        snap = snaps[t.table.id]
-        lo, span = spans[ji]
-        if ji == part_ji:
-            builds.append(cop._stage_partitioned_build(
-                t, snap, lo, span, j))
-            continue
-        cols, vis, host_cols, host_mask = cop._stage_build_table(
-            _facade_dag(t), snap)
-        key_off = t.col_offsets[j.build_key_local]
-        perm = _perm_array(cop, snap, key_off, lo, span, host_mask)
-        perm = cop._place_build_array(
-            perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo, span,
-                       _mask_digest_of(host_mask)))
-        builds.append({"cols": cols, "vis": vis, "perm": perm})
+    with obs.stage("staging", span_name="copr.staging"):
+        for ji, j in enumerate(frag.joins):
+            t = frag.tables[j.build]
+            snap = snaps[t.table.id]
+            lo, span = spans[ji]
+            if ji == part_ji:
+                builds.append(cop._stage_partitioned_build(
+                    t, snap, lo, span, j))
+                continue
+            cols, vis, host_cols, host_mask = cop._stage_build_table(
+                _facade_dag(t), snap)
+            key_off = t.col_offsets[j.build_key_local]
+            perm = _perm_array(cop, snap, key_off, lo, span, host_mask)
+            perm = cop._place_build_array(
+                perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo,
+                           span, _mask_digest_of(host_mask)))
+            builds.append({"cols": cols, "vis": vis, "perm": perm})
 
     chunks: list[Chunk] = []
     if psnap.epoch.num_rows > 0:
@@ -336,18 +338,21 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
             psnap.epoch.num_rows > cop.TILE_ROWS:
         return _run_frag_tiled(cop, frag, snaps, prepared, spans, builds,
                                mode)
-    pcols, pvis, phost, phost_mask = cop._stage_inputs(
-        _facade_dag(probe), psnap, overlay=overlay)
-    # single-device epoch batches swap the in-kernel perm gathers for
-    # epoch-cached ALIGNED build columns (see _stage_aligned): the first
-    # query against an epoch pays the gathers once; every later fragment
-    # query over the same epochs is pure elementwise + MXU work
-    kern_builds = builds
-    if builds and not overlay and \
-            getattr(cop, "frag_axis", None) is None and \
-            prepared.get("__part_join__") is None:
-        kern_builds = _stage_aligned(cop, frag, snaps, prepared, spans,
-                                     builds, pcols)
+    from .. import obs
+    with obs.stage("staging", span_name="copr.staging"):
+        pcols, pvis, phost, phost_mask = cop._stage_inputs(
+            _facade_dag(probe), psnap, overlay=overlay)
+        # single-device epoch batches swap the in-kernel perm gathers
+        # for epoch-cached ALIGNED build columns (see _stage_aligned):
+        # the first query against an epoch pays the gathers once; every
+        # later fragment query over the same epochs is pure elementwise
+        # + MXU work
+        kern_builds = builds
+        if builds and not overlay and \
+                getattr(cop, "frag_axis", None) is None and \
+                prepared.get("__part_join__") is None:
+            kern_builds = _stage_aligned(cop, frag, snaps, prepared,
+                                         spans, builds, pcols)
 
     aux = None
     if mode == "hc" and not overlay and \
@@ -363,8 +368,11 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     kern = cop._kernel(key, lambda: cop._frag_jit(
         _build_frag_kernel(frag, prepared, spans, mode, raw=True, cop=cop),
         mode, prepared))
-    out = jax.device_get(kern(pcols, pvis, kern_builds) if aux is None
-                         else kern(pcols, pvis, kern_builds, aux))
+    with obs.stage("kernel", span_name="device.dispatch"):
+        dev = kern(pcols, pvis, kern_builds) if aux is None \
+            else kern(pcols, pvis, kern_builds, aux)
+    with obs.stage("device_get", span_name="device.fetch"):
+        out = jax.device_get(dev)
 
     if mode == "hc":
         # candidate blocks = exchange partitions (1 on a single device)
@@ -390,17 +398,20 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
     like the single-table tiled path (client._merge_tile_outs)."""
     from .client import _merge_tile_outs
 
+    from .. import obs
     probe = frag.tables[0]
     psnap = snaps[probe.table.id]
-    tiles = cop._stage_tiles(_facade_dag(probe), psnap)
+    with obs.stage("staging", span_name="copr.staging"):
+        tiles = cop._stage_tiles(_facade_dag(probe), psnap)
     bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
     kern = None
     devs = []
     for ti, (cols, vis, cnt) in enumerate(tiles):
         kb = builds
         if builds:
-            kb = _stage_aligned(cop, frag, snaps, prepared, spans,
-                                builds, cols, tag=("tile", ti))
+            with obs.stage("staging", span_name="copr.staging"):
+                kb = _stage_aligned(cop, frag, snaps, prepared, spans,
+                                    builds, cols, tag=("tile", ti))
         if kern is None:
             key = ("frag", _frag_key(frag), _sig(prepared), mode, bucket,
                    tuple(
@@ -412,8 +423,10 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
                                    cop=cop), mode, prepared))
         from ..util import interrupt
         interrupt.check()
-        devs.append(kern(cols, vis, kb))
-    outs = jax.device_get(devs)
+        with obs.stage("kernel", span_name="device.dispatch"):
+            devs.append(kern(cols, vis, kb))
+    with obs.stage("device_get", span_name="device.fetch"):
+        outs = jax.device_get(devs)
 
     if mode == "agg":
         out = _merge_tile_outs(outs, prepared["__agg_sched__"])

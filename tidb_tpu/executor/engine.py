@@ -128,11 +128,19 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
     if ctx.stats is not None:
         import time as _time
 
+        from .. import obs
+
+        # attribute dispatch-stage time (staging/compile/transfer/
+        # kernel/device_get/host_fallback) to this node, INCLUSIVE of
+        # children — same convention as the node wall time
+        rec = obs.active_stage_recorder()
+        before = rec.snapshot() if rec is not None else None
         t0 = _time.perf_counter()
         engine_tag = [None]
         chunk = _run_node(plan, ctx, engine_tag)
+        stages = rec.delta_since(before) if rec is not None else None
         ctx.stats.record(plan, _time.perf_counter() - t0, chunk.num_rows,
-                         engine_tag[0])
+                         engine_tag[0], stages=stages)
         return chunk
     return _run_node(plan, ctx, None)
 

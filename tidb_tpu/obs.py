@@ -46,32 +46,58 @@ class Counter:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram (Prometheus-style cumulative)."""
+    """Fixed-bucket latency histogram (Prometheus-style cumulative).
 
-    BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
-    __slots__ = ("name", "help", "_counts", "_sum", "_total", "_lock")
+    Optionally labeled: `observe(v, stage="compile")` keeps one bucket
+    series per label set (reference: prometheus HistogramVec). The
+    sub-millisecond buckets exist because dispatch stages (column-cache
+    hits, jit-cache hits, staging of small epochs) live in the
+    10µs–1ms range — with a 1ms floor they all collapse into bucket 0
+    and the histogram says nothing."""
+
+    BUCKETS = (0.00001, 0.00005, 0.0001, 0.00025, 0.0005,
+               0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+    __slots__ = ("name", "help", "_series", "_lock")
 
     def __init__(self, name: str, help_: str) -> None:
         self.name = name
         self.help = help_
-        self._counts = [0] * (len(self.BUCKETS) + 1)
-        self._sum = 0.0
-        self._total = 0
+        # label tuple -> [counts list, sum, total]
+        self._series: dict[tuple, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._sum += v
-            self._total += 1
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [
+                    [0] * (len(self.BUCKETS) + 1), 0.0, 0]
+            s[1] += v
+            s[2] += 1
             for i, b in enumerate(self.BUCKETS):
                 if v <= b:
-                    self._counts[i] += 1
+                    s[0][i] += 1
                     return
-            self._counts[-1] += 1
+            s[0][-1] += 1
 
-    def snapshot(self):
+    def snapshot(self, **labels):
+        """(counts, sum, total) for one label set (default: unlabeled)."""
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            return list(self._counts), self._sum, self._total
+            s = self._series.get(key)
+            if s is None:
+                return [0] * (len(self.BUCKETS) + 1), 0.0, 0
+            return list(s[0]), s[1], s[2]
+
+    def series(self):
+        with self._lock:
+            if not self._series:
+                # a never-observed histogram still renders its (zero)
+                # unlabeled series, like a prometheus client would
+                return [((), [0] * (len(self.BUCKETS) + 1), 0.0, 0)]
+            return [(key, list(s[0]), s[1], s[2])
+                    for key, s in sorted(self._series.items())]
 
 
 class Registry:
@@ -85,7 +111,11 @@ class Registry:
             if m is None:
                 m = Counter(name, help_)
                 self._metrics[name] = m
-            return m  # type: ignore[return-value]
+            elif not isinstance(m, Counter):
+                raise TypeError(
+                    f"metric {name} already registered as "
+                    f"{type(m).__name__}")
+            return m
 
     def histogram(self, name: str, help_: str = "") -> Histogram:
         with self._lock:
@@ -93,7 +123,15 @@ class Registry:
             if m is None:
                 m = Histogram(name, help_)
                 self._metrics[name] = m
-            return m  # type: ignore[return-value]
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name} already registered as "
+                    f"{type(m).__name__}")
+            return m
+
+    def families(self) -> list[str]:
+        with self._lock:
+            return list(self._metrics)
 
     def render(self) -> str:
         """Prometheus text exposition format."""
@@ -109,16 +147,21 @@ class Registry:
                     out.append(f"{m.name}{{{lbl}}} {v:g}" if lbl
                                else f"{m.name} {v:g}")
             else:
-                counts, total_sum, total = m.snapshot()
                 out.append(f"# HELP {m.name} {m.help}")
                 out.append(f"# TYPE {m.name} histogram")
-                acc = 0
-                for b, c in zip(m.BUCKETS, counts):
-                    acc += c
-                    out.append(f'{m.name}_bucket{{le="{b}"}} {acc}')
-                out.append(f'{m.name}_bucket{{le="+Inf"}} {total}')
-                out.append(f"{m.name}_sum {total_sum:g}")
-                out.append(f"{m.name}_count {total}")
+                for key, counts, total_sum, total in m.series():
+                    extra = "".join(f',{k}="{val}"' for k, val in key)
+                    acc = 0
+                    for b, c in zip(m.BUCKETS, counts):
+                        acc += c
+                        out.append(
+                            f'{m.name}_bucket{{le="{b}"{extra}}} {acc}')
+                    out.append(
+                        f'{m.name}_bucket{{le="+Inf"{extra}}} {total}')
+                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                    sfx = f"{{{lbl}}}" if lbl else ""
+                    out.append(f"{m.name}_sum{sfx} {total_sum:g}")
+                    out.append(f"{m.name}_count{sfx} {total}")
         return "\n".join(out) + "\n"
 
 
@@ -229,14 +272,23 @@ class Observability:
         self._slow_log: deque = deque(maxlen=SLOW_LOG_MAX)
         self._slow_lock = threading.Lock()
         self.statements = StatementsSummary()
+        # conn_id -> last TRACE span tree (served by /debug/trace/<id>)
+        self._traces: dict[int, dict] = {}
 
-    def record_slow(self, sql: str, db: str, duration_s: float) -> None:
+    def record_slow(self, sql: str, db: str, duration_s: float,
+                    plan_digest: str = "",
+                    stages: Optional[dict[str, float]] = None) -> None:
         self.slow_counter.inc()
         ent = {
             "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
             "db": db,
             "duration_ms": round(duration_s * 1e3, 1),
             "sql": sql if len(sql) <= 4096 else sql[:4096] + "...",
+            # plan digest + per-stage dispatch breakdown (reference:
+            # LogSlowQuery's Plan_digest and execution-detail durations)
+            "plan_digest": plan_digest,
+            "stages": {k: round(v * 1e3, 3)
+                       for k, v in (stages or {}).items()},
         }
         with self._slow_lock:
             self._slow_log.append(ent)
@@ -248,11 +300,29 @@ class Observability:
         with self._slow_lock:
             return list(self._slow_log)
 
+    def record_trace(self, conn_id: int, rows: list) -> None:
+        """Keep the last TRACE span tree per connection so the status
+        port can serve it (/debug/trace/<conn_id>)."""
+        with self._slow_lock:
+            # re-insert so eviction order is least-recently-TRACEd
+            self._traces.pop(conn_id, None)
+            self._traces[conn_id] = {
+                "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+                "spans": [list(r) for r in rows],
+            }
+            while len(self._traces) > TRACE_RING_MAX:
+                self._traces.pop(next(iter(self._traces)))
+
+    def trace_for(self, conn_id: int) -> Optional[dict]:
+        with self._slow_lock:
+            return self._traces.get(conn_id)
+
     def render(self) -> str:
         return self.metrics.render()
 
 
 SLOW_LOG_MAX = 512
+TRACE_RING_MAX = 64
 DEFAULT_SLOW_THRESHOLD_MS = 300
 
 # process-wide default instance: code without a Storage in reach
@@ -276,6 +346,19 @@ COPR_REQUESTS = PROCESS_METRICS.counter(
 FRAG_FALLBACKS = PROCESS_METRICS.counter(
     "tidb_copr_fragment_fallbacks_total",
     "device-fragment gate rejections, by reason")
+DISPATCH_STAGE_SECONDS = PROCESS_METRICS.histogram(
+    "tidb_dispatch_stage_duration_seconds",
+    "per-stage dispatch wall time (staging, compile, transfer, kernel, "
+    "device_get, host_fallback), labeled by stage")
+COL_CACHE = PROCESS_METRICS.counter(
+    "tidb_copr_column_cache_total",
+    "device column-staging cache lookups, by result (hit / miss)")
+JIT_CACHE = PROCESS_METRICS.counter(
+    "tidb_copr_jit_cache_total",
+    "compiled-kernel cache lookups, by result (hit / miss)")
+PROFILER_SAMPLES = PROCESS_METRICS.counter(
+    "tidb_profiler_samples_total",
+    "stack samples taken by the host sampling profiler")
 
 
 # ---- cross-layer span trees (TRACE) -----------------------------------------
@@ -295,6 +378,8 @@ class Span:
 
 _span_tls = threading.local()
 
+TRACE_SPAN_CAP = 4096  # default; sessions override via tidb_trace_span_cap
+
 
 class SpanCollector:
     """Hierarchical span collection across layers (reference:
@@ -304,12 +389,30 @@ class SpanCollector:
 
     Activation is thread-local and scoped: when no collector is active,
     `span()` is a no-op `yield`, so the production path pays one TLS
-    read per instrumented site."""
+    read per instrumented site.
 
-    def __init__(self, name: str = "trace") -> None:
+    Bounded: once `cap` spans have been opened further spans are
+    dropped (count only), so a pathological statement cannot OOM the
+    tracer. The count is lock-guarded so worker threads that inherit
+    the collector stay safe."""
+
+    def __init__(self, name: str = "trace",
+                 cap: Optional[int] = None) -> None:
         self.t0 = time.perf_counter()
         self.root = Span(name, 0.0)
         self._stack = [self.root]
+        self.cap = cap if cap is not None else TRACE_SPAN_CAP
+        self.count = 1
+        self.dropped = 0
+        self._lock = threading.Lock()
+
+    def _admit(self) -> bool:
+        with self._lock:
+            if self.count >= self.cap:
+                self.dropped += 1
+                return False
+            self.count += 1
+            return True
 
     def __enter__(self) -> "SpanCollector":
         _span_tls.coll = self
@@ -317,6 +420,8 @@ class SpanCollector:
 
     def __exit__(self, *exc) -> None:
         self.root.end = time.perf_counter() - self.t0
+        if self.dropped:
+            self.root.note = f"{self.dropped} span(s) dropped at cap"
         _span_tls.coll = None
 
     def rows(self) -> list[tuple]:
@@ -345,7 +450,7 @@ class _SpanCtx:
 
     def __enter__(self) -> Optional[Span]:
         c = self.coll
-        if c is None:
+        if c is None or not c._admit():
             return None
         self.sp = Span(self.name, time.perf_counter() - c.t0)
         c._stack[-1].children.append(self.sp)
@@ -365,35 +470,307 @@ def span(name: str) -> _SpanCtx:
     return _SpanCtx(name)
 
 
+# ---- dispatch-stage accounting ----------------------------------------------
+
+_stage_tls = threading.local()
+
+
+class StageRecorder:
+    """Per-statement dispatch-stage durations, EXCLUSIVE of nested
+    stages: a stage's recorded time is its wall time minus the wall
+    time of stages opened inside it, so the per-stage numbers are
+    additive — they sum to (at most) the instrumented wall time. This
+    is what lets EXPLAIN ANALYZE / the slow log answer "where did the
+    milliseconds go" without double counting (reference:
+    util/execdetails ExecDetails stage durations).
+
+    One recorder per statement, installed by the session; recording a
+    stage is two perf_counter reads and a dict update — cheap enough
+    to stay always-on."""
+
+    __slots__ = ("totals", "counts")
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        self.totals[name] = self.totals.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> dict[str, float]:
+        return dict(self.totals)
+
+    def delta_since(self, before: dict[str, float]) -> dict[str, float]:
+        out = {}
+        for k, v in self.totals.items():
+            d = v - before.get(k, 0.0)
+            if d > 0:
+                out[k] = d
+        return out
+
+
+def install_stage_recorder(rec: Optional[StageRecorder]) -> None:
+    _stage_tls.rec = rec
+
+
+def active_stage_recorder() -> Optional[StageRecorder]:
+    return getattr(_stage_tls, "rec", None)
+
+
+class _StageCtx:
+    """Times one dispatch stage: always feeds the per-stage Prometheus
+    histogram and the active StageRecorder — both with EXCLUSIVE time
+    (a per-thread nesting stack subtracts inner stages, so summing the
+    per-stage histograms never double-counts a nested compile into its
+    enclosing kernel stage) — and opens a TRACE span when a collector
+    is active. Allocates no Span when tracing is off (the hot-path
+    guarantee test_trace pins)."""
+
+    __slots__ = ("stage", "spanctx", "t0", "rec")
+
+    def __init__(self, stage: str, span_name: Optional[str]) -> None:
+        self.stage = stage
+        self.spanctx = _SpanCtx(span_name or stage)
+        self.rec = getattr(_stage_tls, "rec", None)
+        self.t0 = 0.0
+
+    def __enter__(self) -> Optional[Span]:
+        stack = getattr(_stage_tls, "stack", None)
+        if stack is None:
+            stack = _stage_tls.stack = []
+        stack.append(0.0)  # accumulates nested-stage wall time
+        self.t0 = time.perf_counter()
+        return self.spanctx.__enter__()
+
+    def __exit__(self, *exc) -> None:
+        dt = time.perf_counter() - self.t0
+        self.spanctx.__exit__(*exc)
+        stack = _stage_tls.stack
+        child = stack.pop()
+        if stack:
+            stack[-1] += dt
+        excl = dt - child if dt > child else 0.0
+        DISPATCH_STAGE_SECONDS.observe(excl, stage=self.stage)
+        if self.rec is not None:
+            self.rec.add(self.stage, excl)
+
+
+def stage(name: str, span_name: Optional[str] = None) -> _StageCtx:
+    """`with obs.stage("compile"):` — one named dispatch stage.
+    Histogram + recorder always; a span only under an active TRACE."""
+    return _StageCtx(name, span_name)
+
+
+def fmt_stages(stages: Optional[dict[str, float]]) -> str:
+    """stage dict -> 'staging:0.12ms compile:5.3ms ...' (stable order)."""
+    if not stages:
+        return ""
+    order = ("plan_build", "prepare", "staging", "transfer", "compile",
+             "kernel", "device_get", "host_fallback", "ranged")
+    keys = [k for k in order if k in stages] + \
+        sorted(k for k in stages if k not in order)
+    return " ".join(f"{k}:{stages[k] * 1e3:.3g}ms" for k in keys)
+
+
+def fmt_stages_ms(stages_ms: Optional[dict[str, float]]) -> str:
+    """fmt_stages for dicts already in milliseconds (the slow-log
+    entry form written by record_slow)."""
+    if not stages_ms:
+        return ""
+    return fmt_stages({k: v / 1e3 for k, v in stages_ms.items()})
+
+
 # ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------
 
 class RuntimeStatsColl:
     """Per-plan-node runtime stats (reference:
     util/execdetails/execdetails.go RuntimeStatsColl): inclusive wall
-    time, output rows, and which engine served a leaf (device kernel vs
-    host fallback, with the gate's reason)."""
+    time, output rows, which engine served a leaf (device kernel vs
+    host fallback, with the gate's reason), and the inclusive
+    per-dispatch-stage second breakdown (staging / compile / transfer /
+    kernel / device_get / host_fallback)."""
 
     def __init__(self) -> None:
         self.nodes: dict[int, dict] = {}
 
     def record(self, plan, seconds: float, rows: int,
-               engine: Optional[str] = None) -> None:
+               engine: Optional[str] = None,
+               stages: Optional[dict[str, float]] = None) -> None:
         ent = self.nodes.setdefault(id(plan), {
-            "time": 0.0, "rows": 0, "loops": 0, "engine": None})
+            "time": 0.0, "rows": 0, "loops": 0, "engine": None,
+            "stages": {}})
         ent["time"] += seconds
         ent["rows"] += rows
         ent["loops"] += 1
         if engine:
             ent["engine"] = engine
+        if stages:
+            st = ent["stages"]
+            for k, v in stages.items():
+                st[k] = st.get(k, 0.0) + v
 
     def for_plan(self, plan) -> Optional[dict]:
         return self.nodes.get(id(plan))
 
 
+# ---- sampling host-CPU profiler ---------------------------------------------
+
+class Profile:
+    """Aggregated stack samples: {stack tuple -> count}. A stack is a
+    tuple of 'func (file:line)' strings, outermost first."""
+
+    __slots__ = ("stacks", "hz", "duration_s")
+
+    def __init__(self, stacks: dict[tuple, int], hz: float,
+                 duration_s: float) -> None:
+        self.stacks = stacks
+        self.hz = hz
+        self.duration_s = duration_s
+
+    @property
+    def total_samples(self) -> int:
+        return sum(self.stacks.values())
+
+    def hot_frames(self, limit: int = 20) -> list[tuple[str, int]]:
+        """Frames ranked by SELF samples (innermost frame of a stack)."""
+        own: dict[str, int] = {}
+        for stack, n in self.stacks.items():
+            if stack:
+                own[stack[-1]] = own.get(stack[-1], 0) + n
+        return sorted(own.items(), key=lambda kv: -kv[1])[:limit]
+
+    def tree_rows(self, max_rows: int = 512) -> list[tuple[str, float, int]]:
+        """Flamegraph-style rows: (indented frame, est. seconds,
+        samples), depth-first, heaviest subtree first."""
+        root: dict = {}
+        counts: dict[int, int] = {}
+
+        for stack, n in self.stacks.items():
+            node = root
+            for frame in stack:
+                node = node.setdefault(frame, {})
+                counts[id(node)] = counts.get(id(node), 0) + n
+
+        per_sample = 1.0 / self.hz if self.hz > 0 else 0.0
+        rows: list[tuple[str, float, int]] = []
+
+        def walk(node: dict, depth: int) -> None:
+            for frame, child in sorted(
+                    node.items(), key=lambda kv: -counts[id(kv[1])]):
+                if len(rows) >= max_rows:
+                    return
+                n = counts[id(child)]
+                rows.append(("  " * depth + frame,
+                             round(n * per_sample, 6), n))
+                walk(child, depth + 1)
+
+        walk(root, 0)
+        return rows
+
+    def to_dict(self) -> dict:
+        return {
+            "hz": self.hz,
+            "duration_s": round(self.duration_s, 6),
+            "total_samples": self.total_samples,
+            "hot_frames": self.hot_frames(),
+            "tree": [{"frame": f, "seconds": s, "samples": n}
+                     for f, s, n in self.tree_rows()],
+        }
+
+
+def _format_frame(frame) -> str:
+    co = frame.f_code
+    return f"{co.co_name} ({co.co_filename.rsplit('/', 1)[-1]}" \
+        f":{frame.f_lineno})"
+
+
+class SamplingProfiler:
+    """Wall-clock stack sampler over sys._current_frames() (reference:
+    util/profile serving pprof CPU profiles through SQL and the status
+    port). `thread_ids=None` samples every thread (the /debug/profile
+    whole-process view); a set restricts to those threads (the
+    per-statement SHOW PROFILE view). start()/stop() own the sampler
+    thread's lifecycle — stop() joins it, so no sampler leaks past the
+    statement that started it."""
+
+    MAX_DEPTH = 48
+    MAX_STACKS = 4096
+
+    def __init__(self, hz: float = 97.0,
+                 thread_ids: Optional[set] = None) -> None:
+        self.hz = max(float(hz), 1.0)
+        self.thread_ids = thread_ids
+        self._stacks: dict[tuple, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+        self._elapsed = 0.0
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            raise RuntimeError("profiler already running")
+        self._t0 = time.perf_counter()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tidb-tpu-profiler")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        import sys
+
+        me = threading.get_ident()
+        period = 1.0 / self.hz
+        while not self._stop.wait(period):
+            frames = sys._current_frames()
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue
+                if self.thread_ids is not None and \
+                        tid not in self.thread_ids:
+                    continue
+                stack: list[str] = []
+                f = frame
+                while f is not None and len(stack) < self.MAX_DEPTH:
+                    stack.append(_format_frame(f))
+                    f = f.f_back
+                stack.reverse()
+                key = tuple(stack)
+                if key in self._stacks or \
+                        len(self._stacks) < self.MAX_STACKS:
+                    self._stacks[key] = self._stacks.get(key, 0) + 1
+                PROFILER_SAMPLES.inc()
+            del frames
+
+    def stop(self) -> Profile:
+        t = self._thread
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+            self._thread = None
+        self._elapsed = time.perf_counter() - self._t0
+        return Profile(dict(self._stacks), self.hz, self._elapsed)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+
+def profile_process(seconds: float = 0.5, hz: float = 97.0) -> Profile:
+    """Block for `seconds` sampling every thread — the /debug/profile
+    handler's one-shot whole-process view."""
+    p = SamplingProfiler(hz=hz).start()
+    time.sleep(max(min(seconds, 10.0), 0.01))
+    return p.stop()
+
+
 # ---- module-level delegates (default instance) ------------------------------
 
-def record_slow(sql: str, db: str, duration_s: float) -> None:
-    DEFAULT.record_slow(sql, db, duration_s)
+def record_slow(sql: str, db: str, duration_s: float,
+                plan_digest: str = "",
+                stages: Optional[dict[str, float]] = None) -> None:
+    DEFAULT.record_slow(sql, db, duration_s, plan_digest, stages)
 
 
 def slow_queries() -> list[dict]:

@@ -1,9 +1,14 @@
-"""HTTP status server: /status, /metrics, /slow-query.
+"""HTTP status server: /status, /metrics, /slow-query, /debug/*.
 
 Counterpart of the reference's status port (reference:
 server/http_status.go:110-151 — /status JSON, /metrics Prometheus handler;
-default port 10080, tidb-server/main.go:144). Runs on a daemon thread
-beside the MySQL wire listener.
+default port 10080, tidb-server/main.go:144; the pprof debug routes of
+util/profile). Runs on a daemon thread beside the MySQL wire listener.
+
+Debug routes:
+  /debug/trace/<conn_id>  last TRACE span tree of that connection (JSON)
+  /debug/profile?seconds=0.5&hz=97  one-shot whole-process sampling
+      profile: hot frames + flamegraph-style call tree (JSON)
 """
 
 from __future__ import annotations
@@ -12,6 +17,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from .. import obs
 
@@ -57,6 +63,38 @@ class StatusServer:
                 elif self.path == "/statements-summary":
                     body = json.dumps(
                         server_obs.statements.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/trace/"):
+                    try:
+                        conn_id = int(self.path.rsplit("/", 1)[-1])
+                    except ValueError:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    tr = server_obs.trace_for(conn_id)
+                    if tr is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(tr).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/profile"):
+                    q = parse_qs(urlparse(self.path).query)
+
+                    def num(key, default, lo, hi):
+                        import math
+                        try:
+                            v = float(q[key][0])
+                        except (KeyError, ValueError, IndexError):
+                            return default
+                        if not math.isfinite(v):
+                            return default
+                        return min(max(v, lo), hi)
+
+                    prof = obs.profile_process(
+                        seconds=num("seconds", 0.5, 0.05, 10.0),
+                        hz=num("hz", 97.0, 1.0, 1000.0))
+                    body = json.dumps(prof.to_dict()).encode()
                     ctype = "application/json"
                 else:
                     self.send_response(404)

@@ -126,6 +126,10 @@ class Session:
         # Global connection id (embeds the server/node id in shared mode)
         self.conn_id: Optional[int] = None
         self.killed = threading.Event()
+        # @@profiling ring: per-statement sampling profiles served by
+        # SHOW PROFILES / SHOW PROFILE / information_schema.profiling
+        self._profiles: list[dict] = []
+        self._profile_seq = 0
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
@@ -180,6 +184,7 @@ class Session:
         executor/adapter.go; digests feed util/stmtsummary)."""
         import time as _time
 
+        from .. import obs
         from ..obs import DEFAULT_SLOW_THRESHOLD_MS
 
         from ..util import interrupt
@@ -198,6 +203,15 @@ class Session:
         self.in_flight_sql = sql[:256]
         self.in_flight_since = _time.time()
         self._stmt_auto_id = None
+        # per-statement dispatch-stage recorder (always on: two clock
+        # reads + a dict update per stage) feeding the slow log and
+        # EXPLAIN ANALYZE (reference: execdetails on every statement)
+        prev_rec = obs.active_stage_recorder()
+        rec = obs.StageRecorder()
+        obs.install_stage_recorder(rec)
+        # @@profiling: sample THIS thread's stacks for the statement
+        # (reference: util/profile; MySQL SHOW PROFILE semantics)
+        prof = self._maybe_start_profiler(stmt)
         try:
             rs = self._execute_stmt(stmt)
             rows_out = len(rs.rows)
@@ -219,11 +233,14 @@ class Session:
             raise
         finally:
             interrupt.install(None)
+            obs.install_stage_recorder(prev_rec)
             self.in_flight_sql = None
             if self._is_guard is not None:
                 self._is_guard.release()
                 self._is_guard = None
             dt = _time.perf_counter() - t0
+            if prof is not None:
+                self._finish_profile(prof, sql, dt)
             o.query_seconds.observe(dt)
             if digest_sql is not None:
                 o.statements.record(digest_sql, self.current_db, dt,
@@ -234,10 +251,68 @@ class Session:
             except (TypeError, ValueError, SQLError):
                 thresh = DEFAULT_SLOW_THRESHOLD_MS
             if dt * 1e3 >= thresh:
-                o.record_slow(sql, self.current_db, dt)
+                import hashlib
+                # same digest the statements_summary uses, so slow-log
+                # entries join against the digest table
+                digest = hashlib.sha256(
+                    o.statements.normalize(digest_sql or sql)
+                    .encode()).hexdigest()[:32]
+                o.record_slow(sql, self.current_db, dt,
+                              plan_digest=digest, stages=rec.snapshot())
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
+
+    # ==================== statement profiling ====================
+    def _maybe_start_profiler(self, stmt: ast.Stmt):
+        """Start a per-statement stack sampler when @@profiling is on.
+        SET and SHOW PROFILE[S] are exempt (MySQL behaves the same —
+        toggling/inspecting profiles must not clobber the ring)."""
+        if isinstance(stmt, ast.SetStmt):
+            return None
+        if isinstance(stmt, ast.ShowStmt) and \
+                stmt.kind in ("PROFILE", "PROFILES"):
+            return None
+        try:
+            v = self._sysvar_value("profiling")
+        except SQLError:
+            return None
+        if str(v).upper() not in ("1", "ON", "TRUE", "YES"):
+            return None
+        from .. import obs
+        try:
+            hz = float(self._sysvar_value("tidb_profiler_sample_hz") or 97)
+        except (TypeError, ValueError, SQLError):
+            hz = 97.0
+        try:
+            return obs.SamplingProfiler(
+                hz=hz, thread_ids={threading.get_ident()}).start()
+        except Exception:
+            # runs before the statement's try/finally: a sampler that
+            # cannot start must not fail (or leak into) the statement
+            return None
+
+    def _finish_profile(self, prof, sql: str, duration_s: float) -> None:
+        try:
+            profile = prof.stop()
+        except Exception:
+            return
+        self._profile_seq += 1
+        self._profiles.append({
+            "query_id": self._profile_seq,
+            "sql": sql[:512],
+            "duration": duration_s,
+            "profile": profile,
+        })
+        try:
+            raw = self._sysvar_value("profiling_history_size")
+            cap = 15 if raw is None or raw == "" else int(raw)
+        except (TypeError, ValueError, SQLError):
+            cap = 15
+        if cap <= 0:  # MySQL: history size 0 retains nothing
+            self._profiles.clear()
+        else:
+            del self._profiles[:max(len(self._profiles) - cap, 0)]
 
     # ==================== prepared statements ====================
     def prepare(self, sql: str) -> tuple[int, int]:
@@ -893,7 +968,8 @@ class Session:
                 deny(need, f"{db}.{tn.name}")
 
     # ==================== information_schema ====================
-    _VIEWER_SENSITIVE_IS = frozenset({"processlist", "user_privileges"})
+    _VIEWER_SENSITIVE_IS = frozenset({"processlist", "user_privileges",
+                                      "profiling"})
 
     def _refresh_infoschema(self, stmt) -> None:
         """Rebuild any information_schema tables this statement touches
@@ -1231,7 +1307,9 @@ class Session:
         try:
             if getattr(stmt, "for_update", False):
                 self._lock_for_update(stmt)
-            plan = self._plan_cached(stmt, uncacheable=has_vars)
+            from .. import obs
+            with obs.stage("plan_build", span_name="planner.optimize"):
+                plan = self._plan_cached(stmt, uncacheable=has_vars)
             self._check_column_privs(plan)
             ctx = self._exec_ctx()
             try:
@@ -2614,12 +2692,14 @@ class Session:
         for node, line in explain_nodes(plan):
             st = coll.for_plan(node)
             if st is None:
-                rows.append((line, None, None, ""))
+                rows.append((line, None, None, "", ""))
             else:
                 rows.append((line, st["rows"],
                              round(st["time"] * 1e3, 2),
-                             st["engine"] or ""))
-        return ResultSet(["plan", "actRows", "time_ms", "engine"], rows)
+                             st["engine"] or "",
+                             obs.fmt_stages(st.get("stages"))))
+        return ResultSet(["plan", "actRows", "time_ms", "engine",
+                          "stages"], rows)
 
     def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
         """TRACE <select>: execute with span accounting and return the
@@ -2637,12 +2717,18 @@ class Session:
         is_select = isinstance(target, (ast.SelectStmt, ast.SetOpStmt))
         coll = obs.RuntimeStatsColl()
         plan = None
-        with obs.SpanCollector("session.run") as spans:
+        try:
+            raw = self._sysvar_value("tidb_trace_span_cap")
+            cap = obs.TRACE_SPAN_CAP if raw is None or raw == "" \
+                else max(int(raw), 1)  # 1 = root only, rest dropped
+        except (TypeError, ValueError, SQLError):
+            cap = obs.TRACE_SPAN_CAP
+        with obs.SpanCollector("session.run", cap=cap) as spans:
             if is_select:
                 with obs.span("session.prepare"):
                     target = self._maybe_bind_vars(target)
                     self._refresh_infoschema(target)
-                with obs.span("planner.optimize"):
+                with obs.stage("plan_build", span_name="planner.optimize"):
                     plan = self._plan(target)
 
                 def run():
@@ -2663,6 +2749,8 @@ class Session:
                 st = coll.for_plan(node)
                 dur = round(st["time"] * 1e3, 3) if st else None
                 rows.append((f"  {line}", None, dur))
+        # keep the tree reachable from the status port
+        self.storage.obs.record_trace(self.conn_id or 0, rows)
         return ResultSet(["operation", "start_ms", "duration_ms"], rows)
 
     def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
@@ -2809,7 +2897,31 @@ class Session:
                 [(p.title(), "Tables,Databases,Global", "")
                  for p in sorted(PRIVS - {"ALL", "USAGE"})])
         if stmt.kind == "PROFILES":
-            return ResultSet(["Query_ID", "Duration", "Query"], [])
+            # the @@profiling ring (reference: MySQL SHOW PROFILES;
+            # entries recorded by the per-statement sampling profiler)
+            return ResultSet(
+                ["Query_ID", "Duration", "Query"],
+                [(p["query_id"], round(p["duration"], 6), p["sql"])
+                 for p in self._profiles])
+        if stmt.kind == "PROFILE":
+            # flamegraph-style table for one profiled statement: frame
+            # tree rows with estimated seconds + raw sample counts
+            if not self._profiles:
+                return ResultSet(["Status", "Duration", "Samples"], [])
+            if stmt.pattern:
+                qid = int(stmt.pattern)
+                ent = next((p for p in self._profiles
+                            if p["query_id"] == qid), None)
+                if ent is None:
+                    raise SQLError(f"no profile for query {qid}")
+            else:
+                ent = self._profiles[-1]
+            prof = ent["profile"]
+            rows = [(f_, s, n) for f_, s, n in prof.tree_rows()]
+            if not rows:
+                rows = [("(no samples: statement finished between "
+                         f"ticks at {prof.hz:g}Hz)", 0.0, 0)]
+            return ResultSet(["Status", "Duration", "Samples"], rows)
         if stmt.kind == "CREATE_DATABASE":
             name = stmt.pattern or ""
             try:
@@ -2879,9 +2991,13 @@ class Session:
                  "Packed", "Null", "Index_type", "Comment",
                  "Index_comment"], rows)
         if stmt.kind == "SLOW":
-            rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"])
+            from .. import obs as _obs
+            rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"],
+                     e.get("plan_digest", ""),
+                     _obs.fmt_stages_ms(e.get("stages")))
                     for e in self.storage.obs.slow_queries()]
-            return ResultSet(["Time", "DB", "Duration_ms", "Query"], rows)
+            return ResultSet(["Time", "DB", "Duration_ms", "Query",
+                              "Plan_digest", "Stages"], rows)
         if stmt.kind == "METRICS":
             from .. import obs
             rows = []

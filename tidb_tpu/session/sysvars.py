@@ -188,6 +188,10 @@ _VARS = [
     _v("log_queries_not_using_indexes", 0, scope=SCOPE_GLOBAL),
     _v("profiling", 0, scope=SCOPE_SESSION),
     _v("profiling_history_size", 15, scope=SCOPE_SESSION),
+    # host sampling-profiler tick rate (@@profiling, /debug/profile)
+    _v("tidb_profiler_sample_hz", 97),
+    # TRACE drops spans past this cap (bounded span trees)
+    _v("tidb_trace_span_cap", 4096),
     # ---- innodb-shaped surface (inert; columnar-epoch engine) ---------
     _v("innodb_buffer_pool_size", 134217728, scope=SCOPE_GLOBAL,
        read_only=True),

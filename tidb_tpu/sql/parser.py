@@ -1290,6 +1290,33 @@ class Parser:
                 self.cur.text.upper() == "PROFILES":
             self.advance()
             return ast.ShowStmt("PROFILES")
+        if self.cur.kind == TokenKind.IDENT and \
+                self.cur.text.upper() == "PROFILE":
+            # SHOW PROFILE [type[, type]...] [FOR QUERY n]: type
+            # clauses (CPU, BLOCK IO, ...) are accepted and ignored —
+            # the sampler has one view, wall-clock stacks
+            self.advance()
+            stmt = ast.ShowStmt("PROFILE")
+            types = {"ALL", "BLOCK", "IO", "CONTEXT", "SWITCHES", "CPU",
+                     "IPC", "MEMORY", "PAGE", "FAULTS", "SOURCE",
+                     "SWAPS"}
+            while self.cur.kind in (TokenKind.IDENT, TokenKind.KEYWORD) \
+                    and self.cur.text.upper() in types:
+                self.advance()
+                self.accept_op(",")
+            if self.accept_kw("FOR"):
+                t = self.cur
+                if not (t.kind in (TokenKind.IDENT, TokenKind.KEYWORD)
+                        and t.text.upper() == "QUERY"):
+                    raise ParseError("expected QUERY", t)
+                self.advance()
+                t = self.cur
+                if t.kind != TokenKind.INT:
+                    raise ParseError(
+                        "expected integer after FOR QUERY", t)
+                self.advance()
+                stmt.pattern = t.text
+            return stmt
         if self.accept_kw("COLUMNS", "FIELDS"):
             self.expect_kw("FROM")
             return self._show_like(
