@@ -313,8 +313,43 @@ def test_duplicate_registration_type_mismatch_raises():
     r.counter("tidb_thing_total")
     with pytest.raises(TypeError):
         r.histogram("tidb_thing_total")
+    with pytest.raises(TypeError):
+        r.gauge("tidb_thing_total")
     # same-type re-registration returns the same instance
     assert r.counter("tidb_thing_total") is r.counter("tidb_thing_total")
+
+
+def test_gauge_exposition_and_dup_guard():
+    r = obs.Registry()
+    g = r.gauge("tidb_gauge_thing", "a gauge")
+    g.set(3.0, device="0")
+    g.inc(2.0, device="0")
+    g.dec(1.0, device="0")
+    g.set(7.5)
+    text = r.render()
+    assert "# TYPE tidb_gauge_thing gauge" in text
+    assert 'tidb_gauge_thing{device="0"} 4' in text
+    assert "tidb_gauge_thing 7.5" in text
+    with pytest.raises(TypeError):
+        r.counter("tidb_gauge_thing")
+    assert r.gauge("tidb_gauge_thing") is g
+    # the process registry's device-telemetry gauges keep the tidb_
+    # prefix contract (the prefix test walks them too, via families())
+    fams = obs.PROCESS_METRICS.families()
+    for fam in ("tidb_device_transfer_bytes", "tidb_device_buffer_bytes",
+                "tidb_jit_cache_entries", "tidb_process_rss_bytes"):
+        assert fam in fams, fam
+
+
+def test_device_telemetry_gauges_move():
+    tk = _q6_kit()
+    tk.session.storage.flush()  # fold deltas: base-epoch staging caches
+    tk.must_query(Q6)  # stages columns + compiles a kernel
+    obs.run_gauge_probes()
+    assert obs.DEVICE_TRANSFER_BYTES.get() > 0
+    assert obs.DEVICE_BUFFER_BYTES.get() > 0
+    assert obs.JIT_CACHE_ENTRIES.get() > 0
+    assert obs.PROCESS_RSS_BYTES.get() > 0
 
 
 def test_dispatch_stage_cache_counters_move():

@@ -107,6 +107,56 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("duration", FieldType(TypeKind.DOUBLE)),
         ("samples", _bigint()),
     ],
+    # counter/gauge time-series rollup from the MetricsHistory ring
+    # (reference: TiDB 4.0's metrics schema summarized into
+    # INFORMATION_SCHEMA.METRICS_SUMMARY)
+    "metrics_summary": [
+        ("metric_name", _vc(160)), ("samples", _bigint()),
+        ("min_value", FieldType(TypeKind.DOUBLE)),
+        ("avg_value", FieldType(TypeKind.DOUBLE)),
+        ("max_value", FieldType(TypeKind.DOUBLE)),
+        ("last_value", FieldType(TypeKind.DOUBLE)),
+    ],
+    # cluster-wide memtables: one sub-request per live member over the
+    # diag RPC plane (reference: infoschema/cluster.go CLUSTER_* tables
+    # served by executor/memtable_reader.go fan-out). Every table leads
+    # with the member's instance address and ends with an error column:
+    # an unreachable peer contributes [instance, NULLs..., error] plus a
+    # session warning instead of failing the query.
+    "cluster_info": [
+        ("instance", _vc()), ("type", _vc(16)), ("server_id", _bigint()),
+        ("version", _vc()), ("pid", _bigint()), ("start_time", _vc(20)),
+        ("uptime_s", FieldType(TypeKind.DOUBLE)), ("error", _vc(256)),
+    ],
+    "cluster_processlist": [
+        ("instance", _vc()), ("id", _bigint()), ("user", _vc()),
+        ("host", _vc()), ("db", _vc()), ("command", _vc(16)),
+        ("time", _bigint()), ("state", _vc(16)), ("info", _vc(512)),
+        ("error", _vc(256)),
+    ],
+    "cluster_slow_query": [
+        ("instance", _vc()), ("time", _vc(20)), ("db", _vc()),
+        ("query_time_ms", FieldType(TypeKind.DOUBLE)),
+        ("query", _vc(4096)), ("plan_digest", _vc(32)),
+        ("stages", _vc(256)), ("error", _vc(256)),
+    ],
+    "cluster_statements_summary": [
+        ("instance", _vc()), ("digest", _vc(32)), ("schema_name", _vc()),
+        ("digest_text", _vc(512)), ("query_sample_text", _vc(512)),
+        ("exec_count", _bigint()), ("sum_errors", _bigint()),
+        ("sum_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("sum_result_rows", _bigint()), ("last_seen", _vc(20)),
+        ("error", _vc(256)),
+    ],
+    # device/host telemetry per member (live gauges + counters), for
+    # correlating dispatch-latency regressions with device-memory
+    # pressure across the whole cluster
+    "cluster_load": [
+        ("instance", _vc()), ("device_type", _vc(16)),
+        ("name", _vc(160)), ("value", FieldType(TypeKind.DOUBLE)),
+        ("error", _vc(256)),
+    ],
     "key_column_usage": [
         ("constraint_catalog", _vc()), ("constraint_schema", _vc()),
         ("constraint_name", _vc()), ("table_catalog", _vc()),
@@ -327,11 +377,24 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                 round(e["max_latency_ms"], 3), e["sum_rows"],
                 e["first_seen"], e["last_seen"]])
     elif tname == "slow_query":
-        from .. import obs as _obs
-        for e in storage.obs.slow_queries():
-            rows.append([e["ts"], e["db"], e["duration_ms"], e["sql"],
-                         e.get("plan_digest", ""),
-                         _obs.fmt_stages_ms(e.get("stages"))])
+        # same row shape as cluster_slow_query minus (instance, error):
+        # the diag service is the one producer of it
+        rows = storage.diag.diag_slow_query()["rows"]
+    elif tname == "metrics_summary":
+        hist = getattr(storage, "metrics_history", None)
+        if hist is not None:
+            # the ring plus a transient point for "now" — a read must
+            # not append to (and eventually flush) the time-series
+            now = hist.sample_now(record=False)
+            for name, st in sorted(hist.summary(extra=now).items()):
+                rows.append([name, st["samples"], st["min"], st["avg"],
+                             st["max"], st["last"]])
+    elif tname in ("cluster_info", "cluster_processlist",
+                   "cluster_slow_query", "cluster_statements_summary",
+                   "cluster_load"):
+        from ..rpc import diag as _diag
+        rows = _diag.cluster_rows(storage, tname,
+                                  len(_DEFS[tname]), viewer)
     elif tname == "profiling":
         for p in (getattr(viewer, "_profiles", None) or []):
             prof = p["profile"]

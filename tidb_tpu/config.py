@@ -48,6 +48,10 @@ class PerformanceConfig:
     tile_rows: int = 1 << 22              # device tile granularity
     profiler_sample_hz: int = 97          # @@profiling / /debug/profile
     trace_span_cap: int = 4096            # TRACE drops spans past this
+    # metrics time-series ring (information_schema.metrics_summary +
+    # /debug/metrics/history): sampling cadence and retained points
+    metrics_history_interval: int = 15    # seconds between samples
+    metrics_history_cap: int = 240        # retained samples (ring size)
 
 
 @dataclass
@@ -96,6 +100,11 @@ class TransportConfig:
     lock_budget_ms: int = 30000     # mutation-lease acquisition budget
     lease_ms: int = 3000            # leader-granted lease horizon
     stale_reads: bool = True        # degraded followers serve stale reads
+    # follower diagnostics listener (cluster_* tables query it); the
+    # default binds loopback with an ephemeral port — followers on
+    # other hosts must set a SPECIFIC routable address (the bound host
+    # is what peers dial, so wildcards like 0.0.0.0 are rejected)
+    diag_listen: str = "127.0.0.1:0"
 
 
 @dataclass
@@ -166,6 +175,10 @@ class Config:
             raise ConfigError("profiler-sample-hz must be >= 1")
         if self.performance.trace_span_cap < 16:
             raise ConfigError("trace-span-cap must be >= 16")
+        if self.performance.metrics_history_interval < 1:
+            raise ConfigError("metrics-history-interval must be >= 1")
+        if self.performance.metrics_history_cap < 1:
+            raise ConfigError("metrics-history-cap must be >= 1")
         t = self.transport
         if t.listen and t.remote:
             raise ConfigError(
@@ -228,6 +241,7 @@ class Config:
             lock_budget_ms=t.lock_budget_ms,
             lease_ms=t.lease_ms,
             stale_reads=t.stale_reads,
+            diag_listen=t.diag_listen,
         )
 
     # ---- sysvar seeding ------------------------------------------------
@@ -371,6 +385,9 @@ stats-lease = "3s"
 tile-rows = 4194304            # device tile granularity (rows)
 profiler-sample-hz = 97        # @@profiling / /debug/profile tick rate
 trace-span-cap = 4096          # TRACE drops spans past this cap
+metrics-history-interval = 15  # seconds between metrics-history samples
+metrics-history-cap = 240      # samples retained (feeds metrics_summary
+                               # and /debug/metrics/history)
 
 [plan-cache]
 enabled = true
@@ -399,6 +416,11 @@ backoff-budget-ms = 4000       # per-call typed-retry budget
 lock-budget-ms = 30000         # mutation-lease acquisition budget
 lease-ms = 3000                # leader-granted lease horizon
 stale-reads = true             # degraded followers serve stale reads
+diag-listen = "127.0.0.1:0"    # follower diagnostics endpoint
+                               # (cluster_* tables pull rows from it;
+                               # peers dial the bound host, so use a
+                               # specific routable address — wildcards
+                               # like 0.0.0.0 are rejected)
 
 [security]
 skip-grant-table = false

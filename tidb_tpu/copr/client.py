@@ -98,6 +98,41 @@ def _bucket(n: int) -> int:
     return b
 
 
+# ---- device telemetry -------------------------------------------------------
+# live clients, so one process-wide probe can sum staged-buffer bytes
+# and jit-cache entries across sessions without per-dispatch accounting
+import weakref as _weakref
+
+_LIVE_CLIENTS: "_weakref.WeakSet" = _weakref.WeakSet()
+
+
+def _obj_nbytes(o) -> int:
+    if isinstance(o, (tuple, list)):
+        return sum(_obj_nbytes(x) for x in o)
+    return int(getattr(o, "nbytes", 0) or 0)
+
+
+def _note_transfer(*arrays) -> None:
+    """Host->device staging accounting on the dispatch hot path (one
+    attribute read per array; the gauge feeds cluster_load and the
+    MetricsHistory ring)."""
+    obs.DEVICE_TRANSFER_BYTES.inc(_obj_nbytes(arrays))
+
+
+def _device_telemetry_probe() -> None:
+    buf = jit = 0
+    for c in list(_LIVE_CLIENTS):
+        with c._lock:
+            buf += sum(_obj_nbytes(v) for v in c._col_cache.values())
+            buf += sum(_obj_nbytes(v) for v in c._mask_cache.values())
+            jit += len(c._kernels)
+    obs.DEVICE_BUFFER_BYTES.set(buf)
+    obs.JIT_CACHE_ENTRIES.set(jit)
+
+
+obs.register_gauge_probe(_device_telemetry_probe)
+
+
 @dataclass
 class CopResult:
     """Device/coprocessor answer: one or more partial chunks.
@@ -128,6 +163,7 @@ class CopClient:
         self._stats: dict[tuple[int, int], Bound] = {}
         # guards the caches; kernels themselves are thread-safe to call
         self._lock = threading.RLock()
+        _LIVE_CLIENTS.add(self)
 
     def _evict_stale(self, table_id: int, epoch_id: int) -> None:
         """Free device buffers cached for a table's superseded epochs
@@ -659,6 +695,7 @@ class CopClient:
                     with obs.stage("transfer"):
                         cached = self._place_cols(
                             jnp.asarray(padded), jnp.asarray(pvalid))
+                    _note_transfer(cached)
                     if cacheable:
                         with self._lock:
                             self._col_cache[key] = cached
@@ -672,6 +709,7 @@ class CopClient:
                 pmask = _pad_bool(snap.base_visible[lo:lo + cnt], b)
                 with obs.stage("transfer"):
                     vis = self._place_mask(jnp.asarray(pmask))
+                _note_transfer(vis)
                 if cacheable:
                     with self._lock:
                         self._mask_cache[vkey] = vis
@@ -708,6 +746,7 @@ class CopClient:
                         jnp.asarray(_pad(narrow(data), b)),
                         jnp.asarray(_pad_bool(vfull, b)),
                     ))
+                _note_transfer(dev_cols[-1])
             mask = np.zeros(b, bool)
             mask[:n] = True
             return dev_cols, jnp.asarray(mask), host_cols, mask[:n]
@@ -737,6 +776,7 @@ class CopClient:
                 pvalid = _pad_bool(vfull, b)
                 with obs.stage("transfer"):
                     cached = (jnp.asarray(padded), jnp.asarray(pvalid))
+                _note_transfer(cached)
                 if cacheable:
                     with self._lock:
                         self._col_cache[key] = cached
@@ -751,6 +791,7 @@ class CopClient:
             pmask = _pad_bool(snap.base_visible, b)
             with obs.stage("transfer"):
                 vis = jnp.asarray(pmask)
+            _note_transfer(vis)
             if cacheable:
                 with self._lock:
                     # one live mask per (epoch, bucket): every delete/update

@@ -45,6 +45,43 @@ class Counter:
             return list(self._values.items())
 
 
+class Gauge:
+    """A value that can go up and down (Prometheus gauge). Labeled like
+    Counter; `set` overwrites, `inc`/`dec` adjust — device-telemetry
+    consumers use both (transfer bytes accumulate on the hot path,
+    buffer bytes / RSS are overwritten by the sampler probes)."""
+
+    __slots__ = ("name", "help", "_values", "_lock")
+
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def get(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def samples(self):
+        with self._lock:
+            return list(self._values.items())
+
+
 class Histogram:
     """Fixed-bucket latency histogram (Prometheus-style cumulative).
 
@@ -100,6 +137,19 @@ class Histogram:
                     for key, s in sorted(self._series.items())]
 
 
+def _label_name(name: str, key: tuple) -> str:
+    """'name{k="v",...}' (or bare name) for a sorted label-key tuple."""
+    lbl = ",".join(f'{k}="{val}"' for k, val in key)
+    return f"{name}{{{lbl}}}" if lbl else name
+
+
+def _fmt_value(v: float) -> str:
+    """Full-precision exposition value: %g's 6 significant digits would
+    quantize byte-valued gauges (RSS ~1e9) so hard that scrape-to-scrape
+    deltas vanish; integers render as integers, floats via repr."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
 class Registry:
     def __init__(self) -> None:
         self._metrics: dict[str, object] = {}
@@ -112,6 +162,18 @@ class Registry:
                 m = Counter(name, help_)
                 self._metrics[name] = m
             elif not isinstance(m, Counter):
+                raise TypeError(
+                    f"metric {name} already registered as "
+                    f"{type(m).__name__}")
+            return m
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help_)
+                self._metrics[name] = m
+            elif not isinstance(m, Gauge):
                 raise TypeError(
                     f"metric {name} already registered as "
                     f"{type(m).__name__}")
@@ -133,19 +195,33 @@ class Registry:
         with self._lock:
             return list(self._metrics)
 
+    def flat_samples(self) -> list[tuple[str, float]]:
+        """Counter/gauge samples flattened to ('name{l=\"v\"}', value)
+        pairs — the one flattening shared by the metrics-history
+        sampler and the diag plane's load snapshot."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: list[tuple[str, float]] = []
+        for m in metrics:
+            if not isinstance(m, (Counter, Gauge)):
+                continue  # histograms live on /metrics only
+            for key, v in m.samples():
+                out.append((_label_name(m.name, key), v))
+        return out
+
     def render(self) -> str:
         """Prometheus text exposition format."""
         out: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            if isinstance(m, Counter):
+            if isinstance(m, (Counter, Gauge)):
                 out.append(f"# HELP {m.name} {m.help}")
-                out.append(f"# TYPE {m.name} counter")
+                out.append(f"# TYPE {m.name} "
+                           f"{'gauge' if isinstance(m, Gauge) else 'counter'}")
                 for key, v in sorted(m.samples()):
-                    lbl = ",".join(f'{k}="{val}"' for k, val in key)
-                    out.append(f"{m.name}{{{lbl}}} {v:g}" if lbl
-                               else f"{m.name} {v:g}")
+                    out.append(f"{_label_name(m.name, key)} "
+                               f"{_fmt_value(v)}")
             else:
                 out.append(f"# HELP {m.name} {m.help}")
                 out.append(f"# TYPE {m.name} histogram")
@@ -160,7 +236,7 @@ class Registry:
                         f'{m.name}_bucket{{le="+Inf"{extra}}} {total}')
                     lbl = ",".join(f'{k}="{val}"' for k, val in key)
                     sfx = f"{{{lbl}}}" if lbl else ""
-                    out.append(f"{m.name}_sum{sfx} {total_sum:g}")
+                    out.append(f"{m.name}_sum{sfx} {_fmt_value(total_sum)}")
                     out.append(f"{m.name}_count{sfx} {total}")
         return "\n".join(out) + "\n"
 
@@ -360,6 +436,159 @@ PROFILER_SAMPLES = PROCESS_METRICS.counter(
     "tidb_profiler_samples_total",
     "stack samples taken by the host sampling profiler")
 
+# device telemetry gauges (ONE device per process, like the counters
+# above): transfer bytes accumulate on the dispatch hot path; buffer
+# bytes / cache entries / RSS are refreshed by the registered probes
+# right before every scrape or history sample
+DEVICE_TRANSFER_BYTES = PROCESS_METRICS.gauge(
+    "tidb_device_transfer_bytes",
+    "cumulative host->device bytes staged by the coprocessor client")
+DEVICE_BUFFER_BYTES = PROCESS_METRICS.gauge(
+    "tidb_device_buffer_bytes",
+    "live device bytes pinned by the column/mask staging caches")
+JIT_CACHE_ENTRIES = PROCESS_METRICS.gauge(
+    "tidb_jit_cache_entries",
+    "compiled kernels resident in the jit cache")
+PROCESS_RSS_BYTES = PROCESS_METRICS.gauge(
+    "tidb_process_rss_bytes", "resident set size of this process")
+
+# probes recomputing the sampled gauges (device buffer bytes, jit cache
+# entries, RSS) from live state; run by MetricsHistory.sample_now() and
+# the /metrics scrape path so the gauges are current at read time
+# without taxing the dispatch hot path
+_GAUGE_PROBES: list = []
+
+
+def register_gauge_probe(fn) -> None:
+    _GAUGE_PROBES.append(fn)
+
+
+def run_gauge_probes() -> None:
+    for fn in list(_GAUGE_PROBES):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — a probe must never break reads
+            pass
+
+
+def _rss_probe() -> None:
+    try:
+        import os
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        PROCESS_RSS_BYTES.set(pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        import resource
+        import sys
+        # best-effort fallback (peak, not live); ru_maxrss is KiB on
+        # Linux but already bytes on macOS
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        PROCESS_RSS_BYTES.set(rss if sys.platform == "darwin"
+                              else rss * 1024)
+
+
+register_gauge_probe(_rss_probe)
+
+
+# ---- metrics time-series ring (metrics_summary / history route) -------------
+
+class MetricsHistory:
+    """Background sampler keeping a bounded ring of counter/gauge
+    snapshots (reference: the in-cluster metrics schema behind
+    INFORMATION_SCHEMA.METRICS_SUMMARY — TiDB 4.0 reads Prometheus; the
+    embedded analog samples its own registries). One per Storage,
+    started at open and joined at close like the sampling profiler, so
+    no thread outlives its store."""
+
+    DEFAULT_INTERVAL_S = 15.0
+    DEFAULT_CAP = 240  # one hour at the default cadence
+
+    def __init__(self, registries, interval_s: Optional[float] = None,
+                 cap: Optional[int] = None) -> None:
+        self.registries = list(registries)
+        self.interval_s = float(interval_s or self.DEFAULT_INTERVAL_S)
+        self._ring: deque = deque(maxlen=int(cap or self.DEFAULT_CAP))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def configure(self, interval_s: Optional[float] = None,
+                  cap: Optional[int] = None) -> None:
+        """Apply the performance.metrics-history-* config knobs (the
+        server calls this after loading config; safe while running)."""
+        if interval_s:
+            self.interval_s = max(float(interval_s), 0.1)
+        if cap:
+            with self._lock:
+                self._ring = deque(self._ring, maxlen=max(int(cap), 1))
+
+    def sample_now(self, record: bool = True) -> dict:
+        """One sample of every counter/gauge. record=False computes the
+        point without touching the ring — the metrics_summary read path
+        uses it so reading the time-series never mutates it."""
+        run_gauge_probes()
+        values: dict[str, float] = {}
+        for reg in self.registries:
+            values.update(reg.flat_samples())
+        ent = {"ts": time.time(), "values": values}
+        if record:
+            with self._lock:
+                self._ring.append(ent)
+        return ent
+
+    def _run(self) -> None:
+        self.sample_now()  # first point at start, not one interval in
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()
+
+    def start(self) -> "MetricsHistory":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="titpu-metrics-history")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is not None:
+            self._stop.set()
+            t.join(timeout=5.0)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def summary(self, extra: Optional[dict] = None) -> dict[str, dict]:
+        """metric -> {samples, min, avg, max, last} over the ring (the
+        information_schema.metrics_summary rows); `extra` folds in a
+        transient point (e.g. sample_now(record=False)) for 'now'."""
+        out: dict[str, dict] = {}
+        points = self.snapshot()
+        if extra is not None:
+            points.append(extra)
+        for ent in points:
+            for name, v in ent["values"].items():
+                st = out.get(name)
+                if st is None:
+                    out[name] = {"samples": 1, "min": v, "max": v,
+                                 "sum": v, "last": v}
+                else:
+                    st["samples"] += 1
+                    st["min"] = min(st["min"], v)
+                    st["max"] = max(st["max"], v)
+                    st["sum"] += v
+                    st["last"] = v
+        for st in out.values():
+            st["avg"] = st.pop("sum") / st["samples"]
+        return out
+
 
 # ---- cross-layer span trees (TRACE) -----------------------------------------
 
@@ -398,6 +627,7 @@ class SpanCollector:
 
     def __init__(self, name: str = "trace",
                  cap: Optional[int] = None) -> None:
+        import uuid
         self.t0 = time.perf_counter()
         self.root = Span(name, 0.0)
         self._stack = [self.root]
@@ -405,6 +635,16 @@ class SpanCollector:
         self.count = 1
         self.dropped = 0
         self._lock = threading.Lock()
+        # Dapper-style identity: every RPC issued under this collector
+        # carries (trace_id, parent_span_id) so the remote side's spans
+        # come back attributable to this tree (rpc/frame.py trace ctx)
+        self.trace_id = uuid.uuid4().hex
+        self._next_span_id = 1
+
+    def alloc_span_id(self) -> int:
+        with self._lock:
+            self._next_span_id += 1
+            return self._next_span_id
 
     def _admit(self) -> bool:
         with self._lock:
@@ -468,6 +708,81 @@ def span(name: str) -> _SpanCtx:
     """`with obs.span("copr.execute"):` — nests under the active
     collector's current span; no-op without an active TRACE."""
     return _SpanCtx(name)
+
+
+def active_collector() -> Optional[SpanCollector]:
+    """The thread's live TRACE collector, if any (the RPC client reads
+    this to decide whether to propagate trace context)."""
+    return getattr(_span_tls, "coll", None)
+
+
+def run_remote_traced(tc, name: str, fn):
+    """Server side of cross-process trace propagation: when the request
+    carried a trace context, run the handler under its own SpanCollector
+    and hand the span rows back for the caller to stitch (reference:
+    Dapper's span trees crossing process boundaries; TiDB ships remote
+    trace spans back in the coprocessor response). Returns
+    (result, rows-or-None)."""
+    if not isinstance(tc, dict):
+        return fn(), None
+    with SpanCollector(name) as coll:
+        coll.trace_id = str(tc.get("trace_id") or coll.trace_id)
+        coll.root.note = (f"trace_id={coll.trace_id[:16]} "
+                          f"parent_span_id={tc.get('parent_span_id')}")
+        result = fn()
+    return result, coll.rows()
+
+
+def graft_collector(parent: SpanCollector, into: Span,
+                    child: SpanCollector) -> None:
+    """Merge a worker thread's child collector into the caller's tree.
+
+    The span stack is thread-local, so parallel fan-out workers cannot
+    open spans on the caller's collector directly; each worker runs
+    under its own SpanCollector and the caller grafts the children here
+    (re-based by the collectors' perf_counter origins), keeping the
+    tree identical to what sequential execution would have produced."""
+    offset = child.t0 - parent.t0
+
+    def walk(src: Span, dst_children: list) -> bool:
+        if not parent._admit():
+            return False
+        sp = Span(src.name, src.start + offset)
+        sp.end = src.end + offset
+        sp.note = src.note
+        dst_children.append(sp)
+        for c in src.children:
+            if not walk(c, sp.children):
+                return False
+        return True
+
+    for c in child.root.children:
+        if not walk(c, into.children):
+            break
+
+
+def stitch_remote_rows(coll: SpanCollector, parent: Span, rows) -> None:
+    """Client side: graft a peer's span rows (indented-label form, ms
+    offsets relative to the remote handler start) under the local RPC
+    span, re-based onto this collector's clock. Remote spans count
+    against the collector's cap like local ones."""
+    base = parent.start
+    stack: list[tuple[int, Span]] = [(-1, parent)]
+    for r in rows:
+        try:
+            label, start_ms, dur_ms = str(r[0]), float(r[1]), float(r[2])
+        except (TypeError, ValueError, IndexError):
+            continue  # a malformed peer row must not kill the trace
+        name = label.lstrip(" ")
+        depth = (len(label) - len(name)) // 2
+        if not coll._admit():
+            break
+        sp = Span(name, base + start_ms / 1e3)
+        sp.end = sp.start + dur_ms / 1e3
+        while len(stack) > 1 and stack[-1][0] >= depth:
+            stack.pop()
+        stack[-1][1].children.append(sp)
+        stack.append((depth, sp))
 
 
 # ---- dispatch-stage accounting ----------------------------------------------

@@ -27,12 +27,13 @@ import uuid
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from .. import obs
 from ..errno import CodedError
 from ..kv.backoff import BO_RPC, Backoffer, BackoffExhausted
 from ..util import failpoint
 from .errors import WIRE_ERRORS, LeaderUnavailable, RPCError
-from .frame import (FrameError, decode, encode, parse_addr, recv_frame,
-                    send_frame)
+from .frame import (TRACE_KEY, FrameError, decode, encode, make_trace_ctx,
+                    parse_addr, recv_frame, send_frame)
 
 
 @dataclass
@@ -57,6 +58,9 @@ class RpcOptions:
     stale_reads: bool = True
     # max bytes per wal_tail response
     tail_chunk: int = 4 << 20
+    # address a follower's diag listener binds (the per-server
+    # diagnostics endpoint peers query for cluster_* tables)
+    diag_listen: str = "127.0.0.1:0"
 
 
 class RpcClient:
@@ -86,6 +90,11 @@ class RpcClient:
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._want_heartbeat = _heartbeat
+        # extra params the heartbeat ping carries on every beat — the
+        # diag plane rides this to (re)register the follower's diag
+        # listener with the leader's membership registry, so a leader
+        # restart relearns the cluster shape within one lease interval
+        self.ping_params: dict = {}
 
     # ---- connection management --------------------------------------------
     def _connect(self) -> socket.socket:
@@ -149,6 +158,29 @@ class RpcClient:
                         f"{last!r}; {exhausted}") from None
 
     def _call_once(self, method: str, params: dict) -> dict:
+        # cross-server trace propagation: under an active TRACE the
+        # request carries (trace_id, parent_span_id) and the peer's span
+        # rows come back in the response to be stitched under this rpc
+        # span — the hop stops being an opaque wall-clock gap
+        coll = obs.active_collector()
+        spctx = obs.span(f"rpc.{method}")
+        sp = spctx.__enter__()
+        try:
+            resp = self._roundtrip(method, params, coll, sp)
+        finally:
+            spctx.__exit__(None, None, None)
+        if sp is not None and coll is not None:
+            remote_rows = resp.get("sp")
+            if remote_rows:
+                obs.stitch_remote_rows(coll, sp, remote_rows)
+        err = resp.get("err")
+        if err is not None:
+            cls = WIRE_ERRORS.get(err.get("type"), CodedError)
+            raise cls(err.get("msg", "rpc error"),
+                      errno=err.get("errno"))
+        return resp.get("r") or {}
+
+    def _roundtrip(self, method: str, params: dict, coll, sp) -> dict:
         with self._mu:
             if self._sock is None:
                 self._sock = self._connect()
@@ -165,8 +197,16 @@ class RpcClient:
             self._req_id += 1
             req_id = self._req_id
             self.calls += 1
-            payload = encode({"id": req_id, "m": method, "p": params,
-                              "c": self.client_id})
+            req = {"id": req_id, "m": method, "p": params,
+                   "c": self.client_id}
+            if sp is not None and coll is not None:
+                # the rpc span carries its Dapper span id; the remote
+                # root notes the same id as parent_span_id, so the two
+                # halves of the hop are linkable in the rendered tree
+                span_id = coll.alloc_span_id()
+                sp.note = f"span_id={span_id}"
+                req[TRACE_KEY] = make_trace_ctx(coll.trace_id, span_id)
+            payload = encode(req)
             self._send(sock, payload)
             # evaluated ONCE per request: a persistently-enabled point
             # must inject one duplicated response, not starve the real
@@ -190,13 +230,7 @@ class RpcClient:
                 # every response would pin a full tail chunk per client
                 if failpoint.is_enabled("rpc/stale-response"):
                     self._last_resp = raw
-                break
-        err = resp.get("err")
-        if err is not None:
-            cls = WIRE_ERRORS.get(err.get("type"), CodedError)
-            raise cls(err.get("msg", "rpc error"),
-                      errno=err.get("errno"))
-        return resp.get("r") or {}
+                return resp
 
     def _send(self, sock: socket.socket, payload: bytes) -> None:
         cut = failpoint.inject("rpc/partial-write")
@@ -226,7 +260,8 @@ class RpcClient:
             while not self._hb_stop.wait(interval):
                 try:
                     hb.call("ping", _budget_ms=min(
-                        self.options.backoff_budget_ms, 500))
+                        self.options.backoff_budget_ms, 500),
+                        **self.ping_params)
                     self.degraded = False
                     self.last_contact = time.monotonic()
                 except RPCError:

@@ -63,6 +63,33 @@ class WalOffsetMismatch(RPCError):
     sqlstate = "40001"
 
 
+def traced_response(rid, method: str, fn, trace_ctx) -> dict:
+    """The one traced-dispatch envelope both RPC servers answer with:
+    run `fn` (under a SpanCollector when the request carried trace
+    context), return {'id','r'[,'sp']} or the wire_error shape."""
+    from .. import obs
+    try:
+        result, spans = obs.run_remote_traced(
+            trace_ctx, f"remote.{method}", fn)
+        out = {"id": rid, "r": result}
+        if spans is not None:
+            out["sp"] = spans
+        return out
+    except Exception as e:  # noqa: BLE001 — keep the server alive
+        return wire_error(rid, e)
+
+
+def wire_error(rid, e: BaseException) -> dict:
+    """One server-side error as a response envelope — the single place
+    the err-dict wire shape is produced (CoordRPCServer and the diag
+    listeners both answer with it; WIRE_ERRORS re-raises it typed)."""
+    if isinstance(e, CodedError):
+        return {"id": rid, "err": {"type": type(e).__name__,
+                                   "msg": str(e), "errno": e.errno}}
+    return {"id": rid, "err": {"type": "RPCError",
+                               "msg": f"{type(e).__name__}: {e}"}}
+
+
 # wire name -> class, for re-raising a server-side error client-side
 WIRE_ERRORS = {
     "LeaderUnavailable": LeaderUnavailable,
@@ -74,4 +101,5 @@ WIRE_ERRORS = {
 
 
 __all__ = ["RPCError", "LeaderUnavailable", "StaleLeaseError",
-           "ResultUndetermined", "WalOffsetMismatch", "WIRE_ERRORS"]
+           "ResultUndetermined", "WalOffsetMismatch", "WIRE_ERRORS",
+           "wire_error", "traced_response"]

@@ -148,6 +148,28 @@ def recv_frame(sock: socket.socket) -> bytes:
     return _recv_exact(sock, n)
 
 
+# ---- trace context ---------------------------------------------------------
+# Dapper-style propagation (Sigelman et al., 2010): a request issued
+# under an active TRACE carries this key so the remote side can run its
+# handler under a SpanCollector and return its span rows for stitching.
+TRACE_KEY = "tc"
+
+
+def make_trace_ctx(trace_id: str, parent_span_id: int) -> dict:
+    return {"trace_id": str(trace_id),
+            "parent_span_id": int(parent_span_id)}
+
+
+def get_trace_ctx(req) -> Any:
+    """The request's trace context, or None when absent/malformed."""
+    if not isinstance(req, dict):
+        return None
+    tc = req.get(TRACE_KEY)
+    if isinstance(tc, dict) and tc.get("trace_id"):
+        return tc
+    return None
+
+
 # ---- addresses -------------------------------------------------------------
 def parse_addr(addr) -> tuple[int, Any]:
     """'host:port' / ('host', port) -> AF_INET; 'unix:/path' or a bare
@@ -164,4 +186,5 @@ def parse_addr(addr) -> tuple[int, Any]:
 
 
 __all__ = ["FrameError", "encode", "decode", "send_frame", "recv_frame",
-           "parse_addr", "MAX_FRAME"]
+           "parse_addr", "MAX_FRAME", "TRACE_KEY", "make_trace_ctx",
+           "get_trace_ctx"]

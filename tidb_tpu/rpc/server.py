@@ -31,9 +31,10 @@ import time
 from typing import Any, Optional
 
 from ..errno import CodedError
-from .errors import StaleLeaseError, WalOffsetMismatch
-from .frame import MAX_FRAME, FrameError, decode, encode, parse_addr, \
-    recv_frame, send_frame
+from .errors import RPCError, StaleLeaseError, WalOffsetMismatch, \
+    traced_response, wire_error
+from .frame import MAX_FRAME, FrameError, decode, encode, get_trace_ctx, \
+    parse_addr, recv_frame, send_frame
 
 # one tail response carries at most this many bytes; clients loop
 TAIL_CHUNK = 4 << 20
@@ -41,7 +42,8 @@ TAIL_CHUNK = 4 << 20
 
 class _Client:
     __slots__ = ("last_seen", "node_id", "node_fd", "last_seq",
-                 "last_seq_result", "kill_seq", "kill_result")
+                 "last_seq_result", "kill_seq", "kill_result",
+                 "diag_addr", "role", "diag_departed")
 
     def __init__(self) -> None:
         self.last_seen = time.monotonic()
@@ -51,6 +53,14 @@ class _Client:
         self.last_seq_result: Optional[int] = None
         self.kill_seq = -1
         self.kill_result: Optional[list] = None
+        # membership registry fields (the diag plane): where this
+        # client's diagnostics listener answers, and what role it plays;
+        # diag_departed latches on clean unregister so a straggler
+        # heartbeat (its ping was in flight during the peer's close)
+        # cannot resurrect the dead address
+        self.diag_addr: Optional[str] = None
+        self.role: Optional[str] = None
+        self.diag_departed = False
 
 
 class _Grant:
@@ -61,7 +71,101 @@ class _Grant:
         self.token = token
 
 
-class CoordRPCServer:
+class FrameListener:
+    """Frame-protocol server core shared by CoordRPCServer and the diag
+    listeners (rpc/diag.py): bind + accept loop, the per-connection
+    serve loop with the oversized-response guard (an over-MAX_FRAME
+    payload answers typed instead of tearing the stream — a torn stream
+    would make the client retry a deterministic failure), and a
+    teardown that wakes a blocked accept(). Subclasses implement
+    `_dispatch(req) -> response dict`."""
+
+    _thread_prefix = "titpu-frame"
+
+    def _start_listener(self, listen, backlog: int = 64):
+        """Bind + start accepting; returns (family, target) so the
+        subclass can compute its advertised address."""
+        self._shutdown = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_mu = threading.Lock()
+        fam, target = parse_addr(listen)
+        ls = socket.socket(fam, socket.SOCK_STREAM)
+        if fam == socket.AF_INET:
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(target)
+        ls.listen(backlog)
+        self._listener = ls
+        self.port = ls.getsockname()[1] if fam == socket.AF_INET else 0
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name=f"{self._thread_prefix}-accept", daemon=True)
+        self._accept_thread.start()
+        return fam, target
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            with self._conns_mu:
+                self._conns.add(sock)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             name=f"{self._thread_prefix}-conn",
+                             daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    req = decode(recv_frame(sock))
+                except (ConnectionError, FrameError, OSError):
+                    return  # torn stream: client reconnects
+                resp = self._dispatch(req)
+                payload = encode(resp)
+                if len(payload) > MAX_FRAME:
+                    payload = encode({"id": resp.get("id"), "err": {
+                        "type": "RPCError",
+                        "msg": f"response too large for one frame "
+                               f"({len(payload)} > {MAX_FRAME})"}})
+                try:
+                    send_frame(sock, payload)
+                except OSError:
+                    return
+        finally:
+            with self._conns_mu:
+                self._conns.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _close_listener(self) -> None:
+        self._shutdown.set()
+        try:
+            # wake a blocked accept() — closing the fd alone leaves the
+            # accept thread parked until a connection arrives
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_mu:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._accept_thread.join(timeout=5.0)
+
+
+class CoordRPCServer(FrameListener):
+    _thread_prefix = "titpu-rpc"
+
     def __init__(self, storage, listen="127.0.0.1:0",
                  lease_ms: int = 3000,
                  tail_chunk: int = TAIL_CHUNK) -> None:
@@ -84,79 +188,25 @@ class CoordRPCServer:
         # O_APPEND handle for remote records: interleaves safely with
         # the leader engine's own appends (both under the mutation flock)
         self._append_f = open(self._wal_path, "ab")
-        self._shutdown = threading.Event()
-        self._conns: set[socket.socket] = set()
-        fam, target = parse_addr(listen)
-        ls = socket.socket(fam, socket.SOCK_STREAM)
+        fam, target = self._start_listener(listen)
         if fam == socket.AF_INET:
-            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        ls.bind(target)
-        ls.listen(64)
-        self._listener = ls
-        self.port = ls.getsockname()[1] if fam == socket.AF_INET else 0
-        self.address = (f"127.0.0.1:{self.port}"
-                        if fam == socket.AF_INET else f"unix:{target}")
-        threading.Thread(target=self._accept_loop,
-                         name="titpu-rpc-accept", daemon=True).start()
+            # the advertised address doubles as the leader's dialable
+            # diag endpoint in members(); a wildcard bind can't name a
+            # single routable host, so loopback stands in and followers
+            # substitute the leader address they actually dialed
+            # (rpc/diag.py cluster_members)
+            host = self._listener.getsockname()[0]
+            if host in ("0.0.0.0", "::", ""):
+                host = "127.0.0.1"
+            self.address = f"{host}:{self.port}"
+        else:
+            self.address = f"unix:{target}"
         threading.Thread(target=self._reaper_loop,
                          name="titpu-rpc-reaper", daemon=True).start()
 
     # ---- lifecycle ---------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._shutdown.is_set():
-            try:
-                sock, _ = self._listener.accept()
-            except OSError:
-                break
-            with self._mu:
-                self._conns.add(sock)
-            threading.Thread(target=self._serve_conn, args=(sock,),
-                             name="titpu-rpc-conn", daemon=True).start()
-
-    def _serve_conn(self, sock: socket.socket) -> None:
-        try:
-            while not self._shutdown.is_set():
-                try:
-                    raw = recv_frame(sock)
-                    req = decode(raw)
-                except (ConnectionError, FrameError, OSError):
-                    return  # torn stream: client reconnects
-                resp = self._dispatch(req)
-                payload = encode(resp)
-                if len(payload) > MAX_FRAME:
-                    # never tear the connection down silently over an
-                    # oversized response — answer typed so the client
-                    # stops retrying a deterministic failure
-                    payload = encode({"id": resp.get("id"), "err": {
-                        "type": "RPCError",
-                        "msg": f"response too large for one frame "
-                               f"({len(payload)} > {MAX_FRAME})"}})
-                try:
-                    send_frame(sock, payload)
-                except OSError:
-                    return
-        finally:
-            with self._mu:
-                self._conns.discard(sock)
-            try:
-                sock.close()
-            except OSError:
-                pass
-
     def close(self) -> None:
-        self._shutdown.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        with self._mu:
-            conns = list(self._conns)
-            self._conns.clear()
-        for s in conns:
-            try:
-                s.close()
-            except OSError:
-                pass
+        self._close_listener()
         with self._mu:
             for name in list(self._grants):
                 self._release_locked(name)
@@ -188,25 +238,34 @@ class CoordRPCServer:
         params = req.get("p") or {}
         client_id = str(req.get("c") or "")
         handler = getattr(self, f"_h_{method}", None)
-        if handler is None:
-            return {"id": rid, "err": {"type": "RPCError",
-                                       "msg": f"unknown method {method}"}}
-        with self._mu:
-            c = self._clients.get(client_id)
-            if c is None:
-                c = self._clients[client_id] = _Client()
-            c.last_seen = time.monotonic()
-        try:
-            return {"id": rid, "r": handler(client_id, **params)}
-        except CodedError as e:
-            return {"id": rid, "err": {"type": type(e).__name__,
-                                       "msg": str(e), "errno": e.errno}}
-        except Exception as e:  # noqa: BLE001 — keep the server alive
-            return {"id": rid, "err": {"type": "RPCError",
-                                       "msg": f"{type(e).__name__}: {e}"}}
+        if handler is not None:
+            fn = lambda: handler(client_id, **params)  # noqa: E731
+            with self._mu:
+                c = self._clients.get(client_id)
+                if c is None:
+                    c = self._clients[client_id] = _Client()
+                c.last_seen = time.monotonic()
+        elif isinstance(method, str) and method.startswith("diag_"):
+            # the diag service (shared with follower DiagListeners)
+            # serves the leader's own diagnostics over this port;
+            # registry methods like diag_register keep _h_ handlers.
+            # NO _Client entry: diag fan-out callers are not cluster
+            # participants and must not inflate client_count()
+            fn = lambda: self.storage.diag.handle(method)  # noqa: E731
+        else:
+            return wire_error(rid, RPCError(f"unknown method {method}"))
+        # trace propagation: a request under an active client TRACE
+        # runs its handler beneath a SpanCollector and ships the span
+        # rows back for stitching (rpc/frame.py trace ctx)
+        return traced_response(rid, method, fn, get_trace_ctx(req))
 
     # ---- liveness ----------------------------------------------------------
-    def _h_ping(self, client_id: str) -> dict:
+    def _h_ping(self, client_id: str, diag_addr=None, role=None) -> dict:
+        # heartbeats may carry the sender's diag registration so a
+        # restarted leader relearns the membership within one beat
+        if diag_addr:
+            self._register_member(client_id, str(diag_addr),
+                                  str(role or "follower"))
         return {"ok": True, "lease_ms": self.lease_ms}
 
     def _h_hello(self, client_id: str) -> dict:
@@ -218,6 +277,58 @@ class CoordRPCServer:
             horizon = time.monotonic() - 3 * self.lease_ms / 1000.0
             return sum(1 for c in self._clients.values()
                        if c.last_seen >= horizon)
+
+    # ---- membership registry (the diag plane) ------------------------------
+    def _register_member(self, client_id: str, addr: str,
+                         role: str) -> None:
+        with self._mu:
+            c = self._clients.get(client_id)
+            if c is None:
+                c = self._clients[client_id] = _Client()
+            if c.diag_departed:
+                return  # cleanly closed; a straggler ping can't rejoin
+            c.diag_addr, c.role = addr, role
+
+    def _h_diag_register(self, client_id: str, addr: str = "",
+                         role: str = "follower") -> dict:
+        self._register_member(client_id, str(addr), str(role))
+        return {}
+
+    def _h_diag_unregister(self, client_id: str) -> dict:
+        """Clean shutdown: drop the member now instead of letting the
+        cluster_* fan-out burn its budget against the closed address
+        until the lease horizon passes."""
+        with self._mu:
+            c = self._clients.get(client_id)
+            if c is None:
+                c = self._clients[client_id] = _Client()
+            c.diag_addr = c.role = None
+            c.diag_departed = True
+        return {}
+
+    def members(self) -> list[dict]:
+        """Cluster shape: the leader itself plus every registered client
+        with a diag address, tagged with heartbeat age so operators (and
+        the cluster_* fan-out) can judge liveness. The same 3-lease
+        horizon client_count applies bounds how long a crashed peer
+        keeps contributing error rows — past it the peer has departed."""
+        out = [{"id": 0, "addr": self.address, "role": "leader",
+                "hb_age_s": 0.0}]
+        now = time.monotonic()
+        horizon = 3 * self.lease_ms / 1000.0
+        with self._mu:
+            for c in self._clients.values():
+                age = now - c.last_seen
+                if c.diag_addr and age <= horizon:
+                    out.append({
+                        "id": c.node_id if c.node_id is not None else -1,
+                        "addr": c.diag_addr,
+                        "role": c.role or "follower",
+                        "hb_age_s": round(age, 3)})
+        return out
+
+    def _h_members(self, client_id: str) -> dict:
+        return {"members": self.members()}
 
     # ---- TSO ---------------------------------------------------------------
     def _h_tso_next(self, client_id: str) -> dict:
@@ -434,4 +545,4 @@ class CoordRPCServer:
         return {"kills": kills}
 
 
-__all__ = ["CoordRPCServer", "TAIL_CHUNK"]
+__all__ = ["CoordRPCServer", "FrameListener", "TAIL_CHUNK"]

@@ -160,6 +160,9 @@ def main(argv: list[str] | None = None) -> int:
         storage = Storage(cfg.path or None,
                           shared=getattr(args, 'shared', False))
     cfg.seed_sysvars(storage)
+    storage.metrics_history.configure(
+        interval_s=cfg.performance.metrics_history_interval,
+        cap=cfg.performance.metrics_history_cap)
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
                  max_connections=cfg.max_connections,

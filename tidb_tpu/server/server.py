@@ -179,6 +179,13 @@ class Server:
             t = threading.Thread(target=self._kill_mailbox_loop,
                                  name="kill-mailbox", daemon=True)
             t.start()
+        # a serving deployment samples its metrics ring in the
+        # background (embedded stores sample on demand); the thread is
+        # joined by Storage.close(), not here — the store outlives a
+        # server restart
+        history = getattr(self.storage, "metrics_history", None)
+        if history is not None:
+            history.start()
         if self.status_port is not None:
             from .status import StatusServer
             self._status_server = StatusServer(self.status_host,

@@ -9,6 +9,9 @@ Debug routes:
   /debug/trace/<conn_id>  last TRACE span tree of that connection (JSON)
   /debug/profile?seconds=0.5&hz=97  one-shot whole-process sampling
       profile: hot frames + flamegraph-style call tree (JSON)
+  /debug/metrics/history  the MetricsHistory ring: timestamped
+      counter/gauge samples (JSON; cadence/size via the
+      performance.metrics-history-* config knobs)
 """
 
 from __future__ import annotations
@@ -36,7 +39,10 @@ class StatusServer:
                               if outer.sql_server else obs.DEFAULT)
                 if self.path == "/metrics":
                     # this server's registry + the process-wide one
-                    # (disjoint families: copr/device counters only)
+                    # (disjoint families: copr/device counters only);
+                    # probes refresh the sampled gauges (device buffer
+                    # bytes, jit entries, RSS) at scrape time
+                    obs.run_gauge_probes()
                     body = (server_obs.render()
                             + obs.PROCESS_METRICS.render()).encode()
                     ctype = "text/plain; version=0.0.4"
@@ -77,6 +83,19 @@ class StatusServer:
                         self.end_headers()
                         return
                     body = json.dumps(tr).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/metrics/history"):
+                    hist = (getattr(outer.sql_server.storage,
+                                    "metrics_history", None)
+                            if outer.sql_server else None)
+                    if hist is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps({
+                        "interval_s": hist.interval_s,
+                        "samples": hist.snapshot(),
+                    }).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/debug/profile"):
                     q = parse_qs(urlparse(self.path).query)

@@ -130,6 +130,13 @@ class Session:
         # SHOW PROFILES / SHOW PROFILE / information_schema.profiling
         self._profiles: list[dict] = []
         self._profile_seq = 0
+        # per-statement warnings (SHOW WARNINGS): degraded cluster_*
+        # fan-outs report unreachable peers here instead of failing
+        self.warnings: list[tuple[str, int, str]] = []
+
+    def add_warning(self, message: str, code: int = 1105,
+                    level: str = "Warning") -> None:
+        self.warnings.append((level, code, message))
 
     # ==================== public API ====================
     def execute(self, sql: str) -> ResultSet:
@@ -199,6 +206,15 @@ class Session:
         # the socket)
         self.killed.clear()
         interrupt.install(self.killed)
+        # warnings reset per statement — except SHOW WARNINGS and
+        # table-less SELECTs (SELECT @@warning_count, SELECT 1), which
+        # MySQL defines as reading the PREVIOUS statement's list
+        preserves_warnings = (
+            (isinstance(stmt, ast.ShowStmt) and stmt.kind == "WARNINGS")
+            or (isinstance(stmt, ast.SelectStmt)
+                and not self._collect_table_names(stmt)))
+        if not preserves_warnings:
+            self.warnings = []
         # processlist state (SHOW PROCESSLIST reads these from siblings)
         self.in_flight_sql = sql[:256]
         self.in_flight_since = _time.time()
@@ -621,6 +637,10 @@ class Session:
     def _sysvar_value(self, name: str, scope: str = "SESSION") -> Any:
         from .sysvars import SYSVARS
 
+        if name == "warning_count" and scope != "GLOBAL":
+            # computed per statement (MySQL: clients gate their SHOW
+            # WARNINGS fetch on it), like error_count/found_rows
+            return len(self.warnings)
         if scope != "GLOBAL" and name in self.vars:
             return self.vars[name]
         v = self.storage.sysvars.get_global(name)
@@ -969,7 +989,7 @@ class Session:
 
     # ==================== information_schema ====================
     _VIEWER_SENSITIVE_IS = frozenset({"processlist", "user_privileges",
-                                      "profiling"})
+                                      "profiling", "cluster_processlist"})
 
     def _refresh_infoschema(self, stmt) -> None:
         """Rebuild any information_schema tables this statement touches
@@ -2947,7 +2967,8 @@ class Session:
                   f"CREATE VIEW `{v.name}` AS {v.sql}",
                   "utf8mb4", "utf8mb4_bin")])
         if stmt.kind == "WARNINGS":
-            return ResultSet(["Level", "Code", "Message"], [])
+            return ResultSet(["Level", "Code", "Message"],
+                             [tuple(w) for w in self.warnings])
         if stmt.kind == "ENGINES":
             return ResultSet(
                 ["Engine", "Support", "Comment", "Transactions", "XA",

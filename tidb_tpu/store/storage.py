@@ -108,20 +108,56 @@ class Storage:
         if self._owns_tmp_dir:
             import tempfile
             path = tempfile.mkdtemp(prefix="titpu-follower-")
+        import time as _time
+
         self.path = path
         self.remote = remote is not None
         self.shared = bool((shared or self.remote) and path is not None)
         self.coord = None
         self.rpc_server = None
         self._rpc_client = None
+        self._rpc_options = rpc_options
+        self._start_time = _time.time()
+        self.diag_listener = None
+        # diag fan-out state, owned here so concurrent first queries
+        # never race a lazy init (rpc/diag.py uses these)
+        self._diag_clients: dict = {}
+        self._diag_clients_lock = threading.Lock()
+        self._last_members = None
+        self._last_members_ts = -1e9
         if self.remote:
             from ..rpc.client import RpcClient, RpcOptions
+            from ..rpc.diag import DiagListener
             from ..rpc.remote import RemoteCoordinator
-            opts = rpc_options or RpcOptions()
+            opts = self._rpc_options = rpc_options or RpcOptions()
             self._rpc_client = RpcClient(remote, opts)
             self._rpc_client.call("hello")  # fail fast on a dead leader
-            self._rpc_client.start_heartbeat()
-            self.coord = RemoteCoordinator(self._rpc_client, opts)
+            # the diagnostics endpoint peers query for cluster_* rows;
+            # registered with the leader now and re-announced on every
+            # heartbeat (a restarted leader relearns the cluster shape)
+            try:
+                self.diag_listener = DiagListener(self, opts.diag_listen)
+                self._rpc_client.ping_params = {
+                    "diag_addr": self.diag_listener.address,
+                    "role": "follower"}
+                from ..rpc.errors import RPCError as _RPCError
+                try:
+                    self._rpc_client.call(
+                        "diag_register",
+                        addr=self.diag_listener.address,
+                        role="follower", _budget_ms=1000)
+                except _RPCError:
+                    pass  # the next heartbeat re-registers
+                self._rpc_client.start_heartbeat()
+                self.coord = RemoteCoordinator(self._rpc_client, opts)
+            except BaseException:
+                # a failed join must not leak the accept thread, the
+                # bound socket, or the connected coordination client
+                # (callers have no Storage to close)
+                if self.diag_listener is not None:
+                    self.diag_listener.close()
+                self._rpc_client.close()
+                raise
         elif self.shared:
             from .coordinator import SharedDirCoordinator
             self.coord = SharedDirCoordinator(path)
@@ -129,8 +165,22 @@ class Storage:
         # per-server observability (metrics/slow log/statement digests);
         # module-global singletons clobbered each other when two servers
         # shared a process (round-2 verdict weak #6)
+        from .. import obs as _obs
         from ..obs import Observability
         self.obs = Observability()
+        # per-server diagnostics service (the diag/* RPC plane answers
+        # from it; local stores query it directly for cluster_* tables)
+        from ..rpc.diag import DiagService
+        if self.diag_listener is not None:
+            self.diag = self.diag_listener.service
+        else:
+            self.diag = DiagService(self)
+        # bounded time-series of counter/gauge samples feeding
+        # information_schema.metrics_summary + /debug/metrics/history.
+        # The background thread starts with the serving Server (embedded
+        # stores sample on demand), and Storage.close() always joins it.
+        self.metrics_history = _obs.MetricsHistory(
+            [self.obs.metrics, _obs.PROCESS_METRICS])
         self._tso_lease = 0
         if path is not None:
             os.makedirs(os.path.join(path, "epochs"), exist_ok=True)
@@ -144,7 +194,14 @@ class Storage:
             # section (rpc/remote.py)
             from ..rpc.remote import RemoteKV
             engine = RemoteKV(self._rpc_client)
-            engine.bootstrap()
+            try:
+                engine.bootstrap()
+            except BaseException:
+                # same no-leak contract as the join block above: a
+                # failed WAL mirror leaves no listener/heartbeat behind
+                self.diag_listener.close()
+                self._rpc_client.close()
+                raise
             self.coord.engine = engine
         elif self.shared:
             # the shared-WAL refresh protocol lives in the Python engine;
@@ -253,7 +310,7 @@ class Storage:
                     "server (a follower cannot re-serve the store)")
             from ..rpc.client import RpcOptions
             from ..rpc.server import CoordRPCServer
-            opts = rpc_options or RpcOptions()
+            opts = self._rpc_options = rpc_options or RpcOptions()
             self.rpc_server = CoordRPCServer(self, listen=rpc_listen,
                                              lease_ms=opts.lease_ms,
                                              tail_chunk=opts.tail_chunk)
@@ -610,23 +667,60 @@ class Storage:
             self._maintenance = MaintenanceWorker(self, self.catalog)
         return self._maintenance
 
+    @property
+    def diag_address(self) -> str:
+        """Where THIS server's diag service answers: the leader serves
+        it on the coordination port, a follower on its diag listener."""
+        if self.rpc_server is not None:
+            return self.rpc_server.address
+        if self.diag_listener is not None:
+            return self.diag_listener.address
+        return ""
+
     def transport_health(self) -> dict:
         """Multi-process transport state for the status port (reference:
-        http_status.go exposes store health the same way)."""
+        http_status.go exposes store health the same way). Socket modes
+        include the membership view — peer id, diag address, role,
+        last-heartbeat age — so operators see the cluster shape without
+        SQL (the same registry the cluster_* tables fan out over)."""
         if self.remote:
             h = self._rpc_client.health()
             h["mode"] = "socket-follower"
             h["node_id"] = self.coord.node_id
+            h["diag_address"] = self.diag_address
+            from ..rpc.diag import cluster_members
+            h["members"] = cluster_members(self, budget_ms=500)
             return h
         if self.rpc_server is not None:
             return {"mode": "socket-leader",
                     "address": self.rpc_server.address,
-                    "clients": self.rpc_server.client_count()}
+                    "clients": self.rpc_server.client_count(),
+                    "members": self.rpc_server.members()}
         if self.shared:
             return {"mode": "shared-dir", "node_id": self.coord.node_id}
         return {"mode": "local"}
 
     def close(self) -> None:
+        # diagnostics plane first: the history sampler and the follower
+        # diag listener are joined here so no thread outlives the store
+        # (the profiler-lifecycle contract tests/test_trace.py pins)
+        self.metrics_history.stop()
+        if self.diag_listener is not None:
+            from ..rpc.errors import RPCError as _RPCError
+            # stop announcing BEFORE deregistering: a heartbeat firing
+            # between the unregister and the client teardown below
+            # would re-register the closed address for a lease horizon
+            self._rpc_client.ping_params = {}
+            try:
+                # best-effort deregistration so peers stop fanning out
+                # to the closed address (otherwise they pay the diag
+                # budget per query until the lease horizon passes)
+                self._rpc_client.call("diag_unregister", _budget_ms=500)
+            except _RPCError:
+                pass
+            self.diag_listener.close()
+        from ..rpc.diag import close_peer_clients
+        close_peer_clients(self)
         if self._maintenance is not None:
             self._maintenance.stop()
         if self.rpc_server is not None:
