@@ -11,8 +11,10 @@ backend has been initialized yet at conftest import time.
 """
 
 import os
+import time
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 try:
@@ -22,3 +24,121 @@ except AttributeError:
     # initialization (which has not happened yet at conftest import)
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running kill-9 chaos/torture tests (tier-1 runs "
+        "with -m 'not slow')")
+
+
+# ---------------------------------------------------------------------------
+# leak guard: no orphaned child server processes, no leaked listeners
+# ---------------------------------------------------------------------------
+# The chaos/torture suites spawn real server processes and bind real
+# sockets; a test that forgets its teardown poisons every later test
+# (ports exhausted, zombies holding store flocks). This autouse guard
+# snapshots both planes around every test and FAILS the test that
+# leaked — the hygiene contract the kill-9 harness relies on.
+
+def _cmdline(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(
+                "utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _child_pids() -> set[int]:
+    me = str(os.getpid())
+    out = set()
+    try:
+        pids = os.listdir("/proc")
+    except OSError:
+        return out
+    for pid in pids:
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                data = f.read()
+            # comm may contain anything — fields restart after the
+            # final ')': [state, ppid, ...]
+            if data.rsplit(")", 1)[1].split()[1] == me:
+                out.add(int(pid))
+        except (OSError, IndexError):
+            continue
+    return out
+
+
+def _listen_inodes() -> set[str]:
+    """Socket inodes THIS process holds that are in LISTEN state."""
+    fds = set()
+    try:
+        for fd in os.listdir("/proc/self/fd"):
+            try:
+                tgt = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                continue
+            if tgt.startswith("socket:["):
+                fds.add(tgt[8:-1])
+    except OSError:
+        return set()
+    listening = set()
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                next(f, None)
+                for line in f:
+                    parts = line.split()
+                    if len(parts) > 9 and parts[3] == "0A":  # LISTEN
+                        listening.add(parts[9])
+        except OSError:
+            continue
+    return fds & listening
+
+
+# the previous test's clean after-scan doubles as the next test's
+# before-scan, halving the per-test /proc cost; invalidated whenever a
+# test fails the guard (its debris must not become the new baseline)
+_prev_scan: list = [None]
+
+
+@pytest.fixture(autouse=True)
+def _no_orphans_or_leaked_listeners(request):
+    if _prev_scan[0] is not None:
+        before_children, before_listen = _prev_scan[0]
+    else:
+        before_children = _child_pids()
+        before_listen = _listen_inodes()
+    yield
+    # daemonic teardown (accept threads, reaped children) needs a
+    # moment; only what SURVIVES the grace window is a leak.
+    # multiprocessing's resource/semaphore trackers are process-lifetime
+    # singletons, not leaks (cmdline is read only for NEW pids — the
+    # common all-clean path stays at one /proc stat scan)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        after_children = _child_pids()
+        after_listen = _listen_inodes()
+        new_children = {
+            p for p in after_children - before_children
+            if "resource_tracker" not in _cmdline(p)
+            and "semaphore_tracker" not in _cmdline(p)}
+        new_listen = after_listen - before_listen
+        if not new_children and not new_listen:
+            _prev_scan[0] = (after_children, after_listen)
+            return
+        time.sleep(0.1)
+    _prev_scan[0] = None  # debris found: rescan fresh next test
+    problems = []
+    if new_children:
+        cmds = [f"{pid}: {_cmdline(pid)[:120]}"
+                for pid in sorted(new_children)]
+        problems.append(f"orphaned child processes: {cmds}")
+    if new_listen:
+        problems.append(
+            f"leaked listening sockets (inodes): {sorted(new_listen)}")
+    pytest.fail(f"test left cluster debris behind — {'; '.join(problems)}")

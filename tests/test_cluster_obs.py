@@ -414,7 +414,9 @@ def test_shutdown_leaves_no_diag_threads(tmp_path):
     assert _diag_threads()  # sampler + follower listener are live
     follower.close()
     leader.close()
-    deadline = time.monotonic() + 5.0
+    # generous deadline: on a loaded CI box the joins themselves are
+    # slow; what matters is that they HAPPEN (no thread survives)
+    deadline = time.monotonic() + 15.0
     while _diag_threads() and time.monotonic() < deadline:
         time.sleep(0.05)
     assert _diag_threads() == []  # close() joined them, nothing leaked

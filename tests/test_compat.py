@@ -45,6 +45,32 @@ def test_global_scope_crosses_sessions_and_set_global_rules():
         s.execute("SET no_such_var_at_all = 1")
 
 
+def test_max_execution_time_enforced():
+    """@@max_execution_time is a real deadline, not decoration: an
+    expired SELECT dies with MySQL error 3024 at the next interrupt
+    checkpoint (the same plane KILL QUERY rides), and DML is exempt
+    (MySQL scopes the variable to read-only statements)."""
+    import time as _time
+
+    s = Session()
+    s.execute("CREATE TABLE met (id INT PRIMARY KEY)")
+    s.execute("INSERT INTO met VALUES (1)")
+    s.execute("SET max_execution_time = 80")
+    t0 = _time.monotonic()
+    with pytest.raises(SQLError) as exc:
+        s.query("SELECT SLEEP(30)")
+    assert _time.monotonic() - t0 < 10, "deadline did not fire promptly"
+    assert exc.value.errno == 3024
+    assert "maximum statement execution time" in str(exc.value)
+    # a statement under the limit is untouched, and the deadline does
+    # not leak into the next statement
+    assert s.query("SELECT id FROM met") == [(1,)]
+    s.execute("INSERT INTO met VALUES (2)")  # DML exempt
+    # 0 disables
+    s.execute("SET max_execution_time = 0")
+    assert s.query("SELECT SLEEP(0.01)") == [(0,)]
+
+
 def test_alter_user_set_password_rename_user(server):
     root = MiniClient("127.0.0.1", server.port)
     root.execute("create user 'pw1' identified by 'first'")

@@ -55,6 +55,21 @@ class PerformanceConfig:
 
 
 @dataclass
+class StorageConfig:
+    """Durability policy of the KV WAL (reference: TiKV's
+    raftstore.sync-log — the knob that decides whether an acknowledged
+    commit can die with the machine)."""
+
+    # off      — flush to the OS only; process death loses nothing,
+    #            power loss may lose acked commits
+    # commit   — fsync at every commit boundary (no acked-commit loss)
+    # interval — group commit: at most one fsync per sync-interval-ms,
+    #            shared by every commit inside the window
+    sync_log: str = "commit"
+    sync_interval_ms: int = 100
+
+
+@dataclass
 class PlanCacheConfig:
     enabled: bool = True
     capacity: int = 128
@@ -105,6 +120,16 @@ class TransportConfig:
     # other hosts must set a SPECIFIC routable address (the bound host
     # is what peers dial, so wildcards like 0.0.0.0 are rejected)
     diag_listen: str = "127.0.0.1:0"
+    # automatic failover: a follower whose leader heartbeat has failed
+    # continuously for this long runs the deterministic election
+    # (longest replicated WAL wins, ties to the lowest node id) and
+    # either promotes in place or repoints to the winner. 0 disables —
+    # followers then stay degraded read-only until the leader returns.
+    election_timeout_ms: int = 10000
+    # the address this follower serves coordination RPC on IF it wins
+    # an election (peers repoint to the bound host:port, so multi-host
+    # clusters need a routable host here)
+    promote_listen: str = "127.0.0.1:0"
 
 
 @dataclass
@@ -117,6 +142,7 @@ class Config:
     default_db: str = "test"
     lease: str = "45s"               # schema lease (reference: --lease)
     log: LogConfig = field(default_factory=LogConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     status: StatusConfig = field(default_factory=StatusConfig)
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
@@ -192,6 +218,16 @@ class Config:
                      "backoff_budget_ms", "lock_budget_ms", "lease_ms"):
             if getattr(t, knob) <= 0:
                 raise ConfigError(f"transport.{knob} must be > 0")
+        if t.election_timeout_ms < 0:
+            raise ConfigError(
+                "transport.election-timeout-ms must be >= 0 "
+                "(0 disables automatic failover)")
+        if self.storage.sync_log not in ("off", "commit", "interval"):
+            raise ConfigError(
+                f"storage.sync-log must be off|commit|interval, got "
+                f"{self.storage.sync_log!r}")
+        if self.storage.sync_interval_ms <= 0:
+            raise ConfigError("storage.sync-interval-ms must be > 0")
 
     # ---- hot reload ----------------------------------------------------
     # keys that may change at runtime (reference: the hot-reloadable
@@ -242,6 +278,8 @@ class Config:
             lease_ms=t.lease_ms,
             stale_reads=t.stale_reads,
             diag_listen=t.diag_listen,
+            election_timeout_ms=t.election_timeout_ms,
+            promote_listen=t.promote_listen,
         )
 
     # ---- sysvar seeding ------------------------------------------------
@@ -371,6 +409,16 @@ slow-threshold = 300           # ms; statements slower than this are logged
 slow-query-file = ""
 format = "text"
 
+[storage]
+# When the KV write-ahead log reaches disk (the acked-commit loss
+# window under POWER loss; process crashes lose nothing either way):
+#   off      — flush to the OS only
+#   commit   — fsync at every commit boundary (no acked-commit loss)
+#   interval — group commit: at most one fsync per sync-interval-ms,
+#              amortized over every commit inside the window
+sync-log = "commit"
+sync-interval-ms = 100
+
 [status]
 report-status = true           # expose /status /metrics /slow-query
 status-host = "0.0.0.0"
@@ -421,6 +469,15 @@ diag-listen = "127.0.0.1:0"    # follower diagnostics endpoint
                                # peers dial the bound host, so use a
                                # specific routable address — wildcards
                                # like 0.0.0.0 are rejected)
+# Automatic leader failover: after the leader heartbeat has failed for
+# election-timeout-ms, followers elect deterministically (longest
+# replicated WAL wins, ties to the lowest node id); the winner
+# promotes in place on promote-listen with a bumped fencing term, and
+# survivors repoint. 0 disables failover (followers stay degraded
+# read-only until the leader returns).
+election-timeout-ms = 10000
+promote-listen = "127.0.0.1:0" # coordination address if promoted
+                               # (use a routable host across machines)
 
 [security]
 skip-grant-table = false

@@ -37,6 +37,113 @@ class KVError(Exception):
     pass
 
 
+def fsync_dir(path: str) -> None:
+    """Durable-rename helper: fsync the DIRECTORY so a tmp+rename
+    sequence survives power loss (the rename itself lives in the
+    directory's metadata; fsyncing only the file leaves the old name
+    recoverable)."""
+    import os
+    fd = os.open(path or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class SyncPolicy:
+    """THE storage.sync-log policy evaluator, shared by every WAL-ish
+    sink (engine WAL, native engine, follower mirror, leader-side
+    remote appends) so the policy lives in one place:
+
+      off      — never fsync (flushing to the OS is the caller's job)
+      commit   — fsync at every boundary() call; an fsync failure
+                 PROPAGATES so the commit is never acked undurable
+      interval — group commit: at most one fsync per interval_ms. The
+                 tail burst before an idle period is covered by a
+                 deferred one-shot flush timer, so the loss window is
+                 genuinely bounded by interval_ms, not by when the
+                 next commit happens to arrive.
+
+    `fsync` is the sink's own durability callable; it must tolerate
+    being invoked after close (the deferred timer may race teardown).
+    """
+
+    __slots__ = ("policy", "interval_ms", "_fsync", "_lock", "_last",
+                 "_dirty", "_timer", "_closed")
+
+    def __init__(self, policy: str, interval_ms: int, fsync) -> None:
+        self.policy = policy
+        self.interval_ms = interval_ms
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._last = 0.0
+        self._dirty = False
+        self._timer = None
+        self._closed = False
+
+    def mark_dirty(self) -> None:
+        self._dirty = True
+
+    def boundary(self) -> None:
+        """Commit-boundary hook. OSError from the sink propagates (the
+        caller must not ack a commit whose durability failed)."""
+        if not self._dirty or self.policy == "off":
+            return
+        if self.policy == "commit":
+            self.flush()
+            return
+        import time as _time
+        now = _time.monotonic()
+        with self._lock:
+            due = now - self._last >= self.interval_ms / 1000.0
+            if not due:
+                if self._timer is None and not self._closed:
+                    # cover the tail burst: without this, commits that
+                    # land inside the window and are followed by idle
+                    # time would stay un-fsynced indefinitely
+                    delay = self.interval_ms / 1000.0 - (now - self._last)
+                    t = threading.Timer(max(delay, 0.001),
+                                        self._deferred_flush)
+                    t.daemon = True
+                    t.name = "titpu-sync-flush"
+                    self._timer = t
+                    t.start()
+                return
+        self.flush()
+
+    def _deferred_flush(self) -> None:
+        with self._lock:
+            self._timer = None
+            if self._closed:
+                return
+        if self._dirty:
+            try:
+                self.flush()
+            except OSError:
+                pass  # still dirty: the next boundary retries loudly
+
+    def flush(self) -> None:
+        """Unconditional sync-now (checkpoint/close path too)."""
+        import time as _time
+        self._fsync()
+        with self._lock:
+            self._dirty = False
+            self._last = _time.monotonic()
+
+    def clean(self) -> None:
+        """The sink was made durable by other means (checkpoint wrote
+        and fsynced a snapshot; the WAL restarted empty)."""
+        with self._lock:
+            self._dirty = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            t, self._timer = self._timer, None
+        if t is not None:
+            t.cancel()
+
+
 @dataclass
 class LockInfo:
     key: bytes
@@ -78,13 +185,25 @@ class PyOrderedKV:
     record layout in kvstore.cpp write_rec), so either engine can reopen
     a directory the other wrote."""
 
-    def __init__(self, path=None, shared: bool = False) -> None:
+    def __init__(self, path=None, shared: bool = False,
+                 sync_log: str = "off",
+                 sync_interval_ms: int = 100) -> None:
         self._maps: list[dict[bytes, bytes]] = [{}, {}, {}]
         self._keys: list[list[bytes]] = [[], [], []]
         self._dir = None
         self._wal = None
         self._shared = shared
         self._applied_off = 0
+        # durability policy (storage.sync-log): 'off' flushes to the OS
+        # only (a machine crash can lose acked commits), 'commit' fsyncs
+        # at every commit boundary, 'interval' group-commits — at most
+        # one fsync per sync_interval_ms, amortized over the commits
+        # that landed inside the window (reference: TiKV raftstore
+        # sync-log / raft-store.store-io-pool batching)
+        self.sync_log = sync_log
+        self.sync_interval_ms = sync_interval_ms
+        self._syncer = SyncPolicy(sync_log, sync_interval_ms,
+                                  self._fsync_wal)
         # records applied by refresh() that the Storage layer has not yet
         # folded into columnar epochs / catalog (shared mode only)
         self.pending_refresh: list[tuple[int, int, bytes, bytes]] = []
@@ -136,8 +255,22 @@ class PyOrderedKV:
         if self._wal is not None:
             rec = struct.pack("<BBII", op, cf, len(key),
                               len(value)) + key + value
-            self._wal.write(rec)
+            from ..util import failpoint
+            if failpoint.is_enabled("kv/wal-torn-append"):
+                # crash-injection site: half the record reaches the file,
+                # then the armed action fires (the torture harness arms
+                # exit(N)@K here — a kill-9 mid-append). An inert hit
+                # falls through and writes the remainder, keeping the
+                # stream whole.
+                half = rec[:max(1, len(rec) // 2)]
+                self._wal.write(half)
+                self._wal.flush()
+                failpoint.inject("kv/wal-torn-append")
+                self._wal.write(rec[len(half):])
+            else:
+                self._wal.write(rec)
             self._wal.flush()
+            self._syncer.mark_dirty()
             # shared mode: our own appends are already in memory — advance
             # the tail cursor so refresh() skips them. Writes happen only
             # inside the coordinator section after refresh(), so the
@@ -221,17 +354,35 @@ class PyOrderedKV:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self._dir, "snapshot.kv"))
+        # the rename must be durable BEFORE the WAL truncates: a crash
+        # between the two otherwise leaves the old snapshot + an empty
+        # WAL — every record folded into the new snapshot gone
+        fsync_dir(self._dir)
         self._wal.close()
         self._wal = open(os.path.join(self._dir, "wal.log"), "wb")
+        self._syncer.clean()  # the fsync'd snapshot covers everything
+
+    def _fsync_wal(self) -> None:
+        import os
+        wal = self._wal
+        if wal is not None and not wal.closed:
+            wal.flush()
+            os.fsync(wal.fileno())
 
     def sync(self) -> None:
         if self._wal is not None:
-            import os
+            self._syncer.flush()
 
-            self._wal.flush()
-            os.fsync(self._wal.fileno())
+    def maybe_sync(self) -> None:
+        """Commit-boundary durability hook (called at every mutation
+        section exit): fsync per the sync-log policy. 'interval' mode is
+        the group commit — commits inside the window share one fsync,
+        and the tail burst is covered by SyncPolicy's deferred flush."""
+        if self._wal is not None:
+            self._syncer.boundary()
 
     def close(self) -> None:
+        self._syncer.close()
         if self._wal is not None:
             self._wal.close()
             self._wal = None
@@ -740,13 +891,17 @@ class _MutationSection:
     plus the in-process mutex, entered with the shared WAL caught up so
     conflict checks see every sibling process's records."""
 
-    __slots__ = ("store",)
+    __slots__ = ("store", "_coord")
 
     def __init__(self, store: MVCCStore) -> None:
         self.store = store
+        self._coord = None
 
     def __enter__(self):
-        c = self.store.coord
+        # capture the coordinator ONCE: a leader promotion swaps
+        # store.coord mid-flight, and releasing a coordinator this
+        # section never acquired would corrupt both coordinators' state
+        c = self._coord = self.store.coord
         if c is not None:
             c.acquire()
             self.store.kv.refresh()
@@ -755,14 +910,34 @@ class _MutationSection:
         return self
 
     def __exit__(self, *exc) -> None:
-        # coordinator release FIRST, while the engine mutex is still
+        # durability BEFORE visibility to siblings: the section's
+        # records fsync per the sync-log policy while the flock is
+        # still held, so no other process can act on a commit this
+        # process could still lose to a crash. A FAILED fsync must not
+        # strand the locks below — but it must still FAIL the section
+        # (re-raised after teardown): acking a commit whose durability
+        # call errored would quietly void the sync-log=commit contract.
+        c = self._coord
+        sync_err: Optional[OSError] = None
+        try:
+            sync = getattr(self.store.kv, "maybe_sync", None)
+            if sync is not None:
+                sync()
+        except OSError as e:
+            sync_err = e
+        # coordinator release NEXT, while the engine mutex is still
         # held: a remote coordinator publishes (or reverts) the
         # section's buffered records in release(), and doing that
         # outside the mutex would let a concurrent local reader observe
         # a commit that a fenced flush then reverts
-        c = self.store.coord
         try:
             if c is not None:
                 c.release()
         finally:
             self.store._mu.release()
+        if sync_err is not None and exc == (None, None, None):
+            # surface only on the success path (never mask the original
+            # exception already unwinding through this section)
+            raise KVError(
+                f"WAL fsync failed at commit boundary: {sync_err}"
+            ) from sync_err

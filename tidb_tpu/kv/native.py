@@ -87,7 +87,9 @@ class NativeOrderedKV:
     into a snapshot file (truncating the WAL). The file format is shared
     with the Python twin, so either engine reopens the other's directory."""
 
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(self, path: Optional[str] = None,
+                 sync_log: str = "off",
+                 sync_interval_ms: int = 100) -> None:
         self._lib = _load()
         if path is not None:
             Path(path).mkdir(parents=True, exist_ok=True)
@@ -97,16 +99,39 @@ class NativeOrderedKV:
         else:
             self._h = self._lib.kv_open()
         self._mu = threading.Lock()
+        self._durable = path is not None
+        # same storage.sync-log policy the Python twin honors, via the
+        # SAME shared evaluator (mvcc.SyncPolicy — commit/interval
+        # semantics, deferred tail flush); the C++ engine exposes one
+        # kv_sync entry point, so dirtiness is tracked here (every
+        # put/delete under a durable dir dirties)
+        from .mvcc import SyncPolicy
+        self.sync_log = sync_log
+        self.sync_interval_ms = sync_interval_ms
+        self._syncer = SyncPolicy(sync_log, sync_interval_ms,
+                                  self._fsync_native)
+
+    def _fsync_native(self) -> None:
+        with self._mu:
+            if self._h:
+                self._lib.kv_sync(self._h)
 
     def checkpoint(self) -> None:
         with self._mu:
             self._lib.kv_checkpoint(self._h)
+        self._syncer.clean()
 
     def sync(self) -> None:
-        with self._mu:
-            self._lib.kv_sync(self._h)
+        self._syncer.flush()
+
+    def maybe_sync(self) -> None:
+        """Commit-boundary fsync per the sync-log policy (the same
+        contract as mvcc.PyOrderedKV.maybe_sync)."""
+        if self._durable:
+            self._syncer.boundary()
 
     def close(self) -> None:
+        self._syncer.close()
         with self._mu:
             if self._h:
                 self._lib.kv_close(self._h)
@@ -121,10 +146,14 @@ class NativeOrderedKV:
     def put(self, cf: int, key: bytes, value: bytes) -> None:
         with self._mu:
             self._lib.kv_put(self._h, cf, key, len(key), value, len(value))
+        if self._durable:
+            self._syncer.mark_dirty()
 
     def delete(self, cf: int, key: bytes) -> None:
         with self._mu:
             self._lib.kv_delete(self._h, cf, key, len(key))
+        if self._durable:
+            self._syncer.mark_dirty()
 
     def get(self, cf: int, key: bytes) -> Optional[bytes]:
         out = ctypes.c_char_p()

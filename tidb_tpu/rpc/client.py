@@ -31,7 +31,8 @@ from .. import obs
 from ..errno import CodedError
 from ..kv.backoff import BO_RPC, Backoffer, BackoffExhausted
 from ..util import failpoint
-from .errors import WIRE_ERRORS, LeaderUnavailable, RPCError
+from .errors import WIRE_ERRORS, LeaderUnavailable, RPCError, \
+    StaleTermError
 from .frame import (TRACE_KEY, FrameError, decode, encode, make_trace_ctx,
                     parse_addr, recv_frame, send_frame)
 
@@ -61,6 +62,14 @@ class RpcOptions:
     # address a follower's diag listener binds (the per-server
     # diagnostics endpoint peers query for cluster_* tables)
     diag_listen: str = "127.0.0.1:0"
+    # automatic leader failover: a follower whose heartbeat has been
+    # failing for this long runs the election (0 disables — followers
+    # then degrade to read-only forever, the pre-failover behavior)
+    election_timeout_ms: int = 0
+    # address this follower serves coordination RPC on IF it wins an
+    # election and promotes (the bound host:port is what surviving
+    # peers repoint to, so on multi-host clusters use a routable host)
+    promote_listen: str = "127.0.0.1:0"
 
 
 class RpcClient:
@@ -89,7 +98,13 @@ class RpcClient:
         self.last_contact = 0.0
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        self._hb_client: Optional["RpcClient"] = None
         self._want_heartbeat = _heartbeat
+        # highest cluster fencing term witnessed (hello/ping responses
+        # carry it); fenced requests attach it, and a peer answering
+        # with a LOWER term is a deposed leader — calls to it fail
+        # typed so the caller re-resolves instead of split-braining
+        self.term = 0
         # extra params the heartbeat ping carries on every beat — the
         # diag plane rides this to (re)register the follower's diag
         # listener with the leader's membership registry, so a leader
@@ -178,7 +193,19 @@ class RpcClient:
             cls = WIRE_ERRORS.get(err.get("type"), CodedError)
             raise cls(err.get("msg", "rpc error"),
                       errno=err.get("errno"))
-        return resp.get("r") or {}
+        r = resp.get("r") or {}
+        t = r.get("term") if isinstance(r, dict) else None
+        if isinstance(t, int) and t > 0:
+            if t < self.term:
+                # the peer lives in a fenced epoch: a restarted deposed
+                # leader. NOT retryable against this address — the
+                # caller must re-resolve the current leader.
+                raise StaleTermError(
+                    f"peer {self.addr!r} serves term {t} but the "
+                    f"cluster is at term {self.term} (deposed leader)")
+            if t > self.term:
+                self.term = t
+        return r
 
     def _roundtrip(self, method: str, params: dict, coll, sp) -> dict:
         with self._mu:
@@ -254,23 +281,45 @@ class RpcClient:
             return
         hb = RpcClient(self.addr, self.options,
                        client_id=self.client_id, _heartbeat=False)
+        self._hb_client = hb
         interval = max(0.2, self.options.lease_ms / 3000.0)
 
         def beat() -> None:
             while not self._hb_stop.wait(interval):
                 try:
+                    if hb.addr != self.addr:
+                        # the parent repointed to a promoted leader:
+                        # the keepalive must follow or the lease renews
+                        # against the corpse
+                        hb.addr = self.addr
+                        hb._drop_conn()
+                    hb.term = max(hb.term, self.term)
                     hb.call("ping", _budget_ms=min(
                         self.options.backoff_budget_ms, 500),
                         **self.ping_params)
+                    self.term = max(self.term, hb.term)
                     self.degraded = False
                     self.last_contact = time.monotonic()
                 except RPCError:
+                    # covers StaleTermError too: a deposed leader's
+                    # pings must read as leader loss, not liveness
                     self.degraded = True
             hb.close()
 
         self._hb_thread = threading.Thread(
             target=beat, name="titpu-rpc-heartbeat", daemon=True)
         self._hb_thread.start()
+
+    def repoint(self, addr, term: int = 0) -> None:
+        """Re-resolve this client to a newly promoted leader: swap the
+        address, adopt the new term, drop the dead connection, and clear
+        the degraded latch so the next call goes straight through."""
+        with self._mu:
+            self.addr = addr
+            if term:
+                self.term = max(self.term, int(term))
+            self._drop_conn()
+        self.degraded = False
 
     def health(self) -> dict:
         return {
@@ -286,6 +335,23 @@ class RpcClient:
     def close(self) -> None:
         self._closed = True
         self._hb_stop.set()
+        hb, t = self._hb_client, self._hb_thread
+        if hb is not None:
+            # wake a beat blocked in connect/recv (the accept-waking
+            # pattern the listeners use): mark closed and tear the
+            # socket down under the hb client's own lock-free path —
+            # shutdown() interrupts a blocked recv immediately
+            hb._closed = True
+            s = hb._sock
+            if s is not None:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        if t is not None:
+            t.join(timeout=5.0)
+            self._hb_thread = None
+            self._hb_client = None
         with self._mu:
             self._drop_conn()
 

@@ -125,6 +125,37 @@ class DiagService:
                              float(v)])
         return {"rows": rows}
 
+    def diag_election(self) -> dict:
+        """This server's candidacy state for leader elections (polled by
+        peers' FailoverManagers over the diag port): node id, replicated
+        WAL position, known term, and — once anyone has promoted or
+        repointed — where the CURRENT leader answers coordination RPC.
+        Leaders answer too, so a partitioned follower that regains this
+        endpoint immediately learns who rules."""
+        st = self.storage
+        rpc_server = getattr(st, "rpc_server", None)
+        if rpc_server is not None:
+            return {"node_id": int(getattr(st.coord, "node_id", 0) or 0),
+                    "wal_pos": rpc_server._wal_size(),
+                    "term": rpc_server.term,
+                    "role": "leader",
+                    "leader_addr": rpc_server.address}
+        if getattr(st, "_promoting", False):
+            # mid-promotion: neither follower nor leader yet. Voters
+            # must HOLD their election open — treating this window as
+            # "not an elector" elected a second leader (split brain)
+            return {"node_id": int(getattr(st.coord, "node_id", 0) or 0),
+                    "wal_pos": 0, "term": 0,
+                    "role": "promoting", "leader_addr": ""}
+        client = getattr(st, "_rpc_client", None)
+        engine = getattr(st.kv, "kv", None)
+        return {"node_id": int(getattr(st.coord, "node_id", 0) or 0),
+                "wal_pos": int(getattr(engine, "_applied_off", 0)),
+                "term": int(getattr(client, "term", 0) or 0),
+                "role": self._role(),
+                "leader_addr": str(getattr(client, "addr", "") or "")
+                if client is not None and not client.degraded else ""}
+
     def handle(self, method: str) -> dict:
         fn = getattr(self, method, None)
         if fn is None or not method.startswith("diag_"):

@@ -40,6 +40,21 @@ class StaleLeaseError(RPCError):
     sqlstate = "40001"
 
 
+class StaleTermError(RPCError):
+    """A fenced operation carried a superseded fencing TERM.
+
+    Terms (fencing epochs) outlive connections and leases: a promoted
+    leader bumps the cluster term, so a zombie holding the old term —
+    the deposed leader itself, or a client that last spoke to it — has
+    every mutation rejected before it can split-brain the WAL
+    (reference analog: raft terms rejecting a deposed leader's
+    proposals). Clients react by re-resolving the leader, not by
+    retrying the same request."""
+
+    errno = ER_WRITE_CONFLICT
+    sqlstate = "40001"
+
+
 class ResultUndetermined(RPCError):
     """A WAL publish may or may not have landed (the leader became
     unreachable after the request was sent and before a response
@@ -94,6 +109,7 @@ def wire_error(rid, e: BaseException) -> dict:
 WIRE_ERRORS = {
     "LeaderUnavailable": LeaderUnavailable,
     "StaleLeaseError": StaleLeaseError,
+    "StaleTermError": StaleTermError,
     "ResultUndetermined": ResultUndetermined,
     "WalOffsetMismatch": WalOffsetMismatch,
     "RPCError": RPCError,
@@ -101,5 +117,5 @@ WIRE_ERRORS = {
 
 
 __all__ = ["RPCError", "LeaderUnavailable", "StaleLeaseError",
-           "ResultUndetermined", "WalOffsetMismatch", "WIRE_ERRORS",
-           "wire_error", "traced_response"]
+           "StaleTermError", "ResultUndetermined", "WalOffsetMismatch",
+           "WIRE_ERRORS", "wire_error", "traced_response"]

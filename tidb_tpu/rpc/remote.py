@@ -37,33 +37,89 @@ class RemoteKV(PyOrderedKV):
     Inherits the maps/scan machinery and the record format from the
     pure-python engine; overrides the durability plane: appends buffer
     locally (with an undo log) until the mutation section flushes them
-    to the leader, and refresh() tails the leader instead of a file."""
+    to the leader, and refresh() tails the leader instead of a file.
 
-    def __init__(self, client: RpcClient) -> None:
-        super().__init__(path=None)
+    With `mirror_dir` the follower also keeps an on-disk MIRROR of the
+    replicated stream — the leader's snapshot.kv byte-for-byte plus
+    every tailed/published WAL byte in the same order. The mirror is
+    what makes in-place PROMOTION possible: a follower that wins the
+    election re-opens its mirror as the authoritative (snapshot, WAL)
+    pair, and because every follower's mirror is a byte-prefix of the
+    dead leader's file, surviving peers keep tailing from their own
+    offsets against the new leader without re-bootstrapping."""
+
+    def __init__(self, client: RpcClient,
+                 mirror_dir: Optional[str] = None,
+                 sync_log: str = "off",
+                 sync_interval_ms: int = 100) -> None:
+        super().__init__(path=None, sync_log=sync_log,
+                         sync_interval_ms=sync_interval_ms)
         self._client = client
         self._applied_off = 0          # leader-WAL byte position
         self._buf: list[bytes] = []    # records awaiting flush
         self._undo: list = []          # (cf, key, old_value) LIFO
         self._seq = 0                  # client-assigned append sequence
+        self.mirror_dir = mirror_dir
+        self._mirror_wal = None
+        from ..kv.mvcc import SyncPolicy
+        self._mirror_sync = SyncPolicy(sync_log, sync_interval_ms,
+                                       self._fsync_mirror)
+        if mirror_dir is not None:
+            import os
+            os.makedirs(mirror_dir, exist_ok=True)
+            # a stale mirror (earlier join, possibly of a different
+            # leader epoch) cannot be trusted to prefix-match the
+            # current stream: restart the mirror with the bootstrap
+            for name in ("wal.log", "snapshot.kv"):
+                try:
+                    os.remove(os.path.join(mirror_dir, name))
+                except OSError:
+                    pass
+            self._mirror_wal = open(
+                os.path.join(mirror_dir, "wal.log"), "wb")
 
     # ---- bootstrap / tail --------------------------------------------------
     def bootstrap(self) -> None:
         # the snapshot streams in chunks like the WAL (a store with a
         # long pre-shared life can exceed any single frame); a record
         # split at a chunk boundary carries over as `rem`
+        import os
         off, rem = 0, b""
-        while True:
-            r = self._client.call(
-                "wal_bootstrap", offset=off,
-                _budget_ms=self._client.options.lock_budget_ms)
-            data = r.get("snapshot", b"")
-            off += len(data)
-            if rem or data:
-                valid, _ = self._replay_bytes(rem + data, queue=False)
-                rem = (rem + data)[valid:]
-            if not r.get("more"):
-                break
+        snap_tmp = None
+        if self.mirror_dir is not None:
+            snap_tmp = open(
+                os.path.join(self.mirror_dir, "snapshot.tmp"), "wb")
+        try:
+            while True:
+                r = self._client.call(
+                    "wal_bootstrap", offset=off,
+                    _budget_ms=self._client.options.lock_budget_ms)
+                data = r.get("snapshot", b"")
+                off += len(data)
+                if snap_tmp is not None and data:
+                    snap_tmp.write(data)
+                if rem or data:
+                    valid, _ = self._replay_bytes(rem + data, queue=False)
+                    rem = (rem + data)[valid:]
+                if not r.get("more"):
+                    break
+            if snap_tmp is not None:
+                snap_tmp.flush()
+                os.fsync(snap_tmp.fileno())
+                snap_tmp.close()
+                snap_tmp = None
+                if off:
+                    from ..kv.mvcc import fsync_dir
+                    os.replace(
+                        os.path.join(self.mirror_dir, "snapshot.tmp"),
+                        os.path.join(self.mirror_dir, "snapshot.kv"))
+                    fsync_dir(self.mirror_dir)
+                else:
+                    os.remove(
+                        os.path.join(self.mirror_dir, "snapshot.tmp"))
+        finally:
+            if snap_tmp is not None:
+                snap_tmp.close()
         self._applied_off = 0
         self.refresh()  # the log itself streams via chunked tailing
         self.pending_refresh.clear()  # nothing folded yet; _recover scans
@@ -114,9 +170,22 @@ class RemoteKV(PyOrderedKV):
                     return total
                 raise
             data = r.get("data", b"")
+            ws = r.get("wal_size")
+            if isinstance(ws, int) and ws < self._applied_off:
+                # the serving leader holds LESS log than we replicated:
+                # a post-failover leader that never saw our tail (the
+                # documented loss window). Silently waiting would hang
+                # forever; diverged state needs an operator (or a
+                # re-join with a fresh working dir).
+                raise RPCError(
+                    f"replication diverged: this follower is at WAL "
+                    f"offset {self._applied_off} but the leader holds "
+                    f"only {ws} bytes; re-join with a fresh working "
+                    "dir to resync")
             if not data:
                 return total
             valid, n = self._replay_bytes(data)
+            self._mirror_append(data[:valid])
             self._applied_off += valid
             total += n
             if not r.get("more"):
@@ -139,6 +208,38 @@ class RemoteKV(PyOrderedKV):
 
     def tail_clean(self) -> None:
         pass  # the leader owns the file; its tail hygiene applies
+
+    # ---- on-disk mirror ----------------------------------------------------
+    def _fsync_mirror(self) -> None:
+        import os
+        mw = self._mirror_wal
+        if mw is not None and not mw.closed:
+            mw.flush()
+            os.fsync(mw.fileno())
+
+    def _mirror_append(self, data: bytes) -> None:
+        if self._mirror_wal is None or not data:
+            return
+        self._mirror_wal.write(data)
+        self._mirror_wal.flush()
+        self._mirror_sync.mark_dirty()
+        # mirror durability is promotion-quality, not the ack path
+        # (the leader's fsync is) — a failed mirror fsync must not
+        # fail replication
+        try:
+            self._mirror_sync.boundary()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._mirror_sync.close()
+        super().close()
+        if self._mirror_wal is not None:
+            try:
+                self._mirror_wal.close()
+            except OSError:
+                pass
+            self._mirror_wal = None
 
     # ---- buffered append with undo -----------------------------------------
     def _log(self, op: int, cf: int, key: bytes, value: bytes) -> None:
@@ -169,7 +270,8 @@ class RemoteKV(PyOrderedKV):
         try:
             r = self._client.call("wal_append", seq=self._seq,
                                   expected=self._applied_off, data=data,
-                                  token=token or 0)
+                                  token=token or 0,
+                                  term=self._client.term)
         except LeaderUnavailable as e:
             # the request may have landed before the leader went dark:
             # the outcome is UNKNOWN, not failed (reference:
@@ -184,6 +286,11 @@ class RemoteKV(PyOrderedKV):
             # faults: the leader definitively did NOT apply the records
             self._revert()
             raise
+        # the leader wrote our records at exactly `expected` (the offset
+        # fence guarantees it), so the mirror appends the same bytes at
+        # the same position — prefix equality with the leader's file is
+        # preserved through our own publishes
+        self._mirror_append(data)
         self._applied_off = int(r["offset"])
         self._buf, self._undo = [], []
 
@@ -225,7 +332,8 @@ class RemoteCoordinator:
                     "reads only (writes need the mutation lease)")
             bo = Backoffer(budget_ms=self.options.lock_budget_ms)
             while True:
-                r = self.client.call("lock_acquire", name="mutation")
+                r = self.client.call("lock_acquire", name="mutation",
+                                     term=self.client.term)
                 if r.get("granted"):
                     self._token = int(r["token"])
                     return

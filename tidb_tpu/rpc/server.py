@@ -31,13 +31,37 @@ import time
 from typing import Any, Optional
 
 from ..errno import CodedError
-from .errors import RPCError, StaleLeaseError, WalOffsetMismatch, \
-    traced_response, wire_error
+from .errors import RPCError, StaleLeaseError, StaleTermError, \
+    WalOffsetMismatch, traced_response, wire_error
 from .frame import MAX_FRAME, FrameError, decode, encode, get_trace_ctx, \
     parse_addr, recv_frame, send_frame
 
 # one tail response carries at most this many bytes; clients loop
 TAIL_CHUNK = 4 << 20
+
+
+def read_term(path: str) -> int:
+    """The persisted fencing term (0 when absent/corrupt — a torn term
+    file reads as 'unknown', and the caller re-persists)."""
+    try:
+        with open(path) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def write_term(path: str, term: int) -> None:
+    """Crash-atomic term persistence: tmp + fsync + rename + dir fsync
+    (losing a term bump to power loss would let the next incarnation
+    reuse a fenced epoch)."""
+    from ..kv.mvcc import fsync_dir
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(int(term)))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
 
 
 class _Client:
@@ -168,7 +192,8 @@ class CoordRPCServer(FrameListener):
 
     def __init__(self, storage, listen="127.0.0.1:0",
                  lease_ms: int = 3000,
-                 tail_chunk: int = TAIL_CHUNK) -> None:
+                 tail_chunk: int = TAIL_CHUNK,
+                 term: Optional[int] = None) -> None:
         if storage.path is None:
             raise ValueError("RPC coordination needs a durable store dir")
         self.storage = storage
@@ -185,9 +210,27 @@ class CoordRPCServer(FrameListener):
         self._wal_path = os.path.join(self.path, "kv", "wal.log")
         self._snap_path = os.path.join(self.path, "kv", "snapshot.kv")
         os.makedirs(os.path.join(self.path, "kv"), exist_ok=True)
+        # the cluster fencing TERM, persisted beside the WAL it fences:
+        # a fresh leader starts at 1, a clean restart resumes the stored
+        # term, and a PROMOTED follower passes term=stored+1. Mutating
+        # requests carrying a lower term are rejected (StaleTermError) —
+        # the raft-term analog that stops a deposed leader's clients
+        # from split-braining the log.
+        self._term_path = os.path.join(self.path, "kv", "term")
+        self.term = int(term) if term is not None else \
+            max(1, read_term(self._term_path))
+        write_term(self._term_path, self.term)
         # O_APPEND handle for remote records: interleaves safely with
         # the leader engine's own appends (both under the mutation flock)
         self._append_f = open(self._wal_path, "ab")
+        # remote appends honor the SAME storage.sync-log policy as the
+        # engine's own WAL writes (one shared evaluator, kv/mvcc.py)
+        from ..kv.mvcc import SyncPolicy
+        engine = storage.kv.kv
+        self._append_sync = SyncPolicy(
+            getattr(engine, "sync_log", "off"),
+            getattr(engine, "sync_interval_ms", 100),
+            self._fsync_append)
         fam, target = self._start_listener(listen)
         if fam == socket.AF_INET:
             # the advertised address doubles as the leader's dialable
@@ -204,8 +247,15 @@ class CoordRPCServer(FrameListener):
         threading.Thread(target=self._reaper_loop,
                          name="titpu-rpc-reaper", daemon=True).start()
 
+    def _fsync_append(self) -> None:
+        f = self._append_f
+        if not f.closed:
+            f.flush()
+            os.fsync(f.fileno())
+
     # ---- lifecycle ---------------------------------------------------------
     def close(self) -> None:
+        self._append_sync.close()
         self._close_listener()
         with self._mu:
             for name in list(self._grants):
@@ -260,17 +310,30 @@ class CoordRPCServer(FrameListener):
         return traced_response(rid, method, fn, get_trace_ctx(req))
 
     # ---- liveness ----------------------------------------------------------
-    def _h_ping(self, client_id: str, diag_addr=None, role=None) -> dict:
+    def _h_ping(self, client_id: str, diag_addr=None, role=None,
+                node_id=None) -> dict:
         # heartbeats may carry the sender's diag registration so a
         # restarted leader relearns the membership within one beat
         if diag_addr:
             self._register_member(client_id, str(diag_addr),
                                   str(role or "follower"))
-        return {"ok": True, "lease_ms": self.lease_ms}
+        if node_id is not None:
+            with self._mu:
+                c = self._clients.get(client_id)
+                if c is not None and c.node_id is None:
+                    # a follower that repointed here after a promotion
+                    # keeps its original node id; record it so members()
+                    # and the election registry stay id-accurate
+                    c.node_id = int(node_id)
+        # the term rides every beat: clients track the cluster epoch
+        # from it, and a client that knows a HIGHER term than ours
+        # treats us as a deposed leader (StaleTermError client-side)
+        return {"ok": True, "lease_ms": self.lease_ms, "term": self.term}
 
     def _h_hello(self, client_id: str) -> dict:
         return {"lease_ms": self.lease_ms,
-                "wal_size": self._wal_size()}
+                "wal_size": self._wal_size(),
+                "term": self.term}
 
     def client_count(self) -> int:
         with self._mu:
@@ -366,7 +429,19 @@ class CoordRPCServer(FrameListener):
             except OSError:
                 pass
 
-    def _h_lock_acquire(self, client_id: str, name: str = "") -> dict:
+    def _check_term(self, term) -> None:
+        """Reject a mutator still living in a fenced epoch. term=0 means
+        the caller predates term fencing (direct RpcClient users) and is
+        admitted — the lease tokens still protect the WAL."""
+        if term and int(term) < self.term:
+            raise StaleTermError(
+                f"request term {int(term)} is fenced: cluster is at "
+                f"term {self.term} (a new leader was elected; "
+                "re-resolve and retry)")
+
+    def _h_lock_acquire(self, client_id: str, name: str = "",
+                        term: int = 0) -> dict:
+        self._check_term(term)
         with self._mu:
             grant = self._grants.get(name)
             if grant is not None:
@@ -448,6 +523,7 @@ class CoordRPCServer(FrameListener):
         shared chunk constant. `limit` lets a client outgrow the default
         chunk when a single record spans it."""
         n = min(int(limit) or self.tail_chunk, MAX_FRAME - 4096)
+        size = self._wal_size()
         try:
             with open(self._wal_path, "rb") as f:
                 f.seek(int(offset))
@@ -455,12 +531,17 @@ class CoordRPCServer(FrameListener):
                 more = bool(data) and f.read(1) != b""
         except OSError:
             data, more = b"", False
-        return {"data": data, "more": more}
+        # wal_size lets a tailer detect DIVERGENCE: an offset beyond the
+        # file means the tailer replicated more than this leader holds
+        # (possible only across a failover data-loss window) and must
+        # fail typed instead of waiting forever for bytes that never come
+        return {"data": data, "more": more, "wal_size": size}
 
     def _h_wal_append(self, client_id: str, seq: int = 0,
                       expected: int = 0, data: bytes = b"",
-                      token: int = 0) -> dict:
+                      token: int = 0, term: int = 0) -> dict:
         seq = int(seq)
+        self._check_term(term)
         with self._mu:
             c = self._clients[client_id]
             if seq == c.last_seq and c.last_seq_result is not None:
@@ -483,9 +564,18 @@ class CoordRPCServer(FrameListener):
             self._append_f.write(bytes(data))
             self._append_f.flush()
             off = size + len(data)
+        # the ack below IS the follower's commit acknowledgement: honor
+        # the sync-log policy first — but OUTSIDE self._mu, or every
+        # unrelated RPC (pings, tso) queues behind each disk fsync.
+        # Appenders are already serialized by the mutation lease, and a
+        # failed fsync propagates (typed) instead of acking undurable.
+        self._append_sync.mark_dirty()
+        self._append_sync.boundary()
+        with self._mu:
+            c = self._clients[client_id]
             c.last_seq = seq
             c.last_seq_result = off
-            return {"offset": off}
+        return {"offset": off}
 
     # ---- node registry + kill mailbox --------------------------------------
     def _h_node_claim(self, client_id: str) -> dict:
@@ -545,4 +635,5 @@ class CoordRPCServer(FrameListener):
         return {"kills": kills}
 
 
-__all__ = ["CoordRPCServer", "FrameListener", "TAIL_CHUNK"]
+__all__ = ["CoordRPCServer", "FrameListener", "TAIL_CHUNK",
+           "read_term", "write_term"]

@@ -53,6 +53,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "server's private working dir")
     p.add_argument("--path", default=None,
                    help="durable storage directory (default: in-memory)")
+    p.add_argument("--sync-log", default=None,
+                   choices=["off", "commit", "interval"],
+                   help="KV WAL fsync policy: commit = fsync every "
+                        "commit boundary; interval = group commit")
+    p.add_argument("--sync-interval-ms", type=int, default=None,
+                   help="group-commit window for --sync-log interval")
+    p.add_argument("--election-timeout-ms", type=int, default=None,
+                   help="leader-loss window before a follower runs the "
+                        "failover election (0 disables)")
+    p.add_argument("--promote-listen", default=None,
+                   help="coordination address this follower serves on "
+                        "if it wins an election")
     p.add_argument("--socket", default=None, help="unix socket (unused)")
     p.add_argument("--default-db", default=None)
     p.add_argument("--max-connections", type=int, default=None)
@@ -113,6 +125,10 @@ def resolve_config(args) -> Config:
          "proxy_protocol_networks"),
         ("transport_listen", cfg.transport, "listen"),
         ("transport_remote", cfg.transport, "remote"),
+        ("sync_log", cfg.storage, "sync_log"),
+        ("sync_interval_ms", cfg.storage, "sync_interval_ms"),
+        ("election_timeout_ms", cfg.transport, "election_timeout_ms"),
+        ("promote_listen", cfg.transport, "promote_listen"),
     ]
     dotted = {
         "log_slow_threshold": "log.slow_threshold",
@@ -149,16 +165,19 @@ def main(argv: list[str] | None = None) -> int:
     # leader additionally serves the coordination RPC tier; otherwise
     # the local / flock-shared-dir modes (reference: main.go:263 creates
     # the store from the store-type flag the same way)
+    sync_kw = {"sync_log": cfg.storage.sync_log,
+               "sync_interval_ms": cfg.storage.sync_interval_ms}
     if cfg.transport.remote:
         storage = Storage(cfg.path or None, remote=cfg.transport.remote,
-                          rpc_options=cfg.rpc_options())
+                          rpc_options=cfg.rpc_options(), **sync_kw)
     elif cfg.transport.listen:
         storage = Storage(cfg.path or None, shared=True,
                           rpc_listen=cfg.transport.listen,
-                          rpc_options=cfg.rpc_options())
+                          rpc_options=cfg.rpc_options(), **sync_kw)
     else:
         storage = Storage(cfg.path or None,
-                          shared=getattr(args, 'shared', False))
+                          shared=getattr(args, 'shared', False),
+                          **sync_kw)
     cfg.seed_sysvars(storage)
     storage.metrics_history.configure(
         interval_s=cfg.performance.metrics_history_interval,

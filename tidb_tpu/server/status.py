@@ -12,6 +12,9 @@ Debug routes:
   /debug/metrics/history  the MetricsHistory ring: timestamped
       counter/gauge samples (JSON; cadence/size via the
       performance.metrics-history-* config knobs)
+  /debug/failpoints  armed fault-injection points + hit counts (JSON;
+      the torture harness reads this to confirm its env-armed points
+      actually fired inside child server processes)
 """
 
 from __future__ import annotations
@@ -96,6 +99,10 @@ class StatusServer:
                         "interval_s": hist.interval_s,
                         "samples": hist.snapshot(),
                     }).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/failpoints"):
+                    from ..util import failpoint
+                    body = json.dumps(failpoint.snapshot()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/debug/profile"):
                     q = parse_qs(urlparse(self.path).query)

@@ -206,6 +206,29 @@ class Session:
         # the socket)
         self.killed.clear()
         interrupt.install(self.killed)
+        # @@max_execution_time: a per-statement deadline for SELECTs
+        # (MySQL scopes the variable to read-only statements) riding
+        # the SAME interrupt plane as KILL QUERY — the engine already
+        # polls the flag between plan nodes and device tiles, so an
+        # expired statement dies at the next checkpoint with 3024
+        # instead of 1317 (reference: executor/adapter.go handleNoDelay
+        # + the tidb_mem/max_execution_time kill path)
+        deadline_timer = None
+        self._deadline_expired = False
+        if isinstance(stmt, (ast.SelectStmt, ast.SetOpStmt)):
+            try:
+                max_ms = int(self._sysvar_value("max_execution_time")
+                             or 0)
+            except (TypeError, ValueError, SQLError):
+                max_ms = 0
+            if max_ms > 0:
+                def _expire():
+                    self._deadline_expired = True
+                    self.killed.set()
+                deadline_timer = threading.Timer(max_ms / 1000.0,
+                                                 _expire)
+                deadline_timer.daemon = True
+                deadline_timer.start()
         # warnings reset per statement — except SHOW WARNINGS and
         # table-less SELECTs (SELECT @@warning_count, SELECT 1), which
         # MySQL defines as reading the PREVIOUS statement's list
@@ -241,6 +264,12 @@ class Session:
         except interrupt.QueryInterrupted:
             failed = True
             o.query_errors.inc()
+            if self._deadline_expired:
+                from ..errno import ER_QUERY_TIMEOUT
+                raise SQLError(
+                    "Query execution was interrupted, maximum statement "
+                    "execution time exceeded",
+                    errno=ER_QUERY_TIMEOUT) from None
             raise SQLError("Query execution was interrupted",
                            errno=ER_QUERY_INTERRUPTED) from None
         except Exception:
@@ -248,6 +277,9 @@ class Session:
             o.query_errors.inc()
             raise
         finally:
+            if deadline_timer is not None:
+                deadline_timer.cancel()
+            self._deadline_expired = False
             interrupt.install(None)
             obs.install_stage_recorder(prev_rec)
             self.in_flight_sql = None

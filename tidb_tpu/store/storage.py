@@ -49,19 +49,22 @@ class WriteConflictError(CodedError):
     errno = ER_WRITE_CONFLICT
 
 
-def _make_engine(path: Optional[str] = None):
+def _make_engine(path: Optional[str] = None, sync_log: str = "off",
+                 sync_interval_ms: int = 100):
     """C++ ordered-KV engine when buildable, pure-python twin otherwise.
     With `path`, either engine opens WAL+snapshot files there (shared
-    format, native/kvstore.cpp)."""
+    format, native/kvstore.cpp) and honors the sync-log policy."""
     try:
         from ..kv.native import NativeOrderedKV, native_available
         if native_available():
-            return NativeOrderedKV(path)
+            return NativeOrderedKV(path, sync_log=sync_log,
+                                   sync_interval_ms=sync_interval_ms)
     except Exception:
         pass
     if path is not None:
         from ..kv.mvcc import PyOrderedKV
-        return PyOrderedKV(path)
+        return PyOrderedKV(path, sync_log=sync_log,
+                           sync_interval_ms=sync_interval_ms)
     return None
 
 
@@ -73,7 +76,9 @@ _TSO_LEASE_MS = 120_000
 class Storage:
     def __init__(self, path: Optional[str] = None,
                  shared: bool = False, remote=None,
-                 rpc_listen=None, rpc_options=None) -> None:
+                 rpc_listen=None, rpc_options=None,
+                 sync_log: str = "off",
+                 sync_interval_ms: int = 100) -> None:
         """`path=None`: ephemeral in-memory store (tests, benches).
         `path=dir`: durable — KV WAL+snapshot under dir/kv, columnar epoch
         snapshots under dir/epochs, catalog/stats/DDL state in the meta
@@ -97,7 +102,16 @@ class Storage:
         cache/scratch), the KV truth mirrors the leader's WAL via RPC.
         A `path` of the form 'rpc://host:port' selects this mode with a
         throwaway working dir (the store-URL shape of the reference's
-        tikv:// store paths, store/store.go)."""
+        tikv:// store paths, store/store.go).
+
+        `sync_log` (storage.sync-log): when the KV WAL reaches disk —
+        'commit' fsyncs at every commit boundary (no acked commit can
+        die with the machine), 'interval' group-commits at most one
+        fsync per `sync_interval_ms`, 'off' leaves flushing to the OS
+        (process death loses nothing, power loss may). The EMBEDDED
+        default is 'off' (tests/benches construct stores by the
+        thousand); the SERVER config default is 'commit'
+        (config.py StorageConfig — production pays for durability)."""
         import os
 
         from ..stats import StatsHandle
@@ -113,12 +127,23 @@ class Storage:
         self.path = path
         self.remote = remote is not None
         self.shared = bool((shared or self.remote) and path is not None)
+        if sync_log not in ("off", "commit", "interval"):
+            raise ValueError(
+                f"sync_log must be off|commit|interval, got {sync_log!r}")
+        self.sync_log = sync_log
+        self.sync_interval_ms = sync_interval_ms
         self.coord = None
         self.rpc_server = None
         self._rpc_client = None
         self._rpc_options = rpc_options
         self._start_time = _time.time()
         self.diag_listener = None
+        self.failover = None
+        # True while promote_to_leader is mid-flight: diag_election
+        # reports the transitional role so peer voters HOLD their
+        # election open instead of dropping us from the electorate
+        # (dropping the winner mid-promotion elects a second leader)
+        self._promoting = False
         # diag fan-out state, owned here so concurrent first queries
         # never race a lazy init (rpc/diag.py uses these)
         self._diag_clients: dict = {}
@@ -150,6 +175,11 @@ class Storage:
                     pass  # the next heartbeat re-registers
                 self._rpc_client.start_heartbeat()
                 self.coord = RemoteCoordinator(self._rpc_client, opts)
+                # heartbeats also carry our node id so a leader elected
+                # AFTER we joined (or restarted) rebuilds an id-accurate
+                # membership registry from the beats alone
+                self._rpc_client.ping_params["node_id"] = \
+                    self.coord.node_id
             except BaseException:
                 # a failed join must not leak the accept thread, the
                 # bound socket, or the connected coordination client
@@ -191,14 +221,21 @@ class Storage:
         if self.remote:
             # socket follower: the engine mirrors the leader's WAL over
             # RPC; its appends publish through the leased mutation
-            # section (rpc/remote.py)
+            # section (rpc/remote.py). The on-disk mirror under our
+            # private dir is the promotion substrate: the byte-prefix
+            # copy of the leader's (snapshot, WAL) pair an elected
+            # follower re-opens as the authoritative store.
             from ..rpc.remote import RemoteKV
-            engine = RemoteKV(self._rpc_client)
+            engine = RemoteKV(self._rpc_client,
+                              mirror_dir=os.path.join(path, "kv"),
+                              sync_log=sync_log,
+                              sync_interval_ms=sync_interval_ms)
             try:
                 engine.bootstrap()
             except BaseException:
                 # same no-leak contract as the join block above: a
                 # failed WAL mirror leaves no listener/heartbeat behind
+                engine.close()
                 self.diag_listener.close()
                 self._rpc_client.close()
                 raise
@@ -207,10 +244,13 @@ class Storage:
             # the shared-WAL refresh protocol lives in the Python engine;
             # the flock'd sections make its appends safe cross-process
             from ..kv.mvcc import PyOrderedKV
-            engine = PyOrderedKV(os.path.join(path, "kv"), shared=True)
+            engine = PyOrderedKV(os.path.join(path, "kv"), shared=True,
+                                 sync_log=sync_log,
+                                 sync_interval_ms=sync_interval_ms)
         else:
             engine = _make_engine(
-                os.path.join(path, "kv") if path is not None else None)
+                os.path.join(path, "kv") if path is not None else None,
+                sync_log=sync_log, sync_interval_ms=sync_interval_ms)
         self.kv = MVCCStore(engine=engine, coord=self.coord)
         if path is not None and self._tso_lease == 0 and not self.remote:
             # lease file missing/corrupt: floor from the largest commit ts
@@ -314,6 +354,22 @@ class Storage:
             self.rpc_server = CoordRPCServer(self, listen=rpc_listen,
                                              lease_ms=opts.lease_ms,
                                              tail_chunk=opts.tail_chunk)
+        if self.remote and \
+                (self._rpc_options.election_timeout_ms or 0) > 0:
+            # automatic failover: watch the heartbeat, elect on leader
+            # loss, promote or repoint (rpc/failover.py). The voter
+            # roll is seeded NOW: a leader that dies before the first
+            # healthy-tick refresh must not leave this follower with an
+            # empty electorate (it would elect itself unopposed while
+            # its unseen peers do the same — split brain)
+            from ..rpc.diag import cluster_members
+            try:
+                cluster_members(self, budget_ms=1000)
+            except Exception:  # noqa: BLE001 — seeding is best-effort
+                pass
+            from ..rpc.failover import FailoverManager
+            self.failover = FailoverManager(self, self._rpc_options)
+            self.failover.start()
 
     # ---- schema ------------------------------------------------------------
     def register_table(self, info: TableInfo) -> TableStore:
@@ -387,10 +443,21 @@ class Storage:
         wall clock steps backwards."""
         lease = self.tso.current() + (_TSO_LEASE_MS << 18)
         tmp = self._lease_file() + ".tmp"
+        import os
+
+        from ..kv.mvcc import fsync_dir
         with open(tmp, "w") as f:
             f.write(str(lease))
-        import os
+            f.flush()
+            if self.sync_log != "off":
+                os.fsync(f.fileno())
         os.replace(tmp, self._lease_file())
+        if self.sync_log != "off":
+            # a lease bump lost to power loss would let a restarted
+            # oracle re-issue timestamps the pre-crash process already
+            # handed out; under sync-log=off the whole store accepts
+            # the power-loss window, so the lease does too
+            fsync_dir(self.path)
         self._tso_lease = lease
 
     def _maybe_extend_lease(self) -> None:
@@ -466,9 +533,21 @@ class Storage:
                 payload[f"dict{ci}"] = np.array(list(d.values), dtype=object)
         path = self._epoch_file(store.table.id)
         tmp = path + ".tmp"
+        from ..kv.mvcc import fsync_dir
         with open(tmp, "wb") as f:
             np.savez(f, **payload)
+            f.flush()
+            if self.sync_log != "off":
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if self.sync_log != "off":
+            # full crash-atomic sequence (tmp + fsync + rename + dir
+            # fsync): a half-written epoch must never shadow the
+            # previous good one — recovery treats the epoch as the fold
+            # floor and skips the WAL below its fold_ts. sync-log=off
+            # keeps the atomic rename but accepts the power-loss window
+            # (epoch snapshots can be a bulk load's multi-MB fsync).
+            fsync_dir(os.path.dirname(path))
 
     def _load_epoch(self, store: TableStore) -> None:
         import os
@@ -481,7 +560,19 @@ class Storage:
         path = self._epoch_file(store.table.id)
         if not os.path.exists(path):
             return
-        with np.load(path, allow_pickle=True) as z:
+        try:
+            z_ctx = np.load(path, allow_pickle=True)
+        except Exception:  # noqa: BLE001 — torn/corrupt archive
+            # an unreadable epoch snapshot (crash mid-write on a
+            # filesystem without atomic rename, bit rot) must degrade
+            # to a full refold from the KV truth, never to a crash at
+            # open — drop it so the next checkpoint rewrites it
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return
+        with z_ctx as z:
             ncols = int(z["ncols"])
             if ncols != store.table.num_columns:
                 return  # schema moved past this snapshot; refold from KV
@@ -649,12 +740,17 @@ class Storage:
         mode); the WAL always folds."""
         if self.path is None:
             return
+        from ..util import failpoint
         self._flush_sequence_cursors()
         for store in list(self.tables.values()):  # DDL may race the daemon
             if dirty_only and not getattr(store, "epoch_dirty", False):
                 continue
             self._persist_epoch(store)
             store.epoch_dirty = False
+            # crash-injection site: the torture harness kills here with
+            # some epochs persisted and the KV WAL not yet folded —
+            # recovery must treat the half-finished checkpoint as noise
+            failpoint.inject("storage/mid-checkpoint")
         self.kv.checkpoint()
 
     @property
@@ -688,36 +784,157 @@ class Storage:
             h["mode"] = "socket-follower"
             h["node_id"] = self.coord.node_id
             h["diag_address"] = self.diag_address
+            h["term"] = self._rpc_client.term
+            if self.failover is not None:
+                h["failover"] = self.failover.describe()
             from ..rpc.diag import cluster_members
             h["members"] = cluster_members(self, budget_ms=500)
             return h
         if self.rpc_server is not None:
             return {"mode": "socket-leader",
                     "address": self.rpc_server.address,
+                    "term": self.rpc_server.term,
                     "clients": self.rpc_server.client_count(),
                     "members": self.rpc_server.members()}
         if self.shared:
             return {"mode": "shared-dir", "node_id": self.coord.node_id}
         return {"mode": "local"}
 
+    # ---- leader failover (rpc/failover.py drives these) ---------------------
+    def promote_to_leader(self, listen: str = "127.0.0.1:0") -> str:
+        """Promote this socket FOLLOWER to the cluster leader in place.
+
+        The on-disk WAL mirror (rpc/remote.py RemoteKV) is a byte-prefix
+        of the dead leader's (snapshot, WAL) pair, so it re-opens as the
+        authoritative store and surviving followers keep tailing from
+        their own offsets. The fencing term bumps and persists BEFORE
+        the new coordination server answers anything, so a zombie of
+        the old epoch is rejected from the first request (reference
+        analog: raft term bump on election, Ongaro & Ousterhout §5.2).
+        Returns the new coordination address."""
+        if not self.remote:
+            return self.rpc_server.address if self.rpc_server else ""
+        from ..rpc.client import RpcOptions
+
+        client = self._rpc_client
+        opts = self._rpc_options or RpcOptions()
+        new_term = int(client.term) + 1
+        # the transitional flag keeps peer voters from dropping us from
+        # the electorate mid-promotion (they hold their election open
+        # until we answer as a leader)
+        self._promoting = True
+        try:
+            return self._promote_locked(client, opts, new_term, listen)
+        finally:
+            self._promoting = False
+
+    def _promote_locked(self, client, opts, new_term: int,
+                        listen: str) -> str:
+        import os
+
+        from ..kv.mvcc import PyOrderedKV
+        from ..kv.tso import SharedTSO
+        from ..kv.twopc import TwoPhaseCommitter as _TPC
+        from ..owner import owner_manager
+        from ..rpc.server import CoordRPCServer, write_term
+        from .coordinator import SharedDirCoordinator
+
+        with self._commit_lock:
+            old_engine = self.kv.kv
+            mirror_dir = getattr(old_engine, "mirror_dir", None) or \
+                os.path.join(self.path, "kv")
+            # 1. seal the mirror: everything replicated is on disk
+            mw = getattr(old_engine, "_mirror_wal", None)
+            if mw is not None:
+                mw.flush()
+                os.fsync(mw.fileno())
+            old_engine.close()
+            # 2. the bumped fencing term, durable beside the WAL
+            write_term(os.path.join(mirror_dir, "term"), new_term)
+            # 3. the mirror becomes the authoritative engine (replayed
+            #    exactly like a leader restart; shared mode so local and
+            #    remote mutators coexist through the flock)
+            engine = PyOrderedKV(mirror_dir, shared=True,
+                                 sync_log=self.sync_log,
+                                 sync_interval_ms=self.sync_interval_ms)
+            self.kv.kv = engine
+            # 4. coordination over OUR directory now
+            self.coord = SharedDirCoordinator(self.path)
+            self.kv.coord = self.coord
+            # 5. ONE timestamp allocator, floored a full lease horizon
+            #    above anything witnessed: the dead leader may have
+            #    issued timestamps nobody replicated, and a commit_ts
+            #    reuse would corrupt MVCC visibility
+            floor = max(self.tso.current(), self.kv.max_commit_ts()) \
+                + (_TSO_LEASE_MS << 18)
+            self.tso = SharedTSO(self.path, floor=floor)
+            self.committer = _TPC(self.rm, self.tso)
+            # 6. owner elections are kernel flocks on our dir
+            self.ddl_owner = owner_manager(self.path, "ddl")
+            self.gc_owner = owner_manager(self.path, "gc")
+            # 7. identity flip BEFORE serving: diag answers as leader
+            self.remote = False
+            self.shared = True
+            self._rpc_client = None
+            # 8. the old client (and its heartbeat thread) dies with the
+            #    old epoch; stragglers re-resolve via diag_election
+            client.ping_params = {}
+            client.close()
+            self.rpc_server = CoordRPCServer(
+                self, listen=listen, lease_ms=opts.lease_ms,
+                tail_chunk=opts.tail_chunk, term=new_term)
+            self._extend_tso_lease()
+            # 9. the dead leader's in-flight prewrites replicated as
+            #    orphan locks; resolve them exactly like a restart does
+            self._resolve_orphans()
+        return self.rpc_server.address
+
+    def repoint_leader(self, addr: str, term: int = 0) -> None:
+        """Re-resolve this follower to a newly promoted leader: swap
+        the client's address, adopt the bumped term, and re-register
+        the diag endpoint so the new membership registry fills without
+        waiting a heartbeat interval. The WAL tail position carries
+        over unchanged — the new leader's log is a byte-superset of
+        ours (it won the election on length)."""
+        client = self._rpc_client
+        if client is None:
+            return
+        client.repoint(addr, int(term))
+        from ..rpc.errors import RPCError as _RPCError
+        try:
+            if self.diag_listener is not None:
+                client.call("diag_register",
+                            addr=self.diag_listener.address,
+                            role="follower", _budget_ms=1000)
+        except _RPCError:
+            pass  # the next heartbeat re-registers
+
     def close(self) -> None:
-        # diagnostics plane first: the history sampler and the follower
+        # the failover watcher first: a leader-loss election must not
+        # fire (or promote!) halfway through our own teardown
+        if self.failover is not None:
+            self.failover.close()
+        # diagnostics plane next: the history sampler and the follower
         # diag listener are joined here so no thread outlives the store
         # (the profiler-lifecycle contract tests/test_trace.py pins)
         self.metrics_history.stop()
         if self.diag_listener is not None:
-            from ..rpc.errors import RPCError as _RPCError
-            # stop announcing BEFORE deregistering: a heartbeat firing
-            # between the unregister and the client teardown below
-            # would re-register the closed address for a lease horizon
-            self._rpc_client.ping_params = {}
-            try:
-                # best-effort deregistration so peers stop fanning out
-                # to the closed address (otherwise they pay the diag
-                # budget per query until the lease horizon passes)
-                self._rpc_client.call("diag_unregister", _budget_ms=500)
-            except _RPCError:
-                pass
+            if self._rpc_client is not None:
+                from ..rpc.errors import RPCError as _RPCError
+                # stop announcing BEFORE deregistering: a heartbeat
+                # firing between the unregister and the client teardown
+                # below would re-register the closed address for a
+                # lease horizon
+                self._rpc_client.ping_params = {}
+                try:
+                    # best-effort deregistration so peers stop fanning
+                    # out to the closed address (otherwise they pay the
+                    # diag budget per query until the lease horizon
+                    # passes)
+                    self._rpc_client.call("diag_unregister",
+                                          _budget_ms=500)
+                except _RPCError:
+                    pass
             self.diag_listener.close()
         from ..rpc.diag import close_peer_clients
         close_peer_clients(self)
@@ -739,6 +956,9 @@ class Storage:
             except (RPCError, BackoffExhausted):
                 pass
             self._rpc_client.close()
+            close = getattr(self.kv.kv, "close", None)
+            if close is not None:
+                close()  # the WAL mirror handles
             if self._owns_tmp_dir:
                 # rpc:// shorthand: the throwaway scratch dir is ours
                 import shutil

@@ -15,10 +15,27 @@ Tests use the context manager so points never leak:
 
     with failpoint("twopc/after-primary-commit", CrashError()):
         ...
+
+Cross-process arming (the kill-9 torture harness): the environment
+variable `TIDB_TPU_FAILPOINTS=name=value;name2=value2` is parsed at
+import, so points arm inside child server processes the harness spawns
+(reference: the GO_FAILPOINTS env var of pingcap/failpoint). Values:
+
+    exit(N)      os._exit(N) at the hit — the SIGKILL-grade crash
+    sleep(S)     block S seconds at the hit
+    raise        raise RuntimeError at the hit
+    <number>     returned to the call site (delays, counts)
+    true/false   boolean toggle
+    anything@K   fire only on the K-th hit (1-based), inert otherwise —
+                 lets a crash point skip bootstrap traffic
+
+Armed points and their hit counts are listed on the status port at
+/debug/failpoints (snapshot()).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from contextlib import contextmanager
 from typing import Any, Iterator, Optional
@@ -54,6 +71,21 @@ def hits(name: str) -> int:
         return _hits.get(name, 0)
 
 
+def snapshot() -> dict[str, dict]:
+    """Armed points + lifetime hit counts (for /debug/failpoints).
+    Points hit after being disarmed keep their counts until
+    disable_all(), so a chaos run can still read what fired."""
+    with _lock:
+        out: dict[str, dict] = {}
+        for name in set(_active) | set(_hits):
+            out[name] = {
+                "armed": name in _active,
+                "value": repr(_active.get(name)),
+                "hits": _hits.get(name, 0),
+            }
+        return out
+
+
 def inject(name: str) -> Optional[Any]:
     """The call-site hook. Returns None when the point is disabled;
     otherwise raises/calls/returns per the enabled value."""
@@ -80,5 +112,76 @@ def failpoint(name: str, value: Any = True) -> Iterator[None]:
         disable(name)
 
 
+# ---- env-var arming (child processes of the torture harness) ---------------
+def _parse_action(spec: str) -> Any:
+    spec = spec.strip()
+    if spec.startswith("exit(") and spec.endswith(")"):
+        code = int(spec[5:-1] or 1)
+        return lambda: os._exit(code)
+    if spec.startswith("sleep(") and spec.endswith(")"):
+        secs = float(spec[6:-1] or 0)
+        import time as _time
+        return lambda: _time.sleep(secs)
+    if spec == "raise":
+        def _raise():
+            raise RuntimeError("failpoint (env-armed)")
+        return _raise
+    if spec in ("true", "false"):
+        return spec == "true"
+    try:
+        return int(spec)
+    except ValueError:
+        pass
+    try:
+        return float(spec)
+    except ValueError:
+        return spec
+
+
+def _nth_hit(action: Any, k: int) -> Any:
+    """Fire `action` only on the k-th evaluation (1-based): bootstrap
+    traffic through the same site must not eat a crash aimed at the
+    workload. Inert evaluations return None (call sites treat that as
+    disabled)."""
+    state = {"n": 0}
+
+    def fire():
+        state["n"] += 1
+        if state["n"] != k:
+            return None
+        if isinstance(action, BaseException) or (
+                isinstance(action, type)
+                and issubclass(action, BaseException)):
+            raise action
+        return action() if callable(action) else action
+
+    return fire
+
+
+def arm_from_env(spec: Optional[str] = None) -> list[str]:
+    """Parse `name=value;...` (TIDB_TPU_FAILPOINTS by default) and
+    enable each point; returns the armed names."""
+    if spec is None:
+        spec = os.environ.get("TIDB_TPU_FAILPOINTS", "")
+    armed = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, raw = part.partition("=")
+        raw = raw.strip()
+        if "@" in raw:
+            raw, _, nth = raw.rpartition("@")
+            value: Any = _nth_hit(_parse_action(raw), int(nth))
+        else:
+            value = _parse_action(raw)
+        enable(name.strip(), value)
+        armed.append(name.strip())
+    return armed
+
+
+arm_from_env()
+
+
 __all__ = ["enable", "disable", "disable_all", "is_enabled", "inject",
-           "hits", "failpoint"]
+           "hits", "snapshot", "failpoint", "arm_from_env"]
