@@ -14,9 +14,11 @@ from typing import Any, Optional
 
 
 class MySQLError(Exception):
-    def __init__(self, code: int, message: str) -> None:
+    def __init__(self, code: int, message: str,
+                 sqlstate: str = "HY000") -> None:
         super().__init__(f"({code}) {message}")
         self.code = code
+        self.sqlstate = sqlstate
 
 
 class MiniClient:
@@ -58,6 +60,10 @@ class MiniClient:
     def _handshake(self, user: str, password: str, db: str,
                    use_ssl: bool) -> None:
         greet = self._read_packet()
+        if greet[0] == 0xFF:
+            # the server may reject with an ERR packet in place of the
+            # greeting (errno 1040 at the connection gate)
+            raise MySQLError(*_parse_err(greet))
         assert greet[0] == 0x0A, "expected protocol v10 handshake"
         pos = greet.index(b"\x00", 1) + 1  # server version
         pos += 4  # thread id
@@ -186,12 +192,13 @@ def _lenenc(buf: bytes, pos: int) -> tuple[int, int]:
     return int.from_bytes(buf[pos + 1:pos + 9], "little"), pos + 9
 
 
-def _parse_err(data: bytes) -> tuple[int, str]:
+def _parse_err(data: bytes) -> tuple[int, str, str]:
     code = int.from_bytes(data[1:3], "little")
     msg = data[3:].decode("utf-8", "replace")
+    state = "HY000"
     if msg.startswith("#"):
-        msg = msg[6:]
-    return code, msg
+        state, msg = msg[1:6], msg[6:]
+    return code, msg, state
 
 
 def _column_name(cd: bytes) -> str:

@@ -180,3 +180,62 @@ def test_right_join_spill_matches():
     want = tk.must_query(q)
     tk.must_exec("set tidb_mem_quota_query = 30000")
     assert tk.must_query(q) == want
+
+
+def test_oom_cancel_reaches_wire_as_8175_hy000():
+    """End-to-end errno pin: under tidb_mem_oom_action=CANCEL a
+    quota-exceeding statement must reach the CLIENT as errno 8175 with
+    SQLSTATE HY000 — through the real protocol, not just the session
+    layer (the mapping lives in util/memory.QueryMemExceeded)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from mysql_client import MiniClient, MySQLError
+
+    from tidb_tpu.server import Server
+
+    srv = Server(port=0)
+    srv.start()
+    try:
+        c = MiniClient("127.0.0.1", srv.port, timeout=120.0)
+        c.execute("create table w (a int, b varchar(10))")
+        rng = np.random.default_rng(3)
+        rows = ",".join(
+            f"({int(v)},'k{int(v) % 53}')"
+            for v in rng.integers(-500, 500, 3000))
+        c.execute(f"insert into w values {rows}")
+        c.execute("set tidb_mem_oom_action = 'CANCEL'")
+        c.execute("set tidb_mem_quota_query = 6000")
+        with pytest.raises(MySQLError) as ei:
+            c.query("select a, b from w order by a, b")
+        assert ei.value.code == 8175
+        assert ei.value.sqlstate == "HY000"
+        assert "Out Of Memory Quota" in str(ei.value)
+        # the connection survives the cancel
+        c.execute("set tidb_mem_oom_action = 'SPILL'")
+        assert c.query("select count(*) from w") == [("3000",)]
+        c.close()
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+def test_tracker_materialization_ledger():
+    """account() feeds the governor's weight + MEM_MAX surfaces without
+    touching the quota/spill meters."""
+    root = MemTracker("query", quota=1000, action="CANCEL")
+    child = root.child("join")
+    child.account(500)
+    assert root.ledger == 500 and root.ledger_peak == 500
+    assert root.consumed == 0           # quota meter untouched
+    assert root.footprint() == 500
+    assert root.peak_footprint() == 500
+    child.check(900, "join")            # still under quota: no raise
+    child.consume(300)
+    assert root.footprint() == 800
+    # the peak is the COMBINED (consumed + ledger) high-water: mem_max
+    # can never report below a footprint the governor ranked/killed at
+    assert root.peak_footprint() == 800
+    child.release(300)
+    assert root.footprint() == 500
+    assert root.peak_footprint() == 800  # high-water survives release

@@ -1,0 +1,690 @@
+"""Server-wide overload protection: admission control, the global
+memory governor, and the RPC circuit breaker.
+
+Counterpart of the reference's overload seams (reference:
+server/server.go token limiter + ER_CON_COUNT_ERROR 1040;
+util/memory's instance-level kill policy; the client-side fail-fast
+gates of store/tikv). Fast variants run in tier-1 against mock
+trackers and armed failpoints; the real connection-flood and
+memory-bomb chaos runs are marked `slow`.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from mysql_client import MiniClient, MySQLError  # noqa: E402
+
+from tidb_tpu.rpc.client import RpcClient, RpcOptions  # noqa: E402
+from tidb_tpu.rpc.errors import LeaderUnavailable  # noqa: E402
+from tidb_tpu.server import Server  # noqa: E402
+from tidb_tpu.session import Session, SQLError  # noqa: E402
+from tidb_tpu.store.storage import Storage  # noqa: E402
+from tidb_tpu.util import failpoint  # noqa: E402
+from tidb_tpu.util.governor import (  # noqa: E402
+    PRI_DML,
+    PRI_POINT,
+    PRI_SCAN,
+    AdmissionGate,
+    AdmissionTimeout,
+    MemoryGovernor,
+    parse_mem_limit,
+)
+from tidb_tpu.util.memory import MemTracker  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+# ==================== mem-limit parsing ====================
+
+def test_parse_mem_limit_forms():
+    assert parse_mem_limit(0) == 0
+    assert parse_mem_limit("0") == 0
+    assert parse_mem_limit("") == 0
+    assert parse_mem_limit(None) == 0
+    assert parse_mem_limit(1 << 30) == 1 << 30
+    assert parse_mem_limit("1073741824") == 1 << 30
+    assert parse_mem_limit("50%", total=1000) == 500
+    assert parse_mem_limit("0.25", total=1000) == 250
+    for bad in ("1.5GB", "-1", "150%", "abc", True, "0.5.1"):
+        with pytest.raises(ValueError):
+            parse_mem_limit(bad)
+
+
+# ==================== memory governor (mock trackers) ====================
+
+def _mock_entries(gov, weights, cancellable=None):
+    """Register one MemTracker per weight; returns (kill log, tokens)."""
+    killed: list[int] = []
+    tokens = []
+    for i, w in enumerate(weights):
+        t = MemTracker(f"q{i}")
+        t.consume(w)
+        tokens.append(gov.register(
+            t, kill=lambda i=i: killed.append(i), label=f"q{i}",
+            cancellable=(cancellable[i] if cancellable else True)))
+    return killed, tokens
+
+
+def test_governor_kills_exactly_the_heaviest():
+    gov = MemoryGovernor(limit_bytes=1 << 40)  # out of reach for now
+    killed, tokens = _mock_entries(gov, [100, 900, 500])
+    assert killed == []  # registration under the limit kills nobody
+    gov.configure(limit_bytes=1000, cooldown_ms=100)
+    with failpoint.failpoint("governor/mem-pressure", 5000):
+        assert gov.check() is True
+        assert killed == [1]          # the 900-byte statement, only it
+        assert gov.check() is False   # cooldown holds
+        assert killed == [1]
+        gov._last_kill = -1e18        # force the cooldown open
+        assert gov.check() is True
+        assert killed == [1, 2]       # next heaviest, deterministic
+    for tok in tokens:
+        gov.unregister(tok)
+    assert gov.stats()["statements"] == 0
+
+
+def test_governor_respects_cancellable_and_pressure():
+    gov = MemoryGovernor(limit_bytes=1000, cooldown_ms=0)
+    # synthetic pressure BELOW the limit: nothing dies, even at register
+    with failpoint.failpoint("governor/mem-pressure", 500):
+        killed, tokens = _mock_entries(
+            gov, [900, 100], cancellable=[False, True])
+        assert killed == []
+    # over the limit: the heaviest is NOT cancellable -> the lighter
+    # cancellable one dies instead
+    with failpoint.failpoint("governor/mem-pressure", 5000):
+        assert gov.check() is True
+        assert killed == [1]
+        # everyone cancellable is already killed: no further victims
+        assert gov.check() is False
+    for tok in tokens:
+        gov.unregister(tok)
+
+
+def test_governor_disabled_never_kills():
+    gov = MemoryGovernor(limit_bytes=0)
+    killed, tokens = _mock_entries(gov, [1 << 30])
+    with failpoint.failpoint("governor/mem-pressure", 1 << 50):
+        assert gov.check() is False
+    assert killed == []
+    for tok in tokens:
+        gov.unregister(tok)
+
+
+def test_governor_consume_poll_triggers_check():
+    """The tracker-consume hot path re-evaluates the ledger every
+    GOV_POLL_BYTES of root growth — no background thread involved."""
+    gov = MemoryGovernor(limit_bytes=1000, cooldown_ms=0)
+    killed: list[str] = []
+    root = MemTracker("q")
+    with failpoint.failpoint("governor/mem-pressure", 500):
+        gov.register(root, kill=lambda: killed.append("q"))
+    with failpoint.failpoint("governor/mem-pressure", 5000):
+        child = root.child("sort")
+        child.consume(8 << 20)  # crosses the poll threshold
+    assert killed == ["q"]
+
+
+def test_governor_kill_end_to_end_typed_8175():
+    """A real statement killed by the governor surfaces errno 8175 with
+    the server-scoped message, while other sessions keep working, and
+    the kill is explainable from the mem_max surfaces afterwards.
+
+    The kill is advisory through the interrupt plane (like KILL QUERY):
+    a statement past its last checkpoint completes. The 3-way join is
+    sized so the first weight registration happens at the FIRST hash
+    build with two more joins plus the aggregate still ahead — plenty
+    of checkpoints between the kill and completion."""
+    st = Storage()
+    heavy_s = Session(st)
+    light_s = Session(st)
+    heavy_s.execute("create table s (a int, b varchar(10))")
+    rng = np.random.default_rng(3)
+    rows = ",".join(f"({int(v)},'k{int(v) % 53}')"
+                    for v in rng.integers(0, 100, 4000))
+    heavy_s.execute(f"insert into s values {rows}")
+    errs: list = []
+
+    def heavy():
+        try:
+            heavy_s.query("select count(*) from s a "
+                          "join s b on a.a = b.a join s c on b.a = c.a")
+            errs.append(None)
+        except SQLError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=heavy)
+    t.start()
+    try:
+        # wait until the statement registered AND materialized weight
+        # (so the kill is genuinely "the heaviest", not just "the only")
+        deadline = time.monotonic() + 30
+        while st.governor.tracked_bytes() <= 0:
+            assert time.monotonic() < deadline, "statement never weighed"
+            time.sleep(0.01)
+        st.governor.configure(limit_bytes=1 << 20, cooldown_ms=1000)
+        failpoint.enable("governor/mem-pressure", 2 << 20)
+        assert st.governor.check() is True
+    finally:
+        t.join(timeout=60)
+        failpoint.disable("governor/mem-pressure")
+        st.governor.configure(limit_bytes=0)
+    assert not t.is_alive()
+    assert len(errs) == 1 and errs[0] is not None
+    assert errs[0].errno == 8175
+    assert "[server]" in str(errs[0])
+    assert st.governor.kills.get() == 1.0
+    # the victim's weight survives for forensics
+    assert heavy_s.last_mem_peak > 0
+    # the rest of the server is alive and the kill is visible in SQL
+    assert light_s.query("select count(*) from s") == [(4000,)]
+    mem_rows = light_s.query(
+        "select max_mem_bytes from information_schema.statements_summary "
+        "where query_sample_text like '%join s c%'")
+    assert mem_rows and mem_rows[0][0] > 0
+
+
+# ==================== admission gate ====================
+
+def test_admission_gate_unlimited_is_noop():
+    gate = AdmissionGate()
+    assert gate.acquire(PRI_SCAN) is False  # no token held
+    with gate.admit(PRI_POINT):
+        assert gate.stats()["running"] == 0
+
+
+def test_admission_timeout_sheds_typed():
+    gate = AdmissionGate(tokens=1, timeout_ms=50)
+    assert gate.acquire(PRI_SCAN) is True
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionTimeout) as ei:
+        gate.acquire(PRI_SCAN)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.errno == 9003
+    assert "busy" in str(ei.value)
+    assert gate.stats()["shed"] == 1.0
+    assert gate.stats()["queue_depth"] == 0  # waiter cleaned up
+    gate.release()
+    # the token is reusable after release
+    assert gate.acquire(PRI_SCAN) is True
+    gate.release()
+
+
+def test_admission_priority_order():
+    """With one token held, a later-arriving high-priority waiter is
+    admitted before an earlier low-priority one."""
+    gate = AdmissionGate(tokens=1, timeout_ms=10000)
+    assert gate.acquire(PRI_SCAN) is True
+    order: list[str] = []
+    started = threading.Barrier(3)
+
+    def waiter(name, pri):
+        started.wait()
+        if name == "dml":
+            time.sleep(0.2)  # arrives LATER than the scan
+        gate.acquire(pri)
+        order.append(name)
+        gate.release()
+
+    ts = [threading.Thread(target=waiter, args=("scan", PRI_SCAN)),
+          threading.Thread(target=waiter, args=("dml", PRI_DML))]
+    for t in ts:
+        t.start()
+    started.wait()
+    time.sleep(0.5)  # both queued: scan first, dml second
+    gate.release()
+    for t in ts:
+        t.join(timeout=10)
+    assert order == ["dml", "scan"]
+
+
+def test_admission_end_to_end_shed_errno_9003():
+    """token-limit 1: while a heavy statement executes, a concurrent
+    SELECT sheds with the typed server-busy error instead of queueing
+    forever."""
+    st = Storage()
+    s1, s2 = Session(st), Session(st)
+    s1.execute("create table s (a int, b varchar(10))")
+    rng = np.random.default_rng(7)
+    rows = ",".join(f"({int(v)},'k{int(v) % 53}')"
+                    for v in rng.integers(0, 100, 4000))
+    s1.execute(f"insert into s values {rows}")
+    st.admission.configure(tokens=1, timeout_ms=200)
+    done: list = []
+
+    def heavy():
+        done.append(s1.query(
+            "select count(*) from s a join s b on a.a = b.a "
+            "join s c on b.a = c.a"))
+
+    t = threading.Thread(target=heavy)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while st.admission.stats()["running"] < 1:
+            assert time.monotonic() < deadline, "token never acquired"
+            time.sleep(0.01)
+        with pytest.raises(AdmissionTimeout) as ei:
+            s2.query("select count(*) from s")
+        assert ei.value.errno == 9003
+    finally:
+        t.join(timeout=120)
+        st.admission.configure(tokens=0)
+    assert done and done[0][0][0] >= 4000  # the heavy one completed
+    assert st.admission.stats()["shed"] >= 1.0
+    # unthrottled again afterwards
+    assert s2.query("select count(*) from s") == [(4000,)]
+
+
+def test_insert_select_does_not_self_deadlock():
+    """INSERT .. SELECT re-enters the select path; the admission depth
+    guard must not buy a second token at token-limit 1."""
+    st = Storage()
+    s = Session(st)
+    s.execute("create table a (x bigint)")
+    s.execute("insert into a values (1),(2),(3)")
+    s.execute("create table b (x bigint)")
+    st.admission.configure(tokens=1, timeout_ms=500)
+    try:
+        assert s.execute("insert into b select x from a").affected == 3
+        assert s.query("select count(*) from b") == [(3,)]
+    finally:
+        st.admission.configure(tokens=0)
+
+
+# ==================== connection gate (errno 1040) ====================
+
+def test_connection_gate_clean_1040():
+    srv = Server(port=0, max_connections=2)
+    srv.start()
+    try:
+        c1 = MiniClient("127.0.0.1", srv.port)
+        c2 = MiniClient("127.0.0.1", srv.port)
+        with pytest.raises(MySQLError) as ei:
+            MiniClient("127.0.0.1", srv.port)
+        assert ei.value.code == 1040
+        assert ei.value.sqlstate == "08004"
+        assert srv.storage.obs.conn_rejects.get() == 1.0
+        # existing connections keep working through the rejection
+        assert c1.query("select 1+1") == [("2",)]
+        c1.close()
+        # a freed slot admits again
+        deadline = time.monotonic() + 10
+        while srv.connection_count() >= 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        c3 = MiniClient("127.0.0.1", srv.port)
+        assert c3.query("select 2+2") == [("4",)]
+        c2.close()
+        c3.close()
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+@pytest.mark.slow
+def test_connection_flood_slow():
+    """A flood against a small cap: every attempt either serves queries
+    or gets a clean 1040 — no hangs, no leaked sockets (the conftest
+    guard enforces the latter)."""
+    cap = 8
+    srv = Server(port=0, max_connections=cap)
+    srv.start()
+    results: list[str] = []
+    lock = threading.Lock()
+
+    def attempt():
+        try:
+            c = MiniClient("127.0.0.1", srv.port, timeout=30.0)
+            assert c.query("select 40+2") == [("42",)]
+            time.sleep(0.2)
+            c.close()
+            with lock:
+                results.append("served")
+        except MySQLError as e:
+            assert e.code == 1040, e
+            with lock:
+                results.append("1040")
+
+    try:
+        threads = [threading.Thread(target=attempt) for _ in range(40)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "flood hung"
+        assert len(results) == 40
+        assert results.count("served") >= cap  # the cap's worth served
+        assert results.count("1040") >= 1      # and real shedding
+        # drain: every served connection closed cleanly
+        deadline = time.monotonic() + 10
+        while srv.connection_count() > 0:
+            assert time.monotonic() < deadline, "connections leaked"
+            time.sleep(0.05)
+    finally:
+        srv.close(drain_timeout=2.0)
+
+
+# ==================== wire-level chaos (slow) ====================
+
+@pytest.mark.slow
+def test_memory_bomb_wire_slow():
+    """Concurrent memory bombs over the wire: the governor kills
+    exactly the heaviest (typed 8175) and the light statements
+    complete."""
+    srv = Server(port=0)
+    srv.start()
+    st = srv.storage
+    try:
+        c0 = MiniClient("127.0.0.1", srv.port, timeout=120.0)
+        c0.execute("create table s (a int, b varchar(10))")
+        rng = np.random.default_rng(11)
+        rows = ",".join(f"({int(v)},'k{int(v) % 53}')"
+                        for v in rng.integers(0, 200, 8000))
+        c0.execute(f"insert into s values {rows}")
+        heavy_err: list = []
+
+        def heavy():
+            c = MiniClient("127.0.0.1", srv.port, timeout=120.0)
+            try:
+                c.query("select count(*) from s a join s b "
+                        "on a.a = b.a join s c on b.a = c.a")
+                heavy_err.append(None)
+            except MySQLError as e:
+                heavy_err.append(e)
+            finally:
+                c.close()
+
+        t = threading.Thread(target=heavy)
+        t.start()
+        deadline = time.monotonic() + 60
+        while st.governor.stats()["statements"] < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        st.governor.configure(limit_bytes=1 << 20, cooldown_ms=1000)
+        failpoint.enable("governor/mem-pressure", 2 << 20)
+        try:
+            assert st.governor.check() is True
+        finally:
+            t.join(timeout=120)
+            failpoint.disable("governor/mem-pressure")
+            st.governor.configure(limit_bytes=0)
+        assert heavy_err and heavy_err[0] is not None
+        assert heavy_err[0].code == 8175
+        assert heavy_err[0].sqlstate == "HY000"
+        # light traffic survives the kill
+        assert c0.query("select count(*) from s") == [("8000",)]
+        # forensics: the kill shows up in processlist mem columns
+        rows = c0.query("select mem_max from "
+                        "information_schema.processlist")
+        assert rows
+        c0.close()
+    finally:
+        srv.close(drain_timeout=2.0)
+
+
+# ==================== rpc circuit breaker ====================
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+BRK_OPTS = dict(connect_timeout_ms=200, request_timeout_ms=1000,
+                backoff_budget_ms=200, breaker_threshold=2,
+                breaker_cooldown_ms=300)
+
+
+def test_breaker_trips_and_fails_fast():
+    opts = RpcOptions(**BRK_OPTS)
+    client = RpcClient(f"127.0.0.1:{_free_port()}", opts,
+                       _heartbeat=False)
+    try:
+        assert client.breaker_state == "closed"
+        for _ in range(2):
+            with pytest.raises(LeaderUnavailable):
+                client.call("ping")
+        assert client.breaker_state == "open"
+        # open: fail fast WITHOUT burning the backoff budget
+        t0 = time.monotonic()
+        with pytest.raises(LeaderUnavailable) as ei:
+            client.call("ping")
+        assert time.monotonic() - t0 < 0.1
+        assert "circuit breaker open" in str(ei.value)
+        h = client.health()
+        assert h["breaker"] == "open"
+        assert h["breaker_fail_streak"] == 2
+        assert client.degraded
+    finally:
+        client.close()
+
+
+def test_breaker_half_open_recovers(tmp_path):
+    opts = RpcOptions(**BRK_OPTS)
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=opts)
+    try:
+        client = RpcClient(f"127.0.0.1:{leader.rpc_server.port}", opts,
+                           _heartbeat=False)
+        try:
+            assert client.call("ping") is not None
+            # sever the transport deterministically
+            failpoint.enable("rpc/conn-drop", True)
+            for _ in range(2):
+                with pytest.raises(LeaderUnavailable):
+                    client.call("ping")
+            assert client.breaker_state == "open"
+            # heal the wire; the breaker still fails fast mid-cooldown
+            failpoint.disable("rpc/conn-drop")
+            with pytest.raises(LeaderUnavailable):
+                client.call("ping")
+            # after the cooldown the half-open probe goes through and
+            # recovery closes the breaker
+            time.sleep(0.35)
+            assert client.breaker_state == "half-open"
+            assert client.call("ping") is not None
+            assert client.breaker_state == "closed"
+            assert not client.degraded
+        finally:
+            client.close()
+    finally:
+        leader.close()
+
+
+def test_breaker_failed_probe_reopens(tmp_path):
+    opts = RpcOptions(**BRK_OPTS)
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=opts)
+    try:
+        client = RpcClient(f"127.0.0.1:{leader.rpc_server.port}", opts,
+                           _heartbeat=False)
+        try:
+            failpoint.enable("rpc/conn-drop", True)
+            for _ in range(2):
+                with pytest.raises(LeaderUnavailable):
+                    client.call("ping")
+            time.sleep(0.35)
+            assert client.breaker_state == "half-open"
+            # the probe itself fails: straight back to open
+            with pytest.raises(LeaderUnavailable):
+                client.call("ping")
+            assert client.breaker_state == "open"
+            # repoint (e.g. failover) resets the breaker outright
+            client.repoint(f"127.0.0.1:{leader.rpc_server.port}")
+            assert client.breaker_state == "closed"
+            failpoint.disable("rpc/conn-drop")
+            assert client.call("ping") is not None
+        finally:
+            client.close()
+    finally:
+        leader.close()
+
+
+def test_breaker_surfaces_in_transport_health(tmp_path):
+    opts = RpcOptions(connect_timeout_ms=500, request_timeout_ms=2000,
+                      backoff_budget_ms=500, lease_ms=1000,
+                      breaker_threshold=2, breaker_cooldown_ms=300)
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=opts)
+    follower = None
+    try:
+        follower = Storage(
+            str(tmp_path / "follower"),
+            remote=f"127.0.0.1:{leader.rpc_server.port}",
+            rpc_options=opts)
+        h = follower.transport_health()
+        assert h["breaker"] == "closed"
+        assert "breaker_fail_streak" in h
+    finally:
+        if follower is not None:
+            follower.close()
+        leader.close()
+
+
+# ==================== /status + metrics surfaces ====================
+
+def test_status_exposes_admission_and_governor():
+    srv = Server(port=0, status_port=0)
+    srv.start()
+    try:
+        srv.storage.admission.configure(tokens=7, timeout_ms=1234)
+        srv.storage.governor.configure(limit_bytes=1 << 30)
+        import json
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/status",
+                timeout=10) as r:
+            status = json.loads(r.read())
+        assert status["admission"]["token_limit"] == 7
+        assert status["admission"]["timeout_ms"] == 1234
+        assert status["governor"]["limit_bytes"] == 1 << 30
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.status_port}/metrics",
+                timeout=10) as r:
+            text = r.read().decode()
+        assert "tidb_admission_queue_depth" in text
+        assert "tidb_governor_memory_usage_bytes" in text
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+def test_cluster_load_carries_admission_governor_rows():
+    s = Session()
+    s.storage.admission.configure(tokens=4)
+    s.query("select 1")
+    names = {r[0] for r in s.query(
+        "select name from information_schema.cluster_load "
+        "where name like 'tidb_admission%' "
+        "or name like 'tidb_governor%'")}
+    assert "tidb_admission_running" in names
+    assert "tidb_governor_memory_usage_bytes" in names
+
+
+# ==================== satellites ====================
+
+def test_wait_timeout_reaps_idle_connection():
+    srv = Server(port=0)
+    srv.start()
+    try:
+        c = MiniClient("127.0.0.1", srv.port)
+        c.execute("set session wait_timeout = 1")
+        time.sleep(1.6)
+        # the server has gone away: the dead socket surfaces as a
+        # connection error on the next roundtrip
+        with pytest.raises((ConnectionError, OSError)):
+            c.query("select 1")
+        deadline = time.monotonic() + 10
+        while srv.connection_count() > 0:
+            assert time.monotonic() < deadline, "reaped conn leaked"
+            time.sleep(0.05)
+        # an active connection with the default timeout is untouched
+        c2 = MiniClient("127.0.0.1", srv.port)
+        time.sleep(1.2)
+        assert c2.query("select 5") == [("5",)]
+        c2.close()
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+def test_kill_denied_1095_without_super():
+    srv = Server(port=0, users={"root": ""}, allow_unknown_users=False)
+    srv.start()
+    try:
+        root = MiniClient("127.0.0.1", srv.port)
+        root.execute("create user 'bob' identified by 'pw'")
+        victim_id = next(iter(srv._conns))  # root's connection id
+        bob = MiniClient("127.0.0.1", srv.port, user="bob",
+                         password="pw")
+        with pytest.raises(MySQLError) as ei:
+            bob.execute(f"kill {victim_id}")
+        assert ei.value.code == 1095
+        assert "not owner" in str(ei.value)
+        # root (config account, unchecked) can kill anyone
+        bob_id = [cid for cid, c in srv._conns.items()
+                  if c.session.user == "bob"][0]
+        root.execute(f"kill {bob_id}")
+        deadline = time.monotonic() + 10
+        while srv.connection_count() > 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        root.close()
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+def test_kill_own_user_connection_allowed():
+    srv = Server(port=0, users={"root": ""}, allow_unknown_users=False)
+    srv.start()
+    try:
+        root = MiniClient("127.0.0.1", srv.port)
+        root.execute("create user 'carol' identified by 'pw'")
+        c1 = MiniClient("127.0.0.1", srv.port, user="carol",
+                        password="pw")
+        c2 = MiniClient("127.0.0.1", srv.port, user="carol",
+                        password="pw")
+        c1_id = [cid for cid, c in srv._conns.items()
+                 if c.session.user == "carol"][0]
+        # carol kills her OWN other connection: no SUPER needed
+        c2.execute(f"kill {c1_id}")
+        deadline = time.monotonic() + 10
+        while srv.connection_count() > 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        c2.close()
+        root.close()
+    finally:
+        srv.close(drain_timeout=1.0)
+
+
+def test_overload_plane_leaves_no_threads():
+    """Governor + gate are thread-free by design: exercising both must
+    not change the thread census."""
+    before = {t.ident for t in threading.enumerate()}
+    gov = MemoryGovernor(limit_bytes=1000, cooldown_ms=0)
+    killed, tokens = _mock_entries(gov, [500])
+    with failpoint.failpoint("governor/mem-pressure", 5000):
+        gov.check()
+    for tok in tokens:
+        gov.unregister(tok)
+    gate = AdmissionGate(tokens=1, timeout_ms=20)
+    assert gate.acquire(PRI_DML) is True
+    with pytest.raises(AdmissionTimeout):
+        gate.acquire(PRI_SCAN)
+    gate.release()
+    after = {t.ident for t in threading.enumerate()}
+    assert after <= before

@@ -229,7 +229,8 @@ def test_slow_log_carries_digest_and_stages():
     tk.must_exec("set tidb_slow_log_threshold = 100000")
     rs = tk.session.execute("show slow queries")
     assert rs.column_names == ["Time", "DB", "Duration_ms", "Query",
-                               "Plan_digest", "Stages"]
+                               "Plan_digest", "Stages", "Mem_max",
+                               "Spill_count"]
     ent = next(r for r in rs.rows if "l_extendedprice" in r[3])
     assert len(ent[4]) == 32  # digest joins against statements_summary
     digests = {r[0] for r in tk.must_query(

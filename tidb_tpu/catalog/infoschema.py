@@ -88,6 +88,9 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
         ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
         ("sum_result_rows", _bigint()),
+        # per-digest working-set high-water / spill totals (reference:
+        # stmtsummary MAX_MEM / SUM_DISK) — governor-kill forensics
+        ("max_mem_bytes", _bigint()), ("sum_spill_count", _bigint()),
         ("first_seen", _vc(20)), ("last_seen", _vc(20)),
     ],
     # the queryable slow log (reference: executor/slow_query.go parsing
@@ -97,6 +100,9 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)),
         ("plan_digest", _vc(32)), ("stages", _vc(256)),
+        # statement working-set peak + spills (reference: slow_query's
+        # Mem_max / Disk_max columns)
+        ("mem_max", _bigint()), ("spill_count", _bigint()),
     ],
     # per-statement sampling-profiler frames of THIS session's
     # @@profiling ring (reference: INFORMATION_SCHEMA.PROFILING fed by
@@ -138,7 +144,8 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("instance", _vc()), ("time", _vc(20)), ("db", _vc()),
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)), ("plan_digest", _vc(32)),
-        ("stages", _vc(256)), ("error", _vc(256)),
+        ("stages", _vc(256)), ("mem_max", _bigint()),
+        ("spill_count", _bigint()), ("error", _vc(256)),
     ],
     "cluster_statements_summary": [
         ("instance", _vc()), ("digest", _vc(32)), ("schema_name", _vc()),
@@ -194,6 +201,10 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("id", _bigint()), ("user", _vc()), ("host", _vc()),
         ("db", _vc()), ("command", _vc(16)), ("time", _bigint()),
         ("state", _vc(16)), ("info", _vc(512)),
+        # working-set peak of the live (else last) statement + its
+        # spill count (reference: TiDB's PROCESSLIST MEM column) — how
+        # an operator sees WHICH connection the governor would kill
+        ("mem_max", _bigint()), ("spill_count", _bigint()),
     ],
     "views": [
         ("table_catalog", _vc()), ("table_schema", _vc()),
@@ -375,6 +386,7 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                 round(e["sum_latency_ms"], 3),
                 round(e["sum_latency_ms"] / max(e["exec_count"], 1), 3),
                 round(e["max_latency_ms"], 3), e["sum_rows"],
+                e.get("max_mem_bytes", 0), e.get("sum_spill_count", 0),
                 e["first_seen"], e["last_seen"]])
     elif tname == "slow_query":
         # same row shape as cluster_slow_query minus (instance, error):
@@ -410,9 +422,14 @@ def _rows_for(storage, catalog: Catalog, tname: str,
             import time as _t
             info = viewer.in_flight_sql
             t = int(_t.time() - viewer.in_flight_since)                 if info and viewer.in_flight_since else 0
+            live = getattr(viewer, "_live_mem", None)
             plist = [(getattr(viewer, "conn_id", 0) or 0,
                       viewer.user or "root", "localhost",
-                      viewer.current_db, "Query", t, "executing", info)]
+                      viewer.current_db, "Query", t, "executing", info,
+                      int(live.peak_footprint()) if live is not None
+                      else int(getattr(viewer, "last_mem_peak", 0)),
+                      int(live.spill_count) if live is not None
+                      else int(getattr(viewer, "last_spill_count", 0)))]
         if viewer is not None and viewer.user is not None and not                 storage.privileges.check(viewer.user, "PROCESS", "*",
                                          "*", roles=viewer.active_roles):
             # without PROCESS only your own connections are visible
@@ -420,7 +437,9 @@ def _rows_for(storage, catalog: Catalog, tname: str,
             plist = [r for r in plist if r[1] == viewer.user]
         for r in plist:
             rows.append([int(r[0]), r[1], r[2], r[3], r[4], int(r[5]),
-                         r[6], r[7]])
+                         r[6], r[7],
+                         int(r[8]) if len(r) > 8 else 0,
+                         int(r[9]) if len(r) > 9 else 0])
     elif tname == "views":
         for s in user_schemas:
             for v in sorted(getattr(s, "views", {}).values(),

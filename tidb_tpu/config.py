@@ -42,6 +42,19 @@ class StatusConfig:
 class PerformanceConfig:
     max_procs: int = 0
     server_memory_quota: int = 0          # bytes; 0 = unlimited
+    # server-wide memory limit feeding the governor's kill policy
+    # (util/governor.py): bytes ("8589934592"), a fraction of physical
+    # RAM ("0.8"), or a percentage ("80%"); "0" disables. When crossed,
+    # the heaviest cancellable statement is killed with errno 8175.
+    server_memory_limit: str = "0"
+    # governor kill cooldown: one pressure spike kills at most one
+    # statement per window instead of massacring the processlist
+    governor_cooldown_ms: int = 1000
+    # execution admission gate: concurrently EXECUTING statements
+    # (0 = unlimited); waiters shed with a typed "server busy" error
+    # after admission-timeout-ms (reference: token-limit, config.go)
+    token_limit: int = 0
+    admission_timeout_ms: int = 10000
     mem_quota_query: int = 1 << 30        # per-query default
     txn_total_size_limit: int = 100 * 1024 * 1024
     stats_lease: str = "3s"
@@ -130,6 +143,12 @@ class TransportConfig:
     # an election (peers repoint to the bound host:port, so multi-host
     # clusters need a routable host here)
     promote_listen: str = "127.0.0.1:0"
+    # circuit breaker: after breaker-threshold CONSECUTIVE calls
+    # exhausted their retry budget, fail fast for breaker-cooldown-ms
+    # with one half-open probe after, instead of burning a full
+    # backoff-budget-ms per call against a dead leader (0 disables)
+    breaker_threshold: int = 3
+    breaker_cooldown_ms: int = 2000
 
 
 @dataclass
@@ -139,6 +158,10 @@ class Config:
     path: str = ""                   # durable storage dir; '' = in-memory
     socket: str = ""
     max_connections: int = 512
+    # hard cap rejected with errno 1040 BEFORE any handshake work
+    # (reference: max-server-connections / ER_CON_COUNT_ERROR);
+    # 0 = use max-connections as the cap
+    max_server_connections: int = 0
     default_db: str = "test"
     lease: str = "45s"               # schema lease (reference: --lease)
     log: LogConfig = field(default_factory=LogConfig)
@@ -193,10 +216,27 @@ class Config:
                 f"status-port {self.status.status_port} out of range")
         if self.max_connections < 1:
             raise ConfigError("max-connections must be >= 1")
+        if self.max_server_connections < 0:
+            raise ConfigError(
+                "max-server-connections must be >= 0 (0 = use "
+                "max-connections)")
         if self.log.level not in ("debug", "info", "warn", "error"):
             raise ConfigError(f"unknown log level {self.log.level!r}")
         if self.performance.mem_quota_query < 0:
             raise ConfigError("mem-quota-query must be >= 0")
+        from .util.governor import parse_mem_limit
+        try:
+            parse_mem_limit(self.performance.server_memory_limit)
+        except ValueError as e:
+            raise ConfigError(
+                f"performance.server-memory-limit: {e}") from None
+        if self.performance.token_limit < 0:
+            raise ConfigError(
+                "token-limit must be >= 0 (0 = unlimited)")
+        if self.performance.admission_timeout_ms < 1:
+            raise ConfigError("admission-timeout-ms must be >= 1")
+        if self.performance.governor_cooldown_ms < 0:
+            raise ConfigError("governor-cooldown-ms must be >= 0")
         if self.performance.profiler_sample_hz < 1:
             raise ConfigError("profiler-sample-hz must be >= 1")
         if self.performance.trace_span_cap < 16:
@@ -222,6 +262,13 @@ class Config:
             raise ConfigError(
                 "transport.election-timeout-ms must be >= 0 "
                 "(0 disables automatic failover)")
+        if t.breaker_threshold < 0:
+            raise ConfigError(
+                "transport.breaker-threshold must be >= 0 "
+                "(0 disables the circuit breaker)")
+        if t.breaker_cooldown_ms <= 0:
+            raise ConfigError(
+                "transport.breaker-cooldown-ms must be > 0")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -236,6 +283,13 @@ class Config:
         "log.slow_threshold", "log.level",
         "gc.life_time", "gc.run_interval",
         "performance.mem_quota_query",
+        # overload-protection knobs apply live (the reload handler
+        # re-runs seed_overload_protection): an operator fighting an
+        # actual overload must not need a restart to tighten them
+        "performance.server_memory_limit",
+        "performance.governor_cooldown_ms",
+        "performance.token_limit",
+        "performance.admission_timeout_ms",
         "plan_cache.enabled",
     })
 
@@ -280,7 +334,28 @@ class Config:
             diag_listen=t.diag_listen,
             election_timeout_ms=t.election_timeout_ms,
             promote_listen=t.promote_listen,
+            breaker_threshold=t.breaker_threshold,
+            breaker_cooldown_ms=t.breaker_cooldown_ms,
         )
+
+    def effective_max_connections(self) -> int:
+        """The connection-gate cap: max-server-connections when set,
+        else the legacy max-connections knob."""
+        return self.max_server_connections or self.max_connections
+
+    def seed_overload_protection(self, storage) -> None:
+        """Arm the storage's memory governor and execution admission
+        gate from the [performance] knobs (the server entry point and
+        hot reload both call this)."""
+        from .util.governor import parse_mem_limit
+        p = self.performance
+        limit = parse_mem_limit(p.server_memory_limit)
+        if limit == 0 and p.server_memory_quota > 0:
+            limit = p.server_memory_quota  # legacy alias of the limit
+        storage.governor.configure(limit_bytes=limit,
+                                   cooldown_ms=p.governor_cooldown_ms)
+        storage.admission.configure(tokens=p.token_limit,
+                                    timeout_ms=p.admission_timeout_ms)
 
     # ---- sysvar seeding ------------------------------------------------
     def seed_sysvars(self, storage) -> None:
@@ -399,6 +474,9 @@ port = 4000
 # durable storage directory; empty = in-memory store
 path = ""
 max-connections = 512
+# hard connection cap rejected with errno 1040 ("Too many connections")
+# before any handshake work; 0 = use max-connections as the cap
+max-server-connections = 0
 default-db = "test"
 # schema lease (informational; single-process DDL applies instantly)
 lease = "45s"
@@ -427,6 +505,20 @@ metrics-interval = 15
 
 [performance]
 server-memory-quota = 0        # bytes; 0 = unlimited
+# Server-wide memory limit (the governor's kill policy): bytes, a
+# fraction of physical RAM ("0.8"), or a percentage ("80%"). "0"
+# disables. When the server crosses the limit, the heaviest
+# cancellable running statement is killed with errno 8175 and the
+# kill is visible in tidb_governor_kills_total / the slow log's
+# mem_max column. At most one kill per governor-cooldown-ms.
+server-memory-limit = "0"
+governor-cooldown-ms = 1000
+# Execution admission gate: at most token-limit statements EXECUTE
+# concurrently (0 = unlimited). Point gets and DML outrank large
+# scans; waiters shed with a typed "server busy" error (errno 9003)
+# after admission-timeout-ms instead of piling up.
+token-limit = 0
+admission-timeout-ms = 10000
 mem-quota-query = 1073741824   # per-query working-set budget (bytes)
 txn-total-size-limit = 104857600
 stats-lease = "3s"
@@ -478,6 +570,13 @@ diag-listen = "127.0.0.1:0"    # follower diagnostics endpoint
 election-timeout-ms = 10000
 promote-listen = "127.0.0.1:0" # coordination address if promoted
                                # (use a routable host across machines)
+# Circuit breaker: after breaker-threshold CONSECUTIVE calls exhausted
+# their retry budget, fail fast for breaker-cooldown-ms (one half-open
+# probe after) instead of burning a full backoff-budget-ms per call
+# against a dead leader. 0 disables. State rides /status transport
+# health and tidb_rpc_breaker_*_total metrics.
+breaker-threshold = 3
+breaker-cooldown-ms = 2000
 
 [security]
 skip-grant-table = false

@@ -55,6 +55,9 @@ class ExecContext:
     cop: CopClient
     stats: Optional[object] = None  # obs.RuntimeStatsColl for EXPLAIN ANALYZE
     mem: Optional[MemTracker] = None  # per-query quota tracker
+    # statement-end hook (session uses it to unregister the tracker
+    # root from the server-wide memory governor); runs exactly once
+    on_close: Optional[object] = None
 
     def __post_init__(self) -> None:
         self._subq_cache: dict[int, Const] = {}
@@ -72,6 +75,9 @@ class ExecContext:
         if self._spill is not None:
             self._spill.close()
             self._spill = None
+        cb, self.on_close = self.on_close, None
+        if cb is not None:
+            cb()
 
 
 def _overflow(ctx: ExecContext, est: int, label: str) -> bool:
@@ -80,6 +86,11 @@ def _overflow(ctx: ExecContext, est: int, label: str) -> bool:
     configured action is CANCEL (reference: util/memory/action.go:28 —
     spill actions vs PanicOnExceed)."""
     if not ctx.mem.over_budget(est):
+        # admitted in memory: record the working set on the statement's
+        # materialization ledger so the server-wide governor can rank
+        # statements by weight (and MEM_MAX explains kills afterwards);
+        # deliberately NOT consume() — quota/spill decisions unchanged
+        ctx.mem.account(est)
         return False
     ctx.mem.check(est, label)  # raises under CANCEL
     ctx.mem.note_spill()

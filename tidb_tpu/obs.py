@@ -278,7 +278,8 @@ class StatementsSummary:
         return " ".join(out)
 
     def record(self, sql: str, db: str, duration_s: float,
-               rows: int = 0, failed: bool = False) -> None:
+               rows: int = 0, failed: bool = False,
+               mem_peak: int = 0, spill_count: int = 0) -> None:
         import hashlib
 
         norm = self.normalize(sql)
@@ -301,6 +302,7 @@ class StatementsSummary:
                     "exec_count": 0, "errors": 0,
                     "sum_latency_ms": 0.0, "max_latency_ms": 0.0,
                     "sum_rows": 0,
+                    "max_mem_bytes": 0, "sum_spill_count": 0,
                     "first_seen": now, "last_seen": now,
                 }
             ent["exec_count"] += 1
@@ -308,6 +310,12 @@ class StatementsSummary:
             ent["sum_latency_ms"] += ms
             ent["max_latency_ms"] = max(ent["max_latency_ms"], ms)
             ent["sum_rows"] += rows
+            # per-digest working-set high-water + spills (reference:
+            # stmtsummary's MaxMem / SumDisk columns)
+            ent["max_mem_bytes"] = max(ent.get("max_mem_bytes", 0),
+                                       int(mem_peak))
+            ent["sum_spill_count"] = ent.get("sum_spill_count", 0) \
+                + int(spill_count)
             ent["last_seen"] = now
 
     def snapshot(self) -> list[dict]:
@@ -342,6 +350,9 @@ class Observability:
             "tidb_write_conflicts_total", "commit-time write conflicts")
         self.connections = self.metrics.counter(
             "tidb_connections_total", "wire connections accepted")
+        self.conn_rejects = self.metrics.counter(
+            "tidb_server_connections_rejected_total",
+            "connections rejected at the gate with errno 1040")
         self.slow_counter = self.metrics.counter(
             "tidb_slow_queries_total",
             "statements over the slow-log threshold")
@@ -353,7 +364,8 @@ class Observability:
 
     def record_slow(self, sql: str, db: str, duration_s: float,
                     plan_digest: str = "",
-                    stages: Optional[dict[str, float]] = None) -> None:
+                    stages: Optional[dict[str, float]] = None,
+                    mem_peak: int = 0, spill_count: int = 0) -> None:
         self.slow_counter.inc()
         ent = {
             "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -365,6 +377,11 @@ class Observability:
             "plan_digest": plan_digest,
             "stages": {k: round(v * 1e3, 3)
                        for k, v in (stages or {}).items()},
+            # statement working-set peak + spill count (reference:
+            # LogSlowQuery's Mem_max / Disk_max) — what makes a
+            # governor kill explainable after the fact
+            "mem_max": int(mem_peak),
+            "spill_count": int(spill_count),
         }
         with self._slow_lock:
             self._slow_log.append(ent)
@@ -435,6 +452,15 @@ JIT_CACHE = PROCESS_METRICS.counter(
 PROFILER_SAMPLES = PROCESS_METRICS.counter(
     "tidb_profiler_samples_total",
     "stack samples taken by the host sampling profiler")
+# rpc circuit breaker (rpc/client.py): process-wide like the copr
+# counters — every RpcClient in this process reports here, and the
+# breaker state itself is per-client on /status transport_health
+RPC_BREAKER_TRIPS = PROCESS_METRICS.counter(
+    "tidb_rpc_breaker_trips_total",
+    "circuit-breaker opens after consecutive transport failures")
+RPC_BREAKER_FAST_FAILS = PROCESS_METRICS.counter(
+    "tidb_rpc_breaker_fast_failures_total",
+    "calls failed fast by an open rpc circuit breaker")
 
 # device telemetry gauges (ONE device per process, like the counters
 # above): transfer bytes accumulate on the dispatch hot path; buffer
@@ -1084,8 +1110,10 @@ def profile_process(seconds: float = 0.5, hz: float = 97.0) -> Profile:
 
 def record_slow(sql: str, db: str, duration_s: float,
                 plan_digest: str = "",
-                stages: Optional[dict[str, float]] = None) -> None:
-    DEFAULT.record_slow(sql, db, duration_s, plan_digest, stages)
+                stages: Optional[dict[str, float]] = None,
+                mem_peak: int = 0, spill_count: int = 0) -> None:
+    DEFAULT.record_slow(sql, db, duration_s, plan_digest, stages,
+                        mem_peak, spill_count)
 
 
 def slow_queries() -> list[dict]:

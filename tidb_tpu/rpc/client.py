@@ -70,6 +70,13 @@ class RpcOptions:
     # election and promotes (the bound host:port is what surviving
     # peers repoint to, so on multi-host clusters use a routable host)
     promote_listen: str = "127.0.0.1:0"
+    # circuit breaker: after this many CONSECUTIVE calls exhausted
+    # their transport-retry budget, fail fast for breaker-cooldown-ms
+    # instead of burning a full BO_RPC budget per call, then let ONE
+    # half-open probe through — success closes the breaker, failure
+    # re-opens it (0 disables; application errors never count)
+    breaker_threshold: int = 3
+    breaker_cooldown_ms: int = 2000
 
 
 class RpcClient:
@@ -96,6 +103,13 @@ class RpcClient:
         self.retries = 0
         self.degraded = False
         self.last_contact = 0.0
+        # circuit breaker state: consecutive budget-exhausted calls;
+        # while >= threshold the breaker is OPEN until the cooldown
+        # deadline, then HALF-OPEN (one probe call allowed through)
+        self._bk_lock = threading.Lock()
+        self._bk_streak = 0
+        self._bk_open_until = 0.0
+        self._bk_probe = False
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._hb_client: Optional["RpcClient"] = None
@@ -141,6 +155,7 @@ class RpcClient:
         reconnect and retry under BO_RPC until the budget is spent;
         exhaustion raises LeaderUnavailable carrying the history and
         flips the client into degraded mode."""
+        self._breaker_gate(method)
         bo = Backoffer(budget_ms=_budget_ms
                        if _budget_ms is not None
                        else self.options.backoff_budget_ms)
@@ -150,9 +165,19 @@ class RpcClient:
                 raise RPCError("rpc client closed")
             t0 = time.monotonic()
             try:
-                r = self._call_once(method, params)
+                try:
+                    r = self._call_once(method, params)
+                except (OSError, FrameError, FrameProtocolError):
+                    raise
+                except BaseException:
+                    # an application error (typed handler error, stale
+                    # term) rode a COMPLETED round-trip: the transport
+                    # is healthy, so the breaker counts it as success
+                    self._breaker_note(ok=True)
+                    raise
                 self.degraded = False
                 self.last_contact = time.monotonic()
+                self._breaker_note(ok=True)
                 return r
             except (OSError, FrameError, FrameProtocolError) as e:
                 # covers ConnectionError, socket.timeout, refused, reset
@@ -168,9 +193,73 @@ class RpcClient:
                     bo.sleep(BO_RPC)
                 except BackoffExhausted as exhausted:
                     self.degraded = True
+                    self._breaker_note(ok=False)
                     raise LeaderUnavailable(
                         f"rpc {method} to {self.addr!r} failed: "
                         f"{last!r}; {exhausted}") from None
+
+    # ---- circuit breaker ---------------------------------------------------
+    # (reference: the client-go region-cache's store liveness slow-score
+    # gate; classic Nygard breaker states). Counted per CALL, not per
+    # attempt: one exhausted BO_RPC budget = one failure, so a transient
+    # blip inside a single call's retry window never trips it.
+    def _breaker_gate(self, method: str) -> None:
+        """Raise LeaderUnavailable immediately while the breaker is
+        open; claim the single half-open probe slot after cooldown."""
+        if self.options.breaker_threshold <= 0:
+            return
+        with self._bk_lock:
+            if self._bk_streak < self.options.breaker_threshold:
+                return
+            now = time.monotonic()
+            if now < self._bk_open_until:
+                wait_s = self._bk_open_until - now
+            elif self._bk_probe:
+                wait_s = None  # half-open, probe slot taken
+            else:
+                self._bk_probe = True  # this call IS the probe
+                return
+        obs.RPC_BREAKER_FAST_FAILS.inc()
+        self.degraded = True
+        if wait_s is not None:
+            raise LeaderUnavailable(
+                f"rpc {method} to {self.addr!r}: circuit breaker open "
+                f"after {self._bk_streak} consecutive transport "
+                f"failures; half-open probe in {wait_s:.2f}s")
+        raise LeaderUnavailable(
+            f"rpc {method} to {self.addr!r}: circuit breaker "
+            f"half-open, probe already in flight")
+
+    def _breaker_note(self, ok: bool) -> None:
+        if self.options.breaker_threshold <= 0:
+            return
+        with self._bk_lock:
+            self._bk_probe = False
+            if ok:
+                self._bk_streak = 0
+                return
+            self._bk_streak += 1
+            if self._bk_streak >= self.options.breaker_threshold:
+                self._bk_open_until = time.monotonic() \
+                    + self.options.breaker_cooldown_ms / 1000.0
+                if self._bk_streak == self.options.breaker_threshold:
+                    obs.RPC_BREAKER_TRIPS.inc()
+
+    def _breaker_reset(self) -> None:
+        with self._bk_lock:
+            self._bk_streak = 0
+            self._bk_open_until = 0.0
+            self._bk_probe = False
+
+    @property
+    def breaker_state(self) -> str:
+        with self._bk_lock:
+            if self.options.breaker_threshold <= 0 or \
+                    self._bk_streak < self.options.breaker_threshold:
+                return "closed"
+            if time.monotonic() < self._bk_open_until:
+                return "open"
+            return "half-open"
 
     def _call_once(self, method: str, params: dict) -> dict:
         # cross-server trace propagation: under an active TRACE the
@@ -319,6 +408,9 @@ class RpcClient:
             if term:
                 self.term = max(self.term, int(term))
             self._drop_conn()
+        # a fresh leader deserves a closed breaker: the open state was
+        # earned by the corpse this client just stopped talking to
+        self._breaker_reset()
         self.degraded = False
 
     def health(self) -> dict:
@@ -327,6 +419,8 @@ class RpcClient:
             "degraded": self.degraded,
             "calls": self.calls,
             "retries": self.retries,
+            "breaker": self.breaker_state,
+            "breaker_fail_streak": self._bk_streak,
             "last_contact_age_s": round(
                 time.monotonic() - self.last_contact, 3)
             if self.last_contact else None,

@@ -98,7 +98,9 @@ class DiagService:
         for e in self.storage.obs.slow_queries():
             rows.append([e["ts"], e["db"], float(e["duration_ms"]),
                          e["sql"], e.get("plan_digest", ""),
-                         obs.fmt_stages_ms(e.get("stages"))])
+                         obs.fmt_stages_ms(e.get("stages")),
+                         int(e.get("mem_max", 0)),
+                         int(e.get("spill_count", 0))])
         return {"rows": rows}
 
     def diag_statements(self) -> dict:

@@ -68,6 +68,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--socket", default=None, help="unix socket (unused)")
     p.add_argument("--default-db", default=None)
     p.add_argument("--max-connections", type=int, default=None)
+    p.add_argument("--max-server-connections", type=int, default=None,
+                   help="hard connection cap rejected with errno 1040 "
+                        "before handshake work (0 = max-connections)")
+    p.add_argument("--server-memory-limit", default=None,
+                   help="server-wide memory limit (bytes, fraction "
+                        "like 0.8, or 80%%); the governor kills the "
+                        "heaviest statement past it")
+    p.add_argument("--token-limit", type=int, default=None,
+                   help="max concurrently executing statements "
+                        "(0 = unlimited)")
+    p.add_argument("--admission-timeout-ms", type=int, default=None,
+                   help="queue wait before shedding with 'server busy'")
     p.add_argument("--lease", default=None, help="schema lease")
     p.add_argument("-L", "--log-level", default=None,
                    choices=["debug", "info", "warn", "error"])
@@ -104,6 +116,10 @@ def resolve_config(args) -> Config:
         ("path", cfg, "path"), ("socket", cfg, "socket"),
         ("default_db", cfg, "default_db"),
         ("max_connections", cfg, "max_connections"),
+        ("max_server_connections", cfg, "max_server_connections"),
+        ("server_memory_limit", cfg.performance, "server_memory_limit"),
+        ("token_limit", cfg.performance, "token_limit"),
+        ("admission_timeout_ms", cfg.performance, "admission_timeout_ms"),
         ("lease", cfg, "lease"),
         ("log_level", cfg.log, "level"),
         ("log_slow_threshold", cfg.log, "slow_threshold"),
@@ -136,6 +152,12 @@ def resolve_config(args) -> Config:
         "gc_life_time": "gc.life_time",
         "gc_run_interval": "gc.run_interval",
         "mem_quota_query": "performance.mem_quota_query",
+        # reloadable overload knobs: a CLI-pinned value must survive
+        # SIGHUP (hot_reload skips cli_overrides), or the governor/gate
+        # would silently disarm mid-incident
+        "server_memory_limit": "performance.server_memory_limit",
+        "token_limit": "performance.token_limit",
+        "admission_timeout_ms": "performance.admission_timeout_ms",
         "plan_cache": "plan_cache.enabled",
     }
     for flag, obj, attr in flag_map:
@@ -182,9 +204,12 @@ def main(argv: list[str] | None = None) -> int:
     storage.metrics_history.configure(
         interval_s=cfg.performance.metrics_history_interval,
         cap=cfg.performance.metrics_history_cap)
+    # arm the overload-protection plane: memory governor limit/cooldown
+    # and the execution admission gate (util/governor.py)
+    cfg.seed_overload_protection(storage)
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
-                 max_connections=cfg.max_connections,
+                 max_connections=cfg.effective_max_connections(),
                  status_port=(cfg.status.status_port
                               if cfg.status.report_status else None),
                  status_host=cfg.status.status_host,
@@ -219,6 +244,7 @@ def main(argv: list[str] | None = None) -> int:
         try:
             applied = cfg.hot_reload(args.config)
             cfg.seed_sysvars(storage)
+            cfg.seed_overload_protection(storage)
             cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",
                   flush=True)

@@ -259,6 +259,19 @@ class ClientConn:
             "header")
 
     # ---- command loop ------------------------------------------------------
+    def _idle_timeout(self) -> Optional[float]:
+        """@@wait_timeout as the socket read deadline for the NEXT
+        command (reference: server/conn.go Run reads under the
+        wait_timeout deadline; MySQL reaps idle connections the same
+        way). Re-read every iteration so SET SESSION wait_timeout takes
+        effect for the following wait. None/<=0 disables."""
+        try:
+            v = self.session._sysvar_value("wait_timeout")
+            secs = float(v) if v not in (None, "") else 0.0
+        except Exception:  # noqa: BLE001 — a bad value must not reap
+            return None
+        return secs if secs > 0 else None
+
     def run(self) -> None:
         try:
             self._read_proxy_header()
@@ -267,9 +280,22 @@ class ClientConn:
             while self.alive and not self.killed.is_set():
                 self.io.reset_sequence()
                 try:
+                    self.sock.settimeout(self._idle_timeout())
                     data = self.io.read_packet()
-                except ConnectionError:
+                except TimeoutError:
+                    # idle past wait_timeout: close without a farewell —
+                    # the client's next command observes the standard
+                    # "MySQL server has gone away" (a dead socket)
                     break
+                except (ConnectionError, OSError):
+                    break
+                finally:
+                    # commands themselves run with no read deadline (a
+                    # slow statement is not an idle connection)
+                    try:
+                        self.sock.settimeout(None)
+                    except OSError:
+                        pass
                 if not data:
                     break
                 if not self.dispatch(data[0], data[1:]):

@@ -173,6 +173,9 @@ class Server:
         # SHOW PROCESSLIST provider (reference: infoschema PROCESSLIST
         # rows built from the server's client connections)
         self.storage.processlist = self._processlist
+        # KILL ownership lookup: sessions check ER_KILL_DENIED (you may
+        # kill your own user's connections; anyone else's needs SUPER)
+        self.storage.conn_owner = self.conn_owner
         coord = getattr(self.storage, "coord", None)
         if coord is not None:
             coord.register_server(self.port, self.status_port)
@@ -203,21 +206,48 @@ class Server:
                 break  # listener closed
             with self._lock:
                 if len(self._conns) >= self.max_connections:
-                    sock.close()
-                    continue
-                conn_id = self._next_conn_id
-                self._next_conn_id += 1
-                coord = getattr(self.storage, "coord", None)
-                if coord is not None:
-                    # server-id-carrying global ids (reference:
-                    # util/globalconn GCID; tests/globalkilltest)
-                    conn_id = coord.global_conn_id(coord.node_id, conn_id)
-                conn = ClientConn(self, sock, conn_id)
-                self.storage.obs.connections.inc()
-                self._conns[conn_id] = conn
+                    conn = None
+                else:
+                    conn_id = self._next_conn_id
+                    self._next_conn_id += 1
+                    coord = getattr(self.storage, "coord", None)
+                    if coord is not None:
+                        # server-id-carrying global ids (reference:
+                        # util/globalconn GCID; tests/globalkilltest)
+                        conn_id = coord.global_conn_id(coord.node_id,
+                                                       conn_id)
+                    conn = ClientConn(self, sock, conn_id)
+                    self.storage.obs.connections.inc()
+                    self._conns[conn_id] = conn
+            if conn is None:
+                # connection gate: a clean ER_CON_COUNT_ERROR before any
+                # handshake work — no salt, no auth, no session object
+                # (reference: server.go onConn rejecting over the cap;
+                # MySQL sends the ERR in place of the initial handshake)
+                self._reject_connection(sock)
+                continue
             t = threading.Thread(target=conn.run,
                                  name=f"conn-{conn_id}", daemon=True)
             t.start()
+
+    def _reject_connection(self, sock: socket.socket) -> None:
+        """Send errno 1040 as the greeting and close. Best-effort under
+        a short timeout so a stalled flood client cannot wedge the
+        accept loop."""
+        from . import packet as P
+        self.storage.obs.conn_rejects.inc()
+        try:
+            sock.settimeout(1.0)
+            payload = P.err_packet(1040, "Too many connections", "08004")
+            sock.sendall(len(payload).to_bytes(3, "little") + b"\x00"
+                         + payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def deregister(self, conn_id: int) -> None:
         with self._lock:
@@ -240,6 +270,17 @@ class Server:
             conn.kill()
         return True
 
+    def conn_owner(self, conn_id: int) -> Optional[str]:
+        """The authenticated user of a live connection, or None when the
+        id is unknown here (KILL routing uses this for the
+        ER_KILL_DENIED 1095 ownership check; reference: server.go Kill
+        checks SuperPriv || same-user)."""
+        with self._lock:
+            conn = self._conns.get(conn_id)
+        if conn is None:
+            return None
+        return conn.session.user or conn.user or ""
+
     def _kill_mailbox_loop(self) -> None:
         """Poll the shared-dir kill mailbox for requests addressed to
         this server (reference: the etcd-watch kill channel the
@@ -259,8 +300,13 @@ class Server:
             return len(self._conns)
 
     def _processlist(self) -> list[tuple]:
-        """(Id, User, Host, db, Command, Time, State, Info) per live
-        connection; Host prefers the PROXY-header real client address."""
+        """(Id, User, Host, db, Command, Time, State, Info, Mem_max,
+        Spill_count) per live connection; Host prefers the PROXY-header
+        real client address. Mem_max is the LIVE statement tracker's
+        peak while one is registered (so a statement the governor is
+        about to kill shows its weight), else the last statement's —
+        the after-the-fact explainability the governor kill policy
+        needs (reference: infoschema PROCESSLIST's MEM column)."""
         import time
         with self._lock:
             conns = list(self._conns.values())
@@ -276,9 +322,15 @@ class Server:
             info = s.in_flight_sql
             t = int(time.time() - s.in_flight_since) \
                 if info and s.in_flight_since else 0
+            live = getattr(s, "_live_mem", None)
+            mem = int(live.peak_footprint()) if live is not None \
+                else int(getattr(s, "last_mem_peak", 0))
+            spills = int(live.spill_count) if live is not None \
+                else int(getattr(s, "last_spill_count", 0))
             rows.append((c.conn_id, c.user or s.user or "", host,
                          s.current_db, "Query" if info else "Sleep", t,
-                         "" if info is None else "executing", info))
+                         "" if info is None else "executing", info,
+                         mem, spills))
         return rows
 
     def close(self, drain_timeout: float = 5.0) -> None:

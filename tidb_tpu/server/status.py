@@ -58,12 +58,21 @@ class StatusServer:
                     }
                     if outer.sql_server is not None:
                         # multi-process transport health: mode, peer,
-                        # degraded flag, retry counters (reference:
+                        # degraded flag, retry counters, and the rpc
+                        # circuit-breaker state (reference:
                         # http_status.go exposes store state the same way)
-                        health = getattr(outer.sql_server.storage,
-                                         "transport_health", None)
+                        st = outer.sql_server.storage
+                        health = getattr(st, "transport_health", None)
                         if health is not None:
                             status["transport"] = health()
+                        # overload-protection plane: admission gate
+                        # occupancy/sheds + governor limit/usage/kills
+                        gate = getattr(st, "admission", None)
+                        if gate is not None:
+                            status["admission"] = gate.stats()
+                        gov = getattr(st, "governor", None)
+                        if gov is not None:
+                            status["governor"] = gov.stats()
                     body = json.dumps(status).encode()
                     ctype = "application/json"
                 elif self.path == "/slow-query":

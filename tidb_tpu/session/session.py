@@ -12,6 +12,7 @@ statement-level staging gives per-statement rollback inside a txn
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass
 import threading
 from typing import Any, Optional
@@ -42,11 +43,13 @@ from ..errno import (
     ER_DUP_ENTRY,
     ER_FILE_EXISTS,
     ER_FILE_NOT_FOUND,
+    ER_KILL_DENIED,
     ER_NO_SUCH_TABLE,
     ER_NOT_SUPPORTED_YET,
     ER_OPTION_PREVENTS_STATEMENT,
     ER_PARSE_ERROR,
     ER_QUERY_INTERRUPTED,
+    ER_QUERY_MEM_EXCEEDED,
     ER_SPECIFIC_ACCESS_DENIED,
     ER_TABLE_EXISTS,
     ER_TABLEACCESS_DENIED,
@@ -133,6 +136,20 @@ class Session:
         # per-statement warnings (SHOW WARNINGS): degraded cluster_*
         # fan-outs report unreachable peers here instead of failing
         self.warnings: list[tuple[str, int, str]] = []
+        # server-wide overload protection (util/governor.py): the LIVE
+        # per-statement tracker root while one is registered with the
+        # memory governor (processlist MEM reads it), the governor-kill
+        # latch distinguishing 8175 from a plain KILL's 1317, and the
+        # admission re-entrancy depth (INSERT..SELECT must not buy a
+        # second execution token and self-deadlock at token-limit 1)
+        self._live_mem = None
+        self._governor_killed = False
+        self._admission_depth = 0
+        # serializes the governor's kill callback against statement
+        # tracker install/uninstall: the guard-then-set in
+        # _governor_kill must be atomic or a late callback could flag
+        # the session's NEXT statement
+        self._gov_lock = threading.Lock()
 
     def add_warning(self, message: str, code: int = 1105,
                     level: str = "Warning") -> None:
@@ -205,6 +222,13 @@ class Session:
         # statement; KILL CONNECTION leaves it set and the server drops
         # the socket)
         self.killed.clear()
+        self._governor_killed = False
+        # per-statement working-set accounting: reset so a DML or a
+        # failed statement never inherits the previous SELECT's peak in
+        # the digest table / slow log (the select path refreshes these
+        # in its finally, so governor kills still report their weight)
+        self.last_mem_peak = 0
+        self.last_spill_count = 0
         interrupt.install(self.killed)
         # @@max_execution_time: a per-statement deadline for SELECTs
         # (MySQL scopes the variable to read-only statements) riding
@@ -252,7 +276,17 @@ class Session:
         # (reference: util/profile; MySQL SHOW PROFILE semantics)
         prof = self._maybe_start_profiler(stmt)
         try:
-            rs = self._execute_stmt(stmt)
+            if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
+                                 ast.DeleteStmt, ast.LoadDataStmt)):
+                # DML admits at the TOP priority class: point writes
+                # must not starve behind queued analytical scans
+                # (SELECTs admit inside _exec_select, where the
+                # planner's cost estimate is in hand)
+                from ..util.governor import PRI_DML
+                with self._admission(PRI_DML):
+                    rs = self._execute_stmt(stmt)
+            else:
+                rs = self._execute_stmt(stmt)
             rows_out = len(rs.rows)
             if self._stmt_auto_id is not None:
                 self.vars["last_insert_id"] = self._stmt_auto_id
@@ -264,6 +298,17 @@ class Session:
         except interrupt.QueryInterrupted:
             failed = True
             o.query_errors.inc()
+            if self._governor_killed:
+                # the server memory governor picked this statement as
+                # the heaviest cancellable one: 8175-family, server-
+                # scoped message (the per-query quota path raises its
+                # own QueryMemExceeded with the [conn] form)
+                raise SQLError(
+                    "Out Of Memory Quota! [server] statement cancelled "
+                    "by the memory governor: tidb-server memory usage "
+                    "crossed server-memory-limit and this was the "
+                    "heaviest cancellable statement",
+                    errno=ER_QUERY_MEM_EXCEEDED) from None
             if self._deadline_expired:
                 from ..errno import ER_QUERY_TIMEOUT
                 raise SQLError(
@@ -292,7 +337,9 @@ class Session:
             o.query_seconds.observe(dt)
             if digest_sql is not None:
                 o.statements.record(digest_sql, self.current_db, dt,
-                                    rows_out, failed)
+                                    rows_out, failed,
+                                    mem_peak=self.last_mem_peak,
+                                    spill_count=self.last_spill_count)
             try:
                 thresh = float(
                     self._sysvar_value("tidb_slow_log_threshold"))
@@ -306,7 +353,9 @@ class Session:
                     o.statements.normalize(digest_sql or sql)
                     .encode()).hexdigest()[:32]
                 o.record_slow(sql, self.current_db, dt,
-                              plan_digest=digest, stages=rec.snapshot())
+                              plan_digest=digest, stages=rec.snapshot(),
+                              mem_peak=self.last_mem_peak,
+                              spill_count=self.last_spill_count)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -1295,10 +1344,19 @@ class Session:
         (reference: server/server.go:548 Kill; tests/globalkilltest
         cross-server kill with server-id-carrying conn ids)."""
         storage = self.storage
-        # SUPER required to kill anything but your own connection
-        # (reference: server.go Kill checks SuperPriv / same user)
-        if self.user is not None and stmt.conn_id != self.conn_id:
-            self._require_super()
+        # ownership check (reference: server.go Kill — SuperPriv OR the
+        # target belongs to the same user; MySQL types the refusal as
+        # ER_KILL_DENIED 1095, not a generic privilege error)
+        if self.user is not None and stmt.conn_id != self.conn_id \
+                and not storage.privileges.check(
+                    self.user, "ALL", "*", "*", roles=self.active_roles):
+            owner_of = getattr(storage, "conn_owner", None)
+            owner = owner_of(stmt.conn_id) if owner_of is not None \
+                else None
+            if owner != self.user:
+                raise SQLError(
+                    f"You are not owner of thread {stmt.conn_id}",
+                    errno=ER_KILL_DENIED)
         coord = getattr(storage, "coord", None)
         if coord is not None:
             nid, _local = coord.split_conn_id(stmt.conn_id)
@@ -1339,14 +1397,72 @@ class Session:
     def _exec_ctx(self, stats=None) -> ExecContext:
         """ExecContext with the session's memory quota attached
         (reference: sessionVars.MemQuotaQuery feeding the per-query
-        tracker, executor/adapter.go + util/memory/tracker.go:42)."""
+        tracker, executor/adapter.go + util/memory/tracker.go:42).
+        The root tracker also registers with the server-wide memory
+        governor for the statement's lifetime, so a server crossing
+        server-memory-limit can pick (and kill) the heaviest
+        statement; ExecContext.close() unregisters."""
         from ..util.memory import MemTracker
 
         quota = int(self._sysvar_value("tidb_mem_quota_query") or 0)
         action = str(self._sysvar_value("tidb_mem_oom_action") or "SPILL")
         mem = MemTracker("query", quota, action=action.upper())
-        return ExecContext(self._ensure_txn(), self.cop, stats=stats,
-                           mem=mem)
+        ctx = ExecContext(self._ensure_txn(), self.cop, stats=stats,
+                          mem=mem)
+        gov = getattr(self.storage, "governor", None)
+        if gov is not None:
+            token = gov.register(
+                mem, kill=lambda: self._governor_kill(mem),
+                label=(self.in_flight_sql or "")[:256],
+                conn_id=self.conn_id or 0)
+            with self._gov_lock:
+                self._live_mem = mem
+
+            def _release() -> None:
+                gov.unregister(token)
+                with self._gov_lock:
+                    if self._live_mem is mem:
+                        self._live_mem = None
+
+            ctx.on_close = _release
+        return ctx
+
+    def _governor_kill(self, mem) -> None:
+        """Kill callback the memory governor invokes (from the thread
+        that tripped the limit): flip the latch that types the error as
+        8175 and set the statement's interrupt flag — the engine polls
+        it between plan nodes / device tiles, exactly like KILL QUERY.
+        Guarded by tracker identity UNDER the session's governor lock
+        (install/uninstall hold the same lock): the governor picks its
+        victim outside this session's statement lifecycle, so a
+        callback that arrives after the picked statement finished (and
+        a new one installed a fresh tracker) must be a no-op, not a
+        spurious 8175 against whatever runs next. A flag set while the
+        victim is in its final (checkpoint-free) stretch is cleared by
+        the next statement's preamble before it can misfire."""
+        with self._gov_lock:
+            if self._live_mem is not mem:
+                return  # the picked statement already completed
+            self._governor_killed = True
+            self.killed.set()
+
+    @contextmanager
+    def _admission(self, priority: int):
+        """Hold an execution token for the duration (no-op when the gate
+        is unlimited or this statement already holds one — INSERT ..
+        SELECT re-enters through _exec_select and must not buy a second
+        token). AdmissionTimeout (errno 9003) propagates to the client
+        as the typed "server busy" shed."""
+        gate = getattr(self.storage, "admission", None)
+        if gate is None or self._admission_depth > 0:
+            yield
+            return
+        self._admission_depth += 1
+        try:
+            with gate.admit(priority):
+                yield
+        finally:
+            self._admission_depth -= 1
 
     # ==================== SELECT ====================
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
@@ -1356,26 +1472,45 @@ class Session:
         stmt = self._maybe_bind_vars(stmt, has_vars)
         stmt = self._apply_binding(stmt)
         self._refresh_infoschema(stmt)
+        ctx = None
         try:
-            if getattr(stmt, "for_update", False):
-                self._lock_for_update(stmt)
-            from .. import obs
-            with obs.stage("plan_build", span_name="planner.optimize"):
-                plan = self._plan_cached(stmt, uncacheable=has_vars)
-            self._check_column_privs(plan)
-            ctx = self._exec_ctx()
-            try:
-                chunk = run_physical(plan, ctx)
-            finally:
-                ctx.close()
+            from contextlib import nullcontext
+
+            from ..util.governor import PRI_DML, plan_priority
+            # a locking read must admit BEFORE taking row locks: locks-
+            # then-queue inverts against DML (admit-then-lock) and two
+            # idle statements would stall each other until the
+            # admission timeout. FOR UPDATE is DML-class anyway.
+            outer = self._admission(PRI_DML) \
+                if getattr(stmt, "for_update", False) else nullcontext()
+            with outer:
+                if getattr(stmt, "for_update", False):
+                    self._lock_for_update(stmt)
+                from .. import obs
+                with obs.stage("plan_build", span_name="planner.optimize"):
+                    plan = self._plan_cached(stmt, uncacheable=has_vars)
+                self._check_column_privs(plan)
+                # execution admission: the gate bounds concurrently
+                # RUNNING statements, priority from the planner's cost
+                # estimate (point gets and small scans outrank
+                # analytical sweeps); no-op when already admitted above
+                with self._admission(plan_priority(plan)):
+                    ctx = self._exec_ctx()
+                    try:
+                        chunk = run_physical(plan, ctx)
+                    finally:
+                        ctx.close()
         finally:
             # always clear the per-statement read-ts override — a plan
             # error after FOR UPDATE locking must not leak for_update_ts
             # into later statements' snapshots
             if self.txn is not None:
                 self.txn.stmt_read_ts = None
-        self.last_mem_peak = ctx.mem.peak
-        self.last_spill_count = ctx.mem.spill_count
+            # record the working-set peak even when the statement died
+            # (that is precisely when a governor kill needs explaining)
+            if ctx is not None:
+                self.last_mem_peak = ctx.mem.peak_footprint()
+                self.last_spill_count = ctx.mem.spill_count
         self.vars["last_plan_from_binding"] = getattr(
             self, "_lpfb_next", 0)
         self._found_rows = chunk.num_rows  # FOUND_ROWS()
@@ -2886,7 +3021,10 @@ class Session:
         if stmt.kind == "PROCESSLIST":
             provider = getattr(self.storage, "processlist", None)
             if provider is not None:
-                rows = list(provider())
+                # the provider's rows carry (.., mem_max, spill_count)
+                # tails for information_schema.processlist; the SHOW
+                # surface keeps MySQL's classic eight columns
+                rows = [tuple(r[:8]) for r in provider()]
                 # MySQL: without the PROCESS privilege, only your own
                 # connections' rows are visible
                 if self.user is not None and not (
@@ -3047,10 +3185,12 @@ class Session:
             from .. import obs as _obs
             rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"],
                      e.get("plan_digest", ""),
-                     _obs.fmt_stages_ms(e.get("stages")))
+                     _obs.fmt_stages_ms(e.get("stages")),
+                     e.get("mem_max", 0), e.get("spill_count", 0))
                     for e in self.storage.obs.slow_queries()]
             return ResultSet(["Time", "DB", "Duration_ms", "Query",
-                              "Plan_digest", "Stages"], rows)
+                              "Plan_digest", "Stages", "Mem_max",
+                              "Spill_count"], rows)
         if stmt.kind == "METRICS":
             from .. import obs
             rows = []

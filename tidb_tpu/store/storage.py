@@ -205,6 +205,16 @@ class Storage:
             self.diag = self.diag_listener.service
         else:
             self.diag = DiagService(self)
+        # server-wide overload protection (util/governor.py): the global
+        # memory ledger + kill policy, and the execution admission gate.
+        # Both disabled by default (limit 0 / tokens 0) — the server
+        # entry point arms them from the [performance] config knobs.
+        # Metrics ride this server's registry, so governor kills and
+        # admission queue depth show up in /metrics, cluster_load and
+        # the metrics history without extra plumbing.
+        from ..util.governor import AdmissionGate, MemoryGovernor
+        self.governor = MemoryGovernor(self.obs.metrics)
+        self.admission = AdmissionGate(self.obs.metrics)
         # bounded time-series of counter/gauge samples feeding
         # information_schema.metrics_summary + /debug/metrics/history.
         # The background thread starts with the serving Server (embedded

@@ -50,7 +50,8 @@ class MemTracker:
     """
 
     __slots__ = ("label", "quota", "parent", "consumed", "peak",
-                 "action", "spill_count")
+                 "action", "spill_count", "governor", "_gov_next",
+                 "ledger", "ledger_peak")
 
     def __init__(self, label: str = "query", quota: int = 0,
                  parent: Optional["MemTracker"] = None,
@@ -62,17 +63,74 @@ class MemTracker:
         self.peak = 0
         self.action = action
         self.spill_count = 0
+        # server-wide ledger hook: the governor sets itself on the ROOT
+        # tracker at statement registration; consume()/account() then
+        # re-evaluate server memory pressure every GOV_POLL_BYTES of
+        # root growth (util/governor.py), so the kill policy runs
+        # exactly where memory is being acquired, with no background
+        # thread
+        self.governor = None
+        self._gov_next = 0
+        # materialization ledger (ROOT only): working-set estimates the
+        # operators admitted in memory (engine._overflow's fits-branch).
+        # Kept SEPARATE from `consumed` so the per-operator quota/spill
+        # decisions are untouched — this meter exists for the governor's
+        # heaviest-statement choice and the MEM_MAX forensics columns.
+        # ledger_peak is the COMBINED (consumed + ledger) high-water,
+        # maintained by both consume() and account(): mem_max must never
+        # report below the footprint the governor killed at.
+        self.ledger = 0
+        self.ledger_peak = 0
 
     def child(self, label: str) -> "MemTracker":
         return MemTracker(label, 0, self, self.action)
 
     def consume(self, n: int) -> None:
-        t: Optional[MemTracker] = self
-        while t is not None:
+        t: MemTracker = self
+        while True:
             t.consumed += n
             if t.consumed > t.peak:
                 t.peak = t.consumed
+            if t.parent is None:
+                break
             t = t.parent
+        combined = t.consumed + t.ledger
+        if combined > t.ledger_peak:
+            t.ledger_peak = combined
+        g = t.governor
+        if g is not None and combined >= t._gov_next:
+            from .governor import GOV_POLL_BYTES
+            t._gov_next = combined + GOV_POLL_BYTES
+            g.check()
+
+    def account(self, n: int) -> None:
+        """Record `n` bytes of in-memory materialization on the ROOT's
+        ledger (no quota effect — see the ledger comment above) and
+        poll the governor at the same cadence as consume()."""
+        root = self._root()
+        root.ledger += n
+        combined = root.consumed + root.ledger
+        if combined > root.ledger_peak:
+            root.ledger_peak = combined
+        g = root.governor
+        if g is not None and combined >= root._gov_next:
+            from .governor import GOV_POLL_BYTES
+            root._gov_next = combined + GOV_POLL_BYTES
+            g.check()
+
+    def footprint(self) -> int:
+        """Best live working-set estimate of this statement: tracked
+        transient consumption plus the materialization ledger (what the
+        governor ranks statements by)."""
+        root = self._root()
+        return max(root.consumed, 0) + max(root.ledger, 0)
+
+    def peak_footprint(self) -> int:
+        """High-water of the combined footprint — what mem_max columns
+        report, and by construction >= any footprint() the governor
+        ever ranked this statement at."""
+        root = self._root()
+        return max(root.peak, root.ledger_peak)
 
     def release(self, n: int) -> None:
         self.consume(-n)
