@@ -31,7 +31,13 @@ Configs (BASELINE.json configs[0..4] + the r04 join target):
                           BENCH_CB_ROWS (default 100M)
 
 Every timed query passes an exact digest check against a numpy oracle
-first. Environment knobs: BENCH_SF (10), BENCH_JOIN_SF (10),
+first. Each timed query's per-operator/per-stage attribution (the Top
+SQL plane's session-side read: stages_ms / operators_ms / operator
+transfer bytes) is logged as an `attribution <name>: {...}` line and
+stored under the flight result's "attribution" key, and every datagen/
+load phase emits a heartbeat (rows, rows/s, RSS) every 5s — so an OOM
+or timeout kill leaves a diagnosable trail. Environment knobs:
+BENCH_SF (10), BENCH_JOIN_SF (10),
 BENCH_SSB_SF (100), BENCH_CB_ROWS (1e8), BENCH_SF_BIG (100),
 BENCH_REPEAT (5), BENCH_CLIENTS (8), BENCH_PLATFORM,
 BENCH_FLIGHT_TIMEOUT (5400s), BENCH_RAM_FRACTION (0.75),
@@ -46,6 +52,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -84,6 +91,99 @@ def _rss_gb() -> float:
 
 def log(msg: str) -> None:
     print(f"# [rss={_rss_gb():.1f}G] {msg}", file=sys.stderr, flush=True)
+
+
+class _Heartbeat:
+    """Datagen/load heartbeat: a daemon thread logs progress (rows so
+    far, rows/s, process RSS) every few seconds, so the next SF100
+    OOM kill or timeout (BENCH_r04 rc=137 at gen, BENCH_r05 rc=124 at
+    504s/45.9G RSS) leaves a diagnosable trail in the board output
+    instead of a silent death. Flights bump `.rows` as they generate;
+    phases that cannot count rows still get elapsed + RSS."""
+
+    def __init__(self, label: str, interval_s: float = 5.0) -> None:
+        self.label = label
+        self.interval_s = interval_s
+        self.rows = 0
+        self.t0 = time.perf_counter()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="bench-heartbeat")
+
+    def _line(self, tag: str) -> None:
+        el = time.perf_counter() - self.t0
+        rate = self.rows / el if el > 0 else 0.0
+        log(f"heartbeat {self.label} {tag}: rows={self.rows} "
+            f"({rate / 1e6:.2f}M rows/s, {el:.0f}s elapsed)")
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._line("tick")
+
+    def __enter__(self) -> "_Heartbeat":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._line("done" if exc[0] is None else "ABORTED")
+
+
+def generate_lineitem_chunked(n: int, hb: _Heartbeat,
+                              chunk: int = 16_000_000):
+    """Chunked lineitem generation with heartbeat progress: bounded
+    transient RSS (full columns + ONE chunk of generator transients
+    instead of a whole-table generation pass) and `hb.rows` advances
+    per chunk so the heartbeat shows where an SF100 gen dies. Chunks
+    are seeded independently — self-consistent data; the oracles read
+    the same arrays."""
+    from tidb_tpu.bench.tpch import generate_lineitem_arrays
+
+    if n <= chunk:
+        out = generate_lineitem_arrays(n)
+        hb.rows = n
+        return out
+    first = generate_lineitem_arrays(chunk, seed=42)
+    out = {k: np.empty(n, dtype=v.dtype) for k, v in first.items()}
+    lo = 0
+    i = 0
+    while lo < n:
+        hi = min(lo + chunk, n)
+        part = first if lo == 0 else \
+            generate_lineitem_arrays(hi - lo, seed=42 + i)
+        for k in part:
+            out[k][lo:hi] = part[k]
+        part = None
+        if i == 0:
+            first = None
+        hb.rows = hi
+        lo = hi
+        i += 1
+    return out
+
+
+def _attribution(session) -> dict:
+    """The last timed run's per-stage/per-operator attribution (the
+    session-side read of the Top SQL plane) — persisted per query into
+    the flight result + board tail so BENCH_*.json explains where the
+    milliseconds went, not only how many there were."""
+    return {
+        "stages_ms": {k: round(v * 1e3, 3)
+                      for k, v in session.last_stages.items()},
+        "operators_ms": {k: round(v * 1e3, 3)
+                         for k, v in session.last_op_wall.items()},
+        "operator_stages_ms": {
+            op: {k: round(v * 1e3, 3) for k, v in d.items()}
+            for op, d in session.last_op_stages.items()},
+        "operator_bytes": dict(session.last_op_bytes),
+    }
+
+
+def note_attribution(res: dict, name: str, session) -> None:
+    att = _attribution(session)
+    res.setdefault("attribution", {})[name] = att
+    log(f"attribution {name}: " + json.dumps(att, sort_keys=True))
 
 
 # ---------------------------------------------------------------------------
@@ -398,12 +498,7 @@ def _hbm_line(name: str, p50: float, n: int, col_bytes: float) -> str:
 # ---------------------------------------------------------------------------
 
 def flight_tpch(res: dict, big: bool) -> None:
-    from tidb_tpu.bench.tpch import (
-        TPCH_Q1,
-        TPCH_Q6,
-        generate_lineitem_arrays,
-        load_lineitem,
-    )
+    from tidb_tpu.bench.tpch import TPCH_Q1, TPCH_Q6, load_lineitem
     from tidb_tpu.session import Session
 
     _session_env()
@@ -420,12 +515,15 @@ def flight_tpch(res: dict, big: bool) -> None:
     log(f"tpch {sf_label}: generating {n} rows "
         f"(MemAvailable={_meminfo_gb('MemAvailable'):.0f}GB)")
     t0 = time.perf_counter()
-    arrays = generate_lineitem_arrays(n)
+    with _Heartbeat(f"tpch-{sf_label}-gen") as hb:
+        arrays = generate_lineitem_chunked(n, hb)
     gen_s = time.perf_counter() - t0
     log(f"tpch {sf_label}: gen={gen_s:.0f}s; loading")
     session = Session()
     t0 = time.perf_counter()
-    load_lineitem(session, n, arrays=arrays)
+    with _Heartbeat(f"tpch-{sf_label}-load") as hb:
+        hb.rows = n
+        load_lineitem(session, n, arrays=arrays)
     log(f"tpch {sf_label}: gen={gen_s:.0f}s "
         f"load={time.perf_counter() - t0:.0f}s ({n} rows)")
     if not big:
@@ -437,7 +535,9 @@ def flight_tpch(res: dict, big: bool) -> None:
     check_q1(session.query(TPCH_Q1), arrays)
     log("digests OK; timing")
     q6_ts = times(lambda: session.query(TPCH_Q6), repeat)
+    note_attribution(res, f"q6_{sf_label}", session)
     q1_ts = times(lambda: session.query(TPCH_Q1), repeat)
+    note_attribution(res, f"q1_{sf_label}", session)
     l6, q6_rps = report(f"q6_{sf_label}", q6_ts, n)
     l1, q1_rps = report(f"q1_{sf_label}", q1_ts, n)
     lines += [l6, l1]
@@ -506,10 +606,12 @@ def flight_joins(res: dict) -> None:
     join_sf = float(os.environ.get("BENCH_JOIN_SF", 10))
     repeat = int(os.environ.get("BENCH_REPEAT", 5))
     t0 = time.perf_counter()
-    jdata = generate_tpch(join_sf, 11)
-    js = Session()
-    for t in jdata:
-        load_table(js, t, jdata[t])
+    with _Heartbeat(f"tpch-join-sf{join_sf:g}-gen+load") as hb:
+        jdata = generate_tpch(join_sf, 11)
+        hb.rows = len(jdata["lineitem"]["l_orderkey"])
+        js = Session()
+        for t in jdata:
+            load_table(js, t, jdata[t])
     jrows = len(jdata["lineitem"]["l_orderkey"])
     log(f"tpch join corpus sf{join_sf:g}: gen+load="
         f"{time.perf_counter() - t0:.0f}s ({jrows} lineitem rows)")
@@ -526,7 +628,9 @@ def flight_joins(res: dict) -> None:
     assert got5 == want5, f"q5 digest: {got5} vs {want5}"
     log("join digests OK; timing q3/q5")
     q3_ts = times(lambda: js.query(TPCH_QUERIES["q3"]), repeat)
+    note_attribution(res, f"q3_sf{join_sf:g}", js)
     q5_ts = times(lambda: js.query(TPCH_QUERIES["q5"]), repeat)
+    note_attribution(res, f"q5_sf{join_sf:g}", js)
     l3, q3_rps = report(f"q3_sf{join_sf:g}", q3_ts, jrows)
     l5, q5_rps = report(f"q5_sf{join_sf:g}", q5_ts, jrows)
     lines += [l3, l5]
@@ -548,15 +652,19 @@ def flight_ssb(res: dict) -> None:
     n = _scale_to_ram(int(ssb.ROWS_PER_SF * ssb_sf), 155.0, "ssb", lines)
     sf = n / ssb.ROWS_PER_SF
     t0 = time.perf_counter()
-    lo = ssb.generate_lineorder(sf)
-    ss = Session()
-    nrows_ssb = ssb.load_ssb(ss, sf, lineorder=lo)
+    with _Heartbeat(f"ssb-sf{sf:g}-gen+load") as hb:
+        lo = ssb.generate_lineorder(sf)
+        hb.rows = len(lo["lo_orderdate"]) if "lo_orderdate" in lo else 0
+        ss = Session()
+        nrows_ssb = ssb.load_ssb(ss, sf, lineorder=lo)
+        hb.rows = nrows_ssb
     log(f"ssb sf{sf:g}: gen+load={time.perf_counter() - t0:.0f}s "
         f"({nrows_ssb} lineorder rows)")
     for q in ("q1.1", "q1.2", "q1.3"):
         got = ss.query(ssb.SSB_QUERIES[q])[0][0]
         assert got is not None and int(got) == ssb.q1_oracle(lo, q), q
         ts = times(lambda sql=ssb.SSB_QUERIES[q]: ss.query(sql), repeat)
+        note_attribution(res, f"ssb_{q}_sf{sf:g}", ss)
         line, rps = report(f"ssb_{q}_sf{sf:g}", ts, nrows_ssb)
         lines.append(line)
         res["values"][f"ssb_{q}"] = rps
@@ -572,9 +680,11 @@ def flight_cb(res: dict) -> None:
     repeat = int(os.environ.get("BENCH_REPEAT", 5))
     cb_rows = _scale_to_ram(cb_rows, 110.0, "clickbench", lines)
     t0 = time.perf_counter()
-    hits = cbench.generate_hits(cb_rows)
-    cs = Session()
-    cbench.load_hits(cs, cb_rows, hits=hits)
+    with _Heartbeat("clickbench-gen+load") as hb:
+        hits = cbench.generate_hits(cb_rows)
+        hb.rows = cb_rows
+        cs = Session()
+        cbench.load_hits(cs, cb_rows, hits=hits)
     log(f"clickbench hits_{cb_rows // 1_000_000}m: gen+load="
         f"{time.perf_counter() - t0:.0f}s")
     for q, sql in cbench.CB_QUERIES.items():
@@ -588,6 +698,7 @@ def flight_cb(res: dict) -> None:
             ok = [(int(a), int(b)) for a, b in got] == want
         assert ok, f"{q} digest"
         ts = times(lambda s2=sql: cs.query(s2), repeat)
+        note_attribution(res, q, cs)
         line, rps = report(q, ts, cb_rows)
         lines.append(line)
         res["values"][q] = rps

@@ -177,6 +177,33 @@ def test_registry_function(session, sql, want):
         assert str(got) == want, f"{sql}: got {got!r}, want {want!r}"
 
 
+def test_from_unixtime_session_time_zone(session):
+    """FROM_UNIXTIME formats in the session @@time_zone like MySQL
+    (the round-5 ADVICE finding): offset zones shift arithmetically,
+    named zones resolve via zoneinfo, SYSTEM behaves as the server
+    zone (UTC here), and the setting is session-scoped."""
+    s = session
+    try:
+        s.execute("set time_zone = '+05:30'")
+        assert s.query("select from_unixtime(0)")[0][0] == \
+            "1970-01-01 05:30:00"
+        s.execute("set time_zone = '-03:00'")
+        assert s.query(
+            "select from_unixtime(86400, '%Y-%m-%d %H:%i:%s')")[0][0] == \
+            "1970-01-01 21:00:00"
+        s.execute("set time_zone = 'UTC'")
+        assert s.query("select from_unixtime(86400)")[0][0] == \
+            "1970-01-02 00:00:00"
+        # the %c/%e/%k direct-format codes honor the zone too
+        s.execute("set time_zone = '+01:00'")
+        assert s.query(
+            "select from_unixtime(0, '%c/%e %k:%i')")[0][0] == "1/1 1:00"
+    finally:
+        s.execute("set time_zone = 'SYSTEM'")
+    assert s.query("select from_unixtime(0)")[0][0] == \
+        "1970-01-01 00:00:00"
+
+
 def test_float_functions(session):
     q = session.query(
         "select sin(0), round(degrees(pi()), 0), round(atan2(1, 1), 4), "

@@ -103,6 +103,32 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # statement working-set peak + spills (reference: slow_query's
         # Mem_max / Disk_max columns)
         ("mem_max", _bigint()), ("spill_count", _bigint()),
+        # per-operator exclusive wall split ('join:42ms scan:7ms ...')
+        # — which operator of this digest spent the time
+        ("operators", _vc(256)),
+    ],
+    # continuous per-digest resource attribution (reference: TiDB's
+    # Top SQL / util/topsql): one '(stmt)' summary row per (window,
+    # digest) plus one row per plan operator with its exclusive wall
+    # time, stage split, and host->device transfer bytes. Fed on every
+    # statement completion while performance.topsql-enabled is on.
+    "tidb_top_sql": [
+        ("window_start", _vc(20)), ("digest", _vc(32)),
+        ("digest_text", _vc(512)), ("operator", _vc(64)),
+        ("exec_count", _bigint()),
+        ("sum_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("op_time_ms", FieldType(TypeKind.DOUBLE)),
+        ("op_transfer_bytes", _bigint()), ("stages", _vc(256)),
+        ("sum_rows", _bigint()), ("admission_sheds", _bigint()),
+        ("governor_kills", _bigint()),
+    ],
+    # structured server event ring: governor kills, admission sheds,
+    # breaker trips, elections/promotions, checkpoint/fsync stalls —
+    # with conn/digest attribution where the producer has it
+    "tidb_events": [
+        ("id", _bigint()), ("ts", _vc(20)), ("kind", _vc(32)),
+        ("severity", _vc(8)), ("conn_id", _bigint()),
+        ("digest", _vc(32)), ("detail", _vc(512)),
     ],
     # per-statement sampling-profiler frames of THIS session's
     # @@profiling ring (reference: INFORMATION_SCHEMA.PROFILING fed by
@@ -145,7 +171,20 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("query_time_ms", FieldType(TypeKind.DOUBLE)),
         ("query", _vc(4096)), ("plan_digest", _vc(32)),
         ("stages", _vc(256)), ("mem_max", _bigint()),
-        ("spill_count", _bigint()), ("error", _vc(256)),
+        ("spill_count", _bigint()), ("operators", _vc(256)),
+        ("error", _vc(256)),
+    ],
+    # cluster-wide Top SQL: every member's attribution windows under
+    # one roof, degrading per-peer like the other cluster_* tables
+    "cluster_top_sql": [
+        ("instance", _vc()), ("window_start", _vc(20)),
+        ("digest", _vc(32)), ("digest_text", _vc(512)),
+        ("operator", _vc(64)), ("exec_count", _bigint()),
+        ("sum_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("op_time_ms", FieldType(TypeKind.DOUBLE)),
+        ("op_transfer_bytes", _bigint()), ("stages", _vc(256)),
+        ("sum_rows", _bigint()), ("admission_sheds", _bigint()),
+        ("governor_kills", _bigint()), ("error", _vc(256)),
     ],
     "cluster_statements_summary": [
         ("instance", _vc()), ("digest", _vc(32)), ("schema_name", _vc()),
@@ -392,6 +431,11 @@ def _rows_for(storage, catalog: Catalog, tname: str,
         # same row shape as cluster_slow_query minus (instance, error):
         # the diag service is the one producer of it
         rows = storage.diag.diag_slow_query()["rows"]
+    elif tname == "tidb_top_sql":
+        # same producer as the cluster fan-out (minus instance/error)
+        rows = storage.diag.diag_top_sql()["rows"]
+    elif tname == "tidb_events":
+        rows = storage.diag.diag_events()["rows"]
     elif tname == "metrics_summary":
         hist = getattr(storage, "metrics_history", None)
         if hist is not None:
@@ -403,7 +447,7 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                              st["max"], st["last"]])
     elif tname in ("cluster_info", "cluster_processlist",
                    "cluster_slow_query", "cluster_statements_summary",
-                   "cluster_load"):
+                   "cluster_load", "cluster_top_sql"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)

@@ -65,6 +65,17 @@ class PerformanceConfig:
     # /debug/metrics/history): sampling cadence and retained points
     metrics_history_interval: int = 15    # seconds between samples
     metrics_history_cap: int = 240        # retained samples (ring size)
+    # Top SQL: continuous per-digest/per-operator resource attribution
+    # (information_schema.tidb_top_sql, cluster_top_sql, /debug/topsql).
+    # Disabled by default — off it costs ZERO work on the statement
+    # path; enabled it aggregates into a ring of time buckets, each a
+    # digest map capped at topsql-digest-cap with an "(other)" overflow
+    topsql_enabled: bool = False
+    topsql_window_seconds: int = 60       # one attribution bucket's span
+    topsql_digest_cap: int = 50           # digests kept per bucket
+    # structured server event ring (information_schema.tidb_events +
+    # /debug/events): retained events
+    events_history_cap: int = 512
 
 
 @dataclass
@@ -245,6 +256,12 @@ class Config:
             raise ConfigError("metrics-history-interval must be >= 1")
         if self.performance.metrics_history_cap < 1:
             raise ConfigError("metrics-history-cap must be >= 1")
+        if self.performance.topsql_window_seconds < 1:
+            raise ConfigError("topsql-window-seconds must be >= 1")
+        if self.performance.topsql_digest_cap < 1:
+            raise ConfigError("topsql-digest-cap must be >= 1")
+        if self.performance.events_history_cap < 1:
+            raise ConfigError("events-history-cap must be >= 1")
         t = self.transport
         if t.listen and t.remote:
             raise ConfigError(
@@ -290,6 +307,11 @@ class Config:
         "performance.governor_cooldown_ms",
         "performance.token_limit",
         "performance.admission_timeout_ms",
+        # the attribution plane toggles live: turning Top SQL on to
+        # chase a production regression must not need a restart
+        "performance.topsql_enabled",
+        "performance.topsql_window_seconds",
+        "performance.topsql_digest_cap",
         "plan_cache.enabled",
     })
 
@@ -356,6 +378,19 @@ class Config:
                                    cooldown_ms=p.governor_cooldown_ms)
         storage.admission.configure(tokens=p.token_limit,
                                     timeout_ms=p.admission_timeout_ms)
+
+    def seed_observability(self, storage) -> None:
+        """Arm the attribution/event plane from the [performance] knobs
+        (startup and SIGHUP hot reload both call this)."""
+        p = self.performance
+        storage.obs.topsql.configure(
+            enabled=p.topsql_enabled,
+            window_s=p.topsql_window_seconds,
+            digest_cap=p.topsql_digest_cap)
+        storage.obs.events.configure(cap=p.events_history_cap)
+        storage.metrics_history.configure(
+            interval_s=p.metrics_history_interval,
+            cap=p.metrics_history_cap)
 
     # ---- sysvar seeding ------------------------------------------------
     def seed_sysvars(self, storage) -> None:
@@ -528,6 +563,21 @@ trace-span-cap = 4096          # TRACE drops spans past this cap
 metrics-history-interval = 15  # seconds between metrics-history samples
 metrics-history-cap = 240      # samples retained (feeds metrics_summary
                                # and /debug/metrics/history)
+# Top SQL — continuous per-digest + per-operator resource attribution
+# (information_schema.tidb_top_sql / cluster_top_sql, /debug/topsql,
+# top-by-device-time in /status). Off by default: disabled it costs
+# zero work and zero allocations on the statement path. Enabled, every
+# completed statement feeds a ring of topsql-window-seconds buckets;
+# each bucket keeps topsql-digest-cap digests and folds the rest into
+# an "(other)" overflow entry. Hot-reloadable via SIGHUP.
+topsql-enabled = false
+topsql-window-seconds = 60
+topsql-digest-cap = 50
+# Structured server event ring (information_schema.tidb_events,
+# /debug/events): governor kills, admission sheds, rpc breaker trips,
+# elections/promotions, checkpoint/fsync stalls, with conn/digest
+# attribution. events-history-cap bounds the ring.
+events-history-cap = 512
 
 [plan-cache]
 enabled = true

@@ -115,8 +115,11 @@ def _obj_nbytes(o) -> int:
 def _note_transfer(*arrays) -> None:
     """Host->device staging accounting on the dispatch hot path (one
     attribute read per array; the gauge feeds cluster_load and the
-    MetricsHistory ring)."""
-    obs.DEVICE_TRANSFER_BYTES.inc(_obj_nbytes(arrays))
+    MetricsHistory ring). Bytes also attribute to the active plan
+    operator on the statement's recorder (Top SQL / slow log)."""
+    n = _obj_nbytes(arrays)
+    obs.DEVICE_TRANSFER_BYTES.inc(n)
+    obs.note_op_bytes(n)
 
 
 def _device_telemetry_probe() -> None:

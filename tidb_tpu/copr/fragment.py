@@ -78,7 +78,11 @@ def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
             "device-oom" if "RESOURCE_EXHAUSTED" in str(e) else "compile")
         obs.COPR_REQUESTS.inc(engine="host-fragment")
         obs.FRAG_FALLBACKS.inc(reason=reason)
-        r = _host_fragment(frag, snaps)
+        # the host interpreter's time is join work (the probe/gather/
+        # agg loop) — attribute it so the fallback path stays visible
+        # in the per-operator plane, not buried under "fragment"
+        with obs.operator("join"):
+            r = _host_fragment(frag, snaps)
         r.engine = f"host(fragment:{reason})"
         return r
 
@@ -235,7 +239,11 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     # ---- staging ----
     from .. import obs
     builds = []
-    with obs.stage("staging", span_name="copr.staging"):
+    # build-side staging (dimension columns + perm tables) is join
+    # work: the operator frame routes its stage time + transfer bytes
+    # to "join" in the per-operator attribution plane
+    with obs.operator("join"), \
+            obs.stage("staging", span_name="copr.staging"):
         for ji, j in enumerate(frag.joins):
             t = frag.tables[j.build]
             snap = snaps[t.table.id]
@@ -321,6 +329,18 @@ def _perm_array(cop, snap, key_off: int, lo: int, span: int,
     return dev
 
 
+def _mode_op(frag, mode: str) -> str:
+    """The fused kernel's operator label for the attribution plane:
+    one device program covers the whole tree, so the label names the
+    fused composition (the tree's dominant consumers) — a join+agg
+    kernel's milliseconds must not masquerade as plain scan time."""
+    if mode == "hc":
+        return "join+topn" if frag.joins else "topn"
+    if mode == "agg":
+        return "join+agg" if frag.joins else "agg"
+    return "join"
+
+
 def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
                     mode=None):
     probe = frag.tables[0]
@@ -339,18 +359,23 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         return _run_frag_tiled(cop, frag, snaps, prepared, spans, builds,
                                mode)
     from .. import obs
-    with obs.stage("staging", span_name="copr.staging"):
+    # probe-side staging is scan work; aligned build staging is join
+    # work — separate operator frames keep the attribution honest
+    with obs.operator("scan"), \
+            obs.stage("staging", span_name="copr.staging"):
         pcols, pvis, phost, phost_mask = cop._stage_inputs(
             _facade_dag(probe), psnap, overlay=overlay)
-        # single-device epoch batches swap the in-kernel perm gathers
-        # for epoch-cached ALIGNED build columns (see _stage_aligned):
-        # the first query against an epoch pays the gathers once; every
-        # later fragment query over the same epochs is pure elementwise
-        # + MXU work
-        kern_builds = builds
-        if builds and not overlay and \
-                getattr(cop, "frag_axis", None) is None and \
-                prepared.get("__part_join__") is None:
+    # single-device epoch batches swap the in-kernel perm gathers
+    # for epoch-cached ALIGNED build columns (see _stage_aligned):
+    # the first query against an epoch pays the gathers once; every
+    # later fragment query over the same epochs is pure elementwise
+    # + MXU work
+    kern_builds = builds
+    if builds and not overlay and \
+            getattr(cop, "frag_axis", None) is None and \
+            prepared.get("__part_join__") is None:
+        with obs.operator("join"), \
+                obs.stage("staging", span_name="copr.staging"):
             kern_builds = _stage_aligned(cop, frag, snaps, prepared,
                                          spans, builds, pcols)
 
@@ -368,11 +393,12 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     kern = cop._kernel(key, lambda: cop._frag_jit(
         _build_frag_kernel(frag, prepared, spans, mode, raw=True, cop=cop),
         mode, prepared))
-    with obs.stage("kernel", span_name="device.dispatch"):
-        dev = kern(pcols, pvis, kern_builds) if aux is None \
-            else kern(pcols, pvis, kern_builds, aux)
-    with obs.stage("device_get", span_name="device.fetch"):
-        out = jax.device_get(dev)
+    with obs.operator(_mode_op(frag, mode)):
+        with obs.stage("kernel", span_name="device.dispatch"):
+            dev = kern(pcols, pvis, kern_builds) if aux is None \
+                else kern(pcols, pvis, kern_builds, aux)
+        with obs.stage("device_get", span_name="device.fetch"):
+            out = jax.device_get(dev)
 
     if mode == "hc":
         # candidate blocks = exchange partitions (1 on a single device)
@@ -401,15 +427,18 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
     from .. import obs
     probe = frag.tables[0]
     psnap = snaps[probe.table.id]
-    with obs.stage("staging", span_name="copr.staging"):
+    with obs.operator("scan"), \
+            obs.stage("staging", span_name="copr.staging"):
         tiles = cop._stage_tiles(_facade_dag(probe), psnap)
     bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
     kern = None
     devs = []
+    kop = _mode_op(frag, mode)
     for ti, (cols, vis, cnt) in enumerate(tiles):
         kb = builds
         if builds:
-            with obs.stage("staging", span_name="copr.staging"):
+            with obs.operator("join"), \
+                    obs.stage("staging", span_name="copr.staging"):
                 kb = _stage_aligned(cop, frag, snaps, prepared, spans,
                                     builds, cols, tag=("tile", ti))
         if kern is None:
@@ -423,9 +452,11 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
                                    cop=cop), mode, prepared))
         from ..util import interrupt
         interrupt.check()
-        with obs.stage("kernel", span_name="device.dispatch"):
+        with obs.operator(kop), \
+                obs.stage("kernel", span_name="device.dispatch"):
             devs.append(kern(cols, vis, kb))
-    with obs.stage("device_get", span_name="device.fetch"):
+    with obs.operator(kop), \
+            obs.stage("device_get", span_name="device.fetch"):
         outs = jax.device_get(devs)
 
     if mode == "agg":

@@ -915,6 +915,52 @@ def _j_overlaps(a, b):
 # misc compat (reference: builtin_miscellaneous.go, builtin_info.go)
 # ---------------------------------------------------------------------------
 
+# ---- session time zone routing ---------------------------------------------
+# The session installs @@time_zone here for the statement's duration
+# (thread-local, like obs' stage recorder) so time-zone-sensitive
+# builtins — FROM_UNIXTIME — format in the session zone like MySQL
+# instead of hardcoded UTC (the round-5 ADVICE finding).
+
+import threading as _threading
+
+_tz_tls = _threading.local()
+
+
+def install_session_time_zone(tz):
+    """Install the session @@time_zone for this thread; returns the
+    previous value so callers can restore it."""
+    prev = getattr(_tz_tls, "tz", None)
+    _tz_tls.tz = tz
+    return prev
+
+
+def session_time_zone() -> str:
+    return str(getattr(_tz_tls, "tz", None) or "SYSTEM")
+
+
+def _session_struct_time(ts: float):
+    """struct_time of a unix timestamp in the session time zone.
+    SYSTEM behaves as UTC (the server's @@system_time_zone); '+HH:MM'
+    offsets apply arithmetically; named zones resolve via zoneinfo and
+    fall back to UTC when unknown (MySQL would have rejected the SET)."""
+    name = session_time_zone()
+    if name in ("SYSTEM", "UTC", "+00:00", "+0:00"):
+        return _time.gmtime(ts)
+    if name and name[0] in "+-":
+        try:
+            hh, mm = name[1:].split(":")
+            off = int(hh) * 3600 + int(mm) * 60
+        except ValueError:
+            return _time.gmtime(ts)
+        return _time.gmtime(ts + (-off if name[0] == "-" else off))
+    try:
+        from datetime import datetime
+        from zoneinfo import ZoneInfo
+        return datetime.fromtimestamp(ts, ZoneInfo(name)).timetuple()
+    except Exception:  # noqa: BLE001 - unknown zone: UTC fallback
+        return _time.gmtime(ts)
+
+
 _FU_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "d": "%d",
            "H": "%H", "i": "%M", "s": "%S",
            "S": "%S", "p": "%p", "W": "%A", "a": "%a", "b": "%b",
@@ -931,7 +977,7 @@ _FU_DIRECT = {"c": lambda t: str(t.tm_mon),   # month, no leading zero
 def _from_unixtime(ts, fmt=None):
     if float(ts) < 0:
         return None
-    t = _time.gmtime(float(ts))
+    t = _session_struct_time(float(ts))
     if fmt is None:
         return _time.strftime("%Y-%m-%d %H:%M:%S", t)
     out = []

@@ -135,24 +135,66 @@ def _subst_subq(e: PlanExpr, ctx: ExecContext) -> PlanExpr:
     return e
 
 
+# plan-node class -> the operator label the resource-attribution plane
+# aggregates under (obs.StageRecorder op_wall / TopSQL / slow log);
+# PhysTableRead refines by its pushed-down DAG tail, PhysFragmentRead's
+# internals open their own finer-grained frames (copr/fragment.py)
+_OP_LABELS = {
+    "PhysFragmentRead": "fragment",
+    "PhysPointGet": "point_get",
+    "PhysIndexMerge": "index_merge",
+    "PhysSelection": "filter",
+    "PhysProjection": "project",
+    "PhysHashAgg": "agg",
+    "PhysSort": "sort",
+    "PhysLimit": "limit",
+    "PhysHashJoin": "join",
+    "PhysMergeJoin": "join",
+    "PhysIndexJoin": "join",
+    "PhysUnion": "union",
+    "PhysWindow": "window",
+}
+
+
+def _op_label(plan: PhysicalPlan) -> str:
+    if isinstance(plan, PhysTableRead):
+        dag = plan.dag
+        if dag.agg is not None:
+            return "scan+agg"
+        if dag.topn is not None:
+            return "scan+topn"
+        return "scan"
+    return _OP_LABELS.get(type(plan).__name__, "other")
+
+
 def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
+    from .. import obs
+
+    # always-on per-operator attribution: when a statement recorder is
+    # installed (every session statement), each node runs under an
+    # operator frame recording its EXCLUSIVE wall time + tagging the
+    # dispatch stages/transfer bytes opened inside — the continuous
+    # feed for Top SQL and the slow log's operator column. Cost is two
+    # perf_counter reads and a dict update per plan node.
+    rec = obs.active_stage_recorder()
     if ctx.stats is not None:
         import time as _time
-
-        from .. import obs
 
         # attribute dispatch-stage time (staging/compile/transfer/
         # kernel/device_get/host_fallback) to this node, INCLUSIVE of
         # children — same convention as the node wall time
-        rec = obs.active_stage_recorder()
         before = rec.snapshot() if rec is not None else None
         t0 = _time.perf_counter()
         engine_tag = [None]
-        chunk = _run_node(plan, ctx, engine_tag)
+        with obs.operator(_op_label(plan)):
+            chunk = _run_node(plan, ctx, engine_tag)
         stages = rec.delta_since(before) if rec is not None else None
         ctx.stats.record(plan, _time.perf_counter() - t0, chunk.num_rows,
                          engine_tag[0], stages=stages)
         return chunk
+    if rec is not None:
+        with obs.operator(_op_label(plan)):
+            return _run_node(plan, ctx, None)
     return _run_node(plan, ctx, None)
 
 

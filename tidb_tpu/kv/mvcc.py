@@ -69,7 +69,12 @@ class SyncPolicy:
     """
 
     __slots__ = ("policy", "interval_ms", "_fsync", "_lock", "_last",
-                 "_dirty", "_timer", "_closed")
+                 "_dirty", "_timer", "_closed", "on_stall", "stall_ms")
+
+    # an fsync slower than this reports a stall (a healthy fsync is
+    # single-digit ms; ~17ms is this box's measured commit fsync — the
+    # threshold flags the pathological tail, not the normal case)
+    STALL_MS_DEFAULT = 100.0
 
     def __init__(self, policy: str, interval_ms: int, fsync) -> None:
         self.policy = policy
@@ -80,6 +85,11 @@ class SyncPolicy:
         self._dirty = False
         self._timer = None
         self._closed = False
+        # stall reporting hook (seconds -> None), wired by the Storage
+        # to its event ring; exceptions are swallowed — telemetry must
+        # never fail a commit whose fsync succeeded
+        self.on_stall = None
+        self.stall_ms = self.STALL_MS_DEFAULT
 
     def mark_dirty(self) -> None:
         self._dirty = True
@@ -125,7 +135,14 @@ class SyncPolicy:
     def flush(self) -> None:
         """Unconditional sync-now (checkpoint/close path too)."""
         import time as _time
+        t0 = _time.perf_counter()
         self._fsync()
+        dt = _time.perf_counter() - t0
+        if self.on_stall is not None and dt * 1e3 >= self.stall_ms:
+            try:
+                self.on_stall(dt)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         with self._lock:
             self._dirty = False
             self._last = _time.monotonic()

@@ -327,6 +327,280 @@ class StatementsSummary:
             self._entries.clear()
 
 
+# ---- Top SQL: continuous per-digest resource attribution --------------------
+
+class TopSQL:
+    """Windowed per-digest resource attribution (reference: TiDB's Top
+    SQL — util/topsql collecting per-statement CPU/exec metrics into
+    time buckets keyed by SQL digest, resource attribution that runs in
+    PRODUCTION, not only under EXPLAIN ANALYZE).
+
+    Shape: a ring of `n_windows` time buckets, each holding a digest ->
+    entry map capped at `digest_cap`; statements past the cap fold into
+    one "(other)" overflow entry so a digest storm cannot grow the map.
+    Every completed statement feeds one record() with its wall time,
+    per-stage dispatch seconds (PR 2's StageRecorder), per-operator
+    wall/stage/transfer attribution, rows, and admission/governor
+    outcomes.
+
+    Disabled (the default) it is ZERO allocation on the statement path:
+    record() returns before touching the lock or building anything, and
+    the session call site checks `enabled` before assembling arguments.
+    Thread-safe: one lock guards the ring; entries are plain dicts
+    mutated under it."""
+
+    DEFAULT_WINDOW_S = 60
+    DEFAULT_WINDOWS = 6
+    DEFAULT_DIGEST_CAP = 50
+    OTHER = "(other)"
+    STMT = "(stmt)"
+    SESSION_OP = "(session)"
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 n_windows: int = DEFAULT_WINDOWS,
+                 digest_cap: int = DEFAULT_DIGEST_CAP,
+                 enabled: bool = False) -> None:
+        self.enabled = bool(enabled)
+        self.window_s = max(float(window_s), 1.0)
+        self.digest_cap = max(int(digest_cap), 1)
+        self._lock = threading.Lock()
+        self._buckets: deque = deque(maxlen=max(int(n_windows), 1))
+
+    def configure(self, enabled: Optional[bool] = None,
+                  window_s: Optional[float] = None,
+                  digest_cap: Optional[int] = None,
+                  n_windows: Optional[int] = None) -> None:
+        """Apply the performance.topsql-* config knobs (safe while
+        running; a shrunk ring drops the oldest windows)."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if window_s is not None:
+            self.window_s = max(float(window_s), 1.0)
+        if digest_cap is not None:
+            self.digest_cap = max(int(digest_cap), 1)
+        if n_windows is not None:
+            with self._lock:
+                self._buckets = deque(self._buckets,
+                                      maxlen=max(int(n_windows), 1))
+
+    def _bucket_locked(self, now: float) -> dict:
+        win = int(now - (now % self.window_s))
+        for b in reversed(self._buckets):
+            if b["start"] == win:
+                return b
+        last = self._buckets[-1] if self._buckets else None
+        if last is not None and win < last["start"]:
+            # clock went backwards past the ring: charge the newest
+            # window rather than resurrecting evicted history
+            return last
+        b = {"start": win, "digests": {}, "other": None}
+        self._buckets.append(b)
+        return b
+
+    @staticmethod
+    def _new_entry(digest: str, digest_text: str, db: str) -> dict:
+        return {"digest": digest, "digest_text": digest_text,
+                "schema_name": db, "exec_count": 0, "errors": 0,
+                "sum_wall_s": 0.0, "max_wall_s": 0.0, "sum_rows": 0,
+                "sheds": 0, "kills": 0,
+                "stages": {}, "op_wall": {}, "op_stages": {},
+                "op_bytes": {}}
+
+    def record(self, digest: str, digest_text: str, db: str,
+               wall_s: float, stages: Optional[dict] = None,
+               op_wall: Optional[dict] = None,
+               op_stages: Optional[dict] = None,
+               op_bytes: Optional[dict] = None,
+               rows: int = 0, failed: bool = False, shed: bool = False,
+               killed: bool = False,
+               now: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        ts = time.time() if now is None else float(now)
+        with self._lock:
+            b = self._bucket_locked(ts)
+            ent = b["digests"].get(digest)
+            if ent is None:
+                if len(b["digests"]) < self.digest_cap:
+                    ent = b["digests"][digest] = self._new_entry(
+                        digest, digest_text, db)
+                else:
+                    # overflow: fold into the bucket's "(other)" entry
+                    if b["other"] is None:
+                        b["other"] = self._new_entry(
+                            self.OTHER, self.OTHER, "")
+                    ent = b["other"]
+            ent["exec_count"] += 1
+            ent["errors"] += 1 if failed else 0
+            ent["sheds"] += 1 if shed else 0
+            ent["kills"] += 1 if killed else 0
+            ent["sum_wall_s"] += wall_s
+            ent["max_wall_s"] = max(ent["max_wall_s"], wall_s)
+            ent["sum_rows"] += int(rows)
+            if stages:
+                st = ent["stages"]
+                for k, v in stages.items():
+                    st[k] = st.get(k, 0.0) + v
+            if op_wall:
+                ow = ent["op_wall"]
+                for k, v in op_wall.items():
+                    ow[k] = ow.get(k, 0.0) + v
+            if op_stages:
+                target = ent["op_stages"]
+                for op, d in op_stages.items():
+                    td = target.setdefault(op, {})
+                    for k, v in d.items():
+                        td[k] = td.get(k, 0.0) + v
+            if op_bytes:
+                ob = ent["op_bytes"]
+                for k, v in op_bytes.items():
+                    ob[k] = ob.get(k, 0) + int(v)
+
+    def snapshot(self) -> list[dict]:
+        """Deep-copied buckets, oldest first."""
+        import copy
+        with self._lock:
+            return [copy.deepcopy(b) for b in self._buckets]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+
+    @staticmethod
+    def attributed_seconds(ent: dict) -> float:
+        """Statement seconds attributed to SOMETHING named: exclusive
+        per-operator wall plus the dispatch stages recorded outside any
+        operator frame (plan_build et al under '(session)'). Operator
+        wall and op-stage splits overlap by construction (the stages
+        are the split OF the operator wall), so only the session-scoped
+        stages add."""
+        return sum(ent["op_wall"].values()) + sum(
+            ent["op_stages"].get(TopSQL.SESSION_OP, {}).values())
+
+    def table_rows(self) -> list[list]:
+        """information_schema.tidb_top_sql rows: newest window first,
+        digests by total wall desc; per digest one '(stmt)' summary row
+        then one row per operator (heaviest first)."""
+        rows: list[list] = []
+        for b in reversed(self.snapshot()):
+            win = time.strftime("%Y-%m-%d %H:%M:%S",
+                                time.localtime(b["start"]))
+            ents = sorted(b["digests"].values(),
+                          key=lambda e: -e["sum_wall_s"])
+            if b["other"] is not None:
+                ents.append(b["other"])
+            for e in ents:
+                attributed = self.attributed_seconds(e)
+                rows.append([
+                    win, e["digest"], e["digest_text"], self.STMT,
+                    e["exec_count"], round(e["sum_wall_s"] * 1e3, 3),
+                    round(attributed * 1e3, 3),
+                    sum(e["op_bytes"].values()),
+                    fmt_stages(e["stages"])[:256], e["sum_rows"],
+                    e["sheds"], e["kills"]])
+                ops = dict(e["op_wall"])
+                sess = e["op_stages"].get(self.SESSION_OP)
+                if sess:
+                    ops[self.SESSION_OP] = sum(sess.values())
+                for op in sorted(ops, key=lambda o: -ops[o]):
+                    rows.append([
+                        win, e["digest"], e["digest_text"], op,
+                        e["exec_count"], round(e["sum_wall_s"] * 1e3, 3),
+                        round(ops[op] * 1e3, 3),
+                        e["op_bytes"].get(op, 0),
+                        fmt_stages(e["op_stages"].get(op))[:256],
+                        e["sum_rows"], e["sheds"], e["kills"]])
+        return rows
+
+    def top_by_device(self, n: int = 5) -> list[dict]:
+        """Top digests by device time (kernel + device_get stage sums)
+        across the whole ring — the /status quick view. Reduces to
+        scalars directly under the lock instead of deep-copying the
+        ring: monitoring pollers hit this every few seconds and must
+        not lengthen the lock hold against the statement feed."""
+        acc: dict[str, dict] = {}
+        with self._lock:
+            for b in self._buckets:
+                ents = list(b["digests"].values())
+                if b["other"] is not None:
+                    ents.append(b["other"])
+                for e in ents:
+                    dev = e["stages"].get("kernel", 0.0) + \
+                        e["stages"].get("device_get", 0.0)
+                    a = acc.get(e["digest"])
+                    if a is None:
+                        a = acc[e["digest"]] = {
+                            "digest": e["digest"],
+                            "digest_text": e["digest_text"],
+                            "exec_count": 0, "device_ms": 0.0,
+                            "wall_ms": 0.0, "transfer_bytes": 0}
+                    a["exec_count"] += e["exec_count"]
+                    a["device_ms"] += dev * 1e3
+                    a["wall_ms"] += e["sum_wall_s"] * 1e3
+                    a["transfer_bytes"] += sum(e["op_bytes"].values())
+        out = sorted(acc.values(), key=lambda a: -a["device_ms"])[:n]
+        for a in out:
+            a["device_ms"] = round(a["device_ms"], 3)
+            a["wall_ms"] = round(a["wall_ms"], 3)
+        return out
+
+
+# ---- structured server event log --------------------------------------------
+
+class EventLog:
+    """Bounded ring of structured server events (reference: TiDB logs
+    these as structured log lines; here they are queryable after the
+    fact): governor kills, admission sheds, rpc breaker trips,
+    elections/promotions, checkpoint/fsync stalls — each with conn and
+    digest attribution where the producer has it, so PR 4/5's
+    protective actions are explainable without grepping stderr."""
+
+    DEFAULT_CAP = 512
+
+    def __init__(self, cap: int = DEFAULT_CAP, metrics=None) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(int(cap), 1))
+        self._seq = 0
+        if metrics is not None:
+            self.counter = metrics.counter(
+                "tidb_server_events_total",
+                "structured server events recorded, by kind")
+        else:
+            self.counter = None
+
+    def configure(self, cap: Optional[int] = None) -> None:
+        if cap:
+            with self._lock:
+                self._ring = deque(self._ring, maxlen=max(int(cap), 1))
+
+    def record(self, kind: str, detail: str = "",
+               severity: str = "info", conn_id: int = 0,
+               digest: str = "") -> None:
+        ent = {
+            "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "unix": round(time.time(), 3),
+            "kind": str(kind)[:32],
+            "severity": str(severity)[:8],
+            "conn_id": int(conn_id),
+            "digest": str(digest)[:32],
+            "detail": str(detail)[:512],
+        }
+        with self._lock:
+            self._seq += 1
+            ent["id"] = self._seq
+            self._ring.append(ent)
+        if self.counter is not None:
+            self.counter.inc(kind=ent["kind"])
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._ring]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
 # ---- per-server observability state ----------------------------------------
 
 class Observability:
@@ -361,11 +635,18 @@ class Observability:
         self.statements = StatementsSummary()
         # conn_id -> last TRACE span tree (served by /debug/trace/<id>)
         self._traces: dict[int, dict] = {}
+        # continuous per-digest resource attribution (Top SQL), off by
+        # default — performance.topsql-enabled arms it
+        self.topsql = TopSQL()
+        # structured server event ring (governor kills, admission
+        # sheds, breaker trips, elections, checkpoint/fsync stalls)
+        self.events = EventLog(metrics=self.metrics)
 
     def record_slow(self, sql: str, db: str, duration_s: float,
                     plan_digest: str = "",
                     stages: Optional[dict[str, float]] = None,
-                    mem_peak: int = 0, spill_count: int = 0) -> None:
+                    mem_peak: int = 0, spill_count: int = 0,
+                    op_wall: Optional[dict[str, float]] = None) -> None:
         self.slow_counter.inc()
         ent = {
             "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -377,6 +658,11 @@ class Observability:
             "plan_digest": plan_digest,
             "stages": {k: round(v * 1e3, 3)
                        for k, v in (stages or {}).items()},
+            # per-operator exclusive wall (ms): which plan operator of
+            # this digest spent the time — the slow-log half of the
+            # Top SQL attribution plane
+            "operators": {k: round(v * 1e3, 3)
+                          for k, v in (op_wall or {}).items()},
             # statement working-set peak + spill count (reference:
             # LogSlowQuery's Mem_max / Disk_max) — what makes a
             # governor kill explainable after the fact
@@ -814,6 +1100,70 @@ def stitch_remote_rows(coll: SpanCollector, parent: Span, rows) -> None:
 # ---- dispatch-stage accounting ----------------------------------------------
 
 _stage_tls = threading.local()
+_op_tls = threading.local()
+
+
+class _OpCtx:
+    """One plan-operator frame: tags the thread with the operator label
+    (stages closed inside attribute their time to it; transfer-byte
+    accounting does the same) and records the frame's EXCLUSIVE wall
+    seconds on the active StageRecorder — a per-thread nesting stack
+    subtracts inner operator frames, so summing op_wall never double
+    counts a join's probe scan into the join. Without an active
+    recorder it is label bookkeeping only (two TLS writes)."""
+
+    __slots__ = ("label", "prev", "t0", "rec")
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self.prev = None
+        self.t0 = 0.0
+        self.rec = None
+
+    def __enter__(self) -> "_OpCtx":
+        self.prev = getattr(_op_tls, "label", None)
+        _op_tls.label = self.label
+        rec = getattr(_stage_tls, "rec", None)
+        self.rec = rec
+        if rec is not None:
+            stack = getattr(_op_tls, "stack", None)
+            if stack is None:
+                stack = _op_tls.stack = []
+            stack.append(0.0)  # accumulates nested-frame wall time
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _op_tls.label = self.prev
+        rec = self.rec
+        if rec is not None:
+            dt = time.perf_counter() - self.t0
+            stack = _op_tls.stack
+            child = stack.pop()
+            if stack:
+                stack[-1] += dt
+            rec.add_op_wall(self.label,
+                            dt - child if dt > child else 0.0)
+
+
+def operator(label: str) -> _OpCtx:
+    """`with obs.operator("join"):` — attribute the enclosed work (wall
+    time, dispatch stages, transfer bytes) to one named plan operator
+    on the statement's StageRecorder."""
+    return _OpCtx(label)
+
+
+def active_operator() -> Optional[str]:
+    return getattr(_op_tls, "label", None)
+
+
+def note_op_bytes(nbytes: int) -> None:
+    """Attribute host->device transfer bytes to the active operator on
+    the statement's recorder (no-op without one — e.g. background
+    staging outside any statement)."""
+    rec = getattr(_stage_tls, "rec", None)
+    if rec is not None:
+        rec.note_bytes(nbytes)
 
 
 class StageRecorder:
@@ -827,17 +1177,41 @@ class StageRecorder:
 
     One recorder per statement, installed by the session; recording a
     stage is two perf_counter reads and a dict update — cheap enough
-    to stay always-on."""
+    to stay always-on.
 
-    __slots__ = ("totals", "counts")
+    Besides the flat per-stage totals it carries the per-OPERATOR
+    attribution the Top SQL plane aggregates: `op_wall` (exclusive
+    wall seconds per plan operator, from obs.operator frames the
+    executor/fragment paths open), `ops` (each operator's per-stage
+    split; stages recorded outside any operator frame land under
+    '(session)'), and `op_bytes` (host->device transfer bytes per
+    operator, fed by the copr client's staging accounting)."""
+
+    __slots__ = ("totals", "counts", "op_wall", "ops", "op_bytes")
 
     def __init__(self) -> None:
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self.op_wall: dict[str, float] = {}
+        self.ops: dict[str, dict[str, float]] = {}
+        self.op_bytes: dict[str, int] = {}
 
     def add(self, name: str, seconds: float) -> None:
         self.totals[name] = self.totals.get(name, 0.0) + seconds
         self.counts[name] = self.counts.get(name, 0) + 1
+
+    def add_op_wall(self, op: str, seconds: float) -> None:
+        self.op_wall[op] = self.op_wall.get(op, 0.0) + seconds
+
+    def add_op_stage(self, op: str, stage: str, seconds: float) -> None:
+        d = self.ops.get(op)
+        if d is None:
+            d = self.ops[op] = {}
+        d[stage] = d.get(stage, 0.0) + seconds
+
+    def note_bytes(self, nbytes: int) -> None:
+        op = getattr(_op_tls, "label", None) or "(session)"
+        self.op_bytes[op] = self.op_bytes.get(op, 0) + int(nbytes)
 
     def snapshot(self) -> dict[str, float]:
         return dict(self.totals)
@@ -895,6 +1269,12 @@ class _StageCtx:
         DISPATCH_STAGE_SECONDS.observe(excl, stage=self.stage)
         if self.rec is not None:
             self.rec.add(self.stage, excl)
+            # per-operator split of the same exclusive time: stages
+            # closed outside any operator frame (plan_build at the
+            # session layer) land under '(session)'
+            self.rec.add_op_stage(
+                getattr(_op_tls, "label", None) or "(session)",
+                self.stage, excl)
 
 
 def stage(name: str, span_name: Optional[str] = None) -> _StageCtx:
@@ -907,8 +1287,8 @@ def fmt_stages(stages: Optional[dict[str, float]]) -> str:
     """stage dict -> 'staging:0.12ms compile:5.3ms ...' (stable order)."""
     if not stages:
         return ""
-    order = ("plan_build", "prepare", "staging", "transfer", "compile",
-             "kernel", "device_get", "host_fallback", "ranged")
+    order = ("parse", "plan_build", "prepare", "staging", "transfer",
+             "compile", "kernel", "device_get", "host_fallback", "ranged")
     keys = [k for k in order if k in stages] + \
         sorted(k for k in stages if k not in order)
     return " ".join(f"{k}:{stages[k] * 1e3:.3g}ms" for k in keys)
@@ -920,6 +1300,14 @@ def fmt_stages_ms(stages_ms: Optional[dict[str, float]]) -> str:
     if not stages_ms:
         return ""
     return fmt_stages({k: v / 1e3 for k, v in stages_ms.items()})
+
+
+def fmt_ops_ms(ops_ms: Optional[dict[str, float]]) -> str:
+    """operator->ms dict -> 'join:5.2ms scan:1.1ms ...' heaviest first."""
+    if not ops_ms:
+        return ""
+    return " ".join(f"{k}:{v:.3g}ms" for k, v in
+                    sorted(ops_ms.items(), key=lambda kv: -kv[1]))
 
 
 # ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------
@@ -1106,14 +1494,124 @@ def profile_process(seconds: float = 0.5, hz: float = 97.0) -> Profile:
     return p.stop()
 
 
+# ---- metric-hygiene lint -----------------------------------------------------
+
+_METRIC_NAME_RE = None  # compiled lazily (re import stays off hot paths)
+
+
+def lint_metrics(registries) -> list[str]:
+    """Walk registries + their rendered exposition and return hygiene
+    findings (empty list = clean). Checks: every metric carries help
+    text; names are tidb_-prefixed snake_case; no family is registered
+    in more than one of the given registries (their /metrics outputs
+    concatenate); and the rendered Prometheus text exposition is
+    well-formed (HELP/TYPE precede samples, label syntax and values
+    parse, histogram buckets are cumulative and _count-consistent).
+    Run by tier-1 so a metric added by a later PR cannot silently
+    break the scrape."""
+    import re
+    global _METRIC_NAME_RE
+    if _METRIC_NAME_RE is None:
+        _METRIC_NAME_RE = re.compile(r"^tidb_[a-z0-9_]+$")
+    findings: list[str] = []
+    seen: dict[str, int] = {}
+    for ri, reg in enumerate(registries):
+        with reg._lock:
+            metrics = list(reg._metrics.values())
+        for m in metrics:
+            if not getattr(m, "help", ""):
+                findings.append(f"metric {m.name}: missing help text")
+            if not _METRIC_NAME_RE.match(m.name):
+                findings.append(
+                    f"metric {m.name}: name must match tidb_[a-z0-9_]+")
+            if m.name in seen and seen[m.name] != ri:
+                findings.append(
+                    f"metric {m.name}: registered in more than one "
+                    "concatenated registry (duplicate family on "
+                    "/metrics)")
+            seen[m.name] = ri
+        findings.extend(_lint_exposition(reg.render()))
+    return findings
+
+
+def _lint_exposition(text: str) -> list[str]:
+    """Validate one registry's Prometheus text exposition."""
+    import re
+    findings: list[str] = []
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+        r'(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")'
+        r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\]*")*)?\})? (\S+)$')
+    helped: set[str] = set()
+    typed: dict[str, str] = {}
+    bucket_acc: dict[str, int] = {}  # series label-part -> last cum count
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            if len(parts) < 4 or not parts[3].strip():
+                findings.append(f"exposition: HELP without text: {ln!r}")
+            helped.add(parts[2])
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary"):
+                findings.append(f"exposition: malformed TYPE: {ln!r}")
+                continue
+            if parts[2] in typed:
+                findings.append(
+                    f"exposition: duplicate TYPE for {parts[2]}")
+            typed[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#"):
+            continue
+        m = sample_re.match(ln)
+        if m is None:
+            findings.append(f"exposition: malformed sample line: {ln!r}")
+            continue
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        family = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[:-len(sfx)] in typed:
+                family = name[:-len(sfx)]
+                break
+        if family not in typed:
+            findings.append(
+                f"exposition: sample {name} precedes (or lacks) its "
+                "TYPE line")
+        elif family not in helped:
+            findings.append(f"exposition: {family} lacks a HELP line")
+        try:
+            float(value)
+        except ValueError:
+            findings.append(
+                f"exposition: non-numeric value {value!r} on {name}")
+            continue
+        if name.endswith("_bucket") and labels:
+            series = re.sub(r'le="[^"]*",?', "", labels)
+            key = family + "{" + series + "}"
+            cum = int(float(value))
+            if cum < bucket_acc.get(key, 0):
+                findings.append(
+                    f"exposition: non-cumulative buckets on {key}")
+            if 'le="+Inf"' in labels:
+                bucket_acc.pop(key, None)  # series complete; reset
+            else:
+                bucket_acc[key] = cum
+    return findings
+
+
 # ---- module-level delegates (default instance) ------------------------------
 
 def record_slow(sql: str, db: str, duration_s: float,
                 plan_digest: str = "",
                 stages: Optional[dict[str, float]] = None,
-                mem_peak: int = 0, spill_count: int = 0) -> None:
+                mem_peak: int = 0, spill_count: int = 0,
+                op_wall: Optional[dict[str, float]] = None) -> None:
     DEFAULT.record_slow(sql, db, duration_s, plan_digest, stages,
-                        mem_peak, spill_count)
+                        mem_peak, spill_count, op_wall)
 
 
 def slow_queries() -> list[dict]:

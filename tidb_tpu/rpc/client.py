@@ -110,6 +110,10 @@ class RpcClient:
         self._bk_streak = 0
         self._bk_open_until = 0.0
         self._bk_probe = False
+        # structured event sink (obs.EventLog): the owning Storage
+        # wires its per-server ring so trips/recoveries are queryable
+        # via information_schema.tidb_events after the fact
+        self.events = None
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._hb_client: Optional["RpcClient"] = None
@@ -233,17 +237,36 @@ class RpcClient:
     def _breaker_note(self, ok: bool) -> None:
         if self.options.breaker_threshold <= 0:
             return
+        tripped = recovered = False
         with self._bk_lock:
             self._bk_probe = False
             if ok:
+                recovered = \
+                    self._bk_streak >= self.options.breaker_threshold
                 self._bk_streak = 0
-                return
-            self._bk_streak += 1
-            if self._bk_streak >= self.options.breaker_threshold:
-                self._bk_open_until = time.monotonic() \
-                    + self.options.breaker_cooldown_ms / 1000.0
-                if self._bk_streak == self.options.breaker_threshold:
-                    obs.RPC_BREAKER_TRIPS.inc()
+            else:
+                self._bk_streak += 1
+                if self._bk_streak >= self.options.breaker_threshold:
+                    self._bk_open_until = time.monotonic() \
+                        + self.options.breaker_cooldown_ms / 1000.0
+                    if self._bk_streak == self.options.breaker_threshold:
+                        obs.RPC_BREAKER_TRIPS.inc()
+                        tripped = True
+            streak = self._bk_streak  # snapshot: the event detail must
+            # not re-read it unlocked (a racing call could have moved it)
+        # event emission OUTSIDE the breaker lock (the sink takes its
+        # own lock; no reason to nest them)
+        if tripped and self.events is not None:
+            self.events.record(
+                "breaker_trip", severity="warn",
+                detail=f"rpc to {self.addr}: {streak} "
+                       f"consecutive transport failures; failing fast "
+                       f"for {self.options.breaker_cooldown_ms}ms")
+        elif recovered and self.events is not None:
+            self.events.record(
+                "breaker_recover",
+                detail=f"rpc to {self.addr}: half-open probe "
+                       "succeeded, breaker closed")
 
     def _breaker_reset(self) -> None:
         with self._bk_lock:

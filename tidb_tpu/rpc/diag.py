@@ -50,6 +50,7 @@ TABLE_METHODS = {
     "cluster_slow_query": "diag_slow_query",
     "cluster_statements_summary": "diag_statements",
     "cluster_load": "diag_load",
+    "cluster_top_sql": "diag_top_sql",
 }
 
 
@@ -100,7 +101,22 @@ class DiagService:
                          e["sql"], e.get("plan_digest", ""),
                          obs.fmt_stages_ms(e.get("stages")),
                          int(e.get("mem_max", 0)),
-                         int(e.get("spill_count", 0))])
+                         int(e.get("spill_count", 0)),
+                         obs.fmt_ops_ms(e.get("operators"))])
+        return {"rows": rows}
+
+    def diag_top_sql(self) -> dict:
+        """This server's Top SQL attribution windows, row-shaped for
+        information_schema.tidb_top_sql (the cluster_top_sql fan-out
+        adds instance/error). Empty while topsql is disabled."""
+        return {"rows": self.storage.obs.topsql.table_rows()}
+
+    def diag_events(self) -> dict:
+        """The structured server event ring, newest last."""
+        rows = []
+        for e in self.storage.obs.events.snapshot():
+            rows.append([int(e["id"]), e["ts"], e["kind"], e["severity"],
+                         int(e["conn_id"]), e["digest"], e["detail"]])
         return {"rows": rows}
 
     def diag_statements(self) -> dict:

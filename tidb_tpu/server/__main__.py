@@ -201,11 +201,10 @@ def main(argv: list[str] | None = None) -> int:
                           shared=getattr(args, 'shared', False),
                           **sync_kw)
     cfg.seed_sysvars(storage)
-    storage.metrics_history.configure(
-        interval_s=cfg.performance.metrics_history_interval,
-        cap=cfg.performance.metrics_history_cap)
-    # arm the overload-protection plane: memory governor limit/cooldown
-    # and the execution admission gate (util/governor.py)
+    # arm the attribution/event plane (Top SQL, event ring, metrics
+    # history) and the overload-protection plane (memory governor,
+    # execution admission gate) from the [performance] knobs
+    cfg.seed_observability(storage)
     cfg.seed_overload_protection(storage)
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
@@ -244,6 +243,7 @@ def main(argv: list[str] | None = None) -> int:
         try:
             applied = cfg.hot_reload(args.config)
             cfg.seed_sysvars(storage)
+            cfg.seed_observability(storage)
             cfg.seed_overload_protection(storage)
             cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",

@@ -15,6 +15,12 @@ Debug routes:
   /debug/failpoints  armed fault-injection points + hit counts (JSON;
       the torture harness reads this to confirm its env-armed points
       actually fired inside child server processes)
+  /debug/topsql  the Top SQL attribution windows: per-digest stage
+      sums, per-operator wall/stage/transfer splits, admission/
+      governor outcomes (JSON; performance.topsql-* knobs)
+  /debug/events  the structured server event ring: governor kills,
+      admission sheds, breaker trips, elections, checkpoint/fsync
+      stalls (JSON)
 """
 
 from __future__ import annotations
@@ -73,6 +79,13 @@ class StatusServer:
                         gov = getattr(st, "governor", None)
                         if gov is not None:
                             status["governor"] = gov.stats()
+                    # top digests by device time from the continuous
+                    # attribution plane (empty while topsql disabled)
+                    status["top_sql"] = {
+                        "enabled": server_obs.topsql.enabled,
+                        "by_device_time":
+                            server_obs.topsql.top_by_device(5),
+                    }
                     body = json.dumps(status).encode()
                     ctype = "application/json"
                 elif self.path == "/slow-query":
@@ -108,6 +121,21 @@ class StatusServer:
                         "interval_s": hist.interval_s,
                         "samples": hist.snapshot(),
                     }).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/topsql"):
+                    # raw attribution windows (oldest first): per-digest
+                    # entries with stage sums, per-operator wall/stage/
+                    # transfer splits, and admission/governor outcomes
+                    body = json.dumps({
+                        "enabled": server_obs.topsql.enabled,
+                        "window_s": server_obs.topsql.window_s,
+                        "digest_cap": server_obs.topsql.digest_cap,
+                        "windows": server_obs.topsql.snapshot(),
+                    }).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/events"):
+                    body = json.dumps(
+                        server_obs.events.snapshot()).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/debug/failpoints"):
                     from ..util import failpoint

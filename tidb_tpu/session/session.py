@@ -103,6 +103,14 @@ class Session:
         self._stmt_seq = 0
         self.last_mem_peak = 0  # bytes; per-statement tracker peak
         self.last_spill_count = 0
+        # last statement's attribution (stage totals, per-operator
+        # exclusive wall / stage split / transfer bytes) — the embedded
+        # read side of the Top SQL plane (bench.py persists these)
+        self.last_stages: dict[str, float] = {}
+        self.last_op_wall: dict[str, float] = {}
+        self.last_op_stages: dict[str, dict[str, float]] = {}
+        self.last_op_bytes: dict[str, int] = {}
+        self._pending_parse_s = 0.0
         # SQL-text plan cache: key -> (invalidation gen, physical plan)
         # (reference: prepared-plan cache, planner/core/common_plans.go +
         # kvcache LRU; text-keyed here because identical statement replay
@@ -164,12 +172,19 @@ class Session:
             # commits + schema changes before planning (the per-statement
             # domain-reload; store/storage.py refresh)
             self.storage.refresh()
+        import time as _time
+        t_parse = _time.perf_counter()
         try:
             stmts = parse_sql(sql)
         except ParseError as e:
             self.storage.obs.query_errors.inc()
             raise SQLError(f"parse error: {e}",
                            errno=getattr(e, 'errno', ER_PARSE_ERROR)) from None
+        # parse happens before the per-statement recorder exists; stash
+        # it so the first statement's recorder books it as a 'parse'
+        # stage — without this the attribution plane undercounts short
+        # statements by exactly the lexer/parser time
+        self._pending_parse_s = _time.perf_counter() - t_parse
         result = ResultSet([], [])
         single = len(stmts) == 1
         for i, stmt in enumerate(stmts):
@@ -217,6 +232,7 @@ class Session:
         t0 = _time.perf_counter()
         o.queries.inc(type=type(stmt).__name__.removesuffix("Stmt"))
         failed = False
+        shed = False
         rows_out = 0
         # arm the per-statement kill flag (KILL QUERY clears with the
         # statement; KILL CONNECTION leaves it set and the server drops
@@ -272,6 +288,22 @@ class Session:
         prev_rec = obs.active_stage_recorder()
         rec = obs.StageRecorder()
         obs.install_stage_recorder(rec)
+        pp = getattr(self, "_pending_parse_s", 0.0)
+        if pp:
+            # the batch's parse time books against its first statement
+            rec.add("parse", pp)
+            rec.add_op_stage("(session)", "parse", pp)
+            self._pending_parse_s = 0.0
+        # route @@time_zone to the scalar-function layer for the
+        # statement's duration: FROM_UNIXTIME formats in the session
+        # time zone like MySQL (the round-5 ADVICE finding; the %-
+        # strftime portability half was fixed in PR 1)
+        from ..copr import funcs as _funcs
+        try:
+            tz = str(self._sysvar_value("time_zone") or "SYSTEM")
+        except (TypeError, ValueError, SQLError):
+            tz = "SYSTEM"
+        prev_tz = _funcs.install_session_time_zone(tz)
         # @@profiling: sample THIS thread's stacks for the statement
         # (reference: util/profile; MySQL SHOW PROFILE semantics)
         prof = self._maybe_start_profiler(stmt)
@@ -317,8 +349,10 @@ class Session:
                     errno=ER_QUERY_TIMEOUT) from None
             raise SQLError("Query execution was interrupted",
                            errno=ER_QUERY_INTERRUPTED) from None
-        except Exception:
+        except Exception as e:
             failed = True
+            from ..util.governor import AdmissionTimeout
+            shed = isinstance(e, AdmissionTimeout)
             o.query_errors.inc()
             raise
         finally:
@@ -327,6 +361,7 @@ class Session:
             self._deadline_expired = False
             interrupt.install(None)
             obs.install_stage_recorder(prev_rec)
+            _funcs.install_session_time_zone(prev_tz)
             self.in_flight_sql = None
             if self._is_guard is not None:
                 self._is_guard.release()
@@ -335,6 +370,12 @@ class Session:
             if prof is not None:
                 self._finish_profile(prof, sql, dt)
             o.query_seconds.observe(dt)
+            # the statement's attribution, readable by embedded callers
+            # (bench.py persists these per timed query)
+            self.last_stages = rec.totals
+            self.last_op_wall = rec.op_wall
+            self.last_op_stages = rec.ops
+            self.last_op_bytes = rec.op_bytes
             if digest_sql is not None:
                 o.statements.record(digest_sql, self.current_db, dt,
                                     rows_out, failed,
@@ -345,17 +386,32 @@ class Session:
                     self._sysvar_value("tidb_slow_log_threshold"))
             except (TypeError, ValueError, SQLError):
                 thresh = DEFAULT_SLOW_THRESHOLD_MS
-            if dt * 1e3 >= thresh:
+            slow = dt * 1e3 >= thresh
+            # the Top SQL aggregator feed: gated on `enabled` HERE so a
+            # disabled plane costs zero work and zero allocations on
+            # the statement path (the digest/normalize hash is the
+            # expensive part)
+            topsql = o.topsql
+            if slow or (topsql.enabled and digest_sql is not None):
                 import hashlib
                 # same digest the statements_summary uses, so slow-log
-                # entries join against the digest table
-                digest = hashlib.sha256(
-                    o.statements.normalize(digest_sql or sql)
-                    .encode()).hexdigest()[:32]
-                o.record_slow(sql, self.current_db, dt,
-                              plan_digest=digest, stages=rec.snapshot(),
-                              mem_peak=self.last_mem_peak,
-                              spill_count=self.last_spill_count)
+                # and top-sql entries join against the digest table
+                norm = o.statements.normalize(digest_sql or sql)
+                digest = hashlib.sha256(norm.encode()).hexdigest()[:32]
+                if topsql.enabled and digest_sql is not None:
+                    topsql.record(
+                        digest, norm[:512], self.current_db, dt,
+                        stages=rec.totals, op_wall=rec.op_wall,
+                        op_stages=rec.ops, op_bytes=rec.op_bytes,
+                        rows=rows_out, failed=failed, shed=shed,
+                        killed=self._governor_killed)
+                if slow:
+                    o.record_slow(sql, self.current_db, dt,
+                                  plan_digest=digest,
+                                  stages=rec.snapshot(),
+                                  mem_peak=self.last_mem_peak,
+                                  spill_count=self.last_spill_count,
+                                  op_wall=rec.op_wall)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -1459,7 +1515,9 @@ class Session:
             return
         self._admission_depth += 1
         try:
-            with gate.admit(priority):
+            with gate.admit(priority,
+                            info={"conn_id": self.conn_id or 0,
+                                  "sql": self.in_flight_sql or ""}):
                 yield
         finally:
             self._admission_depth -= 1

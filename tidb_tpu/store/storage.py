@@ -288,6 +288,16 @@ class Storage:
             self.tso = TimestampOracle(floor=self._tso_lease)
         self.rm = RegionManager(self.kv)
         self.committer = TwoPhaseCommitter(self.rm, self.tso)
+        # wire the structured event ring into its producers: governor
+        # kills, admission sheds, rpc breaker trips, WAL fsync stalls —
+        # the protective/durability actions PR 4/5 added become
+        # queryable (information_schema.tidb_events) instead of only
+        # being countable
+        self.governor.events = self.obs.events
+        self.admission.events = self.obs.events
+        if self._rpc_client is not None:
+            self._rpc_client.events = self.obs.events
+        self._wire_fsync_stall(engine)
         # GLOBAL sysvar plane (mysql.global_variables analog) — rides the
         # meta keyspace, so durable stores keep SET GLOBAL across restarts
         from ..session.privileges import PrivilegeManager
@@ -750,7 +760,10 @@ class Storage:
         mode); the WAL always folds."""
         if self.path is None:
             return
+        import time as _time
+
         from ..util import failpoint
+        t0 = _time.perf_counter()
         self._flush_sequence_cursors()
         for store in list(self.tables.values()):  # DDL may race the daemon
             if dirty_only and not getattr(store, "epoch_dirty", False):
@@ -762,6 +775,16 @@ class Storage:
             # recovery must treat the half-finished checkpoint as noise
             failpoint.inject("storage/mid-checkpoint")
         self.kv.checkpoint()
+        dt = _time.perf_counter() - t0
+        if dt >= 1.0:
+            # a slow checkpoint competes with the commit path for the
+            # WAL/fsync — surface it in the event ring so a latency
+            # spike is explainable after the fact
+            self.obs.events.record(
+                "checkpoint_stall", severity="warn",
+                detail=f"checkpoint took {dt * 1e3:.0f}ms "
+                       f"({len(self.tables)} tables, "
+                       f"dirty_only={dirty_only})")
 
     @property
     def maintenance(self):
@@ -811,6 +834,25 @@ class Storage:
         return {"mode": "local"}
 
     # ---- leader failover (rpc/failover.py drives these) ---------------------
+    def _wire_fsync_stall(self, engine) -> None:
+        """Point the engine's SyncPolicy stall hook at this server's
+        event ring. Called from __init__ AND from promotion — the
+        promoted leader swaps in a brand-new engine, and losing the
+        hook there would blind the event log on exactly the node (and
+        scenario: post-failover latency spike) it exists to explain."""
+        syncer = getattr(engine, "_syncer", None) or \
+            getattr(engine, "_mirror_sync", None)
+        if syncer is None:
+            return
+        _ev = self.obs.events
+
+        def _fsync_stall(dt_s: float) -> None:
+            _ev.record("fsync_stall", severity="warn",
+                       detail=f"wal fsync took {dt_s * 1e3:.1f}ms "
+                              f"(policy {syncer.policy})")
+
+        syncer.on_stall = _fsync_stall
+
     def promote_to_leader(self, listen: str = "127.0.0.1:0") -> str:
         """Promote this socket FOLLOWER to the cluster leader in place.
 
@@ -834,7 +876,12 @@ class Storage:
         # until we answer as a leader)
         self._promoting = True
         try:
-            return self._promote_locked(client, opts, new_term, listen)
+            addr = self._promote_locked(client, opts, new_term, listen)
+            self.obs.events.record(
+                "leader_promoted", severity="warn",
+                detail=f"promoted in place at {addr} "
+                       f"(fencing term {new_term})")
+            return addr
         finally:
             self._promoting = False
 
@@ -868,6 +915,7 @@ class Storage:
                                  sync_log=self.sync_log,
                                  sync_interval_ms=self.sync_interval_ms)
             self.kv.kv = engine
+            self._wire_fsync_stall(engine)
             # 4. coordination over OUR directory now
             self.coord = SharedDirCoordinator(self.path)
             self.kv.coord = self.coord
@@ -910,6 +958,9 @@ class Storage:
         if client is None:
             return
         client.repoint(addr, int(term))
+        self.obs.events.record(
+            "leader_repointed",
+            detail=f"following new leader at {addr} (term {term})")
         from ..rpc.errors import RPCError as _RPCError
         try:
             if self.diag_listener is not None:

@@ -204,6 +204,9 @@ class MemoryGovernor:
             self.stmts_gauge = _NullCounter()
         self.usage_gauge.set(0)
         self.stmts_gauge.set(0)
+        # structured event sink (obs.EventLog) — the Storage wires its
+        # per-server ring here so kills are explainable after the fact
+        self.events = None
 
     def configure(self, limit_bytes: Optional[int] = None,
                   cooldown_ms: Optional[int] = None) -> None:
@@ -294,6 +297,14 @@ class MemoryGovernor:
             self._last_kill = now
             self._kill_count += 1
         self.kills.inc()
+        if self.events is not None:
+            self.events.record(
+                "governor_kill", severity="warn",
+                conn_id=victim["conn_id"],
+                detail=f"usage {usage} > server-memory-limit "
+                       f"{self.limit_bytes}; killed weight "
+                       f"{self._weight(victim['tracker'])}: "
+                       f"{victim['label']}")
         try:
             victim["kill"]()
         except Exception:  # noqa: BLE001 — a dead session must not
@@ -355,6 +366,8 @@ class AdmissionGate:
             self.running_gauge = _NullCounter()
         self.depth_gauge.set(0)
         self.running_gauge.set(0)
+        # structured event sink (obs.EventLog), wired by the Storage
+        self.events = None
 
     def configure(self, tokens: Optional[int] = None,
                   timeout_ms: Optional[int] = None) -> None:
@@ -370,10 +383,28 @@ class AdmissionGate:
             heapq.heappop(self._waiters)
 
     def acquire(self, priority: int = 0,
-                timeout_s: Optional[float] = None) -> bool:
+                timeout_s: Optional[float] = None,
+                info: Optional[dict] = None) -> bool:
         """Returns True when a token is now held (release() owed),
         False when the gate is unlimited; raises AdmissionTimeout on
-        shed."""
+        shed. `info` ({conn_id, sql}) attributes the shed event to the
+        statement that was turned away."""
+        try:
+            return self._acquire(priority, timeout_s)
+        except AdmissionTimeout as e:
+            # event emission OUTSIDE the gate's condition lock: a shed
+            # storm is exactly when the gate is contended, and the
+            # ring/counter work must not serialize admitters behind it
+            if self.events is not None:
+                sql = str((info or {}).get("sql", ""))[:128]
+                self.events.record(
+                    "admission_shed", severity="warn",
+                    conn_id=int((info or {}).get("conn_id", 0) or 0),
+                    detail=str(e) + (f"; shed: {sql}" if sql else ""))
+            raise
+
+    def _acquire(self, priority: int,
+                 timeout_s: Optional[float]) -> bool:
         with self._cv:
             if self.tokens <= 0:
                 return False
@@ -431,8 +462,9 @@ class AdmissionGate:
 
     @contextmanager
     def admit(self, priority: int = 0,
-              timeout_s: Optional[float] = None):
-        held = self.acquire(priority, timeout_s)
+              timeout_s: Optional[float] = None,
+              info: Optional[dict] = None):
+        held = self.acquire(priority, timeout_s, info)
         try:
             yield
         finally:
