@@ -29,6 +29,10 @@ Configs (BASELINE.json configs[0..4] + the r04 join target):
   ssb q1.1-1.3          — SSB flight at BENCH_SSB_SF (default 100)
   cb_*                  — ClickBench-style wide scan/TopN at
                           BENCH_CB_ROWS (default 100M)
+  multichip             — mesh data plane: sharded-vs-single-device
+                          rows/s + per-device placement (shard spec,
+                          bytes per device) at BENCH_MESH_ROWS rows
+                          over BENCH_MESH_DEVICES devices
 
 Every timed query passes an exact digest check against a numpy oracle
 first. Each timed query's per-operator/per-stage attribution (the Top
@@ -39,6 +43,7 @@ load phase emits a heartbeat (rows, rows/s, RSS) every 5s — so an OOM
 or timeout kill leaves a diagnosable trail. Environment knobs:
 BENCH_SF (10), BENCH_JOIN_SF (10),
 BENCH_SSB_SF (100), BENCH_CB_ROWS (1e8), BENCH_SF_BIG (100),
+BENCH_MESH_ROWS (4e6), BENCH_MESH_DEVICES (8),
 BENCH_REPEAT (5), BENCH_CLIENTS (8), BENCH_PLATFORM,
 BENCH_FLIGHT_TIMEOUT (5400s), BENCH_RAM_FRACTION (0.75),
 BENCH_FLIGHTS (comma list to run a subset).
@@ -704,12 +709,109 @@ def flight_cb(res: dict) -> None:
         res["values"][q] = rps
 
 
+def flight_multichip(res: dict) -> None:
+    """Mesh data plane: Q1/Q6-class scan+agg over epochs sharded across
+    the device mesh vs the single-device path — per-query rows/s for
+    both, plus per-device placement (shard spec + bytes per device from
+    `arr.sharding` / `addressable_shards`). Forces an 8-virtual-device
+    CPU mesh when no real multi-chip backend was requested
+    (BENCH_PLATFORM unset), mirroring the MULTICHIP board's dryrun."""
+    import jax
+
+    want = int(os.environ.get("BENCH_MESH_DEVICES", 8))
+    if not os.environ.get("BENCH_PLATFORM"):
+        # prefer REAL multi-device hardware: probe the default backend
+        # in a throwaway child (this process must not initialize a
+        # backend before deciding — init is one-shot), and only fall
+        # back to `want` virtual CPU devices when the default backend
+        # is cpu or single-device
+        ndev, backend = 1, "cpu"
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend(), "
+                 "len(jax.devices()))"],
+                capture_output=True, text=True, timeout=180)
+            parts = probe.stdout.split()
+            if len(parts) >= 2:
+                backend, ndev = parts[-2], int(parts[-1])
+        except (subprocess.TimeoutExpired, OSError, ValueError):
+            pass
+        if backend != "cpu" and ndev > 1:
+            log(f"multichip: using default backend {backend} "
+                f"({ndev} devices)")
+        else:
+            try:  # must precede backend init; ignored afterwards
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update("jax_num_cpu_devices", want)
+            except AttributeError:
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count={want}")
+    _session_env()
+    from tidb_tpu.bench.tpch import TPCH_Q1, TPCH_Q6, load_lineitem
+    from tidb_tpu.copr import mesh as M
+    from tidb_tpu.copr.client import CopClient
+    from tidb_tpu.session import Session
+
+    lines = res["lines"]
+    n_dev = len(jax.devices())
+    repeat = int(os.environ.get("BENCH_REPEAT", 5))
+    n = _scale_to_ram(int(float(os.environ.get("BENCH_MESH_ROWS", 4e6))),
+                      115.0, "multichip", lines)
+    log(f"multichip: {n_dev} devices, {n} rows")
+    with _Heartbeat("multichip-gen") as hb:
+        arrays = generate_lineitem_chunked(n, hb)
+    single = Session(cop=CopClient())
+    with _Heartbeat("multichip-load") as hb:
+        hb.rows = n
+        load_lineitem(single, n, arrays=arrays)
+    plane = M.MeshPlane(M.MeshConfig(
+        enabled=True, shard_threshold_rows=min(1 << 20, max(n // 2, 1))))
+    mesh = Session(single.storage, cop=plane.client_for(single.storage))
+    res["values"]["mesh_devices"] = n_dev
+    lines.append(f"multichip: {n_dev} devices "
+                 f"(active={plane.active}), {n} rows")
+
+    want6 = q6_oracle(arrays)
+    got = mesh.query(TPCH_Q6)[0][0]
+    assert got is not None and got.unscaled == want6, "mesh q6 digest"
+    assert single.query(TPCH_Q6)[0][0].unscaled == want6
+    check_q1(mesh.query(TPCH_Q1), arrays)
+    log("multichip digests OK (mesh == single == oracle); timing")
+
+    for name, sql in (("q6", TPCH_Q6), ("q1", TPCH_Q1)):
+        ts_s = times(lambda s=sql: single.query(s), repeat)
+        ts_m = times(lambda s=sql: mesh.query(s), repeat)
+        note_attribution(res, f"multichip_{name}_mesh", mesh)
+        _, rps_s = report(f"{name}_single", ts_s, n)
+        _, rps_m = report(f"{name}_mesh", ts_m, n)
+        res["values"][f"{name}_single_1dev"] = rps_s
+        res["values"][f"{name}_mesh_{n_dev}dev"] = rps_m
+        lines.append(
+            f"multichip {name}: single-device "
+            f"{rps_s / 1e6:.1f}M rows/s vs {n_dev}-device mesh "
+            f"{rps_m / 1e6:.1f}M rows/s ({rps_m / rps_s:.2f}x)")
+
+    rep = M.placement_report(mesh.cop)
+    lines.append(
+        f"multichip placement: {rep['sharded_arrays']} sharded + "
+        f"{rep['replicated_arrays']} replicated arrays, "
+        f"spec={rep['shard_spec']}")
+    for dev in sorted(rep["device_bytes"]):
+        lines.append(f"multichip placement {dev}: "
+                     f"{rep['device_bytes'][dev]} bytes")
+    res["values"]["mesh_device_bytes"] = rep["device_bytes"]
+    res["values"]["mesh_sharded_arrays"] = rep["sharded_arrays"]
+
+
 FLIGHTS = {
     "tpch_small": lambda res: flight_tpch(res, big=False),
     "tpch_big": lambda res: flight_tpch(res, big=True),
     "joins": flight_joins,
     "ssb": flight_ssb,
     "cb": flight_cb,
+    "multichip": flight_multichip,
 }
 
 
@@ -779,7 +881,8 @@ def main() -> None:
         log(f"compiled baseline FAILED: {baseline_err}")
 
     flight_names = os.environ.get(
-        "BENCH_FLIGHTS", "tpch_small,tpch_big,joins,ssb,cb").split(",")
+        "BENCH_FLIGHTS",
+        "tpch_small,tpch_big,joins,ssb,cb,multichip").split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}
     all_lines: list[str] = [

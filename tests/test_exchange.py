@@ -16,6 +16,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tidb_tpu.parallel import DistCopClient, make_mesh
+from tidb_tpu.parallel.dist import shard_map
 from tidb_tpu.parallel.exchange import capacity_for, mix_hash, route_rows
 from tidb_tpu.session import Session
 
@@ -35,7 +36,7 @@ def test_route_rows_delivers_every_row_exactly_once():
                 "valid": rv.reshape(1, -1), "ov": ov}
 
     sh = NamedSharding(mesh, P("shard"))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         kern, mesh=mesh, in_specs=(P("shard"), P("shard")),
         out_specs={"vals": P("shard", None), "valid": P("shard", None),
                    "ov": P()}))
@@ -58,7 +59,7 @@ def test_route_rows_detects_overflow():
         return ov
 
     sh = NamedSharding(mesh, P("shard"))
-    f = jax.jit(jax.shard_map(kern, mesh=mesh, in_specs=(P("shard"),),
+    f = jax.jit(shard_map(kern, mesh=mesh, in_specs=(P("shard"),),
                               out_specs=P()))
     assert int(f(jax.device_put(jnp.asarray(dest_np), sh))) > 0
 

@@ -30,10 +30,9 @@ def test_load_data_basic_tsv(tk, tmp_path):
 
 
 def test_load_data_local_rejected(tk, tmp_path):
-    """LOCAL INFILE's client-side transfer sub-protocol is not
-    implemented: the statement must fail clearly (errno 1235), not
-    silently read a SERVER-side path — that spelling difference is a
-    FILE-privilege boundary."""
+    """Without the local_infile opt-in, LOCAL must fail clearly (errno
+    1235), not silently read a SERVER-side path — that spelling
+    difference is a FILE-privilege boundary."""
     p = tmp_path / "t.tsv"
     p.write_text("1\n")
     tk.must_exec("create table t (a int primary key)")
@@ -42,6 +41,90 @@ def test_load_data_local_rejected(tk, tmp_path):
     assert "local" in str(exc.value).lower()
     assert getattr(exc.value, "errno", None) == 1235
     tk.check("select count(*) from t", [(0,)])
+
+
+def test_load_data_local_opt_in(tk, tmp_path):
+    """With SET GLOBAL local_infile = 1 (or the local-infile config
+    knob), LOCAL is accepted with MySQL LOCAL semantics: the file
+    loads, and duplicate-key errors degrade to IGNORE (LOCAL cannot
+    abort a half-streamed file) unless REPLACE was given."""
+    p = tmp_path / "t.tsv"
+    p.write_text("1\talpha\n2\tbeta\n")
+    tk.must_exec("create table t (a int primary key, b varchar(20))")
+    tk.must_exec("set global local_infile = 1")
+    try:
+        rs = tk.must_exec(f"load data local infile '{p}' into table t")
+        assert rs.affected == 2
+        tk.check("select a, b from t order by a",
+                 [(1, "alpha"), (2, "beta")])
+        # duplicates: IGNORE semantics without REPLACE...
+        p2 = tmp_path / "t2.tsv"
+        p2.write_text("2\tBETA2\n3\tgamma\n")
+        tk.must_exec(f"load data local infile '{p2}' into table t")
+        tk.check("select a, b from t order by a",
+                 [(1, "alpha"), (2, "beta"), (3, "gamma")])
+        # ...and REPLACE still replaces
+        tk.must_exec(
+            f"load data local infile '{p2}' replace into table t")
+        tk.check("select b from t where a = 2", [("BETA2",)])
+    finally:
+        tk.must_exec("set global local_infile = 0")
+    # opt-out restores the typed rejection
+    with pytest.raises(Exception) as exc:
+        tk.must_exec(f"load data local infile '{p}' into table t")
+    assert getattr(exc.value, "errno", None) == 1235
+
+
+def test_load_data_local_user_needs_file_or_confinement(tk, tmp_path):
+    """An AUTHENTICATED user without the FILE privilege may use opted-in
+    LOCAL only when secure_file_priv confines the server-side read."""
+    p = tmp_path / "x.tsv"
+    p.write_text("1\n")
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("set global local_infile = 1")
+    # a user with table access but WITHOUT the FILE privilege: the
+    # rejection must come from the LOCAL gate, not the insert check
+    tk.must_exec("create user 'nobody'@'%'")
+    tk.must_exec("grant insert on test.t to 'nobody'@'%'")
+    tk.session.user = "nobody"
+    try:
+        with pytest.raises(Exception) as exc:
+            tk.must_exec(f"load data local infile '{p}' into table t")
+        assert getattr(exc.value, "errno", None) == 1227
+        # confinement configured: allowed within the confined directory
+        tk.session.vars["secure_file_priv"] = str(tmp_path)
+        tk.session.user = None  # table access itself needs no grants
+        tk.must_exec(f"load data local infile '{p}' into table t")
+        tk.check("select a from t", [(1,)])
+    finally:
+        tk.session.user = None
+        tk.session.vars.pop("secure_file_priv", None)
+        tk.must_exec("set global local_infile = 0")
+
+
+def test_load_data_local_respects_secure_file_priv(tk, tmp_path):
+    """Opted-in LOCAL skips the FILE privilege but NOT secure_file_priv:
+    this server's LOCAL read is server-side, so the confinement (when
+    set) must still hold."""
+    allowed = tmp_path / "allowed"
+    allowed.mkdir()
+    outside = tmp_path / "outside.tsv"
+    outside.write_text("1\n")
+    inside = allowed / "in.tsv"
+    inside.write_text("2\n")
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("set global local_infile = 1")
+    tk.session.vars["secure_file_priv"] = str(allowed)
+    try:
+        with pytest.raises(Exception) as exc:
+            tk.must_exec(
+                f"load data local infile '{outside}' into table t")
+        assert getattr(exc.value, "errno", None) == 1290
+        tk.must_exec(f"load data local infile '{inside}' into table t")
+        tk.check("select a from t", [(2,)])
+    finally:
+        tk.session.vars.pop("secure_file_priv", None)
+        tk.must_exec("set global local_infile = 0")
 
 
 def test_load_data_csv_enclosed_ignore_lines(tk, tmp_path):

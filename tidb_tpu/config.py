@@ -94,6 +94,20 @@ class StorageConfig:
 
 
 @dataclass
+class MeshSection:
+    """The `[mesh]` TOML section: field names and defaults MIRROR
+    copr/mesh.MeshConfig (which documents the placement policy and is
+    the runtime owner). Mirrored rather than imported so config
+    parsing/validation never pulls the jax import chain; a tier-1 test
+    (tests/test_mesh.py) pins the two definitions equal."""
+
+    enabled: bool = True
+    axis_size: int = 0                    # devices in the mesh; 0 = all
+    shard_threshold_rows: int = 1 << 20
+    replicate_threshold_bytes: int = 64 << 20
+
+
+@dataclass
 class PlanCacheConfig:
     enabled: bool = True
     capacity: int = 128
@@ -118,6 +132,12 @@ class SecurityConfig:
     # PROXY protocol: allowed LB networks, comma CIDRs or "*"
     # (reference: config.ProxyProtocol.Networks)
     proxy_protocol_networks: str = ""
+    # LOAD DATA LOCAL INFILE opt-in (seeds the local_infile sysvar):
+    # off = typed 1235 rejection; on = accept LOCAL with MySQL
+    # semantics (the server reads the named path — acceptable only
+    # when clients share the server's filesystem or the operator
+    # accepts that exposure)
+    local_infile: bool = False
 
 
 @dataclass
@@ -180,6 +200,7 @@ class Config:
     status: StatusConfig = field(default_factory=StatusConfig)
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
+    mesh: MeshSection = field(default_factory=MeshSection)
     gc: GCConfig = field(default_factory=GCConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
@@ -286,6 +307,14 @@ class Config:
         if t.breaker_cooldown_ms <= 0:
             raise ConfigError(
                 "transport.breaker-cooldown-ms must be > 0")
+        if self.mesh.axis_size < 0:
+            raise ConfigError("mesh.axis-size must be >= 0 (0 = all "
+                              "visible devices)")
+        if self.mesh.shard_threshold_rows < 0:
+            raise ConfigError("mesh.shard-threshold-rows must be >= 0")
+        if self.mesh.replicate_threshold_bytes < 0:
+            raise ConfigError(
+                "mesh.replicate-threshold-bytes must be >= 0")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -379,6 +408,18 @@ class Config:
         storage.admission.configure(tokens=p.token_limit,
                                     timeout_ms=p.admission_timeout_ms)
 
+    def seed_mesh(self) -> None:
+        """Configure the PROCESS-wide device-mesh plane from the [mesh]
+        knobs (server startup; the plane is per-process, not
+        per-storage). Not hot-reloadable: resharding resident epochs
+        under live queries is not worth a SIGHUP."""
+        from .copr import mesh as _mesh
+        m = self.mesh
+        _mesh.configure(
+            enabled=m.enabled, axis_size=m.axis_size,
+            shard_threshold_rows=m.shard_threshold_rows,
+            replicate_threshold_bytes=m.replicate_threshold_bytes)
+
     def seed_observability(self, storage) -> None:
         """Arm the attribution/event plane from the [performance] knobs
         (startup and SIGHUP hot reload both call this)."""
@@ -414,6 +455,8 @@ class Config:
                               self.performance.profiler_sample_hz)
         sv.set_config_default("tidb_trace_span_cap",
                               self.performance.trace_span_cap)
+        sv.set_config_default("local_infile",
+                              1 if self.security.local_infile else 0)
 
 
 class _TomlError(Exception):
@@ -583,6 +626,25 @@ events-history-cap = 512
 enabled = true
 capacity = 128
 
+[mesh]
+# Multi-chip data plane: shard large columnar epochs across the
+# process's device mesh and execute scan/filter/agg fragments
+# partition-wise (XLA partitions the kernels; exact limb partials
+# merge with native-int32 collectives, so results are bit-identical
+# to the single-device path). Placement policy:
+#   * epochs with >= shard-threshold-rows rows shard on the row axis
+#     and stay device-resident across queries;
+#   * smaller tables keep the unchanged single-device path;
+#   * join build sides replicate (broadcast join) unless larger than
+#     replicate-threshold-bytes — then they shard by key range and
+#     probe rows route over the mesh exchange (hash-partition join).
+# With enabled = false or a single visible device everything takes
+# the exact single-device path. axis-size = 0 uses every device.
+enabled = true
+axis-size = 0
+shard-threshold-rows = 1048576
+replicate-threshold-bytes = 67108864
+
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC
 run-interval = "10m0s"         # background maintenance cadence
@@ -636,6 +698,14 @@ ssl-key = ""
 auto-tls = false               # ephemeral self-signed cert at startup
 require-secure-transport = false
 proxy-protocol-networks = ""   # LB CIDRs (or "*") sending PROXY headers
+# LOAD DATA LOCAL INFILE opt-in (seeds the local_infile sysvar).
+# Off: LOCAL is rejected with errno 1235. On: LOCAL is accepted, but
+# since this server reads the named path from ITS OWN filesystem (the
+# client-side transfer sub-protocol is not implemented), authenticated
+# users need either the FILE privilege or a configured
+# secure-file-priv — which, when set, always confines the path.
+# Duplicate-key errors degrade to IGNORE unless REPLACE was given.
+local-infile = false
 """
 
 

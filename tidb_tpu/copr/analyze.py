@@ -205,25 +205,31 @@ def device_column_stats(cop, snap, offsets: list[int]):
     if not usable:
         return {}
     dag = CopDAG(scan=DAGScan(snap.store.table.id, usable))
-    tiles = cop._stage_tiles(dag, snap)
-    bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
+    # placement must match the query path's: an ANALYZE staging outside
+    # the scope would seed the SHARED mesh client's epoch cache with
+    # single-device arrays under the keys sharded queries hit, silently
+    # defeating the persistent sharded residency
+    with cop.placement_scope(snap):
+        tiles = cop._stage_tiles(dag, snap)
+        bucket = tiles[0][0][0][0].shape[0] if tiles and tiles[0][0] else 0
 
-    def build():
-        def kernel(d, v, vis):
-            from .client import widen32
-            (d, v), = widen32([(d, v)])
-            return _column_partials(d, v & vis)
-        return jax.jit(kernel)
+        def build():
+            def kernel(d, v, vis):
+                from .client import widen32
+                (d, v), = widen32([(d, v)])
+                return _column_partials(d, v & vis)
+            return jax.jit(kernel)
 
-    # one kernel per (dtype, bucket) — shared across all columns of that
-    # width, so the first ANALYZE compiles a handful of tiny programs
-    devs = []
-    for ci in range(len(usable)):
-        dt = str(tiles[0][0][ci][0].dtype)
-        kern = cop._kernel(("analyze", dt, bucket), build)
-        devs.append([kern(cols[ci][0], cols[ci][1], vis)
-                     for cols, vis, _ in tiles])
-    outs = jax.device_get(devs)
+        # one kernel per (dtype, bucket) — shared across all columns of
+        # that width, so the first ANALYZE compiles a handful of tiny
+        # programs
+        devs = []
+        for ci in range(len(usable)):
+            dt = str(tiles[0][0][ci][0].dtype)
+            kern = cop._kernel(("analyze", dt, bucket), build)
+            devs.append([kern(cols[ci][0], cols[ci][1], vis)
+                         for cols, vis, _ in tiles])
+        outs = jax.device_get(devs)
     result = {}
     for ci, off in enumerate(usable):
         p = _merge(list(outs[ci]))

@@ -112,6 +112,22 @@ def _obj_nbytes(o) -> int:
     return int(getattr(o, "nbytes", 0) or 0)
 
 
+def _unique_nbytes(vals, seen: set) -> int:
+    """Bytes of device arrays nested in cache values, deduped by
+    identity: one replicated build array sits under BOTH its staging
+    key and its 'repc' re-placement key (device_put to an identical
+    sharding is the same object), and counting it twice would inflate
+    the buffer gauge by the whole build size."""
+    if isinstance(vals, (tuple, list)):
+        return sum(_unique_nbytes(x, seen) for x in vals)
+    if isinstance(vals, dict):
+        return sum(_unique_nbytes(x, seen) for x in vals.values())
+    if id(vals) in seen:
+        return 0
+    seen.add(id(vals))
+    return int(getattr(vals, "nbytes", 0) or 0)
+
+
 def _note_transfer(*arrays) -> None:
     """Host->device staging accounting on the dispatch hot path (one
     attribute read per array; the gauge feeds cluster_load and the
@@ -125,9 +141,10 @@ def _note_transfer(*arrays) -> None:
 def _device_telemetry_probe() -> None:
     buf = jit = 0
     for c in list(_LIVE_CLIENTS):
+        seen: set = set()
         with c._lock:
-            buf += sum(_obj_nbytes(v) for v in c._col_cache.values())
-            buf += sum(_obj_nbytes(v) for v in c._mask_cache.values())
+            buf += _unique_nbytes(list(c._col_cache.values()), seen)
+            buf += _unique_nbytes(list(c._mask_cache.values()), seen)
             jit += len(c._kernels)
     obs.DEVICE_BUFFER_BYTES.set(buf)
     obs.JIT_CACHE_ENTRIES.set(jit)
@@ -154,6 +171,10 @@ class CopClient:
     TILE_ROWS = TILE_ROWS_DEFAULT
 
     def __init__(self) -> None:
+        # per-thread placement state (the mesh client keeps its current
+        # shard/single mode and build-staging flag here; a client is
+        # shared by every session of a storage, so this must be TLS)
+        self._tls = threading.local()
         # (epoch_id, offset, bucket) -> (device data, device valid)
         self._col_cache: dict[tuple, tuple[Any, Any]] = {}
         # (epoch_id, bucket, digest) -> device visibility mask
@@ -193,6 +214,36 @@ class CopClient:
                 del self._mask_cache[k]
             for k in [k for k in self._stats if k[0] == old]:
                 del self._stats[k]
+
+    # ---- placement plane (overridden by the mesh client) -----------------
+    def placement_scope(self, snap):
+        """Context manager pinning this thread's placement decision for
+        one dispatch (engine.py opens it per plan node; the mesh client
+        decides shard-vs-single from the probe epoch here)."""
+        from contextlib import nullcontext
+        return nullcontext()
+
+    def _device_engine(self) -> str:
+        """EXPLAIN ANALYZE engine tag for single-table device paths."""
+        return "device"
+
+    def _frag_engine(self, mode: str) -> str:
+        return f"device[{mode}]"
+
+    def _partition_build(self, snap: TableSnapshot) -> bool:
+        """True when a join build side is too large to replicate and
+        should shard by key range (the hash-partition vs broadcast
+        exchange election; the mesh client also gates on bytes)."""
+        thr = self.partition_join_threshold
+        return thr is not None and snap.epoch.num_rows > thr
+
+    def _stage_key_suffix(self) -> tuple:
+        """Placement tag appended to staging cache keys. The dist client
+        returns ("rep",) while staging a broadcast build: one epoch can
+        be BOTH a sharded probe and a replicated build, and aliasing the
+        two placements under one key would pin a full replica on every
+        device and re-shard it per dispatch."""
+        return ()
 
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
@@ -237,7 +288,8 @@ class CopClient:
                         self._run_batch(dag, snap, prepared, overlay=True))
             if not chunks:
                 chunks = [self._empty_chunk(dag, snap)]
-            return CopResult(chunks, is_partial_agg=dag.agg is not None)
+            return CopResult(chunks, is_partial_agg=dag.agg is not None,
+                             engine=self._device_engine())
 
     # ==================== preparation (host-side resolution) ================
     def _col_stats(self, snap: TableSnapshot, off: int) -> Bound:
@@ -696,8 +748,7 @@ class CopClient:
                         data, self._col_stats(snap, off)), b)
                     pvalid = _pad_bool(vslice, b)
                     with obs.stage("transfer"):
-                        cached = self._place_cols(
-                            jnp.asarray(padded), jnp.asarray(pvalid))
+                        cached = self._place_cols(padded, pvalid)
                     _note_transfer(cached)
                     if cacheable:
                         with self._lock:
@@ -711,7 +762,7 @@ class CopClient:
             if vis is None:
                 pmask = _pad_bool(snap.base_visible[lo:lo + cnt], b)
                 with obs.stage("transfer"):
-                    vis = self._place_mask(jnp.asarray(pmask))
+                    vis = self._place_mask(pmask)
                 _note_transfer(vis)
                 if cacheable:
                     with self._lock:
@@ -719,12 +770,16 @@ class CopClient:
             tiles.append((dev_cols, vis, cnt))
         return tiles
 
-    # placement hooks: the distributed client shards tile rows over the mesh
+    # placement hooks: EVERY staged scan column/mask is created through
+    # these, and the PLACED arrays are what the caches hold — so the
+    # distributed client's row-sharded epochs stay device-resident across
+    # queries instead of being resharded per dispatch (host numpy in,
+    # device arrays out)
     def _place_cols(self, data, valid):
-        return data, valid
+        return jnp.asarray(data), jnp.asarray(valid)
 
     def _place_mask(self, mask):
-        return mask
+        return jnp.asarray(mask)
 
     def _stage_inputs(self, dag: CopDAG, snap: TableSnapshot, overlay: bool):
         """Pad + upload scan columns as 32-bit device buffers; returns device
@@ -745,14 +800,14 @@ class CopClient:
                 vfull = np.ones(n, bool) if valid is None else valid
                 host_cols.append((data, vfull))
                 with obs.stage("transfer"):
-                    dev_cols.append((
-                        jnp.asarray(_pad(narrow(data), b)),
-                        jnp.asarray(_pad_bool(vfull, b)),
-                    ))
+                    dev_cols.append(self._place_cols(
+                        _pad(narrow(data), b), _pad_bool(vfull, b)))
                 _note_transfer(dev_cols[-1])
             mask = np.zeros(b, bool)
             mask[:n] = True
-            return dev_cols, jnp.asarray(mask), host_cols, mask[:n]
+            with obs.stage("transfer"):
+                dev_mask = self._place_mask(mask)
+            return dev_cols, dev_mask, host_cols, mask[:n]
 
         epoch = snap.epoch
         n = epoch.num_rows
@@ -765,8 +820,9 @@ class CopClient:
                 == epoch.epoch_id
         dev_cols = []
         host_cols = []
+        sfx = self._stage_key_suffix()
         for off in offsets:
-            key = (epoch.epoch_id, off, b)
+            key = (epoch.epoch_id, off, b) + sfx
             data = epoch.columns[off]
             valid = epoch.valids[off]
             vfull = np.ones(n, bool) if valid is None else valid
@@ -778,7 +834,7 @@ class CopClient:
                     data, self._col_stats(snap, off)), b)
                 pvalid = _pad_bool(vfull, b)
                 with obs.stage("transfer"):
-                    cached = (jnp.asarray(padded), jnp.asarray(pvalid))
+                    cached = self._place_cols(padded, pvalid)
                 _note_transfer(cached)
                 if cacheable:
                     with self._lock:
@@ -787,22 +843,24 @@ class CopClient:
                 obs.COL_CACHE.inc(result="hit")
             dev_cols.append(cached)
             host_cols.append((data, vfull))
-        vis_key = (epoch.epoch_id, b, _mask_digest(snap.base_visible))
+        vis_digest = _mask_digest(snap.base_visible)
+        vis_key = (epoch.epoch_id, b, vis_digest) + sfx
         with self._lock:
             vis = self._mask_cache.get(vis_key)
         if vis is None:
             pmask = _pad_bool(snap.base_visible, b)
             with obs.stage("transfer"):
-                vis = jnp.asarray(pmask)
+                vis = self._place_mask(pmask)
             _note_transfer(vis)
             if cacheable:
                 with self._lock:
-                    # one live mask per (epoch, bucket): every delete/update
-                    # changes the digest, and stale masks would pin HBM
-                    # until the epoch is superseded
+                    # one live digest per (epoch, bucket): every delete/
+                    # update changes the digest, and stale masks would
+                    # pin HBM until the epoch is superseded (both
+                    # placements of the CURRENT digest stay live)
                     for k in [k for k in self._mask_cache
                               if k[:2] == (epoch.epoch_id, b)
-                              and k != vis_key]:
+                              and k[2] != vis_digest]:
                         del self._mask_cache[k]
                     self._mask_cache[vis_key] = vis
         return dev_cols, vis, host_cols, snap.base_visible
@@ -880,7 +938,8 @@ class CopClient:
                 devs.append(kern(cols, vis))
         with obs.stage("device_get", span_name="device.fetch"):
             outs = jax.device_get(devs)
-        out = _merge_tile_outs(outs, prepared["__agg_sched__"])
+        with obs.stage("merge"):
+            out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         group_dicts = [
             snap.dictionaries[dag.scan.col_offsets[g.idx]]
             if g.ftype.is_string and isinstance(g, Col) else None
@@ -1180,8 +1239,7 @@ def _merge_tile_outs(outs: list[dict], sched) -> dict:
         elif k.startswith("f"):
             merged[k] = np.concatenate(vals, axis=0)
         else:
-            merged[k] = np.sum(
-                np.stack([v.astype(np.int64) for v in vals]), axis=0)
+            merged[k] = SE.merge_additive(vals)
     return merged
 
 

@@ -66,25 +66,31 @@ def execute_fragment(cop: CopClient, frag: FragmentDAG, snaps: dict
                      ) -> CopResult:
     """snaps: table_id -> TableSnapshot for every fragment table."""
     from .. import obs
-    try:
-        with obs.span("copr.fragment") as sp:
-            if sp:
-                sp.note = f"{len(frag.tables)} tables"
-            r = _device_fragment(cop, frag, snaps)
-        obs.COPR_REQUESTS.inc(engine="device-fragment")
-        return r
-    except (_Fallback, CompileError, jax.errors.JaxRuntimeError) as e:
-        reason = getattr(e, "reason", None) or (
-            "device-oom" if "RESOURCE_EXHAUSTED" in str(e) else "compile")
-        obs.COPR_REQUESTS.inc(engine="host-fragment")
-        obs.FRAG_FALLBACKS.inc(reason=reason)
-        # the host interpreter's time is join work (the probe/gather/
-        # agg loop) — attribute it so the fallback path stays visible
-        # in the per-operator plane, not buried under "fragment"
-        with obs.operator("join"):
-            r = _host_fragment(frag, snaps)
-        r.engine = f"host(fragment:{reason})"
-        return r
+    # placement is decided by the PROBE (fact) epoch: a sharded probe
+    # makes this a mesh fragment (builds replicate or key-partition),
+    # a small probe keeps the whole tree on the single-device path
+    with cop.placement_scope(snaps[frag.tables[0].table.id]):
+        try:
+            with obs.span("copr.fragment") as sp:
+                if sp:
+                    sp.note = f"{len(frag.tables)} tables"
+                r = _device_fragment(cop, frag, snaps)
+            obs.COPR_REQUESTS.inc(engine="device-fragment")
+            return r
+        except (_Fallback, CompileError, jax.errors.JaxRuntimeError) as e:
+            reason = getattr(e, "reason", None) or (
+                "device-oom" if "RESOURCE_EXHAUSTED" in str(e) else
+                "compile")
+            obs.COPR_REQUESTS.inc(engine="host-fragment")
+            obs.FRAG_FALLBACKS.inc(reason=reason)
+            # the host interpreter's time is join work (the probe/
+            # gather/agg loop) — attribute it so the fallback path
+            # stays visible in the per-operator plane, not buried
+            # under "fragment"
+            with obs.operator("join"):
+                r = _host_fragment(frag, snaps)
+            r.engine = f"host(fragment:{reason})"
+            return r
 
 
 # ==================== device path ====================
@@ -162,8 +168,7 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     # One partitioned join per fragment; output must be merge-safe
     # partials (agg/hc), since routed rows lose probe-row identity.
     part_ji = None
-    part_thr = getattr(cop, "partition_join_threshold", None)
-    if part_thr is not None and frag.agg is not None and \
+    if frag.agg is not None and \
             getattr(cop, "frag_axis", None) is not None:
         n_probe_cols = len(frag.tables[0].col_offsets)
 
@@ -175,10 +180,13 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                 return e.idx < n_probe_cols
             return all(probe_prefix_only(a) for a in getattr(e, "args", ()))
 
+        # a build too large to replicate — by row count or by bytes
+        # (the mesh client's replicate-threshold-bytes) — shards by key
+        # range; the client decides (cop._partition_build)
         big = [(snaps[frag.tables[j.build].table.id].epoch.num_rows, ji)
                for ji, j in enumerate(frag.joins)
-               if snaps[frag.tables[j.build].table.id].epoch.num_rows
-               > part_thr and probe_prefix_only(j.probe_key)]
+               if cop._partition_build(snaps[frag.tables[j.build].table.id])
+               and probe_prefix_only(j.probe_key)]
         if big:
             part_ji = max(big)[1]
     prepared["__part_join__"] = part_ji
@@ -273,7 +281,7 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
     if not chunks:
         chunks = [_empty_chunk(frag, comb_dicts)]
     return CopResult(chunks, is_partial_agg=frag.agg is not None,
-                     engine=f"device[{mode}]")
+                     engine=cop._frag_engine(mode))
 
 
 def _mask_digest_of(mask):
@@ -460,7 +468,8 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
         outs = jax.device_get(devs)
 
     if mode == "agg":
-        out = _merge_tile_outs(outs, prepared["__agg_sched__"])
+        with obs.stage("merge"):
+            out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         return _decode_frag_agg(frag, snaps, prepared, out)
 
     # rows: per-tile packed bitmasks -> global epoch row indices
@@ -1188,20 +1197,11 @@ def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
         return _decode_hc_rows(frag, snaps, prepared, out, picked)
     # candidate blocks are per-exchange-partition (group spaces disjoint);
     # each partition's buffer must be verified independently
-    blocks = max(1, int(prepared.get("__hc_blocks__", 1)))
-    kb = len(picked) // blocks
-    for b in range(blocks):
-        pb = picked[b * kb:(b + 1) * kb]
-        if pb.all():
-            # more groups may exist beyond this partition's buffer: the
-            # result is sound only if the k-th best score strictly beats
-            # the buffer's worst (f32 scores order-embed the exact primary
-            # values, so a strict gap proves no non-candidate can reach
-            # the top-k; a tie at the boundary is ambiguous -> exact host)
-            score = out["score"][b * kb:(b + 1) * kb]
-            k = frag.hc.k
-            if k >= kb or not (score[k - 1] > score[-1]):
-                raise _Fallback("hc-boundary")
+    from . import hcagg as HC
+    if not HC.candidate_blocks_sound(
+            picked, out["score"], frag.hc.k,
+            prepared.get("__hc_blocks__", 1)):
+        raise _Fallback("hc-boundary")
     return _decode_hc_rows(frag, snaps, prepared, out, picked)
 
 

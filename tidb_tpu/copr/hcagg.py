@@ -106,6 +106,31 @@ def _suffix_min(s: jnp.ndarray) -> jnp.ndarray:
     return s
 
 
+def candidate_blocks_sound(picked: np.ndarray, score: np.ndarray,
+                           k: int, blocks: int) -> bool:
+    """Soundness check for fetched candidate buffers, per exchange
+    partition.
+
+    Candidate blocks are per-device (the mesh group-partition exchange
+    gives every device a disjoint slice of the group space; a single
+    device is one block). A block whose buffer is NOT exhausted proves
+    every group of its partition is a candidate. An exhausted block is
+    sound only if the k-th best score strictly beats the buffer's worst
+    — f32 scores order-embed the exact primary values, so a strict gap
+    proves no non-candidate can reach the top-k; a tie at the boundary
+    is ambiguous and the caller must fall back to the exact host path."""
+    blocks = max(1, int(blocks))
+    kb = len(picked) // blocks
+    for b in range(blocks):
+        pb = picked[b * kb:(b + 1) * kb]
+        if not pb.all():
+            continue
+        sb = score[b * kb:(b + 1) * kb]
+        if k >= kb or not (sb[k - 1] > sb[-1]):
+            return False
+    return True
+
+
 def segment_bounds(sorted_keys: list[jnp.ndarray], valid_row: jnp.ndarray):
     """(is_start, end_idx) for the sorted order. valid_row marks rows that
     belong to some group (dropped rows sorted to the end are False)."""

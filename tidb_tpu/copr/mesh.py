@@ -1,0 +1,535 @@
+"""Mesh plane: process-wide device mesh + placement-aware coprocessor.
+
+The multi-chip DATA plane (ROADMAP item 2). MULTICHIP_r05 showed 8
+devices visible while every fragment executed on one: the sharded
+client (parallel/dist.py) existed but nothing *chose* it, and it
+re-placed cached epochs onto the mesh on every dispatch. This module
+owns both decisions:
+
+* **MeshPlane** — one per process. Owns the 1-D device mesh
+  (`jax.sharding.Mesh` over the `shard` axis, SNIPPETS.md [1]-[3]
+  idiom), the placement policy, and the per-storage shared clients.
+  Configured from the server's `[mesh]` TOML section or the
+  `TIDB_TPU_MESH*` env knobs for embedded use.
+
+* **Placement policy** — per TABLE EPOCH, decided once per plan node
+  (executor/engine.py opens `placement_scope` around every dispatch):
+  - epochs with >= `shard-threshold-rows` rows shard on the row axis
+    (`NamedSharding(mesh, P('shard'))`) — the fact-table side;
+  - smaller epochs run the unchanged single-device path — sharding a
+    4k-row dimension table across 8 chips would pay collective latency
+    for no bandwidth;
+  - join build sides REPLICATE (broadcast exchange) unless bigger than
+    `replicate-threshold-bytes` or the row threshold, in which case
+    they shard by key range and probe rows route over the mesh
+    (hash-partition exchange, parallel/exchange.py). This mirrors the
+    reference's MPP broadcast-vs-hash-partition election
+    (planner/core/fragment.go:45).
+
+* **Persistent sharded residency** — staged columns are PLACED at
+  creation (client._place_cols) and the placed arrays are what the
+  epoch caches hold, so a sharded epoch stays device-resident across
+  queries and sessions; `tidb_device_transfer_bytes` stops paying a
+  re-shard per dispatch. DML that folds a new epoch invalidates the
+  old epoch's device buffers eagerly (Storage.add_epoch_listener).
+
+* **Graceful fallback** — `mesh.enabled = false`, a single visible
+  device, or a below-threshold table all take the EXACT single-device
+  path: `client_for` hands out a plain CopClient when the plane is
+  inactive, and MeshCopClient in `single` mode dispatches every hook
+  to the base implementations.
+
+Results are bit-identical to the single-device path by construction:
+the sharded kernels produce the same exact limb partials and merge
+with native-int32 collectives (parallel/dist.py docstring).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import obs
+from ..parallel.dist import AXIS, DistCopClient, make_mesh
+from .client import CopClient, _obj_nbytes
+
+
+@dataclass
+class MeshConfig:
+    """The `[mesh]` knobs (config.py MeshSection mirrors this)."""
+
+    enabled: bool = True
+    # devices in the mesh; 0 = every visible device
+    axis_size: int = 0
+    # epochs with at least this many rows shard on the row axis
+    shard_threshold_rows: int = 1 << 20
+    # join build sides larger than this stop replicating and shard by
+    # key range (probe rows then route over the exchange)
+    replicate_threshold_bytes: int = 64 << 20
+
+
+def epoch_nbytes(epoch) -> int:
+    """Host bytes of one columnar epoch (columns + validity lanes)."""
+    n = 0
+    for data, valid in zip(epoch.columns, epoch.valids):
+        n += int(data.nbytes)
+        if valid is not None:
+            n += int(valid.nbytes)
+    return n
+
+
+class MeshPlane:
+    """Process-wide mesh owner: device mesh, placement policy, shared
+    per-storage clients, and the per-device telemetry the gauges read."""
+
+    AXIS = AXIS
+
+    def __init__(self, cfg: Optional[MeshConfig] = None,
+                 devices=None) -> None:
+        self.cfg = cfg or MeshConfig()
+        self._devices = devices  # explicit device list (tests)
+        self._mesh = None
+        # RLock: client_for constructs clients (which read .mesh) under
+        # the same lock
+        self._lock = threading.RLock()
+        # storage -> shared MeshCopClient (weak: a collected Storage
+        # must release its device buffers with it)
+        import weakref
+        self._clients: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+
+    # ---- mesh lifecycle ---------------------------------------------------
+    @property
+    def mesh_built(self) -> bool:
+        return self._mesh is not None
+
+    @property
+    def mesh(self):
+        """The 1-D device mesh; building it initializes the backend, so
+        it stays lazy until the first active client asks."""
+        with self._lock:
+            if self._mesh is None:
+                devs = self._devices
+                if devs is None:
+                    import jax
+                    devs = jax.devices()
+                if self.cfg.axis_size > 0:
+                    devs = list(devs)[: self.cfg.axis_size]
+                self._mesh = make_mesh(devs)
+            return self._mesh
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size)
+
+    @property
+    def active(self) -> bool:
+        """Enabled AND more than one device. Checking device count
+        builds the mesh; a disabled plane never touches the backend."""
+        if not self.cfg.enabled:
+            return False
+        try:
+            return self.n_devices > 1
+        except Exception:  # noqa: BLE001 — no backend: single-device
+            return False
+
+    # ---- placement policy -------------------------------------------------
+    def placement_for(self, snap) -> str:
+        """'shard' | 'single' for one table snapshot. Per-EPOCH
+        deterministic (row count is fixed per epoch id), so staged-
+        array cache keys never see both placements for one epoch."""
+        if not self.active:
+            return "single"
+        if snap.epoch.num_rows >= self.cfg.shard_threshold_rows:
+            return "shard"
+        return "single"
+
+    # ---- shared clients ---------------------------------------------------
+    def client_for(self, storage) -> "MeshCopClient":
+        """The storage's shared mesh client: every session of a storage
+        uses ONE client, so sharded epochs persist across queries AND
+        connections, and a folded epoch can be evicted eagerly."""
+        with self._lock:
+            c = self._clients.get(storage)
+            if c is None:
+                c = MeshCopClient(self)
+                self._clients[storage] = c
+        # outside the plane lock: the listener hook takes storage-side
+        # structures only
+        if hasattr(storage, "add_epoch_listener"):
+            storage.add_epoch_listener(c.on_epoch_replaced)
+        return c
+
+    def clients(self) -> list:
+        with self._lock:
+            return list(self._clients.values())
+
+    # ---- telemetry --------------------------------------------------------
+    def device_bytes(self) -> dict[str, int]:
+        """Live device-resident bytes per device across this plane's
+        clients (sharded epochs count their shard; replicated builds
+        count a full copy per device — that is what pins HBM)."""
+        per: dict[str, int] = {}
+        if self.mesh_built:
+            for d in self._mesh.devices.flat:
+                per[str(d)] = 0
+        for c in self.clients():
+            for arr in _cached_arrays(c):
+                try:
+                    _add_shard_bytes(arr, per)
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+        return per
+
+    def status(self) -> dict:
+        """The /status `mesh` section (and the diag fan-out payload)."""
+        out = {
+            "enabled": self.cfg.enabled,
+            "built": self.mesh_built,
+            "devices": self.n_devices if self.mesh_built else 0,
+            "shard_threshold_rows": self.cfg.shard_threshold_rows,
+            "replicate_threshold_bytes":
+                self.cfg.replicate_threshold_bytes,
+        }
+        if self.mesh_built:
+            out["device_buffer_bytes"] = self.device_bytes()
+            out["reshard_bytes_total"] = obs.MESH_RESHARD_BYTES.get()
+        return out
+
+
+def _walk_arrays(o):
+    """Yield jax arrays nested in cache values (tuples/dicts/arrays)."""
+    if isinstance(o, (tuple, list)):
+        for x in o:
+            yield from _walk_arrays(x)
+    elif isinstance(o, dict):
+        for x in o.values():
+            yield from _walk_arrays(x)
+    elif hasattr(o, "addressable_shards"):
+        yield o
+
+
+def _cached_arrays(client):
+    """UNIQUE device arrays resident in a client's caches. The same
+    array can sit under two keys (a replicated build under its base
+    staging key AND its 'repc' re-placement key — jax.device_put to an
+    identical sharding shares buffers), so byte accounting dedupes by
+    identity or it would double-count every broadcast build."""
+    with client._lock:
+        vals = list(client._col_cache.values()) \
+            + list(client._mask_cache.values())
+    seen: set = set()
+    for arr in _walk_arrays(vals):
+        if id(arr) not in seen:
+            seen.add(id(arr))
+            yield arr
+
+
+def _add_shard_bytes(arr, per: dict) -> None:
+    """Accumulate one array's per-device resident bytes from its
+    addressable shards (the one walk device_bytes and
+    placement_report share)."""
+    for sh in arr.addressable_shards:
+        dev = str(sh.device)
+        per[dev] = per.get(dev, 0) + int(sh.data.nbytes)
+
+
+class MeshCopClient(DistCopClient):
+    """Placement-aware coprocessor client over a MeshPlane.
+
+    Every dispatch runs under a thread-local placement mode set by
+    `placement_scope` (engine.py opens it per plan node from the probe
+    snapshot). In `shard` mode the DistCopClient machinery applies —
+    row-sharded staging, shard_map kernels, collective merges, the
+    broadcast/partition join election. In `single` mode every hook
+    dispatches to the base CopClient implementation, so a small table
+    behaves EXACTLY as on one device (same kernels, same cache keys
+    modulo the mode prefix, same engine tags)."""
+
+    def __init__(self, plane: MeshPlane) -> None:
+        super().__init__(plane.mesh)
+        self.plane = plane
+        self._part_thr_rows = DistCopClient.partition_join_threshold
+
+    # ---- placement state ---------------------------------------------------
+    def _mode(self) -> str:
+        return getattr(self._tls, "mode", None) or "single"
+
+    def _sharded(self) -> bool:
+        return self._mode() == "shard"
+
+    @contextmanager
+    def _mode_scope(self, mode: str):
+        prev = getattr(self._tls, "mode", None)
+        self._tls.mode = mode
+        try:
+            yield
+        finally:
+            self._tls.mode = prev
+
+    def placement_scope(self, snap):
+        return self._mode_scope(self.plane.placement_for(snap))
+
+    def execute(self, dag, snap):
+        # direct callers (no engine scope): decide placement here
+        if getattr(self._tls, "mode", None) is None:
+            with self.placement_scope(snap):
+                return super().execute(dag, snap)
+        return super().execute(dag, snap)
+
+    # ---- storage integration ----------------------------------------------
+    def on_epoch_replaced(self, store) -> None:
+        """Eager invalidation on epoch fold (bulk load / compaction /
+        DDL rewrite): free the superseded epoch's device buffers NOW
+        instead of on the next dispatch — sharded epochs pin HBM on
+        every device."""
+        self._evict_stale(store.table.id, store.epoch.epoch_id)
+
+    # ---- engine tags -------------------------------------------------------
+    def _device_engine(self) -> str:
+        return f"device@mesh{self._n}" if self._sharded() else "device"
+
+    def _frag_engine(self, mode: str) -> str:
+        if self._sharded():
+            return f"device[{mode}]@mesh{self._n}"
+        return f"device[{mode}]"
+
+    # ---- mode-dispatched hooks --------------------------------------------
+    # kernels compiled for the two modes differ (shard_map vs plain jit)
+    # while their cache keys could coincide; the mode prefix keeps them
+    # apart
+    def _kernel(self, key, build):
+        return super()._kernel((self._mode(),) + tuple(key), build)
+
+    def _bucket_size(self, n: int) -> int:
+        if self._sharded():
+            return DistCopClient._bucket_size(self, n)
+        return CopClient._bucket_size(self, n)
+
+    def _place_cols(self, data, valid):
+        if self._sharded():
+            return DistCopClient._place_cols(self, data, valid)
+        return CopClient._place_cols(self, data, valid)
+
+    def _place_mask(self, mask):
+        if self._sharded():
+            return DistCopClient._place_mask(self, mask)
+        return CopClient._place_mask(self, mask)
+
+    def _build_agg_kernel(self, dag, prepared, cards, segments):
+        if self._sharded():
+            return DistCopClient._build_agg_kernel(
+                self, dag, prepared, cards, segments)
+        return CopClient._build_agg_kernel(
+            self, dag, prepared, cards, segments)
+
+    def _build_topn_kernel(self, dag, prepared, expr, desc, n):
+        if self._sharded():
+            return DistCopClient._build_topn_kernel(
+                self, dag, prepared, expr, desc, n)
+        return CopClient._build_topn_kernel(
+            self, dag, prepared, expr, desc, n)
+
+    def _build_rowmask_kernel(self, dag, prepared):
+        if self._sharded():
+            return DistCopClient._build_rowmask_kernel(self, dag, prepared)
+        return CopClient._build_rowmask_kernel(self, dag, prepared)
+
+    def _frag_jit(self, kernel, mode, prepared):
+        if not self._sharded():
+            return CopClient._frag_jit(self, kernel, mode, prepared)
+        fn = DistCopClient._frag_jit(self, kernel, mode, prepared)
+        routed = prepared.get("__part_join__") is not None or mode == "hc"
+        if not routed:
+            return fn
+
+        def counted(pcols, pvis, builds, *rest):
+            # rows cross the mesh inside the kernel (all_to_all); the
+            # collective itself is untimeable host-side, so account the
+            # routed payload bytes at dispatch
+            obs.MESH_RESHARD_BYTES.inc(
+                _obj_nbytes(pcols) + _obj_nbytes([pvis]))
+            return fn(pcols, pvis, builds, *rest)
+
+        return counted
+
+    def _stage_build_table(self, facade, snap):
+        if self._sharded():
+            return DistCopClient._stage_build_table(self, facade, snap)
+        return CopClient._stage_build_table(self, facade, snap)
+
+    def _place_build_array(self, arr, key=None):
+        if self._sharded():
+            return DistCopClient._place_build_array(self, arr, key)
+        return CopClient._place_build_array(self, arr, key)
+
+    def _hc_exchange_fn(self, frag, prepared):
+        if self._sharded():
+            return DistCopClient._hc_exchange_fn(self, frag, prepared)
+        return None
+
+    def _join_exchange_fn(self, frag, prepared, spans):
+        if self._sharded():
+            return DistCopClient._join_exchange_fn(
+                self, frag, prepared, spans)
+        return None
+
+    def _stage_partitioned_build(self, t, snap, lo, span, j):
+        # partitioned builds are only elected in shard mode
+        return DistCopClient._stage_partitioned_build(
+            self, t, snap, lo, span, j)
+
+    # ---- join build election ----------------------------------------------
+    @property
+    def partition_join_threshold(self):
+        return self._part_thr_rows if self._sharded() else None
+
+    @partition_join_threshold.setter
+    def partition_join_threshold(self, v) -> None:
+        self._part_thr_rows = v
+
+    def _partition_build(self, snap) -> bool:
+        if not self._sharded():
+            return False
+        if CopClient._partition_build(self, snap):
+            return True
+        return epoch_nbytes(snap.epoch) > \
+            self.plane.cfg.replicate_threshold_bytes
+
+    @property
+    def frag_axis(self):
+        return AXIS if self._sharded() else None
+
+    @property
+    def hc_exchange_blocks(self) -> int:
+        return self._n if self._sharded() else 1
+
+
+# ==================== process-wide plane ====================
+
+_PLANE: Optional[MeshPlane] = None
+_PLANE_LOCK = threading.Lock()
+
+
+def _env_config() -> MeshConfig:
+    """Embedded-use defaults: the `TIDB_TPU_MESH*` env knobs (server
+    processes override via config.seed_mesh from the [mesh] section)."""
+    import os
+
+    cfg = MeshConfig()
+    v = os.environ.get("TIDB_TPU_MESH")
+    if v is not None:
+        cfg.enabled = v not in ("0", "false", "off", "")
+    for env, attr in (("TIDB_TPU_MESH_DEVICES", "axis_size"),
+                      ("TIDB_TPU_MESH_SHARD_ROWS", "shard_threshold_rows"),
+                      ("TIDB_TPU_MESH_REPLICATE_BYTES",
+                       "replicate_threshold_bytes")):
+        raw = os.environ.get(env)
+        if raw:
+            try:
+                setattr(cfg, attr, int(raw))
+            except ValueError:
+                pass
+    return cfg
+
+
+def get_plane() -> MeshPlane:
+    global _PLANE
+    with _PLANE_LOCK:
+        if _PLANE is None:
+            _PLANE = MeshPlane(_env_config())
+        return _PLANE
+
+
+def configure(enabled: Optional[bool] = None,
+              axis_size: Optional[int] = None,
+              shard_threshold_rows: Optional[int] = None,
+              replicate_threshold_bytes: Optional[int] = None) -> MeshPlane:
+    """Replace the process plane (server startup / tests). Existing
+    sessions keep their clients; NEW sessions see the new policy."""
+    global _PLANE
+    cfg = _env_config()
+    if enabled is not None:
+        cfg.enabled = enabled
+    if axis_size is not None:
+        cfg.axis_size = axis_size
+    if shard_threshold_rows is not None:
+        cfg.shard_threshold_rows = shard_threshold_rows
+    if replicate_threshold_bytes is not None:
+        cfg.replicate_threshold_bytes = replicate_threshold_bytes
+    with _PLANE_LOCK:
+        _PLANE = MeshPlane(cfg)
+        return _PLANE
+
+
+def client_for(storage) -> CopClient:
+    """Default coprocessor client for a session over `storage`: the
+    storage's shared mesh client when the plane is active, else a fresh
+    single-device CopClient (exactly the pre-mesh behavior)."""
+    plane = get_plane()
+    if not plane.active:
+        return CopClient()
+    return plane.client_for(storage)
+
+
+def status() -> dict:
+    """The /status `mesh` section; never builds a mesh as a side
+    effect (a scrape must not grab the TPU)."""
+    with _PLANE_LOCK:
+        plane = _PLANE
+    if plane is None:
+        return {"enabled": _env_config().enabled, "built": False,
+                "devices": 0}
+    return plane.status()
+
+
+def placement_report(client: CopClient) -> dict:
+    """Per-device placement of a client's device-resident buffers —
+    the MULTICHIP board / bench flight payload: bytes per device (from
+    `arr.sharding` / `addressable_shards`), array counts by placement,
+    and an example shard spec."""
+    per: dict[str, int] = {}
+    n_sharded = n_replicated = n_single = 0
+    shard_spec = None
+    for arr in _cached_arrays(client):
+        try:
+            s = arr.sharding
+            devs = s.device_set
+            _add_shard_bytes(arr, per)
+            if len(devs) <= 1:
+                n_single += 1
+            elif s.is_fully_replicated:
+                n_replicated += 1
+            else:
+                n_sharded += 1
+                if shard_spec is None:
+                    shard_spec = str(getattr(s, "spec", s))
+        except Exception:  # noqa: BLE001 — report what we can
+            continue
+    return {"device_bytes": per, "sharded_arrays": n_sharded,
+            "replicated_arrays": n_replicated,
+            "single_arrays": n_single, "shard_spec": shard_spec}
+
+
+# ---- per-device gauge probe (run before every /metrics scrape and
+# metrics-history sample; passes obs.lint_metrics via the registered
+# family help texts in obs.py) ------------------------------------------------
+
+def _mesh_telemetry_probe() -> None:
+    with _PLANE_LOCK:
+        plane = _PLANE
+    if plane is None or not plane.mesh_built:
+        return
+    obs.MESH_DEVICES.set(plane.n_devices)
+    for dev, b in plane.device_bytes().items():
+        obs.DEVICE_BUFFER_BYTES.set(b, device=dev)
+
+
+obs.register_gauge_probe(_mesh_telemetry_probe)
+
+
+__all__ = ["MeshConfig", "MeshPlane", "MeshCopClient", "epoch_nbytes",
+           "get_plane", "configure", "client_for", "status",
+           "placement_report"]

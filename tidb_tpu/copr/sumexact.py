@@ -132,6 +132,17 @@ def make_one_hot(seg: jnp.ndarray, segments: int) -> jnp.ndarray:
     return jax.nn.one_hot(seg2, segments, dtype=jnp.float32)
 
 
+def merge_additive(vals) -> np.ndarray:
+    """Sum per-tile / per-shard additive partials host-side in int64.
+
+    Limb partials are exact under addition but hi/lo sums can exceed
+    int32 once many tiles (or mesh shards fetched without a device psum)
+    merge — so the host merge widens first. Shared by the tiled single-
+    table path and the mesh plane's host-side partial merge."""
+    return np.sum(np.stack([np.asarray(v).astype(np.int64) for v in vals]),
+                  axis=0)
+
+
 def combine_partials(p: np.ndarray) -> np.ndarray:
     """int32[n_limbs, 2, segments] -> int64[segments], exact.
 

@@ -205,7 +205,12 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         if plan.dag.scan.table_id < 0:
             return Chunk([])  # dual pseudo-table: one conceptual row, no cols
         snap = ctx.txn.snapshot(plan.dag.scan.table_id)
-        result = ctx.cop.execute(plan.dag, snap)
+        # placement-aware dispatch: the engine pins the mesh placement
+        # (shard the epoch over the device mesh vs single-device) for
+        # this node from the snapshot it just took, so every staging/
+        # kernel decision below sees one consistent answer
+        with ctx.cop.placement_scope(snap):
+            result = ctx.cop.execute(plan.dag, snap)
         if engine_tag is not None:
             engine_tag[0] = result.engine
         out = Chunk.concat(result.chunks) if result.chunks else \

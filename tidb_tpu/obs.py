@@ -764,6 +764,20 @@ JIT_CACHE_ENTRIES = PROCESS_METRICS.gauge(
 PROCESS_RSS_BYTES = PROCESS_METRICS.gauge(
     "tidb_process_rss_bytes", "resident set size of this process")
 
+# mesh plane telemetry (copr/mesh.py): ONE device mesh per process. The
+# devices gauge reports the active mesh width (1 = single-device path);
+# per-device buffer bytes ride the existing tidb_device_buffer_bytes
+# family with a {device} label (the unlabeled sample stays the
+# process-wide total); reshard bytes count replication broadcasts,
+# partitioned-build staging and exchange routing over the mesh axis
+MESH_DEVICES = PROCESS_METRICS.gauge(
+    "tidb_mesh_devices",
+    "devices in the process-wide coprocessor mesh (1 = single-device)")
+MESH_RESHARD_BYTES = PROCESS_METRICS.counter(
+    "tidb_mesh_reshard_bytes_total",
+    "bytes moved across mesh devices by build replication, partitioned "
+    "build staging and exchange routing")
+
 # probes recomputing the sampled gauges (device buffer bytes, jit cache
 # entries, RSS) from live state; run by MetricsHistory.sample_now() and
 # the /metrics scrape path so the gauges are current at read time

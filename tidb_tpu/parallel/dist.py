@@ -27,7 +27,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..copr.client import CopClient
+
+try:  # jax >= 0.5 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: the experimental home
+    from jax.experimental.shard_map import shard_map
 
 AXIS = "shard"
 
@@ -62,7 +68,7 @@ class DistCopClient(CopClient):
 
         # every output is replicated post-collective; a single P() acts
         # as a pytree prefix matching every leaf of the output dict
-        mapped = jax.shard_map(
+        mapped = shard_map(
             sharded,
             mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS)),
@@ -80,27 +86,40 @@ class DistCopClient(CopClient):
         lcm = int(np.lcm(256, 8 * self._n))
         return -(-b // lcm) * lcm
 
-    def _stage_inputs(self, dag, snap, overlay: bool):
-        cols, row_mask, host_cols, host_mask = super()._stage_inputs(
-            dag, snap, overlay)
-        n = row_mask.shape[0]
-        assert n % self._n == 0, f"bucket {n} vs mesh {self._n}"
-        sharding = NamedSharding(self.mesh, P(AXIS))
-        cols = [
-            (jax.device_put(d, sharding), jax.device_put(v, sharding))
-            for d, v in cols
-        ]
-        row_mask = jax.device_put(row_mask, sharding)
-        return cols, row_mask, host_cols, host_mask
+    # staging placement: scan columns/masks shard on the rows axis at
+    # CREATION time and the sharded arrays are what the caches hold, so
+    # epochs stay device-resident across queries (re-placing per dispatch
+    # was a mesh-wide transfer per fragment run). Build-table staging
+    # (the TLS flag below) places REPLICATED instead — the broadcast-join
+    # side every device gathers from. The placed arrays work for tiles
+    # too: each TILE_ROWS slice is scanned by all devices.
+    def _scan_sharding(self):
+        if getattr(self._tls, "place_build", False):
+            return NamedSharding(self.mesh, P())
+        return NamedSharding(self.mesh, P(AXIS))
 
-    # tile placement: shard every tile's rows axis over the mesh (tiles
-    # and shards compose — each TILE_ROWS slice is scanned by all devices)
+    def _note_broadcast(self, *arrays) -> None:
+        """Replicating build arrays copies them to every other device —
+        the dominant reshard-traffic component; counted HERE because
+        placement happens at creation (the later _replicated() re-place
+        is an identity and cannot see the broadcast)."""
+        if getattr(self._tls, "place_build", False):
+            n = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
+            obs.MESH_RESHARD_BYTES.inc(n * max(self._n - 1, 1))
+
     def _place_cols(self, data, valid):
-        sharding = NamedSharding(self.mesh, P(AXIS))
-        return jax.device_put(data, sharding), jax.device_put(valid, sharding)
+        sharding = self._scan_sharding()
+        build = getattr(self._tls, "place_build", False)
+        with obs.stage("reshard" if build else "shard"):
+            self._note_broadcast(data, valid)
+            return (jax.device_put(data, sharding),
+                    jax.device_put(valid, sharding))
 
     def _place_mask(self, mask):
-        return jax.device_put(mask, NamedSharding(self.mesh, P(AXIS)))
+        build = getattr(self._tls, "place_build", False)
+        with obs.stage("reshard" if build else "shard"):
+            self._note_broadcast(mask)
+            return jax.device_put(mask, self._scan_sharding())
 
     # ---- fragment placement: probe shards, build tables replicate ------
     # (broadcast-join placement — the MPP broadcast exchange mode,
@@ -160,18 +179,19 @@ class DistCopClient(CopClient):
         present[pos] = True
         sharding = NamedSharding(self.mesh, P(AXIS))
         bykey = []
-        for off in t.col_offsets:
-            data = np.zeros(span_pad, dtype=_narrow(
-                epoch.columns[off][:0]).dtype)
-            data[pos] = _narrow(epoch.columns[off][idx])
-            v = epoch.valids[off]
-            valid = present.copy()
-            if v is not None:
-                valid[pos] = v[idx]
-            bykey.append((jax.device_put(jnp.asarray(data), sharding),
-                          jax.device_put(jnp.asarray(valid), sharding)))
-        build = {"bykey": bykey,
-                 "present": jax.device_put(jnp.asarray(present), sharding)}
+        with obs.stage("shard"):
+            for off in t.col_offsets:
+                data = np.zeros(span_pad, dtype=_narrow(
+                    epoch.columns[off][:0]).dtype)
+                data[pos] = _narrow(epoch.columns[off][idx])
+                v = epoch.valids[off]
+                valid = present.copy()
+                if v is not None:
+                    valid[pos] = v[idx]
+                bykey.append((jax.device_put(data, sharding),
+                              jax.device_put(valid, sharding)))
+            build = {"bykey": bykey,
+                     "present": jax.device_put(present, sharding)}
         if cacheable:
             with self._lock:
                 self._col_cache[ck] = build
@@ -235,9 +255,22 @@ class DistCopClient(CopClient):
 
         return route
 
+    def _stage_key_suffix(self):
+        # builds cache under a distinct placement namespace: one epoch
+        # can be a sharded probe AND a replicated broadcast build
+        return ("rep",) if getattr(self._tls, "place_build", False) else ()
+
     def _stage_build_table(self, facade, snap):
-        cols, vis, host_cols, host_mask = CopClient._stage_inputs(
-            self, facade, snap, overlay=False)
+        # build columns place REPLICATED at creation (broadcast-join
+        # side) under "rep"-suffixed staging keys; the _replicated()
+        # re-placement below is then a no-copy identity, and the repc
+        # keys keep the epoch-led eviction story
+        self._tls.place_build = True
+        try:
+            cols, vis, host_cols, host_mask = CopClient._stage_inputs(
+                self, facade, snap, overlay=False)
+        finally:
+            self._tls.place_build = False
         b = vis.shape[0]
         eid = snap.epoch.epoch_id
         with self._lock:
@@ -271,7 +304,13 @@ class DistCopClient(CopClient):
             hit = self._col_cache.get(key)
         if hit is not None:
             return hit
-        placed = jax.device_put(arr, NamedSharding(self.mesh, P()))
+        with obs.stage("reshard"):
+            placed = jax.device_put(arr, NamedSharding(self.mesh, P()))
+        if getattr(arr, "sharding", None) != placed.sharding:
+            # a real broadcast (not an identity re-place): every other
+            # device receives a full copy over the mesh links
+            obs.MESH_RESHARD_BYTES.inc(
+                int(getattr(arr, "nbytes", 0)) * max(self._n - 1, 1))
         if cacheable:
             with self._lock:
                 self._col_cache[key] = placed
@@ -288,7 +327,7 @@ class DistCopClient(CopClient):
             def merged(pcols, pvis, builds):
                 return _collective_merge(kernel(pcols, pvis, builds), sched)
 
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 merged, mesh=self.mesh,
                 in_specs=(P(AXIS), P(AXIS), build_specs),
                 out_specs=P())
@@ -304,14 +343,14 @@ class DistCopClient(CopClient):
                 specs[f"cnt{ai}"] = P(None, None, AXIS)
                 for ti in range(len(s.get("terms", ()))):
                     specs[f"s{ai}_{ti}"] = P(None, None, AXIS)
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 kernel, mesh=self.mesh,
                 in_specs=(P(AXIS), P(AXIS), build_specs),
                 out_specs=specs)
             return jax.jit(mapped)
         # row mode: per-shard packed bitmask; shards are 256-multiples so
         # byte boundaries align and concatenation is the global mask
-        mapped = jax.shard_map(
+        mapped = shard_map(
             kernel, mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS), build_specs),
             out_specs=P(AXIS))
@@ -332,7 +371,7 @@ class DistCopClient(CopClient):
     # ---- TopN: local top-k per shard, host merge ------------------------
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):
         raw = self._topn_body(dag, prepared, expr, desc, n)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             raw, mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS)),
             # per-shard candidate columns concatenate along the k axis;
@@ -342,7 +381,7 @@ class DistCopClient(CopClient):
 
     def _build_rowmask_kernel(self, dag, prepared):
         raw = self._rowmask_body(dag, prepared)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             raw, mesh=self.mesh,
             in_specs=(P(AXIS), P(AXIS)),
             out_specs=P(AXIS))

@@ -138,7 +138,7 @@ class DiagService:
         for reg in (self.storage.obs.metrics, obs.PROCESS_METRICS):
             for name, v in reg.flat_samples():
                 dev = name.startswith(("tidb_device_", "tidb_jit_",
-                                       "tidb_copr_"))
+                                       "tidb_copr_", "tidb_mesh_"))
                 rows.append(["device" if dev else "host", name,
                              float(v)])
         return {"rows": rows}

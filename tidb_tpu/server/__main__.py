@@ -203,9 +203,11 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_sysvars(storage)
     # arm the attribution/event plane (Top SQL, event ring, metrics
     # history) and the overload-protection plane (memory governor,
-    # execution admission gate) from the [performance] knobs
+    # execution admission gate) from the [performance] knobs, and the
+    # process-wide device-mesh plane from the [mesh] knobs
     cfg.seed_observability(storage)
     cfg.seed_overload_protection(storage)
+    cfg.seed_mesh()
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
                  max_connections=cfg.effective_max_connections(),

@@ -79,6 +79,14 @@ class StatusServer:
                         gov = getattr(st, "governor", None)
                         if gov is not None:
                             status["governor"] = gov.stats()
+                    # mesh data plane: device count + per-device
+                    # sharded-epoch bytes (never grabs a backend as a
+                    # scrape side effect — copr/mesh.status is lazy)
+                    try:
+                        from ..copr import mesh as _mesh
+                        status["mesh"] = _mesh.status()
+                    except Exception:  # noqa: BLE001 — scrape survives
+                        pass
                     # top digests by device time from the continuous
                     # attribution plane (empty while topsql disabled)
                     status["top_sql"] = {

@@ -88,7 +88,12 @@ class Session:
         self.storage = storage if storage is not None else Storage()
         self.catalog: Catalog = self.storage.catalog
         self.current_db = db
-        self.cop = cop if cop is not None else CopClient()
+        # default coprocessor resolves LAZILY at first access (the first
+        # statement that builds an ExecContext): the mesh plane's active
+        # check counts devices, which initializes the JAX backend — a
+        # session doing metadata-only work must not grab the TPU at
+        # construction time
+        self._cop: Optional[CopClient] = cop
         self._prepared: dict[int, tuple] = {}
         self._next_stmt_id = 0
         self.txn: Optional[Transaction] = None
@@ -158,6 +163,23 @@ class Session:
         # _governor_kill must be atomic or a late callback could flag
         # the session's NEXT statement
         self._gov_lock = threading.Lock()
+
+    @property
+    def cop(self) -> CopClient:
+        """Coprocessor client, resolved on first use: the storage's
+        SHARED mesh client when the process mesh plane is active (>1
+        device + enabled) so sharded epochs stay device-resident across
+        sessions, else a plain per-session CopClient (exact pre-mesh
+        behavior). Lazy because the plane's active check initializes
+        the JAX backend."""
+        if self._cop is None:
+            from ..copr import mesh as _mesh
+            self._cop = _mesh.client_for(self.storage)
+        return self._cop
+
+    @cop.setter
+    def cop(self, client: Optional[CopClient]) -> None:
+        self._cop = client
 
     def add_warning(self, message: str, code: int = 1105,
                     level: str = "Warning") -> None:
@@ -1306,9 +1328,8 @@ class Session:
             if other is not None:
                 store.dictionaries = \
                     self.storage.table_store(other.id).dictionaries
-            if self.storage.path is not None:
-                store.on_epoch = self.storage._on_epoch_changed
             self.storage.tables[d.id] = store
+            self.storage.adopt_table_store(store)
             if d.id == part.defs[0].id:
                 store._next_handle = alloc
             self.catalog.bump_version()
@@ -1998,13 +2019,20 @@ class Session:
         secure_file_priv (when set) confines paths to that directory —
         both per MySQL (reference: planner visitInfo FILE checks;
         executor/load_data.go / select_into.go)."""
-        import os
         if self.user is not None and not self.storage.privileges.check(
                 self.user, "FILE", "*", "*", roles=self.active_roles):
             raise SQLError(
                 "Access denied; you need (at least one of) the FILE "
                 f"privilege(s) for this operation (user '{self.user}')",
                 errno=ER_SPECIFIC_ACCESS_DENIED)
+        self._confine_secure_path(path)
+
+    def _confine_secure_path(self, path: str) -> None:
+        """secure_file_priv confinement (when set) — applied to EVERY
+        server-side file read/write, including opted-in LOAD DATA LOCAL
+        (whose read is server-side here, unlike MySQL's client-side
+        transfer, so the confinement must still hold)."""
+        import os
         base = str(self._sysvar_value("secure_file_priv") or "")
         if base and not os.path.realpath(path).startswith(
                 os.path.realpath(base) + os.sep):
@@ -2019,19 +2047,46 @@ class Session:
         partition routing and indexes all apply (reference:
         executor/load_data.go; TiDB too batches through the txn layer)."""
         import os
-        if stmt.local:
-            # the client-side file transfer (COM_QUERY LOCAL INFILE
-            # sub-protocol) is not implemented; silently reading a
-            # SERVER-side path instead would be both surprising and a
-            # privilege escalation for FILE-less users
+        if stmt.local and not self._sysvar_value("local_infile"):
+            # without the explicit local_infile opt-in (config
+            # local-infile / SET GLOBAL local_infile=1) LOCAL keeps the
+            # typed rejection: the COM_QUERY LOCAL INFILE wire transfer
+            # is not implemented, and silently reading a SERVER-side
+            # path would be both surprising and a privilege escalation
+            # for FILE-less users
             raise SQLError(
-                "LOAD DATA LOCAL INFILE is not supported; use "
-                "server-side LOAD DATA INFILE",
+                "LOAD DATA LOCAL INFILE is not supported (enable the "
+                "local_infile system variable / local-infile config to "
+                "accept it); use server-side LOAD DATA INFILE",
                 errno=ER_NOT_SUPPORTED_YET)
         info, store = self._table_for(stmt.table)
         col_order = self._insert_columns(info, stmt.columns)
         path = stmt.fmt.path
-        self._require_file_priv(path)
+        if not stmt.local:
+            self._require_file_priv(path)
+        else:
+            # LOCAL (opted in): MySQL's LOCAL reads the CLIENT's own
+            # file, but THIS implementation reads a server-side path —
+            # so an authenticated user must bring either the FILE
+            # privilege or a configured secure_file_priv confinement
+            # (otherwise the LOCAL spelling would hand every FILE-less
+            # user the server's filesystem). Embedded sessions
+            # (user=None) are unchecked, as everywhere. Duplicate-key
+            # errors degrade to IGNORE unless REPLACE was given
+            # (reference: executor/load_data.go — LOCAL cannot abort a
+            # half-streamed file).
+            confined = bool(
+                str(self._sysvar_value("secure_file_priv") or ""))
+            if not confined and self.user is not None and \
+                    not self.storage.privileges.check(
+                        self.user, "FILE", "*", "*",
+                        roles=self.active_roles):
+                raise SQLError(
+                    "LOAD DATA LOCAL INFILE reads a server-side path "
+                    "on this server; grant FILE or set "
+                    "secure_file_priv to confine it",
+                    errno=ER_SPECIFIC_ACCESS_DENIED)
+            self._confine_secure_path(path)
         if not os.path.isfile(path):
             raise SQLError(f"File '{path}' not found",
                            errno=ER_FILE_NOT_FOUND)
@@ -2053,8 +2108,10 @@ class Session:
             rows.append(vals)
         shim = ast.InsertStmt(stmt.table, stmt.columns,
                               is_replace=stmt.dup_mode == "replace")
+        ignore = stmt.dup_mode == "ignore" or (
+            stmt.local and stmt.dup_mode != "replace")
         return self._exec_insert(shim, rows_override=rows,
-                                 load_ignore=stmt.dup_mode == "ignore")
+                                 load_ignore=ignore)
 
     def _write_outfile(self, rs: ResultSet, fmt) -> ResultSet:
         """SELECT ... INTO OUTFILE (reference: executor/select_into.go).

@@ -110,6 +110,11 @@ _VARS = [
     _v("tidb_auto_analyze_ratio", 0.5, scope=SCOPE_GLOBAL),
     # ---- file / transport security ------------------------------------
     _v("secure_file_priv", "", scope=SCOPE_GLOBAL, read_only=True),
+    # LOAD DATA LOCAL INFILE opt-in: OFF keeps the typed 1235 rejection
+    # (no wire sub-protocol). ON accepts LOCAL as a SERVER-side read:
+    # authenticated users need FILE or a configured secure_file_priv
+    # (which always confines the path); dup errors degrade to IGNORE
+    _v("local_infile", 0, scope=SCOPE_GLOBAL),
     _v("require_secure_transport", 0, scope=SCOPE_GLOBAL),
     _v("ssl_ca", "", scope=SCOPE_GLOBAL, read_only=True),
     _v("ssl_cert", "", scope=SCOPE_GLOBAL, read_only=True),

@@ -227,6 +227,10 @@ class Storage:
             self._tso_lease = self._read_tso_lease()
         self.stats = StatsHandle()
         self.tables: dict[int, TableStore] = {}
+        # epoch-replacement listeners attached to every (current and
+        # future) TableStore — the mesh plane registers its shared
+        # client here so a folded epoch's device buffers free eagerly
+        self._epoch_listeners: list = []
         # the transactional KV truth: percolator MVCC over regions
         if self.remote:
             # socket follower: the engine mirrors the leader's WAL over
@@ -398,8 +402,7 @@ class Storage:
             return self._register_partitioned(info, part)
         store = TableStore(info)
         self.tables[info.id] = store
-        if self.path is not None:
-            store.on_epoch = self._on_epoch_changed
+        self.adopt_table_store(store)
         # one region per table (reference: split-table-region on create,
         # ddl/split_region.go) — multi-table commits become multi-region
         try:
@@ -424,8 +427,7 @@ class Storage:
             else:
                 store.dictionaries = shared_dicts
             self.tables[d.id] = store
-            if self.path is not None:
-                store.on_epoch = self._on_epoch_changed
+            self.adopt_table_store(store)
             try:
                 self.rm.split(tablecodec.table_prefix(d.id))
             except ValueError:
@@ -434,6 +436,30 @@ class Storage:
                 first = store
         assert first is not None
         return first
+
+    def adopt_table_store(self, store: TableStore) -> None:
+        """Wire a (possibly externally constructed) TableStore into this
+        storage's epoch plumbing: the durable-snapshot hook and the
+        eager-eviction listeners. EVERY TableStore that lands in
+        self.tables must pass through here (register_table, partition
+        registration, TRUNCATE PARTITION's fresh store) or the mesh
+        plane would never see that table's epoch folds."""
+        if self.path is not None:
+            store.on_epoch = self._on_epoch_changed
+        for fn in self._epoch_listeners:
+            if fn not in store.evict_hooks:
+                store.evict_hooks.append(fn)
+
+    def add_epoch_listener(self, fn) -> None:
+        """Attach `fn(store)` to fire after every base-epoch
+        replacement of every table (current and future); idempotent
+        per listener. The mesh plane's eager device-buffer eviction."""
+        if fn in self._epoch_listeners:
+            return
+        self._epoch_listeners.append(fn)
+        for store in list(self.tables.values()):
+            if fn not in store.evict_hooks:
+                store.evict_hooks.append(fn)
 
     @staticmethod
     def child_table_info(info: TableInfo, d) -> TableInfo:

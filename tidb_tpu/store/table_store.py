@@ -260,10 +260,20 @@ class TableStore:
         # the committing session on an O(table) file write
         self.on_epoch = None
         self.epoch_dirty = False
+        # eager-eviction hooks: fired on every base-epoch replacement so
+        # device-resident caches (the mesh plane's sharded epochs pin
+        # HBM on EVERY device) free the superseded epoch's buffers now,
+        # not on the next dispatch (Storage.add_epoch_listener attaches)
+        self.evict_hooks: list = []
 
     def _epoch_changed(self, required: bool = True) -> None:
         if self.on_epoch is not None:
             self.on_epoch(self, required)
+        for fn in list(self.evict_hooks):
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — cache eviction must
+                pass           # never fail the committing session
 
     def restore_epoch(self, epoch: ColumnEpoch,
                       dictionaries: list[Optional[Dictionary]],
