@@ -780,6 +780,11 @@ def flight_multichip(res: dict) -> None:
     check_q1(mesh.query(TPCH_Q1), arrays)
     log("multichip digests OK (mesh == single == oracle); timing")
 
+    # flight-recorder snapshot rides the result: per-query skew +
+    # per-operator max-shard share, per-device bytes, exchange totals —
+    # MULTICHIP_r06+ records placement QUALITY, not just rows/s
+    from tidb_tpu import obs as _obs
+    mesh_info: dict = {"devices": n_dev, "queries": {}}
     for name, sql in (("q6", TPCH_Q6), ("q1", TPCH_Q1)):
         ts_s = times(lambda s=sql: single.query(s), repeat)
         ts_m = times(lambda s=sql: mesh.query(s), repeat)
@@ -788,10 +793,17 @@ def flight_multichip(res: dict) -> None:
         _, rps_m = report(f"{name}_mesh", ts_m, n)
         res["values"][f"{name}_single_1dev"] = rps_s
         res["values"][f"{name}_mesh_{n_dev}dev"] = rps_m
+        om = mesh.last_op_mesh
+        skew = max((v[1] for v in om.values()), default=0.0)
+        mesh_info["queries"][name] = {
+            "skew": round(skew, 3),
+            "op_shares": {k: round(v[0], 4) for k, v in om.items()},
+        }
         lines.append(
             f"multichip {name}: single-device "
             f"{rps_s / 1e6:.1f}M rows/s vs {n_dev}-device mesh "
-            f"{rps_m / 1e6:.1f}M rows/s ({rps_m / rps_s:.2f}x)")
+            f"{rps_m / 1e6:.1f}M rows/s ({rps_m / rps_s:.2f}x), "
+            f"skew={skew:.2f}")
 
     rep = M.placement_report(mesh.cop)
     lines.append(
@@ -803,6 +815,16 @@ def flight_multichip(res: dict) -> None:
                      f"{rep['device_bytes'][dev]} bytes")
     res["values"]["mesh_device_bytes"] = rep["device_bytes"]
     res["values"]["mesh_sharded_arrays"] = rep["sharded_arrays"]
+    mesh_info["device_bytes"] = rep["device_bytes"]
+    mesh_info["device_peak_bytes"] = plane.device_peak_bytes()
+    mesh_info["reshard_bytes_total"] = _obs.MESH_RESHARD_BYTES.get()
+    # bounded dispatch ring: digest, kind, op, dispatches, shards,
+    # last per-shard rows, skew, exchange routing bytes
+    mesh_info["dispatches"] = mesh.cop.recorder.snapshot()["dispatches"]
+    res["mesh"] = mesh_info
+    lines.append(
+        f"multichip exchange: "
+        f"{int(mesh_info['reshard_bytes_total'])} reshard bytes total")
 
 
 FLIGHTS = {

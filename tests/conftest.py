@@ -11,6 +11,7 @@ backend has been initialized yet at conftest import time.
 """
 
 import os
+import threading
 import time
 
 import jax
@@ -114,6 +115,14 @@ def _no_orphans_or_leaked_listeners(request):
         before_children = _child_pids()
         before_listen = _listen_inodes()
     yield
+    # the mesh flight recorder is contractually thread-free (bounded
+    # rings drained on the statement path, no background sampler); a
+    # titpu-mesh* thread appearing anywhere means that contract broke
+    mesh_threads = [t.name for t in threading.enumerate()
+                    if t.name.startswith("titpu-mesh") and t.is_alive()]
+    if mesh_threads:
+        pytest.fail("mesh flight recorder leaked background threads: "
+                    f"{mesh_threads}")
     # daemonic teardown (accept threads, reaped children) needs a
     # moment; only what SURVIVES the grace window is a leak.
     # multiprocessing's resource/semaphore trackers are process-lifetime

@@ -82,6 +82,39 @@ def test_lint_accepts_histogram_exposition():
     assert obs.lint_metrics([reg]) == []
 
 
+def test_lint_flags_unbounded_device_label_cardinality():
+    """Any `device`/`shard` label family must stay bounded by the mesh
+    size — per-device telemetry must never become per-request
+    cardinality (the classic Prometheus blow-up)."""
+    reg = Registry()
+    g = reg.gauge("tidb_mesh_thing_bytes", "per-device thing")
+    for i in range(9):
+        g.set(float(i), device=f"TPU_{i}")
+    findings = obs.lint_metrics([reg], device_label_cap=8)
+    assert any("cardinality" in f and "device" in f
+               for f in findings), findings
+    # at or under the mesh size the same family is clean
+    assert obs.lint_metrics([reg], device_label_cap=9) == []
+    # shard labels are held to the same cap
+    c = reg.counter("tidb_mesh_shard_rows_total", "per-shard rows")
+    for i in range(3):
+        c.inc(shard=str(i))
+    assert obs.lint_metrics([reg], device_label_cap=9) == []
+    findings = obs.lint_metrics([reg], device_label_cap=2)
+    assert any("tidb_mesh_shard_rows_total" in f for f in findings)
+
+
+def test_lint_default_cap_tracks_mesh_size():
+    """Without an explicit cap the lint uses the live mesh width
+    (floor 8), so an 8-device conftest run accepts 8 device labels."""
+    reg = Registry()
+    g = reg.gauge("tidb_mesh_dev_bytes", "per-device")
+    for i in range(8):
+        g.set(1.0, device=f"d{i}")
+    findings = obs.lint_metrics([reg])
+    assert not any("cardinality" in f for f in findings), findings
+
+
 def test_registry_type_conflict_still_raises():
     # duplicate registration under a DIFFERENT type stays a hard error
     # at registration time (lint guards the cross-registry case)

@@ -72,7 +72,7 @@ def test_trace_stage_sum_matches_explain_analyze_wall():
     tk.must_query(Q6)  # warm
     rs = tk.session.execute("explain analyze " + Q6)
     assert rs.column_names == ["plan", "actRows", "time_ms", "engine",
-                               "stages"]
+                               "stages", "mesh"]
     root = rs.rows[0]
     leaf = next(r for r in rs.rows if "TableRead" in r[0])
     assert "device" in leaf[3]
@@ -393,5 +393,13 @@ def test_debug_routes_trace_and_profile():
             timeout=10).read())
         assert prof["hz"] == 200 and "tree" in prof
         assert _profiler_threads() == []
+        # /debug/mesh: the flight-recorder payload is always servable
+        # (plane status + dispatch/compile rings + HBM ledger), and a
+        # scrape never fails even with the plane inactive
+        mesh = json.loads(urllib.request.urlopen(
+            base + "/debug/mesh", timeout=10).read())
+        for key in ("status", "dispatches", "compiles", "storage"):
+            assert key in mesh, mesh.keys()
+        assert "enabled" in mesh["status"]
     finally:
         srv.close()

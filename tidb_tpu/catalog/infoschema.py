@@ -106,6 +106,9 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # per-operator exclusive wall split ('join:42ms scan:7ms ...')
         # — which operator of this digest spent the time
         ("operators", _vc(256)),
+        # worst max/mean shard-row ratio of the statement's sharded
+        # dispatches (0 = no sharded dispatch) — mesh flight recorder
+        ("mesh_skew", FieldType(TypeKind.DOUBLE)),
     ],
     # continuous per-digest resource attribution (reference: TiDB's
     # Top SQL / util/topsql): one '(stmt)' summary row per (window,
@@ -121,6 +124,31 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("op_transfer_bytes", _bigint()), ("stages", _vc(256)),
         ("sum_rows", _bigint()), ("admission_sheds", _bigint()),
         ("governor_kills", _bigint()),
+        # worst max-shard share of the operator's sharded dispatches
+        # (1/shards = balanced, 1.0 = one device did everything)
+        ("max_shard_share", FieldType(TypeKind.DOUBLE)),
+    ],
+    # mesh flight recorder: per-plan-digest per-shard dispatch
+    # accounting (input rows, post-filter survivors, skew, exchange
+    # routing bytes), bounded by mesh.shard-ring-cap
+    "tidb_mesh_shards": [
+        ("digest", _vc(32)), ("kind", _vc(16)), ("operator", _vc(64)),
+        ("dispatches", _bigint()), ("shards", _bigint()),
+        ("last_shard_rows", _vc(256)),
+        ("last_skew", FieldType(TypeKind.DOUBLE)),
+        ("max_skew", FieldType(TypeKind.DOUBLE)),
+        ("in_rows", _bigint()), ("out_rows", _bigint()),
+        ("routed_bytes", _bigint()), ("last_seen", _vc(20)),
+    ],
+    # per-device HBM provenance ledger: every cached placed array
+    # classified by (table/epoch, kind), plus one '(device)' total row
+    # per device with live + peak bytes (live totals equal
+    # tidb_device_buffer_bytes{device})
+    "tidb_mesh_storage": [
+        ("device", _vc(64)), ("table_name", _vc(64)),
+        ("epoch_id", _bigint()), ("kind", _vc(16)),
+        ("arrays", _bigint()), ("bytes", _bigint()),
+        ("peak_bytes", _bigint()),
     ],
     # structured server event ring: governor kills, admission sheds,
     # breaker trips, elections/promotions, checkpoint/fsync stalls —
@@ -172,7 +200,25 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("query", _vc(4096)), ("plan_digest", _vc(32)),
         ("stages", _vc(256)), ("mem_max", _bigint()),
         ("spill_count", _bigint()), ("operators", _vc(256)),
+        ("mesh_skew", FieldType(TypeKind.DOUBLE)),
         ("error", _vc(256)),
+    ],
+    # cluster-wide mesh flight recorder over the diag RPC fan-out
+    "cluster_mesh_shards": [
+        ("instance", _vc()), ("digest", _vc(32)), ("kind", _vc(16)),
+        ("operator", _vc(64)), ("dispatches", _bigint()),
+        ("shards", _bigint()), ("last_shard_rows", _vc(256)),
+        ("last_skew", FieldType(TypeKind.DOUBLE)),
+        ("max_skew", FieldType(TypeKind.DOUBLE)),
+        ("in_rows", _bigint()), ("out_rows", _bigint()),
+        ("routed_bytes", _bigint()), ("last_seen", _vc(20)),
+        ("error", _vc(256)),
+    ],
+    "cluster_mesh_storage": [
+        ("instance", _vc()), ("device", _vc(64)),
+        ("table_name", _vc(64)), ("epoch_id", _bigint()),
+        ("kind", _vc(16)), ("arrays", _bigint()), ("bytes", _bigint()),
+        ("peak_bytes", _bigint()), ("error", _vc(256)),
     ],
     # cluster-wide Top SQL: every member's attribution windows under
     # one roof, degrading per-peer like the other cluster_* tables
@@ -184,7 +230,9 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("op_time_ms", FieldType(TypeKind.DOUBLE)),
         ("op_transfer_bytes", _bigint()), ("stages", _vc(256)),
         ("sum_rows", _bigint()), ("admission_sheds", _bigint()),
-        ("governor_kills", _bigint()), ("error", _vc(256)),
+        ("governor_kills", _bigint()),
+        ("max_shard_share", FieldType(TypeKind.DOUBLE)),
+        ("error", _vc(256)),
     ],
     "cluster_statements_summary": [
         ("instance", _vc()), ("digest", _vc(32)), ("schema_name", _vc()),
@@ -434,6 +482,10 @@ def _rows_for(storage, catalog: Catalog, tname: str,
     elif tname == "tidb_top_sql":
         # same producer as the cluster fan-out (minus instance/error)
         rows = storage.diag.diag_top_sql()["rows"]
+    elif tname == "tidb_mesh_shards":
+        rows = storage.diag.diag_mesh_shards()["rows"]
+    elif tname == "tidb_mesh_storage":
+        rows = storage.diag.diag_mesh_storage()["rows"]
     elif tname == "tidb_events":
         rows = storage.diag.diag_events()["rows"]
     elif tname == "metrics_summary":
@@ -447,7 +499,8 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                              st["max"], st["last"]])
     elif tname in ("cluster_info", "cluster_processlist",
                    "cluster_slow_query", "cluster_statements_summary",
-                   "cluster_load", "cluster_top_sql"):
+                   "cluster_load", "cluster_top_sql",
+                   "cluster_mesh_shards", "cluster_mesh_storage"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)

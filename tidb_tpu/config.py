@@ -105,6 +105,12 @@ class MeshSection:
     axis_size: int = 0                    # devices in the mesh; 0 = all
     shard_threshold_rows: int = 1 << 20
     replicate_threshold_bytes: int = 64 << 20
+    # flight recorder: skew warning threshold (0 disables), HBM
+    # watermark fraction + capacity override, dispatch-ring cap
+    skew_warn_ratio: float = 4.0
+    hbm_watermark_fraction: float = 0.85
+    hbm_bytes: int = 0
+    shard_ring_cap: int = 256
 
 
 @dataclass
@@ -315,6 +321,18 @@ class Config:
         if self.mesh.replicate_threshold_bytes < 0:
             raise ConfigError(
                 "mesh.replicate-threshold-bytes must be >= 0")
+        if self.mesh.skew_warn_ratio < 0:
+            raise ConfigError(
+                "mesh.skew-warn-ratio must be >= 0 (0 disables the "
+                "skew warning)")
+        if not 0 < self.mesh.hbm_watermark_fraction <= 1:
+            raise ConfigError(
+                "mesh.hbm-watermark-fraction must be in (0, 1]")
+        if self.mesh.hbm_bytes < 0:
+            raise ConfigError(
+                "mesh.hbm-bytes must be >= 0 (0 = ask the backend)")
+        if self.mesh.shard_ring_cap < 1:
+            raise ConfigError("mesh.shard-ring-cap must be >= 1")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -418,7 +436,11 @@ class Config:
         _mesh.configure(
             enabled=m.enabled, axis_size=m.axis_size,
             shard_threshold_rows=m.shard_threshold_rows,
-            replicate_threshold_bytes=m.replicate_threshold_bytes)
+            replicate_threshold_bytes=m.replicate_threshold_bytes,
+            skew_warn_ratio=m.skew_warn_ratio,
+            hbm_watermark_fraction=m.hbm_watermark_fraction,
+            hbm_bytes=m.hbm_bytes,
+            shard_ring_cap=m.shard_ring_cap)
 
     def seed_observability(self, storage) -> None:
         """Arm the attribution/event plane from the [performance] knobs
@@ -644,6 +666,18 @@ enabled = true
 axis-size = 0
 shard-threshold-rows = 1048576
 replicate-threshold-bytes = 67108864
+# Mesh flight recorder (observability; zero-work when the plane is
+# inactive). A sharded dispatch whose max/mean shard-row ratio reaches
+# skew-warn-ratio raises a session warning + a mesh_skew event
+# (0 disables). A device whose live buffer bytes cross
+# hbm-watermark-fraction of capacity emits a mesh_hbm_watermark event
+# (capacity from the backend, or hbm-bytes when the backend cannot
+# report it). shard-ring-cap bounds the per-digest dispatch ring
+# behind information_schema.tidb_mesh_shards / /debug/mesh.
+skew-warn-ratio = 4.0
+hbm-watermark-fraction = 0.85
+hbm-bytes = 0
+shard-ring-cap = 256
 
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC

@@ -106,6 +106,28 @@ import weakref as _weakref
 _LIVE_CLIENTS: "_weakref.WeakSet" = _weakref.WeakSet()
 
 
+class _VersionedDict(dict):
+    """Staging-cache dict that counts mutations, so telemetry walks
+    (mesh flight recorder's HBM ledger, per-device buffer gauges) can
+    be memoized per cache generation instead of re-walking every
+    cached array on each scrape. Mutations only flow through item
+    assignment/deletion here (no update()/setdefault() call sites)."""
+
+    __slots__ = ("version",)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.version = 0
+
+    def __setitem__(self, k, v) -> None:
+        self.version += 1
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k) -> None:
+        self.version += 1
+        super().__delitem__(k)
+
+
 def _obj_nbytes(o) -> int:
     if isinstance(o, (tuple, list)):
         return sum(_obj_nbytes(x) for x in o)
@@ -175,10 +197,11 @@ class CopClient:
         # shard/single mode and build-staging flag here; a client is
         # shared by every session of a storage, so this must be TLS)
         self._tls = threading.local()
-        # (epoch_id, offset, bucket) -> (device data, device valid)
-        self._col_cache: dict[tuple, tuple[Any, Any]] = {}
+        # (epoch_id, offset, bucket) -> (device data, device valid);
+        # mutation-versioned so telemetry walks memoize per generation
+        self._col_cache: _VersionedDict = _VersionedDict()
         # (epoch_id, bucket, digest) -> device visibility mask
-        self._mask_cache: dict[tuple, Any] = {}
+        self._mask_cache: _VersionedDict = _VersionedDict()
         # compiled kernel cache
         self._kernels: dict[Any, Any] = {}
         # table_id -> last seen epoch_id, for cache eviction
@@ -226,6 +249,25 @@ class CopClient:
     def _device_engine(self) -> str:
         """EXPLAIN ANALYZE engine tag for single-table device paths."""
         return "device"
+
+    # mesh flight-recorder hooks (overridden by the mesh client): the
+    # single-device statement path pays ONE no-op method call per plan
+    # node / statement and allocates nothing — the zero-work contract
+    # the recorder tests pin
+    def take_mesh_note(self):
+        """Collect + return this thread's pending per-shard dispatch
+        accounting (None on the single-device client)."""
+        return None
+
+    def drain_mesh_warnings(self) -> tuple:
+        """Pop this thread's pending mesh skew warnings (empty on the
+        single-device client)."""
+        return ()
+
+    def discard_mesh_pending(self) -> None:
+        """Drop per-shard accounting queued by a failed statement
+        (no-op on the single-device client)."""
+        return None
 
     def _frag_engine(self, mode: str) -> str:
         return f"device[{mode}]"
@@ -1195,23 +1237,34 @@ class CopClient:
 class _FirstCallCompile:
     """Times a fresh jitted kernel's first invocation as the `compile`
     dispatch stage (jax.jit compiles lazily at first call); later calls
-    delegate straight through."""
+    delegate straight through. `on_first`, when set (the mesh plane's
+    compile observer), receives the first call's wall seconds — the
+    feed for compile counts/durations and recompile-storm detection."""
 
-    __slots__ = ("fn", "note", "done")
+    __slots__ = ("fn", "note", "done", "on_first")
 
     def __init__(self, fn, note: str) -> None:
         self.fn = fn
         self.note = note
         self.done = False
+        self.on_first = None
 
     def __call__(self, *args):
         if self.done:
             return self.fn(*args)
         self.done = True
+        import time as _time
+        t0 = _time.perf_counter()
         with obs.stage("compile", span_name="xla.compile") as sp:
             if sp:
                 sp.note = self.note
-            return self.fn(*args)
+            r = self.fn(*args)
+        if self.on_first is not None:
+            try:
+                self.on_first(_time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+        return r
 
 
 def _merge_tile_outs(outs: list[dict], sched) -> dict:

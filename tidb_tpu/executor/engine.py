@@ -189,13 +189,21 @@ def run_physical(plan: PhysicalPlan, ctx: ExecContext) -> Chunk:
         with obs.operator(_op_label(plan)):
             chunk = _run_node(plan, ctx, engine_tag)
         stages = rec.delta_since(before) if rec is not None else None
+        # mesh flight recorder: collect this node's per-shard dispatch
+        # accounting (a no-op None on the single-device CopClient) —
+        # feeds the EXPLAIN ANALYZE `mesh` column and the skew detector
         ctx.stats.record(plan, _time.perf_counter() - t0, chunk.num_rows,
-                         engine_tag[0], stages=stages)
+                         engine_tag[0], stages=stages,
+                         mesh=ctx.cop.take_mesh_note())
         return chunk
     if rec is not None:
         with obs.operator(_op_label(plan)):
-            return _run_node(plan, ctx, None)
-    return _run_node(plan, ctx, None)
+            chunk = _run_node(plan, ctx, None)
+        ctx.cop.take_mesh_note()
+        return chunk
+    chunk = _run_node(plan, ctx, None)
+    ctx.cop.take_mesh_note()
+    return chunk
 
 
 def _run_node(plan: PhysicalPlan, ctx: ExecContext,

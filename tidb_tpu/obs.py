@@ -404,7 +404,7 @@ class TopSQL:
                 "sum_wall_s": 0.0, "max_wall_s": 0.0, "sum_rows": 0,
                 "sheds": 0, "kills": 0,
                 "stages": {}, "op_wall": {}, "op_stages": {},
-                "op_bytes": {}}
+                "op_bytes": {}, "op_mesh": {}}
 
     def record(self, digest: str, digest_text: str, db: str,
                wall_s: float, stages: Optional[dict] = None,
@@ -413,6 +413,7 @@ class TopSQL:
                op_bytes: Optional[dict] = None,
                rows: int = 0, failed: bool = False, shed: bool = False,
                killed: bool = False,
+               op_mesh: Optional[dict] = None,
                now: Optional[float] = None) -> None:
         if not self.enabled:
             return
@@ -455,6 +456,13 @@ class TopSQL:
                 ob = ent["op_bytes"]
                 for k, v in op_bytes.items():
                     ob[k] = ob.get(k, 0) + int(v)
+            if op_mesh:
+                # per-operator max-shard share of sharded dispatches
+                # (the mesh flight recorder's balance signal): keep the
+                # worst share seen for the digest
+                om = ent.setdefault("op_mesh", {})
+                for k, v in op_mesh.items():
+                    om[k] = max(om.get(k, 0.0), float(v))
 
     def snapshot(self) -> list[dict]:
         """Deep-copied buckets, oldest first."""
@@ -491,13 +499,15 @@ class TopSQL:
                 ents.append(b["other"])
             for e in ents:
                 attributed = self.attributed_seconds(e)
+                mesh = e.get("op_mesh") or {}
                 rows.append([
                     win, e["digest"], e["digest_text"], self.STMT,
                     e["exec_count"], round(e["sum_wall_s"] * 1e3, 3),
                     round(attributed * 1e3, 3),
                     sum(e["op_bytes"].values()),
                     fmt_stages(e["stages"])[:256], e["sum_rows"],
-                    e["sheds"], e["kills"]])
+                    e["sheds"], e["kills"],
+                    round(max(mesh.values(), default=0.0), 4)])
                 ops = dict(e["op_wall"])
                 sess = e["op_stages"].get(self.SESSION_OP)
                 if sess:
@@ -509,7 +519,8 @@ class TopSQL:
                         round(ops[op] * 1e3, 3),
                         e["op_bytes"].get(op, 0),
                         fmt_stages(e["op_stages"].get(op))[:256],
-                        e["sum_rows"], e["sheds"], e["kills"]])
+                        e["sum_rows"], e["sheds"], e["kills"],
+                        round(mesh.get(op, 0.0), 4)])
         return rows
 
     def top_by_device(self, n: int = 5) -> list[dict]:
@@ -646,7 +657,8 @@ class Observability:
                     plan_digest: str = "",
                     stages: Optional[dict[str, float]] = None,
                     mem_peak: int = 0, spill_count: int = 0,
-                    op_wall: Optional[dict[str, float]] = None) -> None:
+                    op_wall: Optional[dict[str, float]] = None,
+                    mesh_skew: float = 0.0) -> None:
         self.slow_counter.inc()
         ent = {
             "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -668,6 +680,11 @@ class Observability:
             # governor kill explainable after the fact
             "mem_max": int(mem_peak),
             "spill_count": int(spill_count),
+            # worst max/mean shard-row ratio of the statement's sharded
+            # dispatches (0 = no sharded dispatch) — the mesh flight
+            # recorder's balance signal, so a slow sharded join shows
+            # WHY (skew) next to where (operators)
+            "mesh_skew": round(float(mesh_skew), 2),
         }
         with self._slow_lock:
             self._slow_log.append(ent)
@@ -777,6 +794,33 @@ MESH_RESHARD_BYTES = PROCESS_METRICS.counter(
     "tidb_mesh_reshard_bytes_total",
     "bytes moved across mesh devices by build replication, partitioned "
     "build staging and exchange routing")
+# mesh flight recorder (copr/mesh.py MeshFlightRecorder): per-dispatch
+# per-shard balance, compile churn and HBM watermark telemetry. Label
+# cardinality is bounded: `kind` is a small fixed set, `device` is the
+# mesh width (lint_metrics enforces the device/shard cap)
+MESH_SKEW_RATIO = PROCESS_METRICS.gauge(
+    "tidb_mesh_skew_ratio",
+    "last observed max/mean shard-row ratio of a sharded dispatch "
+    "(1.0 = perfectly balanced)")
+MESH_SKEW_WARNINGS = PROCESS_METRICS.counter(
+    "tidb_mesh_skew_warnings_total",
+    "sharded dispatches whose shard-row skew crossed "
+    "mesh.skew-warn-ratio")
+MESH_COMPILES = PROCESS_METRICS.counter(
+    "tidb_mesh_compiles_total",
+    "XLA kernel compiles observed by the mesh plane, by kernel kind")
+MESH_COMPILE_SECONDS = PROCESS_METRICS.counter(
+    "tidb_mesh_compile_seconds_total",
+    "wall seconds spent in XLA kernel compiles observed by the mesh "
+    "plane")
+MESH_RECOMPILE_STORMS = PROCESS_METRICS.counter(
+    "tidb_mesh_recompile_storms_total",
+    "kernel signatures that re-entered compile repeatedly "
+    "(bucket/placement-mode churn)")
+MESH_HBM_WATERMARK = PROCESS_METRICS.counter(
+    "tidb_mesh_hbm_watermark_total",
+    "devices whose live buffer bytes crossed "
+    "mesh.hbm-watermark-fraction of capacity, by device")
 
 # probes recomputing the sampled gauges (device buffer bytes, jit cache
 # entries, RSS) from live state; run by MetricsHistory.sample_now() and
@@ -1201,7 +1245,8 @@ class StageRecorder:
     '(session)'), and `op_bytes` (host->device transfer bytes per
     operator, fed by the copr client's staging accounting)."""
 
-    __slots__ = ("totals", "counts", "op_wall", "ops", "op_bytes")
+    __slots__ = ("totals", "counts", "op_wall", "ops", "op_bytes",
+                 "op_mesh")
 
     def __init__(self) -> None:
         self.totals: dict[str, float] = {}
@@ -1209,6 +1254,9 @@ class StageRecorder:
         self.op_wall: dict[str, float] = {}
         self.ops: dict[str, dict[str, float]] = {}
         self.op_bytes: dict[str, int] = {}
+        # per-operator mesh balance from the flight recorder:
+        # op -> [max shard share (max_shard/total), max skew ratio]
+        self.op_mesh: dict[str, list] = {}
 
     def add(self, name: str, seconds: float) -> None:
         self.totals[name] = self.totals.get(name, 0.0) + seconds
@@ -1216,6 +1264,17 @@ class StageRecorder:
 
     def add_op_wall(self, op: str, seconds: float) -> None:
         self.op_wall[op] = self.op_wall.get(op, 0.0) + seconds
+
+    def note_mesh(self, op: str, share: float, skew: float) -> None:
+        """Record one sharded dispatch's balance under the operator
+        that issued it (fed by the mesh flight recorder at collect
+        time): max-shard share of the rows and max/mean skew ratio."""
+        m = self.op_mesh.get(op)
+        if m is None:
+            self.op_mesh[op] = [float(share), float(skew)]
+        else:
+            m[0] = max(m[0], float(share))
+            m[1] = max(m[1], float(skew))
 
     def add_op_stage(self, op: str, stage: str, seconds: float) -> None:
         d = self.ops.get(op)
@@ -1324,6 +1383,20 @@ def fmt_ops_ms(ops_ms: Optional[dict[str, float]]) -> str:
                     sorted(ops_ms.items(), key=lambda kv: -kv[1]))
 
 
+def fmt_mesh(note: Optional[dict]) -> str:
+    """Mesh flight-recorder note -> the EXPLAIN ANALYZE `mesh` cell:
+    'shards=8 skew=1.25 rows=[..per-shard rows..] [routed=NNN]'."""
+    if not note:
+        return ""
+    rows = note.get("rows") or note.get("in") or []
+    s = (f"shards={int(note.get('shards', 0))} "
+         f"skew={float(note.get('skew', 0.0)):.2f} "
+         f"rows=[{','.join(str(int(r)) for r in rows)}]")
+    if note.get("routed"):
+        s += f" routed={int(note['routed'])}"
+    return s
+
+
 # ---- per-statement runtime stats (EXPLAIN ANALYZE) --------------------------
 
 class RuntimeStatsColl:
@@ -1339,10 +1412,11 @@ class RuntimeStatsColl:
 
     def record(self, plan, seconds: float, rows: int,
                engine: Optional[str] = None,
-               stages: Optional[dict[str, float]] = None) -> None:
+               stages: Optional[dict[str, float]] = None,
+               mesh: Optional[dict] = None) -> None:
         ent = self.nodes.setdefault(id(plan), {
             "time": 0.0, "rows": 0, "loops": 0, "engine": None,
-            "stages": {}})
+            "stages": {}, "mesh": None})
         ent["time"] += seconds
         ent["rows"] += rows
         ent["loops"] += 1
@@ -1352,6 +1426,18 @@ class RuntimeStatsColl:
             st = ent["stages"]
             for k, v in stages.items():
                 st[k] = st.get(k, 0.0) + v
+        if mesh:
+            # mesh flight-recorder note: keep the latest per-shard rows
+            # and the worst skew across loops; routed bytes accumulate
+            m = ent["mesh"]
+            if m is None:
+                ent["mesh"] = dict(mesh)
+            else:
+                m["skew"] = max(m.get("skew", 0.0),
+                                mesh.get("skew", 0.0))
+                m["rows"] = mesh.get("rows") or m.get("rows")
+                m["in"] = mesh.get("in") or m.get("in")
+                m["routed"] = m.get("routed", 0) + mesh.get("routed", 0)
 
     def for_plan(self, plan) -> Optional[dict]:
         return self.nodes.get(id(plan))
@@ -1513,22 +1599,29 @@ def profile_process(seconds: float = 0.5, hz: float = 97.0) -> Profile:
 _METRIC_NAME_RE = None  # compiled lazily (re import stays off hot paths)
 
 
-def lint_metrics(registries) -> list[str]:
+def lint_metrics(registries, device_label_cap: Optional[int] = None
+                 ) -> list[str]:
     """Walk registries + their rendered exposition and return hygiene
     findings (empty list = clean). Checks: every metric carries help
     text; names are tidb_-prefixed snake_case; no family is registered
     in more than one of the given registries (their /metrics outputs
-    concatenate); and the rendered Prometheus text exposition is
-    well-formed (HELP/TYPE precede samples, label syntax and values
-    parse, histogram buckets are cumulative and _count-consistent).
-    Run by tier-1 so a metric added by a later PR cannot silently
-    break the scrape."""
+    concatenate); `device`/`shard` label families stay bounded by the
+    mesh size (`device_label_cap`; default = the live mesh width, floor
+    8) so per-device telemetry cannot turn into unbounded cardinality;
+    and the rendered Prometheus text exposition is well-formed
+    (HELP/TYPE precede samples, label syntax and values parse,
+    histogram buckets are cumulative and _count-consistent). Run by
+    tier-1 so a metric added by a later PR cannot silently break the
+    scrape."""
     import re
     global _METRIC_NAME_RE
     if _METRIC_NAME_RE is None:
         _METRIC_NAME_RE = re.compile(r"^tidb_[a-z0-9_]+$")
+    if device_label_cap is None:
+        device_label_cap = max(int(MESH_DEVICES.get()), 8)
     findings: list[str] = []
     seen: dict[str, int] = {}
+    label_vals: dict[tuple[str, str], set] = {}
     for ri, reg in enumerate(registries):
         with reg._lock:
             metrics = list(reg._metrics.values())
@@ -1544,7 +1637,22 @@ def lint_metrics(registries) -> list[str]:
                     "concatenated registry (duplicate family on "
                     "/metrics)")
             seen[m.name] = ri
+            if isinstance(m, (Counter, Gauge)):
+                keys = [k for k, _ in m.samples()]
+            else:
+                keys = [k for k, _, _, _ in m.series()]
+            for key in keys:
+                for lk, lv in key:
+                    if lk in ("device", "shard"):
+                        label_vals.setdefault((m.name, lk),
+                                              set()).add(lv)
         findings.extend(_lint_exposition(reg.render()))
+    for (mname, lk), vals in sorted(label_vals.items()):
+        if len(vals) > device_label_cap:
+            findings.append(
+                f"metric {mname}: label {lk!r} has {len(vals)} values, "
+                f"over the mesh-size cap {device_label_cap} (unbounded "
+                "per-device/per-shard cardinality)")
     return findings
 
 
@@ -1623,9 +1731,10 @@ def record_slow(sql: str, db: str, duration_s: float,
                 plan_digest: str = "",
                 stages: Optional[dict[str, float]] = None,
                 mem_peak: int = 0, spill_count: int = 0,
-                op_wall: Optional[dict[str, float]] = None) -> None:
+                op_wall: Optional[dict[str, float]] = None,
+                mesh_skew: float = 0.0) -> None:
     DEFAULT.record_slow(sql, db, duration_s, plan_digest, stages,
-                        mem_peak, spill_count, op_wall)
+                        mem_peak, spill_count, op_wall, mesh_skew)
 
 
 def slow_queries() -> list[dict]:

@@ -333,20 +333,10 @@ class DistCopClient(CopClient):
                 out_specs=P())
             return jax.jit(mapped)
         if mode == "hc":
-            # per-device candidate blocks concatenate (disjoint group
-            # partitions after the exchange); overflow is psum-replicated
-            specs: dict = {"picked": P(AXIS), "score": P(AXIS),
-                           "overflow": P()}
-            for gi in range(len(prepared["__hc_nulls__"])):
-                specs[f"gk{gi}"] = P(AXIS)
-            for ai, s in enumerate(prepared["__hc_sched__"]):
-                specs[f"cnt{ai}"] = P(None, None, AXIS)
-                for ti in range(len(s.get("terms", ()))):
-                    specs[f"s{ai}_{ti}"] = P(None, None, AXIS)
             mapped = shard_map(
                 kernel, mesh=self.mesh,
                 in_specs=(P(AXIS), P(AXIS), build_specs),
-                out_specs=specs)
+                out_specs=self._hc_out_specs(prepared))
             return jax.jit(mapped)
         # row mode: per-shard packed bitmask; shards are 256-multiples so
         # byte boundaries align and concatenation is the global mask
@@ -355,6 +345,22 @@ class DistCopClient(CopClient):
             in_specs=(P(AXIS), P(AXIS), build_specs),
             out_specs=P(AXIS))
         return jax.jit(mapped)
+
+    @staticmethod
+    def _hc_out_specs(prepared) -> dict:
+        """shard_map out_specs for the hc partial schema: per-device
+        candidate blocks concatenate (disjoint group partitions after
+        the exchange); overflow is psum-replicated. Shared with the
+        mesh client so the spec dict cannot diverge from the schema."""
+        specs: dict = {"picked": P(AXIS), "score": P(AXIS),
+                       "overflow": P()}
+        for gi in range(len(prepared["__hc_nulls__"])):
+            specs[f"gk{gi}"] = P(AXIS)
+        for ai, s in enumerate(prepared["__hc_sched__"]):
+            specs[f"cnt{ai}"] = P(None, None, AXIS)
+            for ti in range(len(s.get("terms", ()))):
+                specs[f"s{ai}_{ti}"] = P(None, None, AXIS)
+        return specs
 
     def _build_in_specs(self, prepared):
         """Per-build shard_map in_specs: broadcast builds replicate (P()),

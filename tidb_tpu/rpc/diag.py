@@ -51,6 +51,8 @@ TABLE_METHODS = {
     "cluster_statements_summary": "diag_statements",
     "cluster_load": "diag_load",
     "cluster_top_sql": "diag_top_sql",
+    "cluster_mesh_shards": "diag_mesh_shards",
+    "cluster_mesh_storage": "diag_mesh_storage",
 }
 
 
@@ -102,7 +104,8 @@ class DiagService:
                          obs.fmt_stages_ms(e.get("stages")),
                          int(e.get("mem_max", 0)),
                          int(e.get("spill_count", 0)),
-                         obs.fmt_ops_ms(e.get("operators"))])
+                         obs.fmt_ops_ms(e.get("operators")),
+                         float(e.get("mesh_skew", 0.0))])
         return {"rows": rows}
 
     def diag_top_sql(self) -> dict:
@@ -110,6 +113,18 @@ class DiagService:
         information_schema.tidb_top_sql (the cluster_top_sql fan-out
         adds instance/error). Empty while topsql is disabled."""
         return {"rows": self.storage.obs.topsql.table_rows()}
+
+    def diag_mesh_shards(self) -> dict:
+        """This server's mesh flight-recorder dispatch ring (empty
+        while the mesh plane is inactive). Reads the EXISTING client —
+        a diag scrape never builds a mesh or grabs a backend."""
+        from ..copr import mesh as _mesh
+        return {"rows": _mesh.shard_rows(self.storage)}
+
+    def diag_mesh_storage(self) -> dict:
+        """This server's per-device HBM provenance ledger."""
+        from ..copr import mesh as _mesh
+        return {"rows": _mesh.storage_rows(self.storage)}
 
     def diag_events(self) -> dict:
         """The structured server event ring, newest last."""

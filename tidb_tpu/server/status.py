@@ -21,6 +21,10 @@ Debug routes:
   /debug/events  the structured server event ring: governor kills,
       admission sheds, breaker trips, elections, checkpoint/fsync
       stalls (JSON)
+  /debug/mesh  the mesh flight recorder: plane status, per-digest
+      per-shard dispatch accounting (rows/skew/exchange bytes),
+      compile ring with recompile-storm flags, and the per-device
+      HBM provenance ledger (JSON; never builds a mesh)
 """
 
 from __future__ import annotations
@@ -144,6 +148,16 @@ class StatusServer:
                 elif self.path.startswith("/debug/events"):
                     body = json.dumps(
                         server_obs.events.snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/mesh"):
+                    # flight recorder + HBM ledger; degrades to the
+                    # plane status alone rather than failing the scrape
+                    try:
+                        from ..copr import mesh as _mesh
+                        payload = _mesh.debug_payload()
+                    except Exception as e:  # noqa: BLE001
+                        payload = {"error": str(e)[:200]}
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/debug/failpoints"):
                     from ..util import failpoint

@@ -115,6 +115,9 @@ class Session:
         self.last_op_wall: dict[str, float] = {}
         self.last_op_stages: dict[str, dict[str, float]] = {}
         self.last_op_bytes: dict[str, int] = {}
+        # per-operator mesh balance ([max shard share, max skew]) from
+        # the flight recorder — empty on single-device statements
+        self.last_op_mesh: dict[str, list] = {}
         self._pending_parse_s = 0.0
         # SQL-text plan cache: key -> (invalidation gen, physical plan)
         # (reference: prepared-plan cache, planner/core/common_plans.go +
@@ -398,6 +401,26 @@ class Session:
             self.last_op_wall = rec.op_wall
             self.last_op_stages = rec.ops
             self.last_op_bytes = rec.op_bytes
+            self.last_op_mesh = rec.op_mesh
+            # worst shard skew of the statement's sharded dispatches
+            # (0 = none); surfaces in the slow log + Top SQL
+            mesh_skew = 0.0
+            if rec.op_mesh:
+                mesh_skew = max(v[1] for v in rec.op_mesh.values())
+            # mesh skew warnings raised by the flight recorder during
+            # this statement become SHOW WARNINGS entries (self._cop,
+            # not self.cop: the property would lazily build a mesh
+            # plane on statements that never dispatched)
+            c = self._cop
+            if c is not None:
+                if failed:
+                    # an interrupted/failed statement leaves queued
+                    # per-shard stats uncollected; drop them so they
+                    # are not folded into the next statement's mesh
+                    # accounting
+                    c.discard_mesh_pending()
+                for w in c.drain_mesh_warnings():
+                    self.add_warning(w)
             if digest_sql is not None:
                 o.statements.record(digest_sql, self.current_db, dt,
                                     rows_out, failed,
@@ -426,14 +449,17 @@ class Session:
                         stages=rec.totals, op_wall=rec.op_wall,
                         op_stages=rec.ops, op_bytes=rec.op_bytes,
                         rows=rows_out, failed=failed, shed=shed,
-                        killed=self._governor_killed)
+                        killed=self._governor_killed,
+                        op_mesh={k: v[0] for k, v in
+                                 rec.op_mesh.items()} or None)
                 if slow:
                     o.record_slow(sql, self.current_db, dt,
                                   plan_digest=digest,
                                   stages=rec.snapshot(),
                                   mem_peak=self.last_mem_peak,
                                   spill_count=self.last_spill_count,
-                                  op_wall=rec.op_wall)
+                                  op_wall=rec.op_wall,
+                                  mesh_skew=mesh_skew)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -2994,14 +3020,15 @@ class Session:
         for node, line in explain_nodes(plan):
             st = coll.for_plan(node)
             if st is None:
-                rows.append((line, None, None, "", ""))
+                rows.append((line, None, None, "", "", ""))
             else:
                 rows.append((line, st["rows"],
                              round(st["time"] * 1e3, 2),
                              st["engine"] or "",
-                             obs.fmt_stages(st.get("stages"))))
+                             obs.fmt_stages(st.get("stages")),
+                             obs.fmt_mesh(st.get("mesh"))))
         return ResultSet(["plan", "actRows", "time_ms", "engine",
-                          "stages"], rows)
+                          "stages", "mesh"], rows)
 
     def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
         """TRACE <select>: execute with span accounting and return the
