@@ -135,22 +135,60 @@ class _Heartbeat:
         self._line("done" if exc[0] is None else "ABORTED")
 
 
+GEN_VERSION = 1  # bump to invalidate on-disk datagen caches
+
+
+def _cache_dir() -> str:
+    return os.environ.get(
+        "BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_cache"))
+
+
 def generate_lineitem_chunked(n: int, hb: _Heartbeat,
                               chunk: int = 16_000_000):
-    """Chunked lineitem generation with heartbeat progress: bounded
-    transient RSS (full columns + ONE chunk of generator transients
-    instead of a whole-table generation pass) and `hb.rows` advances
-    per chunk so the heartbeat shows where an SF100 gen dies. Chunks
-    are seeded independently — self-consistent data; the oracles read
-    the same arrays."""
+    """Chunked lineitem generation, streamed through an on-disk columnar
+    cache (one .npy per column under BENCH_CACHE_DIR) reused across
+    runs.
+
+    The SF100 flights died in datagen two rounds running (BENCH_r04
+    rc=137 OOM, r05 rc=124 timeout at 504s/45.9G RSS, all 600M rows
+    held in memory): generation now writes each chunk straight into
+    np.lib.format memmaps — transient RSS is ONE chunk of generator
+    temporaries, the kernel flushes column pages behind the writer —
+    and a later run finds the `_COMPLETE` marker and memory-maps the
+    columns read-only in O(seconds) with page-cache-evictable RSS.
+    Chunks are seeded independently — self-consistent data; the oracles
+    read the same (mapped) arrays. Falls back to in-memory generation
+    when the cache dir is unwritable."""
     from tidb_tpu.bench.tpch import generate_lineitem_arrays
 
     if n <= chunk:
         out = generate_lineitem_arrays(n)
         hb.rows = n
         return out
+    # chunk is part of the identity: chunks are seeded independently, so
+    # the concrete rows are a function of the chunk size
+    tag = os.path.join(_cache_dir(),
+                       f"lineitem_n{n}_c{chunk}_seed42_v{GEN_VERSION}")
+    marker = os.path.join(tag, "_COMPLETE")
+    if os.path.exists(marker):
+        out = {c: np.load(os.path.join(tag, c + ".npy"), mmap_mode="r")
+               for c in _LI_COLS}
+        hb.rows = n
+        log(f"datagen cache HIT: {tag} ({n} rows mapped)")
+        return out
     first = generate_lineitem_arrays(chunk, seed=42)
-    out = {k: np.empty(n, dtype=v.dtype) for k, v in first.items()}
+    try:
+        os.makedirs(tag, exist_ok=True)
+        out = {k: np.lib.format.open_memmap(
+            os.path.join(tag, k + ".npy"), mode="w+", dtype=v.dtype,
+            shape=(n,)) for k, v in first.items()}
+        cached = True
+    except OSError as e:
+        log(f"datagen cache unavailable ({e}); generating in memory")
+        out = {k: np.empty(n, dtype=v.dtype) for k, v in first.items()}
+        cached = False
     lo = 0
     i = 0
     while lo < n:
@@ -165,6 +203,16 @@ def generate_lineitem_chunked(n: int, hb: _Heartbeat,
         hb.rows = hi
         lo = hi
         i += 1
+    if cached:
+        for v in out.values():
+            v.flush()
+        with open(marker, "w") as f:
+            f.write(f"{n}\n")
+        log(f"datagen cache WRITTEN: {tag}")
+        # reopen read-only: the loaded epochs then share the page cache
+        # and a crashed later phase cannot corrupt the cache
+        out = {c: np.load(os.path.join(tag, c + ".npy"), mmap_mode="r")
+               for c in _LI_COLS}
     return out
 
 
@@ -172,7 +220,11 @@ def _attribution(session) -> dict:
     """The last timed run's per-stage/per-operator attribution (the
     session-side read of the Top SQL plane) — persisted per query into
     the flight result + board tail so BENCH_*.json explains where the
-    milliseconds went, not only how many there were."""
+    milliseconds went, not only how many there were. `engines` is the
+    device/host path decision per coprocessor read, with the fragment
+    mode and any gate reason embedded ("device[fat]@mesh8",
+    "host(fragment:key-span)") — a regression off the device path now
+    names itself on the board."""
     return {
         "stages_ms": {k: round(v * 1e3, 3)
                       for k, v in session.last_stages.items()},
@@ -182,6 +234,7 @@ def _attribution(session) -> dict:
             op: {k: round(v * 1e3, 3) for k, v in d.items()}
             for op, d in session.last_op_stages.items()},
         "operator_bytes": dict(session.last_op_bytes),
+        "engines": list(getattr(session, "last_engines", ()) or ()),
     }
 
 
@@ -189,6 +242,11 @@ def note_attribution(res: dict, name: str, session) -> None:
     att = _attribution(session)
     res.setdefault("attribution", {})[name] = att
     log(f"attribution {name}: " + json.dumps(att, sort_keys=True))
+    paths = sorted(set(att["engines"]))
+    host = [e for e in paths if e.startswith("host")]
+    res["lines"].append(
+        f"path {name}: {','.join(paths) or '(none)'}"
+        + (" <- HOST FALLBACK" if host else ""))
 
 
 # ---------------------------------------------------------------------------
@@ -391,6 +449,49 @@ def q3_oracle(jdata):
     nz = np.nonzero(rev)[0]
     top = nz[np.lexsort((nz, odate[nz], -rev[nz]))[:10]]
     return [(int(k), int(rev[k])) for k in top]
+
+
+def q10_oracle(jdata):
+    """Exact (custkey, revenue_unscaled) top-20 set for TPC-H Q10."""
+    from tidb_tpu.types.value import parse_date
+
+    d1, d2 = parse_date("1993-10-01"), parse_date("1994-01-01")
+    o = jdata["orders"]
+    o_ok = (o["o_orderdate"] >= d1) & (o["o_orderdate"] < d2)
+    ospan = int(o["o_orderkey"].max()) + 1
+    o_cust = np.full(ospan, -1, np.int64)
+    o_cust[o["o_orderkey"][o_ok]] = o["o_custkey"][o_ok]
+    li = jdata["lineitem"]
+    rvocab, rcodes = li["l_returnflag"]
+    r_code = list(rvocab).index("R")
+    cust = o_cust[li["l_orderkey"]]
+    m = (np.asarray(rcodes) == r_code) & (cust >= 0)
+    cspan = int(jdata["customer"]["c_custkey"].max()) + 1
+    rev = np.zeros(cspan, np.int64)
+    np.add.at(rev, cust[m],
+              li["l_extendedprice"][m] * (100 - li["l_discount"][m]))
+    nz = np.nonzero(rev)[0]
+    top = nz[np.lexsort((nz, -rev[nz]))[:20]]
+    # revenue-only ORDER BY: ties leave the tail unordered, so digests
+    # compare the (custkey, revenue) SET
+    return {(int(k), int(rev[k])) for k in top}
+
+
+def time_q10(res: dict, session, jdata, label: str, repeat: int):
+    """Digest-check + time TPC-H Q10 (the fused join+agg+topn shape) on
+    an already-loaded session; returns rows/s."""
+    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+
+    want = q10_oracle(jdata)
+    got = {(int(r[0]), r[2].unscaled)
+           for r in session.query(TPCH_QUERIES["q10"])}
+    assert got == want, f"q10 digest: {sorted(got)[:3]} vs " \
+                        f"{sorted(want)[:3]}"
+    ts = times(lambda: session.query(TPCH_QUERIES["q10"]), repeat)
+    note_attribution(res, label, session)
+    line, rps = report(label, ts, len(jdata["lineitem"]["l_orderkey"]))
+    res["lines"].append(line)
+    return rps
 
 
 def q5_oracle(jdata):
@@ -599,6 +700,24 @@ def flight_tpch(res: dict, big: bool) -> None:
     res["values"]["q6_concurrent"] = tput
     lines.append(f"q6 concurrent throughput ({n_clients} clients): "
                  f"{tput / 1e6:.1f}M rows/s")
+
+    # Q10 — the fused join+agg+topn shape (device multi-key TopN over a
+    # snowflake join) tracked every round at a small join-corpus scale
+    from tidb_tpu.bench.tpch_data import generate_tpch, load_table
+    q10_sf = float(os.environ.get("BENCH_Q10_SF", 1))
+    t0 = time.perf_counter()
+    with _Heartbeat(f"tpch-q10-sf{q10_sf:g}-gen+load") as hb:
+        jdata = generate_tpch(q10_sf, 17)
+        for t in ("part", "partsupp", "supplier", "region"):
+            jdata.pop(t, None)  # generated but unused: free before load
+        hb.rows = len(jdata["lineitem"]["l_orderkey"])
+        js = Session()
+        for t in ("customer", "orders", "lineitem", "nation"):
+            load_table(js, t, jdata[t])
+    log(f"q10 corpus sf{q10_sf:g}: gen+load="
+        f"{time.perf_counter() - t0:.0f}s")
+    res["values"]["q10_small"] = time_q10(
+        res, js, jdata, f"q10_sf{q10_sf:g}", repeat)
 
 
 def flight_joins(res: dict) -> None:
@@ -818,13 +937,43 @@ def flight_multichip(res: dict) -> None:
     mesh_info["device_bytes"] = rep["device_bytes"]
     mesh_info["device_peak_bytes"] = plane.device_peak_bytes()
     mesh_info["reshard_bytes_total"] = _obs.MESH_RESHARD_BYTES.get()
-    # bounded dispatch ring: digest, kind, op, dispatches, shards,
-    # last per-shard rows, skew, exchange routing bytes
-    mesh_info["dispatches"] = mesh.cop.recorder.snapshot()["dispatches"]
     res["mesh"] = mesh_info
     lines.append(
         f"multichip exchange: "
         f"{int(mesh_info['reshard_bytes_total'])} reshard bytes total")
+
+    # Q10 over the mesh: the fused join+agg+topn shape executing
+    # partition-wise (sharded probe, candidate blocks per device) vs the
+    # single-device path — both digest-checked against the oracle. Runs
+    # AFTER the placement report above: its corpus REPLACES the flight's
+    # lineitem table (load_table drops + recreates), and the placement/
+    # device-bytes record must keep describing the main workload.
+    from tidb_tpu.bench.tpch_data import generate_tpch, load_table
+    q10_sf = max(0.1, min(float(os.environ.get(
+        "BENCH_MESH_Q10_SF", n / ROWS_PER_SF)), 10.0))
+    with _Heartbeat(f"multichip-q10-sf{q10_sf:g}-gen+load") as hb:
+        jdata = generate_tpch(q10_sf, 17)
+        for t in ("part", "partsupp", "supplier", "region"):
+            jdata.pop(t, None)  # generated but unused: free before load
+        hb.rows = len(jdata["lineitem"]["l_orderkey"])
+        for t in ("customer", "orders", "lineitem", "nation"):
+            load_table(single, t, jdata[t])
+    jrows = len(jdata["lineitem"]["l_orderkey"])
+    rps_s10 = time_q10(res, single, jdata, "multichip_q10_single", repeat)
+    rps_m10 = time_q10(res, mesh, jdata, "multichip_q10_mesh", repeat)
+    res["values"]["q10_single_1dev"] = rps_s10
+    res["values"][f"q10_mesh_{n_dev}dev"] = rps_m10
+    om = mesh.last_op_mesh
+    mesh_info["queries"]["q10"] = {
+        "skew": round(max((v[1] for v in om.values()), default=0.0), 3),
+        "op_shares": {k: round(v[0], 4) for k, v in om.items()},
+    }
+    lines.append(
+        f"multichip q10 ({jrows} lineitem rows): single-device "
+        f"{rps_s10 / 1e6:.1f}M rows/s vs {n_dev}-device mesh "
+        f"{rps_m10 / 1e6:.1f}M rows/s ({rps_m10 / max(rps_s10, 1):.2f}x)")
+    # dispatch ring taken LAST so the q10 dispatches are in the record
+    mesh_info["dispatches"] = mesh.cop.recorder.snapshot()["dispatches"]
 
 
 FLIGHTS = {

@@ -26,6 +26,21 @@ except AttributeError:
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
 
+# Persistent XLA compilation cache: the tier-1 suite is COMPILE-bound —
+# many test files compile the very same fused kernels (the TPC-H join
+# fragments appear in the fragment/exchange/mesh/lint/graft suites, each
+# with its own CopClient and hence its own in-process jit cache). The
+# disk cache is keyed by HLO, so identical programs compile once per
+# RUN (and once per machine across runs), which keeps the suite inside
+# its wall-clock budget. Scoped to expensive programs only.
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("TIDB_TPU_TEST_JAX_CACHE",
+                                     "/tmp/titpu_test_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+except AttributeError:
+    pass  # older jax: no persistent cache; suite just runs colder
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,0 +1,70 @@
+"""Fallback-reason lint for the snowflake benchmark shapes (ISSUE 9).
+
+Q3/Q5/Q10/Q12 are the queries the data-plane work targets: they must
+execute END-TO-END on the device fragment path — zero `host_fallback`
+stage time, every coprocessor read tagged `device...` — on the
+single-device client AND sharded on the 8-way mesh plane. A regression
+fails with the offending engine tag, whose embedded gate reason names
+the cause (e.g. `host(fragment:key-span)`), so the fix starts from the
+failure message instead of a bisect.
+"""
+
+import jax
+import pytest
+
+from tidb_tpu.bench.tpch_data import TPCH_DDL, generate_tpch, load_table
+from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+from tidb_tpu.copr import mesh as M
+from tidb_tpu.copr.client import CopClient
+from tidb_tpu.session import Session
+
+QUERIES = ("q3", "q5", "q10", "q12")
+
+
+@pytest.fixture(scope="module")
+def sessions():
+    single = Session(cop=CopClient())
+    data = generate_tpch(0.01, 29)
+    for t in TPCH_DDL:
+        load_table(single, t, data[t])
+    assert len(jax.devices()) >= 8, "conftest must provide 8 devices"
+    plane = M.MeshPlane(M.MeshConfig(enabled=True,
+                                     shard_threshold_rows=512))
+    mesh = Session(single.storage, cop=plane.client_for(single.storage))
+    return single, mesh
+
+
+def _lint(session, qname: str, want_mesh: bool) -> None:
+    sql = TPCH_QUERIES[qname]
+    rows = session.execute("EXPLAIN ANALYZE " + sql).rows
+    engines = [str(r[3]) for r in rows if r[3]]
+    assert engines, f"{qname}: no engine-tagged coprocessor read"
+    bad = [e for e in engines if not e.startswith("device")]
+    assert not bad, (
+        f"{qname}: left the device path — engine tags {bad} "
+        "(the parenthesized gate reason names the regression)")
+    stages = " ".join(str(r[4]) for r in rows if r[4])
+    assert "host_fallback" not in stages, (
+        f"{qname}: host_fallback stage time recorded: {stages}")
+    if want_mesh:
+        assert any("@mesh" in e for e in engines), (
+            f"{qname}: not sharded on the mesh plane: {engines}")
+        mesh_col = [str(r[5]) for r in rows if len(r) > 5 and r[5]]
+        assert mesh_col, (
+            f"{qname}: EXPLAIN ANALYZE `mesh` column empty on a "
+            "sharded run")
+
+
+def test_device_path_single_q3(sessions):
+    # single-device spot check on the headline query; the mesh
+    # parametrization below covers all four shapes end-to-end (and is
+    # the acceptance surface) — running both full sets doubles the
+    # suite's compile bill for no added coverage
+    single, _ = sessions
+    _lint(single, "q3", want_mesh=False)
+
+
+@pytest.mark.parametrize("qname", QUERIES)
+def test_device_path_mesh(sessions, qname):
+    _, mesh = sessions
+    _lint(mesh, qname, want_mesh=True)

@@ -284,3 +284,34 @@ def test_pessimistic_delete_serializes():
     # delete ran before or after s1's commit became visible; both are
     # serializable outcomes
     assert remaining in ([(1,), (3,)], [(1,), (2,), (3,)])
+
+
+def test_pessimistic_insert_wait_time_charges_budget():
+    """Time blocked on foreign locks counts against the insert's
+    Backoffer budget (like _pessimistic_scan): with a short
+    innodb_lock_wait_timeout, a waiting insert that keeps losing the
+    race surfaces the TYPED budget exhaustion instead of spinning for a
+    free extra timeout per wait."""
+    s1, s2 = _two_sessions()
+    s1.execute("create table bw (a int primary key, v int)")
+    s1.execute("begin pessimistic")
+    s1.execute("insert into bw values (7, 1)")
+
+    def racing_insert():
+        s2.execute("set innodb_lock_wait_timeout = 1")
+        s2.execute("begin pessimistic")
+        s2.execute("insert into bw values (7, 2)")
+
+    t, box = _run(racing_insert)
+    t0 = time.time()
+    t.join(timeout=15)
+    elapsed = time.time() - t0
+    assert not t.is_alive(), "insert must terminate on its budget"
+    # the holder never commits: the waiter must fail on the typed
+    # budget/timeout path well before a multiple of the timeout
+    assert "err" in box, box
+    msg = str(box["err"]).lower()
+    assert "backoff" in msg or "lock wait timeout" in msg, box["err"]
+    assert elapsed < 10, f"waiter spun past its budget ({elapsed:.1f}s)"
+    s2.execute("rollback")
+    s1.execute("rollback")

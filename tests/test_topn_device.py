@@ -1,0 +1,200 @@
+"""Device multi-key TopN property tests (ISSUE 9 acceptance).
+
+The device paths — scan TopN with the packed multi-key composite, the
+fused join+topn row fragment, and the fused join+agg+topn (`fat`)
+candidate cut — must be BIT-IDENTICAL to the host path under mixed
+ASC/DESC sort items, ties at the limit boundary, NULL ordering, and
+LIMIT beyond the survivor count, in all three execution modes:
+single-device, tiled (epoch larger than TILE_ROWS), and 8-way-sharded
+mesh (the conftest's virtual devices). Host-path results are produced
+by the SAME engine with the device gates forced shut, so the comparison
+covers the full decode/merge stack, not just the kernels. Also pins the
+discard-on-interrupt contract for per-shard stats queued by the new
+fragment kernels.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tidb_tpu.copr import fragment as FR
+from tidb_tpu.copr import mesh as M
+from tidb_tpu.copr.client import CopClient
+from tidb_tpu.session import Session
+
+N_FACT = 12_000
+N_DIM = 3_000
+
+SCAN_QUERIES = [
+    # mixed directions, NULLs in b (first in ASC, last in DESC), ties
+    "select k, b, c from f where c > -40 "
+    "order by b desc, c, k desc limit 9",
+    "select k, b, c from f where c > -40 "
+    "order by b, c desc limit 6",
+    # LIMIT beyond the survivor count
+    "select k, b, c from f where c > 93 order by b desc, c limit 50",
+    # tie-heavy keys: boundary resolution must match the host's stable
+    # order (top_k is index-stable, the host lexsort is stable)
+    "select k, b from f order by b desc limit 11",
+]
+
+JOIN_QUERIES = [
+    "select k, x, b from f, dim where fg = dg "
+    "order by x desc, b, k limit 7",
+    # dictionary string key: order-preserving rank table on device
+    "select k, s, c from f, dim where fg = dg "
+    "order by s, k desc limit 8",
+    "select k, x, c from f, dim where fg = dg and c > 94 "
+    "order by x, c desc, k limit 40",
+]
+
+FAT_QUERIES = [
+    "select dg, x, sum(v) from f, dim where fg = dg "
+    "group by dg, x order by sum(v) desc, x limit 5",
+    "select dg, x, sum(v) from f, dim where fg = dg "
+    "group by dg, x order by sum(v), dg desc limit 6",
+    # coarse values force sum ties at the boundary: the fat cut must
+    # refuse ambiguity (fall back) and still match the host bit-for-bit
+    "select dg, sum(w) from f, dim where fg = dg "
+    "group by dg order by sum(w) desc, dg limit 7",
+]
+
+
+def _bulk(session, name, ddl, cols, valids=None):
+    session.execute(ddl)
+    info = session.catalog.table("test", name)
+    store = session.storage.table_store(info.id)
+    store.bulk_load(cols, valids)
+    return store
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(23)
+    base = Session(cop=CopClient())
+    k = np.arange(N_FACT, dtype=np.int64)
+    fg = rng.integers(0, N_DIM, N_FACT)
+    b = rng.integers(0, 7, N_FACT)
+    b_valid = rng.random(N_FACT) > 0.12
+    c = rng.integers(-50, 100, N_FACT)
+    v = rng.integers(-30, 30, N_FACT)
+    w = rng.integers(0, 2, N_FACT)  # coarse: many equal sums
+    _bulk(base, "f",
+          "create table f (k bigint primary key, fg int, b int, "
+          "c int, v int, w int)",
+          [k, fg, b, c, v, w], [None, None, b_valid, None, None, None])
+    dg = np.arange(N_DIM, dtype=np.int64)
+    x = rng.integers(0, 40, N_DIM)
+    base.execute("create table dim (dg bigint primary key, x int, "
+                 "s varchar(16))")
+    dinfo = base.catalog.table("test", "dim")
+    dstore = base.storage.table_store(dinfo.id)
+    d = dstore.dictionaries[2]
+    svals = np.array([d.encode(f"name-{i % 11:02d}") for i in range(N_DIM)],
+                     dtype=np.int64)
+    dstore.bulk_load([dg, x, svals])
+    return base
+
+
+@pytest.fixture(scope="module")
+def host_results(corpus):
+    """Every query's rows with the device gates forced shut — the host
+    path the device modes must match bit-for-bit."""
+    import unittest.mock as mock
+
+    host = Session(corpus.storage, cop=CopClient())
+
+    def deny_topn(self, dag, col_bounds, prepared):
+        return "forced-host (test)"
+
+    def deny_fragment(cop, frag, snaps):
+        raise FR._Fallback("forced-host")
+
+    out = {}
+    with mock.patch.object(CopClient, "_prepare_topn", deny_topn), \
+            mock.patch.object(FR, "_device_fragment", deny_fragment):
+        for sql in SCAN_QUERIES + JOIN_QUERIES + FAT_QUERIES:
+            out[sql] = host.query(sql)
+    return out
+
+
+def _engines(session, sql):
+    return {r[3] for r in session.execute(
+        "EXPLAIN ANALYZE " + sql).rows if r[3]}
+
+
+_MODE_SESSIONS: dict = {}
+
+
+def _mode_session(corpus, mode):
+    # one session (= one staging/jit cache) per mode for the module
+    s = _MODE_SESSIONS.get(mode)
+    if s is not None and s.storage is corpus.storage:
+        return s
+    if mode == "single":
+        s = Session(corpus.storage, cop=CopClient())
+    elif mode == "tiled":
+        cop = CopClient()
+        cop.TILE_ROWS = 2048  # epochs (20k rows) stream as 10 tiles
+        s = Session(corpus.storage, cop=cop)
+    else:
+        assert len(jax.devices()) >= 8, "conftest must provide 8 devices"
+        plane = M.MeshPlane(M.MeshConfig(enabled=True,
+                                         shard_threshold_rows=512))
+        s = Session(corpus.storage, cop=plane.client_for(corpus.storage))
+    _MODE_SESSIONS[mode] = s
+    return s
+
+
+@pytest.mark.parametrize("mode", ["single", "tiled", "mesh"])
+class TestBitIdenticalVsHost:
+    def test_scan_multikey_topn(self, corpus, host_results, mode):
+        s = _mode_session(corpus, mode)
+        for sql in SCAN_QUERIES:
+            assert s.query(sql) == host_results[sql], (mode, sql)
+
+    def test_join_topn_fragment(self, corpus, host_results, mode):
+        s = _mode_session(corpus, mode)
+        for sql in JOIN_QUERIES:
+            assert s.query(sql) == host_results[sql], (mode, sql)
+            if mode != "tiled":  # tiled mode may or may not tile builds
+                assert any("device[topn]" in e
+                           for e in _engines(s, sql)), (mode, sql)
+
+    def test_fused_agg_topn(self, corpus, host_results, mode):
+        s = _mode_session(corpus, mode)
+        for sql in FAT_QUERIES:
+            assert s.query(sql) == host_results[sql], (mode, sql)
+        # the tie-free queries must actually take the fused device cut
+        eng = _engines(s, FAT_QUERIES[0])
+        assert any("device[fat]" in e for e in eng), (mode, eng)
+
+
+def test_fat_boundary_tie_falls_back(corpus, host_results):
+    """Coarse sums tie at the limit boundary: the fused cut must refuse
+    the ambiguous boundary (host re-ranks exactly) instead of shipping
+    an arbitrary tie-break that disagrees with the host's stable sort."""
+    s = Session(corpus.storage, cop=CopClient())
+    sql = FAT_QUERIES[2]
+    assert s.query(sql) == host_results[sql]
+
+
+def test_mesh_discard_on_interrupt(corpus):
+    """Per-shard stats queued by the new fragment kernels (frag-topn /
+    fused hc) must be discarded when the statement dies before the
+    engine collects them."""
+    plane = M.MeshPlane(M.MeshConfig(enabled=True,
+                                     shard_threshold_rows=512))
+    mesh = Session(corpus.storage, cop=plane.client_for(corpus.storage))
+    mesh.query(JOIN_QUERIES[0])  # warm; collects its own stats
+    rec = mesh.cop.recorder
+    assert not getattr(rec._tls, "pending", None)
+    rec.note_pending("frag-topn", "stalefragtopn00",
+                     np.asarray([[3, 3]] * 8, dtype=np.int32))
+    with pytest.raises(Exception):
+        mesh.execute("select no_such_col from f")
+    assert not getattr(rec._tls, "pending", None), \
+        "failed statement left frag-topn per-shard stats queued"
+    mesh.query(JOIN_QUERIES[0])
+    with rec._lock:
+        assert "stalefragtopn00" not in rec._ring

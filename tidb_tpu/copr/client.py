@@ -546,27 +546,49 @@ class CopClient:
         return None
 
     def _prepare_topn(self, dag, col_bounds, prepared) -> Optional[str]:
-        if len(dag.topn.items) != 1:
-            return "multi-key TopN is host-side for now"
-        e = dag.topn.items[0][0]
-        if e.ftype.is_string:
-            return "string TopN key is host-side"
-        # the sort key references the projection's output schema; substitute
-        # so bounds analysis sees scan-column indices
-        key = _subst_proj_cols(e, dag.projections) if dag.projections else e
-        exprs = [key]
+        # projection outputs are gathered by the kernel either way
         if dag.projections:
-            exprs.extend(dag.projections)
-        for x in exprs:
-            if x.ftype.is_string:
-                continue
-            if not x.ftype.is_float and not expr_device_safe(x, col_bounds):
-                return "TopN expression too wide for int32 device"
-        if not e.ftype.is_float:
-            b = expr_bounds(key, col_bounds)
-            # negated scores must also fit (ASC uses -v)
-            if b is None or not fits_int32(b) or not fits_int32((-b[1], -b[0])):
-                return "TopN key too wide for int32 device"
+            for x in dag.projections:
+                if x.ftype.is_string:
+                    continue
+                if not x.ftype.is_float and \
+                        not expr_device_safe(x, col_bounds):
+                    return "TopN expression too wide for int32 device"
+        items = dag.topn.items
+        if len(items) == 1:
+            e = items[0][0]
+            if e.ftype.is_string:
+                return "string TopN key is host-side"
+            # the sort key references the projection's output schema;
+            # substitute so bounds analysis sees scan-column indices
+            key = _subst_proj_cols(e, dag.projections) \
+                if dag.projections else e
+            if not e.ftype.is_float:
+                if not expr_device_safe(key, col_bounds):
+                    return "TopN expression too wide for int32 device"
+                b = expr_bounds(key, col_bounds)
+                # negated scores must also fit (ASC uses -v)
+                if b is None or not fits_int32(b) or \
+                        not fits_int32((-b[1], -b[0])):
+                    return "TopN key too wide for int32 device"
+            return None
+        # multi-key: pack the bounded mixed-direction keys into ONE int32
+        # lexicographic composite (copr/topnpack.py) — DESC via
+        # complement, NULL ordering as dedicated codes; ties resolve by
+        # row order on both paths (top_k is index-stable, the host merge
+        # sort above is a stable lexsort)
+        from . import topnpack as TP
+        keys = []
+        for e, desc in items:
+            key = _subst_proj_cols(e, dag.projections) \
+                if dag.projections else e
+            keys.append((key, desc))
+        specs, reason = TP.plan_pack(keys, col_bounds)
+        if specs is None:
+            return reason
+        TP.stage_rank_tables(specs, prepared)
+        prepared["__topn_pack__"] = specs
+        prepared["__sig__"].append(("topnpack",) + TP.pack_sig(specs))
         return None
 
     def _scan_dicts(self, dag: CopDAG, snap: TableSnapshot) -> list[Optional[Dictionary]]:
@@ -1096,7 +1118,8 @@ class CopClient:
         expr, desc = dag.topn.items[0]
         n = dag.topn.n
         bucket = tiles[0][1].shape[0]
-        key = ("topn", _dag_key(dag, prepared), bucket, n, desc)
+        key = ("topn", _dag_key(dag, prepared), bucket, n,
+               tuple(d for _, d in dag.topn.items))
         kern = self._kernel(key, lambda: self._build_topn_kernel(
             dag, prepared, expr, desc, n))
         with obs.stage("kernel", span_name="device.dispatch"):
@@ -1156,25 +1179,36 @@ class CopClient:
             exprs = [Col(ci, ft) for ci, ft in enumerate(dag.output_types)]
         out_types = dag.output_types
 
+        pack = prepared.get("__topn_pack__")
+
         def kernel(cols, row_mask):
             cols = widen32(cols)
             mask = row_mask
             if sel is not None:
                 mask = selection_mask(sel.conditions, cols, prepared, mask)
-            v, vl = eval_expr(expr, cols, prepared)
-            # dropped rows must score strictly below NULL-key rows (DESC
-            # sorts NULLs last but they still belong in the result)
-            if jnp.issubdtype(v.dtype, jnp.floating):
-                null_score = jnp.inf if not desc else -jnp.finfo(
-                    jnp.float32).max
-                drop_score = -jnp.inf
-                score = jnp.where(vl, v if desc else -v, null_score)
+            if pack is not None:
+                # multi-key lexicographic composite (>= 0 by
+                # construction); dropped rows take the int32 floor
+                from . import topnpack as TP
+                comp = TP.composite_score(pack, cols, prepared, eval_expr)
+                score = jnp.where(mask, comp, jnp.iinfo(jnp.int32).min)
             else:
-                v32 = v.astype(jnp.int32)
-                null_score = _I32_MAX if not desc else _I32_MIN
-                drop_score = jnp.iinfo(jnp.int32).min
-                score = jnp.where(vl, v32 if desc else -v32, null_score)
-            score = jnp.where(mask, score, drop_score)
+                v, vl = eval_expr(expr, cols, prepared)
+                # dropped rows must score strictly below NULL-key rows
+                # (DESC sorts NULLs last but they still belong in the
+                # result)
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    null_score = jnp.inf if not desc else -jnp.finfo(
+                        jnp.float32).max
+                    drop_score = -jnp.inf
+                    score = jnp.where(vl, v if desc else -v, null_score)
+                else:
+                    v32 = v.astype(jnp.int32)
+                    null_score = _I32_MAX if not desc else _I32_MIN
+                    drop_score = jnp.iinfo(jnp.int32).min
+                    score = jnp.where(vl, v32 if desc else -v32,
+                                      null_score)
+                score = jnp.where(mask, score, drop_score)
             k = min(n, score.shape[0])
             _, idx = jax.lax.top_k(score, k)
             # gather the k result rows in-kernel: the packed output is the

@@ -161,6 +161,26 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
 
     mode = "agg" if frag.agg is not None else "rows"
 
+    if mode == "rows" and frag.topn is not None:
+        # join+topn: pack the consumer's ORDER BY into one int32
+        # composite so the fused program returns only the top-n rows per
+        # batch/tile/shard. An unpackable key set degrades to the plain
+        # row-bitmask mode (still fused joins), never to the host.
+        from . import topnpack as TP
+        try:
+            for e, _ in frag.topn.items:
+                cop._prepare_expr(e, comb_dicts, prepared)
+            specs, _reason = TP.plan_pack(frag.topn.items, comb_bounds,
+                                          comb_dicts)
+        except CompileError:
+            specs = None
+        if specs is not None:
+            TP.stage_rank_tables(specs, prepared)
+            prepared["__topn_pack__"] = specs
+            prepared["__sig__"].append(
+                ("topnpack", frag.topn.n) + TP.pack_sig(specs))
+            mode = "topn"
+
     # ---- partitioned (non-broadcast) join election ----
     # a build too large to replicate is sharded by key range; probe rows
     # route to the owning device before the gathers (the MPP hash-
@@ -244,6 +264,44 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
             # is no sorted-run equivalent (no top-k bound to verify)
             raise _Fallback("having-unordered")
 
+    if mode == "hc" and frag.hc is not None and frag.hc.items:
+        # join+agg+topn fused final cut: every ORDER BY item resolved to
+        # a group key / SUM / COUNT (plan/fragment._resolve_hc_items), so
+        # the kernel can sort the candidate buffer by the EXACT multi-key
+        # order (limb-pair digits; dictionary ranks for string group
+        # keys) and ship only k+1 rows per candidate block — the +1 row
+        # proves the cut boundary is tie-free at decode time.
+        from . import topnpack as TP
+        fused = True
+        for kind, idx, _desc in frag.hc.items:
+            if kind == "agg":
+                entry = prepared["__hc_sched__"][idx]
+                if not TP.digits_fit(entry) or \
+                        TP.count_pairs(entry) > TP.MAX_DIGIT_PAIRS:
+                    fused = False
+                    break
+            else:
+                g = frag.agg.group_by[idx]
+                if g.ftype.is_string and (
+                        not isinstance(g, Col)
+                        or comb_dicts[g.idx] is None):
+                    fused = False
+                    break
+        if fused:
+            prepared["__hc_fused__"] = True
+            for kind, idx, _desc in frag.hc.items:
+                if kind != "group":
+                    continue
+                g = frag.agg.group_by[idx]
+                if not g.ftype.is_string:
+                    continue
+                d = comb_dicts[g.idx]
+                TP.stage_rank_table(prepared, ("hc_rank", idx), d,
+                                    g.ftype.is_ci)
+                prepared["__sig__"].append(("hcrank", idx, len(d)))
+            prepared["__sig__"].append(
+                ("fat", frag.hc.k, tuple(frag.hc.items)))
+
     # ---- staging ----
     from .. import obs
     builds = []
@@ -280,8 +338,9 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                                       builds, overlay=True, mode=mode))
     if not chunks:
         chunks = [_empty_chunk(frag, comb_dicts)]
+    emode = "fat" if prepared.get("__hc_fused__") else mode
     return CopResult(chunks, is_partial_agg=frag.agg is not None,
-                     engine=cop._frag_engine(mode))
+                     engine=cop._frag_engine(emode))
 
 
 def _mask_digest_of(mask):
@@ -343,6 +402,10 @@ def _mode_op(frag, mode: str) -> str:
     fused composition (the tree's dominant consumers) — a join+agg
     kernel's milliseconds must not masquerade as plain scan time."""
     if mode == "hc":
+        if frag.hc is None:  # HAVING-filtered candidate path
+            return "join+agg" if frag.joins else "agg"
+        return "join+agg+topn" if frag.joins else "agg+topn"
+    if mode == "topn":
         return "join+topn" if frag.joins else "topn"
     if mode == "agg":
         return "join+agg" if frag.joins else "agg"
@@ -360,7 +423,7 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     # untiled 60M-row fragment kernel plans ~16GB of HBM intermediates
     # and fails to compile. The rank-space hc kernel streams internally
     # (bounded VMEM window) and keeps whole-epoch staging.
-    if mode in ("agg", "rows") and not overlay and \
+    if mode in ("agg", "rows", "topn") and not overlay and \
             getattr(cop, "frag_axis", None) is None and \
             prepared.get("__part_join__") is None and \
             psnap.epoch.num_rows > cop.TILE_ROWS:
@@ -415,6 +478,9 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
         return [] if chunk is None else [chunk]
     if mode == "agg":
         return _decode_frag_agg(frag, snaps, prepared, out)
+    if mode == "topn":
+        chunk = _decode_frag_topn(frag, snaps, out)
+        return [] if chunk is None else [chunk]
 
     # row mode: device returned a packed probe-row bitmask; host replays
     # the (cheap, vectorized) gathers for the passing rows only
@@ -472,6 +538,15 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
             out = _merge_tile_outs(outs, prepared["__agg_sched__"])
         return _decode_frag_agg(frag, snaps, prepared, out)
 
+    if mode == "topn":
+        # per-tile candidate rows; the host Sort/Limit above merge them
+        chunks = []
+        for out in outs:
+            c = _decode_frag_topn(frag, snaps, out)
+            if c is not None:
+                chunks.append(c)
+        return chunks
+
     # rows: per-tile packed bitmasks -> global epoch row indices
     T = cop.TILE_ROWS
     idx_parts = []
@@ -504,6 +579,42 @@ def _decode_frag_agg(frag, snaps, prepared, out) -> list[Chunk]:
         frag.agg, prepared, cards, out, group_dicts,
         frag.output_types[len(frag.agg.group_by):])
     return [] if chunk is None else [chunk]
+
+
+def _decode_frag_topn(frag, snaps, out) -> Optional[Chunk]:
+    """Fetched top-n candidate rows -> one tree-order chunk (mirrors
+    client._topn_decode); the host Sort/Limit above merge the candidate
+    chunks from batches/tiles/shards exactly. String columns come back
+    as dictionary codes and decode here, after the cut."""
+    ints = np.asarray(out["ints"])
+    flts = out.get("flts")
+    if flts is not None:
+        flts = np.asarray(flts)
+    picked = ints[1].astype(bool)
+    if not picked.any():
+        return None
+    comb_dicts = []
+    for t in frag.tables:
+        snap = snaps[t.table.id]
+        comb_dicts.extend(snap.dictionaries[off] for off in t.col_offsets)
+    columns = []
+    ii = fi = 0
+    for pos, comb in enumerate(frag.out_map):
+        ft = frag.output_types[pos]
+        if ft.is_float:
+            data = flts[fi][picked]
+            valid = flts[fi + 1][picked] > 0
+            fi += 2
+        else:
+            data = ints[2 + ii][picked]
+            valid = ints[2 + ii + 1][picked].astype(bool)
+            ii += 2
+        columns.append(Column(
+            ft, data.astype(ft.np_dtype),
+            None if valid.all() else valid, comb_dicts[comb]))
+    if not columns:
+        return None
+    return Chunk(columns)
 
 
 def _stage_rank_aux(cop, snap, prepared):
@@ -605,6 +716,7 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
 
     nulls: list[int] = []
     spans_ = []
+    los: list[int] = []
     for g in frag.agg.group_by:
         if g.ftype.is_float:
             return False
@@ -615,6 +727,7 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
             return False
         nulls.append(b[1] + 1)
         spans_.append(b[1] - b[0])
+        los.append(b[0])
 
     # ---- segment-key selection (functional dependencies) ----
     # XLA's variadic sort compile time grows steeply with operand count,
@@ -696,8 +809,32 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
                     det |= need
     if not seg_keys:
         seg_keys = [0]
+    segpack = None
     if len(seg_keys) > 2:
-        return False
+        # group-key packing: fold several segment keys into one int32
+        # sort operand when their (span+2) code-space products fit —
+        # XLA's variadic sort keeps <= 2 key operands instead of the
+        # whole query rejecting to the host. Packing is a bijection on
+        # the key tuples, which is all segment_bounds needs (equal
+        # tuples stay contiguous in the sorted order).
+        groups: list[list[int]] = []
+        cur: list[int] = []
+        prod = 1
+        for gi in seg_keys:
+            card = spans_[gi] + 2
+            if card > 2**31 - 2:
+                return False
+            if prod * card > 2**31 - 2 and cur:
+                groups.append(cur)
+                cur, prod = [], 1
+            cur.append(gi)
+            prod *= card
+        groups.append(cur)
+        if len(groups) > 2:
+            return False
+        segpack = [[(gi, los[gi], spans_[gi] + 2) for gi in g]
+                   for g in groups]
+    prepared["__hc_segpack__"] = segpack
     sched: list[dict] = []
     for d in frag.agg.aggs:
         if d.arg is None or d.func == "count":
@@ -720,6 +857,7 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
                       for t, s in terms],
         })
     prepared["__hc_nulls__"] = nulls
+    prepared["__hc_los__"] = los
     prepared["__hc_sched__"] = sched
     prepared["__hc_segkeys__"] = seg_keys
     # run-order eligibility: when every segment key resolves to a plain
@@ -763,7 +901,9 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
         (frag.hc.score, frag.hc.desc, frag.hc.cap) if frag.hc
         else ("having", tuple(frag.having or ())),
         tuple(nulls),
+        tuple(los),  # the fused cut's sentinel-fold branches key on lo
         tuple(seg_keys),
+        tuple(tuple(g) for g in segpack) if segpack else None,
         tuple((s["kind"],) + tuple((repr(t), sh, L)
                                    for t, sh, L in s.get("terms", ()))
               for s in sched)))
@@ -876,9 +1016,120 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
             if overflow_j is not None:
                 res["overflow"] = overflow_j
             return res
+        if mode == "topn":
+            # fused multi-key TopN: ONE int32 composite ranks the joined
+            # rows, and the n winners' output columns gather in-kernel —
+            # the packed candidate rows are the only device->host bytes
+            from . import topnpack as TP
+            comp = TP.composite_score(prepared["__topn_pack__"], cols,
+                                      prepared, eval_expr)
+            score = jnp.where(mask, comp, jnp.iinfo(jnp.int32).min)
+            k = min(frag.topn.n, score.shape[0])
+            _, idx = jax.lax.top_k(score, k)
+            int_rows = [idx.astype(jnp.int32),
+                        mask[idx].astype(jnp.int32)]
+            flt_rows = []
+            for pos, comb in enumerate(frag.out_map):
+                d, v = cols[comb]
+                pvk = d[idx]
+                pvlk = (v & mask)[idx]
+                if frag.output_types[pos].is_float:
+                    flt_rows.append(pvk.astype(jnp.float32))
+                    flt_rows.append(pvlk.astype(jnp.float32))
+                else:
+                    int_rows.append(pvk.astype(jnp.int32))
+                    int_rows.append(pvlk.astype(jnp.int32))
+            res = {"ints": jnp.stack(int_rows)}
+            if flt_rows:
+                res["flts"] = jnp.stack(flt_rows)
+            return res
         return jnp.packbits(mask)
 
     return kernel if raw else jax.jit(kernel)
+
+
+def _maybe_fused_cut(frag, prepared, res):
+    """Device-side exact final ordering for the fused join+agg+topn
+    mode: sort the candidate buffer by the COMPLETE ORDER BY — exact
+    limb-pair digit comparison for SUM/COUNT items (topnpack.pair_digits),
+    rank/complement codes for group keys, MySQL NULL placement as a flag
+    component, candidate order as the final tie-break — then truncate
+    the heavy arrays to k+1 rows per candidate block, so only the
+    winning groups (plus one boundary witness) leave HBM. `picked` and
+    `score` stay cap-length in sorted order: the decode's per-block
+    soundness check still needs the full buffer-exhaustion picture."""
+    if not prepared.get("__hc_fused__"):
+        return res
+    from . import topnpack as TP
+
+    sched = prepared["__hc_sched__"]
+    nulls = prepared["__hc_nulls__"]
+    los = prepared.get("__hc_los__", ())
+    cap = res["picked"].shape[0]
+    i32 = np.iinfo(np.int32)
+    keys = [jnp.int32(1) - res["picked"]]  # picked candidates lead
+    for kind, idx, desc in frag.hc.items:
+        if kind == "group":
+            enc = res[f"gk{idx}"]
+            isnull = enc == jnp.int32(nulls[idx])
+            table = prepared.get(("hc_rank", idx))
+            val = table[jnp.clip(enc, 0, table.shape[0] - 1)] \
+                if table is not None else enc
+            # DESC reverses with ~val (= -1 - val): order-reversing and
+            # wrap-free over the whole int32 range, unlike negation
+            # (which wraps at INT32_MIN). NULL folds into the value
+            # operand when the sentinel cannot collide with a real
+            # (transformed) value: any lo > INT32_MIN leaves one code
+            # free at each end; a key that can hold INT32_MIN itself
+            # (fits_int32 admits it) keeps a separate flag operand.
+            lo = los[idx] if idx < len(los) else None
+            safe = table is not None or (lo is not None
+                                         and lo > i32.min)
+            if desc:  # NULL last; larger value first
+                rev = jnp.int32(-1) - val
+                if safe:
+                    keys.append(jnp.where(isnull, jnp.int32(i32.max),
+                                          rev))
+                else:
+                    keys.append(jnp.where(isnull, 1, 0))
+                    keys.append(jnp.where(isnull, 0, rev))
+            else:     # NULL first; smaller value first
+                if safe:
+                    keys.append(jnp.where(isnull, jnp.int32(i32.min),
+                                          val))
+                else:
+                    keys.append(jnp.where(isnull, 0, 1))
+                    keys.append(jnp.where(isnull, 0, val))
+            continue
+        s_ = sched[idx]
+        if s_["kind"] == "count":
+            contribs = [(0, res[f"cnt{idx}"])]
+            isnull = None  # COUNT is never NULL
+        else:
+            contribs = [(sh, res[f"s{idx}_{ti}"])
+                        for ti, (_t, sh, _L) in enumerate(s_["terms"])]
+            cntp = res[f"cnt{idx}"]
+            cnt = cntp[0, 0] * jnp.int32(4096) + cntp[0, 1]
+            isnull = cnt == 0  # SUM over no valid rows is NULL
+        dks = TP.digit_sort_keys(TP.pair_digits(contribs), desc)
+        if isnull is not None:
+            # the signed head is carry-bounded well inside int32, so the
+            # NULL sentinel folds into it (first-ASC / last-DESC)
+            sent = jnp.int32(i32.max if desc else i32.min)
+            dks = [jnp.where(isnull, sent, dks[0])] + \
+                [jnp.where(isnull, 0, dk) for dk in dks[1:]]
+        keys.extend(dks)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    perm = jax.lax.sort(tuple(keys) + (iota,),
+                        num_keys=len(keys) + 1)[-1]
+    kcut = min(cap, frag.hc.k + 1)
+    cut = {}
+    for name, v in res.items():
+        if name in ("picked", "score"):
+            cut[name] = v[perm]
+        else:
+            cut[name] = v[..., perm[:kcut]]
+    return cut
 
 
 def _hc_rank_body(frag, prepared, cols, mask, aux):
@@ -1009,7 +1260,7 @@ def _hc_rank_body(frag, prepared, cols, mask, aux):
     for gi in range(len(agg.group_by)):
         res[f"gk{gi}"] = encs[gi][rows_of]
     _emit_pairs(res, sched, term_ix, cnt_ix, tot, cand)
-    return res
+    return _maybe_fused_cut(frag, prepared, res)
 
 
 def _emit_pairs(res, sched, term_ix, cnt_ix, tot, cand):
@@ -1071,9 +1322,23 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
         is_start, end_idx = HC.segment_bounds(sk, jnp.ones(n, bool))
         valid = None
     else:
+        segpack = prepared.get("__hc_segpack__")
+        if segpack is not None:
+            # packed operands: Horner over the NULL-encoded shifted
+            # codes — a bijection on the key tuples, so boundaries and
+            # grouping are exactly the multi-operand sort's
+            operands = []
+            for grp in segpack:
+                k = None
+                for gi, lo, card in grp:
+                    code = encs[gi] - jnp.int32(lo)
+                    k = code if k is None else \
+                        k * jnp.int32(card) + code
+                operands.append(k)
+        else:
+            operands = [encs[gi] for gi in seg_keys]
         sort_keys = []
-        for pos, gi in enumerate(seg_keys):
-            k = encs[gi]
+        for pos, k in enumerate(operands):
             if pos == 0:
                 k = jnp.where(mask, k, HC._I32_MAX)
             sort_keys.append(k)
@@ -1178,7 +1443,7 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
         res[f"cnt{ai}"] = out[f"hc_cnt{ai}"][:, :, cand]
         for ti in range(len(s.get("terms", ()))):
             res[f"s{ai}_{ti}"] = out[f"hc_s{ai}_{ti}"][:, :, cand]
-    return res
+    return _maybe_fused_cut(frag, prepared, res)
 
 
 def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
@@ -1202,7 +1467,65 @@ def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
             picked, out["score"], frag.hc.k,
             prepared.get("__hc_blocks__", 1)):
         raise _Fallback("hc-boundary")
+    if prepared.get("__hc_fused__"):
+        return _decode_fat(frag, snaps, prepared, out)
     return _decode_hc_rows(frag, snaps, prepared, out, picked)
+
+
+def _decode_fat(frag, snaps, prepared, out) -> Optional[Chunk]:
+    """Fused-cut candidates -> the final k groups per candidate block.
+
+    The kernel shipped each block's candidates in EXACT final order with
+    the heavy arrays truncated to k+1 rows; take the first
+    min(picked, k) rows per block and verify the cut boundary is
+    tie-free on every ORDER BY item (row k-1 must differ from row k) —
+    an all-key tie is ambiguous against the host's stable sort and falls
+    back to the exact host interpreter."""
+    from . import sumexact as _SE
+
+    k = frag.hc.k
+    blocks = max(1, int(prepared.get("__hc_blocks__", 1)))
+    picked_full = np.asarray(out["picked"]).astype(bool)
+    cap = len(picked_full) // blocks
+    probe = out.get("gk0")
+    if probe is None:
+        probe = out.get("cnt0")
+    kcut = np.asarray(probe).shape[-1] // blocks
+
+    def row_key(block: int, pos: int) -> tuple:
+        p = block * kcut + pos
+        vals: list = []
+        for kind, idx, _desc in frag.hc.items:
+            if kind == "group":
+                vals.append(int(np.asarray(out[f"gk{idx}"])[p]))
+                continue
+            s_ = prepared["__hc_sched__"][idx]
+            cnt = int(_SE.combine_partials(
+                np.asarray(out[f"cnt{idx}"])[:, :, p:p + 1])[0])
+            if s_["kind"] == "count":
+                vals.append(cnt)
+                continue
+            v = 0
+            for ti, (_t, sh, _L) in enumerate(s_["terms"]):
+                v += int(_SE.combine_partials(
+                    np.asarray(out[f"s{idx}_{ti}"])[:, :, p:p + 1])[0]) \
+                    << sh
+            vals.append((cnt == 0, v))  # NULL flag + exact value
+        return tuple(vals)
+
+    sel = np.zeros(blocks * kcut, dtype=bool)
+    for b in range(blocks):
+        npicked = int(picked_full[b * cap:(b + 1) * cap].sum())
+        take = min(npicked, k, kcut)
+        if npicked > k and kcut > k and \
+                row_key(b, k - 1) == row_key(b, k):
+            raise _Fallback("fat-boundary")
+        sel[b * kcut: b * kcut + take] = True
+    if not sel.any():
+        return None
+    heavy = {name: v for name, v in out.items()
+             if name not in ("picked", "score")}
+    return _decode_hc_rows(frag, snaps, prepared, heavy, sel)
 
 
 def _decode_hc_rows(frag, snaps, prepared, out, picked) -> Chunk:
@@ -1265,6 +1588,10 @@ def _frag_key(frag: FragmentDAG) -> str:
         parts.append(repr(frag.agg.aggs))
     if frag.out_map is not None:
         parts.append(repr(frag.out_map))
+    if frag.topn is not None:
+        parts.append(f"topn{frag.topn.n}|{frag.topn.items!r}")
+    if frag.hc is not None:
+        parts.append(f"hc{frag.hc.k}|{frag.hc.items!r}")
     return "|".join(parts)
 
 

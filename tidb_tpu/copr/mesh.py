@@ -845,6 +845,20 @@ class MeshCopClient(DistCopClient):
                 hc_body, mesh=self.mesh,
                 in_specs=(P(AXIS), P(AXIS), build_specs),
                 out_specs=(specs, P(AXIS))))
+        elif mode == "topn":
+            # fused join+topn: per-shard top-n candidate rows concatenate
+            # along the k axis; survivors are not observable outside the
+            # candidate cut, so only input balance is recorded
+            def tp_body(pcols, pvis, builds):
+                res = kernel(pcols, pvis, builds)
+                stats = _stat_pair(jnp.sum(pvis.astype(jnp.int32)),
+                                   jnp.int32(-1))
+                return res, stats
+
+            fn = jax.jit(shard_map(
+                tp_body, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), build_specs),
+                out_specs=(P(None, AXIS), P(AXIS))))
         else:
             # rows mode: the packed bitmask is already P(AXIS)-sharded;
             # each device's slice popcounts to its survivors at collect

@@ -1,0 +1,273 @@
+"""Multi-key TopN on device: lexicographic composites of bounded keys.
+
+The device TopN kernel ranks rows with jax.lax.top_k over ONE int32
+score (64-bit-free device; see copr/client.py module docstring). A
+multi-key ORDER BY therefore needs the sort items packed into a single
+int32 composite that order-embeds the lexicographic (item1, item2, ...)
+comparison. This module is that packing:
+
+* every key gets a dense "goodness" code in [0, card): larger code =
+  earlier in the result. ASC keys complement against the upper bound
+  (hi - v), DESC keys shift by one (v - lo + 1) — per-key [lo, hi]
+  bounds come from the host interval analysis (copr/bounds.py), which
+  covers epoch AND overlay values, so one packing serves both batches;
+* MySQL NULL ordering (first in ASC, last in DESC) is a dedicated code
+  at the top (ASC) or bottom (DESC) of each key's range;
+* dictionary-encoded string keys are admitted through an
+  order-preserving rank table (Dictionary.sort_ranks — the same ranks
+  the host sort uses, so device and host agree exactly, including the
+  *_ci collation family); codes are ranks, decode happens on the host
+  after the TopN cut;
+* the composite is a Horner accumulation code_1·card_2·…·card_n + … ;
+  it packs iff Π card_i fits int32 — the gate reason names the width.
+
+Ties on every packed key resolve by ROW ORDER on both paths: top_k is
+index-stable and the host merge sort above is a stable lexsort, so the
+device candidate set is bit-identical to the host's.
+
+The second half of the module serves the fused join+agg+topn cut: exact
+per-candidate aggregate values arrive as 12-bit limb PAIR sums
+(sumexact.py layout, value = Σ_t 2^shift_t · Σ_l 2^(12l) · (hi·4096+lo))
+and must be compared exactly on a 64-bit-free device. `pair_digits`
+re-normalizes them into canonical base-4096 digit vectors (signed head)
+whose componentwise comparison IS the numeric comparison, so
+jax.lax.sort over the digit operands ranks candidates exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..plan.expr import Col, PlanExpr
+
+# composite must stay strictly inside int32: top_k's drop sentinel is
+# I32_MIN and every packed score is >= 0
+PACK_CAP = 2**31 - 2
+
+# max (term, limb) pair count the digit accumulator admits: per-digit
+# partial sums are < pairs * 2^27 before carry normalization and must
+# not wrap int32
+MAX_DIGIT_PAIRS = 8
+
+N_DIGITS = 7  # base-4096 digits cover the planner's 2^62 sum gate
+# (a top limb at weight 2^61 with a sub-limb shift spills one digit up)
+
+_LIMB_BITS = 12
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+
+
+def plan_pack(items, col_bounds, dicts=None):
+    """Pack plan for sort items resolved to the evaluation column space.
+
+    items: [(expr, desc)]; col_bounds: per-column host interval bounds;
+    dicts: per-column dictionaries for string keys (None rejects them).
+    Returns (specs, None) on success or (None, reason)."""
+    from .bounds import expr_bounds, expr_device_safe
+
+    specs: list[dict[str, Any]] = []
+    prod = 1
+    for e, desc in items:
+        if e.ftype.is_float:
+            return None, "float key in multi-key TopN is host-side"
+        if e.ftype.is_string:
+            if not isinstance(e, Col) or dicts is None or \
+                    e.idx >= len(dicts) or dicts[e.idx] is None:
+                return None, "computed string TopN key is host-side"
+            d = dicts[e.idx]
+            card = len(d) + 1  # ranks 0..len-1 plus the NULL slot
+            if card < 2:
+                card = 2
+            specs.append({"expr": e, "desc": bool(desc), "kind": "rank",
+                          "dict": d, "ci": bool(e.ftype.is_ci),
+                          "card": card})
+        else:
+            if not expr_device_safe(e, col_bounds):
+                return None, "TopN key too wide for int32 device"
+            b = expr_bounds(e, col_bounds)
+            if b is None:
+                return None, "unbounded multi-key TopN key"
+            lo, hi = int(b[0]), int(b[1])
+            card = hi - lo + 2  # value span plus the NULL slot
+            specs.append({"expr": e, "desc": bool(desc), "kind": "int",
+                          "lo": lo, "hi": hi, "card": card})
+        prod *= card
+        if prod > PACK_CAP:
+            return None, (f"multi-key TopN space {prod} too wide to "
+                          "pack int32")
+    return specs, None
+
+
+def pack_sig(specs) -> tuple:
+    """Deterministic cache-key payload for a pack plan (dictionaries are
+    append-only, so their lengths capture rank-table identity)."""
+    out = []
+    for s in specs:
+        if s["kind"] == "rank":
+            out.append(("rank", s["desc"], len(s["dict"]), s["ci"]))
+        else:
+            out.append(("int", s["desc"], s["lo"], s["hi"]))
+    return tuple(out)
+
+
+def stage_rank_table(prepared: dict, key, d, ci: bool) -> None:
+    """Host-side: stash one dictionary's order-preserving rank table in
+    `prepared` under `key` for a kernel closure (mirrors the LIKE
+    code-table staging in client._prepare_expr). Shared by the packed
+    TopN keys and the fused hc cut's string group items."""
+    ranks = d.sort_ranks(ci=ci)
+    prepared[key] = jnp.asarray(ranks) if len(ranks) \
+        else jnp.zeros(1, dtype=jnp.int32)
+
+
+def stage_rank_tables(specs, prepared: dict) -> None:
+    """Resolve every string key's rank table for a pack plan."""
+    for i, s in enumerate(specs):
+        if s["kind"] == "rank":
+            stage_rank_table(prepared, ("topn_rank", i), s["dict"],
+                             s["ci"])
+
+
+def composite_score(specs, cols, prepared, eval_fn) -> jnp.ndarray:
+    """int32 composite over the evaluated keys: larger = earlier in the
+    result. Garbage lanes (invalid/padded) are clipped before packing so
+    jnp.where's eager branches cannot overflow; masked-out rows are the
+    caller's job (replace with the drop sentinel)."""
+    comp: Optional[jnp.ndarray] = None
+    for i, s in enumerate(specs):
+        v, vl = eval_fn(s["expr"], cols, prepared)
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.int32)
+        v = v.astype(jnp.int32)
+        if s["kind"] == "rank":
+            table = prepared[("topn_rank", i)]
+            d_len = table.shape[0]
+            r = table[jnp.clip(v, 0, d_len - 1)]
+            if s["desc"]:
+                code = jnp.where(vl, r + 1, 0)
+            else:
+                code = jnp.where(vl, jnp.int32(d_len - 1) - r,
+                                 jnp.int32(d_len))
+        else:
+            lo, hi = s["lo"], s["hi"]
+            vc = jnp.clip(v, lo, hi)
+            if s["desc"]:
+                code = jnp.where(vl, vc - jnp.int32(lo) + 1, 0)
+            else:
+                code = jnp.where(vl, jnp.int32(hi) - vc,
+                                 jnp.int32(hi - lo + 1))
+        comp = code if comp is None else \
+            comp * jnp.int32(s["card"]) + code
+    assert comp is not None
+    return comp
+
+
+# ==================== exact limb-pair digit comparison ====================
+
+def digits_fit(sched_entry: dict) -> bool:
+    """True when every (term, limb) weight of the schedule entry lands
+    inside the N_DIGITS digit window (pair_digits would raise)."""
+    if sched_entry["kind"] == "count":
+        return True
+    for _t, shift, L in sched_entry.get("terms", ()):
+        for li in range(L):
+            q, r = divmod(_LIMB_BITS * li + int(shift), _LIMB_BITS)
+            if (q if r == 0 else q + 1) >= N_DIGITS:
+                return False
+    return True
+
+
+def count_pairs(sched_entry: dict) -> int:
+    """(term, limb) pair count of one agg schedule entry — the digit
+    accumulator's overflow budget (MAX_DIGIT_PAIRS)."""
+    if sched_entry["kind"] == "count":
+        return 1
+    return sum(L for _, _, L in sched_entry.get("terms", ()))
+
+
+def pair_digits(contribs) -> list[jnp.ndarray]:
+    """Exact canonical digits of Σ_t 2^shift_t · value(pairs_t).
+
+    contribs: [(shift, pairs)] with pairs int32[L, 2, n] in the
+    sumexact layout (limb l value = hi·4096 + lo, hi ≤ n/4096,
+    lo < 2^25, top limb signed). Returns N_DIGITS int32[n] arrays
+    MOST-significant first: all but the head are canonical [0, 4096)
+    digits, the head keeps the sign — componentwise (head signed, rest
+    unsigned) lexicographic comparison equals numeric comparison."""
+    digits = [None] * N_DIGITS
+
+    def acc(q, arr):
+        if digits[q] is None:
+            digits[q] = arr
+        else:
+            digits[q] = digits[q] + arr
+
+    for shift, pairs in contribs:
+        L = pairs.shape[0]
+        for li in range(L):
+            limb_val = pairs[li, 0] * jnp.int32(1 << _LIMB_BITS) + \
+                pairs[li, 1]
+            q, r = divmod(_LIMB_BITS * li + int(shift), _LIMB_BITS)
+            if q >= N_DIGITS:
+                raise ValueError("digit span exceeds N_DIGITS")
+            if r == 0:
+                acc(q, limb_val)
+            else:
+                # split the shifted limb across two digits without ever
+                # materializing the (int32-overflowing) shifted value
+                low = (limb_val & ((1 << (_LIMB_BITS - r)) - 1)) << r
+                high = limb_val >> (_LIMB_BITS - r)  # arithmetic: sign
+                acc(q, low)
+                if q + 1 >= N_DIGITS:
+                    raise ValueError("digit span exceeds N_DIGITS")
+                acc(q + 1, high)
+
+    shape = None
+    for d in digits:
+        if d is not None:
+            shape = d.shape
+            break
+    assert shape is not None
+    zero = jnp.zeros(shape, jnp.int32)
+    carry = zero
+    out = []
+    for i in range(N_DIGITS):
+        t = (digits[i] if digits[i] is not None else zero) + carry
+        if i < N_DIGITS - 1:
+            out.append(t & _LIMB_MASK)
+            carry = t >> _LIMB_BITS  # arithmetic shift: floor carry
+        else:
+            out.append(t)  # signed head absorbs the final carry
+    out.reverse()
+    return out
+
+
+def digit_sort_keys(digs, desc: bool) -> list[jnp.ndarray]:
+    """Ascending-sort keys for a digit vector: packed pairs of canonical
+    digits (24 bits per int32 operand — halves the variadic-sort operand
+    count, whose XLA compile time is the binding constraint), identity
+    for ASC (smaller value first), componentwise reversal for DESC. The
+    signed head negates; a packed pair p = a·4096+b complements to
+    (2^24-1) - p, which IS the componentwise (4095-a, 4095-b) pair."""
+    head, rest = digs[0], list(digs[1:])
+    packed = [head]
+    widths = []
+    i = 0
+    while i < len(rest):
+        if i + 1 < len(rest):
+            packed.append(rest[i] * jnp.int32(1 << _LIMB_BITS)
+                          + rest[i + 1])
+            widths.append(2 * _LIMB_BITS)
+            i += 2
+        else:
+            packed.append(rest[i])
+            widths.append(_LIMB_BITS)
+            i += 1
+    if not desc:
+        return packed
+    out = [-head]
+    for w, p in zip(widths, packed[1:]):
+        out.append(jnp.int32((1 << w) - 1) - p)
+    return out

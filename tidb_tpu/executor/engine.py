@@ -19,6 +19,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .. import obs
 from ..chunk.chunk import Chunk
 from ..chunk.column import Column, Dictionary
 from ..copr.client import CopClient
@@ -219,6 +220,7 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         # kernel decision below sees one consistent answer
         with ctx.cop.placement_scope(snap):
             result = ctx.cop.execute(plan.dag, snap)
+        obs.note_engine(result.engine)
         if engine_tag is not None:
             engine_tag[0] = result.engine
         out = Chunk.concat(result.chunks) if result.chunks else \
@@ -245,6 +247,7 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         snaps = {t.table.id: ctx.txn.snapshot(t.table.id)
                  for t in plan.frag.tables}
         result = execute_fragment(ctx.cop, plan.frag, snaps)
+        obs.note_engine(result.engine)
         if engine_tag is not None:
             engine_tag[0] = result.engine
         if not result.chunks:

@@ -1246,7 +1246,7 @@ class StageRecorder:
     operator, fed by the copr client's staging accounting)."""
 
     __slots__ = ("totals", "counts", "op_wall", "ops", "op_bytes",
-                 "op_mesh")
+                 "op_mesh", "engines")
 
     def __init__(self) -> None:
         self.totals: dict[str, float] = {}
@@ -1257,6 +1257,10 @@ class StageRecorder:
         # per-operator mesh balance from the flight recorder:
         # op -> [max shard share (max_shard/total), max skew ratio]
         self.op_mesh: dict[str, list] = {}
+        # engine tag per coprocessor read this statement issued
+        # ("device", "device[fat]@mesh8", "host(fragment:key-span)", ...)
+        # — the path-decision record bench.py persists per timed query
+        self.engines: list[str] = []
 
     def add(self, name: str, seconds: float) -> None:
         self.totals[name] = self.totals.get(name, 0.0) + seconds
@@ -1296,6 +1300,17 @@ class StageRecorder:
             if d > 0:
                 out[k] = d
         return out
+
+
+def note_engine(tag: Optional[str]) -> None:
+    """Record which engine served a coprocessor read (device / host /
+    ranged, with the fragment mode and gate reason embedded) on the
+    statement's recorder — the always-on path-decision surface."""
+    if not tag:
+        return
+    rec = getattr(_stage_tls, "rec", None)
+    if rec is not None:
+        rec.engines.append(tag)
 
 
 def install_stage_recorder(rec: Optional[StageRecorder]) -> None:

@@ -338,6 +338,15 @@ class DistCopClient(CopClient):
                 in_specs=(P(AXIS), P(AXIS), build_specs),
                 out_specs=self._hc_out_specs(prepared))
             return jax.jit(mapped)
+        if mode == "topn":
+            # fused join+topn: each shard ships its own top-n candidate
+            # rows, concatenated along the k axis (n·shards rows total);
+            # the host Sort/Limit above merge exactly
+            mapped = shard_map(
+                kernel, mesh=self.mesh,
+                in_specs=(P(AXIS), P(AXIS), build_specs),
+                out_specs=P(None, AXIS))
+            return jax.jit(mapped)
         # row mode: per-shard packed bitmask; shards are 256-multiples so
         # byte boundaries align and concatenation is the global mask
         mapped = shard_map(

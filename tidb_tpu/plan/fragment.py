@@ -35,7 +35,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..types.field_type import FieldType, TypeKind
-from .dag import DAGAggregation
+from .dag import DAGAggregation, DAGTopN
 from .expr import AggDesc, Call, Col, Const, PlanExpr, ScalarSubq
 from .physical import (
     PhysHashAgg,
@@ -49,6 +49,7 @@ from .physical import (
     _bare_scan,
     _partial_val_type,
     agg_pushable,
+    expr_pushable,
 )
 from .schema import PlanSchema, ResultField
 
@@ -86,11 +87,21 @@ class HCTopN:
     superset of the top-k groups (sorted-run kernel, copr/hcagg.py)
     instead of the full group set. score: ("group", j) ranks by group key
     j; ("agg", ai) ranks by aggregate ai's (approximate) value. The host
-    layers above re-sort exactly."""
+    layers above re-sort exactly.
+
+    `items`, when set, is the COMPLETE resolved ORDER BY list
+    [(kind, idx, desc), ...] with kind in ("group", "agg") — every item
+    ranks by a group key or by an exactly-recombinable SUM/COUNT — and
+    unlocks the fused final cut (copr/fragment.py `fat` mode,
+    join+agg+topn): the device sorts the candidate buffer by the EXACT
+    multi-key order (limb-pair digits for aggregates, rank tables for
+    dictionary strings) so only the final k groups leave HBM. items[0]
+    always matches `score`."""
 
     score: tuple[str, int]
     desc: bool
     k: int
+    items: Optional[list] = None
 
     @property
     def cap(self) -> int:
@@ -111,6 +122,11 @@ class FragmentDAG:
     # row mode: combined idx per output position (tree schema order)
     out_map: Optional[list[int]] = None
     output_types: list[FieldType] = field(default_factory=list)
+    # row mode with a TopN consumer: sort items in COMBINED column space
+    # + the limit — the device returns only the per-batch top n rows
+    # (copr/fragment.py `topn` mode, join+topn); the host Sort/Limit
+    # above merge the per-batch/tile/shard candidates exactly
+    topn: Optional[DAGTopN] = None
     # set when the agg's consumer is a TopN: permits the high-cardinality
     # candidate path when the dense-segment gate rejects the group space
     hc: Optional[HCTopN] = None
@@ -140,6 +156,8 @@ class FragmentDAG:
         if self.agg is not None:
             parts.append(f"agg(groups={len(self.agg.group_by)}, "
                          f"aggs={self.agg.aggs})")
+        if self.topn is not None:
+            parts.append(f"topn({self.topn.n})")
         return " -> ".join(parts)
 
 
@@ -487,6 +505,35 @@ def _having_entries(conds: list[PlanExpr], agg_node: PhysHashAgg):
     return out
 
 
+def _resolve_hc_items(sort_node, proj, agg_node) -> Optional[list]:
+    """Resolve EVERY sort item to ("group", gi, desc) / ("agg", ai, desc)
+    for the fused final cut. Group items may be strings (the executor
+    compares dictionary RANKS, order-preserving) but not floats;
+    aggregate items must be SUM/COUNT — their candidate limb-pair sums
+    recombine exactly on device (AVG would need a rational compare).
+    Returns None when any item falls outside that set."""
+    ngroups = len(agg_node.group_by)
+    out = []
+    for e, desc in sort_node.items:
+        if proj is not None:
+            e = _subst_cols(e, proj.exprs)
+        if not isinstance(e, Col):
+            return None
+        if e.idx < ngroups:
+            if agg_node.group_by[e.idx].ftype.is_float:
+                return None
+            out.append(("group", e.idx, bool(desc)))
+        else:
+            ai = e.idx - ngroups
+            if ai >= len(agg_node.aggs) or \
+                    agg_node.aggs[ai].func not in ("sum", "count") or \
+                    (agg_node.aggs[ai].arg is not None and
+                     agg_node.aggs[ai].arg.ftype.is_float):
+                return None
+            out.append(("agg", ai, bool(desc)))
+    return out
+
+
 def _attach_hc(limit_node, sort_node, proj, agg_node,
                rewritten: PhysHashAgg) -> bool:
     """Resolve the TopN's primary sort item to a device score and attach
@@ -513,6 +560,9 @@ def _attach_hc(limit_node, sort_node, proj, agg_node,
             return False
         score = ("agg", ai)
     frag.hc = HCTopN(score, desc, limit_node.limit)
+    # full ORDER BY list resolvable -> the executor may run the fused
+    # final cut (join+agg+topn) and return only the k winning groups
+    frag.hc.items = _resolve_hc_items(sort_node, proj, agg_node)
     return True
 
 
@@ -526,10 +576,20 @@ def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
     # the agg so the fragment learns its consumer only needs the top-k
     # groups (high-cardinality candidate path); Sort/Limit stay on the
     # host and re-sort the (few) surviving groups exactly.
-    if isinstance(plan, PhysLimit) and plan.offset == 0 and \
-            isinstance(plan.children[0], PhysSort) and \
-            plan.children[0].items:
-        sort_node = plan.children[0]
+    sort_node = None
+    if isinstance(plan, PhysLimit) and plan.offset == 0:
+        node0 = plan.children[0]
+        if isinstance(node0, PhysSort) and node0.items:
+            sort_node = node0
+        elif isinstance(node0, PhysProjection) and \
+                all(isinstance(e, Col) for e in node0.exprs) and \
+                isinstance(node0.children[0], PhysSort) and \
+                node0.children[0].items:
+            # ORDER BY a hidden column: the planner trims it with a
+            # plain-Col projection between Limit and Sort — transparent
+            # to the TopN patterns below
+            sort_node = node0.children[0]
+    if sort_node is not None:
         below = sort_node.children[0]
         proj = None
         if isinstance(below, PhysProjection) and \
@@ -576,6 +636,37 @@ def apply_fragments(plan: PhysicalPlan) -> PhysicalPlan:
                     # with the hc hint — keep the CopDAG pushdown otherwise
                     below.children = old_children
                 return plan
+
+        # TopN over a bare join tree (no aggregation): fuse the joins as
+        # a row fragment CARRYING the sort+limit, so the device's fused
+        # program selects the top-n rows itself (multi-key composite,
+        # copr/topnpack.py) and only n rows per batch/shard leave HBM.
+        # The host Sort+Limit stay above and merge candidates exactly
+        # (a trim projection between them composes into the sort items).
+        # Float keys never pack (f32 order breaks exactness) and huge
+        # limits would dominate the fetch, so both keep the plain row
+        # fragment whose full bitmask the host replays.
+        if plan.limit <= 16384:
+            items = [( _subst_cols(e, proj.exprs) if proj is not None
+                       else e, d) for e, d in sort_node.items]
+            if all(expr_pushable(e) and not _has_subq(e)
+                   and not e.ftype.is_float for e, _ in items):
+                col = _collect_join_tree(below)
+                if col is not None and len(col.leaves) > 1:
+                    asm = _try_assemble(col)
+                    if asm is not None:
+                        frag, remap = asm
+                        frag.out_map = list(remap)
+                        frag.output_types = list(_tree_types(col))
+                        frag.topn = DAGTopN(
+                            [(_remap_expr(e, remap), bool(d))
+                             for e, d in items], plan.limit)
+                        tr = PhysFragmentRead(frag, below.schema)
+                        if proj is not None:
+                            proj.children = [tr]
+                        else:
+                            sort_node.children = [tr]
+                        return plan
 
     # HAVING over an aggregation: push a safely-widened version of the
     # aggregate-vs-constant predicates into the fragment so the device

@@ -118,6 +118,10 @@ class Session:
         # per-operator mesh balance ([max shard share, max skew]) from
         # the flight recorder — empty on single-device statements
         self.last_op_mesh: dict[str, list] = {}
+        # engine tag per coprocessor read ("device[fat]@mesh8",
+        # "host(fragment:key-span)", ...) — the device/host path
+        # decision + gate reason, persisted by bench.py per timed query
+        self.last_engines: list[str] = []
         self._pending_parse_s = 0.0
         # SQL-text plan cache: key -> (invalidation gen, physical plan)
         # (reference: prepared-plan cache, planner/core/common_plans.go +
@@ -402,6 +406,7 @@ class Session:
             self.last_op_stages = rec.ops
             self.last_op_bytes = rec.op_bytes
             self.last_op_mesh = rec.op_mesh
+            self.last_engines = rec.engines
             # worst shard skew of the statement's sharded dispatches
             # (0 = none); surfaces in the slow log + Top SQL
             mesh_skew = 0.0
@@ -1747,16 +1752,18 @@ class Session:
                     # holder may have committed the very value we carry
                     # (reference: pessimistic lock-then-recheck;
                     # tables/index.go unique key constraint via KV)
-                    from ..kv.backoff import (BO_TXN_CONFLICT, Backoffer,
-                                              BackoffExhausted)
+                    from ..kv.backoff import (BO_TXN_CONFLICT, BO_TXN_LOCK,
+                                              Backoffer, BackoffExhausted)
                     from ..kv.mvcc import WriteConflictError as KVConflict
                     lock_keys = [tablecodec.record_key(tid, handle)]
                     lock_keys += self._unique_lock_keys(tinfo, enc)
                     # the Backoffer budget is the SOLE terminator: like
                     # _lock_for_update, exhaustion surfaces the typed
                     # retry history instead of a bare count cap
+                    import time as _time
                     bo = Backoffer(budget_ms=int(timeout * 1000))
                     while True:
+                        t0_lock = _time.monotonic()
                         try:
                             waited = self.storage.pessimistic_lock_keys(
                                 txn, lock_keys, timeout)
@@ -1766,6 +1773,9 @@ class Session:
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
                             checkers.clear()
                             try:
+                                blocked = _time.monotonic() - t0_lock
+                                if blocked > 0.001:
+                                    bo.charge(BO_TXN_LOCK, blocked)
                                 bo.sleep(BO_TXN_CONFLICT)
                             except BackoffExhausted as e:
                                 raise err_wrap(SQLError, e) from None
@@ -1776,6 +1786,18 @@ class Session:
                         if waited:
                             txn.stmt_read_ts = txn.refresh_for_update_ts()
                             checkers.clear()
+                            # time blocked on foreign locks counts against
+                            # the SAME typed budget (as _pessimistic_scan
+                            # does), or adversarial victim churn could
+                            # hold the statement far past
+                            # innodb_lock_wait_timeout — each wait is a
+                            # free extra timeout otherwise
+                            blocked = _time.monotonic() - t0_lock
+                            if blocked > 0.001:
+                                try:
+                                    bo.charge(BO_TXN_LOCK, blocked)
+                                except BackoffExhausted as e:
+                                    raise err_wrap(SQLError, e) from None
                         checker = checker_for(tid)
                         conflicts = checker.conflicts(handle, enc)
                         # REPLACE deletes its victims and ON DUPLICATE
