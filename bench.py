@@ -40,7 +40,12 @@ SQL plane's session-side read: stages_ms / operators_ms / operator
 transfer bytes) is logged as an `attribution <name>: {...}` line and
 stored under the flight result's "attribution" key, and every datagen/
 load phase emits a heartbeat (rows, rows/s, RSS) every 5s — so an OOM
-or timeout kill leaves a diagnosable trail. Environment knobs:
+or timeout kill leaves a diagnosable trail. On any flight failure the
+child persists an inspection snapshot (res["inspection"]: the
+obs_inspect rules over every live store + event-ring tails) into the
+result JSON, and a partial snapshot is re-dumped every 30s so even a
+SIGKILL'd flight (rc=137/rc=124) leaves a diagnosis. The SF100
+north-star flight (tpch_big) runs FIRST. Environment knobs:
 BENCH_SF (10), BENCH_JOIN_SF (10),
 BENCH_SSB_SF (100), BENCH_CB_ROWS (1e8), BENCH_SF_BIG (100),
 BENCH_MESH_ROWS (4e6), BENCH_MESH_DEVICES (8),
@@ -986,15 +991,79 @@ FLIGHTS = {
 }
 
 
+def _inspection_snapshot() -> list:
+    """One inspection pass over every live Storage the flight built
+    (the obs_inspect weak registry): rule findings + the event-ring
+    tail. Best effort — a post-mortem must never raise."""
+    try:
+        from tidb_tpu import obs_inspect
+        return obs_inspect.inspect_all()
+    except BaseException as e:  # noqa: BLE001 — diagnosis is optional
+        return [{"error": f"{type(e).__name__}: {str(e)[:200]}"}]
+
+
 def run_flight_child(name: str, out_path: str) -> None:
     res = {"ok": False, "lines": [], "values": {}}
+
+    # periodic partial dump (atomic tmp+rename): a flight the parent
+    # SIGKILLs at the timeout — or the OOM killer takes — leaves its
+    # latest inspection snapshot in the result file, so rc=124/rc=137
+    # rounds carry a diagnosis instead of just a heartbeat tail. The
+    # lock + stop re-check keep a mid-cycle dump from clobbering the
+    # FINAL result if its join below times out.
+    stop = threading.Event()
+    out_lock = threading.Lock()
+
+    def _dump_partial() -> None:
+        import copy
+
+        while not stop.wait(30.0):
+            try:
+                # deep copy with a retry: the flight thread mutates
+                # res["values"]/res["lines"] concurrently, and a
+                # mid-iteration mutation raises RuntimeError — exactly
+                # during the active phases this snapshot exists for
+                for _ in range(3):
+                    try:
+                        snap = copy.deepcopy(res)
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    continue  # busy dict; catch it next cycle
+                snap["ok"] = False
+                snap["partial"] = True
+                snap["inspection"] = _inspection_snapshot()
+                tmp = out_path + ".part.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f, default=str)
+                with out_lock:
+                    if stop.is_set():
+                        os.unlink(tmp)
+                        return  # the final result owns the file now
+                    os.replace(tmp, out_path)
+            except BaseException:  # noqa: BLE001 — keep flying
+                pass
+
+    dumper = threading.Thread(target=_dump_partial, daemon=True,
+                              name="bench-inspection-dump")
+    dumper.start()
     try:
         FLIGHTS[name](res)
         res["ok"] = True
     except BaseException as e:  # noqa: BLE001 - report, parent decides
         res["error"] = f"{type(e).__name__}: {str(e)[:300]}"
-    with open(out_path, "w") as f:
-        json.dump(res, f)
+        res["inspection"] = _inspection_snapshot()
+    finally:
+        stop.set()
+        dumper.join(timeout=2.0)
+    with out_lock:
+        # atomic like the periodic dumps: a kill landing mid-final-write
+        # must not truncate away the last good partial snapshot
+        tmp = out_path + ".final.tmp"
+        with open(tmp, "w") as f:
+            json.dump(res, f, default=str)
+        os.replace(tmp, out_path)
     if not res["ok"]:
         log(f"flight {name} FAILED: {res.get('error')}")
         sys.exit(1)
@@ -1051,9 +1120,13 @@ def main() -> None:
         baseline_err = f"{type(e).__name__}: {str(e)[:200]}"
         log(f"compiled baseline FAILED: {baseline_err}")
 
+    # tpch_big FIRST: the SF100 north-star flight gets the freshest
+    # machine (PR 9's datagen cache bounds its RSS) instead of paying
+    # for everything that ran before it — two rounds died before the
+    # big flight ever started (r04 rc=137, r05 rc=124)
     flight_names = os.environ.get(
         "BENCH_FLIGHTS",
-        "tpch_small,tpch_big,joins,ssb,cb,multichip").split(",")
+        "tpch_big,tpch_small,joins,ssb,cb,multichip").split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}
     all_lines: list[str] = [
@@ -1097,6 +1170,19 @@ def main() -> None:
         else:
             all_lines.append(
                 f"flight {name} FAILED: {res.get('error', f'rc={rc}')}")
+            # the child's (possibly partial) inspection snapshot: the
+            # diagnosis rides the board, not just the result JSON
+            for snap in res.get("inspection", []) or []:
+                findings = snap.get("findings") or []
+                if snap.get("error"):
+                    all_lines.append(
+                        f"flight {name} inspection: {snap['error']}")
+                for fnd in findings[:8]:
+                    all_lines.append(
+                        f"flight {name} inspection: {fnd.get('rule')}"
+                        f"[{fnd.get('severity')}] {fnd.get('item')} "
+                        f"{fnd.get('value', '')} — "
+                        f"{str(fnd.get('details', ''))[:160]}")
         log(f"flight {name}: {'ok' if res.get('ok') else 'FAILED'} "
             f"in {time.perf_counter() - t0:.0f}s")
         # incremental headline: supersedes earlier lines, survives any

@@ -23,6 +23,8 @@ from tidb_tpu.session import Session
 
 GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
                       "tpch_plans.txt")
+ENGINES_GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                              "engines.txt")
 
 EXTRA_QUERIES = {
     "having_pushdown": (
@@ -79,3 +81,60 @@ def test_tpch_plan_shapes(session):
         raise AssertionError(
             "plan shapes changed (RECORD_GOLDEN=1 to re-record):\n"
             + diff[:8000])
+
+
+@pytest.fixture(scope="module")
+def exec_session():
+    """Execution corpus for the engine-assignment golden: EXACTLY the
+    scale/seed/ddl of tests/test_tpch_full.py (SF 0.003, seed 7, no
+    ANALYZE), so the fused kernels this fixture compiles are the SAME
+    HLO test_tpch_full compiles — the persistent XLA disk cache
+    (tests/conftest.py) makes whichever file runs second nearly free,
+    keeping the tier-1 suite inside its wall-clock budget."""
+    s = Session()
+    data = generate_tpch(0.003, 7)
+    for t in data:
+        load_table(s, t, data[t])
+    return s
+
+
+def _engines(session) -> str:
+    """Per-query engine tags: EXECUTE every TPC-H query and record the
+    per-read path decision (Session.last_engines — device kernel /
+    fused fragment mode / host fallback with the gate's reason). Plan
+    goldens pin the SHAPE; this pins which ENGINE serves each read, so
+    a silent de-devicing (shape intact, host path taken) fails loudly
+    for all 22 queries, not only the Q3/Q5/Q10/Q12 device-path lint."""
+    out = []
+    for name, sql in sorted(TPCH_QUERIES.items()):
+        out.append(f"==== {name} ====")
+        try:
+            session.query(sql)
+            tags = sorted(set(session.last_engines)) or ["(no reads)"]
+        except Exception as e:  # noqa: BLE001 - recorded as golden
+            tags = [f"ERROR: {type(e).__name__}"]
+        out.extend(tags)
+        out.append("")
+    return "\n".join(out)
+
+
+def test_tpch_engine_assignments(exec_session):
+    got = _engines(exec_session)
+    if os.environ.get("RECORD_GOLDEN"):
+        os.makedirs(os.path.dirname(ENGINES_GOLDEN), exist_ok=True)
+        with open(ENGINES_GOLDEN, "w") as f:
+            f.write(got)
+        pytest.skip("golden engine assignments re-recorded")
+    assert os.path.exists(ENGINES_GOLDEN), \
+        "golden file missing - run with RECORD_GOLDEN=1"
+    with open(ENGINES_GOLDEN) as f:
+        want = f.read()
+    if got != want:
+        import difflib
+        diff = "\n".join(difflib.unified_diff(
+            want.splitlines(), got.splitlines(), "golden", "current",
+            lineterm=""))
+        raise AssertionError(
+            "engine assignments drifted — a query moved on/off the "
+            "device path (RECORD_GOLDEN=1 to re-record after an "
+            "intentional gate change):\n" + diff[:8000])

@@ -115,6 +115,62 @@ def test_lint_default_cap_tracks_mesh_size():
     assert not any("cardinality" in f for f in findings), findings
 
 
+def test_inspection_rule_registry_lints_clean():
+    """Every shipped inspection rule declares name/severity/reference
+    (the ISSUE-10 registry contract): a rule without a reference is a
+    finding the operator cannot act on."""
+    from tidb_tpu import obs_inspect
+
+    assert len(obs_inspect.RULES) >= 10, sorted(obs_inspect.RULES)
+    assert obs_inspect.lint_rules() == []
+
+
+def test_inspection_rule_lint_flags_bad_metadata():
+    from tidb_tpu import obs_inspect
+
+    bad = {
+        "Bad Name": obs_inspect.Rule("Bad Name", "warning", "r",
+                                     lambda c: []),
+        "no-ref": obs_inspect.Rule("no-ref", "warning", "",
+                                   lambda c: []),
+        "bad-sev": obs_inspect.Rule("bad-sev", "fatal", "r",
+                                    lambda c: []),
+    }
+    findings = obs_inspect.lint_rules(bad)
+    assert any("kebab-case" in f for f in findings), findings
+    assert any("missing reference" in f for f in findings), findings
+    assert any("severity" in f for f in findings), findings
+    # the decorator refuses bad registrations outright
+    import pytest
+
+    with pytest.raises(ValueError):
+        obs_inspect.rule("x", "warning", "")(lambda c: [])
+    with pytest.raises(ValueError):
+        obs_inspect.rule("x", "fatal", "ref")(lambda c: [])
+    with pytest.raises(ValueError):
+        obs_inspect.rule("mesh-shard-skew", "warning", "ref")(
+            lambda c: [])  # duplicate name
+
+
+def test_metrics_schema_tables_map_to_live_families():
+    """Every metrics_schema table is backed by a live registered
+    counter/gauge family — no dangling tables (the ISSUE-10 tier-1
+    lint)."""
+    from tidb_tpu.catalog import metrics_schema as MS
+
+    st = _exercised_storage()
+    MS.ensure_schema(st)
+    assert MS.lint(st) == []
+    schema = st.catalog.schemas[MS.DB_NAME]
+    assert set(schema.tables) == set(MS.families(st))
+    # a table whose family is gone IS flagged
+    any_info = next(iter(schema.tables.values()))
+    schema.tables["tidb_gone_total"] = any_info
+    findings = MS.lint(st)
+    assert any("dangling" in f and "tidb_gone_total" in f
+               for f in findings), findings
+
+
 def test_registry_type_conflict_still_raises():
     # duplicate registration under a DIFFERENT type stays a hard error
     # at registration time (lint guards the cross-registry case)

@@ -167,6 +167,24 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("duration", FieldType(TypeKind.DOUBLE)),
         ("samples", _bigint()),
     ],
+    # rules-driven automated diagnosis (reference: TiDB 4.0's
+    # executor/inspection_result.go feeding
+    # INFORMATION_SCHEMA.INSPECTION_RESULT / INSPECTION_SUMMARY):
+    # every registered rule in tidb_tpu/obs_inspect.py evaluated over
+    # the live telemetry planes. Empty — with ZERO rule work — while
+    # diagnostics.enabled is false.
+    "inspection_result": [
+        ("rule", _vc(64)), ("item", _vc(128)), ("severity", _vc(16)),
+        ("value", _vc(64)), ("reference", _vc(256)),
+        ("details", _vc(512)),
+    ],
+    # one row per REGISTERED rule: finding count, worst observed
+    # severity, sample items — the registry itself, SQL-queryable
+    "inspection_summary": [
+        ("rule", _vc(64)), ("severity", _vc(16)),
+        ("findings", _bigint()), ("items", _vc(256)),
+        ("reference", _vc(256)),
+    ],
     # counter/gauge time-series rollup from the MetricsHistory ring
     # (reference: TiDB 4.0's metrics schema summarized into
     # INFORMATION_SCHEMA.METRICS_SUMMARY)
@@ -241,6 +259,15 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("sum_latency_ms", FieldType(TypeKind.DOUBLE)),
         ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
         ("sum_result_rows", _bigint()), ("last_seen", _vc(20)),
+        ("error", _vc(256)),
+    ],
+    # cluster-wide automated diagnosis: every member's inspection
+    # findings under one roof, degrading per peer like the other
+    # cluster_* tables
+    "cluster_inspection_result": [
+        ("instance", _vc()), ("rule", _vc(64)), ("item", _vc(128)),
+        ("severity", _vc(16)), ("value", _vc(64)),
+        ("reference", _vc(256)), ("details", _vc(512)),
         ("error", _vc(256)),
     ],
     # device/host telemetry per member (live gauges + counters), for
@@ -488,6 +515,13 @@ def _rows_for(storage, catalog: Catalog, tname: str,
         rows = storage.diag.diag_mesh_storage()["rows"]
     elif tname == "tidb_events":
         rows = storage.diag.diag_events()["rows"]
+    elif tname == "inspection_result":
+        # same producer as the cluster fan-out (minus instance/error)
+        rows = storage.diag.diag_inspection()["rows"]
+        _warn_critical_inspections(rows, viewer)
+    elif tname == "inspection_summary":
+        from .. import obs_inspect
+        rows = obs_inspect.summary_rows(storage)
     elif tname == "metrics_summary":
         hist = getattr(storage, "metrics_history", None)
         if hist is not None:
@@ -500,7 +534,8 @@ def _rows_for(storage, catalog: Catalog, tname: str,
     elif tname in ("cluster_info", "cluster_processlist",
                    "cluster_slow_query", "cluster_statements_summary",
                    "cluster_load", "cluster_top_sql",
-                   "cluster_mesh_shards", "cluster_mesh_storage"):
+                   "cluster_mesh_shards", "cluster_mesh_storage",
+                   "cluster_inspection_result"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)
@@ -563,6 +598,49 @@ def _rows_for(storage, catalog: Catalog, tname: str,
     return rows
 
 
+def publish_store(storage, info: TableInfo, rows: list[list]) -> None:
+    """Build a fresh memtable store COMPLETELY from `rows`, then publish
+    in one assignment — concurrent readers either see the old rows or
+    the new ones, never an empty/missing table mid-refresh. Shared by
+    the information_schema and metrics_schema refresh paths."""
+    from ..store.table_store import TableStore
+
+    store = TableStore(info)
+    store.on_epoch = None
+    n = len(rows)
+    columns: list[np.ndarray] = []
+    valids: list = []
+    for ci, c in enumerate(info.columns):
+        ft = c.ftype
+        data = np.zeros(n, dtype=ft.np_dtype)
+        valid = np.ones(n, dtype=bool)
+        d = store.dictionaries[ci]
+        for ri, row in enumerate(rows):
+            v = row[ci]
+            if v is None:
+                valid[ri] = False
+            elif d is not None:
+                data[ri] = d.encode(str(v))
+            else:
+                data[ri] = v
+        columns.append(data)
+        valids.append(None if valid.all() else valid)
+    store.bulk_load(columns, valids)
+    storage.tables[info.id] = store  # atomic publish
+
+
+def _warn_critical_inspections(rows: list[list], viewer) -> None:
+    """Critical inspection findings ALSO land in SHOW WARNINGS so the
+    operator who just SELECTed sees the red ones without re-filtering."""
+    if viewer is None or not hasattr(viewer, "add_warning"):
+        return
+    for r in rows:
+        if r[2] == "critical":
+            viewer.add_warning(
+                f"inspection: {r[0]} critical on {r[1]} "
+                f"({r[5][:160]})")
+
+
 def refresh(storage, names: set[str], viewer=None) -> None:
     """Rebuild the named information_schema stores from the live catalog.
     `viewer` is the reading Session for the tables whose contents are
@@ -570,35 +648,23 @@ def refresh(storage, names: set[str], viewer=None) -> None:
     ensure_schema(storage)
     cat: Catalog = storage.catalog
     schema = cat.schemas[DB_NAME]
-    from ..store.table_store import TableStore
+
+    # a statement touching BOTH inspection tables gets one rule run
+    # (and one edge-trigger update) shared by the pair — the tables it
+    # reads must agree, and the snapshot build is not free
+    precomputed: dict[str, list[list]] = {}
+    if {"inspection_result", "inspection_summary"} <= names:
+        from .. import obs_inspect
+        res_rows, sum_rows = obs_inspect.result_and_summary_rows(storage)
+        precomputed["inspection_result"] = res_rows
+        precomputed["inspection_summary"] = sum_rows
+        _warn_critical_inspections(res_rows, viewer)
 
     for tname in names:
         if tname not in _DEFS:
             continue
         info = schema.tables[tname]
-        # build the fresh store COMPLETELY, then publish in one assignment
-        # — concurrent readers either see the old rows or the new ones,
-        # never an empty/missing table mid-refresh
-        store = TableStore(info)
-        store.on_epoch = None
-        rows = _rows_for(storage, cat, tname, viewer)
-        n = len(rows)
-        columns: list[np.ndarray] = []
-        valids: list = []
-        for ci, c in enumerate(info.columns):
-            ft = c.ftype
-            data = np.zeros(n, dtype=ft.np_dtype)
-            valid = np.ones(n, dtype=bool)
-            d = store.dictionaries[ci]
-            for ri, row in enumerate(rows):
-                v = row[ci]
-                if v is None:
-                    valid[ri] = False
-                elif d is not None:
-                    data[ri] = d.encode(str(v))
-                else:
-                    data[ri] = v
-            columns.append(data)
-            valids.append(None if valid.all() else valid)
-        store.bulk_load(columns, valids)
-        storage.tables[info.id] = store  # atomic publish
+        rows = precomputed.get(tname)
+        if rows is None:
+            rows = _rows_for(storage, cat, tname, viewer)
+        publish_store(storage, info, rows)

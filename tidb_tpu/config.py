@@ -114,6 +114,30 @@ class MeshSection:
 
 
 @dataclass
+class DiagnosticsConfig:
+    """The `[diagnostics]` TOML section: the automated inspection
+    engine's knobs (tidb_tpu/obs_inspect.py is the runtime owner —
+    field names/defaults MIRROR obs_inspect.DiagnosticsState, mirrored
+    rather than imported so config parsing never pulls the obs import
+    chain; tests/test_inspection.py pins the two definitions equal)."""
+
+    # master switch: false = information_schema.inspection_result /
+    # inspection_summary answer empty with ZERO rule work
+    enabled: bool = True
+    # how many MetricsHistory samples a windowed rule considers (the
+    # window in seconds is this x metrics-history-interval)
+    history_windows: int = 8
+    # mesh skew must persist this many dispatches before it's a finding
+    skew_min_dispatches: int = 2
+    fsync_stall_threshold: int = 3       # stalls/window before a finding
+    heartbeat_stale_ms: int = 10000      # member hb age past this
+    host_fallback_fraction: float = 0.5  # of a digest's stage split
+    governor_kill_threshold: int = 1     # kills/window before a finding
+    admission_shed_threshold: int = 1    # sheds/window before a finding
+    row_eval_threshold: int = 1          # per-row registry rows/window
+
+
+@dataclass
 class PlanCacheConfig:
     enabled: bool = True
     capacity: int = 128
@@ -207,6 +231,8 @@ class Config:
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
     mesh: MeshSection = field(default_factory=MeshSection)
+    diagnostics: DiagnosticsConfig = field(
+        default_factory=DiagnosticsConfig)
     gc: GCConfig = field(default_factory=GCConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
@@ -333,6 +359,25 @@ class Config:
                 "mesh.hbm-bytes must be >= 0 (0 = ask the backend)")
         if self.mesh.shard_ring_cap < 1:
             raise ConfigError("mesh.shard-ring-cap must be >= 1")
+        d = self.diagnostics
+        if d.history_windows < 1:
+            raise ConfigError("diagnostics.history-windows must be >= 1")
+        if d.skew_min_dispatches < 1:
+            raise ConfigError(
+                "diagnostics.skew-min-dispatches must be >= 1")
+        for knob in ("fsync_stall_threshold", "governor_kill_threshold",
+                     "admission_shed_threshold", "row_eval_threshold"):
+            if getattr(d, knob) < 1:
+                raise ConfigError(
+                    f"diagnostics.{knob.replace('_', '-')} "
+                    "must be >= 1")
+        if d.heartbeat_stale_ms < 0:
+            raise ConfigError(
+                "diagnostics.heartbeat-stale-ms must be >= 0 "
+                "(0 disables the staleness check)")
+        if not 0 < d.host_fallback_fraction <= 1:
+            raise ConfigError(
+                "diagnostics.host-fallback-fraction must be in (0, 1]")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -360,6 +405,17 @@ class Config:
         "performance.topsql_window_seconds",
         "performance.topsql_digest_cap",
         "plan_cache.enabled",
+        # the diagnosis plane toggles/tunes live: arming inspection to
+        # chase a production incident must not need a restart
+        "diagnostics.enabled",
+        "diagnostics.history_windows",
+        "diagnostics.skew_min_dispatches",
+        "diagnostics.fsync_stall_threshold",
+        "diagnostics.heartbeat_stale_ms",
+        "diagnostics.host_fallback_fraction",
+        "diagnostics.governor_kill_threshold",
+        "diagnostics.admission_shed_threshold",
+        "diagnostics.row_eval_threshold",
     })
 
     def hot_reload(self, path: str) -> list[str]:
@@ -441,6 +497,26 @@ class Config:
             hbm_watermark_fraction=m.hbm_watermark_fraction,
             hbm_bytes=m.hbm_bytes,
             shard_ring_cap=m.shard_ring_cap)
+
+    def seed_diagnostics(self, storage) -> None:
+        """Arm the storage's inspection engine from the [diagnostics]
+        knobs (startup and SIGHUP hot reload both call this). The
+        edge-trigger memory survives a reseed — a reload must not
+        re-fire every known critical finding."""
+        d = self.diagnostics
+        st = storage.diagnostics
+        st.enabled = d.enabled
+        st.history_windows = d.history_windows
+        st.skew_min_dispatches = d.skew_min_dispatches
+        st.fsync_stall_threshold = d.fsync_stall_threshold
+        st.heartbeat_stale_ms = d.heartbeat_stale_ms
+        st.host_fallback_fraction = d.host_fallback_fraction
+        st.governor_kill_threshold = d.governor_kill_threshold
+        st.admission_shed_threshold = d.admission_shed_threshold
+        st.row_eval_threshold = d.row_eval_threshold
+        # the /status counts must reflect the new thresholds now, not
+        # after the cache TTL
+        st._status_cache = None
 
     def seed_observability(self, storage) -> None:
         """Arm the attribution/event plane from the [performance] knobs
@@ -678,6 +754,38 @@ skew-warn-ratio = 4.0
 hbm-watermark-fraction = 0.85
 hbm-bytes = 0
 shard-ring-cap = 256
+
+[diagnostics]
+# Automated cluster inspection (information_schema.inspection_result /
+# inspection_summary / cluster_inspection_result, /debug/inspection,
+# the /status inspection section): a registry of named diagnosis rules
+# evaluated over the live telemetry — metrics history, the server
+# event ring, Top SQL windows, the mesh flight recorder, governor/
+# admission/breaker state, transport membership, and config sanity.
+# Rules are pure functions over one snapshot: thread-free, bounded,
+# and with enabled = false the statement path does ZERO inspection
+# work. Hot-reloadable via SIGHUP. A rule's FIRST crossing into
+# severity=critical records an edge-triggered inspection_finding
+# event (tidb_events).
+enabled = true
+# windowed rules consider this many metrics-history samples (window
+# seconds = history-windows x performance.metrics-history-interval)
+history-windows = 8
+# mesh shard skew must persist this many dispatches to be a finding
+skew-min-dispatches = 2
+# WAL fsync stalls (>=100ms) per window before wal-fsync-stall fires
+fsync-stall-threshold = 3
+# member heartbeat age past this is follower-heartbeat-stale (ms;
+# 0 disables)
+heartbeat-stale-ms = 10000
+# a Top SQL digest whose stage split is at least this fraction
+# host_fallback is a de-deviced query (top-sql-host-fallback)
+host-fallback-fraction = 0.5
+# governor kills / admission sheds per window before a finding
+governor-kill-threshold = 1
+admission-shed-threshold = 1
+# per-row scalar-registry rows per window before registry-row-eval
+row-eval-threshold = 1
 
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC

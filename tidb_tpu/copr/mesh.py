@@ -243,6 +243,7 @@ class MeshFlightRecorder:
                         "digest": digest, "kind": kind, "op": op or "",
                         "dispatches": 0, "shards": n, "last_rows": [],
                         "last_skew": 1.0, "max_skew": 1.0,
+                        "skew_hits": [],
                         "in_rows": 0, "out_rows": 0, "routed_bytes": 0,
                         "last_seen": 0.0, "last_warn": 0.0}
                 else:
@@ -259,6 +260,16 @@ class MeshFlightRecorder:
                     ent["in_rows"] += int(inp.sum())
                 ent["last_skew"] = round(skew, 4)
                 ent["max_skew"] = max(ent["max_skew"], round(skew, 4))
+                if thr > 0 and skew >= thr:
+                    # (timestamp, skew) per dispatch that individually
+                    # crossed the warn ratio, bounded — the inspection
+                    # rule's "sustained AND current" evidence: it
+                    # counts and grades ONLY in-window crossings, so
+                    # neither the monotonic max_skew nor a lifetime
+                    # hit pile can flag a long-fixed hot range
+                    hits = ent.setdefault("skew_hits", [])
+                    hits.append((now, round(skew, 4)))
+                    del hits[:-32]
                 ent["routed_bytes"] += routed
                 ent["last_seen"] = now
                 if thr > 0 and skew >= thr and \

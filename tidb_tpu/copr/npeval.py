@@ -43,9 +43,14 @@ class NumpyEval:
         decimal-as-float shortcut was a silent precision loss."""
         import decimal as _pydec
 
+        from .. import obs
         from .funcs import REGISTRY
 
         fd = REGISTRY[e.op[3:]]
+        # the de-vectorization tax, attributed per function: surfaced
+        # through metrics_schema.tidb_registry_row_eval_total and the
+        # registry-row-eval inspection rule
+        obs.REGISTRY_ROW_EVALS.inc(self.n, func=fd.name)
         arg_vv = []
         for a in e.args:
             if a.ftype.is_string:

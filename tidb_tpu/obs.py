@@ -143,6 +143,18 @@ def _label_name(name: str, key: tuple) -> str:
     return f"{name}{{{lbl}}}" if lbl else name
 
 
+def split_sample_name(name: str, family: str) -> Optional[str]:
+    """Inverse of _label_name for one family: 'fam{k="v"}' -> 'k="v"',
+    bare 'fam' -> '', a sample of any OTHER family -> None. The one
+    parser of the flattened-sample convention — metrics_schema and the
+    inspection rules both read flat_samples output through it."""
+    if name == family:
+        return ""
+    if name.startswith(family + "{") and name.endswith("}"):
+        return name[len(family) + 1:-1]
+    return None
+
+
 def _fmt_value(v: float) -> str:
     """Full-precision exposition value: %g's 6 significant digits would
     quantize byte-valued gauges (RSS ~1e9) so hard that scrape-to-scrape
@@ -755,6 +767,12 @@ JIT_CACHE = PROCESS_METRICS.counter(
 PROFILER_SAMPLES = PROCESS_METRICS.counter(
     "tidb_profiler_samples_total",
     "stack samples taken by the host sampling profiler")
+REGISTRY_ROW_EVALS = PROCESS_METRICS.counter(
+    "tidb_registry_row_eval_total",
+    "rows evaluated by the per-row scalar-function registry fallback "
+    "(copr/funcs.py), by function — nonzero means an expression left "
+    "the vectorized path (the registry-row-eval inspection rule reads "
+    "this)")
 # rpc circuit breaker (rpc/client.py): process-wide like the copr
 # counters — every RpcClient in this process reports here, and the
 # breaker state itself is per-client on /status transport_health

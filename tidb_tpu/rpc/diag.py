@@ -53,6 +53,7 @@ TABLE_METHODS = {
     "cluster_top_sql": "diag_top_sql",
     "cluster_mesh_shards": "diag_mesh_shards",
     "cluster_mesh_storage": "diag_mesh_storage",
+    "cluster_inspection_result": "diag_inspection",
 }
 
 
@@ -133,6 +134,14 @@ class DiagService:
             rows.append([int(e["id"]), e["ts"], e["kind"], e["severity"],
                          int(e["conn_id"]), e["digest"], e["detail"]])
         return {"rows": rows}
+
+    def diag_inspection(self) -> dict:
+        """This server's inspection findings: every registered rule of
+        the obs_inspect engine evaluated over one telemetry snapshot.
+        Empty — with ZERO rule work — while diagnostics.enabled is
+        false (obs_inspect.result_rows short-circuits)."""
+        from .. import obs_inspect
+        return {"rows": obs_inspect.result_rows(self.storage)}
 
     def diag_statements(self) -> dict:
         rows = []

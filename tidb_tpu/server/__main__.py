@@ -207,6 +207,7 @@ def main(argv: list[str] | None = None) -> int:
     # process-wide device-mesh plane from the [mesh] knobs
     cfg.seed_observability(storage)
     cfg.seed_overload_protection(storage)
+    cfg.seed_diagnostics(storage)
     cfg.seed_mesh()
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
@@ -247,6 +248,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_sysvars(storage)
             cfg.seed_observability(storage)
             cfg.seed_overload_protection(storage)
+            cfg.seed_diagnostics(storage)
             cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",
                   flush=True)

@@ -25,6 +25,10 @@ Debug routes:
       per-shard dispatch accounting (rows/skew/exchange bytes),
       compile ring with recompile-storm flags, and the per-device
       HBM provenance ledger (JSON; never builds a mesh)
+  /debug/inspection  the automated diagnosis plane: every registered
+      inspection rule evaluated over the live telemetry snapshot,
+      full findings + per-rule summary (JSON; empty with zero rule
+      work while diagnostics.enabled is false)
 """
 
 from __future__ import annotations
@@ -98,6 +102,16 @@ class StatusServer:
                         "by_device_time":
                             server_obs.topsql.top_by_device(5),
                     }
+                    # automated diagnosis: finding counts by severity
+                    # (zero rule work while diagnostics.enabled=false)
+                    if outer.sql_server is not None:
+                        try:
+                            from .. import obs_inspect
+                            status["inspection"] = \
+                                obs_inspect.status_section(
+                                    outer.sql_server.storage)
+                        except Exception:  # noqa: BLE001 — scrape
+                            pass           # survives a broken rule
                     body = json.dumps(status).encode()
                     ctype = "application/json"
                 elif self.path == "/slow-query":
@@ -155,6 +169,22 @@ class StatusServer:
                     try:
                         from ..copr import mesh as _mesh
                         payload = _mesh.debug_payload()
+                    except Exception as e:  # noqa: BLE001
+                        payload = {"error": str(e)[:200]}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/inspection"):
+                    if outer.sql_server is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # like /debug/mesh: a snapshot-build failure (e.g.
+                    # a telemetry plane raising mid-teardown) degrades
+                    # to an error payload, never a dropped connection
+                    try:
+                        from .. import obs_inspect
+                        payload = obs_inspect.debug_payload(
+                            outer.sql_server.storage)
                     except Exception as e:  # noqa: BLE001
                         payload = {"error": str(e)[:200]}
                     body = json.dumps(payload).encode()

@@ -685,8 +685,11 @@ class Session:
                 return self._exec_drop_sequence(stmt)
         if isinstance(stmt, ast.UseStmt):
             from ..catalog import infoschema as I
+            from ..catalog import metrics_schema as MS
             if stmt.db.lower() == I.DB_NAME:
                 I.ensure_schema(self.storage)
+            elif stmt.db.lower() == MS.DB_NAME:
+                MS.ensure_schema(self.storage)
             self.catalog.schema(stmt.db)  # raises if unknown
             self.current_db = stmt.db
             return ResultSet([], [])
@@ -1193,11 +1196,20 @@ class Session:
         it). The statement holds storage.infoschema_lock until it
         finishes (_execute_observed releases)."""
         from ..catalog import infoschema as I
+        from ..catalog import metrics_schema as MS
 
         names: set[str] = set()
+        ms_names: set[str] = set()
         for tn in self._collect_table_names(stmt):
-            if (tn.db or self.current_db).lower() == I.DB_NAME:
+            db = (tn.db or self.current_db).lower()
+            if db == I.DB_NAME:
                 names.add(tn.name.lower())
+            elif db == MS.DB_NAME:
+                ms_names.add(tn.name.lower())
+        if ms_names:
+            # the metric-family memtables (one per registered family;
+            # not viewer-sensitive, so no infoschema lock needed)
+            MS.refresh(self.storage, ms_names)
         if not names:
             return
         if names & self._VIEWER_SENSITIVE_IS and self._is_guard is None:

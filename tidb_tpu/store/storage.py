@@ -221,6 +221,14 @@ class Storage:
         # stores sample on demand), and Storage.close() always joins it.
         self.metrics_history = _obs.MetricsHistory(
             [self.obs.metrics, _obs.PROCESS_METRICS])
+        # automated diagnosis plane (obs_inspect.py): per-storage
+        # settings + edge-trigger memory, seeded from [diagnostics]
+        # config by the server; embedded defaults enable it. The weak
+        # tracking registry lets bench.py's flight child persist an
+        # inspection snapshot of every live store when a flight dies.
+        from .. import obs_inspect as _inspect
+        self.diagnostics = _inspect.DiagnosticsState()
+        _inspect.track(self)
         self._tso_lease = 0
         if path is not None:
             os.makedirs(os.path.join(path, "epochs"), exist_ok=True)
