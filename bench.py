@@ -981,6 +981,156 @@ def flight_multichip(res: dict) -> None:
     mesh_info["dispatches"] = mesh.cop.recorder.snapshot()["dispatches"]
 
 
+def flight_replica_read(res: dict) -> None:
+    """Follower read tier: read QPS against ONE leader vs the same
+    leader with serving follower REPLICA PROCESSES (real processes, so
+    the offloaded compute actually leaves the router's CPU), p50/p99
+    per mode and the routed fraction. The scaling claim of ROADMAP
+    item 2 — read throughput grows with node count — gets a recorded
+    number."""
+    import shutil
+    import signal as _signal
+
+    _session_env()
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    lines = res["lines"]
+    n = int(float(os.environ.get("BENCH_REPLICA_ROWS", 1e5)))
+    n_followers = int(os.environ.get("BENCH_REPLICA_FOLLOWERS", 2))
+    workers = int(os.environ.get("BENCH_REPLICA_WORKERS", 8))
+    seconds = float(os.environ.get("BENCH_REPLICA_SECONDS", 8))
+    tmp = tempfile.mkdtemp(prefix="bench-replica-")
+    procs: list[subprocess.Popen] = []
+    leader = None
+    try:
+        leader = Storage(os.path.join(tmp, "leader"), shared=True,
+                         rpc_listen="127.0.0.1:0")
+        sess = Session(leader)
+        sess.execute("create table rr (id bigint primary key, "
+                     "grp bigint, v bigint)")
+        rng = np.random.default_rng(7)
+        vals = rng.integers(0, 1000, size=n)
+        with _Heartbeat("replica-load") as hb:
+            batch = 2000
+            for lo in range(0, n, batch):
+                hi = min(lo + batch, n)
+                rows = ",".join(
+                    f"({i},{i % 97},{int(vals[i])})"
+                    for i in range(lo, hi))
+                sess.execute(f"insert into rr values {rows}")
+                hb.rows = hi
+        addr = f"127.0.0.1:{leader.rpc_server.port}"
+        code = (
+            "import sys\n"
+            "from tidb_tpu.store.storage import Storage\n"
+            "import time\n"
+            "s = Storage(sys.argv[1], remote=sys.argv[2])\n"
+            "print('follower ready', flush=True)\n"
+            "time.sleep(1e9)\n")
+        env = dict(os.environ, TIDB_TPU_REPLICA_APPLY_MS="100")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        for i in range(n_followers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", code,
+                 os.path.join(tmp, f"f{i}"), addr],
+                stdout=sys.stderr, stderr=sys.stderr, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__))))
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline:
+            serving = [m for m in leader.rpc_server.members()
+                       if m["role"] == "follower" and m.get("serving")]
+            if len(serving) >= n_followers:
+                break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError(
+                f"followers never started serving: "
+                f"{leader.rpc_server.members()}")
+        log(f"replica_read: {n_followers} serving followers up, "
+            f"{n} rows, {workers} workers x {seconds:.0f}s per mode")
+
+        queries = [f"select sum(v), count(*) from rr where grp = {g}"
+                   for g in range(97)]
+
+        def run_mode(mode: str) -> dict:
+            lat: list[list[float]] = [[] for _ in range(workers)]
+            stop = threading.Event()
+
+            def work(wi: int) -> None:
+                s = Session(leader)
+                s.execute(f"set tidb_replica_read = '{mode}'")
+                k = wi
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    s.query(queries[k % len(queries)])
+                    lat[wi].append(time.perf_counter() - t0)
+                    k += 1
+
+            # warm both paths (compile) before the timed window; the
+            # routed-fraction baseline snapshots AFTER the warm query
+            warm = Session(leader)
+            warm.execute(f"set tidb_replica_read = '{mode}'")
+            warm.query(queries[0])
+            served0 = leader.obs.replica_reads.get(outcome="served")
+            threads = [threading.Thread(target=work, args=(i,),
+                                        daemon=True)
+                       for i in range(workers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            wall = time.perf_counter() - t0
+            alls = sorted(x for ws in lat for x in ws)
+            total = len(alls)
+            served = leader.obs.replica_reads.get(
+                outcome="served") - served0
+            return {
+                "qps": total / wall,
+                "p50_ms": alls[total // 2] * 1e3 if alls else 0.0,
+                "p99_ms": alls[min(total - 1, int(total * 0.99))] * 1e3
+                if alls else 0.0,
+                "routed_fraction": served / total if total else 0.0,
+            }
+
+        base = run_mode("leader")
+        routed = run_mode("follower")
+        res["values"]["replica_read_qps_leader"] = round(base["qps"], 1)
+        res["values"]["replica_read_qps_routed"] = \
+            round(routed["qps"], 1)
+        res["values"]["replica_read_routed_fraction"] = \
+            round(routed["routed_fraction"], 3)
+        res["values"]["replica_read_followers"] = n_followers
+        for mode, r in (("leader-only", base),
+                        (f"leader+{n_followers}f", routed)):
+            lines.append(
+                f"replica_read {mode}: {r['qps']:.0f} QPS "
+                f"p50={r['p50_ms']:.1f}ms p99={r['p99_ms']:.1f}ms "
+                f"routed={r['routed_fraction']:.0%}")
+        lines.append(
+            f"replica_read scaling: {routed['qps'] / max(base['qps'], 1e-9):.2f}x "
+            f"QPS with {n_followers} serving followers "
+            f"({workers} workers, {n} rows)")
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        if leader is not None:
+            leader.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 FLIGHTS = {
     "tpch_small": lambda res: flight_tpch(res, big=False),
     "tpch_big": lambda res: flight_tpch(res, big=True),
@@ -988,6 +1138,7 @@ FLIGHTS = {
     "ssb": flight_ssb,
     "cb": flight_cb,
     "multichip": flight_multichip,
+    "replica_read": flight_replica_read,
 }
 
 
@@ -1126,7 +1277,8 @@ def main() -> None:
     # big flight ever started (r04 rc=137, r05 rc=124)
     flight_names = os.environ.get(
         "BENCH_FLIGHTS",
-        "tpch_big,tpch_small,joins,ssb,cb,multichip").split(",")
+        "tpch_big,tpch_small,joins,ssb,cb,multichip,replica_read"
+    ).split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}
     all_lines: list[str] = [

@@ -1,0 +1,455 @@
+"""Follower read tier: live mirror apply, closed timestamps, and
+snapshot-consistent replica routing.
+
+A two-server socket cluster (leader + follower(s), no shared disk) must
+serve an eligible snapshot SELECT from a follower replica BIT-IDENTICAL
+to the leader's answer, with the routing decision visible (engine tag,
+EXPLAIN ANALYZE, tidb_replica_reads_total); a stalled replica
+(failpoint replica/apply-stall) must cause a typed leader fallback —
+never a wrong or failed query; term fencing must reject a replica
+living in another epoch; and a killed serving replica must fall back
+typed mid-statement. (Reference: tidb_replica_read follower reads with
+ReadIndex, and tidb_read_staleness bounded-staleness reads.)"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tidb_tpu import obs_inspect  # noqa: E402
+from tidb_tpu.rpc import replica as replica_mod  # noqa: E402
+from tidb_tpu.rpc.client import RpcOptions  # noqa: E402
+from tidb_tpu.rpc.errors import (  # noqa: E402
+    ReplicaStaleError,
+    RPCError,
+    StaleTermError,
+)
+from tidb_tpu.session import Session  # noqa: E402
+from tidb_tpu.store.storage import Storage  # noqa: E402
+from tidb_tpu.util import failpoint  # noqa: E402
+
+OPTS = RpcOptions(connect_timeout_ms=1000, request_timeout_ms=4000,
+                  backoff_budget_ms=2500, lock_budget_ms=8000,
+                  lease_ms=2000)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+@pytest.fixture()
+def cluster(tmp_path, monkeypatch):
+    monkeypatch.setenv("TIDB_TPU_REPLICA_APPLY_MS", "100")
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    follower = Storage(str(tmp_path / "follower"),
+                       remote=f"127.0.0.1:{leader.rpc_server.port}",
+                       rpc_options=OPTS)
+    try:
+        yield leader, follower
+    finally:
+        follower.close()
+        leader.close()
+
+
+def _wait_serving(leader, n: int = 1, timeout: float = 10.0) -> None:
+    """Until n followers advertise serving on the leader's registry
+    (one apply tick + one heartbeat)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        serving = [m for m in leader.rpc_server.members()
+                   if m["role"] == "follower" and m.get("serving")]
+        if len(serving) >= n:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no serving follower within {timeout}s: "
+        f"{leader.rpc_server.members()}")
+
+
+def _served(storage) -> float:
+    return storage.obs.replica_reads.get(outcome="served")
+
+
+def _fallbacks(storage) -> dict:
+    return {o: storage.obs.replica_reads.get(outcome=o)
+            for o in ("stale_fallback", "unreachable_fallback")}
+
+
+# ==================== config/state mirror pin ====================
+
+def test_replica_state_mirrors_config():
+    """config.ReplicaReadConfig and rpc.replica.ReplicaReadState are
+    deliberate mirrors; a knob added to one must land in the other."""
+    import dataclasses
+
+    from tidb_tpu.config import ReplicaReadConfig
+    st = {f.name: f.default
+          for f in dataclasses.fields(replica_mod.ReplicaReadState)}
+    for f in dataclasses.fields(ReplicaReadConfig):
+        assert f.name in st, f"knob {f.name} missing from runtime state"
+        assert st[f.name] == f.default, f.name
+
+
+# ==================== the happy path ====================
+
+def test_routed_read_bit_identical_and_observable(cluster, tmp_path):
+    leader, follower = cluster
+    f2 = Storage(str(tmp_path / "f2"),
+                 remote=f"127.0.0.1:{leader.rpc_server.port}",
+                 rpc_options=OPTS)
+    try:
+        sl = Session(leader)
+        sl.execute("create table t (id bigint primary key, v bigint, "
+                   "name varchar(32), price decimal(10,2), d date)")
+        sl.execute(
+            "insert into t values "
+            "(1, 10, 'alpha', 12.34, '2024-01-01'), "
+            "(2, 20, 'beta', 0.05, '2024-06-15'), "
+            "(3, 30, 'gamma', 999.99, '2025-12-31')")
+        _wait_serving(leader, n=2)
+
+        sql = ("select id, v, name, price, d, v * 2 from t "
+               "where v >= 10 order by id desc")
+        want = sl.execute(sql).rows          # leader-local answer
+        sl.execute("set tidb_replica_read = 'follower'")
+        got = sl.execute(sql).rows
+        assert got == want                   # bit-identical rows
+        assert _served(leader) == 1.0
+        assert sl.warnings == []             # served, not a fallback
+        assert any(e.startswith("replica@") for e in sl.last_engines)
+        assert "replica_read" in sl.last_stages
+
+        # aggregation routes too, and EXPLAIN ANALYZE shows the
+        # routing decision as the plan's engine
+        assert sl.execute("select sum(v), count(*) from t").rows == \
+            [(60, 3)]
+        ea = sl.execute("explain analyze select sum(v) from t")
+        assert ea.column_names[3] == "engine"
+        assert ea.rows[0][3].startswith("replica@"), ea.rows
+
+        # routed reads land in tidb_replica_reads_total on /metrics
+        # (per-server registry) and in the statement's slow log stages
+        sl.execute("set tidb_slow_log_threshold = 0")
+        sl.execute("select v from t where id = 2")
+        sl.execute("set tidb_slow_log_threshold = 100000")
+        slow = leader.obs.slow_queries()[-1]
+        assert "replica_read" in slow["stages"], slow
+
+        # system-schema reads, table-less reads, and VIEWS never route
+        # (a view body can smuggle NOW()/system memtables past the
+        # top-level eligibility walk; the replica would evaluate them
+        # with its own clock/state — wrong, not stale)
+        before = _served(leader)
+        sl.execute("select 1")
+        sl.execute("select instance from "
+                   "information_schema.cluster_info")
+        sl.execute("create view vt as select id, v from t")
+        assert sl.execute("select * from vt order by id").rows == \
+            [(r[0], r[1]) for r in want][::-1]
+        assert _served(leader) == before
+    finally:
+        f2.close()
+
+
+def test_prefer_follower_state_routes_without_session_var(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table p (id bigint primary key, v bigint)")
+    sl.execute("insert into p values (1, 1)")
+    _wait_serving(leader)
+    leader.replica_read.prefer_follower = True
+    try:
+        assert sl.execute("select v from p").rows == [(1,)]
+        assert _served(leader) >= 1.0
+    finally:
+        leader.replica_read.prefer_follower = False
+
+
+def test_cluster_info_carries_serving_columns(cluster):
+    leader, follower = cluster
+    _wait_serving(leader)
+    sl = Session(leader)
+    rows = sl.execute(
+        "select instance, type, applied_ts, apply_lag_ms, serving, "
+        "error from information_schema.cluster_info").rows
+    by_role = {r[1]: r for r in rows}
+    assert set(by_role) == {"leader", "follower"}
+    lead, fol = by_role["leader"], by_role["follower"]
+    assert lead[2] > 0 and lead[4] == 0        # leader never "serves"
+    assert fol[2] > 0 and fol[4] == 1          # follower serves
+    assert fol[3] >= 0.0
+    assert all(r[5] is None for r in rows)
+    # the leader's registry (members / /status transport) agrees
+    mem = {m["role"]: m for m in leader.rpc_server.members()}
+    assert mem["follower"]["serving"] is True
+    assert mem["follower"]["applied_ts"] > 0
+
+
+# ==================== staleness fence ====================
+
+def test_stalled_replica_causes_typed_leader_fallback(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table s (id bigint primary key, v bigint)")
+    sl.execute("insert into s values (1, 1), (2, 2)")
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select sum(v) from s").rows == [(3,)]
+    assert _served(leader) == 1.0
+
+    with failpoint.failpoint("replica/apply-stall", True):
+        # the write advances the leader's timestamps; the stalled
+        # replica can never close past it
+        sl.execute("insert into s values (3, 4)")
+        rows = sl.execute("select sum(v) from s").rows
+        assert rows == [(7,)]                   # correct, from leader
+        assert failpoint.hits("replica/apply-stall") >= 1
+    assert _served(leader) == 1.0               # not served stale
+    assert _fallbacks(leader)["stale_fallback"] >= 1.0
+    notes = [w for w in sl.warnings if "fell back" in w[2]]
+    assert notes and notes[0][0] == "Note"
+    # recovery: once the stall clears, routing resumes
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        if sl.execute("select sum(v) from s").rows == [(7,)] \
+                and _served(leader) >= 2.0:
+            break
+        time.sleep(0.1)
+    assert _served(leader) >= 2.0
+
+
+def test_bounded_staleness_read_routes(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table b (id bigint primary key, v bigint)")
+    sl.execute("insert into b values (1, 5)")
+    _wait_serving(leader)
+    time.sleep(1.2)  # age the data past the staleness horizon
+    sl.execute("set tidb_read_staleness = -1")
+    try:
+        assert sl.execute("select v from b").rows == [(5,)]
+        assert _served(leader) >= 1.0
+    finally:
+        sl.execute("set tidb_read_staleness = 0")
+
+
+# ==================== term fencing ====================
+
+def test_term_fence_rejects_mismatched_epochs(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table f (id bigint primary key, v bigint)")
+    sl.execute("insert into f values (1, 1)")
+    _wait_serving(leader)
+    ts = follower.apply_engine.applied_ts
+    assert ts > 0
+    # a router living in a DEPOSED epoch (its term below the replica's)
+    with pytest.raises(StaleTermError):
+        replica_mod.serve_replica_read(
+            follower, sql="select v from f", db="test",
+            read_ts=ts, term=follower._rpc_client.term + 1)
+    # the full router path: a replica that adopted a NEWER epoch than
+    # this leader (it follows a promoted winner) is rejected typed and
+    # the leader serves the read itself
+    follower._rpc_client.term += 7
+    try:
+        sl.execute("set tidb_replica_read = 'follower'")
+        assert sl.execute("select v from f").rows == [(1,)]
+        assert _served(leader) == 0.0
+        assert _fallbacks(leader)["stale_fallback"] >= 1.0
+    finally:
+        follower._rpc_client.term -= 7
+
+
+def test_serve_rejects_non_select_and_non_followers(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table w (id bigint primary key, v bigint)")
+    sl.execute("insert into w values (1, 1)")
+    _wait_serving(leader)
+    ts = follower.apply_engine.applied_ts
+    with pytest.raises(RPCError, match="exactly one SELECT"):
+        replica_mod.serve_replica_read(
+            follower, sql="insert into w values (9, 9)", db="test",
+            read_ts=ts)
+    with pytest.raises(RPCError, match="leader"):
+        replica_mod.serve_replica_read(
+            follower, sql="select * from w for update", db="test",
+            read_ts=ts)
+    with pytest.raises(RPCError, match="not a follower"):
+        replica_mod.serve_replica_read(
+            leader, sql="select 1", db="test", read_ts=1)
+    # a replica with serving disabled answers typed staleness
+    follower.replica_read.enabled = False
+    follower.arm_replica_read()
+    try:
+        with pytest.raises(ReplicaStaleError):
+            replica_mod.serve_replica_read(
+                follower, sql="select v from w", db="test", read_ts=ts)
+    finally:
+        follower.replica_read.enabled = True
+        follower.arm_replica_read()
+
+
+# ==================== unreachability ====================
+
+def test_killed_replica_falls_back_typed_mid_statement(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table k (id bigint primary key, v bigint)")
+    sl.execute("insert into k values (1, 1), (2, 2)")
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select sum(v) from k").rows == [(3,)]
+    # kill-9 equivalent: the replica's endpoints vanish without any
+    # deregistration; its membership entry (and serving flag) survive
+    # until the lease horizon — exactly the window the typed fallback
+    # must cover
+    follower.diag_listener.close()
+    follower._rpc_client.close()
+    t0 = time.monotonic()
+    rows = sl.execute("select sum(v) from k").rows
+    elapsed = time.monotonic() - t0
+    assert rows == [(3,)]                       # leader answered
+    assert elapsed < OPTS.backoff_budget_ms / 1000.0 + 5.0
+    assert _fallbacks(leader)["unreachable_fallback"] >= 1.0
+    assert any("fell back" in w[2] for w in sl.warnings)
+
+
+def test_open_breaker_skips_peer_without_burning_budget(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table o (id bigint primary key, v bigint)")
+    sl.execute("insert into o values (1, 1)")
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select v from o").rows == [(1,)]  # warm client
+    from tidb_tpu.rpc.diag import _peer_client
+    client = _peer_client(leader, follower.diag_address)
+    # force the breaker OPEN (as if breaker-threshold calls exhausted
+    # their budgets against a dead peer)
+    with client._bk_lock:
+        client._bk_streak = client.options.breaker_threshold
+        client._bk_open_until = time.monotonic() + 30.0
+    try:
+        # replica selection skips the open peer immediately
+        t0 = time.monotonic()
+        assert sl.execute("select v from o").rows == [(1,)]
+        assert time.monotonic() - t0 < 1.5
+        assert _fallbacks(leader)["unreachable_fallback"] >= 1.0
+        # the diag fan-out degrades to the error row immediately too
+        t0 = time.monotonic()
+        rows = sl.execute("select instance, error from "
+                          "information_schema.cluster_info").rows
+        assert time.monotonic() - t0 < 1.5
+        bad = [r for r in rows if r[1] is not None]
+        assert [r[0] for r in bad] == [follower.diag_address]
+        assert "breaker" in bad[0][1]
+    finally:
+        client._breaker_reset()
+
+
+# ==================== closed-timestamp protocol ====================
+
+def test_closed_ts_capped_below_pending_remote_commit(cluster):
+    """closed_info must never close past a commit timestamp whose
+    records are still unpublished (the pending-commit ledger)."""
+    leader, follower = cluster
+    client = follower._rpc_client
+    pending = int(client.call("tso_commit")["ts"])
+    info = client.call("closed_info")
+    assert info["closed_ts"] < pending
+    # the retire is TS-MATCHED: a stale done (a lost race with the
+    # client's next commit) must not clear a live ledger entry
+    client.call("tso_commit_done", ts=pending + 1)
+    assert client.call("closed_info")["closed_ts"] < pending
+    client.call("tso_commit_done", ts=pending)
+    info2 = client.call("closed_info")
+    assert info2["closed_ts"] >= pending
+    assert info2["wal_size"] >= info["wal_size"]
+
+
+def test_follower_commit_does_not_freeze_closed_ts(cluster):
+    """A follower that WRITES (tso_commit through the real 2PC path)
+    retires its ledger entry: the closed ts keeps advancing and the
+    write is immediately readable through a routed read."""
+    leader, follower = cluster
+    sl, sf = Session(leader), Session(follower)
+    sl.execute("create table c (id bigint primary key, v bigint)")
+    sf.execute("insert into c values (1, 41)")   # remote commit path
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select v from c where id = 1").rows == [(41,)]
+    assert _served(leader) >= 1.0
+
+
+# ==================== wire codec ====================
+
+def test_wire_codec_roundtrips_exact_types():
+    from tidb_tpu.rpc.frame import decode, encode
+    from tidb_tpu.types.value import Decimal
+    values = [None, True, False, 7, -7, 3.5, "text", b"bytes",
+              Decimal(12345, 2), datetime.date(2024, 2, 29),
+              datetime.datetime(2024, 2, 29, 12, 34, 56, 789000)]
+    wired = decode(encode([replica_mod.wire_value(v) for v in values]))
+    got = [replica_mod.unwire_value(v) for v in wired]
+    assert got == values
+    d = got[8]
+    assert isinstance(d, Decimal) and d.unscaled == 12345 and d.scale == 2
+
+
+# ==================== inspection rule ====================
+
+def test_follower_apply_lag_rule_grades_by_threshold():
+    class _Ctx:
+        def __init__(self, members, warn=1000):
+            self.cfg = obs_inspect.DiagnosticsState(
+                apply_lag_warn_ms=warn)
+            self._members = members
+
+        def members(self):
+            return self._members
+
+    rule = obs_inspect.RULES["follower-apply-lag"]
+    assert rule.reference
+    fn = rule.fn
+    assert fn(_Ctx([])) == []
+    healthy = {"role": "follower", "serving": True, "addr": "a:1",
+               "apply_lag_ms": 120.0}
+    assert fn(_Ctx([healthy])) == []
+    lagging = dict(healthy, apply_lag_ms=1500.0)
+    [f] = fn(_Ctx([lagging]))
+    assert f.severity == "warning" and f.item == "a:1"
+    stopped = dict(healthy, apply_lag_ms=3500.0)
+    [f] = fn(_Ctx([stopped]))
+    assert f.severity == "critical"
+    # a non-serving or leader member never fires
+    assert fn(_Ctx([dict(lagging, serving=False)])) == []
+    assert fn(_Ctx([dict(lagging, role="leader")])) == []
+    # 0 disables
+    assert fn(_Ctx([stopped], warn=0)) == []
+
+
+def test_replica_metrics_and_debug_surface(cluster):
+    leader, follower = cluster
+    _wait_serving(leader)
+    # gauge present on the follower's registry (and rendered typed)
+    text = follower.obs.metrics.render()
+    assert "# TYPE tidb_follower_apply_lag_seconds gauge" in text
+    payload = replica_mod.debug_payload(leader)
+    assert payload["enabled"] is True
+    roles = {m["role"] for m in payload["members"]}
+    assert roles == {"leader", "follower"}
+    assert set(payload["reads"]) == {
+        "served", "stale_fallback", "unreachable_fallback"}
+    fol = follower.transport_health()
+    assert fol["replica_apply"]["interval_ms"] == 100

@@ -204,7 +204,13 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
     "cluster_info": [
         ("instance", _vc()), ("type", _vc(16)), ("server_id", _bigint()),
         ("version", _vc()), ("pid", _bigint()), ("start_time", _vc(20)),
-        ("uptime_s", FieldType(TypeKind.DOUBLE)), ("error", _vc(256)),
+        ("uptime_s", FieldType(TypeKind.DOUBLE)),
+        # follower read tier: the member's applied/closed timestamp,
+        # how far behind the leader it runs, and whether it serves
+        # routed replica reads (leaders: newest issued ts / 0 / 0)
+        ("applied_ts", _bigint()),
+        ("apply_lag_ms", FieldType(TypeKind.DOUBLE)),
+        ("serving", _bigint()), ("error", _vc(256)),
     ],
     "cluster_processlist": [
         ("instance", _vc()), ("id", _bigint()), ("user", _vc()),

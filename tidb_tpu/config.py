@@ -135,6 +135,30 @@ class DiagnosticsConfig:
     governor_kill_threshold: int = 1     # kills/window before a finding
     admission_shed_threshold: int = 1    # sheds/window before a finding
     row_eval_threshold: int = 1          # per-row registry rows/window
+    # a serving replica's apply lag past this is follower-apply-lag
+    # (warning; critical at 3x — the replica stopped advancing); 0
+    # disables the rule
+    apply_lag_warn_ms: int = 2000
+
+
+@dataclass
+class ReplicaReadConfig:
+    """The `[replica-read]` TOML section: the follower read tier's
+    knobs (rpc/replica.py ReplicaReadState is the runtime owner —
+    field names/defaults MIRROR it, mirrored rather than imported so
+    config parsing never pulls the rpc import chain;
+    tests/test_replica_read.py pins the two definitions equal)."""
+
+    # master switch: follower apply engine + serving endpoint + router
+    enabled: bool = True
+    # bounded-staleness cap (tidb_read_staleness is clamped to it) and
+    # the lag bound past which a replica stops being a routing candidate
+    max_staleness_ms: int = 5000
+    # follower apply-engine cadence (closed-ts fetch + columnar fold)
+    apply_interval_ms: int = 200
+    # route eligible snapshot SELECTs to followers by default (seeds
+    # the tidb_replica_read sysvar's global default)
+    prefer_follower: bool = False
 
 
 @dataclass
@@ -233,6 +257,8 @@ class Config:
     mesh: MeshSection = field(default_factory=MeshSection)
     diagnostics: DiagnosticsConfig = field(
         default_factory=DiagnosticsConfig)
+    replica_read: ReplicaReadConfig = field(
+        default_factory=ReplicaReadConfig)
     gc: GCConfig = field(default_factory=GCConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
@@ -375,6 +401,17 @@ class Config:
             raise ConfigError(
                 "diagnostics.heartbeat-stale-ms must be >= 0 "
                 "(0 disables the staleness check)")
+        if d.apply_lag_warn_ms < 0:
+            raise ConfigError(
+                "diagnostics.apply-lag-warn-ms must be >= 0 "
+                "(0 disables the follower-apply-lag rule)")
+        rr = self.replica_read
+        if rr.max_staleness_ms < 0:
+            raise ConfigError(
+                "replica-read.max-staleness-ms must be >= 0")
+        if rr.apply_interval_ms < 10:
+            raise ConfigError(
+                "replica-read.apply-interval-ms must be >= 10")
         if not 0 < d.host_fallback_fraction <= 1:
             raise ConfigError(
                 "diagnostics.host-fallback-fraction must be in (0, 1]")
@@ -416,6 +453,13 @@ class Config:
         "diagnostics.governor_kill_threshold",
         "diagnostics.admission_shed_threshold",
         "diagnostics.row_eval_threshold",
+        "diagnostics.apply_lag_warn_ms",
+        # the follower read tier toggles/tunes live: routing policy and
+        # staleness bounds must not need a restart (the apply cadence
+        # does — it is a thread's wait interval, fixed at arm time)
+        "replica_read.enabled",
+        "replica_read.max_staleness_ms",
+        "replica_read.prefer_follower",
     })
 
     def hot_reload(self, path: str) -> list[str]:
@@ -514,9 +558,23 @@ class Config:
         st.governor_kill_threshold = d.governor_kill_threshold
         st.admission_shed_threshold = d.admission_shed_threshold
         st.row_eval_threshold = d.row_eval_threshold
+        st.apply_lag_warn_ms = d.apply_lag_warn_ms
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
         st._status_cache = None
+
+    def seed_replica_read(self, storage) -> None:
+        """Arm the follower read tier from the [replica-read] knobs
+        (startup and SIGHUP hot reload both call this): copy the
+        routing/staleness settings onto the storage's state and
+        start/stop the follower apply engine to match."""
+        r = self.replica_read
+        st = storage.replica_read
+        st.enabled = r.enabled
+        st.max_staleness_ms = r.max_staleness_ms
+        st.apply_interval_ms = r.apply_interval_ms
+        st.prefer_follower = r.prefer_follower
+        storage.arm_replica_read()
 
     def seed_observability(self, storage) -> None:
         """Arm the attribution/event plane from the [performance] knobs
@@ -555,6 +613,10 @@ class Config:
                               self.performance.trace_span_cap)
         sv.set_config_default("local_infile",
                               1 if self.security.local_infile else 0)
+        sv.set_config_default(
+            "tidb_replica_read",
+            "follower" if self.replica_read.prefer_follower
+            else "leader")
 
 
 class _TomlError(Exception):
@@ -786,6 +848,34 @@ governor-kill-threshold = 1
 admission-shed-threshold = 1
 # per-row scalar-registry rows per window before registry-row-eval
 row-eval-threshold = 1
+# a serving replica's apply lag past this fires follower-apply-lag
+# (warning; critical at 3x — the replica stopped advancing); 0 disables
+apply-lag-warn-ms = 2000
+
+[replica-read]
+# Follower read tier: followers fold their mirrored (snapshot, WAL)
+# stream into a live local engine continuously (the apply engine) and
+# advertise a CLOSED timestamp on every heartbeat; eligible snapshot
+# SELECTs (plain autocommit reads over base tables — DML, locking
+# reads, system schemas and nondeterministic functions stay on the
+# leader) then route to the least-loaded live replica that can cover
+# the statement's read timestamp, with typed fallback to the leader on
+# staleness, term fencing, or unreachability. Routed reads are
+# bit-identical to the leader's answer: same fold, same timestamp.
+# Surfaces: information_schema.cluster_info (applied_ts/apply_lag_ms/
+# serving), /debug/replicas, tidb_replica_reads_total,
+# tidb_follower_apply_lag_seconds, engine tag replica@host:port in
+# EXPLAIN ANALYZE / slow log.
+enabled = true
+# staleness cap: bounds tidb_read_staleness AND how far behind a
+# replica may run while remaining a routing candidate
+max-staleness-ms = 5000
+# follower apply cadence (closed-ts fetch + columnar fold)
+apply-interval-ms = 200
+# route eligible SELECTs to followers by default (seeds the
+# tidb_replica_read sysvar; sessions override with
+# SET tidb_replica_read = 'leader' | 'follower')
+prefer-follower = false
 
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC

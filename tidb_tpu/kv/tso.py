@@ -109,6 +109,11 @@ class RemoteTSO:
         self._lock = threading.Lock()
         self._seen = 0            # highest leader-issued ts witnessed
         self.stale_watermark = 0  # every stale re-issue is <= this
+        # the commit ts this THREAD holds open in the leader's ledger
+        # (commit_ts/commit_done pair up per committing thread; a
+        # process-wide flag would let one thread's late done retire a
+        # sibling's in-flight entry)
+        self._commit_tl = threading.local()
 
     def _remote_next(self) -> int:
         ts = int(self._client.call("tso_next")["ts"])
@@ -116,6 +121,37 @@ class RemoteTSO:
             if ts > self._seen:
                 self._seen = ts
         return ts
+
+    def commit_ts(self) -> int:
+        """A COMMIT timestamp: allocated through the leader's
+        pending-commit ledger (rpc/server.py tso_commit) so the
+        closed-timestamp protocol of the follower read tier never
+        closes past a commit whose records are still unpublished.
+        Strict like ts(): never degrades to a stale re-issue."""
+        ts = int(self._client.call("tso_commit")["ts"])
+        with self._lock:
+            if ts > self._seen:
+                self._seen = ts
+        self._commit_tl.ts = ts
+        return ts
+
+    def commit_done(self) -> None:
+        """Retire the pending-commit ledger entry once the commit phase
+        finished (its records are published, or definitively never will
+        be). Carries the exact ts so a done that arrives late — after
+        the same client's NEXT commit replaced the ledger slot — is a
+        no-op server-side. Best effort: the leader also retires the
+        entry by replacement on the next tso_commit and on client
+        reap."""
+        ts = getattr(self._commit_tl, "ts", 0)
+        if not ts:
+            return
+        self._commit_tl.ts = 0
+        from ..rpc.errors import RPCError
+        try:
+            self._client.call("tso_commit_done", ts=ts, _budget_ms=500)
+        except RPCError:
+            pass
 
     def next_ts(self) -> int:
         from ..rpc.errors import RPCError

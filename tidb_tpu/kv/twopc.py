@@ -128,7 +128,15 @@ class TwoPhaseCommitter:
 
     def _commit_phase(self, state, start_ts: int) -> int:
         mutations, primary, resolver = state
-        commit_ts = self.tso.ts()
+        # commit timestamps go through the oracle's COMMIT interface
+        # when it has one (RemoteTSO.commit_ts): the leader's pending-
+        # commit ledger must know this ts may stamp records that are
+        # not published yet, or the follower read tier could close a
+        # timestamp past an in-flight remote commit. Local oracles
+        # have no ledger — their commits run under the storage commit
+        # lock the closed-ts computation also takes.
+        alloc = getattr(self.tso, "commit_ts", None) or self.tso.ts
+        commit_ts = alloc()
 
         # commit the primary synchronously — the txn is durable
         # once this lands (reference: 2pc.go:741)

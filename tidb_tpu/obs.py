@@ -653,6 +653,18 @@ class Observability:
         self.slow_counter = self.metrics.counter(
             "tidb_slow_queries_total",
             "statements over the slow-log threshold")
+        # follower read tier (rpc/replica.py router + rpc/apply.py):
+        # routed-read outcomes on the router's server, apply lag on the
+        # replica's (leaders legitimately report 0 lag)
+        self.replica_reads = self.metrics.counter(
+            "tidb_replica_reads_total",
+            "snapshot reads routed to follower replicas, by outcome "
+            "(served / stale_fallback / unreachable_fallback)")
+        self.apply_lag = self.metrics.gauge(
+            "tidb_follower_apply_lag_seconds",
+            "age of this follower's applied/closed timestamp (how far "
+            "behind the leader the serving replica runs; feeds the "
+            "follower-apply-lag inspection rule)")
         self._slow_log: deque = deque(maxlen=SLOW_LOG_MAX)
         self._slow_lock = threading.Lock()
         self.statements = StatementsSummary()

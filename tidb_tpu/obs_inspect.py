@@ -65,6 +65,9 @@ class DiagnosticsState:
     governor_kill_threshold: int = 1     # kills in the window
     admission_shed_threshold: int = 1    # sheds in the window
     row_eval_threshold: int = 1          # per-row registry rows/window
+    # a serving replica's apply lag past this is a follower-apply-lag
+    # warning; critical at 3x (the replica stopped advancing); 0 off
+    apply_lag_warn_ms: int = 2000
     # (rule, item) pairs already reported critical: inspection_finding
     # events fire on NEW members only (edge-triggered, not level)
     seen_critical: set = field(default_factory=set)
@@ -413,6 +416,37 @@ def _r_heartbeat_stale(ctx: InspectionContext) -> list[Finding]:
                 f"{m.get('role', 'member')} heartbeat age "
                 f"{float(age):.1f}s >= "
                 f"diagnostics.heartbeat-stale-ms {thr_s * 1000:.0f}ms"))
+    return out
+
+
+@rule("follower-apply-lag", "warning",
+      "replica-read.apply-interval-ms — a serving replica's closed/"
+      "applied timestamp is falling behind the leader; past 3x the "
+      "warn threshold it has effectively stopped advancing and every "
+      "routed read falls back to the leader (/debug/replicas, "
+      "tidb_follower_apply_lag_seconds)")
+def _r_follower_apply_lag(ctx: InspectionContext) -> list[Finding]:
+    thr = float(ctx.cfg.apply_lag_warn_ms)
+    if thr <= 0:
+        return []
+    out = []
+    for m in ctx.members():
+        if m.get("role") != "follower" or not m.get("serving"):
+            continue
+        lag = m.get("apply_lag_ms")
+        if lag is None or float(lag) < thr:
+            continue
+        lag = float(lag)
+        inst = str(m.get("addr") or "?")
+        sev = "critical" if lag >= 3 * thr else "warning"
+        out.append(Finding(
+            "follower-apply-lag", inst, sev, f"{lag:.0f}ms",
+            f"serving replica's applied ts is {lag:.0f}ms behind the "
+            f"leader (warn threshold "
+            f"{ctx.cfg.apply_lag_warn_ms}ms"
+            + ("; the replica has stopped advancing — routed reads "
+               "are falling back to the leader" if sev == "critical"
+               else "") + ")"))
     return out
 
 

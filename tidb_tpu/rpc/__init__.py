@@ -22,4 +22,12 @@ Layers:
   timeouts, transparent reconnect, failpoint sites at every edge.
 * remote.py — the follower-side adapters (RemoteKV, RemoteCoordinator,
   RemoteOwnerManager) that plug the client into storage unchanged.
+* diag.py   — per-server diagnostics endpoints + the cluster_* fan-out.
+* failover.py — leader-loss detection, deterministic election, in-place
+  promotion / repoint.
+* apply.py  — the follower read tier's apply engine: continuous mirror
+  fold + the closed-timestamp protocol (applied_ts on every heartbeat).
+* replica.py — snapshot-consistent replica routing: eligible SELECTs
+  route to the least-loaded serving replica whose closed ts covers the
+  statement's read_ts, with typed fallback to the leader.
 """

@@ -85,7 +85,38 @@ class DiagService:
             time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(started))
             if started else "",
             round(time.time() - started, 3) if started else 0.0,
+            *self._replica_cols(),
         ]]}
+
+    def _replica_cols(self) -> list:
+        """The follower-read-tier columns of cluster_info: this
+        server's applied/closed ts, apply lag, and whether it serves
+        routed replica reads. A leader's 'applied' point is the newest
+        issued timestamp (it serves every read, but not as a replica —
+        serving stays 0)."""
+        st = self.storage
+        eng = getattr(st, "apply_engine", None)
+        if eng is not None:
+            # the SAME serving condition the heartbeat advertises
+            # (enabled AND synced at least once) — the two surfaces an
+            # operator compares must never contradict each other
+            return [int(eng.applied_ts), round(eng.lag_ms(), 1),
+                    1 if (st.replica_read.enabled
+                          and eng.applied_ts > 0) else 0]
+        tso = getattr(st, "tso", None)
+        cur = int(tso.current()) if tso is not None else 0
+        return [cur, 0.0, 0]
+
+    def diag_replica_read(self, sql: str = "", db: str = "",
+                          read_ts: int = 0, term: int = 0,
+                          time_zone: str = "SYSTEM") -> dict:
+        """A routed snapshot SELECT served from this follower's local
+        engine at exactly read_ts (rpc/replica.py serve_replica_read:
+        term fence, bounded closed-ts wait, SELECT-only)."""
+        from .replica import serve_replica_read
+        return serve_replica_read(self.storage, sql=sql, db=db,
+                                  read_ts=read_ts, term=term,
+                                  time_zone=time_zone)
 
     def diag_processlist(self) -> dict:
         provider = getattr(self.storage, "processlist", None)
@@ -198,11 +229,11 @@ class DiagService:
                 "leader_addr": str(getattr(client, "addr", "") or "")
                 if client is not None and not client.degraded else ""}
 
-    def handle(self, method: str) -> dict:
+    def handle(self, method: str, **params) -> dict:
         fn = getattr(self, method, None)
         if fn is None or not method.startswith("diag_"):
             raise RPCError(f"unknown diag method {method}")
-        return fn()
+        return fn(**params) if params else fn()
 
 
 class DiagListener(FrameListener):
@@ -239,9 +270,11 @@ class DiagListener(FrameListener):
             return wire_error(None, RPCError("bad request"))
         rid = req.get("id")
         method = str(req.get("m"))
-        return traced_response(rid, method,
-                               lambda: self.service.handle(method),
-                               get_trace_ctx(req))
+        params = req.get("p") if isinstance(req.get("p"), dict) else {}
+        return traced_response(
+            rid, method,
+            lambda: self.service.handle(method, **params),
+            get_trace_ctx(req))
 
     def close(self) -> None:
         self._close_listener()
@@ -356,6 +389,13 @@ def _call_member(storage, member: dict, method: str) -> dict:
     if isinstance(d, (int, float)) and not isinstance(d, bool) and d > 0:
         time.sleep(float(d))
     client = _peer_client(storage, addr)
+    if client.breaker_state == "open":
+        # the peer already burned breaker-threshold budgets: degrade to
+        # the error row NOW instead of rediscovering the dead endpoint
+        # (and paying another Backoffer budget) on every fan-out; the
+        # half-open probe after the cooldown re-admits it
+        raise RPCError(
+            f"peer {addr}: rpc circuit breaker open (failing fast)")
     # capped below the transport budget: cluster_processlist fans out
     # while holding the viewer-sensitive infoschema lock, and a dead
     # peer must not push the hold time toward that lock's 10s acquire

@@ -67,6 +67,16 @@ class ResultUndetermined(RPCError):
     next successful tail re-applies it."""
 
 
+class ReplicaStaleError(RPCError):
+    """A routed replica read could not be served at the requested
+    timestamp: the replica's applied/closed ts does not cover read_ts
+    (apply stalled, serving disabled, or the bounded ReadIndex-style
+    wait expired). The ROUTER reacts by failing over to the next
+    candidate and finally to the leader — the statement never fails
+    and never returns stale rows (reference analog: a follower read
+    whose ReadIndex wait times out retries the leader peer)."""
+
+
 class WalOffsetMismatch(RPCError):
     """An append's expected WAL position no longer matches the file.
 
@@ -111,11 +121,13 @@ WIRE_ERRORS = {
     "StaleLeaseError": StaleLeaseError,
     "StaleTermError": StaleTermError,
     "ResultUndetermined": ResultUndetermined,
+    "ReplicaStaleError": ReplicaStaleError,
     "WalOffsetMismatch": WalOffsetMismatch,
     "RPCError": RPCError,
 }
 
 
 __all__ = ["RPCError", "LeaderUnavailable", "StaleLeaseError",
-           "StaleTermError", "ResultUndetermined", "WalOffsetMismatch",
-           "WIRE_ERRORS", "wire_error", "traced_response"]
+           "StaleTermError", "ResultUndetermined", "ReplicaStaleError",
+           "WalOffsetMismatch", "WIRE_ERRORS", "wire_error",
+           "traced_response"]

@@ -67,7 +67,9 @@ def write_term(path: str, term: int) -> None:
 class _Client:
     __slots__ = ("last_seen", "node_id", "node_fd", "last_seq",
                  "last_seq_result", "kill_seq", "kill_result",
-                 "diag_addr", "role", "diag_departed")
+                 "diag_addr", "role", "diag_departed",
+                 "applied_ts", "apply_lag_ms", "serving", "load",
+                 "peer_term", "pending_commit")
 
     def __init__(self) -> None:
         self.last_seen = time.monotonic()
@@ -85,6 +87,20 @@ class _Client:
         self.diag_addr: Optional[str] = None
         self.role: Optional[str] = None
         self.diag_departed = False
+        # follower-read tier advertisement (rpc/apply.py rides the
+        # heartbeat): closed/applied ts, apply lag, the serving flag,
+        # the admission-gate load signal, and the term the peer lives
+        # in (a lower term marks a deposed-epoch replica non-serving)
+        self.applied_ts = 0
+        self.apply_lag_ms: Optional[float] = None
+        self.serving = False
+        self.load = 0
+        self.peer_term = 0
+        # the ONE remote commit timestamp this client may be holding
+        # unpublished (issued by tso_commit, retired by tso_commit_done
+        # / the next tso_commit / the mutation-lease release) — the
+        # pending-commit ledger closed_info caps the closed ts under
+        self.pending_commit = 0
 
 
 class _Grant:
@@ -301,7 +317,8 @@ class CoordRPCServer(FrameListener):
             # registry methods like diag_register keep _h_ handlers.
             # NO _Client entry: diag fan-out callers are not cluster
             # participants and must not inflate client_count()
-            fn = lambda: self.storage.diag.handle(method)  # noqa: E731
+            fn = lambda: self.storage.diag.handle(  # noqa: E731
+                method, **(params if isinstance(params, dict) else {}))
         else:
             return wire_error(rid, RPCError(f"unknown method {method}"))
         # trace propagation: a request under an active client TRACE
@@ -311,20 +328,34 @@ class CoordRPCServer(FrameListener):
 
     # ---- liveness ----------------------------------------------------------
     def _h_ping(self, client_id: str, diag_addr=None, role=None,
-                node_id=None) -> dict:
+                node_id=None, applied_ts=None, apply_lag_ms=None,
+                serving=None, load=None, term=None) -> dict:
         # heartbeats may carry the sender's diag registration so a
         # restarted leader relearns the membership within one beat
         if diag_addr:
             self._register_member(client_id, str(diag_addr),
                                   str(role or "follower"))
-        if node_id is not None:
+        if node_id is not None or applied_ts is not None:
             with self._mu:
                 c = self._clients.get(client_id)
-                if c is not None and c.node_id is None:
-                    # a follower that repointed here after a promotion
-                    # keeps its original node id; record it so members()
-                    # and the election registry stay id-accurate
-                    c.node_id = int(node_id)
+                if c is not None:
+                    if node_id is not None and c.node_id is None:
+                        # a follower that repointed here after a
+                        # promotion keeps its original node id; record
+                        # it so members() and the election registry
+                        # stay id-accurate
+                        c.node_id = int(node_id)
+                    if applied_ts is not None:
+                        # the follower-read advertisement (rpc/apply.py)
+                        c.applied_ts = int(applied_ts)
+                        c.apply_lag_ms = float(apply_lag_ms or 0.0)
+                        c.load = int(load or 0)
+                        c.peer_term = int(term or 0)
+                        # a replica living in a FENCED epoch (it last
+                        # applied a deposed leader's stream) is never
+                        # a serving candidate, whatever it advertises
+                        c.serving = bool(serving) and \
+                            c.peer_term >= self.term
         # the term rides every beat: clients track the cluster epoch
         # from it, and a client that knows a HIGHER term than ours
         # treats us as a deposed leader (StaleTermError client-side)
@@ -375,8 +406,14 @@ class CoordRPCServer(FrameListener):
         the cluster_* fan-out) can judge liveness. The same 3-lease
         horizon client_count applies bounds how long a crashed peer
         keeps contributing error rows — past it the peer has departed."""
+        # the leader row carries the serving-tier columns too: its
+        # "applied" point is simply the newest issued timestamp, and it
+        # is not a replica-read candidate (serving False) — leader
+        # reads are just reads
         out = [{"id": 0, "addr": self.address, "role": "leader",
-                "hb_age_s": 0.0}]
+                "hb_age_s": 0.0,
+                "applied_ts": int(self.storage.tso.current()),
+                "apply_lag_ms": 0.0, "serving": False, "load": 0}]
         now = time.monotonic()
         horizon = 3 * self.lease_ms / 1000.0
         with self._mu:
@@ -387,7 +424,11 @@ class CoordRPCServer(FrameListener):
                         "id": c.node_id if c.node_id is not None else -1,
                         "addr": c.diag_addr,
                         "role": c.role or "follower",
-                        "hb_age_s": round(age, 3)})
+                        "hb_age_s": round(age, 3),
+                        "applied_ts": int(c.applied_ts),
+                        "apply_lag_ms": c.apply_lag_ms,
+                        "serving": bool(c.serving),
+                        "load": int(c.load)})
         return out
 
     def _h_members(self, client_id: str) -> dict:
@@ -396,6 +437,68 @@ class CoordRPCServer(FrameListener):
     # ---- TSO ---------------------------------------------------------------
     def _h_tso_next(self, client_id: str) -> dict:
         return {"ts": self.storage.tso.next_ts()}
+
+    def _h_tso_commit(self, client_id: str) -> dict:
+        """A COMMIT timestamp for a remote committer: allocated like any
+        other, but entered into the pending-commit ledger until the
+        records it stamps are published (or the commit dies).
+
+        Allocation and registration happen under the SAME storage
+        commit lock _h_closed_info computes under — otherwise a
+        closed_info interleaving between next_ts() and the ledger write
+        would see tso.current() >= ts with an empty pending list and
+        close past an in-flight commit.
+
+        ONE slot per client is safe: the follower's Storage serializes
+        its whole commit phase (allocation through publish) under its
+        own commit lock, so a new tso_commit from the same client means
+        the previous commit finished — publish included — and its entry
+        retires by replacement."""
+        with self.storage._commit_lock:
+            ts = self.storage.tso.next_ts()
+            with self._mu:
+                c = self._clients[client_id]
+                c.pending_commit = ts
+        return {"ts": ts}
+
+    def _h_tso_commit_done(self, client_id: str, ts: int = 0) -> dict:
+        """The remote commit phase completed (published or definitively
+        not going to): retire the pending entry so the closed ts can
+        advance past it. The retire is TS-MATCHED: a done that lost a
+        race with the client's next tso_commit (the commit lock on the
+        follower was released before the done RPC fired) must not wipe
+        the successor's in-flight entry. Best-effort on the client
+        side — a lost done is recovered by the client's next tso_commit
+        or the client reaper."""
+        with self._mu:
+            c = self._clients.get(client_id)
+            if c is not None and (not ts or c.pending_commit == int(ts)):
+                c.pending_commit = 0
+        return {}
+
+    def _h_closed_info(self, client_id: str) -> dict:
+        """The closed-timestamp point for follower serving: every commit
+        with commit_ts <= closed_ts has its WAL records inside the
+        first wal_size bytes (rpc/apply.py adopts the pair once its
+        fold passes wal_size). Correctness: local commits allocate
+        their commit_ts AND append their records under the storage
+        commit lock we hold here, so anything after us is > our
+        tso.current(); remote commits allocate via tso_commit, whose
+        ledger caps us below any still-unpublished timestamp. (Disk-
+        sharing sibling WRITER processes bypass both fences — the
+        serving tier assumes the socket-cluster shape, where the
+        leader process is the only local mutator.)"""
+        st = self.storage
+        with st._commit_lock:
+            closed = int(st.tso.current())
+            with self._mu:
+                pend = [c.pending_commit for c in self._clients.values()
+                        if c.pending_commit]
+            if pend:
+                closed = min(closed, min(pend) - 1)
+            wal = self._wal_size()
+        return {"wal_size": wal, "closed_ts": closed,
+                "term": self.term}
 
     # ---- named leases (mutation section, ddl/gc owner) ---------------------
     def _lock_file(self, name: str) -> str:
@@ -420,7 +523,15 @@ class CoordRPCServer(FrameListener):
             time.monotonic() - c.last_seen > self.lease_ms / 1000.0
 
     def _release_locked(self, name: str) -> None:
-        """Drop a grant; caller holds self._mu."""
+        """Drop a grant; caller holds self._mu. Deliberately does NOT
+        retire the holder's pending commit: mutation sections are also
+        taken by NON-commit paths (pessimistic locking) on other
+        sessions of the same client, and their release racing a
+        sibling's in-flight commit would clear a live ledger entry —
+        closed_ts would pass an unpublished commit. A pending entry a
+        lost tso_commit_done leaves behind only delays closing (the
+        client's next commit or the reaper clears it); conservative
+        beats wrong."""
         self._grants.pop(name, None)
         fd = self._lock_fds.get(name)
         if fd is not None:

@@ -208,6 +208,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_observability(storage)
     cfg.seed_overload_protection(storage)
     cfg.seed_diagnostics(storage)
+    cfg.seed_replica_read(storage)
     cfg.seed_mesh()
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
@@ -249,6 +250,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_observability(storage)
             cfg.seed_overload_protection(storage)
             cfg.seed_diagnostics(storage)
+            cfg.seed_replica_read(storage)
             cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",
                   flush=True)

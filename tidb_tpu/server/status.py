@@ -25,6 +25,10 @@ Debug routes:
       per-shard dispatch accounting (rows/skew/exchange bytes),
       compile ring with recompile-storm flags, and the per-device
       HBM provenance ledger (JSON; never builds a mesh)
+  /debug/replicas  the follower read tier: router knobs, per-member
+    serving/closed-timestamp state, the local apply engine, and the
+    routed-read outcome counters
+
   /debug/inspection  the automated diagnosis plane: every registered
       inspection rule evaluated over the live telemetry snapshot,
       full findings + per-rule summary (JSON; empty with zero rule
@@ -184,6 +188,23 @@ class StatusServer:
                     try:
                         from .. import obs_inspect
                         payload = obs_inspect.debug_payload(
+                            outer.sql_server.storage)
+                    except Exception as e:  # noqa: BLE001
+                        payload = {"error": str(e)[:200]}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/replicas"):
+                    if outer.sql_server is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # follower read tier: router knobs, per-member
+                    # serving/closed-ts state, the local apply engine,
+                    # and the routed-read outcome counters; degrades
+                    # to an error payload like the other /debug routes
+                    try:
+                        from ..rpc import replica as _replica
+                        payload = _replica.debug_payload(
                             outer.sql_server.storage)
                     except Exception as e:  # noqa: BLE001
                         payload = {"error": str(e)[:200]}

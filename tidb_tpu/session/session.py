@@ -134,6 +134,9 @@ class Session:
         self._binding_gen = 0
         self._binding_match_sql: Optional[str] = None
         self._raw_sql: Optional[str] = None
+        # single top-level SELECT text, the only shape the replica-read
+        # router may forward (rpc/replica.py)
+        self._route_sql: Optional[str] = None
         # ACTIVE roles (SET ROLE); wire login activates default roles
         self.active_roles: set[str] = set()
         # processlist state (Info/Time columns)
@@ -225,6 +228,12 @@ class Session:
                     stmt, (ast.SelectStmt, ast.SetOpStmt))) else None
             self._binding_match_sql = self._plan_cache_key
             self._raw_sql = sql if single else None
+            # the replica-read router forwards SQL TEXT, so it only
+            # ever routes a statement that IS its own text: a single
+            # top-level SELECT (INSERT..SELECT re-enters _exec_select
+            # with this unset; prepared statements carry bound ASTs,
+            # not reproducible text)
+            self._route_sql = self._plan_cache_key
             try:
                 # batch members skip digest recording: per-statement text
                 # isn't recoverable from the batch label, and raw batch
@@ -236,6 +245,7 @@ class Session:
                 self._plan_cache_key = None
                 self._binding_match_sql = None
                 self._raw_sql = None
+                self._route_sql = None
         # delta-driven auto-analyze at statement boundaries (the reference
         # runs this in the stats owner's background loop,
         # statistics/handle/update.go:860; single-process checks inline)
@@ -1612,6 +1622,26 @@ class Session:
                 with obs.stage("plan_build", span_name="planner.optimize"):
                     plan = self._plan_cached(stmt, uncacheable=has_vars)
                 self._check_column_privs(plan)
+                # follower read tier: an eligible snapshot read may be
+                # served by a replica whose closed ts covers our
+                # read_ts (rpc/replica.py). Routed BEFORE admission —
+                # the gate bounds LOCAL execution, and an offloaded
+                # read must not consume a leader token. Privileges were
+                # checked above; on any staleness/term/transport
+                # trouble try_route returns None and the unchanged
+                # local path below answers.
+                from ..rpc import replica as _replica
+                routed = _replica.try_route(
+                    self, stmt, getattr(self, "_route_sql", None),
+                    has_vars, expect_cols=len(plan.schema.fields))
+                if routed is not None:
+                    names = [f.name for f in plan.schema.fields]
+                    ftypes = [f.ftype for f in plan.schema.fields]
+                    self._found_rows = len(routed.rows)
+                    self.vars["last_plan_from_binding"] = getattr(
+                        self, "_lpfb_next", 0)
+                    return ResultSet(names, routed.rows,
+                                     column_types=ftypes)
                 # execution admission: the gate bounds concurrently
                 # RUNNING statements, priority from the planner's cost
                 # estimate (point gets and small scans outrank
@@ -3039,6 +3069,30 @@ class Session:
         # explain output, executor/executor.go:262)
         from .. import obs
         from ..plan.physical import explain_nodes
+
+        # follower read tier: when the router would serve this read
+        # from a replica, EXPLAIN ANALYZE executes THAT — the routing
+        # decision is the plan (engine column `replica@host:port`);
+        # per-node device stats belong to the serving replica's own
+        # surfaces (its slow log / Top SQL / EXPLAIN ANALYZE)
+        from ..rpc import replica as _replica
+        routed = _replica.try_route(
+            self, stmt.target, m.group(1) if m else None,
+            self._has_var_reads(stmt.target),
+            expect_cols=len(plan.schema.fields))
+        if routed is not None:
+            self._commit_implicit()  # release the routing read ts
+            rows = []
+            for i, line in enumerate(explain_plan(plan)):
+                rows.append((
+                    line,
+                    len(routed.rows) if i == 0 else None,
+                    round(routed.wall_ms, 2) if i == 0 else None,
+                    f"replica@{routed.addr}" if i == 0 else "",
+                    f"replica_read:{routed.wall_ms / 1e3:.3f}"
+                    if i == 0 else "", ""))
+            return ResultSet(["plan", "actRows", "time_ms", "engine",
+                              "stages", "mesh"], rows)
 
         coll = obs.RuntimeStatsColl()
 

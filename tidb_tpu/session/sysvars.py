@@ -104,6 +104,16 @@ _VARS = [
     _v("tidb_enable_plan_cache", 1),
     _v("tidb_txn_mode", "optimistic"),
     _v("tidb_retry_limit", 10),
+    # follower read tier (rpc/replica.py): "follower" routes eligible
+    # snapshot SELECTs to serving replicas; "leader" (default) keeps
+    # every read local. Config [replica-read] prefer-follower seeds the
+    # global default (reference: tidb_replica_read, tidb_vars.go)
+    _v("tidb_replica_read", "leader"),
+    # bounded-staleness reads: a NEGATIVE number of seconds (-5 = read
+    # up to 5s stale, the reference's tidb_read_staleness semantics),
+    # capped by replica-read.max-staleness-ms; relaxes the closed-ts
+    # fence so a lagging replica can still serve. 0 = exact snapshot.
+    _v("tidb_read_staleness", 0),
     _v("tidb_tile_rows", 1 << 22),
     _v("tidb_gc_life_time", "10m0s", scope=SCOPE_GLOBAL),
     _v("tidb_gc_run_interval", "10m0s", scope=SCOPE_GLOBAL),

@@ -150,6 +150,16 @@ class Storage:
         self._diag_clients_lock = threading.Lock()
         self._last_members = None
         self._last_members_ts = -1e9
+        # follower read tier (rpc/apply.py + rpc/replica.py): per-
+        # storage routing/serving knobs, the follower's continuous
+        # apply engine (started at the end of __init__ for socket
+        # followers; arm_replica_read re-evaluates after config seeds),
+        # and the pooled internal sessions replica reads execute on
+        from ..rpc.replica import ReplicaReadState
+        self.replica_read = ReplicaReadState()
+        self.apply_engine = None
+        self._replica_pool: list = []
+        self._replica_pool_lock = threading.Lock()
         if self.remote:
             from ..rpc.client import RpcClient, RpcOptions
             from ..rpc.diag import DiagListener
@@ -402,6 +412,21 @@ class Storage:
             from ..rpc.failover import FailoverManager
             self.failover = FailoverManager(self, self._rpc_options)
             self.failover.start()
+        if self.remote:
+            # follower read tier: fold the mirror continuously and
+            # advertise the closed/applied ts on every heartbeat
+            # (rpc/apply.py). Env knobs cover embedded/test stores the
+            # config seeds never reach.
+            interval = os.environ.get("TIDB_TPU_REPLICA_APPLY_MS")
+            if interval:
+                try:
+                    self.replica_read.apply_interval_ms = int(interval)
+                except ValueError:
+                    pass
+            if os.environ.get("TIDB_TPU_REPLICA_READ", "").lower() \
+                    in ("0", "false", "off"):
+                self.replica_read.enabled = False
+            self.arm_replica_read()
 
     # ---- schema ------------------------------------------------------------
     def register_table(self, info: TableInfo) -> TableStore:
@@ -854,6 +879,8 @@ class Storage:
             h["term"] = self._rpc_client.term
             if self.failover is not None:
                 h["failover"] = self.failover.describe()
+            if self.apply_engine is not None:
+                h["replica_apply"] = self.apply_engine.info()
             from ..rpc.diag import cluster_members
             h["members"] = cluster_members(self, budget_ms=500)
             return h
@@ -910,6 +937,11 @@ class Storage:
         # until we answer as a leader)
         self._promoting = True
         try:
+            # the apply engine folds the mirror this promotion is about
+            # to re-open as the authoritative engine: stop it first
+            if self.apply_engine is not None:
+                self.apply_engine.close()
+                self.apply_engine = None
             addr = self._promote_locked(client, opts, new_term, listen)
             self.obs.events.record(
                 "leader_promoted", severity="warn",
@@ -1009,6 +1041,11 @@ class Storage:
         # fire (or promote!) halfway through our own teardown
         if self.failover is not None:
             self.failover.close()
+        # the apply engine next: its tick path runs RPC + fold against
+        # the structures torn down below
+        if self.apply_engine is not None:
+            self.apply_engine.close()
+            self.apply_engine = None
         # diagnostics plane next: the history sampler and the follower
         # diag listener are joined here so no thread outlives the store
         # (the profiler-lifecycle contract tests/test_trace.py pins)
@@ -1084,6 +1121,54 @@ class Storage:
 
     def table_store(self, table_id: int) -> TableStore:
         return self.tables[table_id]
+
+    # ---- follower read tier (rpc/apply.py + rpc/replica.py) -----------------
+    def arm_replica_read(self) -> None:
+        """Start or stop the continuous apply engine to match the
+        replica-read settings (called from __init__ and from
+        Config.seed_replica_read on startup/SIGHUP). Leaders and
+        local stores never run one — the engine folds a MIRROR."""
+        if not self.remote:
+            return
+        from ..rpc.apply import ApplyEngine
+        if self.replica_read.enabled and self.apply_engine is None:
+            self.apply_engine = ApplyEngine(
+                self, interval_ms=self.replica_read.apply_interval_ms)
+        elif self.replica_read.enabled:
+            # a reseed with a new cadence adjusts the running engine
+            self.apply_engine.interval_ms = max(
+                10, int(self.replica_read.apply_interval_ms))
+        elif self.apply_engine is not None:
+            eng, self.apply_engine = self.apply_engine, None
+            eng.close()
+            # the heartbeat must stop advertising a serving replica
+            # (atomic dict REPLACEMENT — the heartbeat thread unpacks
+            # ping_params concurrently)
+            client = self._rpc_client
+            if client is not None:
+                client.ping_params = {**client.ping_params,
+                                      "serving": False,
+                                      "applied_ts": 0,
+                                      "apply_lag_ms": 0.0}
+
+    def pin_snapshot_ts(self, ts: int) -> None:
+        """Register an EXTERNALLY chosen snapshot ts (a routed replica
+        read at the router's read_ts) with the compaction safepoint;
+        released through release_snapshot_ts like any acquired one."""
+        with self._snap_lock:
+            self._active_snapshots[ts] = \
+                self._active_snapshots.get(ts, 0) + 1
+
+    def _tso_commit_done(self) -> None:
+        """Retire this storage's pending-commit ledger entry (socket
+        followers; rpc/server.py closed_info). No-op on local oracles.
+        Called OUTSIDE the commit lock — it is an RPC."""
+        done = getattr(self.tso, "commit_done", None)
+        if done is not None:
+            try:
+                done()
+            except Exception:  # noqa: BLE001 — best-effort retire
+                pass
 
     # ---- snapshot registry (compaction safepoint) ---------------------------
     def acquire_snapshot_ts(self) -> int:
@@ -1257,31 +1342,40 @@ class Storage:
         except (KVError, CommitError) as e:
             self._best_effort_rollback(kv_muts, txn.start_ts)
             raise WriteConflictError(f"commit failed: {e}") from None
-        with self._commit_lock, self._fold_section():
-            if self.shared:
-                # fold sibling commits observed during prewrite and adopt
-                # any schema change BEFORE the authoritative fence check
-                self.kv.refresh()
-                self._drain_refresh()
-            try:
-                self._check_schema_fence(txn)
-            except WriteConflictError:
-                self._best_effort_rollback(kv_muts, txn.start_ts)
-                raise
-            try:
-                commit_ts = self.committer.commit_phase(state, txn.start_ts)
-            except (KVError, CommitError) as e:
-                self._best_effort_rollback(kv_muts, txn.start_ts)
-                raise WriteConflictError(f"commit failed: {e}") from None
-            # columnar fold of the committed mutations (the coprocessor's
-            # read view) — inside the lock so no snapshot can observe the
-            # KV commit without the fold
-            from ..util import failpoint
-            failpoint.inject("storage/before-fold")
-            for (table_id, handle), row in mutations.items():
-                store = self.tables.get(table_id)
-                if store is not None:
-                    store.apply_commit(commit_ts, handle, row)
+        try:
+            with self._commit_lock, self._fold_section():
+                if self.shared:
+                    # fold sibling commits observed during prewrite and
+                    # adopt any schema change BEFORE the authoritative
+                    # fence check
+                    self.kv.refresh()
+                    self._drain_refresh()
+                try:
+                    self._check_schema_fence(txn)
+                except WriteConflictError:
+                    self._best_effort_rollback(kv_muts, txn.start_ts)
+                    raise
+                try:
+                    commit_ts = self.committer.commit_phase(
+                        state, txn.start_ts)
+                except (KVError, CommitError) as e:
+                    self._best_effort_rollback(kv_muts, txn.start_ts)
+                    raise WriteConflictError(
+                        f"commit failed: {e}") from None
+                # columnar fold of the committed mutations (the
+                # coprocessor's read view) — inside the lock so no
+                # snapshot can observe the KV commit without the fold
+                from ..util import failpoint
+                failpoint.inject("storage/before-fold")
+                for (table_id, handle), row in mutations.items():
+                    store = self.tables.get(table_id)
+                    if store is not None:
+                        store.apply_commit(commit_ts, handle, row)
+        finally:
+            # pending-commit ledger retire (socket followers): by now
+            # the commit records are published or never will be, so the
+            # leader's closed ts may advance past our commit_ts
+            self._tso_commit_done()
         self.obs.commits.inc()
         # opportunistic compaction at the GC-safe ts
         safe = self.safe_ts()
@@ -1561,9 +1655,12 @@ class Storage:
             # timestamp that a WRITE would then carry
             start_ts = self.tso.ts()
             try:
-                with self._commit_lock:
-                    self.committer.commit(
-                        [Mutation(OP_PUT, key, value)], start_ts)
+                try:
+                    with self._commit_lock:
+                        self.committer.commit(
+                            [Mutation(OP_PUT, key, value)], start_ts)
+                finally:
+                    self._tso_commit_done()
                 return
             except KVWriteConflict:
                 if not retriable:
