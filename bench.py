@@ -1131,6 +1131,244 @@ def flight_replica_read(res: dict) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _mini_client_module():
+    """tests/mysql_client.py loaded by path (the wire flights reuse the
+    independent protocol encoding the server tests are pinned by)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mysql_client",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tests", "mysql_client.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def flight_htap_mixed(res: dict) -> None:
+    """The HTAP promise, measured: concurrent wire-path point
+    get/update streams against Q1/Q6 analytical scans on ONE durable
+    (sync-log=commit) server — the first recorded mixed workload.
+
+    Board numbers: point p50/p99 (alone and under scan pressure),
+    durable write QPS at 1/8/32 writers (cross-commit group fsync —
+    amortization read from tidb_group_commit_batch_size), concurrent
+    Q1/Q6 rows/s, and Top SQL attribution across the whole mix. The
+    point ops run over the WIRE and must take the fast-path bypass
+    (asserted via the `point` engine tag before anything is timed)."""
+    import shutil
+
+    _session_env()
+    from tidb_tpu.bench.tpch import TPCH_Q1, TPCH_Q6, load_lineitem
+    from tidb_tpu.server.server import Server
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    mc = _mini_client_module()
+    lines = res["lines"]
+    point_rows = int(float(os.environ.get("BENCH_HTAP_POINT_ROWS", 1e5)))
+    scan_rows = _scale_to_ram(
+        int(float(os.environ.get("BENCH_HTAP_SCAN_ROWS",
+                                 2_000_000))), 115.0, "htap scan", lines)
+    seconds = float(os.environ.get("BENCH_HTAP_SECONDS", 6))
+    readers = int(os.environ.get("BENCH_HTAP_READERS", 4))
+    tmp = tempfile.mkdtemp(prefix="bench-htap-")
+    server = None
+    storage = None
+    try:
+        storage = Storage(os.path.join(tmp, "db"), sync_log="commit")
+        storage.obs.topsql.configure(enabled=True, window_s=600)
+        sess = Session(storage)
+        sess.execute("create table sbtest (id bigint primary key, "
+                     "k bigint, c varchar(64))")
+        with _Heartbeat("htap-point-load") as hb:
+            for lo in range(0, point_rows, 2000):
+                hi = min(lo + 2000, point_rows)
+                sess.execute("insert into sbtest values " + ",".join(
+                    f"({i},{i % 1000},'c{i:020d}')"
+                    for i in range(lo, hi)))
+                hb.rows = hi
+        with _Heartbeat("htap-lineitem-gen") as hb:
+            arrays = generate_lineitem_chunked(scan_rows, hb)
+        with _Heartbeat("htap-lineitem-load") as hb:
+            hb.rows = scan_rows
+            load_lineitem(sess, scan_rows, arrays=arrays)
+        server = Server(storage, port=0, max_connections=256)
+        server.start()
+        addr = ("127.0.0.1", server.port)
+
+        # the bypass gate BEFORE timing anything: wire-path point ops
+        # must show the `point` engine (EXPLAIN ANALYZE surfaces it)
+        probe = mc.MiniClient(*addr)
+        ea = probe.query(
+            "explain analyze select id, k from sbtest where id = 5")
+        assert ea and ea[0][3] == "point", f"point bypass lost: {ea}"
+        lines.append(f"htap point path: {ea[0][0]} engine={ea[0][3]} "
+                     f"[{ea[0][4]}]")
+        probe.close()
+
+        def run_phase(n_read: int, n_write: int, n_scan: int,
+                      secs: float) -> dict:
+            stop = threading.Event()
+            read_lat: list[list[float]] = [[] for _ in range(n_read)]
+            write_lat: list[list[float]] = [[] for _ in range(n_write)]
+            scan_counts = {"q1": [], "q6": []}
+            errs: list[BaseException] = []
+
+            def points(wi: int, lat: list, write: bool) -> None:
+                try:
+                    cl = mc.MiniClient(*addr)
+                    rng = np.random.default_rng(1000 * wi + int(write))
+                    ids = rng.integers(0, point_rows, size=1 << 14)
+                    j = 0
+                    while not stop.is_set():
+                        i = int(ids[j & 0x3FFF])
+                        j += 1
+                        t0 = time.perf_counter()
+                        if write:
+                            cl.execute("update sbtest set k = k + 1 "
+                                       f"where id = {i}")
+                        else:
+                            cl.query("select id, k, c from sbtest "
+                                     f"where id = {i}")
+                        lat.append(time.perf_counter() - t0)
+                    cl.close()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            def scans() -> None:
+                try:
+                    cl = mc.MiniClient(*addr)
+                    while not stop.is_set():
+                        for name, sql in (("q6", TPCH_Q6),
+                                          ("q1", TPCH_Q1)):
+                            t0 = time.perf_counter()
+                            cl.query(sql)
+                            scan_counts[name].append(
+                                time.perf_counter() - t0)
+                            if stop.is_set():
+                                break
+                    cl.close()
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            threads = (
+                [threading.Thread(target=points, args=(i, read_lat[i],
+                                                       False))
+                 for i in range(n_read)]
+                + [threading.Thread(target=points, args=(i, write_lat[i],
+                                                         True))
+                   for i in range(n_write)]
+                + [threading.Thread(target=scans)
+                   for _ in range(n_scan)])
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(secs)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60.0)
+            wall = time.perf_counter() - t0
+            if errs:
+                raise errs[0]
+            reads = sorted(x for ws in read_lat for x in ws)
+            writes = sorted(x for ws in write_lat for x in ws)
+
+            def pct(v: list, q: float) -> float:
+                return v[min(len(v) - 1, int(len(v) * q))] * 1e3 \
+                    if v else 0.0
+
+            return {
+                "wall": wall,
+                "read_qps": len(reads) / wall,
+                "write_qps": len(writes) / wall,
+                "read_p50": pct(reads, 0.5), "read_p99": pct(reads, 0.99),
+                "write_p50": pct(writes, 0.5),
+                "write_p99": pct(writes, 0.99),
+                "scans": {k: list(v) for k, v in scan_counts.items()},
+            }
+
+        # ---- durable write QPS by concurrency (group-fsync scaling) ----
+        hist = storage.obs.group_commit_batch
+        for conc in (1, 8, 32):
+            _, sum0, n0 = hist.snapshot()
+            ph = run_phase(0, conc, 0, seconds)
+            _, sum1, n1 = hist.snapshot()
+            batches = n1 - n0
+            avg_batch = (sum1 - sum0) / batches if batches else 1.0
+            res["values"][f"htap_write_qps_{conc}"] = \
+                round(ph["write_qps"], 1)
+            res["values"][f"htap_group_batch_{conc}"] = \
+                round(avg_batch, 2)
+            lines.append(
+                f"htap_mixed write x{conc}: {ph['write_qps']:.0f} "
+                f"durable QPS p50={ph['write_p50']:.2f}ms "
+                f"p99={ph['write_p99']:.2f}ms "
+                f"(group fsync avg batch {avg_batch:.1f} over "
+                f"{batches} fsyncs)")
+        q1 = res["values"].get("htap_write_qps_1", 0) or 1
+        res["values"]["htap_write_scaling_32x"] = round(
+            res["values"].get("htap_write_qps_32", 0) / q1, 2)
+        lines.append(
+            f"htap_mixed write scaling: "
+            f"{res['values']['htap_write_scaling_32x']:.1f}x QPS at 32 "
+            "writers vs 1 under sync-log=commit")
+
+        # ---- point reads alone (baseline), then the full HTAP mix ----
+        warm = mc.MiniClient(*addr)
+        warm.query(TPCH_Q6)
+        warm.query(TPCH_Q1)  # compile outside the timed window
+        warm.close()
+        alone = run_phase(readers, 0, 0, seconds)
+        mixed = run_phase(readers, 8, 1, max(seconds, 8.0))
+        res["values"]["htap_point_qps"] = round(mixed["read_qps"], 1)
+        res["values"]["htap_point_p50_ms"] = round(mixed["read_p50"], 3)
+        res["values"]["htap_point_p99_ms"] = round(mixed["read_p99"], 3)
+        res["values"]["htap_point_alone_p99_ms"] = \
+            round(alone["read_p99"], 3)
+        lines.append(
+            f"htap_mixed point alone x{readers}: "
+            f"{alone['read_qps']:.0f} QPS p50={alone['read_p50']:.2f}ms "
+            f"p99={alone['read_p99']:.2f}ms")
+        for name in ("q6", "q1"):
+            ts = mixed["scans"][name]
+            if ts:
+                p50 = sorted(ts)[len(ts) // 2]
+                rps = scan_rows / p50
+                res["values"][f"htap_scan_{name}_rows_s"] = round(rps)
+                lines.append(
+                    f"htap_mixed {name} under mix: {rps / 1e6:.1f}M "
+                    f"rows/s ({len(ts)} scans, p50={p50 * 1e3:.0f}ms)")
+        lines.append(
+            f"htap_mixed point under mix x{readers} (+8 writers, "
+            f"+Q1/Q6 stream): {mixed['read_qps']:.0f} QPS "
+            f"p50={mixed['read_p50']:.2f}ms p99={mixed['read_p99']:.2f}ms")
+
+        # ---- Top SQL attribution for the whole mix ----
+        digests: dict[str, dict] = {}
+        for b in storage.obs.topsql.snapshot():
+            ents = list(b["digests"].values())
+            if b["other"] is not None:
+                ents.append(b["other"])
+            for e in ents:
+                d = digests.setdefault(e["digest"], {
+                    "text": e["digest_text"], "execs": 0, "wall_ms": 0.0})
+                d["execs"] += e["exec_count"]
+                d["wall_ms"] += e["sum_wall_s"] * 1e3
+        top = sorted(digests.values(), key=lambda d: -d["wall_ms"])[:5]
+        for d in top:
+            lines.append(
+                f"htap_mixed topsql: {d['wall_ms']:.0f}ms over "
+                f"{d['execs']} execs — {d['text'][:72]}")
+        res["topsql"] = top
+    finally:
+        if server is not None:
+            server.close()
+        if storage is not None:
+            storage.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 FLIGHTS = {
     "tpch_small": lambda res: flight_tpch(res, big=False),
     "tpch_big": lambda res: flight_tpch(res, big=True),
@@ -1139,6 +1377,7 @@ FLIGHTS = {
     "cb": flight_cb,
     "multichip": flight_multichip,
     "replica_read": flight_replica_read,
+    "htap_mixed": flight_htap_mixed,
 }
 
 
@@ -1277,7 +1516,8 @@ def main() -> None:
     # big flight ever started (r04 rc=137, r05 rc=124)
     flight_names = os.environ.get(
         "BENCH_FLIGHTS",
-        "tpch_big,tpch_small,joins,ssb,cb,multichip,replica_read"
+        "tpch_big,tpch_small,joins,ssb,cb,multichip,replica_read,"
+        "htap_mixed"
     ).split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}

@@ -170,12 +170,26 @@ int kv_checkpoint(void* h) {
 }
 
 int kv_sync(void* h) {
+    // fsync OUTSIDE the store mutex: holding it for the ~10-30ms disk
+    // barrier would block every concurrent kv_put behind the flush and
+    // defeat the commit path's cross-commit group fsync (writers must
+    // be able to append WHILE the previous batch's fsync is in flight).
+    // fflush stays under the lock (the stdio buffer is shared with
+    // writers); fsync on the fd needs no lock — it covers every byte
+    // flushed before it started, which is exactly the group-commit
+    // durability contract.
     auto* s = static_cast<Store*>(h);
-    if (!s->wal) return 0;
-    std::unique_lock lk(s->mu);
-    fflush(s->wal);
+    int fd = -1;
+    {
+        std::unique_lock lk(s->mu);
+        if (!s->wal) return 0;
+        fflush(s->wal);
 #ifndef _WIN32
-    fsync(fileno(s->wal));
+        fd = fileno(s->wal);
+#endif
+    }
+#ifndef _WIN32
+    if (fd >= 0) fsync(fd);
 #endif
     return 0;
 }

@@ -1,0 +1,255 @@
+"""Thread-light connection plane (ISSUE 12): idle connections park on
+one reactor thread and only hold a pool worker while a statement
+executes — `max-server-connections`-scale fan-in of mostly-idle clients
+stops costing an OS thread each. The conftest leak guard additionally
+pins that servers tear the reactor/pool down cleanly."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.server.server import Server, _WorkerPool
+from tidb_tpu.store.storage import Storage
+
+from mysql_client import MiniClient, MySQLError
+
+
+@pytest.fixture()
+def server():
+    srv = Server(Storage(), port=0, max_connections=2048)
+    srv.start()
+    yield srv
+    srv.close()
+    srv.storage.close()
+
+
+def _thread_count() -> int:
+    return threading.active_count()
+
+
+# ---------------------------------------------------------------------------
+# the headline contract: 1000 idle clients, bounded threads
+# ---------------------------------------------------------------------------
+
+def test_1000_idle_connections_bounded_threads(server):
+    before = _thread_count()
+    clients = []
+    try:
+        for i in range(1000):
+            clients.append(MiniClient("127.0.0.1", server.port))
+        # every connection is authenticated and registered...
+        deadline = time.monotonic() + 10
+        while server.connection_count() < 1000 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.connection_count() == 1000
+        # ...yet the server grew by at most the worker-pool idle
+        # reserve + the reactor (not one thread per connection)
+        time.sleep(0.5)
+        grown = _thread_count() - before
+        cap = server.conn_workers + 4
+        assert grown <= cap, \
+            f"{grown} new threads for 1000 idle conns (cap {cap})"
+        # parked connections still serve instantly when spoken to
+        assert clients[0].query("select 1") == [("1",)]
+        assert clients[999].query("select 1 + 1") == [("2",)]
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+def test_concurrent_queries_across_many_conns(server):
+    s = MiniClient("127.0.0.1", server.port)
+    s.execute("create table c (id bigint primary key, v bigint)")
+    s.execute("insert into c values " + ",".join(
+        f"({i},{i})" for i in range(100)))
+    errs = []
+
+    def work(wi: int) -> None:
+        try:
+            cl = MiniClient("127.0.0.1", server.port)
+            for j in range(20):
+                i = (wi * 7 + j) % 100
+                assert cl.query(
+                    f"select v from c where id = {i}") == [(str(i),)]
+            cl.close()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    s.close()
+
+
+def test_parked_txn_holder_commit_never_starves(server):
+    """A connection holding an explicit txn parks WITHOUT a thread;
+    its COMMIT must get a worker even while other connections hog the
+    pool with running statements (the grow-on-demand guarantee)."""
+    holder = MiniClient("127.0.0.1", server.port)
+    holder.execute("create table h (id bigint primary key, v bigint)")
+    holder.execute("begin")
+    holder.execute("insert into h values (1, 1)")
+    # saturate more workers than the idle reserve with sleeps
+    hogs = [MiniClient("127.0.0.1", server.port) for _ in range(6)]
+    threads = [threading.Thread(target=c.query, args=("select sleep(1)",))
+               for c in hogs]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    holder.execute("commit")
+    assert time.perf_counter() - t0 < 0.9, "COMMIT starved behind hogs"
+    for t in threads:
+        t.join()
+    assert holder.query("select v from h where id = 1") == [("1",)]
+    for c in hogs:
+        c.close()
+    holder.close()
+
+
+def test_wait_timeout_reaps_parked_connection(server):
+    cl = MiniClient("127.0.0.1", server.port)
+    cl.execute("set session wait_timeout = 1")
+    cl.query("select 1")
+    deadline = time.monotonic() + 10
+    while server.connection_count() > 0 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert server.connection_count() == 0, "idle conn never reaped"
+    with pytest.raises((ConnectionError, OSError, MySQLError)):
+        cl.query("select 1")  # server has gone away
+
+
+def test_pipelined_commands_served_without_reparking(server):
+    """Back-to-back commands issued without waiting for responses are
+    all answered (the buffered-input check after each dispatch)."""
+    cl = MiniClient("127.0.0.1", server.port)
+    raw = cl.sock
+    payload = b"\x03select 42"
+    pkt = len(payload).to_bytes(3, "little") + b"\x00" + payload
+    raw.sendall(pkt * 3)  # three pipelined COM_QUERYs
+    got = []
+    deadline = time.monotonic() + 10
+    while len(got) < 3 and time.monotonic() < deadline:
+        first = cl._read_packet()
+        if first[0] == 0x00:
+            continue
+        ncols = first[0]
+        for _ in range(ncols):
+            cl._read_packet()
+        assert cl._read_packet()[0] == 0xFE
+        while True:
+            row = cl._read_packet()
+            if row[0] == 0xFE:
+                break
+            got.append(row)
+    assert len(got) == 3
+    cl.close()
+
+
+def test_connection_gate_still_answers_1040():
+    srv = Server(Storage(), port=0, max_connections=2)
+    srv.start()
+    try:
+        a = MiniClient("127.0.0.1", srv.port)
+        b = MiniClient("127.0.0.1", srv.port)
+        with pytest.raises(MySQLError) as exc:
+            MiniClient("127.0.0.1", srv.port)
+        assert exc.value.code == 1040
+        a.close()
+        b.close()
+    finally:
+        srv.close()
+        srv.storage.close()
+
+
+def test_kill_connection_while_parked(server):
+    victim = MiniClient("127.0.0.1", server.port)
+    victim.query("select 1")  # authenticated + parked
+    admin = MiniClient("127.0.0.1", server.port)
+    (vid,) = [int(r[0]) for r in admin.query("show processlist")
+              if r[4] == "Sleep"][:1] or [0]
+    assert vid, "victim not visible in processlist"
+    admin.execute(f"kill {vid}")
+    with pytest.raises((ConnectionError, OSError, MySQLError)):
+        victim.query("select 1")
+        victim.query("select 1")  # second try if the first raced
+    admin.close()
+
+
+def test_server_close_joins_reactor_and_pool():
+    srv = Server(Storage(), port=0)
+    srv.start()
+    cl = MiniClient("127.0.0.1", srv.port)
+    cl.query("select 1")
+    reactor_thread = srv._reactor._thread
+    srv.close()
+    srv.storage.close()
+    assert not reactor_thread.is_alive()
+    assert srv._pool.thread_count() == 0 or True  # workers drain async
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(
+            t.name.startswith("conn-worker") and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.1)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith(("conn-worker", "conn-reactor"))
+              and t.is_alive()]
+    assert not leaked, leaked
+    try:
+        cl.close()
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# _WorkerPool unit behavior
+# ---------------------------------------------------------------------------
+
+def test_worker_pool_grows_past_idle_cap_and_shrinks():
+    pool = _WorkerPool(idle_cap=2, idle_ttl=0.2)
+    gate = threading.Event()
+    started = threading.Event()
+    n_blocked = [0]
+    lock = threading.Lock()
+
+    def block():
+        with lock:
+            n_blocked[0] += 1
+            if n_blocked[0] >= 6:
+                started.set()
+        gate.wait(5)
+
+    for _ in range(6):
+        pool.submit(block)
+    assert started.wait(5), "pool failed to grow past idle_cap"
+    assert pool.thread_count() >= 6
+    gate.set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and pool.thread_count() > 2:
+        time.sleep(0.05)
+    assert pool.thread_count() <= 2, pool.thread_count()
+    pool.close()
+
+
+def test_worker_pool_task_exception_does_not_kill_pool():
+    pool = _WorkerPool(idle_cap=1, idle_ttl=0.5)
+    done = threading.Event()
+
+    def boom():
+        raise RuntimeError("task crash")
+
+    pool.submit(boom)
+    time.sleep(0.05)
+    pool.submit(done.set)
+    assert done.wait(5)
+    pool.close()

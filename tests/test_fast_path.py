@@ -1,0 +1,429 @@
+"""OLTP point fast path (ISSUE 12): recognition, correctness vs the
+slow path, the plan-cache LRU, and the device-work-free lint.
+
+The lint half mirrors tests/test_device_path_lint.py's contract, with
+the sign flipped: point get/update/delete/insert must record ZERO
+compile/kernel/transfer/staging stage time and never touch the
+coprocessor client at all — a poisoned cop object makes any silent
+de-fasting raise at the exact call site.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+# stages that imply device (or dispatch-pipeline) work; a point
+# statement recording any of these has lost the bypass
+DEVICE_STAGES = ("staging", "transfer", "compile", "kernel",
+                 "device_get", "host_fallback", "plan_build")
+
+
+class PoisonCop:
+    """Raises on ANY coprocessor use. The session's statement epilogue
+    legitimately drains mesh telemetry (host-side no-ops); everything
+    else is a bypass violation."""
+
+    def drain_mesh_warnings(self):
+        return ()
+
+    def discard_mesh_pending(self):
+        return None
+
+    def __getattr__(self, name):
+        raise AssertionError(
+            f"point fast path touched the coprocessor: .{name}")
+
+
+@pytest.fixture()
+def point_session():
+    st = Storage()
+    s = Session(st)
+    s.cop = PoisonCop()
+    s.execute("create table p (id bigint primary key, k bigint, "
+              "c varchar(64))")
+    s.execute("insert into p values (1, 10, 'a'), (2, 20, 'b'), "
+              "(3, 30, 'c')")
+    return s
+
+
+def _assert_point(s, expect_engines=("point",)):
+    assert list(s.last_engines) == list(expect_engines), s.last_engines
+    bad = [k for k in s.last_stages if k in DEVICE_STAGES]
+    assert not bad, f"device/pipeline stages on the point path: {bad}"
+    assert "fast_plan" in s.last_stages
+
+
+# ---------------------------------------------------------------------------
+# the device-work-free lint (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+
+def test_lint_point_get_zero_device_work(point_session):
+    s = point_session
+    assert s.query("select * from p where id = 2") == [(2, 20, 'b')]
+    _assert_point(s)
+
+
+def test_lint_point_update_zero_device_work(point_session):
+    s = point_session
+    assert s.execute("update p set k = k + 5 where id = 1").affected == 1
+    _assert_point(s)
+    assert s.query("select k from p where id = 1") == [(15,)]
+
+
+def test_lint_point_delete_zero_device_work(point_session):
+    s = point_session
+    assert s.execute("delete from p where id = 3").affected == 1
+    _assert_point(s)
+    assert s.query("select * from p where id = 3") == []
+
+
+def test_lint_point_insert_zero_device_work(point_session):
+    s = point_session
+    assert s.execute("insert into p values (9, 90, 'i')").affected == 1
+    _assert_point(s)
+    assert s.query("select k from p where id = 9") == [(90,)]
+
+
+def test_lint_point_miss_zero_device_work(point_session):
+    s = point_session
+    assert s.query("select * from p where id = 404") == []
+    _assert_point(s)
+
+
+def test_point_latency_sub_ms(point_session):
+    """The sub-ms bound with CI headroom: the intrinsic path cost
+    (fastest warm execution) must be deep sub-ms, and the median must
+    stay low even with sibling test processes stealing the core. The
+    honest p99 on an otherwise-idle machine is the htap_mixed bench
+    flight's number."""
+    s = point_session
+    for _ in range(50):
+        s.query("select k from p where id = 1")
+    lat = []
+    for _ in range(300):
+        t0 = time.perf_counter()
+        s.query("select k from p where id = 1")
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    assert lat[0] < 1e-3, f"point floor {lat[0] * 1e3:.2f}ms >= 1ms"
+    p50 = lat[len(lat) // 2]
+    assert p50 < 5e-3, f"point p50 {p50 * 1e3:.2f}ms (pathological)"
+
+
+# ---------------------------------------------------------------------------
+# recognition boundaries — everything here must take the SLOW path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("sql", [
+    "select * from p where id > 1",              # range, not point
+    "select * from p where k = 10",              # non-key column
+    "select count(*) from p where id = 1",       # aggregate
+    "select * from p where id = 1 or id = 2",    # disjunction
+    "select * from p order by id",               # no key at all
+    "select * from p where id = 1 for update",   # locking read
+])
+def test_slow_shapes_not_recognized(sql):
+    st = Storage()
+    s = Session(st)
+    s.execute("create table p (id bigint primary key, k bigint, "
+              "c varchar(64))")
+    s.execute("insert into p values (1, 10, 'a'), (2, 20, 'b')")
+    s.query(sql)
+    assert "point" not in s.last_engines, sql
+
+
+def test_explicit_txn_not_bypassed(point_session):
+    s = point_session
+    s.cop = None  # explicit-txn point reads use the planned path
+    s.execute("begin")
+    s.query("select * from p where id = 1")
+    assert "point" not in s.last_engines
+    s.execute("commit")
+
+
+def test_insert_with_unique_secondary_not_bypassed():
+    s = Session()
+    s.execute("create table u (id bigint primary key, "
+              "email varchar(64) unique, v bigint)")
+    s.execute("insert into u values (1, 'a@x', 7)")
+    assert "point" not in s.last_engines  # guard keys need the slow path
+    # ...but unique-key point SELECT does bypass
+    assert s.query("select v from u where email = 'a@x'") == [(7,)]
+    assert list(s.last_engines) == ["point"]
+    with pytest.raises(Exception, match="Duplicate"):
+        s.execute("insert into u values (2, 'a@x', 8)")
+
+
+def test_partitioned_table_not_bypassed():
+    s = Session()
+    s.execute("create table pt (id bigint primary key, v bigint) "
+              "partition by hash(id) partitions 4")
+    s.execute("insert into pt values (1, 7)")
+    s.query("select v from pt where id = 1")
+    assert "point" not in s.last_engines
+
+
+# ---------------------------------------------------------------------------
+# correctness vs the slow path
+# ---------------------------------------------------------------------------
+
+def test_differential_fast_vs_slow_random_ops():
+    """Identical op streams against twin tables, one with the bypass
+    and one without: results and final table state must match."""
+    import random
+
+    s = Session()
+    s.execute("create table d1 (id bigint primary key, v bigint, "
+              "c varchar(32))")
+    s.execute("create table d2 (id bigint primary key, v bigint, "
+              "c varchar(32))")
+    for i in range(40):
+        for t in ("d1", "d2"):
+            s.execute(f"insert into {t} values ({i}, {i * 3}, 's{i}')")
+
+    def slow(fn):
+        s.execute("set tidb_enable_fast_path = 0")
+        try:
+            return fn()
+        finally:
+            s.execute("set tidb_enable_fast_path = 1")
+
+    rng = random.Random(11)
+    for _ in range(150):
+        i = rng.randrange(50)
+        op = rng.random()
+        if op < 0.4:
+            assert s.query(f"select v, c from d1 where id = {i}") == \
+                slow(lambda: s.query(
+                    f"select v, c from d2 where id = {i}"))
+        elif op < 0.65:
+            v = rng.randrange(100)
+            a = s.execute(
+                f"update d1 set v = v + {v} where id = {i}").affected
+            b = slow(lambda: s.execute(
+                f"update d2 set v = v + {v} where id = {i}").affected)
+            assert a == b
+        elif op < 0.8:
+            a = s.execute(f"delete from d1 where id = {i}").affected
+            b = slow(lambda: s.execute(
+                f"delete from d2 where id = {i}").affected)
+            assert a == b
+        else:
+            try:
+                a = s.execute(
+                    f"insert into d1 values ({i}, 1, 'x')").affected
+            except Exception:
+                a = "dup"
+            try:
+                b = slow(lambda: s.execute(
+                    f"insert into d2 values ({i}, 1, 'x')").affected)
+            except Exception:
+                b = "dup"
+            assert a == b
+    assert s.query("select * from d1 order by id") == \
+        s.query("select * from d2 order by id")
+
+
+def test_point_types_roundtrip():
+    s = Session()
+    s.execute("create table ty (id bigint primary key, d decimal(10,2), "
+              "dt date, f double, s varchar(16))")
+    s.execute("insert into ty values (1, 12.34, '1998-01-02', 1.5, 'x')")
+    assert list(s.last_engines) == ["point"]
+    rows = s.query("select d, dt, f, s from ty where id = 1")
+    s.execute("set tidb_enable_fast_path = 0")
+    want = s.query("select d, dt, f, s from ty where id = 1")
+    s.execute("set tidb_enable_fast_path = 1")
+    assert rows == want
+
+
+def test_residual_predicate_checked():
+    s = Session()
+    s.execute("create table r (id bigint primary key, k bigint, "
+              "c varchar(16))")
+    s.execute("insert into r values (1, 5, 'a')")
+    assert s.query("select id from r where id = 1 and k = 5") == [(1,)]
+    assert list(s.last_engines) == ["point"]
+    assert s.query("select id from r where id = 1 and k = 6") == []
+    assert s.query(
+        "select id from r where id = 1 and c = 'a' and k = 5") == [(1,)]
+
+
+def test_write_conflict_conservation_under_contention():
+    """Concurrent fast-path increments on ONE row: every ACKED update
+    is reflected exactly once (optimistic conflicts surface typed and
+    the app retries — same contract as the slow path, which can also
+    exhaust tidb_retry_limit under this much single-row contention)."""
+    st = Storage()
+    s0 = Session(st)
+    s0.execute("create table cc (id bigint primary key, v bigint)")
+    s0.execute("insert into cc values (1, 0)")
+    n_threads, per = 4, 25
+    acked = [0] * n_threads
+    errs = []
+
+    def bump(wi: int) -> None:
+        try:
+            s = Session(st)
+            for _ in range(per):
+                for _attempt in range(20):
+                    try:
+                        s.execute("update cc set v = v + 1 where id = 1")
+                        acked[wi] += 1
+                        break
+                    except Exception as e:  # noqa: BLE001 — typed
+                        msg = str(e)       # conflicts retry app-side
+                        if "conflict" not in msg and \
+                                "lock not found" not in msg:
+                            raise
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=bump, args=(i,))
+          for i in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    assert sum(acked) > 0
+    assert s0.query("select v from cc where id = 1") == [(sum(acked),)]
+
+
+# ---------------------------------------------------------------------------
+# plan cache: true LRU + counters + observability
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_lru_move_to_back_and_evict():
+    st = Storage()
+    s = Session(st)
+    s.execute("create table l (id bigint primary key, v bigint)")
+    for i in range(6):
+        s.execute(f"insert into l values ({i}, {i})")
+    s.execute("set tidb_plan_cache_size = 3")
+    e0 = st.obs.plan_cache_evictions.get()
+    for i in range(3):
+        s.query(f"select v from l where id = {i}")  # cache: 0,1,2
+    s.query("select v from l where id = 0")         # hit: 0 to the back
+    assert s.last_plan_from_cache
+    s.query("select v from l where id = 3")         # evicts 1 (LRU)
+    keys = list(s._plan_cache)
+    assert any("id = 0" in k for k in keys), keys   # survived via hit
+    assert not any("id = 1" in k for k in keys), keys
+    assert st.obs.plan_cache_evictions.get() > e0
+
+
+def test_plan_cache_counters_and_metrics_names():
+    st = Storage()
+    s = Session(st)
+    s.execute("create table m (id bigint primary key, v bigint)")
+    s.execute("insert into m values (1, 1)")
+    h0 = st.obs.plan_cache_hits.get()
+    m0 = st.obs.plan_cache_misses.get()
+    for _ in range(4):
+        s.query("select v from m where id = 1")
+    assert st.obs.plan_cache_misses.get() - m0 >= 1
+    assert st.obs.plan_cache_hits.get() - h0 == 3
+    text = st.obs.render()
+    for fam in ("tidb_plan_cache_hits_total",
+                "tidb_plan_cache_misses_total",
+                "tidb_plan_cache_evictions_total",
+                "tidb_group_commit_batch_size"):
+        assert fam in text, fam
+
+
+def test_prepared_statement_fast_path_and_cache():
+    """COM_STMT_EXECUTE's #stmt keys ride the same LRU: repeated
+    executions with the same params hit; the bypass stays engaged."""
+    st = Storage()
+    s = Session(st)
+    s.execute("create table ps (id bigint primary key, v bigint)")
+    s.execute("insert into ps values (7, 70)")
+    sid, n = s.prepare("select v from ps where id = ?")
+    assert n == 1
+    h0 = st.obs.plan_cache_hits.get()
+    for _ in range(3):
+        rs = s.execute_prepared(sid, [7])
+        assert rs.rows == [(70,)]
+        assert list(s.last_engines) == ["point"]
+    assert st.obs.plan_cache_hits.get() - h0 == 2
+    assert any(k.startswith("#stmt") for k in s._plan_cache)
+
+
+def test_explain_analyze_shows_point_and_cache():
+    s = Session()
+    s.execute("create table ea (id bigint primary key, v bigint)")
+    s.execute("insert into ea values (5, 50)")
+    rows = s.execute("explain analyze select v from ea where id = 5").rows
+    assert rows[0][3] == "point", rows
+    assert "Point_Get" in rows[0][0]
+    assert "plan_cache:" in rows[0][4]
+    assert rows[0][1] == 1  # actRows
+    # slow-path EXPLAIN ANALYZE still renders the full plan
+    rows = s.execute("explain analyze select sum(v) from ea").rows
+    assert all(r[3] != "point" for r in rows)
+
+
+def test_fast_plan_stage_feeds_top_sql():
+    """The fast_plan stage lands in the Top SQL stage split, so
+    fast-path coverage is observable per digest."""
+    st = Storage()
+    st.obs.topsql.configure(enabled=True, window_s=600)
+    s = Session(st)
+    s.execute("create table tsq (id bigint primary key, v bigint)")
+    s.execute("insert into tsq values (1, 1)")
+    for _ in range(3):
+        s.query("select v from tsq where id = 1")
+    ents = [e for b in st.obs.topsql.snapshot()
+            for e in b["digests"].values()
+            if "tsq" in e["digest_text"] and "select" in e["digest_text"]]
+    assert ents, "point digest missing from Top SQL"
+    assert any("fast_plan" in e["stages"] for e in ents), \
+        [e["stages"] for e in ents]
+
+
+def test_wire_path_point_ops_take_bypass():
+    """The acceptance lint's wire half: COM_QUERY point ops through the
+    real server take the bypass (EXPLAIN ANALYZE shows engine `point`),
+    and point DML round-trips over the wire."""
+    from mysql_client import MiniClient
+
+    from tidb_tpu.server.server import Server
+
+    srv = Server(Storage(), port=0)
+    srv.start()
+    try:
+        cl = MiniClient("127.0.0.1", srv.port)
+        cl.execute("create table w (id bigint primary key, v bigint)")
+        cl.execute("insert into w values (1, 10), (2, 20)")
+        ea = cl.query("explain analyze select v from w where id = 1")
+        assert ea and ea[0][3] == "point", ea
+        assert "Point_Get" in ea[0][0]
+        assert cl.execute("update w set v = v + 1 where id = 2") == 1
+        assert cl.query("select v from w where id = 2") == [("21",)]
+        assert cl.execute("delete from w where id = 1") == 1
+        assert cl.query("select v from w where id = 1") == []
+        # prepared-statement path: reuse the same point plan via the
+        # #stmt cache keys (text protocol client: replay identical text)
+        for _ in range(3):
+            assert cl.query("select v from w where id = 2") == [("21",)]
+        cl.close()
+    finally:
+        srv.close()
+        srv.storage.close()
+
+
+def test_sysvar_escape_hatch():
+    s = Session()
+    s.execute("create table esc (id bigint primary key, v bigint)")
+    s.execute("insert into esc values (1, 1)")
+    s.execute("set tidb_enable_fast_path = 0")
+    s.query("select v from esc where id = 1")
+    assert "point" not in s.last_engines
+    s.execute("set tidb_enable_fast_path = 1")
+    s.query("select v from esc where id = 1")
+    assert list(s.last_engines) == ["point"]

@@ -1,0 +1,266 @@
+"""Cross-commit group fsync (ISSUE 12): the SyncPolicy rendezvous,
+storage-level amortization, durability parity with the per-commit
+fsync it replaced, and the telemetry surfaces.
+
+The kill-9 halves live with the rest of the torture harness in
+tests/test_failover.py (slow-marked)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv.mvcc import SyncPolicy
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+
+
+# ---------------------------------------------------------------------------
+# SyncPolicy rendezvous unit tests
+# ---------------------------------------------------------------------------
+
+def _group_policy(fsync):
+    sp = SyncPolicy("commit", 100, fsync)
+    sp.defer_commit = True
+    return sp
+
+
+def test_rendezvous_amortizes_concurrent_commits():
+    calls = []
+
+    def slow_fsync():
+        time.sleep(0.02)
+        calls.append(1)
+
+    sp = _group_policy(slow_fsync)
+    batches = []
+    sp.on_batch = batches.append
+
+    def commit():
+        sp.mark_dirty()
+        sp.commit_sync()
+
+    threads = [threading.Thread(target=commit) for _ in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) <= 6, f"32 commits cost {len(calls)} fsyncs"
+    # every commit was covered by SOME batch's fsync
+    assert sum(batches) >= 32 - len(batches)
+
+
+def test_boundary_defers_but_commit_sync_is_required():
+    calls = []
+    sp = _group_policy(lambda: calls.append(1))
+    sp.mark_dirty()
+    sp.boundary()          # deferred: no fsync inside the section
+    assert calls == []
+    sp.commit_sync()       # the ack path pays it
+    assert calls == [1]
+    sp.commit_sync()       # already covered: no second fsync
+    assert calls == [1]
+
+
+def test_non_deferred_commit_policy_unchanged():
+    """A bare SyncPolicy (defer_commit False) keeps the historical
+    fsync-per-boundary behavior — the shared-dir/flock mode contract."""
+    calls = []
+    sp = SyncPolicy("commit", 100, lambda: calls.append(1))
+    sp.mark_dirty()
+    sp.boundary()
+    assert calls == [1]
+    sp.commit_sync()  # boundary already covered this write generation
+    assert calls == [1]
+
+
+def test_fsync_failure_propagates_and_stranded_waiters_retry():
+    fail_once = [True]
+    ok_calls = []
+
+    def flaky():
+        if fail_once[0]:
+            fail_once[0] = False
+            raise OSError("disk gone")
+        ok_calls.append(1)
+
+    sp = _group_policy(flaky)
+    errs = []
+
+    def commit():
+        sp.mark_dirty()
+        try:
+            sp.commit_sync()
+        except OSError as e:
+            errs.append(e)
+
+    threads = [threading.Thread(target=commit) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly the leader that hit the bad fsync fails; the stranded
+    # waiters elected a new leader and are durable
+    assert len(errs) == 1
+    assert ok_calls, "no retry fsync ran"
+    sp.mark_dirty()
+    sp.commit_sync()  # and the policy stays usable
+
+
+def test_leader_gather_window_and_max_batch():
+    calls = []
+    sp = _group_policy(lambda: calls.append(1))
+    sp.group_max_wait_us = 20000
+    sp.group_max_batch = 2
+    t0 = time.perf_counter()
+    sp.mark_dirty()
+    sp.commit_sync()
+    dt = time.perf_counter() - t0
+    assert dt >= 0.015, f"gather window skipped ({dt * 1e3:.1f}ms)"
+    assert calls == [1]
+
+
+# ---------------------------------------------------------------------------
+# storage-level behavior
+# ---------------------------------------------------------------------------
+
+def _count_wal_fsyncs(st) -> list:
+    """Monkeypatch the engine's fsync callable to count invocations."""
+    eng = st.kv.kv
+    calls = []
+    inner = eng._syncer._fsync
+
+    def counting():
+        calls.append(1)
+        inner()
+    eng._syncer._fsync = counting
+    return calls
+
+
+def test_concurrent_commits_share_fsyncs(tmp_path):
+    st = Storage(str(tmp_path / "db"), sync_log="commit")
+    s0 = Session(st)
+    s0.execute("create table g (id bigint primary key, v bigint)")
+    for i in range(64):
+        s0.execute(f"insert into g values ({i}, 0)")
+    calls = _count_wal_fsyncs(st)
+    _, sum0, n0 = st.obs.group_commit_batch.snapshot()
+    n_threads, per = 8, 6
+    errs = []
+
+    def work(wi: int) -> None:
+        try:
+            s = Session(st)
+            for j in range(per):
+                s.execute(f"update g set v = v + 1 "
+                          f"where id = {wi * per + j}")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    commits = n_threads * per
+    assert len(calls) < commits, \
+        f"{commits} durable commits cost {len(calls)} fsyncs (no " \
+        "amortization)"
+    # the histogram saw the same story
+    _, sum1, n1 = st.obs.group_commit_batch.snapshot()
+    assert sum1 - sum0 >= commits  # every commit counted into a batch
+    assert n1 - n0 <= len(calls)
+    # and every write is present
+    assert Session(st).query("select sum(v) from g")[0][0] == commits
+    st.close()
+
+
+def test_durability_parity_after_crash(tmp_path):
+    """Group commit keeps the sync-log=commit contract: every ACKED
+    commit survives a process crash (close() without checkpoint)."""
+    p = str(tmp_path / "db")
+    st = Storage(p, sync_log="commit")
+    s = Session(st)
+    s.execute("create table d (id bigint primary key, v bigint)")
+    acked = []
+    for i in range(20):
+        s.execute(f"insert into d values ({i}, {i})")
+        acked.append(i)
+    # crash: drop the storage without checkpoint/flush
+    st.kv.kv.close()
+    st2 = Storage(p)
+    got = {r[0] for r in Session(st2).query("select id from d")}
+    assert set(acked) <= got
+    st2.close()
+
+
+def test_group_commit_event_and_knobs(tmp_path):
+    st = Storage(str(tmp_path / "db"), sync_log="commit")
+    st.configure_group_commit(max_batch=16, max_wait_us=500)
+    syncer = st.kv.kv._syncer
+    assert syncer.group_max_batch == 16
+    assert syncer.group_max_wait_us == 500
+    # a multi-commit batch emits a throttled group_commit event
+    st._note_group_commit(4)
+    kinds = {e["kind"] for e in st.obs.events.snapshot()}
+    assert "group_commit" in kinds
+    st.close()
+
+
+def test_wire_path_off_mode_untouched(tmp_path):
+    """sync-log=off stores never fsync at commit (commit_sync no-op)."""
+    st = Storage(str(tmp_path / "db"), sync_log="off")
+    calls = _count_wal_fsyncs(st)
+    s = Session(st)
+    s.execute("create table o (id bigint primary key)")
+    for i in range(5):
+        s.execute(f"insert into o values ({i})")
+    assert calls == []
+    st.close()
+
+
+def test_amortization_factor_grows_with_writers(tmp_path):
+    """The acceptance shape at test scale, measured by the load-
+    insensitive invariant: the commits-per-fsync factor at 8 writers
+    beats the single-writer 1.0 (wall-clock QPS is the bench flight's
+    number — a contended CI core makes it unusable here)."""
+    st = Storage(str(tmp_path / "db"), sync_log="commit")
+    # make the fsync expensive enough to dominate (CI tmpfs fsyncs in
+    # microseconds and writers would outrun the rendezvous window)
+    eng = st.kv.kv
+    inner = eng._syncer._fsync
+
+    def padded():
+        inner()
+        time.sleep(0.004)
+    eng._syncer._fsync = padded
+    s0 = Session(st)
+    s0.execute("create table t (id bigint primary key, v bigint)")
+    for i in range(256):
+        s0.execute(f"insert into t values ({i}, 0)")
+
+    def factor(conc: int, per: int = 12) -> float:
+        _, sum0, n0 = st.obs.group_commit_batch.snapshot()
+
+        def w(wi: int) -> None:
+            s = Session(st)
+            for j in range(per):
+                s.execute(f"update t set v = v + 1 "
+                          f"where id = {(wi * 29 + j) % 256}")
+        threads = [threading.Thread(target=w, args=(i,))
+                   for i in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        _, sum1, n1 = st.obs.group_commit_batch.snapshot()
+        return (sum1 - sum0) / max(n1 - n0, 1)
+
+    f1 = factor(1)
+    f8 = factor(8)
+    assert f1 <= 1.5, f"single writer should not batch ({f1:.2f})"
+    assert f8 > 1.3, f"no fsync amortization at 8 writers ({f8:.2f})"
+    st.close()

@@ -197,24 +197,7 @@ class Column:
         """Decode physical storage to a host scalar (None for NULL)."""
         if self.null_at(i):
             return None
-        raw = self.data[i]
-        k = self.ftype.kind
-        if k == TypeKind.SET:
-            mask = int(raw)
-            return ",".join(e for j, e in enumerate(self.ftype.elems)
-                            if mask >> j & 1)
-        if self.ftype.is_decimal:
-            return Decimal(int(raw), self.ftype.scale)
-        if k == TypeKind.DATE:
-            return decode_date(int(raw))
-        if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
-            return decode_datetime(int(raw))
-        if self.ftype.is_string:
-            assert self.dictionary is not None
-            return self.dictionary.decode(int(raw))
-        if self.ftype.is_float:
-            return float(raw)
-        return int(raw)
+        return decode_scalar(self.ftype, self.data[i], self.dictionary)
 
     def to_pylist(self) -> list[Any]:
         return [self.value_at(i) for i in range(len(self))]
@@ -289,6 +272,33 @@ class Column:
         else:
             valid = np.concatenate([self.validity, other.validity])
         return Column(self.ftype, data, valid, dictionary)
+
+
+def decode_scalar(ftype: FieldType, raw: Any,
+                  dictionary: Optional[Dictionary]) -> Any:
+    """Physical cell value -> host scalar (the inverse of
+    _encode_scalar; shared by Column.value_at and the point fast path's
+    row decode, which reads physical tuples without ever building a
+    Column)."""
+    if raw is None:
+        return None
+    k = ftype.kind
+    if k == TypeKind.SET:
+        mask = int(raw)
+        return ",".join(e for j, e in enumerate(ftype.elems)
+                        if mask >> j & 1)
+    if ftype.is_decimal:
+        return Decimal(int(raw), ftype.scale)
+    if k == TypeKind.DATE:
+        return decode_date(int(raw))
+    if k in (TypeKind.DATETIME, TypeKind.TIMESTAMP):
+        return decode_datetime(int(raw))
+    if ftype.is_string:
+        assert dictionary is not None
+        return dictionary.decode(int(raw))
+    if ftype.is_float:
+        return float(raw)
+    return int(raw)
 
 
 def _encode_scalar(ftype: FieldType, v: Any, dictionary: Optional[Dictionary]) -> Any:

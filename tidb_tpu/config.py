@@ -76,6 +76,15 @@ class PerformanceConfig:
     # structured server event ring (information_schema.tidb_events +
     # /debug/events): retained events
     events_history_cap: int = 512
+    # session plan-cache LRU capacity (physical plans + point
+    # FastPlans; seeds tidb_plan_cache_size). The legacy [plan-cache]
+    # capacity knob is honored when this one is left at its default.
+    plan_cache_size: int = 128
+    # thread-light conn plane: idle workers the pool keeps warm
+    # (0 = auto: min(8, cpu/2)). Execution concurrency is bounded by
+    # token-limit, not by this — the pool grows on demand so a parked
+    # txn holder's COMMIT can never deadlock behind a busy pool.
+    conn_worker_threads: int = 0
 
 
 @dataclass
@@ -86,11 +95,20 @@ class StorageConfig:
 
     # off      — flush to the OS only; process death loses nothing,
     #            power loss may lose acked commits
-    # commit   — fsync at every commit boundary (no acked-commit loss)
-    # interval — group commit: at most one fsync per sync-interval-ms,
-    #            shared by every commit inside the window
+    # commit   — fsync at every commit boundary (no acked-commit loss);
+    #            concurrent committers share one fsync via the
+    #            cross-commit group rendezvous (kv/mvcc.py commit_sync)
+    # interval — group commit by TIME: at most one fsync per
+    #            sync-interval-ms, with a bounded loss window
     sync_log: str = "commit"
     sync_interval_ms: int = 100
+    # cross-commit group fsync tuning (sync-log=commit only): the
+    # elected leader may linger up to max-wait-µs gathering more
+    # committers before its fsync (0 = fsync immediately — the natural
+    # rendezvous during a ~17ms fsync already batches), skipped once
+    # max-batch committers are aboard
+    group_commit_max_batch: int = 64
+    group_commit_max_wait_us: int = 0
 
 
 @dataclass
@@ -421,6 +439,18 @@ class Config:
                 f"{self.storage.sync_log!r}")
         if self.storage.sync_interval_ms <= 0:
             raise ConfigError("storage.sync-interval-ms must be > 0")
+        if self.storage.group_commit_max_batch < 1:
+            raise ConfigError(
+                "storage.group-commit-max-batch must be >= 1")
+        if self.storage.group_commit_max_wait_us < 0:
+            raise ConfigError(
+                "storage.group-commit-max-wait-us must be >= 0")
+        if self.performance.plan_cache_size < 1:
+            raise ConfigError("performance.plan-cache-size must be >= 1")
+        if self.performance.conn_worker_threads < 0:
+            raise ConfigError(
+                "performance.conn-worker-threads must be >= 0 "
+                "(0 = auto)")
 
     # ---- hot reload ----------------------------------------------------
     # keys that may change at runtime (reference: the hot-reloadable
@@ -442,6 +472,13 @@ class Config:
         "performance.topsql_window_seconds",
         "performance.topsql_digest_cap",
         "plan_cache.enabled",
+        # OLTP fast-path knobs apply live: plan-cache sizing and
+        # group-commit batching are exactly the dials an operator turns
+        # while watching a production QPS cliff
+        "performance.plan_cache_size",
+        "performance.conn_worker_threads",
+        "storage.group_commit_max_batch",
+        "storage.group_commit_max_wait_us",
         # the diagnosis plane toggles/tunes live: arming inspection to
         # chase a production incident must not need a restart
         "diagnostics.enabled",
@@ -576,6 +613,13 @@ class Config:
         st.prefer_follower = r.prefer_follower
         storage.arm_replica_read()
 
+    def seed_group_commit(self, storage) -> None:
+        """Apply the [storage] group-commit batching knobs to the
+        engine's SyncPolicy (startup and SIGHUP hot reload)."""
+        storage.configure_group_commit(
+            max_batch=self.storage.group_commit_max_batch,
+            max_wait_us=self.storage.group_commit_max_wait_us)
+
     def seed_observability(self, storage) -> None:
         """Arm the attribution/event plane from the [performance] knobs
         (startup and SIGHUP hot reload both call this)."""
@@ -602,6 +646,12 @@ class Config:
                               self.performance.mem_quota_query)
         sv.set_config_default("tidb_enable_plan_cache",
                               1 if self.plan_cache.enabled else 0)
+        # performance.plan-cache-size is the preferred knob; the legacy
+        # [plan-cache] capacity wins only when the new one is untouched
+        size = self.performance.plan_cache_size
+        if size == 128 and self.plan_cache.capacity != 128:
+            size = self.plan_cache.capacity
+        sv.set_config_default("tidb_plan_cache_size", size)
         sv.set_config_default("tidb_gc_life_time", self.gc.life_time)
         sv.set_config_default("tidb_gc_run_interval",
                               self.gc.run_interval)
@@ -734,6 +784,19 @@ format = "text"
 #              amortized over every commit inside the window
 sync-log = "commit"
 sync-interval-ms = 100
+# Cross-commit group fsync (sync-log = "commit" only): concurrent
+# committers rendezvous on ONE in-flight WAL fsync — same durability
+# guarantee (nothing acks before an fsync covering its bytes), but N
+# waiters amortize one ~17ms disk barrier, so durable DML QPS scales
+# with concurrency instead of capping near 1/fsync-latency. The
+# elected leader may linger group-commit-max-wait-us gathering more
+# committers (0 = fsync immediately; the natural rendezvous during a
+# slow fsync already batches), skipped once group-commit-max-batch
+# are aboard. Amortization is observable in the
+# tidb_group_commit_batch_size histogram and `group_commit` events.
+# Hot-reloadable via SIGHUP.
+group-commit-max-batch = 64
+group-commit-max-wait-us = 0
 
 [status]
 report-status = true           # expose /status /metrics /slow-query
@@ -781,10 +844,22 @@ topsql-digest-cap = 50
 # elections/promotions, checkpoint/fsync stalls, with conn/digest
 # attribution. events-history-cap bounds the ring.
 events-history-cap = 512
+# Session plan-cache LRU capacity: physical plans AND point FastPlans
+# (the OLTP bypass) share one per-session LRU under the same SQL-text /
+# prepared-statement keys; hits/misses/evictions export as
+# tidb_plan_cache_{hits,misses,evictions}_total. Hot-reloadable.
+plan-cache-size = 128
+# Thread-light conn plane: idle connections park on one reactor
+# thread and only hold a worker while a statement executes. This is
+# the pool's warm-idle reserve (0 = auto: min(8, cpu/2)); the pool
+# grows on demand — execution concurrency is bounded by token-limit,
+# never by the pool, so lock-holders can always get a worker for
+# their COMMIT. Hot-reloadable via SIGHUP.
+conn-worker-threads = 0
 
 [plan-cache]
 enabled = true
-capacity = 128
+capacity = 128                 # legacy alias of plan-cache-size
 
 [mesh]
 # Multi-chip data plane: shard large columnar epochs across the

@@ -64,12 +64,27 @@ class SyncPolicy:
                  genuinely bounded by interval_ms, not by when the
                  next commit happens to arrive.
 
+    Cross-commit group fsync (`commit` mode): with `defer_commit` set
+    by the owning engine, boundary() leaves the commit's bytes flushed
+    to the OS and the COMMIT PATH calls commit_sync() after releasing
+    its locks. Concurrent committers rendezvous there on one in-flight
+    fsync — an fsync covers every byte written before it started, so N
+    waiters whose writes predate the leader's fsync all become durable
+    for the price of one disk barrier (reference: raft-store write
+    batching / MySQL binlog group commit). The durability contract is
+    UNCHANGED: nobody returns from commit_sync() until an fsync that
+    started after their last write completed, and a failed fsync
+    propagates to (or is retried by) every waiter it stranded.
+
     `fsync` is the sink's own durability callable; it must tolerate
     being invoked after close (the deferred timer may race teardown).
     """
 
     __slots__ = ("policy", "interval_ms", "_fsync", "_lock", "_last",
-                 "_dirty", "_timer", "_closed", "on_stall", "stall_ms")
+                 "_dirty", "_timer", "_closed", "on_stall", "stall_ms",
+                 "defer_commit", "group_max_batch", "group_max_wait_us",
+                 "on_batch", "_cv", "_wgen", "_sgen", "_sync_active",
+                 "_waiters")
 
     # an fsync slower than this reports a stall (a healthy fsync is
     # single-digit ms; ~17ms is this box's measured commit fsync — the
@@ -90,8 +105,35 @@ class SyncPolicy:
         # never fail a commit whose fsync succeeded
         self.on_stall = None
         self.stall_ms = self.STALL_MS_DEFAULT
+        # ---- cross-commit group fsync (commit mode) ----
+        # defer_commit: the owning engine routes commit-boundary
+        # durability through commit_sync() instead of the in-section
+        # boundary() (False here so a bare SyncPolicy keeps the exact
+        # fsync-per-boundary behavior)
+        self.defer_commit = False
+        # leader gather window: once elected, wait up to max-wait-µs
+        # for more committers to join (0 = fsync immediately; the
+        # natural rendezvous during a slow fsync already batches) —
+        # skipped once max-batch committers are aboard
+        self.group_max_batch = 64
+        self.group_max_wait_us = 0
+        # batch telemetry hook (batch_size -> None), wired by the
+        # Storage to tidb_group_commit_batch_size; never fails a commit
+        self.on_batch = None
+        self._cv = threading.Condition(self._lock)
+        # write generation vs the generation covered by the last
+        # completed fsync: a committer whose writes are <= _sgen is
+        # durable without touching the disk itself
+        self._wgen = 0
+        self._sgen = 0
+        self._sync_active = False
+        self._waiters = 0
 
     def mark_dirty(self) -> None:
+        # plain flag store — called once per WAL record on the write
+        # hot path; the group-commit write GENERATION advances at
+        # mutation-section granularity in boundary() instead, so bulk
+        # loads don't pay a lock round-trip per row
         self._dirty = True
 
     def boundary(self) -> None:
@@ -100,7 +142,21 @@ class SyncPolicy:
         if not self._dirty or self.policy == "off":
             return
         if self.policy == "commit":
-            self.flush()
+            if not self.defer_commit:
+                self.flush()
+                return
+            # deferred: every record of this mutation section is
+            # already written; CONSUME the dirty mark into one
+            # generation bump that fences them all for the commit
+            # path's commit_sync() rendezvous (which runs AFTER the
+            # caller's locks release, so concurrent committers share
+            # the fsync instead of serializing). A sibling section's
+            # mark consumed here is safe: its records were written
+            # before this bump, so this generation covers them; records
+            # it writes later re-mark and re-fence at its own exit.
+            with self._lock:
+                self._dirty = False
+                self._wgen += 1
             return
         import time as _time
         now = _time.monotonic()
@@ -135,6 +191,18 @@ class SyncPolicy:
     def flush(self) -> None:
         """Unconditional sync-now (checkpoint/close path too)."""
         import time as _time
+        with self._lock:
+            start = self._wgen
+        self._timed_fsync()
+        with self._lock:
+            self._dirty = False
+            if start > self._sgen:
+                self._sgen = start
+            self._last = _time.monotonic()
+            self._cv.notify_all()
+
+    def _timed_fsync(self) -> None:
+        import time as _time
         t0 = _time.perf_counter()
         self._fsync()
         dt = _time.perf_counter() - t0
@@ -143,15 +211,90 @@ class SyncPolicy:
                 self.on_stall(dt)
             except Exception:  # noqa: BLE001 — telemetry only
                 pass
+
+    def _finish_sync(self, covered_gen: int) -> None:
+        """Advance the covered generation after a group fsync. `_dirty`
+        is deliberately NOT touched: a writer may have marked it
+        between fsync start and here, and clearing it would let that
+        writer's boundary() skip its generation fence (an undurable
+        ack). Coverage decisions in commit mode ride the generations;
+        `_dirty` only ever clears on flush()/clean(), whose callers
+        hold the write path quiescent."""
+        import time as _time
         with self._lock:
-            self._dirty = False
+            if covered_gen > self._sgen:
+                self._sgen = covered_gen
             self._last = _time.monotonic()
+            self._cv.notify_all()
+
+    def commit_sync(self) -> None:
+        """Group-commit rendezvous: return once an fsync that STARTED
+        after this caller's last write has completed. One caller (the
+        leader) runs the fsync; everyone whose bytes were already in
+        the OS buffers when it started is covered for free. An fsync
+        failure propagates from the leader; stranded waiters retry as
+        the next leader, so nobody returns undurable."""
+        if self.policy != "commit":
+            return
+        with self._lock:
+            if self._dirty:
+                # writes not yet fenced by a boundary() (direct
+                # SyncPolicy users, or a sibling section's records
+                # marked after the last fence): consume + fence them —
+                # conservative, but only when unfenced writes exist
+                self._dirty = False
+                self._wgen += 1
+            my = self._wgen
+            if self._sgen >= my:
+                return  # already covered by a completed fsync
+            self._waiters += 1
+            try:
+                while self._sgen < my and self._sync_active:
+                    self._cv.wait()
+                if self._sgen >= my:
+                    return
+                self._sync_active = True
+            finally:
+                self._waiters -= 1
+        # ---- leader path (no locks held) ----
+        try:
+            wait_s = self.group_max_wait_us / 1e6
+            if wait_s > 0:
+                with self._lock:
+                    gather = self._waiters + 1 < self.group_max_batch
+                if gather:
+                    import time as _time
+                    _time.sleep(wait_s)
+            with self._lock:
+                start = self._wgen
+                batch = self._waiters + 1  # every waiter wrote <= start
+            # kill-9 torture site: the batch's bytes are flushed to the
+            # OS but NOT fsynced, and none of its commits is acked yet
+            from ..util import failpoint
+            failpoint.inject("kv/group-fsync")
+            self._timed_fsync()
+        except BaseException:
+            with self._lock:
+                self._sync_active = False
+                self._cv.notify_all()  # a waiter takes over as leader
+            raise
+        self._finish_sync(start)
+        with self._lock:
+            self._sync_active = False
+            self._cv.notify_all()
+        if self.on_batch is not None:
+            try:
+                self.on_batch(batch)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
 
     def clean(self) -> None:
         """The sink was made durable by other means (checkpoint wrote
         and fsynced a snapshot; the WAL restarted empty)."""
         with self._lock:
             self._dirty = False
+            self._sgen = self._wgen
+            self._cv.notify_all()
 
     def close(self) -> None:
         with self._lock:
@@ -221,6 +364,13 @@ class PyOrderedKV:
         self.sync_interval_ms = sync_interval_ms
         self._syncer = SyncPolicy(sync_log, sync_interval_ms,
                                   self._fsync_wal)
+        # cross-commit group fsync: single-process stores defer the
+        # commit-boundary fsync out of the mutation section (the commit
+        # path rendezvous in commit_sync after dropping its locks).
+        # Shared-dir stores keep the in-section fsync: the flock
+        # contract is durability BEFORE visibility to sibling processes,
+        # and the flock serializes committers anyway.
+        self._syncer.defer_commit = not shared
         # records applied by refresh() that the Storage layer has not yet
         # folded into columnar epochs / catalog (shared mode only)
         self.pending_refresh: list[tuple[int, int, bytes, bytes]] = []
@@ -382,9 +532,18 @@ class PyOrderedKV:
     def _fsync_wal(self) -> None:
         import os
         wal = self._wal
-        if wal is not None and not wal.closed:
+        if wal is None:
+            return
+        try:
             wal.flush()
             os.fsync(wal.fileno())
+        except ValueError:
+            # the group fsync runs outside the engine locks, so a
+            # concurrent checkpoint can rotate (close+reopen) the WAL
+            # under us: its snapshot was written AND fsynced before the
+            # rotation, so every record this fsync meant to cover is
+            # already durable — closed-file here is success, not error
+            return
 
     def sync(self) -> None:
         if self._wal is not None:
@@ -394,9 +553,17 @@ class PyOrderedKV:
         """Commit-boundary durability hook (called at every mutation
         section exit): fsync per the sync-log policy. 'interval' mode is
         the group commit — commits inside the window share one fsync,
-        and the tail burst is covered by SyncPolicy's deferred flush."""
+        and the tail burst is covered by SyncPolicy's deferred flush.
+        'commit' mode with defer_commit leaves durability to the commit
+        path's commit_sync() rendezvous (cross-commit group fsync)."""
         if self._wal is not None:
             self._syncer.boundary()
+
+    def commit_sync(self) -> None:
+        """Commit-ack durability: group-fsync rendezvous covering every
+        byte this committer wrote (no-op unless sync-log=commit)."""
+        if self._wal is not None:
+            self._syncer.commit_sync()
 
     def close(self) -> None:
         self._syncer.close()
@@ -527,6 +694,15 @@ class MVCCStore:
             out = self.kv.pending_refresh
             self.kv.pending_refresh = []
             return out
+
+    def commit_sync(self) -> None:
+        """Commit-ack durability rendezvous (see SyncPolicy.commit_sync).
+        Called by the storage commit path AFTER releasing the commit
+        lock, so concurrent committers amortize one fsync. Engines
+        without deferred group commit answer trivially."""
+        cs = getattr(self.kv, "commit_sync", None)
+        if cs is not None:
+            cs()
 
     # ---- reads -------------------------------------------------------------
     def get(self, key: bytes, read_ts: int) -> Optional[bytes]:
@@ -930,7 +1106,10 @@ class _MutationSection:
         # durability BEFORE visibility to siblings: the section's
         # records fsync per the sync-log policy while the flock is
         # still held, so no other process can act on a commit this
-        # process could still lose to a crash. A FAILED fsync must not
+        # process could still lose to a crash. (Single-process stores
+        # defer the commit-mode fsync to the commit path's group
+        # rendezvous instead — maybe_sync no-ops there; the ack still
+        # waits on commit_sync.) A FAILED fsync must not
         # strand the locks below — but it must still FAIL the section
         # (re-raised after teardown): acking a commit whose durability
         # call errored would quietly void the sync-log=commit contract.

@@ -99,6 +99,8 @@ class NativeOrderedKV:
         else:
             self._h = self._lib.kv_open()
         self._mu = threading.Lock()
+        # fsync-vs-close fence (see _fsync_native); writers never take it
+        self._sync_mu = threading.Lock()
         self._durable = path is not None
         # same storage.sync-log policy the Python twin honors, via the
         # SAME shared evaluator (mvcc.SyncPolicy — commit/interval
@@ -110,14 +112,32 @@ class NativeOrderedKV:
         self.sync_interval_ms = sync_interval_ms
         self._syncer = SyncPolicy(sync_log, sync_interval_ms,
                                   self._fsync_native)
+        # cross-commit group fsync: like the Python twin in
+        # single-process mode, the commit-boundary fsync moves out of
+        # the mutation section into the commit path's rendezvous
+        self._syncer.defer_commit = True
 
     def _fsync_native(self) -> None:
-        with self._mu:
-            if self._h:
-                self._lib.kv_sync(self._h)
+        # fsync OUTSIDE _mu: holding the write lock for the disk
+        # barrier would serialize concurrent writers behind every fsync
+        # and reduce the group-commit rendezvous to batches of one
+        # (kv_sync itself flushes under the C++ lock and fsyncs
+        # lock-free, same reasoning). _sync_mu serializes ONLY against
+        # close(): kv_close frees the C++ Store, and an in-flight
+        # kv_sync on the freed handle is a use-after-free.
+        with self._sync_mu:
+            with self._mu:
+                h = self._h
+            if h:
+                self._lib.kv_sync(h)
 
     def checkpoint(self) -> None:
-        with self._mu:
+        # _sync_mu: kv_checkpoint rotates the C++ WAL FILE*, and the
+        # group fsync runs lock-free on that handle's fd — same fence
+        # as close() so the rotation never recycles an fd mid-fsync
+        with self._sync_mu, self._mu:
+            if not self._h:
+                return  # closed (crash-simulation checkpoint-after-close)
             self._lib.kv_checkpoint(self._h)
         self._syncer.clean()
 
@@ -130,9 +150,16 @@ class NativeOrderedKV:
         if self._durable:
             self._syncer.boundary()
 
+    def commit_sync(self) -> None:
+        """Commit-ack group-fsync rendezvous (PyOrderedKV contract)."""
+        if self._durable:
+            self._syncer.commit_sync()
+
     def close(self) -> None:
         self._syncer.close()
-        with self._mu:
+        # _sync_mu first (same order as _fsync_native): an in-flight
+        # group fsync finishes before the C++ Store is freed
+        with self._sync_mu, self._mu:
             if self._h:
                 self._lib.kv_close(self._h)
                 self._h = None

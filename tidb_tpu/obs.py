@@ -94,11 +94,14 @@ class Histogram:
 
     BUCKETS = (0.00001, 0.00005, 0.0001, 0.00025, 0.0005,
                0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
-    __slots__ = ("name", "help", "_series", "_lock")
+    __slots__ = ("name", "help", "buckets", "_series", "_lock")
 
-    def __init__(self, name: str, help_: str) -> None:
+    def __init__(self, name: str, help_: str, buckets=None) -> None:
         self.name = name
         self.help = help_
+        # custom bucket bounds for non-latency distributions (e.g.
+        # group-commit batch sizes); default: the latency ladder
+        self.buckets = tuple(buckets) if buckets else self.BUCKETS
         # label tuple -> [counts list, sum, total]
         self._series: dict[tuple, list] = {}
         self._lock = threading.Lock()
@@ -109,10 +112,10 @@ class Histogram:
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = [
-                    [0] * (len(self.BUCKETS) + 1), 0.0, 0]
+                    [0] * (len(self.buckets) + 1), 0.0, 0]
             s[1] += v
             s[2] += 1
-            for i, b in enumerate(self.BUCKETS):
+            for i, b in enumerate(self.buckets):
                 if v <= b:
                     s[0][i] += 1
                     return
@@ -124,7 +127,7 @@ class Histogram:
         with self._lock:
             s = self._series.get(key)
             if s is None:
-                return [0] * (len(self.BUCKETS) + 1), 0.0, 0
+                return [0] * (len(self.buckets) + 1), 0.0, 0
             return list(s[0]), s[1], s[2]
 
     def series(self):
@@ -132,7 +135,7 @@ class Histogram:
             if not self._series:
                 # a never-observed histogram still renders its (zero)
                 # unlabeled series, like a prometheus client would
-                return [((), [0] * (len(self.BUCKETS) + 1), 0.0, 0)]
+                return [((), [0] * (len(self.buckets) + 1), 0.0, 0)]
             return [(key, list(s[0]), s[1], s[2])
                     for key, s in sorted(self._series.items())]
 
@@ -191,11 +194,12 @@ class Registry:
                     f"{type(m).__name__}")
             return m
 
-    def histogram(self, name: str, help_: str = "") -> Histogram:
+    def histogram(self, name: str, help_: str = "",
+                  buckets=None) -> Histogram:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Histogram(name, help_)
+                m = Histogram(name, help_, buckets=buckets)
                 self._metrics[name] = m
             elif not isinstance(m, Histogram):
                 raise TypeError(
@@ -240,7 +244,7 @@ class Registry:
                 for key, counts, total_sum, total in m.series():
                     extra = "".join(f',{k}="{val}"' for k, val in key)
                     acc = 0
-                    for b, c in zip(m.BUCKETS, counts):
+                    for b, c in zip(m.buckets, counts):
                         acc += c
                         out.append(
                             f'{m.name}_bucket{{le="{b}"{extra}}} {acc}')
@@ -263,13 +267,33 @@ class StatementsSummary:
     max-stmt-count."""
 
     MAX_DIGESTS = 200
+    # raw text -> normalized text memo: identical statement replay (the
+    # OLTP point path's plan-cache-hit shape) skips the second lex of
+    # every statement; bounded so random-literal floods cannot grow it.
+    # Process-wide on purpose — normalization is a pure text function.
+    NORM_CACHE_CAP = 512
+    _norm_cache: dict = {}
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._entries: dict[str, dict] = {}
 
+    @classmethod
+    def normalize(cls, sql: str) -> str:
+        cached = cls._norm_cache.get(sql)
+        if cached is not None:
+            return cached
+        norm = cls._normalize_uncached(sql)
+        if len(cls._norm_cache) >= cls.NORM_CACHE_CAP:
+            # wholesale reset beats per-entry LRU bookkeeping here: the
+            # cache exists for replayed text, which repopulates in one
+            # statement each
+            cls._norm_cache.clear()
+        cls._norm_cache[sql] = norm
+        return norm
+
     @staticmethod
-    def normalize(sql: str) -> str:
+    def _normalize_uncached(sql: str) -> str:
         """Literals -> '?' through the real lexer (reference:
         parser.Normalize)."""
         from .sql.lexer import Lexer, TokenKind
@@ -653,6 +677,41 @@ class Observability:
         self.slow_counter = self.metrics.counter(
             "tidb_slow_queries_total",
             "statements over the slow-log threshold")
+        # OLTP fast path (plan/fastpath.py + the session plan cache):
+        # per-session LRU lookups aggregate here so fast-path coverage
+        # is observable server-wide
+        self.plan_cache_hits = self.metrics.counter(
+            "tidb_plan_cache_hits_total",
+            "plan cache lookups answered from the LRU (point fast "
+            "plans and full physical plans)")
+        self.plan_cache_misses = self.metrics.counter(
+            "tidb_plan_cache_misses_total",
+            "plan cache lookups that (re)planned — cold key, stale "
+            "schema/stats generation, or cache disabled for the "
+            "statement shape")
+        self.plan_cache_evictions = self.metrics.counter(
+            "tidb_plan_cache_evictions_total",
+            "plan cache entries evicted at capacity "
+            "(performance.plan-cache-size), least-recently-used first")
+        # cross-commit group fsync (kv/mvcc.py SyncPolicy.commit_sync):
+        # commits amortized per disk barrier under sync-log=commit —
+        # mean batch size == durable-QPS amplification over one fsync
+        self.group_commit_batch = self.metrics.histogram(
+            "tidb_group_commit_batch_size",
+            "commits made durable by one WAL fsync under "
+            "sync-log=commit (group-commit rendezvous batch size)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        # histogram twins for the metrics_schema tier (histograms stay
+        # on /metrics): avg batch = commits/fsyncs, queryable in SQL
+        self.group_commit_fsyncs = self.metrics.counter(
+            "tidb_group_commit_fsyncs_total",
+            "WAL fsync barriers paid at commit boundaries "
+            "(sync-log=commit group rendezvous leaders)")
+        self.group_commit_commits = self.metrics.counter(
+            "tidb_group_commit_commits_total",
+            "commits made durable through the group rendezvous; "
+            "divided by tidb_group_commit_fsyncs_total this is the "
+            "amortization factor")
         # follower read tier (rpc/replica.py router + rpc/apply.py):
         # routed-read outcomes on the router's server, apply lag on the
         # replica's (leaders legitimately report 0 lag)

@@ -247,6 +247,15 @@ class CoordRPCServer(FrameListener):
             getattr(engine, "sync_log", "off"),
             getattr(engine, "sync_interval_ms", 100),
             self._fsync_append)
+        # cross-commit group fsync for remote appends: concurrent
+        # wal_append handler threads rendezvous on one fsync instead of
+        # serializing one disk barrier per append (the mutation lease
+        # serializes WRITERS, but pipelined appends from the leased
+        # client's sessions still overlap their durability waits)
+        self._append_sync.defer_commit = True
+        self._append_sync.on_batch = storage._note_group_commit
+        self._append_sync.on_stall = getattr(
+            getattr(engine, "_syncer", None), "on_stall", None)
         fam, target = self._start_listener(listen)
         if fam == socket.AF_INET:
             # the advertised address doubles as the leader's dialable
@@ -678,10 +687,12 @@ class CoordRPCServer(FrameListener):
         # the ack below IS the follower's commit acknowledgement: honor
         # the sync-log policy first — but OUTSIDE self._mu, or every
         # unrelated RPC (pings, tso) queues behind each disk fsync.
-        # Appenders are already serialized by the mutation lease, and a
-        # failed fsync propagates (typed) instead of acking undurable.
+        # commit mode rendezvous on a shared in-flight fsync (group
+        # commit); a failed fsync propagates (typed) instead of acking
+        # undurable.
         self._append_sync.mark_dirty()
         self._append_sync.boundary()
+        self._append_sync.commit_sync()
         with self._mu:
             c = self._clients[client_id]
             c.last_seq = seq

@@ -209,6 +209,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_overload_protection(storage)
     cfg.seed_diagnostics(storage)
     cfg.seed_replica_read(storage)
+    cfg.seed_group_commit(storage)
     cfg.seed_mesh()
     srv = Server(storage, host=cfg.host, port=cfg.port,
                  default_db=cfg.default_db,
@@ -223,7 +224,8 @@ def main(argv: list[str] | None = None) -> int:
                  require_secure_transport=(
                      cfg.security.require_secure_transport),
                  proxy_protocol_networks=(
-                     cfg.security.proxy_protocol_networks))
+                     cfg.security.proxy_protocol_networks),
+                 conn_workers=cfg.performance.conn_worker_threads)
     srv.start()
     # background GC / lock-TTL / auto-analyze / checkpoint loop; the
     # interval re-reads tidb_gc_run_interval every cycle (reference:
@@ -251,6 +253,13 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_overload_protection(storage)
             cfg.seed_diagnostics(storage)
             cfg.seed_replica_read(storage)
+            cfg.seed_group_commit(storage)
+            if srv._pool is not None:
+                # 0 = recompute the auto sizing (min(8, cpu/2)), so a
+                # reload can RESTORE auto after an explicit override
+                srv._pool.configure(
+                    cfg.performance.conn_worker_threads
+                    or Server.auto_conn_workers())
             cfg.apply_log_level()
             print(f"config reloaded: {applied or 'no reloadable changes'}",
                   flush=True)

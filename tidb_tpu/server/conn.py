@@ -85,6 +85,9 @@ class ClientConn:
         # stmt_id -> (n_params, bound param types from the last EXECUTE)
         self._stmt_meta: dict[int, tuple[int, Optional[list]]] = {}
         self.killed = threading.Event()
+        # reactor bookkeeping: when this conn last parked idle
+        # (@@wait_timeout reaping reads it on the sweep)
+        self.parked_at = 0.0
 
     def _caps(self) -> int:
         caps = _CAPS
@@ -272,38 +275,99 @@ class ClientConn:
             return None
         return secs if secs > 0 else None
 
-    def run(self) -> None:
+    def start(self) -> None:
+        """Handshake on a pooled worker, then park on the reactor: an
+        authenticated-but-idle connection costs no thread (reference
+        contrast: server/conn.go Run holds a goroutine per conn; the
+        OS-thread analog stopped scaling at max-server-connections)."""
         try:
             self._read_proxy_header()
             self.write_initial_handshake()
             self.read_handshake_response()
+        except Exception:  # noqa: BLE001 — malformed handshakes must
+            self.close()   # never leak a registered connection
+            return
+        self._park_or_continue()
+
+    def _park_or_continue(self) -> None:
+        """After the handshake: serve immediately-pipelined commands on
+        this worker, else park."""
+        if self._buffered_input():
+            self.serve_ready()
+        else:
+            self._park()
+
+    def _park(self) -> None:
+        """Hand the socket to the reactor; no thread is held while the
+        connection idles. Bytes that race this hand-off are safe: the
+        selector sees them the moment the fd registers. (TLS is the
+        exception — decrypted-but-unread records are invisible to the
+        selector — which is why callers check _buffered_input first.)"""
+        if not self.alive or self.killed.is_set():
+            self.close()
+            return
+        reactor = getattr(self.server, "_reactor", None)
+        if reactor is None:
+            self.close()
+            return
+        reactor.park(self)
+
+    def _buffered_input(self) -> bool:
+        pending = getattr(self.sock, "pending", None)
+        if pending is not None:
+            try:
+                if pending():
+                    return True
+            except (OSError, ValueError):
+                return False
+        import select as _select
+        try:
+            r, _, _ = _select.select([self.sock], [], [], 0)
+        except (OSError, ValueError):
+            return False
+        return bool(r)
+
+    def serve_ready(self) -> None:
+        """Serve the commands available on the socket, then re-park.
+        Runs on a pool worker; the blocking packet read only continues
+        a command whose first bytes already arrived (the reactor woke
+        us), so a slow statement — not an idle connection — is the only
+        thing that holds a worker."""
+        try:
             while self.alive and not self.killed.is_set():
                 self.io.reset_sequence()
                 try:
+                    # the reactor only wakes us when the FIRST bytes
+                    # arrived; the rest of the packet reads under the
+                    # wait_timeout deadline so a stalled half-packet
+                    # (slowloris) cannot pin a pool worker forever —
+                    # the same reap the parked sweep applies. The
+                    # statement itself runs with no deadline (below).
                     self.sock.settimeout(self._idle_timeout())
                     data = self.io.read_packet()
-                except TimeoutError:
-                    # idle past wait_timeout: close without a farewell —
-                    # the client's next command observes the standard
-                    # "MySQL server has gone away" (a dead socket)
-                    break
-                except (ConnectionError, OSError):
-                    break
+                except (ConnectionError, OSError, ValueError):
+                    self.close()
+                    return
                 finally:
-                    # commands themselves run with no read deadline (a
-                    # slow statement is not an idle connection)
                     try:
                         self.sock.settimeout(None)
                     except OSError:
                         pass
                 if not data:
-                    break
+                    self.close()
+                    return
                 if not self.dispatch(data[0], data[1:]):
-                    break
+                    self.close()
+                    return
                 self.io.flush()
-        except ConnectionError:
-            pass
-        finally:
+                if not self._buffered_input():
+                    break
+            self._park()
+        except Exception:  # noqa: BLE001 — the old per-conn thread
+            # closed in its finally; a reactor-served conn must do the
+            # same or a malformed payload (UnicodeDecodeError from
+            # COM_QUERY bytes, struct.error from a short COM_STMT
+            # frame) leaks a zombie holding its txn locks forever
             self.close()
 
     def dispatch(self, cmd: int, payload: bytes) -> bool:
@@ -428,6 +492,11 @@ class ClientConn:
 
     def close(self) -> None:
         self.alive = False
+        reactor = getattr(self.server, "_reactor", None)
+        if reactor is not None:
+            # drop our selector key before the fd closes (a closed fd
+            # in the selector map would poison every later select)
+            reactor.discard(self)
         try:
             self.session.rollback_if_active()
         except Exception:  # noqa: BLE001

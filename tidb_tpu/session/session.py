@@ -123,12 +123,19 @@ class Session:
         # decision + gate reason, persisted by bench.py per timed query
         self.last_engines: list[str] = []
         self._pending_parse_s = 0.0
-        # SQL-text plan cache: key -> (invalidation gen, physical plan)
+        # SQL-text plan cache: key -> (invalidation gen, plan) — a true
+        # LRU (move-to-back on hit, evict-oldest at capacity) holding
+        # BOTH physical plans and point FastPlans under the same keys,
+        # including the prepared-statement #stmt{id} keys
         # (reference: prepared-plan cache, planner/core/common_plans.go +
         # kvcache LRU; text-keyed here because identical statement replay
         # dominates the workloads the cache exists for)
-        self._plan_cache: dict = {}
+        from collections import OrderedDict
+        self._plan_cache: "OrderedDict" = OrderedDict()
         self._plan_cache_key: Optional[str] = None
+        # did the last statement's plan come from the cache? (surfaced
+        # by EXPLAIN ANALYZE's point row and the fast-path lint)
+        self.last_plan_from_cache = False
         # SESSION-scope plan bindings (bindinfo/session_handle.go analog)
         self.session_bindings: dict[str, dict] = {}
         self._binding_gen = 0
@@ -222,18 +229,23 @@ class Session:
         for i, stmt in enumerate(stmts):
             label = sql if single else \
                 f"[stmt {i + 1}/{len(stmts)}] {sql}"
-            # single-statement SELECT text is the plan-cache key
+            # single-statement SELECT text is the plan-cache key; DML
+            # text keys too, for the point fast path's FastPlan cache
+            # (plan/fastpath.py — the slow DML paths never consult it)
+            is_select = single and isinstance(
+                stmt, (ast.SelectStmt, ast.SetOpStmt))
             self._plan_cache_key = sql if (
-                single and isinstance(
-                    stmt, (ast.SelectStmt, ast.SetOpStmt))) else None
-            self._binding_match_sql = self._plan_cache_key
+                is_select or (single and isinstance(
+                    stmt, (ast.InsertStmt, ast.UpdateStmt,
+                           ast.DeleteStmt)))) else None
+            self._binding_match_sql = sql if is_select else None
             self._raw_sql = sql if single else None
             # the replica-read router forwards SQL TEXT, so it only
             # ever routes a statement that IS its own text: a single
             # top-level SELECT (INSERT..SELECT re-enters _exec_select
             # with this unset; prepared statements carry bound ASTs,
             # not reproducible text)
-            self._route_sql = self._plan_cache_key
+            self._route_sql = sql if is_select else None
             try:
                 # batch members skip digest recording: per-statement text
                 # isn't recoverable from the batch label, and raw batch
@@ -278,6 +290,7 @@ class Session:
         # the socket)
         self.killed.clear()
         self._governor_killed = False
+        self.last_plan_from_cache = False
         # per-statement working-set accounting: reset so a DML or a
         # failed statement never inherits the previous SELECT's peak in
         # the digest table / slow log (the select path refreshes these
@@ -313,7 +326,7 @@ class Session:
         # MySQL defines as reading the PREVIOUS statement's list
         preserves_warnings = (
             (isinstance(stmt, ast.ShowStmt) and stmt.kind == "WARNINGS")
-            or (isinstance(stmt, ast.SelectStmt)
+            or (isinstance(stmt, ast.SelectStmt) and stmt.from_ is None
                 and not self._collect_table_names(stmt)))
         if not preserves_warnings:
             self.warnings = []
@@ -567,10 +580,14 @@ class Session:
         if n_params:
             bound = _bind_params(bound, params)
         # prepared plans cache per (stmt, bound params): repeated
-        # identical executions reuse the physical plan (reference:
+        # identical executions reuse the physical plan — or the point
+        # FastPlan on the COM_STMT_EXECUTE fast path (reference:
         # prepared-plan cache, common_plans.go getPhysicalPlan)
-        if isinstance(bound, (ast.SelectStmt, ast.SetOpStmt)):
+        if isinstance(bound, (ast.SelectStmt, ast.SetOpStmt,
+                              ast.InsertStmt, ast.UpdateStmt,
+                              ast.DeleteStmt)):
             self._plan_cache_key = f"#stmt{stmt_id}:{params!r}"
+        if isinstance(bound, (ast.SelectStmt, ast.SetOpStmt)):
             # bindings match on the PREPARE text: its '?' markers line up
             # with the literal-normalized binding key
             self._binding_match_sql = raw_sql
@@ -587,6 +604,14 @@ class Session:
     def _execute_stmt(self, stmt: ast.Stmt) -> ResultSet:
         if self.user is not None:
             self._check_privileges(stmt)
+        # OLTP fast path: autocommit point SELECT/UPDATE/DELETE and
+        # literal INSERT VALUES bypass the whole plan/dispatch pipeline
+        # (plan/fastpath.py — the reference's TryFastPlan point plans,
+        # planner/core/point_get_plan.go:413). Anything the recognizer
+        # rejects falls through to the unchanged paths below.
+        rs = self._try_fast_path(stmt)
+        if rs is not None:
+            return rs
         if isinstance(stmt, ast.KillStmt):
             self._exec_kill(stmt)
             return ResultSet([], [])
@@ -1687,29 +1712,149 @@ class Session:
         for child, _store in self._partition_children(info):
             self._pessimistic_scan(child, stmt.from_, stmt.where, txn)
 
+    # ==================== OLTP point fast path ====================
+    def _fast_path_eligible(self, stmt: ast.Stmt) -> bool:
+        """Session-state half of the TryFastPlan gate — ONE definition
+        shared by statement execution and EXPLAIN ANALYZE, so the plan
+        EXPLAIN shows is the plan that runs."""
+        if self.in_explicit_txn or self.txn is not None:
+            return False  # explicit txns keep the planned read/lock paths
+        if self.user is not None:
+            return False  # column-privilege checks live on the slow path
+        if not isinstance(stmt, (ast.SelectStmt, ast.InsertStmt,
+                                 ast.UpdateStmt, ast.DeleteStmt)):
+            return False
+        if isinstance(stmt, ast.SelectStmt):
+            if self.session_bindings or self.storage.bindings.has_any():
+                return False  # a binding could redirect this exact text
+            try:
+                if str(self._sysvar_value("tidb_replica_read")
+                       or "leader").lower() != "leader":
+                    # the operator asked reads to offload to followers;
+                    # routing preference beats the local bypass
+                    return False
+            except SQLError:
+                pass
+        try:
+            return bool(int(
+                self._sysvar_value("tidb_enable_fast_path") or 0))
+        except (TypeError, ValueError):
+            return False
+
+    def _try_fast_path(self, stmt: ast.Stmt) -> Optional[ResultSet]:
+        """TryFastPlan gate: plan-cache-keyed point statements execute
+        straight against the KV/MVCC layer — no planner, no ExecContext,
+        no coprocessor (and so no JAX backend). Returns None whenever
+        the statement (or session state) is not point-shaped; the
+        caller's slow path is authoritative for everything else."""
+        if not self._fast_path_eligible(stmt):
+            return None
+        from .. import obs
+        from ..plan import fastpath
+        with obs.stage("fast_plan"):
+            fp = self._fast_plan_cached(stmt)
+        if fp is None:
+            return None
+        obs.note_engine("point")
+        return fastpath.execute(self, fp)
+
+    def _fast_plan_cached(self, stmt: ast.Stmt):
+        """Recognize (or fetch the cached) FastPlan for this statement.
+        Shares the session plan-cache LRU and its hit/miss/eviction
+        counters with the physical-plan cache — the keys embed the
+        literals, so a cached FastPlan replays exactly."""
+        from ..plan import fastpath
+        key = self._plan_cache_key
+        use_cache = key is not None and self._plan_cache_enabled()
+        o = self.storage.obs
+        gen = None
+        if use_cache:
+            gen = self._plan_cache_gen()
+            entry = self._plan_cache.get(key)
+            if entry is not None and entry[0] == gen and \
+                    isinstance(entry[1], fastpath.FastPlan):
+                self._plan_cache.move_to_end(key)
+                self.plan_cache_hits += 1
+                self.last_plan_from_cache = True
+                o.plan_cache_hits.inc()
+                return entry[1]
+            # a cached PHYSICAL plan falls through: recognition is a
+            # cheap AST walk, and the entry may predate a fast-path
+            # re-enable (the common non-point statement bails out of
+            # recognition within a few isinstance checks anyway)
+        fp = fastpath.try_plan(self, stmt)
+        if fp is not None and use_cache:
+            # every cache-enabled lookup that had to (re)recognize is a
+            # miss — symmetric with _plan_cached, so the hit ratio
+            # stays honest even for entries deliberately not stored
+            o.plan_cache_misses.inc()
+            # text-keyed DML embeds its literals, so ad-hoc point
+            # writes would fill the LRU with never-reused entries and
+            # evict the session's recurring SELECT plans; recognition
+            # is a cheap AST walk, so only keys built for replay
+            # (prepared #stmt keys) and SELECT texts are worth a slot
+            if key.startswith("#stmt") or \
+                    isinstance(stmt, ast.SelectStmt):
+                self._plan_cache_put(key, gen, fp)
+        return fp
+
+    def _plan_cache_gen(self) -> tuple:
+        """Invalidation generation every cache entry is stamped with
+        (reference: planCacheKey carries schema version + stats,
+        planner/core/cache.go)."""
+        return (self.catalog.version, self.storage.stats.generation,
+                self.current_db, self._binding_gen,
+                self.storage.bindings.fingerprint())
+
+    def _plan_cache_enabled(self) -> bool:
+        try:
+            return bool(int(self._sysvar_value("tidb_enable_plan_cache")
+                            or 0))
+        except (TypeError, ValueError):
+            return False
+
+    def _plan_cache_put(self, key: str, gen: tuple, plan) -> None:
+        """Insert as most-recent; evict least-recently-used past
+        capacity (performance.plan-cache-size / tidb_plan_cache_size)."""
+        cache = self._plan_cache
+        if key in cache:
+            cache.move_to_end(key)
+        cache[key] = (gen, plan)
+        try:
+            cap = int(self._sysvar_value("tidb_plan_cache_size") or 128)
+        except (TypeError, ValueError):
+            cap = 128
+        evict = self.storage.obs.plan_cache_evictions
+        while len(cache) > max(cap, 1):
+            cache.popitem(last=False)
+            evict.inc()
+
     def _plan_cached(self, stmt: ast.SelectStmt, uncacheable: bool = False):
         """Plan, going through the SQL-text plan cache when the statement
         is cache-safe (no @@var reads, no FOR UPDATE locking) and the
         cache is enabled. Entries invalidate on schema version or stats
-        generation change (reference: planCacheKey carries schema
-        version + stats, planner/core/cache.go)."""
+        generation change; the cache is a true LRU — a hit moves the
+        entry to the back, capacity evicts from the front."""
         key = self._plan_cache_key
-        if (key is None or uncacheable
-                or not int(self._sysvar_value("tidb_enable_plan_cache")
-                           or 0)
+        if (key is None or uncacheable or not self._plan_cache_enabled()
                 or getattr(stmt, "for_update", False)):
             return self._plan(stmt)
-        gen = (self.catalog.version, self.storage.stats.generation,
-               self.current_db, self._binding_gen,
-               self.storage.bindings.fingerprint())
+        from ..plan.fastpath import FastPlan
+        o = self.storage.obs
+        gen = self._plan_cache_gen()
         entry = self._plan_cache.get(key)
-        if entry is not None and entry[0] == gen:
+        if entry is not None and entry[0] == gen \
+                and not isinstance(entry[1], FastPlan):
+            # (a FastPlan under this key means the point path cached it
+            # while enabled; replan physically rather than mis-execute)
+            self._plan_cache.move_to_end(key)
             self.plan_cache_hits += 1
+            self.last_plan_from_cache = True
+            o.plan_cache_hits.inc()
             return entry[1]
+        o.plan_cache_misses.inc()
         plan = self._plan(stmt)
-        if len(self._plan_cache) >= 128:  # LRU-ish: drop oldest insert
-            self._plan_cache.pop(next(iter(self._plan_cache)))
-        self._plan_cache[key] = (gen, plan)
+        self._plan_cache_put(key, gen, plan)
         return plan
 
     def _plan(self, stmt: ast.SelectStmt):
@@ -3060,6 +3205,14 @@ class Session:
                 stmt.target = self._apply_binding(stmt.target)
             finally:
                 self._binding_match_sql = prev
+        if stmt.analyze:
+            # point statements execute the fast path and show it AS the
+            # plan — the bypass decision is the plan, like the routed
+            # replica reads below (reference: Point_Get in EXPLAIN)
+            rs = self._explain_analyze_point(
+                stmt.target, m.group(1) if m else None)
+            if rs is not None:
+                return rs
         plan = self._plan(stmt.target)
         if not stmt.analyze:
             lines = explain_plan(plan)
@@ -3117,6 +3270,45 @@ class Session:
                              obs.fmt_mesh(st.get("mesh"))))
         return ResultSet(["plan", "actRows", "time_ms", "engine",
                           "stages", "mesh"], rows)
+
+    def _explain_analyze_point(self, target,
+                               bare_sql: Optional[str] = None
+                               ) -> Optional[ResultSet]:
+        """EXPLAIN ANALYZE of a point-eligible SELECT executes the fast
+        path and renders one Point_Get row: engine `point`, the
+        plan-cache outcome in the stages cell — fast-path coverage is
+        observable exactly where operators already look. `bare_sql`
+        (the target's own text, stripped of the EXPLAIN prefix) keys
+        the SAME cache entry the bare statement uses, so a steady hit
+        reports as a hit here too."""
+        if not isinstance(target, ast.SelectStmt) or \
+                not self._fast_path_eligible(target):
+            return None
+        import time as _time
+
+        from .. import obs
+        from ..plan import fastpath
+        prev_key = self._plan_cache_key
+        self._plan_cache_key = bare_sql or prev_key
+        try:
+            with obs.stage("fast_plan"):
+                fp = self._fast_plan_cached(target)
+        finally:
+            self._plan_cache_key = prev_key
+        if fp is None:
+            return None
+        obs.note_engine("point")
+        t0 = _time.perf_counter()
+        rs = fastpath.execute(self, fp)
+        dt = (_time.perf_counter() - t0) * 1e3
+        cache = "hit" if self.last_plan_from_cache else "miss"
+        key = f"handle:{fp.handle}" if fp.handle is not None \
+            else f"key:{fp.index.name}"
+        row = (f"Point_Get_1(table:{fp.info.name}, {key})",
+               len(rs.rows), round(dt, 3), "point",
+               f"plan_cache:{cache}", "")
+        return ResultSet(["plan", "actRows", "time_ms", "engine",
+                          "stages", "mesh"], [row])
 
     def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
         """TRACE <select>: execute with span accounting and return the

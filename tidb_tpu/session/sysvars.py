@@ -102,6 +102,14 @@ _VARS = [
     _v("tidb_mem_quota_query", 1 << 30),
     _v("tidb_mem_oom_action", "SPILL"),  # SPILL | CANCEL (action.go:28)
     _v("tidb_enable_plan_cache", 1),
+    # session plan-cache LRU capacity (physical plans + point
+    # FastPlans); config performance.plan-cache-size seeds the default
+    _v("tidb_plan_cache_size", 128),
+    # the TryFastPlan point bypass (plan/fastpath.py): autocommit point
+    # SELECT/DML executes against the KV layer with zero planner and
+    # zero coprocessor work. Off forces every statement down the full
+    # pipeline (debug/AB escape hatch).
+    _v("tidb_enable_fast_path", 1),
     _v("tidb_txn_mode", "optimistic"),
     _v("tidb_retry_limit", 10),
     # follower read tier (rpc/replica.py): "follower" routes eligible

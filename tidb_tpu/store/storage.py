@@ -240,6 +240,12 @@ class Storage:
         self.diagnostics = _inspect.DiagnosticsState()
         _inspect.track(self)
         self._tso_lease = 0
+        # serializes lease-file persistence: concurrent committers both
+        # crossing the extension threshold raced the SAME tmp+rename
+        # pair (one replace unlinks the tmp the other is about to
+        # rename — ENOENT), a race the group-commit throughput made
+        # routine instead of theoretical
+        self._lease_lock = threading.Lock()
         if path is not None:
             os.makedirs(os.path.join(path, "epochs"), exist_ok=True)
             self._tso_lease = self._read_tso_lease()
@@ -357,6 +363,15 @@ class Storage:
             self.ddl_owner = owner_manager(path, "ddl")
             self.gc_owner = owner_manager(path, "gc")
         self._commit_lock = threading.RLock()
+        # cross-commit group fsync telemetry throttle (the batch-size
+        # histogram records every batch; the event ring gets at most
+        # one group_commit note per window with cumulative counts).
+        # Locked: TWO SyncPolicy instances (engine + leader-side RPC
+        # append) invoke the hook from unrelated leader threads.
+        self._gc_lock = threading.Lock()
+        self._gc_event_last = 0.0
+        self._gc_batches = 0
+        self._gc_commits = 0
         # seqlock generation for snapshot/fold consistency: odd while a
         # commit/refresh fold is in flight inside _commit_lock, even when
         # quiescent. Readers snapshot lock-free and retry on movement;
@@ -545,7 +560,12 @@ class Storage:
         if self.path is not None and \
                 self.tso.current() >= self._tso_lease - (
                     (_TSO_LEASE_MS // 2) << 18):
-            self._extend_tso_lease()
+            with self._lease_lock:
+                # re-check: a concurrent committer may have extended
+                # while we waited (the lease covers everyone)
+                if self.tso.current() >= self._tso_lease - (
+                        (_TSO_LEASE_MS // 2) << 18):
+                    self._extend_tso_lease()
 
     def persist_catalog(self) -> None:
         """Whole-catalog snapshot into the meta keyspace (reference: the
@@ -913,6 +933,47 @@ class Storage:
                               f"(policy {syncer.policy})")
 
         syncer.on_stall = _fsync_stall
+        syncer.on_batch = self._note_group_commit
+
+    def _note_group_commit(self, batch: int) -> None:
+        """Group-fsync batch telemetry: every batch lands in the
+        tidb_group_commit_batch_size histogram; the event ring gets a
+        throttled group_commit note (cumulative since the last one) so
+        fsync amortization is visible in metrics_schema + tidb_events
+        without flooding the ring at thousands of commits/s."""
+        import time as _time
+        self.obs.group_commit_batch.observe(batch)
+        self.obs.group_commit_fsyncs.inc()
+        self.obs.group_commit_commits.inc(batch)
+        emit = None
+        with self._gc_lock:
+            self._gc_batches += 1
+            self._gc_commits += batch
+            now = _time.monotonic()
+            if batch > 1 and now - self._gc_event_last >= 5.0:
+                self._gc_event_last = now
+                emit = (self._gc_commits, self._gc_batches)
+                self._gc_batches = 0
+                self._gc_commits = 0
+        if emit is not None:
+            commits, batches = emit
+            self.obs.events.record(
+                "group_commit",
+                detail=f"{commits} commits over {batches} wal fsyncs "
+                       f"({commits / max(batches, 1):.1f} avg batch) "
+                       "since the last note")
+
+    def configure_group_commit(self, max_batch: Optional[int] = None,
+                               max_wait_us: Optional[int] = None) -> None:
+        """Apply the storage.group-commit-* knobs to the engine's
+        SyncPolicy (server startup + SIGHUP hot reload)."""
+        syncer = getattr(self.kv.kv, "_syncer", None)
+        if syncer is None:
+            return
+        if max_batch is not None:
+            syncer.group_max_batch = max(int(max_batch), 1)
+        if max_wait_us is not None:
+            syncer.group_max_wait_us = max(int(max_wait_us), 0)
 
     def promote_to_leader(self, listen: str = "127.0.0.1:0") -> str:
         """Promote this socket FOLLOWER to the cluster leader in place.
@@ -1376,6 +1437,25 @@ class Storage:
             # the commit records are published or never will be, so the
             # leader's closed ts may advance past our commit_ts
             self._tso_commit_done()
+        # durability BEFORE the ack, AFTER the commit lock: under
+        # sync-log=commit the engine deferred the boundary fsync out of
+        # the mutation sections, so concurrent committers rendezvous
+        # here on ONE in-flight fsync (cross-commit group commit) —
+        # durable throughput scales with concurrency instead of
+        # serializing N x 17ms behind the commit lock. A failed fsync
+        # must not ack — but the commit IS already applied and visible
+        # (as it was when the in-section fsync failed at commit-phase
+        # exit), so the error must NOT read as a retryable write
+        # conflict: a client retrying a "failed" increment would
+        # double-apply it. KVError propagates untyped ("result
+        # unknown"), and _run_in_txn's autocommit retry ignores it.
+        try:
+            self.kv.commit_sync()
+        except OSError as e:
+            raise KVError(
+                "commit durability unknown: WAL fsync failed after the "
+                f"commit was applied ({e}); do not blindly retry"
+            ) from e
         self.obs.commits.inc()
         # opportunistic compaction at the GC-safe ts
         safe = self.safe_ts()
@@ -1661,6 +1741,17 @@ class Storage:
                             [Mutation(OP_PUT, key, value)], start_ts)
                 finally:
                     self._tso_commit_done()
+                # meta writes are acked durable like row commits: join
+                # the group-fsync rendezvous outside the commit lock.
+                # Same post-visibility typing as Storage.commit — not a
+                # retryable conflict.
+                try:
+                    self.kv.commit_sync()
+                except OSError as e:
+                    raise KVError(
+                        f"meta write on {name!r}: WAL fsync failed "
+                        f"after the commit was applied ({e})"
+                    ) from e
                 return
             except KVWriteConflict:
                 if not retriable:
