@@ -129,7 +129,32 @@ def _no_orphans_or_leaked_listeners(request):
     else:
         before_children = _child_pids()
         before_listen = _listen_inodes()
+    # dynamic lock checker hygiene (tidb_tpu/analysis/lockcheck): note
+    # whether THIS test armed it, so the arming never leaks forward
+    from tidb_tpu.analysis import lockcheck as _lockcheck
+    lockcheck_was_enabled = _lockcheck.enabled()
     yield
+    # a test that ends with an instrumented lock still held leaked a
+    # critical section (a worker parked mid-acquire, a poisoned CV) —
+    # the dynamic-detector twin of the orphaned-process check below
+    if _lockcheck.enabled():
+        # a live background thread may be transiting a critical
+        # section at the instant of the snapshot; only what SURVIVES
+        # a grace window is a leak (same policy as the process scan)
+        held = _lockcheck.held_snapshot()
+        deadline = time.monotonic() + 1.0
+        while held and time.monotonic() < deadline:
+            time.sleep(0.05)
+            held = _lockcheck.held_snapshot()
+        if held:
+            _lockcheck.disable()
+            _lockcheck.reset()
+            pytest.fail(
+                f"test ended with instrumented locks still held: {held}")
+    if not lockcheck_was_enabled and _lockcheck.enabled():
+        # the test armed the checker and forgot to disarm: contain it
+        _lockcheck.disable()
+        _lockcheck.reset()
     # the mesh flight recorder is contractually thread-free (bounded
     # rings drained on the statement path, no background sampler); a
     # titpu-mesh* thread appearing anywhere means that contract broke

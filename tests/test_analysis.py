@@ -1,0 +1,633 @@
+"""Tests for the concurrency analysis plane (tidb_tpu/analysis/).
+
+Static half: each rule fires on a minimal synthetic bad snippet and
+stays silent on its good twin (SourceTree.from_files builds the
+snippet trees); the engine's registry lint and baseline ratchet are
+pinned; `python -m tidb_tpu.analysis --check` must exit 0 on the real
+tree WITHOUT importing jax (the tier-1 wiring).
+
+Dynamic half: the TIDB_TPU_LOCK_CHECK instrumented-lock wrapper — an
+injected lock-order inversion produces the cycle finding (and
+surfaces through the inspection plane as `lock-order-inversion`),
+blocking syscalls under a hot lock are reported, zero overhead when
+off is asserted structurally (plain threading primitives), and the
+held-lock mirror backing the conftest leak guard empties on release.
+
+Native half: a slow-marked torture test runs the PR 12 group-fsync
+workload against the ASan/UBSan build of native/kvstore.cpp.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tidb_tpu.analysis import engine as eng
+from tidb_tpu.analysis import lockcheck
+from tidb_tpu.analysis import rules as _rules  # noqa: F401 — registers
+from tidb_tpu.analysis.engine import SourceTree
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rule(name: str, files: dict, aux=None):
+    tree = SourceTree.from_files(files, aux)
+    return [f for f in eng.run(tree, rules={name: eng.RULES[name]})
+            if f.rule == name]
+
+
+@pytest.fixture
+def checker():
+    """Armed lock checker with clean state; disarmed afterwards."""
+    lockcheck.reset()
+    lockcheck.enable()
+    yield lockcheck
+    lockcheck.disable()
+    lockcheck.reset()
+
+
+# ---- engine / registry ------------------------------------------------------
+
+def test_rule_registry_lints_clean():
+    assert len(eng.RULES) >= 8, sorted(eng.RULES)
+    assert eng.lint_rules() == []
+
+
+def test_rule_decorator_rejects_bad_metadata():
+    with pytest.raises(ValueError):
+        eng.rule("x", "warning", "")(lambda t: [])
+    with pytest.raises(ValueError):
+        eng.rule("x", "fatal", "ref")(lambda t: [])
+    with pytest.raises(ValueError):
+        eng.rule("bare-except", "warning", "ref")(lambda t: [])  # dup
+
+
+def test_baseline_ratchet():
+    """A finding not in the baseline fails check(); a baselined one
+    passes; a baseline key that stopped firing reports stale."""
+    bad = {"tidb_tpu/x.py": (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n")}
+    tree = SourceTree.from_files(bad)
+    rules = {"bare-except": eng.RULES["bare-except"]}
+    findings = eng.run(tree, rules=rules)
+    findings = [f for f in findings if f.rule == "bare-except"]
+    assert len(findings) == 1
+    key = findings[0].key()
+    assert key == ("bare-except", "tidb_tpu/x.py", "f:0")
+
+    new, _ = eng.check(tree, {})
+    assert key in {f.key() for f in new}
+    new2, stale2 = eng.check(tree, {key: "known"})
+    assert key not in {f.key() for f in new2}
+    dead = ("bare-except", "tidb_tpu/gone.py", "g:0")
+    _, stale3 = eng.check(tree, {dead: "old"})
+    assert dead in stale3
+
+
+def test_cli_check_clean_and_jax_free():
+    """The tier-1 wiring: `python -m tidb_tpu.analysis --check` exits
+    0 on the REAL tree (every finding fixed or baselined) and the
+    process never imports jax."""
+    code = (
+        "import sys\n"
+        "from tidb_tpu.analysis.__main__ import main\n"
+        "rc = main(['--check'])\n"
+        "assert 'jax' not in sys.modules, 'analysis imported jax'\n"
+        "sys.exit(rc)\n")
+    env = dict(os.environ)
+    env.pop("TIDB_TPU_LOCK_CHECK", None)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---- static rules: fire on bad, silent on good ------------------------------
+
+def test_blocking_call_under_hot_lock():
+    bad = {"tidb_tpu/x.py": (
+        "import os, threading\n"
+        "class Storage:\n"
+        "    def __init__(self):\n"
+        "        self._commit_lock = threading.RLock()\n"
+        "    def f(self):\n"
+        "        with self._commit_lock:\n"
+        "            os.fsync(3)\n")}
+    out = run_rule("blocking-call-under-hot-lock", bad)
+    assert len(out) == 1 and "os.fsync" in out[0].message
+
+    # one level of same-class helper expansion (the closed_info shape)
+    indirect = {"tidb_tpu/x.py": (
+        "import os, threading\n"
+        "class Storage:\n"
+        "    def __init__(self):\n"
+        "        self._commit_lock = threading.RLock()\n"
+        "    def _wal_size(self):\n"
+        "        return os.path.getsize('x')\n"
+        "    def f(self):\n"
+        "        with self._commit_lock:\n"
+        "            return self._wal_size()\n")}
+    out = run_rule("blocking-call-under-hot-lock", indirect)
+    assert len(out) == 1 and "_wal_size" in out[0].message
+
+    good = {"tidb_tpu/x.py": (
+        "import os, threading\n"
+        "class Storage:\n"
+        "    def __init__(self):\n"
+        "        self._commit_lock = threading.RLock()\n"
+        "    def f(self):\n"
+        "        with self._commit_lock:\n"
+        "            x = 1\n"
+        "        os.fsync(3)\n")}
+    assert run_rule("blocking-call-under-hot-lock", good) == []
+
+    # a cold lock of the same attr name on another class is NOT hot
+    cold = {"tidb_tpu/x.py": (
+        "import os, threading\n"
+        "class Registry:\n"
+        "    def __init__(self):\n"
+        "        self._commit_lock = threading.Lock()\n")}
+    assert run_rule("blocking-call-under-hot-lock", cold) == []
+
+
+def test_lock_order_inversion_static():
+    bad = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"
+        "        self._block = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._block:\n"
+        "            with self._alock:\n"
+        "                pass\n")}
+    out = run_rule("lock-order", bad)
+    assert len(out) == 1
+    assert "A._alock" in out[0].item and "A._block" in out[0].item
+
+    good = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"
+        "        self._block = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n")}
+    assert run_rule("lock-order", good) == []
+
+    # a nested def under the outer lock runs LATER, not under it
+    deferred = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"
+        "        self._block = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._alock:\n"
+        "            def cb():\n"
+        "                with self._block:\n"
+        "                    pass\n"
+        "            return cb\n"
+        "    def g(self):\n"
+        "        with self._block:\n"
+        "            with self._alock:\n"
+        "                pass\n")}
+    assert run_rule("lock-order", deferred) == []
+
+
+def test_tls_frame_hygiene():
+    bad = {"tidb_tpu/x.py": (
+        "def f(rec, prev):\n"
+        "    install_stage_recorder(rec)\n"
+        "    other_work()\n"
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        install_stage_recorder(prev)\n")}
+    out = run_rule("tls-frame-hygiene", bad)
+    assert len(out) == 1 and "install_stage_recorder" in out[0].item
+
+    good_next = {"tidb_tpu/x.py": (
+        "def f(rec, prev):\n"
+        "    install_stage_recorder(rec)\n"
+        "    try:\n"
+        "        other_work()\n"
+        "    finally:\n"
+        "        install_stage_recorder(prev)\n")}
+    assert run_rule("tls-frame-hygiene", good_next) == []
+
+    good_inside = {"tidb_tpu/x.py": (
+        "def f(rec, prev):\n"
+        "    try:\n"
+        "        install_stage_recorder(rec)\n"
+        "        other_work()\n"
+        "    finally:\n"
+        "        install_stage_recorder(prev)\n")}
+    assert run_rule("tls-frame-hygiene", good_inside) == []
+
+    # context-manager-only frames must be `with` items
+    bare_ctx = {"tidb_tpu/x.py": (
+        "def f(cop, snap):\n"
+        "    scope = cop.placement_scope(snap)\n"
+        "    return scope\n")}
+    out = run_rule("tls-frame-hygiene", bare_ctx)
+    assert len(out) == 1 and "placement_scope" in out[0].item
+    with_ctx = {"tidb_tpu/x.py": (
+        "def f(cop, snap):\n"
+        "    with cop.placement_scope(snap):\n"
+        "        pass\n")}
+    assert run_rule("tls-frame-hygiene", with_ctx) == []
+
+
+def test_thread_discipline():
+    bad = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "def f():\n"
+        "    t = threading.Thread(target=f, name='worker-1')\n"
+        "    t.start()\n")}
+    out = run_rule("thread-discipline", bad)
+    assert any(i.item.endswith(":name") for i in out)
+    assert any(i.item.endswith(":join") for i in out)  # non-daemon
+
+    good = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "def f():\n"
+        "    t = threading.Thread(target=f, name='titpu-x',\n"
+        "                         daemon=True)\n"
+        "    t.start()\n")}
+    assert run_rule("thread-discipline", good) == []
+
+    # f-string name rooted in a titpu- _thread_prefix constant is fine
+    prefixed = {"tidb_tpu/x.py": (
+        "import threading\n"
+        "class S:\n"
+        "    _thread_prefix = 'titpu-rpc'\n"
+        "    def f(self):\n"
+        "        threading.Thread(target=self.f, daemon=True,\n"
+        "            name=f'{self._thread_prefix}-conn').start()\n")}
+    assert run_rule("thread-discipline", prefixed) == []
+
+
+_FP_DECL = (
+    "DECLARED = frozenset({\n"
+    "    'kv/group-fsync',\n"
+    "    'twopc/unused-point',\n"
+    "})\n")
+
+
+def test_failpoint_registry():
+    files = {
+        "tidb_tpu/util/failpoint.py": _FP_DECL,
+        "tidb_tpu/a.py": (
+            "from ..util import failpoint\n"
+            "def f():\n"
+            "    failpoint.inject('kv/group-fsync')\n"
+            "    failpoint.inject('kv/undeclared')\n"),
+    }
+    # the ghost spec is assembled at runtime so THIS file never
+    # contains it as a parseable literal (the rule scans tests/ for
+    # env-var arming specs — including this very file)
+    ghost = "daemon" + "/ghost"
+    files["tests/test_x.py"] = (
+        "from tidb_tpu.util.failpoint import failpoint\n"
+        "def test_a():\n"
+        "    with failpoint('rpc/not-a-point'):\n"
+        "        pass\n"
+        "env = {'TIDB_TPU_FAILPOINTS':\n"
+        "       'kv/group-fsync=exit(1)@2;" + ghost + "=raise'}\n")
+    out = run_rule("failpoint-registry", files)
+    items = {f.item for f in out}
+    assert items == {"kv/undeclared",      # inject of undeclared name
+                     "twopc/unused-point",  # declared, no inject site
+                     "rpc/not-a-point",     # test arms undeclared
+                     ghost}                 # env spec arms undeclared
+
+    clean = {
+        "tidb_tpu/util/failpoint.py":
+            "DECLARED = frozenset({'kv/group-fsync'})\n",
+        "tidb_tpu/a.py": (
+            "def f():\n"
+            "    failpoint.inject('kv/group-fsync')\n"),
+        "tests/test_x.py": (
+            "ENV = {'TIDB_TPU_FAILPOINTS': 'kv/group-fsync=true'}\n"
+            "PROSE = 'rc=137/rc=124 remain the last words'\n"),
+    }
+    assert run_rule("failpoint-registry", clean) == []
+
+
+def test_bare_except():
+    bad = {"tidb_tpu/x.py": (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException:\n"
+        "        pass\n")}
+    out = run_rule("bare-except", bad)
+    assert len(out) == 2
+
+    good = {"tidb_tpu/x.py": (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        pass\n"
+        "    except BaseException:\n"
+        "        log()\n"
+        "        raise\n")}
+    assert run_rule("bare-except", good) == []
+
+
+def test_engine_tag_enum():
+    bad = {"tidb_tpu/x.py": (
+        "def f(obs, r):\n"
+        "    obs.note_engine('warp-drive')\n"
+        "    r.engine = 'bogus(tag)'\n")}
+    out = run_rule("engine-tag", bad)
+    assert len(out) == 2
+
+    good = {"tidb_tpu/x.py": (
+        "def f(obs, r, n, mode):\n"
+        "    obs.note_engine('device')\n"
+        "    obs.note_engine(f'device[{mode}]@mesh{n}')\n"
+        "    obs.note_engine('point')\n"
+        "    r.engine = f'host(fragment:{mode})'\n"
+        "    r.engine = 'ranged'\n"
+        "    r.engine = f'replica@{mode}'\n"
+        "    r.engine = computed()\n")}
+    assert run_rule("engine-tag", good) == []
+
+
+def test_metric_families():
+    bad = {"tidb_tpu/x.py": (
+        "def f(reg, ctx):\n"
+        "    reg.counter('tidb_real_total', 'help')\n"
+        "    ctx.metric_delta('tidb_ghost_total')\n")}
+    out = run_rule("metric-families", bad)
+    assert len(out) == 1 and out[0].item == "tidb_ghost_total"
+
+    good = {"tidb_tpu/x.py": (
+        "def f(reg, ctx):\n"
+        "    reg.counter('tidb_real_total', 'help')\n"
+        "    ctx.metric_delta('tidb_real_total')\n"
+        "    ctx.metric('tidb_real_total{k=\"v\"}')\n")}
+    assert run_rule("metric-families", good) == []
+
+
+def test_config_knob_drift_synthetic():
+    aux = {"config.toml.example": (
+        "[storage]\n"
+        "sync-log = \"commit\"\n"
+        "bogus-knob = 1\n")}
+    out = run_rule("config-knob-drift", {"tidb_tpu/x.py": ""}, aux)
+    assert any(f.item == "storage.bogus-knob" and
+               "no parsed Config field" in f.message for f in out)
+    # absent aux (synthetic trees): the rule no-ops
+    assert run_rule("config-knob-drift", {"tidb_tpu/x.py": ""}) == []
+
+
+# ---- dynamic half: the instrumented lock wrapper ----------------------------
+
+def test_zero_overhead_when_off():
+    """Disabled, the factories hand back PLAIN threading primitives —
+    not wrappers — so the production hot path pays literally nothing
+    (the Top SQL contract)."""
+    assert not lockcheck.enabled()
+    lk = lockcheck.lock("test.off", hot=True)
+    rl = lockcheck.rlock("test.off.r")
+    assert isinstance(lk, type(threading.Lock()))
+    assert isinstance(rl, type(threading.RLock()))
+    # note_blocking is a single bool probe
+    lockcheck.note_blocking("fsync", "noop")
+    assert lockcheck.findings() == []
+
+
+def test_injected_lock_order_inversion(checker):
+    """The acceptance demo: two locks taken in opposite orders produce
+    the cycle finding."""
+    a = checker.lock("T.alpha")
+    b = checker.lock("T.beta")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = checker.find_cycles()
+    assert any(set(c) >= {"T.alpha", "T.beta"} for c in cycles), cycles
+    kinds = {f["kind"] for f in checker.findings()}
+    assert "lock-order-inversion" in kinds
+    f = next(f for f in checker.findings()
+             if f["kind"] == "lock-order-inversion")
+    assert "T.alpha" in f["item"] and "T.beta" in f["item"]
+    assert f["stack"]  # a sample stack rides along
+
+
+def test_consistent_order_is_clean(checker):
+    a = checker.lock("T.c1")
+    b = checker.lock("T.c2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert checker.find_cycles() == []
+    assert checker.findings() == []
+
+
+def test_blocking_under_hot_lock_dynamic(checker):
+    hot = checker.lock("T.hot", hot=True)
+    cold = checker.lock("T.cold")
+    with cold:
+        checker.note_blocking("fsync", "cold path")
+    assert checker.findings() == []
+    with hot:
+        checker.note_blocking("fsync", "bad path")
+    out = [f for f in checker.findings()
+           if f["kind"] == "blocking-under-hot-lock"]
+    assert len(out) == 1 and "T.hot" in out[0]["item"]
+
+
+def test_rlock_reentrancy_and_cross_thread(checker):
+    """Reentrant acquires don't self-edge; the inversion is detected
+    across real threads (the deadlocked interleaving, run serially)."""
+    a = checker.rlock("T.ra")
+    b = checker.rlock("T.rb")
+
+    def t1():
+        with a:
+            with a:        # reentrant: no a->a edge
+                with b:
+                    pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1, name="titpu-test-1")
+    th1.start(); th1.join()
+    th2 = threading.Thread(target=t2, name="titpu-test-2")
+    th2.start(); th2.join()
+    edges, _, _ = checker.GRAPH.snapshot()
+    assert ("T.ra", "T.ra") not in edges
+    assert any(set(c) >= {"T.ra", "T.rb"}
+               for c in checker.find_cycles())
+
+
+def test_held_snapshot_mirror(checker):
+    """The conftest leak guard's probe: held while held, empty after
+    release."""
+    lk = checker.lock("T.held")
+    lk.acquire()
+    snap = checker.held_snapshot()
+    assert any("T.held" in names for names in snap.values()), snap
+    lk.release()
+    assert checker.held_snapshot() == {}
+
+
+def test_inspection_rule_surfaces_cycle(checker):
+    """The PR 10 plane: an observed inversion shows up in
+    inspection_result under rule lock-order-inversion."""
+    from tidb_tpu import obs_inspect
+    from tidb_tpu.store.storage import Storage
+
+    a = checker.lock("T.ia")
+    b = checker.lock("T.ib")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    st = Storage()
+    try:
+        rows = obs_inspect.result_rows(st)
+        hits = [r for r in rows if r[0] == "lock-order-inversion"]
+        assert hits, rows
+        assert any("T.ia" in r[1] and "T.ib" in r[1] for r in hits)
+        assert all(r[2] == "critical" for r in hits
+                   if "->" in r[1])
+        # the /debug/lockgraph payload carries the same cycle
+        payload = checker.debug_payload()
+        assert payload["enabled"] is True
+        assert any("T.ia" in c for c in payload["cycles"])
+    finally:
+        st.close()
+
+
+def test_instrumented_storage_runs_clean(checker, tmp_path):
+    """A real durable storage under TIDB_TPU_LOCK_CHECK: product locks
+    register, a write/commit workload leaves NO cycle and NO
+    blocking-under-hot-lock finding (the PR 12 fsync fix, now pinned
+    by instrumentation instead of code review)."""
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    st = Storage(str(tmp_path / "store"), sync_log="commit")
+    try:
+        s = Session(st)
+        s.execute("create table lc (a int primary key, b int)")
+        for i in range(5):
+            s.execute(f"insert into lc values ({i}, {i * 2})")
+        assert s.query("select count(*) from lc") == [(5,)]
+        _, _, locks = checker.GRAPH.snapshot()
+        assert "Storage._commit_lock" in locks
+        assert locks["Storage._commit_lock"] is True  # hot
+        assert "SyncPolicy._lock" in locks
+        bad = [f for f in checker.findings()]
+        assert bad == [], bad
+    finally:
+        st.close()
+
+
+# ---- native half: ASan/UBSan torture ---------------------------------------
+
+_ASAN_CHILD = r"""
+import sys, tempfile, threading
+from tidb_tpu.kv import native
+assert native._sanitize_requested()
+kv = native.NativeOrderedKV(tempfile.mkdtemp(), sync_log="commit")
+errors = []
+def writer(i):
+    try:
+        for n in range(200):
+            kv.put(0, b"k%d-%d" % (i, n), b"v" * 128)
+            if n % 3 == 0:
+                kv.delete(0, b"k%d-%d" % (i, n))
+            kv.commit_sync()
+    except Exception as e:
+        errors.append(e)
+def churner():
+    try:
+        for _ in range(20):
+            kv.checkpoint()
+            list(kv.scan(0, b"", b"\xff", limit=50))
+    except Exception as e:
+        errors.append(e)
+threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+threads.append(threading.Thread(target=churner))
+for t in threads: t.start()
+for t in threads: t.join()
+assert not errors, errors
+kv.close()
+print("TORTURE_OK")
+"""
+
+
+@pytest.mark.slow
+def test_native_sanitizer_group_fsync_torture():
+    """TIDB_TPU_NATIVE_SANITIZE=1: rebuild native/kvstore.cpp under
+    ASan/UBSan and run the PR 12 group-fsync workload (concurrent
+    writers on commit_sync + checkpoint/scan churn) against it. Any
+    use-after-free / data race the sanitizer can see fails the run."""
+    gcc = subprocess.run(["gcc", "-print-file-name=libasan.so"],
+                         capture_output=True, text=True)
+    libasan = gcc.stdout.strip()
+    if gcc.returncode != 0 or not os.path.isfile(libasan):
+        pytest.skip("libasan not available")
+    mk = subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                         "sanitize"], capture_output=True, text=True,
+                        timeout=180)
+    assert mk.returncode == 0, mk.stderr
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "LD_PRELOAD": libasan,
+        "TIDB_TPU_NATIVE_SANITIZE": "1",
+        # the interpreter never frees everything at exit; leaks are
+        # not what this test hunts
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1",
+    })
+    r = subprocess.run([sys.executable, "-c", _ASAN_CHILD],
+                       capture_output=True, text=True, timeout=300,
+                       env=env, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out[-4000:]
+    assert "TORTURE_OK" in r.stdout
+    assert "AddressSanitizer" not in out
+    assert "runtime error" not in out  # UBSan's report prefix

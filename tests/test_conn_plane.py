@@ -198,11 +198,11 @@ def test_server_close_joins_reactor_and_pool():
     assert srv._pool.thread_count() == 0 or True  # workers drain async
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline and any(
-            t.name.startswith("conn-worker") and t.is_alive()
+            t.name.startswith("titpu-conn-worker") and t.is_alive()
             for t in threading.enumerate()):
         time.sleep(0.1)
     leaked = [t.name for t in threading.enumerate()
-              if t.name.startswith(("conn-worker", "conn-reactor"))
+              if t.name.startswith(("titpu-conn-worker", "titpu-conn-reactor"))
               and t.is_alive()]
     assert not leaked, leaked
     try:

@@ -132,7 +132,7 @@ def test_tracing_disabled_allocates_no_spans(monkeypatch):
 
 def _profiler_threads() -> list[threading.Thread]:
     return [t for t in threading.enumerate()
-            if t.name == "tidb-tpu-profiler" and t.is_alive()]
+            if t.name == "titpu-profiler" and t.is_alive()]
 
 
 def test_profiler_lifecycle_no_leaked_thread():

@@ -180,6 +180,22 @@ class ReplicaReadConfig:
 
 
 @dataclass
+class AnalysisConfig:
+    """The `[analysis]` TOML section: the concurrency-analysis plane
+    (tidb_tpu/analysis/). The static half runs offline (`python -m
+    tidb_tpu.analysis --check`) and needs no config; this section arms
+    the DYNAMIC half."""
+
+    # instrument long-lived subsystem locks at creation and feed the
+    # process-wide lock-order graph (cycles -> the lock-order-inversion
+    # inspection rule + /debug/lockgraph). Off by default: disabled,
+    # every lock is a plain threading primitive — zero overhead, the
+    # Top SQL contract. The TIDB_TPU_LOCK_CHECK env var is the
+    # no-config equivalent.
+    lock_check: bool = False
+
+
+@dataclass
 class PlanCacheConfig:
     enabled: bool = True
     capacity: int = 128
@@ -272,6 +288,7 @@ class Config:
     status: StatusConfig = field(default_factory=StatusConfig)
     performance: PerformanceConfig = field(default_factory=PerformanceConfig)
     plan_cache: PlanCacheConfig = field(default_factory=PlanCacheConfig)
+    analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     mesh: MeshSection = field(default_factory=MeshSection)
     diagnostics: DiagnosticsConfig = field(
         default_factory=DiagnosticsConfig)
@@ -517,14 +534,36 @@ class Config:
         return applied
 
     def apply_log_level(self) -> None:
-        """Point the package loggers at the configured level (startup +
-        hot reload both call this; reference: logutil.InitLogger)."""
+        """Point the package loggers at the configured level and wire
+        the [log] sinks (startup + hot reload both call this;
+        reference: logutil.InitLogger). Idempotent: a SIGHUP reload
+        must not stack a second file handler."""
         import logging
 
         level = {"debug": logging.DEBUG, "info": logging.INFO,
                  "warn": logging.WARNING, "error": logging.ERROR}[
                      self.log.level]
         logging.getLogger("tidb_tpu").setLevel(level)
+        fmt: logging.Formatter
+        if self.log.format == "json":
+            fmt = _JsonLogFormatter()
+        else:
+            fmt = logging.Formatter(
+                "%(asctime)s %(levelname)s %(name)s %(message)s")
+        # log.slow-query-file: mirror the slow log to its own file
+        # (reference: the dedicated slow query log file LogSlowQuery
+        # writes; the in-memory ring behind SHOW SLOW QUERIES stays)
+        slow = logging.getLogger("tidb_tpu.slowlog")
+        for h in list(slow.handlers):
+            if getattr(h, "_titpu_slow_sink", False):
+                slow.removeHandler(h)
+                h.close()
+        if self.log.slow_query_file:
+            fh = logging.FileHandler(self.log.slow_query_file,
+                                     encoding="utf-8", delay=True)
+            fh.setFormatter(fmt)
+            fh._titpu_slow_sink = True  # type: ignore[attr-defined]
+            slow.addHandler(fh)
 
     def rpc_options(self):
         """The transport knobs as the RPC tier's options object."""
@@ -562,6 +601,15 @@ class Config:
                                    cooldown_ms=p.governor_cooldown_ms)
         storage.admission.configure(tokens=p.token_limit,
                                     timeout_ms=p.admission_timeout_ms)
+        # commit-time txn size cap (enforced in Storage.commit with
+        # ER_TXN_TOO_LARGE over the encoded mutation bytes)
+        storage.txn_total_size_limit = int(p.txn_total_size_limit)
+        # auto-analyze cadence floor: the maintenance worker skips
+        # analyze passes closer together than the stats lease
+        # (reference: the statistics handle's lease-driven update loop)
+        from .store.daemon import parse_duration
+        storage.maintenance.stats_lease_s = parse_duration(
+            p.stats_lease, 3.0)
 
     def seed_mesh(self) -> None:
         """Configure the PROCESS-wide device-mesh plane from the [mesh]
@@ -629,8 +677,18 @@ class Config:
             window_s=p.topsql_window_seconds,
             digest_cap=p.topsql_digest_cap)
         storage.obs.events.configure(cap=p.events_history_cap)
+        # performance.metrics-history-interval is the preferred knob;
+        # the legacy [status] metrics-interval wins only when the new
+        # one is left at its default (same precedence as plan-cache
+        # capacity — the dataclass defaults are the single source, so
+        # changing a default cannot desynchronize this test)
+        interval = p.metrics_history_interval
+        if interval == PerformanceConfig.metrics_history_interval \
+                and self.status.metrics_interval \
+                != StatusConfig.metrics_interval:
+            interval = self.status.metrics_interval
         storage.metrics_history.configure(
-            interval_s=p.metrics_history_interval,
+            interval_s=interval,
             cap=p.metrics_history_cap)
 
     # ---- sysvar seeding ------------------------------------------------
@@ -667,6 +725,32 @@ class Config:
             "tidb_replica_read",
             "follower" if self.replica_read.prefer_follower
             else "leader")
+
+
+class _JsonLogFormatter:
+    """log.format = "json": one JSON object per record (reference:
+    logutil's zap JSON encoder). Duck-typed Formatter: format() is the
+    only method handlers call on it, and defining it without importing
+    logging keeps config import-light."""
+
+    def format(self, record) -> str:
+        import json
+        import time as _t
+        out = {
+            "ts": _t.strftime("%Y-%m-%d %H:%M:%S",
+                              _t.localtime(record.created)),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        # the slow-log producer (obs.record_slow) attaches its full
+        # structured entry — digest, per-stage/per-operator splits,
+        # mem/spill, mesh skew — so the file sink explains the query,
+        # not just names it
+        slow = getattr(record, "slow_entry", None)
+        if slow is not None:
+            out["slow"] = slow
+        return json.dumps(out, default=str)
 
 
 class _TomlError(Exception):
@@ -860,6 +944,27 @@ conn-worker-threads = 0
 [plan-cache]
 enabled = true
 capacity = 128                 # legacy alias of plan-cache-size
+
+[analysis]
+# Concurrency analysis plane (tidb_tpu/analysis/). The STATIC half —
+# the AST rule engine (blocking-call-under-hot-lock, lock-order,
+# tls-frame-hygiene, thread-discipline, failpoint-registry,
+# bare-except, engine-tag, metric-families, config-knob-drift) with
+# its committed baseline (tidb_tpu/analysis/baseline.txt) — runs
+# offline and inside tier-1:
+#     python -m tidb_tpu.analysis --check
+# and needs no configuration. This section arms the DYNAMIC half:
+# lock-check = true wraps long-lived subsystem locks (storage commit
+# lock, MVCC/native store mutexes, the group-fsync rendezvous, RPC
+# registries) in instrumented twins feeding a process-wide lock-order
+# graph; observed cycles (potential deadlocks) and blocking syscalls
+# under a hot lock surface as the lock-order-inversion inspection
+# rule and /debug/lockgraph. Off by default: disabled, every lock is
+# a plain threading primitive — zero overhead, the Top SQL contract.
+# TIDB_TPU_LOCK_CHECK=1 is the no-config equivalent, and
+# TIDB_TPU_NATIVE_SANITIZE=1 rebuilds the native KV engine under
+# ASan/UBSan (native/Makefile `sanitize` target).
+lock-check = false
 
 [mesh]
 # Multi-chip data plane: shard large columnar epochs across the

@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from ..analysis import lockcheck
 from .codec import encode_uint_desc
 
 CF_LOCK = 0
@@ -95,7 +96,7 @@ class SyncPolicy:
         self.policy = policy
         self.interval_ms = interval_ms
         self._fsync = fsync
-        self._lock = threading.Lock()
+        self._lock = lockcheck.lock("SyncPolicy._lock")
         self._last = 0.0
         self._dirty = False
         self._timer = None
@@ -203,6 +204,10 @@ class SyncPolicy:
 
     def _timed_fsync(self) -> None:
         import time as _time
+        # dynamic twin of the blocking-call-under-hot-lock rule: a
+        # disk barrier with a hot lock held is a typed finding (one
+        # module-global bool probe when the checker is off)
+        lockcheck.note_blocking("fsync", "SyncPolicy WAL fsync")
         t0 = _time.perf_counter()
         self._fsync()
         dt = _time.perf_counter() - t0
@@ -354,6 +359,12 @@ class PyOrderedKV:
         self._wal = None
         self._shared = shared
         self._applied_off = 0
+        # bumped whenever checkpoint() rotates (truncates) the WAL —
+        # the closed-ts protocol brackets its lock-free size stats on
+        # it (shared-mode engines never rotate, so a socket leader's
+        # generation is constant; the counter future-proofs any
+        # rotation path)
+        self.wal_generation = 0
         # durability policy (storage.sync-log): 'off' flushes to the OS
         # only (a machine crash can lose acked commits), 'commit' fsyncs
         # at every commit boundary, 'interval' group-commits — at most
@@ -527,6 +538,11 @@ class PyOrderedKV:
         fsync_dir(self._dir)
         self._wal.close()
         self._wal = open(os.path.join(self._dir, "wal.log"), "wb")
+        # rotation epoch: readers pairing (wal size, other state) —
+        # rpc/server closed_info — bracket on this to detect a
+        # truncate+regrow race that a size comparison alone cannot
+        # (same inode, size may already exceed the pre-rotation stat)
+        self.wal_generation += 1
         self._syncer.clean()  # the fsync'd snapshot covers everything
 
     def _fsync_wal(self) -> None:
@@ -672,7 +688,7 @@ class Mutation:
 class MVCCStore:
     def __init__(self, engine=None, coord=None) -> None:
         self.kv = engine if engine is not None else PyOrderedKV()
-        self._mu = threading.RLock()
+        self._mu = lockcheck.rlock("MVCCStore._mu", hot=True)
         # shared-directory coordinator (multi-process deployments): every
         # mutation runs inside its flock with the WAL tail caught up, so
         # percolator lock/write records from sibling processes are always

@@ -14,8 +14,18 @@ import threading
 from pathlib import Path
 from typing import Iterator, Optional
 
+from ..analysis import lockcheck
+
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
 _SO = _NATIVE_DIR / "libtidbkv.so"
+# TIDB_TPU_NATIVE_SANITIZE=1: load the ASan/UBSan instrumented build
+# instead (native/Makefile `sanitize` target). The process must have
+# libasan preloaded (LD_PRELOAD) — dlopen'ing an ASan object into a
+# clean interpreter fails with "runtime does not come first"; the
+# slow-marked torture test in tests/test_analysis.py spawns a child
+# with the right environment.
+SANITIZE_ENV = "TIDB_TPU_NATIVE_SANITIZE"
+_SO_ASAN = _NATIVE_DIR / "libtidbkv_asan.so"
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -25,18 +35,35 @@ class NativeUnavailable(RuntimeError):
     pass
 
 
+def _sanitize_requested() -> bool:
+    import os
+    # same falsy spellings as lockcheck's env parsing
+    return os.environ.get(SANITIZE_ENV, "") not in ("", "0", "false",
+                                                    "off")
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _SO.exists():
+        so, target = (_SO_ASAN, "sanitize") if _sanitize_requested() \
+            else (_SO, "all")
+        if not so.exists():
             try:
-                subprocess.run(["make", "-C", str(_NATIVE_DIR)],
+                subprocess.run(["make", "-C", str(_NATIVE_DIR), target],
                                check=True, capture_output=True, timeout=120)
             except (subprocess.CalledProcessError, OSError) as e:
-                raise NativeUnavailable(f"cannot build {_SO}: {e}") from e
-        lib = ctypes.CDLL(str(_SO))
+                raise NativeUnavailable(f"cannot build {so}: {e}") from e
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError as e:
+            if so is _SO_ASAN:
+                raise NativeUnavailable(
+                    f"cannot load {so.name}: {e} — the ASan runtime "
+                    "must be preloaded (LD_PRELOAD=$(gcc "
+                    "-print-file-name=libasan.so))") from e
+            raise
         c = ctypes.c_char_p
         vp = ctypes.c_void_p
         sz = ctypes.c_size_t
@@ -98,9 +125,10 @@ class NativeOrderedKV:
                 raise NativeUnavailable(f"cannot open WAL dir {path}")
         else:
             self._h = self._lib.kv_open()
-        self._mu = threading.Lock()
-        # fsync-vs-close fence (see _fsync_native); writers never take it
-        self._sync_mu = threading.Lock()
+        self._mu = lockcheck.lock("NativeOrderedKV._mu", hot=True)
+        # fsync-vs-close fence (see _fsync_native); writers never take
+        # it. NOT a hot lock: holding it across the fsync IS its job
+        self._sync_mu = lockcheck.lock("NativeOrderedKV._sync_mu")
         self._durable = path is not None
         # same storage.sync-log policy the Python twin honors, via the
         # SAME shared evaluator (mvcc.SyncPolicy — commit/interval
@@ -129,6 +157,10 @@ class NativeOrderedKV:
             with self._mu:
                 h = self._h
             if h:
+                # dynamic blocking probe: fires only if a caller holds
+                # a HOT lock (the store mutex) into this fsync — the
+                # deliberately-held _sync_mu close fence is not hot
+                lockcheck.note_blocking("fsync", "native kv_sync")
                 self._lib.kv_sync(h)
 
     def checkpoint(self) -> None:

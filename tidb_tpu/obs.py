@@ -771,9 +771,14 @@ class Observability:
         }
         with self._slow_lock:
             self._slow_log.append(ent)
-        # the reference writes a structured slow log line (adapter.go:866)
+        # the reference writes a structured slow log line
+        # (adapter.go:866). The FULL entry rides the record as
+        # `slow_entry` so the log.slow-query-file sink with
+        # log.format=json emits the structure (digest, stages,
+        # operators, mem/spill, mesh skew), not just this one-liner.
         log.warning("slow query (%.1fms) db=%s: %s",
-                    duration_s * 1e3, db, ent["sql"][:400])
+                    duration_s * 1e3, db, ent["sql"][:400],
+                    extra={"slow_entry": ent})
 
     def slow_queries(self) -> list[dict]:
         with self._slow_lock:
@@ -1646,7 +1651,7 @@ class SamplingProfiler:
         self._t0 = time.perf_counter()
         self._stop.clear()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="tidb-tpu-profiler")
+            target=self._run, daemon=True, name="titpu-profiler")
         self._thread.start()
         return self
 

@@ -517,6 +517,37 @@ def _r_metric_lint(ctx: InspectionContext) -> list[Finding]:
     return out
 
 
+@rule("lock-order-inversion", "critical",
+      "TIDB_TPU_LOCK_CHECK / [analysis] lock-check — the instrumented "
+      "lock wrapper observed a lock-order cycle (potential deadlock) "
+      "or a blocking syscall under a hot lock; /debug/lockgraph has "
+      "the edges and sample stacks")
+def _r_lock_order_inversion(ctx: InspectionContext) -> list[Finding]:
+    # reads the PROCESS-wide lock graph, not the snapshot: the checker
+    # is opt-in instrumentation (zero overhead when off), and its
+    # findings are cumulative facts about this process's execution —
+    # exactly what an inspection read should surface
+    from .analysis import lockcheck
+    if not lockcheck.enabled():
+        return []
+    out = []
+    for f in lockcheck.findings():
+        if f["kind"] == "lock-order-inversion":
+            out.append(Finding(
+                "lock-order-inversion", f["item"], "critical", "cycle",
+                f"lock-order cycle observed at runtime: {f['item']} — "
+                f"two threads acquiring these locks in opposite "
+                f"orders can deadlock"))
+        else:  # blocking-under-hot-lock
+            out.append(Finding(
+                "lock-order-inversion", f["item"], "warning",
+                str(f.get("count", 1)),
+                f"blocking syscall with a hot lock held "
+                f"({f['item']}, x{f.get('count', 1)}): every peer of "
+                f"that lock serializes behind the syscall"))
+    return out
+
+
 @rule("config-sync-log", "warning",
       "storage.sync-log — off on a leader with live followers: acked "
       "commits can die with the machine while replicas follow them")

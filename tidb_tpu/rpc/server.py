@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from ..analysis import lockcheck
 from ..errno import CodedError
 from .errors import RPCError, StaleLeaseError, StaleTermError, \
     WalOffsetMismatch, traced_response, wire_error
@@ -218,7 +219,7 @@ class CoordRPCServer(FrameListener):
         # the server owns the chunk size; clients drive the tail loop
         # off the response's `more` flag, never off their own constant
         self.tail_chunk = tail_chunk
-        self._mu = threading.Lock()
+        self._mu = lockcheck.lock("CoordRPCServer._mu")
         self._clients: dict[str, _Client] = {}
         self._grants: dict[str, _Grant] = {}   # lock name -> grant
         self._lock_fds: dict[str, int] = {}    # lock name -> flock fd
@@ -498,16 +499,46 @@ class CoordRPCServer(FrameListener):
         serving tier assumes the socket-cluster shape, where the
         leader process is the only local mutator.)"""
         st = self.storage
-        with st._commit_lock:
-            closed = int(st.tso.current())
-            with self._mu:
-                pend = [c.pending_commit for c in self._clients.values()
-                        if c.pending_commit]
-            if pend:
-                closed = min(closed, min(pend) - 1)
+        # the WAL stat is disk I/O — kept OUTSIDE the commit lock
+        # (blocking-call-under-hot-lock). Between appends the size
+        # only grows, so a post-lock stat still covers every record of
+        # commits <= closed_ts and the extra bytes belong to newer
+        # commits the MVCC read at closed_ts never sees. The one size
+        # DECREASE is a checkpoint rotating the WAL (which never held
+        # this lock, before or after this change): bracketing stats
+        # detect a rotation racing the closed-ts read and retry, so a
+        # truncated size is never paired with a pre-truncation
+        # closed_ts.
+        # rotation epoch of the leader's WAL: in the serving shape the
+        # leader is shared-mode and never rotates (PyOrderedKV shared
+        # checkpoint is a no-op), so the generation is constant; the
+        # bracket still guards any future rotation path — a size
+        # comparison alone cannot see a truncate-then-regrow (same
+        # file, size already past the pre-rotation stat)
+        def _wal_gen() -> int:
+            return int(getattr(getattr(st.kv, "kv", None),
+                               "wal_generation", 0))
+
+        for _ in range(3):
+            gen0 = _wal_gen()
+            before = self._wal_size()
+            with st._commit_lock:
+                closed = int(st.tso.current())
+                with self._mu:
+                    pend = [c.pending_commit
+                            for c in self._clients.values()
+                            if c.pending_commit]
+                if pend:
+                    closed = min(closed, min(pend) - 1)
             wal = self._wal_size()
-        return {"wal_size": wal, "closed_ts": closed,
-                "term": self.term}
+            if _wal_gen() == gen0 and wal >= before:
+                return {"wal_size": wal, "closed_ts": closed,
+                        "term": self.term}
+        # a rotation raced every retry: REFUSE to advance rather than
+        # pair a fresh closed_ts with a size that may not cover its
+        # records — the apply engine's `closed > applied_ts` guard
+        # makes a zero pair one skipped tick, never a regression
+        return {"wal_size": 0, "closed_ts": 0, "term": self.term}
 
     # ---- named leases (mutation section, ddl/gc owner) ---------------------
     def _lock_file(self, name: str) -> str:

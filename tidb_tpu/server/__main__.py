@@ -183,6 +183,12 @@ def main(argv: list[str] | None = None) -> int:
         return 1
 
     cfg.apply_log_level()
+    # [analysis] lock-check arms the dynamic lock-order checker BEFORE
+    # any storage/lock creation — only locks created after enable()
+    # are instrumented (env TIDB_TPU_LOCK_CHECK is the no-config path)
+    if cfg.analysis.lock_check:
+        from ..analysis import lockcheck
+        lockcheck.enable()
     # transport selection: follower joins a leader over the socket; a
     # leader additionally serves the coordination RPC tier; otherwise
     # the local / flock-shared-dir modes (reference: main.go:263 creates
@@ -220,6 +226,7 @@ def main(argv: list[str] | None = None) -> int:
                  skip_grant_table=cfg.security.skip_grant_table,
                  ssl_cert=cfg.security.ssl_cert or None,
                  ssl_key=cfg.security.ssl_key or None,
+                 ssl_ca=cfg.security.ssl_ca or None,
                  auto_tls=cfg.security.auto_tls,
                  require_secure_transport=(
                      cfg.security.require_secure_transport),

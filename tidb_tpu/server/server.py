@@ -73,7 +73,7 @@ class _WorkerPool:
             self._seq += 1
             self._count += 1
             t = threading.Thread(target=self._worker, daemon=True,
-                                 name=f"conn-worker-{self._seq}")
+                                 name=f"titpu-conn-worker-{self._seq}")
             self._threads.add(t)
         t.start()
 
@@ -137,7 +137,7 @@ class _Reactor:
         self._wake_r.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, None)
         self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="conn-reactor")
+                                        name="titpu-conn-reactor")
         self._thread.start()
 
     def _wake(self) -> None:
@@ -256,6 +256,7 @@ class Server:
         skip_grant_table: bool = False,
         ssl_cert: Optional[str] = None,
         ssl_key: Optional[str] = None,
+        ssl_ca: Optional[str] = None,
         auto_tls: bool = False,
         require_secure_transport: bool = False,
         proxy_protocol_networks: str = "",
@@ -292,7 +293,8 @@ class Server:
         # self-signed pair at startup. require_secure_transport rejects
         # plaintext connections like the MySQL sysvar.
         self.require_secure_transport = require_secure_transport
-        self.ssl_ctx = self._build_ssl_ctx(ssl_cert, ssl_key, auto_tls)
+        self.ssl_ctx = self._build_ssl_ctx(ssl_cert, ssl_key, ssl_ca,
+                                           auto_tls)
         if require_secure_transport and self.ssl_ctx is None:
             # with no TLS context every connection would be rejected —
             # an unrecoverable lockout; refuse to start instead
@@ -354,11 +356,17 @@ class Server:
 
     @staticmethod
     def _build_ssl_ctx(cert: Optional[str], key: Optional[str],
-                       auto_tls: bool):
+                       ca: Optional[str], auto_tls: bool):
         import ssl as _ssl
         if not cert and not auto_tls:
             return None
         ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        if ca:
+            # security.ssl-ca: verify client certificates against the
+            # operator CA when a client presents one (reference:
+            # util.NewTLSConfig ClientCAs + VerifyClientCertIfGiven)
+            ctx.load_verify_locations(cafile=ca)
+            ctx.verify_mode = _ssl.CERT_OPTIONAL
         if cert:
             ctx.load_cert_chain(cert, key or cert)
             return ctx
@@ -401,7 +409,7 @@ class Server:
             sv.set_config_default("have_ssl", "YES")
             sv.set_config_default("have_openssl", "YES")
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="mysql-accept", daemon=True)
+            target=self._accept_loop, name="titpu-mysql-accept", daemon=True)
         self._accept_thread.start()
         # KILL routing: sessions resolve KILL <id> through the storage so
         # statements on ANY server can target connections on THIS one
@@ -416,7 +424,7 @@ class Server:
         if coord is not None:
             coord.register_server(self.port, self.status_port)
             t = threading.Thread(target=self._kill_mailbox_loop,
-                                 name="kill-mailbox", daemon=True)
+                                 name="titpu-kill-mailbox", daemon=True)
             t.start()
         # a serving deployment samples its metrics ring in the
         # background (embedded stores sample on demand); the thread is

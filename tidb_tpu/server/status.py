@@ -33,6 +33,10 @@ Debug routes:
       inspection rule evaluated over the live telemetry snapshot,
       full findings + per-rule summary (JSON; empty with zero rule
       work while diagnostics.enabled is false)
+  /debug/lockgraph  the dynamic lock-order checker
+      (TIDB_TPU_LOCK_CHECK / [analysis] lock-check): instrumented
+      locks, observed acquisition edges, cycles (potential
+      deadlocks), blocking-under-hot-lock events, held mirror (JSON)
 """
 
 from __future__ import annotations
@@ -214,6 +218,13 @@ class StatusServer:
                     from ..util import failpoint
                     body = json.dumps(failpoint.snapshot()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/debug/lockgraph"):
+                    # the dynamic lock-order checker's graph: enabled
+                    # flag, instrumented locks, observed edges, cycles,
+                    # blocking-under-hot-lock events, held mirror
+                    from ..analysis import lockcheck
+                    body = json.dumps(lockcheck.debug_payload()).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/debug/profile"):
                     q = parse_qs(urlparse(self.path).query)
 
@@ -249,7 +260,7 @@ class StatusServer:
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True,
-                                        name="tidb-tpu-status")
+                                        name="titpu-status")
         self._thread.start()
 
     def close(self) -> None:

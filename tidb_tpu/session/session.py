@@ -28,7 +28,8 @@ from ..plan.builder import PlanBuilder, PlanError, _literal_const
 from ..plan.physical import explain_plan, optimize
 from ..sql import ast
 from ..sql.parser import ParseError, parse_sql
-from ..store.storage import Storage, Transaction, WriteConflictError
+from ..store.storage import (Storage, Transaction,
+                             TxnTooLargeError, WriteConflictError)
 from ..store.table_store import TableStore
 from ..types.field_type import FieldType, TypeKind
 from ..types.value import Decimal
@@ -339,7 +340,6 @@ class Session:
         # EXPLAIN ANALYZE (reference: execdetails on every statement)
         prev_rec = obs.active_stage_recorder()
         rec = obs.StageRecorder()
-        obs.install_stage_recorder(rec)
         pp = getattr(self, "_pending_parse_s", 0.0)
         if pp:
             # the batch's parse time books against its first statement
@@ -355,11 +355,22 @@ class Session:
             tz = str(self._sysvar_value("time_zone") or "SYSTEM")
         except (TypeError, ValueError, SQLError):
             tz = "SYSTEM"
-        prev_tz = _funcs.install_session_time_zone(tz)
-        # @@profiling: sample THIS thread's stacks for the statement
-        # (reference: util/profile; MySQL SHOW PROFILE semantics)
-        prof = self._maybe_start_profiler(stmt)
+        # the TLS frames (stage recorder, session time zone) install
+        # INSIDE the protected region: anything raising between an
+        # install and the statement body — the profiler start, DML
+        # admission — must still restore them in the finally, or the
+        # frame leaks onto this worker thread for its next statement
+        # (tls-frame-hygiene analysis rule). Restoring a never-
+        # installed time zone writes None, which reads as SYSTEM.
+        prev_tz = None
+        prof = None
         try:
+            obs.install_stage_recorder(rec)
+            prev_tz = _funcs.install_session_time_zone(tz)
+            # @@profiling: sample THIS thread's stacks for the
+            # statement (reference: util/profile; MySQL SHOW PROFILE
+            # semantics)
+            prof = self._maybe_start_profiler(stmt)
             if isinstance(stmt, (ast.InsertStmt, ast.UpdateStmt,
                                  ast.DeleteStmt, ast.LoadDataStmt)):
                 # DML admits at the TOP priority class: point writes
@@ -1545,6 +1556,11 @@ class Session:
             try:
                 txn.commit()
             except WriteConflictError as e:
+                raise err_wrap(SQLError, e) from None
+            except TxnTooLargeError as e:
+                # performance.txn-total-size-limit crossed: surface as
+                # the session-layer SQLError (errno 8004) like the
+                # wire layer would, keeping embedded callers' contract
                 raise err_wrap(SQLError, e) from None
         else:
             txn.rollback()

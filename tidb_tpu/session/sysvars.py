@@ -90,7 +90,10 @@ _VARS = [
     _v("query_cache_size", 0, scope=SCOPE_GLOBAL, read_only=True),
     _v("have_openssl", "DISABLED", read_only=True),
     _v("have_ssl", "DISABLED", read_only=True),
-    _v("max_connections", 0, scope=SCOPE_GLOBAL),
+    # default mirrors config max-connections (the config-knob-drift
+    # rule pins registry default == config-seeded default, so SHOW
+    # VARIABLES on an embedded store matches a default server's)
+    _v("max_connections", 512, scope=SCOPE_GLOBAL),
     _v("default_storage_engine", "InnoDB", read_only=True),
     _v("default_authentication_plugin", "mysql_native_password",
        scope=SCOPE_GLOBAL, read_only=True),

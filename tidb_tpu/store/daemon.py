@@ -54,6 +54,10 @@ class MaintenanceWorker:
         self.gc_removed_total = 0
         self.locks_resolved_total = 0
         self.auto_analyzed: list[str] = []
+        # auto-analyze cadence floor (performance.stats-lease seeds
+        # it; 0 = analyze on every tick, the embedded/test default)
+        self.stats_lease_s = 0.0
+        self._last_analyze = 0.0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -106,6 +110,11 @@ class MaintenanceWorker:
     def run_auto_analyze(self) -> list[str]:
         if self.catalog is None:
             return []
+        if self.stats_lease_s > 0:
+            now = time.monotonic()
+            if now - self._last_analyze < self.stats_lease_s:
+                return []
+            self._last_analyze = now
         names = self.storage.stats.auto_analyze(self.storage, self.catalog)
         self.auto_analyzed.extend(names)
         return names

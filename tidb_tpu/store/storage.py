@@ -34,19 +34,30 @@ from ..kv.mvcc import (
     OP_PUT,
     WriteConflictError as KVWriteConflict,
 )
+from ..analysis import lockcheck
 from ..kv.region import RegionManager
 from ..kv.tso import TimestampOracle
 from ..kv.twopc import CommitError, TwoPhaseCommitter
 from .table_store import TableSnapshot, TableStore
 
 
-from ..errno import ER_SCHEMA_CHANGED, ER_WRITE_CONFLICT, CodedError
+from ..errno import (ER_SCHEMA_CHANGED, ER_TXN_TOO_LARGE,
+                     ER_WRITE_CONFLICT, CodedError)
 
 
 class WriteConflictError(CodedError):
     """Another txn committed to a key after our start_ts (optimistic SI)."""
 
     errno = ER_WRITE_CONFLICT
+
+
+class TxnTooLargeError(CodedError):
+    """Encoded mutation bytes crossed performance.txn-total-size-limit
+    (reference: kv.ErrTxnTooLarge / txn-total-size-limit, config.go) —
+    a runaway txn must fail BEFORE prewrite floods the region tier,
+    not after it has half-committed a gigabyte."""
+
+    errno = ER_TXN_TOO_LARGE
 
 
 def _make_engine(path: Optional[str] = None, sync_log: str = "off",
@@ -225,6 +236,10 @@ class Storage:
         from ..util.governor import AdmissionGate, MemoryGovernor
         self.governor = MemoryGovernor(self.obs.metrics)
         self.admission = AdmissionGate(self.obs.metrics)
+        # commit-time cap over a txn's ENCODED mutation bytes
+        # (performance.txn-total-size-limit seeds it; 0 disables) —
+        # enforced in commit() with ER_TXN_TOO_LARGE
+        self.txn_total_size_limit = 100 * 1024 * 1024
         # bounded time-series of counter/gauge samples feeding
         # information_schema.metrics_summary + /debug/metrics/history.
         # The background thread starts with the serving Server (embedded
@@ -245,7 +260,7 @@ class Storage:
         # pair (one replace unlinks the tmp the other is about to
         # rename — ENOENT), a race the group-commit throughput made
         # routine instead of theoretical
-        self._lease_lock = threading.Lock()
+        self._lease_lock = lockcheck.lock("Storage._lease_lock")
         if path is not None:
             os.makedirs(os.path.join(path, "epochs"), exist_ok=True)
             self._tso_lease = self._read_tso_lease()
@@ -342,7 +357,8 @@ class Storage:
         self.user_locks = UserLocks()
         # viewer-sensitive information_schema refresh+scan exclusion
         # (session._refresh_infoschema holds this for the statement)
-        self.infoschema_lock = threading.RLock()
+        self.infoschema_lock = lockcheck.rlock(
+            "Storage.infoschema_lock", hot=True)
         # DDL job queue + history (the meta-KV DDLJobList analog,
         # reference meta/meta.go:571) — lives on storage so a replacement
         # worker resumes pending jobs with their reorg checkpoints
@@ -362,7 +378,8 @@ class Storage:
             from ..owner import owner_manager
             self.ddl_owner = owner_manager(path, "ddl")
             self.gc_owner = owner_manager(path, "gc")
-        self._commit_lock = threading.RLock()
+        self._commit_lock = lockcheck.rlock(
+            "Storage._commit_lock", hot=True)
         # cross-commit group fsync telemetry throttle (the batch-size
         # histogram records every batch; the event ring gets at most
         # one group_commit note per window with cumulative counts).
@@ -1394,6 +1411,22 @@ class Storage:
         from ..kv.mvcc import OP_LOCK
         for key in sorted((txn.locked_keys | txn.guard_keys) - written):
             kv_muts.append(Mutation(OP_LOCK, key))
+        # performance.txn-total-size-limit over the ENCODED bytes —
+        # measured here (post-encode, pre-prewrite) so the limit means
+        # what hits the region tier, and an oversized txn fails before
+        # prewriting a single lock
+        limit = self.txn_total_size_limit
+        if limit > 0:
+            total = sum(len(m.key) + len(m.value) for m in kv_muts)
+            if total > limit:
+                # clear pessimistic locks/guards already written to the
+                # KV (same courtesy as every failed-commit sibling path)
+                # — an orphaned OP_LOCK would stall writers on those
+                # rows for the full lock TTL
+                self._best_effort_rollback(kv_muts, txn.start_ts)
+                raise TxnTooLargeError(
+                    f"Transaction is too large, size: {total} "
+                    f"(txn-total-size-limit: {limit})")
         try:
             state = self.committer.prewrite_phase(kv_muts, txn.start_ts)
         except KVWriteConflict as e:

@@ -44,6 +44,34 @@ _lock = threading.Lock()
 _active: dict[str, Any] = {}
 _hits: dict[str, int] = {}
 
+# The declared registry: every inject() site in tidb_tpu/ names one of
+# these, and every name a test arms (context manager, enable(), or a
+# TIDB_TPU_FAILPOINTS env spec) must exist here — an armed point whose
+# inject() site was renamed away silently never fires, which is how
+# chaos coverage rots. The failpoint-registry analysis rule enforces
+# both directions statically (tests/test_analysis.py runs it tier-1).
+DECLARED = frozenset({
+    "daemon/before-gc",            # store/daemon.py GC tick
+    "ddl/before-step",             # ddl/ddl.py job-step boundary
+    "diag/peer-down",              # rpc/diag.py fan-out peer failure
+    "diag/slow-peer",              # rpc/diag.py fan-out latency
+    "governor/mem-pressure",       # util/governor.py synthetic RSS
+    "kv/group-fsync",              # kv/mvcc.py pre-fsync crash site
+    "kv/wal-torn-append",          # kv/mvcc.py torn WAL record
+    "mesh/skew",                   # copr/mesh.py synthetic shard skew
+    "replica/apply-stall",         # rpc/apply.py frozen apply loop
+    "rpc/conn-drop",               # rpc/client.py transport chaos
+    "rpc/delay",
+    "rpc/partial-write",
+    "rpc/stale-response",
+    "storage/before-fold",         # store/storage.py commit fold
+    "storage/mid-checkpoint",      # store/storage.py checkpoint crash
+    "twopc/after-prewrite",        # kv/twopc.py percolator phases
+    "twopc/after-primary-commit",
+    "twopc/before-commit-primary",
+    "twopc/before-prewrite",
+})
+
 
 def enable(name: str, value: Any = True) -> None:
     with _lock:
@@ -183,5 +211,6 @@ def arm_from_env(spec: Optional[str] = None) -> list[str]:
 arm_from_env()
 
 
-__all__ = ["enable", "disable", "disable_all", "is_enabled", "inject",
-           "hits", "snapshot", "failpoint", "arm_from_env"]
+__all__ = ["DECLARED", "enable", "disable", "disable_all",
+           "is_enabled", "inject", "hits", "snapshot", "failpoint",
+           "arm_from_env"]
