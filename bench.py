@@ -499,6 +499,136 @@ def time_q10(res: dict, session, jdata, label: str, repeat: int):
     return rps
 
 
+def _years_of(days: np.ndarray) -> np.ndarray:
+    return days.astype("datetime64[D]").astype(
+        "datetime64[Y]").astype(np.int64) + 1970
+
+
+def _keymap(keys: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    out = np.full(int(keys.max()) + 1, -1, np.int64)
+    out[keys] = vals
+    return out
+
+
+def q7_oracle(jdata):
+    """Exact (supp_nation, cust_nation, year, revenue_unscaled) rows for
+    TPC-H Q7 (FRANCE/GERMANY, 1995-1996)."""
+    from tidb_tpu.types.value import parse_date
+
+    nvocab, ncodes = jdata["nation"]["n_name"]
+    name_of = _keymap(jdata["nation"]["n_nationkey"], np.asarray(ncodes))
+    fr, ge = list(nvocab).index("FRANCE"), list(nvocab).index("GERMANY")
+    s_nat = _keymap(jdata["supplier"]["s_suppkey"],
+                    jdata["supplier"]["s_nationkey"])
+    c_nat = _keymap(jdata["customer"]["c_custkey"],
+                    jdata["customer"]["c_nationkey"])
+    o_cust = _keymap(jdata["orders"]["o_orderkey"],
+                     jdata["orders"]["o_custkey"])
+    li = jdata["lineitem"]
+    d1, d2 = parse_date("1995-01-01"), parse_date("1996-12-31")
+    ship = li["l_shipdate"]
+    sn = name_of[s_nat[li["l_suppkey"]]]
+    cn = name_of[c_nat[o_cust[li["l_orderkey"]]]]
+    m = (ship >= d1) & (ship <= d2) & \
+        (((sn == fr) & (cn == ge)) | ((sn == ge) & (cn == fr)))
+    year = _years_of(ship[m])
+    vol = li["l_extendedprice"][m] * (100 - li["l_discount"][m])
+    key = (sn[m] * 2 + (cn[m] == fr)) * 8192 + year
+    uniq, inv = np.unique(key, return_inverse=True)
+    rev = np.zeros(len(uniq), np.int64)
+    np.add.at(rev, inv, vol)
+    out = set()
+    for k, r in zip(uniq, rev):
+        year = int(k % 8192)
+        sc = int(k // 8192) // 2
+        cc = fr if (k // 8192) % 2 else ge
+        out.add((nvocab[sc], nvocab[cc], year, int(r)))
+    return out
+
+
+def time_q7(res: dict, session, jdata, label: str, repeat: int):
+    """Digest-check + time TPC-H Q7 (the EXTRACT-year grouped
+    aggregation newly device-resident in round 14b); returns rows/s."""
+    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+
+    want = q7_oracle(jdata)
+    got = {(r[0], r[1], int(r[2]), r[3].unscaled)
+           for r in session.query(TPCH_QUERIES["q7"])}
+    assert got == want, f"q7 digest: {sorted(got)[:2]} vs " \
+                        f"{sorted(want)[:2]}"
+    ts = times(lambda: session.query(TPCH_QUERIES["q7"]), repeat)
+    note_attribution(res, label, session)
+    line, rps = report(label, ts, len(jdata["lineitem"]["l_orderkey"]))
+    res["lines"].append(line)
+    return rps
+
+
+def q8_oracle(jdata):
+    """Exact (o_year, mkt_share_unscaled) rows for TPC-H Q8 (AMERICA /
+    BRAZIL / ECONOMY ANODIZED STEEL), mkt_share via the engine's own
+    decimal division semantics (scale + div_precincrement)."""
+    from tidb_tpu.types.value import Decimal, parse_date
+
+    rvocab, rcodes = jdata["region"]["r_name"]
+    am = list(rvocab).index("AMERICA")
+    reg_ok = np.zeros(int(jdata["region"]["r_regionkey"].max()) + 1, bool)
+    reg_ok[jdata["region"]["r_regionkey"][np.asarray(rcodes) == am]] = True
+    nat = jdata["nation"]
+    nvocab, ncodes = nat["n_name"]
+    br = list(nvocab).index("BRAZIL")
+    nat_in_am = _keymap(nat["n_nationkey"],
+                        reg_ok[nat["n_regionkey"]].astype(np.int64))
+    name_of = _keymap(nat["n_nationkey"], np.asarray(ncodes))
+    pvocab, pcodes = jdata["part"]["p_type"]
+    steel = list(pvocab).index("ECONOMY ANODIZED STEEL")
+    p_ok = _keymap(jdata["part"]["p_partkey"],
+                   (np.asarray(pcodes) == steel).astype(np.int64))
+    s_nat = _keymap(jdata["supplier"]["s_suppkey"],
+                    jdata["supplier"]["s_nationkey"])
+    c_nat = _keymap(jdata["customer"]["c_custkey"],
+                    jdata["customer"]["c_nationkey"])
+    o = jdata["orders"]
+    d1, d2 = parse_date("1995-01-01"), parse_date("1996-12-31")
+    o_ok = (o["o_orderdate"] >= d1) & (o["o_orderdate"] <= d2)
+    o_cust = _keymap(o["o_orderkey"],
+                     np.where(o_ok, o["o_custkey"], -1))
+    o_year = _keymap(o["o_orderkey"], _years_of(o["o_orderdate"]))
+    li = jdata["lineitem"]
+    cust = o_cust[li["l_orderkey"]]
+    m = (p_ok[li["l_partkey"]] == 1) & (cust >= 0) & \
+        (nat_in_am[c_nat[np.maximum(cust, 0)]] == 1)
+    vol = li["l_extendedprice"][m] * (100 - li["l_discount"][m])
+    year = o_year[li["l_orderkey"]][m]
+    brazil = name_of[s_nat[li["l_suppkey"]]][m] == br
+    out = set()
+    for y in np.unique(year):
+        ym = year == y
+        den = int(vol[ym].sum())
+        num = int(vol[ym & brazil].sum())
+        # the engine's exact decimal `/` (npeval op "div"): scale 4
+        # operands -> scale 8 result, half away from zero
+        q, r = divmod(abs(num) * 10 ** 8, abs(den))
+        q += 2 * r >= abs(den)
+        out.add((int(y), -q if (num < 0) != (den < 0) else q))
+    return out
+
+
+def time_q8(res: dict, session, jdata, label: str, repeat: int):
+    """Digest-check + time TPC-H Q8; returns rows/s."""
+    from tidb_tpu.bench.tpch_queries import TPCH_QUERIES
+
+    want = q8_oracle(jdata)
+    got = {(int(r[0]), r[1].unscaled)
+           for r in session.query(TPCH_QUERIES["q8"])}
+    assert got == want, f"q8 digest: {sorted(got)[:2]} vs " \
+                        f"{sorted(want)[:2]}"
+    ts = times(lambda: session.query(TPCH_QUERIES["q8"]), repeat)
+    note_attribution(res, label, session)
+    line, rps = report(label, ts, len(jdata["lineitem"]["l_orderkey"]))
+    res["lines"].append(line)
+    return rps
+
+
 def q5_oracle(jdata):
     """Exact (nation, revenue_unscaled) rows for TPC-H Q5 (ASIA/1994)."""
     from tidb_tpu.types.value import parse_date
@@ -713,16 +843,22 @@ def flight_tpch(res: dict, big: bool) -> None:
     t0 = time.perf_counter()
     with _Heartbeat(f"tpch-q10-sf{q10_sf:g}-gen+load") as hb:
         jdata = generate_tpch(q10_sf, 17)
-        for t in ("part", "partsupp", "supplier", "region"):
-            jdata.pop(t, None)  # generated but unused: free before load
+        jdata.pop("partsupp", None)  # unused by q7/q8/q10: free first
         hb.rows = len(jdata["lineitem"]["l_orderkey"])
         js = Session()
-        for t in ("customer", "orders", "lineitem", "nation"):
+        for t in ("customer", "orders", "lineitem", "nation", "part",
+                  "supplier", "region"):
             load_table(js, t, jdata[t])
     log(f"q10 corpus sf{q10_sf:g}: gen+load="
         f"{time.perf_counter() - t0:.0f}s")
     res["values"]["q10_small"] = time_q10(
         res, js, jdata, f"q10_sf{q10_sf:g}", repeat)
+    # Q7/Q8 — the EXTRACT-year grouped aggregations newly
+    # device-resident in round 14b (ISSUE 14), on the same join corpus
+    res["values"]["q7_small"] = time_q7(
+        res, js, jdata, f"q7_sf{q10_sf:g}", repeat)
+    res["values"]["q8_small"] = time_q8(
+        res, js, jdata, f"q8_sf{q10_sf:g}", repeat)
 
 
 def flight_joins(res: dict) -> None:
@@ -958,10 +1094,10 @@ def flight_multichip(res: dict) -> None:
         "BENCH_MESH_Q10_SF", n / ROWS_PER_SF)), 10.0))
     with _Heartbeat(f"multichip-q10-sf{q10_sf:g}-gen+load") as hb:
         jdata = generate_tpch(q10_sf, 17)
-        for t in ("part", "partsupp", "supplier", "region"):
-            jdata.pop(t, None)  # generated but unused: free before load
+        jdata.pop("partsupp", None)  # unused by q7/q8/q10: free first
         hb.rows = len(jdata["lineitem"]["l_orderkey"])
-        for t in ("customer", "orders", "lineitem", "nation"):
+        for t in ("customer", "orders", "lineitem", "nation", "part",
+                  "supplier", "region"):
             load_table(single, t, jdata[t])
     jrows = len(jdata["lineitem"]["l_orderkey"])
     rps_s10 = time_q10(res, single, jdata, "multichip_q10_single", repeat)
@@ -977,6 +1113,25 @@ def flight_multichip(res: dict) -> None:
         f"multichip q10 ({jrows} lineitem rows): single-device "
         f"{rps_s10 / 1e6:.1f}M rows/s vs {n_dev}-device mesh "
         f"{rps_m10 / 1e6:.1f}M rows/s ({rps_m10 / max(rps_s10, 1):.2f}x)")
+    # Q7/Q8 — the round-14b grouped-aggregation conversions, sharded vs
+    # single on the same corpus (ISSUE 14's missing number)
+    for qname, timer in (("q7", time_q7), ("q8", time_q8)):
+        rps_s = timer(res, single, jdata,
+                      f"multichip_{qname}_single", repeat)
+        rps_m = timer(res, mesh, jdata,
+                      f"multichip_{qname}_mesh", repeat)
+        res["values"][f"{qname}_single_1dev"] = rps_s
+        res["values"][f"{qname}_mesh_{n_dev}dev"] = rps_m
+        om = mesh.last_op_mesh
+        mesh_info["queries"][qname] = {
+            "skew": round(max((v[1] for v in om.values()),
+                              default=0.0), 3),
+            "op_shares": {k: round(v[0], 4) for k, v in om.items()},
+        }
+        lines.append(
+            f"multichip {qname} ({jrows} lineitem rows): single-device "
+            f"{rps_s / 1e6:.1f}M rows/s vs {n_dev}-device mesh "
+            f"{rps_m / 1e6:.1f}M rows/s ({rps_m / max(rps_s, 1):.2f}x)")
     # dispatch ring taken LAST so the q10 dispatches are in the record
     mesh_info["dispatches"] = mesh.cop.recorder.snapshot()["dispatches"]
 

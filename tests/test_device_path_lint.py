@@ -1,12 +1,15 @@
-"""Fallback-reason lint for the snowflake benchmark shapes (ISSUE 9).
+"""Fallback-reason lint for the snowflake benchmark shapes (ISSUE 9 +
+ISSUE 14).
 
-Q3/Q5/Q10/Q12 are the queries the data-plane work targets: they must
-execute END-TO-END on the device fragment path — zero `host_fallback`
-stage time, every coprocessor read tagged `device...` — on the
-single-device client AND sharded on the 8-way mesh plane. A regression
-fails with the offending engine tag, whose embedded gate reason names
-the cause (e.g. `host(fragment:key-span)`), so the fix starts from the
-failure message instead of a bisect.
+Q3/Q5/Q10/Q12 are the queries the PR 9 data-plane work targeted; Q7/Q8
+joined with the ISSUE 14 grouped-aggregation work (EXTRACT-year group
+keys through the tightened YEAR bounds + the general sorted-run group
+path). All must execute END-TO-END on the device fragment path — zero
+`host_fallback` stage time, every coprocessor read tagged `device...` —
+on the single-device client AND sharded on the 8-way mesh plane. A
+regression fails with the offending engine tag, whose embedded gate
+reason names the cause (e.g. `host(fragment:group-space)`), so the fix
+starts from the failure message instead of a bisect.
 """
 
 import jax
@@ -18,7 +21,7 @@ from tidb_tpu.copr import mesh as M
 from tidb_tpu.copr.client import CopClient
 from tidb_tpu.session import Session
 
-QUERIES = ("q3", "q5", "q10", "q12")
+QUERIES = ("q3", "q5", "q10", "q12", "q7", "q8")
 
 
 @pytest.fixture(scope="module")
@@ -57,11 +60,33 @@ def _lint(session, qname: str, want_mesh: bool) -> None:
 
 def test_device_path_single_q3(sessions):
     # single-device spot check on the headline query; the mesh
-    # parametrization below covers all four shapes end-to-end (and is
+    # parametrization below covers all six shapes end-to-end (and is
     # the acceptance surface) — running both full sets doubles the
     # suite's compile bill for no added coverage
     single, _ = sessions
     _lint(single, "q3", want_mesh=False)
+
+
+@pytest.mark.parametrize("qname", ("q7", "q8"))
+def test_device_path_single_grouped(sessions, qname):
+    """ISSUE 14 acceptance: Q7/Q8 fully device-resident on the
+    single-device client too, and bit-identical to the forced-host
+    oracle (the mesh runs are linted by test_device_path_mesh)."""
+    import unittest.mock as mock
+
+    from tidb_tpu.copr import fragment as FR
+
+    single, _ = sessions
+    _lint(single, qname, want_mesh=False)
+    got = single.query(TPCH_QUERIES[qname])
+    host = Session(single.storage, cop=CopClient())
+
+    def deny(cop, frag, snaps):
+        raise FR._Fallback("forced-host")
+
+    with mock.patch.object(FR, "_device_fragment", deny):
+        want = host.query(TPCH_QUERIES[qname])
+    assert got == want, f"{qname}: device result differs from host oracle"
 
 
 @pytest.mark.parametrize("qname", QUERIES)

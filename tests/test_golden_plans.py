@@ -118,8 +118,43 @@ def _engines(session) -> str:
     return "\n".join(out)
 
 
+# ISSUE 14 ratchet: corpus-wide count of host(...) engine lines across
+# all 22 TPC-H queries. The grouped-aggregation + semi-join work drove
+# this to ZERO; any regression that re-introduces a host fallback (even
+# one the engines golden is re-recorded around) fails here explicitly.
+HOST_FALLBACK_BUDGET = 0
+
+
+def test_engines_golden_tags_declared():
+    """Every engine tag in the recorded corpus matches a declared
+    family, and every device[...] bracket mode is in the
+    DEVICE_FRAGMENT_MODES vocabulary — tooling that switches on tag
+    spellings (bench path lines, README matrix) never meets an
+    undeclared one."""
+    import re
+
+    from tidb_tpu.analysis import registry as reg
+
+    with open(ENGINES_GOLDEN) as f:
+        tags = [ln for ln in f.read().splitlines()
+                if ln and not ln.startswith("====")]
+    for tag in tags:
+        assert any(tag.startswith(fam)
+                   for fam in reg.ENGINE_TAG_FAMILIES), tag
+        m = re.match(r"device\[([^\]]+)\]", tag)
+        if m:
+            assert m.group(1) in reg.DEVICE_FRAGMENT_MODES, tag
+
+
 def test_tpch_engine_assignments(exec_session):
     got = _engines(exec_session)
+    n_host = got.count("host(")
+    assert n_host <= HOST_FALLBACK_BUDGET, (
+        f"{n_host} host(...) engine lines across the TPC-H corpus "
+        f"(budget {HOST_FALLBACK_BUDGET}) — a query left the device "
+        "path:\n" + "\n".join(
+            ln for ln in got.splitlines()
+            if ln.startswith("====") or "host(" in ln))
     if os.environ.get("RECORD_GOLDEN"):
         os.makedirs(os.path.dirname(ENGINES_GOLDEN), exist_ok=True)
         with open(ENGINES_GOLDEN, "w") as f:

@@ -156,17 +156,29 @@ def test_host_fallback_rule_reads_topsql():
 def test_registry_row_eval_rule_fires_after_fallback():
     """The de-vectorization satellite: a registry-fallback scalar
     function bumps tidb_registry_row_eval_total{func} and the rule
-    reports the per-row rows inside the history window."""
+    reports the per-row rows inside the history window. INSERT() is
+    still rowwise; SUBSTRING_INDEX / REGEXP_LIKE over dictionary
+    columns now evaluate per DISTINCT value (npeval._dict_vec_call)
+    and must NOT count as row evals."""
     st = Storage()
     s = Session(st)
     s.execute("create table rr (a int primary key, b varchar(16))")
     s.execute("insert into rr values (1,'a.b.c'),(2,'d.e.f')")
     st.metrics_history.sample_now()  # window baseline
-    base = obs.REGISTRY_ROW_EVALS.get(func="SUBSTRING_INDEX")
+    base_si = obs.REGISTRY_ROW_EVALS.get(func="SUBSTRING_INDEX")
+    base_rl = obs.REGISTRY_ROW_EVALS.get(func="REGEXP_LIKE")
     s.execute("select substring_index(b, '.', 1) from rr")
-    assert obs.REGISTRY_ROW_EVALS.get(func="SUBSTRING_INDEX") > base
+    s.execute("select a from rr where regexp_like(b, '^a')")
+    assert obs.REGISTRY_ROW_EVALS.get(
+        func="SUBSTRING_INDEX") == base_si, \
+        "SUBSTRING_INDEX over a dict column must dict-vectorize"
+    assert obs.REGISTRY_ROW_EVALS.get(func="REGEXP_LIKE") == base_rl, \
+        "REGEXP_LIKE over a dict column must dict-vectorize"
+    base = obs.REGISTRY_ROW_EVALS.get(func="INSERT")
+    s.execute("select insert(b, 1, 1, 'Z') from rr")
+    assert obs.REGISTRY_ROW_EVALS.get(func="INSERT") > base
     rows = _rows_for_rule(s, "registry-row-eval")
-    assert rows and 'func="SUBSTRING_INDEX"' in rows[0][1], rows
+    assert rows and 'func="INSERT"' in rows[0][1], rows
     assert int(rows[0][3]) >= 2
 
 

@@ -88,8 +88,11 @@ JOIN_QUERIES = [
     "order by t1.b, t2.c limit 500",
     "select count(*), sum(t1.b) from t1 join t2 on t1.a = t2.a",
     "select count(*) from t1 where t1.a not in (select a from t2)",
+    # the cross-table residual (t2.a < t1.b) keeps this EXISTS on the
+    # host hash-join path — plain equi semi joins now fuse into device
+    # fragments (ISSUE 14) and never build a host hash table to spill
     "select count(*) from t1 where exists "
-    "(select 1 from t2 where t2.a = t1.a)",
+    "(select 1 from t2 where t2.a = t1.a and t2.a < t1.b)",
 ]
 
 

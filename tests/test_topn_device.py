@@ -59,6 +59,19 @@ FAT_QUERIES = [
     "group by dg order by sum(w) desc, dg limit 7",
 ]
 
+AVG_FAT_QUERIES = [
+    # AVG items compare as the host's rounded decimal via base-4096
+    # long division of the exact digit sums (ISSUE 14 satellite)
+    "select dg, x, avg(v) a from f, dim where fg = dg "
+    "group by dg, x order by a desc, dg limit 6",
+    "select dg, x, avg(v) a from f, dim where fg = dg "
+    "group by dg, x order by a, dg desc limit 7",
+    # coarse averages tie heavily: later items + the exact boundary
+    # check must keep the cut bit-identical
+    "select dg, x, avg(w) a, sum(v) s from f, dim where fg = dg "
+    "group by dg, x order by a desc, s, dg limit 5",
+]
+
 
 def _bulk(session, name, ddl, cols, valids=None):
     session.execute(ddl)
@@ -113,7 +126,8 @@ def host_results(corpus):
     out = {}
     with mock.patch.object(CopClient, "_prepare_topn", deny_topn), \
             mock.patch.object(FR, "_device_fragment", deny_fragment):
-        for sql in SCAN_QUERIES + JOIN_QUERIES + FAT_QUERIES:
+        for sql in SCAN_QUERIES + JOIN_QUERIES + FAT_QUERIES \
+                + AVG_FAT_QUERIES:
             out[sql] = host.query(sql)
     return out
 
@@ -168,6 +182,65 @@ class TestBitIdenticalVsHost:
         # the tie-free queries must actually take the fused device cut
         eng = _engines(s, FAT_QUERIES[0])
         assert any("device[fat]" in e for e in eng), (mode, eng)
+
+    def test_fused_avg_topn(self, corpus, host_results, mode):
+        s = _mode_session(corpus, mode)
+        for sql in AVG_FAT_QUERIES:
+            assert s.query(sql) == host_results[sql], (mode, sql)
+        eng = _engines(s, AVG_FAT_QUERIES[0])
+        assert any("device[fat]" in e for e in eng), (mode, eng)
+
+
+@pytest.mark.parametrize("desc", [False, True])
+def test_avg_sort_keys_property(desc):
+    """avg_sort_keys orders candidates EXACTLY like the host's AVG
+    value (Decimal.div at arg scale + 4, half away from zero), NULLs
+    placed first-ASC / last-DESC, equal rationals and equal ROUNDED
+    values producing equal keys."""
+    import jax.numpy as jnp
+
+    from tidb_tpu.copr import topnpack as TP
+    from tidb_tpu.types.value import Decimal
+
+    rng = np.random.default_rng(11)
+    n = 512
+    sums = rng.integers(-(10 ** 13), 10 ** 13, n)
+    cnts = rng.integers(1, (1 << 18) - 1, n)
+    # small counts + tiny sums: rounding collisions and exact-equal
+    # rationals (6/4 == 3/2) must key identically
+    cnts[:16] = rng.integers(1, 5, 16)
+    sums[:16] = rng.integers(-8, 8, 16)
+    sums[0], cnts[0], sums[1], cnts[1] = 6, 4, 3, 2
+    sums[2] = sums[3] = 0
+    nulls = np.zeros(n, bool)
+    nulls[4:7] = True
+    # limb-pair layout of the sums (top limb signed, like sumexact)
+    L = 6
+    pairs = np.zeros((L, 2, n), np.int32)
+    x = sums.copy()
+    for i in range(L):
+        pairs[i, 1] = (x & 0xFFF) if i < L - 1 else x
+        x >>= 12
+    digs = TP.pair_digits([(0, jnp.asarray(pairs))])
+    keys = TP.avg_sort_keys(digs, jnp.asarray(cnts.astype(np.int32)),
+                            jnp.asarray(nulls), desc)
+    kmat = np.stack([np.asarray(k) for k in keys], axis=1)
+    # device rank = lexicographic rank of the key rows
+    _, dev_rank = np.unique(kmat, axis=0, return_inverse=True)
+    dev_rank = dev_rank.reshape(-1)
+    host_keys = []
+    for i in range(n):
+        if nulls[i]:
+            hk = (1, 0) if desc else (-1, 0)
+        else:
+            q = Decimal(int(sums[i]), 0).div(
+                Decimal.from_int(int(cnts[i]))).unscaled
+            hk = (0, -q if desc else q)
+        host_keys.append(hk)
+    uniq = sorted(set(host_keys))
+    host_rank = np.array([uniq.index(hk) for hk in host_keys])
+    assert np.array_equal(dev_rank, host_rank), \
+        np.nonzero(dev_rank != host_rank)[0][:10]
 
 
 def test_fat_boundary_tie_falls_back(corpus, host_results):

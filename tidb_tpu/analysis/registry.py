@@ -90,6 +90,25 @@ ENGINE_TAG_FAMILIES: tuple[str, ...] = (
     "replica@",    # follower read tier (rpc/replica.py)
 )
 
+# bracketed device fragment modes — the exact vocabulary inside
+# device[<mode>] / device[<mode>]@meshN tags (copr/fragment.py emode).
+# Tooling that switches on the bracket contents (bench.py path lines,
+# the golden engines corpus, the README coverage matrix) recognizes
+# exactly these; test_golden_plans lints the recorded corpus against
+# this enum so a new spelling must be declared here first.
+#   agg    dense-segment fused join+aggregation
+#   rows   fused joins returning a probe-row bitmask
+#   topn   fused join+topn (packed multi-key composite)
+#   hc     high-cardinality candidate path (plain, host re-ranks)
+#   fat    fused hc final cut (exact device ordering, k+1 rows out)
+#   group  all-groups sorted-run aggregation (dense gate rejected)
+#   +semi  suffix: semi/anti membership bitmap gates fused in
+DEVICE_FRAGMENT_MODES: tuple[str, ...] = (
+    "agg", "rows", "topn", "hc", "fat", "group",
+    "agg+semi", "rows+semi", "topn+semi", "hc+semi", "fat+semi",
+    "group+semi",
+)
+
 __all__ = ["HOT_LOCKS", "BLOCKING_CALLS", "BLOCKING_RECEIVER_ALLOW",
            "TLS_FRAME_FNS", "TLS_FRAME_CTX_ONLY", "THREAD_NAME_PREFIX",
-           "ENGINE_TAG_FAMILIES"]
+           "ENGINE_TAG_FAMILIES", "DEVICE_FRAGMENT_MODES"]

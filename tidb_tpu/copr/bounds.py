@@ -133,6 +133,21 @@ def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
                                             col_bounds))
         return out
     if op == "year":
+        # YEAR over a bounded date/datetime column narrows to the years
+        # its values span (monotone in the day number) — the static
+        # [0, 9999] span would push an EXTRACT(YEAR ...) group key past
+        # the dense-segment gate (TPC-H Q7/Q8 group by l_year/o_year)
+        a = sub(0)
+        ft = e.args[0].ftype
+        from ..types.field_type import TypeKind as _TK
+        if a is not None and ft.kind in (_TK.DATE, _TK.DATETIME,
+                                         _TK.TIMESTAMP):
+            lo, hi = a
+            if ft.kind in (_TK.DATETIME, _TK.TIMESTAMP):
+                lo //= 86_400_000_000  # micros -> days
+                hi //= 86_400_000_000
+            if -1_000_000 <= lo <= hi <= 3_000_000:  # civil range guard
+                return (_year_of_day(lo), _year_of_day(hi))
         return (0, 9999)
     if op == "month":
         return (0, 12)
@@ -175,6 +190,18 @@ def expr_bounds(e: PlanExpr, col_bounds: list[Bound]) -> Bound:
             return a
         return None
     return None
+
+
+def _year_of_day(z: int) -> int:
+    """days-since-epoch -> civil year (host twin of eval._civil_from_days)."""
+    z = int(z) + 719468
+    era = (z if z >= 0 else z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    return y + (1 if mp >= 10 else 0)
 
 
 def _branch_bound(arg: PlanExpr, out_t, col_bounds: list[Bound]) -> Bound:

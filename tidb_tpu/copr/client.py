@@ -306,6 +306,11 @@ class CopClient:
             with obs.stage("prepare", span_name="copr.prepare"):
                 prepared, fallback = self._prepare(dag, snap)
             if fallback is not None:
+                r = self._try_group_fragment(dag, snap, fallback)
+                if r is not None:
+                    if sp:
+                        sp.note = r.engine
+                    return r
                 obs.COPR_REQUESTS.inc(engine="host")
                 with obs.stage("host_fallback",
                                span_name="copr.host_fallback") as hsp:
@@ -332,6 +337,40 @@ class CopClient:
                 chunks = [self._empty_chunk(dag, snap)]
             return CopResult(chunks, is_partial_agg=dag.agg is not None,
                              engine=self._device_engine())
+
+    def _try_group_fragment(self, dag: CopDAG, snap: TableSnapshot,
+                            reason: str) -> Optional[CopResult]:
+        """Single-table GROUP BY rejected by the dense-segment gate:
+        retry as a degenerate one-table fragment on the sorted-run
+        all-groups path (copr/fragment.py mode "group" — sort by the
+        packed group keys + segment-reduce, cap-checked candidate
+        buffer) before conceding the host. Returns None when the shape
+        is ineligible or the fragment path also gates out, and the
+        caller proceeds to the original host fallback."""
+        if dag.agg is None or dag.topn is not None or \
+                dag.limit is not None:
+            return None
+        if not (reason.startswith("group keys not dense-encodable")
+                or "min/max or float aggregates" in reason):
+            return None
+        from ..plan.dag import agg_partial_width
+        if any(agg_partial_width(d) != 2 for d in dag.agg.aggs):
+            return None  # hll sketches don't flow through fragments
+        from . import fragment as FR
+        frag = FR.lift_group_dag(dag, snap)
+        if frag is None:
+            return None
+        try:
+            with obs.span("copr.fragment") as fsp:
+                if fsp:
+                    fsp.note = "group-lift"
+                r = FR._device_fragment(
+                    self, frag, {frag.tables[0].table.id: snap})
+            obs.COPR_REQUESTS.inc(engine="device-fragment")
+            return r
+        except (FR._Fallback, CompileError,
+                jax.errors.JaxRuntimeError):
+            return None
 
     # ==================== preparation (host-side resolution) ================
     def _col_stats(self, snap: TableSnapshot, off: int) -> Bound:

@@ -159,6 +159,30 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         spans.append((lo, span))
         prepared["__sig__"].append(("join", j.build, lo, span))
 
+    # semi/anti membership edges: probe key must compute on device; the
+    # build side only needs a bounded integer key span (the bitmap is
+    # built host-side, so build filters never face device gates)
+    semi_spans = []
+    for si, sm in enumerate(frag.semis):
+        snap = snaps[sm.table.table.id]
+        if len(snap.overlay_handles) > 0:
+            raise _Fallback("build-overlay")
+        cop._evict_stale(sm.table.table.id, snap.epoch.epoch_id)
+        cop._prepare_expr(sm.probe_key, comb_dicts, prepared)
+        if not expr_device_safe(sm.probe_key, comb_bounds):
+            raise _Fallback("key-width")
+        kb = cop._col_stats(
+            snap, sm.table.col_offsets[sm.build_key_local])
+        pb = expr_bounds(sm.probe_key, comb_bounds)
+        if kb is None or pb is None or not fits_int32(pb):
+            raise _Fallback("key-width")
+        lo, span = kb[0], kb[1] - kb[0] + 1
+        if span > FRAG_SPAN_CAP:
+            raise _Fallback("key-span")
+        semi_spans.append((lo, span))
+    prepared["__semi_spans__"] = semi_spans
+    prepared["__n_semis__"] = len(frag.semis)
+
     mode = "agg" if frag.agg is not None else "rows"
 
     if mode == "rows" and frag.topn is not None:
@@ -218,14 +242,21 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         err = cop._prepare_agg(facade, comb_dicts, comb_bounds, prepared,
                                n_rows)
         if err is not None:
-            # dense segment space rejected; a TopN consumer admits the
-            # high-cardinality sorted-run candidate path (copr/hcagg.py);
-            # a HAVING consumer admits the rank-space filtered path
-            if (frag.hc is None and not frag.having) or \
-                    len(psnap.overlay_handles) > 0 or \
+            # dense segment space rejected; the sorted-run candidate
+            # machinery (copr/hcagg.py) covers the rest: a TopN consumer
+            # takes the top-k candidate path, a HAVING consumer the
+            # filtered path, and ANY other consumer the all-groups
+            # "group" mode — sort + segment-reduce with a cap-checked
+            # candidate buffer, so an arbitrary multi-key GROUP BY stays
+            # on device whenever its group count fits the buffer
+            if len(psnap.overlay_handles) > 0 or \
                     not _prepare_hc(frag, comb_bounds, prepared, n_rows):
                 raise _Fallback("group-space")
             mode = "hc"
+            if frag.hc is None and not frag.having:
+                prepared["__hc_all__"] = True
+                prepared["__sig__"].append(
+                    ("hcall", FragmentDAG.HAVING_CAP))
 
     if mode == "hc" and not getattr(cop, "supports_hc", True):
         # a client with neither single-device hc nor a group exchange
@@ -240,7 +271,9 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         # zeros. Exchanges (group hash or partitioned join) re-order rows
         # across devices, so the path is single-device only.
         segcols = prepared.get("__hc_segcols__")
-        if segcols is not None and part_ji is None and \
+        has_mm = any(s["kind"] in ("min", "max")
+                     for s in prepared["__hc_sched__"])
+        if segcols is not None and part_ji is None and not has_mm and \
                 getattr(cop, "frag_axis", None) is None and \
                 cop._runs_ordered(psnap, segcols):
             prepared["__hc_runordered__"] = True
@@ -259,10 +292,9 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                     prepared["__sig__"].append(
                         ("rankseg", meta["nd"], meta["maxd"],
                          meta["n0"], meta["identity"]))
-        if frag.hc is None and prepared.get("__rank_meta__") is None:
-            # the HAVING-filtered path exists only in rank space — there
-            # is no sorted-run equivalent (no top-k bound to verify)
-            raise _Fallback("having-unordered")
+        # hc None (HAVING-filtered or all-groups "group" mode) runs in
+        # rank space when the epoch is run-ordered, else through the
+        # sorted-run body's gate-scored candidate buffer
 
     if mode == "hc" and frag.hc is not None and frag.hc.items:
         # join+agg+topn fused final cut: every ORDER BY item resolved to
@@ -280,6 +312,19 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                         TP.count_pairs(entry) > TP.MAX_DIGIT_PAIRS:
                     fused = False
                     break
+                d_ = frag.agg.aggs[idx]
+                if d_.func == "avg":
+                    # AVG compares as the host's ROUNDED decimal
+                    # (arg scale + div_precincrement); the long
+                    # division is int32-exact only under the count cap
+                    at_ = d_.arg.ftype
+                    ot_ = d_.ftype
+                    src_sc = at_.scale if at_.is_decimal else 0
+                    out_sc = ot_.scale if ot_.is_decimal else 0
+                    if ot_.is_float or out_sc != src_sc + 4 or \
+                            n_rows >= TP.AVG_CNT_CAP:
+                        fused = False
+                        break
             else:
                 g = frag.agg.group_by[idx]
                 if g.ftype.is_string and (
@@ -326,6 +371,20 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                 perm, key=(snap.epoch.epoch_id, "perm-rep", key_off, lo,
                            span, _mask_digest_of(host_mask)))
             builds.append({"cols": cols, "vis": vis, "perm": perm})
+        # membership bitmaps ride BEHIND the join builds in the same
+        # kernel-argument list (replicated on the mesh); their host-side
+        # (has_null, empty) facts bake into the kernel signature
+        semi_flags = []
+        for si, sm in enumerate(frag.semis):
+            snap = snaps[sm.table.table.id]
+            lo, span = semi_spans[si]
+            entry = _stage_semi_bitmap(cop, sm, snap, lo, span)
+            prepared["__sig__"].append(
+                ("semi", si, sm.kind, lo, span,
+                 entry["has_null"], entry["empty"]))
+            semi_flags.append((entry["has_null"], entry["empty"]))
+            builds.append({"bm": entry["bm"]})  # arrays only: jit args
+        prepared["__semi_flags__"] = semi_flags
 
     chunks: list[Chunk] = []
     if psnap.epoch.num_rows > 0:
@@ -338,7 +397,10 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
                                       builds, overlay=True, mode=mode))
     if not chunks:
         chunks = [_empty_chunk(frag, comb_dicts)]
-    emode = "fat" if prepared.get("__hc_fused__") else mode
+    emode = "fat" if prepared.get("__hc_fused__") else (
+        "group" if prepared.get("__hc_all__") else mode)
+    if getattr(frag, "semis", None):
+        emode = f"{emode}+semi"
     return CopResult(chunks, is_partial_agg=frag.agg is not None,
                      engine=cop._frag_engine(emode))
 
@@ -346,6 +408,29 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
 def _mask_digest_of(mask):
     from .client import _mask_digest
     return _mask_digest(mask)
+
+
+def lift_group_dag(dag, snap) -> Optional[FragmentDAG]:
+    """Degenerate one-table FragmentDAG for a pushed-down CopDAG agg
+    whose dense segment space failed (client._try_group_fragment): same
+    scan columns / filters / aggregation, partial layout unchanged, so
+    the all-groups sorted-run path can serve it."""
+    from ..plan.fragment import FragTable
+    table = getattr(snap.store, "table", None)
+    if table is None:
+        return None
+    by_off = {c.offset: c.ftype for c in table.columns}
+    try:
+        col_types = [by_off[off] for off in dag.scan.col_offsets]
+    except KeyError:
+        return None
+    t = FragTable(table, list(dag.scan.col_offsets),
+                  list(dag.selection.conditions) if dag.selection else [],
+                  col_types)
+    frag = FragmentDAG([t], [])
+    frag.agg = dag.agg
+    frag.output_types = list(dag.output_types)
+    return frag
 
 
 def _facade_dag(t):
@@ -396,6 +481,73 @@ def _perm_array(cop, snap, key_off: int, lo: int, span: int,
     return dev
 
 
+def _semi_build_facts(bcols, dicts, t, key_local: int,
+                      keep0: np.ndarray):
+    """NULL-aware membership facts of a semi/anti BUILD side, shared by
+    the device bitmap staging and the host interpreter (one definition
+    of the set semantics, so the bit-identical guarantee can't drift):
+    over the given (data, valid) column pairs and the initial row mask
+    `keep0` (visibility on the device path, all-rows on the host path),
+    returns (keep, has_null, key_data, ok) where `keep` marks
+    filter-passing rows (the SET — NULL-keyed members included),
+    `has_null` whether the set contains a NULL key, and `ok` the
+    valid-key member rows."""
+    n = len(keep0)
+    keep = keep0.copy()
+    if t.filters and n:
+        ev = NumpyEval([(d, np.ones(n, bool) if v is None else v)
+                        for d, v in bcols], dicts, n)
+        for c in t.filters:
+            fv, fvl = ev.eval(c)
+            keep &= _truthy(np.asarray(fv)) & fvl
+    kd, kv = bcols[key_local]
+    has_null = bool(np.any(keep & ~kv)) if kv is not None else False
+    ok = keep if kv is None else (keep & kv)
+    return keep, has_null, kd, ok
+
+
+def _stage_semi_bitmap(cop, sm, snap, lo: int, span: int) -> dict:
+    """Device-resident membership bitmap for a semi/anti edge: bit
+    [key - lo] set iff some visible, filter-passing build row carries
+    that key. Built host-side (numpy — build filters never face device
+    gates) and cached per (epoch, visibility, filter set) like perm
+    tables; NULL-key facts for the NULL-aware NOT IN form ride along as
+    host constants."""
+    from .client import _mask_digest
+    t = sm.table
+    key_off = t.col_offsets[sm.build_key_local]
+    fsig = repr(t.filters)
+    ck = (snap.epoch.epoch_id, "semibm", key_off, lo, span,
+          _mask_digest(snap.base_visible), hash(fsig))
+    with cop._lock:
+        hit = cop._col_cache.get(ck)
+        cacheable = cop._live_epochs.get(t.table.id) \
+            == snap.epoch.epoch_id
+    if hit is not None:
+        return hit
+    bcols = [(snap.epoch.columns[off], snap.epoch.valids[off])
+             for off in t.col_offsets]
+    keep, has_null, kd, ok = _semi_build_facts(
+        bcols, [snap.dictionaries[off] for off in t.col_offsets],
+        t, sm.build_key_local, snap.base_visible)
+    idx = np.nonzero(ok)[0]
+    bm = np.zeros(span, dtype=bool)
+    if len(idx):
+        bm[kd[idx].astype(np.int64) - lo] = True
+    dev = cop._place_build_array(
+        jnp.asarray(bm), key=(snap.epoch.epoch_id, "semibm-rep", key_off,
+                              lo, span, _mask_digest(snap.base_visible),
+                              hash(fsig)))
+    from .client import _note_transfer
+    _note_transfer(dev)
+    entry = {"bm": dev, "has_null": has_null,
+             "empty": not bool(keep.any())}
+    if cacheable:
+        with cop._lock:
+            cop._col_cache[ck] = entry
+    return entry
+
+
 def _mode_op(frag, mode: str) -> str:
     """The fused kernel's operator label for the attribution plane:
     one device program covers the whole tree, so the label names the
@@ -441,14 +593,15 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
     # the first query against an epoch pays the gathers once; every
     # later fragment query over the same epochs is pure elementwise
     # + MXU work
+    jb, sb = builds[:len(frag.joins)], builds[len(frag.joins):]
     kern_builds = builds
-    if builds and not overlay and \
+    if jb and not overlay and \
             getattr(cop, "frag_axis", None) is None and \
             prepared.get("__part_join__") is None:
         with obs.operator("join"), \
                 obs.stage("staging", span_name="copr.staging"):
             kern_builds = _stage_aligned(cop, frag, snaps, prepared,
-                                         spans, builds, pcols)
+                                         spans, jb, pcols) + sb
 
     aux = None
     if mode == "hc" and not overlay and \
@@ -459,6 +612,7 @@ def _run_frag_batch(cop, frag, snaps, prepared, spans, builds, overlay,
            tuple(
                ("part", b["present"].shape[0]) if "bykey" in b
                else ("al", b["found"].shape[0]) if "acols" in b
+               else ("bm", b["bm"].shape[0]) if "bm" in b
                else b["cols"][0][0].shape[0]
                for b in kern_builds))
     kern = cop._kernel(key, lambda: cop._frag_jit(
@@ -508,17 +662,19 @@ def _run_frag_tiled(cop, frag, snaps, prepared, spans, builds, mode):
     kern = None
     devs = []
     kop = _mode_op(frag, mode)
+    jb_t, sb_t = builds[:len(frag.joins)], builds[len(frag.joins):]
     for ti, (cols, vis, cnt) in enumerate(tiles):
         kb = builds
-        if builds:
+        if jb_t:
             with obs.operator("join"), \
                     obs.stage("staging", span_name="copr.staging"):
                 kb = _stage_aligned(cop, frag, snaps, prepared, spans,
-                                    builds, cols, tag=("tile", ti))
+                                    jb_t, cols, tag=("tile", ti)) + sb_t
         if kern is None:
             key = ("frag", _frag_key(frag), _sig(prepared), mode, bucket,
                    tuple(
                        ("al", b["found"].shape[0]) if "acols" in b
+                       else ("bm", b["bm"].shape[0]) if "bm" in b
                        else b["cols"][0][0].shape[0]
                        for b in kb))
             kern = cop._kernel(key, lambda: cop._frag_jit(
@@ -836,9 +992,27 @@ def _prepare_hc(frag, comb_bounds, prepared, n_rows) -> bool:
                    for g in groups]
     prepared["__hc_segpack__"] = segpack
     sched: list[dict] = []
+    n_minmax = 0
     for d in frag.agg.aggs:
         if d.arg is None or d.func == "count":
             sched.append({"kind": "count"})
+            continue
+        if d.func in ("min", "max"):
+            # min/max by the sort itself: the value rides as one extra
+            # ascending sort operand (complemented for max) appended
+            # after the segment keys, so each segment's FIRST row holds
+            # its min/max — one such operand per sort, hence one
+            # min/max aggregate per fragment
+            n_minmax += 1
+            if n_minmax > 1 or d.arg.ftype.is_float or \
+                    not expr_device_safe(d.arg, comb_bounds):
+                return False
+            vb = expr_bounds(d.arg, comb_bounds)
+            # I32_MAX is the NULL/dropped sentinel in the encoded
+            # operand (for max the complement -1-v must also clear it)
+            if vb is None or vb[0] <= -(2**31) + 2 or vb[1] >= 2**31 - 2:
+                return False
+            sched.append({"kind": d.func})
             continue
         if d.func not in ("sum", "avg") or d.arg.ftype.is_float:
             return False
@@ -934,6 +1108,8 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
         part_span = spans[part_ji][1]
         part_n_dev = cop.mesh.devices.size
         part_per_dev = -(-part_span // part_n_dev)
+    semi_spans = prepared.get("__semi_spans__", ())
+    semi_flags = prepared.get("__semi_flags__", ())
 
     def kernel(pcols, pvis, builds, aux=None):
         cols = widen32(list(pcols))
@@ -998,6 +1174,29 @@ def _build_frag_kernel(frag, prepared, spans, mode, raw=False, cop=None):
             for (d, v) in bcols:
                 cols.append((d[gidx], v[gidx] & found))
             mask = mask & found
+        # semi/anti membership gates: bitmap lookups over the combined
+        # columns (applied after every gather so keys from build tables
+        # work), NULL-aware for the NOT IN (ANTI_NULL) form
+        for si, sm in enumerate(frag.semis):
+            b = builds[len(frag.joins) + si]
+            lo_s, span_s = semi_spans[si]
+            has_null, empty = semi_flags[si]
+            if sm.kind == "ANTI_NULL" and empty:
+                continue  # NOT IN (empty set) keeps every row
+            if sm.kind == "ANTI_NULL" and has_null:
+                # any NULL in the subquery side: no row qualifies
+                mask = mask & jnp.zeros_like(mask)
+                continue
+            kv_s, kvl_s = eval_expr(sm.probe_key, cols, prepared)
+            ks = kv_s.astype(jnp.int32) - jnp.int32(lo_s)
+            inr = (ks >= 0) & (ks < span_s)
+            hit = b["bm"][jnp.clip(ks, 0, span_s - 1)] & inr & kvl_s
+            if sm.kind == "SEMI":
+                mask = mask & hit
+            elif sm.kind == "ANTI":
+                mask = mask & ~hit  # NULL probe key never matches: kept
+            else:  # ANTI_NULL, null-free set: NULL probe key filtered
+                mask = mask & kvl_s & ~hit
         if sel:
             mask = selection_mask(sel, cols, prepared, mask)
         if mode == "agg":
@@ -1110,7 +1309,14 @@ def _maybe_fused_cut(frag, prepared, res):
                         for ti, (_t, sh, _L) in enumerate(s_["terms"])]
             cntp = res[f"cnt{idx}"]
             cnt = cntp[0, 0] * jnp.int32(4096) + cntp[0, 1]
-            isnull = cnt == 0  # SUM over no valid rows is NULL
+            isnull = cnt == 0  # SUM/AVG over no valid rows is NULL
+        if s_["kind"] != "count" and \
+                frag.agg.aggs[idx].func == "avg":
+            # exact rounded-decimal AVG ordering (gated on the count
+            # cap + scale shape by the fused-eligibility check)
+            keys.extend(TP.avg_sort_keys(
+                TP.pair_digits(contribs), cnt, isnull, desc))
+            continue
         dks = TP.digit_sort_keys(TP.pair_digits(contribs), desc)
         if isnull is not None:
             # the signed head is carry-bounded well inside int32, so the
@@ -1209,7 +1415,7 @@ def _hc_rank_body(frag, prepared, cols, mask, aux):
         # predicate (f32 relative error margin) — completeness is what
         # matters; the host Selection above re-applies it exactly
         pass_m = gate
-        for (ai, op, thr) in frag.having:
+        for (ai, op, thr) in (frag.having or ()):
             sv, _cnt = agg_f32(ai)
             eps = jnp.abs(sv) * jnp.float32(2.0 ** -18) + jnp.float32(2.0)
             thr_f = jnp.float32(thr)
@@ -1309,6 +1515,22 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
             v = v.astype(jnp.int32)
         encs.append(jnp.where(vl, v.astype(jnp.int32),
                               jnp.int32(nulls[gi])))
+
+    # min/max rides the sort: one extra ascending operand (complement
+    # for max) after the segment keys, so each segment's first row holds
+    # the aggregate; NULL/dropped rows take the I32_MAX sentinel and
+    # sort last within their segment (gated by cnt at decode)
+    mm_ai = next((ai for ai, s_ in enumerate(sched)
+                  if s_["kind"] in ("min", "max")), None)
+    mm_enc = None
+    if mm_ai is not None:
+        assert not runord  # _device_fragment forces the sort path
+        d_mm = agg.aggs[mm_ai]
+        mv, mvl = eval_expr(d_mm.arg, cols, prepared)
+        mv32 = mv.astype(jnp.int32)
+        if sched[mm_ai]["kind"] == "max":
+            mv32 = jnp.int32(-1) - mv32  # order-reversing, wrap-free
+        mm_enc = jnp.where(mask & mvl, mv32, HC._I32_MAX)
     if runord:
         # storage order already groups the segment keys: boundaries are
         # raw key-change points (of the PROBE columns — a substituted
@@ -1342,9 +1564,12 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
             if pos == 0:
                 k = jnp.where(mask, k, HC._I32_MAX)
             sort_keys.append(k)
+        n_seg_ops = len(sort_keys)
+        if mm_enc is not None:
+            sort_keys.append(mm_enc)
         sk, perm = HC.sort_by_keys(sort_keys)
         valid = sk[0] != HC._I32_MAX
-        is_start, end_idx = HC.segment_bounds(sk, valid)
+        is_start, end_idx = HC.segment_bounds(sk[:n_seg_ops], valid)
     iota = jnp.arange(n, dtype=jnp.int32)
 
     def P(x):
@@ -1383,6 +1608,8 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
         _, vl = eval_expr(d.arg, cols, prepared)
         contrib = mask & vl
         out[f"hc_cnt{ai}"] = pair_stack(contrib.astype(jnp.int32), 1)
+        if s["kind"] in ("min", "max"):
+            continue  # value comes from the sorted mm operand below
         for ti, (t, shift, L) in enumerate(s["terms"]):
             tv, _ = eval_expr(t, cols, prepared)
             tv32 = jnp.where(contrib, tv.astype(jnp.int32), 0)
@@ -1400,42 +1627,75 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
         gate = is_start & valid
 
     # ---- candidate selection by (approximate) primary sort score ----
-    kind, idx = hc.score
-    if kind == "group":
-        sv = P(encs[idx]).astype(jnp.float32)
-        score_null = P(encs[idx]) == nulls[idx]
+    if hc is None:
+        # all-groups "group" mode / HAVING over an unordered epoch:
+        # every surviving group is a candidate (score 1.0), HAVING
+        # predicates filter with a safe f32 widening (completeness is
+        # what matters — the host Selection above re-applies them
+        # exactly), and the decode verifies the candidate buffer was
+        # not exhausted so no group was silently dropped
+        pass_m = gate
+        for (ai, op, thr) in (frag.having or ()):
+            if sched[ai]["kind"] == "count":
+                sv_h = pairs_to_f32(out[f"hc_cnt{ai}"])
+            else:
+                sv_h = jnp.zeros(n, jnp.float32)
+                for ti, (t, shift, L) in enumerate(sched[ai]["terms"]):
+                    sv_h = sv_h + pairs_to_f32(out[f"hc_s{ai}_{ti}"]) * \
+                        float(1 << shift)
+            eps = jnp.abs(sv_h) * jnp.float32(2.0 ** -18) + jnp.float32(2.0)
+            thr_f = jnp.float32(thr)
+            if op == "gt":
+                ok = sv_h > thr_f - eps
+            elif op == "ge":
+                ok = sv_h >= thr_f - eps
+            elif op == "lt":
+                ok = sv_h < thr_f + eps
+            else:
+                ok = sv_h <= thr_f + eps
+            pass_m = pass_m & ok
+        score = jnp.where(pass_m, 1.0, -jnp.inf)
+        k_cap = min(FragmentDAG.HAVING_CAP, n)
     else:
-        d = agg.aggs[idx]
-        if sched[idx]["kind"] == "count":
-            sv = pairs_to_f32(out[f"hc_cnt{idx}"])
-            score_null = jnp.zeros(n, bool)  # COUNT is never NULL
+        kind, idx = hc.score
+        if kind == "group":
+            sv = P(encs[idx]).astype(jnp.float32)
+            score_null = P(encs[idx]) == nulls[idx]
         else:
-            sv = jnp.zeros(n, jnp.float32)
-            for ti, (t, shift, L) in enumerate(sched[idx]["terms"]):
-                sv = sv + pairs_to_f32(out[f"hc_s{idx}_{ti}"]) * \
-                    float(1 << shift)
-            cnt = pairs_to_f32(out[f"hc_cnt{idx}"])
-            if d.func == "avg":
-                sv = sv / jnp.maximum(cnt, 1.0)
-            score_null = cnt == 0  # SUM/AVG over no valid rows is NULL
-    signed = sv if hc.desc else -sv
-    # MySQL NULL ordering: first in ASC, last in DESC. ASC -> +inf makes
-    # the NULL group a guaranteed candidate. DESC uses a FINITE floor
-    # (below any real sum, which is bounded by int64) so NULL groups still
-    # outrank non-start rows (-inf): group starts then always win the
-    # candidate slots, making "not all slots picked" a sound proof that
-    # every group is a candidate. Ties among several NULL groups at the
-    # floor are caught by the decode's strict-gap boundary check.
-    signed = jnp.where(score_null,
-                       jnp.float32(-1e38 if hc.desc else np.inf), signed)
-    score = jnp.where(gate, signed, -jnp.inf)
+            d = agg.aggs[idx]
+            if sched[idx]["kind"] == "count":
+                sv = pairs_to_f32(out[f"hc_cnt{idx}"])
+                score_null = jnp.zeros(n, bool)  # COUNT is never NULL
+            else:
+                sv = jnp.zeros(n, jnp.float32)
+                for ti, (t, shift, L) in enumerate(sched[idx]["terms"]):
+                    sv = sv + pairs_to_f32(out[f"hc_s{idx}_{ti}"]) * \
+                        float(1 << shift)
+                cnt = pairs_to_f32(out[f"hc_cnt{idx}"])
+                if d.func == "avg":
+                    sv = sv / jnp.maximum(cnt, 1.0)
+                score_null = cnt == 0  # SUM/AVG over no valid rows is NULL
+        signed = sv if hc.desc else -sv
+        # MySQL NULL ordering: first in ASC, last in DESC. ASC -> +inf
+        # makes the NULL group a guaranteed candidate. DESC uses a FINITE
+        # floor (below any real sum, which is bounded by int64) so NULL
+        # groups still outrank non-start rows (-inf): group starts then
+        # always win the candidate slots, making "not all slots picked" a
+        # sound proof that every group is a candidate. Ties among several
+        # NULL groups at the floor are caught by the decode's strict-gap
+        # boundary check.
+        signed = jnp.where(score_null,
+                           jnp.float32(-1e38 if hc.desc else np.inf),
+                           signed)
+        score = jnp.where(gate, signed, -jnp.inf)
+        k_cap = min(hc.cap, n)
 
-    k_cap = min(hc.cap, n)
     # recall_target=1.0 keeps TPU-native compile times (~10s vs ~20s for
     # lax.top_k at millions of rows) while selecting EXACTLY by score —
     # required for the candidate-superset guarantee the decode relies on
     _, cand = jax.lax.approx_max_k(score, k_cap, recall_target=1.0)
-    res = {"picked": gate[cand].astype(jnp.int32),
+    res = {"picked": (gate if hc is not None else
+                      pass_m)[cand].astype(jnp.int32),
            "score": score[cand]}
     for gi in range(len(agg.group_by)):
         res[f"gk{gi}"] = P(encs[gi])[cand]
@@ -1443,6 +1703,8 @@ def _hc_body(frag, prepared, cols, mask, aux=None):
         res[f"cnt{ai}"] = out[f"hc_cnt{ai}"][:, :, cand]
         for ti in range(len(s.get("terms", ()))):
             res[f"s{ai}_{ti}"] = out[f"hc_s{ai}_{ti}"][:, :, cand]
+    if mm_ai is not None:
+        res[f"mm{mm_ai}"] = sk[-1][cand]
     return _maybe_fused_cut(frag, prepared, res)
 
 
@@ -1455,10 +1717,15 @@ def _decode_hc(frag, snaps, prepared, out) -> Optional[Chunk]:
     if not picked.any():
         return None
     if frag.hc is None:
-        # HAVING mode: sound iff the candidate buffer was not exhausted
-        # (every margined-passing group fit; the host re-filters exactly)
-        if picked.all():
-            raise _Fallback("having-overflow")
+        # HAVING / all-groups mode: sound iff no candidate BLOCK was
+        # exhausted (every group — or margined-passing group — of that
+        # exchange partition fit its buffer); blocks are per-device on
+        # the mesh, one on a single device
+        blocks = max(1, int(prepared.get("__hc_blocks__", 1)))
+        kb = len(picked) // blocks
+        for b in range(blocks):
+            if picked[b * kb:(b + 1) * kb].all():
+                raise _Fallback("group-overflow")
         return _decode_hc_rows(frag, snaps, prepared, out, picked)
     # candidate blocks are per-exchange-partition (group spaces disjoint);
     # each partition's buffer must be verified independently
@@ -1510,6 +1777,18 @@ def _decode_fat(frag, snaps, prepared, out) -> Optional[Chunk]:
                 v += int(_SE.combine_partials(
                     np.asarray(out[f"s{idx}_{ti}"])[:, :, p:p + 1])[0]) \
                     << sh
+            if frag.agg.aggs[idx].func == "avg":
+                # the item compares as the host's rounded decimal —
+                # the tie check must use the SAME value
+                if cnt == 0:
+                    vals.append((True, 0))
+                    continue
+                from ..types.value import Decimal as _Dec
+                at_ = frag.agg.aggs[idx].arg.ftype
+                sc = at_.scale if at_.is_decimal else 0
+                q = _Dec(v, sc).div(_Dec.from_int(cnt))
+                vals.append((False, q.unscaled))
+                continue
             vals.append((cnt == 0, v))  # NULL flag + exact value
         return tuple(vals)
 
@@ -1559,6 +1838,12 @@ def _decode_hc_rows(frag, snaps, prepared, out, picked) -> Chunk:
         val_t = frag.output_types[len(agg.group_by) + 2 * ai]
         if s["kind"] == "count":
             vcol = Column(val_t, cnt.astype(np.int64))
+        elif s["kind"] in ("min", "max"):
+            enc = np.asarray(out[f"mm{ai}"])[sel].astype(np.int64)
+            val = enc if s["kind"] == "min" else -1 - enc
+            val = np.where(cnt > 0, val, 0)  # sentinel-filled when empty
+            vcol = Column(val_t, val.astype(val_t.np_dtype),
+                          None if (cnt > 0).all() else (cnt > 0))
         else:
             total = np.zeros(len(picked), dtype=np.int64)
             for ti, (_, shift, _) in enumerate(s["terms"]):
@@ -1582,6 +1867,8 @@ def _frag_key(frag: FragmentDAG) -> str:
     parts = [frag.describe()]
     for t in frag.tables:
         parts.append(repr(t.filters))
+    for sm in frag.semis:
+        parts.append(f"{sm.kind}|{repr(sm.table.filters)}")
     parts.append(repr(frag.selection))
     if frag.agg is not None:
         parts.append(repr(frag.agg.group_by))
@@ -1740,6 +2027,36 @@ def _host_join(frag, snaps, probe_idx, overlay, epoch_only_probe):
             valids.append((np.ones(nrows, bool) if v is None
                            else v[safe_rows]) & found)
         dicts.extend(bdicts)
+
+    if filtered and nrows:
+        # semi/anti membership gates (device twin: the bitmap lookups in
+        # _build_frag_kernel); device row-mode replay skips them — the
+        # kernel already applied every gate
+        for sm in frag.semis:
+            snap = snaps[sm.table.table.id]
+            bcols = _full_host_cols(snap, sm.table.col_offsets)
+            bn = len(bcols[0][0]) if bcols else 0
+            bkeep, has_null, kd, ok = _semi_build_facts(
+                bcols, [snap.dictionaries[off]
+                        for off in sm.table.col_offsets],
+                sm.table, sm.build_key_local, np.ones(bn, bool))
+            skeys = np.unique(kd[ok].astype(np.int64))
+            ev = NumpyEval([(c, v) for c, v in zip(cols, valids)],
+                           dicts, nrows)
+            pk, pkv = ev.eval(sm.probe_key)
+            pkv = np.asarray(pkv)
+            found = np.isin(np.asarray(pk).astype(np.int64), skeys) & pkv
+            if sm.kind == "SEMI":
+                keep &= found
+            elif sm.kind == "ANTI":
+                keep &= ~found
+            else:  # ANTI_NULL: NULL-aware NOT IN
+                if not bkeep.any():
+                    pass  # NOT IN (empty set) keeps every row
+                elif has_null:
+                    keep &= False
+                else:
+                    keep &= pkv & ~found
 
     if filtered and frag.selection and nrows:
         ev = NumpyEval([(c, v) for c, v in zip(cols, valids)], dicts,

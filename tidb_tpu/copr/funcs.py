@@ -47,14 +47,19 @@ class FuncDef:
     ret: str                  # str | int | float | date | arg0
     fn: Callable
     null_prop: bool = True
+    # pure function of its arguments whose only string input can be a
+    # dictionary column: NumpyEval evaluates it once per DISTINCT
+    # dictionary value and gathers by code (npeval._dict_vec_call)
+    # instead of once per row
+    dict_vec: bool = False
 
 
 REGISTRY: dict[str, FuncDef] = {}
 
 
 def _reg(name: str, lo: int, hi: int, ret: str, fn: Callable,
-         null_prop: bool = True) -> None:
-    REGISTRY[name] = FuncDef(name, lo, hi, ret, fn, null_prop)
+         null_prop: bool = True, dict_vec: bool = False) -> None:
+    REGISTRY[name] = FuncDef(name, lo, hi, ret, fn, null_prop, dict_vec)
 
 
 def lookup(name: str) -> Optional[FuncDef]:
@@ -198,7 +203,7 @@ def _field(s, *strs):
     return 0
 
 
-_reg("SUBSTRING_INDEX", 3, 3, "str", _substring_index)
+_reg("SUBSTRING_INDEX", 3, 3, "str", _substring_index, dict_vec=True)
 _reg("INSERT", 4, 4, "str", _insert)
 _reg("MID", 2, 3, "str", _mid)
 _reg("SUBSTR", 2, 3, "str", _mid)
@@ -316,10 +321,10 @@ def _regexp_replace(s, pat, repl, pos=1, occ=0):
         return None
 
 
-_reg("REGEXP_LIKE", 2, 3, "int", _regexp_like)
-_reg("REGEXP_SUBSTR", 2, 4, "str", _regexp_substr)
-_reg("REGEXP_INSTR", 2, 4, "int", _regexp_instr)
-_reg("REGEXP_REPLACE", 3, 5, "str", _regexp_replace)
+_reg("REGEXP_LIKE", 2, 3, "int", _regexp_like, dict_vec=True)
+_reg("REGEXP_SUBSTR", 2, 4, "str", _regexp_substr, dict_vec=True)
+_reg("REGEXP_INSTR", 2, 4, "int", _regexp_instr, dict_vec=True)
+_reg("REGEXP_REPLACE", 3, 5, "str", _regexp_replace, dict_vec=True)
 
 # ---------------------------------------------------------------------------
 # math functions (reference: expression/builtin_math.go)

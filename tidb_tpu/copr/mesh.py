@@ -620,7 +620,8 @@ def _classify_key(key) -> tuple:
             kind = {"perm": "perm", "perm-rep": "perm",
                     "partb": "partition", "aligned": "aligned",
                     "repc": "replica", "repv": "replica",
-                    "repvis": "replica", "rankaux": "rankaux"}.get(k1, k1)
+                    "repvis": "replica", "rankaux": "rankaux",
+                    "semibm": "perm", "semibm-rep": "perm"}.get(k1, k1)
             return int(key[0]), kind
         if key and key[-1] == "rep":
             return int(key[0]), "replica"

@@ -47,6 +47,9 @@ class NumpyEval:
         from .funcs import REGISTRY
 
         fd = REGISTRY[e.op[3:]]
+        vec = self._dict_vec_call(e, fd)
+        if vec is not None:
+            return vec
         # the de-vectorization tax, attributed per function: surfaced
         # through metrics_schema.tidb_registry_row_eval_total and the
         # registry-row-eval inspection rule
@@ -89,6 +92,74 @@ class NumpyEval:
             if r is not None:
                 out[i] = r
                 valid[i] = True
+        return self._coerce_registry(e, fd, out, valid)
+
+    def _dict_vec_call(self, e: Call, fd) -> Optional[VV]:
+        """Dictionary-vectorized registry call: when the ONE string
+        argument is a plain dict-coded column and every other argument
+        is a constant, evaluate the builtin once per DISTINCT dictionary
+        value and gather per row by code — len(dict) Python calls
+        instead of n (the de-vectorization the registry-row-eval rule
+        watches). Returns None when the shape doesn't apply and the
+        per-row path must run."""
+        import decimal as _pydec
+
+        if not fd.dict_vec or not fd.null_prop:
+            return None
+        col_pos = None
+        consts: dict[int, object] = {}
+        for i, a in enumerate(e.args):
+            if isinstance(a, Col) and a.ftype.is_string:
+                if col_pos is not None:
+                    return None  # two string columns: no single domain
+                col_pos = i
+            elif isinstance(a, Const):
+                if a.value is None:
+                    return None  # NULL const: per-row path propagates
+                if a.ftype.is_string:
+                    consts[i] = str(a.value)
+                elif a.ftype.is_decimal:
+                    consts[i] = _pydec.Decimal(
+                        int(a.value)).scaleb(-a.ftype.scale)
+                elif isinstance(a.value, (int, float, bool)):
+                    consts[i] = a.value
+                else:
+                    return None
+            else:
+                return None
+        if col_pos is None:
+            return None
+        c = e.args[col_pos]
+        d = self.dicts[c.idx] if c.idx < len(self.dicts) else None
+        if d is None or len(d) == 0 or len(d) > max(self.n, 1):
+            return None  # fewer rows than values: per-row is cheaper
+        codes, vl = self.cols[c.idx]
+        dvals = np.empty(len(d), dtype=object)
+        dok = np.zeros(len(d), bool)
+        args = [consts.get(i) for i in range(len(e.args))]
+        for ci, sval in enumerate(d.values):
+            args[col_pos] = sval
+            try:
+                r = fd.fn(*args)
+            except (ValueError, TypeError, OverflowError,
+                    ZeroDivisionError):
+                r = None
+            if r is not None:
+                dvals[ci] = r
+                dok[ci] = True
+        safe = np.clip(codes, 0, len(d) - 1)
+        out = dvals[safe]
+        valid = np.asarray(vl) & dok[safe]
+        out = np.where(valid, out, None)
+        return self._coerce_registry(e, fd, out, valid)
+
+    def _coerce_registry(self, e: Call, fd, out: np.ndarray,
+                         valid: np.ndarray) -> VV:
+        """Registry results (object array) -> the typed (data, valid)
+        pair per the FuncDef's declared return domain."""
+        import decimal as _pydec
+
+        n = self.n
         if fd.ret == "str":
             # string consumers read through eval_str (object array)
             for i in range(n):

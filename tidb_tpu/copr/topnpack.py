@@ -244,6 +244,92 @@ def pair_digits(contribs) -> list[jnp.ndarray]:
     return out
 
 
+# AVG items: every long-division step computes r*4096 + digit with
+# r < cnt, so counts must stay under 2^18 for int32 exactness — the
+# executor gates the fused cut on the dispatch's total row count
+AVG_CNT_CAP = 1 << 18
+_AVG_SCALE_UP = 10_000  # div_precincrement=4: out scale = arg scale + 4
+_AVG_DIGITS = N_DIGITS + 2  # |sum| * 10^4 < 2^62 * 10^4 fits 9 digits
+
+
+def avg_sort_keys(digs, cnt, isnull, desc: bool) -> list[jnp.ndarray]:
+    """Ascending-sort operands ordering candidates by EXACTLY the value
+    the host's AVG produces: round-half-away-from-zero of
+    sum * 10^4 / cnt (types/value.Decimal.div with div_precincrement=4;
+    the executor gates fused AVG items on out_scale == arg_scale + 4).
+
+    digs: the SUM's signed-head canonical base-4096 digits (pair_digits,
+    MSB first); cnt: int32 counts < AVG_CNT_CAP; isnull: cnt == 0.
+    Pipeline, all int32-exact: sign-magnitude split (borrow negation of
+    the canonical digits), scale by 10^4 with carry renormalization,
+    base-4096 long division by cnt (remainders < cnt keep every step
+    under 2^31), half-away rounding on the true remainder, then packed
+    sign-applied digit operands with MySQL NULL placement folded into
+    the leading operand."""
+    neg = digs[0] < 0
+    # |sum| digits, LSB-first borrow propagation over the canonical form
+    mags_lsb = []
+    borrow = jnp.zeros_like(digs[0])
+    for i in range(N_DIGITS - 1, 0, -1):
+        d = digs[i]
+        mags_lsb.append(jnp.where(neg, (-d - borrow) & _LIMB_MASK, d))
+        nb = ((d + borrow) > 0).astype(jnp.int32)
+        borrow = jnp.where(neg, nb, borrow)
+    head = jnp.where(neg, -digs[0] - borrow, digs[0])
+    # scale magnitude by 10^4 (digit * 10^4 < 2^26, carries renormalize)
+    carry = jnp.zeros_like(head)
+    scaled_lsb = []
+    for m in mags_lsb + [head]:
+        cur = m * jnp.int32(_AVG_SCALE_UP) + carry
+        scaled_lsb.append(cur & _LIMB_MASK)
+        carry = cur >> _LIMB_BITS
+    while len(scaled_lsb) < _AVG_DIGITS:
+        scaled_lsb.append(carry & _LIMB_MASK)
+        carry = carry >> _LIMB_BITS
+    # long division MSB-first: quotient digits < 4096, remainder < cnt
+    c = jnp.maximum(cnt, 1)  # cnt == 0 candidates fold via isnull below
+    r = jnp.zeros_like(head)
+    q_msb = []
+    for m in reversed(scaled_lsb):
+        t = r * jnp.int32(1 << _LIMB_BITS) + m
+        q = t // c
+        q_msb.append(q)
+        r = t - q * c
+    # half away from zero on the magnitude (the host rounds |num|/|den|)
+    up = (2 * r >= c).astype(jnp.int32)
+    k_lsb = []
+    carry = up
+    for q in reversed(q_msb):
+        cur = q + carry
+        k_lsb.append(cur & _LIMB_MASK)
+        carry = cur >> _LIMB_BITS
+    k_msb = list(reversed(k_lsb))
+    is_zero = None
+    for d in k_msb:
+        z = d == 0
+        is_zero = z if is_zero is None else (is_zero & z)
+    sgn = jnp.where(is_zero, jnp.int32(0),
+                    jnp.where(neg, jnp.int32(-1), jnp.int32(1)))
+    # pack digit pairs (24 bits per operand) and apply the sign — for
+    # equal signs, negated digits reverse the order componentwise
+    packed = []
+    i = 0
+    while i < len(k_msb):
+        if i + 1 < len(k_msb):
+            packed.append(k_msb[i] * jnp.int32(1 << _LIMB_BITS)
+                          + k_msb[i + 1])
+            i += 2
+        else:
+            packed.append(k_msb[i])
+            i += 1
+    keys = [sgn] + [sgn * p for p in packed]
+    if desc:
+        keys = [-k for k in keys]
+    sent = jnp.int32(2 if desc else -2)  # NULL first-ASC / last-DESC
+    return [jnp.where(isnull, sent, keys[0])] + \
+        [jnp.where(isnull, 0, k) for k in keys[1:]]
+
+
 def digit_sort_keys(digs, desc: bool) -> list[jnp.ndarray]:
     """Ascending-sort keys for a digit vector: packed pairs of canonical
     digits (24 bits per int32 operand — halves the variadic-sort operand

@@ -246,6 +246,10 @@ def _run_node(plan: PhysicalPlan, ctx: ExecContext,
         from ..copr.fragment import execute_fragment
         snaps = {t.table.id: ctx.txn.snapshot(t.table.id)
                  for t in plan.frag.tables}
+        for sm in plan.frag.semis:  # membership builds need snapshots too
+            tid = sm.table.table.id
+            if tid not in snaps:
+                snaps[tid] = ctx.txn.snapshot(tid)
         result = execute_fragment(ctx.cop, plan.frag, snaps)
         obs.note_engine(result.engine)
         if engine_tag is not None:

@@ -367,6 +367,9 @@ class DistCopClient(CopClient):
             specs[f"gk{gi}"] = P(AXIS)
         for ai, s in enumerate(prepared["__hc_sched__"]):
             specs[f"cnt{ai}"] = P(None, None, AXIS)
+            if s["kind"] in ("min", "max"):
+                # sorted-operand min/max: one encoded value per candidate
+                specs[f"mm{ai}"] = P(AXIS)
             for ti in range(len(s.get("terms", ()))):
                 specs[f"s{ai}_{ti}"] = P(None, None, AXIS)
         return specs
@@ -381,7 +384,7 @@ class DistCopClient(CopClient):
         return [
             {"bykey": P(AXIS), "present": P(AXIS)} if ji == part_ji else P()
             for ji in range(n_joins)
-        ]
+        ] + [P()] * prepared.get("__n_semis__", 0)  # replicated bitmaps
 
     # ---- TopN: local top-k per shard, host merge ------------------------
     def _build_topn_kernel(self, dag, prepared, expr, desc, n):

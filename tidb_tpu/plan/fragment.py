@@ -81,6 +81,21 @@ class FragJoin:
 
 
 @dataclass
+class FragSemi:
+    """Membership-gate edge (EXISTS / IN / NOT IN): probe-stream rows
+    survive iff their key is (not) present in the build table's filtered
+    key set. The build table contributes NO columns to the combined
+    space — only a device-resident membership bitmap over its key span
+    (copr/fragment.py stages it host-side per epoch, NULL-aware for the
+    ANTI_NULL NOT-IN form). kind: "SEMI" | "ANTI" | "ANTI_NULL"."""
+
+    table: FragTable
+    probe_key: PlanExpr
+    build_key_local: int
+    kind: str
+
+
+@dataclass
 class HCTopN:
     """High-cardinality group-by hint: the aggregation's consumer is
     ORDER BY <score> LIMIT k, so the device may return only a candidate
@@ -137,7 +152,9 @@ class FragmentDAG:
     # op in lt/le/gt/ge and const already scaled to the aggregate's
     # integer representation.
     having: Optional[list] = None
-    HAVING_CAP = 65536  # candidate buffer for having-filtered groups
+    # semi/anti membership gates applied after the joins (no columns)
+    semis: list[FragSemi] = field(default_factory=list)
+    HAVING_CAP = 65536  # candidate buffer for having/all-groups modes
 
     def combined_types(self) -> list[FieldType]:
         out: list[FieldType] = []
@@ -151,6 +168,9 @@ class FragmentDAG:
         for j in self.joins:
             t = self.tables[j.build]
             parts.append(f"gather(t{t.table.id} key={j.probe_key!r})")
+        for sm in self.semis:
+            parts.append(f"{sm.kind.lower()}(t{sm.table.table.id} "
+                         f"key={sm.probe_key!r})")
         if self.selection:
             parts.append(f"sel({len(self.selection)})")
         if self.agg is not None:
@@ -197,11 +217,47 @@ class _Collected:
     # tree-space residual conjuncts (join ON residue + selections above)
     conds: list[PlanExpr]
     width: int
+    # semi/anti membership edges: (probe tree position, build leaf,
+    # build scan-local key, kind) — build leaves contribute no columns
+    semis: list[tuple[int, PhysTableRead, int, str]] = \
+        field(default_factory=list)
+
+
+def _semi_build_leaf(node: PhysicalPlan):
+    """Bare-scan build side of a semi/anti join; a trailing plain-Col
+    projection (the planner trims the subquery to its key column) is
+    tolerated. Returns (leaf, right-schema idx -> scan-local idx) or
+    None."""
+    if not isinstance(node, PhysTableRead):
+        return None
+    dag = node.dag
+    if dag.scan.table_id < 0 or dag.scan.ranges is not None or \
+            dag.agg is not None or dag.topn is not None or \
+            dag.limit is not None:
+        return None
+    if getattr(node, "table", None) is None:
+        return None
+    if dag.selection and any(_has_subq(c)
+                             for c in dag.selection.conditions):
+        return None
+    projs = dag.projections
+
+    def local_of(i: int) -> Optional[int]:
+        if projs is None:
+            return i
+        if i < len(projs) and isinstance(projs[i], Col):
+            return projs[i].idx
+        return None
+
+    return node, local_of
 
 
 def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
     """Flatten a tree of INNER hash joins over bare scans; positions are
-    absolute over the concatenated leaf columns in tree order."""
+    absolute over the concatenated leaf columns in tree order. Semi/anti
+    joins whose build side is a bare scan fold into membership edges
+    (the probe subtree keeps its column space — semi output schema IS
+    the left schema)."""
     if isinstance(node, PhysSelection):
         inner = _collect_join_tree(node.children[0])
         if inner is None:
@@ -210,6 +266,29 @@ def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
             return None
         inner.conds = inner.conds + list(node.conditions)
         return inner
+    if isinstance(node, PhysHashJoin) and \
+            node.kind in ("SEMI", "ANTI", "ANTI_NULL"):
+        left = _collect_join_tree(node.children[0])
+        if left is None:
+            return None
+        if len(node.eq_conditions) != 1 or node.other_conditions:
+            return None  # per-pair residuals can't gate via a bitmap
+        leaf = _semi_build_leaf(node.children[1])
+        if leaf is None:
+            return None
+        tr, local_of = leaf
+        li, ri = node.eq_conditions[0]
+        blocal = local_of(ri)
+        if blocal is None:
+            return None
+        # integer key domains on both sides (dict codes don't unify)
+        bft = _scan_types(tr)[blocal]
+        pft = _tree_pos_type(left, li)
+        if pft is None or pft.kind not in _FRAG_KEY_KINDS or \
+                bft.kind not in _FRAG_KEY_KINDS:
+            return None
+        left.semis = left.semis + [(li, tr, blocal, node.kind)]
+        return left
     if isinstance(node, PhysHashJoin):
         # CROSS nodes appear when the planner stages a cartesian pair whose
         # linking equalities live higher in the tree (e.g. Q9's
@@ -230,8 +309,10 @@ def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
             if any(_has_subq(c) for c in node.other_conditions):
                 return None
             conds += list(node.other_conditions)
+        semis = list(left.semis) + [
+            (p + lw, tr, bl, kind) for p, tr, bl, kind in right.semis]
         return _Collected(left.leaves + right.leaves, edges, conds,
-                          lw + right.width)
+                          lw + right.width, semis)
     if isinstance(node, PhysTableRead):
         if not _bare_scan(node) or node.dag.scan.ranges is not None:
             return None
@@ -240,6 +321,16 @@ def _collect_join_tree(node: PhysicalPlan) -> Optional[_Collected]:
             return None
         return _Collected([node], [], [],
                           len(node.dag.scan.col_offsets))
+    return None
+
+
+def _tree_pos_type(col: _Collected, pos: int) -> Optional[FieldType]:
+    """Field type at an absolute tree position over the concat'd leaves."""
+    for tr in col.leaves:
+        w = len(tr.dag.scan.col_offsets)
+        if pos < w:
+            return tr.dag.output_types[pos]
+        pos -= w
     return None
 
 
@@ -288,7 +379,7 @@ def _try_assemble(col: _Collected) -> Optional[tuple[FragmentDAG, list[int]]]:
     """Pick a probe and a build order; returns (frag, treepos->combined)."""
     leaves = col.leaves
     n = len(leaves)
-    if n < 2:
+    if n < 2 and not col.semis:
         return None
     # leaf index + local position for every tree position
     leaf_of: list[tuple[int, int]] = []
@@ -380,6 +471,13 @@ def _try_assemble(col: _Collected) -> Optional[tuple[FragmentDAG, list[int]]]:
                         *leaf_of[b]))], FieldType(TypeKind.BOOLEAN)))
         selection = [_remap_expr(c, remap) for c in col.conds] + extra
         frag = FragmentDAG(tables, joins, selection)
+        for ppos, tr, blocal, kind in col.semis:
+            frag.semis.append(FragSemi(
+                FragTable(tr.table, list(tr.dag.scan.col_offsets),
+                          list(tr.dag.selection.conditions)
+                          if tr.dag.selection else [], _scan_types(tr)),
+                Col(remap[ppos], leaf_field_type(*leaf_of[ppos])),
+                blocal, kind))
         return frag, remap
     return None
 
@@ -412,7 +510,7 @@ def _match_agg_fragment(plan: PhysHashAgg, allow_single: bool = False
         # hll sketches don't flow through the fragment partial machinery
         # (streamseg/hcagg are sum-shaped); the scan path carries them
         return None
-    if len(col.leaves) == 1:
+    if len(col.leaves) == 1 and not col.semis:
         if not allow_single:
             return None
         tr = col.leaves[0]
@@ -509,8 +607,11 @@ def _resolve_hc_items(sort_node, proj, agg_node) -> Optional[list]:
     """Resolve EVERY sort item to ("group", gi, desc) / ("agg", ai, desc)
     for the fused final cut. Group items may be strings (the executor
     compares dictionary RANKS, order-preserving) but not floats;
-    aggregate items must be SUM/COUNT — their candidate limb-pair sums
-    recombine exactly on device (AVG would need a rational compare).
+    aggregate items must be SUM/COUNT/AVG — sums and counts recombine
+    exactly from the candidate limb-pair digits, and AVG compares as the
+    exact rational sum/cnt via base-4096 long division on device
+    (copr/topnpack.avg_sort_keys; the executor gates it on the
+    row-count bound that keeps every division step int32-exact).
     Returns None when any item falls outside that set."""
     ngroups = len(agg_node.group_by)
     out = []
@@ -526,7 +627,8 @@ def _resolve_hc_items(sort_node, proj, agg_node) -> Optional[list]:
         else:
             ai = e.idx - ngroups
             if ai >= len(agg_node.aggs) or \
-                    agg_node.aggs[ai].func not in ("sum", "count") or \
+                    agg_node.aggs[ai].func not in \
+                    ("sum", "count", "avg") or \
                     (agg_node.aggs[ai].arg is not None and
                      agg_node.aggs[ai].arg.ftype.is_float):
                 return None
