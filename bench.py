@@ -1615,6 +1615,143 @@ def run_flight_child(name: str, out_path: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Round trajectory (ISSUE 15): compare this round against the previous
+# committed BENCH_r*/MULTICHIP_r* record so a bench round produces a
+# machine-read comparison, not just a JSON file nobody diffs.
+# ---------------------------------------------------------------------------
+
+_P50_RE = None  # compiled lazily
+
+
+def parse_query_p50s(text: str) -> dict[str, float]:
+    """Per-query p50 milliseconds from board text: every timed query
+    reports through report() as '<name>: p50=NN.Nms ...', and the
+    LEGACY round wrappers (r01..r06) carry the same lines in their
+    stderr `tail` — one parser reads both eras."""
+    import re
+    global _P50_RE
+    if _P50_RE is None:
+        _P50_RE = re.compile(
+            r"(?:^|\s)([A-Za-z_][\w.]*): p50=([0-9.]+)ms ")
+    out: dict[str, float] = {}
+    for m in _P50_RE.finditer(text):
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def load_prev_round(prefix: str) -> tuple[int, Optional[dict]]:
+    """Newest committed {prefix}_rNN.json next to this file ->
+    (round_no, data); (0, None) when no round has ever landed."""
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, data = 0, None
+    for fn in sorted(os.listdir(here)):
+        m = re.match(rf"{re.escape(prefix)}_r(\d+)\.json$", fn)
+        if not m or int(m.group(1)) <= best:
+            continue
+        try:
+            with open(os.path.join(here, fn)) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        best, data = int(m.group(1)), d
+    return best, data
+
+
+def prev_round_p50s(data: Optional[dict]) -> dict[str, float]:
+    """A previous round's per-query p50s: the structured `queries` map
+    when the round wrote one (r07+), else parsed out of its board
+    lines / stderr tail (the legacy wrapper format)."""
+    if not isinstance(data, dict):
+        return {}
+    q = data.get("queries")
+    if isinstance(q, dict):
+        out = {}
+        for k, v in q.items():
+            try:
+                out[str(k)] = float(v)
+            except (TypeError, ValueError):
+                continue
+        return out
+    text = "\n".join(str(ln) for ln in data.get("lines", []) or [])
+    return parse_query_p50s(text + "\n" + str(data.get("tail", "")))
+
+
+def compare_rounds(prev_no: int, prev_p50s: dict[str, float],
+                   cur_p50s: dict[str, float],
+                   ratio: float) -> dict:
+    """The trajectory section: per-query prev/cur p50 + speedup, with
+    regressions flagged by the SAME ratio knob the history plane's
+    plan-regression rule uses (history.regression-ratio; env
+    BENCH_REGRESSION_RATIO here — one threshold, two ends of the
+    telemetry loop)."""
+    deltas: dict[str, dict] = {}
+    regressions: list[str] = []
+    for name in sorted(set(cur_p50s) | set(prev_p50s)):
+        cur = cur_p50s.get(name)
+        prev = prev_p50s.get(name)
+        if cur is None:
+            # the worst regression of all: the query stopped producing
+            # a number (flight died/timed out) — flag it, don't let it
+            # vanish from the comparison
+            regressions.append(name)
+            deltas[name] = {"cur_ms": None, "prev_ms": prev,
+                            "speedup": None, "regression": True}
+            continue
+        if prev is None or prev <= 0 or cur <= 0:
+            deltas[name] = {"cur_ms": cur, "prev_ms": prev,
+                            "speedup": None, "regression": False}
+            continue
+        speedup = prev / cur
+        regressed = cur >= ratio * prev
+        if regressed:
+            regressions.append(name)
+        deltas[name] = {"cur_ms": cur, "prev_ms": prev,
+                        "speedup": round(speedup, 2),
+                        "regression": regressed}
+    return {"vs_round": prev_no, "regression_ratio": ratio,
+            "deltas": deltas, "regressions": regressions}
+
+
+def trajectory_lines(label: str, traj: dict) -> list[str]:
+    """Board lines for one trajectory section, regressions loudest."""
+    out = []
+    if not traj["deltas"]:
+        return [f"trajectory {label}: no comparable previous round"]
+    for name, d in traj["deltas"].items():
+        if d["cur_ms"] is None:
+            out.append(
+                f"trajectory {label} {name}: "
+                f"{d['prev_ms']:.1f}ms -> MISSING (no result this "
+                f"round) <- REGRESSION")
+            continue
+        if d["speedup"] is None:
+            out.append(f"trajectory {label} {name}: {d['cur_ms']:.1f}ms "
+                       "(new query, no r"
+                       f"{traj['vs_round']:02d} point)")
+            continue
+        tag = " <- REGRESSION" if d["regression"] else ""
+        out.append(
+            f"trajectory {label} {name}: {d['prev_ms']:.1f}ms -> "
+            f"{d['cur_ms']:.1f}ms ({d['speedup']:.2f}x vs "
+            f"r{traj['vs_round']:02d}){tag}")
+    if traj["regressions"]:
+        out.append(
+            f"trajectory {label}: {len(traj['regressions'])} "
+            f"regression(s) >= {traj['regression_ratio']:g}x: "
+            + ",".join(traj["regressions"]))
+    return out
+
+
+def _persist_round(path: str, payload: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+    log(f"round record written: {path}")
+
+
+# ---------------------------------------------------------------------------
 # Parent board
 # ---------------------------------------------------------------------------
 
@@ -1676,6 +1813,7 @@ def main() -> None:
     ).split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}
+    flight_results: dict[str, dict] = {}
     all_lines: list[str] = [
         f"baseline_c_q6_kv_rowloop: {kv_rps / 1e6:.0f}M rows/s",
         f"baseline_c_q6_columnar_rowloop: {col_rps / 1e6:.0f}M rows/s",
@@ -1710,6 +1848,7 @@ def main() -> None:
                    "error": f"no result file (rc={rc}"
                             f"{', likely OOM-killed' if rc == -9 else ''})"}
         os.unlink(out.name)
+        flight_results[name] = res
         all_lines += res.get("lines", [])
         if res.get("ok"):
             values.update(res.get("values", {}))
@@ -1742,15 +1881,80 @@ def main() -> None:
             f"baseline_py_rowloop: {values['py_baseline'] / 1e3:.0f}K "
             f"rows/s (r01-r04 series denominator; r04 headline would be "
             f"{(values.get('q6_big') or values.get('q6_small', 0)) / values['py_baseline']:.1f}x against it)")
+
+    # ---- round trajectory: this round vs the previous committed one ----
+    ratio = float(os.environ.get("BENCH_REGRESSION_RATIO", 1.5))
+    cur_p50s = parse_query_p50s("\n".join(all_lines))
+    prev_no, prev_data = load_prev_round("BENCH")
+    traj = compare_rounds(prev_no, prev_round_p50s(prev_data),
+                          cur_p50s, ratio)
+    all_lines += trajectory_lines("bench", traj)
+    mc_res = flight_results.get("multichip")
+    mc_traj = None
+    if mc_res is not None:
+        mc_p50s = parse_query_p50s(
+            "\n".join(str(ln) for ln in mc_res.get("lines", [])))
+        mc_no, mc_prev = load_prev_round("MULTICHIP")
+        mc_prev_p50s = prev_round_p50s(mc_prev)
+        if not mc_prev_p50s:
+            # legacy MULTICHIP wrappers carried no query lines of
+            # their own; the paired BENCH round's board has them
+            mc_no = prev_no
+            mc_prev_p50s = {
+                k: v for k, v in prev_round_p50s(prev_data).items()
+                if k.startswith("multichip_")}
+        mc_traj = compare_rounds(mc_no, mc_prev_p50s, mc_p50s, ratio)
+        all_lines += trajectory_lines("multichip", mc_traj)
+
     for ln in all_lines:
         log(ln)
-    if values.get("q6_big") or values.get("q6_small"):
+    headline_ok = bool(values.get("q6_big") or values.get("q6_small"))
+    if headline_ok:
         print(_headline(values, kv_rps, done), flush=True)
     else:
         print(json.dumps({
             "metric": "tpch_q6_rows_per_sec", "value": 0,
             "unit": "rows/s", "vs_baseline": 0,
             "error": "no flight produced a headline"}), flush=True)
+
+    # ---- round record (BENCH_ROUND=N): structured, comparator-ready ----
+    # BENCH_r{N}.json + MULTICHIP_r{N}.json next to this file, written
+    # atomically; the `queries`/`trajectory` sections are what the NEXT
+    # round's comparator (and ROADMAP item 5's strategy learner) read,
+    # so landing a round finally produces a machine-read comparison.
+    round_no = os.environ.get("BENCH_ROUND")
+    if round_no:
+        here = os.path.dirname(os.path.abspath(__file__))
+        n = int(round_no)
+        cmd = " ".join(f"{k}={v}" for k, v in sorted(os.environ.items())
+                       if k.startswith("BENCH_")) + " python bench.py"
+        _persist_round(os.path.join(here, f"BENCH_r{n:02d}.json"), {
+            "round": n, "cmd": cmd,
+            "ok": headline_ok, "flights_done": done,
+            "headline": json.loads(_headline(values, kv_rps, done)),
+            "values": {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in sorted(values.items())},
+            "queries": cur_p50s,
+            "trajectory": traj,
+            "lines": all_lines,
+        })
+        if mc_res is not None:
+            _persist_round(
+                os.path.join(here, f"MULTICHIP_r{n:02d}.json"), {
+                    "round": n,
+                    "ok": bool(mc_res.get("ok")),
+                    "n_devices": int(os.environ.get(
+                        "BENCH_MESH_DEVICES", 8)),
+                    "values": mc_res.get("values", {}),
+                    "queries": parse_query_p50s(
+                        "\n".join(str(ln)
+                                  for ln in mc_res.get("lines", []))),
+                    "trajectory": mc_traj,
+                    "mesh": mc_res.get("mesh"),
+                    "attribution": mc_res.get("attribution"),
+                    "lines": mc_res.get("lines", []),
+                    "error": mc_res.get("error"),
+                })
 
 
 if __name__ == "__main__":

@@ -247,3 +247,55 @@ def test_group_mode_respects_mvcc_overlay(corpus, host_results):
     finally:
         s.execute("rollback")
     assert s.query(sql) == base
+
+
+# ==================== dense-vs-sort strategy gate (ISSUE 15) =========
+
+@pytest.fixture(scope="module")
+def sparse_corpus():
+    """A GROUP BY the dense einsum would happily serve (4096 dense
+    int32 slots) but with ~2 rows/slot estimated occupancy — the
+    mostly-empty one-hot shape the occupancy gate reroutes."""
+    rng = np.random.default_rng(53)
+    s = Session(cop=CopClient())
+    n = 9_000
+    _bulk(s, "sp",
+          "create table sp (id bigint primary key, a3 int, b3 int, "
+          "v3 int)",
+          [np.arange(n, dtype=np.int64),
+           rng.integers(0, 64, n), rng.integers(0, 64, n),
+           rng.integers(-1000, 1000, n)])
+    return s
+
+
+def test_sparse_einsum_reroutes_to_group_mode(sparse_corpus):
+    """Occupancy below the per-slot floor flips the strategy to the
+    sorted-run group mode, bit-identically to the dense einsum it
+    replaces; the engine tag records the chosen strategy (which the
+    workload-history plane persists per digest)."""
+    from tidb_tpu.copr import client as C
+
+    s = sparse_corpus
+    sql = "select a3, b3, sum(v3) from sp group by a3, b3 " \
+          "order by a3, b3"
+    with mock.patch.object(C, "DENSE_MIN_ROWS_PER_SEGMENT", 0):
+        want = s.query(sql)  # the dense einsum, gate disarmed
+        assert _engines(s, sql) == {"device"}
+    got = s.query(sql)
+    eng = _engines(s, sql)
+    assert got == want
+    assert any("device[group" in e for e in eng), eng
+
+
+def test_sparse_gate_retries_dense_when_group_ineligible(sparse_corpus):
+    """A sparse space whose aggregate cannot flow through the group
+    fragment (hll sketches) must RETRY the dense einsum — the gate may
+    never create a host fallback."""
+    s = sparse_corpus
+    sql = "select a3, b3, approx_count_distinct(v3) from sp " \
+          "group by a3, b3 order by a3, b3"
+    got = s.query(sql)
+    eng = _engines(s, sql)
+    assert not any(e.startswith("host(") for e in eng), eng
+    assert any(e.startswith("device") for e in eng), eng
+    assert len(got) > 3000  # the space really is ~2 rows/slot wide

@@ -1,0 +1,552 @@
+"""Workload history plane (ISSUE 15): persistent per-digest plan/perf
+history with plan-change and regression detection.
+
+Pins the acceptance criteria: history records survive a process restart
+(written with tmp+fsync+rename, read back verbatim); a forced plan
+degradation (engine tag device -> host(...) for a known digest) fires a
+`plan_change` event AND a `plan-regression` finding in
+information_schema.inspection_result; zero statement-path work while
+history.enabled is false; rotation respects the history-cap; the
+cluster_ tables fan out with per-peer degradation; the [history] knobs
+parse/seed/hot-reload; and the slow-log file sink rotates at
+log.file.max-size. The conftest guard covers leaked threads/fds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+
+import pytest
+
+from tidb_tpu import obs_history, obs_inspect
+from tidb_tpu.config import Config, HistoryConfig
+from tidb_tpu.obs_history import WorkloadHistory
+from tidb_tpu.rpc.client import RpcOptions
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+from tidb_tpu.util import failpoint
+
+OPTS = RpcOptions(connect_timeout_ms=1000, request_timeout_ms=4000,
+                  backoff_budget_ms=3000, lock_budget_ms=8000,
+                  lease_ms=2000)
+
+W = WorkloadHistory.DEFAULT_WINDOW_S
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _digest_of(storage, sql: str) -> tuple[str, str]:
+    """The (digest, normalized text) the session computes — tests seed
+    baseline records under the exact key the statement path will use."""
+    norm = storage.obs.statements.normalize(sql)
+    return hashlib.sha256(norm.encode()).hexdigest()[:32], norm
+
+
+def _feed(h, digest, wall_s, engines, win, n=1, text="select ?"):
+    """n observations inside window index `win` (windows are W apart,
+    anchored far from 'now' so real time never rotates them)."""
+    for i in range(n):
+        h.observe(digest, text, "test", wall_s, engines=engines,
+                  now=1_000_000 + win * W + i % max(int(W - 1), 1))
+
+
+# ==================== config / state mirror ====================
+
+def test_state_mirrors_config_section():
+    """config.HistoryConfig and obs_history.WorkloadHistory are
+    mirrored definitions (config never imports the obs chain): every
+    knob must exist on the runtime state with the same default, so
+    seed_history cannot silently drop one."""
+    h = WorkloadHistory()
+    for f in dataclasses.fields(HistoryConfig):
+        assert hasattr(h, f.name), f"WorkloadHistory lacks {f.name}"
+        assert getattr(h, f.name) == f.default, f.name
+
+
+def test_history_knobs_parse_seed_and_reload(tmp_path):
+    cfg = Config()
+    cfg.apply({"history": {"enabled": True, "window-seconds": 5,
+                           "history-cap": 7, "regression-ratio": 2.5}})
+    cfg.validate()
+    st = Storage()
+    cfg.seed_history(st)
+    try:
+        assert st.history.enabled is True
+        assert st.history.window_seconds == 5
+        assert st.history.history_cap == 7
+        assert st.history.regression_ratio == 2.5
+    finally:
+        st.close()
+    # the knobs are SIGHUP hot-reloadable
+    for knob in ("history.enabled", "history.window_seconds",
+                 "history.history_cap", "history.regression_ratio"):
+        assert knob in Config.RELOADABLE, knob
+    # validation rejects nonsense
+    bad = Config()
+    bad.history.regression_ratio = 0.5
+    with pytest.raises(Exception, match="regression-ratio"):
+        bad.validate()
+
+
+# ==================== zero work while disabled ====================
+
+def test_disabled_does_zero_history_work(monkeypatch):
+    st = Storage()
+    try:
+        assert st.history.enabled is False  # the Top SQL default
+
+        def boom(*a, **k):
+            raise AssertionError("history touched while disabled")
+
+        monkeypatch.setattr(st.history, "observe", boom)
+        monkeypatch.setattr(st.history, "_ensure_loaded", boom)
+        s = Session(st)
+        s.execute("create table z (a int primary key)")
+        s.execute("insert into z values (1)")
+        s.execute("select a from z")
+        assert st.diag.diag_history() == {"rows": []}
+        assert st.diag.diag_plan_history() == {"rows": []}
+        assert s.execute(
+            "select * from "
+            "information_schema.statements_summary_history").rows == []
+        payload = st.history.debug_payload()
+        assert payload["enabled"] is False and "records" not in payload
+    finally:
+        st.close()
+
+
+# ==================== rotation + caps ====================
+
+def test_rotation_caps_and_gauge():
+    h = WorkloadHistory()
+    h.configure(enabled=True, history_cap=5)
+    for win in range(9):
+        _feed(h, f"d{win:02d}", 0.01, ["device"], win)
+    # 8 windows rotated (the 9th is live); cap keeps the newest 5
+    snap = h.snapshot()
+    assert len(snap["records"]) == 5
+    assert [r["digest"] for r in snap["records"]] == \
+        [f"d{w:02d}" for w in range(3, 8)]
+    assert len(snap["live"]) == 1 and snap["live"][0]["digest"] == "d08"
+
+
+def test_window_aggregation_and_surfaces():
+    h = WorkloadHistory()
+    h.configure(enabled=True)
+    _feed(h, "dd", 0.010, ["device[group]@mesh8"], 0, n=3)
+    _feed(h, "dd", 0.020, ["device[group]@mesh8"], 1)  # rotates win 0
+    snap = h.snapshot()
+    assert len(snap["records"]) == 1
+    rec = snap["records"][0]
+    assert rec["exec_count"] == 3
+    assert rec["modes"] == ["group"]  # the strategy record (ISSUE 15)
+    assert abs(rec["sum_wall_ms"] - 30.0) < 1e-6
+    rows = h.table_rows()
+    assert len(rows) == 2  # record + live window
+    assert rows[0][7] == "group"  # plan_strategy column
+    plans = h.plan_rows()
+    assert len(plans) == 1 and plans[0][13] == 1  # current_plan
+
+
+# ==================== restart persistence (kill + reopen) ==========
+
+def test_records_survive_restart_verbatim(tmp_path):
+    st = Storage(str(tmp_path / "db"))
+    st.history.configure(enabled=True)
+    _feed(st.history, "aa", 0.005, ["device[group]"], 0, n=2)
+    _feed(st.history, "bb", 0.008, ["point"], 1)  # rotates window 0
+    _feed(st.history, "bb", 0.009, ["point"], 2)  # rotates window 1
+    want = st.history.snapshot()["records"]
+    assert len(want) == 2
+    # simulate kill -9 for the history plane: no clean flush — the
+    # reopened store must read what the ROTATIONS' atomic writes left
+    st.history.flush = lambda *a, **k: None
+    st.close()
+    st2 = Storage(str(tmp_path / "db"))
+    try:
+        st2.history.configure(enabled=True)
+        got = st2.history.snapshot()["records"]
+        assert got == want  # read back verbatim
+        # and the SQL surface serves them
+        rows = Session(st2).execute(
+            "select digest, plan_digest, exec_count from "
+            "information_schema.statements_summary_history").rows
+        assert ("aa", obs_history.plan_digest_of(["device[group]"]), 2) \
+            in rows
+    finally:
+        st2.close()
+
+
+def test_corrupt_history_file_degrades_to_empty(tmp_path):
+    st = Storage(str(tmp_path / "db"))
+    st.history.configure(enabled=True)
+    _feed(st.history, "aa", 0.005, ["device"], 0)
+    _feed(st.history, "aa", 0.005, ["device"], 1)
+    st.history.flush = lambda *a, **k: None
+    st.close()
+    path = tmp_path / "db" / "history" / obs_history.RECORDS_FILE
+    path.write_text("{torn", encoding="utf-8")
+    st2 = Storage(str(tmp_path / "db"))
+    try:
+        st2.history.configure(enabled=True)
+        assert st2.history.snapshot()["records"] == []
+        _feed(st2.history, "cc", 0.001, ["device"], 5)
+        _feed(st2.history, "cc", 0.001, ["device"], 6)
+        assert len(st2.history.snapshot()["records"]) == 1
+    finally:
+        st2.close()
+
+
+# ==================== plan-change detection ====================
+
+def test_plan_change_event_fires_and_throttles():
+    st = Storage()
+    try:
+        h = st.history
+        h.configure(enabled=True)
+        _feed(h, "dg", 0.01, ["device[group]"], 0, n=2)
+        # same plan again: silence
+        _feed(h, "dg", 0.01, ["device[group]"], 1)
+        events = [e for e in st.obs.events.snapshot()
+                  if e["kind"] == "plan_change"]
+        assert events == []
+        # DEGRADED flip (device[group] -> host(...)): severity warn
+        _feed(h, "dg", 0.10, ["host(fragment:group-space)"], 1, n=3)
+        events = [e for e in st.obs.events.snapshot()
+                  if e["kind"] == "plan_change"]
+        assert len(events) == 1, "throttled to one event per window"
+        assert events[0]["severity"] == "warn"
+        assert events[0]["digest"] == "dg"
+        assert "host(fragment:group-space)" in events[0]["detail"]
+        # a NON-degrading flip is info
+        _feed(h, "dg", 0.01, ["device[group]@mesh8"], 2)
+        events = [e for e in st.obs.events.snapshot()
+                  if e["kind"] == "plan_change"]
+        assert len(events) == 2 and events[-1]["severity"] == "info"
+        assert st.obs.metrics.counter(
+            "tidb_history_plan_changes_total").get(kind="degraded") == 1
+    finally:
+        st.close()
+
+
+def test_intra_window_plan_flap_keeps_last_plan_current():
+    """A->B->A inside one window: every read surface must call A (the
+    LAST-executed plan) current, not B (first-seen-second order)."""
+    h = WorkloadHistory()
+    h.configure(enabled=True)
+    plan_a, plan_b = (obs_history.plan_digest_of(["device"]),
+                      obs_history.plan_digest_of(["device[group]"]))
+    h.observe("fl", "q", "test", 0.01, engines=["device"],
+              now=1_000_000)
+    h.observe("fl", "q", "test", 0.01, engines=["device[group]"],
+              now=1_000_010)
+    h.observe("fl", "q", "test", 0.01, engines=["device"],
+              now=1_000_020)
+    cur = {r[0]: r[1] for r in h.plan_rows() if r[13] == 1}
+    assert cur == {"fl": plan_a}, (h.plan_rows(), plan_a, plan_b)
+
+
+def test_failed_statements_do_not_pollute_plan_history():
+    """An interrupted statement carries a truncated engine-tag set and
+    an unrepresentative latency: it must count as an ERROR on the
+    digest's known plan, never derive a bogus plan digest, fire
+    plan_change, or feed the regression baselines."""
+    st = Storage()
+    try:
+        h = st.history
+        h.configure(enabled=True)
+        _feed(h, "fx", 0.01, ["device[group]"], 0, n=2)
+        h.observe("fx", "q", "test", 5.0, engines=[], failed=True,
+                  now=1_000_002)
+        snap = st.history.snapshot()
+        assert len(snap["live"]) == 1, snap
+        ent = snap["live"][0]
+        assert ent["errors"] == 1 and ent["exec_count"] == 2
+        assert abs(ent["sum_wall_ms"] - 20.0) < 1e-6  # 5s not recorded
+        assert not [e for e in st.obs.events.snapshot()
+                    if e["kind"] == "plan_change"]
+        # a failed statement for an UNKNOWN digest records nothing
+        h.observe("new", "q", "test", 5.0, engines=[], failed=True,
+                  now=1_000_003)
+        assert len(st.history.snapshot()["live"]) == 1
+    finally:
+        st.close()
+
+
+def test_max_backups_zero_with_rotation_rejected():
+    cfg = Config()
+    cfg.log.file.max_size = 300
+    cfg.log.file.max_backups = 0
+    with pytest.raises(Exception, match="max-backups"):
+        cfg.validate()
+    cfg.log.file.max_size = 0  # rotation off: 0 backups is fine
+    cfg.validate()
+
+
+def test_engine_class_ordering():
+    assert obs_history.engine_class(["host(x)", "device"]) == 0
+    assert obs_history.engine_class(["ranged"]) == 1
+    assert obs_history.engine_class(["device[agg]@mesh8"]) == 2
+    assert obs_history.engine_class(["replica@h:1"]) == 2
+    assert obs_history.engine_class(["point"]) == 3
+    assert obs_history.engine_class([]) == 2  # nothing to regress off
+
+
+# ==================== regression rules ====================
+
+RESULT_SQL = ("select rule, item, severity, value, details "
+              "from information_schema.inspection_result")
+
+
+def test_regression_rules_fire_on_synthetic_telemetry():
+    st = Storage()
+    try:
+        h = st.history
+        h.configure(enabled=True, regression_ratio=1.5)
+        # windows feed in order (the clock only moves forward):
+        # pr = plan flip that got 10x slower -> plan-regression;
+        # sp = same plan, drifted 10x -> stmt-perf-regression;
+        # ok = stable -> silence
+        for win in range(3):
+            _feed(h, "pr", 0.010, ["device[group]"], win, n=2)
+            _feed(h, "sp", 0.010, ["device"], win, n=2)
+            _feed(h, "ok", 0.010, ["device"], win, n=2)
+        _feed(h, "pr", 0.100, ["host(fragment:x)"], 3, n=2)
+        _feed(h, "sp", 0.100, ["device"], 3, n=2)
+        _feed(h, "ok", 0.010, ["device"], 3, n=2)
+        rows = Session(st).execute(RESULT_SQL).rows
+        pr = [r for r in rows if r[0] == "plan-regression"]
+        sp = [r for r in rows if r[0] == "stmt-perf-regression"]
+        assert pr and pr[0][1] == "pr", rows
+        assert pr[0][2] == "critical"  # 10x >= 2 * ratio
+        assert "historical p50" in pr[0][4]
+        assert sp and sp[0][1] == "sp", rows
+        assert not any(r[1] == "ok" for r in rows)
+    finally:
+        st.close()
+
+
+def test_regression_rules_silent_on_healthy_history():
+    st = Storage()
+    try:
+        st.history.configure(enabled=True)
+        for win in range(4):
+            _feed(st.history, "hh", 0.01, ["device"], win, n=2)
+        rows = Session(st).execute(RESULT_SQL).rows
+        assert rows == [], rows
+    finally:
+        st.close()
+
+
+# ==================== the acceptance path: forced degradation =======
+
+def test_forced_plan_degradation_fires_plan_change_and_regression():
+    """ISSUE 15 acceptance: a known digest's device plan degrading to
+    the host path fires plan_change AND a plan-regression finding in
+    information_schema.inspection_result — the degraded run goes
+    through the REAL statement path."""
+    import unittest.mock as mock
+
+    from tidb_tpu.copr.client import CopClient
+
+    st = Storage()
+    try:
+        s = Session(st)
+        s.execute("create table f (a int primary key, b int)")
+        s.execute("insert into f values (1, 10), (2, 20), (3, 30)")
+        sql = "select sum(b) from f where a > 0"
+        digest, norm = _digest_of(st, sql)
+        st.history.configure(enabled=True, regression_ratio=1.5)
+        # the digest's recorded history: the device plan takes ~0.1ms,
+        # so the real host-path run below is provably >= ratio slower
+        _feed(st.history, digest, 0.0001, ["device"], 0, n=4,
+              text=norm)
+        st.history.flush()
+        assert len(st.history.snapshot()["records"]) >= 1
+
+        def degrade(self, dag, snap, sparse_gate=True):
+            return None, "forced-degradation"
+
+        with mock.patch.object(CopClient, "_prepare", degrade):
+            assert s.execute(sql).rows  # real run, host path
+        assert any(e.startswith("host(") for e in s.last_engines), \
+            s.last_engines
+        events = [e for e in st.obs.events.snapshot()
+                  if e["kind"] == "plan_change" and e["digest"] == digest]
+        assert events and events[-1]["severity"] == "warn", \
+            st.obs.events.snapshot()
+        rows = [r for r in s.execute(RESULT_SQL).rows
+                if r[0] == "plan-regression" and r[1] == digest]
+        assert rows, s.execute(RESULT_SQL).rows
+        # the event is queryable through the SQL surface too
+        ev_rows = s.execute(
+            "select kind, digest from information_schema.tidb_events "
+            "where kind = 'plan_change'").rows
+        assert ("plan_change", digest) in ev_rows
+    finally:
+        st.close()
+
+
+# ==================== cluster fan-out ====================
+
+@pytest.fixture()
+def cluster(tmp_path):
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    follower = Storage(str(tmp_path / "follower"),
+                       remote=f"127.0.0.1:{leader.rpc_server.port}",
+                       rpc_options=OPTS)
+    try:
+        yield leader, follower
+    finally:
+        follower.close()
+        leader.close()
+
+
+def test_cluster_history_rows_from_both_members(cluster):
+    leader, follower = cluster
+    for st, dg in ((leader, "ld"), (follower, "fw")):
+        st.history.configure(enabled=True)
+        _feed(st.history, dg, 0.01, ["device[group]"], 0)
+        _feed(st.history, dg, 0.01, ["device[group]"], 1)
+    sl = Session(leader)
+    rows = sl.execute(
+        "select instance, digest, plan_strategy, error from "
+        "information_schema.cluster_statements_summary_history").rows
+    by_inst = {r[0]: r[1] for r in rows if r[3] is None}
+    assert by_inst == {leader.diag_address: "ld",
+                       follower.diag_address: "fw"}, rows
+    assert all(r[2] == "group" for r in rows if r[3] is None)
+    prows = sl.execute(
+        "select instance, digest, current_plan, error from "
+        "information_schema.cluster_plan_history").rows
+    assert {r[0] for r in prows if r[3] is None} == \
+        {leader.diag_address, follower.diag_address}
+
+
+def test_cluster_history_peer_down_degrades(cluster):
+    leader, follower = cluster
+    leader.history.configure(enabled=True)
+    follower.history.configure(enabled=True)
+    sl = Session(leader)
+    failpoint.enable("diag/peer-down")
+    try:
+        rows = sl.execute(
+            "select instance, error from "
+            "information_schema.cluster_statements_summary_history").rows
+    finally:
+        failpoint.disable("diag/peer-down")
+    err = [r for r in rows if r[1] is not None]
+    assert err and any(follower.diag_address == r[0] for r in err), rows
+    assert any("unreachable" in w[2] for w in sl.warnings), sl.warnings
+
+
+# ==================== lint coverage (CI/tooling satellite) =========
+
+def test_history_rules_and_metrics_pass_registry_lints():
+    """The new history surfaces ride the existing lint planes: both
+    inspection rules are registered kebab-cased with references
+    (obs_inspect.lint_rules), the tidb_history_* metric families pass
+    the metric-hygiene lint on a live registry, and the [history]
+    knobs are inside the config-knob-drift rule's coverage (they parse
+    out of EXAMPLE, so a dead knob fails `analysis --check`)."""
+    from tidb_tpu import obs
+
+    assert "plan-regression" in obs_inspect.RULES
+    assert "stmt-perf-regression" in obs_inspect.RULES
+    assert obs_inspect.lint_rules() == []
+    for rule in ("plan-regression", "stmt-perf-regression"):
+        assert "history" in obs_inspect.RULES[rule].reference
+    st = Storage()
+    try:
+        fams = st.obs.metrics.families()
+        for fam in ("tidb_history_records",
+                    "tidb_history_rotations_total",
+                    "tidb_history_plan_changes_total",
+                    "tidb_history_persist_failures_total"):
+            assert fam in fams, fam
+        assert obs.lint_metrics([st.obs.metrics]) == []
+    finally:
+        st.close()
+    # the [history] knobs are part of the example contract the
+    # config-knob-drift rule walks
+    from tidb_tpu.config import EXAMPLE
+    assert "[history]" in EXAMPLE and "regression-ratio" in EXAMPLE
+    assert "[log.file]" in EXAMPLE and "max-backups" in EXAMPLE
+
+
+# ==================== debug payload ====================
+
+def test_debug_payload_shape():
+    st = Storage()
+    try:
+        st.history.configure(enabled=True)
+        _feed(st.history, "dp", 0.01, ["device"], 0)
+        _feed(st.history, "dp", 0.01, ["device"], 1)
+        p = st.history.debug_payload()
+        assert p["enabled"] is True
+        assert len(p["records"]) == 1 and len(p["live"]) == 1
+        assert p["regressions"] == []
+        json.dumps(p)  # the /debug/history route serves exactly this
+    finally:
+        st.close()
+
+
+# ==================== slow-log file rotation (ISSUE 15 satellite) ===
+
+def test_slow_log_file_rotation(tmp_path):
+    slow_file = str(tmp_path / "slow.log")
+    cfg = Config()
+    cfg.log.slow_query_file = slow_file
+    cfg.log.file.max_size = 1       # MB
+    cfg.log.file.max_backups = 2
+    cfg.apply_log_level()
+    slow = logging.getLogger("tidb_tpu.slowlog")
+    # idempotent re-apply: one sink, not a stack of them
+    cfg.apply_log_level()
+    sinks = [h for h in slow.handlers
+             if getattr(h, "_titpu_slow_sink", False)]
+    assert len(sinks) == 1
+    try:
+        line = "x" * 2048
+        for i in range(2000):  # ~4MB through a 1MB cap
+            slow.warning("slow query #%d %s", i, line)
+        base = os.path.basename(slow_file)
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.startswith(base))
+        # base + at most max-backups rotated files, never more
+        assert base in files
+        assert files == [base, base + ".1", base + ".2"], files
+        assert os.path.getsize(slow_file) <= 1.2 * (1 << 20)
+    finally:
+        for h in sinks:
+            slow.removeHandler(h)
+            h.close()
+
+
+def test_rotation_disabled_with_zero_max_size(tmp_path):
+    slow_file = str(tmp_path / "slow.log")
+    cfg = Config()
+    cfg.log.slow_query_file = slow_file
+    cfg.log.file.max_size = 0
+    cfg.apply_log_level()
+    slow = logging.getLogger("tidb_tpu.slowlog")
+    sinks = [h for h in slow.handlers
+             if getattr(h, "_titpu_slow_sink", False)]
+    try:
+        for i in range(50):
+            slow.warning("slow query #%d %s", i, "y" * 4096)
+        assert os.path.exists(slow_file)
+        assert not os.path.exists(slow_file + ".1")
+    finally:
+        for h in sinks:
+            slow.removeHandler(h)
+            h.close()

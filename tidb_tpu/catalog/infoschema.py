@@ -93,6 +93,37 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("max_mem_bytes", _bigint()), ("sum_spill_count", _bigint()),
         ("first_seen", _vc(20)), ("last_seen", _vc(20)),
     ],
+    # workload-history plane (reference: util/stmtsummary's windowed
+    # persistence behind STATEMENTS_SUMMARY_HISTORY): one row per
+    # rotated window x (sql_digest, plan_digest) — wall/stage split,
+    # engine tags + fragment strategy, rows, mesh skew — read back
+    # from <path>/history/ across restarts. Empty (zero work) while
+    # history.enabled is false.
+    "statements_summary_history": [
+        ("summary_begin_time", _vc(20)), ("summary_end_time", _vc(20)),
+        ("digest", _vc(32)), ("schema_name", _vc()),
+        ("digest_text", _vc(512)), ("plan_digest", _vc(32)),
+        ("engines", _vc(256)), ("plan_strategy", _vc(64)),
+        ("exec_count", _bigint()), ("sum_errors", _bigint()),
+        ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("sum_rows", _bigint()), ("stages", _vc(256)),
+        ("mesh_skew", FieldType(TypeKind.DOUBLE)),
+    ],
+    # per-(digest, plan) rollup of the whole retained history — the
+    # "which plan won" view the plan-regression rule and ROADMAP item
+    # 5's adaptive fragment-strategy choice read
+    "tidb_plan_history": [
+        ("digest", _vc(32)), ("plan_digest", _vc(32)),
+        ("digest_text", _vc(512)), ("engines", _vc(256)),
+        ("plan_strategy", _vc(64)), ("windows", _bigint()),
+        ("exec_count", _bigint()), ("sum_errors", _bigint()),
+        ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("p50_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("first_seen", _vc(20)), ("last_seen", _vc(20)),
+        ("current_plan", _bigint()),
+    ],
     # the queryable slow log (reference: executor/slow_query.go parsing
     # the slow-log file back into INFORMATION_SCHEMA.SLOW_QUERY)
     "slow_query": [
@@ -266,6 +297,33 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
         ("sum_result_rows", _bigint()), ("last_seen", _vc(20)),
         ("error", _vc(256)),
+    ],
+    # cluster-wide workload history: every member's rotated windows /
+    # plan rollups under one roof, degrading per peer
+    "cluster_statements_summary_history": [
+        ("instance", _vc()), ("summary_begin_time", _vc(20)),
+        ("summary_end_time", _vc(20)), ("digest", _vc(32)),
+        ("schema_name", _vc()), ("digest_text", _vc(512)),
+        ("plan_digest", _vc(32)), ("engines", _vc(256)),
+        ("plan_strategy", _vc(64)), ("exec_count", _bigint()),
+        ("sum_errors", _bigint()),
+        ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("sum_rows", _bigint()), ("stages", _vc(256)),
+        ("mesh_skew", FieldType(TypeKind.DOUBLE)),
+        ("error", _vc(256)),
+    ],
+    "cluster_plan_history": [
+        ("instance", _vc()), ("digest", _vc(32)),
+        ("plan_digest", _vc(32)), ("digest_text", _vc(512)),
+        ("engines", _vc(256)), ("plan_strategy", _vc(64)),
+        ("windows", _bigint()), ("exec_count", _bigint()),
+        ("sum_errors", _bigint()),
+        ("avg_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("p50_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("max_latency_ms", FieldType(TypeKind.DOUBLE)),
+        ("first_seen", _vc(20)), ("last_seen", _vc(20)),
+        ("current_plan", _bigint()), ("error", _vc(256)),
     ],
     # cluster-wide automated diagnosis: every member's inspection
     # findings under one roof, degrading per peer like the other
@@ -521,6 +579,11 @@ def _rows_for(storage, catalog: Catalog, tname: str,
         rows = storage.diag.diag_mesh_storage()["rows"]
     elif tname == "tidb_events":
         rows = storage.diag.diag_events()["rows"]
+    elif tname == "statements_summary_history":
+        # same producer as the cluster fan-out (minus instance/error)
+        rows = storage.diag.diag_history()["rows"]
+    elif tname == "tidb_plan_history":
+        rows = storage.diag.diag_plan_history()["rows"]
     elif tname == "inspection_result":
         # same producer as the cluster fan-out (minus instance/error)
         rows = storage.diag.diag_inspection()["rows"]
@@ -541,7 +604,9 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                    "cluster_slow_query", "cluster_statements_summary",
                    "cluster_load", "cluster_top_sql",
                    "cluster_mesh_shards", "cluster_mesh_storage",
-                   "cluster_inspection_result"):
+                   "cluster_inspection_result",
+                   "cluster_statements_summary_history",
+                   "cluster_plan_history"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)

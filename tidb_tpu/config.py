@@ -23,11 +23,24 @@ class ConfigError(Exception):
 
 
 @dataclass
+class LogFileConfig:
+    """The `[log.file]` TOML section (reference: config.go Log.File —
+    lumberjack rotation knobs). Applies to the slow-query file sink:
+    the file rotates by atomic rename at max-size, keeping max-backups
+    rotated files, so a history-era long-running server cannot grow an
+    unbounded slow log."""
+
+    max_size: int = 300              # MB per file; 0 = never rotate
+    max_backups: int = 2             # rotated files kept
+
+
+@dataclass
 class LogConfig:
     level: str = "info"
     slow_threshold: int = 300        # ms (reference: log.slow-threshold)
     slow_query_file: str = ""
     format: str = "text"
+    file: LogFileConfig = field(default_factory=LogFileConfig)
 
 
 @dataclass
@@ -157,6 +170,30 @@ class DiagnosticsConfig:
     # (warning; critical at 3x — the replica stopped advancing); 0
     # disables the rule
     apply_lag_warn_ms: int = 2000
+
+
+@dataclass
+class HistoryConfig:
+    """The `[history]` TOML section: the workload-history plane
+    (tidb_tpu/obs_history.py WorkloadHistory is the runtime owner —
+    field names/defaults MIRROR it, mirrored rather than imported so
+    config parsing never pulls the obs chain; tests/test_history.py
+    pins the two definitions equal)."""
+
+    # master switch: off = ZERO statement-path work (the Top SQL
+    # contract); on = every completed statement feeds the per-digest
+    # (sql_digest, plan_digest) history, rotated windows persist under
+    # <path>/history/ and survive restarts
+    enabled: bool = False
+    # one live aggregation window's span; a closed window rotates into
+    # the durable record list (and to disk) at the next observation
+    window_seconds: int = 60
+    # durable records retained (oldest rotated out first)
+    history_cap: int = 512
+    # plan-regression / stmt-perf-regression threshold: a new plan (or
+    # a drifted same-plan window) at least this many times slower than
+    # the historical p50 is a finding
+    regression_ratio: float = 1.5
 
 
 @dataclass
@@ -292,6 +329,7 @@ class Config:
     mesh: MeshSection = field(default_factory=MeshSection)
     diagnostics: DiagnosticsConfig = field(
         default_factory=DiagnosticsConfig)
+    history: HistoryConfig = field(default_factory=HistoryConfig)
     replica_read: ReplicaReadConfig = field(
         default_factory=ReplicaReadConfig)
     gc: GCConfig = field(default_factory=GCConfig)
@@ -440,6 +478,28 @@ class Config:
             raise ConfigError(
                 "diagnostics.apply-lag-warn-ms must be >= 0 "
                 "(0 disables the follower-apply-lag rule)")
+        h = self.history
+        if h.window_seconds < 1:
+            raise ConfigError("history.window-seconds must be >= 1")
+        if h.history_cap < 1:
+            raise ConfigError("history.history-cap must be >= 1")
+        if h.regression_ratio < 1.0:
+            raise ConfigError(
+                "history.regression-ratio must be >= 1.0 (a plan this "
+                "many times slower than its history is a regression)")
+        if self.log.file.max_size < 0:
+            raise ConfigError(
+                "log.file.max-size must be >= 0 (0 = never rotate)")
+        if self.log.file.max_size > 0 and self.log.file.max_backups < 1:
+            # RotatingFileHandler with backupCount=0 never rolls over:
+            # the file would grow unbounded while paying a close+reopen
+            # per record past the threshold — reject the combination
+            raise ConfigError(
+                "log.file.max-backups must be >= 1 when max-size > 0 "
+                "(rotation keeps at least one backup; set max-size = 0 "
+                "to disable rotation)")
+        if self.log.file.max_backups < 0:
+            raise ConfigError("log.file.max-backups must be >= 0")
         rr = self.replica_read
         if rr.max_staleness_ms < 0:
             raise ConfigError(
@@ -508,6 +568,13 @@ class Config:
         "diagnostics.admission_shed_threshold",
         "diagnostics.row_eval_threshold",
         "diagnostics.apply_lag_warn_ms",
+        # the workload-history plane toggles/tunes live: arming the
+        # plan/perf history to chase a production plan flip must not
+        # need a restart (the Top SQL precedent)
+        "history.enabled",
+        "history.window_seconds",
+        "history.history_cap",
+        "history.regression_ratio",
         # the follower read tier toggles/tunes live: routing policy and
         # staleness bounds must not need a restart (the apply cadence
         # does — it is a thread's wait interval, fixed at arm time)
@@ -559,8 +626,16 @@ class Config:
                 slow.removeHandler(h)
                 h.close()
         if self.log.slow_query_file:
-            fh = logging.FileHandler(self.log.slow_query_file,
-                                     encoding="utf-8", delay=True)
+            # rotate by atomic rename at log.file.max-size, keeping
+            # log.file.max-backups rotated files (reference: the
+            # lumberjack rotation behind config.go Log.File) — a
+            # long-running server's slow log stays bounded. max-size 0
+            # keeps the legacy never-rotating sink.
+            from logging.handlers import RotatingFileHandler
+            fh = RotatingFileHandler(
+                self.log.slow_query_file, encoding="utf-8", delay=True,
+                maxBytes=self.log.file.max_size * (1 << 20),
+                backupCount=self.log.file.max_backups)
             fh.setFormatter(fmt)
             fh._titpu_slow_sink = True  # type: ignore[attr-defined]
             slow.addHandler(fh)
@@ -647,6 +722,16 @@ class Config:
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
         st._status_cache = None
+
+    def seed_history(self, storage) -> None:
+        """Arm the workload-history plane from the [history] knobs
+        (startup and SIGHUP hot reload both call this)."""
+        h = self.history
+        storage.history.configure(
+            enabled=h.enabled,
+            window_seconds=h.window_seconds,
+            history_cap=h.history_cap,
+            regression_ratio=h.regression_ratio)
 
     def seed_replica_read(self, storage) -> None:
         """Arm the follower read tier from the [replica-read] knobs
@@ -859,6 +944,15 @@ slow-threshold = 300           # ms; statements slower than this are logged
 slow-query-file = ""
 format = "text"
 
+[log.file]
+# Rotation of the slow-query file sink: at max-size (MB) the file
+# rotates by atomic rename (slow.log -> slow.log.1, shifting), keeping
+# max-backups rotated files — a long-running server's slow log stays
+# bounded. max-size = 0 disables rotation; with rotation on,
+# max-backups must be >= 1 (at least one backup is kept).
+max-size = 300
+max-backups = 2
+
 [storage]
 # When the KV write-ahead log reaches disk (the acked-commit loss
 # window under POWER loss; process crashes lose nothing either way):
@@ -1031,6 +1125,27 @@ row-eval-threshold = 1
 # a serving replica's apply lag past this fires follower-apply-lag
 # (warning; critical at 3x — the replica stopped advancing); 0 disables
 apply-lag-warn-ms = 2000
+
+[history]
+# Workload history plane (information_schema.statements_summary_history
+# / tidb_plan_history + cluster_ variants, /debug/history): every
+# completed statement feeds a per-(sql_digest, plan_digest) history —
+# wall/stage split, engine tags with the fragment strategy, rows, mesh
+# skew — aggregated in window-seconds windows; closed windows rotate
+# into a durable record list persisted crash-atomically under
+# <path>/history/ (tmp+fsync+rename), surviving restarts. A digest
+# executing with a NEW plan digest (or a degraded engine class:
+# device -> host fallback, point fast path -> full dispatch) fires a
+# throttled `plan_change` event, and two inspection rules read the
+# history: plan-regression (new plan >= regression-ratio slower than
+# the replaced plan's p50) and stmt-perf-regression (same plan,
+# sustained drift vs its own baseline). Off by default: disabled it
+# costs ZERO work on the statement path (the Top SQL contract).
+# Hot-reloadable via SIGHUP.
+enabled = false
+window-seconds = 60
+history-cap = 512
+regression-ratio = 1.5
 
 [replica-read]
 # Follower read tier: followers fold their mirrored (snapshot, WAL)

@@ -74,6 +74,14 @@ _I32_MIN = np.int32(-(2**31) + 1)
 
 # dense segment space caps per reduction strategy
 MAX_LOOP_SEGMENTS = 64
+# dense-vs-sort group strategy gate (_prepare_agg): an einsum over a
+# segment space at least this wide whose estimated occupancy
+# (rows / Π(card)) is under the per-slot floor reroutes to the
+# sorted-run "group" mode — the mostly-empty one-hot matmul is
+# FLOPs-bound on exactly the spaces the sort path handles in
+# n log n (Q7's 6084-slot space at ~99 rows/slot, r06's 28s query)
+DENSE_SPARSE_MIN_SEGMENTS = 1024
+DENSE_MIN_ROWS_PER_SEGMENT = 128
 MAX_DENSE_SEGMENTS = 1 << 13
 
 _FLOAT_BLOCKS = 32  # per-segment f32 block partials (host sums in f64)
@@ -311,6 +319,16 @@ class CopClient:
                     if sp:
                         sp.note = r.engine
                     return r
+                if fallback.startswith("sparse segment space"):
+                    # the sort-grouped preference could not be honored
+                    # (group lift ineligible or gated out): the dense
+                    # einsum is still correct and still a device path —
+                    # retry without the sparse gate before conceding
+                    # the host
+                    with obs.stage("prepare", span_name="copr.prepare"):
+                        prepared, fallback = self._prepare(
+                            dag, snap, sparse_gate=False)
+            if fallback is not None:
                 obs.COPR_REQUESTS.inc(engine="host")
                 with obs.stage("host_fallback",
                                span_name="copr.host_fallback") as hsp:
@@ -351,6 +369,7 @@ class CopClient:
                 dag.limit is not None:
             return None
         if not (reason.startswith("group keys not dense-encodable")
+                or reason.startswith("sparse segment space")
                 or "min/max or float aggregates" in reason):
             return None
         from ..plan.dag import agg_partial_width
@@ -448,7 +467,7 @@ class CopClient:
         return out
 
     def _prepare(
-        self, dag: CopDAG, snap: TableSnapshot
+        self, dag: CopDAG, snap: TableSnapshot, sparse_gate: bool = True
     ) -> tuple[Optional[dict[Any, Any]], Optional[str]]:
         """Resolve string constants/predicates against column dictionaries,
         pick the aggregation strategy, bound value ranges, and build the
@@ -494,7 +513,8 @@ class CopClient:
         if dag.agg is not None:
             err = self._prepare_agg(
                 dag, dicts, col_bounds, prepared,
-                snap.epoch.num_rows + len(snap.overlay_handles))
+                snap.epoch.num_rows + len(snap.overlay_handles),
+                sparse_gate=sparse_gate)
             if err is not None:
                 return None, err
         if dag.topn is not None:
@@ -504,7 +524,8 @@ class CopClient:
         return prepared, None
 
     def _prepare_agg(self, dag, dicts, col_bounds, prepared,
-                     n_rows: int) -> Optional[str]:
+                     n_rows: int, sparse_gate: bool = True
+                     ) -> Optional[str]:
         cards, offsets = self._dense_cards(dag, dicts, col_bounds)
         if cards is None:
             return "group keys not dense-encodable on device"
@@ -569,6 +590,25 @@ class CopClient:
                     "is host-side")
         else:
             strategy = "einsum"
+        if strategy == "einsum" and sparse_gate and \
+                segments >= DENSE_SPARSE_MIN_SEGMENTS and \
+                n_rows < segments * DENSE_MIN_ROWS_PER_SEGMENT:
+            # dense-vs-sort strategy gate (ISSUE 15): the one-hot
+            # einsum pays n_rows x segments FLOPs whether or not the
+            # slots are occupied, so a WIDE space with thin estimated
+            # occupancy (rows / Π(card) below the per-slot floor —
+            # Q7's 26*26*9 = 6084-slot space holds ~4 live groups at
+            # any scale) is better served by the PR 14 sorted-run
+            # "group" mode, whose cost tracks n_rows log n_rows. Only
+            # spaces the candidate buffer can PROVABLY hold reroute
+            # (segments <= HAVING_CAP bounds the group count), so the
+            # sort path cannot overflow back to the host; callers that
+            # cannot take the sorted-run path retry with
+            # sparse_gate=False and keep the dense einsum.
+            from ..plan.fragment import FragmentDAG
+            if segments <= FragmentDAG.HAVING_CAP:
+                return (f"sparse segment space: {segments} slots over "
+                        f"{n_rows} rows (sort-grouped path preferred)")
         prepared["__strategy__"] = strategy
         prepared["__agg_sched__"] = sched
         prepared["__sig__"].append((

@@ -242,21 +242,31 @@ def _device_fragment(cop, frag, snaps) -> CopResult:
         err = cop._prepare_agg(facade, comb_dicts, comb_bounds, prepared,
                                n_rows)
         if err is not None:
-            # dense segment space rejected; the sorted-run candidate
-            # machinery (copr/hcagg.py) covers the rest: a TopN consumer
-            # takes the top-k candidate path, a HAVING consumer the
-            # filtered path, and ANY other consumer the all-groups
-            # "group" mode — sort + segment-reduce with a cap-checked
-            # candidate buffer, so an arbitrary multi-key GROUP BY stays
-            # on device whenever its group count fits the buffer
+            # dense segment space rejected (or deliberately skipped:
+            # the sparse-occupancy gate routes wide, mostly-empty
+            # einsum spaces here); the sorted-run candidate machinery
+            # (copr/hcagg.py) covers the rest: a TopN consumer takes
+            # the top-k candidate path, a HAVING consumer the filtered
+            # path, and ANY other consumer the all-groups "group" mode
+            # — sort + segment-reduce with a cap-checked candidate
+            # buffer, so an arbitrary multi-key GROUP BY stays on
+            # device whenever its group count fits the buffer
             if len(psnap.overlay_handles) > 0 or \
                     not _prepare_hc(frag, comb_bounds, prepared, n_rows):
-                raise _Fallback("group-space")
-            mode = "hc"
-            if frag.hc is None and not frag.having:
-                prepared["__hc_all__"] = True
-                prepared["__sig__"].append(
-                    ("hcall", FragmentDAG.HAVING_CAP))
+                if not err.startswith("sparse segment space") or \
+                        cop._prepare_agg(facade, comb_dicts, comb_bounds,
+                                         prepared, n_rows,
+                                         sparse_gate=False) is not None:
+                    raise _Fallback("group-space")
+                # the sparse-occupancy preference could not take the
+                # sorted-run path here (overlay rows / an hc gate):
+                # the dense einsum still serves the query on device
+            else:
+                mode = "hc"
+                if frag.hc is None and not frag.having:
+                    prepared["__hc_all__"] = True
+                    prepared["__sig__"].append(
+                        ("hcall", FragmentDAG.HAVING_CAP))
 
     if mode == "hc" and not getattr(cop, "supports_hc", True):
         # a client with neither single-device hc nor a group exchange

@@ -185,6 +185,12 @@ class InspectionContext:
         self.mesh_client = client
         self.mesh = client.recorder.snapshot() if client is not None \
             else {"dispatches": [], "compiles": []}
+        # workload-history regression findings, computed ONCE per
+        # snapshot (both history rules read this list; an absent or
+        # disabled history plane contributes nothing)
+        hist = getattr(storage, "history", None)
+        self.history_findings = hist.regression_findings() \
+            if hist is not None and hist.enabled else []
 
     # ---- helpers rules share -------------------------------------------
     def metric(self, labeled_name: str) -> float:
@@ -545,6 +551,35 @@ def _r_lock_order_inversion(ctx: InspectionContext) -> list[Finding]:
                 f"blocking syscall with a hot lock held "
                 f"({f['item']}, x{f.get('count', 1)}): every peer of "
                 f"that lock serializes behind the syscall"))
+    return out
+
+
+@rule("plan-regression", "warning",
+      "history.regression-ratio — a digest executes a NEW plan at "
+      "least this many times slower than the historical p50 of the "
+      "plan it replaced (information_schema.tidb_plan_history names "
+      "both plans; Session.last_engines / the plan_change event name "
+      "the path that changed)")
+def _r_plan_regression(ctx: InspectionContext) -> list[Finding]:
+    out = []
+    for f in ctx.history_findings:
+        if f["rule"] == "plan-regression":
+            out.append(Finding("plan-regression", f["item"],
+                               f["severity"], f["value"], f["details"]))
+    return out
+
+
+@rule("stmt-perf-regression", "warning",
+      "history.regression-ratio — a digest's latency drifted past the "
+      "ratio against its own baseline windows ON THE SAME plan "
+      "(information_schema.statements_summary_history has the "
+      "window-by-window trajectory)")
+def _r_stmt_perf_regression(ctx: InspectionContext) -> list[Finding]:
+    out = []
+    for f in ctx.history_findings:
+        if f["rule"] == "stmt-perf-regression":
+            out.append(Finding("stmt-perf-regression", f["item"],
+                               f["severity"], f["value"], f["details"]))
     return out
 
 

@@ -54,6 +54,8 @@ TABLE_METHODS = {
     "cluster_mesh_shards": "diag_mesh_shards",
     "cluster_mesh_storage": "diag_mesh_storage",
     "cluster_inspection_result": "diag_inspection",
+    "cluster_statements_summary_history": "diag_history",
+    "cluster_plan_history": "diag_plan_history",
 }
 
 
@@ -165,6 +167,19 @@ class DiagService:
             rows.append([int(e["id"]), e["ts"], e["kind"], e["severity"],
                          int(e["conn_id"]), e["digest"], e["detail"]])
         return {"rows": rows}
+
+    def diag_history(self) -> dict:
+        """This server's workload-history windows (durable records +
+        the live window), row-shaped for statements_summary_history.
+        Empty — with zero work — while history.enabled is false."""
+        h = self.storage.history
+        return {"rows": h.table_rows() if h.enabled else []}
+
+    def diag_plan_history(self) -> dict:
+        """Per-(digest, plan) rollup of this server's retained
+        history, row-shaped for tidb_plan_history."""
+        h = self.storage.history
+        return {"rows": h.plan_rows() if h.enabled else []}
 
     def diag_inspection(self) -> dict:
         """This server's inspection findings: every registered rule of

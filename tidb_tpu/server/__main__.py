@@ -214,6 +214,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_observability(storage)
     cfg.seed_overload_protection(storage)
     cfg.seed_diagnostics(storage)
+    cfg.seed_history(storage)
     cfg.seed_replica_read(storage)
     cfg.seed_group_commit(storage)
     cfg.seed_mesh()
@@ -259,6 +260,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_observability(storage)
             cfg.seed_overload_protection(storage)
             cfg.seed_diagnostics(storage)
+            cfg.seed_history(storage)
             cfg.seed_replica_read(storage)
             cfg.seed_group_commit(storage)
             if srv._pool is not None:

@@ -33,6 +33,10 @@ Debug routes:
       inspection rule evaluated over the live telemetry snapshot,
       full findings + per-rule summary (JSON; empty with zero rule
       work while diagnostics.enabled is false)
+  /debug/history  the workload-history plane ([history] knobs):
+      durable per-(sql_digest, plan_digest) windowed records + the
+      live window, and the current plan/perf regression findings
+      (JSON; empty payload while history.enabled is false)
   /debug/lockgraph  the dynamic lock-order checker
       (TIDB_TPU_LOCK_CHECK / [analysis] lock-check): instrumented
       locks, observed acquisition edges, cycles (potential
@@ -210,6 +214,22 @@ class StatusServer:
                         from ..rpc import replica as _replica
                         payload = _replica.debug_payload(
                             outer.sql_server.storage)
+                    except Exception as e:  # noqa: BLE001
+                        payload = {"error": str(e)[:200]}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/history"):
+                    if outer.sql_server is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # workload-history plane: knobs, durable records +
+                    # the live window, and the current regression
+                    # findings; degrades to an error payload like the
+                    # other /debug routes
+                    try:
+                        payload = outer.sql_server.storage.history \
+                            .debug_payload()
                     except Exception as e:  # noqa: BLE001
                         payload = {"error": str(e)[:200]}
                     body = json.dumps(payload).encode()

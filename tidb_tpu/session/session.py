@@ -476,12 +476,24 @@ class Session:
             # the statement path (the digest/normalize hash is the
             # expensive part)
             topsql = o.topsql
-            if slow or (topsql.enabled and digest_sql is not None):
+            # workload-history feed: gated on `enabled` HERE like the
+            # Top SQL plane, so a disabled history plane costs zero
+            # work and zero allocations on the statement path
+            history = self.storage.history
+            hist_on = history.enabled and digest_sql is not None
+            if slow or hist_on or \
+                    (topsql.enabled and digest_sql is not None):
                 import hashlib
                 # same digest the statements_summary uses, so slow-log
                 # and top-sql entries join against the digest table
                 norm = o.statements.normalize(digest_sql or sql)
                 digest = hashlib.sha256(norm.encode()).hexdigest()[:32]
+                if hist_on:
+                    history.observe(
+                        digest, norm[:512], self.current_db, dt,
+                        engines=rec.engines, stages=rec.totals,
+                        rows=rows_out, failed=failed,
+                        op_mesh=rec.op_mesh)
                 if topsql.enabled and digest_sql is not None:
                     topsql.record(
                         digest, norm[:512], self.current_db, dt,

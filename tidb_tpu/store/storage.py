@@ -254,6 +254,15 @@ class Storage:
         from .. import obs_inspect as _inspect
         self.diagnostics = _inspect.DiagnosticsState()
         _inspect.track(self)
+        # workload-history plane (obs_history.py): per-digest
+        # (sql_digest, plan_digest) plan/perf history, persisted under
+        # <path>/history/ across restarts. Disabled by default (the Top
+        # SQL zero-work contract); [history] config or embedded callers
+        # arm it via history.configure(enabled=True).
+        from ..obs_history import WorkloadHistory
+        self.history = WorkloadHistory(path=path,
+                                       metrics=self.obs.metrics,
+                                       events=self.obs.events)
         self._tso_lease = 0
         # serializes lease-file persistence: concurrent committers both
         # crossing the extension threshold raced the SAME tmp+rename
@@ -1128,6 +1137,13 @@ class Storage:
         # diag listener are joined here so no thread outlives the store
         # (the profiler-lifecycle contract tests/test_trace.py pins)
         self.metrics_history.stop()
+        # rotate + persist the live workload-history window so a clean
+        # shutdown keeps the newest partial window too (no-op while
+        # history is disabled; kill -9 keeps everything already rotated)
+        try:
+            self.history.flush()
+        except Exception:  # noqa: BLE001 — teardown must not fail
+            pass
         if self.diag_listener is not None:
             if self._rpc_client is not None:
                 from ..rpc.errors import RPCError as _RPCError
