@@ -1524,6 +1524,90 @@ def flight_htap_mixed(res: dict) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def flight_range_write(res: dict) -> None:
+    """Range-sharded write leadership: DURABLE (sync-log=commit,
+    percolator 2PC through the range RPC tier) write QPS against ONE
+    range leader vs N — the write-scaling claim of the range plane.
+    With one range every commit serializes behind one WAL stream; with
+    N ranges the same workload fans out over N independently-fsynced
+    engines, so durable QPS should grow until the disk saturates."""
+    import shutil
+
+    _session_env()
+    from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+    from tidb_tpu.kv.rangeclient import RangeRouter
+    from tidb_tpu.kv.rangemeta import split_keyspace
+    from tidb_tpu.kv.tso import TimestampOracle
+    from tidb_tpu.kv.twopc import TwoPhaseCommitter
+    from tidb_tpu.rpc.ranged import RangeServer
+
+    lines = res["lines"]
+    n_leaders = int(os.environ.get("BENCH_RANGE_LEADERS", 4))
+    workers = int(os.environ.get("BENCH_RANGE_WORKERS", 8))
+    seconds = float(os.environ.get("BENCH_RANGE_SECONDS", 6))
+    qps: dict[int, float] = {}
+    for count in (1, n_leaders):
+        tmp = tempfile.mkdtemp(prefix=f"bench-range-{count}-")
+        srv = None
+        routers: list = []
+        try:
+            srv = RangeServer(tmp, lease_ms=60_000,
+                              specs=split_keyspace(count),
+                              sync_log="commit")
+            tso = TimestampOracle()
+            stop = threading.Event()
+            counts = [0] * workers
+            # uniform single-key txns spread across the keyspace: the
+            # SAME workload both phases, only the range count changes
+            def worker(w: int) -> None:
+                router = RangeRouter(root=tmp)
+                routers.append(router)
+                committer = TwoPhaseCommitter(router, tso,
+                                              lock_ttl=3000)
+                i = 0
+                while not stop.is_set():
+                    key = bytes([(w * 37 + i * 11) % 256]) + \
+                        b"k%d.%d" % (w, i)
+                    committer.commit(
+                        [Mutation(OP_PUT, key, b"v%d" % i)], tso.ts())
+                    counts[w] += 1
+                    i += 1
+            threads = [threading.Thread(target=worker, args=(w,),
+                                        name=f"bench-range-w{w}",
+                                        daemon=True)
+                       for w in range(workers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            wall = time.perf_counter() - t0
+            qps[count] = sum(counts) / wall
+            lines.append(
+                f"range_write x{count} leader{'s' if count > 1 else ''}"
+                f": {qps[count]:.0f} durable txn/s "
+                f"({workers} workers, sync-log=commit, "
+                f"{sum(counts)} commits / {wall:.1f}s)")
+        finally:
+            for router in routers:
+                router.close()
+            if srv is not None:
+                srv.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+    res["values"]["range_write_qps_1"] = round(qps[1], 1)
+    res["values"][f"range_write_qps_{n_leaders}"] = \
+        round(qps[n_leaders], 1)
+    res["values"]["range_write_scaling"] = round(
+        qps[n_leaders] / max(qps[1], 1e-9), 2)
+    res["values"]["range_write_leaders"] = n_leaders
+    lines.append(
+        f"range_write scaling: "
+        f"{res['values']['range_write_scaling']:.2f}x durable write "
+        f"QPS at {n_leaders} range leaders vs 1")
+
+
 FLIGHTS = {
     "tpch_small": lambda res: flight_tpch(res, big=False),
     "tpch_big": lambda res: flight_tpch(res, big=True),
@@ -1533,6 +1617,7 @@ FLIGHTS = {
     "multichip": flight_multichip,
     "replica_read": flight_replica_read,
     "htap_mixed": flight_htap_mixed,
+    "range_write": flight_range_write,
 }
 
 
@@ -1809,7 +1894,7 @@ def main() -> None:
     flight_names = os.environ.get(
         "BENCH_FLIGHTS",
         "tpch_big,tpch_small,joins,ssb,cb,multichip,replica_read,"
-        "htap_mixed"
+        "htap_mixed,range_write"
     ).split(",")
     timeout = float(os.environ.get("BENCH_FLIGHT_TIMEOUT", 5400))
     values: dict = {}

@@ -1,0 +1,274 @@
+"""Per-range kill-9 chaos harness: REAL child processes, REAL SIGKILL.
+
+The acceptance suite for range-sharded write leadership (rpc/ranged.py
++ kv/rangeclient.py): range-leader children die by os._exit(9) at
+env-armed failpoints (range/before-prewrite-ack applied-but-unacked
+prewrite, range/before-commit-ack applied-but-unacked commit) or by a
+bare SIGKILL mid-workload; coordinator children die at the percolator
+phase boundaries (twopc/after-prewrite, twopc/after-primary-commit).
+Invariants asserted against an uncrashed oracle:
+
+  * survivors elect PER RANGE within the lease horizon, term bumped;
+  * every acknowledged commit is present after takeover (the range WAL
+    replays under sync-log=commit — prewrite/commit retries against
+    the successor are idempotent);
+  * a crashed coordinator's cross-range txn is all-or-nothing: rolled
+    BACK if it died before the primary commit, rolled FORWARD by peers
+    via primary-status check if it died after;
+  * the deposed leader's term is fenced — a stale routing view can
+    never write.
+
+Fast in-process protocol tests live in tests/test_ranges.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangemeta import split_keyspace
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import Snapshot, TwoPhaseCommitter
+from tidb_tpu.rpc.client import RpcClient, RpcOptions
+from tidb_tpu.rpc.errors import StaleTermError
+from tidb_tpu.rpc.frame import make_range_ctx
+from tidb_tpu.rpc.ranged import RangeDirectory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LEADER_SRC = """
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+kw = json.loads(os.environ["TIDB_TPU_RANGE_KW"])
+from tidb_tpu.kv.rangemeta import split_keyspace
+from tidb_tpu.rpc.ranged import RangeServer
+srv = RangeServer(kw["root"], lease_ms=kw.get("lease_ms", 500),
+                  specs=split_keyspace(kw.get("count", 2)))
+print(f"PORT={{srv.address}}", flush=True)
+signal.pause()
+"""
+
+COORD_SRC = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+kw = json.loads(os.environ["TIDB_TPU_RANGE_KW"])
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import TwoPhaseCommitter
+router = RangeRouter(root=kw["root"])
+tso = TimestampOracle()
+c = TwoPhaseCommitter(router, tso, lock_ttl=kw.get("ttl", 300))
+for name, pairs in kw["txns"]:
+    muts = [Mutation(OP_PUT, bytes.fromhex(k), v.encode())
+            for k, v in sorted(pairs.items())]
+    ts = c.commit(muts, tso.ts())
+    print(f"ACK {{name}} {{ts}}", flush=True)
+print("DONE", flush=True)
+router.close()
+"""
+
+
+def _spawn(src: str, kw: dict, failpoints: str = ""):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TIDB_TPU_RANGE_KW": json.dumps(kw)}
+    env.pop("TIDB_TPU_FAILPOINTS", None)
+    if failpoints:
+        env["TIDB_TPU_FAILPOINTS"] = failpoints
+    return subprocess.Popen(
+        [sys.executable, "-c", src.format(repo=REPO)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+
+
+def _spawn_leader(root: str, lease_ms: int = 500, count: int = 2,
+                  failpoints: str = ""):
+    proc = _spawn(LEADER_SRC, {"root": root, "lease_ms": lease_ms,
+                               "count": count}, failpoints)
+    deadline = time.time() + 120
+    addr = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT="):
+            addr = line.strip().split("=", 1)[1]
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("range leader died during startup")
+    assert addr, "leader did not report its address"
+    return proc, addr
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=15)
+        if p.stdout:
+            p.stdout.close()
+
+
+def _wait_owner(root: str, rid: int, addr: str, timeout_s: float = 20.0):
+    """Block until `addr` holds a LIVE grant on range `rid`."""
+    d = RangeDirectory(root)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        g = d.read_grant(rid)
+        if g and g.get("owner") == addr \
+                and float(g.get("expires_ms", 0)) > time.time() * 1000:
+            return g
+        time.sleep(0.1)
+    raise AssertionError(f"range {rid} never moved to {addr}")
+
+
+def _commit(committer, pairs: dict, tso) -> int:
+    muts = [Mutation(OP_PUT, k, v) for k, v in sorted(pairs.items())]
+    return committer.commit(muts, tso.ts())
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage", ["range/before-prewrite-ack",
+                                   "range/before-commit-ack"])
+def test_kill9_leader_mid_2pc(tmp_path, stage):
+    """The leader dies by os._exit(9) with a prewrite (or the primary
+    commit) APPLIED but UNACKED. The coordinator's retry lands on the
+    standby after per-range election; the mutation is exactly-once
+    (idempotent replay over the successor's WAL-rebuilt store) and
+    every previously acked commit survives."""
+    root = str(tmp_path)
+    # baseline txn = 2 prewrites + 2 commits against A; the third hit
+    # of the armed point is the chaos txn's first touch
+    armed, armed_addr = _spawn_leader(root,
+                                      failpoints=f"{stage}=exit(9)@3")
+    standby, standby_addr = _spawn_leader(root)
+    router = RangeRouter(root=root, budget_ms=30_000)
+    try:
+        tso = TimestampOracle()
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=2000)
+        for rid in (1, 2):
+            _wait_owner(root, rid, armed_addr)
+        _commit(committer, {b"\x10acked": b"base",
+                            b"\xf0acked": b"base"}, tso)
+        # the chaos txn: the armed leader dies mid-flight, the commit
+        # must still be acked exactly-once via the standby
+        _commit(committer, {b"\x10chaos": b"survives",
+                            b"\xf0chaos": b"survives"}, tso)
+        assert armed.wait(timeout=30) == 9  # died AT the failpoint
+        for rid in (1, 2):
+            g = _wait_owner(root, rid, standby_addr)
+            assert g["term"] >= 2
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10acked") == b"base"
+        assert snap.get(b"\xf0acked") == b"base"
+        assert snap.get(b"\x10chaos") == b"survives"
+        assert snap.get(b"\xf0chaos") == b"survives"
+    finally:
+        router.close()
+        _reap([armed, standby])
+
+
+@pytest.mark.slow
+def test_kill9_coordinator_orphans_roll_both_ways(tmp_path):
+    """Coordinator children die at the two percolator phase
+    boundaries. A peer resolves the orphans from the primary: died
+    after prewrite -> the txn vanishes atomically; died after the
+    primary commit -> the txn completes atomically. Acked txns from
+    the same children are always present."""
+    root = str(tmp_path)
+    leader, _ = _spawn_leader(root, lease_ms=60_000)
+    router = RangeRouter(root=root, budget_ms=30_000)
+    try:
+        tso = TimestampOracle()
+        k = lambda b: b.hex()  # noqa: E731 — wire keys as hex
+        # child 1: t1 acked, then dies with t2 fully prewritten but
+        # uncommitted (exit BEFORE any commit RPC)
+        c1 = _spawn(COORD_SRC, {
+            "root": root, "ttl": 300,
+            "txns": [["t1", {k(b"\x10t1a"): "v", k(b"\xf0t1b"): "v"}],
+                     ["t2", {k(b"\x10t2a"): "v", k(b"\xf0t2b"): "v"}]],
+        }, failpoints="twopc/after-prewrite=exit(9)@2")
+        out1 = c1.stdout.read()
+        assert c1.wait(timeout=60) == 9
+        assert "ACK t1" in out1 and "ACK t2" not in out1
+        # child 2: t3 acked, then dies AFTER t4's primary commit,
+        # before the secondary — committed but unacked
+        c2 = _spawn(COORD_SRC, {
+            "root": root, "ttl": 300,
+            "txns": [["t3", {k(b"\x10t3a"): "v", k(b"\xf0t3b"): "v"}],
+                     ["t4", {k(b"\x10t4a"): "v", k(b"\xf0t4b"): "v"}]],
+        }, failpoints="twopc/after-primary-commit=exit(9)@2")
+        out2 = c2.stdout.read()
+        assert c2.wait(timeout=60) == 9
+        assert "ACK t3" in out2 and "ACK t4" not in out2
+
+        time.sleep(0.4)  # orphan TTLs expire
+        snap = Snapshot(router, tso, tso.ts())
+        oracle = {  # what an uncrashed observer must see
+            b"\x10t1a": b"v", b"\xf0t1b": b"v",   # acked
+            b"\x10t2a": None, b"\xf0t2b": None,   # rolled back
+            b"\x10t3a": b"v", b"\xf0t3b": b"v",   # acked
+            b"\x10t4a": b"v", b"\xf0t4b": b"v",   # rolled forward
+        }
+        got = {key: snap.get(key) for key in oracle}
+        assert got == oracle
+        c1.stdout.close()
+        c2.stdout.close()
+    finally:
+        router.close()
+        _reap([leader])
+
+
+@pytest.mark.slow
+def test_sigkill_leader_survivors_elect_per_range(tmp_path):
+    """A bare SIGKILL (no failpoint, no cleanup): both ranges elect
+    onto the survivor within the lease horizon, acked data survives,
+    writes resume, and the corpse's term is fenced forever."""
+    root = str(tmp_path)
+    a, a_addr = _spawn_leader(root)
+    router = RangeRouter(root=root, budget_ms=30_000)
+    b = None
+    try:
+        tso = TimestampOracle()
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=2000)
+        for rid in (1, 2):
+            _wait_owner(root, rid, a_addr)
+        _commit(committer, {b"\x10d": b"acked", b"\xf0d": b"acked"}, tso)
+        old_terms = {rid: RangeDirectory(root).read_grant(rid)["term"]
+                     for rid in (1, 2)}
+        b_proc, b_addr = _spawn_leader(root)
+        b = b_proc
+        os.kill(a.pid, signal.SIGKILL)
+        a.wait(timeout=30)
+        for rid in (1, 2):
+            g = _wait_owner(root, rid, b_addr)
+            assert g["term"] == old_terms[rid] + 1
+            assert g["prev_owner"] == a_addr
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10d") == b"acked"
+        assert snap.get(b"\xf0d") == b"acked"
+        _commit(committer, {b"\x10e": b"new", b"\xf0e": b"new"}, tso)
+        assert Snapshot(router, tso, tso.ts()).get(b"\xf0e") == b"new"
+        # the deposed term can never write again
+        cli = RpcClient(b_addr, RpcOptions(
+            connect_timeout_ms=1000, request_timeout_ms=3000),
+            _heartbeat=False)
+        spec = RangeDirectory(root).load_specs()[0]
+        with pytest.raises(StaleTermError):
+            cli.call("range_prewrite",
+                     mutations=[[OP_PUT, b"\x01z", b"stale"]],
+                     primary=b"\x01z", start_ts=tso.ts(), ttl=1000,
+                     rc=make_range_ctx(1, spec.epoch, old_terms[1]))
+        cli.close()
+    finally:
+        router.close()
+        _reap([a] + ([b] if b is not None else []))

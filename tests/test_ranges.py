@@ -1,0 +1,522 @@
+"""Range-sharded write leadership: per-range leases + cross-process 2PC.
+
+Fast, in-process coverage of the range tier (rpc/ranged.py +
+kv/rangeclient.py): the durable first-writer-wins range table, lease
+acquisition/renewal/fencing, typed routing errors (NotLeader /
+EpochNotMatch / StaleTerm), the percolator committer running real
+cross-range 2PC through the RangeRouter with the primary key as the
+atomicity anchor, orphan-lock roll-forward/roll-back via
+primary-status checks, the randomized crash-stage atomicity property
+test, and the zero-cost contract: [ranges] disabled (or any
+single-range config) takes the EXACT pre-range commit path — same
+engine tags, storage.ranges untouched.
+
+The kill-9 chaos suite over real child processes lives in
+tests/test_range_chaos.py (slow-marked).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from tidb_tpu import obs
+from tidb_tpu.kv.backoff import BackoffExhausted
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.rangemeta import RangeSpec, locate_spec, split_keyspace
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import Snapshot, TwoPhaseCommitter
+from tidb_tpu.rpc.client import RpcClient, RpcOptions
+from tidb_tpu.rpc.errors import (EpochNotMatchError, NotLeaderError,
+                                 RPCError, StaleTermError)
+from tidb_tpu.rpc.frame import make_range_ctx
+from tidb_tpu.rpc.ranged import RangeDirectory, RangeServer
+from tidb_tpu.util import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _commit_kv(committer, pairs: dict, tso) -> int:
+    muts = [Mutation(OP_PUT, k, v) for k, v in sorted(pairs.items())]
+    return committer.commit(muts, tso.ts())
+
+
+def _eventually(fn, timeout_s: float = 15.0, desc: str = ""):
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            return fn()
+        except AssertionError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+# ==================== range table ====================
+
+def test_split_keyspace_covers_and_locates():
+    specs = split_keyspace(4)
+    assert [s.id for s in specs] == [1, 2, 3, 4]
+    assert specs[0].start_key == b"" and specs[-1].end_key == b""
+    # contiguous, no gaps
+    for a, b in zip(specs, specs[1:]):
+        assert a.end_key == b.start_key
+    for key in (b"", b"\x01", b"\x7f\xff", b"\x80", b"\xff" * 8):
+        spec = locate_spec(specs, key)
+        assert spec.contains(key)
+    # explicit split points override count
+    specs = split_keyspace(2, (b"m",))
+    assert [(s.start_key, s.end_key) for s in specs] == \
+        [(b"", b"m"), (b"m", b"")]
+
+
+def test_bootstrap_first_writer_wins(tmp_path):
+    d1 = RangeDirectory(str(tmp_path))
+    first = d1.bootstrap(split_keyspace(2))
+    # a second bootstrap with a DIFFERENT shape adopts the durable table
+    d2 = RangeDirectory(str(tmp_path))
+    second = d2.bootstrap(split_keyspace(8))
+    assert [(s.id, s.start_key, s.end_key) for s in second] == \
+        [(s.id, s.start_key, s.end_key) for s in first]
+
+
+def test_lease_acquire_renew_fence(tmp_path):
+    d = RangeDirectory(str(tmp_path))
+    d.bootstrap(split_keyspace(1))
+    g1 = d.acquire(1, "a:1", lease_ms=60_000)
+    assert g1 is not None and g1["term"] == 1
+    # a live foreign grant blocks acquisition
+    assert d.acquire(1, "b:1", lease_ms=60_000) is None
+    # the owner renews: expiry extends, tenure token and term hold
+    g2 = d.renew(1, "a:1", g1["token"], lease_ms=60_000)
+    assert g2["term"] == 1 and g2["token"] == g1["token"]
+    assert g2["expires_ms"] >= g1["expires_ms"]
+    # a released lease hands over with a term bump
+    d.release(1, "a:1", g2["token"])
+    g3 = d.acquire(1, "b:1", lease_ms=60_000)
+    assert g3["term"] == 2 and g3["prev_owner"] == "a:1"
+    # the deposed owner's renewal is fenced by its stale token
+    from tidb_tpu.rpc.errors import StaleLeaseError
+    with pytest.raises(StaleLeaseError):
+        d.renew(1, "a:1", g2["token"], lease_ms=60_000)
+
+
+# ==================== cross-range 2PC ====================
+
+def _server(tmp_path, count=2, lease_ms=60_000, **kw):
+    return RangeServer(str(tmp_path), lease_ms=lease_ms,
+                       specs=split_keyspace(count), **kw)
+
+
+def test_cross_range_commit_read_scan(tmp_path):
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=3000)
+        # one key per range: the primary anchors on range 1, the
+        # secondary commits on range 2 — a REAL cross-range txn
+        ts = _commit_kv(committer, {b"\x10k1": b"v1",
+                                    b"\xf0k2": b"v2"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10k1") == b"v1"
+        assert snap.get(b"\xf0k2") == b"v2"
+        # scan stitches ranges back together in key order
+        assert snap.scan(b"", b"") == [(b"\x10k1", b"v1"),
+                                       (b"\xf0k2", b"v2")]
+        assert ts > 0
+        router.close()
+        # seed-mode router (no shared filesystem): bootstraps the
+        # table + grants over the range_table RPC
+        seeded = RangeRouter(seeds=[srv.address])
+        snap2 = Snapshot(seeded, tso, tso.ts())
+        assert snap2.get(b"\xf0k2") == b"v2"
+        seeded.close()
+    finally:
+        srv.close()
+
+
+def test_typed_routing_errors(tmp_path):
+    srv = _server(tmp_path)
+    try:
+        cli = RpcClient(srv.address, RpcOptions(
+            connect_timeout_ms=1000, request_timeout_ms=2000),
+            _heartbeat=False)
+        grant = srv.directory.read_grant(1)
+        spec = srv.directory.load_specs()[0]
+        ok = {"rc": make_range_ctx(1, spec.epoch, grant["term"])}
+        r = cli.call("range_get", key=b"\x01", read_ts=1 << 40, **ok)
+        assert r["ok"] and r["v"] is None
+        # unknown range id
+        with pytest.raises(RPCError):
+            cli.call("range_get", key=b"\x01", read_ts=1,
+                     rc=make_range_ctx(99, spec.epoch, grant["term"]))
+        # stale epoch (the routing table moved under the client)
+        with pytest.raises(EpochNotMatchError):
+            cli.call("range_get", key=b"\x01", read_ts=1,
+                     rc=make_range_ctx(1, spec.epoch + 1, grant["term"]))
+        # a request stamped with a LOWER term than the leader holds is
+        # from a deposed routing view
+        with pytest.raises(StaleTermError):
+            cli.call("range_get", key=b"\x01", read_ts=1,
+                     rc=make_range_ctx(1, spec.epoch,
+                                       grant["term"] - 1))
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_takeover_fences_deposed_leader(tmp_path):
+    """Kill-9 analog in-process: server A dies WITHOUT releasing its
+    leases; B elects per range after lease expiry with a term bump,
+    acked commits survive (WAL replay), and A's old term is fenced."""
+    a = _server(tmp_path, count=2, lease_ms=400)
+    tso = TimestampOracle()
+    router = RangeRouter(root=str(tmp_path))
+    committer = TwoPhaseCommitter(router, tso, lock_ttl=3000)
+    _commit_kv(committer, {b"\x10acked": b"pre-crash",
+                           b"\xf0acked": b"pre-crash"}, tso)
+    old_terms = {d["range_id"]: d["term"] for d in a.describe()}
+    b = _server(tmp_path, count=2, lease_ms=400)
+    try:
+        # hard-stop A: no release, grants left to EXPIRE (flock is
+        # only held during grant writes, so a dead holder blocks nobody)
+        a._stop.set()
+        a._lease_thread.join(timeout=5.0)
+        a._close_listener()
+        _eventually(lambda: (_ for _ in ()).throw(AssertionError)
+                    if sorted(b.hosted_ids()) != [1, 2] else None,
+                    timeout_s=15.0)
+        for d in b.describe():
+            assert d["term"] == old_terms[d["range_id"]] + 1
+        # every acked commit is present on the new leaders
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10acked") == b"pre-crash"
+        assert snap.get(b"\xf0acked") == b"pre-crash"
+        # writes resume through the SAME router (grant cache refresh)
+        _commit_kv(committer, {b"\x10after": b"b",
+                               b"\xf0after": b"b"}, tso)
+        assert Snapshot(router, tso, tso.ts()).get(b"\x10after") == b"b"
+        # the deposed leader's term is fenced
+        cli = RpcClient(b.address, RpcOptions(
+            connect_timeout_ms=1000, request_timeout_ms=2000),
+            _heartbeat=False)
+        spec = b.directory.load_specs()[0]
+        with pytest.raises(StaleTermError):
+            cli.call("range_get", key=b"\x01", read_ts=1,
+                     rc=make_range_ctx(1, spec.epoch, old_terms[1]))
+        cli.close()
+    finally:
+        router.close()
+        b.close()
+        a.close()
+
+
+def test_lease_drop_failpoint_forces_transfer(tmp_path):
+    """range/lease-drop (the chaos harness's forced-transfer lever):
+    the holder releases the named range on its next lease tick and a
+    peer elects it with a term bump — the transfers counter moving
+    proves a full forced hand-over. Other ranges never move."""
+    a = _server(tmp_path, count=2, lease_ms=300)
+    b = _server(tmp_path, count=2, lease_ms=300)
+    try:
+        old1 = a.directory.read_grant(1)
+        old2 = a.directory.read_grant(2)
+        before = obs.RANGE_TRANSFERS.get()
+        with failpoint.failpoint("range/lease-drop", 1):
+            def transferred():
+                assert obs.RANGE_TRANSFERS.get() > before
+            _eventually(transferred)
+        # disarmed: a steady owner re-establishes with a bumped term
+        def settled():
+            g = a.directory.read_grant(1)
+            assert g and float(g["expires_ms"]) > time.time() * 1000
+            assert g["term"] > old1["term"]
+        _eventually(settled)
+        # range 2 was never dropped: same tenure, same term
+        g2 = a.directory.read_grant(2)
+        assert g2["term"] == old2["term"]
+        assert g2["owner"] == old2["owner"]
+    finally:
+        b.close()
+        a.close()
+
+
+def test_router_exhausts_backoff_when_no_leader(tmp_path):
+    d = RangeDirectory(str(tmp_path))
+    d.bootstrap(split_keyspace(1))
+    router = RangeRouter(root=str(tmp_path), budget_ms=300)
+    with pytest.raises(BackoffExhausted):
+        router.get(router.locate(b"k"), b"k", 1)
+    router.close()
+
+
+# ==================== orphan resolution ====================
+
+def test_orphan_rollback_after_coordinator_crash(tmp_path):
+    """Coordinator dies BETWEEN prewrite and commit: its locks must
+    roll BACK via primary-status check once the TTL expires, and the
+    half-done txn's writes never become visible."""
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=50)
+        with failpoint.failpoint("twopc/after-prewrite",
+                                 RuntimeError("coordinator died")):
+            with pytest.raises(RuntimeError):
+                _commit_kv(committer, {b"\x10o1": b"never",
+                                       b"\xf0o2": b"never"}, tso)
+        before = obs.RANGE_ORPHAN_RESOLUTIONS.get()
+        time.sleep(0.08)  # past the TTL
+        # a PEER (fresh router = another process's view) reads through
+        # the orphans: primary check says expired-uncommitted -> both
+        # locks roll back
+        peer = RangeRouter(root=str(tmp_path))
+        snap = Snapshot(peer, tso, tso.ts())
+        assert snap.get(b"\x10o1") is None
+        assert snap.get(b"\xf0o2") is None
+        assert obs.RANGE_ORPHAN_RESOLUTIONS.get() > before
+        peer.close()
+        router.close()
+    finally:
+        srv.close()
+
+
+def test_orphan_rollforward_after_primary_commit(tmp_path):
+    """Coordinator dies AFTER the primary commit: the txn IS durable,
+    so the secondary's orphan lock must roll FORWARD from the
+    primary's write record — both keys visible."""
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=50)
+        with failpoint.failpoint("twopc/after-primary-commit",
+                                 RuntimeError("coordinator died")):
+            with pytest.raises(RuntimeError):
+                _commit_kv(committer, {b"\x10p": b"durable",
+                                       b"\xf0s": b"durable"}, tso)
+        peer = RangeRouter(root=str(tmp_path))
+        snap = Snapshot(peer, tso, tso.ts())
+        assert snap.get(b"\xf0s") == b"durable"  # rolled forward
+        assert snap.get(b"\x10p") == b"durable"
+        peer.close()
+        router.close()
+    finally:
+        srv.close()
+
+
+# ==================== randomized atomicity property ====================
+
+def test_randomized_cross_range_atomicity(tmp_path):
+    """N multi-range transfers with crashes injected at random 2PC
+    stages: after orphan resolution the total balance is conserved and
+    every account matches an uncrashed oracle that applies exactly the
+    txns whose primary committed."""
+    srv = _server(tmp_path, count=4)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=50)
+        rng = random.Random(0xA11CE)
+        prefixes = [b"\x10", b"\x50", b"\x90", b"\xd0"]
+        accounts = [p + b"acct%d" % i
+                    for i, p in enumerate(prefixes * 2)]
+        oracle = {a: 100 for a in accounts}
+        _commit_kv(committer,
+                   {a: b"%d" % v for a, v in oracle.items()}, tso)
+
+        stages = [None, "twopc/after-prewrite",
+                  "twopc/before-commit-primary",
+                  "twopc/after-primary-commit"]
+        for _ in range(30):
+            src, dst = rng.sample(accounts, 2)
+            amt = rng.randint(1, 25)
+            snap = Snapshot(router, tso, tso.ts())
+            cur = {k: int(snap.get(k)) for k in (src, dst)}
+            pairs = {src: b"%d" % (cur[src] - amt),
+                     dst: b"%d" % (cur[dst] + amt)}
+            stage = rng.choice(stages)
+            crashed = False
+            if stage is None:
+                _commit_kv(committer, pairs, tso)
+            else:
+                with failpoint.failpoint(stage, RuntimeError("crash")):
+                    try:
+                        _commit_kv(committer, pairs, tso)
+                    except RuntimeError:
+                        crashed = True
+            assert crashed == (stage is not None)
+            # after-primary-commit = the txn IS committed (all-or-
+            # nothing anchors on the primary); earlier stages = aborted
+            if stage is None or stage == "twopc/after-primary-commit":
+                oracle[src] -= amt
+                oracle[dst] += amt
+            if crashed:
+                time.sleep(0.08)  # let orphan TTLs expire
+
+        time.sleep(0.08)
+        peer = RangeRouter(root=str(tmp_path))
+        snap = Snapshot(peer, tso, tso.ts())
+        got = {a: int(snap.get(a)) for a in accounts}
+        assert sum(got.values()) == 100 * len(accounts)
+        assert got == oracle
+        peer.close()
+        router.close()
+    finally:
+        srv.close()
+
+
+# ==================== the zero-cost contract ====================
+
+def test_disabled_ranges_is_old_path(tmp_path):
+    """[ranges] disabled (the default): storage.ranges stays None and
+    statements execute with the exact pre-range engine tags."""
+    from tidb_tpu.config import Config
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    plain = Storage(str(tmp_path / "plain"))
+    armed = Storage(str(tmp_path / "armed"))
+    try:
+        cfg = Config()
+        cfg.path = armed.path
+        cfg.seed_ranges(plain)
+        assert plain.ranges is None  # disabled = never constructed
+        cfg.ranges.enabled = True
+        cfg.ranges.count = 1
+        cfg.seed_ranges(armed)
+        assert armed.ranges is not None
+        assert armed.ranges.server.hosted_ids() == [1]
+        # identical statements, identical engine tags — arming a
+        # single-range plane does ZERO statement-path work
+        tags = []
+        for st in (plain, armed):
+            s = Session(st)
+            s.execute("create table t (id bigint primary key, v bigint)")
+            s.execute("insert into t values (1, 10), (2, 20)")
+            s.execute("select v from t where id = 2")
+            point = list(s.last_engines)
+            s.execute("select sum(v) from t")
+            tags.append((point, list(s.last_engines)))
+        assert tags[0] == tags[1], tags
+    finally:
+        armed.close()
+        plain.close()
+
+
+def test_plane_status_and_hot_reload(tmp_path):
+    from tidb_tpu.config import Config
+    from tidb_tpu.store.storage import Storage
+
+    st = Storage(str(tmp_path))
+    try:
+        cfg = Config()
+        cfg.path = st.path
+        cfg.ranges.enabled = True
+        cfg.ranges.count = 2
+        cfg.ranges.split_points = ""
+        cfg.validate()
+        cfg.seed_ranges(st)
+        info = st.ranges.status()
+        assert len(info["table"]) == 2
+        assert {d["range_id"] for d in info["hosted"]} == {1, 2}
+        assert info["lease_ms"] == 1000
+        # SIGHUP path: the reloadable subset applies without restart
+        cfg.ranges.lease_ms = 250
+        cfg.ranges.resolve_ttl_ms = 99
+        cfg.seed_ranges(st)
+        assert st.ranges.server.lease_ms == 250
+        assert st.ranges.resolve_ttl_ms == 99
+        # committer inherits the orphan TTL
+        assert st.ranges.committer(TimestampOracle()).lock_ttl == 99
+    finally:
+        st.close()
+
+
+def test_enabled_requires_path():
+    from tidb_tpu.config import Config, ConfigError
+
+    cfg = Config()
+    cfg.ranges.enabled = True
+    with pytest.raises(ConfigError):
+        cfg.validate()
+
+
+# ==================== observability ====================
+
+def test_cluster_info_range_rows_and_status(tmp_path):
+    from tidb_tpu.config import Config
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    st = Storage(str(tmp_path))
+    try:
+        cfg = Config()
+        cfg.path = st.path
+        cfg.ranges.enabled = True
+        cfg.ranges.count = 2
+        cfg.seed_ranges(st)
+        s = Session(st)
+        rows = s.execute(
+            "select type, range_id, range_leader, range_term, "
+            "range_closed_ts from information_schema.cluster_info").rows
+        ranges = [r for r in rows if r[0] == "range"]
+        assert {r[1] for r in ranges} == {1, 2}
+        addr = st.ranges.server.address
+        assert all(r[2] == addr and r[3] >= 1 and r[4] >= 0
+                   for r in ranges)
+        # server rows leave the range columns NULL
+        assert all(r[1] is None for r in rows if r[0] != "range")
+    finally:
+        st.close()
+
+
+def test_range_metrics_registered_and_lint_clean():
+    fams = {m.name for m in obs.PROCESS_METRICS._metrics.values()} \
+        if hasattr(obs.PROCESS_METRICS, "_metrics") else None
+    text = obs.PROCESS_METRICS.render()
+    for fam in ("tidb_range_leaders", "tidb_range_transfers_total",
+                "tidb_range_orphan_resolutions_total"):
+        assert fam in text, (fam, fams)
+    assert obs.lint_metrics([obs.PROCESS_METRICS]) == []
+
+
+def test_range_leader_flap_rule(tmp_path):
+    from tidb_tpu.obs_inspect import RULES, lint_rules
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    assert lint_rules() == []
+    assert "range-leader-flap" in RULES
+    st = Storage()
+    s = Session(st)
+    thr = st.diagnostics.range_flap_threshold
+    # one clean failover: below threshold, silent
+    st.obs.events.record("range_transfer", "r1 a:1 -> b:1 term=2",
+                         severity="warning")
+    rows = [r for r in s.execute(
+        "select rule, item, value from "
+        "information_schema.inspection_result").rows
+        if r[0] == "range-leader-flap"]
+    assert rows == []
+    # a flapping range: threshold transfers inside the window
+    for t in range(3, 3 + thr):
+        st.obs.events.record("range_transfer",
+                             f"r1 b:1 -> a:1 term={t}",
+                             severity="warning")
+    rows = [r for r in s.execute(
+        "select rule, item, value from "
+        "information_schema.inspection_result").rows
+        if r[0] == "range-leader-flap"]
+    assert rows and rows[0][1] == "r1"
+    assert int(rows[0][2]) >= thr
+    st.close()

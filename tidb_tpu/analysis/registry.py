@@ -32,6 +32,11 @@ HOT_LOCKS: dict[str, str] = {
         "the native store mutex — the PR 12 bug was an fsync under "
         "exactly this lock, which serialized every writer behind the "
         "disk barrier (kv/native.py)",
+    "RangeServer._mu":
+        "the hosted-leader map lock — every cross-process 2PC request "
+        "passes its fencing gate under it, so a lease renewal doing "
+        "disk I/O inside would stall every range's writers at once "
+        "(rpc/ranged.py)",
 }
 
 # ---- blocking calls ---------------------------------------------------------

@@ -241,7 +241,14 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # routed replica reads (leaders: newest issued ts / 0 / 0)
         ("applied_ts", _bigint()),
         ("apply_lag_ms", FieldType(TypeKind.DOUBLE)),
-        ("serving", _bigint()), ("error", _vc(256)),
+        ("serving", _bigint()),
+        # range-sharded write leadership: a member hosting range
+        # leaders contributes one extra type='range' row per hosted
+        # range with these filled (NULL on server rows, and no range
+        # rows at all while [ranges] is disabled)
+        ("range_id", _bigint()), ("range_leader", _vc()),
+        ("range_term", _bigint()), ("range_closed_ts", _bigint()),
+        ("error", _vc(256)),
     ],
     "cluster_processlist": [
         ("instance", _vc()), ("id", _bigint()), ("user", _vc()),

@@ -170,6 +170,9 @@ class DiagnosticsConfig:
     # (warning; critical at 3x — the replica stopped advancing); 0
     # disables the rule
     apply_lag_warn_ms: int = 2000
+    # one range changing write leadership this many times in the
+    # window fires range-leader-flap (a clean failover is ONE transfer)
+    range_flap_threshold: int = 3
 
 
 @dataclass
@@ -214,6 +217,37 @@ class ReplicaReadConfig:
     # route eligible snapshot SELECTs to followers by default (seeds
     # the tidb_replica_read sysvar's global default)
     prefer_follower: bool = False
+
+
+@dataclass
+class RangesConfig:
+    """The `[ranges]` TOML section: range-sharded write leadership
+    (rpc/ranged.py RangePlane is the runtime owner). Disabled by
+    default — and disabled means the plane is never constructed, so
+    the statement path does ZERO new work (single-range deployments
+    are byte-identical to the pre-range engine)."""
+
+    # master switch: arm a RangeServer over <path>/ranges — per-range
+    # leases, fencing terms, WALs and the range_* percolator RPC
+    # surface. Needs a durable local path; restart to change.
+    enabled: bool = False
+    # even single-byte-prefix split count when split-points is empty
+    # (the table is written once, first writer wins; restart-only)
+    count: int = 4
+    # explicit split keys, comma-separated (utf-8-encoded; overrides
+    # count when non-empty; restart-only)
+    split_points: str = ""
+    # leadership lease horizon; a leader that cannot renew within it
+    # fences itself, and a successor acquires right after expiry
+    # (hot-reloadable)
+    lease_ms: int = 1000
+    # lock TTL the plane's committers stamp on prewrites: how long a
+    # crashed coordinator's orphan locks block peers before
+    # primary-status resolution may roll them forward/back
+    # (hot-reloadable)
+    resolve_ttl_ms: int = 3000
+    # the range RPC listener bind (restart-only)
+    listen: str = "127.0.0.1:0"
 
 
 @dataclass
@@ -332,6 +366,7 @@ class Config:
     history: HistoryConfig = field(default_factory=HistoryConfig)
     replica_read: ReplicaReadConfig = field(
         default_factory=ReplicaReadConfig)
+    ranges: RangesConfig = field(default_factory=RangesConfig)
     gc: GCConfig = field(default_factory=GCConfig)
     security: SecurityConfig = field(default_factory=SecurityConfig)
     transport: TransportConfig = field(default_factory=TransportConfig)
@@ -510,6 +545,19 @@ class Config:
         if not 0 < d.host_fallback_fraction <= 1:
             raise ConfigError(
                 "diagnostics.host-fallback-fraction must be in (0, 1]")
+        rg = self.ranges
+        if rg.enabled and not self.path:
+            raise ConfigError(
+                "ranges.enabled requires path (range leaders own "
+                "durable per-range WAL directories)")
+        if not 1 <= rg.count <= 256:
+            raise ConfigError(
+                "ranges.count must be in [1, 256] (single-byte prefix "
+                "splits; use split-points for a finer table)")
+        if rg.lease_ms < 50:
+            raise ConfigError("ranges.lease-ms must be >= 50")
+        if rg.resolve_ttl_ms < 1:
+            raise ConfigError("ranges.resolve-ttl-ms must be >= 1")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -581,6 +629,11 @@ class Config:
         "replica_read.enabled",
         "replica_read.max_staleness_ms",
         "replica_read.prefer_follower",
+        # range-plane timing knobs apply live (lease horizon + orphan
+        # TTL are operator dials during an incident); enabling the
+        # plane or reshaping the table stays restart-only
+        "ranges.lease_ms",
+        "ranges.resolve_ttl_ms",
     })
 
     def hot_reload(self, path: str) -> list[str]:
@@ -719,6 +772,7 @@ class Config:
         st.admission_shed_threshold = d.admission_shed_threshold
         st.row_eval_threshold = d.row_eval_threshold
         st.apply_lag_warn_ms = d.apply_lag_warn_ms
+        st.range_flap_threshold = d.range_flap_threshold
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
         st._status_cache = None
@@ -745,6 +799,18 @@ class Config:
         st.apply_interval_ms = r.apply_interval_ms
         st.prefer_follower = r.prefer_follower
         storage.arm_replica_read()
+
+    def seed_ranges(self, storage) -> None:
+        """Arm the range plane from the [ranges] knobs (startup and
+        SIGHUP hot reload both call this; arm_ranges only applies the
+        reloadable subset to an already-armed plane)."""
+        rg = self.ranges
+        points = [p.strip() for p in rg.split_points.split(",")
+                  if p.strip()]
+        storage.arm_ranges(
+            enabled=rg.enabled, count=rg.count, split_points=points,
+            lease_ms=rg.lease_ms, resolve_ttl_ms=rg.resolve_ttl_ms,
+            listen=rg.listen)
 
     def seed_group_commit(self, storage) -> None:
         """Apply the [storage] group-commit batching knobs to the
@@ -1125,6 +1191,9 @@ row-eval-threshold = 1
 # a serving replica's apply lag past this fires follower-apply-lag
 # (warning; critical at 3x — the replica stopped advancing); 0 disables
 apply-lag-warn-ms = 2000
+# one range changing write leadership this many times in the window
+# fires range-leader-flap (a clean failover is ONE transfer)
+range-flap-threshold = 3
 
 [history]
 # Workload history plane (information_schema.statements_summary_history
@@ -1171,6 +1240,34 @@ apply-interval-ms = 200
 # tidb_replica_read sysvar; sessions override with
 # SET tidb_replica_read = 'leader' | 'follower')
 prefer-follower = false
+
+[ranges]
+# Range-sharded write leadership: split the keyspace into ranges whose
+# write leadership is held by independently-leased leaders (possibly
+# different processes per range), each with its own fencing term, its
+# own WAL and its own closed timestamp; cross-range transactions run
+# percolator 2PC against each range's current leader with the primary
+# key as the atomicity anchor. Disabled (the default) constructs
+# nothing: single-range deployments run the exact pre-range commit
+# path. Surfaces: information_schema.cluster_info type='range' rows,
+# /status "ranges", tidb_range_{leaders,transfers_total,
+# orphan_resolutions_total}, the range-leader-flap inspection rule.
+enabled = false
+# initial range table (written once, first writer wins; restart-only):
+# `count` even single-byte-prefix splits, or explicit comma-separated
+# split keys which override count
+count = 4
+split-points = ""
+# leadership lease horizon: a leader that cannot renew within it
+# fences itself and a successor takes over right after expiry
+# (hot-reloadable)
+lease-ms = 1000
+# prewrite lock TTL: how long a crashed coordinator's orphan locks
+# block peers before primary-status checks may roll them
+# forward/backward (hot-reloadable)
+resolve-ttl-ms = 3000
+# the range RPC listener bind (restart-only)
+listen = "127.0.0.1:0"
 
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC

@@ -1,0 +1,79 @@
+"""Range descriptors: the keyspace split into leader-sharded ranges.
+
+A RangeSpec is the unit of write leadership: a half-open key interval
+[start_key, end_key) with a routing-table epoch. The range TABLE (the
+ordered list of specs) is the cluster's authoritative metadata — the
+PD region-table analog (reference: store/tikv/region_cache.go:274
+keeps the client copy; pd owns the truth). rpc/ranged.py persists it
+as `ranges/meta.json` under the shared durable root and bumps a
+range's epoch whenever its metadata or leadership generation changes;
+clients carrying an older epoch are answered with EpochNotMatchError
+and reload.
+
+Key routing is plain byte comparison on the encoded KV keys — the same
+keys kv/region.py routes in-process — so one committer can run against
+either tier.
+"""
+
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+
+
+@dataclass
+class RangeSpec:
+    id: int
+    start_key: bytes
+    end_key: bytes  # b"" = +inf
+    epoch: int = 1
+
+    def contains(self, key: bytes) -> bool:
+        return self.start_key <= key and (not self.end_key
+                                          or key < self.end_key)
+
+    def to_wire(self) -> dict:
+        return {"id": int(self.id), "start": self.start_key,
+                "end": self.end_key, "epoch": int(self.epoch)}
+
+    @staticmethod
+    def from_wire(d: dict) -> "RangeSpec":
+        return RangeSpec(int(d["id"]), bytes(d["start"]),
+                         bytes(d["end"]), int(d.get("epoch", 1)))
+
+
+def split_keyspace(count: int = 1,
+                   split_points: list = ()) -> list[RangeSpec]:
+    """The initial range table from the [ranges] knobs. Explicit split
+    points (strings, encoded utf-8, or bytes) win; otherwise `count`
+    ranges split the single-byte prefix space evenly — coarse on
+    purpose: table-prefixed keys (catalog/codec) hash across prefixes,
+    and real split points come from the knob when a workload needs
+    them. Always covers the whole keyspace ([b'', +inf))."""
+    points: list[bytes] = []
+    for p in split_points:
+        b = p.encode("utf-8") if isinstance(p, str) else bytes(p)
+        if b:
+            points.append(b)
+    if not points and count > 1:
+        count = min(int(count), 256)
+        step = 256 // count
+        points = [bytes([min(i * step, 255)])
+                  for i in range(1, count)]
+    points = sorted(set(points))
+    bounds = [b""] + points + [b""]
+    return [RangeSpec(i + 1, bounds[i], bounds[i + 1])
+            for i in range(len(bounds) - 1)]
+
+
+def locate_spec(specs: list[RangeSpec], key: bytes) -> RangeSpec:
+    """The spec containing key — specs must be the full sorted table
+    (split_keyspace output order)."""
+    starts = [s.start_key for s in specs]
+    i = bisect.bisect_right(starts, key) - 1
+    s = specs[i]
+    assert s.contains(key), (key, s)
+    return s
+
+
+__all__ = ["RangeSpec", "split_keyspace", "locate_spec"]

@@ -125,6 +125,23 @@ class RegionManager:
         self.check_context(region.id, region.epoch, [key])
         return self.store.get(key, read_ts)
 
+    # ---- resolver/read surface (no region gate: these route BY key) -------
+    # The committer and LockResolver call these on whatever rm they were
+    # built over; kv/rangeclient.py's RangeRouter implements the same
+    # three names over cross-process RPC, which is what lets ONE
+    # committer run against either tier.
+    def check_txn_status(self, primary: bytes, lock_ts: int,
+                         current_ts: int) -> tuple[int, bool]:
+        return self.store.check_txn_status(primary, lock_ts, current_ts)
+
+    def resolve_lock(self, key: bytes, start_ts: int,
+                     commit_ts: int) -> None:
+        self.store.resolve_lock(key, start_ts, commit_ts)
+
+    def scan(self, start: bytes, end: bytes, read_ts: int,
+             limit: int = -1) -> list[tuple[bytes, bytes]]:
+        return self.store.scan(start, end, read_ts, limit)
+
 
 def group_by_region(rm: RegionManager,
                     keys: list[bytes]) -> dict[int, tuple[Region, list]]:

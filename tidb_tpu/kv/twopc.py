@@ -56,12 +56,18 @@ class LockResolver:
         self.tso = tso
 
     def resolve(self, lock) -> bool:
-        """True if the lock was cleared (caller may retry immediately)."""
-        commit_ts, done = self.rm.store.check_txn_status(
+        """True if the lock was cleared (caller may retry immediately).
+
+        Goes through the rm-level resolver surface, not rm.store: over
+        the range tier (kv/rangeclient.py) the primary's status lives on
+        ANOTHER range's leader, so the status check and the resolve are
+        two routed calls — exactly how a peer rolls a crashed
+        coordinator's orphans forward/backward."""
+        commit_ts, done = self.rm.check_txn_status(
             lock.primary, lock.start_ts, self.tso.ts())
         if not done:
             return False  # lock holder still alive; caller backs off
-        self.rm.store.resolve_lock(lock.key, lock.start_ts, commit_ts)
+        self.rm.resolve_lock(lock.key, lock.start_ts, commit_ts)
         return True
 
 
@@ -244,7 +250,7 @@ class Snapshot:
         backoff = 0.001
         for _ in range(12):
             try:
-                return self.rm.store.scan(start, end, self.read_ts, limit)
+                return self.rm.scan(start, end, self.read_ts, limit)
             except KeyIsLockedError as e:
                 if not self._resolver.resolve(e.lock):
                     time.sleep(backoff)

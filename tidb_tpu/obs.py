@@ -859,6 +859,21 @@ RPC_BREAKER_FAST_FAILS = PROCESS_METRICS.counter(
     "tidb_rpc_breaker_fast_failures_total",
     "calls failed fast by an open rpc circuit breaker")
 
+# range-sharded write leadership (rpc/ranged.py): process-wide like the
+# breaker counters — a process may host several RangeServers (tests do),
+# so the gauge moves by inc/dec per leadership open/drop rather than set
+RANGE_LEADERS = PROCESS_METRICS.gauge(
+    "tidb_range_leaders",
+    "ranges whose write leadership this process currently holds")
+RANGE_TRANSFERS = PROCESS_METRICS.counter(
+    "tidb_range_transfers_total",
+    "range leadership acquisitions that deposed a different owner "
+    "(term bumps; steady renewal never counts)")
+RANGE_ORPHAN_RESOLUTIONS = PROCESS_METRICS.counter(
+    "tidb_range_orphan_resolutions_total",
+    "orphan percolator locks rolled forward or back via primary-status "
+    "check after a coordinator crash")
+
 # device telemetry gauges (ONE device per process, like the counters
 # above): transfer bytes accumulate on the dispatch hot path; buffer
 # bytes / cache entries / RSS are refreshed by the registered probes

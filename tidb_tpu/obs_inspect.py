@@ -64,6 +64,9 @@ class DiagnosticsState:
     host_fallback_fraction: float = 0.5  # of a digest's stage split
     governor_kill_threshold: int = 1     # kills in the window
     admission_shed_threshold: int = 1    # sheds in the window
+    # one range changing write leadership this many times in the
+    # window is flapping (a clean failover is ONE transfer)
+    range_flap_threshold: int = 3
     row_eval_threshold: int = 1          # per-row registry rows/window
     # a serving replica's apply lag past this is a follower-apply-lag
     # warning; critical at 3x (the replica stopped advancing); 0 off
@@ -453,6 +456,34 @@ def _r_follower_apply_lag(ctx: InspectionContext) -> list[Finding]:
             + ("; the replica has stopped advancing — routed reads "
                "are falling back to the leader" if sev == "critical"
                else "") + ")"))
+    return out
+
+
+@rule("range-leader-flap", "warning",
+      "ranges.lease-ms — one range's write leadership changed hands "
+      "repeatedly inside the window (a clean failover is ONE "
+      "transfer); leaders cannot hold their lease — check lease-ms "
+      "against renewal latency and crash-looping hosts "
+      "(tidb_events kind=range_transfer, tidb_range_transfers_total)")
+def _r_range_leader_flap(ctx: InspectionContext) -> list[Finding]:
+    moves = ctx.window_events("range_transfer")
+    if len(moves) < ctx.cfg.range_flap_threshold:
+        return []
+    # every range_transfer detail leads with "r<id> " (rpc/ranged.py)
+    per: dict = {}
+    for e in moves:
+        rid = str(e.get("detail", "")).split(" ", 1)[0] or "?"
+        per.setdefault(rid, []).append(e)
+    out = []
+    for rid, evs in sorted(per.items()):
+        if len(evs) < ctx.cfg.range_flap_threshold:
+            continue
+        out.append(Finding(
+            "range-leader-flap", rid, "warning", str(len(evs)),
+            f"range {rid} changed write leadership {len(evs)} times "
+            f"inside {ctx.window_s:.0f}s (threshold "
+            f"{ctx.cfg.range_flap_threshold}); last: "
+            f"{evs[-1]['detail'][:200]}"))
     return out
 
 

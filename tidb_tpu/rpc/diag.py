@@ -79,7 +79,7 @@ class DiagService:
         from ..server.conn import SERVER_VERSION
         started = getattr(self.storage, "_start_time", 0.0)
         coord = getattr(self.storage, "coord", None)
-        return {"rows": [[
+        rows = [[
             self._role(),
             int(getattr(coord, "node_id", 0) or 0),
             SERVER_VERSION,
@@ -88,7 +88,18 @@ class DiagService:
             if started else "",
             round(time.time() - started, 3) if started else 0.0,
             *self._replica_cols(),
-        ]]}
+            None, None, None, None,
+        ]]
+        # one type='range' row per range whose write leadership this
+        # member currently holds ([ranges] disabled adds nothing)
+        plane = getattr(self.storage, "ranges", None)
+        if plane is not None:
+            for d in plane.server.describe():
+                rows.append(["range", None, None, None, None, None,
+                             None, None, None,
+                             int(d["range_id"]), str(d["leader"]),
+                             int(d["term"]), int(d["closed_ts"])])
+        return {"rows": rows}
 
     def _replica_cols(self) -> list:
         """The follower-read-tier columns of cluster_info: this

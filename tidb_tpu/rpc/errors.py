@@ -55,6 +55,32 @@ class StaleTermError(RPCError):
     sqlstate = "40001"
 
 
+class NotLeaderError(RPCError):
+    """The addressed server does not (or no longer) lead the range the
+    request named. Carries the server's current view of the grant in
+    the message; the client reacts by refreshing its range-leader cache
+    and retrying against the new holder (reference:
+    region_request.go onNotLeader — retry with the hinted leader),
+    never by failing the statement. Like the region-miss class it maps
+    to the write-conflict errno so a statement that does escape retries
+    is safely re-runnable."""
+
+    errno = ER_WRITE_CONFLICT
+    sqlstate = "40001"
+
+
+class EpochNotMatchError(RPCError):
+    """The request's range epoch is older than the server's routing
+    table — the range METADATA changed (split/reshard) since the client
+    cached it. Distinct from NotLeaderError: the cure is reloading the
+    range table itself, not just the leader grant (reference:
+    region_request.go onRegionError EpochNotMatch — invalidate the
+    region cache entry and re-locate)."""
+
+    errno = ER_WRITE_CONFLICT
+    sqlstate = "40001"
+
+
 class ResultUndetermined(RPCError):
     """A WAL publish may or may not have landed (the leader became
     unreachable after the request was sent and before a response
@@ -120,6 +146,8 @@ WIRE_ERRORS = {
     "LeaderUnavailable": LeaderUnavailable,
     "StaleLeaseError": StaleLeaseError,
     "StaleTermError": StaleTermError,
+    "NotLeaderError": NotLeaderError,
+    "EpochNotMatchError": EpochNotMatchError,
     "ResultUndetermined": ResultUndetermined,
     "ReplicaStaleError": ReplicaStaleError,
     "WalOffsetMismatch": WalOffsetMismatch,
@@ -128,6 +156,7 @@ WIRE_ERRORS = {
 
 
 __all__ = ["RPCError", "LeaderUnavailable", "StaleLeaseError",
-           "StaleTermError", "ResultUndetermined", "ReplicaStaleError",
+           "StaleTermError", "NotLeaderError", "EpochNotMatchError",
+           "ResultUndetermined", "ReplicaStaleError",
            "WalOffsetMismatch", "WIRE_ERRORS", "wire_error",
            "traced_response"]

@@ -170,6 +170,32 @@ def get_trace_ctx(req) -> Any:
     return None
 
 
+# ---- range routing context --------------------------------------------------
+# Every range-addressed request carries this key: the client's cached
+# view of (range id, routing-table epoch, leadership term). The server
+# gates on it BEFORE touching data — a mismatch answers typed
+# (EpochNotMatchError / NotLeaderError / StaleTermError) so stale
+# routing can never produce a silently wrong result (reference: the
+# kvrpcpb.Context every TiKV request carries — region_id, region_epoch,
+# peer — checked by raftstore before proposing).
+RANGE_KEY = "rc"
+
+
+def make_range_ctx(range_id: int, epoch: int, term: int) -> dict:
+    return {"range_id": int(range_id), "epoch": int(epoch),
+            "term": int(term)}
+
+
+def get_range_ctx(params) -> Any:
+    """The request's range context, or None when absent/malformed."""
+    if not isinstance(params, dict):
+        return None
+    rc = params.get(RANGE_KEY)
+    if isinstance(rc, dict) and "range_id" in rc:
+        return rc
+    return None
+
+
 # ---- addresses -------------------------------------------------------------
 def parse_addr(addr) -> tuple[int, Any]:
     """'host:port' / ('host', port) -> AF_INET; 'unix:/path' or a bare
@@ -187,4 +213,5 @@ def parse_addr(addr) -> tuple[int, Any]:
 
 __all__ = ["FrameError", "encode", "decode", "send_frame", "recv_frame",
            "parse_addr", "MAX_FRAME", "TRACE_KEY", "make_trace_ctx",
-           "get_trace_ctx"]
+           "get_trace_ctx", "RANGE_KEY", "make_range_ctx",
+           "get_range_ctx"]

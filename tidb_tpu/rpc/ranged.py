@@ -1,0 +1,642 @@
+"""Range-sharded write leadership: per-range leases over a shared
+durable root, serving percolator RPCs with fencing checks.
+
+The tier splits the keyspace into ranges (kv/rangemeta.py) whose write
+leadership is held by INDEPENDENTLY-leased leaders — possibly different
+processes per range — so durable writes scale past one commit lock /
+one WAL and a single crash only stalls the ranges that process led
+(reference: the region model — raftstore leaders per region, not per
+store; PAPER.md L7). Three pieces:
+
+* RangeDirectory — the filesystem directory service under
+  `<root>/ranges/`: the range table (`meta.json`, first writer wins),
+  and per range a grant file + fencing-term file + WAL directory.
+  Lease acquisition takes an flock only for the read-modify-write of
+  the grant; TENURE is the grant's wall-clock expiry, never the flock
+  (a SIGKILLed holder's flock vanishes with the process — the grant
+  must keep fencing until it times out). Terms bump exactly when
+  ownership changes hands, and persist crash-atomically (the
+  rpc/server.py write_term idiom), so a deposed leader — or a client
+  that last spoke to it — presents a provably stale term forever after.
+
+* RangeLeader — one hosted range: an MVCCStore over the range's own
+  WAL directory (sync_log='commit' by default: acked means fsynced),
+  replayed on open, plus the range's closed timestamp (min pending
+  lock start_ts - 1, else max committed ts — the per-range analog of
+  the PR 11 pending-commit ledger).
+
+* RangeServer — a FrameListener answering `range_*` percolator RPCs.
+  Every data request carries the client's (range_id, epoch, term)
+  context and is gated BEFORE any data access: wrong host answers
+  NotLeaderError, an older routing table answers EpochNotMatchError,
+  a superseded term answers StaleTermError, and a grant past its
+  expiry refuses to serve at all — stale routing can produce a typed
+  retry, never a silently wrong result. A lease loop acquires unheld
+  ranges (election = the deterministic lease race over the shared
+  directory; the WAL replay makes takeover lossless for acked commits)
+  and renews held ones.
+
+Loss window (document over deny): leadership fencing is checked at
+request entry, not per WAL byte. A leader paused (SIGSTOP) MID-handler
+past its lease expiry can still append after a successor opened the
+same WAL — the same bounded window the pull-replication tier documents.
+Kill-9 (the failure mode the chaos suite drives) has no such window:
+a dead process appends nothing.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from .. import obs
+from ..analysis import lockcheck
+from ..kv.mvcc import (KVError, KeyIsLockedError, MVCCStore, Mutation,
+                       PyOrderedKV, TxnNotFoundError, WriteConflictError,
+                       fsync_dir)
+from ..kv.rangemeta import RangeSpec, split_keyspace
+from ..util import failpoint
+from .errors import (EpochNotMatchError, NotLeaderError, RPCError,
+                     StaleLeaseError, StaleTermError, traced_response,
+                     wire_error)
+from .frame import get_range_ctx, get_trace_ctx
+from .server import FrameListener, read_term, write_term
+
+
+def _now_ms() -> float:
+    # wall clock on purpose: grant expiries must compare across
+    # processes, which monotonic clocks never do
+    return time.time() * 1000.0
+
+
+def _write_json_atomic(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ---- the directory service ---------------------------------------------------
+class RangeDirectory:
+    """Range table + per-range lease grants under `<root>/ranges/`.
+
+    Layout:
+        ranges/meta.json          the range table (id, bounds, epoch)
+        ranges/meta.lock          flock serializing table writes
+        ranges/r<id>/lease.lock   flock serializing grant writes
+        ranges/r<id>/grant.json   {owner, token, term, expires_ms, ...}
+        ranges/r<id>/term         persisted fencing term (write_term)
+        ranges/r<id>/data/        the range's own WAL directory
+    """
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.dir = os.path.join(root, "ranges")
+        os.makedirs(self.dir, exist_ok=True)
+
+    # ---- paths ----
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, "meta.json")
+
+    def _range_dir(self, rid: int) -> str:
+        return os.path.join(self.dir, f"r{int(rid)}")
+
+    def data_dir(self, rid: int) -> str:
+        return os.path.join(self._range_dir(rid), "data")
+
+    def _grant_path(self, rid: int) -> str:
+        return os.path.join(self._range_dir(rid), "grant.json")
+
+    def _term_path(self, rid: int) -> str:
+        return os.path.join(self._range_dir(rid), "term")
+
+    @contextmanager
+    def _flock(self, path: str):
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            os.close(fd)  # releases the flock with the fd
+
+    # ---- the range table ----
+    def bootstrap(self, specs: Optional[list] = None) -> list[RangeSpec]:
+        """Write the range table if absent (first writer wins — every
+        later bootstrapper adopts the existing table regardless of its
+        own knobs, so concurrently started servers can never disagree
+        about range bounds). Returns the authoritative table."""
+        with self._flock(os.path.join(self.dir, "meta.lock")):
+            existing = self.load_specs()
+            if existing is not None:
+                return existing
+            specs = list(specs) if specs else split_keyspace(1)
+            _write_json_atomic(self._meta_path(), {
+                "ranges": [{"id": s.id, "start": s.start_key.hex(),
+                            "end": s.end_key.hex(), "epoch": s.epoch}
+                           for s in specs]})
+            for s in specs:
+                os.makedirs(self.data_dir(s.id), exist_ok=True)
+            return specs
+
+    def load_specs(self) -> Optional[list[RangeSpec]]:
+        doc = _read_json(self._meta_path())
+        if not doc:
+            return None
+        return [RangeSpec(int(r["id"]), bytes.fromhex(r["start"]),
+                          bytes.fromhex(r["end"]), int(r.get("epoch", 1)))
+                for r in doc["ranges"]]
+
+    def bump_epoch(self, rid: int) -> int:
+        """Advance one range's routing epoch (the metadata-changed
+        signal: clients carrying the old epoch get EpochNotMatchError
+        and reload the table). Bounds stay put — this repo reshapes
+        tables offline, not live."""
+        with self._flock(os.path.join(self.dir, "meta.lock")):
+            doc = _read_json(self._meta_path())
+            if not doc:
+                raise RPCError("range table missing")
+            new = 0
+            for r in doc["ranges"]:
+                if int(r["id"]) == int(rid):
+                    r["epoch"] = new = int(r.get("epoch", 1)) + 1
+            if not new:
+                raise RPCError(f"unknown range {rid}")
+            _write_json_atomic(self._meta_path(), doc)
+            return new
+
+    # ---- grants ----
+    def read_grant(self, rid: int) -> Optional[dict]:
+        """Lock-free grant read (atomic rename makes it torn-proof) —
+        what routers use to find a range's current leader."""
+        return _read_json(self._grant_path(rid))
+
+    def acquire(self, rid: int, owner: str,
+                lease_ms: int) -> Optional[dict]:
+        """Take the range's lease if it is free, expired, or already
+        ours. The token bumps on EVERY grant write (per-tenure fencing
+        for renewal); the TERM bumps only when ownership changes hands
+        (the cross-process fencing epoch a deposed leader can never
+        re-present). Returns the grant, or None while another owner's
+        grant is still live."""
+        os.makedirs(self._range_dir(rid), exist_ok=True)
+        with self._flock(os.path.join(self._range_dir(rid),
+                                      "lease.lock")):
+            g = _read_json(self._grant_path(rid))
+            now = _now_ms()
+            if g and g.get("owner") != owner \
+                    and float(g.get("expires_ms", 0)) > now:
+                return None  # live grant held elsewhere
+            prev_owner = g.get("owner", "") if g else ""
+            # the term floor survives a torn/corrupt grant file: the
+            # dedicated term file is the durable fencing record
+            term = max(int(g.get("term", 0)) if g else 0,
+                       read_term(self._term_path(rid)))
+            if prev_owner != owner:
+                term += 1
+                write_term(self._term_path(rid), term)
+            grant = {"range_id": int(rid), "owner": owner,
+                     "token": (int(g.get("token", 0)) if g else 0) + 1,
+                     "term": term, "expires_ms": now + int(lease_ms),
+                     "prev_owner": prev_owner}
+            _write_json_atomic(self._grant_path(rid), grant)
+            return grant
+
+    def renew(self, rid: int, owner: str, token: int,
+              lease_ms: int) -> dict:
+        """Extend our own grant; StaleLeaseError when the grant is no
+        longer ours (another process acquired while our lease was
+        expired — the holder must fence itself immediately)."""
+        with self._flock(os.path.join(self._range_dir(rid),
+                                      "lease.lock")):
+            g = _read_json(self._grant_path(rid))
+            if not g or g.get("owner") != owner \
+                    or int(g.get("token", -1)) != int(token):
+                raise StaleLeaseError(
+                    f"range {rid} grant is {g and g.get('owner')!r} "
+                    f"token {g and g.get('token')}, not {owner!r} "
+                    f"token {token}")
+            g["expires_ms"] = _now_ms() + int(lease_ms)
+            _write_json_atomic(self._grant_path(rid), g)
+            return g
+
+    def release(self, rid: int, owner: str, token: int) -> bool:
+        """Zero our grant's expiry so a successor can acquire without
+        waiting out the lease (graceful shutdown / forced transfer)."""
+        with self._flock(os.path.join(self._range_dir(rid),
+                                      "lease.lock")):
+            g = _read_json(self._grant_path(rid))
+            if not g or g.get("owner") != owner \
+                    or int(g.get("token", -1)) != int(token):
+                return False
+            g["expires_ms"] = 0
+            _write_json_atomic(self._grant_path(rid), g)
+            return True
+
+
+# ---- one hosted range --------------------------------------------------------
+class RangeLeader:
+    """A range this process leads: its own durable MVCC store (WAL
+    replay on open makes takeover lossless for acked commits) plus the
+    lease/fencing state the request gate checks."""
+
+    def __init__(self, spec: RangeSpec, grant: dict, data_dir: str,
+                 sync_log: str = "commit") -> None:
+        self.spec = spec
+        self.grant = dict(grant)
+        self.store = MVCCStore(PyOrderedKV(data_dir, sync_log=sync_log))
+        self._max_commit = self.store.max_commit_ts()
+        self.fenced = False
+
+    @property
+    def term(self) -> int:
+        return int(self.grant.get("term", 0))
+
+    def note_commit(self, commit_ts: int) -> None:
+        if commit_ts > self._max_commit:
+            self._max_commit = commit_ts
+
+    def closed_ts(self) -> int:
+        """Everything at or below this ts is settled on this range: one
+        pending prewrite holds it at start_ts-1 (that txn may still
+        commit anywhere above its start), otherwise the newest commit
+        — the per-range pending-commit ledger."""
+        locks = self.store.all_locks()
+        if locks:
+            return min(l.start_ts for l in locks) - 1
+        return self._max_commit
+
+    def close(self) -> None:
+        close = getattr(self.store.kv, "close", None)
+        if close is not None:
+            close()
+
+
+def _kv_guarded(fn) -> dict:
+    """Run one store operation and fold its typed KV failures into the
+    response envelope — KV errors are RESULTS the committer interprets
+    (resolve the lock, retry the conflict), not transport errors, so
+    they must not burn the client's retry budget or trip its breaker."""
+    try:
+        return {"ok": True, "v": fn()}
+    except KeyIsLockedError as e:
+        lk = e.lock
+        return {"ok": False, "err_kv": {
+            "kind": "locked", "key": lk.key, "primary": lk.primary,
+            "start_ts": lk.start_ts, "op": lk.op, "ttl": lk.ttl}}
+    except WriteConflictError as e:
+        return {"ok": False, "err_kv": {
+            "kind": "conflict", "key": e.key, "start_ts": e.start_ts,
+            "conflict_ts": e.conflict_ts}}
+    except TxnNotFoundError as e:
+        return {"ok": False, "err_kv": {"kind": "txn_not_found",
+                                        "msg": str(e)}}
+    except KVError as e:
+        return {"ok": False, "err_kv": {"kind": "kv", "msg": str(e)}}
+
+
+# ---- the server ---------------------------------------------------------------
+class RangeServer(FrameListener):
+    """Per-range write leadership over the frame protocol."""
+
+    _thread_prefix = "titpu-range"
+
+    def __init__(self, root: str, listen: str = "127.0.0.1:0",
+                 lease_ms: int = 1000, specs: Optional[list] = None,
+                 sync_log: str = "commit", events=None) -> None:
+        self.directory = RangeDirectory(root)
+        self.specs = self.directory.bootstrap(specs)
+        self.lease_ms = int(lease_ms)
+        self.events = events
+        # guards the hosted-leader map only — every critical section is
+        # a dict op (HOT_LOCKS-declared: this sits on the 2PC data path)
+        self._mu = lockcheck.lock("RangeServer._mu", hot=True)
+        self._leaders: dict[int, RangeLeader] = {}
+        self._closed = False
+        fam, target = self._start_listener(listen)
+        import socket as _socket
+        if fam == _socket.AF_INET:
+            host = target[0] or "127.0.0.1"
+            self.address = f"{host}:{self.port}"
+        else:
+            self.address = str(listen)
+        # one synchronous pass before serving: a just-constructed server
+        # already hosts every free range (tests need no settle loop)
+        self._lease_tick()
+        self._stop = threading.Event()
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="titpu-range-lease",
+            daemon=True)
+        self._lease_thread.start()
+
+    # ---- lease plane ----
+    def _lease_loop(self) -> None:
+        period = max(0.05, self.lease_ms / 3000.0)
+        while not self._stop.wait(period):
+            try:
+                self._lease_tick()
+            except Exception as e:  # keep the plane alive
+                if self.events is not None:
+                    self.events.record("range_lease_error", str(e),
+                                       severity="warning")
+
+    def _lease_tick(self) -> None:
+        specs = self.directory.load_specs()
+        if specs:
+            self.specs = specs
+        drop = failpoint.inject("range/lease-drop")
+        for spec in self.specs:
+            with self._mu:
+                leader = self._leaders.get(spec.id)
+            if leader is not None:
+                leader.spec = spec  # adopt epoch bumps
+                if drop is not None and (
+                        drop is True or int(drop) == spec.id):
+                    self.directory.release(spec.id, self.address,
+                                           leader.grant["token"])
+                    self._drop_leader(spec.id, "lease-drop failpoint")
+                    continue
+                try:
+                    leader.grant = self.directory.renew(
+                        spec.id, self.address, leader.grant["token"],
+                        self.lease_ms)
+                except (StaleLeaseError, OSError) as e:
+                    self._drop_leader(spec.id, f"lease lost: {e}")
+            else:
+                try:
+                    g = self.directory.acquire(spec.id, self.address,
+                                               self.lease_ms)
+                except OSError:
+                    g = None
+                if g:
+                    self._open_leader(spec, g)
+
+    def _open_leader(self, spec: RangeSpec, grant: dict) -> None:
+        leader = RangeLeader(spec, grant,
+                             self.directory.data_dir(spec.id))
+        with self._mu:
+            self._leaders[spec.id] = leader
+        obs.RANGE_LEADERS.inc()
+        prev = grant.get("prev_owner", "")
+        if prev and prev != self.address:
+            obs.RANGE_TRANSFERS.inc()
+            if self.events is not None:
+                self.events.record(
+                    "range_transfer",
+                    f"r{spec.id} {prev} -> {self.address} "
+                    f"term={grant['term']}", severity="warning")
+
+    def _drop_leader(self, rid: int, why: str) -> None:
+        with self._mu:
+            leader = self._leaders.pop(rid, None)
+        if leader is None:
+            return
+        leader.fenced = True
+        obs.RANGE_LEADERS.dec()
+        if self.events is not None:
+            self.events.record("range_transfer",
+                               f"r{rid} dropped by {self.address}: "
+                               f"{why}", severity="warning")
+        leader.close()
+
+    # ---- request gate ----
+    def _leader_for(self, params: dict) -> RangeLeader:
+        """The fencing gate every data request passes BEFORE any data
+        access; raises typed so the client refreshes + retries instead
+        of acting on a stale view."""
+        rc = get_range_ctx(params)
+        if rc is None:
+            raise RPCError("missing range context")
+        rid = int(rc["range_id"])
+        with self._mu:
+            leader = self._leaders.get(rid)
+        if leader is None or leader.fenced:
+            g = self.directory.read_grant(rid)
+            hint = (f" (grant: {g['owner']} term {g['term']})"
+                    if g else "")
+            raise NotLeaderError(f"range {rid} not led here{hint}")
+        if float(leader.grant.get("expires_ms", 0)) <= _now_ms():
+            # our own lease ran out and the renew loop hasn't caught it
+            # yet — refusing here is what makes the lease a fence
+            raise NotLeaderError(f"range {rid} lease expired on "
+                                 f"{self.address}")
+        if int(rc.get("epoch", 0)) != int(leader.spec.epoch):
+            raise EpochNotMatchError(
+                f"range {rid} epoch {rc.get('epoch')} != "
+                f"{leader.spec.epoch} — reload the range table")
+        cterm = int(rc.get("term", 0))
+        if cterm < leader.term:
+            raise StaleTermError(f"range {rid} request term {cterm} < "
+                                 f"current {leader.term}")
+        if cterm > leader.term:
+            # the CLIENT has seen a newer tenure than ours: we are the
+            # deposed one (a renew raced); never serve on a stale term
+            raise NotLeaderError(f"range {rid} deposed: request term "
+                                 f"{cterm} > local {leader.term}")
+        return leader
+
+    # ---- dispatch ----
+    def _dispatch(self, req) -> dict:
+        if not isinstance(req, dict) or "m" not in req:
+            return wire_error(None, RPCError("bad request"))
+        rid = req.get("id")
+        method = str(req.get("m"))
+        params = req.get("p") if isinstance(req.get("p"), dict) else {}
+        handler = getattr(self, f"_h_{method}", None) \
+            if method.startswith("range_") else None
+        if handler is None:
+            return wire_error(rid, RPCError(
+                f"unknown range method {method!r}"))
+        return traced_response(rid, method, lambda: handler(params),
+                               get_trace_ctx(req))
+
+    # ---- percolator handlers ----
+    def _h_range_prewrite(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        muts = [Mutation(bytes(m[0]), bytes(m[1]), bytes(m[2]))
+                for m in params["mutations"]]
+        out = _kv_guarded(lambda: leader.store.prewrite(
+            muts, bytes(params["primary"]), int(params["start_ts"]),
+            int(params.get("ttl", 3000))))
+        # applied-but-unacked: a kill here is the harshest prewrite
+        # crash — the lock is durable, the coordinator never heard back
+        failpoint.inject("range/before-prewrite-ack")
+        return out
+
+    def _h_range_commit(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        commit_ts = int(params["commit_ts"])
+        out = _kv_guarded(lambda: leader.store.commit(
+            [bytes(k) for k in params["keys"]],
+            int(params["start_ts"]), commit_ts))
+        if out["ok"]:
+            leader.note_commit(commit_ts)
+        failpoint.inject("range/before-commit-ack")
+        return out
+
+    def _h_range_rollback(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        return _kv_guarded(lambda: leader.store.rollback(
+            [bytes(k) for k in params["keys"]],
+            int(params["start_ts"])))
+
+    def _h_range_get(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        return _kv_guarded(lambda: leader.store.get(
+            bytes(params["key"]), int(params["read_ts"])))
+
+    def _h_range_scan(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        spec = leader.spec
+        start = max(bytes(params.get("start", b"")), spec.start_key)
+        end = bytes(params.get("end", b""))
+        if spec.end_key and (not end or end > spec.end_key):
+            end = spec.end_key
+        return _kv_guarded(lambda: [list(kv) for kv in leader.store.scan(
+            start, end, int(params["read_ts"]),
+            int(params.get("limit", -1)))])
+
+    def _h_range_check_txn_status(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+
+        def run():
+            commit_ts, expired = leader.store.check_txn_status(
+                bytes(params["primary"]), int(params["lock_ts"]),
+                int(params["current_ts"]))
+            return {"commit_ts": commit_ts, "expired": expired}
+
+        return _kv_guarded(run)
+
+    def _h_range_resolve_lock(self, params: dict) -> dict:
+        leader = self._leader_for(params)
+        out = _kv_guarded(lambda: leader.store.resolve_lock(
+            bytes(params["key"]), int(params["start_ts"]),
+            int(params["commit_ts"])))
+        if out["ok"]:
+            obs.RANGE_ORPHAN_RESOLUTIONS.inc()
+        return out
+
+    # ---- metadata / diagnostics ----
+    def _h_range_table(self, params: dict) -> dict:
+        """The routing bootstrap for clients without filesystem access
+        to the shared root: table + every range's current grant."""
+        specs = self.directory.load_specs() or self.specs
+        grants = {}
+        for s in specs:
+            g = self.directory.read_grant(s.id)
+            if g:
+                grants[int(s.id)] = {"owner": g.get("owner", ""),
+                                     "term": int(g.get("term", 0)),
+                                     "expires_ms":
+                                         float(g.get("expires_ms", 0))}
+        return {"specs": [s.to_wire() for s in specs],
+                "grants": grants}
+
+    def _h_range_info(self, params: dict) -> dict:
+        return {"ranges": self.describe()}
+
+    def describe(self) -> list[dict]:
+        """Hosted ranges, one row each — what /status and cluster_info
+        render."""
+        with self._mu:
+            leaders = sorted(self._leaders.items())
+        out = []
+        for rid, leader in leaders:
+            out.append({"range_id": rid, "leader": self.address,
+                        "term": leader.term,
+                        "epoch": leader.spec.epoch,
+                        "token": int(leader.grant.get("token", 0)),
+                        "closed_ts": leader.closed_ts(),
+                        "start": leader.spec.start_key.hex(),
+                        "end": leader.spec.end_key.hex()})
+        return out
+
+    def hosted_ids(self) -> list[int]:
+        with self._mu:
+            return sorted(self._leaders)
+
+    def close(self, release: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._lease_thread.join(timeout=5.0)
+        self._close_listener()
+        with self._mu:
+            leaders = dict(self._leaders)
+            self._leaders.clear()
+        for rid, leader in leaders.items():
+            leader.fenced = True
+            if release:
+                try:
+                    self.directory.release(rid, self.address,
+                                           leader.grant["token"])
+                except OSError:
+                    pass
+            obs.RANGE_LEADERS.dec()
+            leader.close()
+
+
+class RangePlane:
+    """The [ranges]-armed subsystem one Storage owns: a RangeServer
+    rooted under the storage path plus the knobs mirror. Entirely OFF
+    the statement path — arming starts a listener and a lease loop;
+    statements never consult it, which is what makes `[ranges]`
+    disabled byte-identical to the pre-range engine."""
+
+    def __init__(self, storage, count: int = 1, split_points=(),
+                 lease_ms: int = 1000, resolve_ttl_ms: int = 3000,
+                 listen: str = "127.0.0.1:0") -> None:
+        self.storage = storage
+        self.resolve_ttl_ms = int(resolve_ttl_ms)
+        self.server = RangeServer(
+            storage.path, listen=listen, lease_ms=int(lease_ms),
+            specs=split_keyspace(int(count), split_points),
+            events=storage.obs.events)
+
+    def router(self, **kw):
+        from ..kv.rangeclient import RangeRouter
+        return RangeRouter(root=self.storage.path, **kw)
+
+    def committer(self, tso, **kw):
+        from ..kv.twopc import TwoPhaseCommitter
+        kw.setdefault("lock_ttl", self.resolve_ttl_ms)
+        return TwoPhaseCommitter(self.router(), tso, **kw)
+
+    def set_knobs(self, lease_ms: Optional[int] = None,
+                  resolve_ttl_ms: Optional[int] = None) -> None:
+        """The SIGHUP-reloadable subset."""
+        if lease_ms is not None:
+            self.server.lease_ms = max(int(lease_ms), 50)
+        if resolve_ttl_ms is not None:
+            self.resolve_ttl_ms = max(int(resolve_ttl_ms), 1)
+
+    def status(self) -> dict:
+        return {"listen": self.server.address,
+                "lease_ms": self.server.lease_ms,
+                "resolve_ttl_ms": self.resolve_ttl_ms,
+                "table": [s.to_wire() | {"start": s.start_key.hex(),
+                                         "end": s.end_key.hex()}
+                          for s in self.server.specs],
+                "hosted": self.server.describe()}
+
+    def close(self) -> None:
+        self.server.close()
+
+
+__all__ = ["RangeDirectory", "RangeLeader", "RangeServer", "RangePlane"]

@@ -216,6 +216,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_diagnostics(storage)
     cfg.seed_history(storage)
     cfg.seed_replica_read(storage)
+    cfg.seed_ranges(storage)
     cfg.seed_group_commit(storage)
     cfg.seed_mesh()
     srv = Server(storage, host=cfg.host, port=cfg.port,
@@ -262,6 +263,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_diagnostics(storage)
             cfg.seed_history(storage)
             cfg.seed_replica_read(storage)
+            cfg.seed_ranges(storage)
             cfg.seed_group_commit(storage)
             if srv._pool is not None:
                 # 0 = recompute the auto sizing (min(8, cpu/2)), so a

@@ -99,6 +99,13 @@ class StatusServer:
                         gov = getattr(st, "governor", None)
                         if gov is not None:
                             status["governor"] = gov.stats()
+                        # range-sharded write leadership: the range
+                        # table plus every range this process leads
+                        # (id, term, closed_ts) — absent while
+                        # [ranges] is disabled
+                        plane = getattr(st, "ranges", None)
+                        if plane is not None:
+                            status["ranges"] = plane.status()
                     # mesh data plane: device count + per-device
                     # sharded-epoch bytes (never grabs a backend as a
                     # scrape side effect — copr/mesh.status is lazy)

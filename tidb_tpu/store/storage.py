@@ -150,6 +150,10 @@ class Storage:
         self._start_time = _time.time()
         self.diag_listener = None
         self.failover = None
+        # range-sharded write leadership (rpc/ranged.py RangePlane);
+        # None until [ranges] arms it — the statement path never reads
+        # this attribute, so disabled costs exactly nothing
+        self.ranges = None
         # True while promote_to_leader is mid-flight: diag_election
         # reports the transitional role so peer voters HOLD their
         # election open instead of dropping us from the electorate
@@ -1166,6 +1170,9 @@ class Storage:
         close_peer_clients(self)
         if self._maintenance is not None:
             self._maintenance.stop()
+        if self.ranges is not None:
+            self.ranges.close()
+            self.ranges = None
         if self.rpc_server is not None:
             self.rpc_server.close()
         self.ddl_owner.close()
@@ -1215,6 +1222,31 @@ class Storage:
 
     def table_store(self, table_id: int) -> TableStore:
         return self.tables[table_id]
+
+    # ---- range-sharded write leadership (rpc/ranged.py) ---------------------
+    def arm_ranges(self, enabled: bool = False, count: int = 1,
+                   split_points=(), lease_ms: int = 1000,
+                   resolve_ttl_ms: int = 3000,
+                   listen: str = "127.0.0.1:0") -> None:
+        """Start the range plane to match the [ranges] settings (called
+        from Config.seed_ranges on startup/SIGHUP). lease-ms and
+        resolve-ttl-ms reload live; enabling/disabling or reshaping the
+        table needs a restart (the table is durable, first writer
+        wins). Only a durable local store can host range leaders —
+        followers and in-memory stores route to one that does."""
+        if self.ranges is not None:
+            if enabled:
+                self.ranges.set_knobs(lease_ms=lease_ms,
+                                      resolve_ttl_ms=resolve_ttl_ms)
+            return
+        if not enabled or self.remote or self.path is None:
+            return
+        from ..rpc.ranged import RangePlane
+        self.ranges = RangePlane(self, count=count,
+                                 split_points=split_points,
+                                 lease_ms=lease_ms,
+                                 resolve_ttl_ms=resolve_ttl_ms,
+                                 listen=listen)
 
     # ---- follower read tier (rpc/apply.py + rpc/replica.py) -----------------
     def arm_replica_read(self) -> None:

@@ -59,6 +59,12 @@ DECLARED = frozenset({
     "kv/group-fsync",              # kv/mvcc.py pre-fsync crash site
     "kv/wal-torn-append",          # kv/mvcc.py torn WAL record
     "mesh/skew",                   # copr/mesh.py synthetic shard skew
+    "range/before-commit-ack",     # rpc/ranged.py commit applied,
+                                   # ack not sent (leader-kill site)
+    "range/before-prewrite-ack",   # rpc/ranged.py prewrite applied,
+                                   # ack not sent (leader-kill site)
+    "range/lease-drop",            # rpc/ranged.py forced lease release
+                                   # (value: range id, or true = all)
     "replica/apply-stall",         # rpc/apply.py frozen apply loop
     "rpc/conn-drop",               # rpc/client.py transport chaos
     "rpc/delay",
