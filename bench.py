@@ -1469,6 +1469,29 @@ def flight_htap_mixed(res: dict) -> None:
             f"{res['values']['htap_write_scaling_32x']:.1f}x QPS at 32 "
             "writers vs 1 under sync-log=commit")
 
+        # ---- wait-profile zero-overhead check: the x8 write phase
+        # again with performance.wait-profile-enabled on (per-statement
+        # typed ledger + windowed digest attribution) ----
+        storage.obs.waitprofile.configure(enabled=True)
+        try:
+            wp_ph = run_phase(0, 8, 0, seconds)
+        finally:
+            storage.obs.waitprofile.configure(enabled=False)
+        base = res["values"].get("htap_write_qps_8", 0) or 1
+        res["values"]["htap_write_qps_8_wp"] = round(wp_ph["write_qps"], 1)
+        res["values"]["htap_wp_ratio"] = round(
+            wp_ph["write_qps"] / base, 3)
+        lines.append(
+            f"htap_mixed write x8 +wait-profile: "
+            f"{wp_ph['write_qps']:.0f} durable QPS "
+            f"({res['values']['htap_wp_ratio']:.3f}x of ledger-off)")
+        wrows = storage.obs.waitprofile.table_rows()
+        upd = [r for r in wrows if "update" in (r[2] or "")][:3]
+        for r in upd:
+            lines.append(
+                f"htap_mixed waitprofile: {r[6]} {r[7]:.1f}ms "
+                f"({r[8]:.0%} of wall) — {r[2][:60]}")
+
         # ---- point reads alone (baseline), then the full HTAP mix ----
         warm = mc.MiniClient(*addr)
         warm.query(TPCH_Q6)
@@ -1541,12 +1564,18 @@ def flight_range_write(res: dict) -> None:
     from tidb_tpu.kv.twopc import TwoPhaseCommitter
     from tidb_tpu.rpc.ranged import RangeServer
 
+    from tidb_tpu import obs as _obs
+
     lines = res["lines"]
     n_leaders = int(os.environ.get("BENCH_RANGE_LEADERS", 4))
     workers = int(os.environ.get("BENCH_RANGE_WORKERS", 8))
     seconds = float(os.environ.get("BENCH_RANGE_SECONDS", 6))
-    qps: dict[int, float] = {}
-    for count in (1, n_leaders):
+    # third phase: the wait-profile zero-overhead check — the same
+    # n_leaders workload with a fresh per-txn WaitLedger installed
+    # (what performance.wait-profile-enabled costs this path)
+    qps: dict[tuple[int, bool], float] = {}
+    for count, with_ledger in ((1, False), (n_leaders, False),
+                               (n_leaders, True)):
         tmp = tempfile.mkdtemp(prefix=f"bench-range-{count}-")
         srv = None
         routers: list = []
@@ -1566,12 +1595,18 @@ def flight_range_write(res: dict) -> None:
                                               lock_ttl=3000)
                 i = 0
                 while not stop.is_set():
+                    if with_ledger:
+                        # per-statement semantics: a fresh ledger per
+                        # txn, like Session._execute_observed installs
+                        _obs.install_wait_ledger(_obs.WaitLedger())
                     key = bytes([(w * 37 + i * 11) % 256]) + \
                         b"k%d.%d" % (w, i)
                     committer.commit(
                         [Mutation(OP_PUT, key, b"v%d" % i)], tso.ts())
                     counts[w] += 1
                     i += 1
+                if with_ledger:
+                    _obs.install_wait_ledger(None)
             threads = [threading.Thread(target=worker, args=(w,),
                                         name=f"bench-range-w{w}",
                                         daemon=True)
@@ -1584,10 +1619,11 @@ def flight_range_write(res: dict) -> None:
             for t in threads:
                 t.join(timeout=30)
             wall = time.perf_counter() - t0
-            qps[count] = sum(counts) / wall
+            qps[(count, with_ledger)] = sum(counts) / wall
+            tag = " +wait-profile" if with_ledger else ""
             lines.append(
                 f"range_write x{count} leader{'s' if count > 1 else ''}"
-                f": {qps[count]:.0f} durable txn/s "
+                f"{tag}: {qps[(count, with_ledger)]:.0f} durable txn/s "
                 f"({workers} workers, sync-log=commit, "
                 f"{sum(counts)} commits / {wall:.1f}s)")
         finally:
@@ -1596,16 +1632,23 @@ def flight_range_write(res: dict) -> None:
             if srv is not None:
                 srv.close()
             shutil.rmtree(tmp, ignore_errors=True)
-    res["values"]["range_write_qps_1"] = round(qps[1], 1)
+    res["values"]["range_write_qps_1"] = round(qps[(1, False)], 1)
     res["values"][f"range_write_qps_{n_leaders}"] = \
-        round(qps[n_leaders], 1)
+        round(qps[(n_leaders, False)], 1)
     res["values"]["range_write_scaling"] = round(
-        qps[n_leaders] / max(qps[1], 1e-9), 2)
+        qps[(n_leaders, False)] / max(qps[(1, False)], 1e-9), 2)
     res["values"]["range_write_leaders"] = n_leaders
     lines.append(
         f"range_write scaling: "
         f"{res['values']['range_write_scaling']:.2f}x durable write "
         f"QPS at {n_leaders} range leaders vs 1")
+    res["values"]["range_write_qps_wp"] = round(qps[(n_leaders, True)], 1)
+    res["values"]["range_write_wp_ratio"] = round(
+        qps[(n_leaders, True)] / max(qps[(n_leaders, False)], 1e-9), 3)
+    lines.append(
+        f"range_write wait-profile cost: "
+        f"{res['values']['range_write_wp_ratio']:.3f}x QPS with the "
+        "typed wait ledger on (fresh ledger per txn) vs off")
 
 
 FLIGHTS = {

@@ -108,7 +108,8 @@ class TestExplainAnalyzeMeshColumn:
     def test_single_device_has_empty_mesh_cell(self, sessions):
         single, _, _ = sessions
         rs = single.execute("EXPLAIN ANALYZE " + TPCH_Q6)
-        assert rs.column_names[-1] == "mesh"
+        assert rs.column_names[5] == "mesh"
+        assert rs.column_names[-1] == "wait_profile"
         assert all(not r[5] for r in rs.rows), rs.rows
 
 

@@ -520,3 +520,119 @@ def test_range_leader_flap_rule(tmp_path):
     assert rows and rows[0][1] == "r1"
     assert int(rows[0][2]) >= thr
     st.close()
+
+
+# ==================== distributed write tracing ====================
+
+def test_cross_range_traced_write_stitched_tree(tmp_path):
+    """An autocommit-shaped cross-range write under TRACE produces ONE
+    stitched tree: the coordinator's 2PC phase spans with a per-range-
+    leader subtree (lease gate -> WAL append -> apply) riding back on
+    each routed RPC, plus a typed wait ledger whose exclusive sums stay
+    inside the wall clock."""
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=3000)
+        led = obs.WaitLedger()
+        prev = obs.install_wait_ledger(led)
+        try:
+            t0 = time.perf_counter()
+            with obs.SpanCollector("stmt") as coll:
+                _commit_kv(committer, {b"\x10t": b"v",
+                                       b"\xf0t": b"v"}, tso)
+            wall = time.perf_counter() - t0
+        finally:
+            obs.install_wait_ledger(prev)
+        rows = coll.rows()
+        labels = [r[0] for r in rows]
+        names = [lb.strip().split(" ")[0] for lb in labels]
+        # coordinator 2PC phases
+        assert "twopc.prewrite" in names
+        assert "twopc.commit_primary" in names
+        assert "twopc.commit_secondary" in names
+        # one remote subtree PER range leader: the primary and the
+        # secondary prewrite land on different ranges, each answering
+        # with its own server-side spans
+        assert names.count("remote.range_prewrite") >= 2, names
+        assert names.count("range.lease_gate") >= 2, names
+        assert names.count("range.apply") >= 2, names
+        assert names.count("wal.append") >= 2, names
+        # the remote roots carry THIS trace's identity (Dapper ctx
+        # propagated through the wire, not re-generated per hop)
+        joined = " ".join(labels)
+        assert f"trace_id={coll.trace_id[:16]}" in joined, joined
+        # typed ledger: the phases appear, exclusively accounted
+        assert led.totals.get("prewrite", 0.0) > 0.0, led.totals
+        assert led.totals.get("commit_primary", 0.0) > 0.0, led.totals
+        assert sum(led.totals.values()) <= wall * 1.05, (led.totals, wall)
+        router.close()
+    finally:
+        srv.close()
+
+
+def test_range_write_no_trace_no_ledger_allocations(tmp_path, monkeypatch):
+    """Zero-cost contract on the range write path: with no TRACE active
+    and no ledger installed, a cross-range commit allocates no Span and
+    no WaitLedger (histogram .observe() calls are the only telemetry)."""
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=3000)
+        _commit_kv(committer, {b"\x10warm": b"v", b"\xf0warm": b"v"}, tso)
+
+        made = []
+        real_init = obs.Span.__init__
+
+        def counting_init(self, name, start):
+            made.append(name)
+            real_init(self, name, start)
+
+        def poison_ledger(self, *a, **kw):
+            raise AssertionError("WaitLedger built on the untraced path")
+
+        monkeypatch.setattr(obs.Span, "__init__", counting_init)
+        monkeypatch.setattr(obs.WaitLedger, "__init__", poison_ledger)
+        _commit_kv(committer, {b"\x10cold": b"v", b"\xf0cold": b"v"}, tso)
+        assert made == [], made
+        router.close()
+    finally:
+        srv.close()
+
+
+def test_orphan_resolution_emits_traced_event(tmp_path):
+    """A peer that rolls a crashed coordinator's orphan lock forward
+    leaves a structured EventLog record carrying the resolving
+    statement's trace_id — the audit trail /debug/events serves."""
+    srv = _server(tmp_path)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        crashed = TwoPhaseCommitter(router, tso, lock_ttl=50)
+        with failpoint.failpoint("twopc/after-primary-commit",
+                                 RuntimeError("coordinator died")):
+            with pytest.raises(RuntimeError):
+                _commit_kv(crashed, {b"\x10e": b"durable",
+                                     b"\xf0e": b"durable"}, tso)
+        time.sleep(0.08)  # past the TTL
+        ev = obs.EventLog()
+        peer = RangeRouter(root=str(tmp_path))
+        resolver = TwoPhaseCommitter(peer, tso, lock_ttl=3000, events=ev)
+        with obs.SpanCollector("stmt") as coll:
+            # writing over the orphaned secondary hits its lock: the
+            # resolver checks the primary (committed) and rolls forward
+            _commit_kv(resolver, {b"\xf0e": b"w2"}, tso)
+        recs = [e for e in ev.snapshot() if e["kind"] == "orphan_resolved"]
+        assert recs, ev.snapshot()
+        detail = recs[0]["detail"]
+        assert "roll-forward" in detail, detail
+        assert f"trace_id={coll.trace_id}" in detail, detail
+        snap = Snapshot(peer, tso, tso.ts())
+        assert snap.get(b"\x10e") == b"durable"
+        assert snap.get(b"\xf0e") == b"w2"
+        peer.close()
+        router.close()
+    finally:
+        srv.close()

@@ -72,7 +72,7 @@ def test_trace_stage_sum_matches_explain_analyze_wall():
     tk.must_query(Q6)  # warm
     rs = tk.session.execute("explain analyze " + Q6)
     assert rs.column_names == ["plan", "actRows", "time_ms", "engine",
-                               "stages", "mesh"]
+                               "stages", "mesh", "wait_profile"]
     root = rs.rows[0]
     leaf = next(r for r in rs.rows if "TableRead" in r[0])
     assert "device" in leaf[3]
@@ -230,7 +230,7 @@ def test_slow_log_carries_digest_and_stages():
     rs = tk.session.execute("show slow queries")
     assert rs.column_names == ["Time", "DB", "Duration_ms", "Query",
                                "Plan_digest", "Stages", "Mem_max",
-                               "Spill_count"]
+                               "Spill_count", "Wait_profile"]
     ent = next(r for r in rs.rows if "l_extendedprice" in r[3])
     assert len(ent[4]) == 32  # digest joins against statements_summary
     digests = {r[0] for r in tk.must_query(
@@ -403,3 +403,168 @@ def test_debug_routes_trace_and_profile():
         assert "enabled" in mesh["status"]
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------- wait-state
+# attribution: typed per-statement wait ledger + profile surfaces
+
+
+def test_wait_ledger_exclusive_accounting_within_wall():
+    import time as _time
+    led = obs.WaitLedger()
+    prev = obs.install_wait_ledger(led)
+    try:
+        t0 = _time.perf_counter()
+        with obs.wait("prewrite"):
+            _time.sleep(0.02)
+            # fallback frames are no-ops inside an open frame: the wire
+            # time stays charged to the enclosing 2PC phase
+            with obs.wait("rpc_net", fallback=True):
+                _time.sleep(0.005)
+            _time.sleep(0.01)
+        obs.note_wait("backoff.txnLock", 0.01)
+        wall = _time.perf_counter() - t0
+    finally:
+        obs.install_wait_ledger(prev)
+    assert "rpc_net" not in led.totals, led.totals
+    assert led.totals["prewrite"] >= 0.03
+    assert abs(led.totals["backoff.txnLock"] - 0.01) < 1e-9
+    # exclusive accounting: states never sum past the wall clock
+    assert sum(led.totals.values()) <= wall * 1.05 + 0.01
+    assert led.counts["prewrite"] == 1
+
+
+def test_wait_ledger_nested_frames_are_exclusive():
+    import time as _time
+    led = obs.WaitLedger()
+    prev = obs.install_wait_ledger(led)
+    try:
+        with obs.wait("commit_primary"):
+            _time.sleep(0.01)
+            with obs.wait("fsync_wait"):
+                _time.sleep(0.02)
+            _time.sleep(0.005)
+    finally:
+        obs.install_wait_ledger(prev)
+    # the child's 20ms is excluded from the parent's share
+    assert led.totals["fsync_wait"] >= 0.02
+    assert led.totals["commit_primary"] >= 0.01
+    assert led.totals["commit_primary"] < 0.03
+
+
+def test_wait_profile_statement_surfaces():
+    tk = _q6_kit()
+    st = tk.session.storage
+    st.obs.waitprofile.configure(enabled=True)
+    try:
+        tk.must_exec("set tidb_slow_log_threshold = 0")
+        tk.must_exec("create table w (a int primary key, b int)")
+        tk.must_exec("insert into w values (1, 10), (2, 20)")
+        waits = dict(tk.session.last_waits)
+        assert waits.get("prewrite", 0.0) > 0.0, waits
+        assert "tso_wait" in waits, waits
+        # the slow-log entry carries the same typed split, bounded by wall
+        ent = next(e for e in st.obs.slow_queries()
+                   if "insert into w" in e["sql"])
+        assert ent["waits"] and ent["waits"].get("prewrite", 0) > 0
+        assert sum(ent["waits"].values()) <= ent["duration_ms"] * 1.05 + 1.0
+        rs = tk.must_exec("show slow queries")
+        assert rs.column_names[-1] == "Wait_profile"
+        row = next(r for r in rs.rows if "insert into w" in r[3])
+        assert "prewrite:" in row[-1], row
+        # information_schema.tidb_wait_profile: typed split with sane fracs
+        rows = tk.must_query(
+            "select state, wait_ms, wait_frac "
+            "from information_schema.tidb_wait_profile")
+        states = {r[0] for r in rows}
+        assert "prewrite" in states, states
+        assert all(0.0 <= r[2] <= 1.0 for r in rows), rows
+        # slow_query table exposes the formatted profile column
+        sq = tk.must_query(
+            "select wait_profile from information_schema.slow_query "
+            "where query like '%insert into w%'")
+        assert any("prewrite:" in (r[0] or "") for r in sq), sq
+        # EXPLAIN ANALYZE grows a wait_profile header column; a pure
+        # device-path select has no kv waits, so the cell stays empty
+        rs2 = tk.must_exec("explain analyze select * from w")
+        assert rs2.column_names[-1] == "wait_profile"
+        assert all(r[-1] == "" for r in rs2.rows), rs2.rows
+        # the cell renders the active statement ledger, heaviest first
+        led = obs.WaitLedger()
+        led.totals.update({"prewrite": 0.002, "tso_wait": 0.0005})
+        prev = obs.install_wait_ledger(led)
+        try:
+            cell = tk.session._wait_profile_cell()
+        finally:
+            obs.install_wait_ledger(prev)
+        assert cell.startswith("prewrite:2ms"), cell
+        assert "tso_wait:" in cell
+    finally:
+        tk.must_exec("set tidb_slow_log_threshold = 100000")
+        st.obs.waitprofile.configure(enabled=False)
+        st.obs.waitprofile.clear()
+
+
+def test_wait_profile_disabled_is_zero_cost(monkeypatch):
+    tk = TestKit()
+    assert not tk.session.storage.obs.waitprofile.enabled
+
+    def _poison(self, *a, **kw):
+        raise AssertionError("wait-profile machinery ran while disabled")
+
+    monkeypatch.setattr(obs.WaitLedger, "__init__", _poison)
+    monkeypatch.setattr(obs.WaitProfile, "record", _poison)
+    tk.must_exec("create table z (a int primary key)")
+    tk.must_exec("insert into z values (1)")
+    assert tk.session.last_waits == {}
+    # metric families still fire with the ledger off: the histogram tier
+    # is always-on, only the per-statement ledger is gated
+    assert obs.WAIT_SECONDS_TOTAL.get(state="prewrite") > 0
+
+
+def test_backoffer_sleep_reports_typed_wait():
+    from tidb_tpu.kv.backoff import Backoffer, BO_TXN_LOCK, BO_REGION_MISS
+    led = obs.WaitLedger()
+    prev = obs.install_wait_ledger(led)
+    before = obs.BACKOFF_EVENTS.get(kind="txnLock")
+    try:
+        bo = Backoffer(budget_ms=200)
+        bo.sleep(BO_TXN_LOCK)
+        bo.sleep(BO_REGION_MISS, wait_state="lease_wait")
+    finally:
+        obs.install_wait_ledger(prev)
+    assert obs.BACKOFF_EVENTS.get(kind="txnLock") == before + 1
+    assert led.totals.get("backoff.txnLock", 0.0) > 0.0, led.totals
+    # wait_state override: lease retries land under lease_wait, not
+    # backoff.regionMiss, so the profile names the cause
+    assert led.totals.get("lease_wait", 0.0) > 0.0, led.totals
+    assert "backoff.regionMiss" not in led.totals
+
+
+def test_dominant_wait_inspection_rule():
+    from tidb_tpu import obs_inspect
+    st = Storage()
+    wp = st.obs.waitprofile
+    wp.configure(enabled=True)
+    try:
+        wp.record("d" * 32, "update hot set v = v + 1 where k = 9",
+                  "test", 1.0, {"backoff.txnLock": 0.8, "prewrite": 0.1})
+        finds = [f for f in obs_inspect.inspect(st)
+                 if f.rule == "dominant-wait"]
+        assert len(finds) == 1, finds
+        assert "backoff.txnLock" in finds[0].details
+        wp.clear()
+        # below the threshold: healthy
+        wp.record("e" * 32, "select 1", "test", 1.0,
+                  {"backoff.txnLock": 0.2})
+        assert not [f for f in obs_inspect.inspect(st)
+                    if f.rule == "dominant-wait"]
+        # disabled: rule stays silent regardless of ring contents
+        wp.record("f" * 32, "select 2", "test", 1.0,
+                  {"backoff.txnLock": 0.99})
+        wp.configure(enabled=False)
+        assert not [f for f in obs_inspect.inspect(st)
+                    if f.rule == "dominant-wait"]
+    finally:
+        wp.configure(enabled=False)
+        wp.clear()

@@ -140,6 +140,10 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # worst max/mean shard-row ratio of the statement's sharded
         # dispatches (0 = no sharded dispatch) — mesh flight recorder
         ("mesh_skew", FieldType(TypeKind.DOUBLE)),
+        # typed exclusive wait split ('prewrite:8.2ms tso_wait:1.1ms
+        # ...') — where this statement BLOCKED, heaviest state first;
+        # empty while performance.wait-profile-enabled is off
+        ("wait_profile", _vc(256)),
     ],
     # continuous per-digest resource attribution (reference: TiDB's
     # Top SQL / util/topsql): one '(stmt)' summary row per (window,
@@ -158,6 +162,23 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # worst max-shard share of the operator's sharded dispatches
         # (1/shards = balanced, 1.0 = one device did everything)
         ("max_shard_share", FieldType(TypeKind.DOUBLE)),
+        # dominant typed wait state of the (window, digest) as
+        # 'state:frac' ('backoff.txnLock:0.73'); empty on operator
+        # rows and while the wait profile is off
+        ("dominant_wait", _vc(64)),
+    ],
+    # per-(window, digest, wait-state) exclusive wait attribution —
+    # the SQL face of the WaitProfile ring (one row per typed state a
+    # digest spent blocked in, newest window first). Empty (zero
+    # ledger work) while performance.wait-profile-enabled is false.
+    "tidb_wait_profile": [
+        ("window_start", _vc(20)), ("digest", _vc(32)),
+        ("digest_text", _vc(512)), ("schema_name", _vc()),
+        ("exec_count", _bigint()),
+        ("sum_wall_ms", FieldType(TypeKind.DOUBLE)),
+        ("state", _vc(32)),
+        ("wait_ms", FieldType(TypeKind.DOUBLE)),
+        ("wait_frac", FieldType(TypeKind.DOUBLE)),
     ],
     # mesh flight recorder: per-plan-digest per-shard dispatch
     # accounting (input rows, post-filter survivors, skew, exchange
@@ -263,6 +284,7 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("stages", _vc(256)), ("mem_max", _bigint()),
         ("spill_count", _bigint()), ("operators", _vc(256)),
         ("mesh_skew", FieldType(TypeKind.DOUBLE)),
+        ("wait_profile", _vc(256)),
         ("error", _vc(256)),
     ],
     # cluster-wide mesh flight recorder over the diag RPC fan-out
@@ -294,6 +316,18 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("sum_rows", _bigint()), ("admission_sheds", _bigint()),
         ("governor_kills", _bigint()),
         ("max_shard_share", FieldType(TypeKind.DOUBLE)),
+        ("dominant_wait", _vc(64)),
+        ("error", _vc(256)),
+    ],
+    # cluster-wide typed wait attribution over the diag RPC fan-out
+    "cluster_tidb_wait_profile": [
+        ("instance", _vc()), ("window_start", _vc(20)),
+        ("digest", _vc(32)), ("digest_text", _vc(512)),
+        ("schema_name", _vc()), ("exec_count", _bigint()),
+        ("sum_wall_ms", FieldType(TypeKind.DOUBLE)),
+        ("state", _vc(32)),
+        ("wait_ms", FieldType(TypeKind.DOUBLE)),
+        ("wait_frac", FieldType(TypeKind.DOUBLE)),
         ("error", _vc(256)),
     ],
     "cluster_statements_summary": [
@@ -580,6 +614,8 @@ def _rows_for(storage, catalog: Catalog, tname: str,
     elif tname == "tidb_top_sql":
         # same producer as the cluster fan-out (minus instance/error)
         rows = storage.diag.diag_top_sql()["rows"]
+    elif tname == "tidb_wait_profile":
+        rows = storage.diag.diag_wait_profile()["rows"]
     elif tname == "tidb_mesh_shards":
         rows = storage.diag.diag_mesh_shards()["rows"]
     elif tname == "tidb_mesh_storage":
@@ -613,7 +649,7 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                    "cluster_mesh_shards", "cluster_mesh_storage",
                    "cluster_inspection_result",
                    "cluster_statements_summary_history",
-                   "cluster_plan_history"):
+                   "cluster_plan_history", "cluster_tidb_wait_profile"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)

@@ -86,6 +86,13 @@ class PerformanceConfig:
     topsql_enabled: bool = False
     topsql_window_seconds: int = 60       # one attribution bucket's span
     topsql_digest_cap: int = 50           # digests kept per bucket
+    # typed wait-state attribution (information_schema.tidb_wait_profile,
+    # /debug/waitprofile, the wait_profile EXPLAIN ANALYZE / slow-log
+    # column and the dominant-wait inspection rule). Disabled by
+    # default — off, no WaitLedger is installed and the statement path
+    # does ZERO ledger work; the tidb_wait_seconds histograms stay on
+    # either way.
+    wait_profile_enabled: bool = False
     # structured server event ring (information_schema.tidb_events +
     # /debug/events): retained events
     events_history_cap: int = 512
@@ -173,6 +180,10 @@ class DiagnosticsConfig:
     # one range changing write leadership this many times in the
     # window fires range-leader-flap (a clean failover is ONE transfer)
     range_flap_threshold: int = 3
+    # dominant-wait: a digest spending at least this fraction of its
+    # wall time blocked in backoff.* or lease_wait is a finding
+    # (needs performance.wait-profile-enabled for data to exist)
+    dominant_wait_threshold: float = 0.5
 
 
 @dataclass
@@ -513,6 +524,9 @@ class Config:
             raise ConfigError(
                 "diagnostics.apply-lag-warn-ms must be >= 0 "
                 "(0 disables the follower-apply-lag rule)")
+        if not 0 < d.dominant_wait_threshold <= 1:
+            raise ConfigError(
+                "diagnostics.dominant-wait-threshold must be in (0, 1]")
         h = self.history
         if h.window_seconds < 1:
             raise ConfigError("history.window-seconds must be >= 1")
@@ -596,6 +610,9 @@ class Config:
         "performance.topsql_enabled",
         "performance.topsql_window_seconds",
         "performance.topsql_digest_cap",
+        # the wait-state attribution plane toggles live: typing WHERE
+        # a production statement blocks must not need a restart
+        "performance.wait_profile_enabled",
         "plan_cache.enabled",
         # OLTP fast-path knobs apply live: plan-cache sizing and
         # group-commit batching are exactly the dials an operator turns
@@ -616,6 +633,7 @@ class Config:
         "diagnostics.admission_shed_threshold",
         "diagnostics.row_eval_threshold",
         "diagnostics.apply_lag_warn_ms",
+        "diagnostics.dominant_wait_threshold",
         # the workload-history plane toggles/tunes live: arming the
         # plan/perf history to chase a production plan flip must not
         # need a restart (the Top SQL precedent)
@@ -773,6 +791,7 @@ class Config:
         st.row_eval_threshold = d.row_eval_threshold
         st.apply_lag_warn_ms = d.apply_lag_warn_ms
         st.range_flap_threshold = d.range_flap_threshold
+        st.dominant_wait_threshold = d.dominant_wait_threshold
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
         st._status_cache = None
@@ -827,6 +846,8 @@ class Config:
             enabled=p.topsql_enabled,
             window_s=p.topsql_window_seconds,
             digest_cap=p.topsql_digest_cap)
+        storage.obs.waitprofile.configure(
+            enabled=p.wait_profile_enabled)
         storage.obs.events.configure(cap=p.events_history_cap)
         # performance.metrics-history-interval is the preferred knob;
         # the legacy [status] metrics-interval wins only when the new
@@ -1083,6 +1104,16 @@ metrics-history-cap = 240      # samples retained (feeds metrics_summary
 topsql-enabled = false
 topsql-window-seconds = 60
 topsql-digest-cap = 50
+# Typed wait-state attribution — per-statement exclusive wait ledger
+# (tso_wait, lease_wait, backoff.{kind}, rpc_net, prewrite,
+# commit_primary, commit_secondary, resolve_lock, fsync_wait) feeding
+# the wait_profile column of EXPLAIN ANALYZE / the slow log,
+# information_schema.tidb_wait_profile (+ cluster_ variant),
+# /debug/waitprofile and the dominant-wait inspection rule. Off by
+# default: disabled, no ledger is installed and the statement path does
+# zero ledger work (the tidb_wait_seconds histograms stay on either
+# way). Hot-reloadable via SIGHUP.
+wait-profile-enabled = false
 # Structured server event ring (information_schema.tidb_events,
 # /debug/events): governor kills, admission sheds, rpc breaker trips,
 # elections/promotions, checkpoint/fsync stalls, with conn/digest
@@ -1194,6 +1225,10 @@ apply-lag-warn-ms = 2000
 # one range changing write leadership this many times in the window
 # fires range-leader-flap (a clean failover is ONE transfer)
 range-flap-threshold = 3
+# a digest spending at least this fraction of its wall time blocked in
+# backoff.* or lease_wait fires dominant-wait (needs
+# performance.wait-profile-enabled for the data to exist)
+dominant-wait-threshold = 0.5
 
 [history]
 # Workload history plane (information_schema.statements_summary_history

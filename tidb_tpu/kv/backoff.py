@@ -17,6 +17,7 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from .. import obs
 from ..errno import ER_TIKV_SERVER_TIMEOUT, CodedError
 
 
@@ -48,13 +49,17 @@ class Backoffer:
 
     sleep(kind) blocks for the kind's current backoff (exponential with
     equal-jitter, capped) and charges the shared budget; once spent,
-    BackoffExhausted carries the typed history."""
+    BackoffExhausted carries the typed history. Every sleep reports
+    (kind, ms) to the tidb_backoff_seconds histogram and the active
+    statement's wait ledger — never a silent time.sleep. A caller that
+    knows the wait's higher-level meaning passes wait_state (the range
+    router types its grant-settle sleeps as lease_wait)."""
 
     budget_ms: int
     total_ms: float = 0.0
     attempts: dict = field(default_factory=dict)
 
-    def sleep(self, kind: BackoffKind) -> None:
+    def sleep(self, kind: BackoffKind, wait_state: str = "") -> None:
         n = self.attempts.get(kind.name, 0)
         self.attempts[kind.name] = n + 1
         raw = min(kind.base_ms * (2 ** n), kind.cap_ms)
@@ -67,6 +72,10 @@ class Backoffer:
                 f"(budget {self.budget_ms}ms): {hist}")
         self.total_ms += ms
         time.sleep(ms / 1000.0)
+        s = ms / 1000.0
+        obs.BACKOFF_SECONDS.observe(s, kind=kind.name)
+        obs.BACKOFF_EVENTS.inc(kind=kind.name)
+        obs.note_wait(wait_state or f"backoff.{kind.name}", s)
 
     def charge(self, kind: BackoffKind, waited_s: float) -> None:
         """Account an externally-performed wait (e.g. a condition-var

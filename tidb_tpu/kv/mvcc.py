@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from .. import obs
 from ..analysis import lockcheck
 from .codec import encode_uint_desc
 
@@ -209,7 +210,10 @@ class SyncPolicy:
         # module-global bool probe when the checker is off)
         lockcheck.note_blocking("fsync", "SyncPolicy WAL fsync")
         t0 = _time.perf_counter()
-        self._fsync()
+        # typed wait + server span: a range leader's commit fsync rides
+        # back to the coordinator's trace as wal.fsync
+        with obs.wait("fsync_wait", span_name="wal.fsync"):
+            self._fsync()
         dt = _time.perf_counter() - t0
         if self.on_stall is not None and dt * 1e3 >= self.stall_ms:
             try:
@@ -241,6 +245,10 @@ class SyncPolicy:
         the next leader, so nobody returns undurable."""
         if self.policy != "commit":
             return
+        with obs.wait("fsync_wait", span_name="wal.group_commit"):
+            self._commit_sync()
+
+    def _commit_sync(self) -> None:
         with self._lock:
             if self._dirty:
                 # writes not yet fenced by a boundary() (direct
@@ -801,11 +809,15 @@ class MVCCStore:
                     errs.append(e)
             if errs:
                 raise errs[0]
-            for m in mutations:
-                self.kv.put(CF_LOCK, m.key, _lock_enc(
-                    LockInfo(m.key, primary, start_ts, m.op, ttl)))
-                if m.op == OP_PUT:
-                    self.kv.put(CF_DATA, _dkey(m.key, start_ts), m.value)
+            # wal.append: lock/data records hitting the engine (+WAL)
+            # — a child span under a traced range_prewrite
+            with obs.span("wal.append"):
+                for m in mutations:
+                    self.kv.put(CF_LOCK, m.key, _lock_enc(
+                        LockInfo(m.key, primary, start_ts, m.op, ttl)))
+                    if m.op == OP_PUT:
+                        self.kv.put(CF_DATA, _dkey(m.key, start_ts),
+                                    m.value)
 
     def _prewrite_check(self, key: bytes, start_ts: int) -> Optional[KVError]:
         lv = self.kv.get(CF_LOCK, key)
@@ -834,7 +846,7 @@ class MVCCStore:
     def commit(self, keys: list[bytes], start_ts: int,
                commit_ts: int) -> None:
         """Second phase (reference: mvcc_leveldb.go Commit)."""
-        with self._mutate():
+        with self._mutate(), obs.span("wal.append"):
             for key in keys:
                 lv = self.kv.get(CF_LOCK, key)
                 if lv is None:

@@ -174,8 +174,9 @@ class RangeRouter:
             if g is None:
                 # nobody holds the range yet (mid-failover): wait for
                 # the lease race to settle. BackoffExhausted escapes
-                # typed when it never does.
-                bo.sleep(BO_REGION_MISS)
+                # typed when it never does. The ledger types this as
+                # lease_wait — blocked on leadership, not on routing.
+                bo.sleep(BO_REGION_MISS, wait_state="lease_wait")
                 continue
             params[RANGE_KEY] = make_range_ctx(rid, epoch,
                                                int(g.get("term", 0)))
@@ -192,7 +193,7 @@ class RangeRouter:
             except (NotLeaderError, StaleTermError,
                     StaleLeaseError) as e:
                 self._invalidate_grant(rid)
-                bo.sleep(BO_REGION_MISS)
+                bo.sleep(BO_REGION_MISS, wait_state="lease_wait")
                 continue
             except LeaderUnavailable as e:
                 self._invalidate_grant(rid)

@@ -41,6 +41,8 @@ import struct
 import threading
 import time
 
+from .. import obs
+
 _LOGICAL_BITS = 18
 
 
@@ -116,7 +118,11 @@ class RemoteTSO:
         self._commit_tl = threading.local()
 
     def _remote_next(self) -> int:
-        ts = int(self._client.call("tso_next")["ts"])
+        # typed wait: time blocked on the leader's allocator is
+        # tso_wait unless an enclosing frame (a 2PC phase) already
+        # owns it
+        with obs.wait("tso_wait", fallback=True):
+            ts = int(self._client.call("tso_next")["ts"])
         with self._lock:
             if ts > self._seen:
                 self._seen = ts
@@ -128,7 +134,8 @@ class RemoteTSO:
         closed-timestamp protocol of the follower read tier never
         closes past a commit whose records are still unpublished.
         Strict like ts(): never degrades to a stale re-issue."""
-        ts = int(self._client.call("tso_commit")["ts"])
+        with obs.wait("tso_wait", fallback=True):
+            ts = int(self._client.call("tso_commit")["ts"])
         with self._lock:
             if ts > self._seen:
                 self._seen = ts
@@ -288,7 +295,10 @@ class SharedTSO:
 
     # ---- oracle interface --------------------------------------------------
     def next_ts(self) -> int:
-        with self._lock, self._alloc_locked():
+        # the cross-process flock IS a wait: type it so a contended
+        # shared allocator shows up as tso_wait, not untyped wall
+        with obs.wait("tso_wait", fallback=True), \
+                self._lock, self._alloc_locked():
             last = self._read_mem()
             # +1 carries logical overflow into physical: the borrow-next-
             # tick behavior of the in-process oracle, for free

@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from .. import obs
 from ..util import failpoint
 from .mvcc import KeyIsLockedError, KVError, Mutation
 from .region import Region, RegionError, RegionManager
@@ -51,9 +52,13 @@ class LockResolver:
     """Resolves locks left by crashed/slow transactions (reference:
     store/tikv/lock_resolver.go ResolveLocks)."""
 
-    def __init__(self, rm: RegionManager, tso: TSO) -> None:
+    def __init__(self, rm: RegionManager, tso: TSO,
+                 events=None) -> None:
         self.rm = rm
         self.tso = tso
+        # optional structured EventLog sink: every orphan actually
+        # rolled forward/back is auditable in /debug/events
+        self.events = events
 
     def resolve(self, lock) -> bool:
         """True if the lock was cleared (caller may retry immediately).
@@ -63,11 +68,21 @@ class LockResolver:
         ANOTHER range's leader, so the status check and the resolve are
         two routed calls — exactly how a peer rolls a crashed
         coordinator's orphans forward/backward."""
-        commit_ts, done = self.rm.check_txn_status(
-            lock.primary, lock.start_ts, self.tso.ts())
-        if not done:
-            return False  # lock holder still alive; caller backs off
-        self.rm.resolve_lock(lock.key, lock.start_ts, commit_ts)
+        with obs.wait("resolve_lock"):
+            commit_ts, done = self.rm.check_txn_status(
+                lock.primary, lock.start_ts, self.tso.ts())
+            if not done:
+                return False  # lock holder still alive; caller backs off
+            self.rm.resolve_lock(lock.key, lock.start_ts, commit_ts)
+        if self.events is not None:
+            coll = obs.active_collector()
+            action = "roll-forward" if commit_ts else "roll-back"
+            self.events.record(
+                "orphan_resolved",
+                detail=f"{action} key={lock.key!r} "
+                       f"primary={lock.primary!r} "
+                       f"start_ts={lock.start_ts} commit_ts={commit_ts} "
+                       f"trace_id={coll.trace_id if coll else ''}")
         return True
 
 
@@ -82,6 +97,9 @@ class TwoPhaseCommitter:
     # so this is time-based, unlike the count-based region retries
     # (reference: backoff.go txnLockFastBackoff with a total budget)
     lock_wait_timeout_s: float = 50.0
+    # structured EventLog sink for orphan resolutions (the storage
+    # passes its obs.events; bare committers audit nothing)
+    events: Optional[object] = None
 
     def commit(self, mutations: list[Mutation], start_ts: int) -> int:
         """Run 2PC; returns commit_ts (reference: 2pc.go execute :1050)."""
@@ -96,14 +114,13 @@ class TwoPhaseCommitter:
         hold serializing locks across it — the storage runs it outside
         its commit lock (the reference has no such global lock; its fold
         equivalent is TiFlash's async raft apply)."""
-        from .. import obs
-        with obs.span("twopc.prewrite") as sp:
+        with obs.wait("prewrite"), obs.span("twopc.prewrite") as sp:
             if sp:
                 sp.note = f"{len(mutations)} keys"
             return self._prewrite_phase(mutations, start_ts)
 
     def _prewrite_phase(self, mutations: list[Mutation], start_ts: int):
-        resolver = LockResolver(self.rm, self.tso)
+        resolver = LockResolver(self.rm, self.tso, events=self.events)
         mutations = sorted(mutations, key=lambda m: m.key)
         # the primary must leave a write record: a lock-only (OP_LOCK)
         # primary would give crash recovery nothing to roll forward from
@@ -128,7 +145,6 @@ class TwoPhaseCommitter:
     def commit_phase(self, state, start_ts: int) -> int:
         """Phase 2: never waits on foreign locks (we hold every key),
         so it is safe inside the storage commit lock."""
-        from .. import obs
         with obs.span("twopc.commit"):
             return self._commit_phase(state, start_ts)
 
@@ -142,15 +158,18 @@ class TwoPhaseCommitter:
         # have no ledger — their commits run under the storage commit
         # lock the closed-ts computation also takes.
         alloc = getattr(self.tso, "commit_ts", None) or self.tso.ts
-        commit_ts = alloc()
+        with obs.wait("tso_wait"):
+            commit_ts = alloc()
 
         # commit the primary synchronously — the txn is durable
         # once this lands (reference: 2pc.go:741)
         failpoint.inject("twopc/before-commit-primary")
-        self._retry_region(
-            primary, resolver,
-            lambda region: self.rm.commit(region, [primary], start_ts,
-                                          commit_ts))
+        with obs.wait("commit_primary",
+                      span_name="twopc.commit_primary"):
+            self._retry_region(
+                primary, resolver,
+                lambda region: self.rm.commit(region, [primary],
+                                              start_ts, commit_ts))
         # crash here = committed txn with secondary locks left behind:
         # the resolver must roll them FORWARD from the primary's write
         # record (reference failpoint site: 2pc.go:1027)
@@ -161,18 +180,22 @@ class TwoPhaseCommitter:
         # NOT surface as a commit failure (the lock resolver rolls the
         # stragglers forward from the committed primary)
         rest = [m.key for m in mutations if m.key != primary]
-        for key in rest:
-            try:
-                self._retry_region(
-                    key, resolver,
-                    lambda region, k=key: self.rm.commit(
-                        region, [k], start_ts, commit_ts))
-            except (CommitError, KVError):
-                pass  # resolver recovers from the primary's write record
+        if rest:
+            with obs.wait("commit_secondary",
+                          span_name="twopc.commit_secondary"):
+                for key in rest:
+                    try:
+                        self._retry_region(
+                            key, resolver,
+                            lambda region, k=key: self.rm.commit(
+                                region, [k], start_ts, commit_ts))
+                    except (CommitError, KVError):
+                        # resolver recovers from the primary's record
+                        pass
         return commit_ts
 
     def rollback(self, mutations: list[Mutation], start_ts: int) -> None:
-        resolver = LockResolver(self.rm, self.tso)
+        resolver = LockResolver(self.rm, self.tso, events=self.events)
         for m in mutations:
             self._retry_region(
                 m.key, resolver,
@@ -219,7 +242,17 @@ class TwoPhaseCommitter:
                     err.errno = 1205  # ER_LOCK_WAIT_TIMEOUT
                     raise err from None
                 time.sleep(backoff)
+                _note_lock_backoff(backoff)
                 backoff = min(backoff * 2, 0.05)
+
+
+def _note_lock_backoff(seconds: float) -> None:
+    """Type a foreign-lock wait sleep: the backoff families plus the
+    active statement's wait ledger — no silent time.sleep on the
+    commit/read retry paths."""
+    obs.BACKOFF_SECONDS.observe(seconds, kind="txnLock")
+    obs.BACKOFF_EVENTS.inc(kind="txnLock")
+    obs.note_wait("backoff.txnLock", seconds)
 
 
 class Snapshot:
@@ -242,6 +275,7 @@ class Snapshot:
             except KeyIsLockedError as e:
                 if not self._resolver.resolve(e.lock):
                     time.sleep(backoff)
+                    _note_lock_backoff(backoff)
                     backoff = min(backoff * 2, 0.1)
         raise CommitError(f"read of {key!r} kept hitting locks")
 
@@ -254,5 +288,6 @@ class Snapshot:
             except KeyIsLockedError as e:
                 if not self._resolver.resolve(e.lock):
                     time.sleep(backoff)
+                    _note_lock_backoff(backoff)
                     backoff = min(backoff * 2, 0.1)
         raise CommitError("scan kept hitting locks")

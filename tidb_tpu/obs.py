@@ -440,7 +440,7 @@ class TopSQL:
                 "sum_wall_s": 0.0, "max_wall_s": 0.0, "sum_rows": 0,
                 "sheds": 0, "kills": 0,
                 "stages": {}, "op_wall": {}, "op_stages": {},
-                "op_bytes": {}, "op_mesh": {}}
+                "op_bytes": {}, "op_mesh": {}, "waits": {}}
 
     def record(self, digest: str, digest_text: str, db: str,
                wall_s: float, stages: Optional[dict] = None,
@@ -450,6 +450,7 @@ class TopSQL:
                rows: int = 0, failed: bool = False, shed: bool = False,
                killed: bool = False,
                op_mesh: Optional[dict] = None,
+               waits: Optional[dict] = None,
                now: Optional[float] = None) -> None:
         if not self.enabled:
             return
@@ -499,6 +500,12 @@ class TopSQL:
                 om = ent.setdefault("op_mesh", {})
                 for k, v in op_mesh.items():
                     om[k] = max(om.get(k, 0.0), float(v))
+            if waits:
+                # typed wait-state split — what makes a window
+                # attributable to its dominant wait state
+                tw = ent.setdefault("waits", {})
+                for k, v in waits.items():
+                    tw[k] = tw.get(k, 0.0) + v
 
     def snapshot(self) -> list[dict]:
         """Deep-copied buckets, oldest first."""
@@ -536,6 +543,10 @@ class TopSQL:
             for e in ents:
                 attributed = self.attributed_seconds(e)
                 mesh = e.get("op_mesh") or {}
+                # dominant wait state of the digest's window: which
+                # typed wait (if any) owned the wall — 'state:frac'
+                dst, dfrac = WaitProfile.dominant(e)
+                dom = f"{dst}:{dfrac:.2f}" if dst else ""
                 rows.append([
                     win, e["digest"], e["digest_text"], self.STMT,
                     e["exec_count"], round(e["sum_wall_s"] * 1e3, 3),
@@ -543,7 +554,7 @@ class TopSQL:
                     sum(e["op_bytes"].values()),
                     fmt_stages(e["stages"])[:256], e["sum_rows"],
                     e["sheds"], e["kills"],
-                    round(max(mesh.values(), default=0.0), 4)])
+                    round(max(mesh.values(), default=0.0), 4), dom])
                 ops = dict(e["op_wall"])
                 sess = e["op_stages"].get(self.SESSION_OP)
                 if sess:
@@ -556,7 +567,7 @@ class TopSQL:
                         e["op_bytes"].get(op, 0),
                         fmt_stages(e["op_stages"].get(op))[:256],
                         e["sum_rows"], e["sheds"], e["kills"],
-                        round(mesh.get(op, 0.0), 4)])
+                        round(mesh.get(op, 0.0), 4), ""])
         return rows
 
     def top_by_device(self, n: int = 5) -> list[dict]:
@@ -590,6 +601,140 @@ class TopSQL:
             a["device_ms"] = round(a["device_ms"], 3)
             a["wall_ms"] = round(a["wall_ms"], 3)
         return out
+
+
+# ---- wait-state profile: windowed per-digest wait attribution ---------------
+
+class WaitProfile:
+    """Windowed per-digest typed-wait attribution — the continuous
+    (production, not only EXPLAIN ANALYZE) aggregation of WaitLedger
+    totals, same ring shape as TopSQL: `n_windows` time buckets, each a
+    digest -> entry map capped at `digest_cap` with an "(other)"
+    overflow fold. Feeds information_schema.tidb_wait_profile, the
+    /debug/waitprofile endpoint and the dominant-wait inspection rule.
+
+    Disabled (the default) it is ZERO cost on the statement path:
+    record() returns before the lock, and the session neither installs
+    a WaitLedger nor assembles arguments (performance.wait-profile-
+    enabled arms it, SIGHUP-hot-reloadable)."""
+
+    DEFAULT_WINDOW_S = 60
+    DEFAULT_WINDOWS = 6
+    DEFAULT_DIGEST_CAP = 50
+    OTHER = "(other)"
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 n_windows: int = DEFAULT_WINDOWS,
+                 digest_cap: int = DEFAULT_DIGEST_CAP,
+                 enabled: bool = False) -> None:
+        self.enabled = bool(enabled)
+        self.window_s = max(float(window_s), 1.0)
+        self.digest_cap = max(int(digest_cap), 1)
+        self._lock = threading.Lock()
+        self._buckets: deque = deque(maxlen=max(int(n_windows), 1))
+
+    def configure(self, enabled: Optional[bool] = None,
+                  window_s: Optional[float] = None,
+                  digest_cap: Optional[int] = None,
+                  n_windows: Optional[int] = None) -> None:
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if window_s is not None:
+            self.window_s = max(float(window_s), 1.0)
+        if digest_cap is not None:
+            self.digest_cap = max(int(digest_cap), 1)
+        if n_windows is not None:
+            with self._lock:
+                self._buckets = deque(self._buckets,
+                                      maxlen=max(int(n_windows), 1))
+
+    def _bucket_locked(self, now: float) -> dict:
+        win = int(now - (now % self.window_s))
+        for b in reversed(self._buckets):
+            if b["start"] == win:
+                return b
+        last = self._buckets[-1] if self._buckets else None
+        if last is not None and win < last["start"]:
+            return last
+        b = {"start": win, "digests": {}, "other": None}
+        self._buckets.append(b)
+        return b
+
+    @staticmethod
+    def _new_entry(digest: str, digest_text: str, db: str) -> dict:
+        return {"digest": digest, "digest_text": digest_text,
+                "schema_name": db, "exec_count": 0,
+                "sum_wall_s": 0.0, "waits": {}}
+
+    def record(self, digest: str, digest_text: str, db: str,
+               wall_s: float, waits: dict,
+               now: Optional[float] = None) -> None:
+        if not self.enabled:
+            return
+        ts = time.time() if now is None else float(now)
+        with self._lock:
+            b = self._bucket_locked(ts)
+            ent = b["digests"].get(digest)
+            if ent is None:
+                if len(b["digests"]) < self.digest_cap:
+                    ent = b["digests"][digest] = self._new_entry(
+                        digest, digest_text, db)
+                else:
+                    if b["other"] is None:
+                        b["other"] = self._new_entry(
+                            self.OTHER, self.OTHER, "")
+                    ent = b["other"]
+            ent["exec_count"] += 1
+            ent["sum_wall_s"] += wall_s
+            w = ent["waits"]
+            for k, v in waits.items():
+                w[k] = w.get(k, 0.0) + v
+
+    def snapshot(self) -> list[dict]:
+        """Deep-copied buckets, oldest first."""
+        import copy
+        with self._lock:
+            return [copy.deepcopy(b) for b in self._buckets]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buckets.clear()
+
+    @staticmethod
+    def dominant(ent: dict) -> tuple[str, float]:
+        """(state, fraction-of-wall) of the entry's heaviest wait state
+        — what the dominant-wait inspection rule and the TopSQL
+        attribution column read. ('', 0.0) when nothing waited."""
+        waits = ent.get("waits") or {}
+        if not waits or ent.get("sum_wall_s", 0.0) <= 0:
+            return "", 0.0
+        state = max(waits, key=lambda k: waits[k])
+        return state, min(waits[state] / ent["sum_wall_s"], 1.0)
+
+    def table_rows(self) -> list[list]:
+        """information_schema.tidb_wait_profile rows: newest window
+        first, digests by total wall desc, one row per wait state
+        (heaviest first)."""
+        rows: list[list] = []
+        for b in reversed(self.snapshot()):
+            win = time.strftime("%Y-%m-%d %H:%M:%S",
+                                time.localtime(b["start"]))
+            ents = sorted(b["digests"].values(),
+                          key=lambda e: -e["sum_wall_s"])
+            if b["other"] is not None:
+                ents.append(b["other"])
+            for e in ents:
+                wall = e["sum_wall_s"]
+                waits = e["waits"]
+                for st in sorted(waits, key=lambda k: -waits[k]):
+                    frac = waits[st] / wall if wall > 0 else 0.0
+                    rows.append([
+                        win, e["digest"], e["digest_text"],
+                        e["schema_name"], e["exec_count"],
+                        round(wall * 1e3, 3), st,
+                        round(waits[st] * 1e3, 3),
+                        round(min(frac, 1.0), 4)])
+        return rows
 
 
 # ---- structured server event log --------------------------------------------
@@ -735,13 +880,17 @@ class Observability:
         # structured server event ring (governor kills, admission
         # sheds, breaker trips, elections, checkpoint/fsync stalls)
         self.events = EventLog(metrics=self.metrics)
+        # windowed per-digest typed-wait attribution, off by default —
+        # performance.wait-profile-enabled arms it
+        self.waitprofile = WaitProfile()
 
     def record_slow(self, sql: str, db: str, duration_s: float,
                     plan_digest: str = "",
                     stages: Optional[dict[str, float]] = None,
                     mem_peak: int = 0, spill_count: int = 0,
                     op_wall: Optional[dict[str, float]] = None,
-                    mesh_skew: float = 0.0) -> None:
+                    mesh_skew: float = 0.0,
+                    waits: Optional[dict[str, float]] = None) -> None:
         self.slow_counter.inc()
         ent = {
             "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -768,6 +917,11 @@ class Observability:
             # recorder's balance signal, so a slow sharded join shows
             # WHY (skew) next to where (operators)
             "mesh_skew": round(float(mesh_skew), 2),
+            # typed wait-state split (ms): where the statement BLOCKED
+            # (2PC phases, backoff, tso/lease/fsync waits) — the
+            # critical-path half next to the dispatch stages
+            "waits": {k: round(v * 1e3, 3)
+                      for k, v in (waits or {}).items()},
         }
         with self._slow_lock:
             self._slow_log.append(ent)
@@ -873,6 +1027,31 @@ RANGE_ORPHAN_RESOLUTIONS = PROCESS_METRICS.counter(
     "tidb_range_orphan_resolutions_total",
     "orphan percolator locks rolled forward or back via primary-status "
     "check after a coordinator crash")
+
+# wait-state attribution plane (typed per-statement wait ledger):
+# process-wide like the breaker counters — Backoffer/RpcClient/SyncPolicy
+# have no Storage in reach. The histogram carries the distribution per
+# typed state; the counter twin is the metrics_schema tier's SQL view of
+# accumulated wait seconds (histograms stay on /metrics)
+WAIT_SECONDS = PROCESS_METRICS.histogram(
+    "tidb_wait_seconds",
+    "exclusive statement wait time by typed state (tso_wait, "
+    "lease_wait, backoff.{kind}, rpc_net, prewrite, commit_primary, "
+    "commit_secondary, resolve_lock, fsync_wait)")
+WAIT_SECONDS_TOTAL = PROCESS_METRICS.counter(
+    "tidb_wait_total_seconds",
+    "accumulated exclusive wait seconds by typed state — the "
+    "SQL-queryable twin of the tidb_wait_seconds histogram (named "
+    "total_seconds, not seconds_total, so the counter family never "
+    "prefix-collides with the histogram's sample names)")
+BACKOFF_SECONDS = PROCESS_METRICS.histogram(
+    "tidb_backoff_seconds",
+    "Backoffer sleep time by backoff kind (txnLock, txnConflict, "
+    "regionMiss, metaConflict, tsoWait, tikvRPC)")
+BACKOFF_EVENTS = PROCESS_METRICS.counter(
+    "tidb_backoff_events_total",
+    "Backoffer sleeps taken, by backoff kind — each typed sleep "
+    "reports here instead of silently time.sleep-ing")
 
 # device telemetry gauges (ONE device per process, like the counters
 # above): transfer bytes accumulate on the dispatch hot path; buffer
@@ -1478,6 +1657,140 @@ def stage(name: str, span_name: Optional[str] = None) -> _StageCtx:
     """`with obs.stage("compile"):` — one named dispatch stage.
     Histogram + recorder always; a span only under an active TRACE."""
     return _StageCtx(name, span_name)
+
+
+# ---- typed wait-state ledger (critical-path attribution) --------------------
+
+_wait_tls = threading.local()
+
+
+class WaitLedger:
+    """Per-statement typed wait totals, EXCLUSIVE of nested wait frames
+    (same additive guarantee as StageRecorder: summing the states never
+    exceeds the instrumented wall). One ledger per statement, installed
+    by the session ONLY while performance.wait-profile-enabled is on —
+    disabled, nothing on the statement path allocates or touches one
+    (the poison/zero-alloc contract test_trace pins). The states are
+    the write path's blocking taxonomy: tso_wait, lease_wait,
+    backoff.{kind}, rpc_net, prewrite, commit_primary,
+    commit_secondary, resolve_lock, fsync_wait (reference: TiDB's
+    execution-stage runtime stats feeding slow log and Top SQL)."""
+
+    __slots__ = ("totals", "counts")
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    def add(self, state: str, seconds: float) -> None:
+        self.totals[state] = self.totals.get(state, 0.0) + seconds
+        self.counts[state] = self.counts.get(state, 0) + 1
+
+    def snapshot(self) -> dict[str, float]:
+        return dict(self.totals)
+
+
+def install_wait_ledger(led: Optional[WaitLedger]) -> None:
+    _wait_tls.led = led
+
+
+def active_wait_ledger() -> Optional[WaitLedger]:
+    return getattr(_wait_tls, "led", None)
+
+
+class _WaitCtx:
+    """Times one typed wait frame: always feeds the tidb_wait_seconds
+    histogram (+ its counter twin) with EXCLUSIVE time — a per-thread
+    nesting stack subtracts inner wait frames and note_wait charges,
+    so the per-state sums are additive — and feeds the active
+    WaitLedger when one is installed. With `fallback=True` the frame
+    is a full no-op when ANY wait frame is already open: the enclosed
+    time stays attributed to the more specific enclosing state
+    (rpc_net is the catch-all for network time not already typed as a
+    2PC phase or tso_wait). Optionally opens a TRACE span (span_name),
+    allocating no Span when tracing is off."""
+
+    __slots__ = ("state", "spanctx", "t0", "skip")
+
+    def __init__(self, state: str, span_name: Optional[str],
+                 fallback: bool) -> None:
+        self.state = state
+        self.skip = bool(fallback and getattr(_wait_tls, "stack", None))
+        self.spanctx = _SpanCtx(span_name) if (
+            span_name and not self.skip) else None
+        self.t0 = 0.0
+
+    def __enter__(self) -> "_WaitCtx":
+        if self.skip:
+            return self
+        stack = getattr(_wait_tls, "stack", None)
+        if stack is None:
+            stack = _wait_tls.stack = []
+        stack.append(0.0)  # accumulates nested-frame wall time
+        if self.spanctx is not None:
+            self.spanctx.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.skip:
+            return
+        dt = time.perf_counter() - self.t0
+        if self.spanctx is not None:
+            self.spanctx.__exit__(*exc)
+        stack = _wait_tls.stack
+        child = stack.pop()
+        if stack:
+            stack[-1] += dt
+        excl = dt - child if dt > child else 0.0
+        WAIT_SECONDS.observe(excl, state=self.state)
+        WAIT_SECONDS_TOTAL.inc(excl, state=self.state)
+        led = getattr(_wait_tls, "led", None)
+        if led is not None:
+            led.add(self.state, excl)
+
+
+def wait(state: str, span_name: Optional[str] = None,
+         fallback: bool = False) -> _WaitCtx:
+    """`with obs.wait("prewrite"):` — one typed wait frame. Histogram +
+    active ledger always (exclusive time); a span only when span_name
+    is given AND a TRACE collector is active."""
+    return _WaitCtx(state, span_name, fallback)
+
+
+def note_wait(state: str, seconds: float) -> None:
+    """Charge externally-timed wait seconds (a Backoffer sleep, a
+    transport-timeout block) to the typed state: histogram + counter
+    twin + the active ledger, and the enclosing wait frame's exclusive
+    accounting (the charge is subtracted from the enclosing frame, so
+    a backoff sleep inside a prewrite frame never double-counts)."""
+    if seconds <= 0:
+        return
+    stack = getattr(_wait_tls, "stack", None)
+    if stack:
+        stack[-1] += seconds
+    WAIT_SECONDS.observe(seconds, state=state)
+    WAIT_SECONDS_TOTAL.inc(seconds, state=state)
+    led = getattr(_wait_tls, "led", None)
+    if led is not None:
+        led.add(state, seconds)
+
+
+def fmt_waits(waits: Optional[dict[str, float]]) -> str:
+    """wait dict (seconds) -> 'prewrite:3.2ms rpc_net:1.1ms ...'
+    heaviest first — the EXPLAIN ANALYZE / slow-log wait_profile cell."""
+    if not waits:
+        return ""
+    return " ".join(f"{k}:{v * 1e3:.3g}ms" for k, v in
+                    sorted(waits.items(), key=lambda kv: -kv[1]))
+
+
+def fmt_waits_ms(waits_ms: Optional[dict[str, float]]) -> str:
+    """fmt_waits for dicts already in milliseconds (the slow-log entry
+    form written by record_slow)."""
+    if not waits_ms:
+        return ""
+    return fmt_waits({k: v / 1e3 for k, v in waits_ms.items()})
 
 
 def fmt_stages(stages: Optional[dict[str, float]]) -> str:

@@ -71,6 +71,10 @@ class DiagnosticsState:
     # a serving replica's apply lag past this is a follower-apply-lag
     # warning; critical at 3x (the replica stopped advancing); 0 off
     apply_lag_warn_ms: int = 2000
+    # dominant-wait: a digest spending at least this fraction of its
+    # wall blocked in backoff.* or lease_wait is a finding (needs
+    # performance.wait-profile-enabled for the data to exist)
+    dominant_wait_threshold: float = 0.5
     # (rule, item) pairs already reported critical: inspection_finding
     # events fire on NEW members only (edge-triggered, not level)
     seen_critical: set = field(default_factory=set)
@@ -175,6 +179,7 @@ class InspectionContext:
             float(self.cfg.history_windows) * float(hist.interval_s)
         self.events = storage.obs.events.snapshot()
         self.topsql = storage.obs.topsql
+        self.waitprofile = storage.obs.waitprofile
         gov = getattr(storage, "governor", None)
         self.governor = gov.stats() if gov is not None else {}
         gate = getattr(storage, "admission", None)
@@ -519,6 +524,51 @@ def _r_host_fallback(ctx: InspectionContext) -> list[Finding]:
         f"host_fallback is {share:.0%} of the stage split "
         f"({host_s * 1e3:.1f}ms): {text[:200]}")
         for digest, (share, host_s, text) in sorted(worst.items())]
+
+
+@rule("dominant-wait", "warning",
+      "performance.wait-profile-enabled — a digest spends most of its "
+      "wall time blocked in lock/lease contention (backoff.* or "
+      "lease_wait), not executing; "
+      "information_schema.tidb_wait_profile has the full typed split, "
+      "diagnostics.dominant-wait-threshold tunes the cutoff")
+def _r_dominant_wait(ctx: InspectionContext) -> list[Finding]:
+    wp = ctx.waitprofile
+    if not wp.enabled:
+        return []
+    thr = float(ctx.cfg.dominant_wait_threshold)
+    worst: dict[str, tuple] = {}
+    for b in wp.snapshot():
+        # windowed like top-sql-host-fallback: wait buckets only
+        # rotate when statements arrive, so an idle server would keep
+        # reporting a long-fixed contention storm forever
+        if b["start"] + wp.window_s < ctx.now - ctx.window_s:
+            continue
+        ents = list(b["digests"].values())
+        if b.get("other") is not None:
+            ents.append(b["other"])
+        for e in ents:
+            wall = float(e.get("sum_wall_s", 0.0))
+            if wall <= 0:
+                continue
+            blocked = {k: v for k, v in e["waits"].items()
+                       if k == "lease_wait" or k.startswith("backoff.")}
+            share = min(sum(blocked.values()) / wall, 1.0)
+            if not blocked or share < thr:
+                continue
+            top = max(blocked, key=lambda k: blocked[k])
+            prev = worst.get(e["digest"])
+            if prev is None or share > prev[0]:
+                worst[e["digest"]] = (share, top,
+                                      blocked[top], wall,
+                                      e["digest_text"])
+    return [Finding(
+        "dominant-wait", digest, "warning", f"{share:.0%}",
+        f"{share:.0%} of {wall * 1e3:.1f}ms wall spent blocked in "
+        f"contention waits (heaviest: {top} {top_s * 1e3:.1f}ms): "
+        f"{text[:200]}")
+        for digest, (share, top, top_s, wall, text)
+        in sorted(worst.items())]
 
 
 @rule("registry-row-eval", "warning",

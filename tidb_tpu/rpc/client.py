@@ -170,7 +170,13 @@ class RpcClient:
             t0 = time.monotonic()
             try:
                 try:
-                    r = self._call_once(method, params)
+                    # rpc_net is the CATCH-ALL wait state: network time
+                    # not already typed by a more specific enclosing
+                    # frame (a 2PC phase, tso_wait, resolve_lock) —
+                    # fallback=True keeps the frame a no-op under one,
+                    # so the specific state owns its wire time
+                    with obs.wait("rpc_net", fallback=True):
+                        r = self._call_once(method, params)
                 except (OSError, FrameError, FrameProtocolError):
                     raise
                 except BaseException:

@@ -56,6 +56,7 @@ TABLE_METHODS = {
     "cluster_inspection_result": "diag_inspection",
     "cluster_statements_summary_history": "diag_history",
     "cluster_plan_history": "diag_plan_history",
+    "cluster_tidb_wait_profile": "diag_wait_profile",
 }
 
 
@@ -150,7 +151,8 @@ class DiagService:
                          int(e.get("mem_max", 0)),
                          int(e.get("spill_count", 0)),
                          obs.fmt_ops_ms(e.get("operators")),
-                         float(e.get("mesh_skew", 0.0))])
+                         float(e.get("mesh_skew", 0.0)),
+                         obs.fmt_waits_ms(e.get("waits"))])
         return {"rows": rows}
 
     def diag_top_sql(self) -> dict:
@@ -158,6 +160,12 @@ class DiagService:
         information_schema.tidb_top_sql (the cluster_top_sql fan-out
         adds instance/error). Empty while topsql is disabled."""
         return {"rows": self.storage.obs.topsql.table_rows()}
+
+    def diag_wait_profile(self) -> dict:
+        """This server's typed wait-state attribution windows,
+        row-shaped for information_schema.tidb_wait_profile. Empty
+        while performance.wait-profile-enabled is false."""
+        return {"rows": self.storage.obs.waitprofile.table_rows()}
 
     def diag_mesh_shards(self) -> dict:
         """This server's mesh flight-recorder dispatch ring (empty

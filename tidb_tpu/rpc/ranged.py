@@ -293,7 +293,11 @@ def _kv_guarded(fn) -> dict:
     (resolve the lock, retry the conflict), not transport errors, so
     they must not burn the client's retry budget or trip its breaker."""
     try:
-        return {"ok": True, "v": fn()}
+        # range.apply: the store mutation/read itself (child spans —
+        # wal.append, wal.fsync — open inside the engine) riding back
+        # to the coordinator's stitched trace via traced_response
+        with obs.span("range.apply"):
+            return {"ok": True, "v": fn()}
     except KeyIsLockedError as e:
         lk = e.lock
         return {"ok": False, "err_kv": {
@@ -418,7 +422,12 @@ class RangeServer(FrameListener):
     def _leader_for(self, params: dict) -> RangeLeader:
         """The fencing gate every data request passes BEFORE any data
         access; raises typed so the client refreshes + retries instead
-        of acting on a stale view."""
+        of acting on a stale view. Traced as range.lease_gate so a
+        fencing rejection's cost is visible in the stitched tree."""
+        with obs.span("range.lease_gate"):
+            return self._leader_for_gated(params)
+
+    def _leader_for_gated(self, params: dict) -> RangeLeader:
         rc = get_range_ctx(params)
         if rc is None:
             raise RPCError("missing range context")
@@ -616,6 +625,7 @@ class RangePlane:
     def committer(self, tso, **kw):
         from ..kv.twopc import TwoPhaseCommitter
         kw.setdefault("lock_ttl", self.resolve_ttl_ms)
+        kw.setdefault("events", self.storage.obs.events)
         return TwoPhaseCommitter(self.router(), tso, **kw)
 
     def set_knobs(self, lease_ms: Optional[int] = None,

@@ -18,6 +18,9 @@ Debug routes:
   /debug/topsql  the Top SQL attribution windows: per-digest stage
       sums, per-operator wall/stage/transfer splits, admission/
       governor outcomes (JSON; performance.topsql-* knobs)
+  /debug/waitprofile  typed wait-state attribution windows: per-digest
+    exclusive wait splits (tso_wait, lease_wait, backoff.{kind},
+    prewrite, ...) with the dominant state of each entry (JSON)
   /debug/events  the structured server event ring: governor kills,
       admission sheds, breaker trips, elections, checkpoint/fsync
       stalls (JSON)
@@ -176,6 +179,27 @@ class StatusServer:
                         "window_s": server_obs.topsql.window_s,
                         "digest_cap": server_obs.topsql.digest_cap,
                         "windows": server_obs.topsql.snapshot(),
+                    }).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/waitprofile"):
+                    # typed wait-state attribution windows (oldest
+                    # first): per-digest exclusive wait splits plus
+                    # the dominant state of each entry
+                    wp = server_obs.waitprofile
+                    wins = wp.snapshot()
+                    for w in wins:
+                        ents = list(w.get("digests", {}).values())
+                        if w.get("other"):
+                            ents.append(w["other"])
+                        for ent in ents:
+                            st, frac = wp.dominant(ent)
+                            ent["dominant_wait"] = st
+                            ent["dominant_frac"] = round(frac, 4)
+                    body = json.dumps({
+                        "enabled": wp.enabled,
+                        "window_s": wp.window_s,
+                        "digest_cap": wp.digest_cap,
+                        "windows": wins,
                     }).encode()
                     ctype = "application/json"
                 elif self.path.startswith("/debug/events"):

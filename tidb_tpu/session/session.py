@@ -340,6 +340,12 @@ class Session:
         # EXPLAIN ANALYZE (reference: execdetails on every statement)
         prev_rec = obs.active_stage_recorder()
         rec = obs.StageRecorder()
+        # typed wait-state ledger (tso/lease/backoff/2PC/fsync waits):
+        # allocated ONLY while performance.wait-profile-enabled is on —
+        # disabled, the statement path provably never builds or touches
+        # one (the poison/zero-alloc contract test_trace pins)
+        prev_led = obs.active_wait_ledger()
+        led = obs.WaitLedger() if o.waitprofile.enabled else None
         pp = getattr(self, "_pending_parse_s", 0.0)
         if pp:
             # the batch's parse time books against its first statement
@@ -366,6 +372,7 @@ class Session:
         prof = None
         try:
             obs.install_stage_recorder(rec)
+            obs.install_wait_ledger(led)
             prev_tz = _funcs.install_session_time_zone(tz)
             # @@profiling: sample THIS thread's stacks for the
             # statement (reference: util/profile; MySQL SHOW PROFILE
@@ -424,6 +431,7 @@ class Session:
             self._deadline_expired = False
             interrupt.install(None)
             obs.install_stage_recorder(prev_rec)
+            obs.install_wait_ledger(prev_led)
             _funcs.install_session_time_zone(prev_tz)
             self.in_flight_sql = None
             if self._is_guard is not None:
@@ -441,6 +449,7 @@ class Session:
             self.last_op_bytes = rec.op_bytes
             self.last_op_mesh = rec.op_mesh
             self.last_engines = rec.engines
+            self.last_waits = led.totals if led is not None else {}
             # worst shard skew of the statement's sharded dispatches
             # (0 = none); surfaces in the slow log + Top SQL
             mesh_skew = 0.0
@@ -481,7 +490,11 @@ class Session:
             # work and zero allocations on the statement path
             history = self.storage.history
             hist_on = history.enabled and digest_sql is not None
-            if slow or hist_on or \
+            # wait-profile feed: the ledger only exists while the plane
+            # is enabled, so this adds zero work when it is off
+            wp_on = led is not None and led.totals \
+                and digest_sql is not None
+            if slow or hist_on or wp_on or \
                     (topsql.enabled and digest_sql is not None):
                 import hashlib
                 # same digest the statements_summary uses, so slow-log
@@ -494,6 +507,10 @@ class Session:
                         engines=rec.engines, stages=rec.totals,
                         rows=rows_out, failed=failed,
                         op_mesh=rec.op_mesh)
+                if wp_on:
+                    o.waitprofile.record(digest, norm[:512],
+                                         self.current_db, dt,
+                                         led.totals)
                 if topsql.enabled and digest_sql is not None:
                     topsql.record(
                         digest, norm[:512], self.current_db, dt,
@@ -502,7 +519,8 @@ class Session:
                         rows=rows_out, failed=failed, shed=shed,
                         killed=self._governor_killed,
                         op_mesh={k: v[0] for k, v in
-                                 rec.op_mesh.items()} or None)
+                                 rec.op_mesh.items()} or None,
+                        waits=led.totals if led is not None else None)
                 if slow:
                     o.record_slow(sql, self.current_db, dt,
                                   plan_digest=digest,
@@ -510,7 +528,9 @@ class Session:
                                   mem_peak=self.last_mem_peak,
                                   spill_count=self.last_spill_count,
                                   op_wall=rec.op_wall,
-                                  mesh_skew=mesh_skew)
+                                  mesh_skew=mesh_skew,
+                                  waits=dict(led.totals)
+                                  if led is not None else None)
 
     def query(self, sql: str) -> list[tuple[Any, ...]]:
         return self.execute(sql).rows
@@ -3217,6 +3237,18 @@ class Session:
         return ResultSet([], [])
 
     # ==================== EXPLAIN / SHOW ====================
+    def _wait_profile_cell(self) -> str:
+        """Statement-level typed wait profile for the EXPLAIN ANALYZE
+        header row. EXPLAIN ANALYZE itself runs under the statement's
+        wait ledger (installed by `_execute_observed`), so the active
+        ledger holds exactly the waits the analyzed execution accrued
+        so far. Empty when the wait profile is disabled."""
+        from .. import obs
+        led = obs.active_wait_ledger()
+        if led is None or not led.totals:
+            return ""
+        return obs.fmt_waits(led.totals)
+
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         if not isinstance(stmt.target, (ast.SelectStmt, ast.SetOpStmt)):
             raise SQLError("EXPLAIN supports SELECT only for now")
@@ -3271,9 +3303,10 @@ class Session:
                     round(routed.wall_ms, 2) if i == 0 else None,
                     f"replica@{routed.addr}" if i == 0 else "",
                     f"replica_read:{routed.wall_ms / 1e3:.3f}"
-                    if i == 0 else "", ""))
+                    if i == 0 else "", "",
+                    self._wait_profile_cell() if i == 0 else ""))
             return ResultSet(["plan", "actRows", "time_ms", "engine",
-                              "stages", "mesh"], rows)
+                              "stages", "mesh", "wait_profile"], rows)
 
         coll = obs.RuntimeStatsColl()
 
@@ -3285,19 +3318,22 @@ class Session:
                 ctx.close()
 
         self._run_in_txn(run)
+        wp = self._wait_profile_cell()
         rows = []
-        for node, line in explain_nodes(plan):
+        for i, (node, line) in enumerate(explain_nodes(plan)):
             st = coll.for_plan(node)
             if st is None:
-                rows.append((line, None, None, "", "", ""))
+                rows.append((line, None, None, "", "", "",
+                             wp if i == 0 else ""))
             else:
                 rows.append((line, st["rows"],
                              round(st["time"] * 1e3, 2),
                              st["engine"] or "",
                              obs.fmt_stages(st.get("stages")),
-                             obs.fmt_mesh(st.get("mesh"))))
+                             obs.fmt_mesh(st.get("mesh")),
+                             wp if i == 0 else ""))
         return ResultSet(["plan", "actRows", "time_ms", "engine",
-                          "stages", "mesh"], rows)
+                          "stages", "mesh", "wait_profile"], rows)
 
     def _explain_analyze_point(self, target,
                                bare_sql: Optional[str] = None
@@ -3334,9 +3370,9 @@ class Session:
             else f"key:{fp.index.name}"
         row = (f"Point_Get_1(table:{fp.info.name}, {key})",
                len(rs.rows), round(dt, 3), "point",
-               f"plan_cache:{cache}", "")
+               f"plan_cache:{cache}", "", self._wait_profile_cell())
         return ResultSet(["plan", "actRows", "time_ms", "engine",
-                          "stages", "mesh"], [row])
+                          "stages", "mesh", "wait_profile"], [row])
 
     def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
         """TRACE <select>: execute with span accounting and return the
@@ -3636,11 +3672,12 @@ class Session:
             rows = [(e["ts"], e["db"], e["duration_ms"], e["sql"],
                      e.get("plan_digest", ""),
                      _obs.fmt_stages_ms(e.get("stages")),
-                     e.get("mem_max", 0), e.get("spill_count", 0))
+                     e.get("mem_max", 0), e.get("spill_count", 0),
+                     _obs.fmt_waits_ms(e.get("waits")))
                     for e in self.storage.obs.slow_queries()]
             return ResultSet(["Time", "DB", "Duration_ms", "Query",
                               "Plan_digest", "Stages", "Mem_max",
-                              "Spill_count"], rows)
+                              "Spill_count", "Wait_profile"], rows)
         if stmt.kind == "METRICS":
             from .. import obs
             rows = []

@@ -343,7 +343,8 @@ class Storage:
         else:
             self.tso = TimestampOracle(floor=self._tso_lease)
         self.rm = RegionManager(self.kv)
-        self.committer = TwoPhaseCommitter(self.rm, self.tso)
+        self.committer = TwoPhaseCommitter(self.rm, self.tso,
+                                           events=self.obs.events)
         # wire the structured event ring into its producers: governor
         # kills, admission sheds, rpc breaker trips, WAL fsync stalls —
         # the protective/durability actions PR 4/5 added become
