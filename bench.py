@@ -1573,16 +1573,28 @@ def flight_range_write(res: dict) -> None:
     # third phase: the wait-profile zero-overhead check — the same
     # n_leaders workload with a fresh per-txn WaitLedger installed
     # (what performance.wait-profile-enabled costs this path)
+    from tidb_tpu.obs_heat import RangeHeatRecorder
+
     qps: dict[tuple[int, bool], float] = {}
+    heat_board: dict = {}
     for count, with_ledger in ((1, False), (n_leaders, False),
                                (n_leaders, True)):
         tmp = tempfile.mkdtemp(prefix=f"bench-range-{count}-")
         srv = None
         routers: list = []
+        # the n-leader phase runs with the keyspace heat plane armed:
+        # the flight result carries the observed per-range traffic
+        # split (the keyspace-balance trail of the scaling claim)
+        heat = None
+        if count == n_leaders and not with_ledger:
+            heat = RangeHeatRecorder()
+            heat.configure(enabled=True, bucket_seconds=1,
+                           sustained_buckets=1)
+            heat.set_specs(split_keyspace(count))
         try:
             srv = RangeServer(tmp, lease_ms=60_000,
                               specs=split_keyspace(count),
-                              sync_log="commit")
+                              sync_log="commit", heat=heat)
             tso = TimestampOracle()
             stop = threading.Event()
             counts = [0] * workers
@@ -1626,6 +1638,26 @@ def flight_range_write(res: dict) -> None:
                 f"{tag}: {qps[(count, with_ledger)]:.0f} durable txn/s "
                 f"({workers} workers, sync-log=commit, "
                 f"{sum(counts)} commits / {wall:.1f}s)")
+            if heat is not None:
+                payload = heat.debug_payload()
+                heat_board = {
+                    "ranges": payload.get("totals", {}),
+                    "findings": payload.get("findings", []),
+                    "heatmap": payload.get("heatmap", []),
+                }
+                writes = {rid: t[2] for rid, t
+                          in heat_board["ranges"].items()}
+                total_w = sum(writes.values()) or 1
+                split = ", ".join(
+                    f"r{rid}={w * 100.0 / total_w:.0f}%"
+                    for rid, w in sorted(writes.items()))
+                lines.append(f"range_write heat split: {split}")
+                for hl in heat_board["heatmap"]:
+                    lines.append(f"  {hl}")
+                for f in heat_board["findings"]:
+                    lines.append(
+                        f"range_write heat finding: {f['rule']} "
+                        f"{f['item']} {f['value']}")
         finally:
             for router in routers:
                 router.close()
@@ -1642,6 +1674,7 @@ def flight_range_write(res: dict) -> None:
         f"range_write scaling: "
         f"{res['values']['range_write_scaling']:.2f}x durable write "
         f"QPS at {n_leaders} range leaders vs 1")
+    res["heatmap"] = heat_board
     res["values"]["range_write_qps_wp"] = round(qps[(n_leaders, True)], 1)
     res["values"]["range_write_wp_ratio"] = round(
         qps[(n_leaders, True)] / max(qps[(n_leaders, False)], 1e-9), 3)

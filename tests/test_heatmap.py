@@ -1,0 +1,435 @@
+"""Keyspace heat plane (ISSUE 18): per-range traffic histograms,
+hot-range detection and load-based split advisories.
+
+Pins the acceptance criteria: zero statement-path heat work while
+[heatmap] enabled = false (poison test); the ring/bucket rotation
+respects the caps; each feeding site (fastpath point read, coprocessor
+scan, local 2PC commit, range-leader apply) lands in the RIGHT range
+cell; a deliberately skewed write workload against a 4-range store
+produces a `hot-range` finding in information_schema.inspection_result
+plus a `range-split-advisory` whose split key falls inside the hot
+range's observed key span; uniform load stays silent; the cluster_
+table fans out with per-peer degradation; and the [heatmap] knobs
+parse/seed/hot-reload. The conftest guard covers leaked threads (the
+recorder has none of its own — rotation is lazy)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import pytest
+
+from tidb_tpu import obs, obs_heat, obs_inspect
+from tidb_tpu.config import Config, ConfigError, HeatmapConfig
+from tidb_tpu.kv import tablecodec
+from tidb_tpu.kv.rangemeta import split_keyspace
+from tidb_tpu.obs_heat import RangeHeatRecorder
+from tidb_tpu.rpc.client import RpcOptions
+from tidb_tpu.session import Session
+from tidb_tpu.store.storage import Storage
+from tidb_tpu.util import failpoint
+
+OPTS = RpcOptions(connect_timeout_ms=1000, request_timeout_ms=4000,
+                  backoff_budget_ms=3000, lock_budget_ms=8000,
+                  lease_ms=2000)
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+class _Clock:
+    """Controls obs_heat's wall clock so bucket rotation is
+    deterministic (the module only calls time.time())."""
+
+    def __init__(self, t=1_000_000.0):
+        self.t = float(t)
+
+    def time(self):
+        return self.t
+
+
+class _Events:
+    def __init__(self):
+        self.rows = []
+
+    def record(self, kind, detail="", severity="info", **kw):
+        self.rows.append((kind, detail, severity))
+
+
+def _recorder(monkeypatch, **knobs):
+    clock = _Clock()
+    monkeypatch.setattr(obs_heat, "time", clock)
+    ev = _Events()
+    h = RangeHeatRecorder(events=ev)
+    h.configure(enabled=True, **knobs)
+    return h, clock, ev
+
+
+# ==================== config / state mirror ====================
+
+def test_state_mirrors_config_section():
+    """config.HeatmapConfig and obs_heat.RangeHeatRecorder are mirrored
+    definitions (config never imports the obs chain): every knob must
+    exist on the recorder with the same default, so seed_heatmap cannot
+    silently drop one."""
+    h = RangeHeatRecorder()
+    for f in dataclasses.fields(HeatmapConfig):
+        assert hasattr(h, f.name), f"RangeHeatRecorder lacks {f.name}"
+        assert getattr(h, f.name) == f.default, f.name
+
+
+def test_heatmap_knobs_parse_seed_and_reload():
+    cfg = Config()
+    cfg.apply({"heatmap": {"enabled": True, "bucket-seconds": 2,
+                           "ring-buckets": 5, "hot-ratio": 3.0,
+                           "sustained-buckets": 1,
+                           "key-sample-cap": 8}})
+    cfg.validate()
+    st = Storage()
+    try:
+        cfg.seed_heatmap(st)
+        assert st.heat.enabled is True
+        assert st.heat.bucket_seconds == 2
+        assert st.heat.ring_buckets == 5
+        assert st.heat.hot_ratio == 3.0
+        assert st.heat.sustained_buckets == 1
+        assert st.heat.key_sample_cap == 8
+        # SIGHUP: disabling reloads live too
+        cfg.heatmap.enabled = False
+        cfg.seed_heatmap(st)
+        assert st.heat.enabled is False
+    finally:
+        st.close()
+    for knob in ("heatmap.enabled", "heatmap.bucket_seconds",
+                 "heatmap.ring_buckets", "heatmap.hot_ratio",
+                 "heatmap.sustained_buckets", "heatmap.key_sample_cap"):
+        assert knob in Config.RELOADABLE, knob
+    # validation rejects nonsense
+    for field_, bad, msg in (("hot_ratio", 0.5, "hot-ratio"),
+                             ("ring_buckets", 1, "ring-buckets"),
+                             ("key_sample_cap", 1, "key-sample-cap")):
+        c = Config()
+        setattr(c.heatmap, field_, bad)
+        with pytest.raises(ConfigError, match=msg):
+            c.validate()
+
+
+# ==================== zero work while disabled ====================
+
+def test_disabled_does_zero_heat_work(monkeypatch):
+    st = Storage()
+    try:
+        assert st.heat.enabled is False  # the Top SQL default
+
+        def boom(*a, **k):
+            raise AssertionError("heat touched while disabled")
+
+        # poison every accounting entry point AND the cell machinery;
+        # the note_* prologues and the call-site `.enabled` gates must
+        # keep statements from ever reaching them
+        for name in ("_cell", "_rotate", "_sample", "_detect"):
+            monkeypatch.setattr(st.heat, name, boom)
+        s = Session(st)
+        s.execute("create table z (id bigint primary key, v bigint)")
+        s.execute("insert into z values (1, 10), (2, 20)")
+        s.execute("select v from z where id = 2")  # fastpath point read
+        s.execute("select sum(v) from z")          # coprocessor scan
+        assert st.heat.findings() == []
+        assert st.heat.table_rows() == []
+        assert st.diag.diag_hot_ranges() == {"rows": []}
+        payload = st.heat.debug_payload()
+        assert payload["enabled"] is False and "buckets" not in payload
+        rows = s.execute("select * from "
+                         "information_schema.tidb_hot_ranges").rows
+        assert rows == []
+    finally:
+        st.close()
+
+
+# ==================== rotation + caps ====================
+
+def test_ring_rotation_respects_caps(monkeypatch):
+    h, clock, _ = _recorder(monkeypatch, bucket_seconds=1,
+                            ring_buckets=3)
+    for i in range(10):
+        clock.t = 1_000_000.0 + i
+        h.note_read(b"k", rows=1, nbytes=1)
+    assert len(h._ring) == 3  # oldest buckets dropped
+    assert [b["start"] for b in h._ring] == [1_000_007, 1_000_008,
+                                             1_000_009]
+    # lifetime totals survive rotation
+    assert h.range_totals(1) == (10, 10, 0, 0)
+    # shrinking the ring live drops the oldest immediately
+    h.configure(ring_buckets=2)
+    assert len(h._ring) == 2
+    # knob clamps: nonsense inputs degrade to the documented floors
+    h.configure(bucket_seconds=0, ring_buckets=1, hot_ratio=0.2,
+                sustained_buckets=0, key_sample_cap=1)
+    assert h.bucket_seconds == 1 and h.ring_buckets == 2
+    assert h.hot_ratio == 1.0 and h.sustained_buckets == 1
+    assert h.key_sample_cap == 2
+
+
+def test_key_sample_bounded_and_weighted(monkeypatch):
+    h, _, _ = _recorder(monkeypatch, key_sample_cap=4)
+    h.note_write([(b"k%03d" % i, 1) for i in range(32)])
+    s = h._samples[1]
+    assert len(s["order"]) == 4 and len(s["keys"]) == 4
+    assert s["n"] == 32
+    # re-observing a sampled key adds weight instead of a slot
+    kept = s["order"][0]
+    before = s["keys"][kept]
+    h.note_write([(kept, 9)])
+    assert s["keys"][kept] == before + 10  # weight = 1 + value bytes
+    assert len(s["order"]) == 4
+
+
+# ==================== per-site attribution ====================
+
+def test_sites_land_in_the_right_cell():
+    st = Storage()
+    try:
+        s = Session(st)
+        s.execute("create table t (id bigint primary key, v bigint)")
+        tid = st.catalog.table("test", "t").id
+        # two ranges split inside t's handle space at handle 50
+        st.heat.set_specs(split_keyspace(
+            1, [tablecodec.record_key(tid, 50)]))
+        st.heat.configure(enabled=True, bucket_seconds=3600)
+        # 2PC commits (the LOCAL committer carries the recorder):
+        # 3 rows left of the split, 3 right of it
+        s.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+        s.execute("insert into t values "
+                  "(100, 1), (101, 2), (102, 3)")
+        w1 = st.heat.range_totals(1)
+        w2 = st.heat.range_totals(2)
+        assert w1[2] == 3 and w1[3] > 0, w1  # write rows/bytes, r1
+        assert w2[2] == 3 and w2[3] > 0, w2
+        assert w1[0] == w2[0] == 0  # no reads yet
+        # fastpath point read routes by the ROW's record key
+        s.execute("select v from t where id = 101")
+        assert list(s.last_engines) == ["point"], s.last_engines
+        assert st.heat.range_totals(1)[0] == 0
+        assert st.heat.range_totals(2)[0] == 1
+        # a coprocessor scan splits across every overlapped range
+        s.execute("select sum(v) from t")
+        r1 = st.heat.range_totals(1)[0]
+        r2 = st.heat.range_totals(2)[0]
+        assert r1 >= 1 and r2 >= 2, (r1, r2)
+        # the metric families carry the same per-range attribution
+        fams = st.obs.metrics.families()
+        for fam in ("tidb_range_read_rows_total",
+                    "tidb_range_read_bytes_total",
+                    "tidb_range_write_rows_total",
+                    "tidb_range_write_bytes_total",
+                    "tidb_hot_range_ratio"):
+            assert fam in fams, fam
+        assert 'range="2"' in st.obs.metrics.render()
+        assert obs.lint_metrics([st.obs.metrics]) == []
+    finally:
+        st.close()
+
+
+def test_note_range_is_the_leader_feed(monkeypatch):
+    """rpc/ranged.py's leader apply uses the direct cell feed: no key
+    routing, keys feed the split sample at weight 1."""
+    h, _, _ = _recorder(monkeypatch)
+    h.set_specs(split_keyspace(4))
+    h.note_range(3, write_rows=5, write_bytes=50,
+                 keys=[b"\x81a", b"\x81b"])
+    h.note_range(3, read_rows=2, read_bytes=16)
+    assert h.range_totals(3) == (2, 16, 5, 50)
+    assert h.range_totals(1) == (0, 0, 0, 0)
+    assert sorted(h._samples[3]["keys"]) == [b"\x81a", b"\x81b"]
+
+
+# ==================== hot detection + split advisory ====================
+
+def test_uniform_load_stays_silent(monkeypatch):
+    h, clock, ev = _recorder(monkeypatch, bucket_seconds=1,
+                             sustained_buckets=1)
+    h.set_specs(split_keyspace(4))
+    for i in range(4):
+        prefix = bytes([0x40 * i + 1])
+        h.note_write([(prefix + b"%02d" % j, 8) for j in range(20)])
+    assert h.findings() == []
+    clock.t += 1
+    h.note_read(b"\x01", 1, 1)  # rotate: detection on the closed bucket
+    assert not [r for r in ev.rows if r[0] == "hot_range"], ev.rows
+
+
+def test_skew_fires_hot_range_and_advisory(monkeypatch):
+    h, clock, ev = _recorder(monkeypatch, bucket_seconds=1,
+                             sustained_buckets=1, hot_ratio=8.0)
+    h.set_specs(split_keyspace(4))
+    spec = next(s for s in h._specs if s.id == 2)
+
+    def skew(n=40):
+        # all writes into range 2's span, two distinct key groups
+        h.note_write([(spec.start_key + b"%02d" % (j % 10), 8)
+                      for j in range(n)])
+
+    skew()
+    # on-demand view: hot NOW, without waiting out a bucket
+    found = {f["rule"]: f for f in h.findings()}
+    assert found["hot-range"]["item"] == "r2"
+    adv = found["range-split-advisory"]
+    assert adv["item"] == "r2" and adv["severity"] == "info"
+    split = bytes.fromhex(adv["value"])
+    sampled = sorted(h._samples[2]["keys"])
+    # the advisory partitions the OBSERVED span: strictly above the
+    # smallest sampled key, at most the largest
+    assert sampled[0] < split <= sampled[-1], (sampled, split)
+    # rotation closes the bucket -> ONE edge-triggered event
+    clock.t += 1
+    h.note_write([(spec.start_key, 1)])
+    assert [r[0] for r in ev.rows].count("hot_range") == 1
+    # still hot next bucket: no re-fire while the edge is held
+    skew()
+    clock.t += 1
+    skew()
+    assert [r[0] for r in ev.rows].count("hot_range") == 1
+    # a cold bucket re-arms the trigger, the next hot one fires again
+    clock.t += 1
+    h.note_read(b"\x01", 1, 1)   # rotate over an (almost) silent bucket
+    clock.t += 1
+    skew()
+    clock.t += 1
+    h.note_read(b"\x01", 1, 1)
+    assert [r[0] for r in ev.rows].count("hot_range") == 2, ev.rows
+    skew()  # make the LIVE bucket hot again for the on-demand views
+    # table rows carry the hot flag + advisory; payload is JSON-safe
+    rows = {r[0]: r for r in h.table_rows()}
+    assert rows[2][8] == 1 and rows[2][9] is not None
+    assert rows[1][8] == 0 and rows[1][9] is None
+    payload = h.debug_payload()
+    json.dumps(payload)
+    assert len(payload["heatmap"]) == 4  # one shade line per range
+    assert any("@" in line for line in payload["heatmap"])
+
+
+def test_one_key_hotspot_has_no_advisory(monkeypatch):
+    """A single hammered key cannot be split — advisory stays None
+    (that is the salted-key case, a later PR's actuator)."""
+    h, _, _ = _recorder(monkeypatch, sustained_buckets=1)
+    h.set_specs(split_keyspace(4))
+    h.note_write([(b"\x01same", 8)] * 50)
+    assert h.split_advisory(1) is None
+    rules = [f["rule"] for f in h.findings()]
+    assert rules == ["hot-range"], rules
+
+
+# ==================== acceptance: 4-range store end to end ==========
+
+def test_skewed_writes_on_4_range_store_reach_inspection(tmp_path):
+    st = Storage(str(tmp_path))
+    try:
+        s = Session(st)
+        s.execute("create table t (id bigint primary key, v bigint)")
+        tid = st.catalog.table("test", "t").id
+        splits = [tablecodec.record_key(tid, h) for h in (25, 50, 75)]
+        st.arm_ranges(enabled=True, split_points=splits)
+        assert st.ranges is not None
+        assert len(st.heat._specs) == 4  # arm_ranges adopted the table
+        st.heat.configure(enabled=True, bucket_seconds=3600,
+                          sustained_buckets=1, hot_ratio=8.0)
+        # skew: every write lands in range 3 = [key(50), key(75))
+        for h in range(50, 74, 4):
+            s.execute("insert into t values " + ", ".join(
+                f"({h + i}, {i})" for i in range(4)))
+        rows = s.execute(
+            "select rule, item, value from "
+            "information_schema.inspection_result").rows
+        hot = [r for r in rows if r[0] == "hot-range"]
+        adv = [r for r in rows if r[0] == "range-split-advisory"]
+        assert hot and hot[0][1] == "r3", rows
+        assert adv and adv[0][1] == "r3", rows
+        # the recommended split key falls inside the hot range's
+        # OBSERVED key span: a record key of t, handle in (50, 74)
+        key = bytes.fromhex(adv[0][2])
+        assert splits[1] <= key < splits[2]
+        ktid, handle = tablecodec.decode_record_key(key)
+        assert ktid == tid and 50 < handle < 74, (ktid, handle)
+        # the same heat reaches the SQL matrix + the range describe()
+        hr = {r[0]: r for r in s.execute(
+            "select * from "
+            "information_schema.tidb_hot_ranges").rows}
+        assert hr[3][8] == 1 and hr[3][5] == 24, hr  # hot, write_rows
+        assert hr[1][8] == 0
+        ci = [r for r in s.execute(
+            "select type, range_id, range_write_rows from "
+            "information_schema.cluster_info").rows
+            if r[0] == "range"]
+        assert {r[1]: r[2] for r in ci}[3] == 24, ci
+    finally:
+        st.close()
+
+
+# ==================== cluster fan-out ====================
+
+@pytest.fixture()
+def cluster(tmp_path):
+    leader = Storage(str(tmp_path / "leader"), shared=True,
+                     rpc_listen="127.0.0.1:0", rpc_options=OPTS)
+    follower = Storage(str(tmp_path / "follower"),
+                       remote=f"127.0.0.1:{leader.rpc_server.port}",
+                       rpc_options=OPTS)
+    try:
+        yield leader, follower
+    finally:
+        follower.close()
+        leader.close()
+
+
+def test_cluster_hot_ranges_from_both_members(cluster):
+    leader, follower = cluster
+    for st, reads in ((leader, 3), (follower, 7)):
+        st.heat.configure(enabled=True, bucket_seconds=3600)
+        st.heat.note_read(b"k", rows=reads, nbytes=reads * 8)
+    sl = Session(leader)
+    rows = sl.execute(
+        "select instance, range_id, read_rows, error from "
+        "information_schema.cluster_hot_ranges").rows
+    by_inst = {r[0]: r[2] for r in rows if r[3] is None}
+    assert by_inst == {leader.diag_address: 3,
+                       follower.diag_address: 7}, rows
+    assert all(r[1] == 1 for r in rows if r[3] is None)
+
+
+def test_cluster_hot_ranges_peer_down_degrades(cluster):
+    leader, follower = cluster
+    leader.heat.configure(enabled=True)
+    follower.heat.configure(enabled=True)
+    sl = Session(leader)
+    failpoint.enable("diag/peer-down")
+    try:
+        rows = sl.execute(
+            "select instance, error from "
+            "information_schema.cluster_hot_ranges").rows
+    finally:
+        failpoint.disable("diag/peer-down")
+    err = [r for r in rows if r[1] is not None]
+    assert err and any(follower.diag_address == r[0] for r in err), rows
+    assert any("unreachable" in w[2] for w in sl.warnings), sl.warnings
+
+
+# ==================== lint coverage (CI/tooling satellite) =========
+
+def test_heat_rules_and_metrics_pass_registry_lints():
+    """The heat surfaces ride the existing lint planes: both inspection
+    rules are registered kebab-cased with heatmap-knob references, the
+    tidb_range_*/tidb_hot_range_ratio families pass the metric-hygiene
+    lint on a live registry, and the [heatmap] knobs are inside the
+    config-knob-drift rule's coverage."""
+    assert "hot-range" in obs_inspect.RULES
+    assert "range-split-advisory" in obs_inspect.RULES
+    assert obs_inspect.lint_rules() == []
+    for rule in ("hot-range", "range-split-advisory"):
+        assert "heatmap" in obs_inspect.RULES[rule].reference
+    from tidb_tpu.config import EXAMPLE
+    assert "[heatmap]" in EXAMPLE and "hot-ratio" in EXAMPLE
+    assert "key-sample-cap" in EXAMPLE

@@ -147,7 +147,15 @@ def test_governor_kill_end_to_end_typed_8175():
     a statement past its last checkpoint completes. The 3-way join is
     sized so the first weight registration happens at the FIRST hash
     build with two more joins plus the aggregate still ahead — plenty
-    of checkpoints between the kill and completion."""
+    of checkpoints between the kill and completion.
+
+    Deterministic on 1-core boxes: the limit and the mem-pressure
+    failpoint are armed BEFORE the statement starts — no cross-thread
+    race against the statement finishing first (the pre-PR-18 flake).
+    The registration-time pressure check alone would kill the victim
+    at weight 0 (before forensics have anything to record), so it is
+    gated out; the kill then fires at the victim's FIRST tracker-
+    consume poll, in its own thread, with weight materialized."""
     st = Storage()
     heavy_s = Session(st)
     light_s = Session(st)
@@ -167,19 +175,28 @@ def test_governor_kill_end_to_end_typed_8175():
             errs.append(e)
 
     t = threading.Thread(target=heavy)
-    t.start()
+    # arm BEFORE the statement runs: usage (failpoint) > limit, no
+    # timing window. Skip ONLY the registration-time check (it would
+    # kill at weight 0); the first consume poll (_gov_next starts at 0)
+    # then runs the real check inside the victim's own thread, with
+    # the statement's weight materialized.
+    st.governor.configure(limit_bytes=1 << 20, cooldown_ms=60_000)
+    failpoint.enable("governor/mem-pressure", 2 << 20)
+    real_check = st.governor.check
+    seen = []
+
+    def gated_check():
+        if not seen:
+            seen.append(1)
+            return False
+        return real_check()
+
+    st.governor.check = gated_check
     try:
-        # wait until the statement registered AND materialized weight
-        # (so the kill is genuinely "the heaviest", not just "the only")
-        deadline = time.monotonic() + 30
-        while st.governor.tracked_bytes() <= 0:
-            assert time.monotonic() < deadline, "statement never weighed"
-            time.sleep(0.01)
-        st.governor.configure(limit_bytes=1 << 20, cooldown_ms=1000)
-        failpoint.enable("governor/mem-pressure", 2 << 20)
-        assert st.governor.check() is True
-    finally:
+        t.start()
         t.join(timeout=60)
+    finally:
+        del st.governor.check
         failpoint.disable("governor/mem-pressure")
         st.governor.configure(limit_bytes=0)
     assert not t.is_alive()

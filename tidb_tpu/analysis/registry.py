@@ -37,6 +37,11 @@ HOT_LOCKS: dict[str, str] = {
         "passes its fencing gate under it, so a lease renewal doing "
         "disk I/O inside would stall every range's writers at once "
         "(rpc/ranged.py)",
+    "RangeHeatRecorder._mu":
+        "the keyspace heat recorder's cell ring — every point read, "
+        "scan, and 2PC commit notes its traffic under it while the "
+        "heatmap is enabled, so any blocking call inside would "
+        "serialize the whole statement path behind it (obs_heat.py)",
 }
 
 # ---- blocking calls ---------------------------------------------------------

@@ -237,6 +237,19 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("findings", _bigint()), ("items", _vc(256)),
         ("reference", _vc(256)),
     ],
+    # keyspace heat plane (obs_heat.py): one row per known range with
+    # lifetime served traffic, the live hot ratio vs the fleet median,
+    # and the load-based split advisory (reference: PD's hot-region
+    # tables behind INFORMATION_SCHEMA.TIDB_HOT_REGIONS). Empty — with
+    # zero recorder work — while [heatmap] is disabled.
+    "tidb_hot_ranges": [
+        ("range_id", _bigint()), ("start_key", _vc(64)),
+        ("end_key", _vc(64)), ("read_rows", _bigint()),
+        ("read_bytes", _bigint()), ("write_rows", _bigint()),
+        ("write_bytes", _bigint()),
+        ("hot_ratio", FieldType(TypeKind.DOUBLE)),
+        ("hot", _bigint()), ("split_advisory", _vc(64)),
+    ],
     # counter/gauge time-series rollup from the MetricsHistory ring
     # (reference: TiDB 4.0's metrics schema summarized into
     # INFORMATION_SCHEMA.METRICS_SUMMARY)
@@ -269,6 +282,11 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         # rows at all while [ranges] is disabled)
         ("range_id", _bigint()), ("range_leader", _vc()),
         ("range_term", _bigint()), ("range_closed_ts", _bigint()),
+        # keyspace heat plane: lifetime traffic served by the hosted
+        # range (NULL on server rows; zeros while [heatmap] disabled)
+        ("range_read_rows", _bigint()), ("range_read_bytes", _bigint()),
+        ("range_write_rows", _bigint()),
+        ("range_write_bytes", _bigint()),
         ("error", _vc(256)),
     ],
     "cluster_processlist": [
@@ -373,6 +391,17 @@ _DEFS: dict[str, list[tuple[str, FieldType]]] = {
         ("instance", _vc()), ("rule", _vc(64)), ("item", _vc(128)),
         ("severity", _vc(16)), ("value", _vc(64)),
         ("reference", _vc(256)), ("details", _vc(512)),
+        ("error", _vc(256)),
+    ],
+    # cluster-wide keyspace heat: every member's tidb_hot_ranges under
+    # one roof, degrading per peer like the other cluster_* tables
+    "cluster_hot_ranges": [
+        ("instance", _vc()), ("range_id", _bigint()),
+        ("start_key", _vc(64)), ("end_key", _vc(64)),
+        ("read_rows", _bigint()), ("read_bytes", _bigint()),
+        ("write_rows", _bigint()), ("write_bytes", _bigint()),
+        ("hot_ratio", FieldType(TypeKind.DOUBLE)),
+        ("hot", _bigint()), ("split_advisory", _vc(64)),
         ("error", _vc(256)),
     ],
     # device/host telemetry per member (live gauges + counters), for
@@ -622,6 +651,9 @@ def _rows_for(storage, catalog: Catalog, tname: str,
         rows = storage.diag.diag_mesh_storage()["rows"]
     elif tname == "tidb_events":
         rows = storage.diag.diag_events()["rows"]
+    elif tname == "tidb_hot_ranges":
+        # same producer as the cluster fan-out (minus instance/error)
+        rows = storage.diag.diag_hot_ranges()["rows"]
     elif tname == "statements_summary_history":
         # same producer as the cluster fan-out (minus instance/error)
         rows = storage.diag.diag_history()["rows"]
@@ -649,7 +681,8 @@ def _rows_for(storage, catalog: Catalog, tname: str,
                    "cluster_mesh_shards", "cluster_mesh_storage",
                    "cluster_inspection_result",
                    "cluster_statements_summary_history",
-                   "cluster_plan_history", "cluster_tidb_wait_profile"):
+                   "cluster_plan_history", "cluster_tidb_wait_profile",
+                   "cluster_hot_ranges"):
         from ..rpc import diag as _diag
         rows = _diag.cluster_rows(storage, tname,
                                   len(_DEFS[tname]), viewer)

@@ -211,6 +211,32 @@ class HistoryConfig:
 
 
 @dataclass
+class HeatmapConfig:
+    """The `[heatmap]` TOML section: the keyspace heat plane
+    (tidb_tpu/obs_heat.py RangeHeatRecorder is the runtime owner —
+    field names/defaults MIRROR it, mirrored rather than imported so
+    config parsing never pulls the obs chain; tests/test_heatmap.py
+    pins the two definitions equal)."""
+
+    # master switch: off = ZERO statement-path work (the Top SQL
+    # contract); on = point reads, scans, 2PC commits and range-leader
+    # applies feed the per-range time x traffic matrix
+    enabled: bool = False
+    # one heat bucket's span; hot detection runs at bucket rotation
+    bucket_seconds: int = 10
+    # buckets retained in the ring (the keyviz window =
+    # ring-buckets x bucket-seconds)
+    ring_buckets: int = 36
+    # a range at >= this multiple of the fleet-median activity in a
+    # bucket is hot-candidate
+    hot_ratio: float = 8.0
+    # consecutive hot buckets before the hot_range event / finding
+    sustained_buckets: int = 2
+    # per-range bounded write-key sample feeding the split advisory
+    key_sample_cap: int = 64
+
+
+@dataclass
 class ReplicaReadConfig:
     """The `[replica-read]` TOML section: the follower read tier's
     knobs (rpc/replica.py ReplicaReadState is the runtime owner —
@@ -375,6 +401,7 @@ class Config:
     diagnostics: DiagnosticsConfig = field(
         default_factory=DiagnosticsConfig)
     history: HistoryConfig = field(default_factory=HistoryConfig)
+    heatmap: HeatmapConfig = field(default_factory=HeatmapConfig)
     replica_read: ReplicaReadConfig = field(
         default_factory=ReplicaReadConfig)
     ranges: RangesConfig = field(default_factory=RangesConfig)
@@ -536,6 +563,23 @@ class Config:
             raise ConfigError(
                 "history.regression-ratio must be >= 1.0 (a plan this "
                 "many times slower than its history is a regression)")
+        hm = self.heatmap
+        if hm.bucket_seconds < 1:
+            raise ConfigError("heatmap.bucket-seconds must be >= 1")
+        if hm.ring_buckets < 2:
+            raise ConfigError(
+                "heatmap.ring-buckets must be >= 2 (detection compares "
+                "a closed bucket against the ring)")
+        if hm.hot_ratio < 1.0:
+            raise ConfigError(
+                "heatmap.hot-ratio must be >= 1.0 (a range this many "
+                "times over the fleet median is hot)")
+        if hm.sustained_buckets < 1:
+            raise ConfigError("heatmap.sustained-buckets must be >= 1")
+        if hm.key_sample_cap < 2:
+            raise ConfigError(
+                "heatmap.key-sample-cap must be >= 2 (a split advisory "
+                "needs at least two distinct sampled keys)")
         if self.log.file.max_size < 0:
             raise ConfigError(
                 "log.file.max-size must be >= 0 (0 = never rotate)")
@@ -641,6 +685,16 @@ class Config:
         "history.window_seconds",
         "history.history_cap",
         "history.regression_ratio",
+        # the keyspace heat plane toggles/tunes live: arming the
+        # heatmap to chase a hot range mid-incident must not need a
+        # restart (same contract as [history]; every knob is a plain
+        # recorder field re-read per note/rotation)
+        "heatmap.enabled",
+        "heatmap.bucket_seconds",
+        "heatmap.ring_buckets",
+        "heatmap.hot_ratio",
+        "heatmap.sustained_buckets",
+        "heatmap.key_sample_cap",
         # the follower read tier toggles/tunes live: routing policy and
         # staleness bounds must not need a restart (the apply cadence
         # does — it is a thread's wait interval, fixed at arm time)
@@ -805,6 +859,18 @@ class Config:
             window_seconds=h.window_seconds,
             history_cap=h.history_cap,
             regression_ratio=h.regression_ratio)
+
+    def seed_heatmap(self, storage) -> None:
+        """Arm the keyspace heat plane from the [heatmap] knobs
+        (startup and SIGHUP hot reload both call this)."""
+        hm = self.heatmap
+        storage.heat.configure(
+            enabled=hm.enabled,
+            bucket_seconds=hm.bucket_seconds,
+            ring_buckets=hm.ring_buckets,
+            hot_ratio=hm.hot_ratio,
+            sustained_buckets=hm.sustained_buckets,
+            key_sample_cap=hm.key_sample_cap)
 
     def seed_replica_read(self, storage) -> None:
         """Arm the follower read tier from the [replica-read] knobs
@@ -1303,6 +1369,39 @@ lease-ms = 1000
 resolve-ttl-ms = 3000
 # the range RPC listener bind (restart-only)
 listen = "127.0.0.1:0"
+
+[heatmap]
+# Keyspace heat plane (information_schema.tidb_hot_ranges /
+# cluster_hot_ranges, /debug/keyviz): a rolling ring of ring-buckets
+# time buckets x range cells, each accumulating read rows/bytes, write
+# rows/bytes and statement counts, fed from the four traffic sites —
+# fast-path point reads, coprocessor scans, 2PC commits, and
+# range-leader applies (a routed write counts exactly once, on its
+# leader). At each bucket rotation every range's activity is compared
+# against the FLEET MEDIAN across all known ranges: a range at
+# >= hot-ratio x median for sustained-buckets consecutive buckets
+# fires one edge-triggered `hot_range` event, the hot-range inspection
+# rule, and a range-split-advisory naming the within-range key (the
+# weighted median of a bounded key-sample sketch) that best halves the
+# observed write traffic — advisory only, add it to
+# ranges.split-points to act on it. Surfaces also include
+# tidb_range_{read,write}_{rows,bytes}_total{range},
+# tidb_hot_range_ratio, and heat columns on /status ranges +
+# cluster_info type='range' rows. Off by default: disabled it costs
+# ZERO work on the statement path (the Top SQL contract).
+# Hot-reloadable via SIGHUP.
+enabled = false
+# one heat bucket's span; hot detection runs at bucket rotation
+bucket-seconds = 10
+# buckets retained (the keyviz window = ring-buckets x bucket-seconds)
+ring-buckets = 36
+# a range at >= this multiple of the fleet-median bucket activity is a
+# hot candidate
+hot-ratio = 8.0
+# consecutive hot buckets before the event / finding fires
+sustained-buckets = 2
+# per-range bounded write-key sample feeding the split advisory
+key-sample-cap = 64
 
 [gc]
 life-time = "10m0s"            # versions younger than this survive GC

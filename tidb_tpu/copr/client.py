@@ -218,6 +218,11 @@ class CopClient:
         self._stats: dict[tuple[int, int], Bound] = {}
         # guards the caches; kernels themselves are thread-safe to call
         self._lock = threading.RLock()
+        # keyspace heat recorder (obs_heat.RangeHeatRecorder), attached
+        # by mesh.client_for from the owning storage: every coprocessor
+        # scan accounts its table's record span on the heatmap. None on
+        # bare clients; one gated attribute test per execute() when off
+        self.heat = None
         _LIVE_CLIENTS.add(self)
 
     def _evict_stale(self, table_id: int, epoch_id: int) -> None:
@@ -298,6 +303,15 @@ class CopClient:
     # ==================== public entry ====================
     def execute(self, dag: CopDAG, snap: TableSnapshot) -> CopResult:
         with obs.span(f"copr.execute(t{dag.scan.table_id})") as sp:
+            heat = self.heat
+            if heat is not None and heat.enabled:
+                # one scan note per coprocessor dispatch, split across
+                # the ranges overlapping the table's record span —
+                # regardless of which engine ends up serving it
+                heat.note_scan(
+                    dag.scan.table_id,
+                    rows=snap.epoch.num_rows + len(snap.overlay_handles),
+                    nbytes=_obj_nbytes(snap.epoch.columns))
             if dag.scan.ranges is not None:
                 # index-ranged scan: the index permutation resolves a
                 # (small) handle set; the DAG runs host-side over the

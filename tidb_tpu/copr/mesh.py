@@ -464,6 +464,9 @@ class MeshPlane:
         # receives mesh_skew / mesh_compile_storm / mesh_hbm_watermark
         if c.recorder.obs is None:
             c.recorder.obs = getattr(storage, "obs", None)
+        # the keyspace heat recorder: scans account per-range traffic
+        if c.heat is None:
+            c.heat = getattr(storage, "heat", None)
         # module-level storage->client registry: the diag/infoschema
         # read side (client_of) resolves through it, so recorder rings
         # stay queryable whichever plane instance built the client
@@ -1112,7 +1115,9 @@ def client_for(storage) -> CopClient:
     single-device CopClient (exactly the pre-mesh behavior)."""
     plane = get_plane()
     if not plane.active:
-        return CopClient()
+        c = CopClient()
+        c.heat = getattr(storage, "heat", None)
+        return c
     return plane.client_for(storage)
 
 

@@ -100,6 +100,11 @@ class TwoPhaseCommitter:
     # structured EventLog sink for orphan resolutions (the storage
     # passes its obs.events; bare committers audit nothing)
     events: Optional[object] = None
+    # keyspace heat recorder (obs_heat.RangeHeatRecorder). ONLY the
+    # storage's committer over LOCAL regions carries it — the range
+    # tier's per-worker committers leave it None so a routed write is
+    # counted once, by the range leader's apply (rpc/ranged.py)
+    heat: Optional[object] = None
 
     def commit(self, mutations: list[Mutation], start_ts: int) -> int:
         """Run 2PC; returns commit_ts (reference: 2pc.go execute :1050)."""
@@ -174,6 +179,11 @@ class TwoPhaseCommitter:
         # the resolver must roll them FORWARD from the primary's write
         # record (reference failpoint site: 2pc.go:1027)
         failpoint.inject("twopc/after-primary-commit")
+        # the txn is durable: account it on the keyspace heatmap (keys
+        # route to range cells; OP_LOCK values are empty — 0 bytes)
+        if self.heat is not None and self.heat.enabled:
+            self.heat.note_write(
+                [(m.key, len(m.value or b"")) for m in mutations])
         # secondaries may commit lazily; do them inline (the reference
         # fires a goroutine — same semantics, resolver covers crashes).
         # IMPORTANT: the txn is already durable — a secondary failure must

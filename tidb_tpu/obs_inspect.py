@@ -199,6 +199,12 @@ class InspectionContext:
         hist = getattr(storage, "history", None)
         self.history_findings = hist.regression_findings() \
             if hist is not None and hist.enabled else []
+        # keyspace heat findings, computed ONCE per snapshot (both heat
+        # rules read this list; a disabled heat plane contributes
+        # nothing — the [heatmap] zero-work contract)
+        heat = getattr(storage, "heat", None)
+        self.heat_findings = heat.findings() \
+            if heat is not None and heat.enabled else []
 
     # ---- helpers rules share -------------------------------------------
     def metric(self, labeled_name: str) -> float:
@@ -681,6 +687,35 @@ def _r_config_sync_log(ctx: InspectionContext) -> list[Finding]:
         f"leader runs sync-log=off with {len(followers)} live "
         "follower(s); a power loss can drop acked commits that "
         "followers already replicated")]
+
+
+@rule("hot-range", "warning",
+      "heatmap.hot-ratio / heatmap.sustained-buckets — one range "
+      "serves at least hot-ratio x the fleet-median traffic for "
+      "sustained-buckets consecutive heat buckets "
+      "(information_schema.tidb_hot_ranges has the per-range matrix; "
+      "/debug/keyviz renders it)")
+def _r_hot_range(ctx: InspectionContext) -> list[Finding]:
+    out = []
+    for f in ctx.heat_findings:
+        if f["rule"] == "hot-range":
+            out.append(Finding("hot-range", f["item"],
+                               f["severity"], f["value"], f["details"]))
+    return out
+
+
+@rule("range-split-advisory", "info",
+      "heatmap.key-sample-cap — the within-range key that best halves "
+      "a hot range's observed write traffic (its weighted-median "
+      "sampled key); advisory only — add it to ranges.split-points "
+      "to act on it")
+def _r_range_split_advisory(ctx: InspectionContext) -> list[Finding]:
+    out = []
+    for f in ctx.heat_findings:
+        if f["rule"] == "range-split-advisory":
+            out.append(Finding("range-split-advisory", f["item"],
+                               f["severity"], f["value"], f["details"]))
+    return out
 
 
 # ---- the engine -------------------------------------------------------------

@@ -490,7 +490,15 @@ def _exec_get(session, fp: FastPlan):
     from ..session.session import ResultSet
 
     txn = session._ensure_txn()
-    _, row = _lookup_row(session, fp, txn)
+    handle, row = _lookup_row(session, fp, txn)
+    heat = getattr(session.storage, "heat", None)
+    if heat is not None and heat.enabled and row is not None:
+        # OLTP point reads land on the keyspace heatmap by record key
+        # (bytes ~ column count: physical width is not rematerialized
+        # on this path, and the heat plane wants relative skew)
+        from ..kv import tablecodec
+        heat.note_read(tablecodec.record_key(fp.info.id, int(handle)),
+                       rows=1, nbytes=8 * fp.info.num_columns)
     rows: list[tuple] = []
     if row is not None:
         store = session.storage.table_store(fp.info.id)

@@ -57,6 +57,7 @@ TABLE_METHODS = {
     "cluster_statements_summary_history": "diag_history",
     "cluster_plan_history": "diag_plan_history",
     "cluster_tidb_wait_profile": "diag_wait_profile",
+    "cluster_hot_ranges": "diag_hot_ranges",
 }
 
 
@@ -89,17 +90,23 @@ class DiagService:
             if started else "",
             round(time.time() - started, 3) if started else 0.0,
             *self._replica_cols(),
-            None, None, None, None,
+            None, None, None, None, None, None, None, None,
         ]]
         # one type='range' row per range whose write leadership this
-        # member currently holds ([ranges] disabled adds nothing)
+        # member currently holds ([ranges] disabled adds nothing);
+        # the trailing four are the keyspace heat plane's lifetime
+        # traffic columns (zeros while [heatmap] is disabled)
         plane = getattr(self.storage, "ranges", None)
         if plane is not None:
             for d in plane.server.describe():
                 rows.append(["range", None, None, None, None, None,
                              None, None, None,
                              int(d["range_id"]), str(d["leader"]),
-                             int(d["term"]), int(d["closed_ts"])])
+                             int(d["term"]), int(d["closed_ts"]),
+                             int(d.get("read_rows", 0)),
+                             int(d.get("read_bytes", 0)),
+                             int(d.get("write_rows", 0)),
+                             int(d.get("write_bytes", 0))])
         return {"rows": rows}
 
     def _replica_cols(self) -> list:
@@ -166,6 +173,13 @@ class DiagService:
         row-shaped for information_schema.tidb_wait_profile. Empty
         while performance.wait-profile-enabled is false."""
         return {"rows": self.storage.obs.waitprofile.table_rows()}
+
+    def diag_hot_ranges(self) -> dict:
+        """This server's keyspace heat matrix, row-shaped for
+        information_schema.tidb_hot_ranges (the cluster_hot_ranges
+        fan-out adds instance/error). Empty — with zero recorder
+        work — while [heatmap] is disabled."""
+        return {"rows": self.storage.heat.table_rows()}
 
     def diag_mesh_shards(self) -> dict:
         """This server's mesh flight-recorder dispatch ring (empty

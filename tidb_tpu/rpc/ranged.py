@@ -322,11 +322,16 @@ class RangeServer(FrameListener):
 
     def __init__(self, root: str, listen: str = "127.0.0.1:0",
                  lease_ms: int = 1000, specs: Optional[list] = None,
-                 sync_log: str = "commit", events=None) -> None:
+                 sync_log: str = "commit", events=None,
+                 heat=None) -> None:
         self.directory = RangeDirectory(root)
         self.specs = self.directory.bootstrap(specs)
         self.lease_ms = int(lease_ms)
         self.events = events
+        # keyspace heat recorder: the LEADER apply is the single
+        # counting site for routed writes (the range tier's committers
+        # carry no recorder — see kv/twopc.py)
+        self.heat = heat
         # guards the hosted-leader map only — every critical section is
         # a dict op (HOT_LOCKS-declared: this sits on the 2PC data path)
         self._mu = lockcheck.lock("RangeServer._mu", hot=True)
@@ -482,6 +487,15 @@ class RangeServer(FrameListener):
         out = _kv_guarded(lambda: leader.store.prewrite(
             muts, bytes(params["primary"]), int(params["start_ts"]),
             int(params.get("ttl", 3000))))
+        # the leader-side apply is where a routed write lands on the
+        # keyspace heatmap (exactly once: the coordinator's committer
+        # carries no recorder over the range tier)
+        if out["ok"] and self.heat is not None and self.heat.enabled:
+            self.heat.note_range(
+                leader.spec.id,
+                write_rows=len(muts),
+                write_bytes=sum(len(m.value or b"") for m in muts),
+                keys=[m.key for m in muts])
         # applied-but-unacked: a kill here is the harshest prewrite
         # crash — the lock is durable, the coordinator never heard back
         failpoint.inject("range/before-prewrite-ack")
@@ -506,8 +520,14 @@ class RangeServer(FrameListener):
 
     def _h_range_get(self, params: dict) -> dict:
         leader = self._leader_for(params)
-        return _kv_guarded(lambda: leader.store.get(
+        out = _kv_guarded(lambda: leader.store.get(
             bytes(params["key"]), int(params["read_ts"])))
+        if out["ok"] and self.heat is not None and self.heat.enabled:
+            v = out["v"]
+            self.heat.note_range(
+                leader.spec.id, read_rows=1,
+                read_bytes=len(v) if v else 0)
+        return out
 
     def _h_range_scan(self, params: dict) -> dict:
         leader = self._leader_for(params)
@@ -516,9 +536,15 @@ class RangeServer(FrameListener):
         end = bytes(params.get("end", b""))
         if spec.end_key and (not end or end > spec.end_key):
             end = spec.end_key
-        return _kv_guarded(lambda: [list(kv) for kv in leader.store.scan(
+        out = _kv_guarded(lambda: [list(kv) for kv in leader.store.scan(
             start, end, int(params["read_ts"]),
             int(params.get("limit", -1)))])
+        if out["ok"] and self.heat is not None and self.heat.enabled:
+            rows = out["v"]
+            self.heat.note_range(
+                leader.spec.id, read_rows=len(rows),
+                read_bytes=sum(len(kv[1] or b"") for kv in rows))
+        return out
 
     def _h_range_check_txn_status(self, params: dict) -> dict:
         leader = self._leader_for(params)
@@ -566,13 +592,17 @@ class RangeServer(FrameListener):
             leaders = sorted(self._leaders.items())
         out = []
         for rid, leader in leaders:
+            rr, rb, wr, wb = self.heat.range_totals(rid) \
+                if self.heat is not None else (0, 0, 0, 0)
             out.append({"range_id": rid, "leader": self.address,
                         "term": leader.term,
                         "epoch": leader.spec.epoch,
                         "token": int(leader.grant.get("token", 0)),
                         "closed_ts": leader.closed_ts(),
                         "start": leader.spec.start_key.hex(),
-                        "end": leader.spec.end_key.hex()})
+                        "end": leader.spec.end_key.hex(),
+                        "read_rows": rr, "read_bytes": rb,
+                        "write_rows": wr, "write_bytes": wb})
         return out
 
     def hosted_ids(self) -> list[int]:
@@ -616,7 +646,8 @@ class RangePlane:
         self.server = RangeServer(
             storage.path, listen=listen, lease_ms=int(lease_ms),
             specs=split_keyspace(int(count), split_points),
-            events=storage.obs.events)
+            events=storage.obs.events,
+            heat=getattr(storage, "heat", None))
 
     def router(self, **kw):
         from ..kv.rangeclient import RangeRouter

@@ -215,6 +215,7 @@ def main(argv: list[str] | None = None) -> int:
     cfg.seed_overload_protection(storage)
     cfg.seed_diagnostics(storage)
     cfg.seed_history(storage)
+    cfg.seed_heatmap(storage)
     cfg.seed_replica_read(storage)
     cfg.seed_ranges(storage)
     cfg.seed_group_commit(storage)
@@ -262,6 +263,7 @@ def main(argv: list[str] | None = None) -> int:
             cfg.seed_overload_protection(storage)
             cfg.seed_diagnostics(storage)
             cfg.seed_history(storage)
+            cfg.seed_heatmap(storage)
             cfg.seed_replica_read(storage)
             cfg.seed_ranges(storage)
             cfg.seed_group_commit(storage)

@@ -44,6 +44,11 @@ Debug routes:
       (TIDB_TPU_LOCK_CHECK / [analysis] lock-check): instrumented
       locks, observed acquisition edges, cycles (potential
       deadlocks), blocking-under-hot-lock events, held mirror (JSON)
+  /debug/keyviz  the keyspace heat plane ([heatmap] knobs): the
+      time x range traffic matrix, per-range totals, an ASCII
+      heatmap rendering, and the current hot-range / split-advisory
+      findings (JSON; knobs-only payload while heatmap.enabled is
+      false)
 """
 
 from __future__ import annotations
@@ -260,6 +265,23 @@ class StatusServer:
                     # other /debug routes
                     try:
                         payload = outer.sql_server.storage.history \
+                            .debug_payload()
+                    except Exception as e:  # noqa: BLE001
+                        payload = {"error": str(e)[:200]}
+                    body = json.dumps(payload).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/keyviz"):
+                    if outer.sql_server is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    # keyspace heat plane: knobs, the time x range
+                    # traffic matrix, per-range totals, the ASCII
+                    # heatmap rendering, and the current hot-range /
+                    # split-advisory findings; degrades to an error
+                    # payload like the other /debug routes
+                    try:
+                        payload = outer.sql_server.storage.heat \
                             .debug_payload()
                     except Exception as e:  # noqa: BLE001
                         payload = {"error": str(e)[:200]}

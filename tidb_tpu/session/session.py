@@ -1614,12 +1614,18 @@ class Session:
                           mem=mem)
         gov = getattr(self.storage, "governor", None)
         if gov is not None:
+            # install the tracker BEFORE registering: register() runs a
+            # synchronous pressure check, and a kill issued by it calls
+            # back into _governor_kill, whose tracker-identity guard
+            # would no-op against a not-yet-installed _live_mem — a
+            # statement admitted into an already-over-limit server must
+            # be killable at that admission-time check
+            with self._gov_lock:
+                self._live_mem = mem
             token = gov.register(
                 mem, kill=lambda: self._governor_kill(mem),
                 label=(self.in_flight_sql or "")[:256],
                 conn_id=self.conn_id or 0)
-            with self._gov_lock:
-                self._live_mem = mem
 
             def _release() -> None:
                 gov.unregister(token)

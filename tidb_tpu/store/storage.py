@@ -267,6 +267,13 @@ class Storage:
         self.history = WorkloadHistory(path=path,
                                        metrics=self.obs.metrics,
                                        events=self.obs.events)
+        # keyspace heat plane (obs_heat.py): per-range traffic matrix +
+        # hot-range detection + split advisories. Same zero-work-while-
+        # disabled contract as Top SQL / history; [heatmap] config or
+        # embedded callers arm it via heat.configure(enabled=True).
+        from ..obs_heat import RangeHeatRecorder
+        self.heat = RangeHeatRecorder(metrics=self.obs.metrics,
+                                      events=self.obs.events)
         self._tso_lease = 0
         # serializes lease-file persistence: concurrent committers both
         # crossing the extension threshold raced the SAME tmp+rename
@@ -344,7 +351,8 @@ class Storage:
             self.tso = TimestampOracle(floor=self._tso_lease)
         self.rm = RegionManager(self.kv)
         self.committer = TwoPhaseCommitter(self.rm, self.tso,
-                                           events=self.obs.events)
+                                           events=self.obs.events,
+                                           heat=self.heat)
         # wire the structured event ring into its producers: governor
         # kills, admission sheds, rpc breaker trips, WAL fsync stalls —
         # the protective/durability actions PR 4/5 added become
@@ -1084,7 +1092,9 @@ class Storage:
             floor = max(self.tso.current(), self.kv.max_commit_ts()) \
                 + (_TSO_LEASE_MS << 18)
             self.tso = SharedTSO(self.path, floor=floor)
-            self.committer = _TPC(self.rm, self.tso)
+            self.committer = _TPC(self.rm, self.tso,
+                                  events=self.obs.events,
+                                  heat=self.heat)
             # 6. owner elections are kernel flocks on our dir
             self.ddl_owner = owner_manager(self.path, "ddl")
             self.gc_owner = owner_manager(self.path, "gc")
@@ -1248,6 +1258,9 @@ class Storage:
                                  lease_ms=lease_ms,
                                  resolve_ttl_ms=resolve_ttl_ms,
                                  listen=listen)
+        # the heat matrix resolves against the authoritative table the
+        # plane just bootstrapped (first writer wins; re-seed adopts)
+        self.heat.set_specs(self.ranges.server.specs)
 
     # ---- follower read tier (rpc/apply.py + rpc/replica.py) -----------------
     def arm_replica_read(self) -> None:
