@@ -1,0 +1,639 @@
+"""Online range splits: the crash-safe split protocol and the
+heat-driven auto-split actuator, fast and in-process.
+
+Covers: split_spec/table_gaps table algebra, the leader-coordinated
+split_range protocol (journal -> meta commit -> WAL partition -> ready
+-> parent retire), deterministic recovery at each in-process failpoint
+(roll-back before the meta commit, roll-forward after), the router
+under back-to-back split storms (typed EpochNotMatch retries only —
+zero failed statements), heat-plane cell migration on split, the
+advisory -> auto-split acting loop end-to-end, the [ranges] auto-split
+zero-work/poison contract, the range-split-flap inspection rule, and
+the knob plumbing (parse/validate/seed/hot-reload + /status).
+
+The kill-9 chaos suite over real child processes lives in
+tests/test_split_chaos.py (slow-marked).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tidb_tpu import obs
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.rangemeta import (RangeSpec, split_keyspace, split_spec,
+                                   table_gaps)
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import Snapshot, TwoPhaseCommitter
+from tidb_tpu.obs_heat import RangeHeatRecorder
+from tidb_tpu.rpc.errors import RPCError
+from tidb_tpu.rpc.ranged import RangeDirectory, RangeServer
+from tidb_tpu.util import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _server(tmp_path, count=2, lease_ms=60_000, **kw):
+    return RangeServer(str(tmp_path), lease_ms=lease_ms,
+                       specs=split_keyspace(count), **kw)
+
+
+def _commit(committer, pairs: dict, tso) -> int:
+    muts = [Mutation(OP_PUT, k, v) for k, v in sorted(pairs.items())]
+    return committer.commit(muts, tso.ts())
+
+
+def _seed(tmp_path, srv, n=30):
+    """n single-key rows through the real 2PC path; returns the oracle
+    dict and the (router, committer, tso) triple."""
+    tso = TimestampOracle()
+    router = RangeRouter(root=str(tmp_path))
+    committer = TwoPhaseCommitter(router, tso)
+    oracle = {}
+    for i in range(n):
+        k = b"k%04d" % i
+        v = b"v%04d" % i
+        _commit(committer, {k: v}, tso)
+        oracle[k] = v
+    return oracle, router, committer, tso
+
+
+# ==================== table algebra ====================
+
+def test_split_spec_delta_and_validation():
+    parent = RangeSpec(1, b"a", b"z", epoch=3)
+    left, right = split_spec(parent, b"m", 7)
+    assert (left.id, left.start_key, left.end_key, left.epoch) == \
+        (1, b"a", b"m", 4)
+    assert (right.id, right.start_key, right.end_key, right.epoch) == \
+        (7, b"m", b"z", 4)
+    # the split key must fall strictly inside the parent
+    for bad in (b"a", b"z", b"", b"zz"):
+        with pytest.raises(ValueError):
+            split_spec(parent, bad, 7)
+    with pytest.raises(ValueError):
+        split_spec(parent, b"m", 1)  # child id collides with parent
+    # an unbounded parent splits fine
+    left, right = split_spec(RangeSpec(2, b"m", b""), b"q", 9)
+    assert right.end_key == b""
+
+
+def test_table_gaps_detects_every_defect():
+    ok = split_keyspace(4)
+    assert table_gaps(ok) == []
+    assert table_gaps([]) == ["empty table"]
+    # gap
+    bad = [RangeSpec(1, b"", b"a"), RangeSpec(2, b"b", b"")]
+    assert any("gap" in d for d in table_gaps(bad))
+    # overlap
+    bad = [RangeSpec(1, b"", b"c"), RangeSpec(2, b"b", b"")]
+    assert any("overlap" in d for d in table_gaps(bad))
+    # missing edges
+    bad = [RangeSpec(1, b"a", b"")]
+    assert any("-inf" in d for d in table_gaps(bad))
+    bad = [RangeSpec(1, b"", b"x")]
+    assert any("+inf" in d for d in table_gaps(bad))
+    # duplicate ids
+    bad = [RangeSpec(1, b"", b"m"), RangeSpec(1, b"m", b"")]
+    assert any("duplicate" in d for d in table_gaps(bad))
+
+
+# ==================== split mechanics ====================
+
+def test_split_range_partitions_table_and_data(tmp_path):
+    srv = _server(tmp_path)
+    try:
+        oracle, router, committer, tso = _seed(tmp_path, srv)
+        left, right = srv.split_range(1, b"k0010")
+        # table: three ranges, gap-free, children at epoch parent+1
+        specs = srv.directory.load_specs()
+        assert table_gaps(specs) == []
+        assert len(specs) == 3
+        assert (left.id, left.epoch) == (1, 2)
+        assert right.epoch == 2 and right.id == 3
+        # both children led here immediately (no lease-tick wait)
+        assert sorted(srv.hosted_ids()) == [1, 2, 3]
+        # the parent physically retired the child's half...
+        with srv._mu:
+            l_parent = srv._leaders[1]
+            l_child = srv._leaders[3]
+        assert l_parent.store.export_range(b"k0010", b"\x80") == []
+        # ...and the child holds exactly it
+        assert l_child.store.export_range(b"", b"k0010") == []
+        assert l_child.store.get(b"k0015", tso.ts()) == b"v0015"
+        # no journal left behind
+        assert srv.directory.read_split(1) is None
+        # every acked write present exactly once through the router
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"", b"", -1)) == oracle
+        # both children keep accepting writes
+        _commit(committer, {b"k0005x": b"l", b"k0020x": b"r"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"k0005x") == b"l"
+        assert snap.get(b"k0020x") == b"r"
+        # the metric moved with trigger=manual
+        assert 'tidb_range_splits_total{trigger="manual"}' \
+            in obs.PROCESS_METRICS.render()
+    finally:
+        srv.close()
+
+
+def test_split_rejects_bad_requests(tmp_path):
+    srv = _server(tmp_path)
+    try:
+        # split key outside the parent's bounds
+        with pytest.raises(RPCError):
+            srv.split_range(1, b"\x81")
+        # unknown / un-led range
+        with pytest.raises(Exception):
+            srv.split_range(99, b"k")
+        # a range already splitting refuses a second split
+        srv.directory.begin_split(1, b"\x10")
+        with pytest.raises(RPCError, match="already splitting"):
+            srv.directory.begin_split(1, b"\x20")
+    finally:
+        srv.close()
+
+
+def test_split_exception_before_meta_commit_rolls_back(tmp_path):
+    """An in-process failure BEFORE the meta rename leaves no trace:
+    the journal is withdrawn, the table keeps its pre-split shape, and
+    serving continues — the same decision the kill-9 successor takes."""
+    srv = _server(tmp_path)
+    try:
+        oracle, router, committer, tso = _seed(tmp_path, srv, n=10)
+        failpoint.enable("range/split-before-meta-commit", RuntimeError)
+        with pytest.raises(RuntimeError):
+            srv.split_range(1, b"k0005")
+        failpoint.disable("range/split-before-meta-commit")
+        assert failpoint.hits("range/split-before-meta-commit") == 1
+        specs = srv.directory.load_specs()
+        assert len(specs) == 2 and table_gaps(specs) == []
+        assert srv.directory.read_split(1) is None
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"", b"", -1)) == oracle
+        # and a later split of the same range succeeds
+        srv.split_range(1, b"k0005")
+        assert len(srv.directory.load_specs()) == 3
+    finally:
+        srv.close()
+
+
+@pytest.mark.parametrize("point", [
+    "range/split-after-meta-commit",
+    "range/split-mid-wal-partition",
+    "range/split-before-parent-retire",
+])
+def test_split_exception_after_meta_commit_rolls_forward(
+        tmp_path, point):
+    """Past the meta rename the split is committed: an in-process
+    failure at any later stage leaves a pending/ready journal that the
+    next lease tick's recovery completes — half-committed splits heal
+    without manual intervention, and acked data survives intact."""
+    srv = _server(tmp_path)
+    try:
+        oracle, router, committer, tso = _seed(tmp_path, srv, n=20)
+        failpoint.enable(point, RuntimeError)
+        with pytest.raises(RuntimeError):
+            srv.split_range(1, b"k0010")
+        failpoint.disable(point)
+        # committed but unfinished: the journal survives the failure
+        assert srv.directory.read_split(1) is not None
+        assert len(srv.directory.load_specs()) == 3
+        # recovery runs on the lease tick (the chaos suite exercises
+        # the same path on a fresh process)
+        srv._lease_tick()
+        assert srv.directory.read_split(1) is None
+        assert sorted(srv.hosted_ids()) == [1, 2, 3]
+        assert table_gaps(srv.directory.load_specs()) == []
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"", b"", -1)) == oracle
+        _commit(committer, {b"k0005y": b"l", b"k0015y": b"r"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"k0005y") == b"l"
+        assert snap.get(b"k0015y") == b"r"
+    finally:
+        srv.close()
+
+
+def test_pending_child_lease_embargo(tmp_path):
+    """A mid-split child (journal pending) must not be acquirable: its
+    data dir may be partial. Only the parent-side recovery lifts the
+    embargo by completing the split."""
+    srv = _server(tmp_path)
+    try:
+        _seed(tmp_path, srv, n=10)
+        failpoint.enable("range/split-mid-wal-partition", RuntimeError)
+        with pytest.raises(RuntimeError):
+            srv.split_range(1, b"k0005")
+        failpoint.disable("range/split-mid-wal-partition")
+        assert srv.directory.pending_children() == {3}
+        # a second server joining now must NOT lease the pending child
+        srv2 = RangeServer(str(tmp_path), lease_ms=60_000)
+        try:
+            assert 3 not in srv2.hosted_ids()
+        finally:
+            srv2.close()
+    finally:
+        srv.close()
+
+
+# ==================== router under a split storm ====================
+
+def test_router_sees_only_typed_retries_during_split_storm(tmp_path):
+    """Concurrent RangeRouter clients through back-to-back splits:
+    every statement lands exactly once inside the Backoffer budget —
+    zero failed statements, zero stale-route writes, the EpochNotMatch
+    -> reload -> retry loop proven under real concurrency."""
+    srv = _server(tmp_path)
+    failures: list = []
+    written: dict[bytes, bytes] = {}
+    stop = threading.Event()
+    tso = TimestampOracle()
+
+    def writer(wid: int):
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso)
+        i = 0
+        while not stop.is_set():
+            k = b"w%d-%04d" % (wid, i)
+            try:
+                _commit(committer, {k: b"v%d" % wid}, tso)
+                written[k] = b"v%d" % wid
+            except Exception as e:  # noqa: BLE001 — any failure flunks
+                failures.append((k, repr(e)))
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        # back-to-back splits while the writers hammer: w0* and w1*
+        # straddle each split point
+        time.sleep(0.2)
+        srv.split_range(1, b"w0-")
+        time.sleep(0.2)
+        srv.split_range(3, b"w1-")
+        time.sleep(0.2)
+        srv.split_range(4, b"w2-")
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    try:
+        assert failures == []
+        assert all(not t.is_alive() for t in threads)
+        specs = srv.directory.load_specs()
+        assert table_gaps(specs) == [] and len(specs) == 5
+        assert len(written) > 30, "writers barely ran"
+        # exactly-once: the store agrees with every acked write
+        router = RangeRouter(root=str(tmp_path))
+        snap = Snapshot(router, tso, tso.ts())
+        rows = dict(snap.scan(b"", b"", -1))
+        assert rows == written
+    finally:
+        srv.close()
+
+
+# ==================== heat-plane cell migration ====================
+
+def _hot_recorder(events=None):
+    rec = RangeHeatRecorder(events=events)
+    rec.configure(enabled=True, bucket_seconds=1, sustained_buckets=1,
+                  hot_ratio=1.5, key_sample_cap=64)
+    return rec
+
+
+def test_heat_on_split_retires_parent_cells(tmp_path):
+    """After a split the recorder must show NO phantom parent state:
+    totals/samples/streaks and every ring bucket's parent cells are
+    dropped, and both children inherit a clean window."""
+    rec = _hot_recorder()
+    specs = split_keyspace(2)
+    rec.set_specs(specs)
+    for i in range(50):
+        rec.note_range(1, write_rows=1, write_bytes=32,
+                       keys=[b"h%03d" % i])
+    assert rec.range_totals(1)[2] == 50
+    assert rec.split_advisory(1) is not None
+    post = [RangeSpec(1, b"", b"h025", 2), RangeSpec(3, b"h025",
+                                                     b"\x80", 2),
+            RangeSpec(2, b"\x80", b"", 1)]
+    rec.on_split(1, post)
+    # the parent id (now the LEFT child) starts clean — its recorded
+    # cells spanned the pre-split bounds
+    assert rec.range_totals(1) == (0, 0, 0, 0)
+    assert rec.split_advisory(1) is None
+    with rec._mu:
+        assert all(1 not in b["cells"] and 3 not in b["cells"]
+                   for b in rec._ring)
+        assert [s.id for s in rec._specs] == [1, 3, 2]
+    # no findings name a phantom range
+    assert all(f["item"] != "r1" for f in rec.findings())
+    # fresh traffic on the children accounts normally
+    rec.note_range(3, write_rows=2, write_bytes=8, keys=[b"h030"])
+    assert rec.range_totals(3)[2] == 2
+
+
+def test_split_server_migrates_heat_cells(tmp_path):
+    """The server wires on_split into split_range: leader-applied
+    traffic recorded pre-split never haunts the post-split table."""
+    rec = _hot_recorder()
+    srv = _server(tmp_path, heat=rec)
+    rec.set_specs(srv.specs)
+    try:
+        oracle, router, committer, tso = _seed(tmp_path, srv, n=20)
+        assert rec.range_totals(1)[2] > 0
+        srv.split_range(1, b"k0010")
+        assert rec.range_totals(1) == (0, 0, 0, 0)
+        with rec._mu:
+            assert [s.id for s in rec._specs] == [1, 3, 2]
+        # post-split traffic lands on the children's own cells
+        _commit(committer, {b"k0001z": b"v"}, tso)
+        _commit(committer, {b"k0015z": b"v"}, tso)
+        assert rec.range_totals(1)[2] == 1
+        assert rec.range_totals(3)[2] == 1
+    finally:
+        srv.close()
+
+
+# ==================== the acting loop ====================
+
+def test_auto_split_acting_loop_end_to_end(tmp_path):
+    """ISSUE 19's closed loop, no manual intervention: skewed writes on
+    a real multi-range store -> heat advisory -> auto-split at the
+    advised weighted-median key -> range_split event with trigger=auto
+    -> both children independently leased and serving."""
+    events = obs.EventLog()
+    rec = _hot_recorder(events=events)
+    srv = _server(tmp_path, lease_ms=200, events=events, heat=rec,
+                  auto_split=True, split_cooldown_ms=0)
+    rec.set_specs(srv.specs)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso)
+        written = {}
+        deadline = time.monotonic() + 30.0
+        i = 0
+        while time.monotonic() < deadline \
+                and len(srv.directory.load_specs()) < 3:
+            k = b"hot%04d" % (i % 64)
+            _commit(committer, {k: b"x" * 32}, tso)
+            written[k] = b"x" * 32
+            i += 1
+        specs = srv.directory.load_specs()
+        assert len(specs) == 3, "the actuator never fired"
+        assert table_gaps(specs) == []
+        # the tick thread bumps the counter just after the split lands
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and srv._auto_splits == 0:
+            time.sleep(0.02)
+        assert srv._auto_splits == 1
+        # the structured event: parent, children, epoch, advised key
+        # digest, trigger
+        evs = [e for e in events.snapshot()
+               if e["kind"] == "range_split"]
+        assert len(evs) == 1
+        d = evs[0]["detail"]
+        assert d.startswith("r1 -> r1+r3 at ")
+        assert "trigger=auto" in d and "advisory=" in d
+        assert "epoch=2" in d
+        # the metric moved with trigger=auto
+        assert 'tidb_range_splits_total{trigger="auto"}' \
+            in obs.PROCESS_METRICS.render()
+        # both children leased here and serving: write to each side
+        assert sorted(srv.hosted_ids()) == [1, 2, 3]
+        _commit(committer, {b"hot0000z": b"l", b"hoz": b"r"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"hot", b"hp", -1)) == \
+            written | {b"hot0000z": b"l", b"hoz": b"r"}
+    finally:
+        srv.close()
+
+
+def test_auto_split_cooldown_and_cap(tmp_path):
+    srv = _server(tmp_path, auto_split=True, split_cooldown_ms=3_600_000,
+                  max_auto_splits=4, heat=_hot_recorder())
+    try:
+        # a recent auto-split inside the cooldown: the tick does nothing
+        srv._last_auto_split_ms = time.time() * 1000.0
+        srv.heat.findings = lambda: (_ for _ in ()).throw(
+            AssertionError("tick consulted findings inside cooldown"))
+        srv._auto_split_tick()
+        # the lifetime cap stops the actuator even with cooldown clear
+        srv._last_auto_split_ms = 0.0
+        srv._auto_splits = srv.max_auto_splits
+        srv._auto_split_tick()
+    finally:
+        srv.close()
+
+
+def test_auto_split_disabled_is_zero_work(tmp_path):
+    """The [ranges] auto-split=false default does NO actuator work —
+    poison-pinned like the heatmap contract: every surface the actuator
+    would touch raises, and the lease tick still runs clean. Splits
+    never occur spontaneously."""
+    rec = _hot_recorder()
+    srv = _server(tmp_path, heat=rec, auto_split=False)
+    try:
+        _seed(tmp_path, srv, n=10)
+
+        def _poison(*a, **k):
+            raise AssertionError("actuator worked while disabled")
+
+        rec.findings = _poison
+        rec.split_advisory = _poison
+        srv.split_range = _poison
+        for _ in range(3):
+            srv._lease_tick()
+        assert len(srv.directory.load_specs()) == 2
+        # flipping the knob on is what arms the tick (hot reload path)
+        assert srv.auto_split is False
+    finally:
+        srv.close()
+
+
+def test_split_failpoint_declared_and_auto_site_fires(tmp_path):
+    """Every range/split-* + actuator failpoint is DECLARED, and the
+    actuator's own site fires on the acting path."""
+    for name in ("range/split-before-meta-commit",
+                 "range/split-after-meta-commit",
+                 "range/split-mid-wal-partition",
+                 "range/split-before-parent-retire",
+                 "range/auto-split"):
+        assert name in failpoint.DECLARED, name
+    rec = _hot_recorder()
+    srv = _server(tmp_path, heat=rec, auto_split=True,
+                  split_cooldown_ms=0)
+    rec.set_specs(srv.specs)
+    try:
+        tso = TimestampOracle()
+        router = RangeRouter(root=str(tmp_path))
+        committer = TwoPhaseCommitter(router, tso)
+        # arm the actuator site to ABORT the split: proves the hook
+        # sits before any state change
+        failpoint.enable("range/auto-split", RPCError("vetoed"))
+        deadline = time.monotonic() + 20.0
+        i = 0
+        while time.monotonic() < deadline \
+                and failpoint.hits("range/auto-split") == 0:
+            _commit(committer, {b"fp%04d" % (i % 64): b"v"}, tso)
+            i += 1
+            srv._auto_split_tick()
+        assert failpoint.hits("range/auto-split") >= 1
+        assert len(srv.directory.load_specs()) == 2, \
+            "vetoed auto-split still executed"
+    finally:
+        srv.close()
+
+
+# ==================== inspection: range-split-flap ====================
+
+def test_range_split_flap_rule(tmp_path):
+    from tidb_tpu.obs_inspect import RULES, lint_rules
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+
+    assert lint_rules() == []
+    assert "range-split-flap" in RULES
+    st = Storage()
+    s = Session(st)
+    thr = st.diagnostics.split_flap_threshold
+    # one honest split: silent
+    st.obs.events.record("range_split",
+                         "r1 -> r1+r3 at 6b30 epoch=2 trigger=auto")
+    rows = [r for r in s.execute(
+        "select rule, item, value from "
+        "information_schema.inspection_result").rows
+        if r[0] == "range-split-flap"]
+    assert rows == []
+    # a flapping range: threshold splits inside the window
+    for t in range(thr):
+        st.obs.events.record(
+            "range_split",
+            f"r1 -> r1+r{4 + t} at 6b3{t} epoch={3 + t} trigger=auto")
+    rows = [r for r in s.execute(
+        "select rule, item, value from "
+        "information_schema.inspection_result").rows
+        if r[0] == "range-split-flap"]
+    assert rows and rows[0][1] == "r1"
+    assert int(rows[0][2]) >= thr
+    # threshold 0 disables the rule
+    st.diagnostics.split_flap_threshold = 0
+    st.diagnostics._status_cache = None
+    rows = [r for r in s.execute(
+        "select rule from information_schema.inspection_result").rows
+        if r[0] == "range-split-flap"]
+    assert rows == []
+    st.close()
+
+
+# ==================== knobs ====================
+
+def _load_cfg(tmp_path, text):
+    from tidb_tpu.config import Config
+    p = tmp_path / "cfg.toml"
+    p.write_text(text)
+    return Config.load(str(p))
+
+
+def test_split_knobs_parse_validate_seed_and_status(tmp_path):
+    from tidb_tpu.config import Config, ConfigError
+
+    cfg = _load_cfg(tmp_path, f"""
+path = "{tmp_path / 'store'}"
+
+[ranges]
+enabled = true
+count = 2
+auto-split = true
+split-cooldown-ms = 250
+max-auto-splits = 9
+
+[diagnostics]
+split-flap-threshold = 5
+split-flap-window-s = 60
+""")
+    cfg.validate()
+    assert cfg.ranges.auto_split is True
+    assert cfg.ranges.split_cooldown_ms == 250
+    assert cfg.ranges.max_auto_splits == 9
+    assert cfg.diagnostics.split_flap_threshold == 5
+    assert cfg.diagnostics.split_flap_window_s == 60
+    for bad in ("[ranges]\nsplit-cooldown-ms = -1\n",
+                "[ranges]\nmax-auto-splits = -2\n",
+                "[diagnostics]\nsplit-flap-threshold = -1\n",
+                "[diagnostics]\nsplit-flap-window-s = -5\n"):
+        with pytest.raises(ConfigError):
+            _load_cfg(tmp_path, bad).validate()
+    # the reloadable subset includes the actuator knobs
+    assert {"ranges.auto_split", "ranges.split_cooldown_ms",
+            "ranges.max_auto_splits"} <= Config.RELOADABLE
+
+    # seed -> server fields -> /status; re-seed applies live
+    from tidb_tpu.store.storage import Storage
+    st = Storage(path=str(tmp_path / "store"))
+    try:
+        cfg.seed_ranges(st)
+        assert st.ranges is not None
+        assert st.ranges.server.auto_split is True
+        assert st.ranges.server.split_cooldown_ms == 250
+        assert st.ranges.server.max_auto_splits == 9
+        status = st.ranges.status()
+        assert status["auto_split"] is True
+        assert status["split_cooldown_ms"] == 250
+        assert status["max_auto_splits"] == 9
+        cfg.ranges.auto_split = False
+        cfg.ranges.split_cooldown_ms = 990
+        cfg.seed_ranges(st)
+        assert st.ranges.server.auto_split is False
+        assert st.ranges.server.split_cooldown_ms == 990
+    finally:
+        st.close()
+
+
+def test_diagnostics_split_flap_knobs_seed(tmp_path):
+    from tidb_tpu.store.storage import Storage
+
+    cfg = _load_cfg(
+        tmp_path,
+        "[diagnostics]\nsplit-flap-threshold = 7\n"
+        "split-flap-window-s = 11\n")
+    st = Storage()
+    try:
+        cfg.seed_diagnostics(st)
+        assert st.diagnostics.split_flap_threshold == 7
+        assert st.diagnostics.split_flap_window_s == 11
+    finally:
+        st.close()
+
+
+def test_split_metric_family_registered_and_lint_clean():
+    text = obs.PROCESS_METRICS.render()
+    assert "tidb_range_splits_total" in text
+    assert obs.lint_metrics([obs.PROCESS_METRICS]) == []
+    # and the family is queryable through the metrics_schema tier
+    from tidb_tpu.session import Session
+    from tidb_tpu.store.storage import Storage
+    obs.RANGE_SPLITS.inc(trigger="manual")
+    st = Storage()
+    try:
+        rows = Session(st).execute(
+            "select labels, value from "
+            "metrics_schema.tidb_range_splits_total").rows
+        assert any(r[0] == 'trigger="manual"' and r[1] >= 1
+                   for r in rows)
+    finally:
+        st.close()

@@ -1,0 +1,295 @@
+"""Mid-split kill-9 chaos harness: REAL child processes, REAL exit(9).
+
+The acceptance suite for the online split protocol (rpc/ranged.py
+begin_split/_finish_split + kv/rangemeta.py split_spec/table_gaps):
+a range-leader child dies by os._exit(9) at each env-armed split
+failpoint — range/split-before-meta-commit (journal written, table
+uncommitted), range/split-after-meta-commit (table committed, child
+WAL empty), range/split-mid-wal-partition (child WAL half-copied),
+range/split-before-parent-retire (child ready, parent still holds
+both halves) — while concurrent writers straddle the split key.
+Invariants asserted against an uncrashed oracle:
+
+  * the keyspace stays gap-free and overlap-free through every crash
+    (table_gaps on the recovered meta == []);
+  * the half-committed split resolves DETERMINISTICALLY: a death
+    before the meta rename rolls back (journal withdrawn, pre-split
+    table), any later death rolls forward (successor completes the
+    WAL partition and parent retire);
+  * every acknowledged write is present exactly once after takeover —
+    no failed statements, no doubly-applied statements;
+  * repeated kill/recover is idempotent: a SECOND leader killed
+    mid-recovery leaves a state a third completes from.
+
+Fast in-process protocol tests live in tests/test_split.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.rangemeta import table_gaps
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import Snapshot, TwoPhaseCommitter
+from tidb_tpu.rpc.client import RpcClient, RpcOptions
+from tidb_tpu.rpc.ranged import RangeDirectory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPLIT_KEY = b"\x40"
+
+LEADER_SRC = """
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+kw = json.loads(os.environ["TIDB_TPU_RANGE_KW"])
+from tidb_tpu.kv.rangemeta import split_keyspace
+from tidb_tpu.rpc.ranged import RangeServer
+srv = RangeServer(kw["root"], lease_ms=kw.get("lease_ms", 500),
+                  specs=split_keyspace(kw.get("count", 2)))
+print(f"PORT={{srv.address}}", flush=True)
+signal.pause()
+"""
+
+
+def _spawn_leader(root: str, lease_ms: int = 500, failpoints: str = "",
+                  may_die_in_startup: bool = False):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "TIDB_TPU_RANGE_KW": json.dumps(
+               {"root": root, "lease_ms": lease_ms, "count": 2})}
+    env.pop("TIDB_TPU_FAILPOINTS", None)
+    if failpoints:
+        env["TIDB_TPU_FAILPOINTS"] = failpoints
+    proc = subprocess.Popen(
+        [sys.executable, "-c", LEADER_SRC.format(repo=REPO)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    deadline = time.time() + 120
+    addr = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT="):
+            addr = line.strip().split("=", 1)[1]
+            break
+        if proc.poll() is not None:
+            if may_die_in_startup:
+                # an armed recovery failpoint can fire on the FIRST
+                # lease tick, inside the constructor — that death is
+                # the scenario, not a harness failure
+                return proc, addr
+            raise RuntimeError("range leader died during startup")
+    assert addr, "leader did not report its address"
+    return proc, addr
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        p.wait(timeout=15)
+        if p.stdout:
+            p.stdout.close()
+
+
+def _wait_owner(root: str, rid: int, addr: str, timeout_s: float = 30.0):
+    d = RangeDirectory(root)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        g = d.read_grant(rid)
+        if g and g.get("owner") == addr \
+                and float(g.get("expires_ms", 0)) > time.time() * 1000:
+            return g
+        time.sleep(0.1)
+    raise AssertionError(f"range {rid} never moved to {addr}")
+
+
+def _wait_split_settled(root: str, want_ranges: int,
+                        timeout_s: float = 60.0):
+    """Block until the split journal is gone and the table holds
+    exactly `want_ranges` gap-free ranges."""
+    d = RangeDirectory(root)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        specs = d.load_specs()
+        if d.read_split(1) is None and len(specs) == want_ranges \
+                and table_gaps(specs) == []:
+            return specs
+        time.sleep(0.1)
+    specs = d.load_specs()
+    raise AssertionError(
+        f"split never settled: journal={d.read_split(1)} "
+        f"ranges={len(specs)} gaps={table_gaps(specs)}")
+
+
+def _commit(committer, pairs: dict, tso) -> int:
+    muts = [Mutation(OP_PUT, k, v) for k, v in sorted(pairs.items())]
+    return committer.commit(muts, tso.ts())
+
+
+def _fire_split(addr: str, split_key: bytes = SPLIT_KEY):
+    """Trigger the operator split RPC; the armed leader dies mid-call,
+    so any transport/typed error is expected — the assertions live in
+    the recovered on-disk state, not the doomed response."""
+    cli = RpcClient(addr, RpcOptions(connect_timeout_ms=2000,
+                                     request_timeout_ms=20_000),
+                    _heartbeat=False)
+    try:
+        return cli.call("range_split", range_id=1, split_key=split_key)
+    except Exception:  # noqa: BLE001 — death mid-RPC is the point
+        return None
+    finally:
+        cli.close()
+
+
+STAGES = [
+    # (failpoint armed on the leader, ranges after recovery)
+    ("range/split-before-meta-commit", 2),   # rolls BACK
+    ("range/split-after-meta-commit", 3),    # rolls forward
+    ("range/split-mid-wal-partition", 3),    # rolls forward
+    ("range/split-before-parent-retire", 3), # rolls forward
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage,want_ranges", STAGES)
+def test_kill9_mid_split_each_stage(tmp_path, stage, want_ranges):
+    """The leader dies by os._exit(9) at each split stage while
+    writers hammer both sides of the split key. The standby resolves
+    the half-committed split deterministically (back before the meta
+    rename, forward after), the keyspace stays gap/overlap-free, and
+    every acked write survives exactly once."""
+    root = str(tmp_path)
+    armed, armed_addr = _spawn_leader(root,
+                                      failpoints=f"{stage}=exit(9)@1")
+    standby, standby_addr = _spawn_leader(root)
+    router = RangeRouter(root=root, budget_ms=60_000)
+    acked: dict[bytes, bytes] = {}
+    failures: list = []
+    stop = threading.Event()
+    tso = TimestampOracle()
+
+    def writer(wid: int, prefix: bytes):
+        w_router = RangeRouter(root=root, budget_ms=60_000)
+        committer = TwoPhaseCommitter(w_router, tso, lock_ttl=2000)
+        i = 0
+        try:
+            while not stop.is_set():
+                k = prefix + b"-w%d-%04d" % (wid, i)
+                try:
+                    _commit(committer, {k: b"v%d" % wid}, tso)
+                    acked[k] = b"v%d" % wid
+                except Exception as e:  # noqa: BLE001
+                    failures.append((k, repr(e)))
+                    return
+                i += 1
+                time.sleep(0.01)
+        finally:
+            w_router.close()
+    # writers straddle the split key: \x10* lands left of \x40, \x60*
+    # right of it (both inside pre-split range 1)
+    threads = [threading.Thread(target=writer, args=(0, b"\x10")),
+               threading.Thread(target=writer, args=(1, b"\x60"))]
+    try:
+        for rid in (1, 2):
+            _wait_owner(root, rid, armed_addr)
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # let some pre-split acks accumulate
+        _fire_split(armed_addr)
+        assert armed.wait(timeout=30) == 9, "died AT the failpoint"
+        # the standby inherits the parent and resolves the journal
+        _wait_owner(root, 1, standby_addr)
+        specs = _wait_split_settled(root, want_ranges)
+        # writers ride through the crash inside the Backoffer budget
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=90)
+        assert failures == [], f"failed statements: {failures[:3]}"
+        assert all(not t.is_alive() for t in threads)
+        assert len(acked) > 10, "writers barely ran"
+        # exactly-once vs the uncrashed oracle
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"", b"\x80", -1)) == acked
+        # and both sides keep accepting writes post-recovery
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=2000)
+        _commit(committer, {b"\x10post": b"l", b"\x60post": b"r"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10post") == b"l"
+        assert snap.get(b"\x60post") == b"r"
+        if want_ranges == 2:
+            assert {s.id for s in specs} == {1, 2}
+        else:
+            assert {s.id for s in specs} == {1, 2, 3}
+            by_id = {s.id: s for s in specs}
+            assert by_id[1].end_key == SPLIT_KEY
+            assert by_id[3].start_key == SPLIT_KEY
+            assert by_id[1].epoch == by_id[3].epoch == 2
+    finally:
+        stop.set()
+        for t in threads:
+            if t.is_alive():
+                t.join(timeout=90)
+        router.close()
+        _reap([armed, standby])
+
+
+@pytest.mark.slow
+def test_kill9_twice_recovery_is_idempotent(tmp_path):
+    """Kill the leader mid-split, then kill the RECOVERING successor
+    mid-WAL-partition: a third, unarmed leader still converges to the
+    same committed split. Proves _finish_split is an idempotent
+    roll-forward, not a one-shot."""
+    root = str(tmp_path)
+    a, a_addr = _spawn_leader(
+        root, failpoints="range/split-after-meta-commit=exit(9)@1")
+    router = RangeRouter(root=root, budget_ms=60_000)
+    b = c = None
+    try:
+        tso = TimestampOracle()
+        committer = TwoPhaseCommitter(router, tso, lock_ttl=2000)
+        for rid in (1, 2):
+            _wait_owner(root, rid, a_addr)
+        oracle = {}
+        for i in range(24):  # both sides of the split key
+            k = (b"\x10" if i % 2 else b"\x60") + b"seed%02d" % i
+            _commit(committer, {k: b"v%02d" % i}, tso)
+            oracle[k] = b"v%02d" % i
+        _fire_split(a_addr)
+        assert a.wait(timeout=30) == 9
+        d = RangeDirectory(root)
+        assert len(d.load_specs()) == 3  # meta committed pre-death
+        assert d.read_split(1) is not None
+        # successor B dies INSIDE recovery, half way through copying
+        # the child's WAL
+        b, b_addr = _spawn_leader(
+            root, failpoints="range/split-mid-wal-partition=exit(9)@1",
+            may_die_in_startup=True)
+        assert b.wait(timeout=60) == 9, \
+            "successor never reached recovery"
+        assert d.read_split(1) is not None  # still half-committed
+        # third leader, unarmed: recovery completes from any state
+        c, c_addr = _spawn_leader(root)
+        _wait_owner(root, 1, c_addr)
+        specs = _wait_split_settled(root, 3)
+        assert {s.id for s in specs} == {1, 2, 3}
+        # acked data exactly once, both children serving
+        snap = Snapshot(router, tso, tso.ts())
+        assert dict(snap.scan(b"", b"\x80", -1)) == oracle
+        _commit(committer, {b"\x10fin": b"l", b"\x60fin": b"r"}, tso)
+        snap = Snapshot(router, tso, tso.ts())
+        assert snap.get(b"\x10fin") == b"l"
+        assert snap.get(b"\x60fin") == b"r"
+    finally:
+        router.close()
+        _reap([a] + [p for p in (b, c) if p is not None])

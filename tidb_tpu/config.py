@@ -180,6 +180,14 @@ class DiagnosticsConfig:
     # one range changing write leadership this many times in the
     # window fires range-leader-flap (a clean failover is ONE transfer)
     range_flap_threshold: int = 3
+    # one range SPLITTING this many times inside split-flap-window-s
+    # fires range-split-flap (the salted/monotonic hot-key symptom
+    # splitting cannot fix); 0 disables the rule
+    split_flap_threshold: int = 3
+    # seconds of range_split history the split-flap rule considers
+    # (its own window: splits are cooldown-paced, so the shared
+    # history window is usually too short); 0 = the shared window
+    split_flap_window_s: int = 300
     # dominant-wait: a digest spending at least this fraction of its
     # wall time blocked in backoff.* or lease_wait is a finding
     # (needs performance.wait-profile-enabled for data to exist)
@@ -285,6 +293,18 @@ class RangesConfig:
     resolve_ttl_ms: int = 3000
     # the range RPC listener bind (restart-only)
     listen: str = "127.0.0.1:0"
+    # heat-driven auto-split actuator: act on range-split-advisory
+    # findings by splitting at the advised weighted-median key. Off
+    # (the default) the lease tick does ZERO actuator work — splits
+    # never occur spontaneously (hot-reloadable)
+    auto_split: bool = False
+    # minimum quiet time between auto-splits — paces a hot workload
+    # instead of shattering the keyspace (hot-reloadable)
+    split_cooldown_ms: int = 10000
+    # lifetime cap on actuator-triggered splits per server process, a
+    # runaway-advisory backstop; manual range_split RPCs are never
+    # counted or capped (hot-reloadable)
+    max_auto_splits: int = 4
 
 
 @dataclass
@@ -616,6 +636,18 @@ class Config:
             raise ConfigError("ranges.lease-ms must be >= 50")
         if rg.resolve_ttl_ms < 1:
             raise ConfigError("ranges.resolve-ttl-ms must be >= 1")
+        if rg.split_cooldown_ms < 0:
+            raise ConfigError("ranges.split-cooldown-ms must be >= 0")
+        if rg.max_auto_splits < 0:
+            raise ConfigError("ranges.max-auto-splits must be >= 0")
+        if self.diagnostics.split_flap_threshold < 0:
+            raise ConfigError(
+                "diagnostics.split-flap-threshold must be >= 0 "
+                "(0 disables the rule)")
+        if self.diagnostics.split_flap_window_s < 0:
+            raise ConfigError(
+                "diagnostics.split-flap-window-s must be >= 0 "
+                "(0 = the shared history window)")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -706,6 +738,12 @@ class Config:
         # plane or reshaping the table stays restart-only
         "ranges.lease_ms",
         "ranges.resolve_ttl_ms",
+        # the auto-split actuator toggles/tunes live: arming it to
+        # chase a hot range mid-incident (or disarming a runaway one)
+        # must not need a restart
+        "ranges.auto_split",
+        "ranges.split_cooldown_ms",
+        "ranges.max_auto_splits",
     })
 
     def hot_reload(self, path: str) -> list[str]:
@@ -845,6 +883,8 @@ class Config:
         st.row_eval_threshold = d.row_eval_threshold
         st.apply_lag_warn_ms = d.apply_lag_warn_ms
         st.range_flap_threshold = d.range_flap_threshold
+        st.split_flap_threshold = d.split_flap_threshold
+        st.split_flap_window_s = d.split_flap_window_s
         st.dominant_wait_threshold = d.dominant_wait_threshold
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
@@ -895,7 +935,9 @@ class Config:
         storage.arm_ranges(
             enabled=rg.enabled, count=rg.count, split_points=points,
             lease_ms=rg.lease_ms, resolve_ttl_ms=rg.resolve_ttl_ms,
-            listen=rg.listen)
+            listen=rg.listen, auto_split=rg.auto_split,
+            split_cooldown_ms=rg.split_cooldown_ms,
+            max_auto_splits=rg.max_auto_splits)
 
     def seed_group_commit(self, storage) -> None:
         """Apply the [storage] group-commit batching knobs to the
@@ -1291,6 +1333,14 @@ apply-lag-warn-ms = 2000
 # one range changing write leadership this many times in the window
 # fires range-leader-flap (a clean failover is ONE transfer)
 range-flap-threshold = 3
+# one range SPLITTING this many times inside split-flap-window-s fires
+# range-split-flap (the salted/monotonic hot-key symptom splitting
+# cannot fix); 0 disables the rule
+split-flap-threshold = 3
+# seconds of range_split history the split-flap rule considers (its
+# own window: splits are cooldown-paced, so the shared history window
+# is usually too short); 0 = the shared window
+split-flap-window-s = 300
 # a digest spending at least this fraction of its wall time blocked in
 # backoff.* or lease_wait fires dominant-wait (needs
 # performance.wait-profile-enabled for the data to exist)
@@ -1352,7 +1402,8 @@ prefer-follower = false
 # nothing: single-range deployments run the exact pre-range commit
 # path. Surfaces: information_schema.cluster_info type='range' rows,
 # /status "ranges", tidb_range_{leaders,transfers_total,
-# orphan_resolutions_total}, the range-leader-flap inspection rule.
+# orphan_resolutions_total,splits_total}, the range-leader-flap and
+# range-split-flap inspection rules.
 enabled = false
 # initial range table (written once, first writer wins; restart-only):
 # `count` even single-byte-prefix splits, or explicit comma-separated
@@ -1369,6 +1420,19 @@ lease-ms = 1000
 resolve-ttl-ms = 3000
 # the range RPC listener bind (restart-only)
 listen = "127.0.0.1:0"
+# heat-driven auto-split actuator: act on range-split-advisory findings
+# (needs heatmap.enabled) by splitting the hot range online at the
+# advised weighted-median key. Off (the default) the lease tick does
+# ZERO actuator work — splits never occur spontaneously
+# (hot-reloadable)
+auto-split = false
+# minimum quiet time between auto-splits — paces a hot workload instead
+# of shattering the keyspace (hot-reloadable)
+split-cooldown-ms = 10000
+# lifetime cap on actuator-triggered splits per server process, a
+# runaway-advisory backstop; manual range_split RPCs are never counted
+# or capped (hot-reloadable)
+max-auto-splits = 4
 
 [heatmap]
 # Keyspace heat plane (information_schema.tidb_hot_ranges /

@@ -119,7 +119,11 @@ class RangeRouter:
                     "range_table", _budget_ms=self.attempt_budget_ms)
             except RPCError:
                 continue
-            specs = [RangeSpec.from_wire(d) for d in r.get("specs", [])]
+            # sorted defensively: locate_spec bisects, and a split
+            # inserts the new child mid-table
+            specs = sorted((RangeSpec.from_wire(d)
+                            for d in r.get("specs", [])),
+                           key=lambda s: s.start_key)
             if not specs:
                 continue
             grants = {int(k): dict(v)

@@ -76,4 +76,53 @@ def locate_spec(specs: list[RangeSpec], key: bytes) -> RangeSpec:
     return s
 
 
-__all__ = ["RangeSpec", "split_keyspace", "locate_spec"]
+def split_spec(parent: RangeSpec, split_key: bytes,
+               child_id: int) -> tuple[RangeSpec, RangeSpec]:
+    """One online split's table delta: the parent keeps its id as the
+    LEFT child [start, split_key) (so its metric/heat series never
+    turns into a phantom id), a fresh id takes [split_key, end), and
+    BOTH carry epoch parent+1 — any request stamped with the parent's
+    pre-split epoch is answered EpochNotMatchError and re-routes
+    (reference: the region-split epoch bump, region_cache.go:274)."""
+    if not (parent.start_key < split_key
+            and (not parent.end_key or split_key < parent.end_key)):
+        raise ValueError(
+            f"split key {split_key!r} not strictly inside "
+            f"[{parent.start_key!r}, {parent.end_key!r})")
+    if int(child_id) == int(parent.id):
+        raise ValueError("child id must differ from the parent's")
+    epoch = int(parent.epoch) + 1
+    left = RangeSpec(parent.id, parent.start_key, split_key, epoch)
+    right = RangeSpec(int(child_id), split_key, parent.end_key, epoch)
+    return left, right
+
+
+def table_gaps(specs: list[RangeSpec]) -> list[str]:
+    """Coverage defects in a (sorted) range table: gaps, overlaps, a
+    missing -inf/+inf edge, duplicate ids. Empty list = the table
+    covers the whole keyspace exactly once — the invariant every
+    split must preserve and the chaos suite asserts after a kill."""
+    out: list[str] = []
+    if not specs:
+        return ["empty table"]
+    specs = sorted(specs, key=lambda s: s.start_key)
+    ids = [s.id for s in specs]
+    if len(set(ids)) != len(ids):
+        out.append(f"duplicate range ids: {sorted(ids)}")
+    if specs[0].start_key != b"":
+        out.append(f"keyspace starts at "
+                   f"{specs[0].start_key!r}, not -inf")
+    if specs[-1].end_key != b"":
+        out.append(f"keyspace ends at {specs[-1].end_key!r}, not +inf")
+    for a, b in zip(specs, specs[1:]):
+        if not a.end_key or a.end_key > b.start_key:
+            out.append(f"r{a.id}/r{b.id} overlap at "
+                       f"{b.start_key!r}")
+        elif a.end_key < b.start_key:
+            out.append(f"gap between r{a.id} and r{b.id}: "
+                       f"[{a.end_key!r}, {b.start_key!r})")
+    return out
+
+
+__all__ = ["RangeSpec", "split_keyspace", "locate_spec", "split_spec",
+           "table_gaps"]

@@ -214,17 +214,26 @@ class TwoPhaseCommitter:
 
     # ---- helpers -----------------------------------------------------------
     def _run_batches(self, mutations, primary, resolver, fn) -> None:
-        groups: dict[int, tuple[Region, list[Mutation]]] = {}
-        for m in mutations:
-            r = self.rm.locate(m.key)
-            groups.setdefault(r.id, (r, []))[1].append(m)
-        ordered = sorted(
-            groups.values(),
-            key=lambda g: 0 if any(m.key == primary for m in g[1]) else 1)
-        for region, batch in ordered:
-            self._retry(
-                lambda reg=region, b=batch: fn(reg, b),
-                [m.key for m in batch], resolver)
+        """Group by region, primary's batch first — re-locating and
+        re-grouping on EVERY attempt: an online split moves keys to a
+        fresh region/epoch mid-flight, and retrying with the handle
+        that just answered EpochNotMatch would exhaust the budget
+        without ever seeing the reloaded table. Re-sending an already-
+        applied batch is safe — prewrite/commit/rollback are all
+        idempotent per (key, start_ts) (see mvcc._prewrite_check)."""
+        def attempt():
+            groups: dict[int, tuple[Region, list[Mutation]]] = {}
+            for m in mutations:
+                r = self.rm.locate(m.key)
+                groups.setdefault(r.id, (r, []))[1].append(m)
+            ordered = sorted(
+                groups.values(),
+                key=lambda g: 0 if any(m.key == primary
+                                       for m in g[1]) else 1)
+            for region, batch in ordered:
+                fn(region, batch)
+
+        self._retry(attempt, [m.key for m in mutations], resolver)
 
     def _retry_region(self, key: bytes, resolver, fn) -> None:
         self._retry(lambda: fn(self.rm.locate(key)), [key], resolver)
@@ -295,6 +304,8 @@ class Snapshot:
         for _ in range(12):
             try:
                 return self.rm.scan(start, end, self.read_ts, limit)
+            except RegionError:
+                continue  # split/reload mid-scan: routing refreshed
             except KeyIsLockedError as e:
                 if not self._resolver.resolve(e.lock):
                     time.sleep(backoff)

@@ -1027,6 +1027,10 @@ RANGE_ORPHAN_RESOLUTIONS = PROCESS_METRICS.counter(
     "tidb_range_orphan_resolutions_total",
     "orphan percolator locks rolled forward or back via primary-status "
     "check after a coordinator crash")
+RANGE_SPLITS = PROCESS_METRICS.counter(
+    "tidb_range_splits_total",
+    "online range splits completed, by trigger (manual = operator "
+    "range_split RPC, auto = heat-advisory actuator)")
 
 # wait-state attribution plane (typed per-statement wait ledger):
 # process-wide like the breaker counters — Backoffer/RpcClient/SyncPolicy

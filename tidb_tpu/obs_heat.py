@@ -182,6 +182,32 @@ class RangeHeatRecorder:
         with self._mu:
             self._specs = sorted(specs, key=lambda s: s.start_key)
 
+    def on_split(self, parent_rid: int, specs) -> None:
+        """Cell migration for one completed range split: adopt the
+        post-split table and retire the parent's recorded state —
+        its cells/totals/samples span the PRE-split bounds, which no
+        live range has, so carrying them forward would hand one child
+        phantom heat (and keyviz/hot-range phantom parent rows). Both
+        children start with a clean window; the hot workload refills
+        it within a bucket. Runs on the maintenance path (split/lease
+        tick), never per statement — and touches none of the note-path
+        internals, so it is safe even on a disabled recorder."""
+        parent_rid = int(parent_rid)
+        with self._mu:
+            if specs:
+                self._specs = sorted(specs, key=lambda s: s.start_key)
+            live = {s.id for s in self._specs}
+            doomed = ({rid for rid in self._totals if rid not in live}
+                      | {parent_rid})
+            for rid in doomed:
+                self._totals.pop(rid, None)
+                self._samples.pop(rid, None)
+                self._streak.pop(rid, None)
+                self._fired.discard(rid)
+            for bucket in self._ring:
+                for rid in doomed:
+                    bucket["cells"].pop(rid, None)
+
     # ==================== the note hot path ====================
     def note_read(self, key: bytes, rows: int, nbytes: int) -> None:
         """One point read: route the key, account one cell."""

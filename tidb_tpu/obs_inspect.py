@@ -67,6 +67,14 @@ class DiagnosticsState:
     # one range changing write leadership this many times in the
     # window is flapping (a clean failover is ONE transfer)
     range_flap_threshold: int = 3
+    # one range SPLITTING this many times inside split-flap-window-s
+    # is flapping: the advisory keeps firing without draining the
+    # heat — the salted/monotonic hot-key symptom splits cannot fix
+    split_flap_threshold: int = 3
+    # seconds of range_split history the split-flap rule considers
+    # (its own window, not history-windows: splits are rare and
+    # cooldown-paced, so the shared window is usually too short)
+    split_flap_window_s: int = 300
     row_eval_threshold: int = 1          # per-row registry rows/window
     # a serving replica's apply lag past this is a follower-apply-lag
     # warning; critical at 3x (the replica stopped advancing); 0 off
@@ -495,6 +503,42 @@ def _r_range_leader_flap(ctx: InspectionContext) -> list[Finding]:
             f"inside {ctx.window_s:.0f}s (threshold "
             f"{ctx.cfg.range_flap_threshold}); last: "
             f"{evs[-1]['detail'][:200]}"))
+    return out
+
+
+@rule("range-split-flap", "warning",
+      "diagnostics.split-flap-threshold / split-flap-window-s — one "
+      "range kept splitting inside the window: the heat advisory "
+      "keeps firing without the split draining the hotspot (the "
+      "salted/monotonic hot-key symptom); splitting cannot help — "
+      "fix the key design or raise ranges.split-cooldown-ms "
+      "(tidb_events kind=range_split, tidb_range_splits_total)")
+def _r_range_split_flap(ctx: InspectionContext) -> list[Finding]:
+    thr = int(ctx.cfg.split_flap_threshold)
+    if thr <= 0:
+        return []
+    # splits are cooldown-paced, so the rule carries its OWN window
+    # (split-flap-window-s) instead of the shared history window
+    win = float(ctx.cfg.split_flap_window_s) or ctx.window_s
+    cutoff = ctx.now - win
+    splits = [e for e in ctx.events
+              if e["kind"] == "range_split"
+              and e.get("unix", 0.0) >= cutoff]
+    if len(splits) < thr:
+        return []
+    # every range_split detail leads with "r<parent> " (rpc/ranged.py)
+    per: dict = {}
+    for e in splits:
+        rid = str(e.get("detail", "")).split(" ", 1)[0] or "?"
+        per.setdefault(rid, []).append(e)
+    out = []
+    for rid, evs in sorted(per.items()):
+        if len(evs) < thr:
+            continue
+        out.append(Finding(
+            "range-split-flap", rid, "warning", str(len(evs)),
+            f"range {rid} split {len(evs)} times inside {win:.0f}s "
+            f"(threshold {thr}); last: {evs[-1]['detail'][:200]}"))
     return out
 
 

@@ -49,6 +49,7 @@ from __future__ import annotations
 import fcntl
 import json
 import os
+import shutil
 import threading
 import time
 from contextlib import contextmanager
@@ -59,7 +60,7 @@ from ..analysis import lockcheck
 from ..kv.mvcc import (KVError, KeyIsLockedError, MVCCStore, Mutation,
                        PyOrderedKV, TxnNotFoundError, WriteConflictError,
                        fsync_dir)
-from ..kv.rangemeta import RangeSpec, split_keyspace
+from ..kv.rangemeta import RangeSpec, split_keyspace, split_spec
 from ..util import failpoint
 from .errors import (EpochNotMatchError, NotLeaderError, RPCError,
                      StaleLeaseError, StaleTermError, traced_response,
@@ -103,6 +104,7 @@ class RangeDirectory:
         ranges/r<id>/grant.json   {owner, token, term, expires_ms, ...}
         ranges/r<id>/term         persisted fencing term (write_term)
         ranges/r<id>/data/        the range's own WAL directory
+        ranges/r<id>/split.json   in-flight split journal (parent side)
     """
 
     def __init__(self, root: str) -> None:
@@ -125,6 +127,9 @@ class RangeDirectory:
 
     def _term_path(self, rid: int) -> str:
         return os.path.join(self._range_dir(rid), "term")
+
+    def split_path(self, rid: int) -> str:
+        return os.path.join(self._range_dir(rid), "split.json")
 
     @contextmanager
     def _flock(self, path: str):
@@ -158,15 +163,19 @@ class RangeDirectory:
         doc = _read_json(self._meta_path())
         if not doc:
             return None
-        return [RangeSpec(int(r["id"]), bytes.fromhex(r["start"]),
-                          bytes.fromhex(r["end"]), int(r.get("epoch", 1)))
-                for r in doc["ranges"]]
+        # sorted by start on every read: locate_spec bisects and the
+        # router scans in table order — a split inserts mid-table
+        return sorted(
+            [RangeSpec(int(r["id"]), bytes.fromhex(r["start"]),
+                       bytes.fromhex(r["end"]), int(r.get("epoch", 1)))
+             for r in doc["ranges"]],
+            key=lambda s: s.start_key)
 
     def bump_epoch(self, rid: int) -> int:
         """Advance one range's routing epoch (the metadata-changed
         signal: clients carrying the old epoch get EpochNotMatchError
-        and reload the table). Bounds stay put — this repo reshapes
-        tables offline, not live."""
+        and reload the table). Bounds stay put — live reshapes go
+        through begin_split."""
         with self._flock(os.path.join(self.dir, "meta.lock")):
             doc = _read_json(self._meta_path())
             if not doc:
@@ -179,6 +188,99 @@ class RangeDirectory:
                 raise RPCError(f"unknown range {rid}")
             _write_json_atomic(self._meta_path(), doc)
             return new
+
+    # ---- the split journal ----
+    def read_split(self, rid: int) -> Optional[dict]:
+        """The parent-side split journal, if a split is in flight:
+        {parent, child, split (hex), state: pending|ready}."""
+        return _read_json(self.split_path(rid))
+
+    def begin_split(self, parent_id: int, split_key: bytes,
+                    trigger: str = "manual"
+                    ) -> tuple[RangeSpec, RangeSpec]:
+        """Crash-atomically commit one split's table delta. Protocol,
+        all under the meta flock: (1) journal the intent next to the
+        parent's grant (state=pending), (2) rewrite meta.json with the
+        two children — the tmp+fsync+rename+dirfsync discipline makes
+        that rename THE commit point. A crash between the two leaves a
+        pending journal whose child id is absent from the meta: the
+        successor's recovery rolls the split BACK deterministically. A
+        crash after leaves both, and recovery rolls FORWARD. Returns
+        (left, right) — the parent keeps its id as the left child,
+        both at epoch parent+1 (in-flight requests stamped with the
+        old epoch get EpochNotMatchError and re-route)."""
+        split_key = bytes(split_key)
+        with self._flock(os.path.join(self.dir, "meta.lock")):
+            specs = self.load_specs()
+            if not specs:
+                raise RPCError("range table missing")
+            parent = next((s for s in specs
+                           if s.id == int(parent_id)), None)
+            if parent is None:
+                raise RPCError(f"unknown range {parent_id}")
+            if self.read_split(parent.id) is not None:
+                raise RPCError(f"range {parent.id} already splitting")
+            child_id = max(s.id for s in specs) + 1
+            try:
+                left, right = split_spec(parent, split_key, child_id)
+            except ValueError as e:
+                raise RPCError(str(e)) from e
+            _write_json_atomic(self.split_path(parent.id), {
+                "parent": int(parent.id), "child": int(child_id),
+                "split": split_key.hex(), "state": "pending",
+                "trigger": str(trigger)})
+            try:
+                failpoint.inject("range/split-before-meta-commit")
+                table = sorted(
+                    [s for s in specs if s.id != parent.id]
+                    + [left, right], key=lambda s: s.start_key)
+                _write_json_atomic(self._meta_path(), {
+                    "ranges": [{"id": s.id, "start": s.start_key.hex(),
+                                "end": s.end_key.hex(),
+                                "epoch": s.epoch} for s in table]})
+            except BaseException:
+                # the meta never committed: withdraw the intent (the
+                # in-process twin of the successor's roll-back)
+                try:
+                    os.unlink(self.split_path(parent.id))
+                except OSError:
+                    pass
+                raise
+            os.makedirs(self.data_dir(child_id), exist_ok=True)
+            return left, right
+
+    def mark_split_ready(self, rid: int) -> None:
+        """The child's store is complete and durable: from here the
+        split only rolls FORWARD (recovery must never rebuild a ready
+        child — it may already hold post-split writes)."""
+        j = self.read_split(rid)
+        if j is not None:
+            j["state"] = "ready"
+            _write_json_atomic(self.split_path(rid), j)
+
+    def clear_split(self, rid: int) -> None:
+        try:
+            os.unlink(self.split_path(rid))
+            fsync_dir(self._range_dir(rid))
+        except OSError:
+            pass
+
+    def pending_children(self) -> set[int]:
+        """Child range ids whose split journal is still pending — their
+        data dirs may be partial, so NOBODY may acquire their lease
+        until the parent-side recovery marks them ready."""
+        out: set[int] = set()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for n in names:
+            if not n.startswith("r"):
+                continue
+            j = _read_json(os.path.join(self.dir, n, "split.json"))
+            if j and j.get("state") == "pending":
+                out.add(int(j.get("child", -1)))
+        return out
 
     # ---- grants ----
     def read_grant(self, rid: int) -> Optional[dict]:
@@ -262,6 +364,15 @@ class RangeLeader:
         self.store = MVCCStore(PyOrderedKV(data_dir, sync_log=sync_log))
         self._max_commit = self.store.max_commit_ts()
         self.fenced = False
+        # split/serve exclusion: every data handler holds this across
+        # its fencing check AND its store op, and split_range holds it
+        # exclusively while it bumps the epoch and partitions the
+        # store — so a request that passed the gate pre-split can
+        # never mutate the parent after the child copy was cut. Plain
+        # RLock, deliberately NOT hot-declared: the split does file
+        # I/O under it, and handler critical sections already
+        # serialize per range on MVCCStore._mu anyway.
+        self.gate = threading.RLock()
 
     @property
     def term(self) -> int:
@@ -323,11 +434,23 @@ class RangeServer(FrameListener):
     def __init__(self, root: str, listen: str = "127.0.0.1:0",
                  lease_ms: int = 1000, specs: Optional[list] = None,
                  sync_log: str = "commit", events=None,
-                 heat=None) -> None:
+                 heat=None, auto_split: bool = False,
+                 split_cooldown_ms: int = 10_000,
+                 max_auto_splits: int = 4) -> None:
         self.directory = RangeDirectory(root)
         self.specs = self.directory.bootstrap(specs)
         self.lease_ms = int(lease_ms)
         self.events = events
+        self._sync_log = str(sync_log)
+        # heat-driven auto-split actuator knobs ([ranges] auto-split /
+        # split-cooldown-ms / max-auto-splits; all hot-reloadable).
+        # Disabled (the default) the lease tick returns before touching
+        # the heat plane — the zero-work contract the poison test pins.
+        self.auto_split = bool(auto_split)
+        self.split_cooldown_ms = int(split_cooldown_ms)
+        self.max_auto_splits = int(max_auto_splits)
+        self._auto_splits = 0
+        self._last_auto_split_ms = 0.0
         # keyspace heat recorder: the LEADER apply is the single
         # counting site for routed writes (the range tier's committers
         # carry no recorder — see kv/twopc.py)
@@ -369,6 +492,10 @@ class RangeServer(FrameListener):
         if specs:
             self.specs = specs
         drop = failpoint.inject("range/lease-drop")
+        # a child range mid-split (journal pending) has a possibly
+        # partial data dir: nobody may serve it until the parent-side
+        # recovery (or the splitter itself) marks it ready
+        embargoed = self.directory.pending_children()
         for spec in self.specs:
             with self._mu:
                 leader = self._leaders.get(spec.id)
@@ -386,7 +513,7 @@ class RangeServer(FrameListener):
                         self.lease_ms)
                 except (StaleLeaseError, OSError) as e:
                     self._drop_leader(spec.id, f"lease lost: {e}")
-            else:
+            elif spec.id not in embargoed:
                 try:
                     g = self.directory.acquire(spec.id, self.address,
                                                self.lease_ms)
@@ -394,10 +521,13 @@ class RangeServer(FrameListener):
                     g = None
                 if g:
                     self._open_leader(spec, g)
+        self._recover_splits()
+        self._auto_split_tick()
 
     def _open_leader(self, spec: RangeSpec, grant: dict) -> None:
         leader = RangeLeader(spec, grant,
-                             self.directory.data_dir(spec.id))
+                             self.directory.data_dir(spec.id),
+                             sync_log=self._sync_log)
         with self._mu:
             self._leaders[spec.id] = leader
         obs.RANGE_LEADERS.inc()
@@ -423,16 +553,223 @@ class RangeServer(FrameListener):
                                f"{why}", severity="warning")
         leader.close()
 
+    # ---- online splits ----
+    def split_range(self, rid: int, split_key: bytes,
+                    trigger: str = "manual", advised_by: str = ""
+                    ) -> tuple[RangeSpec, RangeSpec]:
+        """Split one hosted range at split_key, online. Under the
+        leader's gate (no data request interleaves): journal + commit
+        the two-entry table delta (begin_split's meta rename is THE
+        commit point and bumps both children to epoch parent+1), cut
+        the child's WAL stream out of the parent's store, mark the
+        journal ready, retire the parent's half, clear the journal —
+        then lease and serve the child immediately. A kill-9 anywhere
+        in that sequence is recovered deterministically by
+        _recover_splits on whichever process next leads the parent:
+        back before the meta commit, forward after. In-flight 2PC
+        stamped with the parent's old epoch gets EpochNotMatchError
+        and re-routes through the client's reload loop."""
+        rid = int(rid)
+        split_key = bytes(split_key)
+        with self._mu:
+            leader = self._leaders.get(rid)
+        if leader is None or leader.fenced:
+            raise NotLeaderError(f"range {rid} not led here")
+        with leader.gate:
+            left, right = self.directory.begin_split(
+                rid, split_key, trigger=trigger)
+            # table committed: the split now only moves forward (here,
+            # or via _recover_splits on a successor)
+            leader.spec = left
+            failpoint.inject("range/split-after-meta-commit")
+            self._materialize_child(leader, right)
+            self.directory.mark_split_ready(rid)
+            failpoint.inject("range/split-before-parent-retire")
+            leader.store.discard_range(split_key, right.end_key)
+            self.directory.clear_split(rid)
+        self.specs = self.directory.load_specs() or self.specs
+        self._note_split(left, right, trigger, advised_by)
+        self._adopt_child(right)
+        return left, right
+
+    def _materialize_child(self, parent: RangeLeader,
+                           child: RangeSpec) -> None:
+        """Partition the per-range WAL stream: every lock/version whose
+        decoded USER key falls in the child's bounds, rewritten into
+        the child's own data dir so both sides replay independently.
+        Rebuilds from scratch (rmtree first) so a recovery retry over
+        a half-written child dir is idempotent; the parent still holds
+        every pre-split byte until the retire step, which only ever
+        runs after the journal says ready."""
+        child_dir = self.directory.data_dir(child.id)
+        if os.path.isdir(child_dir):
+            shutil.rmtree(child_dir, ignore_errors=True)
+        os.makedirs(child_dir, exist_ok=True)
+        items = parent.store.export_range(child.start_key,
+                                          child.end_key)
+        kv = PyOrderedKV(child_dir, sync_log=self._sync_log)
+        try:
+            mid = max(1, len(items) // 2)
+            for i, (cf, k, v) in enumerate(items):
+                kv.put(cf, k, v)
+                if i + 1 == mid:
+                    failpoint.inject("range/split-mid-wal-partition")
+            if not items:
+                failpoint.inject("range/split-mid-wal-partition")
+            kv.sync()
+        finally:
+            kv.close()
+
+    def _adopt_child(self, child: RangeSpec) -> None:
+        """Serve the fresh child now — its lease is free, its journal
+        is cleared, and waiting a lease tick would stall writes to the
+        upper half of the just-split keyspace."""
+        try:
+            g = self.directory.acquire(child.id, self.address,
+                                       self.lease_ms)
+        except OSError:
+            g = None
+        if g:
+            self._open_leader(child, g)
+
+    def _note_split(self, left: RangeSpec, right: RangeSpec,
+                    trigger: str, advised_by: str = "") -> None:
+        if self.heat is not None:
+            # re-key the heat plane: the parent's pre-split cells span
+            # bounds no live range has — both children start clean
+            self.heat.on_split(left.id, self.specs)
+        obs.RANGE_SPLITS.inc(trigger=str(trigger))
+        if self.events is not None:
+            detail = (f"r{left.id} -> r{left.id}+r{right.id} at "
+                      f"{right.start_key.hex()[:24]} "
+                      f"epoch={left.epoch} trigger={trigger}")
+            if advised_by:
+                detail += f" advisory={advised_by}"
+            self.events.record("range_split", detail, severity="info")
+
+    def _recover_splits(self) -> None:
+        """Finish — or deterministically roll back — any split journal
+        a crashed leader left on a range we now lead. Runs every lease
+        tick; a journal with no leader here is someone else's to
+        recover (whoever wins the parent's lease)."""
+        for rid in self.hosted_ids():
+            j = self.directory.read_split(rid)
+            if j is None:
+                continue
+            with self._mu:
+                leader = self._leaders.get(rid)
+            if leader is None or leader.fenced:
+                continue
+            try:
+                self._finish_split(leader, j)
+            except Exception as e:
+                if self.events is not None:
+                    self.events.record(
+                        "range_split_error",
+                        f"r{rid} split recovery failed: {e}",
+                        severity="warning")
+
+    def _finish_split(self, leader: RangeLeader, j: dict) -> None:
+        """One journal's recovery. The meta rename decides direction:
+        child id absent from the table → the split never committed,
+        roll BACK (scrap the partial child dir, withdraw the intent);
+        present → roll FORWARD (rebuild the child unless the journal
+        already says ready — a ready child may hold post-split writes
+        and must NEVER be rebuilt — then retire the parent's half)."""
+        rid = int(j["parent"])
+        child_id = int(j["child"])
+        split_key = bytes.fromhex(j["split"])
+        trigger = str(j.get("trigger", "manual"))
+        with leader.gate:
+            specs = self.directory.load_specs() or []
+            by_id = {s.id: s for s in specs}
+            if child_id not in by_id:
+                shutil.rmtree(self.directory.data_dir(child_id),
+                              ignore_errors=True)
+                self.directory.clear_split(rid)
+                if self.events is not None:
+                    self.events.record(
+                        "range_split_rollback",
+                        f"r{rid} pending split at "
+                        f"{split_key.hex()[:24]} rolled back",
+                        severity="warning")
+                return
+            left, right = by_id[rid], by_id[child_id]
+            leader.spec = left
+            if j.get("state") != "ready":
+                self._materialize_child(leader, right)
+                self.directory.mark_split_ready(rid)
+            failpoint.inject("range/split-before-parent-retire")
+            leader.store.discard_range(split_key, right.end_key)
+            self.directory.clear_split(rid)
+        self.specs = self.directory.load_specs() or self.specs
+        self._note_split(left, right, trigger)
+        self._adopt_child(right)
+
+    def _auto_split_tick(self) -> None:
+        """The heat→split actuator: consume PR 18 range-split-advisory
+        findings and execute the advised split. Knob-gated and
+        rate-limited; disabled (the default) this returns before
+        touching the heat plane at all — the zero-work contract the
+        poison test pins. At most one split per tick: the cooldown
+        paces a salted-key workload instead of shattering it."""
+        if not self.auto_split or self.heat is None \
+                or not self.heat.enabled:
+            return
+        if self._auto_splits >= self.max_auto_splits:
+            return
+        if _now_ms() - self._last_auto_split_ms \
+                < self.split_cooldown_ms:
+            return
+        for f in self.heat.findings():
+            if f.get("rule") != "range-split-advisory":
+                continue
+            item = str(f.get("item", ""))
+            try:
+                rid = int(item.lstrip("r"))
+            except ValueError:
+                continue
+            with self._mu:
+                leader = self._leaders.get(rid)
+            if leader is None or leader.fenced:
+                continue
+            if self.directory.read_split(rid) is not None:
+                continue
+            # the finding's value is a truncated hex digest for the
+            # event board — refetch the full weighted-median key
+            key = self.heat.split_advisory(rid)
+            spec = leader.spec
+            if key is None or not (
+                    spec.start_key < key
+                    and (not spec.end_key or key < spec.end_key)):
+                continue
+            try:
+                failpoint.inject("range/auto-split")
+                self.split_range(
+                    rid, key, trigger="auto",
+                    advised_by=str(f.get("value", ""))[:48])
+            except RPCError as e:
+                if self.events is not None:
+                    self.events.record(
+                        "range_split_error",
+                        f"r{rid} auto-split failed: {e}",
+                        severity="warning")
+                continue
+            self._auto_splits += 1
+            self._last_auto_split_ms = _now_ms()
+            return
+
     # ---- request gate ----
-    def _leader_for(self, params: dict) -> RangeLeader:
+    @contextmanager
+    def _gate(self, params: dict):
         """The fencing gate every data request passes BEFORE any data
         access; raises typed so the client refreshes + retries instead
-        of acting on a stale view. Traced as range.lease_gate so a
-        fencing rejection's cost is visible in the stitched tree."""
-        with obs.span("range.lease_gate"):
-            return self._leader_for_gated(params)
-
-    def _leader_for_gated(self, params: dict) -> RangeLeader:
+        of acting on a stale view. Yields the leader WITH its gate
+        lock held, so the fencing verdict stays true through the store
+        op — a split that lands between the check and the apply would
+        otherwise let a pre-split request mutate keys the child now
+        owns. Traced as range.lease_gate so a fencing rejection's cost
+        is visible in the stitched tree."""
         rc = get_range_ctx(params)
         if rc is None:
             raise RPCError("missing range context")
@@ -444,6 +781,15 @@ class RangeServer(FrameListener):
             hint = (f" (grant: {g['owner']} term {g['term']})"
                     if g else "")
             raise NotLeaderError(f"range {rid} not led here{hint}")
+        with leader.gate:
+            with obs.span("range.lease_gate"):
+                self._check_gate(leader, rc, rid)
+            yield leader
+
+    def _check_gate(self, leader: RangeLeader, rc: dict,
+                    rid: int) -> None:
+        if leader.fenced:
+            raise NotLeaderError(f"range {rid} not led here")
         if float(leader.grant.get("expires_ms", 0)) <= _now_ms():
             # our own lease ran out and the renew loop hasn't caught it
             # yet — refusing here is what makes the lease a fence
@@ -462,7 +808,6 @@ class RangeServer(FrameListener):
             # deposed one (a renew raced); never serve on a stale term
             raise NotLeaderError(f"range {rid} deposed: request term "
                                  f"{cterm} > local {leader.term}")
-        return leader
 
     # ---- dispatch ----
     def _dispatch(self, req) -> dict:
@@ -481,90 +826,105 @@ class RangeServer(FrameListener):
 
     # ---- percolator handlers ----
     def _h_range_prewrite(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        muts = [Mutation(bytes(m[0]), bytes(m[1]), bytes(m[2]))
-                for m in params["mutations"]]
-        out = _kv_guarded(lambda: leader.store.prewrite(
-            muts, bytes(params["primary"]), int(params["start_ts"]),
-            int(params.get("ttl", 3000))))
-        # the leader-side apply is where a routed write lands on the
-        # keyspace heatmap (exactly once: the coordinator's committer
-        # carries no recorder over the range tier)
-        if out["ok"] and self.heat is not None and self.heat.enabled:
-            self.heat.note_range(
-                leader.spec.id,
-                write_rows=len(muts),
-                write_bytes=sum(len(m.value or b"") for m in muts),
-                keys=[m.key for m in muts])
+        with self._gate(params) as leader:
+            muts = [Mutation(bytes(m[0]), bytes(m[1]), bytes(m[2]))
+                    for m in params["mutations"]]
+            out = _kv_guarded(lambda: leader.store.prewrite(
+                muts, bytes(params["primary"]),
+                int(params["start_ts"]),
+                int(params.get("ttl", 3000))))
+            # the leader-side apply is where a routed write lands on
+            # the keyspace heatmap (exactly once: the coordinator's
+            # committer carries no recorder over the range tier)
+            if out["ok"] and self.heat is not None \
+                    and self.heat.enabled:
+                self.heat.note_range(
+                    leader.spec.id,
+                    write_rows=len(muts),
+                    write_bytes=sum(len(m.value or b"")
+                                    for m in muts),
+                    keys=[m.key for m in muts])
         # applied-but-unacked: a kill here is the harshest prewrite
         # crash — the lock is durable, the coordinator never heard back
         failpoint.inject("range/before-prewrite-ack")
         return out
 
     def _h_range_commit(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        commit_ts = int(params["commit_ts"])
-        out = _kv_guarded(lambda: leader.store.commit(
-            [bytes(k) for k in params["keys"]],
-            int(params["start_ts"]), commit_ts))
-        if out["ok"]:
-            leader.note_commit(commit_ts)
+        with self._gate(params) as leader:
+            commit_ts = int(params["commit_ts"])
+            out = _kv_guarded(lambda: leader.store.commit(
+                [bytes(k) for k in params["keys"]],
+                int(params["start_ts"]), commit_ts))
+            if out["ok"]:
+                leader.note_commit(commit_ts)
         failpoint.inject("range/before-commit-ack")
         return out
 
     def _h_range_rollback(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        return _kv_guarded(lambda: leader.store.rollback(
-            [bytes(k) for k in params["keys"]],
-            int(params["start_ts"])))
+        with self._gate(params) as leader:
+            return _kv_guarded(lambda: leader.store.rollback(
+                [bytes(k) for k in params["keys"]],
+                int(params["start_ts"])))
 
     def _h_range_get(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        out = _kv_guarded(lambda: leader.store.get(
-            bytes(params["key"]), int(params["read_ts"])))
-        if out["ok"] and self.heat is not None and self.heat.enabled:
-            v = out["v"]
-            self.heat.note_range(
-                leader.spec.id, read_rows=1,
-                read_bytes=len(v) if v else 0)
-        return out
+        with self._gate(params) as leader:
+            out = _kv_guarded(lambda: leader.store.get(
+                bytes(params["key"]), int(params["read_ts"])))
+            if out["ok"] and self.heat is not None \
+                    and self.heat.enabled:
+                v = out["v"]
+                self.heat.note_range(
+                    leader.spec.id, read_rows=1,
+                    read_bytes=len(v) if v else 0)
+            return out
 
     def _h_range_scan(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        spec = leader.spec
-        start = max(bytes(params.get("start", b"")), spec.start_key)
-        end = bytes(params.get("end", b""))
-        if spec.end_key and (not end or end > spec.end_key):
-            end = spec.end_key
-        out = _kv_guarded(lambda: [list(kv) for kv in leader.store.scan(
-            start, end, int(params["read_ts"]),
-            int(params.get("limit", -1)))])
-        if out["ok"] and self.heat is not None and self.heat.enabled:
-            rows = out["v"]
-            self.heat.note_range(
-                leader.spec.id, read_rows=len(rows),
-                read_bytes=sum(len(kv[1] or b"") for kv in rows))
-        return out
+        with self._gate(params) as leader:
+            spec = leader.spec
+            start = max(bytes(params.get("start", b"")),
+                        spec.start_key)
+            end = bytes(params.get("end", b""))
+            if spec.end_key and (not end or end > spec.end_key):
+                end = spec.end_key
+            out = _kv_guarded(
+                lambda: [list(kv) for kv in leader.store.scan(
+                    start, end, int(params["read_ts"]),
+                    int(params.get("limit", -1)))])
+            if out["ok"] and self.heat is not None \
+                    and self.heat.enabled:
+                rows = out["v"]
+                self.heat.note_range(
+                    leader.spec.id, read_rows=len(rows),
+                    read_bytes=sum(len(kv[1] or b"") for kv in rows))
+            return out
 
     def _h_range_check_txn_status(self, params: dict) -> dict:
-        leader = self._leader_for(params)
+        with self._gate(params) as leader:
 
-        def run():
-            commit_ts, expired = leader.store.check_txn_status(
-                bytes(params["primary"]), int(params["lock_ts"]),
-                int(params["current_ts"]))
-            return {"commit_ts": commit_ts, "expired": expired}
+            def run():
+                commit_ts, expired = leader.store.check_txn_status(
+                    bytes(params["primary"]), int(params["lock_ts"]),
+                    int(params["current_ts"]))
+                return {"commit_ts": commit_ts, "expired": expired}
 
-        return _kv_guarded(run)
+            return _kv_guarded(run)
 
     def _h_range_resolve_lock(self, params: dict) -> dict:
-        leader = self._leader_for(params)
-        out = _kv_guarded(lambda: leader.store.resolve_lock(
-            bytes(params["key"]), int(params["start_ts"]),
-            int(params["commit_ts"])))
-        if out["ok"]:
-            obs.RANGE_ORPHAN_RESOLUTIONS.inc()
-        return out
+        with self._gate(params) as leader:
+            out = _kv_guarded(lambda: leader.store.resolve_lock(
+                bytes(params["key"]), int(params["start_ts"]),
+                int(params["commit_ts"])))
+            if out["ok"]:
+                obs.RANGE_ORPHAN_RESOLUTIONS.inc()
+            return out
+
+    def _h_range_split(self, params: dict) -> dict:
+        """Operator-triggered online split (the chaos harness drives
+        the in-process protocol through this same door)."""
+        left, right = self.split_range(
+            int(params["range_id"]), bytes(params["split_key"]),
+            trigger=str(params.get("trigger", "manual")))
+        return {"parent": left.to_wire(), "child": right.to_wire()}
 
     # ---- metadata / diagnostics ----
     def _h_range_table(self, params: dict) -> dict:
@@ -640,14 +1000,19 @@ class RangePlane:
 
     def __init__(self, storage, count: int = 1, split_points=(),
                  lease_ms: int = 1000, resolve_ttl_ms: int = 3000,
-                 listen: str = "127.0.0.1:0") -> None:
+                 listen: str = "127.0.0.1:0", auto_split: bool = False,
+                 split_cooldown_ms: int = 10_000,
+                 max_auto_splits: int = 4) -> None:
         self.storage = storage
         self.resolve_ttl_ms = int(resolve_ttl_ms)
         self.server = RangeServer(
             storage.path, listen=listen, lease_ms=int(lease_ms),
             specs=split_keyspace(int(count), split_points),
             events=storage.obs.events,
-            heat=getattr(storage, "heat", None))
+            heat=getattr(storage, "heat", None),
+            auto_split=auto_split,
+            split_cooldown_ms=split_cooldown_ms,
+            max_auto_splits=max_auto_splits)
 
     def router(self, **kw):
         from ..kv.rangeclient import RangeRouter
@@ -660,17 +1025,31 @@ class RangePlane:
         return TwoPhaseCommitter(self.router(), tso, **kw)
 
     def set_knobs(self, lease_ms: Optional[int] = None,
-                  resolve_ttl_ms: Optional[int] = None) -> None:
+                  resolve_ttl_ms: Optional[int] = None,
+                  auto_split: Optional[bool] = None,
+                  split_cooldown_ms: Optional[int] = None,
+                  max_auto_splits: Optional[int] = None) -> None:
         """The SIGHUP-reloadable subset."""
         if lease_ms is not None:
             self.server.lease_ms = max(int(lease_ms), 50)
         if resolve_ttl_ms is not None:
             self.resolve_ttl_ms = max(int(resolve_ttl_ms), 1)
+        if auto_split is not None:
+            self.server.auto_split = bool(auto_split)
+        if split_cooldown_ms is not None:
+            self.server.split_cooldown_ms = max(int(split_cooldown_ms),
+                                                0)
+        if max_auto_splits is not None:
+            self.server.max_auto_splits = max(int(max_auto_splits), 0)
 
     def status(self) -> dict:
         return {"listen": self.server.address,
                 "lease_ms": self.server.lease_ms,
                 "resolve_ttl_ms": self.resolve_ttl_ms,
+                "auto_split": self.server.auto_split,
+                "split_cooldown_ms": self.server.split_cooldown_ms,
+                "max_auto_splits": self.server.max_auto_splits,
+                "auto_splits_done": self.server._auto_splits,
                 "table": [s.to_wire() | {"start": s.start_key.hex(),
                                          "end": s.end_key.hex()}
                           for s in self.server.specs],

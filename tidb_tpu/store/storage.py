@@ -1238,17 +1238,24 @@ class Storage:
     def arm_ranges(self, enabled: bool = False, count: int = 1,
                    split_points=(), lease_ms: int = 1000,
                    resolve_ttl_ms: int = 3000,
-                   listen: str = "127.0.0.1:0") -> None:
+                   listen: str = "127.0.0.1:0",
+                   auto_split: bool = False,
+                   split_cooldown_ms: int = 10000,
+                   max_auto_splits: int = 4) -> None:
         """Start the range plane to match the [ranges] settings (called
-        from Config.seed_ranges on startup/SIGHUP). lease-ms and
-        resolve-ttl-ms reload live; enabling/disabling or reshaping the
-        table needs a restart (the table is durable, first writer
-        wins). Only a durable local store can host range leaders —
-        followers and in-memory stores route to one that does."""
+        from Config.seed_ranges on startup/SIGHUP). lease-ms,
+        resolve-ttl-ms and the auto-split actuator knobs reload live;
+        enabling/disabling or reshaping the table needs a restart (the
+        table is durable, first writer wins). Only a durable local
+        store can host range leaders — followers and in-memory stores
+        route to one that does."""
         if self.ranges is not None:
             if enabled:
-                self.ranges.set_knobs(lease_ms=lease_ms,
-                                      resolve_ttl_ms=resolve_ttl_ms)
+                self.ranges.set_knobs(
+                    lease_ms=lease_ms, resolve_ttl_ms=resolve_ttl_ms,
+                    auto_split=auto_split,
+                    split_cooldown_ms=split_cooldown_ms,
+                    max_auto_splits=max_auto_splits)
             return
         if not enabled or self.remote or self.path is None:
             return
@@ -1257,7 +1264,10 @@ class Storage:
                                  split_points=split_points,
                                  lease_ms=lease_ms,
                                  resolve_ttl_ms=resolve_ttl_ms,
-                                 listen=listen)
+                                 listen=listen,
+                                 auto_split=auto_split,
+                                 split_cooldown_ms=split_cooldown_ms,
+                                 max_auto_splits=max_auto_splits)
         # the heat matrix resolves against the authoritative table the
         # plane just bootstrapped (first writer wins; re-seed adopts)
         self.heat.set_specs(self.ranges.server.specs)

@@ -65,6 +65,12 @@ DECLARED = frozenset({
                                    # ack not sent (leader-kill site)
     "range/lease-drop",            # rpc/ranged.py forced lease release
                                    # (value: range id, or true = all)
+    "range/auto-split",            # rpc/ranged.py actuator about to
+                                   # execute an advised split
+    "range/split-before-meta-commit",   # journal written, table not
+    "range/split-after-meta-commit",    # table committed, child empty
+    "range/split-mid-wal-partition",    # child WAL half-written
+    "range/split-before-parent-retire", # child ready, parent whole
     "replica/apply-stall",         # rpc/apply.py frozen apply loop
     "rpc/conn-drop",               # rpc/client.py transport chaos
     "rpc/delay",
