@@ -1269,6 +1269,45 @@ def flight_replica_read(res: dict) -> None:
             f"replica_read scaling: {routed['qps'] / max(base['qps'], 1e-9):.2f}x "
             f"QPS with {n_followers} serving followers "
             f"({workers} workers, {n} rows)")
+
+        # ranged phase: the same routed read with the range plane
+        # armed as a 4-range leader fleet and the range-aware covering
+        # gate on — every SELECT must be covered by the min published
+        # closed_ts over the ranges its span touches, so the board
+        # carries the gate's real cost: QPS under the gate plus the
+        # fraction of worker busy-time spent in the covered_ts wait
+        from tidb_tpu import obs as _obs
+        from tidb_tpu.kv import tablecodec as _tc
+        tid = leader.catalog.table("test", "rr").id
+        splits = [_tc.record_key(int(tid), h)
+                  for h in (n // 4, n // 2, 3 * n // 4)]
+        leader.arm_ranges(enabled=True, split_points=splits,
+                          lease_ms=150)
+        leader.replica_read.range_aware = True
+        nr = len(leader.ranges.server.specs)
+        log(f"replica_read: range plane armed ({nr} ranges), "
+            "range-aware covering gate on")
+        wait0 = _obs.WAIT_SECONDS_TOTAL.get(state="covered_ts")
+        ranged = run_mode("follower")
+        waited = _obs.WAIT_SECONDS_TOTAL.get(
+            state="covered_ts") - wait0
+        busy = workers * seconds
+        res["values"]["replica_read_qps_ranged"] = \
+            round(ranged["qps"], 1)
+        res["values"]["replica_read_covered_wait_fraction"] = \
+            round(waited / busy, 4)
+        res["values"]["replica_read_ranges"] = nr
+        lines.append(
+            f"replica_read ranged ({nr} ranges, gate on): "
+            f"{ranged['qps']:.0f} QPS p50={ranged['p50_ms']:.1f}ms "
+            f"p99={ranged['p99_ms']:.1f}ms "
+            f"routed={ranged['routed_fraction']:.0%} "
+            f"covered-ts wait {waited / busy:.1%} of busy time")
+        lines.append(
+            f"replica_read gate cost: "
+            f"{ranged['qps'] / max(routed['qps'], 1e-9):.2f}x QPS vs "
+            f"ungated routed read (fresh read_ts waits for the next "
+            f"closed-ts heartbeat)")
     finally:
         for p in procs:
             try:

@@ -1,0 +1,489 @@
+"""Network chaos over the range plane: partition-tolerant reads.
+
+The acceptance suite for PR 20's fault-injection seam (rpc/netfault.py
+hooked into rpc/frame.py) and the per-range closed-timestamp ledger
+(rpc/ranged.py): concurrent cross-range transfers run under
+delay/drop/dup schedules and partition/heal cycles, with a split and a
+leader handover mid-storm, and a history checker asserts
+
+  * per-range closed timestamps NEVER regress (monotonic through
+    splits, transfers, and partitions);
+  * snapshots at a covered timestamp are prefix-consistent — no torn
+    cross-range transaction is ever observable at or below the min
+    closed_ts of the ranges it touched;
+  * every acknowledged transfer is durable exactly once (balance
+    invariant against an uncrashed oracle);
+  * the unarmed frame path does zero fault-plane work (the WORK
+    poison pin).
+
+Unit coverage for the fault engine itself (schedule matching,
+determinism, asymmetric partitions) lives here too, driven through
+real socketpairs and the real send_frame/recv_frame.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+from tidb_tpu.kv.rangeclient import RangeRouter
+from tidb_tpu.kv.rangemeta import split_keyspace
+from tidb_tpu.kv.tso import TimestampOracle
+from tidb_tpu.kv.twopc import Snapshot, TwoPhaseCommitter
+from tidb_tpu.rpc import netfault
+from tidb_tpu.rpc.client import RpcOptions
+from tidb_tpu.rpc.frame import recv_frame, send_frame
+from tidb_tpu.rpc.ranged import RangeServer
+from tidb_tpu.util import failpoint
+
+# short transport timeouts: a silently-dropped frame must resolve into
+# a retry in ~250ms, not the 5s production default
+OPTS = RpcOptions(connect_timeout_ms=500, request_timeout_ms=400,
+                  backoff_budget_ms=6000)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    netfault.reset()
+    yield
+    failpoint.disable_all()
+    netfault.reset()
+
+
+# ==================== the fault engine, at the socket ====================
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(2.0)
+    b.settimeout(2.0)
+    return a, b
+
+
+def test_unarmed_frames_do_zero_fault_work():
+    """The zero-work contract: with nothing armed the frame path reads
+    netfault.ACTIVE and nothing else — the WORK pin stays flat."""
+    a, b = _pair()
+    try:
+        assert netfault.ACTIVE is False
+        before = netfault.WORK
+        for i in range(50):
+            send_frame(a, b"x%d" % i)
+            assert recv_frame(b) == b"x%d" % i
+        assert netfault.WORK == before
+    finally:
+        a.close()
+        b.close()
+
+
+def test_delay_drop_dup_partition_schedules():
+    a, b = _pair()
+    try:
+        # delay: a fixed sleep on matching frames
+        netfault.arm("net/delay", ms=30)
+        t0 = time.perf_counter()
+        send_frame(a, b"slow")
+        assert recv_frame(b) == b"slow"
+        assert time.perf_counter() - t0 >= 0.03
+        netfault.heal("net/delay")
+
+        # drop: deterministic — every 3rd frame vanishes (send side)
+        netfault.arm("net/drop", nth=3)
+        got = []
+        for i in range(6):
+            send_frame(a, b"d%d" % i)
+        b.settimeout(0.2)
+        with pytest.raises((socket.timeout, ConnectionError)):
+            while True:
+                got.append(recv_frame(b))
+        assert got == [b"d0", b"d1", b"d3", b"d4"]  # d2, d5 dropped
+        netfault.heal("net/drop")
+
+        # dup: every frame doubled; both copies arrive intact
+        netfault.arm("net/dup")
+        send_frame(a, b"twice")
+        netfault.heal("net/dup")
+        b.settimeout(2.0)
+        assert recv_frame(b) == b"twice"
+        assert recv_frame(b) == b"twice"
+
+        # partition: the wire is cut typed, heal restores it
+        netfault.arm("net/partition")
+        with pytest.raises(ConnectionResetError):
+            send_frame(a, b"cut")
+        netfault.heal("net/partition")
+        send_frame(a, b"healed")
+        assert recv_frame(b) == b"healed"
+        assert netfault.WORK > 0  # the armed path did count entries
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_matching_and_asymmetric_partition():
+    """side+dir express asymmetric cuts: traffic TOWARD the named
+    endpoint dies while the reverse direction still flows."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    cli = socket.create_connection(("127.0.0.1", port), timeout=2.0)
+    acc, _ = srv.accept()
+    cli.settimeout(2.0)
+    acc.settimeout(2.0)
+    try:
+        # a rule naming some OTHER endpoint must not fire here
+        netfault.arm("net/partition", peer="127.0.0.1:1", side="peer")
+        send_frame(cli, b"pass")
+        assert recv_frame(acc) == b"pass"
+        netfault.heal("net/partition")
+
+        # cut only frames SENT TOWARD the server's port: the client's
+        # send dies, the server can still push toward the client
+        netfault.arm("net/partition", peer=f"127.0.0.1:{port}",
+                     side="peer", dir="send")
+        with pytest.raises(ConnectionResetError):
+            send_frame(cli, b"toward-server")
+        send_frame(acc, b"from-server")  # acc's peer is the CLIENT
+        assert recv_frame(cli) == b"from-server"
+    finally:
+        cli.close()
+        acc.close()
+        srv.close()
+
+
+# ==================== the chaos harness ====================
+
+def _acct_key(i: int) -> bytes:
+    # accounts spread across the 4-way split at g/n/t
+    return b"acct/%c%03d" % (b"afpu"[i % 4], i)
+
+
+def _read_accounts(router, tso, n, read_ts=None):
+    snap = Snapshot(router, tso,
+                    read_ts if read_ts is not None else tso.ts())
+    out = {}
+    for i in range(n):
+        v = snap.get(_acct_key(i))
+        out[i] = int(v) if v else 0
+    return out
+
+
+def test_transfers_survive_partition_heal_cycles(tmp_path):
+    """The headline drill: concurrent cross-range transfers while the
+    wire degrades (delay+dup armed throughout, drop and full-partition
+    phases cycling), a split lands mid-storm, and write leadership
+    changes hands. The oracle is the sum of acknowledged transfers."""
+    root = str(tmp_path / "ranges")
+    n_accts, seed = 8, 100
+    srv = RangeServer(root, lease_ms=400,
+                      specs=split_keyspace(4, (b"acct/g", b"acct/p",
+                                               b"acct/u")))
+    tso = TimestampOracle()
+    router = RangeRouter(root=root, options=OPTS, budget_ms=12000)
+    seed_c = TwoPhaseCommitter(router, tso, lock_ttl=2000)
+    seed_c.commit([Mutation(OP_PUT, _acct_key(i), b"%d" % seed)
+                   for i in range(n_accts)], tso.ts())
+
+    stop = threading.Event()
+    acked = []          # (start_ts, commit_ts, src, dst, amt)
+    closed_floor: dict[int, int] = {}
+    errors: list[str] = []
+
+    def transfer_worker(wid: int) -> None:
+        c = TwoPhaseCommitter(router, tso, lock_ttl=2000,
+                              max_retries=30)
+        k = 0
+        while not stop.is_set():
+            src = (wid * 3 + k) % n_accts
+            dst = (src + 1) % n_accts  # adjacent = different range
+            k += 1
+            ts = tso.ts()
+            snap = Snapshot(router, tso, ts)
+            try:
+                a = int(snap.get(_acct_key(src)) or b"0")
+                b_ = int(snap.get(_acct_key(dst)) or b"0")
+                if a < 1:
+                    continue
+                cts = c.commit(
+                    [Mutation(OP_PUT, _acct_key(src), b"%d" % (a - 1)),
+                     Mutation(OP_PUT, _acct_key(dst), b"%d" % (b_ + 1))],
+                    ts)
+                acked.append((ts, cts, src, dst, 1))
+            except Exception:  # noqa: BLE001 — conflicts/cuts retry
+                continue
+
+    def closed_monitor() -> None:
+        while not stop.is_set():
+            try:
+                for rid, ts in router.closed_over(b"", b"",
+                                                  refresh=True):
+                    prev = closed_floor.get(rid, 0)
+                    if ts < prev:
+                        errors.append(
+                            f"closed_ts regressed on r{rid}: "
+                            f"{prev} -> {ts}")
+                    closed_floor[rid] = max(prev, ts)
+            except Exception:  # noqa: BLE001 — mid-handover read
+                pass
+            time.sleep(0.05)
+
+    def prefix_reader() -> None:
+        # no torn cross-range txn at a covered timestamp: both legs of
+        # an acked transfer land in the same snapshot prefix, so the
+        # total at ANY covered ts equals the seeded total
+        while not stop.is_set():
+            time.sleep(0.15)
+            try:
+                cov = min(ts for _, ts in
+                          router.closed_over(b"", b"", refresh=True))
+                if cov <= 0:
+                    continue
+                snap = Snapshot(router, tso, cov)
+                total = sum(
+                    int(snap.get(_acct_key(i)) or b"0")
+                    for i in range(n_accts))
+                if total != n_accts * seed:
+                    errors.append(
+                        f"torn snapshot at covered ts {cov}: "
+                        f"total {total} != {n_accts * seed}")
+            except Exception:  # noqa: BLE001 — cut mid-scan retries
+                continue
+
+    threads = [threading.Thread(target=transfer_worker, args=(w,),
+                                daemon=True) for w in range(3)]
+    threads += [threading.Thread(target=closed_monitor, daemon=True),
+                threading.Thread(target=prefix_reader, daemon=True)]
+    srv2 = None
+    try:
+        # background degradation for the whole run
+        netfault.arm("net/delay", ms=2)
+        netfault.arm("net/dup", nth=5)
+        for t in threads:
+            t.start()
+
+        # phase 1: loss
+        netfault.arm("net/drop", nth=7)
+        time.sleep(0.6)
+        netfault.heal("net/drop")
+
+        # phase 2: full partition of the range tier, then heal
+        netfault.arm("net/partition", peer=srv.address, side="peer")
+        time.sleep(0.4)
+        netfault.heal("net/partition")
+        time.sleep(0.4)
+
+        # phase 3: a split lands mid-storm (ledger handoff to children)
+        parent = next(h.id for h in router.regions()
+                      if h.contains(b"acct/a000"))
+        srv.split_range(parent, b"acct/c")
+        time.sleep(0.4)
+
+        # phase 4: leadership changes hands — srv dies unreleased, a
+        # successor process takes over after the lease horizon; the
+        # published closed floors must carry across the transfer
+        netfault.arm("net/partition", peer=srv.address, side="peer")
+        time.sleep(0.3)
+        netfault.heal("net/partition")
+        srv.close(release=False)
+        srv2 = RangeServer(root, lease_ms=400)
+        time.sleep(1.2)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        netfault.heal()
+
+        assert errors == [], errors[:5]
+        assert acked, "no transfer ever succeeded under chaos"
+
+        # the history check against the uncrashed oracle: balances
+        # reflect the acked transfers EXACTLY (exactly-once, no loss)
+        deadline = time.time() + 10
+        while True:
+            final = _read_accounts(router, tso, n_accts)
+            want = {i: seed for i in range(n_accts)}
+            for _, _, src, dst, amt in acked:
+                want[src] -= amt
+                want[dst] += amt
+            if final == want:
+                break
+            if time.time() > deadline:
+                assert final == want
+            time.sleep(0.2)
+
+        # closed floors are live again and cover fresh timestamps
+        cov_deadline = time.time() + 8
+        while True:
+            cov = min(ts for _, ts in
+                      router.closed_over(b"", b"", refresh=True))
+            if cov >= max(cts for _, cts, *_ in acked):
+                break
+            assert time.time() < cov_deadline, \
+                "closed_ts never covered the last acked commit"
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        netfault.heal()
+        router.close()
+        if srv2 is not None:
+            srv2.close()
+        else:
+            srv.close()
+
+
+# ==================== ledger semantics, in process ====================
+
+def test_cross_range_commit_holds_ledger_until_txn_done(tmp_path):
+    """A cross-range participant's closed_ts stays BELOW the txn's
+    commit_ts until the coordinator's txn_done reports every secondary
+    durable — the window where a replica read could otherwise observe
+    a torn transaction."""
+    root = str(tmp_path / "r")
+    srv = RangeServer(root, lease_ms=300, specs=split_keyspace(2, (b"m",)))
+    tso = TimestampOracle()
+    router = RangeRouter(root=root, options=OPTS)
+    try:
+        left = router.locate(b"a")
+        right = router.locate(b"z")
+        assert left.id != right.id
+        start_ts = tso.ts()
+        router.prewrite(left, [Mutation(OP_PUT, b"a", b"1")], b"a",
+                        start_ts)
+        router.prewrite(right, [Mutation(OP_PUT, b"z", b"1")], b"a",
+                        start_ts)
+        commit_ts = tso.ts()
+        # primary committed, done=False: the ledger entry re-pins at
+        # commit_ts instead of retiring
+        router.commit(left, [b"a"], start_ts, commit_ts, done=False)
+        time.sleep(0.5)  # heartbeats publish while the hold is open
+        closed = dict(router.closed_over(b"", b"", refresh=True))
+        assert closed[left.id] < commit_ts, \
+            "participant closed past an in-flight cross-range commit"
+        # secondary durable + txn_done: both ranges may now advance
+        router.commit(right, [b"z"], start_ts, commit_ts, done=False)
+        router.txn_done(left, start_ts)
+        router.txn_done(right, start_ts)
+        deadline = time.time() + 5
+        while True:
+            closed = dict(router.closed_over(b"", b"", refresh=True))
+            if min(closed.values()) >= commit_ts:
+                break
+            assert time.time() < deadline, closed
+            time.sleep(0.05)
+    finally:
+        router.close()
+        srv.close()
+
+
+def test_lost_txn_done_self_retires_after_hold_ttl(tmp_path):
+    """A crashed coordinator never sends txn_done: the commit-pinned
+    ledger entry expires after hold_ms and closed_ts moves on (any
+    still-unresolved secondary lock keeps pinning via the lock union,
+    so the early retire is safe)."""
+    root = str(tmp_path / "r")
+    srv = RangeServer(root, lease_ms=200,
+                      specs=split_keyspace(2, (b"m",)), hold_ms=400)
+    tso = TimestampOracle()
+    router = RangeRouter(root=root, options=OPTS)
+    try:
+        left = router.locate(b"a")
+        start_ts = tso.ts()
+        router.prewrite(left, [Mutation(OP_PUT, b"a", b"1")], b"a",
+                        start_ts)
+        commit_ts = tso.ts()
+        router.commit(left, [b"a"], start_ts, commit_ts, done=False)
+        # no txn_done — the hold must expire on its own
+        deadline = time.time() + 6
+        while True:
+            closed = dict(router.closed_over(b"", b"",
+                                             refresh=True))[left.id]
+            if closed >= commit_ts:
+                break
+            assert time.time() < deadline, \
+                "ledger hold never expired without txn_done"
+            time.sleep(0.05)
+    finally:
+        router.close()
+        srv.close()
+
+
+def test_leader_transfer_floors_successor_closed_ts(tmp_path):
+    """The successor's published closed_ts starts AT OR ABOVE the
+    predecessor's last published value (the monotonicity half of the
+    closed-timestamp contract across failover)."""
+    root = str(tmp_path / "r")
+    srv = RangeServer(root, lease_ms=250, specs=split_keyspace(1))
+    tso = TimestampOracle()
+    router = RangeRouter(root=root, options=OPTS)
+    try:
+        c = TwoPhaseCommitter(router, tso)
+        c.commit([Mutation(OP_PUT, b"k", b"v")], tso.ts())
+        time.sleep(0.6)  # a few heartbeat publications
+        before = dict(router.closed_over(b"", b"", refresh=True))
+        assert min(before.values()) > 0
+        srv.close(release=False)  # die without releasing = kill
+        srv2 = RangeServer(root, lease_ms=250)
+        try:
+            deadline = time.time() + 8
+            while not srv2.hosted_ids():
+                assert time.time() < deadline, "successor never led"
+                time.sleep(0.05)
+            after = dict(router.closed_over(b"", b"", refresh=True))
+            for rid, floor in before.items():
+                assert after[rid] >= floor, \
+                    f"r{rid} closed_ts regressed across transfer"
+        finally:
+            srv2.close()
+    finally:
+        router.close()
+
+
+def test_split_hands_ledger_floor_to_children(tmp_path):
+    """Both split children start with closed_ts >= the parent's value
+    at the handoff point, and an in-flight cross-range txn spanning
+    the split key keeps BOTH children below its commit_ts."""
+    root = str(tmp_path / "r")
+    srv = RangeServer(root, lease_ms=300, specs=split_keyspace(1))
+    tso = TimestampOracle()
+    router = RangeRouter(root=root, options=OPTS)
+    try:
+        c = TwoPhaseCommitter(router, tso)
+        c.commit([Mutation(OP_PUT, b"a", b"1"),
+                  Mutation(OP_PUT, b"z", b"1")], tso.ts())
+        parent = router.regions()[0]
+        # an open cross-range-style hold on the parent (commit pinned,
+        # no txn_done yet) straddling the future split point
+        start_ts = tso.ts()
+        router.prewrite(parent, [Mutation(OP_PUT, b"b", b"2"),
+                                 Mutation(OP_PUT, b"y", b"2")], b"b",
+                        start_ts)
+        commit_ts = tso.ts()
+        router.commit(parent, [b"b", b"y"], start_ts, commit_ts,
+                      done=False)
+        parent_closed = dict(router.closed_over(
+            b"", b"", refresh=True))[parent.id]
+        srv.split_range(parent.id, b"m")
+        closed = dict(router.closed_over(b"", b"", refresh=True))
+        assert len(closed) == 2
+        for rid, ts in closed.items():
+            assert ts >= parent_closed, \
+                f"child r{rid} below the parent's handoff floor"
+            assert ts < commit_ts, \
+                f"child r{rid} closed past the straddling txn"
+        router.txn_done(router.locate(b"b"), start_ts)
+        router.txn_done(router.locate(b"y"), start_ts)
+        deadline = time.time() + 6
+        while True:
+            closed = dict(router.closed_over(b"", b"", refresh=True))
+            if min(closed.values()) >= commit_ts:
+                break
+            assert time.time() < deadline, closed
+            time.sleep(0.05)
+    finally:
+        router.close()
+        srv.close()

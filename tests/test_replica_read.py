@@ -453,3 +453,118 @@ def test_replica_metrics_and_debug_surface(cluster):
         "served", "stale_fallback", "unreachable_fallback"}
     fol = follower.transport_health()
     assert fol["replica_apply"]["interval_ms"] == 100
+
+
+# ==================== range-aware covering gate ====================
+# PR 20: with [ranges] armed and replica-read.range-aware on, a routed
+# SELECT must be covered by every touched range's published closed_ts
+# — uncovered reads fall back TYPED to the leader (never wrong, never
+# failed), and an online split mid-read keeps that contract.
+
+def _arm_ranged(leader, tid, split_rows=()):
+    from tidb_tpu.kv import tablecodec
+    splits = [tablecodec.record_key(int(tid), h) for h in split_rows]
+    leader.arm_ranges(enabled=True, split_points=splits, lease_ms=300)
+    leader.replica_read.range_aware = True
+
+
+def test_range_aware_gate_serves_covered_reads(cluster):
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table g (id bigint primary key, v bigint)")
+    sl.execute("insert into g values (1, 10), (2, 20), (3, 30)")
+    tid = leader.catalog.table("test", "g").id
+    _arm_ranged(leader, tid, split_rows=(2,))
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select sum(v) from g").rows == [(60,)]
+    assert _served(leader) >= 1.0
+    assert replica_mod.debug_payload(leader)["range_aware"] is True
+
+
+def test_range_gate_blocks_uncovered_read_and_recovers(cluster):
+    """An unresolved prewrite inside the table's span pins that
+    range's closed_ts; a later routed read must fall back typed (the
+    leader serves the identical snapshot), and flipping range-aware
+    OFF must restore the pre-gate routing engine byte-for-byte."""
+    from tidb_tpu.kv.mvcc import OP_PUT, Mutation
+    from tidb_tpu.kv.tablecodec import table_range
+    from tidb_tpu.kv.tso import TimestampOracle
+
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table w (id bigint primary key, v bigint)")
+    sl.execute("insert into w values (1, 7), (2, 8)")
+    tid = leader.catalog.table("test", "w").id
+    _arm_ranged(leader, tid)
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    assert sl.execute("select sum(v) from w").rows == [(15,)]
+    served0 = _served(leader)
+
+    start, _end = table_range(int(tid))
+    key = start + b"\x00wedge"
+    wedge_ts = TimestampOracle().ts()
+    router = leader.ranges.router(options=OPTS)
+    try:
+        h = router.locate(key)
+        router.prewrite(h, [Mutation(OP_PUT, key, b"x")], key,
+                        wedge_ts, ttl=60_000)
+        time.sleep(0.01)  # read_ts strictly above the wedge's ms
+        assert sl.execute("select sum(v) from w").rows == [(15,)]
+        assert _served(leader) == served0       # not served stale
+        assert _fallbacks(leader)["stale_fallback"] >= 1.0
+        notes = [w for w in sl.warnings if "uncovered" in w[2]]
+        assert notes and notes[0][0] == "Note"
+        assert "range#" in notes[0][2]
+        # range-aware off: the gate vanishes and routing behaves as
+        # before this PR (the wedge lives on the range plane, OFF the
+        # statement path, so the replica's answer is still correct)
+        leader.replica_read.range_aware = False
+        assert sl.execute("select sum(v) from w").rows == [(15,)]
+        assert _served(leader) == served0 + 1.0
+        leader.replica_read.range_aware = True
+        router.rollback(h, [key], wedge_ts)
+    finally:
+        router.close()
+    # recovery: the next heartbeats republish an advancing closed_ts
+    deadline = time.monotonic() + 8.0
+    while time.monotonic() < deadline:
+        if sl.execute("select sum(v) from w").rows == [(15,)] \
+                and _served(leader) >= served0 + 2.0:
+            break
+        time.sleep(0.1)
+    assert _served(leader) >= served0 + 2.0
+
+
+def test_split_during_routed_read_never_wrong(cluster):
+    """Online splits while routed reads are in flight: every answer is
+    the pinned-snapshot answer or a typed leader fallback — never a
+    wrong row set, never a failed statement."""
+    from tidb_tpu.kv import tablecodec
+    from tidb_tpu.kv.rangemeta import locate_spec
+
+    leader, follower = cluster
+    sl = Session(leader)
+    sl.execute("create table sp (id bigint primary key, v bigint)")
+    sl.execute("insert into sp values " + ", ".join(
+        f"({i}, {i})" for i in range(1, 41)))
+    expect = sum(range(1, 41))
+    tid = leader.catalog.table("test", "sp").id
+    _arm_ranged(leader, tid)
+    _wait_serving(leader)
+    sl.execute("set tidb_replica_read = 'follower'")
+    srv = leader.ranges.server
+    split_keys = [tablecodec.record_key(int(tid), h)
+                  for h in (10, 20, 30)]
+    for i in range(12):
+        if i in (2, 5, 8):
+            key = split_keys.pop(0)
+            spec = locate_spec(sorted(srv.specs,
+                                      key=lambda s: s.start_key), key)
+            srv.split_range(spec.id, key)
+        assert sl.execute("select sum(v) from sp").rows == [(expect,)]
+    assert _served(leader) >= 1.0               # routing survived
+    # the split children now gate the covering computation too
+    s0, e0 = tablecodec.table_range(int(tid))
+    assert len(leader.ranges.closed_over(s0, e0)) >= 4

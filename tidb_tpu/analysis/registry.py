@@ -98,6 +98,8 @@ ENGINE_TAG_FAMILIES: tuple[str, ...] = (
     "host(",       # host fallback with the gate reason embedded
     "point",       # the OLTP point fast path (plan/fastpath.py)
     "replica@",    # follower read tier (rpc/replica.py)
+    "range#",      # per-range gate verdicts: range#<id>@gated
+    "ranges@",     # range-aware covering summary: ranges@covered(...)
 )
 
 # bracketed device fragment modes — the exact vocabulary inside

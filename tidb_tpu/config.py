@@ -192,6 +192,11 @@ class DiagnosticsConfig:
     # wall time blocked in backoff.* or lease_wait is a finding
     # (needs performance.wait-profile-enabled for data to exist)
     dominant_wait_threshold: float = 0.5
+    # a range whose published closed_ts has not advanced for this long
+    # WHILE its write counters moved fires range-closed-ts-stall
+    # (warning; critical at 3x — every ranged replica read over it is
+    # falling back); 0 disables the rule
+    closed_ts_stall_ms: int = 10000
 
 
 @dataclass
@@ -262,6 +267,10 @@ class ReplicaReadConfig:
     # route eligible snapshot SELECTs to followers by default (seeds
     # the tidb_replica_read sysvar's global default)
     prefer_follower: bool = False
+    # range-aware covering: a routed SELECT requires every range its
+    # table spans touch to have published closed_ts >= read_ts (the
+    # per-range ledger floors). False = today's routing byte-for-byte
+    range_aware: bool = False
 
 
 @dataclass
@@ -648,6 +657,10 @@ class Config:
             raise ConfigError(
                 "diagnostics.split-flap-window-s must be >= 0 "
                 "(0 = the shared history window)")
+        if self.diagnostics.closed_ts_stall_ms < 0:
+            raise ConfigError(
+                "diagnostics.closed-ts-stall-ms must be >= 0 "
+                "(0 disables the rule)")
         if self.storage.sync_log not in ("off", "commit", "interval"):
             raise ConfigError(
                 f"storage.sync-log must be off|commit|interval, got "
@@ -710,6 +723,7 @@ class Config:
         "diagnostics.row_eval_threshold",
         "diagnostics.apply_lag_warn_ms",
         "diagnostics.dominant_wait_threshold",
+        "diagnostics.closed_ts_stall_ms",
         # the workload-history plane toggles/tunes live: arming the
         # plan/perf history to chase a production plan flip must not
         # need a restart (the Top SQL precedent)
@@ -733,6 +747,9 @@ class Config:
         "replica_read.enabled",
         "replica_read.max_staleness_ms",
         "replica_read.prefer_follower",
+        # range-aware covering is a pure router-side gate (one state
+        # bit read per routed statement), so it toggles live too
+        "replica_read.range_aware",
         # range-plane timing knobs apply live (lease horizon + orphan
         # TTL are operator dials during an incident); enabling the
         # plane or reshaping the table stays restart-only
@@ -886,6 +903,7 @@ class Config:
         st.split_flap_threshold = d.split_flap_threshold
         st.split_flap_window_s = d.split_flap_window_s
         st.dominant_wait_threshold = d.dominant_wait_threshold
+        st.closed_ts_stall_ms = d.closed_ts_stall_ms
         # the /status counts must reflect the new thresholds now, not
         # after the cache TTL
         st._status_cache = None
@@ -923,6 +941,7 @@ class Config:
         st.max_staleness_ms = r.max_staleness_ms
         st.apply_interval_ms = r.apply_interval_ms
         st.prefer_follower = r.prefer_follower
+        st.range_aware = r.range_aware
         storage.arm_replica_read()
 
     def seed_ranges(self, storage) -> None:
@@ -1345,6 +1364,11 @@ split-flap-window-s = 300
 # backoff.* or lease_wait fires dominant-wait (needs
 # performance.wait-profile-enabled for the data to exist)
 dominant-wait-threshold = 0.5
+# a range whose published closed timestamp has not advanced for this
+# long WHILE its write counters moved fires range-closed-ts-stall
+# (warning; critical at 3x — every range-aware replica read over it is
+# falling back to the leader); 0 disables the rule
+closed-ts-stall-ms = 10000
 
 [history]
 # Workload history plane (information_schema.statements_summary_history
@@ -1391,6 +1415,15 @@ apply-interval-ms = 200
 # tidb_replica_read sysvar; sessions override with
 # SET tidb_replica_read = 'leader' | 'follower')
 prefer-follower = false
+# range-aware covering: a routed SELECT additionally requires every
+# range its table spans touch to have published closed_ts >= read_ts
+# (the per-range pending-commit ledger floors; needs [ranges] armed to
+# see any ranges — without a range plane the gate is a no-op). Fault
+# schedules for the partition drills this tier is tested under arm via
+# the failpoint registry (TIDB_TPU_FAILPOINTS=net/delay=5 etc., see
+# rpc/netfault.py), not TOML. false = single-closed-ts routing,
+# byte-for-byte today's behavior.
+range-aware = false
 
 [ranges]
 # Range-sharded write leadership: split the keyspace into ranges whose

@@ -224,9 +224,23 @@ class RangeRouter:
                    primary=primary, start_ts=start_ts, ttl=ttl)
 
     def commit(self, region: RangeHandle, keys: list[bytes],
-               start_ts: int, commit_ts: int) -> None:
+               start_ts: int, commit_ts: int,
+               done: bool = True) -> None:
+        # done=False marks a cross-range participant: the range keeps
+        # its pending-commit ledger entry (closed_ts held below
+        # commit_ts) until txn_done reports every secondary durable
         self._call(region.id, region.epoch, "range_commit", keys=keys,
-                   start_ts=start_ts, commit_ts=commit_ts)
+                   start_ts=start_ts, commit_ts=commit_ts, done=done)
+
+    def txn_done(self, region: RangeHandle, start_ts: int) -> None:
+        """Release one participant range's ledger hold. Best-effort:
+        a lost call costs closed-ts latency (the hold TTL), never
+        correctness, so routing trouble is swallowed."""
+        try:
+            self._call(region.id, region.epoch, "range_txn_done",
+                       start_ts=start_ts)
+        except (RPCError, RegionError, KVError):
+            pass
 
     def rollback(self, region: RangeHandle, keys: list[bytes],
                  start_ts: int) -> None:
@@ -250,6 +264,37 @@ class RangeRouter:
         h = self.locate(key)
         self._call(h.id, h.epoch, "range_resolve_lock", key=key,
                    start_ts=start_ts, commit_ts=commit_ts)
+
+    def closed_over(self, start: bytes, end: bytes,
+                    refresh: bool = False) -> list[tuple[int, int]]:
+        """Per-range published closed timestamps over the key span
+        [start, end): [(range_id, closed_ts), ...] in key order. The
+        span's COVERED timestamp is the min — a snapshot read at or
+        below it is settled on every range it touches. closed_ts 0 =
+        no grant/publication visible (counts as uncovered). refresh
+        reloads the range table and bypasses the grant cache, so a
+        waiting reader observes heartbeat progress AND mid-wait
+        splits (a child range it has never routed to still gates)."""
+        if refresh:
+            self._load_table()
+        out: list[tuple[int, int]] = []
+        for h in self.regions():
+            if end and h.start_key and h.start_key >= end:
+                break
+            if h.end_key and h.end_key <= start:
+                continue
+            if self.directory is not None:
+                # raw grant read: a published closed_ts is a floor
+                # FOREVER (monotonic across transfers), so even an
+                # expired grant's value safely covers reads at/below it
+                g = self.directory.read_grant(h.id)
+            else:
+                if refresh:
+                    self._invalidate_grant(h.id)
+                g = self._grant(h.id)
+            out.append((int(h.id),
+                        int(g.get("closed_ts", 0)) if g else 0))
+        return out
 
     def scan(self, start: bytes, end: bytes, read_ts: int,
              limit: int = -1) -> list[tuple[bytes, bytes]]:

@@ -19,6 +19,7 @@ from typing import Optional
 from .. import obs
 from ..util import failpoint
 from .mvcc import KeyIsLockedError, KVError, Mutation
+from ..rpc.errors import RPCError
 from .region import Region, RegionError, RegionManager
 
 
@@ -166,6 +167,27 @@ class TwoPhaseCommitter:
         with obs.wait("tso_wait"):
             commit_ts = alloc()
 
+        # over the RANGE tier (RangeRouter exposes txn_done), a
+        # cross-range transaction must hold the pending-commit ledger
+        # open on EVERY participant range until its secondaries are
+        # durable — commits carry done=False and the fan-out below
+        # releases the holds. Single-range traffic (and the in-process
+        # region tier) keeps the retire-on-commit fast path.
+        fanout = getattr(self.rm, "txn_done", None)
+        cross = False
+        if fanout is not None:
+            try:
+                cross = len({self.rm.locate(m.key).id
+                             for m in mutations}) > 1
+            except (RegionError, RPCError):
+                cross = True  # routing unsettled: hold conservatively
+
+        def commit_call(region, keys):
+            if fanout is not None:
+                return self.rm.commit(region, keys, start_ts,
+                                      commit_ts, done=not cross)
+            return self.rm.commit(region, keys, start_ts, commit_ts)
+
         # commit the primary synchronously — the txn is durable
         # once this lands (reference: 2pc.go:741)
         failpoint.inject("twopc/before-commit-primary")
@@ -173,8 +195,7 @@ class TwoPhaseCommitter:
                       span_name="twopc.commit_primary"):
             self._retry_region(
                 primary, resolver,
-                lambda region: self.rm.commit(region, [primary],
-                                              start_ts, commit_ts))
+                lambda region: commit_call(region, [primary]))
         # crash here = committed txn with secondary locks left behind:
         # the resolver must roll them FORWARD from the primary's write
         # record (reference failpoint site: 2pc.go:1027)
@@ -197,11 +218,26 @@ class TwoPhaseCommitter:
                     try:
                         self._retry_region(
                             key, resolver,
-                            lambda region, k=key: self.rm.commit(
-                                region, [k], start_ts, commit_ts))
+                            lambda region, k=key: commit_call(
+                                region, [k]))
                     except (CommitError, KVError):
                         # resolver recovers from the primary's record
                         pass
+        if cross:
+            # every participant's secondaries were driven durable
+            # above: release the ledger holds so each range's
+            # closed_ts may pass commit_ts. Best-effort — a lost
+            # txn_done costs hold-TTL latency, never correctness.
+            done_rids: set = set()
+            for m in mutations:
+                try:
+                    region = self.rm.locate(m.key)
+                except (RegionError, RPCError):
+                    continue
+                if region.id in done_rids:
+                    continue
+                done_rids.add(region.id)
+                fanout(region, start_ts)
         return commit_ts
 
     def rollback(self, mutations: list[Mutation], start_ts: int) -> None:

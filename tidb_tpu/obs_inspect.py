@@ -83,6 +83,16 @@ class DiagnosticsState:
     # wall blocked in backoff.* or lease_wait is a finding (needs
     # performance.wait-profile-enabled for the data to exist)
     dominant_wait_threshold: float = 0.5
+    # a range whose published closed_ts has not advanced for this long
+    # WHILE its write counters moved is range-closed-ts-stall
+    # (warning; critical at 3x — every range-aware replica read over
+    # it is falling back to the leader); 0 disables the rule
+    closed_ts_stall_ms: int = 10000
+    # range-closed-ts-stall memory: range_id -> (closed_ts, wall_ms
+    # first seen at that value, write mark) — the rule needs history
+    # to tell "static" from "just observed" (edge memory like
+    # seen_critical, surviving reseeds)
+    closed_progress: dict = field(default_factory=dict)
     # (rule, item) pairs already reported critical: inspection_finding
     # events fire on NEW members only (edge-triggered, not level)
     seen_critical: set = field(default_factory=set)
@@ -213,6 +223,15 @@ class InspectionContext:
         heat = getattr(storage, "heat", None)
         self.heat_findings = heat.findings() \
             if heat is not None and heat.enabled else []
+        # hosted range rows (closed_ts / max_commit_ts / traffic), one
+        # snapshot per inspection; no range plane armed = no rows = the
+        # range rules stay silent on a healthy single-range server
+        plane = getattr(storage, "ranges", None)
+        try:
+            self.ranges = plane.server.describe() \
+                if plane is not None else []
+        except Exception:  # noqa: BLE001 — plane closing mid-snapshot
+            self.ranges = []
 
     # ---- helpers rules share -------------------------------------------
     def metric(self, labeled_name: str) -> float:
@@ -539,6 +558,56 @@ def _r_range_split_flap(ctx: InspectionContext) -> list[Finding]:
             "range-split-flap", rid, "warning", str(len(evs)),
             f"range {rid} split {len(evs)} times inside {win:.0f}s "
             f"(threshold {thr}); last: {evs[-1]['detail'][:200]}"))
+    return out
+
+
+@rule("range-closed-ts-stall", "warning",
+      "diagnostics.closed-ts-stall-ms — one range's published closed "
+      "timestamp stopped advancing while its writes kept landing: a "
+      "pending-commit ledger entry or an unresolved orphan lock is "
+      "pinning it, and every range-aware replica read touching the "
+      "range falls back to the leader (cluster_info range rows, "
+      "/debug/ranges; tidb_events kind=orphan_resolved shows the "
+      "resolver working the backlog)")
+def _r_range_closed_ts_stall(ctx: InspectionContext) -> list[Finding]:
+    thr = float(ctx.cfg.closed_ts_stall_ms)
+    if thr <= 0 or not ctx.ranges:
+        return []
+    mem = ctx.cfg.closed_progress
+    now_ms = ctx.now * 1000.0
+    out = []
+    live = set()
+    for row in ctx.ranges:
+        rid = str(row.get("range_id", "?"))
+        live.add(rid)
+        closed = int(row.get("closed_ts") or 0)
+        # write progress independent of closed_ts: the commit floor
+        # (always present) plus heat traffic (when armed). An IDLE
+        # range with a static closed_ts is not a stall — there is
+        # nothing to close past.
+        mark = (int(row.get("max_commit_ts") or 0),
+                int(row.get("write_rows") or 0))
+        prev = mem.get(rid)
+        if prev is None or closed != prev[0]:
+            mem[rid] = (closed, now_ms, mark)
+            continue
+        stalled_ms = now_ms - float(prev[1])
+        if mark == prev[2] or stalled_ms < thr:
+            continue
+        sev = "critical" if stalled_ms >= 3 * thr else "warning"
+        out.append(Finding(
+            "range-closed-ts-stall", rid, sev, f"{stalled_ms:.0f}ms",
+            f"range {rid} closed_ts {closed} static for "
+            f"{stalled_ms:.0f}ms while writes advanced "
+            f"(commit floor {prev[2][0]} -> {mark[0]}, threshold "
+            f"{ctx.cfg.closed_ts_stall_ms}ms"
+            + ("; the range cannot close any newer timestamp — "
+               "range-aware replica reads over it are all falling "
+               "back to the leader" if sev == "critical" else "")
+            + "); check for an orphaned lock or a lost txn_done "
+            f"({row.get('pending', 0)} ledger entries pending)"))
+    for rid in [r for r in mem if r not in live]:
+        del mem[rid]
     return out
 
 

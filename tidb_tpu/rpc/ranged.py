@@ -315,15 +315,24 @@ class RangeDirectory:
             grant = {"range_id": int(rid), "owner": owner,
                      "token": (int(g.get("token", 0)) if g else 0) + 1,
                      "term": term, "expires_ms": now + int(lease_ms),
-                     "prev_owner": prev_owner}
+                     "prev_owner": prev_owner,
+                     # the closed-ts FLOOR a successor inherits: the
+                     # predecessor published this value and routed
+                     # reads may already have trusted it, so the new
+                     # leader's closed_ts must never start below it
+                     "closed_ts": int(g.get("closed_ts", 0)) if g
+                     else 0}
             _write_json_atomic(self._grant_path(rid), grant)
             return grant
 
     def renew(self, rid: int, owner: str, token: int,
-              lease_ms: int) -> dict:
+              lease_ms: int, closed_ts: Optional[int] = None) -> dict:
         """Extend our own grant; StaleLeaseError when the grant is no
         longer ours (another process acquired while our lease was
-        expired — the holder must fence itself immediately)."""
+        expired — the holder must fence itself immediately). The lease
+        heartbeat doubles as the closed-ts publication: routers read
+        the grant's closed_ts lock-free to compute read coverage, so
+        the published value only ever ratchets up."""
         with self._flock(os.path.join(self._range_dir(rid),
                                       "lease.lock")):
             g = _read_json(self._grant_path(rid))
@@ -334,6 +343,9 @@ class RangeDirectory:
                     f"token {g and g.get('token')}, not {owner!r} "
                     f"token {token}")
             g["expires_ms"] = _now_ms() + int(lease_ms)
+            if closed_ts is not None:
+                g["closed_ts"] = max(int(g.get("closed_ts", 0)),
+                                     int(closed_ts))
             _write_json_atomic(self._grant_path(rid), g)
             return g
 
@@ -355,15 +367,59 @@ class RangeDirectory:
 class RangeLeader:
     """A range this process leads: its own durable MVCC store (WAL
     replay on open makes takeover lossless for acked commits) plus the
-    lease/fencing state the request gate checks."""
+    lease/fencing state the request gate checks — and the per-range
+    pending-commit LEDGER the closed timestamp is computed from (the
+    PR 11 `closed_info` slot protocol, scoped to this range's 2PC
+    traffic).
+
+    Ledger rules:
+      * a prewrite ENTERS an entry pinned at its start_ts;
+      * commit with done=True (single-range txn, or the coordinator's
+        txn_done already covers it) RETIRES the entry;
+      * commit with done=False (a cross-range participant whose
+        secondaries are not yet durable everywhere) RE-PINS the entry
+        at commit_ts and stamps the wall clock — the closed ts may
+        not pass a half-committed transaction on ANY participant;
+      * rollback / orphan resolution / a txn_done RPC retires;
+      * a commit-pinned entry whose txn_done was lost (coordinator
+        death, partition) self-retires after hold_ms — by then the
+        locks its unresolved secondaries still hold pin the closed ts
+        through the lock union, and resolution retires those.
+
+    The published value is MONOTONIC: max over (ledger ∪ live locks
+    → min-1, else newest commit), floored at the grant's closed_ts —
+    the predecessor's published value after a leader transfer, the
+    parent's after a split handoff. Safety: every published value is
+    ≤ the TSO's current reading at publication (a pending entry pins
+    below its txn's eventual commit_ts; with none, _max_commit is an
+    already-allocated ts), and every future commit_ts allocation is
+    strictly above the TSO — so a later prewrite that dips the
+    candidate can never invalidate an already-published closed ts."""
 
     def __init__(self, spec: RangeSpec, grant: dict, data_dir: str,
-                 sync_log: str = "commit") -> None:
+                 sync_log: str = "commit",
+                 hold_ms: int = 3000) -> None:
         self.spec = spec
         self.grant = dict(grant)
         self.store = MVCCStore(PyOrderedKV(data_dir, sync_log=sync_log))
         self._max_commit = self.store.max_commit_ts()
         self.fenced = False
+        self.hold_ms = int(hold_ms)
+        # ledger entries: start_ts -> [pin_ts, committed_wall_ms or 0]
+        # (plain Lock, not hot-declared: every critical section is a
+        # dict op; closed_ts() is called off the lease tick while
+        # handlers mutate under the leader gate)
+        self._ledger_mu = threading.Lock()
+        self._pending: dict[int, list] = {}
+        # transfer/split floor: never publish below what a predecessor
+        # already published (routers may have trusted it)
+        self._closed = int(grant.get("closed_ts", 0) or 0)
+        # re-derive pending entries from replayed-but-unresolved
+        # prewrites in the per-range WAL: a lock that survived replay
+        # is a transaction whose fate this leader does not know yet
+        for lk in self.store.all_locks():
+            self._pending.setdefault(int(lk.start_ts),
+                                     [int(lk.start_ts), 0.0])
         # split/serve exclusion: every data handler holds this across
         # its fencing check AND its store op, and split_range holds it
         # exclusively while it bumps the epoch and partitions the
@@ -382,15 +438,71 @@ class RangeLeader:
         if commit_ts > self._max_commit:
             self._max_commit = commit_ts
 
+    # ---- pending-commit ledger ----
+    def ledger_enter(self, start_ts: int) -> None:
+        with self._ledger_mu:
+            self._pending.setdefault(int(start_ts),
+                                     [int(start_ts), 0.0])
+
+    def ledger_commit(self, start_ts: int, commit_ts: int,
+                      done: bool) -> None:
+        with self._ledger_mu:
+            if done:
+                self._pending.pop(int(start_ts), None)
+            else:
+                self._pending[int(start_ts)] = [int(commit_ts),
+                                                _now_ms()]
+
+    def ledger_retire(self, start_ts: int) -> None:
+        with self._ledger_mu:
+            self._pending.pop(int(start_ts), None)
+
+    def adopt_handoff(self, floor: int, pending: dict) -> None:
+        """Split handoff: inherit the parent's published floor and its
+        pending entries before this child's closed_ts may advance.
+        Entries for keys the sibling owns are harmless — they only
+        delay closing until the coordinator's txn_done/hold expiry."""
+        with self._ledger_mu:
+            if int(floor) > self._closed:
+                self._closed = int(floor)
+            for ts, ent in dict(pending).items():
+                self._pending.setdefault(int(ts), list(ent))
+
+    def ledger_snapshot(self) -> dict:
+        with self._ledger_mu:
+            return {ts: list(ent)
+                    for ts, ent in self._pending.items()}
+
     def closed_ts(self) -> int:
-        """Everything at or below this ts is settled on this range: one
-        pending prewrite holds it at start_ts-1 (that txn may still
-        commit anywhere above its start), otherwise the newest commit
-        — the per-range pending-commit ledger."""
-        locks = self.store.all_locks()
-        if locks:
-            return min(l.start_ts for l in locks) - 1
-        return self._max_commit
+        """Everything at or below this ts is settled on this range —
+        no routed read at or below it can ever meet an unresolved
+        lock or miss a later-arriving commit."""
+        now = _now_ms()
+        with self._ledger_mu:
+            if self._pending:
+                # lost-txn_done fallback: a commit-pinned entry past
+                # the hold deadline stops pinning (bounded liveness —
+                # any still-unresolved secondary lock keeps pinning
+                # through the lock union below)
+                dead = [ts for ts, (pin, cms) in self._pending.items()
+                        if cms and now - cms > self.hold_ms]
+                for ts in dead:
+                    del self._pending[ts]
+            pins = [pin for pin, _cms in self._pending.values()]
+        pins.extend(lk.start_ts for lk in self.store.all_locks())
+        # an IDLE range still closes forward: every TSO implementation
+        # allocates at or above its wall reading (physical<<18), so
+        # with the cluster's shared/synced clock no future commit_ts
+        # can land at or below (now - margin) — the PR 11 protocol's
+        # min(tso.current(), pending-1) with the wall clock standing
+        # in for the oracle the range tier doesn't own
+        idle = max(self._max_commit,
+                   max(0, int(time.time() * 1000) - 5) << 18)
+        cand = min(pins) - 1 if pins else idle
+        with self._ledger_mu:
+            if cand > self._closed:
+                self._closed = cand
+            return self._closed
 
     def close(self) -> None:
         close = getattr(self.store.kv, "close", None)
@@ -436,10 +548,15 @@ class RangeServer(FrameListener):
                  sync_log: str = "commit", events=None,
                  heat=None, auto_split: bool = False,
                  split_cooldown_ms: int = 10_000,
-                 max_auto_splits: int = 4) -> None:
+                 max_auto_splits: int = 4,
+                 hold_ms: int = 3000) -> None:
         self.directory = RangeDirectory(root)
         self.specs = self.directory.bootstrap(specs)
         self.lease_ms = int(lease_ms)
+        # how long a cross-range commit may hold a range's ledger open
+        # waiting for the coordinator's txn_done (mirrors the orphan
+        # resolve TTL: past it, resolution owns the cleanup)
+        self.hold_ms = int(hold_ms)
         self.events = events
         self._sync_log = str(sync_log)
         # heat-driven auto-split actuator knobs ([ranges] auto-split /
@@ -508,9 +625,12 @@ class RangeServer(FrameListener):
                     self._drop_leader(spec.id, "lease-drop failpoint")
                     continue
                 try:
+                    # the heartbeat publishes the range's closed ts:
+                    # routers read it lock-free off the grant file /
+                    # range_table RPC to compute read coverage
                     leader.grant = self.directory.renew(
                         spec.id, self.address, leader.grant["token"],
-                        self.lease_ms)
+                        self.lease_ms, closed_ts=leader.closed_ts())
                 except (StaleLeaseError, OSError) as e:
                     self._drop_leader(spec.id, f"lease lost: {e}")
             elif spec.id not in embargoed:
@@ -524,13 +644,30 @@ class RangeServer(FrameListener):
         self._recover_splits()
         self._auto_split_tick()
 
-    def _open_leader(self, spec: RangeSpec, grant: dict) -> None:
+    def _open_leader(self, spec: RangeSpec, grant: dict,
+                     floor: int = 0,
+                     pending: Optional[dict] = None) -> None:
         leader = RangeLeader(spec, grant,
                              self.directory.data_dir(spec.id),
-                             sync_log=self._sync_log)
+                             sync_log=self._sync_log,
+                             hold_ms=self.hold_ms)
+        if floor or pending:
+            # split handoff: the parent's published floor + pending
+            # ledger land on the child BEFORE it serves (grant floors
+            # cover leader TRANSFER; a fresh child has no grant
+            # history, so the splitter hands its own down explicitly)
+            leader.adopt_handoff(floor, pending or {})
         with self._mu:
             self._leaders[spec.id] = leader
         obs.RANGE_LEADERS.inc()
+        # publish immediately: until the first heartbeat lands, the
+        # grant would otherwise advertise only the inherited floor
+        try:
+            leader.grant = self.directory.renew(
+                spec.id, self.address, leader.grant["token"],
+                self.lease_ms, closed_ts=leader.closed_ts())
+        except (StaleLeaseError, OSError):
+            pass  # the lease tick will fence or retry
         prev = grant.get("prev_owner", "")
         if prev and prev != self.address:
             obs.RANGE_TRANSFERS.inc()
@@ -587,9 +724,17 @@ class RangeServer(FrameListener):
             failpoint.inject("range/split-before-parent-retire")
             leader.store.discard_range(split_key, right.end_key)
             self.directory.clear_split(rid)
+            # ledger handoff, captured under the gate: BOTH children
+            # inherit the parent's published closed floor and pending
+            # entries before either side's closed_ts may advance (the
+            # left child IS the parent leader and keeps its ledger;
+            # the right child receives a copy at adoption)
+            handoff_floor = leader.closed_ts()
+            handoff_pending = leader.ledger_snapshot()
         self.specs = self.directory.load_specs() or self.specs
         self._note_split(left, right, trigger, advised_by)
-        self._adopt_child(right)
+        self._adopt_child(right, floor=handoff_floor,
+                          pending=handoff_pending)
         return left, right
 
     def _materialize_child(self, parent: RangeLeader,
@@ -620,7 +765,8 @@ class RangeServer(FrameListener):
         finally:
             kv.close()
 
-    def _adopt_child(self, child: RangeSpec) -> None:
+    def _adopt_child(self, child: RangeSpec, floor: int = 0,
+                     pending: Optional[dict] = None) -> None:
         """Serve the fresh child now — its lease is free, its journal
         is cleared, and waiting a lease tick would stall writes to the
         upper half of the just-split keyspace."""
@@ -630,7 +776,7 @@ class RangeServer(FrameListener):
         except OSError:
             g = None
         if g:
-            self._open_leader(child, g)
+            self._open_leader(child, g, floor=floor, pending=pending)
 
     def _note_split(self, left: RangeSpec, right: RangeSpec,
                     trigger: str, advised_by: str = "") -> None:
@@ -702,9 +848,12 @@ class RangeServer(FrameListener):
             failpoint.inject("range/split-before-parent-retire")
             leader.store.discard_range(split_key, right.end_key)
             self.directory.clear_split(rid)
+            handoff_floor = leader.closed_ts()
+            handoff_pending = leader.ledger_snapshot()
         self.specs = self.directory.load_specs() or self.specs
         self._note_split(left, right, trigger)
-        self._adopt_child(right)
+        self._adopt_child(right, floor=handoff_floor,
+                          pending=handoff_pending)
 
     def _auto_split_tick(self) -> None:
         """The heat→split actuator: consume PR 18 range-split-advisory
@@ -833,6 +982,10 @@ class RangeServer(FrameListener):
                 muts, bytes(params["primary"]),
                 int(params["start_ts"]),
                 int(params.get("ttl", 3000))))
+            if out["ok"]:
+                # the prewrite enters this range's pending-commit
+                # ledger; primary-commit/rollback/txn_done retires it
+                leader.ledger_enter(int(params["start_ts"]))
             # the leader-side apply is where a routed write lands on
             # the keyspace heatmap (exactly once: the coordinator's
             # committer carries no recorder over the range tier)
@@ -857,14 +1010,34 @@ class RangeServer(FrameListener):
                 int(params["start_ts"]), commit_ts))
             if out["ok"]:
                 leader.note_commit(commit_ts)
+                # done=False: a cross-range participant — the entry
+                # stays, re-pinned at commit_ts, until every
+                # participant's secondaries are durable and the
+                # coordinator's txn_done (or the hold TTL) retires it.
+                # Absent flag = single-range traffic: retire now.
+                leader.ledger_commit(int(params["start_ts"]),
+                                     commit_ts,
+                                     bool(params.get("done", True)))
         failpoint.inject("range/before-commit-ack")
         return out
 
     def _h_range_rollback(self, params: dict) -> dict:
         with self._gate(params) as leader:
-            return _kv_guarded(lambda: leader.store.rollback(
+            out = _kv_guarded(lambda: leader.store.rollback(
                 [bytes(k) for k in params["keys"]],
                 int(params["start_ts"])))
+            if out["ok"]:
+                leader.ledger_retire(int(params["start_ts"]))
+            return out
+
+    def _h_range_txn_done(self, params: dict) -> dict:
+        """A cross-range transaction's secondaries are durable on every
+        participant: release the ledger hold so closed_ts may pass its
+        commit_ts. Best-effort by design — a lost txn_done is covered
+        by the hold TTL + orphan resolution."""
+        with self._gate(params) as leader:
+            leader.ledger_retire(int(params["start_ts"]))
+            return {"ok": True}
 
     def _h_range_get(self, params: dict) -> dict:
         with self._gate(params) as leader:
@@ -907,7 +1080,14 @@ class RangeServer(FrameListener):
                     int(params["current_ts"]))
                 return {"commit_ts": commit_ts, "expired": expired}
 
-            return _kv_guarded(run)
+            out = _kv_guarded(run)
+            if out["ok"] and (out["v"]["expired"]
+                              or out["v"]["commit_ts"]):
+                # the transaction's fate is decided (rolled back on
+                # expiry / already committed): its ledger entry no
+                # longer guards anything the lock union doesn't
+                leader.ledger_retire(int(params["lock_ts"]))
+            return out
 
     def _h_range_resolve_lock(self, params: dict) -> dict:
         with self._gate(params) as leader:
@@ -916,6 +1096,7 @@ class RangeServer(FrameListener):
                 int(params["commit_ts"])))
             if out["ok"]:
                 obs.RANGE_ORPHAN_RESOLUTIONS.inc()
+                leader.ledger_retire(int(params["start_ts"]))
             return out
 
     def _h_range_split(self, params: dict) -> dict:
@@ -938,7 +1119,9 @@ class RangeServer(FrameListener):
                 grants[int(s.id)] = {"owner": g.get("owner", ""),
                                      "term": int(g.get("term", 0)),
                                      "expires_ms":
-                                         float(g.get("expires_ms", 0))}
+                                         float(g.get("expires_ms", 0)),
+                                     "closed_ts":
+                                         int(g.get("closed_ts", 0))}
         return {"specs": [s.to_wire() for s in specs],
                 "grants": grants}
 
@@ -959,6 +1142,11 @@ class RangeServer(FrameListener):
                         "epoch": leader.spec.epoch,
                         "token": int(leader.grant.get("token", 0)),
                         "closed_ts": leader.closed_ts(),
+                        # commit progress independent of the heat
+                        # plane: the closed-ts-stall rule compares it
+                        # against a static closed_ts
+                        "max_commit_ts": int(leader._max_commit),
+                        "pending": len(leader._pending),
                         "start": leader.spec.start_key.hex(),
                         "end": leader.spec.end_key.hex(),
                         "read_rows": rr, "read_bytes": rb,
@@ -1012,11 +1200,32 @@ class RangePlane:
             heat=getattr(storage, "heat", None),
             auto_split=auto_split,
             split_cooldown_ms=split_cooldown_ms,
-            max_auto_splits=max_auto_splits)
+            max_auto_splits=max_auto_splits,
+            hold_ms=int(resolve_ttl_ms))
 
     def router(self, **kw):
         from ..kv.rangeclient import RangeRouter
         return RangeRouter(root=self.storage.path, **kw)
+
+    def closed_over(self, start: bytes,
+                    end: bytes) -> list[tuple[int, int]]:
+        """Per-range published closed timestamps over [start, end) —
+        the same durable floors RangeRouter.closed_over serves remote
+        readers, read straight off the directory (the plane shares its
+        filesystem root, no client machinery). closed_ts 0 = no grant
+        published yet, which counts as uncovered."""
+        d = self.server.directory
+        specs = d.load_specs() or self.server.specs
+        out: list[tuple[int, int]] = []
+        for s in sorted(specs, key=lambda s: s.start_key):
+            if end and s.start_key and s.start_key >= end:
+                break
+            if s.end_key and s.end_key <= start:
+                continue
+            g = d.read_grant(s.id)
+            out.append((int(s.id),
+                        int(g.get("closed_ts", 0)) if g else 0))
+        return out
 
     def committer(self, tso, **kw):
         from ..kv.twopc import TwoPhaseCommitter
@@ -1034,6 +1243,13 @@ class RangePlane:
             self.server.lease_ms = max(int(lease_ms), 50)
         if resolve_ttl_ms is not None:
             self.resolve_ttl_ms = max(int(resolve_ttl_ms), 1)
+            # the ledger hold mirrors the resolve TTL: past it, orphan
+            # resolution owns the cleanup a lost txn_done left behind
+            self.server.hold_ms = self.resolve_ttl_ms
+            with self.server._mu:
+                leaders = list(self.server._leaders.values())
+            for ld in leaders:
+                ld.hold_ms = self.resolve_ttl_ms
         if auto_split is not None:
             self.server.auto_split = bool(auto_split)
         if split_cooldown_ms is not None:

@@ -71,6 +71,11 @@ class ReplicaReadState:
     # route eligible SELECTs to followers by default (seeds the
     # tidb_replica_read sysvar default; sessions override per-session)
     prefer_follower: bool = False
+    # range-aware covering: before dispatching, require every range the
+    # statement's table spans touch to have published closed_ts >=
+    # read_ts (the per-range ledger floors, rpc/ranged.py). False keeps
+    # today's single-closed-ts routing byte-for-byte
+    range_aware: bool = False
 
 
 # functions whose value depends on WHERE/WHEN they run: routing them
@@ -329,6 +334,71 @@ def _candidates(storage, read_ts: int, max_staleness_ms: int,
     return cands, len(serving)
 
 
+def _range_spans(session, stmt) -> Optional[list]:
+    """[start, end) row-key spans of every base table the statement
+    touches (kv/tablecodec.table_range), or None when one cannot be
+    resolved — then the range gate is inapplicable and routing behaves
+    exactly as without it (the leader errors on the real problem)."""
+    from ..kv.tablecodec import table_range
+    try:
+        tables = session._collect_table_names(stmt)
+    except Exception:  # noqa: BLE001 — gate is advisory, never fatal
+        return None
+    spans = []
+    for t in tables:
+        try:
+            schema = session.catalog.schema(t.db or session.current_db)
+        except KeyError:
+            return None
+        info = schema.tables.get(t.name.lower())
+        if info is None:
+            return None
+        spans.append(table_range(int(info.id)))
+    return spans or None
+
+
+def _range_gate(storage, spans, read_ts: int,
+                budget_s: float = 1.0) -> Optional[dict]:
+    """Range-aware coverage check: the statement's COVERED timestamp is
+    the min published closed_ts over every range its spans touch; a
+    read above it may observe a torn cross-range transaction on a
+    replica (a participant range's secondaries not yet durable), so
+    the router refuses to ship it. Waits bounded (heartbeats publish
+    every lease tick) under the `covered_ts` wait state, then reports
+    which ranges still gate. None = no range plane armed here."""
+    plane = getattr(storage, "ranges", None)
+    if plane is None:
+        return None
+
+    def probe() -> dict:
+        per: dict[int, int] = {}
+        for start, end in spans:
+            for rid, closed in plane.closed_over(start, end):
+                per[rid] = closed
+        return per
+
+    t0 = time.perf_counter()
+    per = probe()
+    if not per:
+        return None
+    gated = sorted((rid, ts) for rid, ts in per.items()
+                   if ts < read_ts)
+    waited = 0.0
+    if gated:
+        with obs.wait("covered_ts"):
+            deadline = t0 + budget_s
+            while time.perf_counter() < deadline:
+                time.sleep(0.02)
+                per = probe()
+                gated = sorted((rid, ts) for rid, ts in per.items()
+                               if ts < read_ts)
+                if not gated:
+                    break
+        waited = (time.perf_counter() - t0) * 1e3
+    return {"covered": not gated, "gated": gated, "n": len(per),
+            "waited_ms": waited}
+
+
 def try_route(session, stmt, sql: Optional[str],
               has_vars: bool = False,
               expect_cols: Optional[int] = None) -> Optional[RoutedRead]:
@@ -374,6 +444,28 @@ def try_route(session, stmt, sql: Optional[str],
         return None  # no serving tier: not a replica-read situation
     term = cluster_term(storage)
     counter = storage.obs.replica_reads
+    if getattr(st, "range_aware", False):
+        spans = _range_spans(session, stmt)
+        gate = _range_gate(storage, spans, read_ts) if spans else None
+        if gate is not None and not gate["covered"]:
+            # typed fallback, same contract as replica staleness: the
+            # leader serves the identical snapshot. The gating ranges
+            # land in the engine tags (EXPLAIN ANALYZE / last_engines)
+            # so "why didn't this route" is answerable per statement.
+            for rid, ts in gate["gated"][:8]:
+                obs.note_engine(f"range#{rid}@gated")
+            counter.inc(outcome="stale_fallback")
+            why = ", ".join(f"range#{rid} closed_ts={ts}"
+                            for rid, ts in gate["gated"][:4])
+            session.add_warning(
+                f"replica read fell back to the leader "
+                f"(stale_fallback): read_ts {read_ts} uncovered on "
+                f"{len(gate['gated'])}/{gate['n']} ranges: {why}"[:512],
+                level="Note")
+            return None
+        if gate is not None and gate["waited_ms"] > 1.0:
+            obs.note_engine(f"ranges@covered(n={gate['n']},"
+                            f"wait={gate['waited_ms']:.0f}ms)")
     stale_reason: Optional[str] = None
     unreachable_reason: Optional[str] = None
     from .diag import _peer_client
@@ -446,6 +538,8 @@ def debug_payload(storage) -> dict:
         "enabled": bool(st is not None and st.enabled),
         "prefer_follower": bool(st is not None and st.prefer_follower),
         "max_staleness_ms": st.max_staleness_ms if st is not None else 0,
+        "range_aware": bool(st is not None
+                            and getattr(st, "range_aware", False)),
         "term": cluster_term(storage),
     }
     try:

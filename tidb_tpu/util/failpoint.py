@@ -43,6 +43,24 @@ from typing import Any, Iterator, Optional
 _lock = threading.Lock()
 _active: dict[str, Any] = {}
 _hits: dict[str, int] = {}
+# Arming-change listeners (rpc/netfault.py): called OUTSIDE _lock after
+# any enable/disable so hot paths can cache "is anything armed" in a
+# plain module flag instead of taking _lock per operation.
+_listeners: list = []
+
+
+def on_change(cb) -> None:
+    with _lock:
+        if cb not in _listeners:
+            _listeners.append(cb)
+
+
+def _notify() -> None:
+    for cb in list(_listeners):
+        try:
+            cb()
+        except Exception:  # noqa: BLE001 — listeners never break arming
+            pass
 
 # The declared registry: every inject() site in tidb_tpu/ names one of
 # these, and every name a test arms (context manager, enable(), or a
@@ -59,6 +77,11 @@ DECLARED = frozenset({
     "kv/group-fsync",              # kv/mvcc.py pre-fsync crash site
     "kv/wal-torn-append",          # kv/mvcc.py torn WAL record
     "mesh/skew",                   # copr/mesh.py synthetic shard skew
+    "net/delay",                   # rpc/netfault.py per-peer frame
+                                   # delay schedule
+    "net/drop",                    # rpc/netfault.py silent frame loss
+    "net/dup",                     # rpc/netfault.py frame duplication
+    "net/partition",               # rpc/netfault.py sym/asym partition
     "range/before-commit-ack",     # rpc/ranged.py commit applied,
                                    # ack not sent (leader-kill site)
     "range/before-prewrite-ack",   # rpc/ranged.py prewrite applied,
@@ -88,17 +111,20 @@ DECLARED = frozenset({
 def enable(name: str, value: Any = True) -> None:
     with _lock:
         _active[name] = value
+    _notify()
 
 
 def disable(name: str) -> None:
     with _lock:
         _active.pop(name, None)
+    _notify()
 
 
 def disable_all() -> None:
     with _lock:
         _active.clear()
         _hits.clear()
+    _notify()
 
 
 def is_enabled(name: str) -> bool:
@@ -225,4 +251,4 @@ arm_from_env()
 
 __all__ = ["DECLARED", "enable", "disable", "disable_all",
            "is_enabled", "inject", "hits", "snapshot", "failpoint",
-           "arm_from_env"]
+           "arm_from_env", "on_change"]
